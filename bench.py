@@ -1,0 +1,102 @@
+"""Benchmark entry point — prints ONE JSON line with the headline metric.
+
+Round-1 metric: in-process engine throughput (infer/sec) on the `simple`
+INT32[16] add/sub conformance model with dynamic batching, concurrency 32 —
+the C-API-style no-network path (reference perf_analyzer's TRITON_C_API
+mode, SURVEY.md §3.5). Later rounds move to the BASELINE.md north star:
+perf_analyzer ips + p99 on ssd_mobilenet_v2 with tpu-shm I/O.
+
+The baseline reference publishes no numbers (BASELINE.md), so vs_baseline is
+reported against the best previously recorded value of this same metric in
+BENCH_HISTORY.json (1.0 on first run).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+
+def bench_inproc_simple(duration_s: float = 5.0, concurrency: int = 32):
+    import numpy as np
+
+    from client_tpu.engine import InferRequest, TpuEngine
+    from client_tpu.models import build_repository
+
+    engine = TpuEngine(build_repository(["simple"]))
+
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+
+    def make_req():
+        return InferRequest(model_name="simple",
+                            inputs={"INPUT0": a, "INPUT1": b})
+
+    # warmup (compile every bucket)
+    for _ in range(8):
+        engine.infer(make_req(), timeout_s=120)
+
+    stop = time.monotonic() + duration_s
+    counts = [0] * concurrency
+    lat_ns: list[int] = []
+    lock = threading.Lock()
+
+    def worker(i):
+        local_lat = []
+        while time.monotonic() < stop:
+            t0 = time.monotonic_ns()
+            engine.infer(make_req(), timeout_s=60)
+            local_lat.append(time.monotonic_ns() - t0)
+            counts[i] += 1
+        with lock:
+            lat_ns.extend(local_lat)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(concurrency)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t_start
+    total = sum(counts)
+    engine.shutdown()
+
+    lat_ns.sort()
+    p99 = lat_ns[int(len(lat_ns) * 0.99) - 1] / 1e3 if lat_ns else 0.0
+    return total / elapsed, p99
+
+
+def main():
+    ips, p99_us = bench_inproc_simple()
+
+    hist_path = os.path.join(os.path.dirname(__file__), "BENCH_HISTORY.json")
+    best = None
+    try:
+        with open(hist_path) as f:
+            hist = json.load(f)
+        best = max(h["value"] for h in hist
+                   if h.get("metric") == "inproc_simple_ips")
+    except Exception:  # noqa: BLE001 — first run
+        hist = []
+    vs = ips / best if best else 1.0
+    hist.append({"metric": "inproc_simple_ips", "value": ips,
+                 "p99_us": p99_us, "ts": time.time()})
+    try:
+        with open(hist_path, "w") as f:
+            json.dump(hist, f, indent=1)
+    except OSError:
+        pass
+
+    print(json.dumps({
+        "metric": "inproc_simple_ips",
+        "value": round(ips, 2),
+        "unit": "infer/sec",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""client_tpu — a TPU-native inference client/serving ecosystem.
+
+A brand-new framework with the capabilities of the Triton Inference Server
+client stack (reference: /root/reference, hmahadik/client): C++/Python client
+libraries speaking the KServe v2 protocol over HTTP and gRPC (sync, async,
+bidirectional streaming), a shared-memory zero-copy tensor I/O data plane in
+which CUDA-IPC regions are replaced by XLA/PjRt TPU-HBM buffer handles
+(``tpu_shared_memory``), an in-process TPU serving engine (JAX/XLA/pjit/Pallas)
+taking the place of the dlopen'd ``libtritonserver.so``, and a perf_analyzer
+equivalent load/latency benchmarking harness.
+
+Package map (mirrors the reference's layer map, SURVEY.md §1):
+
+- ``client_tpu.protocol``  — L1/L2 wire schema: dtypes, BYTES codec, HTTP
+  binary framing, gRPC protos.
+- ``client_tpu.engine``    — L0 in-process TPU serving engine (the
+  ``libtritonserver.so`` equivalent, TPU-first).
+- ``client_tpu.models``    — model zoo (simple add/sub, ResNet50, DenseNet,
+  BERT, SSD-MobileNet, MoE) as JAX/flax modules.
+- ``client_tpu.server``    — HTTP and gRPC network frontends over the engine.
+- ``client_tpu.http`` / ``client_tpu.grpc`` — L3 Python client libraries
+  (API-compatible in spirit with ``tritonclient.http`` / ``tritonclient.grpc``).
+- ``client_tpu.utils``     — dtype helpers, BYTES tensor codec,
+  ``shared_memory`` (POSIX) and ``tpu_shared_memory`` (HBM) utilities.
+- ``client_tpu.perf``      — L5 benchmarking harness (perf_analyzer
+  equivalent: concurrency / request-rate / custom-interval load managers and
+  the stability-searched inference profiler).
+- ``client_tpu.parallel``  — device mesh + sharding helpers for multi-chip
+  serving (tp/dp/sp over ``jax.sharding.Mesh``).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,21 @@
+"""In-process TPU serving engine.
+
+This package is the TPU-native replacement for the piece the reference
+*dlopens* but does not contain — ``libtritonserver.so`` reached through the
+``triton_c_api`` backend (/root/reference/src/c++/perf_analyzer/client_backend/
+triton_c_api/triton_loader.cc:251,899). Design is TPU-first:
+
+- models are JAX callables compiled per batch-bucket (XLA static shapes),
+  executing on a PjRt device set (one chip or a ``jax.sharding.Mesh``);
+- request batching happens on host in per-model schedulers (dynamic batcher
+  with bucketed padding, sequence batcher with correlation-ID routing,
+  ensemble DAG scheduler);
+- I/O buffers can live in TPU HBM (``tpu_shared_memory`` regions) so the
+  network frontends move handles, not bytes.
+
+Public façade: :class:`client_tpu.engine.engine.TpuEngine`.
+"""
+
+from client_tpu.engine.config import ModelConfig, TensorConfig  # noqa: F401
+from client_tpu.engine.engine import TpuEngine  # noqa: F401
+from client_tpu.engine.types import EngineError, InferRequest, InferResponse  # noqa: F401

@@ -1,0 +1,217 @@
+"""Model configuration — the engine-side analog of Triton's ``config.pbtxt``.
+
+Field names deliberately match the reference's model-config schema (the
+in-tree example /root/reference/models/ssd_mobilenet_v2_coco_quantized/
+config.pbtxt and the model_config.proto it instantiates) so configs translate
+1:1, but the native formats here are a Python dict / JSON. A pbtxt loader is
+layered on via protobuf text_format once the proto bindings exist.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from client_tpu.protocol.dtypes import DataType
+
+
+def _norm_dtype(dt: str) -> str:
+    """Accept both bare ('INT32') and proto-enum ('TYPE_INT32') spellings."""
+    if dt.startswith("TYPE_"):
+        dt = dt[len("TYPE_"):]
+    if dt == "STRING":
+        dt = DataType.BYTES
+    if dt not in DataType.ALL:
+        raise ValueError(f"unknown data_type '{dt}'")
+    return dt
+
+
+@dataclass
+class TensorConfig:
+    name: str
+    data_type: str
+    dims: list[int]
+    # Optional server-side reshape (model sees `reshape` dims instead of `dims`).
+    reshape: list[int] | None = None
+    is_shape_tensor: bool = False
+    optional: bool = False
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TensorConfig":
+        return cls(
+            name=d["name"],
+            data_type=_norm_dtype(d["data_type"]),
+            dims=[int(x) for x in d["dims"]],
+            reshape=[int(x) for x in d["reshape"]["shape"]] if "reshape" in d else None,
+            is_shape_tensor=bool(d.get("is_shape_tensor", False)),
+            optional=bool(d.get("optional", False)),
+        )
+
+
+@dataclass
+class DynamicBatchingConfig:
+    preferred_batch_size: list[int] = field(default_factory=list)
+    max_queue_delay_microseconds: int = 0
+
+
+@dataclass
+class SequenceBatchingConfig:
+    # 'direct' (slot-pinned) or 'oldest' (dynamic over active sequences) —
+    # mirrors Triton's two sequence-batcher strategies.
+    strategy: str = "direct"
+    max_sequence_idle_microseconds: int = 1_000_000_000
+
+
+@dataclass
+class EnsembleStep:
+    model_name: str
+    model_version: int = -1
+    input_map: dict[str, str] = field(default_factory=dict)   # model input -> ensemble tensor
+    output_map: dict[str, str] = field(default_factory=dict)  # model output -> ensemble tensor
+
+
+@dataclass
+class ModelConfig:
+    name: str
+    platform: str = "jax"           # 'jax' | 'ensemble' (reference: backend/platform)
+    max_batch_size: int = 0         # 0 = model handles full shapes itself
+    input: list[TensorConfig] = field(default_factory=list)
+    output: list[TensorConfig] = field(default_factory=list)
+    dynamic_batching: DynamicBatchingConfig | None = None
+    sequence_batching: SequenceBatchingConfig | None = None
+    ensemble_scheduling: list[EnsembleStep] = field(default_factory=list)
+    instance_count: int = 1
+    decoupled: bool = False          # model_transaction_policy { decoupled }
+    version: int = 1
+    # Batch buckets the engine pre-compiles; default = powers of two up to
+    # max_batch_size. XLA needs static shapes, so off-bucket batches pad up.
+    batch_buckets: list[int] | None = None
+    parameters: dict[str, Any] = field(default_factory=dict)
+
+    def scheduler_kind(self) -> str:
+        """NONE / DYNAMIC / SEQUENCE / ENSEMBLE(+_SEQUENCE) — the reference's
+        model_parser classification (model_parser.h scheduler types)."""
+        if self.ensemble_scheduling:
+            if self.sequence_batching is not None:
+                return "ENSEMBLE_SEQUENCE"
+            return "ENSEMBLE"
+        if self.sequence_batching is not None:
+            return "SEQUENCE"
+        if self.dynamic_batching is not None:
+            return "DYNAMIC"
+        return "NONE"
+
+    def effective_buckets(self) -> list[int]:
+        if self.max_batch_size <= 0:
+            return [0]
+        if self.batch_buckets:
+            return sorted(set(int(b) for b in self.batch_buckets))
+        buckets, b = [], 1
+        while b < self.max_batch_size:
+            buckets.append(b)
+            b *= 2
+        buckets.append(self.max_batch_size)
+        return buckets
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ModelConfig":
+        db = None
+        if "dynamic_batching" in d:
+            raw = d["dynamic_batching"] or {}
+            db = DynamicBatchingConfig(
+                preferred_batch_size=[int(x) for x in raw.get("preferred_batch_size", [])],
+                max_queue_delay_microseconds=int(raw.get("max_queue_delay_microseconds", 0)),
+            )
+        sb = None
+        if "sequence_batching" in d:
+            raw = d["sequence_batching"] or {}
+            strategy = "oldest" if "oldest" in raw else raw.get("strategy", "direct")
+            sb = SequenceBatchingConfig(
+                strategy=strategy,
+                max_sequence_idle_microseconds=int(
+                    raw.get("max_sequence_idle_microseconds", 1_000_000_000)),
+            )
+        steps = []
+        ens = d.get("ensemble_scheduling")
+        if ens:
+            for s in ens.get("step", []):
+                steps.append(EnsembleStep(
+                    model_name=s["model_name"],
+                    model_version=int(s.get("model_version", -1)),
+                    input_map=dict(s.get("input_map", {})),
+                    output_map=dict(s.get("output_map", {})),
+                ))
+        decoupled = bool(
+            (d.get("model_transaction_policy") or {}).get("decoupled", False))
+        return cls(
+            name=d["name"],
+            platform=d.get("platform", d.get("backend", "jax")),
+            max_batch_size=int(d.get("max_batch_size", 0)),
+            input=[TensorConfig.from_dict(x) for x in d.get("input", [])],
+            output=[TensorConfig.from_dict(x) for x in d.get("output", [])],
+            dynamic_batching=db,
+            sequence_batching=sb,
+            ensemble_scheduling=steps,
+            instance_count=int(
+                (d.get("instance_group") or [{}])[0].get("count", 1)
+                if isinstance(d.get("instance_group"), list)
+                else d.get("instance_group", {}).get("count", 1)),
+            decoupled=decoupled,
+            version=int(d.get("version", 1)),
+            batch_buckets=[int(b) for b in d["batch_buckets"]] if d.get("batch_buckets") else None,
+            parameters=dict(d.get("parameters", {})),
+        )
+
+    def metadata_dict(self, versions: list[str] | None = None) -> dict:
+        """v2 model-metadata JSON (GET /v2/models/<name>)."""
+        def io_md(tc: TensorConfig) -> dict:
+            dims = ([-1] if self.max_batch_size > 0 else []) + list(tc.dims)
+            return {"name": tc.name, "datatype": tc.data_type, "shape": dims}
+
+        return {
+            "name": self.name,
+            "versions": versions or [str(self.version)],
+            "platform": self.platform,
+            "inputs": [io_md(t) for t in self.input],
+            "outputs": [io_md(t) for t in self.output],
+        }
+
+    def config_dict(self) -> dict:
+        """v2 model-config JSON (GET /v2/models/<name>/config)."""
+        out: dict[str, Any] = {
+            "name": self.name,
+            "platform": self.platform,
+            "backend": self.platform,
+            "max_batch_size": self.max_batch_size,
+            "input": [
+                {"name": t.name, "data_type": f"TYPE_{t.data_type}", "dims": t.dims}
+                for t in self.input
+            ],
+            "output": [
+                {"name": t.name, "data_type": f"TYPE_{t.data_type}", "dims": t.dims}
+                for t in self.output
+            ],
+        }
+        if self.dynamic_batching is not None:
+            out["dynamic_batching"] = {
+                "preferred_batch_size": self.dynamic_batching.preferred_batch_size,
+                "max_queue_delay_microseconds":
+                    self.dynamic_batching.max_queue_delay_microseconds,
+            }
+        if self.sequence_batching is not None:
+            out["sequence_batching"] = {"strategy": self.sequence_batching.strategy}
+        if self.ensemble_scheduling:
+            out["ensemble_scheduling"] = {
+                "step": [
+                    {
+                        "model_name": s.model_name,
+                        "model_version": s.model_version,
+                        "input_map": s.input_map,
+                        "output_map": s.output_map,
+                    }
+                    for s in self.ensemble_scheduling
+                ]
+            }
+        if self.decoupled:
+            out["model_transaction_policy"] = {"decoupled": True}
+        return out

@@ -1,0 +1,215 @@
+"""TpuEngine — the in-process serving façade.
+
+This is the API surface the reference reaches through ~45 dlsym-bound
+``TRITONSERVER_*`` entry points (/root/reference/src/c++/perf_analyzer/
+client_backend/triton_c_api/triton_loader.h:83-255): server metadata and
+health, model metadata/config/statistics, repository control, shared-memory
+registration, and inference (sync + callback async). Network frontends
+(``client_tpu.server``) and the in-process perf backend both sit directly on
+this class, so benchmarking without a network is first-class (the reference's
+C-API mode, SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+import client_tpu
+from client_tpu.engine.repository import ModelRepository
+from client_tpu.engine.scheduler import Scheduler, make_scheduler
+from client_tpu.engine.stats import ModelStats
+from client_tpu.engine.types import (
+    EngineError,
+    InferRequest,
+    InferResponse,
+    now_ns,
+)
+
+SERVER_NAME = "client_tpu"
+SERVER_EXTENSIONS = [
+    "classification",
+    "sequence",
+    "model_repository",
+    "model_repository(unload_dependents)",
+    "schedule_policy",
+    "model_configuration",
+    "binary_tensor_data",
+    "parameters",
+    "statistics",
+]
+
+
+class TpuEngine:
+    def __init__(self, repository: ModelRepository | None = None, *,
+                 jit: bool = True, warmup: bool = False,
+                 load_all: bool = True):
+        self.repository = repository or ModelRepository(jit=jit)
+        self._schedulers: dict[str, Scheduler] = {}
+        self._stats: dict[str, ModelStats] = {}
+        self._lock = threading.RLock()
+        self._warmup = warmup
+        self._live = True
+        # Shared-memory managers are attached by client_tpu.shm at startup;
+        # kept as attributes so frontends can reach them uniformly.
+        self.system_shm = None
+        self.tpu_shm = None
+        if load_all:
+            for name in self.repository.names():
+                try:
+                    self.load_model(name)
+                except Exception:
+                    pass  # surfaced via repository index state
+
+    # -- health / metadata ---------------------------------------------------
+
+    def is_live(self) -> bool:
+        return self._live
+
+    def is_ready(self) -> bool:
+        return self._live
+
+    def server_metadata(self) -> dict:
+        # shm extensions are advertised only when a manager is attached.
+        extensions = list(SERVER_EXTENSIONS)
+        if self.system_shm is not None:
+            extensions.append("system_shared_memory")
+        if self.tpu_shm is not None:
+            extensions.append("tpu_shared_memory")
+            extensions.append("cuda_shared_memory")  # wire-parity alias
+        return {
+            "name": SERVER_NAME,
+            "version": client_tpu.__version__,
+            "extensions": extensions,
+        }
+
+    def model_is_ready(self, name: str, version: str = "") -> bool:
+        return self.repository.is_ready(name)
+
+    def _model(self, name: str):
+        model = self.repository.get(name)
+        if model is None:
+            if name in self.repository.names():
+                raise EngineError(f"model '{name}' is not ready", 400)
+            raise EngineError(f"unknown model '{name}'", 404)
+        return model
+
+    def model_metadata(self, name: str, version: str = "") -> dict:
+        return self._model(name).config.metadata_dict()
+
+    def model_config(self, name: str, version: str = "") -> dict:
+        return self._model(name).config.config_dict()
+
+    def model_statistics(self, name: str = "", version: str = "") -> dict:
+        with self._lock:
+            if name:
+                self._model(name)
+                stats = [self._stats[name].to_dict()] if name in self._stats else []
+            else:
+                stats = [s.to_dict() for _, s in sorted(self._stats.items())]
+        return {"model_stats": stats}
+
+    # -- repository control --------------------------------------------------
+
+    def load_model(self, name: str) -> None:
+        model = self.repository.load(name)
+        with self._lock:
+            if name in self._schedulers:
+                return
+            stats = self._stats.get(name)
+            if stats is None:
+                stats = ModelStats(name, str(model.config.version))
+                self._stats[name] = stats
+            from client_tpu.engine.ensemble import EnsembleScheduler
+            from client_tpu.engine.sequence import SequenceScheduler
+
+            self._schedulers[name] = make_scheduler(
+                model, stats,
+                sequence_cls=SequenceScheduler,
+                ensemble_cls=EnsembleScheduler,
+                engine=self,
+            )
+        if self._warmup:
+            model.warmup()
+
+    def unload_model(self, name: str) -> None:
+        with self._lock:
+            sched = self._schedulers.pop(name, None)
+        if sched is not None:
+            sched.stop()
+        self.repository.unload(name)
+
+    def repository_index(self) -> list[dict]:
+        return self.repository.index()
+
+    # -- inference -----------------------------------------------------------
+
+    def async_infer(self, req: InferRequest,
+                    callback: Callable[[InferResponse], None] | None = None) -> None:
+        """Submit; responses arrive on ``req.response_callback`` (or
+        ``callback``). Decoupled models may deliver several."""
+        if callback is not None:
+            req.response_callback = callback
+        if req.response_callback is None:
+            raise EngineError("async_infer requires a response callback", 400)
+        req.times.received = now_ns()
+        with self._lock:
+            sched = self._schedulers.get(req.model_name)
+        if sched is None:
+            # Resolve 404-vs-not-ready and deliver as a response, matching
+            # how the wire protocols surface errors. (A model can be in the
+            # repository but scheduler-less mid-load.)
+            try:
+                self._model(req.model_name)
+                raise EngineError(
+                    f"model '{req.model_name}' is not ready", 400)
+            except EngineError as exc:
+                req.response_callback(InferResponse.make_error(req, exc))
+                return
+        model = sched.model
+        try:
+            if not model.config.ensemble_scheduling:
+                model.validate_inputs(req.inputs,
+                                      batched=model.config.max_batch_size > 0)
+        except EngineError as exc:
+            req.response_callback(InferResponse.make_error(req, exc))
+            return
+        sched.submit(req)
+
+    def infer(self, req: InferRequest, timeout_s: float | None = None) -> InferResponse:
+        """Blocking inference; raises EngineError on failure.
+
+        Decoupled models are rejected here (matching Triton: HTTP infer on a
+        decoupled model is an error) — their N-response streams are only
+        reachable via :meth:`async_infer` / the gRPC stream frontend.
+        """
+        model = self.repository.get(req.model_name)
+        if model is not None and model.config.decoupled:
+            raise EngineError(
+                f"model '{req.model_name}' is decoupled; use streaming "
+                "(async_infer / gRPC stream) to receive its responses", 400)
+        done = threading.Event()
+        box: list[InferResponse] = []
+
+        def _cb(resp: InferResponse) -> None:
+            if resp.final:
+                box.append(resp)
+                done.set()
+
+        self.async_infer(req, _cb)
+        if not done.wait(timeout=timeout_s):
+            raise EngineError("inference timed out", 504)
+        resp = box[0]
+        if resp.error is not None:
+            raise resp.error
+        return resp
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def shutdown(self) -> None:
+        self._live = False
+        with self._lock:
+            scheds = list(self._schedulers.values())
+            self._schedulers.clear()
+        for s in scheds:
+            s.stop()

@@ -1,0 +1,116 @@
+"""Ensemble scheduler: DAG of composing models with tensor-name mapping.
+
+The reference's perf harness understands ensembles only through server
+metadata (composing-model stat rollups, inference_profiler.cc:910-960); the
+actual DAG execution lives in the server the reference dlopens. This is our
+engine-side implementation: steps declare ``input_map``/``output_map`` between
+ensemble-level tensor names and composing-model tensor names; execution walks
+the steps in dependency order, feeding each composing model through the
+engine's own scheduler (so per-composing-model statistics accumulate exactly
+like Triton's ensemble breakdown).
+"""
+
+from __future__ import annotations
+
+from client_tpu.engine.scheduler import Scheduler, _SHUTDOWN
+from client_tpu.engine.types import (
+    EngineError,
+    InferRequest,
+    InferResponse,
+    OutputRequest,
+    now_ns,
+)
+
+
+class EnsembleScheduler(Scheduler):
+    def __init__(self, model, stats, engine=None, **_):
+        if engine is None:
+            raise EngineError("ensemble scheduler needs the engine", 500)
+        self.engine = engine
+        super().__init__(model, stats)
+
+    def _worker_loop(self) -> None:
+        while True:
+            item = self.queue.get()
+            if item is _SHUTDOWN:
+                return
+            req: InferRequest = item
+            if self._check_timeout(req):
+                continue
+            try:
+                self._run_dag(req)
+            except Exception as exc:  # noqa: BLE001
+                self._fail(req, exc)
+
+    def _run_dag(self, req: InferRequest) -> None:
+        cfg = self.model.config
+        req.times.compute_start = now_ns()
+        # Tensor pool starts with the ensemble-level inputs.
+        pool = dict(req.inputs)
+        steps = list(cfg.ensemble_scheduling)
+        pending = steps
+        # Dependency-ordered execution: run any step whose mapped inputs are
+        # all present; repeat. Detects cycles/underfeeding.
+        while pending:
+            progressed = False
+            still = []
+            for step in pending:
+                needed = list(step.input_map.values())
+                if all(n in pool for n in needed):
+                    self._run_step(req, step, pool)
+                    progressed = True
+                else:
+                    still.append(step)
+            pending = still
+            if not progressed and pending:
+                missing = {
+                    n for s in pending for n in s.input_map.values()
+                    if n not in pool
+                }
+                raise EngineError(
+                    f"ensemble '{cfg.name}': unsatisfiable steps; missing "
+                    f"tensors {sorted(missing)}", 500)
+
+        outputs = {}
+        for tc in cfg.output:
+            if tc.name not in pool:
+                raise EngineError(
+                    f"ensemble '{cfg.name}': no step produced output "
+                    f"'{tc.name}'", 500)
+            outputs[tc.name] = pool[tc.name]
+        if req.outputs:
+            requested = {o.name for o in req.outputs}
+            outputs = {k: v for k, v in outputs.items() if k in requested}
+
+        req.times.compute_input_end = req.times.compute_start
+        req.times.compute_infer_end = now_ns()
+        req.times.compute_output_end = req.times.compute_infer_end
+        self.stats.record_execution(1)
+        self.stats.record_request(req.times, success=True)
+        self._respond(req, InferResponse(
+            model_name=req.model_name,
+            model_version=req.model_version or str(cfg.version),
+            request_id=req.request_id,
+            outputs=outputs,
+            times=req.times,
+        ))
+
+    def _run_step(self, req: InferRequest, step, pool: dict) -> None:
+        sub = InferRequest(
+            model_name=step.model_name,
+            model_version="" if step.model_version < 0 else str(step.model_version),
+            request_id=req.request_id,
+            inputs={mi: pool[et] for mi, et in step.input_map.items()},
+            outputs=[OutputRequest(name=mo) for mo in step.output_map],
+            sequence_id=req.sequence_id,
+            sequence_start=req.sequence_start,
+            sequence_end=req.sequence_end,
+            timeout_us=req.timeout_us,
+        )
+        resp = self.engine.infer(sub)
+        for model_out, ensemble_name in step.output_map.items():
+            if model_out not in resp.outputs:
+                raise EngineError(
+                    f"ensemble step '{step.model_name}' did not produce "
+                    f"'{model_out}'", 500)
+            pool[ensemble_name] = resp.outputs[model_out]

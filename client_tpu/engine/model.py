@@ -1,0 +1,216 @@
+"""Model execution: JAX callables compiled per batch bucket.
+
+TPU-first executor design:
+
+- a model backend supplies a *pure* ``apply(inputs) -> outputs`` pytree
+  function (optionally closed over weights) which the engine wraps in
+  ``jax.jit`` once — XLA's jit cache then keys on concrete shapes/dtypes;
+- XLA wants static shapes, so variable client batches are padded up to a
+  small set of pre-declared buckets (powers of two by default,
+  ``ModelConfig.effective_buckets``) before entering the jitted call — this is
+  the TPU answer to Triton's dynamic batch shapes (SURVEY.md §7 hard part 5);
+- inputs move host→HBM via ``jax.device_put`` (or are already device-resident
+  when supplied through ``tpu_shared_memory``), outputs come back as numpy
+  unless the client asked for device placement.
+
+Backends implement the small :class:`ModelBackend` protocol; the model zoo in
+``client_tpu.models`` provides concrete ones.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from client_tpu.engine.config import ModelConfig
+from client_tpu.engine.types import EngineError
+from client_tpu.protocol.dtypes import wire_to_np_dtype
+
+
+class ModelBackend:
+    """Protocol for model implementations.
+
+    Required: ``config`` attribute and :meth:`make_apply`. Decoupled models
+    implement :meth:`generate` instead of/alongside ``make_apply``.
+    """
+
+    config: ModelConfig
+
+    def make_apply(self) -> Callable[[dict], dict]:
+        raise NotImplementedError
+
+    def generate(self, inputs: dict[str, np.ndarray],
+                 parameters: dict[str, Any]) -> Iterator[dict[str, np.ndarray]]:
+        raise EngineError(
+            f"model '{self.config.name}' does not support decoupled execution")
+
+    # Sequence models: apply signature is (state, inputs) -> (state, outputs)
+    # and initial_state() supplies per-sequence state. See sequence.py.
+    def initial_state(self):
+        return None
+
+
+class Model:
+    """A loaded model: backend + jitted executable + bucket padding."""
+
+    def __init__(self, backend: ModelBackend, jit: bool = True):
+        import jax
+
+        self.backend = backend
+        self.config = backend.config
+        self._lock = threading.Lock()
+        self._apply = None
+        self._jitted = False
+        if not self.config.ensemble_scheduling:
+            apply_fn = backend.make_apply()
+            jittable = getattr(backend, "jittable", True)
+            self._jitted = jit and jittable
+            self._apply = jax.jit(apply_fn) if self._jitted else apply_fn
+        self._jax = jax
+
+    # -- shape/validation helpers -------------------------------------------
+
+    def validate_inputs(self, inputs: dict[str, np.ndarray],
+                        batched: bool) -> int:
+        """Check names/dtypes/shapes; returns the request batch size (1 if
+        the model is unbatched)."""
+        cfg = self.config
+        batch = 1
+        declared = {t.name: t for t in cfg.input}
+        for t in cfg.input:
+            if t.name not in inputs:
+                if t.optional:
+                    continue
+                raise EngineError(
+                    f"missing input '{t.name}' for model '{cfg.name}'")
+        for name, arr in inputs.items():
+            tc = declared.get(name)
+            if tc is None:
+                raise EngineError(
+                    f"unexpected input '{name}' for model '{cfg.name}'")
+            want = wire_to_np_dtype(tc.data_type)
+            if tc.data_type != "BYTES" and np.dtype(want) != arr.dtype:
+                raise EngineError(
+                    f"input '{name}': dtype {arr.dtype} != declared "
+                    f"{tc.data_type}")
+            dims = list(tc.dims)
+            shape = list(arr.shape)
+            if cfg.max_batch_size > 0 and batched:
+                if len(shape) != len(dims) + 1:
+                    raise EngineError(
+                        f"input '{name}': expected batched rank {len(dims)+1}, "
+                        f"got shape {shape}")
+                batch = shape[0]
+                shape = shape[1:]
+            if len(shape) != len(dims):
+                raise EngineError(
+                    f"input '{name}': rank mismatch, {shape} vs dims {dims}")
+            for got, want_d in zip(shape, dims):
+                if want_d != -1 and got != want_d:
+                    raise EngineError(
+                        f"input '{name}': shape {shape} incompatible with "
+                        f"dims {dims}")
+        if cfg.max_batch_size > 0 and batch > cfg.max_batch_size:
+            raise EngineError(
+                f"batch size {batch} exceeds max_batch_size "
+                f"{cfg.max_batch_size} for '{cfg.name}'")
+        return batch
+
+    def pick_bucket(self, batch: int) -> int:
+        for b in self.config.effective_buckets():
+            if b >= batch:
+                return b
+        return self.config.max_batch_size
+
+    # -- execution ----------------------------------------------------------
+
+    def execute(self, inputs: dict[str, np.ndarray],
+                batch_size: int | None = None) -> dict[str, np.ndarray]:
+        """Run one (possibly padded) batch through the jitted executable.
+
+        ``batch_size``: true batch before padding; outputs are sliced back.
+        Timing of the three compute phases is the caller's job (scheduler) —
+        this method just stages, runs, and fetches.
+        """
+        if self._apply is None:
+            raise EngineError(
+                f"model '{self.config.name}' is an ensemble; "
+                "execute composing models instead", 500)
+        cfg = self.config
+        pad_to = None
+        if cfg.max_batch_size > 0 and batch_size is not None:
+            pad_to = self.pick_bucket(batch_size)
+
+        staged = {}
+        for name, arr in inputs.items():
+            if arr.dtype == np.object_ or not self._jitted:
+                staged[name] = arr  # BYTES / host models stay host-side
+                continue
+            if pad_to is not None and arr.shape[0] < pad_to:
+                pad_width = [(0, pad_to - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+                arr = np.pad(arr, pad_width)
+            staged[name] = self._jax.device_put(arr)
+
+        outputs = self._apply(staged)
+        if not isinstance(outputs, dict):
+            raise EngineError(
+                f"model '{cfg.name}' returned {type(outputs)}, expected dict", 500)
+
+        host: dict[str, np.ndarray] = {}
+        for name, val in outputs.items():
+            arr = np.asarray(val)
+            if pad_to is not None and batch_size is not None and arr.ndim >= 1 \
+                    and arr.shape[0] == pad_to:
+                arr = arr[:batch_size]
+            host[name] = arr
+        return host
+
+    def execute_stateful(self, state, inputs: dict[str, np.ndarray]):
+        """Sequence-model step: ``apply(state, inputs) -> (state, outputs)``.
+
+        State is an explicit pytree living in HBM between requests; the whole
+        step is jitted, so repeated steps of a sequence reuse one executable.
+        """
+        if self._apply is None:
+            raise EngineError(
+                f"model '{self.config.name}' has no executable", 500)
+        staged = {
+            name: arr if arr.dtype == np.object_ else self._jax.device_put(arr)
+            for name, arr in inputs.items()
+        }
+        new_state, outputs = self._apply(state, staged)
+        if not isinstance(outputs, dict):
+            raise EngineError(
+                f"model '{self.config.name}' returned {type(outputs)}, "
+                "expected dict", 500)
+        host = {name: np.asarray(val) for name, val in outputs.items()}
+        return new_state, host
+
+    def warmup(self) -> None:
+        """Pre-compile every bucket with zero inputs so first real requests
+        don't pay XLA compile latency (first compile ~20-40s on TPU)."""
+        cfg = self.config
+        if self._apply is None:
+            return
+        for bucket in cfg.effective_buckets():
+            inputs = {}
+            for tc in cfg.input:
+                if tc.data_type == "BYTES":
+                    continue
+                dims = [d if d != -1 else 1 for d in tc.dims]
+                shape = ([bucket] if cfg.max_batch_size > 0 else []) + dims
+                inputs[tc.name] = np.zeros(
+                    shape, dtype=wire_to_np_dtype(tc.data_type))
+            if len(inputs) < len([t for t in cfg.input if t.data_type != "BYTES"]):
+                continue
+            try:
+                self.execute(inputs,
+                             batch_size=bucket if cfg.max_batch_size > 0 else None)
+            except EngineError:
+                raise
+            except Exception:
+                # Models with data-dependent preprocessing may reject zeros;
+                # warmup is best-effort.
+                return

@@ -1,0 +1,90 @@
+"""Model repository: registration, load/unload, index.
+
+Mirrors the reference's model-repository control surface
+(LoadModel/UnloadModel/ModelRepositoryIndex, /root/reference/src/c++/library/
+grpc_client.h:195-213) for an in-process engine. Models are registered as
+builder callables so load/unload controls weight residency in HBM.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from client_tpu.engine.model import Model, ModelBackend
+from client_tpu.engine.types import EngineError
+
+
+class ModelRepository:
+    def __init__(self, jit: bool = True):
+        self._builders: dict[str, Callable[[], ModelBackend]] = {}
+        self._loaded: dict[str, Model] = {}
+        self._state: dict[str, tuple[str, str]] = {}  # name -> (state, reason)
+        self._lock = threading.RLock()
+        self._jit = jit
+
+    def register(self, name: str,
+                 builder: Callable[[], ModelBackend]) -> None:
+        with self._lock:
+            self._builders[name] = builder
+            self._state.setdefault(name, ("UNAVAILABLE", "unloaded"))
+
+    def register_backend(self, backend: ModelBackend) -> None:
+        self.register(backend.config.name, lambda: backend)
+
+    def load(self, name: str) -> Model:
+        with self._lock:
+            if name in self._loaded:
+                return self._loaded[name]
+            builder = self._builders.get(name)
+            if builder is None:
+                raise EngineError(f"unknown model '{name}'", 404)
+            self._state[name] = ("LOADING", "")
+        try:
+            model = Model(builder(), jit=self._jit)
+        except Exception as exc:
+            with self._lock:
+                self._state[name] = ("UNAVAILABLE", str(exc))
+            raise
+        with self._lock:
+            self._loaded[name] = model
+            self._state[name] = ("READY", "")
+        return model
+
+    def unload(self, name: str) -> None:
+        with self._lock:
+            if name not in self._builders:
+                raise EngineError(f"unknown model '{name}'", 404)
+            self._loaded.pop(name, None)
+            self._state[name] = ("UNAVAILABLE", "unloaded")
+
+    def get(self, name: str) -> Model | None:
+        with self._lock:
+            return self._loaded.get(name)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._builders)
+
+    def loaded_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._loaded)
+
+    def is_ready(self, name: str) -> bool:
+        with self._lock:
+            return name in self._loaded
+
+    def index(self) -> list[dict]:
+        with self._lock:
+            out = []
+            for name in sorted(self._builders):
+                state, reason = self._state.get(name, ("UNAVAILABLE", ""))
+                version = "1"
+                model = self._loaded.get(name)
+                if model is not None:
+                    version = str(model.config.version)
+                entry = {"name": name, "version": version, "state": state}
+                if reason:
+                    entry["reason"] = reason
+                out.append(entry)
+            return out

@@ -1,0 +1,121 @@
+"""Sequence batcher: stateful-model scheduling by correlation ID.
+
+Reproduces the reference's *inference* sequence semantics (SURVEY.md §5.7):
+requests carry ``sequence_id`` + ``sequence_start``/``sequence_end`` flags
+(/root/reference/src/c++/library/common.h:173-184); all requests of a live
+sequence route to the same model state, in order.
+
+TPU-first state design: sequence state is an explicit JAX pytree threaded
+through a pure ``apply(state, inputs) -> (state, outputs)`` function — no
+hidden module state — so the whole step stays jittable and the state lives in
+HBM between requests. The 'direct' strategy pins each live sequence to a
+serialized execution lane (a per-sequence lock), mirroring the reference's
+1-context-per-sequence concurrency rule
+(concurrency_manager.cc:148-152, 302-335).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from client_tpu.engine.scheduler import Scheduler, _SHUTDOWN
+from client_tpu.engine.types import (
+    EngineError,
+    InferRequest,
+    InferResponse,
+    now_ns,
+)
+
+
+class _SequenceSlot:
+    __slots__ = ("state", "lock", "last_used_ns")
+
+    def __init__(self, state):
+        self.state = state
+        self.lock = threading.Lock()
+        self.last_used_ns = now_ns()
+
+
+class SequenceScheduler(Scheduler):
+    """Routes requests to per-sequence state; executes via the stateful
+    jitted apply."""
+
+    def __init__(self, model, stats):
+        self._slots: dict[int, _SequenceSlot] = {}
+        self._slots_lock = threading.Lock()
+        super().__init__(model, stats)
+
+    def _worker_loop(self) -> None:
+        while True:
+            item = self.queue.get()
+            if item is _SHUTDOWN:
+                return
+            req: InferRequest = item
+            if self._check_timeout(req):
+                continue
+            try:
+                self._run_one(req)
+            except Exception as exc:  # noqa: BLE001
+                self._fail(req, exc)
+
+    def _get_slot(self, req: InferRequest) -> _SequenceSlot:
+        sid = req.sequence_id
+        with self._slots_lock:
+            slot = self._slots.get(sid)
+            if req.sequence_start or slot is None:
+                if slot is None and not req.sequence_start:
+                    raise EngineError(
+                        f"sequence {sid}: request without start flag for an "
+                        "inactive sequence", 400)
+                slot = _SequenceSlot(self.model.backend.initial_state())
+                self._slots[sid] = slot
+            self._gc_idle_locked()
+            return slot
+
+    def _gc_idle_locked(self) -> None:
+        sb = self.model.config.sequence_batching
+        if sb is None:
+            return
+        idle_ns = sb.max_sequence_idle_microseconds * 1000
+        cutoff = now_ns() - idle_ns
+        dead = [sid for sid, s in self._slots.items() if s.last_used_ns < cutoff]
+        for sid in dead:
+            del self._slots[sid]
+
+    def _run_one(self, req: InferRequest) -> None:
+        if req.sequence_id == 0:
+            raise EngineError(
+                f"model '{self.model.config.name}' uses sequence batching; "
+                "requests must carry a non-zero sequence id", 400)
+        slot = self._get_slot(req)
+        start = now_ns()
+        req.times.compute_start = start
+        with slot.lock:  # in-order, one in-flight request per sequence
+            new_state, outputs = self.model.execute_stateful(
+                slot.state, req.inputs)
+            slot.state = new_state
+            slot.last_used_ns = now_ns()
+        if req.sequence_end:
+            with self._slots_lock:
+                self._slots.pop(req.sequence_id, None)
+        req.times.compute_input_end = start
+        req.times.compute_infer_end = now_ns()
+        req.times.compute_output_end = req.times.compute_infer_end
+        self.stats.record_execution(1)
+        if req.outputs:
+            requested = {o.name for o in req.outputs}
+            outputs = {k: v for k, v in outputs.items() if k in requested}
+        self.stats.record_request(req.times, success=True)
+        self._respond(req, InferResponse(
+            model_name=req.model_name,
+            model_version=req.model_version or str(self.model.config.version),
+            request_id=req.request_id,
+            outputs=outputs,
+            times=req.times,
+        ))
+
+    def active_sequences(self) -> int:
+        with self._slots_lock:
+            return len(self._slots)

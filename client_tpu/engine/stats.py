@@ -1,0 +1,93 @@
+"""Per-model server-side statistics.
+
+Exposes the same phase breakdown perf_analyzer differences per measurement
+window in the reference (queue / compute_input / compute_infer /
+compute_output; /root/reference/src/c++/perf_analyzer/inference_profiler.cc:
+836-908), in the v2 statistics JSON shape.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from client_tpu.engine.types import RequestTimes
+
+
+@dataclass
+class _DurationStat:
+    count: int = 0
+    ns: int = 0
+
+    def add(self, ns: int) -> None:
+        self.count += 1
+        self.ns += ns
+
+    def to_dict(self) -> dict:
+        return {"count": self.count, "ns": self.ns}
+
+
+@dataclass
+class ModelStats:
+    model_name: str
+    model_version: str = "1"
+    success: _DurationStat = field(default_factory=_DurationStat)
+    fail: _DurationStat = field(default_factory=_DurationStat)
+    queue: _DurationStat = field(default_factory=_DurationStat)
+    compute_input: _DurationStat = field(default_factory=_DurationStat)
+    compute_infer: _DurationStat = field(default_factory=_DurationStat)
+    compute_output: _DurationStat = field(default_factory=_DurationStat)
+    cache_hit: _DurationStat = field(default_factory=_DurationStat)
+    cache_miss: _DurationStat = field(default_factory=_DurationStat)
+    inference_count: int = 0
+    execution_count: int = 0
+    batch_hist: dict[int, int] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def record_request(self, times: RequestTimes, success: bool,
+                       total_ns: int | None = None) -> None:
+        with self._lock:
+            total = total_ns if total_ns is not None else (
+                times.compute_output_end - times.queue_start)
+            if success:
+                self.success.add(max(0, total))
+                self.queue.add(times.queue_ns)
+                self.compute_input.add(times.compute_input_ns)
+                self.compute_infer.add(times.compute_infer_ns)
+                self.compute_output.add(times.compute_output_ns)
+                self.inference_count += 1
+            else:
+                self.fail.add(max(0, total))
+
+    def record_execution(self, batch_size: int) -> None:
+        with self._lock:
+            self.execution_count += 1
+            self.batch_hist[batch_size] = self.batch_hist.get(batch_size, 0) + 1
+
+    def to_dict(self) -> dict:
+        """v2 `GET /v2/models/<m>/stats` entry."""
+        with self._lock:
+            return {
+                "name": self.model_name,
+                "version": self.model_version,
+                "last_inference": 0,
+                "inference_count": self.inference_count,
+                "execution_count": self.execution_count,
+                "inference_stats": {
+                    "success": self.success.to_dict(),
+                    "fail": self.fail.to_dict(),
+                    "queue": self.queue.to_dict(),
+                    "compute_input": self.compute_input.to_dict(),
+                    "compute_infer": self.compute_infer.to_dict(),
+                    "compute_output": self.compute_output.to_dict(),
+                    "cache_hit": self.cache_hit.to_dict(),
+                    "cache_miss": self.cache_miss.to_dict(),
+                },
+                "batch_stats": [
+                    {
+                        "batch_size": bs,
+                        "compute_infer": {"count": n, "ns": 0},
+                    }
+                    for bs, n in sorted(self.batch_hist.items())
+                ],
+            }

@@ -1,0 +1,114 @@
+"""Engine-internal request/response types and timing.
+
+The timing mirrors the reference's server-side phase breakdown that
+perf_analyzer pulls and differences per window (queue / compute_input /
+compute_infer / compute_output, /root/reference/src/c++/perf_analyzer/
+inference_profiler.cc:836-908).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+
+class EngineError(Exception):
+    """Engine-level failure; carries an HTTP-ish status code for frontends."""
+
+    def __init__(self, message: str, status: int = 400):
+        super().__init__(message)
+        self.status = status
+
+
+def now_ns() -> int:
+    return time.monotonic_ns()
+
+
+@dataclass
+class RequestTimes:
+    """Nanosecond timestamps of the server-side request lifecycle."""
+
+    received: int = 0
+    queue_start: int = 0
+    compute_start: int = 0        # batch assembled; input staging begins
+    compute_input_end: int = 0    # inputs on device
+    compute_infer_end: int = 0    # executable done
+    compute_output_end: int = 0   # outputs staged for the frontend
+
+    @property
+    def queue_ns(self) -> int:
+        return max(0, self.compute_start - self.queue_start)
+
+    @property
+    def compute_input_ns(self) -> int:
+        return max(0, self.compute_input_end - self.compute_start)
+
+    @property
+    def compute_infer_ns(self) -> int:
+        return max(0, self.compute_infer_end - self.compute_input_end)
+
+    @property
+    def compute_output_ns(self) -> int:
+        return max(0, self.compute_output_end - self.compute_infer_end)
+
+
+@dataclass
+class OutputRequest:
+    """What the client asked for per output (classification, shm placement)."""
+
+    name: str
+    classification_count: int = 0
+    shm_region: str | None = None
+    shm_offset: int = 0
+    shm_byte_size: int = 0
+    binary: bool = True
+    parameters: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class InferRequest:
+    model_name: str
+    inputs: dict[str, np.ndarray]
+    model_version: str = ""
+    request_id: str = ""
+    outputs: list[OutputRequest] = field(default_factory=list)
+    parameters: dict[str, Any] = field(default_factory=dict)
+    # Stateful-model sequence routing (reference common.h:173-184).
+    sequence_id: int = 0
+    sequence_start: bool = False
+    sequence_end: bool = False
+    priority: int = 0
+    timeout_us: int = 0
+    times: RequestTimes = field(default_factory=RequestTimes)
+    # Decoupled models invoke this once per streamed response; the final
+    # response (or the only one, for non-decoupled) resolves the future too.
+    response_callback: Callable[["InferResponse"], None] | None = None
+
+    def requested_output_names(self) -> list[str]:
+        return [o.name for o in self.outputs]
+
+
+@dataclass
+class InferResponse:
+    model_name: str
+    model_version: str
+    request_id: str = ""
+    outputs: dict[str, np.ndarray] = field(default_factory=dict)
+    parameters: dict[str, Any] = field(default_factory=dict)
+    error: EngineError | None = None
+    final: bool = True            # False for non-terminal decoupled responses
+    times: RequestTimes | None = None
+
+    @classmethod
+    def make_error(cls, req: InferRequest, exc: Exception) -> "InferResponse":
+        err = exc if isinstance(exc, EngineError) else EngineError(str(exc), 500)
+        return cls(
+            model_name=req.model_name,
+            model_version=req.model_version or "1",
+            request_id=req.request_id,
+            error=err,
+            times=req.times,
+        )

@@ -1,0 +1,536 @@
+"""Python HTTP/REST client library.
+
+API mirrors the reference's ``tritonclient.http``
+(/root/reference/src/python/library/tritonclient/http/__init__.py:131-1421):
+``InferenceServerClient`` with the full control plane, ``InferInput`` /
+``InferRequestedOutput`` / ``InferResult``, sync ``infer`` and pool-based
+``async_infer``. Transport is stdlib ``http.client`` over a connection pool +
+a thread pool (the reference uses gevent greenlets; threads are the
+dependency-free equivalent and the GIL is released during socket I/O).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import queue
+import threading
+import zlib
+from concurrent.futures import ThreadPoolExecutor
+from http.client import HTTPConnection
+from urllib.parse import quote, urlencode
+
+import numpy as np
+
+from client_tpu.protocol import rest
+from client_tpu.protocol.codec import serialize_tensor
+from client_tpu.protocol.dtypes import np_to_wire_dtype, wire_to_np_dtype
+from client_tpu.utils import InferenceServerException, raise_error
+
+
+class InferInput:
+    """An input tensor for an inference request (mirrors reference
+    http/__init__.py:1540-1621 semantics incl. binary vs JSON data and shm)."""
+
+    def __init__(self, name, shape, datatype):
+        self._name = name
+        self._shape = list(shape)
+        self._datatype = datatype
+        self._parameters = {}
+        self._data = None          # JSON-inline list
+        self._raw_data = None      # binary payload bytes
+
+    def name(self):
+        return self._name
+
+    def datatype(self):
+        return self._datatype
+
+    def shape(self):
+        return self._shape
+
+    def set_shape(self, shape):
+        self._shape = list(shape)
+        return self
+
+    def set_data_from_numpy(self, input_tensor, binary_data=True):
+        if not isinstance(input_tensor, np.ndarray):
+            raise_error("input_tensor must be a numpy array")
+        dtype = np_to_wire_dtype(input_tensor.dtype)
+        if self._datatype != dtype and not (
+                self._datatype == "BYTES" and dtype in ("BYTES", None)):
+            raise_error(
+                f"got unexpected datatype {dtype} from numpy array, expected "
+                f"{self._datatype}")
+        valid_shape = list(input_tensor.shape) == self._shape
+        if not valid_shape:
+            raise_error(
+                f"got unexpected numpy array shape [{list(input_tensor.shape)}]"
+                f", expected [{self._shape}]")
+        self._parameters.pop("shared_memory_region", None)
+        self._parameters.pop("shared_memory_byte_size", None)
+        self._parameters.pop("shared_memory_offset", None)
+        if binary_data:
+            self._data = None
+            self._raw_data = serialize_tensor(input_tensor, self._datatype)
+            self._parameters["binary_data_size"] = len(self._raw_data)
+        else:
+            self._raw_data = None
+            self._parameters.pop("binary_data_size", None)
+            if self._datatype == "BYTES":
+                flat = np.ravel(input_tensor, order="C")
+                self._data = [
+                    x.decode("utf-8") if isinstance(x, (bytes, np.bytes_))
+                    else str(x)
+                    for x in flat
+                ]
+            else:
+                self._data = np.ravel(input_tensor, order="C").tolist()
+        return self
+
+    def set_shared_memory(self, region_name, byte_size, offset=0):
+        self._data = None
+        self._raw_data = None
+        self._parameters.pop("binary_data_size", None)
+        self._parameters["shared_memory_region"] = region_name
+        self._parameters["shared_memory_byte_size"] = byte_size
+        if offset:
+            self._parameters["shared_memory_offset"] = offset
+        return self
+
+    def _get_tensor(self):
+        entry = {
+            "name": self._name,
+            "shape": self._shape,
+            "datatype": self._datatype,
+        }
+        if self._parameters:
+            entry["parameters"] = dict(self._parameters)
+        if self._data is not None:
+            entry["data"] = self._data
+        return entry
+
+
+class InferRequestedOutput:
+    """A requested output (classification count, binary flag, shm placement;
+    reference http/__init__.py InferRequestedOutput)."""
+
+    def __init__(self, name, binary_data=True, class_count=0):
+        self._name = name
+        self._parameters = {}
+        if binary_data:
+            self._parameters["binary_data"] = True
+        if class_count:
+            self._parameters["classification"] = class_count
+
+    def name(self):
+        return self._name
+
+    def set_shared_memory(self, region_name, byte_size, offset=0):
+        self._parameters.pop("binary_data", None)
+        self._parameters["shared_memory_region"] = region_name
+        self._parameters["shared_memory_byte_size"] = byte_size
+        if offset:
+            self._parameters["shared_memory_offset"] = offset
+        return self
+
+    def unset_shared_memory(self):
+        self._parameters.pop("shared_memory_region", None)
+        self._parameters.pop("shared_memory_byte_size", None)
+        self._parameters.pop("shared_memory_offset", None)
+        return self
+
+    def _get_tensor(self):
+        entry = {"name": self._name}
+        if self._parameters:
+            entry["parameters"] = dict(self._parameters)
+        return entry
+
+
+class InferResult:
+    """Parsed inference response: JSON head + binary tails mapped by offset
+    (reference http/__init__.py:1768-1962)."""
+
+    def __init__(self, response_body: bytes, header_length: int | None,
+                 verbose: bool = False):
+        self._head, tail = rest.split_body(response_body, header_length)
+        if "error" in self._head:
+            raise InferenceServerException(self._head["error"])
+        self._tensors = {
+            t.name: t
+            for t in rest.parse_tensors(self._head.get("outputs", []), tail)
+        }
+
+    @classmethod
+    def from_response_body(cls, response_body, verbose=False,
+                           header_length=None, content_encoding=None):
+        if content_encoding == "gzip":
+            response_body = gzip.decompress(response_body)
+        elif content_encoding == "deflate":
+            response_body = zlib.decompress(response_body)
+        return cls(response_body, header_length, verbose)
+
+    def as_numpy(self, name):
+        t = self._tensors.get(name)
+        if t is None:
+            return None
+        if "shared_memory_region" in t.parameters:
+            return None  # caller reads from its own region
+        return t.to_numpy()
+
+    def get_output(self, name):
+        t = self._tensors.get(name)
+        if t is None:
+            return None
+        entry = {"name": t.name, "datatype": t.datatype, "shape": t.shape}
+        if t.parameters:
+            entry["parameters"] = t.parameters
+        if t.data is not None:
+            entry["data"] = t.data
+        return entry
+
+    def get_response(self):
+        return self._head
+
+
+class InferAsyncRequest:
+    def __init__(self, future, verbose=False):
+        self._future = future
+
+    def get_result(self, block=True, timeout=None):
+        if not block:
+            if not self._future.done():
+                raise InferenceServerException("result not ready")
+        try:
+            return self._future.result(timeout=timeout)
+        except InferenceServerException:
+            raise
+        except Exception as exc:  # noqa: BLE001
+            raise InferenceServerException(str(exc)) from exc
+
+
+class _ConnectionPool:
+    def __init__(self, host, port, size, timeout):
+        self._host, self._port, self._timeout = host, port, timeout
+        self._pool: queue.LifoQueue = queue.LifoQueue()
+        self._lock = threading.Lock()
+        self._created = 0
+        self._size = size
+
+    def acquire(self) -> HTTPConnection:
+        try:
+            return self._pool.get_nowait()
+        except queue.Empty:
+            pass
+        with self._lock:
+            self._created += 1
+        return HTTPConnection(self._host, self._port, timeout=self._timeout)
+
+    def release(self, conn: HTTPConnection, broken=False):
+        if broken or self._pool.qsize() >= self._size:
+            # enforce the pool bound: excess/broken connections are closed
+            try:
+                conn.close()
+            finally:
+                with self._lock:
+                    self._created -= 1
+            return
+        self._pool.put(conn)
+
+    def close(self):
+        while True:
+            try:
+                self._pool.get_nowait().close()
+            except queue.Empty:
+                return
+
+
+class InferenceServerClient:
+    """HTTP client for the v2 protocol (control plane + inference)."""
+
+    def __init__(self, url, verbose=False, concurrency=1,
+                 connection_timeout=60.0, network_timeout=60.0,
+                 max_greenlets=None, ssl=False, ssl_options=None,
+                 ssl_context_factory=None, insecure=False):
+        if ssl:
+            raise InferenceServerException(
+                "ssl is not supported by this transport yet")
+        if "://" in url:
+            url = url.split("://", 1)[1]
+        host, _, port = url.partition(":")
+        self._host = host
+        self._port = int(port or 80)
+        self._verbose = verbose
+        self._pool = _ConnectionPool(self._host, self._port, concurrency,
+                                     max(connection_timeout, network_timeout))
+        self._executor = ThreadPoolExecutor(max_workers=max(concurrency, 1))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def close(self):
+        self._executor.shutdown(wait=False)
+        self._pool.close()
+
+    # -- low-level ----------------------------------------------------------
+
+    def _request(self, method, path, body=None, headers=None,
+                 query_params=None):
+        headers = dict(headers or {})
+        if query_params:
+            path = path + "?" + urlencode(query_params)
+        conn = self._pool.acquire()
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            self._pool.release(conn)
+        except Exception:
+            self._pool.release(conn, broken=True)
+            raise
+        if self._verbose:
+            print(f"{method} {path}, status {resp.status}")
+        return resp, data
+
+    def _get_json(self, path, query_params=None, headers=None):
+        resp, data = self._request("GET", path, headers=headers,
+                                   query_params=query_params)
+        self._raise_if_error(resp, data)
+        return json.loads(data) if data else {}
+
+    def _post_json(self, path, obj=None, query_params=None, headers=None):
+        body = json.dumps(obj).encode() if obj is not None else b""
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
+        resp, data = self._request(
+            "POST", path, body=body, headers=hdrs,
+            query_params=query_params)
+        self._raise_if_error(resp, data)
+        return json.loads(data) if data else {}
+
+    @staticmethod
+    def _raise_if_error(resp, data):
+        if resp.status >= 400:
+            msg = ""
+            try:
+                msg = json.loads(data).get("error", "")
+            except Exception:  # noqa: BLE001
+                msg = data.decode("utf-8", errors="replace")
+            raise InferenceServerException(msg or f"HTTP {resp.status}",
+                                           status=resp.status)
+
+    # -- health / metadata ---------------------------------------------------
+
+    def is_server_live(self, headers=None, query_params=None):
+        resp, _ = self._request("GET", "/v2/health/live",
+                                query_params=query_params)
+        return resp.status == 200
+
+    def is_server_ready(self, headers=None, query_params=None):
+        resp, _ = self._request("GET", "/v2/health/ready",
+                                query_params=query_params)
+        return resp.status == 200
+
+    def is_model_ready(self, model_name, model_version="", headers=None,
+                       query_params=None):
+        path = f"/v2/models/{quote(model_name)}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        resp, _ = self._request("GET", path + "/ready",
+                                query_params=query_params)
+        return resp.status == 200
+
+    def get_server_metadata(self, headers=None, query_params=None):
+        return self._get_json("/v2", query_params, headers)
+
+    def get_model_metadata(self, model_name, model_version="", headers=None,
+                           query_params=None):
+        path = f"/v2/models/{quote(model_name)}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        return self._get_json(path, query_params, headers)
+
+    def get_model_config(self, model_name, model_version="", headers=None,
+                         query_params=None):
+        path = f"/v2/models/{quote(model_name)}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        return self._get_json(path + "/config", query_params, headers)
+
+    def get_model_repository_index(self, headers=None, query_params=None):
+        return self._post_json("/v2/repository/index", {}, query_params, headers)
+
+    def load_model(self, model_name, headers=None, query_params=None,
+                   config=None, files=None):
+        self._post_json(f"/v2/repository/models/{quote(model_name)}/load",
+                        {}, query_params, headers)
+
+    def unload_model(self, model_name, headers=None, query_params=None,
+                     unload_dependents=False):
+        self._post_json(f"/v2/repository/models/{quote(model_name)}/unload",
+                        {}, query_params, headers)
+
+    def get_inference_statistics(self, model_name="", model_version="",
+                                 headers=None, query_params=None):
+        if model_name:
+            path = f"/v2/models/{quote(model_name)}"
+            if model_version:
+                path += f"/versions/{model_version}"
+            path += "/stats"
+        else:
+            path = "/v2/models/stats"
+        return self._get_json(path, query_params, headers)
+
+    # -- shared memory control ----------------------------------------------
+
+    def get_system_shared_memory_status(self, region_name="", headers=None,
+                                        query_params=None):
+        path = "/v2/systemsharedmemory"
+        if region_name:
+            path += f"/region/{quote(region_name)}"
+        return self._get_json(path + "/status", query_params, headers)
+
+    def register_system_shared_memory(self, name, key, byte_size, offset=0,
+                                      headers=None, query_params=None):
+        self._post_json(
+            f"/v2/systemsharedmemory/region/{quote(name)}/register",
+            {"key": key, "offset": offset, "byte_size": byte_size},
+            query_params, headers)
+
+    def unregister_system_shared_memory(self, name="", headers=None,
+                                        query_params=None):
+        path = "/v2/systemsharedmemory"
+        if name:
+            path += f"/region/{quote(name)}"
+        self._post_json(path + "/unregister", {}, query_params, headers)
+
+    def get_tpu_shared_memory_status(self, region_name="", headers=None,
+                                     query_params=None):
+        path = "/v2/tpusharedmemory"
+        if region_name:
+            path += f"/region/{quote(region_name)}"
+        return self._get_json(path + "/status", query_params, headers)
+
+    def register_tpu_shared_memory(self, name, raw_handle, device_id,
+                                   byte_size, headers=None,
+                                   query_params=None):
+        """Register a TPU-HBM region by serialized buffer handle — the
+        TPU-native replacement for register_cuda_shared_memory (reference
+        cuda_shared_memory base64 handle transport)."""
+        self._post_json(
+            f"/v2/tpusharedmemory/region/{quote(name)}/register",
+            {"raw_handle": {"b64": raw_handle}, "device_id": device_id,
+             "byte_size": byte_size},
+            query_params, headers)
+
+    def unregister_tpu_shared_memory(self, name="", headers=None,
+                                     query_params=None):
+        path = "/v2/tpusharedmemory"
+        if name:
+            path += f"/region/{quote(name)}"
+        self._post_json(path + "/unregister", {}, query_params, headers)
+
+    # CUDA-named aliases for drop-in compatibility with reference clients:
+    get_cuda_shared_memory_status = get_tpu_shared_memory_status
+    register_cuda_shared_memory = register_tpu_shared_memory
+    unregister_cuda_shared_memory = unregister_tpu_shared_memory
+
+    # -- inference -----------------------------------------------------------
+
+    @staticmethod
+    def generate_request_body(inputs, outputs=None, request_id="",
+                              sequence_id=0, sequence_start=False,
+                              sequence_end=False, priority=0, timeout=None,
+                              parameters=None):
+        """Build (body, header_length) without sending — mirrors the
+        reference's static generate_request_body (http/__init__.py:1015)."""
+        params = dict(parameters or {})
+        if outputs is None:
+            # No explicit outputs: ask the server for binary encoding of all
+            # outputs (matches the reference client's default, which sets
+            # binary_data_output when outputs are unspecified).
+            params.setdefault("binary_data_output", True)
+        if sequence_id:
+            params["sequence_id"] = sequence_id
+            params["sequence_start"] = sequence_start
+            params["sequence_end"] = sequence_end
+        if priority:
+            params["priority"] = priority
+        if timeout is not None:
+            params["timeout"] = timeout
+        tensor_entries = [(i._get_tensor(), i._raw_data) for i in inputs]
+        out_entries = [o._get_tensor() for o in outputs] if outputs else None
+        body, jlen = rest.build_infer_request_body(
+            tensor_entries, out_entries, request_id=request_id,
+            parameters=params or None)
+        has_binary = any(raw is not None for _, raw in tensor_entries)
+        return body, (jlen if has_binary else None)
+
+    @staticmethod
+    def parse_response_body(response_body, verbose=False, header_length=None,
+                            content_encoding=None):
+        return InferResult.from_response_body(
+            response_body, verbose, header_length, content_encoding)
+
+    def _infer_request(self, model_name, model_version, body, header_length,
+                       headers, query_params, request_compression_algorithm,
+                       response_compression_algorithm):
+        req_headers = dict(headers or {})
+        if header_length is not None:
+            req_headers[rest.HEADER_INFERENCE_CONTENT_LENGTH] = str(header_length)
+        if request_compression_algorithm == "gzip":
+            body = gzip.compress(body)
+            req_headers["Content-Encoding"] = "gzip"
+        elif request_compression_algorithm == "deflate":
+            body = zlib.compress(body)
+            req_headers["Content-Encoding"] = "deflate"
+        if response_compression_algorithm in ("gzip", "deflate"):
+            req_headers["Accept-Encoding"] = response_compression_algorithm
+
+        path = f"/v2/models/{quote(model_name)}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        path += "/infer"
+        resp, data = self._request("POST", path, body=body,
+                                   headers=req_headers,
+                                   query_params=query_params)
+        encoding = resp.getheader("Content-Encoding")
+        if encoding == "gzip":
+            data = gzip.decompress(data)
+        elif encoding == "deflate":
+            data = zlib.decompress(data)
+        self._raise_if_error(resp, data)
+        hdr = resp.getheader(rest.HEADER_INFERENCE_CONTENT_LENGTH)
+        return InferResult(data, int(hdr) if hdr is not None else None,
+                           self._verbose)
+
+    def infer(self, model_name, inputs, model_version="", outputs=None,
+              request_id="", sequence_id=0, sequence_start=False,
+              sequence_end=False, priority=0, timeout=None, headers=None,
+              query_params=None, request_compression_algorithm=None,
+              response_compression_algorithm=None, parameters=None):
+        body, header_length = self.generate_request_body(
+            inputs, outputs, request_id, sequence_id, sequence_start,
+            sequence_end, priority, timeout, parameters)
+        return self._infer_request(
+            model_name, model_version, body, header_length, headers,
+            query_params, request_compression_algorithm,
+            response_compression_algorithm)
+
+    def async_infer(self, model_name, inputs, model_version="", outputs=None,
+                    request_id="", sequence_id=0, sequence_start=False,
+                    sequence_end=False, priority=0, timeout=None,
+                    headers=None, query_params=None,
+                    request_compression_algorithm=None,
+                    response_compression_algorithm=None, parameters=None):
+        body, header_length = self.generate_request_body(
+            inputs, outputs, request_id, sequence_id, sequence_start,
+            sequence_end, priority, timeout, parameters)
+        future = self._executor.submit(
+            self._infer_request, model_name, model_version, body,
+            header_length, headers, query_params,
+            request_compression_algorithm, response_compression_algorithm)
+        return InferAsyncRequest(future, self._verbose)

@@ -1,0 +1,61 @@
+"""Model zoo for the TPU serving engine.
+
+Conformance models (the reference's examples assert exact values against the
+server's `simple*` family — e.g. add/sub INT32[16] checks in
+/root/reference/src/c++/examples/simple_grpc_infer_client.cc:337):
+
+- ``simple``            — INT32[16] add/sub (batched, dynamic batching)
+- ``simple_string``     — BYTES decimal add/sub
+- ``simple_identity``   — BYTES passthrough
+- ``simple_sequence``   — stateful accumulator (sequence batching)
+- ``simple_repeat``     — decoupled/streaming repeat
+- ``simple_dyna_sequence`` — sequence + additive correlation-id semantics
+
+Flagship models (BASELINE.md configs): ``resnet50``, ``densenet_onnx``
+(DenseNet-121), ``bert_base``, ``ssd_mobilenet_v2_coco_quantized``, plus the
+``ensemble_bert`` preprocess→BERT→postprocess pipeline.
+
+All are JAX/flax, bfloat16 on the MXU where it matters.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from client_tpu.engine.model import ModelBackend
+from client_tpu.engine.repository import ModelRepository
+
+_REGISTRY: dict[str, Callable[[], ModelBackend]] = {}
+
+
+def register_model(name: str):
+    def deco(builder: Callable[[], ModelBackend]):
+        _REGISTRY[name] = builder
+        return builder
+    return deco
+
+
+def model_names() -> list[str]:
+    _import_all()
+    return sorted(_REGISTRY)
+
+
+def build_repository(names: list[str] | None = None,
+                     jit: bool = True) -> ModelRepository:
+    """Repository with the requested zoo models registered (all by default)."""
+    _import_all()
+    repo = ModelRepository(jit=jit)
+    for name, builder in _REGISTRY.items():
+        if names is None or name in names:
+            repo.register(name, builder)
+    return repo
+
+
+def _import_all() -> None:
+    from client_tpu.models import simple  # noqa: F401
+
+    for mod in ("vision", "bert", "ssd", "ensembles"):
+        try:
+            __import__(f"client_tpu.models.{mod}")
+        except ImportError:
+            pass

@@ -1,0 +1,10 @@
+"""Device-mesh + sharding utilities for multi-chip serving and training.
+
+TPU-native distribution: pick a ``jax.sharding.Mesh``, annotate shardings
+with ``NamedSharding``/``PartitionSpec``, and let XLA insert the collectives
+(psum/all_gather/reduce_scatter ride ICI). This replaces the reference's
+client↔server transports for the *device-side* data plane (SURVEY.md §2.9:
+the reference has no NCCL/MPI; its transports map per §5.8).
+"""
+
+from client_tpu.parallel.mesh import make_mesh, mesh_axes  # noqa: F401

@@ -1,0 +1,60 @@
+"""Mesh construction helpers.
+
+Standard axis vocabulary used across the framework:
+
+- ``dp`` — data parallel (batch dimension)
+- ``sp`` — sequence/context parallel (sequence dimension)
+- ``tp`` — tensor parallel (hidden/heads dimensions)
+- ``pp`` — pipeline parallel (layer stages)
+- ``ep`` — expert parallel (MoE experts)
+
+Meshes are built over however many devices the runtime exposes — one real
+TPU chip, a v5e-8 slice, or N virtual CPU devices for tests/dry runs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _factor(n: int, ways: int) -> list[int]:
+    """Greedy near-balanced factorization of n into `ways` factors."""
+    dims = [1] * ways
+    remaining = n
+    i = ways - 1
+    while remaining > 1 and i >= 0:
+        # largest power-of-two-ish divisor step: prefer 2s
+        f = 2 if remaining % 2 == 0 else remaining
+        dims[i] *= f
+        remaining //= f
+        i = (i - 1) if i > 0 else ways - 1
+    return dims
+
+
+def mesh_axes(n_devices: int,
+              axes: tuple[str, ...] = ("dp", "sp", "tp")) -> dict[str, int]:
+    """Pick per-axis sizes whose product is n_devices."""
+    dims = _factor(n_devices, len(axes))
+    assert int(np.prod(dims)) == n_devices, (dims, n_devices)
+    return dict(zip(axes, dims))
+
+
+def make_mesh(n_devices: int | None = None,
+              axes: tuple[str, ...] = ("dp", "sp", "tp"),
+              axis_sizes: dict[str, int] | None = None):
+    """Build a Mesh over the first n_devices devices."""
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices > len(devices):
+        raise ValueError(
+            f"requested {n_devices} devices, runtime has {len(devices)}")
+    sizes = axis_sizes or mesh_axes(n_devices, axes)
+    shape = tuple(sizes[a] for a in axes)
+    dev_array = mesh_utils.create_device_mesh(
+        shape, devices=devices[:n_devices])
+    return Mesh(dev_array, axes)

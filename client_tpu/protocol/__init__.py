@@ -1,0 +1,18 @@
+"""Wire-level schema for the KServe v2 inference protocol.
+
+Pure, dependency-light building blocks shared by clients and servers:
+
+- :mod:`client_tpu.protocol.dtypes` — the v2 datatype table and numpy mapping.
+- :mod:`client_tpu.protocol.codec` — BYTES tensor codec and raw tensor
+  (de)serialization.
+- :mod:`client_tpu.protocol.rest` — HTTP/REST JSON + binary-extension framing.
+
+Everything here is fully unit-testable with no server (SURVEY.md §7 step 1).
+"""
+
+from client_tpu.protocol.dtypes import (  # noqa: F401
+    DataType,
+    dtype_byte_size,
+    np_to_wire_dtype,
+    wire_to_np_dtype,
+)

@@ -1,0 +1,107 @@
+"""Tensor (de)serialization codecs.
+
+Two codecs, both wire-compatible with the reference implementations:
+
+- BYTES tensors: each element is a 4-byte little-endian length prefix followed
+  by the raw bytes (reference:
+  /root/reference/src/python/library/tritonclient/utils/__init__.py:187-271,
+  /root/reference/src/c++/perf_analyzer/perf_utils.h:122-129,
+  /root/reference/src/java/.../BinaryProtocol.java:92-104).
+- Fixed-size tensors: row-major raw bytes in the tensor's natural dtype.
+
+Plus base64 helpers used for device-handle transport over JSON control planes
+(the reference base64-encodes ``cudaIpcMemHandle_t`` for HTTP registration,
+/root/reference/src/python/library/tritonclient/utils/cuda_shared_memory/
+cuda_shared_memory.cc:100-123; we do the same for TPU buffer handles).
+"""
+
+from __future__ import annotations
+
+import base64
+import struct
+
+import numpy as np
+
+from client_tpu.protocol.dtypes import DataType, np_to_wire_dtype, wire_to_np_dtype
+
+
+def serialize_bytes_tensor(tensor: np.ndarray) -> bytes:
+    """Serialize a BYTES tensor (dtype object/bytes/str) to the 4B-LE-prefixed
+    flattened wire form. Row-major ('C') element order."""
+    if tensor.size == 0:
+        return b""
+    flat = np.ravel(tensor, order="C")
+    out = bytearray()
+    for item in flat:
+        if isinstance(item, (bytes, bytearray)):
+            raw = bytes(item)
+        elif isinstance(item, str):
+            raw = item.encode("utf-8")
+        elif isinstance(item, np.bytes_):
+            raw = bytes(item)
+        else:
+            raw = str(item).encode("utf-8")
+        out += struct.pack("<I", len(raw))
+        out += raw
+    return bytes(out)
+
+
+def deserialize_bytes_tensor(encoded: bytes, count: int | None = None) -> np.ndarray:
+    """Inverse of :func:`serialize_bytes_tensor` -> 1-D object ndarray of bytes.
+
+    The caller reshapes to the wire shape. ``count`` (if given) bounds the
+    number of elements parsed (used when a shm region is larger than the
+    tensor, reference shared_memory/__init__.py:211-227).
+    """
+    items = []
+    offset = 0
+    n = len(encoded)
+    while offset + 4 <= n:
+        if count is not None and len(items) >= count:
+            break
+        (length,) = struct.unpack_from("<I", encoded, offset)
+        offset += 4
+        if offset + length > n:
+            raise ValueError(
+                f"malformed BYTES tensor: element length {length} at offset "
+                f"{offset - 4} overruns buffer of {n} bytes"
+            )
+        items.append(encoded[offset : offset + length])
+        offset += length
+    return np.array(items, dtype=np.object_)
+
+
+def serialize_tensor(tensor: np.ndarray, wire_dtype: str | None = None) -> bytes:
+    """Any tensor -> raw wire bytes (BYTES codec or row-major raw)."""
+    if wire_dtype is None:
+        wire_dtype = np_to_wire_dtype(tensor.dtype)
+    if wire_dtype == DataType.BYTES:
+        return serialize_bytes_tensor(tensor)
+    want = wire_to_np_dtype(wire_dtype)
+    arr = np.ascontiguousarray(tensor, dtype=want)
+    return arr.tobytes()
+
+
+def deserialize_tensor(raw: bytes, wire_dtype: str, shape) -> np.ndarray:
+    """Raw wire bytes -> ndarray of the given v2 dtype and shape."""
+    shape = tuple(int(d) for d in shape)
+    if wire_dtype == DataType.BYTES:
+        n = 1
+        for d in shape:
+            n *= d
+        arr = deserialize_bytes_tensor(raw, count=n)
+        return arr.reshape(shape)
+    np_dtype = wire_to_np_dtype(wire_dtype)
+    if np_dtype is None:
+        raise ValueError(f"unknown datatype '{wire_dtype}'")
+    arr = np.frombuffer(raw, dtype=np_dtype)
+    return arr.reshape(shape)
+
+
+def b64_encode_handle(raw: bytes) -> str:
+    """Opaque device/shm handle -> base64 ascii for JSON transport."""
+    return base64.b64encode(raw).decode("ascii")
+
+
+def b64_decode_handle(encoded: str) -> bytes:
+    return base64.b64decode(encoded)

@@ -1,0 +1,138 @@
+"""KServe v2 datatype table and numpy mapping.
+
+Behavioral contract mirrors the reference's dtype tables
+(/root/reference/src/python/library/tritonclient/utils/__init__.py:127-186 and
+/root/reference/src/c++/perf_analyzer/perf_utils.cc element-size helpers), but
+adds BF16 as a first-class citizen because it is the native TPU matmul type.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax; keeps this module importable without jax.
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+
+class DataType:
+    """String constants for the v2 wire datatypes."""
+
+    BOOL = "BOOL"
+    UINT8 = "UINT8"
+    UINT16 = "UINT16"
+    UINT32 = "UINT32"
+    UINT64 = "UINT64"
+    INT8 = "INT8"
+    INT16 = "INT16"
+    INT32 = "INT32"
+    INT64 = "INT64"
+    FP16 = "FP16"
+    FP32 = "FP32"
+    FP64 = "FP64"
+    BYTES = "BYTES"
+    BF16 = "BF16"
+
+    ALL = (
+        BOOL, UINT8, UINT16, UINT32, UINT64, INT8, INT16, INT32, INT64,
+        FP16, FP32, FP64, BYTES, BF16,
+    )
+
+
+_NP_TO_WIRE = {
+    np.dtype(np.bool_): DataType.BOOL,
+    np.dtype(np.uint8): DataType.UINT8,
+    np.dtype(np.uint16): DataType.UINT16,
+    np.dtype(np.uint32): DataType.UINT32,
+    np.dtype(np.uint64): DataType.UINT64,
+    np.dtype(np.int8): DataType.INT8,
+    np.dtype(np.int16): DataType.INT16,
+    np.dtype(np.int32): DataType.INT32,
+    np.dtype(np.int64): DataType.INT64,
+    np.dtype(np.float16): DataType.FP16,
+    np.dtype(np.float32): DataType.FP32,
+    np.dtype(np.float64): DataType.FP64,
+    np.dtype(np.object_): DataType.BYTES,
+    np.dtype(np.bytes_): DataType.BYTES,
+}
+if _BF16 is not None:
+    _NP_TO_WIRE[_BF16] = DataType.BF16
+
+_WIRE_TO_NP = {
+    DataType.BOOL: np.bool_,
+    DataType.UINT8: np.uint8,
+    DataType.UINT16: np.uint16,
+    DataType.UINT32: np.uint32,
+    DataType.UINT64: np.uint64,
+    DataType.INT8: np.int8,
+    DataType.INT16: np.int16,
+    DataType.INT32: np.int32,
+    DataType.INT64: np.int64,
+    DataType.FP16: np.float16,
+    DataType.FP32: np.float32,
+    DataType.FP64: np.float64,
+    DataType.BYTES: np.object_,
+}
+if _BF16 is not None:
+    _WIRE_TO_NP[DataType.BF16] = _BF16
+
+# Fixed per-element byte sizes; BYTES is variable-length (-1 sentinel), matching
+# the reference convention (perf_utils lets BYTES size come from the data).
+_BYTE_SIZE = {
+    DataType.BOOL: 1,
+    DataType.UINT8: 1,
+    DataType.UINT16: 2,
+    DataType.UINT32: 4,
+    DataType.UINT64: 8,
+    DataType.INT8: 1,
+    DataType.INT16: 2,
+    DataType.INT32: 4,
+    DataType.INT64: 8,
+    DataType.FP16: 2,
+    DataType.BF16: 2,
+    DataType.FP32: 4,
+    DataType.FP64: 8,
+    DataType.BYTES: -1,
+}
+
+
+def np_to_wire_dtype(np_dtype) -> str | None:
+    """numpy dtype (or anything np.dtype accepts) -> v2 wire name, or None."""
+    if np_dtype is bytes or np_dtype is str:
+        return DataType.BYTES
+    dt = np.dtype(np_dtype)
+    if dt.kind in ("S", "U"):
+        return DataType.BYTES
+    return _NP_TO_WIRE.get(dt)
+
+
+def wire_to_np_dtype(wire: str):
+    """v2 wire name -> numpy dtype class (np.object_ for BYTES), or None."""
+    return _WIRE_TO_NP.get(wire)
+
+
+def dtype_byte_size(wire: str) -> int:
+    """Per-element size in bytes; -1 for variable-length BYTES."""
+    try:
+        return _BYTE_SIZE[wire]
+    except KeyError:
+        raise ValueError(f"unknown datatype '{wire}'") from None
+
+
+def element_count(shape) -> int:
+    """Number of elements for a shape; 0-d means 1."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def tensor_byte_size(wire: str, shape) -> int:
+    """Fixed-size tensor byte size; raises for BYTES (variable)."""
+    per = dtype_byte_size(wire)
+    if per < 0:
+        raise ValueError("BYTES tensors have data-dependent size")
+    return per * element_count(shape)

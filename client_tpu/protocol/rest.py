@@ -1,0 +1,196 @@
+"""HTTP/REST framing for the KServe v2 inference protocol.
+
+Implements the JSON + binary-tensor-extension body format used by the
+reference on both directions of ``POST /v2/models/<m>[/versions/<v>]/infer``:
+
+- request: JSON head (inputs/outputs metadata), each input may carry a
+  ``binary_data_size`` parameter and append its raw bytes, in input order,
+  after the JSON head (reference http_client.cc:301-434,
+  python http/__init__.py:81-128).
+- the ``Inference-Header-Content-Length`` header carries the JSON head length
+  so the peer can split head from binary tail (http_client.cc:1396-1407).
+- response: mirrored — outputs with ``binary_data_size`` parameters are mapped
+  by walking offsets in parameter order (http_client.cc:752-835,
+  python http/__init__.py:1768-1962).
+
+These builders/parsers are shared by the Python client, the HTTP server
+frontend, and the conformance tests, so a single implementation defines the
+wire contract.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from client_tpu.protocol.codec import deserialize_tensor, serialize_tensor
+from client_tpu.protocol.dtypes import DataType
+
+HEADER_INFERENCE_CONTENT_LENGTH = "Inference-Header-Content-Length"
+
+
+@dataclass
+class WireTensor:
+    """One input/output tensor as it appears on the wire."""
+
+    name: str
+    datatype: str | None = None
+    shape: list[int] | None = None
+    parameters: dict[str, Any] = field(default_factory=dict)
+    # Exactly one of the following is populated:
+    data: list | None = None      # JSON-inline representation
+    raw: bytes | None = None      # binary extension payload
+
+    def to_numpy(self) -> np.ndarray:
+        if self.raw is not None:
+            return deserialize_tensor(self.raw, self.datatype, self.shape)
+        if self.data is None:
+            raise ValueError(f"tensor '{self.name}' carries no data")
+        if self.datatype == DataType.BYTES:
+            flat = _flatten(self.data)
+            arr = np.array(
+                [x.encode("utf-8") if isinstance(x, str) else bytes(x) for x in flat],
+                dtype=np.object_,
+            )
+            return arr.reshape(self.shape)
+        from client_tpu.protocol.dtypes import wire_to_np_dtype
+
+        return np.array(self.data, dtype=wire_to_np_dtype(self.datatype)).reshape(
+            self.shape
+        )
+
+
+def _flatten(lst):
+    out = []
+    stack = [lst]
+    while stack:
+        item = stack.pop()
+        if isinstance(item, list):
+            stack.extend(reversed(item))
+        else:
+            out.append(item)
+    return out
+
+
+def _json_safe(arr: np.ndarray, datatype: str) -> list:
+    if datatype == DataType.BYTES:
+        flat = np.ravel(arr, order="C")
+        return [
+            x.decode("utf-8", errors="replace") if isinstance(x, (bytes, np.bytes_)) else str(x)
+            for x in flat
+        ]
+    return np.ravel(arr, order="C").tolist()
+
+
+def build_tensor_json(
+    name: str,
+    arr: np.ndarray | None,
+    datatype: str,
+    shape,
+    *,
+    binary: bool = True,
+    parameters: dict | None = None,
+) -> tuple[dict, bytes | None]:
+    """Build the JSON dict + optional binary payload for one request input."""
+    entry: dict[str, Any] = {
+        "name": name,
+        "datatype": datatype,
+        "shape": [int(d) for d in shape],
+    }
+    params = dict(parameters or {})
+    raw = None
+    if arr is not None:
+        if binary:
+            raw = serialize_tensor(arr, datatype)
+            params["binary_data_size"] = len(raw)
+        else:
+            entry["data"] = _json_safe(arr, datatype)
+    if params:
+        entry["parameters"] = params
+    return entry, raw
+
+
+def build_infer_request_body(
+    inputs: list[tuple[dict, bytes | None]],
+    outputs: list[dict] | None = None,
+    request_id: str = "",
+    parameters: dict | None = None,
+) -> tuple[bytes, int]:
+    """Assemble the full request body.
+
+    Returns ``(body, json_length)``; when any input has a binary payload the
+    caller must send the ``Inference-Header-Content-Length: json_length``
+    header, matching the reference contract.
+    """
+    head: dict[str, Any] = {}
+    if request_id:
+        head["id"] = request_id
+    if parameters:
+        head["parameters"] = parameters
+    head["inputs"] = [entry for entry, _ in inputs]
+    if outputs is not None:
+        head["outputs"] = outputs
+    json_bytes = json.dumps(head, separators=(",", ":")).encode("utf-8")
+    tails = [raw for _, raw in inputs if raw is not None]
+    body = json_bytes + b"".join(tails)
+    return body, len(json_bytes)
+
+
+def split_body(body: bytes, header_json_length: int | None) -> tuple[dict, bytes]:
+    """Split a v2 body into (parsed JSON head, binary tail)."""
+    if header_json_length is None:
+        return json.loads(body.decode("utf-8")), b""
+    head = json.loads(body[:header_json_length].decode("utf-8"))
+    return head, body[header_json_length:]
+
+
+def parse_tensors(head_list: list[dict], tail: bytes) -> list[WireTensor]:
+    """Walk tensors in declared order, slicing binary payloads by offset —
+    the reference's binary-offset output mapping (http_client.cc:752-835)."""
+    tensors: list[WireTensor] = []
+    offset = 0
+    for entry in head_list or []:
+        t = WireTensor(
+            name=entry["name"],
+            datatype=entry.get("datatype"),
+            shape=entry.get("shape"),
+            parameters=entry.get("parameters", {}) or {},
+        )
+        size = t.parameters.get("binary_data_size")
+        if size is not None:
+            if offset + size > len(tail):
+                raise ValueError(
+                    f"binary payload for '{t.name}' ({size}B at {offset}) "
+                    f"overruns body tail of {len(tail)}B"
+                )
+            t.raw = tail[offset : offset + size]
+            offset += size
+        elif "data" in entry:
+            t.data = entry["data"]
+        tensors.append(t)
+    return tensors
+
+
+def build_infer_response_body(
+    outputs: list[tuple[dict, bytes | None]],
+    model_name: str,
+    model_version: str,
+    request_id: str = "",
+    parameters: dict | None = None,
+) -> tuple[bytes, int]:
+    """Server-side mirror of :func:`build_infer_request_body`."""
+    head: dict[str, Any] = {
+        "model_name": model_name,
+        "model_version": str(model_version),
+    }
+    if request_id:
+        head["id"] = request_id
+    if parameters:
+        head["parameters"] = parameters
+    head["outputs"] = [entry for entry, _ in outputs]
+    json_bytes = json.dumps(head, separators=(",", ":")).encode("utf-8")
+    tails = [raw for _, raw in outputs if raw is not None]
+    return json_bytes + b"".join(tails), len(json_bytes)

@@ -1,0 +1,9 @@
+"""Network frontends over the in-process engine: HTTP/REST and gRPC.
+
+The reference talks to these endpoints from the outside (KServe v2 routes,
+/root/reference/src/c++/library/http_client.cc:1241-1245 and the
+``inference.GRPCInferenceService`` stub); here we implement the server side so
+the whole stack is self-contained and hermetically testable.
+"""
+
+from client_tpu.server.http_server import HttpInferenceServer  # noqa: F401

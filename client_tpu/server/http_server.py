@@ -1,0 +1,359 @@
+"""HTTP/REST frontend: the KServe v2 endpoint surface.
+
+Routes mirror what the reference client calls (http_client.cc:1241-1245 for
+infer, http_client.h:112-341 for the control plane): health, metadata,
+config, stats, repository control, shared-memory registration, and
+``POST /v2/models/<m>[/versions/<v>]/infer`` with the JSON + binary-tensor
+body split by ``Inference-Header-Content-Length``. Request bodies may be
+deflate/gzip compressed (the reference client can send both,
+http_client.cc:122-198); responses compress when the client accepts it.
+
+Implementation: stdlib ThreadingHTTPServer — each connection gets a thread;
+actual device work is serialized by the engine's per-model schedulers, so the
+frontend threads only do framing.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import re
+import threading
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from client_tpu.engine.engine import TpuEngine
+from client_tpu.engine.types import EngineError, InferRequest, OutputRequest
+from client_tpu.protocol import rest
+from client_tpu.server.classification import classify_output
+
+_ROUTES: list[tuple[str, re.Pattern, str]] = [
+    ("GET", re.compile(r"^/v2/health/live$"), "health_live"),
+    ("GET", re.compile(r"^/v2/health/ready$"), "health_ready"),
+    ("GET", re.compile(r"^/v2(?:/)?$"), "server_metadata"),
+    ("GET", re.compile(r"^/v2/models/([^/]+)(?:/versions/([^/]+))?/ready$"), "model_ready"),
+    ("GET", re.compile(r"^/v2/models/([^/]+)(?:/versions/([^/]+))?/config$"), "model_config"),
+    ("GET", re.compile(r"^/v2/models/([^/]+)(?:/versions/([^/]+))?/stats$"), "model_stats"),
+    ("GET", re.compile(r"^/v2/models/stats$"), "all_stats"),
+    ("GET", re.compile(r"^/v2/models/([^/]+)(?:/versions/([^/]+))?$"), "model_metadata"),
+    ("POST", re.compile(r"^/v2/models/([^/]+)(?:/versions/([^/]+))?/infer$"), "infer"),
+    ("POST", re.compile(r"^/v2/repository/index$"), "repo_index"),
+    ("POST", re.compile(r"^/v2/repository/models/([^/]+)/load$"), "repo_load"),
+    ("POST", re.compile(r"^/v2/repository/models/([^/]+)/unload$"), "repo_unload"),
+    ("GET", re.compile(r"^/v2/(systemsharedmemory|cudasharedmemory|tpusharedmemory)"
+                       r"(?:/region/([^/]+))?/status$"), "shm_status"),
+    ("POST", re.compile(r"^/v2/(systemsharedmemory|cudasharedmemory|tpusharedmemory)"
+                        r"/region/([^/]+)/register$"), "shm_register"),
+    ("POST", re.compile(r"^/v2/(systemsharedmemory|cudasharedmemory|tpusharedmemory)"
+                        r"(?:/region/([^/]+))?/unregister$"), "shm_unregister"),
+]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    engine: TpuEngine = None  # patched onto the subclass by HttpInferenceServer
+    verbose = False
+
+    # -- plumbing -----------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # noqa: A003
+        if self.verbose:
+            super().log_message(fmt, *args)
+
+    def _dispatch(self, method: str) -> None:
+        try:
+            for m, pat, name in _ROUTES:
+                if m != method:
+                    continue
+                match = pat.match(self.path.split("?")[0])
+                if match:
+                    getattr(self, "h_" + name)(*match.groups())
+                    return
+            self._send_error(404, f"no route for {method} {self.path}")
+        except EngineError as exc:
+            self._send_error(exc.status, str(exc))
+        except (json.JSONDecodeError, ValueError, KeyError, zlib.error,
+                gzip.BadGzipFile) as exc:
+            self._send_error(400, f"malformed request: {exc!r}")
+        except BrokenPipeError:
+            pass
+        except Exception as exc:  # noqa: BLE001
+            self._send_error(500, f"internal error: {exc}")
+
+    def do_GET(self):  # noqa: N802
+        self._dispatch("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._dispatch("POST")
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b""
+        encoding = (self.headers.get("Content-Encoding") or "").lower()
+        if encoding == "deflate":
+            body = zlib.decompress(body)
+        elif encoding == "gzip":
+            body = gzip.decompress(body)
+        elif encoding:
+            raise EngineError(f"unsupported Content-Encoding '{encoding}'", 415)
+        return body
+
+    def _send(self, status: int, body: bytes,
+              content_type: str = "application/json",
+              extra_headers: dict | None = None) -> None:
+        accept = (self.headers.get("Accept-Encoding") or "").lower()
+        headers = dict(extra_headers or {})
+        if body and "gzip" in accept:
+            body = gzip.compress(body, compresslevel=1)
+            headers["Content-Encoding"] = "gzip"
+        elif body and "deflate" in accept:
+            body = zlib.compress(body, level=1)
+            headers["Content-Encoding"] = "deflate"
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _send_json(self, obj, status: int = 200) -> None:
+        self._send(status, json.dumps(obj).encode("utf-8"))
+
+    def _send_error(self, status: int, msg: str) -> None:
+        try:
+            self._send(status, json.dumps({"error": msg}).encode("utf-8"))
+        except Exception:  # noqa: BLE001 — peer may have gone away
+            pass
+
+    # -- handlers -----------------------------------------------------------
+
+    def h_health_live(self):
+        self._send(200 if self.engine.is_live() else 400, b"")
+
+    def h_health_ready(self):
+        self._send(200 if self.engine.is_ready() else 400, b"")
+
+    def h_server_metadata(self):
+        self._send_json(self.engine.server_metadata())
+
+    def h_model_ready(self, name, version=None):
+        ready = self.engine.model_is_ready(name, version or "")
+        self._send(200 if ready else 400, b"")
+
+    def h_model_metadata(self, name, version=None):
+        self._send_json(self.engine.model_metadata(name, version or ""))
+
+    def h_model_config(self, name, version=None):
+        self._send_json(self.engine.model_config(name, version or ""))
+
+    def h_model_stats(self, name, version=None):
+        self._send_json(self.engine.model_statistics(name, version or ""))
+
+    def h_all_stats(self):
+        self._send_json(self.engine.model_statistics())
+
+    def h_repo_index(self):
+        self._send_json(self.engine.repository_index())
+
+    def h_repo_load(self, name):
+        self._read_body()
+        self.engine.load_model(name)
+        self._send_json({})
+
+    def h_repo_unload(self, name):
+        self._read_body()
+        self.engine.unload_model(name)
+        self._send_json({})
+
+    # -- shared memory control plane ----------------------------------------
+
+    def _shm_manager(self, kind: str):
+        if kind == "systemsharedmemory":
+            mgr = self.engine.system_shm
+        else:  # cudasharedmemory is served by the TPU region manager
+            mgr = self.engine.tpu_shm
+        if mgr is None:
+            raise EngineError(f"{kind} is not enabled on this server", 400)
+        return mgr
+
+    def h_shm_status(self, kind, region=None):
+        self._send_json(self._shm_manager(kind).status(region))
+
+    def h_shm_register(self, kind, region):
+        body = json.loads(self._read_body() or b"{}")
+        self._shm_manager(kind).register_from_json(region, body)
+        self._send_json({})
+
+    def h_shm_unregister(self, kind, region=None):
+        self._read_body()
+        self._shm_manager(kind).unregister(region)
+        self._send_json({})
+
+    # -- inference ----------------------------------------------------------
+
+    def h_infer(self, name, version=None):
+        body = self._read_body()
+        header_len = self.headers.get(rest.HEADER_INFERENCE_CONTENT_LENGTH)
+        head, tail = rest.split_body(
+            body, int(header_len) if header_len is not None else None)
+
+        inputs: dict[str, np.ndarray] = {}
+        for wire in rest.parse_tensors(head.get("inputs", []), tail):
+            shm_region = wire.parameters.get("shared_memory_region")
+            if shm_region is not None:
+                arr = self._read_shm_input(wire)
+            else:
+                arr = wire.to_numpy()
+            inputs[wire.name] = arr
+
+        outputs: list[OutputRequest] = []
+        request_binary_all = bool(
+            (head.get("parameters") or {}).get("binary_data_output", False))
+        for o in head.get("outputs", []) or []:
+            p = o.get("parameters", {}) or {}
+            outputs.append(OutputRequest(
+                name=o["name"],
+                classification_count=int(p.get("classification", 0)),
+                shm_region=p.get("shared_memory_region"),
+                shm_offset=int(p.get("shared_memory_offset", 0)),
+                shm_byte_size=int(p.get("shared_memory_byte_size", 0)),
+                binary=bool(p.get("binary_data", request_binary_all)),
+                parameters=p,
+            ))
+
+        params = head.get("parameters", {}) or {}
+        req = InferRequest(
+            model_name=name,
+            model_version=version or "",
+            request_id=head.get("id", ""),
+            inputs=inputs,
+            outputs=outputs,
+            parameters=params,
+            sequence_id=int(params.get("sequence_id", 0)),
+            sequence_start=bool(params.get("sequence_start", False)),
+            sequence_end=bool(params.get("sequence_end", False)),
+            priority=int(params.get("priority", 0)),
+            timeout_us=int(params.get("timeout", 0)),
+        )
+        resp = self.engine.infer(req)
+        self._send_infer_response(req, resp)
+
+    def _read_shm_input(self, wire) -> np.ndarray:
+        mgr_sys = self.engine.system_shm
+        mgr_tpu = self.engine.tpu_shm
+        region = wire.parameters["shared_memory_region"]
+        offset = int(wire.parameters.get("shared_memory_offset", 0))
+        size = int(wire.parameters.get("shared_memory_byte_size", 0))
+        for mgr in (mgr_tpu, mgr_sys):
+            if mgr is not None and mgr.has_region(region):
+                return mgr.read_tensor(region, offset, size,
+                                       wire.datatype, wire.shape)
+        raise EngineError(f"shared memory region '{region}' not registered", 400)
+
+    def _send_infer_response(self, req: InferRequest, resp) -> None:
+        entries = []
+        cfg = None
+        model = self.engine.repository.get(req.model_name)
+        if model is not None:
+            cfg = model.config
+        out_req = {o.name: o for o in req.outputs}
+        for out_name, arr in resp.outputs.items():
+            o = out_req.get(out_name)
+            # classification extension
+            if o is not None and o.classification_count > 0:
+                labels = None
+                if cfg is not None:
+                    labels = (cfg.parameters.get("labels") or {}).get(out_name)
+                arr = classify_output(arr, o.classification_count, labels)
+                entry, raw = rest.build_tensor_json(
+                    out_name, arr, "BYTES", arr.shape,
+                    binary=o.binary if o else False)
+                entries.append((entry, raw))
+                continue
+            # shared-memory output placement
+            if o is not None and o.shm_region:
+                written = self._write_shm_output(o, arr)
+                from client_tpu.protocol.dtypes import np_to_wire_dtype
+
+                entry = {
+                    "name": out_name,
+                    "datatype": np_to_wire_dtype(arr.dtype),
+                    "shape": list(arr.shape),
+                    "parameters": {
+                        "shared_memory_region": o.shm_region,
+                        "shared_memory_offset": o.shm_offset,
+                        "shared_memory_byte_size": written,
+                    },
+                }
+                entries.append((entry, None))
+                continue
+            from client_tpu.protocol.dtypes import np_to_wire_dtype
+
+            dt = np_to_wire_dtype(arr.dtype)
+            # Binary encoding is opt-in (v2 binary-data extension default is
+            # false): per-output binary_data param, or the request-wide
+            # binary_data_output parameter for unlisted outputs.
+            binary = o.binary if o is not None else bool(
+                req.parameters.get("binary_data_output", False))
+            entry, raw = rest.build_tensor_json(
+                out_name, arr, dt, arr.shape, binary=binary)
+            entries.append((entry, raw))
+
+        body, jlen = rest.build_infer_response_body(
+            entries, model_name=resp.model_name,
+            model_version=resp.model_version, request_id=resp.request_id,
+            parameters={k: v for k, v in resp.parameters.items()} or None)
+        has_binary = any(raw is not None for _, raw in entries)
+        headers = {}
+        if has_binary:
+            headers[rest.HEADER_INFERENCE_CONTENT_LENGTH] = str(jlen)
+            ctype = "application/octet-stream"
+        else:
+            ctype = "application/json"
+        self._send(200, body, content_type=ctype, extra_headers=headers)
+
+    def _write_shm_output(self, o: OutputRequest, arr: np.ndarray) -> int:
+        for mgr in (self.engine.tpu_shm, self.engine.system_shm):
+            if mgr is not None and mgr.has_region(o.shm_region):
+                return mgr.write_tensor(o.shm_region, o.shm_offset,
+                                        o.shm_byte_size, arr)
+        raise EngineError(
+            f"shared memory region '{o.shm_region}' not registered", 400)
+
+
+class HttpInferenceServer:
+    """Threaded v2 REST server over a TpuEngine."""
+
+    def __init__(self, engine: TpuEngine, host: str = "127.0.0.1",
+                 port: int = 8000, verbose: bool = False):
+        handler = type("BoundHandler", (_Handler,),
+                       {"engine": engine, "verbose": verbose})
+        self.engine = engine
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self.httpd.server_address[0]
+        return f"{host}:{self.port}"
+
+    def start(self) -> "HttpInferenceServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="http-server", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

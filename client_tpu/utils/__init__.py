@@ -1,0 +1,82 @@
+"""Client-facing utility API.
+
+Function names/signatures intentionally match the reference's
+``tritonclient.utils`` (np_to_triton_dtype / triton_to_np_dtype /
+serialize_byte_tensor / deserialize_bytes_tensor / InferenceServerException,
+/root/reference/src/python/library/tritonclient/utils/__init__.py:65-271) so
+reference users can switch imports without code changes. Implementations
+delegate to :mod:`client_tpu.protocol`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from client_tpu.protocol import codec as _codec
+from client_tpu.protocol import dtypes as _dtypes
+
+
+class InferenceServerException(Exception):
+    """Exception raised by client APIs; carries optional status + debug details."""
+
+    def __init__(self, msg, status=None, debug_details=None):
+        self._msg = msg
+        self._status = status
+        self._debug_details = debug_details
+        super().__init__(msg)
+
+    def __str__(self):
+        msg = super().__str__() if self._msg is None else self._msg
+        if self._status is not None:
+            msg = "[" + str(self._status) + "] " + msg
+        return msg
+
+    def message(self):
+        return self._msg
+
+    def status(self):
+        return self._status
+
+    def debug_details(self):
+        return self._debug_details
+
+
+def raise_error(msg):
+    raise InferenceServerException(msg=msg)
+
+
+def np_to_triton_dtype(np_dtype):
+    return _dtypes.np_to_wire_dtype(np_dtype)
+
+
+def triton_to_np_dtype(dtype):
+    return _dtypes.wire_to_np_dtype(dtype)
+
+
+def serialize_byte_tensor(input_tensor: np.ndarray):
+    """BYTES tensor -> flat uint8-viewable array of the 4B-LE-prefixed wire
+    form (returned as np array to match the reference's return type)."""
+    if input_tensor.size == 0:
+        return np.empty([0], dtype=np.uint8)
+    raw = _codec.serialize_bytes_tensor(input_tensor)
+    return np.frombuffer(raw, dtype=np.uint8)
+
+
+def serialized_byte_size(tensor_value: np.ndarray) -> int:
+    if tensor_value.size == 0:
+        return 0
+    return len(_codec.serialize_bytes_tensor(tensor_value))
+
+
+def deserialize_bytes_tensor(encoded_tensor) -> np.ndarray:
+    if isinstance(encoded_tensor, np.ndarray):
+        encoded_tensor = encoded_tensor.tobytes()
+    return _codec.deserialize_bytes_tensor(bytes(encoded_tensor))
+
+
+def deserialize_bf16_tensor(encoded_tensor) -> np.ndarray:
+    """Raw little-endian BF16 bytes -> ml_dtypes.bfloat16 ndarray (flat)."""
+    if isinstance(encoded_tensor, np.ndarray):
+        encoded_tensor = encoded_tensor.tobytes()
+    return np.frombuffer(bytes(encoded_tensor),
+                         dtype=_dtypes.wire_to_np_dtype("BF16"))

@@ -1,0 +1,25 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Tests exercise multi-chip sharding logic without TPU hardware; the driver's
+``dryrun_multichip`` uses the same mechanism. The runtime image pre-imports
+jax via a sitecustomize hook (PYTHONPATH=/root/.axon_site), so setting env
+vars here is not enough — the platform must also be forced through
+``jax.config`` before any backend initializes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:  # older jax: XLA_FLAGS handles it
+    pass

@@ -1,0 +1,281 @@
+"""Engine tests: repository, schedulers, stats, the simple* model family.
+
+These are the hermetic in-process tests the reference lacks (SURVEY.md §4
+notes upstream keeps QA in the server repo); the simple-model value
+assertions mirror the reference examples' hard-coded add/sub checks.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from client_tpu.engine import EngineError, InferRequest, TpuEngine
+from client_tpu.engine.types import OutputRequest
+from client_tpu.models import build_repository
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = TpuEngine(build_repository(
+        ["simple", "simple_string", "simple_identity", "simple_sequence",
+         "simple_repeat"]))
+    yield eng
+    eng.shutdown()
+
+
+def _infer(engine, model, inputs, **kw):
+    return engine.infer(InferRequest(model_name=model, inputs=inputs, **kw),
+                        timeout_s=30)
+
+
+class TestMetadata:
+    def test_server_metadata(self, engine):
+        md = engine.server_metadata()
+        assert md["name"] == "client_tpu"
+        assert "binary_tensor_data" in md["extensions"]
+        # shm extensions only advertised once managers are attached
+        assert "tpu_shared_memory" not in md["extensions"]
+
+    def test_model_metadata(self, engine):
+        md = engine.model_metadata("simple")
+        assert md["name"] == "simple"
+        ins = {i["name"]: i for i in md["inputs"]}
+        assert ins["INPUT0"]["datatype"] == "INT32"
+        assert ins["INPUT0"]["shape"] == [-1, 16]
+
+    def test_model_config(self, engine):
+        cfg = engine.model_config("simple")
+        assert cfg["max_batch_size"] == 8
+        assert cfg["dynamic_batching"]["preferred_batch_size"] == [4, 8]
+
+    def test_unknown_model_404(self, engine):
+        with pytest.raises(EngineError) as ei:
+            engine.model_metadata("nope")
+        assert ei.value.status == 404
+
+    def test_repository_index(self, engine):
+        idx = {e["name"]: e["state"] for e in engine.repository_index()}
+        assert idx["simple"] == "READY"
+
+    def test_load_unload(self):
+        eng = TpuEngine(build_repository(["simple"]), load_all=False)
+        assert not eng.model_is_ready("simple")
+        eng.load_model("simple")
+        assert eng.model_is_ready("simple")
+        eng.unload_model("simple")
+        assert not eng.model_is_ready("simple")
+        eng.shutdown()
+
+
+class TestAddSub:
+    def test_values(self, engine):
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.ones((1, 16), dtype=np.int32)
+        resp = _infer(engine, "simple", {"INPUT0": a, "INPUT1": b})
+        np.testing.assert_array_equal(resp.outputs["OUTPUT0"], a + b)
+        np.testing.assert_array_equal(resp.outputs["OUTPUT1"], a - b)
+
+    def test_batched(self, engine):
+        a = np.arange(48, dtype=np.int32).reshape(3, 16)
+        b = np.full((3, 16), 2, dtype=np.int32)
+        resp = _infer(engine, "simple", {"INPUT0": a, "INPUT1": b})
+        np.testing.assert_array_equal(resp.outputs["OUTPUT0"], a + b)
+
+    def test_requested_outputs_filter(self, engine):
+        a = np.zeros((1, 16), dtype=np.int32)
+        resp = _infer(engine, "simple", {"INPUT0": a, "INPUT1": a},
+                      outputs=[OutputRequest(name="OUTPUT1")])
+        assert set(resp.outputs) == {"OUTPUT1"}
+
+    def test_dtype_mismatch(self, engine):
+        a = np.zeros((1, 16), dtype=np.float32)
+        with pytest.raises(EngineError):
+            _infer(engine, "simple", {"INPUT0": a, "INPUT1": a})
+
+    def test_shape_mismatch(self, engine):
+        a = np.zeros((1, 8), dtype=np.int32)
+        with pytest.raises(EngineError):
+            _infer(engine, "simple", {"INPUT0": a, "INPUT1": a})
+
+    def test_batch_too_large(self, engine):
+        a = np.zeros((9, 16), dtype=np.int32)
+        with pytest.raises(EngineError):
+            _infer(engine, "simple", {"INPUT0": a, "INPUT1": a})
+
+    def test_missing_input(self, engine):
+        a = np.zeros((1, 16), dtype=np.int32)
+        with pytest.raises(EngineError):
+            _infer(engine, "simple", {"INPUT0": a})
+
+    def test_concurrent_clients_dynamic_batching(self, engine):
+        errs, results = [], {}
+
+        def worker(i):
+            try:
+                a = np.full((1, 16), i, dtype=np.int32)
+                b = np.ones((1, 16), dtype=np.int32)
+                r = _infer(engine, "simple", {"INPUT0": a, "INPUT1": b})
+                results[i] = r.outputs["OUTPUT0"][0, 0]
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert results == {i: i + 1 for i in range(16)}
+        stats = engine.model_statistics("simple")["model_stats"][0]
+        assert stats["inference_count"] >= 16
+        # dynamic batching should have produced at least one multi-request batch
+        assert stats["execution_count"] <= stats["inference_count"]
+
+
+class TestString:
+    def test_string_addsub(self, engine):
+        a = np.array([[str(i).encode() for i in range(16)]], dtype=np.object_)
+        b = np.array([[b"2"] * 16], dtype=np.object_)
+        resp = _infer(engine, "simple_string", {"INPUT0": a, "INPUT1": b})
+        assert resp.outputs["OUTPUT0"][0, 3] == b"5"
+        assert resp.outputs["OUTPUT1"][0, 3] == b"1"
+
+    def test_identity(self, engine):
+        s = np.array([[b"hello tpu", b""]], dtype=np.object_)
+        resp = _infer(engine, "simple_identity", {"INPUT0": s})
+        assert list(resp.outputs["OUTPUT0"][0]) == [b"hello tpu", b""]
+
+
+class TestSequence:
+    def test_accumulate_in_order(self, engine):
+        sid = 101
+        vals = [5, 3, 2, 10]
+        outs = []
+        for i, v in enumerate(vals):
+            resp = _infer(
+                engine, "simple_sequence",
+                {"INPUT": np.array([v], dtype=np.int32)},
+                sequence_id=sid,
+                sequence_start=(i == 0),
+                sequence_end=(i == len(vals) - 1),
+            )
+            outs.append(int(resp.outputs["OUTPUT"][0]))
+        assert outs == [5, 8, 10, 20]
+
+    def test_two_interleaved_sequences(self, engine):
+        r1 = _infer(engine, "simple_sequence",
+                    {"INPUT": np.array([1], np.int32)},
+                    sequence_id=1, sequence_start=True)
+        r2 = _infer(engine, "simple_sequence",
+                    {"INPUT": np.array([100], np.int32)},
+                    sequence_id=2, sequence_start=True)
+        r1b = _infer(engine, "simple_sequence",
+                     {"INPUT": np.array([1], np.int32)},
+                     sequence_id=1, sequence_end=True)
+        r2b = _infer(engine, "simple_sequence",
+                     {"INPUT": np.array([100], np.int32)},
+                     sequence_id=2, sequence_end=True)
+        assert int(r1b.outputs["OUTPUT"][0]) == 2
+        assert int(r2b.outputs["OUTPUT"][0]) == 200
+        assert int(r1.outputs["OUTPUT"][0]) == 1
+        assert int(r2.outputs["OUTPUT"][0]) == 100
+
+    def test_no_sequence_id_rejected(self, engine):
+        with pytest.raises(EngineError):
+            _infer(engine, "simple_sequence",
+                   {"INPUT": np.array([1], np.int32)})
+
+    def test_missing_start_rejected(self, engine):
+        with pytest.raises(EngineError):
+            _infer(engine, "simple_sequence",
+                   {"INPUT": np.array([1], np.int32)}, sequence_id=999)
+
+
+class TestDecoupled:
+    def test_streaming_responses(self, engine):
+        responses = []
+        done = threading.Event()
+
+        def cb(resp):
+            responses.append(resp)
+            if resp.final:
+                done.set()
+
+        req = InferRequest(
+            model_name="simple_repeat",
+            inputs={"IN": np.array([7, 8, 9], dtype=np.int32)},
+            response_callback=cb,
+        )
+        engine.async_infer(req)
+        assert done.wait(timeout=30)
+        # 3 data responses + 1 empty terminal final-flag response
+        assert len(responses) == 4
+        assert [int(r.outputs["OUT"][0]) for r in responses[:3]] == [7, 8, 9]
+        assert [r.final for r in responses] == [False, False, False, True]
+        assert responses[-1].outputs == {}
+        assert responses[-1].parameters["triton_final_response"] is True
+
+    def test_sync_infer_rejected_for_decoupled(self, engine):
+        with pytest.raises(EngineError) as ei:
+            _infer(engine, "simple_repeat",
+                   {"IN": np.array([1], dtype=np.int32)})
+        assert "decoupled" in str(ei.value)
+
+
+class TestEnsemble:
+    def test_linear_pipeline(self):
+        from client_tpu.engine.config import EnsembleStep, ModelConfig, TensorConfig
+        from client_tpu.engine.model import ModelBackend
+        from client_tpu.models.simple import AddSubBackend
+
+        class EnsembleBackend(ModelBackend):
+            def __init__(self):
+                self.config = ModelConfig(
+                    name="ens",
+                    platform="ensemble",
+                    max_batch_size=8,
+                    input=[
+                        TensorConfig("E_IN0", "INT32", [16]),
+                        TensorConfig("E_IN1", "INT32", [16]),
+                    ],
+                    output=[TensorConfig("E_OUT", "INT32", [16])],
+                    ensemble_scheduling=[
+                        # stage 1: s = IN0+IN1 (take OUTPUT0)
+                        EnsembleStep("simple", input_map={
+                            "INPUT0": "E_IN0", "INPUT1": "E_IN1"},
+                            output_map={"OUTPUT0": "mid"}),
+                        # stage 2: E_OUT = mid + IN0
+                        EnsembleStep("simple", input_map={
+                            "INPUT0": "mid", "INPUT1": "E_IN0"},
+                            output_map={"OUTPUT0": "E_OUT"}),
+                    ],
+                )
+
+        from client_tpu.engine.repository import ModelRepository
+
+        repo = ModelRepository()
+        repo.register("simple", AddSubBackend)
+        repo.register("ens", EnsembleBackend)
+        eng = TpuEngine(repo)
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.ones((1, 16), dtype=np.int32)
+        resp = eng.infer(InferRequest(model_name="ens",
+                                      inputs={"E_IN0": a, "E_IN1": b}),
+                         timeout_s=30)
+        np.testing.assert_array_equal(resp.outputs["E_OUT"], a + b + a)
+        # composing-model stats accumulated under 'simple'
+        st = eng.model_statistics("simple")["model_stats"][0]
+        assert st["inference_count"] == 2
+        eng.shutdown()
+
+
+class TestTimeout:
+    def test_queue_timeout(self, engine):
+        # timeout_us=1 will almost surely expire before the worker dequeues
+        with pytest.raises(EngineError) as ei:
+            _infer(engine, "simple",
+                   {"INPUT0": np.zeros((1, 16), np.int32),
+                    "INPUT1": np.zeros((1, 16), np.int32)},
+                   timeout_us=1)
+        assert ei.value.status == 504

@@ -1,0 +1,207 @@
+"""End-to-end HTTP tests: Python client ↔ HTTP server ↔ engine.
+
+The hermetic equivalent of the reference's live-server example-as-test
+scripts (simple_http_* family, SURVEY.md §4): hard value assertions on the
+simple model family over the real wire format.
+"""
+
+import numpy as np
+import pytest
+
+import client_tpu.http as httpclient
+from client_tpu.engine import TpuEngine
+from client_tpu.models import build_repository
+from client_tpu.server import HttpInferenceServer
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def server():
+    eng = TpuEngine(build_repository(
+        ["simple", "simple_string", "simple_identity", "simple_sequence"]))
+    srv = HttpInferenceServer(eng, port=0).start()
+    yield srv
+    srv.stop()
+    eng.shutdown()
+
+
+@pytest.fixture()
+def client(server):
+    c = httpclient.InferenceServerClient(server.url, concurrency=4)
+    yield c
+    c.close()
+
+
+def _simple_inputs(batch=1):
+    a = np.arange(16 * batch, dtype=np.int32).reshape(batch, 16)
+    b = np.ones((batch, 16), dtype=np.int32)
+    i0 = httpclient.InferInput("INPUT0", a.shape, "INT32")
+    i0.set_data_from_numpy(a)
+    i1 = httpclient.InferInput("INPUT1", b.shape, "INT32")
+    i1.set_data_from_numpy(b)
+    return a, b, [i0, i1]
+
+
+class TestControlPlane:
+    def test_live_ready(self, client):
+        assert client.is_server_live()
+        assert client.is_server_ready()
+
+    def test_model_ready(self, client):
+        assert client.is_model_ready("simple")
+        assert not client.is_model_ready("missing_model")
+
+    def test_server_metadata(self, client):
+        md = client.get_server_metadata()
+        assert md["name"] == "client_tpu"
+        assert "binary_tensor_data" in md["extensions"]
+
+    def test_model_metadata(self, client):
+        md = client.get_model_metadata("simple")
+        assert md["name"] == "simple"
+        assert {o["name"] for o in md["outputs"]} == {"OUTPUT0", "OUTPUT1"}
+
+    def test_model_config(self, client):
+        cfg = client.get_model_config("simple")
+        assert cfg["max_batch_size"] == 8
+
+    def test_repository_index(self, client):
+        idx = client.get_model_repository_index()
+        names = {e["name"] for e in idx}
+        assert "simple" in names
+
+    def test_load_unload(self, client):
+        client.unload_model("simple_identity")
+        assert not client.is_model_ready("simple_identity")
+        client.load_model("simple_identity")
+        assert client.is_model_ready("simple_identity")
+
+    def test_statistics(self, client):
+        stats = client.get_inference_statistics("simple")
+        assert stats["model_stats"][0]["name"] == "simple"
+
+    def test_unknown_model_error(self, client):
+        with pytest.raises(InferenceServerException) as ei:
+            client.get_model_metadata("missing_model")
+        assert "unknown model" in str(ei.value)
+
+
+class TestInfer:
+    def test_binary(self, client):
+        a, b, inputs = _simple_inputs()
+        result = client.infer("simple", inputs, request_id="req-1")
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), a - b)
+        assert result.get_response()["id"] == "req-1"
+
+    def test_json_tensors(self, client):
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.full((1, 16), 3, dtype=np.int32)
+        i0 = httpclient.InferInput("INPUT0", a.shape, "INT32")
+        i0.set_data_from_numpy(a, binary_data=False)
+        i1 = httpclient.InferInput("INPUT1", b.shape, "INT32")
+        i1.set_data_from_numpy(b, binary_data=False)
+        outs = [httpclient.InferRequestedOutput("OUTPUT0", binary_data=False)]
+        result = client.infer("simple", [i0, i1], outputs=outs)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+        assert result.as_numpy("OUTPUT1") is None
+
+    def test_requested_outputs(self, client):
+        _, _, inputs = _simple_inputs()
+        outs = [httpclient.InferRequestedOutput("OUTPUT1")]
+        result = client.infer("simple", inputs, outputs=outs)
+        assert result.as_numpy("OUTPUT0") is None
+        assert result.as_numpy("OUTPUT1") is not None
+
+    def test_string_model(self, client):
+        a = np.array([[str(i).encode() for i in range(16)]], dtype=np.object_)
+        b = np.array([[b"1"] * 16], dtype=np.object_)
+        i0 = httpclient.InferInput("INPUT0", [1, 16], "BYTES")
+        i0.set_data_from_numpy(a)
+        i1 = httpclient.InferInput("INPUT1", [1, 16], "BYTES")
+        i1.set_data_from_numpy(b, binary_data=False)
+        result = client.infer("simple_string", [i0, i1])
+        assert result.as_numpy("OUTPUT0")[0, 5] == b"6"
+
+    def test_async_infer(self, client):
+        a, b, inputs = _simple_inputs()
+        handles = [client.async_infer("simple", inputs) for _ in range(8)]
+        for h in handles:
+            result = h.get_result(timeout=30)
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+
+    def test_compression_roundtrip(self, client):
+        a, b, inputs = _simple_inputs(batch=4)
+        for algo in ("gzip", "deflate"):
+            result = client.infer(
+                "simple", inputs,
+                request_compression_algorithm=algo,
+                response_compression_algorithm=algo)
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+
+    def test_sequence_over_http(self, client):
+        sid = 77
+        vals, outs = [4, 6, 1], []
+        for i, v in enumerate(vals):
+            x = np.array([v], dtype=np.int32)
+            inp = httpclient.InferInput("INPUT", [1], "INT32")
+            inp.set_data_from_numpy(x)
+            result = client.infer(
+                "simple_sequence", [inp],
+                sequence_id=sid,
+                sequence_start=(i == 0),
+                sequence_end=(i == len(vals) - 1))
+            outs.append(int(result.as_numpy("OUTPUT")[0]))
+        assert outs == [4, 10, 11]
+
+    def test_infer_error_shape(self, client):
+        bad = np.zeros((1, 4), dtype=np.int32)
+        i0 = httpclient.InferInput("INPUT0", [1, 4], "INT32")
+        i0.set_data_from_numpy(bad)
+        i1 = httpclient.InferInput("INPUT1", [1, 4], "INT32")
+        i1.set_data_from_numpy(bad)
+        with pytest.raises(InferenceServerException) as ei:
+            client.infer("simple", [i0, i1])
+        assert "incompatible" in str(ei.value) or "shape" in str(ei.value)
+
+    def test_generate_and_parse_body_statics(self, client):
+        a, b, inputs = _simple_inputs()
+        body, header_length = httpclient.InferenceServerClient.generate_request_body(
+            inputs)
+        assert header_length is not None
+        result = client.infer("simple", inputs)
+        assert result.get_response()["model_name"] == "simple"
+
+
+class TestClassification:
+    def test_class_count(self, server):
+        # build a tiny scores model with labels, served over HTTP
+        from client_tpu.engine.config import ModelConfig, TensorConfig
+        from client_tpu.engine.model import ModelBackend
+
+        class ScoresBackend(ModelBackend):
+            def __init__(self):
+                self.config = ModelConfig(
+                    name="scores", platform="jax", max_batch_size=4,
+                    input=[TensorConfig("IN", "FP32", [4])],
+                    output=[TensorConfig("PROB", "FP32", [4])],
+                    parameters={"labels": {
+                        "PROB": ["cat", "dog", "bird", "fish"]}},
+                )
+
+            def make_apply(self):
+                return lambda inputs: {"PROB": inputs["IN"] * 1.0}
+
+        server.engine.repository.register_backend(ScoresBackend())
+        server.engine.load_model("scores")
+        c = httpclient.InferenceServerClient(server.url)
+        x = np.array([[0.1, 0.7, 0.05, 0.15]], dtype=np.float32)
+        inp = httpclient.InferInput("IN", [1, 4], "FP32")
+        inp.set_data_from_numpy(x)
+        out = httpclient.InferRequestedOutput("PROB", class_count=2)
+        result = c.infer("scores", [inp], outputs=[out])
+        top = result.as_numpy("PROB")
+        assert top.shape == (1, 2)
+        first = top[0, 0].decode()
+        assert first.endswith(":1:dog")
+        c.close()

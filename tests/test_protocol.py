@@ -1,0 +1,192 @@
+"""Unit tests for the wire schema: dtypes, codecs, REST framing.
+
+Behavioral oracles come from the reference's codec contracts
+(tritonclient/utils/__init__.py BYTES codec, http binary framing).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from client_tpu.protocol import codec, dtypes, rest
+from client_tpu.protocol.dtypes import DataType
+
+
+class TestDtypes:
+    def test_roundtrip_all_fixed(self):
+        for wire in DataType.ALL:
+            if wire == DataType.BYTES:
+                continue
+            np_dt = dtypes.wire_to_np_dtype(wire)
+            assert np_dt is not None
+            assert dtypes.np_to_wire_dtype(np_dt) == wire
+
+    def test_bytes_mappings(self):
+        assert dtypes.np_to_wire_dtype(np.object_) == "BYTES"
+        assert dtypes.np_to_wire_dtype(np.bytes_) == "BYTES"
+        assert dtypes.np_to_wire_dtype(np.dtype("S10")) == "BYTES"
+        assert dtypes.np_to_wire_dtype(np.dtype("U4")) == "BYTES"
+        assert dtypes.np_to_wire_dtype(bytes) == "BYTES"
+
+    def test_byte_sizes(self):
+        assert dtypes.dtype_byte_size("INT32") == 4
+        assert dtypes.dtype_byte_size("BF16") == 2
+        assert dtypes.dtype_byte_size("FP64") == 8
+        assert dtypes.dtype_byte_size("BYTES") == -1
+        with pytest.raises(ValueError):
+            dtypes.dtype_byte_size("NOPE")
+
+    def test_tensor_byte_size(self):
+        assert dtypes.tensor_byte_size("INT32", (4, 4)) == 64
+        assert dtypes.tensor_byte_size("FP16", ()) == 2
+        with pytest.raises(ValueError):
+            dtypes.tensor_byte_size("BYTES", (2,))
+
+    def test_bf16_numpy(self):
+        import ml_dtypes
+
+        assert dtypes.wire_to_np_dtype("BF16") == np.dtype(ml_dtypes.bfloat16)
+
+
+class TestBytesCodec:
+    def test_roundtrip(self):
+        t = np.array([b"hello", b"", b"tpu \x00 native", "unicode é".encode()],
+                     dtype=np.object_)
+        enc = codec.serialize_bytes_tensor(t)
+        dec = codec.deserialize_bytes_tensor(enc)
+        assert list(dec) == list(t)
+
+    def test_length_prefix_layout(self):
+        enc = codec.serialize_bytes_tensor(np.array([b"abc"], dtype=np.object_))
+        assert enc == b"\x03\x00\x00\x00abc"  # 4-byte LE length prefix
+
+    def test_str_elements_utf8(self):
+        enc = codec.serialize_bytes_tensor(np.array(["hi"], dtype=np.object_))
+        assert enc == b"\x02\x00\x00\x00hi"
+
+    def test_empty(self):
+        assert codec.serialize_bytes_tensor(np.array([], dtype=np.object_)) == b""
+        assert len(codec.deserialize_bytes_tensor(b"")) == 0
+
+    def test_count_bound(self):
+        enc = codec.serialize_bytes_tensor(
+            np.array([b"a", b"bb", b"ccc"], dtype=np.object_))
+        dec = codec.deserialize_bytes_tensor(enc, count=2)
+        assert list(dec) == [b"a", b"bb"]
+
+    def test_malformed_overrun(self):
+        with pytest.raises(ValueError):
+            codec.deserialize_bytes_tensor(b"\xff\x00\x00\x00ab")
+
+    def test_2d_row_major(self):
+        t = np.array([[b"r0c0", b"r0c1"], [b"r1c0", b"r1c1"]], dtype=np.object_)
+        dec = codec.deserialize_bytes_tensor(codec.serialize_bytes_tensor(t))
+        assert list(dec) == [b"r0c0", b"r0c1", b"r1c0", b"r1c1"]
+
+
+class TestRawCodec:
+    @pytest.mark.parametrize("wire,np_dt", [
+        ("INT32", np.int32), ("FP32", np.float32), ("UINT8", np.uint8),
+        ("FP16", np.float16), ("BOOL", np.bool_), ("INT64", np.int64),
+    ])
+    def test_roundtrip(self, wire, np_dt):
+        arr = (np.arange(24).reshape(2, 3, 4) % 2).astype(np_dt)
+        raw = codec.serialize_tensor(arr, wire)
+        back = codec.deserialize_tensor(raw, wire, (2, 3, 4))
+        np.testing.assert_array_equal(back, arr)
+
+    def test_bf16_roundtrip(self):
+        import ml_dtypes
+
+        arr = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16)
+        raw = codec.serialize_tensor(arr, "BF16")
+        assert len(raw) == 16
+        back = codec.deserialize_tensor(raw, "BF16", (8,))
+        np.testing.assert_array_equal(back.astype(np.float32),
+                                      arr.astype(np.float32))
+
+    def test_bytes_via_generic(self):
+        arr = np.array([b"x", b"yz"], dtype=np.object_)
+        raw = codec.serialize_tensor(arr, "BYTES")
+        back = codec.deserialize_tensor(raw, "BYTES", (2,))
+        assert list(back) == [b"x", b"yz"]
+
+    def test_b64_handle(self):
+        h = bytes(range(64))
+        assert codec.b64_decode_handle(codec.b64_encode_handle(h)) == h
+
+
+class TestRestFraming:
+    def test_binary_request_roundtrip(self):
+        a = np.arange(16, dtype=np.int32)
+        b = np.ones((2, 2), dtype=np.float32)
+        in0 = rest.build_tensor_json("INPUT0", a, "INT32", a.shape, binary=True)
+        in1 = rest.build_tensor_json("INPUT1", b, "FP32", b.shape, binary=True)
+        body, jlen = rest.build_infer_request_body(
+            [in0, in1], outputs=[{"name": "OUTPUT0", "parameters": {"binary_data": True}}],
+            request_id="42")
+        head, tail = rest.split_body(body, jlen)
+        assert head["id"] == "42"
+        tensors = rest.parse_tensors(head["inputs"], tail)
+        np.testing.assert_array_equal(tensors[0].to_numpy(), a)
+        np.testing.assert_array_equal(tensors[1].to_numpy(), b)
+
+    def test_json_request_no_header(self):
+        a = np.arange(4, dtype=np.int32)
+        in0 = rest.build_tensor_json("X", a, "INT32", a.shape, binary=False)
+        body, jlen = rest.build_infer_request_body([in0])
+        assert jlen == len(body)  # no binary tail
+        head, tail = rest.split_body(body, None)
+        assert tail == b""
+        (t,) = rest.parse_tensors(head["inputs"], b"")
+        np.testing.assert_array_equal(t.to_numpy(), a)
+
+    def test_mixed_binary_and_json(self):
+        a = np.arange(4, dtype=np.int32)
+        s = np.array([b"str0", b"s1"], dtype=np.object_)
+        in0 = rest.build_tensor_json("A", a, "INT32", a.shape, binary=True)
+        in1 = rest.build_tensor_json("S", s, "BYTES", s.shape, binary=False)
+        body, jlen = rest.build_infer_request_body([in0, in1])
+        head, tail = rest.split_body(body, jlen)
+        t0, t1 = rest.parse_tensors(head["inputs"], tail)
+        np.testing.assert_array_equal(t0.to_numpy(), a)
+        assert list(t1.to_numpy()) == [b"str0", b"s1"]
+
+    def test_response_offset_walk(self):
+        o0 = np.arange(6, dtype=np.float32).reshape(2, 3)
+        o1 = np.arange(4, dtype=np.int64)
+        e0 = rest.build_tensor_json("OUT0", o0, "FP32", o0.shape, binary=True)
+        e1 = rest.build_tensor_json("OUT1", o1, "INT64", o1.shape, binary=True)
+        body, jlen = rest.build_infer_response_body(
+            [e0, e1], model_name="m", model_version="1", request_id="7")
+        head, tail = rest.split_body(body, jlen)
+        assert head["model_name"] == "m" and head["id"] == "7"
+        t0, t1 = rest.parse_tensors(head["outputs"], tail)
+        np.testing.assert_array_equal(t0.to_numpy(), o0)
+        np.testing.assert_array_equal(t1.to_numpy(), o1)
+
+    def test_shm_param_passthrough(self):
+        entry, raw = rest.build_tensor_json(
+            "X", None, "INT32", (16,),
+            parameters={"shared_memory_region": "r0",
+                        "shared_memory_byte_size": 64,
+                        "shared_memory_offset": 0})
+        assert raw is None
+        assert entry["parameters"]["shared_memory_region"] == "r0"
+        body, jlen = rest.build_infer_request_body([(entry, raw)])
+        head, _ = rest.split_body(body, jlen)
+        assert "data" not in head["inputs"][0]
+
+    def test_binary_overrun_raises(self):
+        entry = {"name": "X", "datatype": "INT32", "shape": [4],
+                 "parameters": {"binary_data_size": 999}}
+        with pytest.raises(ValueError):
+            rest.parse_tensors([entry], b"\x00" * 16)
+
+    def test_head_is_compact_json(self):
+        a = np.arange(2, dtype=np.int32)
+        in0 = rest.build_tensor_json("A", a, "INT32", a.shape, binary=True)
+        body, jlen = rest.build_infer_request_body([in0])
+        head = json.loads(body[:jlen])
+        assert head["inputs"][0]["shape"] == [2]
