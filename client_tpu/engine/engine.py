@@ -50,10 +50,12 @@ class TpuEngine:
         self._lock = threading.RLock()
         self._warmup = warmup
         self._live = True
-        # Shared-memory managers are attached by client_tpu.shm at startup;
-        # kept as attributes so frontends can reach them uniformly.
-        self.system_shm = None
-        self.tpu_shm = None
+        # Shared-memory data planes (SURVEY.md §5.8); frontends reach them
+        # uniformly through these attributes.
+        from client_tpu.engine.shm import SystemShmManager, TpuShmManager
+
+        self.system_shm = SystemShmManager()
+        self.tpu_shm = TpuShmManager()
         if load_all:
             for name in self.repository.names():
                 try:
@@ -213,3 +215,9 @@ class TpuEngine:
             self._schedulers.clear()
         for s in scheds:
             s.stop()
+        # regions are released only after in-flight work drains, so requests
+        # with shm-placed outputs can still complete during shutdown
+        if self.system_shm is not None:
+            self.system_shm.unregister(None)
+        if self.tpu_shm is not None:
+            self.tpu_shm.unregister(None)

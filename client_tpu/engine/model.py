@@ -150,7 +150,14 @@ class Model:
                 continue
             if pad_to is not None and arr.shape[0] < pad_to:
                 pad_width = [(0, pad_to - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
-                arr = np.pad(arr, pad_width)
+                if isinstance(arr, self._jax.Array):
+                    # device-resident (tpu-shm region): pad on device, don't
+                    # round-trip through host
+                    import jax.numpy as jnp
+
+                    arr = jnp.pad(arr, pad_width)
+                else:
+                    arr = np.pad(arr, pad_width)
             staged[name] = self._jax.device_put(arr)
 
         outputs = self._apply(staged)

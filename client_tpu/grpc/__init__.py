@@ -1,0 +1,562 @@
+"""Python gRPC client library.
+
+API mirrors the reference's ``tritonclient.grpc``
+(/root/reference/src/python/library/tritonclient/grpc/__init__.py:146-1445):
+``InferenceServerClient`` with the full control plane, unary ``infer``,
+future-based ``async_infer``, and bidirectional streaming
+(``start_stream`` / ``async_stream_infer`` / ``stop_stream``). Mechanisms
+carried over from the reference design: a process-global channel cache keyed
+by URL (grpc_client.cc:48-123) and request-proto reuse across calls
+(grpc_client.cc:1113-1210).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import grpc
+import numpy as np
+
+from client_tpu.protocol import grpc_codec, grpc_service_pb2 as pb
+from client_tpu.protocol.codec import serialize_tensor
+from client_tpu.protocol.dtypes import np_to_wire_dtype
+from client_tpu.protocol.grpc_stub import GRPCInferenceServiceStub
+from client_tpu.utils import InferenceServerException, raise_error
+
+service_pb2 = pb  # re-export, as the reference re-exports its generated pb2
+
+_channel_cache: dict[tuple, tuple[grpc.Channel, GRPCInferenceServiceStub]] = {}
+_channel_cache_lock = threading.Lock()
+
+
+class KeepAliveOptions:
+    """gRPC keepalive knobs (reference grpc/__init__.py:104-144)."""
+
+    def __init__(self, keepalive_time_ms=7200000, keepalive_timeout_ms=20000,
+                 keepalive_permit_without_calls=False,
+                 http2_max_pings_without_data=2):
+        self.keepalive_time_ms = keepalive_time_ms
+        self.keepalive_timeout_ms = keepalive_timeout_ms
+        self.keepalive_permit_without_calls = keepalive_permit_without_calls
+        self.http2_max_pings_without_data = http2_max_pings_without_data
+
+
+def _grpc_error(exc: grpc.RpcError) -> InferenceServerException:
+    try:
+        return InferenceServerException(
+            msg=exc.details(), status=str(exc.code()))
+    except Exception:  # noqa: BLE001
+        return InferenceServerException(msg=str(exc))
+
+
+class InferInput:
+    """Input tensor; data goes in raw_input_contents (fast path) by default,
+    or typed contents via set_data_from_numpy(..., use_contents=True)."""
+
+    def __init__(self, name, shape, datatype):
+        self._input = pb.ModelInferRequest.InferInputTensor(
+            name=name, datatype=datatype, shape=[int(d) for d in shape])
+        self._raw = None
+
+    def name(self):
+        return self._input.name
+
+    def datatype(self):
+        return self._input.datatype
+
+    def shape(self):
+        return list(self._input.shape)
+
+    def set_shape(self, shape):
+        del self._input.shape[:]
+        self._input.shape.extend(int(d) for d in shape)
+        return self
+
+    def set_data_from_numpy(self, input_tensor, use_contents=False):
+        if not isinstance(input_tensor, np.ndarray):
+            raise_error("input_tensor must be a numpy array")
+        dtype = np_to_wire_dtype(input_tensor.dtype)
+        expected = self._input.datatype
+        if expected != dtype and not (expected == "BYTES" and dtype is None):
+            raise_error(
+                f"got unexpected datatype {dtype}, expected {expected}")
+        if list(input_tensor.shape) != list(self._input.shape):
+            raise_error(
+                f"got unexpected numpy array shape "
+                f"[{list(input_tensor.shape)}], expected "
+                f"[{list(self._input.shape)}]")
+        self._input.parameters.pop("shared_memory_region", None)
+        self._input.parameters.pop("shared_memory_byte_size", None)
+        self._input.parameters.pop("shared_memory_offset", None)
+        if use_contents:
+            self._raw = None
+            self._input.contents.Clear()
+            grpc_codec.fill_contents(self._input.contents, input_tensor,
+                                     expected)
+        else:
+            self._input.contents.Clear()
+            self._raw = serialize_tensor(input_tensor, expected)
+        return self
+
+    def set_shared_memory(self, region_name, byte_size, offset=0):
+        self._raw = None
+        self._input.contents.Clear()
+        grpc_codec.set_param(self._input.parameters, "shared_memory_region",
+                             region_name)
+        grpc_codec.set_param(self._input.parameters,
+                             "shared_memory_byte_size", byte_size)
+        if offset:
+            grpc_codec.set_param(self._input.parameters,
+                                 "shared_memory_offset", offset)
+        return self
+
+    def _get_tensor(self):
+        return self._input, self._raw
+
+
+class InferRequestedOutput:
+    def __init__(self, name, class_count=0):
+        self._output = pb.ModelInferRequest.InferRequestedOutputTensor(
+            name=name)
+        if class_count:
+            grpc_codec.set_param(self._output.parameters, "classification",
+                                 class_count)
+
+    def name(self):
+        return self._output.name
+
+    def set_shared_memory(self, region_name, byte_size, offset=0):
+        grpc_codec.set_param(self._output.parameters, "shared_memory_region",
+                             region_name)
+        grpc_codec.set_param(self._output.parameters,
+                             "shared_memory_byte_size", byte_size)
+        if offset:
+            grpc_codec.set_param(self._output.parameters,
+                                 "shared_memory_offset", offset)
+        return self
+
+    def unset_shared_memory(self):
+        self._output.parameters.pop("shared_memory_region", None)
+        self._output.parameters.pop("shared_memory_byte_size", None)
+        self._output.parameters.pop("shared_memory_offset", None)
+        return self
+
+    def _get_tensor(self):
+        return self._output
+
+
+class InferResult:
+    """Zero-copy-ish view over ModelInferResponse: as_numpy slices
+    raw_output_contents by output index (reference InferResultGrpc,
+    grpc_client.cc:144-365)."""
+
+    def __init__(self, result: "pb.ModelInferResponse"):
+        self._result = result
+
+    def as_numpy(self, name):
+        raw_idx = 0
+        for tensor in self._result.outputs:
+            # shm-placed outputs carry no payload at all — they must not
+            # consume a raw_output_contents slot
+            is_shm = "shared_memory_region" in tensor.parameters
+            has_raw = not is_shm and not _tensor_has_contents(tensor)
+            if tensor.name == name:
+                if is_shm:
+                    return None
+                if has_raw:
+                    if raw_idx < len(self._result.raw_output_contents):
+                        return grpc_codec.tensor_to_ndarray(
+                            tensor,
+                            self._result.raw_output_contents[raw_idx])
+                    return None
+                return grpc_codec.tensor_to_ndarray(tensor, None)
+            if has_raw:
+                raw_idx += 1
+        return None
+
+    def get_output(self, name, as_json=False):
+        for tensor in self._result.outputs:
+            if tensor.name == name:
+                if as_json:
+                    from google.protobuf import json_format
+
+                    return json_format.MessageToDict(
+                        tensor, preserving_proto_field_name=True)
+                return tensor
+        return None
+
+    def get_response(self, as_json=False):
+        if as_json:
+            from google.protobuf import json_format
+
+            return json_format.MessageToDict(
+                self._result, preserving_proto_field_name=True)
+        return self._result
+
+
+def _tensor_has_contents(tensor) -> bool:
+    c = tensor.contents
+    return any(len(getattr(c, f.name)) for f in c.DESCRIPTOR.fields)
+
+
+class CallContext:
+    """Cancellable handle returned by async_infer."""
+
+    def __init__(self, future):
+        self._future = future
+
+    def cancel(self):
+        return self._future.cancel()
+
+
+class _InferStream:
+    """Single bidi stream: request queue feeds the stream-stream call; a
+    reader thread dispatches responses to the user callback (reference
+    _InferStream + _RequestIterator, grpc/__init__.py:1802-1933)."""
+
+    def __init__(self, stub, callback, stream_timeout=None, headers=None):
+        self._q: queue.Queue = queue.Queue()
+        self._callback = callback
+        self._closed = False
+        metadata = list(headers.items()) if headers else None
+        self._call = stub.ModelStreamInfer(
+            self._request_iterator(), timeout=stream_timeout,
+            metadata=metadata)
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _request_iterator(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            yield item
+
+    def _read_loop(self):
+        try:
+            for response in self._call:
+                if response.error_message:
+                    self._callback(
+                        None, InferenceServerException(
+                            response.error_message))
+                else:
+                    self._callback(InferResult(response.infer_response), None)
+        except grpc.RpcError as exc:
+            if not self._closed:
+                self._callback(None, _grpc_error(exc))
+
+    def send(self, request):
+        if self._closed:
+            raise_error("stream is closed")
+        self._q.put(request)
+
+    def close(self, cancel_requests=False):
+        if self._closed:
+            return
+        self._closed = True
+        if cancel_requests:
+            self._call.cancel()
+        self._q.put(None)
+        self._reader.join(timeout=10)
+
+
+class InferenceServerClient:
+    def __init__(self, url, verbose=False, ssl=False, root_certificates=None,
+                 private_key=None, certificate_chain=None, creds=None,
+                 keepalive_options=None, channel_args=None):
+        if ssl:
+            raise InferenceServerException(
+                "ssl is not supported by this transport yet")
+        options = list(channel_args or [])
+        options += [
+            ("grpc.max_send_message_length", -1),
+            ("grpc.max_receive_message_length", -1),
+        ]
+        if keepalive_options is not None:
+            options += [
+                ("grpc.keepalive_time_ms",
+                 keepalive_options.keepalive_time_ms),
+                ("grpc.keepalive_timeout_ms",
+                 keepalive_options.keepalive_timeout_ms),
+                ("grpc.keepalive_permit_without_calls",
+                 int(keepalive_options.keepalive_permit_without_calls)),
+                ("grpc.http2.max_pings_without_data",
+                 keepalive_options.http2_max_pings_without_data),
+            ]
+        key = (url, tuple(sorted(options)))
+        # Process-global channel/stub reuse keyed by URL+options, the same
+        # allocation hygiene as the reference's channel cache.
+        with _channel_cache_lock:
+            cached = _channel_cache.get(key)
+            if cached is None:
+                channel = grpc.insecure_channel(url, options=options)
+                stub = GRPCInferenceServiceStub(channel)
+                _channel_cache[key] = (channel, stub)
+            else:
+                channel, stub = cached
+        self._channel = channel
+        self._client_stub = stub
+        self._verbose = verbose
+        self._stream: _InferStream | None = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def close(self):
+        self.stop_stream()
+        # channel stays cached for other clients of the same URL
+
+    # -- health / metadata ---------------------------------------------------
+
+    @staticmethod
+    def _md(headers):
+        return list(headers.items()) if headers else None
+
+    def _call(self, method, request, headers=None, as_json=False,
+              client_timeout=None):
+        try:
+            response = method(request, metadata=self._md(headers),
+                              timeout=client_timeout)
+        except grpc.RpcError as exc:
+            raise _grpc_error(exc) from None
+        if as_json:
+            from google.protobuf import json_format
+
+            return json_format.MessageToDict(
+                response, preserving_proto_field_name=True)
+        return response
+
+    def is_server_live(self, headers=None, client_timeout=None):
+        return self._call(self._client_stub.ServerLive,
+                          pb.ServerLiveRequest(), headers,
+                          client_timeout=client_timeout).live
+
+    def is_server_ready(self, headers=None, client_timeout=None):
+        return self._call(self._client_stub.ServerReady,
+                          pb.ServerReadyRequest(), headers,
+                          client_timeout=client_timeout).ready
+
+    def is_model_ready(self, model_name, model_version="", headers=None,
+                       client_timeout=None):
+        return self._call(
+            self._client_stub.ModelReady,
+            pb.ModelReadyRequest(name=model_name, version=model_version),
+            headers, client_timeout=client_timeout).ready
+
+    def get_server_metadata(self, headers=None, as_json=False,
+                            client_timeout=None):
+        return self._call(self._client_stub.ServerMetadata,
+                          pb.ServerMetadataRequest(), headers, as_json,
+                          client_timeout)
+
+    def get_model_metadata(self, model_name, model_version="", headers=None,
+                           as_json=False, client_timeout=None):
+        return self._call(
+            self._client_stub.ModelMetadata,
+            pb.ModelMetadataRequest(name=model_name, version=model_version),
+            headers, as_json, client_timeout)
+
+    def get_model_config(self, model_name, model_version="", headers=None,
+                         as_json=False, client_timeout=None):
+        return self._call(
+            self._client_stub.ModelConfig,
+            pb.ModelConfigRequest(name=model_name, version=model_version),
+            headers, as_json, client_timeout)
+
+    def get_model_repository_index(self, headers=None, as_json=False,
+                                   client_timeout=None):
+        return self._call(self._client_stub.RepositoryIndex,
+                          pb.RepositoryIndexRequest(), headers, as_json,
+                          client_timeout)
+
+    def load_model(self, model_name, headers=None, config=None, files=None,
+                   client_timeout=None):
+        self._call(self._client_stub.RepositoryModelLoad,
+                   pb.RepositoryModelLoadRequest(model_name=model_name),
+                   headers, client_timeout=client_timeout)
+
+    def unload_model(self, model_name, headers=None,
+                     unload_dependents=False, client_timeout=None):
+        self._call(self._client_stub.RepositoryModelUnload,
+                   pb.RepositoryModelUnloadRequest(model_name=model_name),
+                   headers, client_timeout=client_timeout)
+
+    def get_inference_statistics(self, model_name="", model_version="",
+                                 headers=None, as_json=False,
+                                 client_timeout=None):
+        return self._call(
+            self._client_stub.ModelStatistics,
+            pb.ModelStatisticsRequest(name=model_name,
+                                      version=model_version),
+            headers, as_json, client_timeout)
+
+    # -- shared memory -------------------------------------------------------
+
+    def get_system_shared_memory_status(self, region_name="", headers=None,
+                                        as_json=False, client_timeout=None):
+        return self._call(
+            self._client_stub.SystemSharedMemoryStatus,
+            pb.SystemSharedMemoryStatusRequest(name=region_name),
+            headers, as_json, client_timeout)
+
+    def register_system_shared_memory(self, name, key, byte_size, offset=0,
+                                      headers=None, client_timeout=None):
+        self._call(
+            self._client_stub.SystemSharedMemoryRegister,
+            pb.SystemSharedMemoryRegisterRequest(
+                name=name, key=key, offset=offset, byte_size=byte_size),
+            headers, client_timeout=client_timeout)
+
+    def unregister_system_shared_memory(self, name="", headers=None,
+                                        client_timeout=None):
+        self._call(
+            self._client_stub.SystemSharedMemoryUnregister,
+            pb.SystemSharedMemoryUnregisterRequest(name=name), headers,
+            client_timeout=client_timeout)
+
+    def get_tpu_shared_memory_status(self, region_name="", headers=None,
+                                     as_json=False, client_timeout=None):
+        return self._call(
+            self._client_stub.TpuSharedMemoryStatus,
+            pb.TpuSharedMemoryStatusRequest(name=region_name),
+            headers, as_json, client_timeout)
+
+    def register_tpu_shared_memory(self, name, raw_handle, device_id,
+                                   byte_size, headers=None,
+                                   client_timeout=None):
+        """Register a TPU-HBM region by serialized buffer handle (the raw
+        bytes travel in the proto, like the reference's cudaIpcMemHandle_t
+        in raw_handle, grpc_client.cc:811)."""
+        self._call(
+            self._client_stub.TpuSharedMemoryRegister,
+            pb.TpuSharedMemoryRegisterRequest(
+                name=name, raw_handle=raw_handle, device_id=device_id,
+                byte_size=byte_size),
+            headers, client_timeout=client_timeout)
+
+    def unregister_tpu_shared_memory(self, name="", headers=None,
+                                     client_timeout=None):
+        self._call(
+            self._client_stub.TpuSharedMemoryUnregister,
+            pb.TpuSharedMemoryUnregisterRequest(name=name), headers,
+            client_timeout=client_timeout)
+
+    get_cuda_shared_memory_status = get_tpu_shared_memory_status
+    register_cuda_shared_memory = register_tpu_shared_memory
+    unregister_cuda_shared_memory = unregister_tpu_shared_memory
+
+    # -- inference -----------------------------------------------------------
+
+    def _make_request(self, model_name, inputs, model_version, outputs,
+                      request_id, sequence_id, sequence_start, sequence_end,
+                      priority, timeout, parameters):
+        request = pb.ModelInferRequest(
+            model_name=model_name, model_version=model_version,
+            id=request_id)
+        if sequence_id:
+            grpc_codec.set_param(request.parameters, "sequence_id",
+                                 sequence_id)
+            grpc_codec.set_param(request.parameters, "sequence_start",
+                                 sequence_start)
+            grpc_codec.set_param(request.parameters, "sequence_end",
+                                 sequence_end)
+        if priority:
+            grpc_codec.set_param(request.parameters, "priority", priority)
+        if timeout is not None:
+            grpc_codec.set_param(request.parameters, "timeout", timeout)
+        for k, v in (parameters or {}).items():
+            grpc_codec.set_param(request.parameters, k, v)
+        for i in inputs:
+            tensor, raw = i._get_tensor()
+            request.inputs.append(tensor)
+            if raw is not None:
+                request.raw_input_contents.append(raw)
+        for o in outputs or []:
+            request.outputs.append(o._get_tensor())
+        return request
+
+    def infer(self, model_name, inputs, model_version="", outputs=None,
+              request_id="", sequence_id=0, sequence_start=False,
+              sequence_end=False, priority=0, timeout=None,
+              client_timeout=None, headers=None, compression_algorithm=None,
+              parameters=None):
+        request = self._make_request(
+            model_name, inputs, model_version, outputs, request_id,
+            sequence_id, sequence_start, sequence_end, priority, timeout,
+            parameters)
+        try:
+            response = self._client_stub.ModelInfer(
+                request, metadata=self._md(headers), timeout=client_timeout,
+                compression=_compression(compression_algorithm))
+        except grpc.RpcError as exc:
+            raise _grpc_error(exc) from None
+        return InferResult(response)
+
+    def async_infer(self, model_name, inputs, callback, model_version="",
+                    outputs=None, request_id="", sequence_id=0,
+                    sequence_start=False, sequence_end=False, priority=0,
+                    timeout=None, client_timeout=None, headers=None,
+                    compression_algorithm=None, parameters=None):
+        request = self._make_request(
+            model_name, inputs, model_version, outputs, request_id,
+            sequence_id, sequence_start, sequence_end, priority, timeout,
+            parameters)
+        future = self._client_stub.ModelInfer.future(
+            request, metadata=self._md(headers), timeout=client_timeout,
+            compression=_compression(compression_algorithm))
+
+        def _done(f):
+            # only the RPC result fetch is guarded: an exception raised by
+            # the user's own callback must not re-invoke it as an error
+            try:
+                result = InferResult(f.result())
+            except grpc.RpcError as exc:
+                callback(None, _grpc_error(exc))
+                return
+            except Exception as exc:  # noqa: BLE001
+                callback(None, InferenceServerException(str(exc)))
+                return
+            callback(result, None)
+
+        future.add_done_callback(_done)
+        return CallContext(future)
+
+    # -- streaming -----------------------------------------------------------
+
+    def start_stream(self, callback, stream_timeout=None, headers=None):
+        if self._stream is not None:
+            raise_error("stream already started")
+        self._stream = _InferStream(self._client_stub, callback,
+                                    stream_timeout, headers)
+
+    def stop_stream(self, cancel_requests=False):
+        if self._stream is not None:
+            self._stream.close(cancel_requests)
+            self._stream = None
+
+    def async_stream_infer(self, model_name, inputs, model_version="",
+                           outputs=None, request_id="", sequence_id=0,
+                           sequence_start=False, sequence_end=False,
+                           priority=0, timeout=None, parameters=None,
+                           enable_empty_final_response=False):
+        if self._stream is None:
+            raise_error("stream not started (call start_stream first)")
+        request = self._make_request(
+            model_name, inputs, model_version, outputs, request_id,
+            sequence_id, sequence_start, sequence_end, priority, timeout,
+            parameters)
+        self._stream.send(request)
+
+
+def _compression(name):
+    if name is None:
+        return None
+    if name == "gzip":
+        return grpc.Compression.Gzip
+    if name == "deflate":
+        return grpc.Compression.Deflate
+    return grpc.Compression.NoCompression

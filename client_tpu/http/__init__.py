@@ -419,7 +419,13 @@ class InferenceServerClient:
                                    query_params=None):
         """Register a TPU-HBM region by serialized buffer handle — the
         TPU-native replacement for register_cuda_shared_memory (reference
-        cuda_shared_memory base64 handle transport)."""
+        cuda_shared_memory base64 handle transport). ``raw_handle`` may be
+        the raw bytes from ``tpu_shared_memory.get_raw_handle`` or an
+        already-base64 string."""
+        if isinstance(raw_handle, (bytes, bytearray)):
+            from client_tpu.protocol.codec import b64_encode_handle
+
+            raw_handle = b64_encode_handle(bytes(raw_handle))
         self._post_json(
             f"/v2/tpusharedmemory/region/{quote(name)}/register",
             {"raw_handle": {"b64": raw_handle}, "device_id": device_id,

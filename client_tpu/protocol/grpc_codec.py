@@ -1,0 +1,97 @@
+"""numpy ↔ gRPC protobuf tensor conversion.
+
+Two encodings, as in the v2 protocol: ``raw_*_contents`` (packed little-endian
+bytes, the fast path the reference uses for everything,
+grpc_client.cc:1084-1222) and typed ``InferTensorContents`` fields (used by
+the explicit-content example clients, e.g.
+/root/reference/src/python/examples/grpc_explicit_int_content_client.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from client_tpu.protocol import grpc_service_pb2 as pb
+from client_tpu.protocol.codec import deserialize_tensor, serialize_tensor
+from client_tpu.protocol.dtypes import DataType, wire_to_np_dtype
+
+# typed-contents field per wire dtype (BYTES handled separately)
+_CONTENT_FIELD = {
+    DataType.BOOL: "bool_contents",
+    DataType.INT8: "int_contents",
+    DataType.INT16: "int_contents",
+    DataType.INT32: "int_contents",
+    DataType.INT64: "int64_contents",
+    DataType.UINT8: "uint_contents",
+    DataType.UINT16: "uint_contents",
+    DataType.UINT32: "uint_contents",
+    DataType.UINT64: "uint64_contents",
+    DataType.FP32: "fp32_contents",
+    DataType.FP64: "fp64_contents",
+    DataType.BYTES: "bytes_contents",
+}
+
+
+def set_param(param_map, key, value) -> None:
+    p = param_map[key]
+    if isinstance(value, bool):
+        p.bool_param = value
+    elif isinstance(value, int):
+        p.int64_param = value
+    elif isinstance(value, float):
+        p.double_param = value
+    else:
+        p.string_param = str(value)
+
+
+def param_value(p: "pb.InferParameter"):
+    which = p.WhichOneof("parameter_choice")
+    return getattr(p, which) if which else None
+
+
+def params_to_dict(param_map) -> dict:
+    return {k: param_value(v) for k, v in param_map.items()}
+
+
+def fill_contents(contents: "pb.InferTensorContents", arr: np.ndarray,
+                  datatype: str) -> None:
+    """Populate the typed contents field from a numpy array."""
+    field = _CONTENT_FIELD.get(datatype)
+    if field is None:
+        raise ValueError(
+            f"datatype {datatype} has no typed contents field; use raw")
+    if datatype == DataType.BYTES:
+        flat = np.ravel(arr, order="C")
+        contents.bytes_contents.extend(
+            x if isinstance(x, bytes) else
+            bytes(x) if isinstance(x, (bytearray, np.bytes_)) else
+            str(x).encode("utf-8")
+            for x in flat)
+    else:
+        getattr(contents, field).extend(
+            np.ravel(arr, order="C").tolist())
+
+
+def contents_to_ndarray(contents: "pb.InferTensorContents", datatype: str,
+                        shape) -> np.ndarray:
+    field = _CONTENT_FIELD.get(datatype)
+    if field is None:
+        raise ValueError(f"datatype {datatype} not representable as contents")
+    shape = tuple(int(d) for d in shape)
+    if datatype == DataType.BYTES:
+        arr = np.array(list(contents.bytes_contents), dtype=np.object_)
+    else:
+        arr = np.array(getattr(contents, field),
+                       dtype=wire_to_np_dtype(datatype))
+    return arr.reshape(shape)
+
+
+def tensor_to_ndarray(tensor, raw: bytes | None) -> np.ndarray:
+    """InferInputTensor/InferOutputTensor (+ its raw slice) -> ndarray."""
+    if raw is not None:
+        return deserialize_tensor(raw, tensor.datatype, tensor.shape)
+    return contents_to_ndarray(tensor.contents, tensor.datatype, tensor.shape)
+
+
+def ndarray_to_raw(arr: np.ndarray, datatype: str) -> bytes:
+    return serialize_tensor(arr, datatype)

@@ -1,0 +1,111 @@
+"""gRPC stubs for inference.GRPCInferenceService.
+
+Hand-written equivalent of the ``*_pb2_grpc.py`` file grpc_tools would
+generate (the runtime image ships grpcio + protoc but not grpc_tools).
+Method table mirrors the service definition in protos/grpc_service.proto;
+the fully-qualified method paths match the reference protocol, so these
+stubs interoperate with any v2 gRPC peer.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from client_tpu.protocol import grpc_service_pb2 as pb
+
+_SERVICE = "inference.GRPCInferenceService"
+
+# (method name, request message, response message, is_streaming)
+_METHODS = [
+    ("ServerLive", pb.ServerLiveRequest, pb.ServerLiveResponse, False),
+    ("ServerReady", pb.ServerReadyRequest, pb.ServerReadyResponse, False),
+    ("ModelReady", pb.ModelReadyRequest, pb.ModelReadyResponse, False),
+    ("ServerMetadata", pb.ServerMetadataRequest, pb.ServerMetadataResponse, False),
+    ("ModelMetadata", pb.ModelMetadataRequest, pb.ModelMetadataResponse, False),
+    ("ModelInfer", pb.ModelInferRequest, pb.ModelInferResponse, False),
+    ("ModelStreamInfer", pb.ModelInferRequest, pb.ModelStreamInferResponse, True),
+    ("ModelConfig", pb.ModelConfigRequest, pb.ModelConfigResponse, False),
+    ("ModelStatistics", pb.ModelStatisticsRequest, pb.ModelStatisticsResponse, False),
+    ("RepositoryIndex", pb.RepositoryIndexRequest, pb.RepositoryIndexResponse, False),
+    ("RepositoryModelLoad", pb.RepositoryModelLoadRequest,
+     pb.RepositoryModelLoadResponse, False),
+    ("RepositoryModelUnload", pb.RepositoryModelUnloadRequest,
+     pb.RepositoryModelUnloadResponse, False),
+    ("SystemSharedMemoryStatus", pb.SystemSharedMemoryStatusRequest,
+     pb.SystemSharedMemoryStatusResponse, False),
+    ("SystemSharedMemoryRegister", pb.SystemSharedMemoryRegisterRequest,
+     pb.SystemSharedMemoryRegisterResponse, False),
+    ("SystemSharedMemoryUnregister", pb.SystemSharedMemoryUnregisterRequest,
+     pb.SystemSharedMemoryUnregisterResponse, False),
+    ("CudaSharedMemoryStatus", pb.CudaSharedMemoryStatusRequest,
+     pb.CudaSharedMemoryStatusResponse, False),
+    ("CudaSharedMemoryRegister", pb.CudaSharedMemoryRegisterRequest,
+     pb.CudaSharedMemoryRegisterResponse, False),
+    ("CudaSharedMemoryUnregister", pb.CudaSharedMemoryUnregisterRequest,
+     pb.CudaSharedMemoryUnregisterResponse, False),
+    ("TpuSharedMemoryStatus", pb.TpuSharedMemoryStatusRequest,
+     pb.TpuSharedMemoryStatusResponse, False),
+    ("TpuSharedMemoryRegister", pb.TpuSharedMemoryRegisterRequest,
+     pb.TpuSharedMemoryRegisterResponse, False),
+    ("TpuSharedMemoryUnregister", pb.TpuSharedMemoryUnregisterRequest,
+     pb.TpuSharedMemoryUnregisterResponse, False),
+]
+
+
+class GRPCInferenceServiceStub:
+    """Client-side stub; one callable per RPC, plus Async variants exposed
+    via the callables' ``.future`` (grpcio's standard mechanism)."""
+
+    def __init__(self, channel: grpc.Channel):
+        for name, req_t, resp_t, streaming in _METHODS:
+            path = f"/{_SERVICE}/{name}"
+            if streaming:
+                call = channel.stream_stream(
+                    path,
+                    request_serializer=req_t.SerializeToString,
+                    response_deserializer=resp_t.FromString,
+                )
+            else:
+                call = channel.unary_unary(
+                    path,
+                    request_serializer=req_t.SerializeToString,
+                    response_deserializer=resp_t.FromString,
+                )
+            setattr(self, name, call)
+
+
+class GRPCInferenceServiceServicer:
+    """Server-side base class; override the RPCs the server implements."""
+
+
+def _make_unimplemented(name):
+    def method(self, request, context):
+        context.set_code(grpc.StatusCode.UNIMPLEMENTED)
+        context.set_details(f"{name} is not implemented")
+        raise NotImplementedError(name)
+
+    return method
+
+
+for _name, _req, _resp, _streaming in _METHODS:
+    setattr(GRPCInferenceServiceServicer, _name, _make_unimplemented(_name))
+
+
+def add_GRPCInferenceServiceServicer_to_server(servicer, server):  # noqa: N802
+    handlers = {}
+    for name, req_t, resp_t, streaming in _METHODS:
+        fn = getattr(servicer, name)
+        if streaming:
+            handlers[name] = grpc.stream_stream_rpc_method_handler(
+                fn,
+                request_deserializer=req_t.FromString,
+                response_serializer=resp_t.SerializeToString,
+            )
+        else:
+            handlers[name] = grpc.unary_unary_rpc_method_handler(
+                fn,
+                request_deserializer=req_t.FromString,
+                response_serializer=resp_t.SerializeToString,
+            )
+    generic = grpc.method_handlers_generic_handler(_SERVICE, handlers)
+    server.add_generic_rpc_handlers((generic,))

@@ -1,0 +1,121 @@
+"""ModelConfig protobuf ↔ engine-config-dict conversion + pbtxt loading.
+
+Lets the engine serve its JSON-native configs over the gRPC ModelConfig RPC,
+and lets users load Triton-style ``config.pbtxt`` files (like the reference's
+in-tree /root/reference/models/ssd_mobilenet_v2_coco_quantized/config.pbtxt)
+via protobuf text_format.
+"""
+
+from __future__ import annotations
+
+from google.protobuf import text_format
+
+from client_tpu.protocol import grpc_service_pb2 as pb
+
+
+def config_dict_to_proto(d: dict) -> "pb.ModelConfig":
+    cfg = pb.ModelConfig(
+        name=d.get("name", ""),
+        platform=d.get("platform", ""),
+        backend=d.get("backend", ""),
+        max_batch_size=int(d.get("max_batch_size", 0)),
+    )
+    for io_key, holder in (("input", cfg.input), ("output", cfg.output)):
+        for t in d.get(io_key, []):
+            entry = holder.add(name=t["name"],
+                               dims=[int(x) for x in t["dims"]])
+            dt = t.get("data_type", "TYPE_INVALID")
+            if not dt.startswith("TYPE_"):
+                dt = "TYPE_" + ("STRING" if dt == "BYTES" else dt)
+            entry.data_type = pb.DataType.Value(dt)
+    if "dynamic_batching" in d:
+        db = d["dynamic_batching"] or {}
+        cfg.dynamic_batching.preferred_batch_size.extend(
+            int(x) for x in db.get("preferred_batch_size", []))
+        cfg.dynamic_batching.max_queue_delay_microseconds = int(
+            db.get("max_queue_delay_microseconds", 0))
+    if "sequence_batching" in d:
+        sb = d["sequence_batching"] or {}
+        if sb.get("strategy") == "oldest":
+            cfg.sequence_batching.oldest.SetInParent()
+        else:
+            cfg.sequence_batching.direct.SetInParent()
+    if d.get("ensemble_scheduling"):
+        for s in d["ensemble_scheduling"].get("step", []):
+            step = cfg.ensemble_scheduling.step.add(
+                model_name=s["model_name"],
+                model_version=int(s.get("model_version", -1)))
+            step.input_map.update(s.get("input_map", {}))
+            step.output_map.update(s.get("output_map", {}))
+    if (d.get("model_transaction_policy") or {}).get("decoupled"):
+        cfg.model_transaction_policy.decoupled = True
+    return cfg
+
+
+def proto_to_config_dict(cfg: "pb.ModelConfig") -> dict:
+    d: dict = {
+        "name": cfg.name,
+        "platform": cfg.platform or cfg.backend or "jax",
+        "max_batch_size": cfg.max_batch_size,
+        "input": [],
+        "output": [],
+    }
+    for t in cfg.input:
+        entry = {
+            "name": t.name,
+            "data_type": pb.DataType.Name(t.data_type),
+            "dims": list(t.dims),
+        }
+        if t.reshape.shape:
+            entry["reshape"] = {"shape": list(t.reshape.shape)}
+        if t.optional:
+            entry["optional"] = True
+        d["input"].append(entry)
+    for t in cfg.output:
+        entry = {
+            "name": t.name,
+            "data_type": pb.DataType.Name(t.data_type),
+            "dims": list(t.dims),
+        }
+        if t.reshape.shape:
+            entry["reshape"] = {"shape": list(t.reshape.shape)}
+        d["output"].append(entry)
+    if cfg.HasField("dynamic_batching"):
+        d["dynamic_batching"] = {
+            "preferred_batch_size": list(
+                cfg.dynamic_batching.preferred_batch_size),
+            "max_queue_delay_microseconds":
+                cfg.dynamic_batching.max_queue_delay_microseconds,
+        }
+    if cfg.HasField("sequence_batching"):
+        sb: dict = {"max_sequence_idle_microseconds":
+                    cfg.sequence_batching.max_sequence_idle_microseconds
+                    or 1_000_000_000}
+        if cfg.sequence_batching.WhichOneof("strategy_choice") == "oldest":
+            sb["strategy"] = "oldest"
+        d["sequence_batching"] = sb
+    if cfg.ensemble_scheduling.step:
+        d["ensemble_scheduling"] = {
+            "step": [
+                {
+                    "model_name": s.model_name,
+                    "model_version": s.model_version,
+                    "input_map": dict(s.input_map),
+                    "output_map": dict(s.output_map),
+                }
+                for s in cfg.ensemble_scheduling.step
+            ]
+        }
+    if cfg.instance_group:
+        d["instance_group"] = [{"count": g.count or 1}
+                               for g in cfg.instance_group]
+    if cfg.model_transaction_policy.decoupled:
+        d["model_transaction_policy"] = {"decoupled": True}
+    return d
+
+
+def load_pbtxt(path: str) -> dict:
+    """Parse a Triton-style config.pbtxt into an engine config dict."""
+    with open(path) as f:
+        cfg = text_format.Parse(f.read(), pb.ModelConfig())
+    return proto_to_config_dict(cfg)

@@ -6,4 +6,5 @@ The reference talks to these endpoints from the outside (KServe v2 routes,
 the whole stack is self-contained and hermetically testable.
 """
 
+from client_tpu.server.grpc_server import GrpcInferenceServer  # noqa: F401
 from client_tpu.server.http_server import HttpInferenceServer  # noqa: F401

@@ -1,0 +1,446 @@
+"""gRPC frontend: inference.GRPCInferenceService over the engine.
+
+Serves the same RPC surface the reference's gRPC client consumes
+(/root/reference/src/c++/library/grpc_client.h:99-312): control plane,
+unary ModelInfer, and bidirectional ModelStreamInfer (one stream carries many
+requests; responses — several per request for decoupled models — flow back
+with correlation by request id, terminated per-request by the
+``triton_final_response`` parameter).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent import futures
+
+import grpc
+import numpy as np
+
+from client_tpu.engine.engine import TpuEngine
+from client_tpu.engine.types import EngineError, InferRequest, OutputRequest
+from client_tpu.protocol import grpc_codec, grpc_service_pb2 as pb
+from client_tpu.protocol.grpc_stub import (
+    GRPCInferenceServiceServicer,
+    add_GRPCInferenceServiceServicer_to_server,
+)
+from client_tpu.protocol.model_config import config_dict_to_proto
+from client_tpu.server.classification import classify_output
+
+_STATUS_BY_HTTP = {
+    400: grpc.StatusCode.INVALID_ARGUMENT,
+    404: grpc.StatusCode.NOT_FOUND,
+    415: grpc.StatusCode.INVALID_ARGUMENT,
+    500: grpc.StatusCode.INTERNAL,
+    504: grpc.StatusCode.DEADLINE_EXCEEDED,
+}
+
+
+def _abort(context, exc: Exception):
+    if isinstance(exc, EngineError):
+        code = _STATUS_BY_HTTP.get(exc.status, grpc.StatusCode.UNKNOWN)
+        context.abort(code, str(exc))
+    context.abort(grpc.StatusCode.INTERNAL, str(exc))
+
+
+def _proto_to_request(engine: TpuEngine,
+                     request: "pb.ModelInferRequest") -> InferRequest:
+    inputs: dict[str, np.ndarray] = {}
+    raw = list(request.raw_input_contents)
+    raw_idx = 0
+    for tensor in request.inputs:
+        t_params = grpc_codec.params_to_dict(tensor.parameters)
+        region = t_params.get("shared_memory_region")
+        if region is not None:
+            arr = _read_shm_input(engine, tensor, t_params)
+        elif raw_idx < len(raw) and not _has_contents(tensor):
+            arr = grpc_codec.tensor_to_ndarray(tensor, raw[raw_idx])
+            raw_idx += 1
+        else:
+            arr = grpc_codec.tensor_to_ndarray(tensor, None)
+        inputs[tensor.name] = arr
+
+    outputs = []
+    for o in request.outputs:
+        p = grpc_codec.params_to_dict(o.parameters)
+        outputs.append(OutputRequest(
+            name=o.name,
+            classification_count=int(p.get("classification", 0)),
+            shm_region=p.get("shared_memory_region"),
+            shm_offset=int(p.get("shared_memory_offset", 0)),
+            shm_byte_size=int(p.get("shared_memory_byte_size", 0)),
+            parameters=p,
+        ))
+
+    params = grpc_codec.params_to_dict(request.parameters)
+    return InferRequest(
+        model_name=request.model_name,
+        model_version=request.model_version,
+        request_id=request.id,
+        inputs=inputs,
+        outputs=outputs,
+        parameters=params,
+        sequence_id=int(params.get("sequence_id", 0)),
+        sequence_start=bool(params.get("sequence_start", False)),
+        sequence_end=bool(params.get("sequence_end", False)),
+        priority=int(params.get("priority", 0)),
+        timeout_us=int(params.get("timeout", 0)),
+    )
+
+
+def _has_contents(tensor) -> bool:
+    c = tensor.contents
+    return any(len(getattr(c, f.name)) for f in c.DESCRIPTOR.fields)
+
+
+def _read_shm_input(engine, tensor, params) -> np.ndarray:
+    region = params["shared_memory_region"]
+    offset = int(params.get("shared_memory_offset", 0))
+    size = int(params.get("shared_memory_byte_size", 0))
+    for mgr in (engine.tpu_shm, engine.system_shm):
+        if mgr is not None and mgr.has_region(region):
+            return mgr.read_tensor(region, offset, size, tensor.datatype,
+                                   tensor.shape)
+    raise EngineError(f"shared memory region '{region}' not registered", 400)
+
+
+def _response_to_proto(engine: TpuEngine, req: InferRequest, resp,
+                       use_raw: bool = True) -> "pb.ModelInferResponse":
+    out = pb.ModelInferResponse(
+        model_name=resp.model_name,
+        model_version=resp.model_version,
+        id=resp.request_id,
+    )
+    for k, v in (resp.parameters or {}).items():
+        grpc_codec.set_param(out.parameters, k, v)
+
+    model = engine.repository.get(req.model_name)
+    cfg = model.config if model is not None else None
+    out_req = {o.name: o for o in req.outputs}
+    from client_tpu.protocol.dtypes import np_to_wire_dtype
+
+    for name, arr in resp.outputs.items():
+        o = out_req.get(name)
+        if o is not None and o.classification_count > 0:
+            labels = None
+            if cfg is not None:
+                labels = (cfg.parameters.get("labels") or {}).get(name)
+            arr = classify_output(arr, o.classification_count, labels)
+            tensor = out.outputs.add(name=name, datatype="BYTES",
+                                     shape=list(arr.shape))
+            out.raw_output_contents.append(
+                grpc_codec.ndarray_to_raw(arr, "BYTES"))
+            continue
+        dt = np_to_wire_dtype(arr.dtype)
+        tensor = out.outputs.add(name=name, datatype=dt,
+                                 shape=list(arr.shape))
+        if o is not None and o.shm_region:
+            written = _write_shm_output(engine, o, arr)
+            grpc_codec.set_param(tensor.parameters, "shared_memory_region",
+                                 o.shm_region)
+            grpc_codec.set_param(tensor.parameters, "shared_memory_offset",
+                                 o.shm_offset)
+            grpc_codec.set_param(tensor.parameters, "shared_memory_byte_size",
+                                 written)
+            continue
+        out.raw_output_contents.append(grpc_codec.ndarray_to_raw(arr, dt))
+    return out
+
+
+def _write_shm_output(engine, o: OutputRequest, arr: np.ndarray) -> int:
+    for mgr in (engine.tpu_shm, engine.system_shm):
+        if mgr is not None and mgr.has_region(o.shm_region):
+            return mgr.write_tensor(o.shm_region, o.shm_offset,
+                                    o.shm_byte_size, arr)
+    raise EngineError(
+        f"shared memory region '{o.shm_region}' not registered", 400)
+
+
+class _Servicer(GRPCInferenceServiceServicer):
+    def __init__(self, engine: TpuEngine):
+        self.engine = engine
+
+    # -- health / metadata ---------------------------------------------------
+
+    def ServerLive(self, request, context):  # noqa: N802
+        return pb.ServerLiveResponse(live=self.engine.is_live())
+
+    def ServerReady(self, request, context):  # noqa: N802
+        return pb.ServerReadyResponse(ready=self.engine.is_ready())
+
+    def ModelReady(self, request, context):  # noqa: N802
+        return pb.ModelReadyResponse(
+            ready=self.engine.model_is_ready(request.name, request.version))
+
+    def ServerMetadata(self, request, context):  # noqa: N802
+        md = self.engine.server_metadata()
+        return pb.ServerMetadataResponse(
+            name=md["name"], version=md["version"],
+            extensions=md["extensions"])
+
+    def ModelMetadata(self, request, context):  # noqa: N802
+        try:
+            md = self.engine.model_metadata(request.name, request.version)
+        except Exception as exc:  # noqa: BLE001
+            _abort(context, exc)
+        resp = pb.ModelMetadataResponse(
+            name=md["name"], versions=md["versions"], platform=md["platform"])
+        for io_key, holder in (("inputs", resp.inputs),
+                               ("outputs", resp.outputs)):
+            for t in md[io_key]:
+                holder.add(name=t["name"], datatype=t["datatype"],
+                           shape=t["shape"])
+        return resp
+
+    def ModelConfig(self, request, context):  # noqa: N802
+        try:
+            cfg = self.engine.model_config(request.name, request.version)
+        except Exception as exc:  # noqa: BLE001
+            _abort(context, exc)
+        return pb.ModelConfigResponse(config=config_dict_to_proto(cfg))
+
+    def ModelStatistics(self, request, context):  # noqa: N802
+        try:
+            stats = self.engine.model_statistics(request.name,
+                                                 request.version)
+        except Exception as exc:  # noqa: BLE001
+            _abort(context, exc)
+        resp = pb.ModelStatisticsResponse()
+        for s in stats["model_stats"]:
+            entry = resp.model_stats.add(
+                name=s["name"], version=s["version"],
+                last_inference=s["last_inference"],
+                inference_count=s["inference_count"],
+                execution_count=s["execution_count"])
+            for phase, msg in (
+                    ("success", entry.inference_stats.success),
+                    ("fail", entry.inference_stats.fail),
+                    ("queue", entry.inference_stats.queue),
+                    ("compute_input", entry.inference_stats.compute_input),
+                    ("compute_infer", entry.inference_stats.compute_infer),
+                    ("compute_output", entry.inference_stats.compute_output)):
+                msg.count = s["inference_stats"][phase]["count"]
+                msg.ns = s["inference_stats"][phase]["ns"]
+            for b in s.get("batch_stats", []):
+                be = entry.batch_stats.add(batch_size=b["batch_size"])
+                be.compute_infer.count = b["compute_infer"]["count"]
+        return resp
+
+    # -- repository ----------------------------------------------------------
+
+    def RepositoryIndex(self, request, context):  # noqa: N802
+        resp = pb.RepositoryIndexResponse()
+        for e in self.engine.repository_index():
+            resp.models.add(name=e["name"], version=e.get("version", ""),
+                            state=e.get("state", ""),
+                            reason=e.get("reason", ""))
+        return resp
+
+    def RepositoryModelLoad(self, request, context):  # noqa: N802
+        try:
+            self.engine.load_model(request.model_name)
+        except Exception as exc:  # noqa: BLE001
+            _abort(context, exc)
+        return pb.RepositoryModelLoadResponse()
+
+    def RepositoryModelUnload(self, request, context):  # noqa: N802
+        try:
+            self.engine.unload_model(request.model_name)
+        except Exception as exc:  # noqa: BLE001
+            _abort(context, exc)
+        return pb.RepositoryModelUnloadResponse()
+
+    # -- shared memory -------------------------------------------------------
+
+    def _sys_mgr(self, context):
+        if self.engine.system_shm is None:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "system shared memory is not enabled")
+        return self.engine.system_shm
+
+    def _tpu_mgr(self, context):
+        if self.engine.tpu_shm is None:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "tpu shared memory is not enabled")
+        return self.engine.tpu_shm
+
+    def SystemSharedMemoryStatus(self, request, context):  # noqa: N802
+        mgr = self._sys_mgr(context)
+        resp = pb.SystemSharedMemoryStatusResponse()
+        for name, info in mgr.status(request.name or None).items():
+            resp.regions[name].name = name
+            resp.regions[name].key = info.get("key", "")
+            resp.regions[name].offset = int(info.get("offset", 0))
+            resp.regions[name].byte_size = int(info.get("byte_size", 0))
+        return resp
+
+    def SystemSharedMemoryRegister(self, request, context):  # noqa: N802
+        try:
+            self._sys_mgr(context).register(
+                request.name, request.key, request.offset, request.byte_size)
+        except Exception as exc:  # noqa: BLE001
+            _abort(context, exc)
+        return pb.SystemSharedMemoryRegisterResponse()
+
+    def SystemSharedMemoryUnregister(self, request, context):  # noqa: N802
+        try:
+            self._sys_mgr(context).unregister(request.name or None)
+        except Exception as exc:  # noqa: BLE001
+            _abort(context, exc)
+        return pb.SystemSharedMemoryUnregisterResponse()
+
+    def _device_shm_status(self, request, context, resp):
+        mgr = self._tpu_mgr(context)
+        for name, info in mgr.status(request.name or None).items():
+            resp.regions[name].name = name
+            resp.regions[name].device_id = int(info.get("device_id", 0))
+            resp.regions[name].byte_size = int(info.get("byte_size", 0))
+        return resp
+
+    def _device_shm_register(self, request, context):
+        try:
+            self._tpu_mgr(context).register_handle(
+                request.name, request.raw_handle, request.device_id,
+                request.byte_size)
+        except Exception as exc:  # noqa: BLE001
+            _abort(context, exc)
+
+    def TpuSharedMemoryStatus(self, request, context):  # noqa: N802
+        return self._device_shm_status(
+            request, context, pb.TpuSharedMemoryStatusResponse())
+
+    def TpuSharedMemoryRegister(self, request, context):  # noqa: N802
+        self._device_shm_register(request, context)
+        return pb.TpuSharedMemoryRegisterResponse()
+
+    def TpuSharedMemoryUnregister(self, request, context):  # noqa: N802
+        try:
+            self._tpu_mgr(context).unregister(request.name or None)
+        except Exception as exc:  # noqa: BLE001
+            _abort(context, exc)
+        return pb.TpuSharedMemoryUnregisterResponse()
+
+    # cuda-named RPCs map onto the TPU region manager (wire parity)
+    def CudaSharedMemoryStatus(self, request, context):  # noqa: N802
+        return self._device_shm_status(
+            request, context, pb.CudaSharedMemoryStatusResponse())
+
+    def CudaSharedMemoryRegister(self, request, context):  # noqa: N802
+        self._device_shm_register(request, context)
+        return pb.CudaSharedMemoryRegisterResponse()
+
+    def CudaSharedMemoryUnregister(self, request, context):  # noqa: N802
+        try:
+            self._tpu_mgr(context).unregister(request.name or None)
+        except Exception as exc:  # noqa: BLE001
+            _abort(context, exc)
+        return pb.CudaSharedMemoryUnregisterResponse()
+
+    # -- inference -----------------------------------------------------------
+
+    def ModelInfer(self, request, context):  # noqa: N802
+        try:
+            req = _proto_to_request(self.engine, request)
+            resp = self.engine.infer(req)
+            return _response_to_proto(self.engine, req, resp)
+        except Exception as exc:  # noqa: BLE001
+            _abort(context, exc)
+
+    def ModelStreamInfer(self, request_iterator, context):  # noqa: N802
+        """Bidi stream: requests in, responses out; decoupled models emit
+        multiple responses per request (final marked by parameter)."""
+        out_q: queue.Queue = queue.Queue()
+        inflight = [0]
+        lock = threading.Lock()
+        done_reading = threading.Event()
+
+        def pump_requests():
+            try:
+                for request in request_iterator:
+                    try:
+                        req = _proto_to_request(self.engine, request)
+                    except Exception as exc:  # noqa: BLE001
+                        out_q.put(pb.ModelStreamInferResponse(
+                            error_message=str(exc)))
+                        continue
+
+                    with lock:
+                        inflight[0] += 1
+
+                    def make_cb(req):
+                        def cb(resp):
+                            if resp.error is not None:
+                                msg = pb.ModelStreamInferResponse(
+                                    error_message=str(resp.error))
+                                msg.infer_response.id = req.request_id
+                                out_q.put(msg)
+                            else:
+                                proto = _response_to_proto(
+                                    self.engine, req, resp)
+                                if resp.final:
+                                    grpc_codec.set_param(
+                                        proto.parameters,
+                                        "triton_final_response", True)
+                                out_q.put(pb.ModelStreamInferResponse(
+                                    infer_response=proto))
+                            if resp.final:
+                                with lock:
+                                    inflight[0] -= 1
+                                    rem = inflight[0]
+                                if rem == 0 and done_reading.is_set():
+                                    out_q.put(None)  # wake writer to exit
+                        return cb
+
+                    try:
+                        self.engine.async_infer(req, make_cb(req))
+                    except Exception as exc:  # noqa: BLE001
+                        out_q.put(pb.ModelStreamInferResponse(
+                            error_message=str(exc)))
+                        with lock:
+                            inflight[0] -= 1
+            finally:
+                done_reading.set()
+                out_q.put(None)  # wake the writer to re-check state
+
+        reader = threading.Thread(target=pump_requests, daemon=True)
+        reader.start()
+
+        while True:
+            item = out_q.get()
+            if item is not None:
+                yield item
+                continue
+            # sentinel: exit once the request side is done and no responses
+            # remain in flight (late finals re-post the sentinel above)
+            if done_reading.is_set():
+                with lock:
+                    remaining = inflight[0]
+                if remaining == 0 and out_q.empty():
+                    return
+
+
+class GrpcInferenceServer:
+    def __init__(self, engine: TpuEngine, host: str = "127.0.0.1",
+                 port: int = 8001, max_workers: int = 16):
+        self.engine = engine
+        self.server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[
+                ("grpc.max_send_message_length", -1),
+                ("grpc.max_receive_message_length", -1),
+            ])
+        add_GRPCInferenceServiceServicer_to_server(_Servicer(engine),
+                                                   self.server)
+        self.port = self.server.add_insecure_port(f"{host}:{port}")
+        self.host = host
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "GrpcInferenceServer":
+        self.server.start()
+        return self
+
+    def stop(self, grace: float = 2.0) -> None:
+        self.server.stop(grace).wait()

@@ -1,0 +1,83 @@
+"""Client-side TPU shared-memory utilities — the CUDA-shm replacement.
+
+API mirrors the reference's ``tritonclient.utils.cuda_shared_memory``
+(/root/reference/src/python/library/tritonclient/utils/cuda_shared_memory/
+__init__.py:46-270): create a region, get an opaque raw handle to register
+with the server, set/get tensors. The reference's handle is a
+base64-serializable ``cudaIpcMemHandle_t``; ours is a serialized descriptor
+of the region's host staging buffer (see
+:mod:`client_tpu.engine.shm` for the server-side semantics — cross-process
+HBM export is not a public libtpu capability, so the region contract is
+"zero network bytes, one host↔HBM DMA", with true zero-copy on the
+in-process path).
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+
+import numpy as np
+
+from client_tpu.engine.shm import make_tpu_handle
+from client_tpu.protocol.codec import b64_encode_handle
+from client_tpu.utils import shared_memory as _sysshm
+
+
+class TpuSharedMemoryException(Exception):
+    pass
+
+
+class TpuSharedMemoryRegion:
+    def __init__(self, triton_shm_name: str, byte_size: int, device_id: int,
+                 staging: "_sysshm.SharedMemoryRegion"):
+        self.triton_shm_name = triton_shm_name
+        self.byte_size = byte_size
+        self.device_id = device_id
+        self._staging = staging
+
+
+_regions: dict[str, TpuSharedMemoryRegion] = {}
+
+
+def create_shared_memory_region(triton_shm_name, byte_size,
+                                device_id=0) -> TpuSharedMemoryRegion:
+    key = f"/tpushm_{uuid.uuid4().hex[:12]}"
+    staging = _sysshm.create_shared_memory_region(
+        f"{triton_shm_name}__staging", key, byte_size)
+    region = TpuSharedMemoryRegion(triton_shm_name, byte_size, device_id,
+                                   staging)
+    _regions[triton_shm_name] = region
+    return region
+
+
+def get_raw_handle(shm_handle: TpuSharedMemoryRegion) -> bytes:
+    """Opaque handle bytes for Register calls (raw in gRPC proto; the HTTP
+    client base64-wraps them, mirroring the reference's handle transport)."""
+    return make_tpu_handle(shm_handle._staging.shm_key,
+                           shm_handle.byte_size, shm_handle.device_id)
+
+
+def get_raw_handle_b64(shm_handle: TpuSharedMemoryRegion) -> str:
+    return b64_encode_handle(get_raw_handle(shm_handle))
+
+
+def set_shared_memory_region(shm_handle: TpuSharedMemoryRegion, input_values,
+                             offset=0) -> None:
+    _sysshm.set_shared_memory_region(shm_handle._staging, input_values,
+                                     offset=offset)
+
+
+def get_contents_as_numpy(shm_handle: TpuSharedMemoryRegion, datatype, shape,
+                          offset=0) -> np.ndarray:
+    return _sysshm.get_contents_as_numpy(shm_handle._staging, datatype,
+                                         shape, offset=offset)
+
+
+def allocated_shared_memory_regions():
+    return list(_regions.keys())
+
+
+def destroy_shared_memory_region(shm_handle: TpuSharedMemoryRegion) -> None:
+    _regions.pop(shm_handle.triton_shm_name, None)
+    _sysshm.destroy_shared_memory_region(shm_handle._staging)

@@ -34,8 +34,9 @@ class TestMetadata:
         md = engine.server_metadata()
         assert md["name"] == "client_tpu"
         assert "binary_tensor_data" in md["extensions"]
-        # shm extensions only advertised once managers are attached
-        assert "tpu_shared_memory" not in md["extensions"]
+        # shm managers attach by default, so the extensions are advertised
+        assert "tpu_shared_memory" in md["extensions"]
+        assert "system_shared_memory" in md["extensions"]
 
     def test_model_metadata(self, engine):
         md = engine.model_metadata("simple")

@@ -1,0 +1,272 @@
+"""End-to-end gRPC tests: Python client ↔ gRPC server ↔ engine.
+
+Covers the reference's gRPC example/test surface (simple_grpc_*):
+unary sync/async, typed-contents and raw paths, streaming (decoupled and
+sequence), control plane, statistics, error mapping.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+from client_tpu.engine import TpuEngine
+from client_tpu.models import build_repository
+from client_tpu.server import GrpcInferenceServer
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def server():
+    eng = TpuEngine(build_repository(
+        ["simple", "simple_string", "simple_sequence", "simple_repeat"]))
+    srv = GrpcInferenceServer(eng, port=0).start()
+    yield srv
+    srv.stop()
+    eng.shutdown()
+
+
+@pytest.fixture()
+def client(server):
+    c = grpcclient.InferenceServerClient(server.url)
+    yield c
+    c.close()
+
+
+def _simple_inputs(batch=1):
+    a = np.arange(16 * batch, dtype=np.int32).reshape(batch, 16)
+    b = np.ones((batch, 16), dtype=np.int32)
+    i0 = grpcclient.InferInput("INPUT0", a.shape, "INT32")
+    i0.set_data_from_numpy(a)
+    i1 = grpcclient.InferInput("INPUT1", b.shape, "INT32")
+    i1.set_data_from_numpy(b)
+    return a, b, [i0, i1]
+
+
+class TestControlPlane:
+    def test_live_ready(self, client):
+        assert client.is_server_live()
+        assert client.is_server_ready()
+        assert client.is_model_ready("simple")
+
+    def test_server_metadata(self, client):
+        md = client.get_server_metadata()
+        assert md.name == "client_tpu"
+        md_json = client.get_server_metadata(as_json=True)
+        assert "binary_tensor_data" in md_json["extensions"]
+
+    def test_model_metadata(self, client):
+        md = client.get_model_metadata("simple")
+        assert md.name == "simple"
+        assert md.inputs[0].datatype == "INT32"
+        assert list(md.inputs[0].shape) == [-1, 16]
+
+    def test_model_config(self, client):
+        cfg = client.get_model_config("simple")
+        assert cfg.config.max_batch_size == 8
+        assert list(cfg.config.dynamic_batching.preferred_batch_size) == [4, 8]
+
+    def test_repository(self, client):
+        idx = client.get_model_repository_index()
+        names = {m.name for m in idx.models}
+        assert "simple" in names
+        client.unload_model("simple_string")
+        assert not client.is_model_ready("simple_string")
+        client.load_model("simple_string")
+        assert client.is_model_ready("simple_string")
+
+    def test_statistics(self, client):
+        st = client.get_inference_statistics("simple")
+        assert st.model_stats[0].name == "simple"
+
+    def test_unknown_model_not_found(self, client):
+        with pytest.raises(InferenceServerException) as ei:
+            client.get_model_metadata("ghost")
+        assert "unknown model" in str(ei.value)
+
+
+class TestInfer:
+    def test_raw_roundtrip(self, client):
+        a, b, inputs = _simple_inputs()
+        result = client.infer("simple", inputs, request_id="42")
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), a - b)
+        assert result.get_response().id == "42"
+
+    def test_typed_contents(self, client):
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.full((1, 16), 5, dtype=np.int32)
+        i0 = grpcclient.InferInput("INPUT0", a.shape, "INT32")
+        i0.set_data_from_numpy(a, use_contents=True)
+        i1 = grpcclient.InferInput("INPUT1", b.shape, "INT32")
+        i1.set_data_from_numpy(b, use_contents=True)
+        result = client.infer("simple", [i0, i1])
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+
+    def test_requested_outputs(self, client):
+        a, b, inputs = _simple_inputs()
+        outs = [grpcclient.InferRequestedOutput("OUTPUT1")]
+        result = client.infer("simple", inputs, outputs=outs)
+        assert result.as_numpy("OUTPUT0") is None
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), a - b)
+
+    def test_string_model(self, client):
+        a = np.array([[b"7"] * 16], dtype=np.object_)
+        b = np.array([[b"3"] * 16], dtype=np.object_)
+        i0 = grpcclient.InferInput("INPUT0", [1, 16], "BYTES")
+        i0.set_data_from_numpy(a)
+        i1 = grpcclient.InferInput("INPUT1", [1, 16], "BYTES")
+        i1.set_data_from_numpy(b, use_contents=True)
+        result = client.infer("simple_string", [i0, i1])
+        assert result.as_numpy("OUTPUT0")[0, 0] == b"10"
+        assert result.as_numpy("OUTPUT1")[0, 0] == b"4"
+
+    def test_async_infer(self, client):
+        a, b, inputs = _simple_inputs()
+        done = threading.Event()
+        box = []
+
+        def cb(result, error):
+            box.append((result, error))
+            done.set()
+
+        client.async_infer("simple", inputs, cb)
+        assert done.wait(timeout=30)
+        result, error = box[0]
+        assert error is None
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+
+    def test_async_infer_error(self, client):
+        a, b, inputs = _simple_inputs()
+        done = threading.Event()
+        box = []
+
+        def cb(result, error):
+            box.append((result, error))
+            done.set()
+
+        client.async_infer("ghost", inputs, cb)
+        assert done.wait(timeout=30)
+        result, error = box[0]
+        assert result is None
+        assert isinstance(error, InferenceServerException)
+
+    def test_infer_shape_error(self, client):
+        bad = np.zeros((1, 4), dtype=np.int32)
+        i0 = grpcclient.InferInput("INPUT0", [1, 4], "INT32")
+        i0.set_data_from_numpy(bad)
+        i1 = grpcclient.InferInput("INPUT1", [1, 4], "INT32")
+        i1.set_data_from_numpy(bad)
+        with pytest.raises(InferenceServerException):
+            client.infer("simple", [i0, i1])
+
+    def test_compression(self, client):
+        a, b, inputs = _simple_inputs(batch=4)
+        result = client.infer("simple", inputs,
+                              compression_algorithm="gzip")
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+
+    def test_sequence_unary(self, client):
+        outs = []
+        for i, v in enumerate([10, 20, 30]):
+            inp = grpcclient.InferInput("INPUT", [1], "INT32")
+            inp.set_data_from_numpy(np.array([v], dtype=np.int32))
+            r = client.infer("simple_sequence", [inp], sequence_id=900,
+                             sequence_start=(i == 0), sequence_end=(i == 2))
+            outs.append(int(r.as_numpy("OUTPUT")[0]))
+        assert outs == [10, 30, 60]
+
+
+class TestStreaming:
+    def test_stream_basic(self, server):
+        c = grpcclient.InferenceServerClient(server.url)
+        results, errors = [], []
+        done = threading.Event()
+
+        def cb(result, error):
+            if error is not None:
+                errors.append(error)
+                done.set()
+                return
+            results.append(result)
+            params = result.get_response().parameters
+            if ("triton_final_response" in params
+                    and params["triton_final_response"].bool_param):
+                done.set()
+
+        c.start_stream(cb)
+        a, b, inputs = _simple_inputs()
+        c.async_stream_infer("simple", inputs, request_id="s1")
+        assert done.wait(timeout=30)
+        assert not errors
+        np.testing.assert_array_equal(results[0].as_numpy("OUTPUT0"), a + b)
+        c.stop_stream()
+        c.close()
+
+    def test_stream_decoupled(self, server):
+        c = grpcclient.InferenceServerClient(server.url)
+        data_results = []
+        done = threading.Event()
+
+        def cb(result, error):
+            assert error is None, error
+            params = result.get_response().parameters
+            final = ("triton_final_response" in params
+                     and params["triton_final_response"].bool_param)
+            if result.get_response().outputs:
+                data_results.append(result)
+            if final:
+                done.set()
+
+        c.start_stream(cb)
+        inp = grpcclient.InferInput("IN", [3], "INT32")
+        inp.set_data_from_numpy(np.array([5, 6, 7], dtype=np.int32))
+        c.async_stream_infer("simple_repeat", [inp], request_id="d1")
+        assert done.wait(timeout=30)
+        assert [int(r.as_numpy("OUT")[0]) for r in data_results] == [5, 6, 7]
+        c.stop_stream()
+        c.close()
+
+    def test_stream_sequence(self, server):
+        c = grpcclient.InferenceServerClient(server.url)
+        outs = []
+        count = threading.Semaphore(0)
+
+        def cb(result, error):
+            assert error is None, error
+            if result.get_response().outputs:
+                outs.append(int(result.as_numpy("OUTPUT")[0]))
+            count.release()
+
+        c.start_stream(cb)
+        for i, v in enumerate([2, 4, 8]):
+            inp = grpcclient.InferInput("INPUT", [1], "INT32")
+            inp.set_data_from_numpy(np.array([v], dtype=np.int32))
+            c.async_stream_infer("simple_sequence", [inp], sequence_id=777,
+                                 sequence_start=(i == 0),
+                                 sequence_end=(i == 2))
+        for _ in range(3):
+            assert count.acquire(timeout=30)
+        assert outs == [2, 6, 14]
+        c.stop_stream()
+        c.close()
+
+    def test_stream_error_routed_to_callback(self, server):
+        c = grpcclient.InferenceServerClient(server.url)
+        errors = []
+        done = threading.Event()
+
+        def cb(result, error):
+            if error is not None:
+                errors.append(error)
+                done.set()
+
+        c.start_stream(cb)
+        inp = grpcclient.InferInput("INPUT0", [1, 16], "INT32")
+        inp.set_data_from_numpy(np.zeros((1, 16), dtype=np.int32))
+        c.async_stream_infer("ghost", [inp])
+        assert done.wait(timeout=30)
+        assert "unknown model" in str(errors[0])
+        c.stop_stream()
+        c.close()

@@ -1,0 +1,293 @@
+"""Shared-memory data-plane tests: system shm + tpu shm over HTTP and gRPC.
+
+Covers the reference's shm example surface (simple_grpc_shm_client.cc:299,
+simple_grpc_cudashm_client.cc:197-244 → tpu-shm equivalents): create
+regions, register, infer with shm inputs AND outputs, read results back from
+the region, status/unregister lifecycle.
+"""
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+import client_tpu.utils.shared_memory as shm
+import client_tpu.utils.tpu_shared_memory as tpushm
+from client_tpu.engine import TpuEngine
+from client_tpu.models import build_repository
+from client_tpu.server import GrpcInferenceServer, HttpInferenceServer
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def servers():
+    eng = TpuEngine(build_repository(["simple"]))
+    http_srv = HttpInferenceServer(eng, port=0).start()
+    grpc_srv = GrpcInferenceServer(eng, port=0).start()
+    yield http_srv, grpc_srv
+    grpc_srv.stop()
+    http_srv.stop()
+    eng.shutdown()
+
+
+def _expected():
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    return a, b
+
+
+class TestSystemShmGrpc:
+    def test_full_lifecycle(self, servers):
+        _, grpc_srv = servers
+        c = grpcclient.InferenceServerClient(grpc_srv.url)
+        a, b = _expected()
+
+        in_handle = shm.create_shared_memory_region("in_region", "/ct_in0", 128)
+        out_handle = shm.create_shared_memory_region("out_region", "/ct_out0", 128)
+        shm.set_shared_memory_region(in_handle, [a, b])
+        c.register_system_shared_memory("in_region", "/ct_in0", 128)
+        c.register_system_shared_memory("out_region", "/ct_out0", 128)
+
+        status = c.get_system_shared_memory_status()
+        assert set(status.regions.keys()) == {"in_region", "out_region"}
+
+        i0 = grpcclient.InferInput("INPUT0", [1, 16], "INT32")
+        i0.set_shared_memory("in_region", 64, offset=0)
+        i1 = grpcclient.InferInput("INPUT1", [1, 16], "INT32")
+        i1.set_shared_memory("in_region", 64, offset=64)
+        o0 = grpcclient.InferRequestedOutput("OUTPUT0")
+        o0.set_shared_memory("out_region", 64, offset=0)
+        o1 = grpcclient.InferRequestedOutput("OUTPUT1")
+        o1.set_shared_memory("out_region", 64, offset=64)
+
+        result = c.infer("simple", [i0, i1], outputs=[o0, o1])
+        # outputs are in shm, not inline
+        assert result.as_numpy("OUTPUT0") is None
+        out0 = shm.get_contents_as_numpy(out_handle, np.int32, (1, 16))
+        out1 = shm.get_contents_as_numpy(out_handle, np.int32, (1, 16),
+                                         offset=64)
+        np.testing.assert_array_equal(out0, a + b)
+        np.testing.assert_array_equal(out1, a - b)
+
+        c.unregister_system_shared_memory("in_region")
+        c.unregister_system_shared_memory("out_region")
+        assert len(c.get_system_shared_memory_status().regions) == 0
+        shm.destroy_shared_memory_region(in_handle)
+        shm.destroy_shared_memory_region(out_handle)
+        c.close()
+
+    def test_register_missing_key(self, servers):
+        _, grpc_srv = servers
+        c = grpcclient.InferenceServerClient(grpc_srv.url)
+        with pytest.raises(InferenceServerException) as ei:
+            c.register_system_shared_memory("bad", "/ct_missing_key", 64)
+        assert "does not exist" in str(ei.value)
+        c.close()
+
+    def test_double_register(self, servers):
+        _, grpc_srv = servers
+        c = grpcclient.InferenceServerClient(grpc_srv.url)
+        h = shm.create_shared_memory_region("dup", "/ct_dup", 64)
+        c.register_system_shared_memory("dup", "/ct_dup", 64)
+        with pytest.raises(InferenceServerException) as ei:
+            c.register_system_shared_memory("dup", "/ct_dup", 64)
+        assert "already registered" in str(ei.value)
+        c.unregister_system_shared_memory("dup")
+        shm.destroy_shared_memory_region(h)
+        c.close()
+
+
+class TestSystemShmHttp:
+    def test_full_lifecycle(self, servers):
+        http_srv, _ = servers
+        c = httpclient.InferenceServerClient(http_srv.url)
+        a, b = _expected()
+
+        in_handle = shm.create_shared_memory_region("h_in", "/ct_hin", 128)
+        out_handle = shm.create_shared_memory_region("h_out", "/ct_hout", 128)
+        shm.set_shared_memory_region(in_handle, [a, b])
+        c.register_system_shared_memory("h_in", "/ct_hin", 128)
+        c.register_system_shared_memory("h_out", "/ct_hout", 128)
+
+        status = c.get_system_shared_memory_status()
+        assert "h_in" in status and "h_out" in status
+
+        i0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+        i0.set_shared_memory("h_in", 64, offset=0)
+        i1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+        i1.set_shared_memory("h_in", 64, offset=64)
+        o0 = httpclient.InferRequestedOutput("OUTPUT0")
+        o0.set_shared_memory("h_out", 64, offset=0)
+        result = c.infer("simple", [i0, i1], outputs=[o0])
+        assert result.as_numpy("OUTPUT0") is None
+        entry = result.get_output("OUTPUT0")
+        assert entry["parameters"]["shared_memory_byte_size"] == 64
+        out0 = shm.get_contents_as_numpy(out_handle, np.int32, (1, 16))
+        np.testing.assert_array_equal(out0, a + b)
+
+        c.unregister_system_shared_memory()
+        assert c.get_system_shared_memory_status() == {}
+        shm.destroy_shared_memory_region(in_handle)
+        shm.destroy_shared_memory_region(out_handle)
+        c.close()
+
+
+class TestTpuShmGrpc:
+    def test_full_lifecycle(self, servers):
+        _, grpc_srv = servers
+        c = grpcclient.InferenceServerClient(grpc_srv.url)
+        a, b = _expected()
+
+        in_h = tpushm.create_shared_memory_region("t_in", 128, device_id=0)
+        out_h = tpushm.create_shared_memory_region("t_out", 128, device_id=0)
+        tpushm.set_shared_memory_region(in_h, [a, b])
+        c.register_tpu_shared_memory("t_in", tpushm.get_raw_handle(in_h),
+                                     0, 128)
+        c.register_tpu_shared_memory("t_out", tpushm.get_raw_handle(out_h),
+                                     0, 128)
+        status = c.get_tpu_shared_memory_status()
+        assert set(status.regions.keys()) == {"t_in", "t_out"}
+
+        i0 = grpcclient.InferInput("INPUT0", [1, 16], "INT32")
+        i0.set_shared_memory("t_in", 64, offset=0)
+        i1 = grpcclient.InferInput("INPUT1", [1, 16], "INT32")
+        i1.set_shared_memory("t_in", 64, offset=64)
+        o0 = grpcclient.InferRequestedOutput("OUTPUT0")
+        o0.set_shared_memory("t_out", 64, offset=0)
+        o1 = grpcclient.InferRequestedOutput("OUTPUT1")
+        o1.set_shared_memory("t_out", 64, offset=64)
+        c.infer("simple", [i0, i1], outputs=[o0, o1])
+
+        out0 = tpushm.get_contents_as_numpy(out_h, np.int32, (1, 16))
+        out1 = tpushm.get_contents_as_numpy(out_h, np.int32, (1, 16),
+                                            offset=64)
+        np.testing.assert_array_equal(out0, a + b)
+        np.testing.assert_array_equal(out1, a - b)
+
+        c.unregister_tpu_shared_memory()
+        assert len(c.get_tpu_shared_memory_status().regions) == 0
+        tpushm.destroy_shared_memory_region(in_h)
+        tpushm.destroy_shared_memory_region(out_h)
+        c.close()
+
+    def test_cuda_alias_rpcs(self, servers):
+        """The cuda-named API maps onto TPU regions for drop-in parity."""
+        _, grpc_srv = servers
+        c = grpcclient.InferenceServerClient(grpc_srv.url)
+        h = tpushm.create_shared_memory_region("alias_r", 64)
+        c.register_cuda_shared_memory("alias_r", tpushm.get_raw_handle(h),
+                                      0, 64)
+        status = c.get_cuda_shared_memory_status()
+        assert "alias_r" in status.regions
+        c.unregister_cuda_shared_memory("alias_r")
+        tpushm.destroy_shared_memory_region(h)
+        c.close()
+
+    def test_malformed_handle(self, servers):
+        _, grpc_srv = servers
+        c = grpcclient.InferenceServerClient(grpc_srv.url)
+        with pytest.raises(InferenceServerException) as ei:
+            c.register_tpu_shared_memory("badh", b"\x00\x01garbage", 0, 64)
+        assert "malformed" in str(ei.value)
+        c.close()
+
+
+class TestTpuShmHttp:
+    def test_b64_handle_transport(self, servers):
+        http_srv, _ = servers
+        c = httpclient.InferenceServerClient(http_srv.url)
+        a, b = _expected()
+        in_h = tpushm.create_shared_memory_region("hb_in", 128)
+        out_h = tpushm.create_shared_memory_region("hb_out", 128)
+        tpushm.set_shared_memory_region(in_h, [a, b])
+        # raw-bytes handle: the client base64-wraps for JSON transport
+        c.register_tpu_shared_memory("hb_in", tpushm.get_raw_handle(in_h),
+                                     0, 128)
+        c.register_tpu_shared_memory("hb_out",
+                                     tpushm.get_raw_handle_b64(out_h), 0, 128)
+        i0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+        i0.set_shared_memory("hb_in", 64, offset=0)
+        i1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+        i1.set_shared_memory("hb_in", 64, offset=64)
+        o0 = httpclient.InferRequestedOutput("OUTPUT0")
+        o0.set_shared_memory("hb_out", 64, offset=0)
+        c.infer("simple", [i0, i1], outputs=[o0])
+        np.testing.assert_array_equal(
+            tpushm.get_contents_as_numpy(out_h, np.int32, (1, 16)), a + b)
+        c.unregister_tpu_shared_memory()
+        tpushm.destroy_shared_memory_region(in_h)
+        tpushm.destroy_shared_memory_region(out_h)
+        c.close()
+
+
+class TestInProcessDeviceRegions:
+    def test_zero_copy_device_region(self):
+        """In-process path: region is a device array; outputs stay in HBM."""
+        import jax.numpy as jnp
+
+        from client_tpu.engine import InferRequest
+        from client_tpu.engine.types import OutputRequest
+
+        eng = TpuEngine(build_repository(["simple"]))
+        a = jnp.arange(16, dtype=jnp.int32).reshape(1, 16)
+        b = jnp.ones((1, 16), dtype=jnp.int32)
+        eng.tpu_shm.register_device_array("dev_in0", a)
+        eng.tpu_shm.register_device_array("dev_in1", b)
+
+        in0 = eng.tpu_shm.read_tensor("dev_in0", 0, 64, "INT32", (1, 16))
+        in1 = eng.tpu_shm.read_tensor("dev_in1", 0, 64, "INT32", (1, 16))
+        resp = eng.infer(InferRequest(
+            model_name="simple",
+            inputs={"INPUT0": in0, "INPUT1": in1},
+            outputs=[OutputRequest(name="OUTPUT0")]), timeout_s=30)
+        eng.tpu_shm.register_device_array("dev_out", resp.outputs["OUTPUT0"])
+        eng.tpu_shm.write_tensor("dev_out", 0, 64, resp.outputs["OUTPUT0"])
+        back = np.asarray(eng.tpu_shm.read_back("dev_out"))
+        np.testing.assert_array_equal(back, np.asarray(a) + np.asarray(b))
+        eng.shutdown()
+
+
+class TestShmEdgeCases:
+    """Regressions from review: offset validation, mixed shm/raw outputs."""
+
+    def test_negative_offset_rejected(self, servers):
+        _, grpc_srv = servers
+        c = grpcclient.InferenceServerClient(grpc_srv.url)
+        h = tpushm.create_shared_memory_region("neg_r", 128)
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        tpushm.set_shared_memory_region(h, [a])
+        c.register_tpu_shared_memory("neg_r", tpushm.get_raw_handle(h), 0, 128)
+        i0 = grpcclient.InferInput("INPUT0", [1, 16], "INT32")
+        i0.set_shared_memory("neg_r", 64, offset=-64)
+        i1 = grpcclient.InferInput("INPUT1", [1, 16], "INT32")
+        i1.set_shared_memory("neg_r", 64, offset=0)
+        with pytest.raises(InferenceServerException) as ei:
+            c.infer("simple", [i0, i1])
+        assert "offset" in str(ei.value)
+        c.unregister_tpu_shared_memory("neg_r")
+        tpushm.destroy_shared_memory_region(h)
+        c.close()
+
+    def test_mixed_shm_and_raw_outputs(self, servers):
+        """A shm-placed output must not consume a raw_output_contents slot."""
+        _, grpc_srv = servers
+        c = grpcclient.InferenceServerClient(grpc_srv.url)
+        a, b = _expected()
+        out_h = tpushm.create_shared_memory_region("mix_out", 64)
+        c.register_tpu_shared_memory("mix_out", tpushm.get_raw_handle(out_h),
+                                     0, 64)
+        i0 = grpcclient.InferInput("INPUT0", [1, 16], "INT32")
+        i0.set_data_from_numpy(a)
+        i1 = grpcclient.InferInput("INPUT1", [1, 16], "INT32")
+        i1.set_data_from_numpy(b)
+        o0 = grpcclient.InferRequestedOutput("OUTPUT0")
+        o0.set_shared_memory("mix_out", 64, offset=0)
+        o1 = grpcclient.InferRequestedOutput("OUTPUT1")  # raw
+        result = c.infer("simple", [i0, i1], outputs=[o0, o1])
+        assert result.as_numpy("OUTPUT0") is None
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), a - b)
+        np.testing.assert_array_equal(
+            tpushm.get_contents_as_numpy(out_h, np.int32, (1, 16)), a + b)
+        c.unregister_tpu_shared_memory("mix_out")
+        tpushm.destroy_shared_memory_region(out_h)
+        c.close()
