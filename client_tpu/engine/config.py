@@ -198,6 +198,8 @@ class ModelConfig:
                 "max_queue_delay_microseconds":
                     self.dynamic_batching.max_queue_delay_microseconds,
             }
+        if self.instance_count != 1:
+            out["instance_group"] = [{"count": self.instance_count}]
         if self.sequence_batching is not None:
             out["sequence_batching"] = {"strategy": self.sequence_batching.strategy}
         if self.ensemble_scheduling:

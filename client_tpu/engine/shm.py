@@ -55,6 +55,10 @@ class _SysRegion:
         if not os.path.exists(path):
             raise EngineError(
                 f"shared memory key '{key}' does not exist", 400)
+        if self.offset < 0 or self.byte_size < 0:
+            raise EngineError(
+                f"region '{name}': offset/byte_size must be non-negative "
+                f"(got {self.offset}/{self.byte_size})", 400)
         self.fd = os.open(path, os.O_RDWR)
         try:
             self.map = mmap.mmap(self.fd, 0)
@@ -111,7 +115,10 @@ class _SysRegion:
                 f"({self.byte_size}B)", 400)
         raw = serialize_tensor(arr, np_to_wire_dtype(arr.dtype))
         start = self.offset + offset
-        limit = byte_size if byte_size > 0 else self.byte_size - offset
+        # Clamp the client-supplied placement size to the region extent so a
+        # write can never spill past the registered region.
+        limit = byte_size if byte_size > 0 else self.byte_size
+        limit = min(limit, self.byte_size - offset)
         if len(raw) > limit:
             raise EngineError(
                 f"output ({len(raw)}B) exceeds shm placement in region "

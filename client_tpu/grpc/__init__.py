@@ -12,6 +12,7 @@ by URL (grpc_client.cc:48-123) and request-proto reuse across calls
 
 from __future__ import annotations
 
+import base64
 import queue
 import threading
 
@@ -160,7 +161,7 @@ class InferResult:
             # shm-placed outputs carry no payload at all — they must not
             # consume a raw_output_contents slot
             is_shm = "shared_memory_region" in tensor.parameters
-            has_raw = not is_shm and not _tensor_has_contents(tensor)
+            has_raw = not is_shm and not grpc_codec.tensor_has_contents(tensor)
             if tensor.name == name:
                 if is_shm:
                     return None
@@ -193,11 +194,6 @@ class InferResult:
             return json_format.MessageToDict(
                 self._result, preserving_proto_field_name=True)
         return self._result
-
-
-def _tensor_has_contents(tensor) -> bool:
-    c = tensor.contents
-    return any(len(getattr(c, f.name)) for f in c.DESCRIPTOR.fields)
 
 
 class CallContext:
@@ -236,15 +232,25 @@ class _InferStream:
     def _read_loop(self):
         try:
             for response in self._call:
-                if response.error_message:
-                    self._callback(
-                        None, InferenceServerException(
-                            response.error_message))
-                else:
-                    self._callback(InferResult(response.infer_response), None)
+                # A user callback that raises must not kill the reader
+                # thread — later responses on the stream would be silently
+                # dropped (same guard the unary async path applies).
+                try:
+                    if response.error_message:
+                        self._callback(
+                            None, InferenceServerException(
+                                response.error_message))
+                    else:
+                        self._callback(
+                            InferResult(response.infer_response), None)
+                except Exception:  # noqa: BLE001 — user callback fault
+                    pass
         except grpc.RpcError as exc:
             if not self._closed:
-                self._callback(None, _grpc_error(exc))
+                try:
+                    self._callback(None, _grpc_error(exc))
+                except Exception:  # noqa: BLE001
+                    pass
 
     def send(self, request):
         if self._closed:
@@ -375,14 +381,21 @@ class InferenceServerClient:
 
     def load_model(self, model_name, headers=None, config=None, files=None,
                    client_timeout=None):
-        self._call(self._client_stub.RepositoryModelLoad,
-                   pb.RepositoryModelLoadRequest(model_name=model_name),
+        request = pb.RepositoryModelLoadRequest(model_name=model_name)
+        if config is not None:
+            request.parameters["config"].string_param = config
+        for path, content in (files or {}).items():
+            request.parameters[path].string_param = base64.b64encode(
+                content).decode("ascii")
+        self._call(self._client_stub.RepositoryModelLoad, request,
                    headers, client_timeout=client_timeout)
 
     def unload_model(self, model_name, headers=None,
                      unload_dependents=False, client_timeout=None):
-        self._call(self._client_stub.RepositoryModelUnload,
-                   pb.RepositoryModelUnloadRequest(model_name=model_name),
+        request = pb.RepositoryModelUnloadRequest(model_name=model_name)
+        if unload_dependents:
+            request.parameters["unload_dependents"].bool_param = True
+        self._call(self._client_stub.RepositoryModelUnload, request,
                    headers, client_timeout=client_timeout)
 
     def get_inference_statistics(self, model_name="", model_version="",

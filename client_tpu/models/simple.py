@@ -44,6 +44,10 @@ class AddSubBackend(ModelBackend):
                 preferred_batch_size=[4, max_batch_size],
                 max_queue_delay_microseconds=100,
             ),
+            # Several executor instances keep multiple batches in flight so
+            # device round-trips overlap (the device transport pipelines
+            # concurrent dispatch+fetch; serialized batches leave it idle).
+            instance_count=4,
         )
 
     def make_apply(self):

@@ -95,3 +95,11 @@ def tensor_to_ndarray(tensor, raw: bytes | None) -> np.ndarray:
 
 def ndarray_to_raw(arr: np.ndarray, datatype: str) -> bytes:
     return serialize_tensor(arr, datatype)
+
+
+def tensor_has_contents(tensor) -> bool:
+    """True if any typed ``InferTensorContents`` field is populated — such a
+    tensor does not consume a ``raw_*_contents`` slot (client and server must
+    agree on this rule or raw slots mis-assign)."""
+    c = tensor.contents
+    return any(len(getattr(c, f.name)) for f in c.DESCRIPTOR.fields)

@@ -53,7 +53,7 @@ def _proto_to_request(engine: TpuEngine,
         region = t_params.get("shared_memory_region")
         if region is not None:
             arr = _read_shm_input(engine, tensor, t_params)
-        elif raw_idx < len(raw) and not _has_contents(tensor):
+        elif raw_idx < len(raw) and not grpc_codec.tensor_has_contents(tensor):
             arr = grpc_codec.tensor_to_ndarray(tensor, raw[raw_idx])
             raw_idx += 1
         else:
@@ -86,11 +86,6 @@ def _proto_to_request(engine: TpuEngine,
         priority=int(params.get("priority", 0)),
         timeout_us=int(params.get("timeout", 0)),
     )
-
-
-def _has_contents(tensor) -> bool:
-    c = tensor.contents
-    return any(len(getattr(c, f.name)) for f in c.DESCRIPTOR.fields)
 
 
 def _read_shm_input(engine, tensor, params) -> np.ndarray:
@@ -237,6 +232,13 @@ class _Servicer(GRPCInferenceServiceServicer):
         return resp
 
     def RepositoryModelLoad(self, request, context):  # noqa: N802
+        if request.parameters:
+            # Explicit config overrides / file uploads are not supported by
+            # the in-process repository; reject rather than silently load
+            # the on-disk config.
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "load_model parameters (config/file overrides) "
+                          "are not supported")
         try:
             self.engine.load_model(request.model_name)
         except Exception as exc:  # noqa: BLE001
