@@ -134,12 +134,21 @@ class TpuEngine:
         if self._warmup:
             model.warmup()
 
-    def unload_model(self, name: str) -> None:
+    def unload_model(self, name: str, unload_dependents: bool = False) -> None:
+        dependents: list[str] = []
+        if unload_dependents:
+            model = self.repository.get(name)
+            if model is not None and model.config.ensemble_scheduling:
+                dependents = [s.model_name
+                              for s in model.config.ensemble_scheduling]
         with self._lock:
             sched = self._schedulers.pop(name, None)
         if sched is not None:
             sched.stop()
         self.repository.unload(name)
+        for dep in dependents:
+            if dep != name:
+                self.unload_model(dep, unload_dependents=True)
 
     def repository_index(self) -> list[dict]:
         return self.repository.index()

@@ -12,6 +12,7 @@ dependency-free equivalent and the GIL is released during socket I/O).
 from __future__ import annotations
 
 import gzip
+import base64
 import json
 import queue
 import threading
@@ -365,13 +366,24 @@ class InferenceServerClient:
 
     def load_model(self, model_name, headers=None, query_params=None,
                    config=None, files=None):
+        body = {}
+        params = {}
+        if config is not None:
+            params["config"] = config
+        for path, content in (files or {}).items():
+            params[path] = base64.b64encode(content).decode("ascii")
+        if params:
+            body["parameters"] = params
         self._post_json(f"/v2/repository/models/{quote(model_name)}/load",
-                        {}, query_params, headers)
+                        body, query_params, headers)
 
     def unload_model(self, model_name, headers=None, query_params=None,
                      unload_dependents=False):
+        body = {}
+        if unload_dependents:
+            body["parameters"] = {"unload_dependents": True}
         self._post_json(f"/v2/repository/models/{quote(model_name)}/unload",
-                        {}, query_params, headers)
+                        body, query_params, headers)
 
     def get_inference_statistics(self, model_name="", model_version="",
                                  headers=None, query_params=None):

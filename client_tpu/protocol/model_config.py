@@ -49,8 +49,6 @@ def config_dict_to_proto(d: dict) -> "pb.ModelConfig":
             step.output_map.update(s.get("output_map", {}))
     for g in d.get("instance_group", []) or []:
         cfg.instance_group.add(count=int(g.get("count", 1)))
-    if not d.get("instance_group") and int(d.get("instance_count", 1)) > 1:
-        cfg.instance_group.add(count=int(d["instance_count"]))
     if (d.get("model_transaction_policy") or {}).get("decoupled"):
         cfg.model_transaction_policy.decoupled = True
     return cfg

@@ -246,8 +246,12 @@ class _Servicer(GRPCInferenceServiceServicer):
         return pb.RepositoryModelLoadResponse()
 
     def RepositoryModelUnload(self, request, context):  # noqa: N802
+        unload_dependents = bool(
+            request.parameters["unload_dependents"].bool_param
+            if "unload_dependents" in request.parameters else False)
         try:
-            self.engine.unload_model(request.model_name)
+            self.engine.unload_model(request.model_name,
+                                     unload_dependents=unload_dependents)
         except Exception as exc:  # noqa: BLE001
             _abort(context, exc)
         return pb.RepositoryModelUnloadResponse()

@@ -160,13 +160,33 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(self.engine.repository_index())
 
     def h_repo_load(self, name):
-        self._read_body()
+        body = self._read_body()
+        params = {}
+        if body:
+            try:
+                params = json.loads(body).get("parameters", {}) or {}
+            except (ValueError, AttributeError):
+                raise EngineError("malformed load request body", 400)
+        if params:
+            # Same policy as the gRPC frontend: explicit config/file
+            # overrides are not supported by the in-process repository —
+            # reject rather than silently load the on-disk config.
+            raise EngineError(
+                "load parameters (config/file overrides) are not supported",
+                400)
         self.engine.load_model(name)
         self._send_json({})
 
     def h_repo_unload(self, name):
-        self._read_body()
-        self.engine.unload_model(name)
+        body = self._read_body()
+        unload_dependents = False
+        if body:
+            try:
+                params = json.loads(body).get("parameters", {}) or {}
+            except (ValueError, AttributeError):
+                raise EngineError("malformed unload request body", 400)
+            unload_dependents = bool(params.get("unload_dependents", False))
+        self.engine.unload_model(name, unload_dependents=unload_dependents)
         self._send_json({})
 
     # -- shared memory control plane ----------------------------------------

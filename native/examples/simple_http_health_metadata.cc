@@ -1,0 +1,90 @@
+// Control-plane conformance client: health, metadata, config, statistics,
+// repository index + load/unload.
+//
+// Reference counterpart: simple_http_health_metadata.py and
+// simple_http_model_control (§2.7) folded into one binary.
+#include <unistd.h>
+
+#include <iostream>
+
+#include "tpuclient/http_client.h"
+
+namespace tc = tpuclient;
+
+#define FAIL_IF_ERR(X, MSG)                                        \
+  do {                                                             \
+    tc::Error err__ = (X);                                         \
+    if (!err__.IsOk()) {                                           \
+      std::cerr << "error: " << (MSG) << ": " << err__ << std::endl; \
+      exit(1);                                                     \
+    }                                                              \
+  } while (false)
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  int opt;
+  while ((opt = getopt(argc, argv, "u:")) != -1)
+    if (opt == 'u') url = optarg;
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  FAIL_IF_ERR(tc::InferenceServerHttpClient::Create(&client, url),
+              "create client");
+
+  bool live = false, ready = false, model_ready = false;
+  FAIL_IF_ERR(client->IsServerLive(&live), "live");
+  FAIL_IF_ERR(client->IsServerReady(&ready), "ready");
+  FAIL_IF_ERR(client->IsModelReady(&model_ready, "simple"), "model ready");
+  if (!live || !ready || !model_ready) {
+    std::cerr << "health checks failed: live=" << live << " ready=" << ready
+              << " model_ready=" << model_ready << std::endl;
+    return 1;
+  }
+
+  tc::JsonPtr metadata;
+  FAIL_IF_ERR(client->ServerMetadata(&metadata), "server metadata");
+  if (!metadata->Has("name") || !metadata->Has("version")) {
+    std::cerr << "server metadata missing fields" << std::endl;
+    return 1;
+  }
+
+  tc::JsonPtr model_md;
+  FAIL_IF_ERR(client->ModelMetadata(&model_md, "simple"), "model metadata");
+  if (model_md->Get("name")->AsString() != "simple") {
+    std::cerr << "model metadata name mismatch" << std::endl;
+    return 1;
+  }
+
+  tc::JsonPtr config;
+  FAIL_IF_ERR(client->ModelConfig(&config, "simple"), "model config");
+  if (config->Get("max_batch_size")->AsInt() <= 0) {
+    std::cerr << "model config missing max_batch_size" << std::endl;
+    return 1;
+  }
+
+  tc::JsonPtr index;
+  FAIL_IF_ERR(client->ModelRepositoryIndex(&index), "repository index");
+
+  // unload → not ready → load → ready
+  FAIL_IF_ERR(client->UnloadModel("simple"), "unload");
+  client->IsModelReady(&model_ready, "simple");
+  if (model_ready) {
+    std::cerr << "model still ready after unload" << std::endl;
+    return 1;
+  }
+  FAIL_IF_ERR(client->LoadModel("simple"), "load");
+  client->IsModelReady(&model_ready, "simple");
+  if (!model_ready) {
+    std::cerr << "model not ready after load" << std::endl;
+    return 1;
+  }
+
+  tc::JsonPtr stats;
+  FAIL_IF_ERR(client->ModelInferenceStatistics(&stats, "simple"), "stats");
+  if (!stats->Has("model_stats")) {
+    std::cerr << "stats missing model_stats" << std::endl;
+    return 1;
+  }
+
+  std::cout << "PASS : simple_http_health_metadata" << std::endl;
+  return 0;
+}

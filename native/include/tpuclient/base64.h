@@ -1,0 +1,18 @@
+// base64 codec, used to carry opaque TPU-region handles over the HTTP
+// control plane (same role the vendored libb64 plays for CUDA-IPC handles in
+// the reference, /root/reference/src/c++/library/http_client.cc:108-119).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace tpuclient {
+
+std::string Base64Encode(const uint8_t* data, size_t len);
+inline std::string Base64Encode(const std::string& s) {
+  return Base64Encode(reinterpret_cast<const uint8_t*>(s.data()), s.size());
+}
+bool Base64Decode(const std::string& text, std::vector<uint8_t>* out);
+
+}  // namespace tpuclient

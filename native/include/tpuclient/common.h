@@ -1,0 +1,279 @@
+// Shared API core of the C++ client library.
+//
+// Plays the role of the reference's common.{h,cc}
+// (/root/reference/src/c++/library/common.h:26-617): request options, tensor
+// descriptors with scatter-gather raw buffers, result interface, six-point
+// request timers, and cumulative client-side statistics. The design is
+// re-derived for this framework: tensors carry the v2 wire dtype string,
+// data is referenced (not copied) until the transport needs it, and shared
+// memory placement (system or TPU) replaces inline data per tensor.
+#pragma once
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tpuclient/error.h"
+
+namespace tpuclient {
+
+// v2-protocol dtype helpers (dtype table mirrors
+// client_tpu/protocol/dtypes.py and reference perf_utils.h:114-121).
+size_t DtypeByteSize(const std::string& datatype);  // 0 for BYTES/unknown
+
+inline int64_t ElementCount(const std::vector<int64_t>& shape) {
+  int64_t n = 1;
+  for (int64_t d : shape) {
+    if (d < 0) return -1;
+    n *= d;
+  }
+  return n;
+}
+
+// Per-request options (reference InferOptions, common.h:156-208).
+struct InferOptions {
+  explicit InferOptions(const std::string& model_name_)
+      : model_name(model_name_) {}
+
+  std::string model_name;
+  std::string model_version;
+  std::string request_id;
+  // Stateful-model sequence routing (reference common.h:173-198).
+  uint64_t sequence_id = 0;
+  bool sequence_start = false;
+  bool sequence_end = false;
+  uint64_t priority = 0;
+  // Server-side queue timeout, microseconds (0 = none).
+  uint64_t server_timeout_us = 0;
+  // Client-side transport timeout, microseconds (0 = none).
+  uint64_t client_timeout_us = 0;
+};
+
+// Input tensor: shape/dtype plus either scatter-gather host buffers or a
+// shared-memory placement (reference InferInput, common.h:214-353).
+class InferInput {
+ public:
+  static Error Create(InferInput** input, const std::string& name,
+                      const std::vector<int64_t>& dims,
+                      const std::string& datatype);
+
+  const std::string& Name() const { return name_; }
+  const std::string& Datatype() const { return datatype_; }
+  const std::vector<int64_t>& Shape() const { return shape_; }
+  Error SetShape(const std::vector<int64_t>& dims);
+
+  // Appends a no-copy reference to caller-owned memory; the caller keeps the
+  // buffer alive until the request completes (scatter-gather bufs_,
+  // reference common.h:337-339).
+  Error AppendRaw(const uint8_t* data, size_t byte_size);
+  Error AppendRaw(const std::vector<uint8_t>& data) {
+    return AppendRaw(data.data(), data.size());
+  }
+  // BYTES tensors: appends one length-prefixed string element
+  // (4-byte LE length + payload, reference common.cc AppendFromString).
+  Error AppendFromString(const std::vector<std::string>& strings);
+
+  Error SetSharedMemory(const std::string& region_name, size_t byte_size,
+                        size_t offset = 0);
+  Error Reset();
+
+  bool IsSharedMemory() const { return !shm_name_.empty(); }
+  const std::string& SharedMemoryName() const { return shm_name_; }
+  size_t SharedMemoryByteSize() const { return shm_byte_size_; }
+  size_t SharedMemoryOffset() const { return shm_offset_; }
+
+  size_t TotalByteSize() const { return total_byte_size_; }
+  const std::vector<std::pair<const uint8_t*, size_t>>& Buffers() const {
+    return bufs_;
+  }
+  // Concatenate scatter-gather buffers (transport fast path iterates
+  // Buffers() instead when it can stream).
+  void CopyTo(std::string* out) const;
+
+ private:
+  InferInput(const std::string& name, const std::vector<int64_t>& dims,
+             const std::string& datatype)
+      : name_(name), shape_(dims), datatype_(datatype) {}
+
+  std::string name_;
+  std::vector<int64_t> shape_;
+  std::string datatype_;
+  std::vector<std::pair<const uint8_t*, size_t>> bufs_;
+  // Backing store for AppendFromString (serialized BYTES payloads must
+  // outlive the call site's temporaries).
+  std::vector<std::string> owned_;
+  size_t total_byte_size_ = 0;
+  std::string shm_name_;
+  size_t shm_byte_size_ = 0;
+  size_t shm_offset_ = 0;
+};
+
+// Requested output: by name, optionally class_count (classification
+// extension) or shared-memory placement (reference InferRequestedOutput,
+// common.h:359-431).
+class InferRequestedOutput {
+ public:
+  static Error Create(InferRequestedOutput** output, const std::string& name,
+                      size_t class_count = 0);
+
+  const std::string& Name() const { return name_; }
+  size_t ClassCount() const { return class_count_; }
+  bool BinaryData() const { return binary_data_; }
+  void SetBinaryData(bool b) { binary_data_ = b; }
+
+  Error SetSharedMemory(const std::string& region_name, size_t byte_size,
+                        size_t offset = 0);
+  Error UnsetSharedMemory();
+  bool IsSharedMemory() const { return !shm_name_.empty(); }
+  const std::string& SharedMemoryName() const { return shm_name_; }
+  size_t SharedMemoryByteSize() const { return shm_byte_size_; }
+  size_t SharedMemoryOffset() const { return shm_offset_; }
+
+ private:
+  InferRequestedOutput(const std::string& name, size_t class_count)
+      : name_(name), class_count_(class_count) {}
+
+  std::string name_;
+  size_t class_count_;
+  bool binary_data_ = true;
+  std::string shm_name_;
+  size_t shm_byte_size_ = 0;
+  size_t shm_offset_ = 0;
+};
+
+// Result interface implemented per transport (reference InferResult,
+// common.h:437-504).
+class InferResult {
+ public:
+  virtual ~InferResult() = default;
+  virtual Error ModelName(std::string* name) const = 0;
+  virtual Error ModelVersion(std::string* version) const = 0;
+  virtual Error Id(std::string* id) const = 0;
+  virtual Error Shape(const std::string& output_name,
+                      std::vector<int64_t>* shape) const = 0;
+  virtual Error Datatype(const std::string& output_name,
+                         std::string* datatype) const = 0;
+  // Zero-copy view into the response buffer; valid while the result lives.
+  virtual Error RawData(const std::string& output_name, const uint8_t** buf,
+                        size_t* byte_size) const = 0;
+  // BYTES tensor decode: splits the 4-byte-LE-length-prefixed stream
+  // (reference StringData, common.h:474-480).
+  virtual Error StringData(const std::string& output_name,
+                           std::vector<std::string>* string_result) const;
+  virtual Error RequestStatus() const = 0;
+  virtual std::string DebugString() const = 0;
+};
+
+// Six-point per-request timestamps, nanoseconds
+// (reference RequestTimers, common.h:509-589).
+struct RequestTimers {
+  enum class Kind {
+    REQUEST_START,
+    REQUEST_END,
+    SEND_START,
+    SEND_END,
+    RECV_START,
+    RECV_END
+  };
+
+  uint64_t request_start_ns = 0;
+  uint64_t request_end_ns = 0;
+  uint64_t send_start_ns = 0;
+  uint64_t send_end_ns = 0;
+  uint64_t recv_start_ns = 0;
+  uint64_t recv_end_ns = 0;
+
+  static uint64_t Now() {
+    return std::chrono::duration_cast<std::chrono::nanoseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+
+  void Capture(Kind kind) {
+    uint64_t now = Now();
+    switch (kind) {
+      case Kind::REQUEST_START:
+        request_start_ns = now;
+        break;
+      case Kind::REQUEST_END:
+        request_end_ns = now;
+        break;
+      case Kind::SEND_START:
+        send_start_ns = now;
+        break;
+      case Kind::SEND_END:
+        send_end_ns = now;
+        break;
+      case Kind::RECV_START:
+        recv_start_ns = now;
+        break;
+      case Kind::RECV_END:
+        recv_end_ns = now;
+        break;
+    }
+  }
+};
+
+// Cumulative client-side statistics (reference InferStat, common.h:92-113).
+struct InferStat {
+  size_t completed_request_count = 0;
+  uint64_t cumulative_total_request_time_ns = 0;
+  uint64_t cumulative_send_time_ns = 0;
+  uint64_t cumulative_receive_time_ns = 0;
+};
+
+using OnCompleteFn = std::function<void(InferResult*)>;
+
+// Client base: holds cumulative stats and the async worker machinery shared
+// by transports (reference InferenceServerClient, common.h:118-151).
+class InferenceServerClient {
+ public:
+  explicit InferenceServerClient(bool verbose)
+      : verbose_(verbose), exiting_(false) {}
+  virtual ~InferenceServerClient() = default;
+
+  Error ClientInferStat(InferStat* infer_stat) const {
+    std::lock_guard<std::mutex> lk(stat_mutex_);
+    *infer_stat = infer_stat_;
+    return Error::Success();
+  }
+
+ protected:
+  void UpdateInferStat(const RequestTimers& timers) {
+    std::lock_guard<std::mutex> lk(stat_mutex_);
+    infer_stat_.completed_request_count++;
+    infer_stat_.cumulative_total_request_time_ns +=
+        timers.request_end_ns - timers.request_start_ns;
+    infer_stat_.cumulative_send_time_ns +=
+        timers.send_end_ns - timers.send_start_ns;
+    infer_stat_.cumulative_receive_time_ns +=
+        timers.recv_end_ns - timers.recv_start_ns;
+  }
+
+  bool verbose_;
+  std::thread worker_;
+  std::mutex mutex_;
+  std::condition_variable cv_;
+  bool exiting_;
+
+ private:
+  mutable std::mutex stat_mutex_;
+  InferStat infer_stat_;
+};
+
+// BYTES tensor codec helpers (4-byte LE length prefix per element,
+// reference utils/__init__.py:187-271 and perf_utils.h:122-129).
+void SerializeStringTensor(const std::vector<std::string>& strings,
+                           std::string* out);
+Error DeserializeStringTensor(const uint8_t* buf, size_t byte_size,
+                              std::vector<std::string>* out);
+
+}  // namespace tpuclient

@@ -1,0 +1,43 @@
+// Error type for the C++ client library.
+//
+// Same role as the reference's triton::client::Error
+// (/root/reference/src/c++/library/common.h:60-82): a value type carrying
+// success/failure plus a message, returned by every client call. Ours also
+// carries the HTTP status (or 0) so callers can distinguish timeout (499)
+// from protocol errors without string matching.
+#pragma once
+
+#include <ostream>
+#include <string>
+
+namespace tpuclient {
+
+class Error {
+ public:
+  Error() : ok_(true), status_(0) {}
+  explicit Error(std::string msg, int status = 0)
+      : ok_(false), msg_(std::move(msg)), status_(status) {}
+
+  static Error Success() { return Error(); }
+
+  bool IsOk() const { return ok_; }
+  const std::string& Message() const { return msg_; }
+  int StatusCode() const { return status_; }
+
+  friend std::ostream& operator<<(std::ostream& out, const Error& err) {
+    if (err.ok_) {
+      out << "OK";
+    } else {
+      out << err.msg_;
+      if (err.status_ != 0) out << " (status " << err.status_ << ")";
+    }
+    return out;
+  }
+
+ private:
+  bool ok_;
+  std::string msg_;
+  int status_;
+};
+
+}  // namespace tpuclient

@@ -1,0 +1,97 @@
+// Minimal self-contained JSON DOM (parse + serialize).
+//
+// The reference links rapidjson / TritonJson for its request building and
+// response parsing (/root/reference/src/c++/library/http_client.cc:301-434,
+// json_utils.h:35); neither is available in this image, so the framework
+// carries its own ~400-line DOM sized for the v2 protocol: numbers kept as
+// int64/uint64/double, strings, bools, arrays, objects (insertion-ordered).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "tpuclient/error.h"
+
+namespace tpuclient {
+
+class Json;
+using JsonPtr = std::shared_ptr<Json>;
+
+class Json {
+ public:
+  enum class Type { kNull, kBool, kInt, kUint, kDouble, kString, kArray, kObject };
+
+  Json() : type_(Type::kNull) {}
+
+  static JsonPtr MakeNull() { return std::make_shared<Json>(); }
+  static JsonPtr MakeBool(bool v);
+  static JsonPtr MakeInt(int64_t v);
+  static JsonPtr MakeUint(uint64_t v);
+  static JsonPtr MakeDouble(double v);
+  static JsonPtr MakeString(std::string v);
+  static JsonPtr MakeArray();
+  static JsonPtr MakeObject();
+
+  Type type() const { return type_; }
+  bool IsNull() const { return type_ == Type::kNull; }
+  bool IsBool() const { return type_ == Type::kBool; }
+  bool IsNumber() const {
+    return type_ == Type::kInt || type_ == Type::kUint || type_ == Type::kDouble;
+  }
+  bool IsString() const { return type_ == Type::kString; }
+  bool IsArray() const { return type_ == Type::kArray; }
+  bool IsObject() const { return type_ == Type::kObject; }
+
+  bool AsBool() const { return bool_; }
+  int64_t AsInt() const;
+  uint64_t AsUint() const;
+  double AsDouble() const;
+  const std::string& AsString() const { return str_; }
+
+  // Array access
+  size_t Size() const { return arr_.size(); }
+  const JsonPtr& At(size_t i) const { return arr_[i]; }
+  void Append(JsonPtr v) { arr_.push_back(std::move(v)); }
+
+  // Object access (insertion order preserved for serialization)
+  JsonPtr Get(const std::string& key) const;
+  bool Has(const std::string& key) const;
+  void Set(const std::string& key, JsonPtr v);
+  const std::vector<std::pair<std::string, JsonPtr>>& Members() const {
+    return obj_;
+  }
+
+  // Convenience setters
+  void Set(const std::string& key, const std::string& v) {
+    Set(key, MakeString(v));
+  }
+  void Set(const std::string& key, const char* v) { Set(key, MakeString(v)); }
+  void Set(const std::string& key, int64_t v) { Set(key, MakeInt(v)); }
+  void Set(const std::string& key, uint64_t v) { Set(key, MakeUint(v)); }
+  void Set(const std::string& key, int v) { Set(key, MakeInt(v)); }
+  void Set(const std::string& key, bool v) { Set(key, MakeBool(v)); }
+  void Set(const std::string& key, double v) { Set(key, MakeDouble(v)); }
+
+  std::string Serialize() const;
+  void SerializeTo(std::string* out) const;
+
+  // Parses `text` (full buffer must be one JSON value + optional whitespace).
+  static Error Parse(const char* text, size_t len, JsonPtr* out);
+  static Error Parse(const std::string& text, JsonPtr* out) {
+    return Parse(text.data(), text.size(), out);
+  }
+
+  Type type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  uint64_t uint_ = 0;
+  double dbl_ = 0.0;
+  std::string str_;
+  std::vector<JsonPtr> arr_;
+  std::vector<std::pair<std::string, JsonPtr>> obj_;
+};
+
+}  // namespace tpuclient

@@ -1,0 +1,27 @@
+// POSIX shared-memory helpers for the system-shm data plane.
+//
+// Same surface as the reference's shm_utils.{h,cc}
+// (/root/reference/src/c++/library/shm_utils.cc:38-106): create/map/unmap/
+// unlink a /dev/shm segment that the server maps by key after a
+// RegisterSystemSharedMemory control call.
+#pragma once
+
+#include <cstddef>
+
+#include "tpuclient/error.h"
+
+namespace tpuclient {
+
+// shm_open(O_CREAT|O_RDWR) + ftruncate; returns the fd.
+Error CreateSharedMemoryRegion(const std::string& shm_key, size_t byte_size,
+                               int* shm_fd);
+
+// mmap(PROT_READ|PROT_WRITE, MAP_SHARED) at `offset`.
+Error MapSharedMemory(int shm_fd, size_t offset, size_t byte_size,
+                      void** shm_addr);
+
+Error CloseSharedMemory(int shm_fd);
+Error UnlinkSharedMemoryRegion(const std::string& shm_key);
+Error UnmapSharedMemory(void* shm_addr, size_t byte_size);
+
+}  // namespace tpuclient

@@ -1,0 +1,111 @@
+/* C extension backing client_tpu.utils.shared_memory (ctypes-loaded).
+ *
+ * Same API shape as the reference's libcshm
+ * (/root/reference/src/python/library/tritonclient/utils/shared_memory/
+ * shared_memory.cc, shared_memory_handle.h:44): an opaque handle wrapping
+ * {shm key, fd, mmap base, size, offset}, created/written/read/destroyed
+ * from Python via ctypes. Kept in C so region setup costs no Python-level
+ * copies and the handle can be passed between processes by key.
+ */
+#include <errno.h>
+#include <fcntl.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+typedef struct {
+  char* shm_key;
+  int shm_fd;
+  void* base_addr;
+  uint64_t byte_size;
+  uint64_t offset;
+} SharedMemoryHandle;
+
+/* Error codes mirror the reference's convention: 0 success, negative errno-
+ * style failures. */
+#define SHM_ERR_CREATE -2
+#define SHM_ERR_MAP -3
+#define SHM_ERR_RANGE -4
+#define SHM_ERR_UNLINK -5
+
+int SharedMemoryRegionCreate(const char* shm_key, uint64_t byte_size,
+                             void** handle_out) {
+  int fd = shm_open(shm_key, O_RDWR | O_CREAT, S_IRUSR | S_IWUSR);
+  if (fd < 0) return SHM_ERR_CREATE;
+  if (ftruncate(fd, (off_t)byte_size) != 0) {
+    close(fd);
+    return SHM_ERR_CREATE;
+  }
+  void* base = mmap(NULL, byte_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return SHM_ERR_MAP;
+  }
+  SharedMemoryHandle* h = (SharedMemoryHandle*)malloc(sizeof(*h));
+  h->shm_key = strdup(shm_key);
+  h->shm_fd = fd;
+  h->base_addr = base;
+  h->byte_size = byte_size;
+  h->offset = 0;
+  *handle_out = h;
+  return 0;
+}
+
+/* Overflow-safe bounds check: offset+byte_size may wrap in uint64. */
+static int in_range(const SharedMemoryHandle* h, uint64_t offset,
+                    uint64_t byte_size) {
+  return offset <= h->byte_size && byte_size <= h->byte_size - offset;
+}
+
+int SharedMemoryRegionSet(void* handle, uint64_t offset, uint64_t byte_size,
+                          const void* data) {
+  SharedMemoryHandle* h = (SharedMemoryHandle*)handle;
+  if (!in_range(h, offset, byte_size)) return SHM_ERR_RANGE;
+  memcpy((char*)h->base_addr + offset, data, byte_size);
+  return 0;
+}
+
+int SharedMemoryRegionRead(void* handle, uint64_t offset, uint64_t byte_size,
+                           void* out) {
+  SharedMemoryHandle* h = (SharedMemoryHandle*)handle;
+  if (!in_range(h, offset, byte_size)) return SHM_ERR_RANGE;
+  memcpy(out, (char*)h->base_addr + offset, byte_size);
+  return 0;
+}
+
+int GetSharedMemoryHandleInfo(void* handle, char** shm_key, int* shm_fd,
+                              uint64_t* offset, uint64_t* byte_size,
+                              void** base_addr) {
+  SharedMemoryHandle* h = (SharedMemoryHandle*)handle;
+  if (shm_key) *shm_key = h->shm_key;
+  if (shm_fd) *shm_fd = h->shm_fd;
+  if (offset) *offset = h->offset;
+  if (byte_size) *byte_size = h->byte_size;
+  if (base_addr) *base_addr = h->base_addr;
+  return 0;
+}
+
+int SharedMemoryRegionDestroy(void* handle) {
+  SharedMemoryHandle* h = (SharedMemoryHandle*)handle;
+  int rc = 0;
+  munmap(h->base_addr, h->byte_size);
+  close(h->shm_fd);
+  if (shm_unlink(h->shm_key) != 0) rc = SHM_ERR_UNLINK;
+  free(h->shm_key);
+  free(h);
+  return rc;
+}
+
+/* Release the local mapping without unlinking the segment (for handles that
+ * merely attach to a region owned elsewhere). */
+int SharedMemoryRegionRelease(void* handle) {
+  SharedMemoryHandle* h = (SharedMemoryHandle*)handle;
+  munmap(h->base_addr, h->byte_size);
+  close(h->shm_fd);
+  free(h->shm_key);
+  free(h);
+  return 0;
+}

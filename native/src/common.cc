@@ -1,0 +1,157 @@
+#include "tpuclient/common.h"
+
+namespace tpuclient {
+
+size_t DtypeByteSize(const std::string& datatype) {
+  if (datatype == "BOOL" || datatype == "INT8" || datatype == "UINT8")
+    return 1;
+  if (datatype == "INT16" || datatype == "UINT16" || datatype == "FP16" ||
+      datatype == "BF16")
+    return 2;
+  if (datatype == "INT32" || datatype == "UINT32" || datatype == "FP32")
+    return 4;
+  if (datatype == "INT64" || datatype == "UINT64" || datatype == "FP64")
+    return 8;
+  return 0;  // BYTES / unknown: variable
+}
+
+// ---------------------------------------------------------------------------
+// InferInput
+// ---------------------------------------------------------------------------
+
+Error InferInput::Create(InferInput** input, const std::string& name,
+                         const std::vector<int64_t>& dims,
+                         const std::string& datatype) {
+  *input = new InferInput(name, dims, datatype);
+  return Error::Success();
+}
+
+Error InferInput::SetShape(const std::vector<int64_t>& dims) {
+  shape_ = dims;
+  return Error::Success();
+}
+
+Error InferInput::AppendRaw(const uint8_t* data, size_t byte_size) {
+  if (IsSharedMemory()) {
+    return Error("can not append raw data to a shared-memory input '" + name_ +
+                     "'",
+                 400);
+  }
+  bufs_.emplace_back(data, byte_size);
+  total_byte_size_ += byte_size;
+  return Error::Success();
+}
+
+Error InferInput::AppendFromString(const std::vector<std::string>& strings) {
+  std::string serialized;
+  SerializeStringTensor(strings, &serialized);
+  owned_.push_back(std::move(serialized));
+  const std::string& s = owned_.back();
+  return AppendRaw(reinterpret_cast<const uint8_t*>(s.data()), s.size());
+}
+
+Error InferInput::SetSharedMemory(const std::string& region_name,
+                                  size_t byte_size, size_t offset) {
+  if (!bufs_.empty()) {
+    return Error("can not set shared memory on input '" + name_ +
+                     "' with raw data appended",
+                 400);
+  }
+  shm_name_ = region_name;
+  shm_byte_size_ = byte_size;
+  shm_offset_ = offset;
+  return Error::Success();
+}
+
+Error InferInput::Reset() {
+  bufs_.clear();
+  owned_.clear();
+  total_byte_size_ = 0;
+  shm_name_.clear();
+  shm_byte_size_ = 0;
+  shm_offset_ = 0;
+  return Error::Success();
+}
+
+void InferInput::CopyTo(std::string* out) const {
+  out->reserve(out->size() + total_byte_size_);
+  for (const auto& buf : bufs_) {
+    out->append(reinterpret_cast<const char*>(buf.first), buf.second);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// InferRequestedOutput
+// ---------------------------------------------------------------------------
+
+Error InferRequestedOutput::Create(InferRequestedOutput** output,
+                                   const std::string& name,
+                                   size_t class_count) {
+  *output = new InferRequestedOutput(name, class_count);
+  return Error::Success();
+}
+
+Error InferRequestedOutput::SetSharedMemory(const std::string& region_name,
+                                            size_t byte_size, size_t offset) {
+  shm_name_ = region_name;
+  shm_byte_size_ = byte_size;
+  shm_offset_ = offset;
+  return Error::Success();
+}
+
+Error InferRequestedOutput::UnsetSharedMemory() {
+  shm_name_.clear();
+  shm_byte_size_ = 0;
+  shm_offset_ = 0;
+  return Error::Success();
+}
+
+// ---------------------------------------------------------------------------
+// BYTES codec
+// ---------------------------------------------------------------------------
+
+void SerializeStringTensor(const std::vector<std::string>& strings,
+                           std::string* out) {
+  size_t total = 0;
+  for (const auto& s : strings) total += 4 + s.size();
+  out->reserve(out->size() + total);
+  for (const auto& s : strings) {
+    uint32_t len = static_cast<uint32_t>(s.size());
+    char lenbuf[4];
+    memcpy(lenbuf, &len, 4);  // little-endian on all supported targets
+    out->append(lenbuf, 4);
+    out->append(s);
+  }
+}
+
+Error DeserializeStringTensor(const uint8_t* buf, size_t byte_size,
+                              std::vector<std::string>* out) {
+  size_t pos = 0;
+  while (pos + 4 <= byte_size) {
+    uint32_t len;
+    memcpy(&len, buf + pos, 4);
+    pos += 4;
+    if (pos + len > byte_size) {
+      return Error("malformed BYTES tensor: element length " +
+                       std::to_string(len) + " exceeds buffer",
+                   400);
+    }
+    out->emplace_back(reinterpret_cast<const char*>(buf + pos), len);
+    pos += len;
+  }
+  if (pos != byte_size) {
+    return Error("malformed BYTES tensor: trailing bytes", 400);
+  }
+  return Error::Success();
+}
+
+Error InferResult::StringData(const std::string& output_name,
+                              std::vector<std::string>* string_result) const {
+  const uint8_t* buf;
+  size_t byte_size;
+  Error err = RawData(output_name, &buf, &byte_size);
+  if (!err.IsOk()) return err;
+  return DeserializeStringTensor(buf, byte_size, string_result);
+}
+
+}  // namespace tpuclient

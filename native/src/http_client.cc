@@ -1,0 +1,951 @@
+#include "tpuclient/http_client.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <climits>
+#include <cstring>
+
+#include "tpuclient/base64.h"
+
+#ifndef IOV_MAX
+#define IOV_MAX 1024
+#endif
+
+namespace tpuclient {
+
+// ---------------------------------------------------------------------------
+// HttpConnection: one keep-alive HTTP/1.1 connection over a POSIX socket
+// ---------------------------------------------------------------------------
+
+class HttpConnection {
+ public:
+  HttpConnection(const std::string& host, int port)
+      : host_(host), port_(port), fd_(-1) {}
+  ~HttpConnection() { Close(); }
+
+  void Close() {
+    if (fd_ >= 0) {
+      ::close(fd_);
+      fd_ = -1;
+    }
+    rbuf_.clear();
+  }
+
+  Error EnsureConnected() {
+    if (fd_ >= 0) return Error::Success();
+    struct addrinfo hints;
+    memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    std::string port_str = std::to_string(port_);
+    int rc = getaddrinfo(host_.c_str(), port_str.c_str(), &hints, &res);
+    if (rc != 0) {
+      return Error("failed to resolve " + host_ + ": " + gai_strerror(rc),
+                   400);
+    }
+    for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+      fd_ = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd_ < 0) continue;
+      if (::connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0) break;
+      ::close(fd_);
+      fd_ = -1;
+    }
+    freeaddrinfo(res);
+    if (fd_ < 0) {
+      return Error("failed to connect to " + host_ + ":" + port_str, 400);
+    }
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    return Error::Success();
+  }
+
+  // Sends headers + scatter-gather body segments with writev.
+  Error SendRequest(const std::string& head,
+                    const std::vector<std::pair<const uint8_t*, size_t>>& segs) {
+    Error err = EnsureConnected();
+    if (!err.IsOk()) return err;
+    std::vector<struct iovec> iov;
+    iov.reserve(segs.size() + 1);
+    iov.push_back({const_cast<char*>(head.data()), head.size()});
+    for (const auto& s : segs) {
+      if (s.second > 0)
+        iov.push_back({const_cast<uint8_t*>(s.first), s.second});
+    }
+    size_t idx = 0;
+    while (idx < iov.size()) {
+      ssize_t n = ::writev(fd_, iov.data() + idx,
+                           static_cast<int>(
+                               std::min<size_t>(iov.size() - idx, IOV_MAX)));
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        Close();
+        return Error(std::string("send failed: ") + strerror(errno), 400);
+      }
+      size_t sent = static_cast<size_t>(n);
+      while (idx < iov.size() && sent >= iov[idx].iov_len) {
+        sent -= iov[idx].iov_len;
+        ++idx;
+      }
+      if (idx < iov.size() && sent > 0) {
+        iov[idx].iov_base = static_cast<char*>(iov[idx].iov_base) + sent;
+        iov[idx].iov_len -= sent;
+      }
+    }
+    return Error::Success();
+  }
+
+  // Reads one full HTTP response. timeout_us==0 means no timeout.
+  Error ReadResponse(int* status, Headers* headers, std::string* body,
+                     uint64_t timeout_us) {
+    uint64_t deadline_ns =
+        timeout_us ? RequestTimers::Now() + timeout_us * 1000 : 0;
+    std::string head;
+    // --- status line + headers ---
+    size_t header_end;
+    while (true) {
+      header_end = rbuf_.find("\r\n\r\n");
+      if (header_end != std::string::npos) break;
+      Error err = Fill(deadline_ns);
+      if (!err.IsOk()) return err;
+    }
+    head = rbuf_.substr(0, header_end);
+    rbuf_.erase(0, header_end + 4);
+
+    size_t line_end = head.find("\r\n");
+    std::string status_line =
+        line_end == std::string::npos ? head : head.substr(0, line_end);
+    if (status_line.size() < 12 || status_line.compare(0, 5, "HTTP/") != 0) {
+      Close();
+      return Error("malformed HTTP status line: " + status_line, 400);
+    }
+    *status = atoi(status_line.c_str() + 9);
+
+    headers->clear();
+    size_t pos = line_end == std::string::npos ? head.size() : line_end + 2;
+    bool chunked = false;
+    ssize_t content_length = -1;
+    bool close_conn = false;
+    while (pos < head.size()) {
+      size_t eol = head.find("\r\n", pos);
+      if (eol == std::string::npos) eol = head.size();
+      std::string line = head.substr(pos, eol - pos);
+      pos = eol + 2;
+      size_t colon = line.find(':');
+      if (colon == std::string::npos) continue;
+      std::string key = line.substr(0, colon);
+      size_t vstart = colon + 1;
+      while (vstart < line.size() && line[vstart] == ' ') ++vstart;
+      std::string value = line.substr(vstart);
+      for (auto& c : key) c = static_cast<char>(tolower(c));
+      (*headers)[key] = value;
+      if (key == "content-length") content_length = atoll(value.c_str());
+      if (key == "transfer-encoding" &&
+          value.find("chunked") != std::string::npos)
+        chunked = true;
+      if (key == "connection" && value.find("close") != std::string::npos)
+        close_conn = true;
+    }
+
+    body->clear();
+    if (chunked) {
+      Error err = ReadChunked(body, deadline_ns);
+      if (!err.IsOk()) return err;
+    } else if (content_length >= 0) {
+      while (rbuf_.size() < static_cast<size_t>(content_length)) {
+        Error err = Fill(deadline_ns);
+        if (!err.IsOk()) return err;
+      }
+      body->assign(rbuf_, 0, content_length);
+      rbuf_.erase(0, content_length);
+    } else {
+      // read until close
+      while (true) {
+        Error err = Fill(deadline_ns);
+        if (!err.IsOk()) break;
+      }
+      body->swap(rbuf_);
+      rbuf_.clear();
+      Close();
+    }
+    if (close_conn) Close();
+    return Error::Success();
+  }
+
+ private:
+  Error Fill(uint64_t deadline_ns) {
+    if (fd_ < 0) return Error("connection closed", 400);
+    if (deadline_ns) {
+      uint64_t now = RequestTimers::Now();
+      if (now >= deadline_ns) {
+        Close();
+        return Error("Deadline Exceeded", 499);
+      }
+      struct pollfd pfd {fd_, POLLIN, 0};
+      int timeout_ms = static_cast<int>((deadline_ns - now) / 1000000) + 1;
+      int prc = ::poll(&pfd, 1, timeout_ms);
+      if (prc == 0) {
+        Close();
+        return Error("Deadline Exceeded", 499);
+      }
+      if (prc < 0 && errno != EINTR) {
+        Close();
+        return Error(std::string("poll failed: ") + strerror(errno), 400);
+      }
+    }
+    char buf[65536];
+    ssize_t n = ::recv(fd_, buf, sizeof(buf), 0);
+    if (n == 0) {
+      Close();
+      return Error("connection closed by server", 400);
+    }
+    if (n < 0) {
+      if (errno == EINTR) return Error::Success();
+      Close();
+      return Error(std::string("recv failed: ") + strerror(errno), 400);
+    }
+    rbuf_.append(buf, n);
+    return Error::Success();
+  }
+
+  Error ReadChunked(std::string* body, uint64_t deadline_ns) {
+    while (true) {
+      size_t eol;
+      while ((eol = rbuf_.find("\r\n")) == std::string::npos) {
+        Error err = Fill(deadline_ns);
+        if (!err.IsOk()) return err;
+      }
+      size_t chunk_size = strtoul(rbuf_.c_str(), nullptr, 16);
+      rbuf_.erase(0, eol + 2);
+      if (chunk_size == 0) {
+        while (rbuf_.find("\r\n") == std::string::npos) {
+          Error err = Fill(deadline_ns);
+          if (!err.IsOk()) return err;
+        }
+        rbuf_.erase(0, rbuf_.find("\r\n") + 2);
+        return Error::Success();
+      }
+      while (rbuf_.size() < chunk_size + 2) {
+        Error err = Fill(deadline_ns);
+        if (!err.IsOk()) return err;
+      }
+      body->append(rbuf_, 0, chunk_size);
+      rbuf_.erase(0, chunk_size + 2);  // chunk + CRLF
+    }
+  }
+
+  std::string host_;
+  int port_;
+  int fd_;
+  std::string rbuf_;
+};
+
+// ---------------------------------------------------------------------------
+// InferResultHttp
+// ---------------------------------------------------------------------------
+
+// Flattened JSON data array → packed little-endian bytes (the inverse of the
+// server's JSON tensor encoding; BYTES elements become 4-byte-LE
+// length-prefixed).
+static Error MaterializeJsonData(const Json& data, const std::string& datatype,
+                                 std::string* out) {
+  size_t elem = DtypeByteSize(datatype);
+  out->reserve(data.Size() * (elem ? elem : 8));
+  for (size_t i = 0; i < data.Size(); ++i) {
+    const JsonPtr& v = data.At(i);
+    if (datatype == "BYTES") {
+      const std::string& s = v->AsString();
+      uint32_t len = static_cast<uint32_t>(s.size());
+      out->append(reinterpret_cast<const char*>(&len), 4);
+      out->append(s);
+    } else if (datatype == "FP32") {
+      float f = static_cast<float>(v->AsDouble());
+      out->append(reinterpret_cast<const char*>(&f), 4);
+    } else if (datatype == "FP64") {
+      double d = v->AsDouble();
+      out->append(reinterpret_cast<const char*>(&d), 8);
+    } else if (datatype == "BOOL") {
+      char b = v->AsBool() ? 1 : 0;
+      out->append(&b, 1);
+    } else if (elem > 0) {
+      int64_t n = v->AsInt();
+      uint64_t u = v->AsUint();
+      const char* src = (datatype[0] == 'U')
+                            ? reinterpret_cast<const char*>(&u)
+                            : reinterpret_cast<const char*>(&n);
+      out->append(src, elem);  // little-endian truncation
+    } else {
+      return Error("cannot materialize JSON data for datatype '" + datatype +
+                       "'",
+                   400);
+    }
+  }
+  return Error::Success();
+}
+
+Error InferResultHttp::Create(InferResult** result, std::string&& response_body,
+                              size_t header_length, int http_status) {
+  auto* res = new InferResultHttp();
+  res->body_ = std::move(response_body);
+  if (header_length > res->body_.size()) {
+    delete res;
+    return Error("Inference-Header-Content-Length " +
+                     std::to_string(header_length) + " exceeds body size",
+                 400);
+  }
+  size_t head_len = header_length ? header_length : res->body_.size();
+  Error err = Json::Parse(res->body_.data(), head_len, &res->head_);
+  if (!err.IsOk()) {
+    delete res;
+    return err;
+  }
+  if (http_status != 200) {
+    JsonPtr msg = res->head_->IsObject() ? res->head_->Get("error") : nullptr;
+    res->status_ = Error(msg && msg->IsString() ? msg->AsString()
+                                                : "inference failed",
+                         http_status);
+    *result = res;
+    return Error::Success();
+  }
+  res->status_ = Error::Success();
+
+  // Walk outputs; binary ones consume body bytes after the head, in order
+  // (reference binary-offset output mapping, http_client.cc:752-835).
+  const uint8_t* cursor =
+      reinterpret_cast<const uint8_t*>(res->body_.data()) + head_len;
+  size_t remaining = res->body_.size() - head_len;
+  JsonPtr outputs = res->head_->Get("outputs");
+  if (outputs && outputs->IsArray()) {
+    for (size_t i = 0; i < outputs->Size(); ++i) {
+      JsonPtr out = outputs->At(i);
+      if (!out->IsObject()) continue;
+      JsonPtr name = out->Get("name");
+      if (!name || !name->IsString()) continue;
+      OutputRef ref;
+      ref.meta = out;
+      JsonPtr params = out->Get("parameters");
+      bool is_binary = false;
+      if (params && params->IsObject()) {
+        JsonPtr bds = params->Get("binary_data_size");
+        if (bds && bds->IsNumber()) {
+          is_binary = true;
+          size_t sz = static_cast<size_t>(bds->AsUint());
+          if (sz > remaining) {
+            delete res;
+            return Error("binary output '" + name->AsString() +
+                             "' overruns response body",
+                         400);
+          }
+          ref.data = cursor;
+          ref.byte_size = sz;
+          cursor += sz;
+          remaining -= sz;
+        }
+      }
+      if (!is_binary) {
+        // JSON data array: materialize packed little-endian bytes so
+        // RawData/StringData work uniformly regardless of response form.
+        JsonPtr data = out->Get("data");
+        JsonPtr dt = out->Get("datatype");
+        if (data && data->IsArray() && dt && dt->IsString()) {
+          ref.json_backing = std::make_shared<std::string>();
+          Error merr =
+              MaterializeJsonData(*data, dt->AsString(), ref.json_backing.get());
+          if (!merr.IsOk()) {
+            delete res;
+            return merr;
+          }
+          ref.data = reinterpret_cast<const uint8_t*>(ref.json_backing->data());
+          ref.byte_size = ref.json_backing->size();
+        }
+      }
+      res->outputs_[name->AsString()] = std::move(ref);
+    }
+  }
+  *result = res;
+  return Error::Success();
+}
+
+Error InferResultHttp::ModelName(std::string* name) const {
+  JsonPtr v = head_->Get("model_name");
+  if (!v || !v->IsString()) return Error("no model_name in response");
+  *name = v->AsString();
+  return Error::Success();
+}
+Error InferResultHttp::ModelVersion(std::string* version) const {
+  JsonPtr v = head_->Get("model_version");
+  if (!v || !v->IsString()) return Error("no model_version in response");
+  *version = v->AsString();
+  return Error::Success();
+}
+Error InferResultHttp::Id(std::string* id) const {
+  JsonPtr v = head_->Get("id");
+  *id = (v && v->IsString()) ? v->AsString() : "";
+  return Error::Success();
+}
+
+Error InferResultHttp::Shape(const std::string& output_name,
+                             std::vector<int64_t>* shape) const {
+  auto it = outputs_.find(output_name);
+  if (it == outputs_.end())
+    return Error("output '" + output_name + "' not found");
+  JsonPtr s = it->second.meta->Get("shape");
+  if (!s || !s->IsArray()) return Error("output has no shape");
+  shape->clear();
+  for (size_t i = 0; i < s->Size(); ++i) shape->push_back(s->At(i)->AsInt());
+  return Error::Success();
+}
+
+Error InferResultHttp::Datatype(const std::string& output_name,
+                                std::string* datatype) const {
+  auto it = outputs_.find(output_name);
+  if (it == outputs_.end())
+    return Error("output '" + output_name + "' not found");
+  JsonPtr d = it->second.meta->Get("datatype");
+  if (!d || !d->IsString()) return Error("output has no datatype");
+  *datatype = d->AsString();
+  return Error::Success();
+}
+
+Error InferResultHttp::RawData(const std::string& output_name,
+                               const uint8_t** buf, size_t* byte_size) const {
+  auto it = outputs_.find(output_name);
+  if (it == outputs_.end())
+    return Error("output '" + output_name + "' not found");
+  if (it->second.data == nullptr)
+    return Error("output '" + output_name +
+                 "' returned as JSON data; request binary_data");
+  *buf = it->second.data;
+  *byte_size = it->second.byte_size;
+  return Error::Success();
+}
+
+Error InferResultHttp::RequestStatus() const { return status_; }
+
+std::string InferResultHttp::DebugString() const {
+  return head_ ? head_->Serialize() : "<empty>";
+}
+
+// ---------------------------------------------------------------------------
+// InferenceServerHttpClient
+// ---------------------------------------------------------------------------
+
+Error InferenceServerHttpClient::Create(
+    std::unique_ptr<InferenceServerHttpClient>* client,
+    const std::string& server_url, bool verbose) {
+  std::string url = server_url;
+  size_t scheme = url.find("://");
+  if (scheme != std::string::npos) url = url.substr(scheme + 3);
+  int port = 8000;
+  std::string host = url;
+  size_t colon = url.rfind(':');
+  if (colon != std::string::npos) {
+    host = url.substr(0, colon);
+    port = atoi(url.c_str() + colon + 1);
+  }
+  client->reset(new InferenceServerHttpClient(host, port, verbose));
+  return Error::Success();
+}
+
+InferenceServerHttpClient::InferenceServerHttpClient(const std::string& host,
+                                                     int port, bool verbose)
+    : InferenceServerClient(verbose), host_(host), port_(port) {}
+
+InferenceServerHttpClient::~InferenceServerHttpClient() {
+  async_exit_ = true;
+  async_cv_.notify_all();
+  for (auto& t : async_workers_) {
+    if (t.joinable()) t.join();
+  }
+}
+
+std::unique_ptr<HttpConnection> InferenceServerHttpClient::BorrowConnection() {
+  std::lock_guard<std::mutex> lk(pool_mutex_);
+  if (!pool_.empty()) {
+    auto conn = std::move(pool_.front());
+    pool_.pop_front();
+    return conn;
+  }
+  return std::make_unique<HttpConnection>(host_, port_);
+}
+
+void InferenceServerHttpClient::ReturnConnection(
+    std::unique_ptr<HttpConnection> conn) {
+  std::lock_guard<std::mutex> lk(pool_mutex_);
+  if (pool_.size() < 32) pool_.push_back(std::move(conn));
+}
+
+static std::string BuildHttpHead(const std::string& method,
+                                 const std::string& path,
+                                 const std::string& host,
+                                 const Headers& headers, size_t body_len,
+                                 size_t infer_header_len, bool has_ihcl) {
+  std::string head;
+  head.reserve(256);
+  head += method + " " + path + " HTTP/1.1\r\n";
+  head += "Host: " + host + "\r\n";
+  head += "Content-Length: " + std::to_string(body_len) + "\r\n";
+  if (has_ihcl) {
+    head += "Inference-Header-Content-Length: " +
+            std::to_string(infer_header_len) + "\r\n";
+    head += "Content-Type: application/octet-stream\r\n";
+  } else if (body_len > 0) {
+    head += "Content-Type: application/json\r\n";
+  }
+  for (const auto& kv : headers) {
+    head += kv.first + ": " + kv.second + "\r\n";
+  }
+  head += "\r\n";
+  return head;
+}
+
+Error InferenceServerHttpClient::Get(const std::string& path, JsonPtr* response,
+                                     const Headers& headers) {
+  auto conn = BorrowConnection();
+  std::string head = BuildHttpHead("GET", path, host_, headers, 0, 0, false);
+  Error err = conn->SendRequest(head, {});
+  if (!err.IsOk()) {
+    // one retry on a stale keep-alive connection
+    conn->Close();
+    err = conn->SendRequest(head, {});
+    if (!err.IsOk()) return err;
+  }
+  int status;
+  Headers resp_headers;
+  std::string body;
+  err = conn->ReadResponse(&status, &resp_headers, &body, 0);
+  if (!err.IsOk()) return err;
+  ReturnConnection(std::move(conn));
+  if (response != nullptr && !body.empty()) {
+    Error perr = Json::Parse(body, response);
+    if (!perr.IsOk()) return perr;
+  } else if (response != nullptr) {
+    *response = Json::MakeObject();
+  }
+  if (status != 200) {
+    std::string msg = "HTTP " + std::to_string(status);
+    if (response && *response && (*response)->IsObject()) {
+      JsonPtr e = (*response)->Get("error");
+      if (e && e->IsString()) msg = e->AsString();
+    }
+    return Error(msg, status);
+  }
+  return Error::Success();
+}
+
+Error InferenceServerHttpClient::Post(const std::string& path,
+                                      const std::string& body,
+                                      JsonPtr* response,
+                                      const Headers& headers) {
+  auto conn = BorrowConnection();
+  std::string head =
+      BuildHttpHead("POST", path, host_, headers, body.size(), 0, false);
+  std::vector<std::pair<const uint8_t*, size_t>> segs;
+  if (!body.empty())
+    segs.emplace_back(reinterpret_cast<const uint8_t*>(body.data()),
+                      body.size());
+  Error err = conn->SendRequest(head, segs);
+  if (!err.IsOk()) {
+    conn->Close();
+    err = conn->SendRequest(head, segs);
+    if (!err.IsOk()) return err;
+  }
+  int status;
+  Headers resp_headers;
+  std::string resp_body;
+  err = conn->ReadResponse(&status, &resp_headers, &resp_body, 0);
+  if (!err.IsOk()) return err;
+  ReturnConnection(std::move(conn));
+  JsonPtr parsed;
+  if (!resp_body.empty()) {
+    Error perr = Json::Parse(resp_body, &parsed);
+    if (perr.IsOk() && response != nullptr) *response = parsed;
+  }
+  if (response != nullptr && *response == nullptr)
+    *response = Json::MakeObject();
+  if (status != 200) {
+    std::string msg = "HTTP " + std::to_string(status);
+    if (parsed && parsed->IsObject()) {
+      JsonPtr e = parsed->Get("error");
+      if (e && e->IsString()) msg = e->AsString();
+    }
+    return Error(msg, status);
+  }
+  return Error::Success();
+}
+
+// -- control plane ----------------------------------------------------------
+
+Error InferenceServerHttpClient::IsServerLive(bool* live,
+                                              const Headers& headers) {
+  Error err = Get("/v2/health/live", nullptr, headers);
+  *live = err.IsOk();
+  return (err.StatusCode() >= 500 || err.IsOk()) ? Error::Success() : err;
+}
+
+Error InferenceServerHttpClient::IsServerReady(bool* ready,
+                                               const Headers& headers) {
+  Error err = Get("/v2/health/ready", nullptr, headers);
+  *ready = err.IsOk();
+  return Error::Success();
+}
+
+Error InferenceServerHttpClient::IsModelReady(bool* ready,
+                                              const std::string& model_name,
+                                              const std::string& model_version,
+                                              const Headers& headers) {
+  std::string path = "/v2/models/" + model_name;
+  if (!model_version.empty()) path += "/versions/" + model_version;
+  path += "/ready";
+  Error err = Get(path, nullptr, headers);
+  *ready = err.IsOk();
+  return Error::Success();
+}
+
+Error InferenceServerHttpClient::ServerMetadata(JsonPtr* metadata,
+                                                const Headers& headers) {
+  return Get("/v2", metadata, headers);
+}
+
+Error InferenceServerHttpClient::ModelMetadata(JsonPtr* metadata,
+                                               const std::string& model_name,
+                                               const std::string& model_version,
+                                               const Headers& headers) {
+  std::string path = "/v2/models/" + model_name;
+  if (!model_version.empty()) path += "/versions/" + model_version;
+  return Get(path, metadata, headers);
+}
+
+Error InferenceServerHttpClient::ModelConfig(JsonPtr* config,
+                                             const std::string& model_name,
+                                             const std::string& model_version,
+                                             const Headers& headers) {
+  std::string path = "/v2/models/" + model_name;
+  if (!model_version.empty()) path += "/versions/" + model_version;
+  path += "/config";
+  return Get(path, config, headers);
+}
+
+Error InferenceServerHttpClient::ModelRepositoryIndex(JsonPtr* index,
+                                                      const Headers& headers) {
+  return Post("/v2/repository/index", "", index, headers);
+}
+
+Error InferenceServerHttpClient::LoadModel(const std::string& model_name,
+                                           const Headers& headers,
+                                           const std::string& config) {
+  std::string body;
+  if (!config.empty()) {
+    auto obj = Json::MakeObject();
+    auto params = Json::MakeObject();
+    params->Set("config", config);
+    obj->Set("parameters", params);
+    body = obj->Serialize();
+  }
+  return Post("/v2/repository/models/" + model_name + "/load", body, nullptr,
+              headers);
+}
+
+Error InferenceServerHttpClient::UnloadModel(const std::string& model_name,
+                                             const Headers& headers) {
+  return Post("/v2/repository/models/" + model_name + "/unload", "", nullptr,
+              headers);
+}
+
+Error InferenceServerHttpClient::ModelInferenceStatistics(
+    JsonPtr* infer_stat, const std::string& model_name,
+    const std::string& model_version, const Headers& headers) {
+  std::string path = "/v2/models";
+  if (!model_name.empty()) {
+    path += "/" + model_name;
+    if (!model_version.empty()) path += "/versions/" + model_version;
+  }
+  path += "/stats";
+  return Get(path, infer_stat, headers);
+}
+
+// -- shared memory ----------------------------------------------------------
+
+Error InferenceServerHttpClient::SystemSharedMemoryStatus(
+    JsonPtr* status, const std::string& region_name, const Headers& headers) {
+  std::string path = "/v2/systemsharedmemory";
+  if (!region_name.empty()) path += "/region/" + region_name;
+  path += "/status";
+  return Get(path, status, headers);
+}
+
+Error InferenceServerHttpClient::RegisterSystemSharedMemory(
+    const std::string& name, const std::string& key, size_t byte_size,
+    size_t offset, const Headers& headers) {
+  auto obj = Json::MakeObject();
+  obj->Set("key", key);
+  obj->Set("offset", static_cast<uint64_t>(offset));
+  obj->Set("byte_size", static_cast<uint64_t>(byte_size));
+  return Post("/v2/systemsharedmemory/region/" + name + "/register",
+              obj->Serialize(), nullptr, headers);
+}
+
+Error InferenceServerHttpClient::UnregisterSystemSharedMemory(
+    const std::string& name, const Headers& headers) {
+  std::string path = "/v2/systemsharedmemory";
+  if (!name.empty()) path += "/region/" + name;
+  path += "/unregister";
+  return Post(path, "", nullptr, headers);
+}
+
+Error InferenceServerHttpClient::TpuSharedMemoryStatus(
+    JsonPtr* status, const std::string& region_name, const Headers& headers) {
+  std::string path = "/v2/tpusharedmemory";
+  if (!region_name.empty()) path += "/region/" + region_name;
+  path += "/status";
+  return Get(path, status, headers);
+}
+
+Error InferenceServerHttpClient::RegisterTpuSharedMemory(
+    const std::string& name, const std::string& raw_handle, size_t byte_size,
+    int device_id, const Headers& headers) {
+  auto obj = Json::MakeObject();
+  obj->Set("raw_handle", Json::MakeObject());
+  obj->Get("raw_handle")->Set("b64", Base64Encode(raw_handle));
+  obj->Set("device_id", static_cast<int64_t>(device_id));
+  obj->Set("byte_size", static_cast<uint64_t>(byte_size));
+  return Post("/v2/tpusharedmemory/region/" + name + "/register",
+              obj->Serialize(), nullptr, headers);
+}
+
+Error InferenceServerHttpClient::UnregisterTpuSharedMemory(
+    const std::string& name, const Headers& headers) {
+  std::string path = "/v2/tpusharedmemory";
+  if (!name.empty()) path += "/region/" + name;
+  path += "/unregister";
+  return Post(path, "", nullptr, headers);
+}
+
+// -- inference --------------------------------------------------------------
+
+Error InferenceServerHttpClient::PrepareInferRequest(
+    PreparedRequest* prep, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  prep->path = "/v2/models/" + options.model_name;
+  if (!options.model_version.empty())
+    prep->path += "/versions/" + options.model_version;
+  prep->path += "/infer";
+  prep->timeout_us = options.client_timeout_us;
+
+  auto head = Json::MakeObject();
+  if (!options.request_id.empty()) head->Set("id", options.request_id);
+
+  auto params = Json::MakeObject();
+  if (options.sequence_id != 0) {
+    params->Set("sequence_id", options.sequence_id);
+    params->Set("sequence_start", options.sequence_start);
+    params->Set("sequence_end", options.sequence_end);
+  }
+  if (options.priority != 0) params->Set("priority", options.priority);
+  if (options.server_timeout_us != 0)
+    params->Set("timeout", options.server_timeout_us);
+  // With no explicit output list, ask for all outputs as binary tails
+  // rather than JSON data arrays (reference `binary_data_output` request
+  // parameter, http_client.cc:334).
+  if (outputs.empty()) params->Set("binary_data_output", true);
+  if (!params->Members().empty()) head->Set("parameters", params);
+
+  auto jinputs = Json::MakeArray();
+  for (const InferInput* input : inputs) {
+    auto jin = Json::MakeObject();
+    jin->Set("name", input->Name());
+    auto shape = Json::MakeArray();
+    for (int64_t d : input->Shape()) shape->Append(Json::MakeInt(d));
+    jin->Set("shape", shape);
+    jin->Set("datatype", input->Datatype());
+    auto iparams = Json::MakeObject();
+    if (input->IsSharedMemory()) {
+      iparams->Set("shared_memory_region", input->SharedMemoryName());
+      iparams->Set("shared_memory_byte_size",
+                   static_cast<uint64_t>(input->SharedMemoryByteSize()));
+      if (input->SharedMemoryOffset() != 0)
+        iparams->Set("shared_memory_offset",
+                     static_cast<uint64_t>(input->SharedMemoryOffset()));
+    } else {
+      iparams->Set("binary_data_size",
+                   static_cast<uint64_t>(input->TotalByteSize()));
+      for (const auto& seg : input->Buffers()) prep->tail.push_back(seg);
+    }
+    jin->Set("parameters", iparams);
+    jinputs->Append(jin);
+  }
+  head->Set("inputs", jinputs);
+
+  if (!outputs.empty()) {
+    auto joutputs = Json::MakeArray();
+    for (const InferRequestedOutput* output : outputs) {
+      auto jout = Json::MakeObject();
+      jout->Set("name", output->Name());
+      auto oparams = Json::MakeObject();
+      if (output->IsSharedMemory()) {
+        oparams->Set("shared_memory_region", output->SharedMemoryName());
+        oparams->Set("shared_memory_byte_size",
+                     static_cast<uint64_t>(output->SharedMemoryByteSize()));
+        if (output->SharedMemoryOffset() != 0)
+          oparams->Set("shared_memory_offset",
+                       static_cast<uint64_t>(output->SharedMemoryOffset()));
+      } else {
+        if (output->BinaryData()) oparams->Set("binary_data", true);
+        if (output->ClassCount() > 0)
+          oparams->Set("classification",
+                       static_cast<uint64_t>(output->ClassCount()));
+      }
+      if (!oparams->Members().empty()) jout->Set("parameters", oparams);
+      joutputs->Append(jout);
+    }
+    head->Set("outputs", joutputs);
+  }
+
+  prep->json_head = head->Serialize();
+  prep->header_length = prep->json_head.size();
+  prep->total_body = prep->header_length;
+  for (const auto& seg : prep->tail) prep->total_body += seg.second;
+  return Error::Success();
+}
+
+Error InferenceServerHttpClient::DoInfer(HttpConnection* conn,
+                                         const PreparedRequest& prep,
+                                         const Headers& headers,
+                                         RequestTimers* timers,
+                                         InferResult** result) {
+  std::string http_head =
+      BuildHttpHead("POST", prep.path, host_, headers, prep.total_body,
+                    prep.header_length, true);
+  std::vector<std::pair<const uint8_t*, size_t>> segs;
+  segs.emplace_back(reinterpret_cast<const uint8_t*>(prep.json_head.data()),
+                    prep.json_head.size());
+  for (const auto& seg : prep.tail) segs.push_back(seg);
+
+  timers->Capture(RequestTimers::Kind::SEND_START);
+  Error err = conn->SendRequest(http_head, segs);
+  if (!err.IsOk()) {
+    conn->Close();
+    err = conn->SendRequest(http_head, segs);
+    if (!err.IsOk()) return err;
+  }
+  timers->Capture(RequestTimers::Kind::SEND_END);
+
+  int status;
+  Headers resp_headers;
+  std::string body;
+  timers->Capture(RequestTimers::Kind::RECV_START);
+  err = conn->ReadResponse(&status, &resp_headers, &body, prep.timeout_us);
+  timers->Capture(RequestTimers::Kind::RECV_END);
+  if (!err.IsOk()) return err;
+
+  size_t header_length = 0;
+  auto it = resp_headers.find("inference-header-content-length");
+  if (it != resp_headers.end()) header_length = atoll(it->second.c_str());
+  return InferResultHttp::Create(result, std::move(body), header_length,
+                                 status);
+}
+
+Error InferenceServerHttpClient::Infer(
+    InferResult** result, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const Headers& headers) {
+  RequestTimers timers;
+  timers.Capture(RequestTimers::Kind::REQUEST_START);
+
+  PreparedRequest prep;
+  Error err = PrepareInferRequest(&prep, options, inputs, outputs);
+  if (!err.IsOk()) return err;
+
+  auto conn = BorrowConnection();
+  err = DoInfer(conn.get(), prep, headers, &timers, result);
+  if (!err.IsOk()) return err;
+  ReturnConnection(std::move(conn));
+
+  timers.Capture(RequestTimers::Kind::REQUEST_END);
+  UpdateInferStat(timers);
+  return Error::Success();
+}
+
+Error InferenceServerHttpClient::AsyncInfer(
+    OnCompleteFn callback, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const Headers& headers) {
+  if (callback == nullptr)
+    return Error("callback is required for AsyncInfer", 400);
+
+  auto job = std::make_unique<AsyncJob>();
+  Error err = PrepareInferRequest(&job->prep, options, inputs, outputs);
+  if (!err.IsOk()) return err;
+  job->headers = headers;
+  job->callback = std::move(callback);
+
+  // Copy tail segments so callers may free inputs immediately.
+  size_t tail_size = 0;
+  for (const auto& seg : job->prep.tail) tail_size += seg.second;
+  job->body_copy.reserve(tail_size);
+  for (const auto& seg : job->prep.tail)
+    job->body_copy.append(reinterpret_cast<const char*>(seg.first),
+                          seg.second);
+  job->prep.tail.clear();
+  if (!job->body_copy.empty())
+    job->prep.tail.emplace_back(
+        reinterpret_cast<const uint8_t*>(job->body_copy.data()),
+        job->body_copy.size());
+
+  {
+    std::lock_guard<std::mutex> lk(async_mutex_);
+    async_queue_.push(std::move(job));
+    size_t wanted = std::min(max_async_workers_,
+                             async_workers_.size() + async_queue_.size());
+    while (async_workers_.size() < wanted) {
+      async_workers_.emplace_back(
+          [this]() { AsyncWorkerLoop(); });
+    }
+  }
+  async_cv_.notify_one();
+  return Error::Success();
+}
+
+void InferenceServerHttpClient::AsyncWorkerLoop() {
+  // Each worker owns one keep-alive connection; one in-flight request per
+  // worker gives up to max_async_workers_ concurrent requests.
+  HttpConnection conn(host_, port_);
+  while (true) {
+    std::unique_ptr<AsyncJob> job;
+    {
+      std::unique_lock<std::mutex> lk(async_mutex_);
+      async_cv_.wait(lk,
+                     [this]() { return async_exit_ || !async_queue_.empty(); });
+      if (async_exit_ && async_queue_.empty()) return;
+      job = std::move(async_queue_.front());
+      async_queue_.pop();
+    }
+    RequestTimers timers;
+    timers.Capture(RequestTimers::Kind::REQUEST_START);
+    InferResult* result = nullptr;
+    Error err = DoInfer(&conn, job->prep, job->headers, &timers, &result);
+    timers.Capture(RequestTimers::Kind::REQUEST_END);
+    if (err.IsOk()) {
+      UpdateInferStat(timers);
+    }
+    if (result == nullptr) {
+      // Build a minimal error result so callbacks always receive one.
+      std::string body = "{\"error\":\"" + err.Message() + "\"}";
+      InferResultHttp::Create(&result, std::move(body), 0,
+                              err.StatusCode() ? err.StatusCode() : 400);
+    }
+    job->callback(result);
+  }
+}
+
+}  // namespace tpuclient

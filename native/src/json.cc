@@ -1,0 +1,499 @@
+#include "tpuclient/json.h"
+
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+
+namespace tpuclient {
+
+JsonPtr Json::MakeBool(bool v) {
+  auto j = std::make_shared<Json>();
+  j->type_ = Type::kBool;
+  j->bool_ = v;
+  return j;
+}
+JsonPtr Json::MakeInt(int64_t v) {
+  auto j = std::make_shared<Json>();
+  j->type_ = Type::kInt;
+  j->int_ = v;
+  return j;
+}
+JsonPtr Json::MakeUint(uint64_t v) {
+  auto j = std::make_shared<Json>();
+  j->type_ = Type::kUint;
+  j->uint_ = v;
+  return j;
+}
+JsonPtr Json::MakeDouble(double v) {
+  auto j = std::make_shared<Json>();
+  j->type_ = Type::kDouble;
+  j->dbl_ = v;
+  return j;
+}
+JsonPtr Json::MakeString(std::string v) {
+  auto j = std::make_shared<Json>();
+  j->type_ = Type::kString;
+  j->str_ = std::move(v);
+  return j;
+}
+JsonPtr Json::MakeArray() {
+  auto j = std::make_shared<Json>();
+  j->type_ = Type::kArray;
+  return j;
+}
+JsonPtr Json::MakeObject() {
+  auto j = std::make_shared<Json>();
+  j->type_ = Type::kObject;
+  return j;
+}
+
+int64_t Json::AsInt() const {
+  switch (type_) {
+    case Type::kInt:
+      return int_;
+    case Type::kUint:
+      return static_cast<int64_t>(uint_);
+    case Type::kDouble:
+      return static_cast<int64_t>(dbl_);
+    case Type::kBool:
+      return bool_ ? 1 : 0;
+    default:
+      return 0;
+  }
+}
+uint64_t Json::AsUint() const {
+  switch (type_) {
+    case Type::kInt:
+      return static_cast<uint64_t>(int_);
+    case Type::kUint:
+      return uint_;
+    case Type::kDouble:
+      return static_cast<uint64_t>(dbl_);
+    case Type::kBool:
+      return bool_ ? 1 : 0;
+    default:
+      return 0;
+  }
+}
+double Json::AsDouble() const {
+  switch (type_) {
+    case Type::kInt:
+      return static_cast<double>(int_);
+    case Type::kUint:
+      return static_cast<double>(uint_);
+    case Type::kDouble:
+      return dbl_;
+    default:
+      return 0.0;
+  }
+}
+
+JsonPtr Json::Get(const std::string& key) const {
+  for (const auto& kv : obj_) {
+    if (kv.first == key) return kv.second;
+  }
+  return nullptr;
+}
+bool Json::Has(const std::string& key) const { return Get(key) != nullptr; }
+void Json::Set(const std::string& key, JsonPtr v) {
+  for (auto& kv : obj_) {
+    if (kv.first == key) {
+      kv.second = std::move(v);
+      return;
+    }
+  }
+  obj_.emplace_back(key, std::move(v));
+}
+
+// ---------------------------------------------------------------------------
+// Serialization
+// ---------------------------------------------------------------------------
+
+static void EscapeString(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"':
+        out->append("\\\"");
+        break;
+      case '\\':
+        out->append("\\\\");
+        break;
+      case '\b':
+        out->append("\\b");
+        break;
+      case '\f':
+        out->append("\\f");
+        break;
+      case '\n':
+        out->append("\\n");
+        break;
+      case '\r':
+        out->append("\\r");
+        break;
+      case '\t':
+        out->append("\\t");
+        break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out->append(buf);
+        } else {
+          out->push_back(static_cast<char>(c));
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+void Json::SerializeTo(std::string* out) const {
+  char buf[32];
+  switch (type_) {
+    case Type::kNull:
+      out->append("null");
+      break;
+    case Type::kBool:
+      out->append(bool_ ? "true" : "false");
+      break;
+    case Type::kInt:
+      snprintf(buf, sizeof(buf), "%lld", static_cast<long long>(int_));
+      out->append(buf);
+      break;
+    case Type::kUint:
+      snprintf(buf, sizeof(buf), "%llu", static_cast<unsigned long long>(uint_));
+      out->append(buf);
+      break;
+    case Type::kDouble: {
+      if (std::isfinite(dbl_)) {
+        char dbuf[40];
+        snprintf(dbuf, sizeof(dbuf), "%.17g", dbl_);
+        out->append(dbuf);
+      } else {
+        out->append("null");  // JSON has no Inf/NaN
+      }
+      break;
+    }
+    case Type::kString:
+      EscapeString(str_, out);
+      break;
+    case Type::kArray: {
+      out->push_back('[');
+      for (size_t i = 0; i < arr_.size(); ++i) {
+        if (i) out->push_back(',');
+        arr_[i]->SerializeTo(out);
+      }
+      out->push_back(']');
+      break;
+    }
+    case Type::kObject: {
+      out->push_back('{');
+      bool first = true;
+      for (const auto& kv : obj_) {
+        if (!first) out->push_back(',');
+        first = false;
+        EscapeString(kv.first, out);
+        out->push_back(':');
+        kv.second->SerializeTo(out);
+      }
+      out->push_back('}');
+      break;
+    }
+  }
+}
+
+std::string Json::Serialize() const {
+  std::string out;
+  out.reserve(256);
+  SerializeTo(&out);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Parsing
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Parser {
+  const char* p;
+  const char* end;
+  std::string err;
+
+  void SkipWs() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
+  }
+
+  bool Fail(const std::string& msg) {
+    err = msg + " at offset " + std::to_string(p - start);
+    return false;
+  }
+
+  const char* start;
+
+  bool ParseValue(JsonPtr* out) {
+    SkipWs();
+    if (p >= end) return Fail("unexpected end of input");
+    switch (*p) {
+      case '{':
+        return ParseObject(out);
+      case '[':
+        return ParseArray(out);
+      case '"': {
+        std::string s;
+        if (!ParseString(&s)) return false;
+        *out = Json::MakeString(std::move(s));
+        return true;
+      }
+      case 't':
+        if (end - p >= 4 && memcmp(p, "true", 4) == 0) {
+          p += 4;
+          *out = Json::MakeBool(true);
+          return true;
+        }
+        return Fail("invalid literal");
+      case 'f':
+        if (end - p >= 5 && memcmp(p, "false", 5) == 0) {
+          p += 5;
+          *out = Json::MakeBool(false);
+          return true;
+        }
+        return Fail("invalid literal");
+      case 'n':
+        if (end - p >= 4 && memcmp(p, "null", 4) == 0) {
+          p += 4;
+          *out = Json::MakeNull();
+          return true;
+        }
+        return Fail("invalid literal");
+      default:
+        return ParseNumber(out);
+    }
+  }
+
+  bool ParseString(std::string* out) {
+    ++p;  // opening quote
+    out->clear();
+    while (p < end) {
+      unsigned char c = static_cast<unsigned char>(*p);
+      if (c == '"') {
+        ++p;
+        return true;
+      }
+      if (c == '\\') {
+        ++p;
+        if (p >= end) return Fail("bad escape");
+        switch (*p) {
+          case '"':
+            out->push_back('"');
+            break;
+          case '\\':
+            out->push_back('\\');
+            break;
+          case '/':
+            out->push_back('/');
+            break;
+          case 'b':
+            out->push_back('\b');
+            break;
+          case 'f':
+            out->push_back('\f');
+            break;
+          case 'n':
+            out->push_back('\n');
+            break;
+          case 'r':
+            out->push_back('\r');
+            break;
+          case 't':
+            out->push_back('\t');
+            break;
+          case 'u': {
+            if (end - p < 5) return Fail("bad \\u escape");
+            unsigned code = 0;
+            for (int i = 1; i <= 4; ++i) {
+              char h = p[i];
+              code <<= 4;
+              if (h >= '0' && h <= '9')
+                code |= h - '0';
+              else if (h >= 'a' && h <= 'f')
+                code |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F')
+                code |= h - 'A' + 10;
+              else
+                return Fail("bad \\u escape");
+            }
+            p += 4;
+            // UTF-8 encode (surrogate pairs for completeness)
+            if (code >= 0xD800 && code <= 0xDBFF && end - p >= 7 &&
+                p[1] == '\\' && p[2] == 'u') {
+              unsigned lo = 0;
+              bool ok = true;
+              for (int i = 3; i <= 6; ++i) {
+                char h = p[i];
+                lo <<= 4;
+                if (h >= '0' && h <= '9')
+                  lo |= h - '0';
+                else if (h >= 'a' && h <= 'f')
+                  lo |= h - 'a' + 10;
+                else if (h >= 'A' && h <= 'F')
+                  lo |= h - 'A' + 10;
+                else {
+                  ok = false;
+                  break;
+                }
+              }
+              if (ok && lo >= 0xDC00 && lo <= 0xDFFF) {
+                code = 0x10000 + ((code - 0xD800) << 10) + (lo - 0xDC00);
+                p += 6;
+              }
+            }
+            if (code < 0x80) {
+              out->push_back(static_cast<char>(code));
+            } else if (code < 0x800) {
+              out->push_back(static_cast<char>(0xC0 | (code >> 6)));
+              out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            } else if (code < 0x10000) {
+              out->push_back(static_cast<char>(0xE0 | (code >> 12)));
+              out->push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            } else {
+              out->push_back(static_cast<char>(0xF0 | (code >> 18)));
+              out->push_back(static_cast<char>(0x80 | ((code >> 12) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            }
+            break;
+          }
+          default:
+            return Fail("bad escape");
+        }
+        ++p;
+      } else {
+        out->push_back(static_cast<char>(c));
+        ++p;
+      }
+    }
+    return Fail("unterminated string");
+  }
+
+  bool ParseNumber(JsonPtr* out) {
+    const char* num_start = p;
+    bool neg = false;
+    bool is_double = false;
+    if (p < end && *p == '-') {
+      neg = true;
+      ++p;
+    }
+    while (p < end && isdigit(static_cast<unsigned char>(*p))) ++p;
+    if (p < end && *p == '.') {
+      is_double = true;
+      ++p;
+      while (p < end && isdigit(static_cast<unsigned char>(*p))) ++p;
+    }
+    if (p < end && (*p == 'e' || *p == 'E')) {
+      is_double = true;
+      ++p;
+      if (p < end && (*p == '+' || *p == '-')) ++p;
+      while (p < end && isdigit(static_cast<unsigned char>(*p))) ++p;
+    }
+    if (p == num_start || (neg && p == num_start + 1))
+      return Fail("invalid number");
+    std::string text(num_start, p - num_start);
+    if (is_double) {
+      *out = Json::MakeDouble(strtod(text.c_str(), nullptr));
+    } else if (neg) {
+      *out = Json::MakeInt(strtoll(text.c_str(), nullptr, 10));
+    } else {
+      uint64_t v = strtoull(text.c_str(), nullptr, 10);
+      if (v <= static_cast<uint64_t>(INT64_MAX)) {
+        *out = Json::MakeInt(static_cast<int64_t>(v));
+      } else {
+        *out = Json::MakeUint(v);
+      }
+    }
+    return true;
+  }
+
+  bool ParseArray(JsonPtr* out) {
+    ++p;  // '['
+    auto arr = Json::MakeArray();
+    SkipWs();
+    if (p < end && *p == ']') {
+      ++p;
+      *out = arr;
+      return true;
+    }
+    while (true) {
+      JsonPtr v;
+      if (!ParseValue(&v)) return false;
+      arr->Append(std::move(v));
+      SkipWs();
+      if (p >= end) return Fail("unterminated array");
+      if (*p == ',') {
+        ++p;
+        continue;
+      }
+      if (*p == ']') {
+        ++p;
+        *out = arr;
+        return true;
+      }
+      return Fail("expected ',' or ']'");
+    }
+  }
+
+  bool ParseObject(JsonPtr* out) {
+    ++p;  // '{'
+    auto obj = Json::MakeObject();
+    SkipWs();
+    if (p < end && *p == '}') {
+      ++p;
+      *out = obj;
+      return true;
+    }
+    while (true) {
+      SkipWs();
+      if (p >= end || *p != '"') return Fail("expected object key");
+      std::string key;
+      if (!ParseString(&key)) return false;
+      SkipWs();
+      if (p >= end || *p != ':') return Fail("expected ':'");
+      ++p;
+      JsonPtr v;
+      if (!ParseValue(&v)) return false;
+      obj->Set(key, std::move(v));
+      SkipWs();
+      if (p >= end) return Fail("unterminated object");
+      if (*p == ',') {
+        ++p;
+        continue;
+      }
+      if (*p == '}') {
+        ++p;
+        *out = obj;
+        return true;
+      }
+      return Fail("expected ',' or '}'");
+    }
+  }
+};
+
+}  // namespace
+
+Error Json::Parse(const char* text, size_t len, JsonPtr* out) {
+  Parser parser{text, text + len, "", text};
+  JsonPtr v;
+  if (!parser.ParseValue(&v)) {
+    return Error("JSON parse error: " + parser.err, 400);
+  }
+  parser.SkipWs();
+  if (parser.p != parser.end) {
+    return Error("JSON parse error: trailing data", 400);
+  }
+  *out = std::move(v);
+  return Error::Success();
+}
+
+}  // namespace tpuclient

@@ -1,0 +1,172 @@
+// Unit tests for the dependency-free core: JSON DOM, base64, BYTES codec,
+// shm utils, InferInput scatter-gather. No server required (SURVEY.md §4:
+// the reference has no unit suite; this framework's test pyramid starts
+// with codec-level units).
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+
+#include "tpuclient/base64.h"
+#include "tpuclient/common.h"
+#include "tpuclient/json.h"
+#include "tpuclient/shm_utils.h"
+
+using namespace tpuclient;
+
+static int failures = 0;
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);  \
+      ++failures;                                                      \
+    }                                                                  \
+  } while (0)
+
+static void TestJsonRoundTrip() {
+  const char* text =
+      "{\"name\":\"simple\",\"ready\":true,\"n\":-42,\"u\":18446744073709551615,"
+      "\"pi\":3.5,\"arr\":[1,2,3],\"nested\":{\"s\":\"a\\nb\\u0041\"},"
+      "\"nil\":null}";
+  JsonPtr j;
+  Error err = Json::Parse(text, strlen(text), &j);
+  CHECK(err.IsOk());
+  CHECK(j->IsObject());
+  CHECK(j->Get("name")->AsString() == "simple");
+  CHECK(j->Get("ready")->AsBool());
+  CHECK(j->Get("n")->AsInt() == -42);
+  CHECK(j->Get("u")->AsUint() == 18446744073709551615ULL);
+  CHECK(j->Get("pi")->AsDouble() == 3.5);
+  CHECK(j->Get("arr")->Size() == 3);
+  CHECK(j->Get("arr")->At(2)->AsInt() == 3);
+  CHECK(j->Get("nested")->Get("s")->AsString() == "a\nbA");
+  CHECK(j->Get("nil")->IsNull());
+
+  // serialize → reparse fixpoint
+  std::string ser = j->Serialize();
+  JsonPtr j2;
+  CHECK(Json::Parse(ser, &j2).IsOk());
+  CHECK(j2->Get("u")->AsUint() == 18446744073709551615ULL);
+  CHECK(j2->Serialize() == ser);
+
+  // failures
+  JsonPtr bad;
+  CHECK(!Json::Parse("{not json", 9, &bad).IsOk());
+  CHECK(!Json::Parse("[1,2", 4, &bad).IsOk());
+  CHECK(!Json::Parse("{}trailing", 10, &bad).IsOk());
+  CHECK(Json::Parse("\"\\ud83d\\ude00\"", 14, &bad).IsOk());  // 😀 surrogate
+  CHECK(bad->AsString() == "\xF0\x9F\x98\x80");
+}
+
+static void TestBase64() {
+  const uint8_t data[] = {0x00, 0x01, 0xFE, 0xFF, 0x7F};
+  for (size_t n = 0; n <= sizeof(data); ++n) {
+    std::string enc = Base64Encode(data, n);
+    std::vector<uint8_t> dec;
+    CHECK(Base64Decode(enc, &dec));
+    CHECK(dec.size() == n);
+    CHECK(memcmp(dec.data(), data, n) == 0);
+  }
+  CHECK(Base64Encode(reinterpret_cast<const uint8_t*>("hello"), 5) ==
+        "aGVsbG8=");
+  std::vector<uint8_t> dec;
+  CHECK(!Base64Decode("a!b", &dec));
+}
+
+static void TestBytesCodec() {
+  std::vector<std::string> strings = {"", "a", "hello world",
+                                      std::string("\x00\x01", 2)};
+  std::string buf;
+  SerializeStringTensor(strings, &buf);
+  CHECK(buf.size() == 4 * 4 + 0 + 1 + 11 + 2);
+  std::vector<std::string> out;
+  Error err = DeserializeStringTensor(
+      reinterpret_cast<const uint8_t*>(buf.data()), buf.size(), &out);
+  CHECK(err.IsOk());
+  CHECK(out == strings);
+
+  // truncated payload must fail, not crash
+  out.clear();
+  err = DeserializeStringTensor(reinterpret_cast<const uint8_t*>(buf.data()),
+                                buf.size() - 1, &out);
+  CHECK(!err.IsOk());
+}
+
+static void TestInferInput() {
+  InferInput* input;
+  CHECK(InferInput::Create(&input, "INPUT0", {2, 16}, "INT32").IsOk());
+  int32_t a[16], b[16];
+  for (int i = 0; i < 16; ++i) {
+    a[i] = i;
+    b[i] = 100 + i;
+  }
+  CHECK(input->AppendRaw(reinterpret_cast<uint8_t*>(a), sizeof(a)).IsOk());
+  CHECK(input->AppendRaw(reinterpret_cast<uint8_t*>(b), sizeof(b)).IsOk());
+  CHECK(input->TotalByteSize() == 128);
+  CHECK(input->Buffers().size() == 2);
+  std::string concat;
+  input->CopyTo(&concat);
+  CHECK(concat.size() == 128);
+  CHECK(memcmp(concat.data(), a, 64) == 0);
+  CHECK(memcmp(concat.data() + 64, b, 64) == 0);
+  // shm and raw are mutually exclusive
+  CHECK(!input->SetSharedMemory("region", 128).IsOk());
+  CHECK(input->Reset().IsOk());
+  CHECK(input->SetSharedMemory("region", 128).IsOk());
+  CHECK(!input->AppendRaw(reinterpret_cast<uint8_t*>(a), 64).IsOk());
+  delete input;
+
+  InferRequestedOutput* output;
+  CHECK(InferRequestedOutput::Create(&output, "OUTPUT0", 3).IsOk());
+  CHECK(output->ClassCount() == 3);
+  CHECK(output->SetSharedMemory("region", 64).IsOk());
+  CHECK(output->IsSharedMemory());
+  CHECK(output->UnsetSharedMemory().IsOk());
+  CHECK(!output->IsSharedMemory());
+  delete output;
+}
+
+static void TestShmUtils() {
+  const char* key = "/tpuclient_unit_shm";
+  int fd;
+  CHECK(CreateSharedMemoryRegion(key, 4096, &fd).IsOk());
+  void* addr;
+  CHECK(MapSharedMemory(fd, 0, 4096, &addr).IsOk());
+  memset(addr, 0xAB, 4096);
+  // second mapping sees the data
+  int fd2;
+  CHECK(CreateSharedMemoryRegion(key, 4096, &fd2).IsOk());
+  void* addr2;
+  CHECK(MapSharedMemory(fd2, 0, 4096, &addr2).IsOk());
+  CHECK(memcmp(addr, addr2, 4096) == 0);
+  CHECK(UnmapSharedMemory(addr, 4096).IsOk());
+  CHECK(UnmapSharedMemory(addr2, 4096).IsOk());
+  CHECK(CloseSharedMemory(fd).IsOk());
+  CHECK(CloseSharedMemory(fd2).IsOk());
+  CHECK(UnlinkSharedMemoryRegion(key).IsOk());
+  CHECK(!UnlinkSharedMemoryRegion(key).IsOk());  // already gone
+}
+
+static void TestDtypes() {
+  CHECK(DtypeByteSize("INT32") == 4);
+  CHECK(DtypeByteSize("FP64") == 8);
+  CHECK(DtypeByteSize("BF16") == 2);
+  CHECK(DtypeByteSize("BOOL") == 1);
+  CHECK(DtypeByteSize("BYTES") == 0);
+  CHECK(ElementCount({2, 3, 4}) == 24);
+  CHECK(ElementCount({2, -1}) == -1);
+}
+
+int main() {
+  TestJsonRoundTrip();
+  TestBase64();
+  TestBytesCodec();
+  TestInferInput();
+  TestShmUtils();
+  TestDtypes();
+  if (failures == 0) {
+    printf("ALL UNIT TESTS PASSED\n");
+    return 0;
+  }
+  printf("%d FAILURES\n", failures);
+  return 1;
+}

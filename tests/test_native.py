@@ -1,0 +1,92 @@
+"""Native C++ layer tests: build, unit tests, and example-clients-as-
+conformance-tests against a live HTTP server (the example binaries
+hard-assert output values, same oracle style as the reference's simple_*
+examples, SURVEY.md §4).
+"""
+
+import os
+import subprocess
+
+import pytest
+
+from client_tpu.engine import TpuEngine
+from client_tpu.models import build_repository
+from client_tpu.server import HttpInferenceServer
+
+NATIVE = os.path.join(os.path.dirname(__file__), "..", "native")
+BUILD = os.path.join(NATIVE, "build")
+
+EXAMPLES = [
+    "simple_http_infer_client",
+    "simple_http_async_infer_client",
+    "simple_http_string_infer_client",
+    "simple_http_shm_client",
+    "simple_http_sequence_client",
+    "simple_http_health_metadata",
+]
+
+
+@pytest.fixture(scope="module")
+def native_build():
+    """Configure+build the native tree (no-op when up to date)."""
+    subprocess.run(
+        ["cmake", "-B", "build", "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release"],
+        cwd=NATIVE, check=True, capture_output=True)
+    proc = subprocess.run(["ninja", "-C", "build"], cwd=NATIVE,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return BUILD
+
+
+@pytest.fixture(scope="module")
+def server():
+    eng = TpuEngine(build_repository(
+        ["simple", "simple_string", "simple_sequence"]))
+    srv = HttpInferenceServer(eng, port=0).start()
+    yield srv
+    srv.stop()
+    eng.shutdown()
+
+
+def test_unit_tests(native_build):
+    proc = subprocess.run([os.path.join(native_build, "tpuclient_unit_tests")],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ALL UNIT TESTS PASSED" in proc.stdout
+
+
+@pytest.mark.parametrize("example", EXAMPLES)
+def test_example_conformance(native_build, server, example):
+    binary = os.path.join(native_build, example)
+    proc = subprocess.run([binary, "-u", server.url], capture_output=True,
+                          text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
+
+
+def test_libcshm_ctypes(native_build):
+    """The C shm extension loads via ctypes and round-trips data
+    (reference shared_memory ctypes bindings,
+    /root/reference/src/python/library/tritonclient/utils/shared_memory/
+    __init__.py:46-73)."""
+    import ctypes
+
+    lib = ctypes.CDLL(os.path.join(native_build, "libcshm.so"))
+    lib.SharedMemoryRegionCreate.restype = ctypes.c_int
+    lib.SharedMemoryRegionCreate.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p)]
+    handle = ctypes.c_void_p()
+    rc = lib.SharedMemoryRegionCreate(b"/pytest_cshm", 1024,
+                                      ctypes.byref(handle))
+    assert rc == 0
+    data = (ctypes.c_uint8 * 4)(1, 2, 3, 4)
+    assert lib.SharedMemoryRegionSet(
+        handle, ctypes.c_uint64(0), ctypes.c_uint64(4), data) == 0
+    out = (ctypes.c_uint8 * 4)()
+    assert lib.SharedMemoryRegionRead(
+        handle, ctypes.c_uint64(0), ctypes.c_uint64(4), out) == 0
+    assert list(out) == [1, 2, 3, 4]
+    # out-of-range rejected
+    assert lib.SharedMemoryRegionSet(
+        handle, ctypes.c_uint64(1021), ctypes.c_uint64(4), data) != 0
+    assert lib.SharedMemoryRegionDestroy(handle) == 0
