@@ -1,0 +1,173 @@
+"""BERT-base flagship model (`bert_base`).
+
+Serving-side counterpart of BASELINE.md config 5 (ensemble
+preprocess→BERT-base→postprocess); the reference carries no model code, so
+this is a TPU-first encoder design:
+
+- bfloat16 parameters and matmuls (MXU-friendly [B,S,H] einsums), float32
+  layer-norm statistics and softmax accumulation,
+- fixed sequence length per config (XLA static shapes; long-context variants
+  shard the sequence axis over the mesh — see client_tpu.parallel),
+- one pure ``apply`` over a params pytree; the engine jits per batch bucket.
+
+Inputs follow the common BERT serving convention: ``input_ids`` INT32[S],
+``attention_mask`` INT32[S]. Outputs: ``pooled_output`` FP32[hidden] (tanh
+pooler over [CLS]) and ``logits`` FP32[num_labels] for the ensemble's
+classification postprocess.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from client_tpu.engine.config import (
+    DynamicBatchingConfig,
+    ModelConfig,
+    TensorConfig,
+)
+from client_tpu.engine.model import ModelBackend
+from client_tpu.models import register_model
+
+VOCAB_SIZE = 30522  # BERT wordpiece vocabulary size
+
+
+class BertBackend(ModelBackend):
+    """BERT-base encoder: 12 layers, hidden 768, 12 heads, FFN 3072."""
+
+    def __init__(self, name: str = "bert_base", seq_len: int = 128,
+                 hidden: int = 768, n_layers: int = 12, n_heads: int = 12,
+                 ffn: int = 3072, num_labels: int = 2,
+                 vocab: int = VOCAB_SIZE, max_batch_size: int = 16):
+        self.seq_len = seq_len
+        self.hidden = hidden
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        self.ffn = ffn
+        self.num_labels = num_labels
+        self.vocab = vocab
+        self.config = ModelConfig(
+            name=name,
+            platform="jax",
+            max_batch_size=max_batch_size,
+            input=[
+                TensorConfig("input_ids", "INT32", [seq_len]),
+                TensorConfig("attention_mask", "INT32", [seq_len]),
+            ],
+            output=[
+                TensorConfig("pooled_output", "FP32", [hidden]),
+                TensorConfig("logits", "FP32", [num_labels]),
+            ],
+            dynamic_batching=DynamicBatchingConfig(
+                preferred_batch_size=[max(1, max_batch_size // 2),
+                                      max_batch_size],
+                max_queue_delay_microseconds=500,
+            ),
+            instance_count=2,
+        )
+
+    def _init_params(self):
+        import jax
+        import jax.numpy as jnp
+
+        dt = jnp.bfloat16
+        h, f = self.hidden, self.ffn
+        key = jax.random.PRNGKey(768)
+
+        def nk():
+            nonlocal key
+            key, sub = jax.random.split(key)
+            return sub
+
+        def dense(cin, cout):
+            std = np.sqrt(1.0 / cin)
+            return {
+                "w": (jax.random.normal(nk(), (cin, cout)) * std).astype(dt),
+                "b": np.zeros((cout,), dt),
+            }
+
+        def ln(c):
+            return {"scale": np.ones((c,), np.float32),
+                    "bias": np.zeros((c,), np.float32)}
+
+        params = {
+            "tok_embed": (jax.random.normal(nk(), (self.vocab, h)) * 0.02
+                          ).astype(dt),
+            "pos_embed": (jax.random.normal(nk(), (self.seq_len, h)) * 0.02
+                          ).astype(dt),
+            "embed_ln": ln(h),
+            "layers": [],
+            "pooler": dense(h, h),
+            "classifier": dense(h, self.num_labels),
+        }
+        for _ in range(self.n_layers):
+            params["layers"].append({
+                "wq": dense(h, h), "wk": dense(h, h), "wv": dense(h, h),
+                "wo": dense(h, h),
+                "ln1": ln(h),
+                "w1": dense(h, f), "w2": dense(f, h),
+                "ln2": ln(h),
+            })
+        return params
+
+    def make_apply(self):
+        params = self._init_params()
+        n_heads = self.n_heads
+        head_dim = self.hidden // n_heads
+
+        def layer_norm(x, p):
+            import jax
+            import jax.numpy as jnp
+
+            x32 = x.astype(jnp.float32)
+            mu = jnp.mean(x32, axis=-1, keepdims=True)
+            var = jnp.var(x32, axis=-1, keepdims=True)
+            y = (x32 - mu) * jax.lax.rsqrt(var + 1e-12)
+            return (y * p["scale"] + p["bias"]).astype(jnp.bfloat16)
+
+        def proj(x, p):
+            return x @ p["w"] + p["b"]
+
+        def attention(x, mask_bias, lp):
+            import jax
+            import jax.numpy as jnp
+
+            b, s, h = x.shape
+            q = proj(x, lp["wq"]).reshape(b, s, n_heads, head_dim)
+            k = proj(x, lp["wk"]).reshape(b, s, n_heads, head_dim)
+            v = proj(x, lp["wv"]).reshape(b, s, n_heads, head_dim)
+            # [B, heads, S, S] scores, fp32 softmax accumulation
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+            scores = scores / np.sqrt(head_dim) + mask_bias
+            probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, h)
+            return proj(ctx, lp["wo"])
+
+        def apply(inputs):
+            import jax
+            import jax.numpy as jnp
+
+            ids = inputs["input_ids"]
+            mask = inputs["attention_mask"].astype(jnp.float32)
+            # additive attention bias: 0 where attended, -1e9 where masked
+            mask_bias = (mask[:, None, None, :] - 1.0) * 1e9
+
+            x = params["tok_embed"][ids] + params["pos_embed"][None, :, :]
+            x = layer_norm(x, params["embed_ln"])
+            for lp in params["layers"]:
+                x = layer_norm(x + attention(x, mask_bias, lp), lp["ln1"])
+                y = jax.nn.gelu(proj(x, lp["w1"]))
+                x = layer_norm(x + proj(y, lp["w2"]), lp["ln2"])
+
+            cls = x[:, 0, :].astype(jnp.float32)
+            pooler = params["pooler"]
+            pooled = jnp.tanh(cls @ pooler["w"].astype(jnp.float32)
+                              + pooler["b"].astype(jnp.float32))
+            clf = params["classifier"]
+            logits = pooled @ clf["w"].astype(jnp.float32) \
+                + clf["b"].astype(jnp.float32)
+            return {"pooled_output": pooled, "logits": logits}
+
+        return apply
+
+
+register_model("bert_base")(BertBackend)

@@ -13,6 +13,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <functional>
 #include <map>
 #include <memory>
@@ -109,7 +110,10 @@ class InferInput {
   std::vector<std::pair<const uint8_t*, size_t>> bufs_;
   // Backing store for AppendFromString (serialized BYTES payloads must
   // outlive the call site's temporaries).
-  std::vector<std::string> owned_;
+  // deque: pointers into elements stay valid across later appends (bufs_
+  // records (data,size) pairs into these strings; vector reallocation would
+  // relocate SSO buffers and dangle them)
+  std::deque<std::string> owned_;
   size_t total_byte_size_ = 0;
   std::string shm_name_;
   size_t shm_byte_size_ = 0;

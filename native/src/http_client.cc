@@ -103,6 +103,44 @@ class HttpConnection {
   }
 
   // Reads one full HTTP response. timeout_us==0 means no timeout.
+  // One send+read round trip with a single whole-request retry on a stale
+  // keep-alive socket. A pooled connection the server closed after its idle
+  // timeout fails either at send (RST) or — more commonly — with a clean
+  // EOF at read even though writev() was accepted into the half-closed
+  // socket's buffer; both are safe to retry on a fresh connection because
+  // no response bytes ever arrived. Timeouts (499) and partial responses
+  // are NOT retried.
+  Error RoundTrip(const std::string& head,
+                  const std::vector<std::pair<const uint8_t*, size_t>>& segs,
+                  uint64_t timeout_us, int* status, Headers* headers,
+                  std::string* body, RequestTimers* timers = nullptr) {
+    bool reused = fd_ >= 0;
+    if (timers) timers->Capture(RequestTimers::Kind::SEND_START);
+    Error err = SendRequest(head, segs);
+    bool need_retry = false;
+    if (err.IsOk()) {
+      if (timers) timers->Capture(RequestTimers::Kind::SEND_END);
+      got_bytes_ = !rbuf_.empty();
+      if (timers) timers->Capture(RequestTimers::Kind::RECV_START);
+      err = ReadResponse(status, headers, body, timeout_us);
+      if (timers) timers->Capture(RequestTimers::Kind::RECV_END);
+      if (err.IsOk()) return err;
+      need_retry = reused && !got_bytes_ && err.StatusCode() != 499;
+    } else {
+      need_retry = reused;
+    }
+    if (!need_retry) return err;
+    Close();
+    if (timers) timers->Capture(RequestTimers::Kind::SEND_START);
+    err = SendRequest(head, segs);
+    if (!err.IsOk()) return err;
+    if (timers) timers->Capture(RequestTimers::Kind::SEND_END);
+    if (timers) timers->Capture(RequestTimers::Kind::RECV_START);
+    err = ReadResponse(status, headers, body, timeout_us);
+    if (timers) timers->Capture(RequestTimers::Kind::RECV_END);
+    return err;
+  }
+
   Error ReadResponse(int* status, Headers* headers, std::string* body,
                      uint64_t timeout_us) {
     uint64_t deadline_ns =
@@ -212,6 +250,7 @@ class HttpConnection {
       return Error(std::string("recv failed: ") + strerror(errno), 400);
     }
     rbuf_.append(buf, n);
+    got_bytes_ = true;
     return Error::Success();
   }
 
@@ -245,6 +284,9 @@ class HttpConnection {
   int port_;
   int fd_;
   std::string rbuf_;
+  // whether any response byte arrived for the in-flight request (guards the
+  // RoundTrip stale-connection retry against replaying a half-answered call)
+  bool got_bytes_ = false;
 };
 
 // ---------------------------------------------------------------------------
@@ -459,7 +501,13 @@ InferenceServerHttpClient::InferenceServerHttpClient(const std::string& host,
     : InferenceServerClient(verbose), host_(host), port_(port) {}
 
 InferenceServerHttpClient::~InferenceServerHttpClient() {
-  async_exit_ = true;
+  {
+    // Lock so the store can't slip between a worker's predicate check and
+    // its block — an unsynchronized store + notify loses the wakeup and
+    // join() below hangs.
+    std::lock_guard<std::mutex> lk(async_mutex_);
+    async_exit_ = true;
+  }
   async_cv_.notify_all();
   for (auto& t : async_workers_) {
     if (t.joinable()) t.join();
@@ -510,17 +558,10 @@ Error InferenceServerHttpClient::Get(const std::string& path, JsonPtr* response,
                                      const Headers& headers) {
   auto conn = BorrowConnection();
   std::string head = BuildHttpHead("GET", path, host_, headers, 0, 0, false);
-  Error err = conn->SendRequest(head, {});
-  if (!err.IsOk()) {
-    // one retry on a stale keep-alive connection
-    conn->Close();
-    err = conn->SendRequest(head, {});
-    if (!err.IsOk()) return err;
-  }
   int status;
   Headers resp_headers;
   std::string body;
-  err = conn->ReadResponse(&status, &resp_headers, &body, 0);
+  Error err = conn->RoundTrip(head, {}, 0, &status, &resp_headers, &body);
   if (!err.IsOk()) return err;
   ReturnConnection(std::move(conn));
   if (response != nullptr && !body.empty()) {
@@ -551,16 +592,11 @@ Error InferenceServerHttpClient::Post(const std::string& path,
   if (!body.empty())
     segs.emplace_back(reinterpret_cast<const uint8_t*>(body.data()),
                       body.size());
-  Error err = conn->SendRequest(head, segs);
-  if (!err.IsOk()) {
-    conn->Close();
-    err = conn->SendRequest(head, segs);
-    if (!err.IsOk()) return err;
-  }
   int status;
   Headers resp_headers;
   std::string resp_body;
-  err = conn->ReadResponse(&status, &resp_headers, &resp_body, 0);
+  Error err =
+      conn->RoundTrip(head, segs, 0, &status, &resp_headers, &resp_body);
   if (!err.IsOk()) return err;
   ReturnConnection(std::move(conn));
   JsonPtr parsed;
@@ -829,21 +865,11 @@ Error InferenceServerHttpClient::DoInfer(HttpConnection* conn,
                     prep.json_head.size());
   for (const auto& seg : prep.tail) segs.push_back(seg);
 
-  timers->Capture(RequestTimers::Kind::SEND_START);
-  Error err = conn->SendRequest(http_head, segs);
-  if (!err.IsOk()) {
-    conn->Close();
-    err = conn->SendRequest(http_head, segs);
-    if (!err.IsOk()) return err;
-  }
-  timers->Capture(RequestTimers::Kind::SEND_END);
-
   int status;
   Headers resp_headers;
   std::string body;
-  timers->Capture(RequestTimers::Kind::RECV_START);
-  err = conn->ReadResponse(&status, &resp_headers, &body, prep.timeout_us);
-  timers->Capture(RequestTimers::Kind::RECV_END);
+  Error err = conn->RoundTrip(http_head, segs, prep.timeout_us, &status,
+                              &resp_headers, &body, timers);
   if (!err.IsOk()) return err;
 
   size_t header_length = 0;
@@ -939,9 +965,13 @@ void InferenceServerHttpClient::AsyncWorkerLoop() {
       UpdateInferStat(timers);
     }
     if (result == nullptr) {
-      // Build a minimal error result so callbacks always receive one.
-      std::string body = "{\"error\":\"" + err.Message() + "\"}";
-      InferResultHttp::Create(&result, std::move(body), 0,
+      // Build a minimal error result so callbacks always receive one. The
+      // message goes through the JSON serializer: raw concatenation breaks
+      // on quotes/backslashes in server-echoed error text and would leave
+      // the callback holding nullptr.
+      auto err_obj = Json::MakeObject();
+      err_obj->Set("error", err.Message());
+      InferResultHttp::Create(&result, err_obj->Serialize(), 0,
                               err.StatusCode() ? err.StatusCode() : 400);
     }
     job->callback(result);

@@ -1,0 +1,158 @@
+"""Model-zoo tests: flagship architectures, shapes, ensemble pipelines.
+
+Small instantiations keep XLA-on-CPU compile times test-friendly; the
+architectures are identical to the registered full-size flagships (same
+code paths, smaller stage widths / fewer layers / smaller images).
+"""
+
+import numpy as np
+import pytest
+
+from client_tpu.engine import InferRequest, TpuEngine
+from client_tpu.engine.repository import ModelRepository
+from client_tpu.models import model_names
+from client_tpu.models.bert import BertBackend
+from client_tpu.models.ensembles import (
+    BertPostprocessBackend,
+    BertPreprocessBackend,
+    ImagePreprocessBackend,
+)
+from client_tpu.models.ssd import MAX_DETECTIONS, SsdMobileNetV2Backend
+from client_tpu.models.vision import DenseNet121Backend, ResNet50Backend
+
+
+def test_registry_has_flagships():
+    names = model_names()
+    for expected in (
+        "simple", "simple_string", "simple_identity", "simple_sequence",
+        "simple_repeat", "resnet50", "densenet_onnx", "bert_base",
+        "ssd_mobilenet_v2_coco_quantized", "ssd_mobilenet_v2_tpu",
+        "ensemble_bert", "ensemble_image", "bert_preprocess",
+        "bert_postprocess", "image_preprocess",
+    ):
+        assert expected in names, expected
+
+
+def test_resnet_small_forward():
+    backend = ResNet50Backend(
+        name="resnet_tiny", num_classes=10, image_size=32,
+        stages=((1, 8), (1, 16)), max_batch_size=2)
+    apply_fn = backend.make_apply()
+    out = apply_fn({"INPUT": np.random.rand(2, 32, 32, 3).astype(np.float32)})
+    assert out["OUTPUT"].shape == (2, 10)
+    assert np.asarray(out["OUTPUT"]).dtype == np.float32
+    assert np.all(np.isfinite(np.asarray(out["OUTPUT"], np.float32)))
+
+
+def test_densenet_small_forward():
+    backend = DenseNet121Backend(
+        name="densenet_tiny", num_classes=7, image_size=32,
+        blocks=(2, 2), growth=8, max_batch_size=2)
+    apply_fn = backend.make_apply()
+    out = apply_fn({"INPUT": np.random.rand(1, 32, 32, 3).astype(np.float32)})
+    assert out["OUTPUT"].shape == (1, 7)
+    assert np.all(np.isfinite(np.asarray(out["OUTPUT"], np.float32)))
+
+
+def test_bert_small_forward_mask_invariance():
+    import jax
+
+    backend = BertBackend(
+        name="bert_tiny", seq_len=16, hidden=32, n_layers=2, n_heads=4,
+        ffn=64, vocab=1000, max_batch_size=2)
+    apply_fn = jax.jit(backend.make_apply())
+    ids = np.zeros((2, 16), np.int32)
+    mask = np.zeros((2, 16), np.int32)
+    ids[:, :5] = [[7, 8, 9, 10, 11], [7, 8, 9, 10, 11]]
+    mask[:, :5] = 1
+    out1 = apply_fn({"input_ids": ids, "attention_mask": mask})
+    # garbage in masked positions must not change the output
+    ids2 = ids.copy()
+    ids2[:, 10:] = 503
+    out2 = apply_fn({"input_ids": ids2, "attention_mask": mask})
+    assert out1["pooled_output"].shape == (2, 32)
+    assert out1["logits"].shape == (2, 2)
+    np.testing.assert_allclose(
+        np.asarray(out1["logits"]), np.asarray(out2["logits"]),
+        rtol=2e-2, atol=2e-2)
+
+
+def test_ssd_forward_shapes_and_nms():
+    import jax
+
+    backend = SsdMobileNetV2Backend()
+    apply_fn = jax.jit(backend.make_apply())
+    img = np.random.randint(0, 256, (1, 300, 300, 3), np.uint8)
+    out = apply_fn({"normalized_input_image_tensor": img})
+    boxes = np.asarray(out["TFLite_Detection_PostProcess"], np.float32)
+    classes = np.asarray(out["TFLite_Detection_PostProcess:1"], np.float32)
+    scores = np.asarray(out["TFLite_Detection_PostProcess:2"], np.float32)
+    count = np.asarray(out["TFLite_Detection_PostProcess:3"], np.float32)
+    assert boxes.shape == (1, 1, MAX_DETECTIONS, 4)
+    assert classes.shape == (1, 1, MAX_DETECTIONS)
+    assert scores.shape == (1, 1, MAX_DETECTIONS)
+    assert count.shape == (1, 1)
+    # scores sorted non-increasing (greedy NMS picks max first)
+    s = scores[0, 0]
+    assert np.all(s[:-1] >= s[1:] - 1e-6)
+    assert 0 <= count[0, 0] <= MAX_DETECTIONS
+
+
+def test_bert_preprocess_postprocess_roundtrip():
+    pre = BertPreprocessBackend(seq_len=16).make_apply()
+    out = pre({"TEXT": np.array([[b"hello world"], [b"HELLO WORLD"]],
+                                dtype=np.object_)})
+    ids, mask = out["input_ids"], out["attention_mask"]
+    assert ids.shape == (2, 16) and mask.shape == (2, 16)
+    # tokenization is case-insensitive and deterministic
+    np.testing.assert_array_equal(ids[0], ids[1])
+    assert mask[0].sum() == 4  # CLS + 2 tokens + SEP
+
+    post = BertPostprocessBackend().make_apply()
+    res = post({"logits": np.array([[0.1, 2.0], [3.0, -1.0]], np.float32)})
+    assert res["LABEL"][0, 0] == b"positive"
+    assert res["LABEL"][1, 0] == b"negative"
+    assert res["SCORE"].shape == (2, 1)
+    assert np.all((res["SCORE"] > 0.5) & (res["SCORE"] <= 1.0))
+
+
+def test_image_preprocess_resize():
+    pre = ImagePreprocessBackend(size=8).make_apply()
+    img = np.full((1, 31, 57, 3), 128, np.uint8)
+    out = pre({"RAW_IMAGE": img})
+    assert out["IMAGE"].shape == (1, 8, 8, 3)
+    # constant image -> constant normalized output
+    assert np.allclose(out["IMAGE"][0, :, :, 0], out["IMAGE"][0, 0, 0, 0])
+
+
+@pytest.fixture(scope="module")
+def tiny_ensemble_engine():
+    """Engine serving a tiny bert + pre/post + ensemble pipeline."""
+    repo = ModelRepository()
+    pre = BertPreprocessBackend(seq_len=16)
+    tiny = BertBackend(name="bert_base", seq_len=16, hidden=32, n_layers=2,
+                       n_heads=4, ffn=64, vocab=1000, max_batch_size=8)
+    post = BertPostprocessBackend()
+    from client_tpu.models.ensembles import EnsembleBertBackend
+
+    repo.register_backend(pre)
+    repo.register_backend(tiny)
+    repo.register_backend(post)
+    repo.register_backend(EnsembleBertBackend())
+    engine = TpuEngine(repo)
+    yield engine
+    engine.shutdown()
+
+
+def test_ensemble_bert_end_to_end(tiny_ensemble_engine):
+    engine = tiny_ensemble_engine
+    req = InferRequest(
+        model_name="ensemble_bert",
+        inputs={"TEXT": np.array([[b"a fine day"]], dtype=np.object_)})
+    resp = engine.infer(req, timeout_s=120)
+    assert resp.outputs["LABEL"].shape == (1, 1)
+    assert resp.outputs["LABEL"][0, 0] in (b"positive", b"negative")
+    assert resp.outputs["SCORE"].shape == (1, 1)
+    # composing-model statistics accumulated (ensemble rollup parity)
+    stats = engine.model_statistics("bert_base")["model_stats"][0]
+    assert stats["inference_count"] >= 1

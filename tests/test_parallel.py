@@ -40,7 +40,8 @@ class TestGraftEntry:
         fn, args = ge.entry()
         out = jax.jit(fn)(*args)
         jax.block_until_ready(out)
-        assert out["OUTPUT0"].shape == (8, 16)
+        assert out["logits"].shape == (8, 2)
+        assert out["pooled_output"].shape == (8, 768)
 
     def test_dryrun_multichip(self):
         import __graft_entry__ as ge
