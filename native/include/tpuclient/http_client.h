@@ -133,6 +133,13 @@ class InferenceServerHttpClient : public InferenceServerClient {
                    const std::vector<const InferRequestedOutput*>& outputs = {},
                    const Headers& headers = {});
 
+  // Sizes the async worker-connection pool (one in-flight request per
+  // worker). Takes effect for workers not yet spawned; call before the
+  // first AsyncInfer for full effect.
+  void SetMaxAsyncWorkers(size_t n) {
+    if (n > 0) max_async_workers_ = n;
+  }
+
   // Raw entry points used by the generate/profile tooling.
   Error Get(const std::string& path, JsonPtr* response,
             const Headers& headers = {});

@@ -1,0 +1,151 @@
+#include "client_backend.h"
+
+#include "tpuclient/http_client.h"
+
+using tpuclient::Error;
+using tpuclient::JsonPtr;
+
+namespace tpuperf {
+
+Error ClientBackend::RegisterSystemSharedMemory(const std::string&,
+                                                const std::string&, size_t) {
+  return Error("shared memory not supported by this backend", 400);
+}
+
+Error ClientBackend::UnregisterSystemSharedMemory(const std::string&) {
+  return Error("shared memory not supported by this backend", 400);
+}
+
+namespace {
+
+class HttpClientBackend : public ClientBackend {
+ public:
+  static Error Create(const std::string& url, bool verbose,
+                      size_t max_async_concurrency,
+                      std::unique_ptr<ClientBackend>* backend) {
+    auto b = std::unique_ptr<HttpClientBackend>(new HttpClientBackend());
+    Error err =
+        tpuclient::InferenceServerHttpClient::Create(&b->client_, url, verbose);
+    if (!err.IsOk()) return err;
+    b->client_->SetMaxAsyncWorkers(max_async_concurrency);
+    *backend = std::move(b);
+    return Error::Success();
+  }
+
+  Error ServerExtensions(std::vector<std::string>* extensions) override {
+    JsonPtr md;
+    Error err = client_->ServerMetadata(&md);
+    if (!err.IsOk()) return err;
+    extensions->clear();
+    JsonPtr ext = md->Get("extensions");
+    if (ext && ext->IsArray()) {
+      for (size_t i = 0; i < ext->Size(); ++i) {
+        if (ext->At(i)->IsString()) extensions->push_back(ext->At(i)->AsString());
+      }
+    }
+    return Error::Success();
+  }
+
+  Error ModelMetadata(JsonPtr* metadata, const std::string& model_name,
+                      const std::string& version) override {
+    return client_->ModelMetadata(metadata, model_name, version);
+  }
+
+  Error ModelConfig(JsonPtr* config, const std::string& model_name,
+                    const std::string& version) override {
+    return client_->ModelConfig(config, model_name, version);
+  }
+
+  Error Infer(tpuclient::InferResult** result,
+              const tpuclient::InferOptions& options,
+              const std::vector<tpuclient::InferInput*>& inputs,
+              const std::vector<const tpuclient::InferRequestedOutput*>&
+                  outputs) override {
+    return client_->Infer(result, options, inputs, outputs);
+  }
+
+  Error AsyncInfer(tpuclient::OnCompleteFn callback,
+                   const tpuclient::InferOptions& options,
+                   const std::vector<tpuclient::InferInput*>& inputs,
+                   const std::vector<const tpuclient::InferRequestedOutput*>&
+                       outputs) override {
+    return client_->AsyncInfer(std::move(callback), options, inputs, outputs);
+  }
+
+  Error ModelInferenceStatistics(std::map<std::string, ModelStatistics>* stats,
+                                 const std::string& model_name) override {
+    JsonPtr body;
+    Error err = client_->ModelInferenceStatistics(&body, model_name);
+    if (!err.IsOk()) return err;
+    stats->clear();
+    JsonPtr list = body->Get("model_stats");
+    if (!list || !list->IsArray())
+      return Error("statistics response missing model_stats", 400);
+    for (size_t i = 0; i < list->Size(); ++i) {
+      JsonPtr m = list->At(i);
+      if (!m->IsObject()) continue;
+      JsonPtr name = m->Get("name");
+      if (!name || !name->IsString()) continue;
+      ModelStatistics ms;
+      auto u64 = [&](const JsonPtr& obj, const char* key) -> uint64_t {
+        if (!obj) return 0;
+        JsonPtr v = obj->Get(key);
+        return v && v->IsNumber() ? v->AsUint() : 0;
+      };
+      ms.inference_count = u64(m, "inference_count");
+      ms.execution_count = u64(m, "execution_count");
+      JsonPtr infer_stats = m->Get("inference_stats");
+      if (infer_stats && infer_stats->IsObject()) {
+        auto phase = [&](const char* key, uint64_t* count_out) -> uint64_t {
+          JsonPtr p = infer_stats->Get(key);
+          if (!p || !p->IsObject()) return 0;
+          if (count_out) *count_out = u64(p, "count");
+          return u64(p, "ns");
+        };
+        uint64_t success_count = 0;
+        ms.cumulative_request_time_ns = phase("success", &success_count);
+        ms.success_count = success_count;
+        ms.queue_time_ns = phase("queue", nullptr);
+        ms.compute_input_time_ns = phase("compute_input", nullptr);
+        ms.compute_infer_time_ns = phase("compute_infer", nullptr);
+        ms.compute_output_time_ns = phase("compute_output", nullptr);
+      }
+      (*stats)[name->AsString()] = ms;
+    }
+    return Error::Success();
+  }
+
+  Error ClientInferStat(tpuclient::InferStat* stat) override {
+    return client_->ClientInferStat(stat);
+  }
+
+  Error RegisterSystemSharedMemory(const std::string& name,
+                                   const std::string& key,
+                                   size_t byte_size) override {
+    return client_->RegisterSystemSharedMemory(name, key, byte_size);
+  }
+
+  Error UnregisterSystemSharedMemory(const std::string& name) override {
+    return client_->UnregisterSystemSharedMemory(name);
+  }
+
+ private:
+  HttpClientBackend() = default;
+  std::unique_ptr<tpuclient::InferenceServerHttpClient> client_;
+};
+
+}  // namespace
+
+Error ClientBackendFactory::Create(
+    std::unique_ptr<ClientBackend>* backend) const {
+  switch (kind_) {
+    case BackendKind::TPU_HTTP:
+      return HttpClientBackend::Create(url_, verbose_, max_async_concurrency_,
+                                       backend);
+    case BackendKind::TPU_CAPI:
+      return Error("TPU_CAPI backend not wired yet", 400);
+  }
+  return Error("unknown backend kind", 400);
+}
+
+}  // namespace tpuperf

@@ -1,0 +1,98 @@
+// Backend abstraction decoupling the perf harness from the service kind.
+//
+// Counterpart of the reference's client_backend layer
+// (/root/reference/src/c++/perf_analyzer/client_backend/client_backend.h:
+// 101-368): a factory + virtual interface so the load managers and profiler
+// drive any endpoint kind. Kinds here: TPU_HTTP (our native HTTP client),
+// TPU_CAPI (in-process engine via dlopen'd C-API shim — the reference's
+// triton_c_api equivalent). gRPC joins when the native gRPC client lands.
+// Unlike the reference, the interface reuses the tpuclient tensor types
+// directly instead of wrapping them per backend — same-process types, no
+// adapter cost.
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "tpuclient/common.h"
+#include "tpuclient/json.h"
+
+namespace tpuperf {
+
+enum class BackendKind { TPU_HTTP, TPU_CAPI };
+
+// Server-side per-model statistics snapshot (reference ModelStatistics,
+// client_backend.h:148-168), pulled from the v2 statistics endpoint.
+struct ModelStatistics {
+  uint64_t success_count = 0;
+  uint64_t inference_count = 0;
+  uint64_t execution_count = 0;
+  uint64_t queue_time_ns = 0;
+  uint64_t compute_input_time_ns = 0;
+  uint64_t compute_infer_time_ns = 0;
+  uint64_t compute_output_time_ns = 0;
+  uint64_t cumulative_request_time_ns = 0;
+};
+
+class ClientBackend {
+ public:
+  virtual ~ClientBackend() = default;
+
+  virtual tpuclient::Error ServerExtensions(
+      std::vector<std::string>* extensions) = 0;
+  virtual tpuclient::Error ModelMetadata(tpuclient::JsonPtr* metadata,
+                                         const std::string& model_name,
+                                         const std::string& version) = 0;
+  virtual tpuclient::Error ModelConfig(tpuclient::JsonPtr* config,
+                                       const std::string& model_name,
+                                       const std::string& version) = 0;
+
+  virtual tpuclient::Error Infer(
+      tpuclient::InferResult** result, const tpuclient::InferOptions& options,
+      const std::vector<tpuclient::InferInput*>& inputs,
+      const std::vector<const tpuclient::InferRequestedOutput*>& outputs) = 0;
+
+  virtual tpuclient::Error AsyncInfer(
+      tpuclient::OnCompleteFn callback, const tpuclient::InferOptions& options,
+      const std::vector<tpuclient::InferInput*>& inputs,
+      const std::vector<const tpuclient::InferRequestedOutput*>& outputs) = 0;
+
+  // model_name -> stats; empty name = all models (ensemble rollup pulls the
+  // composing models from the same snapshot).
+  virtual tpuclient::Error ModelInferenceStatistics(
+      std::map<std::string, ModelStatistics>* stats,
+      const std::string& model_name = "") = 0;
+
+  virtual tpuclient::Error ClientInferStat(tpuclient::InferStat* stat) = 0;
+
+  // Shared-memory control plane (system shm data plane for request tensors;
+  // reference client_backend.h:330-368).
+  virtual tpuclient::Error RegisterSystemSharedMemory(const std::string& name,
+                                                      const std::string& key,
+                                                      size_t byte_size);
+  virtual tpuclient::Error UnregisterSystemSharedMemory(
+      const std::string& name);
+
+  virtual bool SupportsAsync() const { return true; }
+};
+
+class ClientBackendFactory {
+ public:
+  ClientBackendFactory(BackendKind kind, std::string url, bool verbose,
+                       size_t max_async_concurrency = 8)
+      : kind_(kind), url_(std::move(url)), verbose_(verbose),
+        max_async_concurrency_(max_async_concurrency) {}
+
+  tpuclient::Error Create(std::unique_ptr<ClientBackend>* backend) const;
+
+  BackendKind Kind() const { return kind_; }
+
+ private:
+  BackendKind kind_;
+  std::string url_;
+  bool verbose_;
+  size_t max_async_concurrency_;
+};
+
+}  // namespace tpuperf

@@ -1,0 +1,194 @@
+#include "concurrency_manager.h"
+
+using tpuclient::Error;
+
+namespace tpuperf {
+
+Error ConcurrencyManager::Create(const LoadOptions& options,
+                                 const ClientBackendFactory& factory,
+                                 std::shared_ptr<ModelParser> parser,
+                                 std::shared_ptr<DataLoader> data_loader,
+                                 std::unique_ptr<ConcurrencyManager>* manager) {
+  auto m = std::unique_ptr<ConcurrencyManager>(new ConcurrencyManager(
+      options, factory, std::move(parser), std::move(data_loader)));
+  Error err = m->InitManager();
+  if (!err.IsOk()) return err;
+  *manager = std::move(m);
+  return Error::Success();
+}
+
+ConcurrencyManager::~ConcurrencyManager() {
+  exit_.store(true);
+  wake_cv_.notify_all();
+  StopWorkerThreads();
+}
+
+Error ConcurrencyManager::ChangeConcurrencyLevel(size_t concurrency) {
+  // Thread fleet: one thread per in-flight request in sync mode (a blocking
+  // Infer can't multiplex), contexts multiplexed per thread in async mode
+  // (reference concurrency_manager.cc:90-146). Both are capped by
+  // max_threads; sync mode warns because the cap silently limits the real
+  // generated load.
+  size_t n_threads = std::min(concurrency, options_.max_threads);
+  if (!options_.async && concurrency > options_.max_threads) {
+    fprintf(stderr,
+            "warning: sync mode caps in-flight requests at --max-threads "
+            "(%zu < requested %zu); use -a for higher concurrency\n",
+            options_.max_threads, concurrency);
+  }
+  // spawn missing workers
+  while (threads_.size() < n_threads) {
+    size_t idx = threads_.size();
+    auto stat = std::make_shared<ThreadStat>();
+    auto config = std::make_shared<ThreadConfig>();
+    config->index = idx;
+    Error err = factory_.Create(&config->backend);
+    if (!err.IsOk()) return err;
+    if (options_.shm_type != SharedMemoryType::NONE && !shm_ready_) {
+      err = InitSharedMemory(config->backend.get());
+      if (!err.IsOk()) return err;
+    }
+    auto share = std::make_shared<Share>();
+    thread_stats_.push_back(stat);
+    thread_configs_.push_back(config);
+    shares_.push_back(share);
+    threads_.emplace_back(&ConcurrencyManager::WorkerLoop, this, stat, config,
+                          share);
+  }
+  // distribute the concurrency over the fleet
+  for (size_t i = 0; i < shares_.size(); ++i) {
+    size_t share = 0;
+    if (i < n_threads) {
+      share = concurrency / n_threads + (i < concurrency % n_threads ? 1 : 0);
+    }
+    shares_[i]->target.store(share);
+  }
+  wake_cv_.notify_all();
+  return Error::Success();
+}
+
+void ConcurrencyManager::WorkerLoop(std::shared_ptr<ThreadStat> stat,
+                                    std::shared_ptr<ThreadConfig> config,
+                                    std::shared_ptr<Share> share) {
+  // Async completion accounting: callbacks decrement `ongoing` and record
+  // the end timestamp (reference callback latency capture,
+  // concurrency_manager.cc:182-219).
+  auto ongoing = std::make_shared<std::atomic<size_t>>(0);
+
+  while (!exit_.load()) {
+    size_t target = share->target.load();
+    if (target == 0) {
+      std::unique_lock<std::mutex> lk(wake_mutex_);
+      wake_cv_.wait_for(lk, std::chrono::milliseconds(50), [&]() {
+        return exit_.load() || share->target.load() > 0;
+      });
+      continue;
+    }
+
+    if (!options_.async) {
+      // sync: one blocking request per pass
+      InferContext* ctx;
+      if (config->ctxs.empty()) {
+        Error err = MakeContext(config.get(), &ctx);
+        if (!err.IsOk()) {
+          std::lock_guard<std::mutex> lk(stat->mu);
+          stat->status = err;
+          return;
+        }
+      } else {
+        ctx = config->ctxs[0].get();
+      }
+      Error err = PrepareRequest(ctx);
+      if (err.IsOk()) {
+        tpuclient::InferResult* result = nullptr;
+        uint64_t start = NowNs();
+        err = config->backend->Infer(&result, *ctx->options, ctx->inputs,
+                                     ctx->outputs);
+        uint64_t end = NowNs();
+        if (err.IsOk() && result != nullptr) {
+          err = result->RequestStatus();
+        }
+        delete result;
+        if (err.IsOk()) {
+          RecordRequest(stat.get(), start, end, ctx->options->sequence_end,
+                        false);
+        }
+      }
+      if (!err.IsOk()) {
+        std::lock_guard<std::mutex> lk(stat->mu);
+        stat->status = err;
+        return;
+      }
+      continue;
+    }
+
+    // async: top up in-flight requests to the target share
+    while (ongoing->load() < target && !exit_.load()) {
+      // find or create a free context
+      InferContext* ctx = nullptr;
+      for (auto& c : config->ctxs) {
+        if (!c->inflight) {
+          ctx = c.get();
+          break;
+        }
+      }
+      if (ctx == nullptr) {
+        Error err = MakeContext(config.get(), &ctx);
+        if (!err.IsOk()) {
+          std::lock_guard<std::mutex> lk(stat->mu);
+          stat->status = err;
+          return;
+        }
+      }
+      Error err = PrepareRequest(ctx);
+      if (!err.IsOk()) {
+        std::lock_guard<std::mutex> lk(stat->mu);
+        stat->status = err;
+        return;
+      }
+      ctx->inflight = true;
+      ctx->start_ns = NowNs();
+      bool seq_end = ctx->options->sequence_end;
+      ThreadStat* stat_ptr = stat.get();
+      // count before dispatch: the callback may fire (and decrement) before
+      // AsyncInfer returns
+      ongoing->fetch_add(1);
+      err = config->backend->AsyncInfer(
+          [this, ctx, ongoing, stat_ptr, seq_end](
+              tpuclient::InferResult* result) {
+            uint64_t end = NowNs();
+            Error status =
+                result != nullptr ? result->RequestStatus() : Error("null");
+            delete result;
+            if (status.IsOk()) {
+              RecordRequest(stat_ptr, ctx->start_ns, end, seq_end, false);
+            } else {
+              std::lock_guard<std::mutex> lk(stat_ptr->mu);
+              stat_ptr->status = status;
+            }
+            ctx->inflight = false;
+            ongoing->fetch_sub(1);
+            wake_cv_.notify_all();
+          },
+          *ctx->options, ctx->inputs, ctx->outputs);
+      if (!err.IsOk()) {
+        ctx->inflight = false;
+        ongoing->fetch_sub(1);
+        std::lock_guard<std::mutex> lk(stat->mu);
+        stat->status = err;
+        return;
+      }
+    }
+    // wait for a completion or a concurrency change
+    std::unique_lock<std::mutex> lk(wake_mutex_);
+    wake_cv_.wait_for(lk, std::chrono::milliseconds(50), [&]() {
+      return exit_.load() || ongoing->load() < share->target.load();
+    });
+  }
+  // drain in-flight requests before the backend is destroyed
+  while (ongoing->load() > 0) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+}
+
+}  // namespace tpuperf

@@ -1,0 +1,52 @@
+// Closed-loop load: maintain N concurrent outstanding requests.
+//
+// Counterpart of the reference's concurrency_manager.{h,cc}
+// (/root/reference/src/c++/perf_analyzer/concurrency_manager.cc:90-425):
+// worker threads each own a context pool; sync mode blocks one request per
+// thread, async mode keeps (concurrency / threads) requests in flight per
+// thread with completion callbacks capturing end timestamps. Sequence models
+// pin one live sequence per context.
+#pragma once
+
+#include "load_manager.h"
+
+namespace tpuperf {
+
+class ConcurrencyManager : public LoadManager {
+ public:
+  static tpuclient::Error Create(const LoadOptions& options,
+                                 const ClientBackendFactory& factory,
+                                 std::shared_ptr<ModelParser> parser,
+                                 std::shared_ptr<DataLoader> data_loader,
+                                 std::unique_ptr<ConcurrencyManager>* manager);
+  ~ConcurrencyManager() override;
+
+  // Reconfigures the worker fleet to hold `concurrency` requests in flight
+  // (reference ChangeConcurrencyLevel, concurrency_manager.cc:90-146).
+  tpuclient::Error ChangeConcurrencyLevel(size_t concurrency);
+
+ private:
+  ConcurrencyManager(const LoadOptions& options,
+                     const ClientBackendFactory& factory,
+                     std::shared_ptr<ModelParser> parser,
+                     std::shared_ptr<DataLoader> data_loader)
+      : LoadManager(options, factory, std::move(parser),
+                    std::move(data_loader)) {}
+
+  // per-thread target concurrency, adjusted by ChangeConcurrencyLevel
+  struct Share {
+    std::atomic<size_t> target{0};
+  };
+
+  // each worker holds its own shared_ptr to its Share: shares_ may grow
+  // (push_back) while workers run, so workers never index the vector
+  void WorkerLoop(std::shared_ptr<ThreadStat> stat,
+                  std::shared_ptr<ThreadConfig> config,
+                  std::shared_ptr<Share> share);
+
+  std::vector<std::shared_ptr<Share>> shares_;
+  std::mutex wake_mutex_;
+  std::condition_variable wake_cv_;
+};
+
+}  // namespace tpuperf

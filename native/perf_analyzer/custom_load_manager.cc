@@ -1,0 +1,86 @@
+#include "custom_load_manager.h"
+
+#include <fstream>
+#include <stdexcept>
+
+using tpuclient::Error;
+
+namespace tpuperf {
+
+Error CustomLoadManager::Create(
+    const LoadOptions& options, const std::string& intervals_file,
+    const ClientBackendFactory& factory, std::shared_ptr<ModelParser> parser,
+    std::shared_ptr<DataLoader> data_loader,
+    std::unique_ptr<CustomLoadManager>* manager) {
+  auto m = std::unique_ptr<CustomLoadManager>(new CustomLoadManager(
+      options, intervals_file, factory, std::move(parser),
+      std::move(data_loader)));
+  Error err = m->InitManager();
+  if (!err.IsOk()) return err;
+  err = m->InitCustomIntervals();
+  if (!err.IsOk()) return err;
+  *manager = std::move(m);
+  return Error::Success();
+}
+
+Error CustomLoadManager::InitCustomIntervals() {
+  std::ifstream f(intervals_file_);
+  if (!f.good())
+    return Error("cannot open intervals file '" + intervals_file_ + "'", 400);
+  intervals_ns_.clear();
+  std::string line;
+  size_t line_no = 0;
+  while (std::getline(f, line)) {
+    ++line_no;
+    if (line.find_first_not_of(" \t\r") == std::string::npos) continue;
+    try {
+      size_t used = 0;
+      uint64_t v = std::stoull(line, &used);
+      if (line.find_first_not_of(" \t\r", used) != std::string::npos) {
+        throw std::invalid_argument("trailing characters");
+      }
+      intervals_ns_.push_back(v);
+    } catch (const std::exception&) {
+      return Error("intervals file '" + intervals_file_ + "' line " +
+                       std::to_string(line_no) + " is not a nanosecond "
+                       "integer: '" + line + "'",
+                   400);
+    }
+  }
+  if (intervals_ns_.empty())
+    return Error("intervals file '" + intervals_file_ + "' is empty", 400);
+  return Error::Success();
+}
+
+Error CustomLoadManager::GetCustomRequestRate(double* request_rate) {
+  if (intervals_ns_.empty()) return Error("no intervals loaded", 400);
+  uint64_t total = 0;
+  for (uint64_t v : intervals_ns_) total += v;
+  if (total == 0) return Error("intervals sum to zero", 400);
+  *request_rate =
+      static_cast<double>(intervals_ns_.size()) * 1e9 / total;
+  return Error::Success();
+}
+
+Error CustomLoadManager::GenerateSchedule(double /*request_rate*/) {
+  auto schedule = std::make_shared<std::vector<uint64_t>>();
+  uint64_t t = 0;
+  for (uint64_t gap : intervals_ns_) {
+    t += gap;
+    schedule->push_back(t);
+  }
+  std::lock_guard<std::mutex> lk(wake_mutex_);
+  schedule_ = std::move(schedule);
+  return Error::Success();
+}
+
+Error CustomLoadManager::Start() {
+  // the implied average rate sizes the worker fleet; the schedule itself
+  // comes verbatim from the file
+  double rate = 1.0;
+  Error err = GetCustomRequestRate(&rate);
+  if (!err.IsOk()) return err;
+  return ChangeRequestRate(rate);
+}
+
+}  // namespace tpuperf

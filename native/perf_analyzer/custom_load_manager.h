@@ -1,0 +1,45 @@
+// Replays a user-supplied file of inter-request intervals.
+//
+// Counterpart of the reference's custom_load_manager.{h,cc}
+// (/root/reference/src/c++/perf_analyzer/custom_load_manager.cc:82-103):
+// reads nanosecond intervals (one per line), builds the schedule from them
+// instead of a statistical distribution, and reuses the RequestRateManager
+// worker machinery.
+#pragma once
+
+#include "request_rate_manager.h"
+
+namespace tpuperf {
+
+class CustomLoadManager : public RequestRateManager {
+ public:
+  static tpuclient::Error Create(const LoadOptions& options,
+                                 const std::string& intervals_file,
+                                 const ClientBackendFactory& factory,
+                                 std::shared_ptr<ModelParser> parser,
+                                 std::shared_ptr<DataLoader> data_loader,
+                                 std::unique_ptr<CustomLoadManager>* manager);
+
+  tpuclient::Error InitCustomIntervals();
+  // Average rate implied by the interval file (drives the profiler's
+  // reporting; reference GetCustomRequestRate).
+  tpuclient::Error GetCustomRequestRate(double* request_rate);
+  tpuclient::Error Start();
+
+ private:
+  CustomLoadManager(const LoadOptions& options,
+                    const std::string& intervals_file,
+                    const ClientBackendFactory& factory,
+                    std::shared_ptr<ModelParser> parser,
+                    std::shared_ptr<DataLoader> data_loader)
+      : RequestRateManager(options, Distribution::CUSTOM, factory,
+                           std::move(parser), std::move(data_loader)),
+        intervals_file_(intervals_file) {}
+
+  tpuclient::Error GenerateSchedule(double request_rate) override;
+
+  std::string intervals_file_;
+  std::vector<uint64_t> intervals_ns_;
+};
+
+}  // namespace tpuperf

@@ -1,0 +1,293 @@
+#include "data_loader.h"
+
+#include <fstream>
+#include <random>
+#include <sstream>
+
+#include "tpuclient/common.h"
+
+using tpuclient::Error;
+using tpuclient::Json;
+using tpuclient::JsonPtr;
+
+namespace tpuperf {
+
+static Error ResolveShape(const ModelTensor& tensor,
+                          const DataLoader::Options& opts,
+                          std::vector<int64_t>* shape) {
+  auto it = opts.shapes.find(tensor.name);
+  if (it != opts.shapes.end()) {
+    *shape = it->second;
+    return Error::Success();
+  }
+  *shape = tensor.shape;
+  for (int64_t& d : *shape) {
+    if (d < 0) {
+      return Error("input '" + tensor.name +
+                       "' has dynamic shape; use --shape to fix it",
+                   400);
+    }
+  }
+  return Error::Success();
+}
+
+Error DataLoader::MakeTensor(const ModelTensor& tensor, const Options& opts,
+                             uint64_t salt, TensorData* out) {
+  Error err = ResolveShape(tensor, opts, &out->shape);
+  if (!err.IsOk()) return err;
+  int64_t elements = tpuclient::ElementCount(out->shape);
+
+  if (tensor.datatype == "BYTES") {
+    std::vector<std::string> strings;
+    strings.reserve(elements);
+    std::mt19937_64 gen(opts.seed + salt);
+    std::uniform_int_distribution<int> ch('a', 'z');
+    for (int64_t i = 0; i < elements; ++i) {
+      if (!opts.string_data.empty()) {
+        strings.push_back(opts.string_data);
+      } else if (opts.zero_data) {
+        strings.emplace_back(opts.string_length, '0');
+      } else {
+        std::string s(opts.string_length, 'x');
+        for (auto& c : s) c = static_cast<char>(ch(gen));
+        strings.push_back(std::move(s));
+      }
+    }
+    tpuclient::SerializeStringTensor(strings, &out->bytes);
+    return Error::Success();
+  }
+
+  size_t elem_size = tpuclient::DtypeByteSize(tensor.datatype);
+  if (elem_size == 0) {
+    return Error("unsupported datatype '" + tensor.datatype + "' for input '" +
+                     tensor.name + "'",
+                 400);
+  }
+  out->bytes.assign(elements * elem_size, '\0');
+  if (!opts.zero_data) {
+    // Random bytes are fine for every dtype except floats, where random bit
+    // patterns can be NaN/inf; fill those from a bounded real distribution.
+    std::mt19937_64 gen(opts.seed + salt);
+    if (tensor.datatype == "FP32") {
+      std::uniform_real_distribution<float> d(0.0f, 1.0f);
+      auto* p = reinterpret_cast<float*>(&out->bytes[0]);
+      for (int64_t i = 0; i < elements; ++i) p[i] = d(gen);
+    } else if (tensor.datatype == "FP64") {
+      std::uniform_real_distribution<double> d(0.0, 1.0);
+      auto* p = reinterpret_cast<double*>(&out->bytes[0]);
+      for (int64_t i = 0; i < elements; ++i) p[i] = d(gen);
+    } else if (tensor.datatype == "FP16" || tensor.datatype == "BF16") {
+      // positive small half/bfloat patterns: zero exponent-high bits kept
+      std::uniform_int_distribution<uint16_t> d(0, 0x3BFF);
+      auto* p = reinterpret_cast<uint16_t*>(&out->bytes[0]);
+      for (int64_t i = 0; i < elements; ++i) p[i] = d(gen);
+    } else {
+      std::uniform_int_distribution<int> d(0, 127);
+      for (auto& c : out->bytes) c = static_cast<char>(d(gen));
+    }
+  }
+  return Error::Success();
+}
+
+Error DataLoader::GenerateData(const ModelParser& parser,
+                               const Options& opts) {
+  data_.clear();
+  data_.emplace_back();
+  data_[0].emplace_back();
+  uint64_t salt = 0;
+  for (const auto& kv : parser.Inputs()) {
+    TensorData td;
+    Error err = MakeTensor(kv.second, opts, salt++, &td);
+    if (!err.IsOk()) return err;
+    data_[0][0][kv.first] = std::move(td);
+  }
+  return Error::Success();
+}
+
+// One JSON step object {input_name: value} -> wire tensors. Value forms:
+// flat array, nested array (shape inferred), {"content": [...],
+// "shape": [...]}, or {"b64": "..."} is NOT supported (reference supports
+// b64; tracked as a gap).
+static Error ParseStep(const ModelParser& parser, const JsonPtr& step_obj,
+                       const DataLoader::Options& opts,
+                       std::map<std::string, std::string>* raw,
+                       std::map<std::string, std::vector<int64_t>>* shapes);
+
+static void FlattenJsonArray(const JsonPtr& v, std::vector<JsonPtr>* out,
+                             std::vector<int64_t>* shape, int depth) {
+  if (v->IsArray()) {
+    if (static_cast<int>(shape->size()) <= depth)
+      shape->push_back(static_cast<int64_t>(v->Size()));
+    for (size_t i = 0; i < v->Size(); ++i)
+      FlattenJsonArray(v->At(i), out, shape, depth + 1);
+  } else {
+    out->push_back(v);
+  }
+}
+
+static Error EncodeScalars(const ModelTensor& tensor,
+                           const std::vector<JsonPtr>& scalars,
+                           std::string* bytes) {
+  if (tensor.datatype == "BYTES") {
+    std::vector<std::string> strings;
+    strings.reserve(scalars.size());
+    for (const auto& s : scalars) {
+      if (!s->IsString())
+        return Error("BYTES input '" + tensor.name + "' needs strings", 400);
+      strings.push_back(s->AsString());
+    }
+    tpuclient::SerializeStringTensor(strings, bytes);
+    return Error::Success();
+  }
+  size_t elem_size = tpuclient::DtypeByteSize(tensor.datatype);
+  bytes->assign(scalars.size() * elem_size, '\0');
+  for (size_t i = 0; i < scalars.size(); ++i) {
+    char* dst = &(*bytes)[i * elem_size];
+    const std::string& dt = tensor.datatype;
+    if (dt == "FP32") {
+      float v = static_cast<float>(scalars[i]->AsDouble());
+      memcpy(dst, &v, 4);
+    } else if (dt == "FP64") {
+      double v = scalars[i]->AsDouble();
+      memcpy(dst, &v, 8);
+    } else if (dt == "INT64") {
+      int64_t v = scalars[i]->AsInt();
+      memcpy(dst, &v, 8);
+    } else if (dt == "UINT64") {
+      uint64_t v = scalars[i]->AsUint();
+      memcpy(dst, &v, 8);
+    } else if (dt == "INT32") {
+      int32_t v = static_cast<int32_t>(scalars[i]->AsInt());
+      memcpy(dst, &v, 4);
+    } else if (dt == "UINT32") {
+      uint32_t v = static_cast<uint32_t>(scalars[i]->AsUint());
+      memcpy(dst, &v, 4);
+    } else if (dt == "INT16") {
+      int16_t v = static_cast<int16_t>(scalars[i]->AsInt());
+      memcpy(dst, &v, 2);
+    } else if (dt == "UINT16") {
+      uint16_t v = static_cast<uint16_t>(scalars[i]->AsUint());
+      memcpy(dst, &v, 2);
+    } else if (dt == "INT8") {
+      *dst = static_cast<char>(scalars[i]->AsInt());
+    } else if (dt == "UINT8") {
+      *reinterpret_cast<uint8_t*>(dst) =
+          static_cast<uint8_t>(scalars[i]->AsUint());
+    } else if (dt == "BOOL") {
+      *dst = scalars[i]->AsBool() ? 1 : 0;
+    } else {
+      return Error("unsupported datatype '" + dt + "' in JSON data", 400);
+    }
+  }
+  return Error::Success();
+}
+
+static Error ParseStep(const ModelParser& parser, const JsonPtr& step_obj,
+                       const DataLoader::Options& opts,
+                       std::map<std::string, std::string>* raw,
+                       std::map<std::string, std::vector<int64_t>>* shapes) {
+  if (!step_obj->IsObject()) return Error("data step must be an object", 400);
+  for (const auto& member : step_obj->Members()) {
+    const std::string& name = member.first;
+    auto it = parser.Inputs().find(name);
+    if (it == parser.Inputs().end())
+      return Error("data file names unknown input '" + name + "'", 400);
+    const ModelTensor& tensor = it->second;
+
+    JsonPtr value = member.second;
+    std::vector<int64_t> shape;
+    JsonPtr content = value;
+    if (value->IsObject()) {
+      JsonPtr sh = value->Get("shape");
+      if (sh && sh->IsArray()) {
+        for (size_t i = 0; i < sh->Size(); ++i)
+          shape.push_back(sh->At(i)->AsInt());
+      }
+      content = value->Get("content");
+      if (!content) return Error("data object missing 'content'", 400);
+    }
+    std::vector<JsonPtr> scalars;
+    std::vector<int64_t> inferred;
+    FlattenJsonArray(content, &scalars, &inferred, 0);
+    if (shape.empty()) {
+      // flat arrays take the declared (or overridden) model shape
+      if (inferred.size() <= 1) {
+        Error err = ResolveShape(tensor, opts, &shape);
+        if (!err.IsOk()) shape = {static_cast<int64_t>(scalars.size())};
+      } else {
+        shape = inferred;
+      }
+    }
+    int64_t want = tpuclient::ElementCount(shape);
+    if (want >= 0 && want != static_cast<int64_t>(scalars.size())) {
+      return Error("data for '" + name + "' has " +
+                       std::to_string(scalars.size()) +
+                       " elements, shape wants " + std::to_string(want),
+                   400);
+    }
+    std::string bytes;
+    Error err = EncodeScalars(tensor, scalars, &bytes);
+    if (!err.IsOk()) return err;
+    (*raw)[name] = std::move(bytes);
+    (*shapes)[name] = std::move(shape);
+  }
+  return Error::Success();
+}
+
+Error DataLoader::ReadDataFromJson(const ModelParser& parser,
+                                   const std::string& path,
+                                   const Options& opts) {
+  std::ifstream f(path);
+  if (!f.good()) return Error("cannot open data file '" + path + "'", 400);
+  std::stringstream ss;
+  ss << f.rdbuf();
+  JsonPtr root;
+  Error err = Json::Parse(ss.str(), &root);
+  if (!err.IsOk()) return err;
+  if (!root->IsObject() || !root->Has("data"))
+    return Error("data file must be {\"data\": [...]}", 400);
+  JsonPtr data = root->Get("data");
+  if (!data->IsArray() || data->Size() == 0)
+    return Error("'data' must be a non-empty array", 400);
+
+  data_.clear();
+  bool stream_major = data->At(0)->IsArray();
+  size_t n_streams = stream_major ? data->Size() : 1;
+  for (size_t s = 0; s < n_streams; ++s) {
+    data_.emplace_back();
+    JsonPtr steps = stream_major ? data->At(s) : data;
+    for (size_t st = 0; st < steps->Size(); ++st) {
+      std::map<std::string, std::string> raw;
+      std::map<std::string, std::vector<int64_t>> shapes;
+      err = ParseStep(parser, steps->At(st), opts, &raw, &shapes);
+      if (!err.IsOk()) return err;
+      data_[s].emplace_back();
+      for (auto& kv : raw) {
+        TensorData td;
+        td.bytes = std::move(kv.second);
+        td.shape = shapes[kv.first];
+        data_[s].back()[kv.first] = std::move(td);
+      }
+    }
+  }
+  return Error::Success();
+}
+
+Error DataLoader::GetInputData(const std::string& name, size_t stream,
+                               size_t step, const uint8_t** data,
+                               size_t* byte_size,
+                               std::vector<int64_t>* shape) const {
+  if (stream >= data_.size() || step >= data_[stream].size())
+    return Error("no data for stream " + std::to_string(stream) + " step " +
+                     std::to_string(step),
+                 400);
+  auto it = data_[stream][step].find(name);
+  if (it == data_[stream][step].end())
+    return Error("no data for input '" + name + "'", 400);
+  *data = reinterpret_cast<const uint8_t*>(it->second.bytes.data());
+  *byte_size = it->second.bytes.size();
+  if (shape != nullptr) *shape = it->second.shape;
+  return Error::Success();
+}
+
+}  // namespace tpuperf

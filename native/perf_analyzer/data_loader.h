@@ -1,0 +1,67 @@
+// Test-data supply for the perf harness.
+//
+// Counterpart of the reference's data_loader.{h,cc}
+// (/root/reference/src/c++/perf_analyzer/data_loader.h:40-107): synthetic
+// random/zero tensors, random or fixed strings for BYTES, and user-supplied
+// multi-stream JSON data ({"data": [stream][step]{input: ...}} or the flat
+// one-stream form). Data is materialized once into wire-format byte strings
+// and referenced zero-copy by every request the load managers build.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "model_parser.h"
+#include "tpuclient/error.h"
+
+namespace tpuperf {
+
+class DataLoader {
+ public:
+  struct Options {
+    bool zero_data = false;           // zeros instead of random
+    size_t string_length = 16;        // random BYTES element length
+    std::string string_data;          // fixed BYTES element (overrides random)
+    uint64_t seed = 2024;
+    // Shape overrides for dynamic dims: name -> concrete dims.
+    std::map<std::string, std::vector<int64_t>> shapes;
+  };
+
+  // Synthetic generation for every model input (reference GenerateData,
+  // data_loader.cc:133-200).
+  tpuclient::Error GenerateData(const ModelParser& parser,
+                                const Options& opts);
+
+  // Load {"data": ...} JSON. Accepts [ {input: value} ... ] (one stream,
+  // many steps) or [ [ {input: value} ... ] ... ] (stream-major).
+  tpuclient::Error ReadDataFromJson(const ModelParser& parser,
+                                    const std::string& path,
+                                    const Options& opts);
+
+  size_t StreamCount() const { return data_.size(); }
+  size_t StepCount(size_t stream) const {
+    return stream < data_.size() ? data_[stream].size() : 0;
+  }
+
+  // Wire-format bytes + concrete shape for one input at (stream, step).
+  tpuclient::Error GetInputData(const std::string& name, size_t stream,
+                                size_t step, const uint8_t** data,
+                                size_t* byte_size,
+                                std::vector<int64_t>* shape) const;
+
+ private:
+  struct TensorData {
+    std::string bytes;            // wire format (BYTES incl. length prefixes)
+    std::vector<int64_t> shape;
+  };
+  // data_[stream][step][input_name]
+  std::vector<std::vector<std::map<std::string, TensorData>>> data_;
+
+  tpuclient::Error MakeTensor(const ModelTensor& tensor, const Options& opts,
+                              uint64_t salt, TensorData* out);
+};
+
+}  // namespace tpuperf

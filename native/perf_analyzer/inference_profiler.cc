@@ -1,0 +1,338 @@
+#include "inference_profiler.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstdio>
+#include <thread>
+
+using tpuclient::Error;
+
+namespace tpuperf {
+
+InferenceProfiler::InferenceProfiler(
+    const Options& options, std::shared_ptr<ModelParser> parser,
+    std::unique_ptr<ClientBackend> stats_backend, LoadManager* manager)
+    : options_(options), parser_(std::move(parser)),
+      stats_backend_(std::move(stats_backend)), manager_(manager) {}
+
+Error InferenceProfiler::GetServerSideStats(
+    std::map<std::string, ModelStatistics>* stats) {
+  // pull the full snapshot so ensemble composing models come along
+  return stats_backend_->ModelInferenceStatistics(stats, "");
+}
+
+Error InferenceProfiler::Measure(PerfStatus* status) {
+  std::map<std::string, ModelStatistics> server_start, server_end;
+  tpuclient::InferStat client_start, client_end;
+
+  Error err = GetServerSideStats(&server_start);
+  bool have_server_stats = err.IsOk();
+  err = manager_->GetAccumulatedClientStat(&client_start);
+  if (!err.IsOk()) return err;
+  // drop records from before this window
+  TimestampVector discard;
+  manager_->SwapTimestamps(&discard);
+
+  uint64_t window_start = NowNs();
+  if (options_.measurement_mode == MeasurementMode::TIME_WINDOWS) {
+    // sleep 1.2x the window so in-flight tails complete (reference
+    // inference_profiler.cc:602); chunked so SIGINT drains promptly
+    uint64_t remaining_ms = options_.measurement_window_ms * 12 / 10;
+    while (remaining_ms > 0 && !EarlyExit().load()) {
+      uint64_t chunk = std::min<uint64_t>(remaining_ms, 100);
+      std::this_thread::sleep_for(std::chrono::milliseconds(chunk));
+      remaining_ms -= chunk;
+    }
+  } else {
+    while (manager_->CountCollectedRequests() <
+               options_.measurement_request_count &&
+           !EarlyExit().load()) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(20));
+      Error health = manager_->CheckHealth();
+      if (!health.IsOk()) return health;
+    }
+  }
+  uint64_t window_end = NowNs();
+
+  err = manager_->CheckHealth();
+  if (!err.IsOk()) return err;
+
+  if (have_server_stats) {
+    err = GetServerSideStats(&server_end);
+    if (!err.IsOk()) have_server_stats = false;
+  }
+  err = manager_->GetAccumulatedClientStat(&client_end);
+  if (!err.IsOk()) return err;
+  TimestampVector timestamps;
+  manager_->SwapTimestamps(&timestamps);
+
+  SummarizeClient(timestamps, client_start, client_end,
+                  window_end - window_start, &status->client_stats);
+  if (have_server_stats) {
+    SummarizeServer(server_start, server_end, &status->server_stats);
+  }
+  status->batch_size = manager_->BatchSize();
+
+  if (options_.percentile > 0) {
+    auto it = status->client_stats.percentile_latency_ns.find(
+        static_cast<size_t>(options_.percentile));
+    status->stabilizing_latency_ns =
+        it != status->client_stats.percentile_latency_ns.end()
+            ? it->second
+            : status->client_stats.avg_latency_ns;
+  } else {
+    status->stabilizing_latency_ns = status->client_stats.avg_latency_ns;
+  }
+  return Error::Success();
+}
+
+void InferenceProfiler::SummarizeClient(const TimestampVector& timestamps,
+                                        const tpuclient::InferStat& start_stat,
+                                        const tpuclient::InferStat& end_stat,
+                                        uint64_t duration_ns,
+                                        ClientSideStats* stats) {
+  *stats = ClientSideStats();
+  stats->duration_ns = duration_ns;
+  stats->request_count = timestamps.size();
+  if (timestamps.empty() || duration_ns == 0) return;
+
+  std::vector<uint64_t> latencies;
+  latencies.reserve(timestamps.size());
+  size_t sequence_ends = 0;
+  for (const auto& r : timestamps) {
+    latencies.push_back(r.end_ns - r.start_ns);
+    if (r.sequence_end) sequence_ends++;
+    if (r.delayed) stats->delayed_request_count++;
+  }
+  std::sort(latencies.begin(), latencies.end());
+
+  double seconds = duration_ns / 1e9;
+  stats->infer_per_sec = timestamps.size() / seconds;
+  stats->sequence_per_sec = sequence_ends / seconds;
+
+  uint64_t total = 0;
+  for (uint64_t l : latencies) total += l;
+  stats->avg_latency_ns = total / latencies.size();
+  double var = 0;
+  for (uint64_t l : latencies) {
+    double d = static_cast<double>(l) - stats->avg_latency_ns;
+    var += d * d;
+  }
+  stats->std_latency_ns = static_cast<uint64_t>(
+      std::sqrt(var / latencies.size()));
+  for (size_t p : {50, 90, 95, 99}) {
+    size_t idx = std::min(latencies.size() - 1,
+                          static_cast<size_t>(latencies.size() * p / 100));
+    stats->percentile_latency_ns[p] = latencies[idx];
+  }
+
+  uint64_t req_delta =
+      end_stat.completed_request_count - start_stat.completed_request_count;
+  if (req_delta > 0) {
+    stats->avg_send_time_ns =
+        (end_stat.cumulative_send_time_ns - start_stat.cumulative_send_time_ns) /
+        req_delta;
+    stats->avg_receive_time_ns = (end_stat.cumulative_receive_time_ns -
+                                  start_stat.cumulative_receive_time_ns) /
+                                 req_delta;
+  }
+}
+
+static ServerSideStats DiffStats(const ModelStatistics& a,
+                                 const ModelStatistics& b) {
+  ServerSideStats out;
+  out.inference_count = b.inference_count - a.inference_count;
+  out.execution_count = b.execution_count - a.execution_count;
+  out.success_count = b.success_count - a.success_count;
+  out.queue_time_ns = b.queue_time_ns - a.queue_time_ns;
+  out.compute_input_time_ns = b.compute_input_time_ns - a.compute_input_time_ns;
+  out.compute_infer_time_ns = b.compute_infer_time_ns - a.compute_infer_time_ns;
+  out.compute_output_time_ns =
+      b.compute_output_time_ns - a.compute_output_time_ns;
+  out.cumulative_request_time_ns =
+      b.cumulative_request_time_ns - a.cumulative_request_time_ns;
+  return out;
+}
+
+void InferenceProfiler::SummarizeServer(
+    const std::map<std::string, ModelStatistics>& start,
+    const std::map<std::string, ModelStatistics>& end, ServerSideStats* stats) {
+  *stats = ServerSideStats();
+  auto diff_model = [&](const std::string& name, ServerSideStats* out) {
+    auto it_end = end.find(name);
+    if (it_end == end.end()) return;
+    ModelStatistics zero;
+    auto it_start = start.find(name);
+    *out = DiffStats(it_start != start.end() ? it_start->second : zero,
+                     it_end->second);
+  };
+  diff_model(parser_->Name(), stats);
+  for (const auto& composing : parser_->ComposingModels()) {
+    ServerSideStats child;
+    diff_model(composing, &child);
+    stats->composing[composing] = child;
+  }
+}
+
+Error InferenceProfiler::ProfileOnce(PerfStatus* status,
+                                     bool* meets_threshold) {
+  *meets_threshold = true;
+  std::vector<PerfStatus> history;
+  for (size_t trial = 0; trial < options_.max_trials; ++trial) {
+    if (EarlyExit().load()) return Error::Success();
+    PerfStatus measurement = *status;
+    Error err = Measure(&measurement);
+    if (!err.IsOk()) return err;
+    if (measurement.client_stats.request_count == 0) continue;
+    history.push_back(measurement);
+    *status = measurement;
+
+    if (options_.verbose) {
+      fprintf(stderr, "  trial %zu: %.1f infer/sec, avg latency %.0f usec\n",
+              trial + 1, measurement.client_stats.infer_per_sec,
+              measurement.client_stats.avg_latency_ns / 1e3);
+    }
+
+    if (options_.latency_threshold_us > 0 &&
+        measurement.stabilizing_latency_ns >
+            options_.latency_threshold_us * 1000) {
+      *meets_threshold = false;
+      return Error::Success();
+    }
+    if (history.size() >= options_.stable_window) {
+      // stability: max deviation from the window mean within threshold on
+      // BOTH throughput and latency (reference inference_profiler.cc:503-547)
+      double ips_sum = 0, lat_sum = 0;
+      size_t n = options_.stable_window;
+      for (size_t i = history.size() - n; i < history.size(); ++i) {
+        ips_sum += history[i].client_stats.infer_per_sec;
+        lat_sum += static_cast<double>(history[i].stabilizing_latency_ns);
+      }
+      double ips_avg = ips_sum / n, lat_avg = lat_sum / n;
+      bool stable = true;
+      for (size_t i = history.size() - n; i < history.size(); ++i) {
+        if (std::abs(history[i].client_stats.infer_per_sec - ips_avg) >
+            options_.stability_threshold * ips_avg)
+          stable = false;
+        if (std::abs(static_cast<double>(history[i].stabilizing_latency_ns) -
+                     lat_avg) > options_.stability_threshold * lat_avg)
+          stable = false;
+      }
+      if (stable) return Error::Success();
+    }
+  }
+  // not stable within max_trials: keep the last measurement, warn
+  fprintf(stderr,
+          "warning: measurement did not stabilize within %zu trials\n",
+          options_.max_trials);
+  return Error::Success();
+}
+
+Error InferenceProfiler::ProfileConcurrency(size_t start, size_t end,
+                                            size_t step, bool binary_search,
+                                            std::vector<PerfStatus>* results) {
+  auto* manager = dynamic_cast<ConcurrencyManager*>(manager_);
+  if (manager == nullptr)
+    return Error("concurrency profiling needs a ConcurrencyManager", 400);
+
+  auto run_one = [&](size_t concurrency, PerfStatus* status,
+                     bool* meets) -> Error {
+    Error err = manager->ChangeConcurrencyLevel(concurrency);
+    if (!err.IsOk()) return err;
+    status->concurrency = concurrency;
+    status->on_sequence_model =
+        parser_->Scheduler() == ModelParser::SchedulerType::SEQUENCE ||
+        parser_->Scheduler() == ModelParser::SchedulerType::ENSEMBLE_SEQUENCE;
+    return ProfileOnce(status, meets);
+  };
+
+  if (!binary_search) {
+    for (size_t c = start; c <= end; c += step) {
+      PerfStatus status;
+      bool meets = true;
+      Error err = run_one(c, &status, &meets);
+      if (!err.IsOk()) return err;
+      results->push_back(status);
+      if (!meets || EarlyExit().load()) break;
+    }
+    return Error::Success();
+  }
+
+  // binary search for the highest concurrency under the latency threshold
+  size_t lo = start, hi = end;
+  while (lo <= hi) {
+    size_t mid = lo + (hi - lo) / 2;
+    PerfStatus status;
+    bool meets = true;
+    Error err = run_one(mid, &status, &meets);
+    if (!err.IsOk()) return err;
+    results->push_back(status);
+    if (EarlyExit().load()) break;
+    if (meets) {
+      if (mid == hi) break;
+      lo = mid + 1;
+    } else {
+      if (mid == lo) break;
+      hi = mid - 1;
+    }
+  }
+  return Error::Success();
+}
+
+Error InferenceProfiler::ProfileRate(double start, double end, double step,
+                                     bool binary_search,
+                                     std::vector<PerfStatus>* results) {
+  auto* manager = dynamic_cast<RequestRateManager*>(manager_);
+  if (manager == nullptr)
+    return Error("rate profiling needs a RequestRateManager", 400);
+
+  auto run_one = [&](double rate, PerfStatus* status, bool* meets) -> Error {
+    Error err = manager->ChangeRequestRate(rate);
+    if (!err.IsOk()) return err;
+    status->request_rate = rate;
+    return ProfileOnce(status, meets);
+  };
+
+  if (!binary_search) {
+    for (double r = start; r <= end + 1e-9; r += step) {
+      PerfStatus status;
+      bool meets = true;
+      Error err = run_one(r, &status, &meets);
+      if (!err.IsOk()) return err;
+      results->push_back(status);
+      if (!meets || EarlyExit().load()) break;
+    }
+    return Error::Success();
+  }
+
+  double lo = start, hi = end;
+  while (hi - lo > step / 2) {
+    double mid = (lo + hi) / 2;
+    PerfStatus status;
+    bool meets = true;
+    Error err = run_one(mid, &status, &meets);
+    if (!err.IsOk()) return err;
+    results->push_back(status);
+    if (EarlyExit().load()) break;
+    if (meets) lo = mid;
+    else hi = mid;
+  }
+  return Error::Success();
+}
+
+Error InferenceProfiler::ProfileCustom(std::vector<PerfStatus>* results) {
+  auto* manager = dynamic_cast<CustomLoadManager*>(manager_);
+  if (manager == nullptr)
+    return Error("custom profiling needs a CustomLoadManager", 400);
+  Error err = manager->Start();
+  if (!err.IsOk()) return err;
+  PerfStatus status;
+  manager->GetCustomRequestRate(&status.request_rate);
+  bool meets = true;
+  err = ProfileOnce(&status, &meets);
+  if (!err.IsOk()) return err;
+  results->push_back(status);
+  return Error::Success();
+}
+
+}  // namespace tpuperf

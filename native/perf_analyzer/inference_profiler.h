@@ -1,0 +1,117 @@
+// Search driver + measurement statistics.
+//
+// Counterpart of the reference's inference_profiler.{h,cc}
+// (/root/reference/src/c++/perf_analyzer/inference_profiler.h:71-238,
+// .cc:441-960): sweeps concurrency or request rate (linear or binary
+// search), takes measurements over time- or count-windows, detects
+// stability over a 3-window history (±threshold on both throughput and
+// latency), and merges client-side timestamps with server-side stat deltas
+// (queue / compute phases, ensemble composing-model rollup).
+#pragma once
+
+#include <map>
+#include <memory>
+#include <vector>
+
+#include "concurrency_manager.h"
+#include "custom_load_manager.h"
+#include "request_rate_manager.h"
+
+namespace tpuperf {
+
+struct ServerSideStats {
+  uint64_t inference_count = 0;
+  uint64_t execution_count = 0;
+  uint64_t success_count = 0;
+  uint64_t queue_time_ns = 0;
+  uint64_t compute_input_time_ns = 0;
+  uint64_t compute_infer_time_ns = 0;
+  uint64_t compute_output_time_ns = 0;
+  uint64_t cumulative_request_time_ns = 0;
+  // ensemble composing-model breakdown (reference ServerSideStats map,
+  // inference_profiler.h:71-82)
+  std::map<std::string, ServerSideStats> composing;
+};
+
+struct ClientSideStats {
+  uint64_t request_count = 0;
+  double infer_per_sec = 0;
+  double sequence_per_sec = 0;
+  uint64_t avg_latency_ns = 0;
+  uint64_t std_latency_ns = 0;
+  std::map<size_t, uint64_t> percentile_latency_ns;  // 50/90/95/99
+  uint64_t avg_send_time_ns = 0;
+  uint64_t avg_receive_time_ns = 0;
+  size_t delayed_request_count = 0;
+  uint64_t duration_ns = 0;
+};
+
+struct PerfStatus {
+  size_t concurrency = 0;
+  double request_rate = 0;
+  ClientSideStats client_stats;
+  ServerSideStats server_stats;
+  size_t batch_size = 1;
+  bool on_sequence_model = false;
+  // latency used for stability/threshold decisions (avg or percentile)
+  uint64_t stabilizing_latency_ns = 0;
+};
+
+class InferenceProfiler {
+ public:
+  struct Options {
+    double stability_threshold = 0.1;    // ±10%
+    uint64_t measurement_window_ms = 5000;
+    MeasurementMode measurement_mode = MeasurementMode::TIME_WINDOWS;
+    uint64_t measurement_request_count = 50;
+    size_t max_trials = 10;
+    uint64_t latency_threshold_us = 0;   // 0 = no limit
+    size_t stable_window = 3;
+    int64_t percentile = -1;             // -1 = use average latency
+    bool verbose = false;
+  };
+
+  InferenceProfiler(const Options& options,
+                    std::shared_ptr<ModelParser> parser,
+                    std::unique_ptr<ClientBackend> stats_backend,
+                    LoadManager* manager);
+
+  // Concurrency sweep (manager must be a ConcurrencyManager).
+  tpuclient::Error ProfileConcurrency(size_t start, size_t end, size_t step,
+                                      bool binary_search,
+                                      std::vector<PerfStatus>* results);
+  // Request-rate sweep (manager must be a RequestRateManager).
+  tpuclient::Error ProfileRate(double start, double end, double step,
+                               bool binary_search,
+                               std::vector<PerfStatus>* results);
+  // Custom intervals: single measurement at the file-implied rate.
+  tpuclient::Error ProfileCustom(std::vector<PerfStatus>* results);
+
+ private:
+  // Measure until stable or max_trials (reference ProfileHelper,
+  // inference_profiler.cc:441-566). `meets_threshold` false when the
+  // latency limit was exceeded (search should stop descending/ascending).
+  tpuclient::Error ProfileOnce(PerfStatus* status, bool* meets_threshold);
+
+  // One measurement window (reference Measure, inference_profiler.cc:
+  // 584-636): server stat delta + client stat delta + timestamp swap.
+  tpuclient::Error Measure(PerfStatus* status);
+
+  tpuclient::Error GetServerSideStats(
+      std::map<std::string, ModelStatistics>* stats);
+
+  void SummarizeClient(const TimestampVector& timestamps,
+                       const tpuclient::InferStat& start_stat,
+                       const tpuclient::InferStat& end_stat,
+                       uint64_t duration_ns, ClientSideStats* stats);
+  void SummarizeServer(const std::map<std::string, ModelStatistics>& start,
+                       const std::map<std::string, ModelStatistics>& end,
+                       ServerSideStats* stats);
+
+  Options options_;
+  std::shared_ptr<ModelParser> parser_;
+  std::unique_ptr<ClientBackend> stats_backend_;
+  LoadManager* manager_;
+};
+
+}  // namespace tpuperf

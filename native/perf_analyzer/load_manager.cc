@@ -1,0 +1,275 @@
+#include "load_manager.h"
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstring>
+
+#include "tpuclient/shm_utils.h"
+
+using tpuclient::Error;
+using tpuclient::InferInput;
+using tpuclient::InferOptions;
+using tpuclient::InferRequestedOutput;
+
+namespace tpuperf {
+
+LoadManager::LoadManager(const LoadOptions& options,
+                         ClientBackendFactory factory,
+                         std::shared_ptr<ModelParser> parser,
+                         std::shared_ptr<DataLoader> data_loader)
+    : options_(options), factory_(std::move(factory)),
+      parser_(std::move(parser)), data_loader_(std::move(data_loader)) {
+  is_sequence_ =
+      parser_->Scheduler() == ModelParser::SchedulerType::SEQUENCE ||
+      parser_->Scheduler() == ModelParser::SchedulerType::ENSEMBLE_SEQUENCE;
+  next_seq_id_ = options_.start_sequence_id;
+}
+
+LoadManager::~LoadManager() {
+  StopWorkerThreads();
+  if (shm_ready_ && !thread_configs_.empty() &&
+      thread_configs_[0]->backend != nullptr) {
+    CleanupSharedMemory(thread_configs_[0]->backend.get());
+  }
+  for (auto& ctx_cfg : thread_configs_) {
+    for (auto& ctx : ctx_cfg->ctxs) {
+      for (auto* input : ctx->inputs) delete input;
+      for (const auto* output : ctx->outputs) delete output;
+    }
+  }
+}
+
+Error LoadManager::InitManager() {
+  if (options_.shm_type == SharedMemoryType::TPU) {
+    return Error(
+        "tpu shared memory staging requires device buffers on the client "
+        "host; use --shared-memory system for host staging (tpu-shm is "
+        "exercised via the Python tooling)",
+        400);
+  }
+  return Error::Success();
+}
+
+std::string LoadManager::ShmRegionName(const std::string& input, size_t stream,
+                                       size_t step) const {
+  return "perf_" + input + "_" + std::to_string(stream) + "_" +
+         std::to_string(step);
+}
+
+Error LoadManager::InitSharedMemory(ClientBackend* backend) {
+  // One region per input x stream x step holding the wire bytes, plus one
+  // region per output (reference load_manager.cc:256-446). Regions are
+  // registered with the server by /dev/shm key.
+  for (size_t stream = 0; stream < data_loader_->StreamCount(); ++stream) {
+    for (size_t step = 0; step < data_loader_->StepCount(stream); ++step) {
+      for (const auto& kv : parser_->Inputs()) {
+        const uint8_t* data = nullptr;
+        size_t byte_size = 0;
+        Error err = data_loader_->GetInputData(kv.first, stream, step, &data,
+                                               &byte_size, nullptr);
+        if (!err.IsOk()) return err;
+        // batch>1 repeats the step data per batched sample
+        size_t region_size = byte_size * options_.batch_size;
+
+        ShmRegion region;
+        region.name = ShmRegionName(kv.first, stream, step);
+        region.key = "/" + region.name;
+        region.byte_size = region_size;
+        err = tpuclient::CreateSharedMemoryRegion(region.key, region_size,
+                                                  &region.fd);
+        if (!err.IsOk()) return err;
+        err = tpuclient::MapSharedMemory(region.fd, 0, region_size,
+                                         &region.base);
+        if (!err.IsOk()) return err;
+        for (int32_t b = 0; b < options_.batch_size; ++b) {
+          memcpy(static_cast<uint8_t*>(region.base) + b * byte_size, data,
+                 byte_size);
+        }
+        err = backend->RegisterSystemSharedMemory(region.name, region.key,
+                                                  region_size);
+        if (!err.IsOk()) return err;
+        shm_regions_.push_back(region);
+      }
+    }
+  }
+  for (const auto& kv : parser_->Outputs()) {
+    ShmRegion region;
+    region.name = "perf_out_" + kv.first;
+    region.key = "/" + region.name;
+    region.byte_size = options_.output_shm_size;
+    Error err = tpuclient::CreateSharedMemoryRegion(
+        region.key, region.byte_size, &region.fd);
+    if (!err.IsOk()) return err;
+    err = tpuclient::MapSharedMemory(region.fd, 0, region.byte_size,
+                                     &region.base);
+    if (!err.IsOk()) return err;
+    err = backend->RegisterSystemSharedMemory(region.name, region.key,
+                                              region.byte_size);
+    if (!err.IsOk()) return err;
+    shm_regions_.push_back(region);
+  }
+  shm_ready_ = true;
+  return Error::Success();
+}
+
+void LoadManager::CleanupSharedMemory(ClientBackend* backend) {
+  for (auto& region : shm_regions_) {
+    backend->UnregisterSystemSharedMemory(region.name);
+    if (region.base != nullptr)
+      tpuclient::UnmapSharedMemory(region.base, region.byte_size);
+    if (region.fd >= 0) tpuclient::CloseSharedMemory(region.fd);
+    tpuclient::UnlinkSharedMemoryRegion(region.key);
+  }
+  shm_regions_.clear();
+  shm_ready_ = false;
+}
+
+Error LoadManager::MakeContext(ThreadConfig* config, InferContext** out) {
+  auto ctx = std::make_unique<InferContext>();
+  ctx->options = std::make_unique<InferOptions>(parser_->Name());
+  ctx->options->model_version = parser_->Version();
+  ctx->options->client_timeout_us = options_.request_timeout_us;
+  ctx->stream = config->index % std::max<size_t>(1, data_loader_->StreamCount());
+
+  bool batched = parser_->MaxBatchSize() > 0;
+  for (const auto& kv : parser_->Inputs()) {
+    const uint8_t* data = nullptr;
+    size_t byte_size = 0;
+    std::vector<int64_t> shape;
+    Error err = data_loader_->GetInputData(kv.first, ctx->stream, 0, &data,
+                                           &byte_size, &shape);
+    if (!err.IsOk()) return err;
+    std::vector<int64_t> full_shape;
+    if (batched) full_shape.push_back(options_.batch_size);
+    full_shape.insert(full_shape.end(), shape.begin(), shape.end());
+
+    InferInput* input = nullptr;
+    err = InferInput::Create(&input, kv.first, full_shape, kv.second.datatype);
+    if (!err.IsOk()) return err;
+    ctx->inputs.push_back(input);
+  }
+  for (const auto& kv : parser_->Outputs()) {
+    InferRequestedOutput* output = nullptr;
+    Error err = InferRequestedOutput::Create(&output, kv.first);
+    if (!err.IsOk()) return err;
+    if (options_.shm_type == SharedMemoryType::SYSTEM) {
+      output->SetSharedMemory("perf_out_" + kv.first,
+                              options_.output_shm_size);
+    }
+    ctx->outputs.push_back(output);
+  }
+  config->ctxs.push_back(std::move(ctx));
+  *out = config->ctxs.back().get();
+  return Error::Success();
+}
+
+Error LoadManager::PrepareRequest(InferContext* ctx) {
+  // sequence bookkeeping first: it picks the data step within the stream
+  if (is_sequence_) {
+    if (ctx->seq_remaining == 0) {
+      std::lock_guard<std::mutex> lk(seq_mutex_);
+      ctx->seq_id = next_seq_id_++;
+      // length jitter: 80%..120% of the nominal sequence length
+      uint64_t len = options_.sequence_length;
+      uint64_t lo = std::max<uint64_t>(1, len * 4 / 5);
+      uint64_t hi = std::max<uint64_t>(lo, len * 6 / 5);
+      ctx->seq_remaining = lo + seq_len_gen_() % (hi - lo + 1);
+      ctx->options->sequence_start = true;
+      ctx->step = 0;
+    } else {
+      ctx->options->sequence_start = false;
+    }
+    ctx->options->sequence_id = ctx->seq_id;
+    ctx->seq_remaining--;
+    ctx->options->sequence_end = (ctx->seq_remaining == 0);
+  }
+
+  size_t steps = data_loader_->StepCount(ctx->stream);
+  size_t step = steps > 0 ? ctx->step % steps : 0;
+
+  for (auto* input : ctx->inputs) {
+    if (options_.shm_type == SharedMemoryType::SYSTEM) {
+      const uint8_t* data = nullptr;
+      size_t byte_size = 0;
+      Error err = data_loader_->GetInputData(input->Name(), ctx->stream, step,
+                                             &data, &byte_size, nullptr);
+      if (!err.IsOk()) return err;
+      input->SetSharedMemory(ShmRegionName(input->Name(), ctx->stream, step),
+                             byte_size * options_.batch_size);
+      continue;
+    }
+    const uint8_t* data = nullptr;
+    size_t byte_size = 0;
+    Error err = data_loader_->GetInputData(input->Name(), ctx->stream, step,
+                                           &data, &byte_size, nullptr);
+    if (!err.IsOk()) return err;
+    input->Reset();
+    for (int32_t b = 0; b < options_.batch_size; ++b) {
+      err = input->AppendRaw(data, byte_size);
+      if (!err.IsOk()) return err;
+    }
+  }
+  ctx->step++;
+  return Error::Success();
+}
+
+void LoadManager::RecordRequest(ThreadStat* stat, uint64_t start_ns,
+                                uint64_t end_ns, bool sequence_end,
+                                bool delayed) {
+  std::lock_guard<std::mutex> lk(stat->mu);
+  stat->requests.push_back({start_ns, end_ns, sequence_end, delayed});
+}
+
+Error LoadManager::CheckHealth() {
+  for (auto& stat : thread_stats_) {
+    std::lock_guard<std::mutex> lk(stat->mu);
+    if (!stat->status.IsOk()) return stat->status;
+  }
+  return Error::Success();
+}
+
+Error LoadManager::SwapTimestamps(TimestampVector* out) {
+  out->clear();
+  for (auto& stat : thread_stats_) {
+    std::lock_guard<std::mutex> lk(stat->mu);
+    out->insert(out->end(), stat->requests.begin(), stat->requests.end());
+    stat->requests.clear();
+  }
+  return Error::Success();
+}
+
+size_t LoadManager::CountCollectedRequests() {
+  size_t n = 0;
+  for (auto& stat : thread_stats_) {
+    std::lock_guard<std::mutex> lk(stat->mu);
+    n += stat->requests.size();
+  }
+  return n;
+}
+
+Error LoadManager::GetAccumulatedClientStat(tpuclient::InferStat* stat) {
+  *stat = tpuclient::InferStat();
+  for (auto& config : thread_configs_) {
+    if (config->backend == nullptr) continue;
+    tpuclient::InferStat s;
+    Error err = config->backend->ClientInferStat(&s);
+    if (!err.IsOk()) return err;
+    stat->completed_request_count += s.completed_request_count;
+    stat->cumulative_total_request_time_ns +=
+        s.cumulative_total_request_time_ns;
+    stat->cumulative_send_time_ns += s.cumulative_send_time_ns;
+    stat->cumulative_receive_time_ns += s.cumulative_receive_time_ns;
+  }
+  return Error::Success();
+}
+
+void LoadManager::StopWorkerThreads() {
+  exit_.store(true);
+  for (auto& t : threads_) {
+    if (t.joinable()) t.join();
+  }
+  threads_.clear();
+}
+
+}  // namespace tpuperf

@@ -1,0 +1,132 @@
+// Base load-generation machinery.
+//
+// Counterpart of the reference's load_manager.{h,cc}
+// (/root/reference/src/c++/perf_analyzer/load_manager.h:73-248, load_manager
+// .cc:219-721): prepares request tensors from the DataLoader, optionally
+// stages them in registered shared-memory regions, tracks per-worker-thread
+// request timestamp vectors, and handles stateful-model sequence bookkeeping
+// (sequence_id / start / end flags, one live sequence per context —
+// concurrency_manager.cc:148-152).
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "client_backend.h"
+#include "data_loader.h"
+#include "model_parser.h"
+#include "perf_utils.h"
+
+namespace tpuperf {
+
+struct LoadOptions {
+  int32_t batch_size = 1;
+  bool async = false;
+  size_t max_threads = 16;
+  SharedMemoryType shm_type = SharedMemoryType::NONE;
+  size_t output_shm_size = 100 * 1024;
+  // sequence load (reference load_manager.cc:676-719)
+  uint64_t start_sequence_id = 1;
+  uint64_t sequence_length = 20;
+  uint64_t request_timeout_us = 0;
+};
+
+class LoadManager {
+ public:
+  virtual ~LoadManager();
+
+  // Worker liveness check (reference CheckHealth, load_manager.cc:131).
+  tpuclient::Error CheckHealth();
+
+  // Hands collected request records to the profiler and resets the
+  // accumulators (reference SwapTimestamps).
+  tpuclient::Error SwapTimestamps(TimestampVector* out);
+  size_t CountCollectedRequests();
+
+  // Sum of per-backend cumulative client stats (send/recv times).
+  tpuclient::Error GetAccumulatedClientStat(tpuclient::InferStat* stat);
+
+  int32_t BatchSize() const { return options_.batch_size; }
+
+ protected:
+  LoadManager(const LoadOptions& options, ClientBackendFactory factory,
+              std::shared_ptr<ModelParser> parser,
+              std::shared_ptr<DataLoader> data_loader);
+
+  // One worker thread's accumulators; guarded by its mutex.
+  struct ThreadStat {
+    std::mutex mu;
+    TimestampVector requests;
+    tpuclient::Error status;
+  };
+
+  // One outstanding-request slot: tensors + options, reused across requests
+  // (the reference reuses request objects for allocation hygiene, §5.9).
+  struct InferContext {
+    std::vector<tpuclient::InferInput*> inputs;
+    std::vector<const tpuclient::InferRequestedOutput*> outputs;
+    std::unique_ptr<tpuclient::InferOptions> options;
+    size_t stream = 0;
+    size_t step = 0;
+    // sequence state (valid when is_sequence_)
+    uint64_t seq_id = 0;
+    uint64_t seq_remaining = 0;
+    bool inflight = false;
+    uint64_t start_ns = 0;
+  };
+
+  struct ThreadConfig {
+    size_t index = 0;
+    size_t stride = 1;
+    std::unique_ptr<ClientBackend> backend;
+    std::vector<std::unique_ptr<InferContext>> ctxs;
+  };
+
+  // Registered shm staging for one input data chunk.
+  struct ShmRegion {
+    std::string name;
+    std::string key;
+    void* base = nullptr;
+    size_t byte_size = 0;
+    int fd = -1;
+  };
+
+  tpuclient::Error InitManager();
+  tpuclient::Error MakeContext(ThreadConfig* config, InferContext** out);
+  // Points ctx inputs at the (stream, step) data (or its shm region) and
+  // sets sequence options when the model is sequence-batched.
+  tpuclient::Error PrepareRequest(InferContext* ctx);
+  void RecordRequest(ThreadStat* stat, uint64_t start_ns, uint64_t end_ns,
+                     bool sequence_end, bool delayed);
+  void StopWorkerThreads();
+
+  // shm staging (reference InitSharedMemory, load_manager.cc:256-446)
+  tpuclient::Error InitSharedMemory(ClientBackend* backend);
+  void CleanupSharedMemory(ClientBackend* backend);
+  std::string ShmRegionName(const std::string& input, size_t stream,
+                            size_t step) const;
+
+  LoadOptions options_;
+  ClientBackendFactory factory_;
+  std::shared_ptr<ModelParser> parser_;
+  std::shared_ptr<DataLoader> data_loader_;
+  bool is_sequence_ = false;
+
+  std::vector<std::shared_ptr<ThreadStat>> thread_stats_;
+  std::vector<std::shared_ptr<ThreadConfig>> thread_configs_;
+  std::vector<std::thread> threads_;
+  std::atomic<bool> exit_{false};
+
+  std::mutex seq_mutex_;
+  uint64_t next_seq_id_ = 1;
+  std::mt19937_64 seq_len_gen_{77};
+
+  std::vector<ShmRegion> shm_regions_;
+  bool shm_ready_ = false;
+};
+
+}  // namespace tpuperf

@@ -1,0 +1,425 @@
+// tpu_perf_analyzer — load generator / latency profiler CLI.
+//
+// Counterpart of the reference's perf_analyzer main
+// (/root/reference/src/c++/perf_analyzer/main.cc:645-1668): option parsing,
+// manager/profiler wiring, human summary and CSV export. Backend kinds:
+// http (default, our native client), capi (in-process engine, when built).
+#include <getopt.h>
+#include <signal.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+
+#include "inference_profiler.h"
+
+using tpuclient::Error;
+using namespace tpuperf;
+
+namespace {
+
+void SignalHandler(int) { EarlyExit().store(true); }
+
+void Usage(const char* msg = nullptr) {
+  if (msg != nullptr) fprintf(stderr, "error: %s\n", msg);
+  fprintf(stderr, R"(Usage: tpu_perf_analyzer -m <model> [options]
+
+Options:
+  -m <name>              model name (required)
+  -x <version>           model version
+  -u <url>               server url (default localhost:8000)
+  -i <protocol>          protocol: http (default)
+  -b <n>                 batch size (default 1)
+  -a                     async mode
+  --concurrency-range <start:end:step>
+  --request-rate-range <start:end:step>
+  --request-distribution <poisson|constant> (default constant)
+  --request-intervals <file>   custom inter-request intervals (ns, one/line)
+  --binary-search        binary instead of linear search
+  -p <ms>                measurement window (default 5000)
+  --measurement-mode <time_windows|count_windows>
+  --measurement-request-count <n>   (count mode window, default 50)
+  -s <pct>               stability threshold percent (default 10)
+  -r <n>                 max trials per step (default 10)
+  -l <us>                latency threshold; search stops above it
+  --percentile <n>       use p<n> latency for stability (default: average)
+  --input-data <zero|random|path.json>  (default random)
+  --shape <name:d1,d2,...>    concrete shape for dynamic input dims
+  --string-length <n>    BYTES element length (default 16)
+  --string-data <s>      fixed BYTES element value
+  --sequence-length <n>  requests per sequence (default 20)
+  --start-sequence-id <n>
+  --shared-memory <none|system>   tensor transport (default none)
+  --output-shared-memory-size <bytes>
+  --max-threads <n>      worker thread cap (default 16)
+  -f <path>              export CSV
+  -v                     verbose
+)");
+  exit(msg != nullptr ? 1 : 0);
+}
+
+struct Args {
+  std::string model;
+  std::string version;
+  std::string url = "localhost:8000";
+  std::string protocol = "http";
+  int batch_size = 1;
+  bool async = false;
+  bool has_concurrency = false;
+  size_t conc_start = 1, conc_end = 1, conc_step = 1;
+  bool has_rate = false;
+  double rate_start = 0, rate_end = 0, rate_step = 1;
+  std::string intervals_file;
+  bool binary_search = false;
+  uint64_t window_ms = 5000;
+  MeasurementMode mode = MeasurementMode::TIME_WINDOWS;
+  uint64_t request_count = 50;
+  double stability_pct = 10.0;
+  size_t max_trials = 10;
+  uint64_t latency_threshold_us = 0;
+  int64_t percentile = -1;
+  std::string input_data = "random";
+  DataLoader::Options data_opts;
+  uint64_t sequence_length = 20;
+  uint64_t start_sequence_id = 1;
+  SharedMemoryType shm = SharedMemoryType::NONE;
+  size_t output_shm_size = 100 * 1024;
+  size_t max_threads = 16;
+  std::string csv_path;
+  bool verbose = false;
+  bool poisson = false;
+};
+
+bool ParseRange(const char* s, double* a, double* b, double* c) {
+  return sscanf(s, "%lf:%lf:%lf", a, b, c) >= 2;
+}
+
+void PrintServerStats(const char* indent, const ServerSideStats& s) {
+  uint64_t n = std::max<uint64_t>(1, s.success_count);
+  printf("%sInference count: %lu\n", indent,
+         static_cast<unsigned long>(s.inference_count));
+  printf("%sExecution count: %lu\n", indent,
+         static_cast<unsigned long>(s.execution_count));
+  printf("%sAvg queue: %.0f usec, compute input: %.0f usec, "
+         "compute infer: %.0f usec, compute output: %.0f usec\n",
+         indent, s.queue_time_ns / 1e3 / n, s.compute_input_time_ns / 1e3 / n,
+         s.compute_infer_time_ns / 1e3 / n,
+         s.compute_output_time_ns / 1e3 / n);
+}
+
+void PrintStatus(const PerfStatus& st) {
+  if (st.concurrency > 0)
+    printf("Concurrency: %zu\n", st.concurrency);
+  else
+    printf("Request rate: %.1f infer/sec\n", st.request_rate);
+  const auto& c = st.client_stats;
+  printf("  Client:\n");
+  printf("    Request count: %lu\n", static_cast<unsigned long>(c.request_count));
+  printf("    Throughput: %.1f infer/sec\n", c.infer_per_sec);
+  if (st.on_sequence_model)
+    printf("    Sequence throughput: %.1f seq/sec\n", c.sequence_per_sec);
+  if (c.delayed_request_count > 0)
+    printf("    Delayed requests: %zu\n", c.delayed_request_count);
+  printf("    Avg latency: %.0f usec (std %.0f usec)\n", c.avg_latency_ns / 1e3,
+         c.std_latency_ns / 1e3);
+  for (auto& kv : c.percentile_latency_ns) {
+    printf("    p%zu latency: %.0f usec\n", kv.first, kv.second / 1e3);
+  }
+  printf("    Avg HTTP send/recv: %.0f / %.0f usec\n", c.avg_send_time_ns / 1e3,
+         c.avg_receive_time_ns / 1e3);
+  printf("  Server:\n");
+  PrintServerStats("    ", st.server_stats);
+  for (auto& kv : st.server_stats.composing) {
+    printf("    Composing model %s:\n", kv.first.c_str());
+    PrintServerStats("      ", kv.second);
+  }
+}
+
+void WriteCsv(const Args& args, const std::vector<PerfStatus>& results) {
+  std::ofstream f(args.csv_path);
+  if (!f.good()) {
+    fprintf(stderr, "cannot write CSV to %s\n", args.csv_path.c_str());
+    return;
+  }
+  f << "Concurrency,Request Rate,Inferences/Second,Client Send,"
+    << "Network+Server Send/Recv,Server Queue,Server Compute Input,"
+    << "Server Compute Infer,Server Compute Output,Client Recv,"
+    << "p50 latency,p90 latency,p95 latency,p99 latency,Avg latency\n";
+  for (const auto& st : results) {
+    const auto& c = st.client_stats;
+    const auto& s = st.server_stats;
+    uint64_t n = std::max<uint64_t>(1, s.success_count);
+    uint64_t queue_us = s.queue_time_ns / 1000 / n;
+    uint64_t ci_us = s.compute_input_time_ns / 1000 / n;
+    uint64_t cf_us = s.compute_infer_time_ns / 1000 / n;
+    uint64_t co_us = s.compute_output_time_ns / 1000 / n;
+    uint64_t send_us = c.avg_send_time_ns / 1000;
+    uint64_t recv_us = c.avg_receive_time_ns / 1000;
+    // Network+Server Send/Recv = client latency - client send/recv -
+    // server phases, clamped at 0 (reference main.cc:1576-1590)
+    int64_t net = static_cast<int64_t>(c.avg_latency_ns / 1000) - send_us -
+                  recv_us - queue_us - ci_us - cf_us - co_us;
+    if (net < 0) net = 0;
+    auto pct = [&](size_t p) -> uint64_t {
+      auto it = c.percentile_latency_ns.find(p);
+      return it == c.percentile_latency_ns.end() ? 0 : it->second / 1000;
+    };
+    f << st.concurrency << "," << st.request_rate << "," << c.infer_per_sec
+      << "," << send_us << "," << net << "," << queue_us << "," << ci_us
+      << "," << cf_us << "," << co_us << "," << recv_us << "," << pct(50)
+      << "," << pct(90) << "," << pct(95) << "," << pct(99) << ","
+      << c.avg_latency_ns / 1000 << "\n";
+  }
+  printf("CSV written to %s\n", args.csv_path.c_str());
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  signal(SIGINT, SignalHandler);
+
+  static struct option long_opts[] = {
+      {"concurrency-range", required_argument, nullptr, 1000},
+      {"request-rate-range", required_argument, nullptr, 1001},
+      {"request-distribution", required_argument, nullptr, 1002},
+      {"request-intervals", required_argument, nullptr, 1003},
+      {"binary-search", no_argument, nullptr, 1004},
+      {"measurement-mode", required_argument, nullptr, 1005},
+      {"measurement-request-count", required_argument, nullptr, 1006},
+      {"percentile", required_argument, nullptr, 1007},
+      {"input-data", required_argument, nullptr, 1008},
+      {"shape", required_argument, nullptr, 1009},
+      {"string-length", required_argument, nullptr, 1010},
+      {"string-data", required_argument, nullptr, 1011},
+      {"sequence-length", required_argument, nullptr, 1012},
+      {"start-sequence-id", required_argument, nullptr, 1013},
+      {"shared-memory", required_argument, nullptr, 1014},
+      {"output-shared-memory-size", required_argument, nullptr, 1015},
+      {"max-threads", required_argument, nullptr, 1016},
+      {"help", no_argument, nullptr, 'h'},
+      {nullptr, 0, nullptr, 0}};
+
+  Args args;
+  int opt;
+  while ((opt = getopt_long(argc, argv, "m:x:u:i:b:ap:s:r:l:f:vh", long_opts,
+                            nullptr)) != -1) {
+    switch (opt) {
+      case 'm': args.model = optarg; break;
+      case 'x': args.version = optarg; break;
+      case 'u': args.url = optarg; break;
+      case 'i': args.protocol = optarg; break;
+      case 'b': args.batch_size = atoi(optarg); break;
+      case 'a': args.async = true; break;
+      case 'p': args.window_ms = strtoull(optarg, nullptr, 10); break;
+      case 's': args.stability_pct = atof(optarg); break;
+      case 'r': args.max_trials = strtoull(optarg, nullptr, 10); break;
+      case 'l': args.latency_threshold_us =
+                    strtoull(optarg, nullptr, 10) * 1000; break;
+      case 'f': args.csv_path = optarg; break;
+      case 'v': args.verbose = true; break;
+      case 'h': Usage(); break;
+      case 1000: {
+        double a = 1, b = 1, c = 1;
+        if (!ParseRange(optarg, &a, &b, &c))
+          Usage("bad --concurrency-range, want start:end[:step]");
+        args.has_concurrency = true;
+        args.conc_start = a; args.conc_end = b; args.conc_step = c;
+        break;
+      }
+      case 1001: {
+        double a = 0, b = 0, c = 1;
+        if (!ParseRange(optarg, &a, &b, &c))
+          Usage("bad --request-rate-range, want start:end[:step]");
+        args.has_rate = true;
+        args.rate_start = a; args.rate_end = b; args.rate_step = c;
+        break;
+      }
+      case 1002:
+        if (strcmp(optarg, "poisson") == 0) {
+          args.poisson = true;
+        } else if (strcmp(optarg, "constant") != 0) {
+          Usage("--request-distribution must be poisson or constant");
+        }
+        break;
+      case 1003: args.intervals_file = optarg; break;
+      case 1004: args.binary_search = true; break;
+      case 1005:
+        if (strcmp(optarg, "count_windows") == 0)
+          args.mode = MeasurementMode::COUNT_WINDOWS;
+        break;
+      case 1006: args.request_count = strtoull(optarg, nullptr, 10); break;
+      case 1007: args.percentile = atoll(optarg); break;
+      case 1008: args.input_data = optarg; break;
+      case 1009: {
+        std::string spec(optarg);
+        size_t colon = spec.rfind(':');
+        if (colon == std::string::npos) Usage("bad --shape, want name:d1,d2");
+        std::string name = spec.substr(0, colon);
+        std::vector<int64_t> dims;
+        std::stringstream ss(spec.substr(colon + 1));
+        std::string tok;
+        while (std::getline(ss, tok, ',')) dims.push_back(atoll(tok.c_str()));
+        args.data_opts.shapes[name] = dims;
+        break;
+      }
+      case 1010:
+        args.data_opts.string_length = strtoull(optarg, nullptr, 10);
+        break;
+      case 1011: args.data_opts.string_data = optarg; break;
+      case 1012: args.sequence_length = strtoull(optarg, nullptr, 10); break;
+      case 1013:
+        args.start_sequence_id = strtoull(optarg, nullptr, 10);
+        break;
+      case 1014:
+        if (strcmp(optarg, "system") == 0) args.shm = SharedMemoryType::SYSTEM;
+        else if (strcmp(optarg, "tpu") == 0) args.shm = SharedMemoryType::TPU;
+        else if (strcmp(optarg, "none") != 0)
+          Usage("--shared-memory must be none|system|tpu");
+        break;
+      case 1015: args.output_shm_size = strtoull(optarg, nullptr, 10); break;
+      case 1016: args.max_threads = strtoull(optarg, nullptr, 10); break;
+      default: Usage("unknown option");
+    }
+  }
+  if (args.model.empty()) Usage("-m <model> is required");
+  if (args.protocol != "http") Usage("only -i http is available");
+
+  // --- backend + parser -----------------------------------------------------
+  ClientBackendFactory factory(BackendKind::TPU_HTTP, args.url, args.verbose,
+                               /*max_async_concurrency=*/32);
+  std::unique_ptr<ClientBackend> meta_backend;
+  Error err = factory.Create(&meta_backend);
+  if (!err.IsOk()) {
+    fprintf(stderr, "failed to create backend: %s\n", err.Message().c_str());
+    return 1;
+  }
+  auto parser = std::make_shared<ModelParser>();
+  {
+    tpuclient::JsonPtr metadata, config;
+    err = meta_backend->ModelMetadata(&metadata, args.model, args.version);
+    if (err.IsOk())
+      err = meta_backend->ModelConfig(&config, args.model, args.version);
+    if (err.IsOk()) err = parser->Init(metadata, config);
+    if (!err.IsOk()) {
+      fprintf(stderr, "failed to load model info for '%s': %s\n",
+              args.model.c_str(), err.Message().c_str());
+      return 1;
+    }
+  }
+  if (parser->MaxBatchSize() == 0 && args.batch_size > 1) {
+    fprintf(stderr, "model does not support batching (max_batch_size 0)\n");
+    return 1;
+  }
+
+  // --- data -----------------------------------------------------------------
+  auto data_loader = std::make_shared<DataLoader>();
+  args.data_opts.zero_data = args.input_data == "zero";
+  if (args.input_data == "zero" || args.input_data == "random") {
+    err = data_loader->GenerateData(*parser, args.data_opts);
+  } else {
+    err = data_loader->ReadDataFromJson(*parser, args.input_data,
+                                        args.data_opts);
+  }
+  if (!err.IsOk()) {
+    fprintf(stderr, "data error: %s\n", err.Message().c_str());
+    return 1;
+  }
+
+  // --- manager --------------------------------------------------------------
+  LoadOptions load_opts;
+  load_opts.batch_size = args.batch_size;
+  load_opts.async = args.async;
+  load_opts.max_threads = args.max_threads;
+  load_opts.shm_type = args.shm;
+  load_opts.output_shm_size = args.output_shm_size;
+  load_opts.sequence_length = args.sequence_length;
+  load_opts.start_sequence_id = args.start_sequence_id;
+
+  std::unique_ptr<LoadManager> manager;
+  enum class Mode { CONCURRENCY, RATE, CUSTOM } mode = Mode::CONCURRENCY;
+  if (!args.intervals_file.empty()) {
+    mode = Mode::CUSTOM;
+    std::unique_ptr<CustomLoadManager> m;
+    err = CustomLoadManager::Create(load_opts, args.intervals_file, factory,
+                                    parser, data_loader, &m);
+    manager = std::move(m);
+  } else if (args.has_rate) {
+    mode = Mode::RATE;
+    std::unique_ptr<RequestRateManager> m;
+    err = RequestRateManager::Create(
+        load_opts,
+        args.poisson ? Distribution::POISSON : Distribution::CONSTANT,
+        factory, parser, data_loader, &m);
+    manager = std::move(m);
+  } else {
+    std::unique_ptr<ConcurrencyManager> m;
+    err = ConcurrencyManager::Create(load_opts, factory, parser, data_loader,
+                                     &m);
+    manager = std::move(m);
+  }
+  if (!err.IsOk()) {
+    fprintf(stderr, "failed to create load manager: %s\n",
+            err.Message().c_str());
+    return 1;
+  }
+
+  // --- profiler -------------------------------------------------------------
+  InferenceProfiler::Options popts;
+  popts.stability_threshold = args.stability_pct / 100.0;
+  popts.measurement_window_ms = args.window_ms;
+  popts.measurement_mode = args.mode;
+  popts.measurement_request_count = args.request_count;
+  popts.max_trials = args.max_trials;
+  popts.latency_threshold_us = args.latency_threshold_us;
+  popts.percentile = args.percentile;
+  popts.verbose = args.verbose;
+
+  std::unique_ptr<ClientBackend> stats_backend;
+  err = factory.Create(&stats_backend);
+  if (!err.IsOk()) {
+    fprintf(stderr, "failed to create stats backend: %s\n",
+            err.Message().c_str());
+    return 1;
+  }
+  InferenceProfiler profiler(popts, parser, std::move(stats_backend),
+                             manager.get());
+
+  printf("*** Measurement Settings ***\n");
+  printf("  Model: %s, batch size: %d, protocol: %s, mode: %s\n",
+         args.model.c_str(), args.batch_size, args.protocol.c_str(),
+         args.async ? "async" : "sync");
+  printf("  Window: %lu ms (%s), stability: %.0f%%, max trials: %zu\n\n",
+         static_cast<unsigned long>(args.window_ms),
+         args.mode == MeasurementMode::TIME_WINDOWS ? "time" : "count",
+         args.stability_pct, args.max_trials);
+
+  std::vector<PerfStatus> results;
+  switch (mode) {
+    case Mode::CONCURRENCY:
+      err = profiler.ProfileConcurrency(args.conc_start, args.conc_end,
+                                        args.conc_step, args.binary_search,
+                                        &results);
+      break;
+    case Mode::RATE:
+      err = profiler.ProfileRate(args.rate_start, args.rate_end,
+                                 args.rate_step, args.binary_search, &results);
+      break;
+    case Mode::CUSTOM:
+      err = profiler.ProfileCustom(&results);
+      break;
+  }
+  if (!err.IsOk()) {
+    fprintf(stderr, "profiling failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+
+  printf("\n*** Results ***\n");
+  for (const auto& st : results) {
+    PrintStatus(st);
+    printf("\n");
+  }
+  if (!args.csv_path.empty()) WriteCsv(args, results);
+  return 0;
+}

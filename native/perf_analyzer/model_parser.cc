@@ -1,0 +1,100 @@
+#include "model_parser.h"
+
+using tpuclient::Error;
+using tpuclient::Json;
+using tpuclient::JsonPtr;
+
+namespace tpuperf {
+
+static void ParseTensors(const JsonPtr& list,
+                         std::map<std::string, ModelTensor>* out) {
+  if (!list || !list->IsArray()) return;
+  for (size_t i = 0; i < list->Size(); ++i) {
+    const JsonPtr& t = list->At(i);
+    if (!t->IsObject()) continue;
+    ModelTensor mt;
+    JsonPtr name = t->Get("name");
+    if (!name || !name->IsString()) continue;
+    mt.name = name->AsString();
+    JsonPtr dt = t->Get("datatype");
+    if (dt && dt->IsString()) mt.datatype = dt->AsString();
+    JsonPtr shape = t->Get("shape");
+    if (shape && shape->IsArray()) {
+      for (size_t j = 0; j < shape->Size(); ++j)
+        mt.shape.push_back(shape->At(j)->AsInt());
+    }
+    JsonPtr opt = t->Get("optional");
+    if (opt && opt->IsBool()) mt.is_optional = opt->AsBool();
+    (*out)[mt.name] = mt;
+  }
+}
+
+Error ModelParser::Init(const JsonPtr& metadata, const JsonPtr& config) {
+  if (!metadata || !metadata->IsObject())
+    return Error("model metadata is not a JSON object", 400);
+  JsonPtr name = metadata->Get("name");
+  if (!name || !name->IsString())
+    return Error("model metadata missing 'name'", 400);
+  name_ = name->AsString();
+  JsonPtr versions = metadata->Get("versions");
+  if (versions && versions->IsArray() && versions->Size() > 0 &&
+      versions->At(versions->Size() - 1)->IsString()) {
+    version_ = versions->At(versions->Size() - 1)->AsString();
+  }
+
+  ParseTensors(metadata->Get("inputs"), &inputs_);
+  ParseTensors(metadata->Get("outputs"), &outputs_);
+
+  if (!config || !config->IsObject())
+    return Error("model config is not a JSON object", 400);
+  JsonPtr mbs = config->Get("max_batch_size");
+  if (mbs && mbs->IsNumber()) max_batch_size_ = mbs->AsInt();
+
+  // metadata shapes include the batch dim when the model is batchable
+  // (ModelConfig.metadata_dict prepends -1); strip it so the harness works
+  // with per-request shapes.
+  if (max_batch_size_ > 0) {
+    for (auto* tensors : {&inputs_, &outputs_}) {
+      for (auto& kv : *tensors) {
+        if (!kv.second.shape.empty()) {
+          kv.second.shape.erase(kv.second.shape.begin());
+        }
+      }
+    }
+  }
+
+  bool has_sequence = config->Has("sequence_batching");
+  bool has_dynamic = config->Has("dynamic_batching");
+  bool has_ensemble = false;
+  JsonPtr ens = config->Get("ensemble_scheduling");
+  if (ens && ens->IsObject()) {
+    JsonPtr steps = ens->Get("step");
+    if (steps && steps->IsArray()) {
+      has_ensemble = steps->Size() > 0;
+      for (size_t i = 0; i < steps->Size(); ++i) {
+        JsonPtr mn = steps->At(i)->Get("model_name");
+        if (mn && mn->IsString()) composing_.insert(mn->AsString());
+      }
+    }
+  }
+
+  if (has_ensemble) {
+    scheduler_ = has_sequence ? SchedulerType::ENSEMBLE_SEQUENCE
+                              : SchedulerType::ENSEMBLE;
+  } else if (has_sequence) {
+    scheduler_ = SchedulerType::SEQUENCE;
+  } else if (has_dynamic) {
+    scheduler_ = SchedulerType::DYNAMIC;
+  } else {
+    scheduler_ = SchedulerType::NONE;
+  }
+
+  JsonPtr policy = config->Get("model_transaction_policy");
+  if (policy && policy->IsObject()) {
+    JsonPtr dec = policy->Get("decoupled");
+    if (dec && dec->IsBool()) decoupled_ = dec->AsBool();
+  }
+  return Error::Success();
+}
+
+}  // namespace tpuperf

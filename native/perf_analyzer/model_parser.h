@@ -1,0 +1,66 @@
+// Model metadata/config -> harness scheduling knowledge.
+//
+// Counterpart of the reference's model_parser.{h,cc}
+// (/root/reference/src/c++/perf_analyzer/model_parser.h:33-149): classifies
+// the model's scheduler (NONE / DYNAMIC / SEQUENCE / ENSEMBLE /
+// ENSEMBLE_SEQUENCE), records batching capability and tensor shapes, and
+// collects composing-model names for ensemble stat rollups.
+#pragma once
+
+#include <map>
+#include <memory>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "tpuclient/error.h"
+#include "tpuclient/json.h"
+
+namespace tpuperf {
+
+struct ModelTensor {
+  std::string name;
+  std::string datatype;           // v2 wire dtype ("INT32", "BYTES", ...)
+  std::vector<int64_t> shape;     // without batch dim; -1 = dynamic
+  bool is_optional = false;
+};
+
+class ModelParser {
+ public:
+  enum class SchedulerType {
+    NONE,
+    DYNAMIC,
+    SEQUENCE,
+    ENSEMBLE,
+    ENSEMBLE_SEQUENCE
+  };
+
+  // metadata: GET /v2/models/<m> JSON; config: GET /v2/models/<m>/config.
+  tpuclient::Error Init(const tpuclient::JsonPtr& metadata,
+                        const tpuclient::JsonPtr& config);
+
+  const std::string& Name() const { return name_; }
+  const std::string& Version() const { return version_; }
+  SchedulerType Scheduler() const { return scheduler_; }
+  int64_t MaxBatchSize() const { return max_batch_size_; }
+  bool IsDecoupled() const { return decoupled_; }
+  const std::map<std::string, ModelTensor>& Inputs() const { return inputs_; }
+  const std::map<std::string, ModelTensor>& Outputs() const {
+    return outputs_;
+  }
+  // Composing models of an ensemble (for per-model stat rollup, reference
+  // inference_profiler.cc:910-960).
+  const std::set<std::string>& ComposingModels() const { return composing_; }
+
+ private:
+  std::string name_;
+  std::string version_;
+  SchedulerType scheduler_ = SchedulerType::NONE;
+  int64_t max_batch_size_ = 0;
+  bool decoupled_ = false;
+  std::map<std::string, ModelTensor> inputs_;
+  std::map<std::string, ModelTensor> outputs_;
+  std::set<std::string> composing_;
+};
+
+}  // namespace tpuperf

@@ -1,0 +1,73 @@
+// Shared enums + helpers for the perf harness.
+//
+// Counterpart of the reference's perf_utils.{h,cc}
+// (/root/reference/src/c++/perf_analyzer/perf_utils.h:53-146): per-request
+// timestamp tuples, load-distribution/search-mode/shm-type enums, and the
+// inter-arrival schedule distribution generators.
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <random>
+#include <string>
+#include <vector>
+
+namespace tpuperf {
+
+// Cooperative early-exit flag: set by the CLI's SIGINT handler, polled by
+// the profiler's measurement loops so Ctrl-C drains gracefully (reference
+// main.cc:42-55 `early_exit`).
+inline std::atomic<bool>& EarlyExit() {
+  static std::atomic<bool> flag{false};
+  return flag;
+}
+
+// (start_ns, end_ns, sequence_end, delayed) — reference TimestampVector
+// tuple (perf_utils.h:53-54).
+struct RequestRecord {
+  uint64_t start_ns = 0;
+  uint64_t end_ns = 0;
+  bool sequence_end = false;
+  bool delayed = false;
+};
+
+using TimestampVector = std::vector<RequestRecord>;
+
+enum class Distribution { POISSON, CONSTANT, CUSTOM };
+enum class SearchMode { LINEAR, BINARY, NONE };
+enum class SharedMemoryType { NONE, SYSTEM, TPU };
+enum class MeasurementMode { TIME_WINDOWS, COUNT_WINDOWS };
+
+inline uint64_t NowNs() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Inter-arrival schedule generator (reference ScheduleDistribution template,
+// perf_utils.h:144-146): returns nanosecond gaps for the given request rate.
+class ScheduleDistribution {
+ public:
+  ScheduleDistribution(Distribution kind, double rate_per_sec, uint64_t seed)
+      : kind_(kind), gen_(seed) {
+    period_ns_ = rate_per_sec > 0 ? 1e9 / rate_per_sec : 0;
+    exp_ = std::exponential_distribution<double>(
+        rate_per_sec > 0 ? rate_per_sec / 1e9 : 1.0);
+  }
+
+  uint64_t NextGapNs() {
+    if (kind_ == Distribution::POISSON) {
+      return static_cast<uint64_t>(exp_(gen_));
+    }
+    return static_cast<uint64_t>(period_ns_);
+  }
+
+ private:
+  Distribution kind_;
+  std::mt19937_64 gen_;
+  double period_ns_;
+  std::exponential_distribution<double> exp_;
+};
+
+}  // namespace tpuperf

@@ -1,0 +1,217 @@
+#include "request_rate_manager.h"
+
+using tpuclient::Error;
+
+namespace tpuperf {
+
+Error RequestRateManager::Create(
+    const LoadOptions& options, Distribution distribution,
+    const ClientBackendFactory& factory, std::shared_ptr<ModelParser> parser,
+    std::shared_ptr<DataLoader> data_loader,
+    std::unique_ptr<RequestRateManager>* manager) {
+  auto m = std::unique_ptr<RequestRateManager>(new RequestRateManager(
+      options, distribution, factory, std::move(parser),
+      std::move(data_loader)));
+  Error err = m->InitManager();
+  if (!err.IsOk()) return err;
+  *manager = std::move(m);
+  return Error::Success();
+}
+
+RequestRateManager::~RequestRateManager() {
+  exit_.store(true);
+  running_.store(true);  // release any paused workers so they can exit
+  wake_cv_.notify_all();
+  StopWorkerThreads();
+}
+
+Error RequestRateManager::GenerateSchedule(double request_rate) {
+  // Two seconds of schedule, repeated cyclically by the workers (reference
+  // generates max_trials * measurement windows; cyclic repeat is equivalent
+  // for constant/Poisson and keeps memory bounded).
+  if (request_rate <= 0) return Error("request rate must be > 0", 400);
+  ScheduleDistribution dist(distribution_, request_rate, 42);
+  auto schedule = std::make_shared<std::vector<uint64_t>>();
+  uint64_t t = 0;
+  uint64_t horizon = 2'000'000'000ULL;
+  while (t < horizon || schedule->size() < 8) {
+    t += dist.NextGapNs();
+    schedule->push_back(t);
+  }
+  std::lock_guard<std::mutex> lk(wake_mutex_);
+  schedule_ = std::move(schedule);
+  return Error::Success();
+}
+
+Error RequestRateManager::ChangeRequestRate(double request_rate) {
+  PauseWorkers();
+  Error err = GenerateSchedule(request_rate);
+  if (!err.IsOk()) return err;
+  size_t n_threads =
+      std::min<size_t>(options_.max_threads,
+                       std::max<size_t>(1, static_cast<size_t>(
+                                               request_rate / 100) + 1));
+  StartWorkers(n_threads);
+  return Error::Success();
+}
+
+void RequestRateManager::PauseWorkers() {
+  running_.store(false);
+}
+
+void RequestRateManager::StartWorkers(size_t n_threads) {
+  while (threads_.size() < n_threads) {
+    size_t idx = threads_.size();
+    auto stat = std::make_shared<ThreadStat>();
+    auto config = std::make_shared<ThreadConfig>();
+    config->index = idx;
+    Error err = factory_.Create(&config->backend);
+    if (!err.IsOk()) {
+      std::lock_guard<std::mutex> lk(stat->mu);
+      stat->status = err;
+      return;
+    }
+    if (options_.shm_type != SharedMemoryType::NONE && !shm_ready_) {
+      err = InitSharedMemory(config->backend.get());
+      if (!err.IsOk()) {
+        std::lock_guard<std::mutex> lk(stat->mu);
+        stat->status = err;
+        return;
+      }
+    }
+    thread_stats_.push_back(stat);
+    thread_configs_.push_back(config);
+    threads_.emplace_back(&RequestRateManager::WorkerLoop, this, stat, config);
+  }
+  for (auto& config : thread_configs_) {
+    config->stride = threads_.size();
+  }
+  delayed_.store(false);
+  epoch_ns_.store(NowNs());
+  running_.store(true);
+  wake_cv_.notify_all();
+}
+
+void RequestRateManager::WorkerLoop(std::shared_ptr<ThreadStat> stat,
+                                    std::shared_ptr<ThreadConfig> config) {
+  size_t slot = config->index;
+  uint64_t cycle = 0;  // how many times the schedule wrapped
+  uint64_t seen_epoch = 0;
+  auto inflight = std::make_shared<std::atomic<size_t>>(0);
+
+  while (!exit_.load()) {
+    if (!running_.load()) {
+      std::unique_lock<std::mutex> lk(wake_mutex_);
+      wake_cv_.wait_for(lk, std::chrono::milliseconds(20), [&]() {
+        return exit_.load() || running_.load();
+      });
+      continue;
+    }
+    uint64_t epoch = epoch_ns_.load();
+    if (epoch != seen_epoch) {
+      seen_epoch = epoch;
+      slot = config->index;
+      cycle = 0;
+    }
+    std::shared_ptr<const std::vector<uint64_t>> schedule;
+    {
+      std::lock_guard<std::mutex> lk(wake_mutex_);
+      schedule = schedule_;
+    }
+    if (!schedule || schedule->empty()) continue;
+
+    uint64_t cycle_span = schedule->back();
+    uint64_t offset =
+        (*schedule)[slot % schedule->size()] + cycle * cycle_span;
+    uint64_t due = epoch + offset;
+    uint64_t now = NowNs();
+    if (now < due) {
+      uint64_t wait_ns = due - now;
+      std::this_thread::sleep_for(std::chrono::nanoseconds(
+          std::min<uint64_t>(wait_ns, 20'000'000)));
+      if (due - now > 20'000'000) continue;  // re-check running/exit
+    }
+    bool was_delayed = NowNs() > due + 2'000'000;  // >2ms behind schedule
+    if (was_delayed) delayed_.store(true);
+
+    // context: sync uses one, async finds a free one
+    InferContext* ctx = nullptr;
+    for (auto& c : config->ctxs) {
+      if (!c->inflight) {
+        ctx = c.get();
+        break;
+      }
+    }
+    if (ctx == nullptr) {
+      Error err = MakeContext(config.get(), &ctx);
+      if (!err.IsOk()) {
+        std::lock_guard<std::mutex> lk(stat->mu);
+        stat->status = err;
+        return;
+      }
+    }
+    Error err = PrepareRequest(ctx);
+    if (!err.IsOk()) {
+      std::lock_guard<std::mutex> lk(stat->mu);
+      stat->status = err;
+      return;
+    }
+
+    if (options_.async) {
+      ctx->inflight = true;
+      ctx->start_ns = NowNs();
+      bool seq_end = ctx->options->sequence_end;
+      ThreadStat* stat_ptr = stat.get();
+      inflight->fetch_add(1);
+      err = config->backend->AsyncInfer(
+          [this, ctx, stat_ptr, seq_end, was_delayed, inflight](
+              tpuclient::InferResult* result) {
+            uint64_t end = NowNs();
+            Error status =
+                result != nullptr ? result->RequestStatus() : Error("null");
+            delete result;
+            if (status.IsOk()) {
+              RecordRequest(stat_ptr, ctx->start_ns, end, seq_end,
+                            was_delayed);
+            } else {
+              std::lock_guard<std::mutex> lk(stat_ptr->mu);
+              stat_ptr->status = status;
+            }
+            ctx->inflight = false;
+            inflight->fetch_sub(1);
+          },
+          *ctx->options, ctx->inputs, ctx->outputs);
+      if (!err.IsOk()) {
+        ctx->inflight = false;
+        inflight->fetch_sub(1);
+        std::lock_guard<std::mutex> lk(stat->mu);
+        stat->status = err;
+        return;
+      }
+    } else {
+      tpuclient::InferResult* result = nullptr;
+      uint64_t start = NowNs();
+      err = config->backend->Infer(&result, *ctx->options, ctx->inputs,
+                                   ctx->outputs);
+      uint64_t end = NowNs();
+      if (err.IsOk() && result != nullptr) err = result->RequestStatus();
+      delete result;
+      if (err.IsOk()) {
+        RecordRequest(stat.get(), start, end, ctx->options->sequence_end,
+                      was_delayed);
+      } else {
+        std::lock_guard<std::mutex> lk(stat->mu);
+        stat->status = err;
+        return;
+      }
+    }
+
+    slot += config->stride;
+    cycle = slot / schedule->size();
+  }
+  while (inflight->load() > 0) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+}
+
+}  // namespace tpuperf

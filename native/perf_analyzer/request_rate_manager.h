@@ -1,0 +1,61 @@
+// Open-loop load: requests fired on a pre-generated timestamp schedule.
+//
+// Counterpart of the reference's request_rate_manager.{h,cc}
+// (/root/reference/src/c++/perf_analyzer/request_rate_manager.cc:113-357):
+// a schedule of send offsets (Poisson or constant inter-arrival) walked by
+// worker threads with stride = thread count; a request sent after its slot
+// is marked `delayed`. Async mode doesn't wait for completions — that's
+// what makes the loop open.
+#pragma once
+
+#include "load_manager.h"
+
+namespace tpuperf {
+
+class RequestRateManager : public LoadManager {
+ public:
+  static tpuclient::Error Create(const LoadOptions& options,
+                                 Distribution distribution,
+                                 const ClientBackendFactory& factory,
+                                 std::shared_ptr<ModelParser> parser,
+                                 std::shared_ptr<DataLoader> data_loader,
+                                 std::unique_ptr<RequestRateManager>* manager);
+  ~RequestRateManager() override;
+
+  tpuclient::Error ChangeRequestRate(double request_rate);
+
+  // Whether the generated load kept up with the schedule in the last swap
+  // window (reference delayed_ flag).
+  bool HasDelayedRequests() const { return delayed_.load(); }
+
+ protected:
+  RequestRateManager(const LoadOptions& options, Distribution distribution,
+                     const ClientBackendFactory& factory,
+                     std::shared_ptr<ModelParser> parser,
+                     std::shared_ptr<DataLoader> data_loader)
+      : LoadManager(options, factory, std::move(parser),
+                    std::move(data_loader)),
+        distribution_(distribution) {}
+
+  // Generates `schedule_`: absolute ns offsets from the epoch start
+  // (reference GenerateSchedule, request_rate_manager.cc:113-134).
+  virtual tpuclient::Error GenerateSchedule(double request_rate);
+
+  void StartWorkers(size_t n_threads);
+  void PauseWorkers();
+  void WorkerLoop(std::shared_ptr<ThreadStat> stat,
+                  std::shared_ptr<ThreadConfig> config);
+
+  Distribution distribution_;
+  // Send offsets (ns). Immutable snapshot: GenerateSchedule installs a new
+  // vector under wake_mutex_ and workers copy the shared_ptr per iteration,
+  // so a rate change never mutates a schedule a worker is reading.
+  std::shared_ptr<const std::vector<uint64_t>> schedule_;
+  std::atomic<uint64_t> epoch_ns_{0};
+  std::atomic<bool> running_{false};
+  std::atomic<bool> delayed_{false};
+  std::mutex wake_mutex_;
+  std::condition_variable wake_cv_;
+};
+
+}  // namespace tpuperf

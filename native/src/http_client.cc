@@ -121,9 +121,16 @@ class HttpConnection {
     if (err.IsOk()) {
       if (timers) timers->Capture(RequestTimers::Kind::SEND_END);
       got_bytes_ = !rbuf_.empty();
-      if (timers) timers->Capture(RequestTimers::Kind::RECV_START);
+      first_byte_ns_ = 0;
       err = ReadResponse(status, headers, body, timeout_us);
-      if (timers) timers->Capture(RequestTimers::Kind::RECV_END);
+      // RECV_START = first response byte (matches the reference's curl
+      // semantics); the wait for the server to answer lands in the derived
+      // "Network+Server Send/Recv" metric instead of client receive time.
+      if (timers) {
+        timers->recv_start_ns =
+            first_byte_ns_ ? first_byte_ns_ : RequestTimers::Now();
+        timers->Capture(RequestTimers::Kind::RECV_END);
+      }
       if (err.IsOk()) return err;
       need_retry = reused && !got_bytes_ && err.StatusCode() != 499;
     } else {
@@ -135,9 +142,14 @@ class HttpConnection {
     err = SendRequest(head, segs);
     if (!err.IsOk()) return err;
     if (timers) timers->Capture(RequestTimers::Kind::SEND_END);
-    if (timers) timers->Capture(RequestTimers::Kind::RECV_START);
+    got_bytes_ = false;  // fresh connection, fresh first-byte tracking
+    first_byte_ns_ = 0;
     err = ReadResponse(status, headers, body, timeout_us);
-    if (timers) timers->Capture(RequestTimers::Kind::RECV_END);
+    if (timers) {
+      timers->recv_start_ns =
+          first_byte_ns_ ? first_byte_ns_ : RequestTimers::Now();
+      timers->Capture(RequestTimers::Kind::RECV_END);
+    }
     return err;
   }
 
@@ -250,6 +262,7 @@ class HttpConnection {
       return Error(std::string("recv failed: ") + strerror(errno), 400);
     }
     rbuf_.append(buf, n);
+    if (!got_bytes_) first_byte_ns_ = RequestTimers::Now();
     got_bytes_ = true;
     return Error::Success();
   }
@@ -287,6 +300,7 @@ class HttpConnection {
   // whether any response byte arrived for the in-flight request (guards the
   // RoundTrip stale-connection retry against replaying a half-answered call)
   bool got_bytes_ = false;
+  uint64_t first_byte_ns_ = 0;
 };
 
 // ---------------------------------------------------------------------------
