@@ -1,0 +1,92 @@
+// Control-plane conformance client over gRPC: liveness, readiness, server
+// and model metadata, model config, repository index, statistics.
+//
+// Reference counterpart: simple_grpc_health_metadata.py / the control-plane
+// surface of grpc_client.h:125-312 (§2.7). Asserts protobuf-typed responses,
+// exercising the zero-parse path the JSON/HTTP client can't.
+#include <unistd.h>
+
+#include <iostream>
+
+#include "tpuclient/grpc_client.h"
+
+namespace tc = tpuclient;
+
+#define FAIL_IF_ERR(X, MSG)                                          \
+  do {                                                               \
+    tc::Error err__ = (X);                                           \
+    if (!err__.IsOk()) {                                             \
+      std::cerr << "error: " << (MSG) << ": " << err__ << std::endl; \
+      exit(1);                                                       \
+    }                                                                \
+  } while (false)
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8001";
+  int opt;
+  while ((opt = getopt(argc, argv, "u:")) != -1)
+    if (opt == 'u') url = optarg;
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(tc::InferenceServerGrpcClient::Create(&client, url),
+              "create client");
+
+  bool live = false, ready = false, model_ready = false;
+  FAIL_IF_ERR(client->IsServerLive(&live), "ServerLive");
+  FAIL_IF_ERR(client->IsServerReady(&ready), "ServerReady");
+  FAIL_IF_ERR(client->IsModelReady(&model_ready, "simple"), "ModelReady");
+  if (!live || !ready || !model_ready) {
+    std::cerr << "error: live/ready flags false" << std::endl;
+    return 1;
+  }
+
+  inference::ServerMetadataResponse server_meta;
+  FAIL_IF_ERR(client->ServerMetadata(&server_meta), "ServerMetadata");
+  if (server_meta.name().empty() || server_meta.version().empty()) {
+    std::cerr << "error: empty server metadata" << std::endl;
+    return 1;
+  }
+
+  inference::ModelMetadataResponse model_meta;
+  FAIL_IF_ERR(client->ModelMetadata(&model_meta, "simple"), "ModelMetadata");
+  if (model_meta.name() != "simple" || model_meta.inputs_size() != 2 ||
+      model_meta.outputs_size() != 2) {
+    std::cerr << "error: unexpected model metadata: "
+              << model_meta.ShortDebugString() << std::endl;
+    return 1;
+  }
+  for (const auto& io : model_meta.inputs()) {
+    if (io.datatype() != "INT32") {
+      std::cerr << "error: unexpected input dtype " << io.datatype()
+                << std::endl;
+      return 1;
+    }
+  }
+
+  inference::ModelConfigResponse model_config;
+  FAIL_IF_ERR(client->ModelConfig(&model_config, "simple"), "ModelConfig");
+  if (model_config.config().name() != "simple") {
+    std::cerr << "error: unexpected model config" << std::endl;
+    return 1;
+  }
+
+  inference::RepositoryIndexResponse index;
+  FAIL_IF_ERR(client->ModelRepositoryIndex(&index), "RepositoryIndex");
+  bool found = false;
+  for (const auto& m : index.models()) found |= m.name() == "simple";
+  if (!found) {
+    std::cerr << "error: 'simple' missing from repository index" << std::endl;
+    return 1;
+  }
+
+  inference::ModelStatisticsResponse stats;
+  FAIL_IF_ERR(client->ModelInferenceStatistics(&stats, "simple"),
+              "ModelStatistics");
+  if (stats.model_stats_size() < 1) {
+    std::cerr << "error: empty model statistics" << std::endl;
+    return 1;
+  }
+
+  std::cout << "PASS : simple_grpc_health_metadata" << std::endl;
+  return 0;
+}

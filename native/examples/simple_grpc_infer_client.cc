@@ -1,0 +1,135 @@
+// Value-asserting add/sub conformance client over gRPC.
+//
+// Reference counterpart: simple_grpc_infer_client.cc
+// (/root/reference/src/c++/examples/simple_grpc_infer_client.cc:337 asserts
+// OUTPUT0=a+b, OUTPUT1=a-b on INT32[16]). Exercises the in-tree HTTP/2
+// transport end-to-end against the framework's grpcio-based server.
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <iostream>
+
+#include "tpuclient/grpc_client.h"
+
+namespace tc = tpuclient;
+
+#define FAIL_IF_ERR(X, MSG)                                          \
+  do {                                                               \
+    tc::Error err__ = (X);                                           \
+    if (!err__.IsOk()) {                                             \
+      std::cerr << "error: " << (MSG) << ": " << err__ << std::endl; \
+      exit(1);                                                       \
+    }                                                                \
+  } while (false)
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8001";
+  bool verbose = false;
+  int opt;
+  while ((opt = getopt(argc, argv, "vu:")) != -1) {
+    switch (opt) {
+      case 'u':
+        url = optarg;
+        break;
+      case 'v':
+        verbose = true;
+        break;
+      default:
+        std::cerr << "usage: " << argv[0] << " [-v] [-u host:port]"
+                  << std::endl;
+        return 2;
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(tc::InferenceServerGrpcClient::Create(&client, url, verbose),
+              "unable to create client");
+
+  bool live = false;
+  FAIL_IF_ERR(client->IsServerLive(&live), "server live check");
+  if (!live) {
+    std::cerr << "error: server not live" << std::endl;
+    return 1;
+  }
+
+  std::vector<int32_t> input0_data(16);
+  std::vector<int32_t> input1_data(16);
+  for (int i = 0; i < 16; ++i) {
+    input0_data[i] = i;
+    input1_data[i] = 1;
+  }
+
+  tc::InferInput* input0;
+  tc::InferInput* input1;
+  FAIL_IF_ERR(tc::InferInput::Create(&input0, "INPUT0", {1, 16}, "INT32"),
+              "create INPUT0");
+  FAIL_IF_ERR(tc::InferInput::Create(&input1, "INPUT1", {1, 16}, "INT32"),
+              "create INPUT1");
+  std::unique_ptr<tc::InferInput> i0(input0), i1(input1);
+  FAIL_IF_ERR(
+      input0->AppendRaw(reinterpret_cast<uint8_t*>(input0_data.data()),
+                        input0_data.size() * sizeof(int32_t)),
+      "set INPUT0 data");
+  FAIL_IF_ERR(
+      input1->AppendRaw(reinterpret_cast<uint8_t*>(input1_data.data()),
+                        input1_data.size() * sizeof(int32_t)),
+      "set INPUT1 data");
+
+  tc::InferRequestedOutput* output0;
+  tc::InferRequestedOutput* output1;
+  FAIL_IF_ERR(tc::InferRequestedOutput::Create(&output0, "OUTPUT0"),
+              "create OUTPUT0");
+  FAIL_IF_ERR(tc::InferRequestedOutput::Create(&output1, "OUTPUT1"),
+              "create OUTPUT1");
+  std::unique_ptr<tc::InferRequestedOutput> o0(output0), o1(output1);
+
+  tc::InferOptions options("simple");
+  options.request_id = "1";
+
+  tc::InferResult* result;
+  FAIL_IF_ERR(client->Infer(&result, options, {input0, input1},
+                            {output0, output1}),
+              "infer");
+  std::unique_ptr<tc::InferResult> result_owner(result);
+  FAIL_IF_ERR(result->RequestStatus(), "request status");
+
+  for (const auto& check :
+       {std::make_pair(std::string("OUTPUT0"), +1),
+        std::make_pair(std::string("OUTPUT1"), -1)}) {
+    std::vector<int64_t> shape;
+    std::string datatype;
+    FAIL_IF_ERR(result->Shape(check.first, &shape), "output shape");
+    FAIL_IF_ERR(result->Datatype(check.first, &datatype), "output dtype");
+    if (shape != std::vector<int64_t>({1, 16}) || datatype != "INT32") {
+      std::cerr << "error: unexpected shape/datatype for " << check.first
+                << std::endl;
+      return 1;
+    }
+    const uint8_t* buf;
+    size_t byte_size;
+    FAIL_IF_ERR(result->RawData(check.first, &buf, &byte_size), "raw data");
+    if (byte_size != 16 * sizeof(int32_t)) {
+      std::cerr << "error: unexpected byte size " << byte_size << std::endl;
+      return 1;
+    }
+    const int32_t* vals = reinterpret_cast<const int32_t*>(buf);
+    for (int i = 0; i < 16; ++i) {
+      int32_t expect = input0_data[i] + check.second * input1_data[i];
+      if (vals[i] != expect) {
+        std::cerr << "error: " << check.first << "[" << i << "] = " << vals[i]
+                  << ", expected " << expect << std::endl;
+        return 1;
+      }
+    }
+  }
+
+  tc::InferStat stat;
+  client->ClientInferStat(&stat);
+  if (verbose) {
+    std::cout << "completed " << stat.completed_request_count << " requests"
+              << std::endl;
+  }
+  std::cout << "PASS : simple_grpc_infer_client" << std::endl;
+  return 0;
+}

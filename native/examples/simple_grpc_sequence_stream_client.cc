@@ -1,0 +1,123 @@
+// Bidirectional-streaming sequence conformance client.
+//
+// Reference counterpart: simple_grpc_sequence_stream_infer_client.cc (§2.7):
+// drives two interleaved stateful sequences over ONE ModelStreamInfer bidi
+// stream (StartStream + AsyncStreamInfer + ordered callbacks), asserting the
+// server-held accumulator state per sequence — the decoupled/streaming hot
+// path of the reference (grpc_client.cc:986-1080).
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <iostream>
+#include <mutex>
+
+#include "tpuclient/grpc_client.h"
+
+namespace tc = tpuclient;
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8001";
+  int opt;
+  while ((opt = getopt(argc, argv, "u:")) != -1)
+    if (opt == 'u') url = optarg;
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  if (!tc::InferenceServerGrpcClient::Create(&client, url).IsOk()) return 1;
+
+  std::mutex mtx;
+  std::condition_variable cv;
+  // Responses complete in engine order, not send order, across different
+  // sequences — match them back by request id (per-sequence order is still
+  // guaranteed by the sequence scheduler, which the totals assert below).
+  std::map<std::string, int32_t> results;
+  bool stream_error = false;
+
+  tc::Error err = client->StartStream([&](tc::InferResult* result) {
+    std::unique_ptr<tc::InferResult> owner(result);
+    std::lock_guard<std::mutex> lk(mtx);
+    std::string id;
+    if (!result->RequestStatus().IsOk() || !result->Id(&id).IsOk()) {
+      std::cerr << "stream response error: " << result->RequestStatus()
+                << std::endl;
+      stream_error = true;
+    } else {
+      const uint8_t* buf;
+      size_t sz;
+      if (result->RawData("OUTPUT", &buf, &sz).IsOk() &&
+          sz == sizeof(int32_t)) {
+        results[id] = *reinterpret_cast<const int32_t*>(buf);
+      } else {
+        stream_error = true;
+      }
+    }
+    cv.notify_all();
+  });
+  if (!err.IsOk()) {
+    std::cerr << "StartStream failed: " << err << std::endl;
+    return 1;
+  }
+
+  // Two interleaved sequences on one stream, accumulator oracle per step.
+  const uint64_t kSeqA = 2001, kSeqB = 2002;
+  int32_t a_vals[] = {1, 2, 3};
+  int32_t b_vals[] = {10, 20, 30};
+  std::map<std::string, int32_t> expected;
+  int32_t a_total = 0, b_total = 0;
+  // Keep inputs alive until all responses arrive (no-copy AppendRaw).
+  std::deque<int32_t> values;
+  std::vector<std::unique_ptr<tc::InferInput>> inputs_alive;
+  for (int i = 0; i < 3; ++i) {
+    for (auto seq : {kSeqA, kSeqB}) {
+      int32_t value = seq == kSeqA ? a_vals[i] : b_vals[i];
+      (seq == kSeqA ? a_total : b_total) += value;
+      std::string id =
+          (seq == kSeqA ? "A" : "B") + std::to_string(i);
+      expected[id] = seq == kSeqA ? a_total : b_total;
+
+      values.push_back(value);
+      tc::InferInput* input;
+      tc::InferInput::Create(&input, "INPUT", {1}, "INT32");
+      inputs_alive.emplace_back(input);
+      input->AppendRaw(reinterpret_cast<uint8_t*>(&values.back()),
+                       sizeof(int32_t));
+
+      tc::InferOptions options("simple_sequence");
+      options.request_id = id;
+      options.sequence_id = seq;
+      options.sequence_start = i == 0;
+      options.sequence_end = i == 2;
+      tc::Error serr = client->AsyncStreamInfer(options, {input});
+      if (!serr.IsOk()) {
+        std::cerr << "AsyncStreamInfer failed: " << serr << std::endl;
+        return 1;
+      }
+    }
+  }
+
+  {
+    std::unique_lock<std::mutex> lk(mtx);
+    if (!cv.wait_for(lk, std::chrono::seconds(60), [&] {
+          return stream_error || results.size() >= expected.size();
+        })) {
+      std::cerr << "error: timed out (" << results.size() << "/"
+                << expected.size() << " responses)" << std::endl;
+      return 1;
+    }
+    if (stream_error) return 1;
+    for (const auto& kv : expected) {
+      auto it = results.find(kv.first);
+      if (it == results.end() || it->second != kv.second) {
+        std::cerr << "error: response " << kv.first << " = "
+                  << (it == results.end() ? -999999 : it->second)
+                  << ", expected " << kv.second << std::endl;
+        return 1;
+      }
+    }
+  }
+
+  client->StopStream();
+  std::cout << "PASS : simple_grpc_sequence_stream_client" << std::endl;
+  return 0;
+}

@@ -1,0 +1,70 @@
+// BYTES (string tensor) conformance client over gRPC.
+//
+// Reference counterpart: simple_grpc_string_infer_client.cc (§2.7) — sends
+// decimal strings through the 4-byte-LE-length-prefixed BYTES codec to the
+// `simple_string` model and validates the summed/subtracted string results.
+#include <unistd.h>
+
+#include <iostream>
+
+#include "tpuclient/grpc_client.h"
+
+namespace tc = tpuclient;
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8001";
+  int opt;
+  while ((opt = getopt(argc, argv, "u:")) != -1)
+    if (opt == 'u') url = optarg;
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  if (!tc::InferenceServerGrpcClient::Create(&client, url).IsOk()) return 1;
+
+  std::vector<std::string> in0, in1;
+  for (int i = 0; i < 16; ++i) {
+    in0.push_back(std::to_string(i));
+    in1.push_back(std::to_string(1));
+  }
+
+  tc::InferInput *input0, *input1;
+  tc::InferInput::Create(&input0, "INPUT0", {1, 16}, "BYTES");
+  tc::InferInput::Create(&input1, "INPUT1", {1, 16}, "BYTES");
+  std::unique_ptr<tc::InferInput> i0(input0), i1(input1);
+  input0->AppendFromString(in0);
+  input1->AppendFromString(in1);
+
+  tc::InferOptions options("simple_string");
+  tc::InferResult* result;
+  tc::Error err = client->Infer(&result, options, {input0, input1});
+  if (!err.IsOk()) {
+    std::cerr << "infer failed: " << err << std::endl;
+    return 1;
+  }
+  std::unique_ptr<tc::InferResult> owner(result);
+  if (!result->RequestStatus().IsOk()) {
+    std::cerr << "request failed: " << result->RequestStatus() << std::endl;
+    return 1;
+  }
+
+  for (const auto& check :
+       {std::make_pair(std::string("OUTPUT0"), +1),
+        std::make_pair(std::string("OUTPUT1"), -1)}) {
+    std::vector<std::string> values;
+    if (!result->StringData(check.first, &values).IsOk() ||
+        values.size() != 16) {
+      std::cerr << "bad " << check.first << std::endl;
+      return 1;
+    }
+    for (int i = 0; i < 16; ++i) {
+      int expect = i + check.second * 1;
+      if (atoi(values[i].c_str()) != expect) {
+        std::cerr << "error: " << check.first << "[" << i
+                  << "] = " << values[i] << ", expected " << expect
+                  << std::endl;
+        return 1;
+      }
+    }
+  }
+  std::cout << "PASS : simple_grpc_string_infer_client" << std::endl;
+  return 0;
+}

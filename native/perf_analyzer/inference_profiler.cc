@@ -66,12 +66,13 @@ Error InferenceProfiler::Measure(PerfStatus* status) {
   TimestampVector timestamps;
   manager_->SwapTimestamps(&timestamps);
 
+  status->batch_size = manager_->BatchSize();
   SummarizeClient(timestamps, client_start, client_end,
-                  window_end - window_start, &status->client_stats);
+                  window_end - window_start, status->batch_size,
+                  &status->client_stats);
   if (have_server_stats) {
     SummarizeServer(server_start, server_end, &status->server_stats);
   }
-  status->batch_size = manager_->BatchSize();
 
   if (options_.percentile > 0) {
     auto it = status->client_stats.percentile_latency_ns.find(
@@ -90,6 +91,7 @@ void InferenceProfiler::SummarizeClient(const TimestampVector& timestamps,
                                         const tpuclient::InferStat& start_stat,
                                         const tpuclient::InferStat& end_stat,
                                         uint64_t duration_ns,
+                                        size_t batch_size,
                                         ClientSideStats* stats) {
   *stats = ClientSideStats();
   stats->duration_ns = duration_ns;
@@ -107,7 +109,9 @@ void InferenceProfiler::SummarizeClient(const TimestampVector& timestamps,
   std::sort(latencies.begin(), latencies.end());
 
   double seconds = duration_ns / 1e9;
-  stats->infer_per_sec = timestamps.size() / seconds;
+  // Each request carries batch_size inferences (reference SummarizeClientStat
+  // computes valid_request_count * batch / duration, inference_profiler.cc:812).
+  stats->infer_per_sec = timestamps.size() * batch_size / seconds;
   stats->sequence_per_sec = sequence_ends / seconds;
 
   uint64_t total = 0;
@@ -121,8 +125,9 @@ void InferenceProfiler::SummarizeClient(const TimestampVector& timestamps,
   stats->std_latency_ns = static_cast<uint64_t>(
       std::sqrt(var / latencies.size()));
   for (size_t p : {50, 90, 95, 99}) {
-    size_t idx = std::min(latencies.size() - 1,
-                          static_cast<size_t>(latencies.size() * p / 100));
+    // Nearest-rank percentile: ceil(N*p/100) ranks, 0-based index.
+    size_t rank = (latencies.size() * p + 99) / 100;
+    size_t idx = std::min(latencies.size() - 1, rank > 0 ? rank - 1 : 0);
     stats->percentile_latency_ns[p] = latencies[idx];
   }
 
@@ -306,6 +311,16 @@ Error InferenceProfiler::ProfileRate(double start, double end, double step,
   }
 
   double lo = start, hi = end;
+  if (hi - lo <= step / 2) {
+    // Degenerate range (e.g. start == end): still take one measurement
+    // instead of silently reporting nothing.
+    PerfStatus status;
+    bool meets = true;
+    Error err = run_one(lo, &status, &meets);
+    if (!err.IsOk()) return err;
+    results->push_back(status);
+    return Error::Success();
+  }
   while (hi - lo > step / 2) {
     double mid = (lo + hi) / 2;
     PerfStatus status;

@@ -103,7 +103,8 @@ class InferenceProfiler {
   void SummarizeClient(const TimestampVector& timestamps,
                        const tpuclient::InferStat& start_stat,
                        const tpuclient::InferStat& end_stat,
-                       uint64_t duration_ns, ClientSideStats* stats);
+                       uint64_t duration_ns, size_t batch_size,
+                       ClientSideStats* stats);
   void SummarizeServer(const std::map<std::string, ModelStatistics>& start,
                        const std::map<std::string, ModelStatistics>& end,
                        ServerSideStats* stats);

@@ -75,13 +75,19 @@ class LoadManager {
     // sequence state (valid when is_sequence_)
     uint64_t seq_id = 0;
     uint64_t seq_remaining = 0;
-    bool inflight = false;
+    // Written by transport callback threads, scanned by the worker thread:
+    // release/acquire so the worker's free-context scan observes the
+    // callback's timestamp recording before reusing the context.
+    std::atomic<bool> inflight{false};
     uint64_t start_ns = 0;
   };
 
   struct ThreadConfig {
     size_t index = 0;
-    size_t stride = 1;
+    // Written by StartWorkers while a previously-started worker may still be
+    // mid-iteration (PauseWorkers does not quiesce), read in the schedule
+    // walk — atomic to keep that benign overlap defined.
+    std::atomic<size_t> stride{1};
     std::unique_ptr<ClientBackend> backend;
     std::vector<std::unique_ptr<InferContext>> ctxs;
   };

@@ -1,0 +1,906 @@
+// gRPC client implementation over the in-tree HTTP/2 transport. See
+// grpc_client.h for the role map onto the reference grpc_client.cc.
+
+#include "tpuclient/grpc_client.h"
+
+#include <algorithm>
+#include <cctype>
+#include <cstdlib>
+#include <cstring>
+
+#include "h2.h"
+
+namespace tpuclient {
+
+namespace {
+
+constexpr const char* kServicePrefix = "/inference.GRPCInferenceService/";
+
+// Process-global channel cache keyed by "host:port" (reference
+// grpc_client.cc:48-123). Dead connections are replaced on next Create.
+std::mutex& CacheMutex() {
+  static std::mutex m;
+  return m;
+}
+std::map<std::string, std::shared_ptr<h2::Connection>>& ChannelCache() {
+  static auto* cache = new std::map<std::string,
+                                    std::shared_ptr<h2::Connection>>();
+  return *cache;
+}
+
+// gRPC message framing: 1-byte compressed flag + 4-byte BE length.
+void FrameMessage(const std::string& payload, std::string* out) {
+  out->reserve(5 + payload.size());
+  out->push_back(0);
+  uint32_t n = uint32_t(payload.size());
+  out->push_back(char(n >> 24));
+  out->push_back(char(n >> 16));
+  out->push_back(char(n >> 8));
+  out->push_back(char(n));
+  out->append(payload);
+}
+
+// Pops one complete framed message out of buf[*pos..]; false if incomplete.
+bool PopMessage(const std::string& buf, size_t* pos, std::string* msg,
+                Error* err) {
+  if (buf.size() - *pos < 5) return false;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(buf.data()) + *pos;
+  if (p[0] != 0) {
+    *err = Error("gRPC: compressed messages not supported");
+    return false;
+  }
+  uint32_t len = (uint32_t(p[1]) << 24) | (uint32_t(p[2]) << 16) |
+                 (uint32_t(p[3]) << 8) | uint32_t(p[4]);
+  if (buf.size() - *pos - 5 < len) return false;
+  msg->assign(buf, *pos + 5, len);
+  *pos += 5 + len;
+  return true;
+}
+
+std::string PercentDecode(const std::string& in) {
+  std::string out;
+  out.reserve(in.size());
+  for (size_t i = 0; i < in.size(); ++i) {
+    if (in[i] == '%' && i + 2 < in.size() && isxdigit(in[i + 1]) &&
+        isxdigit(in[i + 2])) {
+      out.push_back(char(std::stoi(in.substr(i + 1, 2), nullptr, 16)));
+      i += 2;
+    } else {
+      out.push_back(in[i]);
+    }
+  }
+  return out;
+}
+
+const std::string* FindHeader(const h2::HeaderList& headers,
+                              const std::string& name) {
+  for (const auto& h : headers) {
+    if (h.first == name) return &h.second;
+  }
+  return nullptr;
+}
+
+// Extracts the gRPC status from a finished stream's header/trailer blocks.
+// found=false when neither block carries grpc-status (stream died early).
+Error GrpcStatusFromStream(const h2::Connection::Stream& s, bool* found) {
+  *found = false;
+  const std::string* status = FindHeader(s.trailers, "grpc-status");
+  const std::string* message = FindHeader(s.trailers, "grpc-message");
+  if (status == nullptr) {
+    status = FindHeader(s.headers, "grpc-status");
+    message = FindHeader(s.headers, "grpc-message");
+  }
+  if (status == nullptr) return Error("gRPC: no status in response");
+  *found = true;
+  int code = atoi(status->c_str());
+  if (code == 0) return Error::Success();
+  std::string msg = message != nullptr ? PercentDecode(*message)
+                                       : "(no message)";
+  // DEADLINE_EXCEEDED(4) maps onto the library's timeout status 499 the way
+  // the HTTP client maps curl timeouts (reference http_client.cc:1278-1279).
+  return Error("gRPC error " + std::to_string(code) + ": " + msg,
+               code == 4 ? 499 : code);
+}
+
+h2::HeaderList CallHeaders(const std::string& authority,
+                           const std::string& method, uint64_t timeout_us,
+                           const GrpcHeaders& extra) {
+  h2::HeaderList h = {
+      {":method", "POST"},
+      {":scheme", "http"},
+      {":path", std::string(kServicePrefix) + method},
+      {":authority", authority},
+      {"te", "trailers"},
+      {"content-type", "application/grpc"},
+      {"user-agent", "tpuclient-grpc/1.0"},
+  };
+  if (timeout_us > 0) {
+    h.emplace_back("grpc-timeout", std::to_string(timeout_us) + "u");
+  }
+  for (const auto& kv : extra) h.emplace_back(kv.first, kv.second);
+  return h;
+}
+
+uint64_t DeadlineNs(uint64_t timeout_us) {
+  return timeout_us == 0 ? 0
+                         : RequestTimers::Now() + timeout_us * 1000;
+}
+
+void SetParam(google::protobuf::Map<std::string, inference::InferParameter>*
+                  params,
+              const std::string& key, int64_t value) {
+  (*params)[key].set_int64_param(value);
+}
+void SetParamBool(google::protobuf::Map<std::string,
+                                        inference::InferParameter>* params,
+                  const std::string& key, bool value) {
+  (*params)[key].set_bool_param(value);
+}
+void SetParamU64(google::protobuf::Map<std::string,
+                                       inference::InferParameter>* params,
+                 const std::string& key, uint64_t value) {
+  (*params)[key].set_uint64_param(value);
+}
+void SetParamStr(google::protobuf::Map<std::string,
+                                       inference::InferParameter>* params,
+                 const std::string& key, const std::string& value) {
+  (*params)[key].set_string_param(value);
+}
+
+}  // namespace
+
+// ------------------------------------------------------- InferResultGrpc ----
+
+Error InferResultGrpc::Create(
+    InferResult** result,
+    std::shared_ptr<inference::ModelInferResponse> response, Error status) {
+  *result = new InferResultGrpc(std::move(response), std::move(status));
+  return Error::Success();
+}
+
+InferResultGrpc::InferResultGrpc(
+    std::shared_ptr<inference::ModelInferResponse> response, Error status)
+    : response_(std::move(response)), status_(std::move(status)) {
+  if (response_ != nullptr) {
+    for (int i = 0; i < response_->outputs_size(); ++i) {
+      index_[response_->outputs(i).name()] = i;
+    }
+  }
+}
+
+Error InferResultGrpc::ModelName(std::string* name) const {
+  if (!status_.IsOk()) return status_;
+  *name = response_->model_name();
+  return Error::Success();
+}
+
+Error InferResultGrpc::ModelVersion(std::string* version) const {
+  if (!status_.IsOk()) return status_;
+  *version = response_->model_version();
+  return Error::Success();
+}
+
+Error InferResultGrpc::Id(std::string* id) const {
+  if (!status_.IsOk()) return status_;
+  *id = response_->id();
+  return Error::Success();
+}
+
+Error InferResultGrpc::Shape(const std::string& output_name,
+                             std::vector<int64_t>* shape) const {
+  if (!status_.IsOk()) return status_;
+  auto it = index_.find(output_name);
+  if (it == index_.end()) {
+    return Error("output '" + output_name + "' not found");
+  }
+  shape->assign(response_->outputs(it->second).shape().begin(),
+                response_->outputs(it->second).shape().end());
+  return Error::Success();
+}
+
+Error InferResultGrpc::Datatype(const std::string& output_name,
+                                std::string* datatype) const {
+  if (!status_.IsOk()) return status_;
+  auto it = index_.find(output_name);
+  if (it == index_.end()) {
+    return Error("output '" + output_name + "' not found");
+  }
+  *datatype = response_->outputs(it->second).datatype();
+  return Error::Success();
+}
+
+Error InferResultGrpc::RawData(const std::string& output_name,
+                               const uint8_t** buf, size_t* byte_size) const {
+  if (!status_.IsOk()) return status_;
+  auto it = index_.find(output_name);
+  if (it == index_.end()) {
+    return Error("output '" + output_name + "' not found");
+  }
+  if (it->second >= response_->raw_output_contents_size()) {
+    // Output lives in shared memory — no inline bytes on the wire.
+    *buf = nullptr;
+    *byte_size = 0;
+    return Error::Success();
+  }
+  const std::string& raw = response_->raw_output_contents(it->second);
+  *buf = reinterpret_cast<const uint8_t*>(raw.data());
+  *byte_size = raw.size();
+  return Error::Success();
+}
+
+Error InferResultGrpc::RequestStatus() const { return status_; }
+
+std::string InferResultGrpc::DebugString() const {
+  if (!status_.IsOk()) return "error: " + status_.Message();
+  return response_->ShortDebugString();
+}
+
+// -------------------------------------------- InferenceServerGrpcClient ----
+
+InferenceServerGrpcClient::InferenceServerGrpcClient(bool verbose)
+    : InferenceServerClient(verbose) {}
+
+Error InferenceServerGrpcClient::Create(
+    std::unique_ptr<InferenceServerGrpcClient>* client, const std::string& url,
+    bool verbose, bool use_cached_channel) {
+  client->reset(new InferenceServerGrpcClient(verbose));
+  Error err = (*client)->Connect(url, use_cached_channel);
+  if (!err.IsOk()) client->reset();
+  return err;
+}
+
+InferenceServerGrpcClient::~InferenceServerGrpcClient() {
+  StopStream();
+  async_exit_ = true;
+  async_cv_.notify_all();
+  if (async_worker_.joinable()) async_worker_.join();
+}
+
+Error InferenceServerGrpcClient::Connect(const std::string& url,
+                                         bool use_cached_channel) {
+  std::string hostport = url;
+  auto scheme = hostport.find("://");
+  if (scheme != std::string::npos) hostport = hostport.substr(scheme + 3);
+  std::string host = hostport;
+  int port = 8001;
+  auto colon = hostport.rfind(':');
+  if (colon != std::string::npos) {
+    host = hostport.substr(0, colon);
+    port = atoi(hostport.c_str() + colon + 1);
+  }
+  authority_ = host + ":" + std::to_string(port);
+
+  if (use_cached_channel) {
+    std::lock_guard<std::mutex> lk(CacheMutex());
+    auto it = ChannelCache().find(authority_);
+    if (it != ChannelCache().end() && it->second->Alive()) {
+      conn_ = it->second;
+      return Error::Success();
+    }
+    auto conn = std::make_shared<h2::Connection>();
+    Error err = conn->Connect(host, port);
+    if (!err.IsOk()) return err;
+    ChannelCache()[authority_] = conn;
+    conn_ = conn;
+    return Error::Success();
+  }
+  conn_ = std::make_shared<h2::Connection>();
+  return conn_->Connect(host, port);
+}
+
+Error InferenceServerGrpcClient::Rpc(const std::string& method,
+                                     const google::protobuf::Message& request,
+                                     google::protobuf::Message* response,
+                                     uint64_t timeout_us,
+                                     const GrpcHeaders& headers) {
+  std::string payload;
+  if (!request.SerializeToString(&payload)) {
+    return Error("failed to serialize " + method + " request");
+  }
+  std::string body;
+  FrameMessage(payload, &body);
+
+  uint64_t deadline = DeadlineNs(timeout_us);
+  int32_t sid = 0;
+  Error err = conn_->StartStream(
+      CallHeaders(authority_, method, timeout_us, headers), false, &sid);
+  if (!err.IsOk()) return err;
+  err = conn_->SendData(sid, reinterpret_cast<const uint8_t*>(body.data()),
+                        body.size(), true, deadline);
+  if (!err.IsOk()) {
+    conn_->CloseStream(sid);
+    return err;
+  }
+  // Unary: wait for the peer half-close (SIZE_MAX min_bytes can never be
+  // satisfied by data alone, so this unblocks on end_stream/reset/deadline).
+  if (!conn_->WaitStream(sid, SIZE_MAX, deadline)) {
+    conn_->CloseStream(sid);
+    return Error("Deadline Exceeded", 499);
+  }
+  std::string msg;
+  Error status("stream vanished");
+  bool have_status = false;
+  conn_->WithStream(sid, [&](h2::Connection::Stream& s) {
+    if (s.reset && !s.end_stream) {
+      status = Error("gRPC: stream reset (code " +
+                     std::to_string(s.reset_code) + ")" +
+                     (conn_->Alive() ? "" : ": " + conn_->ConnectionError()));
+      have_status = true;
+      return;
+    }
+    status = GrpcStatusFromStream(s, &have_status);
+    if (!have_status) {
+      status = Error("gRPC: missing response status");
+      have_status = true;
+      return;
+    }
+    if (status.IsOk()) {
+      size_t pos = 0;
+      Error perr = Error::Success();
+      if (!PopMessage(s.data, &pos, &msg, &perr)) {
+        status = perr.IsOk() ? Error("gRPC: empty unary response") : perr;
+      }
+    }
+  });
+  conn_->CloseStream(sid);
+  if (!status.IsOk()) return status;
+  if (!response->ParseFromString(msg)) {
+    return Error("failed to parse " + method + " response");
+  }
+  return Error::Success();
+}
+
+// -- control plane -----------------------------------------------------------
+
+Error InferenceServerGrpcClient::IsServerLive(bool* live) {
+  inference::ServerLiveRequest req;
+  inference::ServerLiveResponse resp;
+  Error err = Rpc("ServerLive", req, &resp);
+  if (err.IsOk()) *live = resp.live();
+  return err;
+}
+
+Error InferenceServerGrpcClient::IsServerReady(bool* ready) {
+  inference::ServerReadyRequest req;
+  inference::ServerReadyResponse resp;
+  Error err = Rpc("ServerReady", req, &resp);
+  if (err.IsOk()) *ready = resp.ready();
+  return err;
+}
+
+Error InferenceServerGrpcClient::IsModelReady(
+    bool* ready, const std::string& model_name,
+    const std::string& model_version) {
+  inference::ModelReadyRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  inference::ModelReadyResponse resp;
+  Error err = Rpc("ModelReady", req, &resp);
+  if (err.IsOk()) *ready = resp.ready();
+  return err;
+}
+
+Error InferenceServerGrpcClient::ServerMetadata(
+    inference::ServerMetadataResponse* response) {
+  inference::ServerMetadataRequest req;
+  return Rpc("ServerMetadata", req, response);
+}
+
+Error InferenceServerGrpcClient::ModelMetadata(
+    inference::ModelMetadataResponse* response, const std::string& model_name,
+    const std::string& model_version) {
+  inference::ModelMetadataRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  return Rpc("ModelMetadata", req, response);
+}
+
+Error InferenceServerGrpcClient::ModelConfig(
+    inference::ModelConfigResponse* response, const std::string& model_name,
+    const std::string& model_version) {
+  inference::ModelConfigRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  return Rpc("ModelConfig", req, response);
+}
+
+Error InferenceServerGrpcClient::ModelRepositoryIndex(
+    inference::RepositoryIndexResponse* response) {
+  inference::RepositoryIndexRequest req;
+  return Rpc("RepositoryIndex", req, response);
+}
+
+Error InferenceServerGrpcClient::LoadModel(const std::string& model_name) {
+  inference::RepositoryModelLoadRequest req;
+  req.set_model_name(model_name);
+  inference::RepositoryModelLoadResponse resp;
+  return Rpc("RepositoryModelLoad", req, &resp);
+}
+
+Error InferenceServerGrpcClient::UnloadModel(const std::string& model_name) {
+  inference::RepositoryModelUnloadRequest req;
+  req.set_model_name(model_name);
+  inference::RepositoryModelUnloadResponse resp;
+  return Rpc("RepositoryModelUnload", req, &resp);
+}
+
+Error InferenceServerGrpcClient::ModelInferenceStatistics(
+    inference::ModelStatisticsResponse* response,
+    const std::string& model_name, const std::string& model_version) {
+  inference::ModelStatisticsRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  return Rpc("ModelStatistics", req, response);
+}
+
+Error InferenceServerGrpcClient::RegisterSystemSharedMemory(
+    const std::string& name, const std::string& key, size_t byte_size,
+    size_t offset) {
+  inference::SystemSharedMemoryRegisterRequest req;
+  req.set_name(name);
+  req.set_key(key);
+  req.set_offset(offset);
+  req.set_byte_size(byte_size);
+  inference::SystemSharedMemoryRegisterResponse resp;
+  return Rpc("SystemSharedMemoryRegister", req, &resp);
+}
+
+Error InferenceServerGrpcClient::UnregisterSystemSharedMemory(
+    const std::string& name) {
+  inference::SystemSharedMemoryUnregisterRequest req;
+  req.set_name(name);
+  inference::SystemSharedMemoryUnregisterResponse resp;
+  return Rpc("SystemSharedMemoryUnregister", req, &resp);
+}
+
+Error InferenceServerGrpcClient::SystemSharedMemoryStatus(
+    inference::SystemSharedMemoryStatusResponse* response) {
+  inference::SystemSharedMemoryStatusRequest req;
+  return Rpc("SystemSharedMemoryStatus", req, response);
+}
+
+Error InferenceServerGrpcClient::RegisterTpuSharedMemory(
+    const std::string& name, const std::string& raw_handle, int64_t device_id,
+    size_t byte_size) {
+  inference::TpuSharedMemoryRegisterRequest req;
+  req.set_name(name);
+  req.set_raw_handle(raw_handle);
+  req.set_device_id(device_id);
+  req.set_byte_size(byte_size);
+  inference::TpuSharedMemoryRegisterResponse resp;
+  return Rpc("TpuSharedMemoryRegister", req, &resp);
+}
+
+Error InferenceServerGrpcClient::UnregisterTpuSharedMemory(
+    const std::string& name) {
+  inference::TpuSharedMemoryUnregisterRequest req;
+  req.set_name(name);
+  inference::TpuSharedMemoryUnregisterResponse resp;
+  return Rpc("TpuSharedMemoryUnregister", req, &resp);
+}
+
+Error InferenceServerGrpcClient::TpuSharedMemoryStatus(
+    inference::TpuSharedMemoryStatusResponse* response) {
+  inference::TpuSharedMemoryStatusRequest req;
+  return Rpc("TpuSharedMemoryStatus", req, response);
+}
+
+// -- infer request build (reference PreRunProcessing, grpc_client.cc:1084) --
+
+void InferenceServerGrpcClient::BuildRequest(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    inference::ModelInferRequest* request) {
+  // Clear() keeps protobuf arena/heap blocks around, giving the same
+  // allocation-reuse benefit as the reference's submessage recycling.
+  request->Clear();
+  request->set_model_name(options.model_name);
+  request->set_model_version(options.model_version);
+  request->set_id(options.request_id);
+  auto* params = request->mutable_parameters();
+  if (options.sequence_id != 0) {
+    SetParamU64(params, "sequence_id", options.sequence_id);
+    SetParamBool(params, "sequence_start", options.sequence_start);
+    SetParamBool(params, "sequence_end", options.sequence_end);
+  }
+  if (options.priority != 0) SetParamU64(params, "priority", options.priority);
+  if (options.server_timeout_us != 0) {
+    SetParamU64(params, "timeout", options.server_timeout_us);
+  }
+  for (const InferInput* input : inputs) {
+    auto* tensor = request->add_inputs();
+    tensor->set_name(input->Name());
+    tensor->set_datatype(input->Datatype());
+    for (int64_t d : input->Shape()) tensor->add_shape(d);
+    if (input->IsSharedMemory()) {
+      auto* tparams = tensor->mutable_parameters();
+      SetParamStr(tparams, "shared_memory_region", input->SharedMemoryName());
+      SetParamU64(tparams, "shared_memory_byte_size",
+                  input->SharedMemoryByteSize());
+      if (input->SharedMemoryOffset() != 0) {
+        SetParamU64(tparams, "shared_memory_offset",
+                    input->SharedMemoryOffset());
+      }
+    } else {
+      // Scatter-gather buffers concatenate into one raw content entry (the
+      // hot memcpy path, reference grpc_client.cc raw_input_contents loop).
+      std::string* raw = request->add_raw_input_contents();
+      raw->reserve(input->TotalByteSize());
+      for (const auto& buf : input->Buffers()) {
+        raw->append(reinterpret_cast<const char*>(buf.first), buf.second);
+      }
+    }
+  }
+  for (const InferRequestedOutput* output : outputs) {
+    auto* tensor = request->add_outputs();
+    tensor->set_name(output->Name());
+    auto* oparams = tensor->mutable_parameters();
+    if (output->ClassCount() > 0) {
+      SetParam(oparams, "classification", int64_t(output->ClassCount()));
+    }
+    if (output->IsSharedMemory()) {
+      SetParamStr(oparams, "shared_memory_region", output->SharedMemoryName());
+      SetParamU64(oparams, "shared_memory_byte_size",
+                  output->SharedMemoryByteSize());
+      if (output->SharedMemoryOffset() != 0) {
+        SetParamU64(oparams, "shared_memory_offset",
+                    output->SharedMemoryOffset());
+      }
+    }
+  }
+}
+
+// -- sync infer --------------------------------------------------------------
+
+Error InferenceServerGrpcClient::Infer(
+    InferResult** result, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const GrpcHeaders& headers) {
+  RequestTimers timers;
+  timers.Capture(RequestTimers::Kind::REQUEST_START);
+
+  std::string payload;
+  {
+    std::lock_guard<std::mutex> lk(sync_mutex_);
+    BuildRequest(options, inputs, outputs, &sync_request_);
+    if (!sync_request_.SerializeToString(&payload)) {
+      return Error("failed to serialize infer request");
+    }
+  }
+  std::string body;
+  FrameMessage(payload, &body);
+
+  uint64_t deadline = DeadlineNs(options.client_timeout_us);
+  int32_t sid = 0;
+  timers.Capture(RequestTimers::Kind::SEND_START);
+  Error err = conn_->StartStream(
+      CallHeaders(authority_, "ModelInfer", options.client_timeout_us,
+                  headers),
+      false, &sid);
+  if (!err.IsOk()) return err;
+  err = conn_->SendData(sid, reinterpret_cast<const uint8_t*>(body.data()),
+                        body.size(), true, deadline);
+  timers.Capture(RequestTimers::Kind::SEND_END);
+  if (!err.IsOk()) {
+    conn_->CloseStream(sid);
+    return err;
+  }
+  if (!conn_->WaitStream(sid, SIZE_MAX, deadline)) {
+    conn_->CloseStream(sid);
+    return Error("Deadline Exceeded", 499);
+  }
+  timers.Capture(RequestTimers::Kind::RECV_START);
+  auto response = std::make_shared<inference::ModelInferResponse>();
+  Error status("stream vanished");
+  conn_->WithStream(sid, [&](h2::Connection::Stream& s) {
+    if (s.reset && !s.end_stream) {
+      status = Error("gRPC: stream reset (code " +
+                     std::to_string(s.reset_code) + ")" +
+                     (conn_->Alive() ? "" : ": " + conn_->ConnectionError()));
+      return;
+    }
+    bool have = false;
+    status = GrpcStatusFromStream(s, &have);
+    if (!status.IsOk()) return;
+    size_t pos = 0;
+    std::string msg;
+    Error perr = Error::Success();
+    if (!PopMessage(s.data, &pos, &msg, &perr)) {
+      status = perr.IsOk() ? Error("gRPC: empty infer response") : perr;
+      return;
+    }
+    if (!response->ParseFromString(msg)) {
+      status = Error("failed to parse infer response");
+    }
+  });
+  conn_->CloseStream(sid);
+  timers.Capture(RequestTimers::Kind::RECV_END);
+  timers.Capture(RequestTimers::Kind::REQUEST_END);
+  if (!status.IsOk()) return status;
+  UpdateInferStat(timers);
+  return InferResultGrpc::Create(result, std::move(response));
+}
+
+// -- async infer -------------------------------------------------------------
+
+Error InferenceServerGrpcClient::AsyncInfer(
+    OnCompleteFn callback, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const GrpcHeaders& headers) {
+  if (callback == nullptr) {
+    return Error("callback is required for AsyncInfer");
+  }
+  {
+    // Lazy worker spawn (reference grpc_client.cc:934-936).
+    std::lock_guard<std::mutex> lk(async_mutex_);
+    if (!async_worker_.joinable()) {
+      async_worker_ = std::thread([this] { AsyncWorker(); });
+    }
+  }
+
+  auto job = std::make_shared<AsyncJob>();
+  job->callback = std::move(callback);
+  job->timers.Capture(RequestTimers::Kind::REQUEST_START);
+
+  inference::ModelInferRequest request;
+  BuildRequest(options, inputs, outputs, &request);
+  std::string payload;
+  if (!request.SerializeToString(&payload)) {
+    return Error("failed to serialize infer request");
+  }
+  std::string body;
+  FrameMessage(payload, &body);
+
+  uint64_t deadline = DeadlineNs(options.client_timeout_us);
+  job->timers.Capture(RequestTimers::Kind::SEND_START);
+  Error err = conn_->StartStream(
+      CallHeaders(authority_, "ModelInfer", options.client_timeout_us,
+                  headers),
+      false, &job->sid);
+  if (!err.IsOk()) return err;
+  // Completion signal: the h2 reader calls on_event with its stream lock
+  // held, so the handler must stay lock-free — it only pokes the worker cv.
+  conn_->WithStream(job->sid, [this](h2::Connection::Stream& s) {
+    s.on_event = [this] {
+      async_events_.fetch_add(1);
+      async_cv_.notify_all();
+    };
+  });
+  err = conn_->SendData(job->sid,
+                        reinterpret_cast<const uint8_t*>(body.data()),
+                        body.size(), true, deadline);
+  job->timers.Capture(RequestTimers::Kind::SEND_END);
+  if (!err.IsOk()) {
+    conn_->CloseStream(job->sid);
+    return err;
+  }
+  {
+    std::lock_guard<std::mutex> lk(async_mutex_);
+    async_jobs_.push_back(job);
+  }
+  async_events_.fetch_add(1);
+  async_cv_.notify_all();
+  return Error::Success();
+}
+
+void InferenceServerGrpcClient::AsyncWorker() {
+  // Drains completions, mirroring the reference's AsyncTransfer CQ loop
+  // (grpc_client.cc:1225-1268). The timed wait is a backstop against the
+  // (benign) lost-wakeup window of the lock-free on_event notify.
+  uint64_t seen = 0;
+  while (true) {
+    std::vector<std::shared_ptr<AsyncJob>> jobs;
+    {
+      std::unique_lock<std::mutex> lk(async_mutex_);
+      async_cv_.wait_for(lk, std::chrono::milliseconds(50), [&] {
+        return async_exit_.load() || async_events_.load() != seen;
+      });
+      seen = async_events_.load();
+      if (async_exit_.load()) {
+        // Fail whatever is still in flight so callbacks always fire.
+        jobs.assign(async_jobs_.begin(), async_jobs_.end());
+        async_jobs_.clear();
+        lk.unlock();
+        for (auto& job : jobs) {
+          conn_->CloseStream(job->sid);
+          InferResult* result = nullptr;
+          InferResultGrpc::Create(&result, nullptr,
+                                  Error("client shutting down"));
+          job->callback(result);
+        }
+        return;
+      }
+      jobs.assign(async_jobs_.begin(), async_jobs_.end());
+    }
+    for (auto& job : jobs) {
+      bool done = false;
+      Error status("stream vanished");
+      auto response = std::make_shared<inference::ModelInferResponse>();
+      bool present = conn_->WithStream(
+          job->sid, [&](h2::Connection::Stream& s) {
+            if (!s.end_stream && !s.reset) return;
+            done = true;
+            if (s.reset && !s.end_stream) {
+              status = Error("gRPC: stream reset (code " +
+                             std::to_string(s.reset_code) + ")");
+              return;
+            }
+            bool have = false;
+            status = GrpcStatusFromStream(s, &have);
+            if (!status.IsOk()) return;
+            size_t pos = 0;
+            std::string msg;
+            Error perr = Error::Success();
+            if (!PopMessage(s.data, &pos, &msg, &perr)) {
+              status =
+                  perr.IsOk() ? Error("gRPC: empty infer response") : perr;
+              return;
+            }
+            if (!response->ParseFromString(msg)) {
+              status = Error("failed to parse infer response");
+            }
+          });
+      if (!present) {
+        done = true;
+        status = Error("stream closed before completion");
+      }
+      if (!done) continue;
+      conn_->CloseStream(job->sid);
+      {
+        std::lock_guard<std::mutex> lk(async_mutex_);
+        auto it = std::find(async_jobs_.begin(), async_jobs_.end(), job);
+        if (it != async_jobs_.end()) async_jobs_.erase(it);
+      }
+      job->timers.Capture(RequestTimers::Kind::RECV_START);
+      job->timers.Capture(RequestTimers::Kind::RECV_END);
+      job->timers.Capture(RequestTimers::Kind::REQUEST_END);
+      if (status.IsOk()) UpdateInferStat(job->timers);
+      InferResult* result = nullptr;
+      InferResultGrpc::Create(&result, std::move(response),
+                              std::move(status));
+      job->callback(result);
+    }
+  }
+}
+
+// -- streaming ---------------------------------------------------------------
+
+Error InferenceServerGrpcClient::StartStream(OnCompleteFn callback,
+                                             const GrpcHeaders& headers) {
+  if (callback == nullptr) return Error("callback is required");
+  std::lock_guard<std::mutex> lk(stream_mutex_);
+  if (stream_active_) return Error("stream already active");
+  int32_t sid = 0;
+  Error err = conn_->StartStream(
+      CallHeaders(authority_, "ModelStreamInfer", 0, headers), false, &sid);
+  if (!err.IsOk()) return err;
+  stream_sid_ = sid;
+  stream_callback_ = std::move(callback);
+  stream_active_ = true;
+  stream_exit_ = false;
+  stream_worker_ = std::thread([this] { StreamWorker(); });
+  return Error::Success();
+}
+
+Error InferenceServerGrpcClient::AsyncStreamInfer(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  int32_t sid;
+  {
+    std::lock_guard<std::mutex> lk(stream_mutex_);
+    if (!stream_active_) return Error("no active stream; call StartStream");
+    sid = stream_sid_;
+  }
+  inference::ModelInferRequest request;
+  BuildRequest(options, inputs, outputs, &request);
+  std::string payload;
+  if (!request.SerializeToString(&payload)) {
+    return Error("failed to serialize stream infer request");
+  }
+  std::string body;
+  FrameMessage(payload, &body);
+  return conn_->SendData(sid, reinterpret_cast<const uint8_t*>(body.data()),
+                         body.size(), false,
+                         DeadlineNs(options.client_timeout_us));
+}
+
+void InferenceServerGrpcClient::StreamWorker() {
+  // Reads stream responses in order and fires the user callback per message
+  // (reference AsyncStreamTransfer read loop, grpc_client.cc:1271-1315).
+  while (true) {
+    bool closed = false;
+    std::vector<std::string> messages;
+    Error terminal = Error::Success();
+    // Bounded wait so StopStream's stream_exit_ flag is honored even when
+    // the peer never closes; normal wakeups come from the reader's
+    // state_cv_ notifications inside WaitStream.
+    conn_->WaitStream(stream_sid_, 5,
+                      RequestTimers::Now() + uint64_t(250e6));
+    if (stream_exit_.load()) return;
+    bool present = conn_->WithStream(
+        stream_sid_, [&](h2::Connection::Stream& s) {
+          Error perr = Error::Success();
+          std::string msg;
+          size_t pos = s.consumed;
+          while (PopMessage(s.data, &pos, &msg, &perr)) {
+            messages.push_back(std::move(msg));
+          }
+          s.consumed = pos;
+          // Trim consumed prefix so long-lived streams don't grow without
+          // bound.
+          if (s.consumed > (1u << 20)) {
+            s.data.erase(0, s.consumed);
+            s.consumed = 0;
+          }
+          if (!perr.IsOk()) {
+            closed = true;
+            terminal = perr;
+            return;
+          }
+          if (s.reset && !s.end_stream) {
+            closed = true;
+            terminal = Error("gRPC: stream reset (code " +
+                             std::to_string(s.reset_code) + ")");
+          } else if (s.end_stream) {
+            // All complete messages were popped above; anything left is a
+            // truncated tail that can never complete.
+            closed = true;
+            bool have = false;
+            terminal = GrpcStatusFromStream(s, &have);
+          }
+        });
+    if (!present) return;
+    for (auto& msg : messages) {
+      inference::ModelStreamInferResponse stream_response;
+      InferResult* result = nullptr;
+      if (!stream_response.ParseFromString(msg)) {
+        InferResultGrpc::Create(&result, nullptr,
+                                Error("failed to parse stream response"));
+      } else if (!stream_response.error_message().empty()) {
+        InferResultGrpc::Create(&result, nullptr,
+                                Error(stream_response.error_message()));
+      } else {
+        auto response = std::make_shared<inference::ModelInferResponse>(
+            std::move(*stream_response.mutable_infer_response()));
+        InferResultGrpc::Create(&result, std::move(response));
+      }
+      stream_callback_(result);
+    }
+    if (closed) {
+      if (!terminal.IsOk() && !stream_exit_.load()) {
+        InferResult* result = nullptr;
+        InferResultGrpc::Create(&result, nullptr, terminal);
+        stream_callback_(result);
+      }
+      return;
+    }
+    if (stream_exit_.load()) return;
+  }
+}
+
+Error InferenceServerGrpcClient::StopStream() {
+  int32_t sid;
+  {
+    std::lock_guard<std::mutex> lk(stream_mutex_);
+    if (!stream_active_) return Error::Success();
+    sid = stream_sid_;
+  }
+  // Half-close; the server answers with trailers, the worker drains and
+  // exits, then the stream can be dropped.
+  conn_->SendData(sid, nullptr, 0, true);
+  uint64_t deadline = RequestTimers::Now() + uint64_t(5e9);
+  conn_->WaitStream(sid, SIZE_MAX, deadline);
+  stream_exit_ = true;
+  stream_cv_.notify_all();
+  if (stream_worker_.joinable()) stream_worker_.join();
+  conn_->CloseStream(sid);
+  std::lock_guard<std::mutex> lk(stream_mutex_);
+  stream_active_ = false;
+  stream_callback_ = nullptr;
+  stream_sid_ = 0;
+  return Error::Success();
+}
+
+}  // namespace tpuclient

@@ -1,0 +1,819 @@
+// HTTP/2 + HPACK client transport implementation. See h2.h for design notes.
+//
+// Protocol references: RFC 7540 (framing, flow control), RFC 7541 (HPACK).
+// The Huffman table is generated and cross-verified against libnghttp2 by
+// tools/gen_hpack_table.py.
+
+#include "h2.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <unordered_map>
+
+namespace tpuclient {
+namespace h2 {
+
+namespace {
+
+#include "hpack_huffman.inc"
+
+// Frame types (RFC 7540 §6).
+constexpr uint8_t kData = 0x0;
+constexpr uint8_t kHeaders = 0x1;
+constexpr uint8_t kRstStream = 0x3;
+constexpr uint8_t kSettings = 0x4;
+constexpr uint8_t kPing = 0x6;
+constexpr uint8_t kGoaway = 0x7;
+constexpr uint8_t kWindowUpdate = 0x8;
+constexpr uint8_t kContinuation = 0x9;
+
+// Flags.
+constexpr uint8_t kFlagEndStream = 0x1;
+constexpr uint8_t kFlagAck = 0x1;
+constexpr uint8_t kFlagEndHeaders = 0x4;
+constexpr uint8_t kFlagPadded = 0x8;
+constexpr uint8_t kFlagPriority = 0x20;
+
+// Settings ids.
+constexpr uint16_t kSettingsEnablePush = 0x2;
+constexpr uint16_t kSettingsInitialWindowSize = 0x4;
+constexpr uint16_t kSettingsMaxFrameSize = 0x5;
+
+constexpr int64_t kOurStreamWindow = 4 << 20;   // INITIAL_WINDOW_SIZE we set
+constexpr int64_t kOurConnWindow = 16 << 20;    // connection recv window
+
+// HPACK static table (RFC 7541 Appendix A).
+const struct { const char* name; const char* value; } kStaticTable[61] = {
+    {":authority", ""},
+    {":method", "GET"},
+    {":method", "POST"},
+    {":path", "/"},
+    {":path", "/index.html"},
+    {":scheme", "http"},
+    {":scheme", "https"},
+    {":status", "200"},
+    {":status", "204"},
+    {":status", "206"},
+    {":status", "304"},
+    {":status", "400"},
+    {":status", "404"},
+    {":status", "500"},
+    {"accept-charset", ""},
+    {"accept-encoding", "gzip, deflate"},
+    {"accept-language", ""},
+    {"accept-ranges", ""},
+    {"accept", ""},
+    {"access-control-allow-origin", ""},
+    {"age", ""},
+    {"allow", ""},
+    {"authorization", ""},
+    {"cache-control", ""},
+    {"content-disposition", ""},
+    {"content-encoding", ""},
+    {"content-language", ""},
+    {"content-length", ""},
+    {"content-location", ""},
+    {"content-range", ""},
+    {"content-type", ""},
+    {"cookie", ""},
+    {"date", ""},
+    {"etag", ""},
+    {"expect", ""},
+    {"expires", ""},
+    {"from", ""},
+    {"host", ""},
+    {"if-match", ""},
+    {"if-modified-since", ""},
+    {"if-none-match", ""},
+    {"if-range", ""},
+    {"if-unmodified-since", ""},
+    {"last-modified", ""},
+    {"link", ""},
+    {"location", ""},
+    {"max-forwards", ""},
+    {"proxy-authenticate", ""},
+    {"proxy-authorization", ""},
+    {"range", ""},
+    {"referer", ""},
+    {"refresh", ""},
+    {"retry-after", ""},
+    {"server", ""},
+    {"set-cookie", ""},
+    {"strict-transport-security", ""},
+    {"transfer-encoding", ""},
+    {"user-agent", ""},
+    {"vary", ""},
+    {"via", ""},
+    {"www-authenticate", ""},
+};
+
+// (nbits<<32 | code) -> symbol, built lazily once.
+const std::unordered_map<uint64_t, uint8_t>& HuffmanReverse() {
+  static const std::unordered_map<uint64_t, uint8_t>* map = [] {
+    auto* m = new std::unordered_map<uint64_t, uint8_t>();
+    m->reserve(256);
+    for (int s = 0; s < 256; ++s) {
+      (*m)[(uint64_t(kHuffmanTable[s].nbits) << 32) | kHuffmanTable[s].code] =
+          uint8_t(s);
+    }
+    return m;
+  }();
+  return *map;
+}
+
+void PutU32(std::string* out, uint32_t v) {
+  out->push_back(char(v >> 24));
+  out->push_back(char(v >> 16));
+  out->push_back(char(v >> 8));
+  out->push_back(char(v));
+}
+
+uint32_t GetU32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+void HpackEncodeInt(uint64_t value, int prefix_bits, uint8_t first_flags,
+                    std::string* out) {
+  uint64_t mask = (1u << prefix_bits) - 1;
+  if (value < mask) {
+    out->push_back(char(first_flags | value));
+    return;
+  }
+  out->push_back(char(first_flags | mask));
+  value -= mask;
+  while (value >= 0x80) {
+    out->push_back(char(0x80 | (value & 0x7F)));
+    value >>= 7;
+  }
+  out->push_back(char(value));
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------- HPACK ----
+
+void HuffmanEncode(const std::string& in, std::string* out) {
+  uint64_t acc = 0;
+  int nacc = 0;
+  for (unsigned char c : in) {
+    acc = (acc << kHuffmanTable[c].nbits) | kHuffmanTable[c].code;
+    nacc += kHuffmanTable[c].nbits;
+    while (nacc >= 8) {
+      nacc -= 8;
+      out->push_back(char((acc >> nacc) & 0xFF));
+    }
+  }
+  if (nacc > 0) {
+    int pad = 8 - nacc;
+    out->push_back(char(((acc << pad) | ((1u << pad) - 1)) & 0xFF));
+  }
+}
+
+Error HuffmanDecode(const uint8_t* data, size_t len, std::string* out) {
+  const auto& rev = HuffmanReverse();
+  uint64_t acc = 0;
+  int nacc = 0;
+  for (size_t i = 0; i < len; ++i) {
+    acc = (acc << 8) | data[i];
+    nacc += 8;
+    bool matched = true;
+    while (matched && nacc >= 5) {
+      matched = false;
+      int maxb = nacc < 30 ? nacc : 30;
+      for (int nb = 5; nb <= maxb; ++nb) {
+        uint64_t code = (acc >> (nacc - nb)) & ((1u << nb) - 1);
+        auto it = rev.find((uint64_t(nb) << 32) | code);
+        if (it != rev.end()) {
+          out->push_back(char(it->second));
+          nacc -= nb;
+          acc &= (uint64_t(1) << nacc) - 1;
+          matched = true;
+          break;
+        }
+      }
+    }
+    if (nacc > 30) return Error("HPACK: invalid Huffman sequence");
+  }
+  // Remaining bits must be the EOS-prefix padding: < 8 bits, all ones.
+  if (nacc >= 8 || acc != (uint64_t(1) << nacc) - 1) {
+    return Error("HPACK: invalid Huffman padding");
+  }
+  return Error::Success();
+}
+
+void HpackEncode(const HeaderList& headers, std::string* out) {
+  for (const auto& h : headers) {
+    // Literal Header Field without Indexing — New Name (RFC 7541 §6.2.2).
+    out->push_back(0x00);
+    HpackEncodeInt(h.first.size(), 7, 0x00, out);
+    out->append(h.first);
+    HpackEncodeInt(h.second.size(), 7, 0x00, out);
+    out->append(h.second);
+  }
+}
+
+Error HpackDecoder::ReadInt(const uint8_t* data, size_t len, size_t* pos,
+                            int prefix_bits, uint64_t* value) {
+  if (*pos >= len) return Error("HPACK: truncated integer");
+  uint64_t mask = (1u << prefix_bits) - 1;
+  *value = data[(*pos)++] & mask;
+  if (*value < mask) return Error::Success();
+  int shift = 0;
+  while (true) {
+    if (*pos >= len) return Error("HPACK: truncated varint");
+    if (shift > 56) return Error("HPACK: integer overflow");
+    uint8_t b = data[(*pos)++];
+    *value += uint64_t(b & 0x7F) << shift;
+    shift += 7;
+    if (!(b & 0x80)) return Error::Success();
+  }
+}
+
+Error HpackDecoder::ReadString(const uint8_t* data, size_t len, size_t* pos,
+                               std::string* out) {
+  if (*pos >= len) return Error("HPACK: truncated string");
+  bool huffman = (data[*pos] & 0x80) != 0;
+  uint64_t slen;
+  Error err = ReadInt(data, len, pos, 7, &slen);
+  if (!err.IsOk()) return err;
+  if (*pos + slen > len) return Error("HPACK: string exceeds block");
+  if (huffman) {
+    err = HuffmanDecode(data + *pos, slen, out);
+    if (!err.IsOk()) return err;
+  } else {
+    out->assign(reinterpret_cast<const char*>(data + *pos), slen);
+  }
+  *pos += slen;
+  return Error::Success();
+}
+
+Error HpackDecoder::LookupIndex(uint64_t index, std::string* name,
+                                std::string* value) {
+  if (index == 0) return Error("HPACK: index 0");
+  if (index <= 61) {
+    *name = kStaticTable[index - 1].name;
+    *value = kStaticTable[index - 1].value;
+    return Error::Success();
+  }
+  size_t di = index - 62;
+  if (di >= dynamic_.size()) return Error("HPACK: index out of range");
+  *name = dynamic_[di].first;
+  *value = dynamic_[di].second;
+  return Error::Success();
+}
+
+void HpackDecoder::DynamicInsert(const std::string& name,
+                                 const std::string& value) {
+  dynamic_.emplace_front(name, value);
+  dynamic_size_ += name.size() + value.size() + 32;
+  EvictToFit();
+}
+
+void HpackDecoder::EvictToFit() {
+  while (dynamic_size_ > max_dynamic_size_ && !dynamic_.empty()) {
+    dynamic_size_ -=
+        dynamic_.back().first.size() + dynamic_.back().second.size() + 32;
+    dynamic_.pop_back();
+  }
+}
+
+Error HpackDecoder::Decode(const uint8_t* data, size_t len, HeaderList* out) {
+  size_t pos = 0;
+  while (pos < len) {
+    uint8_t b = data[pos];
+    std::string name, value;
+    Error err;
+    uint64_t index;
+    if (b & 0x80) {  // Indexed Header Field (§6.1)
+      err = ReadInt(data, len, &pos, 7, &index);
+      if (!err.IsOk()) return err;
+      err = LookupIndex(index, &name, &value);
+      if (!err.IsOk()) return err;
+      out->emplace_back(std::move(name), std::move(value));
+    } else if (b & 0x40) {  // Literal with Incremental Indexing (§6.2.1)
+      err = ReadInt(data, len, &pos, 6, &index);
+      if (!err.IsOk()) return err;
+      if (index > 0) {
+        std::string ignored;
+        err = LookupIndex(index, &name, &ignored);
+        if (!err.IsOk()) return err;
+      } else {
+        err = ReadString(data, len, &pos, &name);
+        if (!err.IsOk()) return err;
+      }
+      err = ReadString(data, len, &pos, &value);
+      if (!err.IsOk()) return err;
+      DynamicInsert(name, value);
+      out->emplace_back(std::move(name), std::move(value));
+    } else if ((b & 0xE0) == 0x20) {  // Dynamic Table Size Update (§6.3)
+      err = ReadInt(data, len, &pos, 5, &index);
+      if (!err.IsOk()) return err;
+      max_dynamic_size_ = index;
+      EvictToFit();
+    } else {  // Literal without Indexing / Never Indexed (§6.2.2/§6.2.3)
+      err = ReadInt(data, len, &pos, 4, &index);
+      if (!err.IsOk()) return err;
+      if (index > 0) {
+        std::string ignored;
+        err = LookupIndex(index, &name, &ignored);
+        if (!err.IsOk()) return err;
+      } else {
+        err = ReadString(data, len, &pos, &name);
+        if (!err.IsOk()) return err;
+      }
+      err = ReadString(data, len, &pos, &value);
+      if (!err.IsOk()) return err;
+      out->emplace_back(std::move(name), std::move(value));
+    }
+  }
+  return Error::Success();
+}
+
+// ----------------------------------------------------------- connection ----
+
+Connection::~Connection() {
+  FailConnection("connection destroyed");
+  if (reader_.joinable()) reader_.join();
+  if (fd_ >= 0) ::close(fd_);
+}
+
+Error Connection::Connect(const std::string& host, int port) {
+  struct addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  int rv = ::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                         &res);
+  if (rv != 0) {
+    return Error("getaddrinfo(" + host + "): " + gai_strerror(rv));
+  }
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  ::freeaddrinfo(res);
+  if (fd < 0) return Error("failed to connect to " + host);
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  fd_ = fd;
+
+  // Client preface + SETTINGS + connection window bump (RFC 7540 §3.5).
+  static const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+  std::string settings;
+  auto put_setting = [&settings](uint16_t id, uint32_t v) {
+    settings.push_back(char(id >> 8));
+    settings.push_back(char(id));
+    PutU32(&settings, v);
+  };
+  put_setting(kSettingsEnablePush, 0);
+  put_setting(kSettingsInitialWindowSize, uint32_t(kOurStreamWindow));
+  std::lock_guard<std::mutex> wl(write_mutex_);
+  Error err = SendRaw(reinterpret_cast<const uint8_t*>(kPreface),
+                      sizeof(kPreface) - 1);
+  if (!err.IsOk()) return err;
+  err = SendFrame(kSettings, 0, 0,
+                  reinterpret_cast<const uint8_t*>(settings.data()),
+                  settings.size());
+  if (!err.IsOk()) return err;
+  std::string wu;
+  PutU32(&wu, uint32_t(kOurConnWindow - 65535));
+  err = SendFrame(kWindowUpdate, 0, 0,
+                  reinterpret_cast<const uint8_t*>(wu.data()), wu.size());
+  if (!err.IsOk()) return err;
+
+  reader_ = std::thread([this] { ReaderLoop(); });
+  return Error::Success();
+}
+
+Error Connection::SendRaw(const uint8_t* data, size_t len) {
+  size_t off = 0;
+  while (off < len) {
+    ssize_t n = ::send(fd_, data + off, len - off, MSG_NOSIGNAL);
+    if (n <= 0) {
+      return Error("h2 send failed: " +
+                   std::string(n < 0 ? strerror(errno) : "closed"));
+    }
+    off += size_t(n);
+  }
+  return Error::Success();
+}
+
+Error Connection::SendFrame(uint8_t type, uint8_t flags, int32_t sid,
+                            const uint8_t* payload, size_t len) {
+  uint8_t hdr[9];
+  hdr[0] = uint8_t(len >> 16);
+  hdr[1] = uint8_t(len >> 8);
+  hdr[2] = uint8_t(len);
+  hdr[3] = type;
+  hdr[4] = flags;
+  hdr[5] = uint8_t(uint32_t(sid) >> 24) & 0x7F;
+  hdr[6] = uint8_t(uint32_t(sid) >> 16);
+  hdr[7] = uint8_t(uint32_t(sid) >> 8);
+  hdr[8] = uint8_t(uint32_t(sid));
+  Error err = SendRaw(hdr, 9);
+  if (!err.IsOk()) return err;
+  if (len > 0) return SendRaw(payload, len);
+  return Error::Success();
+}
+
+Error Connection::StartStream(const HeaderList& headers, bool end_stream,
+                              int32_t* sid) {
+  std::string block;
+  HpackEncode(headers, &block);
+
+  // Hold the write lock across id allocation + HEADERS so stream ids appear
+  // on the wire in increasing order (RFC 7540 §5.1.1).
+  std::lock_guard<std::mutex> wl(write_mutex_);
+  size_t max_frame;
+  {
+    std::lock_guard<std::mutex> sl(state_mutex_);
+    if (dead_) return Error("h2 connection dead: " + error_);
+    auto stream = std::make_shared<Stream>();
+    stream->id = next_stream_id_;
+    next_stream_id_ += 2;
+    stream->send_window = peer_initial_window_;
+    streams_[stream->id] = stream;
+    *sid = stream->id;
+    max_frame = peer_max_frame_;
+  }
+  uint8_t flags = kFlagEndHeaders | (end_stream ? kFlagEndStream : 0);
+  if (block.size() <= max_frame) {
+    return SendFrame(kHeaders, flags, *sid,
+                     reinterpret_cast<const uint8_t*>(block.data()),
+                     block.size());
+  }
+  // Oversized header block: HEADERS + CONTINUATION chain (must be contiguous
+  // on the wire — we are still under the write lock).
+  size_t off = 0;
+  Error err = SendFrame(kHeaders, flags & ~kFlagEndHeaders, *sid,
+                        reinterpret_cast<const uint8_t*>(block.data()),
+                        max_frame);
+  if (!err.IsOk()) return err;
+  off = max_frame;
+  while (off < block.size()) {
+    size_t n = std::min(max_frame, block.size() - off);
+    bool last = off + n == block.size();
+    err = SendFrame(kContinuation, last ? kFlagEndHeaders : 0, *sid,
+                    reinterpret_cast<const uint8_t*>(block.data()) + off, n);
+    if (!err.IsOk()) return err;
+    off += n;
+  }
+  return Error::Success();
+}
+
+Error Connection::SendData(int32_t sid, const uint8_t* data, size_t len,
+                           bool end_stream, uint64_t deadline_ns) {
+  size_t off = 0;
+  while (off < len || (end_stream && off == 0 && len == 0)) {
+    size_t chunk = 0;
+    size_t max_frame;
+    {
+      std::unique_lock<std::mutex> sl(state_mutex_);
+      auto pred = [&] {
+        if (dead_) return true;
+        auto it = streams_.find(sid);
+        if (it == streams_.end() || it->second->reset) return true;
+        return len == off ||
+               (conn_send_window_ > 0 && it->second->send_window > 0);
+      };
+      if (deadline_ns > 0) {
+        auto dl = std::chrono::steady_clock::time_point(
+            std::chrono::nanoseconds(deadline_ns));
+        if (!state_cv_.wait_until(sl, dl, pred)) {
+          return Error("h2 send: flow-control deadline exceeded", 499);
+        }
+      } else {
+        state_cv_.wait(sl, pred);
+      }
+      if (dead_) return Error("h2 connection dead: " + error_);
+      auto it = streams_.find(sid);
+      if (it == streams_.end()) return Error("h2 send on closed stream");
+      if (it->second->reset) {
+        return Error("h2 stream reset by peer (code " +
+                     std::to_string(it->second->reset_code) + ")");
+      }
+      if (len > off) {
+        chunk = std::min({len - off, size_t(conn_send_window_),
+                          size_t(it->second->send_window), peer_max_frame_});
+        conn_send_window_ -= int64_t(chunk);
+        it->second->send_window -= int64_t(chunk);
+      }
+      max_frame = peer_max_frame_;
+      (void)max_frame;
+    }
+    bool last = end_stream && off + chunk == len;
+    std::lock_guard<std::mutex> wl(write_mutex_);
+    Error err = SendFrame(kData, last ? kFlagEndStream : 0, sid, data + off,
+                          chunk);
+    if (!err.IsOk()) return err;
+    off += chunk;
+    if (last) break;
+  }
+  return Error::Success();
+}
+
+bool Connection::WaitStream(int32_t sid, size_t min_bytes,
+                            uint64_t deadline_ns) {
+  std::unique_lock<std::mutex> sl(state_mutex_);
+  auto pred = [&] {
+    if (dead_) return true;
+    auto it = streams_.find(sid);
+    if (it == streams_.end()) return true;
+    const Stream& s = *it->second;
+    return s.reset || s.end_stream ||
+           s.data.size() - s.consumed >= min_bytes;
+  };
+  if (deadline_ns > 0) {
+    auto dl = std::chrono::steady_clock::time_point(
+        std::chrono::nanoseconds(deadline_ns));
+    return state_cv_.wait_until(sl, dl, pred);
+  }
+  state_cv_.wait(sl, pred);
+  return true;
+}
+
+bool Connection::WithStream(int32_t sid,
+                            const std::function<void(Stream&)>& fn) {
+  std::lock_guard<std::mutex> sl(state_mutex_);
+  auto it = streams_.find(sid);
+  if (it == streams_.end()) return false;
+  fn(*it->second);
+  return true;
+}
+
+void Connection::CloseStream(int32_t sid) {
+  bool need_rst = false;
+  {
+    std::lock_guard<std::mutex> sl(state_mutex_);
+    auto it = streams_.find(sid);
+    if (it == streams_.end()) return;
+    need_rst = !it->second->end_stream && !it->second->reset && !dead_;
+    streams_.erase(it);
+  }
+  if (need_rst) {
+    std::string payload;
+    PutU32(&payload, 0x8);  // CANCEL
+    std::lock_guard<std::mutex> wl(write_mutex_);
+    SendFrame(kRstStream, 0, sid,
+              reinterpret_cast<const uint8_t*>(payload.data()),
+              payload.size());
+  }
+  state_cv_.notify_all();
+}
+
+bool Connection::Alive() {
+  std::lock_guard<std::mutex> sl(state_mutex_);
+  return !dead_;
+}
+
+const std::string& Connection::ConnectionError() {
+  std::lock_guard<std::mutex> sl(state_mutex_);
+  return error_;
+}
+
+bool Connection::ReadN(uint8_t* buf, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t r = ::recv(fd_, buf + off, n - off, 0);
+    if (r <= 0) return false;
+    off += size_t(r);
+  }
+  return true;
+}
+
+void Connection::ReaderLoop() {
+  std::vector<uint8_t> payload;
+  while (true) {
+    uint8_t hdr[9];
+    if (!ReadN(hdr, 9)) {
+      FailConnection("connection closed by peer");
+      return;
+    }
+    size_t len = (size_t(hdr[0]) << 16) | (size_t(hdr[1]) << 8) | hdr[2];
+    uint8_t type = hdr[3];
+    uint8_t flags = hdr[4];
+    int32_t sid = int32_t(GetU32(hdr + 5) & 0x7FFFFFFF);
+    if (len > (32u << 20)) {
+      FailConnection("oversized frame from peer");
+      return;
+    }
+    payload.resize(len);
+    if (len > 0 && !ReadN(payload.data(), len)) {
+      FailConnection("connection closed mid-frame");
+      return;
+    }
+    HandleFrame(type, flags, sid, payload.data(), len);
+    {
+      std::lock_guard<std::mutex> sl(state_mutex_);
+      if (dead_) return;
+    }
+  }
+}
+
+void Connection::HandleFrame(uint8_t type, uint8_t flags, int32_t sid,
+                             const uint8_t* payload, size_t len) {
+  switch (type) {
+    case kData: {
+      size_t off = 0, dlen = len;
+      if (flags & kFlagPadded) {
+        if (len < 1) return FailConnection("bad padded DATA");
+        size_t pad = payload[0];
+        if (pad + 1 > len) return FailConnection("bad DATA padding");
+        off = 1;
+        dlen = len - 1 - pad;
+      }
+      {
+        std::lock_guard<std::mutex> sl(state_mutex_);
+        auto it = streams_.find(sid);
+        if (it != streams_.end()) {
+          it->second->data.append(reinterpret_cast<const char*>(payload + off),
+                                  dlen);
+          if (flags & kFlagEndStream) it->second->end_stream = true;
+          if (it->second->on_event) it->second->on_event();
+        }
+      }
+      state_cv_.notify_all();
+      // Replenish flow-control windows by the full frame length (padding
+      // counts, RFC 7540 §6.9.1).
+      if (len > 0) {
+        std::string wu;
+        PutU32(&wu, uint32_t(len));
+        std::lock_guard<std::mutex> wl(write_mutex_);
+        SendFrame(kWindowUpdate, 0, 0,
+                  reinterpret_cast<const uint8_t*>(wu.data()), wu.size());
+        if (!(flags & kFlagEndStream)) {
+          SendFrame(kWindowUpdate, 0, sid,
+                    reinterpret_cast<const uint8_t*>(wu.data()), wu.size());
+        }
+      }
+      break;
+    }
+    case kHeaders: {
+      size_t off = 0, blen = len;
+      if (flags & kFlagPadded) {
+        if (len < 1) return FailConnection("bad padded HEADERS");
+        size_t pad = payload[0];
+        off = 1;
+        if (1 + pad > len) return FailConnection("bad HEADERS padding");
+        blen = len - 1 - pad;
+      }
+      if (flags & kFlagPriority) {
+        if (blen < 5) return FailConnection("bad HEADERS priority");
+        off += 5;
+        blen -= 5;
+      }
+      continuation_sid_ = sid;
+      continuation_buf_.assign(reinterpret_cast<const char*>(payload + off),
+                               blen);
+      continuation_end_stream_ = (flags & kFlagEndStream) != 0;
+      if (flags & kFlagEndHeaders) {
+        HeaderList fields;
+        Error err = hpack_.Decode(
+            reinterpret_cast<const uint8_t*>(continuation_buf_.data()),
+            continuation_buf_.size(), &fields);
+        if (!err.IsOk()) return FailConnection("HPACK error: " + err.Message());
+        std::lock_guard<std::mutex> sl(state_mutex_);
+        auto it = streams_.find(sid);
+        if (it != streams_.end()) {
+          Stream& s = *it->second;
+          if (!s.headers_done) {
+            s.headers = std::move(fields);
+            s.headers_done = true;
+          } else {
+            s.trailers = std::move(fields);
+          }
+          if (continuation_end_stream_) s.end_stream = true;
+          if (s.on_event) s.on_event();
+        }
+        continuation_sid_ = 0;
+        state_cv_.notify_all();
+      }
+      break;
+    }
+    case kContinuation: {
+      if (sid != continuation_sid_) {
+        return FailConnection("CONTINUATION for wrong stream");
+      }
+      continuation_buf_.append(reinterpret_cast<const char*>(payload), len);
+      if (flags & kFlagEndHeaders) {
+        HeaderList fields;
+        Error err = hpack_.Decode(
+            reinterpret_cast<const uint8_t*>(continuation_buf_.data()),
+            continuation_buf_.size(), &fields);
+        if (!err.IsOk()) return FailConnection("HPACK error: " + err.Message());
+        std::lock_guard<std::mutex> sl(state_mutex_);
+        auto it = streams_.find(sid);
+        if (it != streams_.end()) {
+          Stream& s = *it->second;
+          if (!s.headers_done) {
+            s.headers = std::move(fields);
+            s.headers_done = true;
+          } else {
+            s.trailers = std::move(fields);
+          }
+          if (continuation_end_stream_) s.end_stream = true;
+          if (s.on_event) s.on_event();
+        }
+        continuation_sid_ = 0;
+        state_cv_.notify_all();
+      }
+      break;
+    }
+    case kRstStream: {
+      if (len < 4) return FailConnection("bad RST_STREAM");
+      std::lock_guard<std::mutex> sl(state_mutex_);
+      auto it = streams_.find(sid);
+      if (it != streams_.end()) {
+        it->second->reset = true;
+        it->second->reset_code = GetU32(payload);
+        if (it->second->on_event) it->second->on_event();
+      }
+      state_cv_.notify_all();
+      break;
+    }
+    case kSettings: {
+      if (flags & kFlagAck) break;
+      {
+        std::lock_guard<std::mutex> sl(state_mutex_);
+        for (size_t p = 0; p + 6 <= len; p += 6) {
+          uint16_t id = (uint16_t(payload[p]) << 8) | payload[p + 1];
+          uint32_t value = GetU32(payload + p + 2);
+          if (id == kSettingsInitialWindowSize) {
+            int64_t delta = int64_t(value) - peer_initial_window_;
+            peer_initial_window_ = value;
+            for (auto& kv : streams_) kv.second->send_window += delta;
+          } else if (id == kSettingsMaxFrameSize) {
+            peer_max_frame_ = value;
+          }
+        }
+      }
+      state_cv_.notify_all();
+      {
+        std::lock_guard<std::mutex> wl(write_mutex_);
+        SendFrame(kSettings, kFlagAck, 0, nullptr, 0);
+      }
+      break;
+    }
+    case kPing: {
+      if (!(flags & kFlagAck) && len == 8) {
+        std::lock_guard<std::mutex> wl(write_mutex_);
+        SendFrame(kPing, kFlagAck, 0, payload, len);
+      }
+      break;
+    }
+    case kWindowUpdate: {
+      if (len < 4) return FailConnection("bad WINDOW_UPDATE");
+      uint32_t inc = GetU32(payload) & 0x7FFFFFFF;
+      std::lock_guard<std::mutex> sl(state_mutex_);
+      if (sid == 0) {
+        conn_send_window_ += inc;
+      } else {
+        auto it = streams_.find(sid);
+        if (it != streams_.end()) it->second->send_window += inc;
+      }
+      state_cv_.notify_all();
+      break;
+    }
+    case kGoaway: {
+      std::string debug;
+      if (len > 8) {
+        debug.assign(reinterpret_cast<const char*>(payload + 8), len - 8);
+      }
+      FailConnection("GOAWAY from peer" +
+                     (debug.empty() ? std::string() : ": " + debug));
+      break;
+    }
+    default:
+      break;  // PRIORITY / PUSH_PROMISE / unknown: ignore
+  }
+}
+
+void Connection::FailConnection(const std::string& reason) {
+  {
+    std::lock_guard<std::mutex> sl(state_mutex_);
+    if (dead_) return;
+    dead_ = true;
+    error_ = reason;
+    for (auto& kv : streams_) {
+      kv.second->reset = true;
+      kv.second->reset_code = 0xFFFFFFFF;
+      if (kv.second->on_event) kv.second->on_event();
+    }
+  }
+  state_cv_.notify_all();
+  if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+}
+
+}  // namespace h2
+}  // namespace tpuclient

@@ -1,0 +1,157 @@
+// Minimal dependency-free HTTP/2 (h2c, RFC 7540) + HPACK (RFC 7541) client
+// transport, sized for gRPC: cleartext prior-knowledge connections, client-
+// initiated streams only (no server push), full flow control, HPACK with
+// dynamic table + Huffman decoding (table generated and verified against
+// libnghttp2 — see hpack_huffman.inc / tools/gen_hpack_table.py).
+//
+// This is the piece the reference gets from linking grpc++
+// (/root/reference/src/c++/library/grpc_client.cc); this image has no grpc++
+// or nghttp2 headers, and the native tree is dependency-free by design, so
+// the transport is implemented here and the gRPC semantics (message framing,
+// trailers, status) live in grpc_client.cc on top of it.
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <utility>
+#include <vector>
+
+#include "tpuclient/error.h"
+
+namespace tpuclient {
+namespace h2 {
+
+using HeaderList = std::vector<std::pair<std::string, std::string>>;
+
+// ---------------------------------------------------------------- HPACK ----
+
+// Encoder: emits every field as "literal without indexing — new name"
+// (RFC 7541 §6.2.2, no Huffman). Always legal, stateless, and keeps the
+// encoder trivially correct; the decoder side is where full HPACK lives.
+void HpackEncode(const HeaderList& headers, std::string* out);
+
+// Decoder: full HPACK — static + dynamic tables, all field representations,
+// Huffman-coded strings, dynamic table size updates.
+class HpackDecoder {
+ public:
+  explicit HpackDecoder(size_t max_dynamic_size = 4096)
+      : max_dynamic_size_(max_dynamic_size) {}
+
+  // Decodes one complete header block (HEADERS + CONTINUATIONs payload).
+  Error Decode(const uint8_t* data, size_t len, HeaderList* out);
+
+ private:
+  Error ReadInt(const uint8_t* data, size_t len, size_t* pos, int prefix_bits,
+                uint64_t* value);
+  Error ReadString(const uint8_t* data, size_t len, size_t* pos,
+                   std::string* out);
+  Error LookupIndex(uint64_t index, std::string* name, std::string* value);
+  void DynamicInsert(const std::string& name, const std::string& value);
+  void EvictToFit();
+
+  std::deque<std::pair<std::string, std::string>> dynamic_;  // newest front
+  size_t dynamic_size_ = 0;
+  size_t max_dynamic_size_;
+};
+
+// Huffman primitives exposed for unit tests.
+Error HuffmanDecode(const uint8_t* data, size_t len, std::string* out);
+void HuffmanEncode(const std::string& in, std::string* out);
+
+// ----------------------------------------------------------- connection ----
+
+// One h2c connection: socket, reader thread, stream registry, flow control.
+// Thread-safe: any thread may open streams / send data; the reader thread
+// dispatches frames into per-stream state and wakes waiters.
+class Connection {
+ public:
+  struct Stream {
+    int32_t id = 0;
+    HeaderList headers;         // initial response HEADERS block
+    HeaderList trailers;        // trailing HEADERS block
+    bool headers_done = false;
+    std::string data;           // received DATA bytes, appended in order
+    size_t consumed = 0;        // bytes the application has taken from data
+    bool end_stream = false;    // peer half-closed (trailers or END_STREAM)
+    bool reset = false;         // RST_STREAM received
+    uint32_t reset_code = 0;
+    int64_t send_window = 0;
+    // Called (with the connection stream lock held) whenever state advances;
+    // used by gRPC streaming to pump messages without a poll loop.
+    std::function<void()> on_event;
+  };
+
+  Connection() = default;
+  ~Connection();
+  Connection(const Connection&) = delete;
+  Connection& operator=(const Connection&) = delete;
+
+  // TCP connect + preface + SETTINGS exchange kickoff (does not wait for the
+  // server SETTINGS ack). host may be an IPv4 literal or DNS name.
+  Error Connect(const std::string& host, int port);
+
+  // Opens a stream with the given request headers. end_stream=true for
+  // requests with no body. Returns the stream id.
+  Error StartStream(const HeaderList& headers, bool end_stream, int32_t* sid);
+
+  // Sends body bytes on a stream, splitting to MAX_FRAME_SIZE and blocking
+  // on connection/stream flow-control windows. deadline_ns: steady-clock
+  // deadline (0 = none) applied to window waits.
+  Error SendData(int32_t sid, const uint8_t* data, size_t len,
+                 bool end_stream, uint64_t deadline_ns = 0);
+
+  // Blocks until the stream has ≥ min_bytes unconsumed data, is half-closed
+  // by the peer, reset, or the deadline passes. Returns false on deadline.
+  bool WaitStream(int32_t sid, size_t min_bytes, uint64_t deadline_ns);
+
+  // Access stream state under the registry lock via callback (the pointer is
+  // only valid inside the callback).
+  bool WithStream(int32_t sid, const std::function<void(Stream&)>& fn);
+
+  // Drops the stream from the registry (sends RST_STREAM if still open).
+  void CloseStream(int32_t sid);
+
+  bool Alive();
+  const std::string& ConnectionError();  // non-empty once dead
+
+ private:
+  Error SendFrame(uint8_t type, uint8_t flags, int32_t sid,
+                  const uint8_t* payload, size_t len);
+  Error SendRaw(const uint8_t* data, size_t len);
+  void ReaderLoop();
+  void HandleFrame(uint8_t type, uint8_t flags, int32_t sid,
+                   const uint8_t* payload, size_t len);
+  void FailConnection(const std::string& reason);
+  bool ReadN(uint8_t* buf, size_t n);
+
+  int fd_ = -1;
+  std::thread reader_;
+  std::mutex write_mutex_;   // serializes socket writes
+  std::mutex state_mutex_;   // streams_, windows, settings, error
+  std::condition_variable state_cv_;
+  std::map<int32_t, std::shared_ptr<Stream>> streams_;
+  int32_t next_stream_id_ = 1;
+  std::string error_;
+  bool dead_ = false;
+
+  // Flow control / peer settings.
+  int64_t conn_send_window_ = 65535;
+  int64_t peer_initial_window_ = 65535;
+  size_t peer_max_frame_ = 16384;
+
+  HpackDecoder hpack_;
+  // HEADERS accumulation across CONTINUATION frames.
+  int32_t continuation_sid_ = 0;
+  std::string continuation_buf_;
+  bool continuation_end_stream_ = false;
+};
+
+}  // namespace h2
+}  // namespace tpuclient

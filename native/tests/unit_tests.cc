@@ -11,6 +11,8 @@
 #include "tpuclient/json.h"
 #include "tpuclient/shm_utils.h"
 
+#include "../src/h2.h"
+
 using namespace tpuclient;
 
 static int failures = 0;
@@ -156,6 +158,97 @@ static void TestDtypes() {
   CHECK(ElementCount({2, -1}) == -1);
 }
 
+static void TestHuffman() {
+  // Round-trip through the RFC 7541 Appendix B codes (table generated and
+  // verified against libnghttp2 by tools/gen_hpack_table.py).
+  for (const std::string& s :
+       {std::string("www.example.com"), std::string(""),
+        std::string("application/grpc"), std::string("\x00\xff\x01\xfe", 4),
+        std::string(256, '\x07')}) {
+    std::string enc, dec;
+    h2::HuffmanEncode(s, &enc);
+    CHECK(h2::HuffmanDecode(reinterpret_cast<const uint8_t*>(enc.data()),
+                            enc.size(), &dec)
+              .IsOk());
+    CHECK(dec == s);
+  }
+  // RFC 7541 C.4.1: "www.example.com" huffman-encodes to these 12 bytes.
+  std::string enc;
+  h2::HuffmanEncode("www.example.com", &enc);
+  const uint8_t expect[] = {0xf1, 0xe3, 0xc2, 0xe5, 0xf2, 0x3a,
+                            0x6b, 0xa0, 0xab, 0x90, 0xf4, 0xff};
+  CHECK(enc.size() == sizeof(expect));
+  CHECK(memcmp(enc.data(), expect, sizeof(expect)) == 0);
+  // Bad padding must be rejected: 0x00 = symbol '0' (code 00000) followed
+  // by three zero padding bits — RFC 7541 §5.2 requires padding be the
+  // all-ones EOS prefix.
+  const uint8_t bad[] = {0x00};
+  std::string out;
+  CHECK(!h2::HuffmanDecode(bad, 1, &out).IsOk());
+}
+
+static void TestHpack() {
+  // Our encoder's output must decode back through the full decoder.
+  h2::HeaderList in = {
+      {":method", "POST"},
+      {":path", "/inference.GRPCInferenceService/ModelInfer"},
+      {"content-type", "application/grpc"},
+      {"x-empty", ""},
+  };
+  std::string block;
+  h2::HpackEncode(in, &block);
+  h2::HpackDecoder dec;
+  h2::HeaderList out;
+  CHECK(dec.Decode(reinterpret_cast<const uint8_t*>(block.data()),
+                   block.size(), &out)
+            .IsOk());
+  CHECK(out == in);
+
+  // RFC 7541 C.3: three request header blocks on one decoder exercising
+  // indexed fields, incremental indexing, and dynamic-table references.
+  h2::HpackDecoder rfc;
+  {
+    const uint8_t block1[] = {0x82, 0x86, 0x84, 0x41, 0x0f, 0x77, 0x77, 0x77,
+                              0x2e, 0x65, 0x78, 0x61, 0x6d, 0x70, 0x6c, 0x65,
+                              0x2e, 0x63, 0x6f, 0x6d};
+    h2::HeaderList h;
+    CHECK(rfc.Decode(block1, sizeof(block1), &h).IsOk());
+    h2::HeaderList expect1 = {{":method", "GET"},
+                              {":scheme", "http"},
+                              {":path", "/"},
+                              {":authority", "www.example.com"}};
+    CHECK(h == expect1);
+  }
+  {
+    const uint8_t block2[] = {0x82, 0x86, 0x84, 0xbe, 0x58, 0x08, 0x6e, 0x6f,
+                              0x2d, 0x63, 0x61, 0x63, 0x68, 0x65};
+    h2::HeaderList h;
+    CHECK(rfc.Decode(block2, sizeof(block2), &h).IsOk());
+    h2::HeaderList expect2 = {{":method", "GET"},
+                              {":scheme", "http"},
+                              {":path", "/"},
+                              {":authority", "www.example.com"},
+                              {"cache-control", "no-cache"}};
+    CHECK(h == expect2);
+  }
+  {
+    // Third block switches to https/index.html and adds a custom pair via
+    // huffman-free literals; dynamic entries from prior blocks resolve.
+    const uint8_t block3[] = {0x82, 0x87, 0x85, 0xbf, 0x40, 0x0a, 0x63, 0x75,
+                              0x73, 0x74, 0x6f, 0x6d, 0x2d, 0x6b, 0x65, 0x79,
+                              0x0c, 0x63, 0x75, 0x73, 0x74, 0x6f, 0x6d, 0x2d,
+                              0x76, 0x61, 0x6c, 0x75, 0x65};
+    h2::HeaderList h;
+    CHECK(rfc.Decode(block3, sizeof(block3), &h).IsOk());
+    h2::HeaderList expect3 = {{":method", "GET"},
+                              {":scheme", "https"},
+                              {":path", "/index.html"},
+                              {":authority", "www.example.com"},
+                              {"custom-key", "custom-value"}};
+    CHECK(h == expect3);
+  }
+}
+
 int main() {
   TestJsonRoundTrip();
   TestBase64();
@@ -163,6 +256,8 @@ int main() {
   TestInferInput();
   TestShmUtils();
   TestDtypes();
+  TestHuffman();
+  TestHpack();
   if (failures == 0) {
     printf("ALL UNIT TESTS PASSED\n");
     return 0;

@@ -12,6 +12,7 @@ import pytest
 from client_tpu.engine import TpuEngine
 from client_tpu.models import build_repository
 from client_tpu.server import HttpInferenceServer
+from client_tpu.server.grpc_server import GrpcInferenceServer
 
 NATIVE = os.path.join(os.path.dirname(__file__), "..", "native")
 BUILD = os.path.join(NATIVE, "build")
@@ -23,6 +24,17 @@ EXAMPLES = [
     "simple_http_shm_client",
     "simple_http_sequence_client",
     "simple_http_health_metadata",
+]
+
+# gRPC conformance clients: the in-tree C++ HTTP/2+HPACK transport driven
+# against the framework's grpcio-based server (wire interop both ways).
+GRPC_EXAMPLES = [
+    "simple_grpc_infer_client",
+    "simple_grpc_async_infer_client",
+    "simple_grpc_string_infer_client",
+    "simple_grpc_shm_client",
+    "simple_grpc_sequence_stream_client",
+    "simple_grpc_health_metadata",
 ]
 
 
@@ -55,6 +67,16 @@ def test_unit_tests(native_build):
     assert "ALL UNIT TESTS PASSED" in proc.stdout
 
 
+@pytest.fixture(scope="module")
+def grpc_server():
+    eng = TpuEngine(build_repository(
+        ["simple", "simple_string", "simple_sequence"]))
+    srv = GrpcInferenceServer(eng, port=0).start()
+    yield srv
+    srv.stop()
+    eng.shutdown()
+
+
 @pytest.mark.parametrize("example", EXAMPLES)
 def test_example_conformance(native_build, server, example):
     binary = os.path.join(native_build, example)
@@ -62,6 +84,37 @@ def test_example_conformance(native_build, server, example):
                           text=True, timeout=180)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "PASS" in proc.stdout
+
+
+@pytest.mark.parametrize("example", GRPC_EXAMPLES)
+def test_grpc_example_conformance(native_build, grpc_server, example):
+    binary = os.path.join(native_build, example)
+    url = f"127.0.0.1:{grpc_server.port}"
+    proc = subprocess.run([binary, "-u", url], capture_output=True,
+                          text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
+
+
+def test_perf_analyzer_smoke(native_build, server, tmp_path):
+    """tpu_perf_analyzer end-to-end: short concurrency sweep against the live
+    HTTP server, asserting a sane throughput figure and CSV export
+    (reference perf_analyzer CLI surface, SURVEY.md §2.2/§3.3)."""
+    csv = tmp_path / "perf.csv"
+    proc = subprocess.run(
+        [os.path.join(native_build, "tpu_perf_analyzer"),
+         "-m", "simple", "-u", server.url, "-p", "600", "-r", "6",
+         "-s", "70", "--concurrency-range", "2:2", "-f", str(csv)],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Throughput" in proc.stdout
+    lines = csv.read_text().strip().splitlines()
+    assert len(lines) >= 2, lines
+    # header + one row; throughput column must be positive
+    header = lines[0].split(",")
+    row = lines[1].split(",")
+    ips = float(row[header.index("Inferences/Second")])
+    assert ips > 0
 
 
 def test_libcshm_ctypes(native_build):
