@@ -1,0 +1,131 @@
+"""Support module for the in-process C API (`libtpuserver.so`).
+
+The native shim (native/capi/tpu_server_capi.cc) embeds CPython and calls the
+functions here — this file is the whole Python-side surface of the embedded
+server, so the C code stays a thin marshalling layer. Plays the role the
+reference delegates to the dlopen'd libtritonserver.so
+(/root/reference/src/c++/perf_analyzer/client_backend/triton_c_api/
+triton_loader.cc:251,899): an engine in the benchmark process, no network.
+
+Contract with the C side:
+- create_engine(models_csv) -> engine object (opaque PyObject to C)
+- *_json helpers return JSON strings
+- infer(engine, request_json, buffers) -> (response_json, [np.ndarray])
+  where `buffers` are zero-copy memoryviews of caller-owned input bytes
+  (valid only for the duration of the call) and the returned arrays are
+  C-contiguous, exposed back to C via the buffer protocol (zero-copy out).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from client_tpu.engine import InferRequest, TpuEngine
+from client_tpu.engine.types import OutputRequest
+from client_tpu.models import build_repository
+from client_tpu.protocol.codec import (
+    deserialize_bytes_tensor,
+    serialize_bytes_tensor,
+)
+from client_tpu.protocol.dtypes import np_to_wire_dtype, wire_to_np_dtype
+
+
+def create_engine(models_csv: str = "") -> TpuEngine:
+    # CLIENT_TPU_PLATFORM=cpu lets the embedded engine run hermetically
+    # (tests, machines without a TPU). The image's sitecustomize pins the
+    # platform before env vars are seen, so this must go through jax.config.
+    platform = os.environ.get("CLIENT_TPU_PLATFORM")
+    if platform:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", platform)
+        except Exception:  # noqa: BLE001 — backend already initialized
+            pass
+    names = [n.strip() for n in models_csv.split(",") if n.strip()] or None
+    return TpuEngine(build_repository(names))
+
+
+def shutdown_engine(engine: TpuEngine) -> None:
+    engine.shutdown()
+
+
+def model_metadata_json(engine: TpuEngine, name: str, version: str = "") -> str:
+    return json.dumps(engine.model_metadata(name, version))
+
+
+def model_config_json(engine: TpuEngine, name: str, version: str = "") -> str:
+    return json.dumps(engine.model_config(name, version))
+
+
+def model_statistics_json(engine: TpuEngine, name: str = "",
+                          version: str = "") -> str:
+    return json.dumps(engine.model_statistics(name, version))
+
+
+def server_metadata_json(engine: TpuEngine) -> str:
+    return json.dumps(engine.server_metadata())
+
+
+def _input_array(meta: dict, buf) -> np.ndarray:
+    dtype = meta["datatype"]
+    shape = meta["shape"]
+    if dtype == "BYTES":
+        arr = deserialize_bytes_tensor(bytes(buf))
+        return arr.reshape(shape)
+    # Zero-copy view over caller memory; the engine's batcher copies on
+    # concatenation, and the call is synchronous, so the view never outlives
+    # the caller's buffer.
+    return np.frombuffer(buf, dtype=wire_to_np_dtype(dtype)).reshape(shape)
+
+
+def infer(engine: TpuEngine, request_json: str, buffers: list):
+    req_d = json.loads(request_json)
+    inputs_meta = req_d.get("inputs", [])
+    if len(inputs_meta) != len(buffers):
+        raise ValueError(
+            f"{len(inputs_meta)} input descriptors but {len(buffers)} buffers")
+    inputs = {m["name"]: _input_array(m, b)
+              for m, b in zip(inputs_meta, buffers)}
+    outputs = [OutputRequest(name=o["name"],
+                             classification_count=int(o.get("classification",
+                                                            0)))
+               for o in req_d.get("outputs", [])]
+    req = InferRequest(
+        model_name=req_d["model_name"],
+        model_version=req_d.get("model_version", ""),
+        request_id=req_d.get("id", ""),
+        inputs=inputs,
+        outputs=outputs,
+        sequence_id=int(req_d.get("sequence_id", 0)),
+        sequence_start=bool(req_d.get("sequence_start", False)),
+        sequence_end=bool(req_d.get("sequence_end", False)),
+        priority=int(req_d.get("priority", 0)),
+        timeout_us=int(req_d.get("timeout_us", 0)),
+    )
+    timeout_s = req.timeout_us / 1e6 if req.timeout_us else None
+    resp = engine.infer(req, timeout_s=timeout_s)
+
+    out_meta = []
+    out_arrays = []
+    for name, arr in resp.outputs.items():
+        wire = np_to_wire_dtype(arr.dtype)
+        if wire is None or arr.dtype.kind in ("S", "U", "O"):
+            data = np.frombuffer(serialize_bytes_tensor(arr), dtype=np.uint8)
+            out_meta.append({"name": name, "datatype": "BYTES",
+                             "shape": list(arr.shape)})
+            out_arrays.append(data)
+        else:
+            out_meta.append({"name": name, "datatype": wire,
+                             "shape": list(arr.shape)})
+            out_arrays.append(np.ascontiguousarray(arr))
+    response_json = json.dumps({
+        "model_name": resp.model_name,
+        "model_version": resp.model_version,
+        "id": resp.request_id,
+        "outputs": out_meta,
+    })
+    return response_json, out_arrays

@@ -1,0 +1,326 @@
+// In-process TPU serving engine C API — implementation.
+//
+// Embeds CPython once per process and hosts the JAX/XLA engine through
+// client_tpu/capi_embed.py; every exported function is a thin marshalling
+// layer (GIL acquire -> PyObject calls -> release). Inputs enter as
+// zero-copy memoryviews; outputs leave as buffer-protocol views pinned by
+// the response object. See tpu_server_capi.h for the contract and the
+// reference-role citation.
+
+#include "tpu_server_capi.h"
+
+#include <Python.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::once_flag g_init_once;
+PyObject* g_embed_module = nullptr;  // client_tpu.capi_embed
+std::string g_init_error;
+
+char* DupString(const std::string& s) {
+  char* out = static_cast<char*>(malloc(s.size() + 1));
+  memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+// Formats the current Python exception into an error string (clears it).
+std::string FetchPyError() {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject* str = PyObject_Str(value);
+    if (str != nullptr) {
+      const char* c = PyUnicode_AsUTF8(str);
+      if (c != nullptr) msg = c;
+      Py_DECREF(str);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+  return msg;
+}
+
+void InitializePython(const char* repo_root) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* sys_path = PySys_GetObject("path");  // borrowed
+  auto prepend = [sys_path](const char* p) {
+    if (p == nullptr || *p == '\0' || sys_path == nullptr) return;
+    PyObject* s = PyUnicode_FromString(p);
+    if (s != nullptr) {
+      PyList_Insert(sys_path, 0, s);
+      Py_DECREF(s);
+    }
+  };
+  prepend(".");
+  prepend(getenv("TPU_REPO_ROOT"));
+  prepend(repo_root);
+  g_embed_module = PyImport_ImportModule("client_tpu.capi_embed");
+  if (g_embed_module == nullptr) {
+    g_init_error = "failed to import client_tpu.capi_embed: " + FetchPyError();
+  }
+  PyGILState_Release(gil);
+  // Release the GIL from this (embedding) thread so worker threads can
+  // acquire it via PyGILState_Ensure.
+  if (PyGILState_Check()) {
+    PyEval_SaveThread();
+  }
+}
+
+// Calls g_embed_module.<fn>(*args); returns new reference or null + error.
+PyObject* CallEmbed(const char* fn, PyObject* args, std::string* error) {
+  PyObject* callable = PyObject_GetAttrString(g_embed_module, fn);
+  if (callable == nullptr) {
+    *error = "missing capi_embed." + std::string(fn);
+    return nullptr;
+  }
+  PyObject* result = PyObject_CallObject(callable, args);
+  Py_DECREF(callable);
+  if (result == nullptr) *error = FetchPyError();
+  return result;
+}
+
+}  // namespace
+
+struct TpuServer {
+  PyObject* engine = nullptr;
+};
+
+struct TpuServerResponse {
+  std::string json;
+  // Per output: metadata strings + a buffer-protocol view into the array.
+  struct Output {
+    std::string name;
+    std::string datatype;
+    std::vector<int64_t> shape;
+    Py_buffer view{};
+    bool have_view = false;
+  };
+  std::vector<Output> outputs;
+  PyObject* arrays = nullptr;  // keeps the ndarrays alive
+};
+
+extern "C" {
+
+char* TpuServerNew(TpuServer** server, const char* models_csv,
+                   const char* repo_root) {
+  std::call_once(g_init_once, InitializePython, repo_root);
+  if (g_embed_module == nullptr) return DupString(g_init_error);
+
+  PyGILState_STATE gil = PyGILState_Ensure();
+  std::string error;
+  PyObject* args = Py_BuildValue("(s)", models_csv ? models_csv : "");
+  PyObject* engine = CallEmbed("create_engine", args, &error);
+  Py_XDECREF(args);
+  if (engine == nullptr) {
+    PyGILState_Release(gil);
+    return DupString("create_engine failed: " + error);
+  }
+  *server = new TpuServer{engine};
+  PyGILState_Release(gil);
+  return nullptr;
+}
+
+void TpuServerDelete(TpuServer* server) {
+  if (server == nullptr) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  std::string error;
+  PyObject* args = Py_BuildValue("(O)", server->engine);
+  PyObject* r = CallEmbed("shutdown_engine", args, &error);
+  Py_XDECREF(args);
+  Py_XDECREF(r);
+  PyErr_Clear();
+  Py_DECREF(server->engine);
+  PyGILState_Release(gil);
+  delete server;
+}
+
+static char* JsonCall(TpuServer* server, const char* fn, const char* a1,
+                      const char* a2, char** json_out) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  std::string error;
+  PyObject* args =
+      a2 != nullptr
+          ? Py_BuildValue("(Oss)", server->engine, a1 ? a1 : "", a2)
+          : (a1 != nullptr ? Py_BuildValue("(Os)", server->engine, a1)
+                           : Py_BuildValue("(O)", server->engine));
+  PyObject* result = CallEmbed(fn, args, &error);
+  Py_XDECREF(args);
+  char* err = nullptr;
+  if (result == nullptr) {
+    err = DupString(error);
+  } else {
+    const char* c = PyUnicode_AsUTF8(result);
+    *json_out = DupString(c ? c : "{}");
+    Py_DECREF(result);
+  }
+  PyGILState_Release(gil);
+  return err;
+}
+
+char* TpuServerMetadataJson(TpuServer* server, char** json_out) {
+  return JsonCall(server, "server_metadata_json", nullptr, nullptr, json_out);
+}
+
+char* TpuServerModelMetadataJson(TpuServer* server, const char* model,
+                                 const char* version, char** json_out) {
+  return JsonCall(server, "model_metadata_json", model, version ? version : "",
+                  json_out);
+}
+
+char* TpuServerModelConfigJson(TpuServer* server, const char* model,
+                               const char* version, char** json_out) {
+  return JsonCall(server, "model_config_json", model, version ? version : "",
+                  json_out);
+}
+
+char* TpuServerModelStatisticsJson(TpuServer* server, const char* model,
+                                   char** json_out) {
+  return JsonCall(server, "model_statistics_json", model ? model : "", "",
+                  json_out);
+}
+
+char* TpuServerInfer(TpuServer* server, const char* request_json,
+                     const TpuServerTensor* inputs, size_t input_count,
+                     TpuServerResponse** response) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  std::string error;
+
+  PyObject* buffers = PyList_New(Py_ssize_t(input_count));
+  for (size_t i = 0; i < input_count; ++i) {
+    // Zero-copy read-only view of caller memory; valid for this call only
+    // (capi_embed._input_array documents the lifetime contract).
+    PyObject* mv = PyMemoryView_FromMemory(
+        const_cast<char*>(static_cast<const char*>(inputs[i].data)),
+        Py_ssize_t(inputs[i].byte_size), PyBUF_READ);
+    if (mv == nullptr) {
+      Py_DECREF(buffers);
+      PyGILState_Release(gil);
+      return DupString("failed to wrap input buffer " + std::to_string(i));
+    }
+    PyList_SET_ITEM(buffers, Py_ssize_t(i), mv);  // steals ref
+  }
+
+  PyObject* args = Py_BuildValue("(OsO)", server->engine, request_json,
+                                 buffers);
+  Py_DECREF(buffers);
+  PyObject* result = CallEmbed("infer", args, &error);
+  Py_XDECREF(args);
+  if (result == nullptr) {
+    PyGILState_Release(gil);
+    return DupString(error);
+  }
+
+  // result = (response_json: str, arrays: list[np.ndarray])
+  if (!PyTuple_Check(result) || PyTuple_Size(result) != 2 ||
+      !PyList_Check(PyTuple_GetItem(result, 1))) {
+    Py_DECREF(result);
+    PyErr_Clear();
+    PyGILState_Release(gil);
+    return DupString("capi_embed.infer returned an unexpected shape "
+                     "(want (json_str, list))");
+  }
+  PyObject* json_obj = PyTuple_GetItem(result, 0);   // borrowed
+  PyObject* arrays = PyTuple_GetItem(result, 1);     // borrowed
+  auto* resp = new TpuServerResponse();
+  const char* jc =
+      PyUnicode_Check(json_obj) ? PyUnicode_AsUTF8(json_obj) : nullptr;
+  resp->json = jc ? jc : "{}";
+  Py_INCREF(arrays);
+  resp->arrays = arrays;
+
+  // Parse output metadata out of the returned JSON on the Python side once:
+  // names/datatypes/shapes are in resp->json; the C side exposes views in
+  // list order, so we re-walk the "outputs" array with Python's json to
+  // avoid duplicating a JSON parser here.
+  PyObject* json_mod = PyImport_ImportModule("json");
+  PyObject* loads = json_mod ? PyObject_GetAttrString(json_mod, "loads")
+                             : nullptr;
+  PyObject* parsed =
+      loads ? PyObject_CallFunction(loads, "s", resp->json.c_str()) : nullptr;
+  PyObject* outs =
+      parsed ? PyDict_GetItemString(parsed, "outputs") : nullptr;  // borrowed
+  Py_ssize_t n = arrays ? PyList_Size(arrays) : 0;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    TpuServerResponse::Output out;
+    if (outs != nullptr && i < PyList_Size(outs)) {
+      PyObject* meta = PyList_GetItem(outs, i);  // borrowed
+      PyObject* name = PyDict_GetItemString(meta, "name");
+      PyObject* dtype = PyDict_GetItemString(meta, "datatype");
+      PyObject* shape = PyDict_GetItemString(meta, "shape");
+      const char* nc = name ? PyUnicode_AsUTF8(name) : nullptr;
+      const char* dc = dtype ? PyUnicode_AsUTF8(dtype) : nullptr;
+      if (nc) out.name = nc;
+      if (dc) out.datatype = dc;
+      if (shape != nullptr) {
+        for (Py_ssize_t d = 0; d < PyList_Size(shape); ++d) {
+          out.shape.push_back(
+              PyLong_AsLongLong(PyList_GetItem(shape, d)));
+        }
+      }
+    }
+    PyObject* arr = PyList_GetItem(arrays, i);  // borrowed
+    if (PyObject_GetBuffer(arr, &out.view, PyBUF_SIMPLE) == 0) {
+      out.have_view = true;
+    } else {
+      PyErr_Clear();
+    }
+    resp->outputs.push_back(std::move(out));
+  }
+  Py_XDECREF(parsed);
+  Py_XDECREF(loads);
+  Py_XDECREF(json_mod);
+  Py_DECREF(result);
+  PyGILState_Release(gil);
+  *response = resp;
+  return nullptr;
+}
+
+const char* TpuServerResponseJson(TpuServerResponse* response) {
+  return response->json.c_str();
+}
+
+size_t TpuServerResponseOutputCount(TpuServerResponse* response) {
+  return response->outputs.size();
+}
+
+char* TpuServerResponseOutput(TpuServerResponse* response, size_t index,
+                              TpuServerTensor* tensor) {
+  if (index >= response->outputs.size()) {
+    return DupString("output index out of range");
+  }
+  const auto& out = response->outputs[index];
+  tensor->name = out.name.c_str();
+  tensor->datatype = out.datatype.c_str();
+  tensor->shape = out.shape.data();
+  tensor->dims = out.shape.size();
+  tensor->data = out.have_view ? out.view.buf : nullptr;
+  tensor->byte_size = out.have_view ? size_t(out.view.len) : 0;
+  return nullptr;
+}
+
+void TpuServerResponseDelete(TpuServerResponse* response) {
+  if (response == nullptr) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  for (auto& out : response->outputs) {
+    if (out.have_view) PyBuffer_Release(&out.view);
+  }
+  Py_XDECREF(response->arrays);
+  PyGILState_Release(gil);
+  delete response;
+}
+
+void TpuServerFreeString(char* s) { free(s); }
+
+}  // extern "C"

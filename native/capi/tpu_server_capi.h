@@ -1,0 +1,75 @@
+// C API of the in-process TPU serving engine (libtpuserver.so).
+//
+// Counterpart of the TRITONSERVER_* C API surface the reference dlopens
+// (/root/reference/src/c++/perf_analyzer/client_backend/triton_c_api/
+// triton_loader.h:83-255): a benchmark process loads this library, creates a
+// server bound to the model zoo, and runs inference with zero network in the
+// loop. The implementation embeds CPython and hosts the JAX/XLA engine
+// (client_tpu.capi_embed); this header is plain C so any language can bind.
+//
+// Error convention: functions return a malloc'd error string (caller frees
+// with TpuServerFreeString) or NULL on success.
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct TpuServer TpuServer;
+typedef struct TpuServerResponse TpuServerResponse;
+
+// A tensor view. For inputs, all pointers are caller-owned and must stay
+// valid for the duration of the call. For outputs, pointers are owned by the
+// TpuServerResponse and valid until TpuServerResponseDelete.
+typedef struct {
+  const char* name;
+  const char* datatype;   // v2 wire dtype string ("INT32", "FP32", ...)
+  const int64_t* shape;
+  size_t dims;
+  const void* data;
+  size_t byte_size;
+} TpuServerTensor;
+
+// Creates a server hosting the given comma-separated model-zoo models (empty
+// = all). repo_root is prepended to the embedded interpreter's sys.path so
+// `client_tpu` resolves; pass NULL to rely on the process CWD.
+char* TpuServerNew(TpuServer** server, const char* models_csv,
+                   const char* repo_root);
+void TpuServerDelete(TpuServer* server);
+
+// Control plane: JSON results (v2-shaped dicts), caller frees *json_out
+// with TpuServerFreeString.
+char* TpuServerMetadataJson(TpuServer* server, char** json_out);
+char* TpuServerModelMetadataJson(TpuServer* server, const char* model,
+                                 const char* version, char** json_out);
+char* TpuServerModelConfigJson(TpuServer* server, const char* model,
+                               const char* version, char** json_out);
+char* TpuServerModelStatisticsJson(TpuServer* server, const char* model,
+                                   char** json_out);
+
+// Synchronous inference. request_json carries model/id/sequence options and
+// the input/output descriptors:
+//   {"model_name": ..., "id": ..., "sequence_id": ..., ...,
+//    "inputs": [{"name","datatype","shape"}...],
+//    "outputs": [{"name","classification"}...]}
+// inputs[i].data supplies the raw bytes for request_json["inputs"][i].
+char* TpuServerInfer(TpuServer* server, const char* request_json,
+                     const TpuServerTensor* inputs, size_t input_count,
+                     TpuServerResponse** response);
+
+// Response access: header JSON (model/id/output metadata) plus zero-copy
+// tensor views into the engine's output arrays.
+const char* TpuServerResponseJson(TpuServerResponse* response);
+size_t TpuServerResponseOutputCount(TpuServerResponse* response);
+char* TpuServerResponseOutput(TpuServerResponse* response, size_t index,
+                              TpuServerTensor* tensor);
+void TpuServerResponseDelete(TpuServerResponse* response);
+
+void TpuServerFreeString(char* s);
+
+#ifdef __cplusplus
+}
+#endif

@@ -90,15 +90,15 @@ int main(int argc, char** argv) {
                                       kTensorBytes),
               "INPUT1 shm");
 
+  // Mixed placement: OUTPUT0 lands in shared memory, OUTPUT1 comes back
+  // inline — the response's raw contents then hold only OUTPUT1, which must
+  // not be misattributed to OUTPUT0 (shm outputs have no raw wire entry).
   tc::InferRequestedOutput *output0, *output1;
   tc::InferRequestedOutput::Create(&output0, "OUTPUT0");
   tc::InferRequestedOutput::Create(&output1, "OUTPUT1");
   std::unique_ptr<tc::InferRequestedOutput> o0(output0), o1(output1);
   FAIL_IF_ERR(output0->SetSharedMemory("grpc_output_data", kTensorBytes, 0),
               "OUTPUT0 shm");
-  FAIL_IF_ERR(output1->SetSharedMemory("grpc_output_data", kTensorBytes,
-                                       kTensorBytes),
-              "OUTPUT1 shm");
 
   tc::InferOptions options("simple");
   tc::InferResult* result;
@@ -109,12 +109,34 @@ int main(int argc, char** argv) {
   FAIL_IF_ERR(result->RequestStatus(), "request status");
 
   const int32_t* out0 = reinterpret_cast<const int32_t*>(output_addr);
-  const int32_t* out1 = out0 + 16;
   for (int i = 0; i < 16; ++i) {
-    if (out0[i] != input0_shm[i] + input1_shm[i] ||
-        out1[i] != input0_shm[i] - input1_shm[i]) {
-      std::cerr << "error: shm output mismatch at " << i << ": " << out0[i]
-                << ", " << out1[i] << std::endl;
+    if (out0[i] != input0_shm[i] + input1_shm[i]) {
+      std::cerr << "error: shm OUTPUT0 mismatch at " << i << ": " << out0[i]
+                << std::endl;
+      return 1;
+    }
+  }
+  const uint8_t* shm_view = nullptr;
+  size_t shm_view_size = 1;
+  FAIL_IF_ERR(result->RawData("OUTPUT0", &shm_view, &shm_view_size),
+              "OUTPUT0 raw");
+  if (shm_view != nullptr || shm_view_size != 0) {
+    std::cerr << "error: shm OUTPUT0 unexpectedly has inline bytes"
+              << std::endl;
+    return 1;
+  }
+  const uint8_t* buf = nullptr;
+  size_t byte_size = 0;
+  FAIL_IF_ERR(result->RawData("OUTPUT1", &buf, &byte_size), "OUTPUT1 raw");
+  if (byte_size != kTensorBytes) {
+    std::cerr << "error: OUTPUT1 byte size " << byte_size << std::endl;
+    return 1;
+  }
+  const int32_t* out1 = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) {
+    if (out1[i] != input0_shm[i] - input1_shm[i]) {
+      std::cerr << "error: inline OUTPUT1 mismatch at " << i << ": "
+                << out1[i] << std::endl;
       return 1;
     }
   }

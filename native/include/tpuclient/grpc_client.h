@@ -64,8 +64,11 @@ class InferResultGrpc : public InferResult {
                   Error status);
   std::shared_ptr<inference::ModelInferResponse> response_;
   Error status_;
-  // output name -> index into response outputs/raw_output_contents
+  // output name -> index into response outputs
   std::map<std::string, int> index_;
+  // output name -> index into raw_output_contents (-1 = shared memory; the
+  // wire carries no raw entry for shm outputs)
+  std::map<std::string, int> raw_index_;
 };
 
 class InferenceServerGrpcClient : public InferenceServerClient {
@@ -150,7 +153,6 @@ class InferenceServerGrpcClient : public InferenceServerClient {
     int32_t sid = 0;
     OnCompleteFn callback;
     RequestTimers timers;
-    std::string recv;  // accumulated gRPC frame bytes
   };
   void AsyncWorker();
   void StreamWorker();
@@ -176,7 +178,10 @@ class InferenceServerGrpcClient : public InferenceServerClient {
 
   // Streaming state.
   std::mutex stream_mutex_;
-  std::condition_variable stream_cv_;
+  // Serializes whole gRPC messages onto the bidi stream: h2 SendData locks
+  // per DATA chunk, so without this two AsyncStreamInfer calls (or a racing
+  // StopStream half-close) could interleave chunks of different messages.
+  std::mutex stream_send_mutex_;
   int32_t stream_sid_ = 0;
   bool stream_active_ = false;
   OnCompleteFn stream_callback_;

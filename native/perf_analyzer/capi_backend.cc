@@ -1,0 +1,363 @@
+// Kind=TPU_CAPI: runs the TPU serving engine IN-PROCESS by dlopen'ing
+// libtpuserver.so and binding its C API — no network anywhere in the loop.
+//
+// Counterpart of the reference's triton_c_api backend, which dlopens
+// libtritonserver.so and binds ~45 TRITONSERVER_* entrypoints
+// (/root/reference/src/c++/perf_analyzer/client_backend/triton_c_api/
+// shared_library.cc:37-89, triton_loader.h:83-255, triton_loader.cc:251).
+// Like the reference (main.cc:1227-1248): sync-only, no shared memory —
+// in-process tensors are already zero-copy by construction.
+
+#include <dlfcn.h>
+
+#include <cstring>
+#include <mutex>
+
+#include "client_backend.h"
+#include "../capi/tpu_server_capi.h"
+
+using tpuclient::Error;
+using tpuclient::JsonPtr;
+
+namespace tpuperf {
+
+namespace {
+
+// Singleton loader: one dlopen'd library + one engine per process, shared by
+// every worker's backend instance (reference TritonLoader singleton).
+class TpuServerLibrary {
+ public:
+  static TpuServerLibrary& Get() {
+    static TpuServerLibrary lib;
+    return lib;
+  }
+
+  Error Init(const std::string& lib_path, const std::string& models,
+             const std::string& repo_root) {
+    std::lock_guard<std::mutex> lk(mutex_);
+    if (server_ != nullptr) return Error::Success();
+    handle_ = dlopen(lib_path.c_str(), RTLD_NOW | RTLD_GLOBAL);
+    if (handle_ == nullptr) {
+      return Error(std::string("dlopen failed: ") + dlerror());
+    }
+    auto bind = [this](const char* name) -> void* {
+      void* fn = dlsym(handle_, name);
+      if (fn == nullptr) bind_error_ = name;
+      return fn;
+    };
+    new_ = reinterpret_cast<decltype(&TpuServerNew)>(bind("TpuServerNew"));
+    delete_ =
+        reinterpret_cast<decltype(&TpuServerDelete)>(bind("TpuServerDelete"));
+    metadata_ = reinterpret_cast<decltype(&TpuServerModelMetadataJson)>(
+        bind("TpuServerModelMetadataJson"));
+    config_ = reinterpret_cast<decltype(&TpuServerModelConfigJson)>(
+        bind("TpuServerModelConfigJson"));
+    stats_ = reinterpret_cast<decltype(&TpuServerModelStatisticsJson)>(
+        bind("TpuServerModelStatisticsJson"));
+    infer_ = reinterpret_cast<decltype(&TpuServerInfer)>(
+        bind("TpuServerInfer"));
+    resp_json_ = reinterpret_cast<decltype(&TpuServerResponseJson)>(
+        bind("TpuServerResponseJson"));
+    resp_count_ = reinterpret_cast<decltype(&TpuServerResponseOutputCount)>(
+        bind("TpuServerResponseOutputCount"));
+    resp_output_ = reinterpret_cast<decltype(&TpuServerResponseOutput)>(
+        bind("TpuServerResponseOutput"));
+    resp_delete_ = reinterpret_cast<decltype(&TpuServerResponseDelete)>(
+        bind("TpuServerResponseDelete"));
+    free_ = reinterpret_cast<decltype(&TpuServerFreeString)>(
+        bind("TpuServerFreeString"));
+    if (!bind_error_.empty()) {
+      return Error("missing symbol in " + lib_path + ": " + bind_error_);
+    }
+    char* err = new_(&server_, models.c_str(),
+                     repo_root.empty() ? nullptr : repo_root.c_str());
+    if (err != nullptr) {
+      std::string msg(err);
+      free_(err);
+      server_ = nullptr;
+      return Error("TpuServerNew failed: " + msg);
+    }
+    return Error::Success();
+  }
+
+  // Wraps a C-API call returning a malloc'd error string.
+  Error Wrap(char* err) {
+    if (err == nullptr) return Error::Success();
+    std::string msg(err);
+    free_(err);
+    return Error(msg, 400);
+  }
+
+  TpuServer* server() { return server_; }
+
+  decltype(&TpuServerModelMetadataJson) metadata_ = nullptr;
+  decltype(&TpuServerModelConfigJson) config_ = nullptr;
+  decltype(&TpuServerModelStatisticsJson) stats_ = nullptr;
+  decltype(&TpuServerInfer) infer_ = nullptr;
+  decltype(&TpuServerResponseJson) resp_json_ = nullptr;
+  decltype(&TpuServerResponseOutputCount) resp_count_ = nullptr;
+  decltype(&TpuServerResponseOutput) resp_output_ = nullptr;
+  decltype(&TpuServerResponseDelete) resp_delete_ = nullptr;
+  decltype(&TpuServerFreeString) free_ = nullptr;
+
+ private:
+  TpuServerLibrary() = default;
+  std::mutex mutex_;
+  void* handle_ = nullptr;
+  std::string bind_error_;
+  decltype(&TpuServerNew) new_ = nullptr;
+  decltype(&TpuServerDelete) delete_ = nullptr;
+  TpuServer* server_ = nullptr;
+};
+
+// Result over an in-process response: raw views straight into the engine's
+// output arrays (held alive by the response object).
+class InferResultCApi : public tpuclient::InferResult {
+ public:
+  InferResultCApi(TpuServerResponse* response, JsonPtr head)
+      : response_(response), head_(std::move(head)) {
+    auto& lib = TpuServerLibrary::Get();
+    size_t n = lib.resp_count_(response_);
+    for (size_t i = 0; i < n; ++i) {
+      TpuServerTensor t{};
+      char* err = lib.resp_output_(response_, i, &t);
+      if (err != nullptr) {
+        lib.free_(err);
+        continue;
+      }
+      outputs_[t.name] = t;
+    }
+  }
+
+  ~InferResultCApi() override {
+    TpuServerLibrary::Get().resp_delete_(response_);
+  }
+
+  Error ModelName(std::string* name) const override {
+    return FromHead("model_name", name);
+  }
+  Error ModelVersion(std::string* version) const override {
+    return FromHead("model_version", version);
+  }
+  Error Id(std::string* id) const override { return FromHead("id", id); }
+
+  Error Shape(const std::string& output_name,
+              std::vector<int64_t>* shape) const override {
+    auto it = outputs_.find(output_name);
+    if (it == outputs_.end())
+      return Error("output '" + output_name + "' not found");
+    shape->assign(it->second.shape, it->second.shape + it->second.dims);
+    return Error::Success();
+  }
+
+  Error Datatype(const std::string& output_name,
+                 std::string* datatype) const override {
+    auto it = outputs_.find(output_name);
+    if (it == outputs_.end())
+      return Error("output '" + output_name + "' not found");
+    *datatype = it->second.datatype;
+    return Error::Success();
+  }
+
+  Error RawData(const std::string& output_name, const uint8_t** buf,
+                size_t* byte_size) const override {
+    auto it = outputs_.find(output_name);
+    if (it == outputs_.end())
+      return Error("output '" + output_name + "' not found");
+    *buf = static_cast<const uint8_t*>(it->second.data);
+    *byte_size = it->second.byte_size;
+    return Error::Success();
+  }
+
+  Error RequestStatus() const override { return Error::Success(); }
+  std::string DebugString() const override {
+    return head_ ? head_->Serialize() : "{}";
+  }
+
+ private:
+  Error FromHead(const char* key, std::string* out) const {
+    if (head_ == nullptr) return Error("no response head");
+    JsonPtr v = head_->Get(key);
+    *out = v && v->IsString() ? v->AsString() : "";
+    return Error::Success();
+  }
+
+  TpuServerResponse* response_;
+  JsonPtr head_;
+  std::map<std::string, TpuServerTensor> outputs_;
+};
+
+class CApiClientBackend : public ClientBackend {
+ public:
+  static Error Create(const std::string& lib_path, const std::string& models,
+                      const std::string& repo_root,
+                      std::unique_ptr<ClientBackend>* backend) {
+    Error err = TpuServerLibrary::Get().Init(lib_path, models, repo_root);
+    if (!err.IsOk()) return err;
+    backend->reset(new CApiClientBackend());
+    return Error::Success();
+  }
+
+  Error ServerExtensions(std::vector<std::string>* extensions) override {
+    extensions->clear();
+    return Error::Success();
+  }
+
+  Error ModelMetadata(JsonPtr* metadata, const std::string& model_name,
+                      const std::string& version) override {
+    auto& lib = TpuServerLibrary::Get();
+    char* json = nullptr;
+    Error err = lib.Wrap(lib.metadata_(lib.server(), model_name.c_str(),
+                                       version.c_str(), &json));
+    if (!err.IsOk()) return err;
+    err = tpuclient::Json::Parse(json, metadata);
+    lib.free_(json);
+    return err;
+  }
+
+  Error ModelConfig(JsonPtr* config, const std::string& model_name,
+                    const std::string& version) override {
+    auto& lib = TpuServerLibrary::Get();
+    char* json = nullptr;
+    Error err = lib.Wrap(lib.config_(lib.server(), model_name.c_str(),
+                                     version.c_str(), &json));
+    if (!err.IsOk()) return err;
+    err = tpuclient::Json::Parse(json, config);
+    lib.free_(json);
+    return err;
+  }
+
+  Error Infer(tpuclient::InferResult** result,
+              const tpuclient::InferOptions& options,
+              const std::vector<tpuclient::InferInput*>& inputs,
+              const std::vector<const tpuclient::InferRequestedOutput*>&
+                  outputs) override {
+    auto& lib = TpuServerLibrary::Get();
+    tpuclient::RequestTimers timers;
+    timers.Capture(tpuclient::RequestTimers::Kind::REQUEST_START);
+    timers.Capture(tpuclient::RequestTimers::Kind::SEND_START);
+
+    // Build the request head.
+    JsonPtr req = tpuclient::Json::MakeObject();
+    req->Set("model_name", options.model_name);
+    if (!options.model_version.empty())
+      req->Set("model_version", options.model_version);
+    if (!options.request_id.empty()) req->Set("id", options.request_id);
+    if (options.sequence_id != 0) {
+      req->Set("sequence_id", uint64_t(options.sequence_id));
+      req->Set("sequence_start", options.sequence_start);
+      req->Set("sequence_end", options.sequence_end);
+    }
+    if (options.priority != 0) req->Set("priority", uint64_t(options.priority));
+    if (options.server_timeout_us != 0)
+      req->Set("timeout_us", uint64_t(options.server_timeout_us));
+
+    std::vector<TpuServerTensor> tensors(inputs.size());
+    std::vector<std::string> staging(inputs.size());
+    JsonPtr in_list = tpuclient::Json::MakeArray();
+    for (size_t i = 0; i < inputs.size(); ++i) {
+      const auto* input = inputs[i];
+      JsonPtr meta = tpuclient::Json::MakeObject();
+      meta->Set("name", input->Name());
+      meta->Set("datatype", input->Datatype());
+      JsonPtr shape = tpuclient::Json::MakeArray();
+      for (int64_t d : input->Shape())
+        shape->Append(tpuclient::Json::MakeInt(d));
+      meta->Set("shape", shape);
+      in_list->Append(meta);
+
+      TpuServerTensor& t = tensors[i];
+      t.name = nullptr;  // names travel in the JSON head
+      t.datatype = nullptr;
+      t.shape = nullptr;
+      t.dims = 0;
+      const auto& bufs = input->Buffers();
+      if (bufs.size() == 1) {
+        t.data = bufs[0].first;
+        t.byte_size = bufs[0].second;
+      } else {
+        input->CopyTo(&staging[i]);
+        t.data = staging[i].data();
+        t.byte_size = staging[i].size();
+      }
+    }
+    req->Set("inputs", in_list);
+    JsonPtr out_list = tpuclient::Json::MakeArray();
+    for (const auto* output : outputs) {
+      JsonPtr meta = tpuclient::Json::MakeObject();
+      meta->Set("name", output->Name());
+      if (output->ClassCount() > 0)
+        meta->Set("classification", uint64_t(output->ClassCount()));
+      out_list->Append(meta);
+    }
+    req->Set("outputs", out_list);
+
+    TpuServerResponse* response = nullptr;
+    Error err = lib.Wrap(lib.infer_(lib.server(), req->Serialize().c_str(),
+                                    tensors.data(), tensors.size(),
+                                    &response));
+    timers.Capture(tpuclient::RequestTimers::Kind::SEND_END);
+    timers.Capture(tpuclient::RequestTimers::Kind::RECV_START);
+    timers.Capture(tpuclient::RequestTimers::Kind::RECV_END);
+    timers.Capture(tpuclient::RequestTimers::Kind::REQUEST_END);
+    if (!err.IsOk()) return err;
+
+    JsonPtr head;
+    Error perr = tpuclient::Json::Parse(lib.resp_json_(response), &head);
+    if (!perr.IsOk()) head = nullptr;
+    *result = new InferResultCApi(response, head);
+    {
+      std::lock_guard<std::mutex> lk(stat_mutex_);
+      stat_.completed_request_count++;
+      stat_.cumulative_total_request_time_ns +=
+          timers.request_end_ns - timers.request_start_ns;
+    }
+    return Error::Success();
+  }
+
+  Error AsyncInfer(tpuclient::OnCompleteFn,
+                   const tpuclient::InferOptions&,
+                   const std::vector<tpuclient::InferInput*>&,
+                   const std::vector<const tpuclient::InferRequestedOutput*>&)
+      override {
+    return Error("TPU_CAPI backend is sync-only (like the reference C-API "
+                 "kind)", 400);
+  }
+
+  Error ModelInferenceStatistics(std::map<std::string, ModelStatistics>* stats,
+                                 const std::string& model_name) override {
+    auto& lib = TpuServerLibrary::Get();
+    char* json = nullptr;
+    Error err =
+        lib.Wrap(lib.stats_(lib.server(), model_name.c_str(), &json));
+    if (!err.IsOk()) return err;
+    JsonPtr body;
+    err = tpuclient::Json::Parse(json, &body);
+    lib.free_(json);
+    if (!err.IsOk()) return err;
+    return ParseModelStatsJson(body, stats);
+  }
+
+  Error ClientInferStat(tpuclient::InferStat* stat) override {
+    std::lock_guard<std::mutex> lk(stat_mutex_);
+    *stat = stat_;
+    return Error::Success();
+  }
+
+  bool SupportsAsync() const override { return false; }
+
+ private:
+  CApiClientBackend() = default;
+  std::mutex stat_mutex_;
+  tpuclient::InferStat stat_;
+};
+
+}  // namespace
+
+Error CreateCApiBackend(const std::string& lib_path, const std::string& models,
+                        const std::string& repo_root,
+                        std::unique_ptr<ClientBackend>* backend) {
+  return CApiClientBackend::Create(lib_path, models, repo_root, backend);
+}
+
+}  // namespace tpuperf

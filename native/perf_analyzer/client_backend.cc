@@ -77,42 +77,7 @@ class HttpClientBackend : public ClientBackend {
     JsonPtr body;
     Error err = client_->ModelInferenceStatistics(&body, model_name);
     if (!err.IsOk()) return err;
-    stats->clear();
-    JsonPtr list = body->Get("model_stats");
-    if (!list || !list->IsArray())
-      return Error("statistics response missing model_stats", 400);
-    for (size_t i = 0; i < list->Size(); ++i) {
-      JsonPtr m = list->At(i);
-      if (!m->IsObject()) continue;
-      JsonPtr name = m->Get("name");
-      if (!name || !name->IsString()) continue;
-      ModelStatistics ms;
-      auto u64 = [&](const JsonPtr& obj, const char* key) -> uint64_t {
-        if (!obj) return 0;
-        JsonPtr v = obj->Get(key);
-        return v && v->IsNumber() ? v->AsUint() : 0;
-      };
-      ms.inference_count = u64(m, "inference_count");
-      ms.execution_count = u64(m, "execution_count");
-      JsonPtr infer_stats = m->Get("inference_stats");
-      if (infer_stats && infer_stats->IsObject()) {
-        auto phase = [&](const char* key, uint64_t* count_out) -> uint64_t {
-          JsonPtr p = infer_stats->Get(key);
-          if (!p || !p->IsObject()) return 0;
-          if (count_out) *count_out = u64(p, "count");
-          return u64(p, "ns");
-        };
-        uint64_t success_count = 0;
-        ms.cumulative_request_time_ns = phase("success", &success_count);
-        ms.success_count = success_count;
-        ms.queue_time_ns = phase("queue", nullptr);
-        ms.compute_input_time_ns = phase("compute_input", nullptr);
-        ms.compute_infer_time_ns = phase("compute_infer", nullptr);
-        ms.compute_output_time_ns = phase("compute_output", nullptr);
-      }
-      (*stats)[name->AsString()] = ms;
-    }
-    return Error::Success();
+    return ParseModelStatsJson(body, stats);
   }
 
   Error ClientInferStat(tpuclient::InferStat* stat) override {
@@ -136,6 +101,46 @@ class HttpClientBackend : public ClientBackend {
 
 }  // namespace
 
+Error ParseModelStatsJson(const JsonPtr& body,
+                          std::map<std::string, ModelStatistics>* stats) {
+  stats->clear();
+  JsonPtr list = body->Get("model_stats");
+  if (!list || !list->IsArray())
+    return Error("statistics response missing model_stats", 400);
+  for (size_t i = 0; i < list->Size(); ++i) {
+    JsonPtr m = list->At(i);
+    if (!m->IsObject()) continue;
+    JsonPtr name = m->Get("name");
+    if (!name || !name->IsString()) continue;
+    ModelStatistics ms;
+    auto u64 = [&](const JsonPtr& obj, const char* key) -> uint64_t {
+      if (!obj) return 0;
+      JsonPtr v = obj->Get(key);
+      return v && v->IsNumber() ? v->AsUint() : 0;
+    };
+    ms.inference_count = u64(m, "inference_count");
+    ms.execution_count = u64(m, "execution_count");
+    JsonPtr infer_stats = m->Get("inference_stats");
+    if (infer_stats && infer_stats->IsObject()) {
+      auto phase = [&](const char* key, uint64_t* count_out) -> uint64_t {
+        JsonPtr p = infer_stats->Get(key);
+        if (!p || !p->IsObject()) return 0;
+        if (count_out) *count_out = u64(p, "count");
+        return u64(p, "ns");
+      };
+      uint64_t success_count = 0;
+      ms.cumulative_request_time_ns = phase("success", &success_count);
+      ms.success_count = success_count;
+      ms.queue_time_ns = phase("queue", nullptr);
+      ms.compute_input_time_ns = phase("compute_input", nullptr);
+      ms.compute_infer_time_ns = phase("compute_infer", nullptr);
+      ms.compute_output_time_ns = phase("compute_output", nullptr);
+    }
+    (*stats)[name->AsString()] = ms;
+  }
+  return Error::Success();
+}
+
 Error ClientBackendFactory::Create(
     std::unique_ptr<ClientBackend>* backend) const {
   switch (kind_) {
@@ -143,7 +148,8 @@ Error ClientBackendFactory::Create(
       return HttpClientBackend::Create(url_, verbose_, max_async_concurrency_,
                                        backend);
     case BackendKind::TPU_CAPI:
-      return Error("TPU_CAPI backend not wired yet", 400);
+      return CreateCApiBackend(capi_lib_path_, capi_models_, capi_repo_root_,
+                               backend);
   }
   return Error("unknown backend kind", 400);
 }

@@ -84,6 +84,17 @@ class ClientBackendFactory {
       : kind_(kind), url_(std::move(url)), verbose_(verbose),
         max_async_concurrency_(max_async_concurrency) {}
 
+  // TPU_CAPI parameters: path to libtpuserver.so, comma-separated model-zoo
+  // names to host, and the repo root for the embedded interpreter's
+  // sys.path (reference triton_c_api takes the triton library dir the same
+  // way, main.cc:1253-1266).
+  void SetCApiOptions(std::string lib_path, std::string models,
+                      std::string repo_root) {
+    capi_lib_path_ = std::move(lib_path);
+    capi_models_ = std::move(models);
+    capi_repo_root_ = std::move(repo_root);
+  }
+
   tpuclient::Error Create(std::unique_ptr<ClientBackend>* backend) const;
 
   BackendKind Kind() const { return kind_; }
@@ -93,6 +104,21 @@ class ClientBackendFactory {
   std::string url_;
   bool verbose_;
   size_t max_async_concurrency_;
+  std::string capi_lib_path_;
+  std::string capi_models_;
+  std::string capi_repo_root_;
 };
+
+// Parses a v2 statistics body ({"model_stats": [...]}) into the per-model
+// map; shared by the HTTP and C-API backends.
+tpuclient::Error ParseModelStatsJson(
+    const tpuclient::JsonPtr& body,
+    std::map<std::string, ModelStatistics>* stats);
+
+// Defined in capi_backend.cc.
+tpuclient::Error CreateCApiBackend(const std::string& lib_path,
+                                   const std::string& models,
+                                   const std::string& repo_root,
+                                   std::unique_ptr<ClientBackend>* backend);
 
 }  // namespace tpuperf

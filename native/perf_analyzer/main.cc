@@ -54,6 +54,14 @@ Options:
   --shared-memory <none|system>   tensor transport (default none)
   --output-shared-memory-size <bytes>
   --max-threads <n>      worker thread cap (default 16)
+  --service-kind <tpu_http|tpu_capi>   endpoint kind (default tpu_http);
+                         tpu_capi runs the engine in-process via
+                         libtpuserver.so — no network, sync only
+  --capi-library-path <path>   libtpuserver.so location
+                               (default ./build/libtpuserver.so)
+  --capi-models <csv>    model-zoo models the in-process server hosts
+                         (default: the -m model)
+  --capi-repo-root <dir> repo root for the embedded python (default .)
   -f <path>              export CSV
   -v                     verbose
 )");
@@ -90,6 +98,10 @@ struct Args {
   std::string csv_path;
   bool verbose = false;
   bool poisson = false;
+  BackendKind kind = BackendKind::TPU_HTTP;
+  std::string capi_lib = "./build/libtpuserver.so";
+  std::string capi_models;
+  std::string capi_repo_root = ".";
 };
 
 bool ParseRange(const char* s, double* a, double* b, double* c) {
@@ -198,6 +210,10 @@ int main(int argc, char** argv) {
       {"shared-memory", required_argument, nullptr, 1014},
       {"output-shared-memory-size", required_argument, nullptr, 1015},
       {"max-threads", required_argument, nullptr, 1016},
+      {"service-kind", required_argument, nullptr, 1017},
+      {"capi-library-path", required_argument, nullptr, 1018},
+      {"capi-models", required_argument, nullptr, 1019},
+      {"capi-repo-root", required_argument, nullptr, 1020},
       {"help", no_argument, nullptr, 'h'},
       {nullptr, 0, nullptr, 0}};
 
@@ -280,15 +296,34 @@ int main(int argc, char** argv) {
         break;
       case 1015: args.output_shm_size = strtoull(optarg, nullptr, 10); break;
       case 1016: args.max_threads = strtoull(optarg, nullptr, 10); break;
+      case 1017:
+        if (strcmp(optarg, "tpu_capi") == 0) args.kind = BackendKind::TPU_CAPI;
+        else if (strcmp(optarg, "tpu_http") != 0)
+          Usage("--service-kind must be tpu_http|tpu_capi");
+        break;
+      case 1018: args.capi_lib = optarg; break;
+      case 1019: args.capi_models = optarg; break;
+      case 1020: args.capi_repo_root = optarg; break;
       default: Usage("unknown option");
     }
   }
   if (args.model.empty()) Usage("-m <model> is required");
   if (args.protocol != "http") Usage("only -i http is available");
+  if (args.kind == BackendKind::TPU_CAPI) {
+    // Same restrictions as the reference's C-API kind (main.cc:1227-1248):
+    // in-process path is sync-only and has no shm control plane (in-process
+    // tensors are already zero-copy).
+    if (args.async) Usage("--service-kind tpu_capi is sync-only");
+    if (args.shm != SharedMemoryType::NONE)
+      Usage("--shared-memory is not applicable to tpu_capi");
+    if (args.capi_models.empty()) args.capi_models = args.model;
+  }
 
   // --- backend + parser -----------------------------------------------------
-  ClientBackendFactory factory(BackendKind::TPU_HTTP, args.url, args.verbose,
+  ClientBackendFactory factory(args.kind, args.url, args.verbose,
                                /*max_async_concurrency=*/32);
+  factory.SetCApiOptions(args.capi_lib, args.capi_models,
+                         args.capi_repo_root);
   std::unique_ptr<ClientBackend> meta_backend;
   Error err = factory.Create(&meta_backend);
   if (!err.IsOk()) {

@@ -102,6 +102,24 @@ Error GrpcStatusFromStream(const h2::Connection::Stream& s, bool* found) {
                code == 4 ? 499 : code);
 }
 
+// Extracts status + the single framed message from a finished unary stream
+// (shared by Rpc, Infer, and the async completion worker).
+Error ExtractUnaryResult(const h2::Connection::Stream& s, std::string* msg) {
+  if (s.reset && !s.end_stream) {
+    return Error("gRPC: stream reset (code " + std::to_string(s.reset_code) +
+                 ")");
+  }
+  bool have = false;
+  Error status = GrpcStatusFromStream(s, &have);
+  if (!status.IsOk()) return status;
+  size_t pos = 0;
+  Error perr = Error::Success();
+  if (!PopMessage(s.data, &pos, msg, &perr)) {
+    return perr.IsOk() ? Error("gRPC: empty unary response") : perr;
+  }
+  return Error::Success();
+}
+
 h2::HeaderList CallHeaders(const std::string& authority,
                            const std::string& method, uint64_t timeout_us,
                            const GrpcHeaders& extra) {
@@ -162,8 +180,15 @@ InferResultGrpc::InferResultGrpc(
     std::shared_ptr<inference::ModelInferResponse> response, Error status)
     : response_(std::move(response)), status_(std::move(status)) {
   if (response_ != nullptr) {
+    // raw_output_contents has no entry for shared-memory outputs (the server
+    // skips them, grpc_server.py _response_to_proto), so the raw index must
+    // be counted over non-shm outputs only.
+    int raw_idx = 0;
     for (int i = 0; i < response_->outputs_size(); ++i) {
-      index_[response_->outputs(i).name()] = i;
+      const auto& out = response_->outputs(i);
+      index_[out.name()] = i;
+      bool in_shm = out.parameters().count("shared_memory_region") > 0;
+      raw_index_[out.name()] = in_shm ? -1 : raw_idx++;
     }
   }
 }
@@ -212,11 +237,11 @@ Error InferResultGrpc::Datatype(const std::string& output_name,
 Error InferResultGrpc::RawData(const std::string& output_name,
                                const uint8_t** buf, size_t* byte_size) const {
   if (!status_.IsOk()) return status_;
-  auto it = index_.find(output_name);
-  if (it == index_.end()) {
+  auto it = raw_index_.find(output_name);
+  if (it == raw_index_.end()) {
     return Error("output '" + output_name + "' not found");
   }
-  if (it->second >= response_->raw_output_contents_size()) {
+  if (it->second < 0 || it->second >= response_->raw_output_contents_size()) {
     // Output lives in shared memory — no inline bytes on the wire.
     *buf = nullptr;
     *byte_size = 0;
@@ -271,15 +296,26 @@ Error InferenceServerGrpcClient::Connect(const std::string& url,
   authority_ = host + ":" + std::to_string(port);
 
   if (use_cached_channel) {
-    std::lock_guard<std::mutex> lk(CacheMutex());
-    auto it = ChannelCache().find(authority_);
-    if (it != ChannelCache().end() && it->second->Alive()) {
-      conn_ = it->second;
-      return Error::Success();
+    {
+      std::lock_guard<std::mutex> lk(CacheMutex());
+      auto it = ChannelCache().find(authority_);
+      if (it != ChannelCache().end() && it->second->Alive()) {
+        conn_ = it->second;
+        return Error::Success();
+      }
     }
+    // Connect OUTSIDE the cache lock: a slow/unreachable host must not
+    // stall unrelated clients' Create calls. Losing the insert race just
+    // means adopting the winner's connection.
     auto conn = std::make_shared<h2::Connection>();
     Error err = conn->Connect(host, port);
     if (!err.IsOk()) return err;
+    std::lock_guard<std::mutex> lk(CacheMutex());
+    auto it = ChannelCache().find(authority_);
+    if (it != ChannelCache().end() && it->second->Alive()) {
+      conn_ = it->second;  // another thread won; drop ours
+      return Error::Success();
+    }
     ChannelCache()[authority_] = conn;
     conn_ = conn;
     return Error::Success();
@@ -319,28 +355,8 @@ Error InferenceServerGrpcClient::Rpc(const std::string& method,
   }
   std::string msg;
   Error status("stream vanished");
-  bool have_status = false;
   conn_->WithStream(sid, [&](h2::Connection::Stream& s) {
-    if (s.reset && !s.end_stream) {
-      status = Error("gRPC: stream reset (code " +
-                     std::to_string(s.reset_code) + ")" +
-                     (conn_->Alive() ? "" : ": " + conn_->ConnectionError()));
-      have_status = true;
-      return;
-    }
-    status = GrpcStatusFromStream(s, &have_status);
-    if (!have_status) {
-      status = Error("gRPC: missing response status");
-      have_status = true;
-      return;
-    }
-    if (status.IsOk()) {
-      size_t pos = 0;
-      Error perr = Error::Success();
-      if (!PopMessage(s.data, &pos, &msg, &perr)) {
-        status = perr.IsOk() ? Error("gRPC: empty unary response") : perr;
-      }
-    }
+    status = ExtractUnaryResult(s, &msg);
   });
   conn_->CloseStream(sid);
   if (!status.IsOk()) return status;
@@ -594,23 +610,9 @@ Error InferenceServerGrpcClient::Infer(
   auto response = std::make_shared<inference::ModelInferResponse>();
   Error status("stream vanished");
   conn_->WithStream(sid, [&](h2::Connection::Stream& s) {
-    if (s.reset && !s.end_stream) {
-      status = Error("gRPC: stream reset (code " +
-                     std::to_string(s.reset_code) + ")" +
-                     (conn_->Alive() ? "" : ": " + conn_->ConnectionError()));
-      return;
-    }
-    bool have = false;
-    status = GrpcStatusFromStream(s, &have);
-    if (!status.IsOk()) return;
-    size_t pos = 0;
     std::string msg;
-    Error perr = Error::Success();
-    if (!PopMessage(s.data, &pos, &msg, &perr)) {
-      status = perr.IsOk() ? Error("gRPC: empty infer response") : perr;
-      return;
-    }
-    if (!response->ParseFromString(msg)) {
+    status = ExtractUnaryResult(s, &msg);
+    if (status.IsOk() && !response->ParseFromString(msg)) {
       status = Error("failed to parse infer response");
     }
   });
@@ -722,23 +724,9 @@ void InferenceServerGrpcClient::AsyncWorker() {
           job->sid, [&](h2::Connection::Stream& s) {
             if (!s.end_stream && !s.reset) return;
             done = true;
-            if (s.reset && !s.end_stream) {
-              status = Error("gRPC: stream reset (code " +
-                             std::to_string(s.reset_code) + ")");
-              return;
-            }
-            bool have = false;
-            status = GrpcStatusFromStream(s, &have);
-            if (!status.IsOk()) return;
-            size_t pos = 0;
             std::string msg;
-            Error perr = Error::Success();
-            if (!PopMessage(s.data, &pos, &msg, &perr)) {
-              status =
-                  perr.IsOk() ? Error("gRPC: empty infer response") : perr;
-              return;
-            }
-            if (!response->ParseFromString(msg)) {
+            status = ExtractUnaryResult(s, &msg);
+            if (status.IsOk() && !response->ParseFromString(msg)) {
               status = Error("failed to parse infer response");
             }
           });
@@ -801,6 +789,7 @@ Error InferenceServerGrpcClient::AsyncStreamInfer(
   }
   std::string body;
   FrameMessage(payload, &body);
+  std::lock_guard<std::mutex> lk(stream_send_mutex_);
   return conn_->SendData(sid, reinterpret_cast<const uint8_t*>(body.data()),
                          body.size(), false,
                          DeadlineNs(options.client_timeout_us));
@@ -809,14 +798,17 @@ Error InferenceServerGrpcClient::AsyncStreamInfer(
 void InferenceServerGrpcClient::StreamWorker() {
   // Reads stream responses in order and fires the user callback per message
   // (reference AsyncStreamTransfer read loop, grpc_client.cc:1271-1315).
+  size_t want = 5;  // unconsumed bytes needed before the next scan is useful
   while (true) {
     bool closed = false;
     std::vector<std::string> messages;
     Error terminal = Error::Success();
     // Bounded wait so StopStream's stream_exit_ flag is honored even when
     // the peer never closes; normal wakeups come from the reader's
-    // state_cv_ notifications inside WaitStream.
-    conn_->WaitStream(stream_sid_, 5,
+    // state_cv_ notifications inside WaitStream. `want` grows to the full
+    // frame size once a message header is visible, so a partially-received
+    // large message blocks here instead of spinning.
+    conn_->WaitStream(stream_sid_, want,
                       RequestTimers::Now() + uint64_t(250e6));
     if (stream_exit_.load()) return;
     bool present = conn_->WithStream(
@@ -828,6 +820,15 @@ void InferenceServerGrpcClient::StreamWorker() {
             messages.push_back(std::move(msg));
           }
           s.consumed = pos;
+          size_t avail = s.data.size() - s.consumed;
+          want = 5;
+          if (avail >= 5) {
+            const uint8_t* p =
+                reinterpret_cast<const uint8_t*>(s.data.data()) + s.consumed;
+            uint32_t len = (uint32_t(p[1]) << 24) | (uint32_t(p[2]) << 16) |
+                           (uint32_t(p[3]) << 8) | uint32_t(p[4]);
+            want = 5 + size_t(len);
+          }
           // Trim consumed prefix so long-lived streams don't grow without
           // bound.
           if (s.consumed > (1u << 20)) {
@@ -889,11 +890,13 @@ Error InferenceServerGrpcClient::StopStream() {
   }
   // Half-close; the server answers with trailers, the worker drains and
   // exits, then the stream can be dropped.
-  conn_->SendData(sid, nullptr, 0, true);
+  {
+    std::lock_guard<std::mutex> send_lk(stream_send_mutex_);
+    conn_->SendData(sid, nullptr, 0, true);
+  }
   uint64_t deadline = RequestTimers::Now() + uint64_t(5e9);
   conn_->WaitStream(sid, SIZE_MAX, deadline);
   stream_exit_ = true;
-  stream_cv_.notify_all();
   if (stream_worker_.joinable()) stream_worker_.join();
   conn_->CloseStream(sid);
   std::lock_guard<std::mutex> lk(stream_mutex_);

@@ -117,6 +117,30 @@ def test_perf_analyzer_smoke(native_build, server, tmp_path):
     assert ips > 0
 
 
+def test_perf_analyzer_capi_inprocess(native_build, tmp_path):
+    """--service-kind tpu_capi: perf harness dlopens libtpuserver.so, which
+    embeds CPython hosting the engine — no server process, no network
+    (reference triton_c_api kind, SURVEY.md §2.3/§3.5). CPU platform for
+    hermetic runs."""
+    csv = tmp_path / "capi.csv"
+    env = dict(os.environ, CLIENT_TPU_PLATFORM="cpu")
+    proc = subprocess.run(
+        [os.path.join(native_build, "tpu_perf_analyzer"),
+         "-m", "simple", "--service-kind", "tpu_capi",
+         "--capi-library-path", os.path.join(native_build, "libtpuserver.so"),
+         "--capi-repo-root", os.path.join(NATIVE, ".."),
+         "-p", "600", "-r", "6", "-s", "70",
+         "--concurrency-range", "2:2", "-f", str(csv)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Throughput" in proc.stdout
+    # Server-side stats must flow through the in-process path too.
+    assert "Inference count" in proc.stdout
+    lines = csv.read_text().strip().splitlines()
+    header, row = lines[0].split(","), lines[1].split(",")
+    assert float(row[header.index("Inferences/Second")]) > 0
+
+
 def test_libcshm_ctypes(native_build):
     """The C shm extension loads via ctypes and round-trips data
     (reference shared_memory ctypes bindings,
