@@ -10,10 +10,13 @@ triton_loader.cc:251,899): an engine in the benchmark process, no network.
 Contract with the C side:
 - create_engine(models_csv) -> engine object (opaque PyObject to C)
 - *_json helpers return JSON strings
-- infer(engine, request_json, buffers) -> (response_json, [np.ndarray])
+- infer(engine, request_json, buffers) ->
+  (response_json, [np.ndarray], [(name, datatype, shape)])
   where `buffers` are zero-copy memoryviews of caller-owned input bytes
-  (valid only for the duration of the call) and the returned arrays are
-  C-contiguous, exposed back to C via the buffer protocol (zero-copy out).
+  (valid only for the duration of the call), the returned arrays are
+  C-contiguous and exposed back to C via the buffer protocol (zero-copy
+  out), and the metadata tuples let the C side read names/dtypes/shapes
+  without re-parsing the JSON on the hot path.
 """
 
 from __future__ import annotations
@@ -128,4 +131,5 @@ def infer(engine: TpuEngine, request_json: str, buffers: list):
         "id": resp.request_id,
         "outputs": out_meta,
     })
-    return response_json, out_arrays
+    metas = [(m["name"], m["datatype"], m["shape"]) for m in out_meta]
+    return response_json, out_arrays, metas

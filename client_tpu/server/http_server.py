@@ -64,6 +64,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _dispatch(self, method: str) -> None:
         try:
+            # Drain the request body up front: handlers that ignore it (e.g.
+            # repository index with an empty JSON body) must not leave bytes
+            # in the keep-alive stream, or they would prefix the next
+            # request line and desync the connection.
+            self._raw_body = (self.rfile.read(
+                int(self.headers.get("Content-Length", 0) or 0))
+                if method == "POST" else b"")
             for m, pat, name in _ROUTES:
                 if m != method:
                     continue
@@ -89,8 +96,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._dispatch("POST")
 
     def _read_body(self) -> bytes:
-        length = int(self.headers.get("Content-Length", 0))
-        body = self.rfile.read(length) if length else b""
+        body = self._raw_body
         encoding = (self.headers.get("Content-Encoding") or "").lower()
         if encoding == "deflate":
             body = zlib.decompress(body)

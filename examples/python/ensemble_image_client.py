@@ -1,0 +1,32 @@
+#!/usr/bin/env python3
+"""Ensemble pipeline client: raw HxWx3 bytes -> image_preprocess ->
+resnet50, one request end to end.
+
+Reference counterpart: src/c++/examples/ensemble_image_client.cc:365 /
+the preprocess+classify ensemble flow.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+from client_tpu.http import InferenceServerClient, InferInput
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8000")
+args = parser.parse_args()
+
+rng = np.random.default_rng(11)
+raw = rng.integers(0, 256, size=(480, 640, 3), dtype=np.uint8)
+
+with InferenceServerClient(args.url) as client:
+    inp = InferInput("RAW_IMAGE", [1, *raw.shape], "UINT8")
+    inp.set_data_from_numpy(raw[None])
+    result = client.infer("ensemble_image", [inp])
+    logits = result.as_numpy("CLASS_LOGITS")
+    if logits.shape[-1] != 1000 or not np.isfinite(logits).all():
+        sys.exit(f"error: bad logits {logits.shape}")
+    print("top class:", int(np.argmax(logits)))
+
+print("PASS: ensemble image")

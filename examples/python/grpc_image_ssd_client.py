@@ -1,0 +1,72 @@
+#!/usr/bin/env python3
+"""SSD-MobileNet object-detection client over gRPC.
+
+Counterpart of the fork-added reference example
+src/python/examples/grpc_image_ssd_client.py:486 (raw generated stubs, COCO
+labels, box drawing): sends a UINT8 NHWC 300x300x3 image to the TFLite-style
+SSD model and prints detections [boxes, classes, scores, count] with COCO
+label names (models/ssd_mobilenet_v2_coco_quantized/labels.txt when
+present).
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+import numpy as np
+
+from client_tpu.grpc import InferenceServerClient, InferInput
+
+parser = argparse.ArgumentParser()
+parser.add_argument("image", nargs="?", help="image file (needs PIL)")
+parser.add_argument("-u", "--url", default="localhost:8001")
+parser.add_argument("-m", "--model", default="ssd_mobilenet_v2_coco_quantized")
+parser.add_argument("-t", "--threshold", type=float, default=0.3)
+args = parser.parse_args()
+
+LABELS_FILE = (Path(__file__).resolve().parents[2] / "models" /
+               "ssd_mobilenet_v2_coco_quantized" / "labels.txt")
+labels = (LABELS_FILE.read_text().splitlines()
+          if LABELS_FILE.exists() else [])
+
+
+def load_image():
+    if args.image:
+        try:
+            from PIL import Image
+        except ImportError:
+            sys.exit("PIL not available; run without an image argument to "
+                     "use a synthetic input")
+        img = Image.open(args.image).convert("RGB").resize((300, 300))
+        return np.asarray(img, dtype=np.uint8)
+    rng = np.random.default_rng(3)
+    return rng.integers(0, 256, size=(300, 300, 3), dtype=np.uint8)
+
+
+image = load_image()
+
+with InferenceServerClient(args.url) as client:
+    inp = InferInput("normalized_input_image_tensor", [1, 300, 300, 3],
+                     "UINT8")
+    inp.set_data_from_numpy(image[None])
+    result = client.infer(args.model, [inp])
+
+    # outputs are [batch, 1, N(, 4)]-shaped; flatten the singleton dims
+    boxes = result.as_numpy("TFLite_Detection_PostProcess").reshape(-1, 4)
+    classes = np.ravel(result.as_numpy("TFLite_Detection_PostProcess:1"))
+    scores = np.ravel(result.as_numpy("TFLite_Detection_PostProcess:2"))
+    count = int(np.ravel(result.as_numpy("TFLite_Detection_PostProcess:3"))[0])
+
+    shown = 0
+    for i in range(count):
+        if scores[i] < args.threshold:
+            continue
+        cls = int(classes[i])
+        name = labels[cls] if cls < len(labels) else str(cls)
+        ymin, xmin, ymax, xmax = boxes[i]
+        print(f"  {name}: {scores[i]:.2f} "
+              f"[{ymin:.2f},{xmin:.2f},{ymax:.2f},{xmax:.2f}]")
+        shown += 1
+    print(f"{count} detections ({shown} above threshold)")
+
+print("PASS: ssd detection")

@@ -1,0 +1,78 @@
+#!/usr/bin/env python3
+"""Image classification client (ResNet-50): preprocess, infer (HTTP or
+gRPC, sync or async, batched), print top-K classes via the classification
+extension.
+
+Reference counterpart: src/python/examples/image_client.py (PIL preprocess,
+-m/-b/-c/-s flags, async/streaming variants). Accepts image files when PIL
+is available; otherwise --synthetic generates a deterministic test image.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+parser = argparse.ArgumentParser()
+parser.add_argument("image", nargs="*", help="image file(s) (needs PIL)")
+parser.add_argument("-m", "--model", default="resnet50")
+parser.add_argument("-u", "--url", default=None)
+parser.add_argument("-i", "--protocol", default="http",
+                    choices=["http", "grpc"])
+parser.add_argument("-b", "--batch-size", type=int, default=1)
+parser.add_argument("-c", "--classes", type=int, default=3,
+                    help="top-K classes (classification extension)")
+parser.add_argument("-a", "--async", dest="use_async", action="store_true")
+parser.add_argument("--synthetic", action="store_true",
+                    help="use a generated test image instead of files")
+args = parser.parse_args()
+
+
+def load_images():
+    if args.image and not args.synthetic:
+        try:
+            from PIL import Image
+        except ImportError:
+            sys.exit("PIL not available; rerun with --synthetic")
+        arrays = []
+        for path in args.image:
+            img = Image.open(path).convert("RGB").resize((224, 224))
+            arrays.append(np.asarray(img, dtype=np.float32) / 255.0)
+        return arrays
+    rng = np.random.default_rng(7)
+    return [rng.random((224, 224, 3), dtype=np.float32)
+            for _ in range(args.batch_size)]
+
+
+if args.protocol == "grpc":
+    from client_tpu.grpc import InferenceServerClient, InferInput, \
+        InferRequestedOutput
+    url = args.url or "localhost:8001"
+else:
+    from client_tpu.http import InferenceServerClient, InferInput, \
+        InferRequestedOutput
+    url = args.url or "localhost:8000"
+
+images = load_images()
+batch = np.stack(images[:args.batch_size]).astype(np.float32)
+
+with InferenceServerClient(url) as client:
+    inp = InferInput("INPUT", list(batch.shape), "FP32")
+    inp.set_data_from_numpy(batch)
+    out = InferRequestedOutput("OUTPUT", class_count=args.classes)
+
+    if args.use_async and args.protocol == "http":
+        result = client.async_infer(args.model, [inp],
+                                    outputs=[out]).get_result(timeout=300)
+    else:
+        result = client.infer(args.model, [inp], outputs=[out])
+
+    # classification extension: BYTES "score:index[:label]" per class
+    classes = result.as_numpy("OUTPUT")
+    for n, row in enumerate(classes):
+        print(f"image {n}:")
+        for entry in np.ravel(row)[:args.classes]:
+            text = entry.decode() if isinstance(entry, bytes) else str(entry)
+            print(f"    {text}")
+
+print("PASS: image classification")

@@ -1,0 +1,52 @@
+#!/usr/bin/env python3
+"""Memory-growth probe: many inferences while polling process RSS; fails if
+resident memory keeps climbing.
+
+Reference counterpart: src/python/examples/memory_growth_test.py:98 (RSS
+polling around repeated inferences, paired with the C++ memory_leak_test).
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+from client_tpu.http import InferenceServerClient, InferInput
+
+
+def rss_kb() -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1])
+    return 0
+
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8000")
+parser.add_argument("-n", "--iterations", type=int, default=500)
+parser.add_argument("--max-growth-kb", type=int, default=50_000)
+args = parser.parse_args()
+
+with InferenceServerClient(args.url) as client:
+    input0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1 = np.ones((1, 16), dtype=np.int32)
+    inputs = [InferInput("INPUT0", [1, 16], "INT32"),
+              InferInput("INPUT1", [1, 16], "INT32")]
+    inputs[0].set_data_from_numpy(input0)
+    inputs[1].set_data_from_numpy(input1)
+
+    # warmup, then baseline after allocator steady-state
+    for _ in range(50):
+        client.infer("simple", inputs)
+    base = rss_kb()
+    for i in range(args.iterations):
+        client.infer("simple", inputs)
+        if i % 100 == 0:
+            print(f"iter {i}: RSS {rss_kb()} kB")
+    growth = rss_kb() - base
+    print(f"RSS growth over {args.iterations} inferences: {growth} kB")
+    if growth > args.max_growth_kb:
+        sys.exit(f"error: RSS grew {growth} kB > {args.max_growth_kb} kB")
+
+print("PASS: memory growth bounded")

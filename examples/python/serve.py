@@ -1,0 +1,58 @@
+#!/usr/bin/env python3
+"""Launches the framework's HTTP + gRPC inference servers for the examples.
+
+The reference examples assume an externally-started tritonserver with the
+`simple*` models (README.md usage sections); this framework ships its own
+engine, so one command brings up everything the examples in this directory
+talk to:
+
+    python examples/python/serve.py [--models simple,simple_string,...]
+                                    [--http-port 8000] [--grpc-port 8001]
+"""
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from client_tpu.engine import TpuEngine  # noqa: E402
+from client_tpu.models import build_repository  # noqa: E402
+from client_tpu.server import HttpInferenceServer  # noqa: E402
+from client_tpu.server.grpc_server import GrpcInferenceServer  # noqa: E402
+
+DEFAULT_MODELS = ("simple,simple_string,simple_identity,simple_sequence,"
+                  "simple_repeat")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--models", default=DEFAULT_MODELS,
+                    help="comma-separated model-zoo names (see "
+                         "client_tpu/models); pass 'all' for every model")
+    ap.add_argument("--http-port", type=int, default=8000)
+    ap.add_argument("--grpc-port", type=int, default=8001)
+    args = ap.parse_args()
+
+    names = None if args.models == "all" else [
+        n.strip() for n in args.models.split(",") if n.strip()]
+    engine = TpuEngine(build_repository(names))
+    http_srv = HttpInferenceServer(engine, port=args.http_port).start()
+    grpc_srv = GrpcInferenceServer(engine, port=args.grpc_port).start()
+    print(f"HTTP  : {http_srv.url}")
+    print(f"gRPC  : 127.0.0.1:{grpc_srv.port}")
+    print("Ctrl-C to stop.")
+    try:
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        grpc_srv.stop()
+        http_srv.stop()
+        engine.shutdown()
+
+
+if __name__ == "__main__":
+    main()

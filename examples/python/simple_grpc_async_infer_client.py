@@ -1,0 +1,44 @@
+#!/usr/bin/env python3
+"""Async gRPC client: callback-style async_infer over grpc futures.
+
+Reference counterpart: src/python/examples/simple_grpc_async_infer_client.py.
+"""
+
+import argparse
+import queue
+import sys
+
+import numpy as np
+
+from client_tpu.grpc import InferenceServerClient, InferInput
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8001")
+parser.add_argument("-n", "--requests", type=int, default=8)
+args = parser.parse_args()
+
+results: "queue.Queue" = queue.Queue()
+
+with InferenceServerClient(args.url) as client:
+    input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1_data = np.full((1, 16), 2, dtype=np.int32)
+    inputs = [InferInput("INPUT0", [1, 16], "INT32"),
+              InferInput("INPUT1", [1, 16], "INT32")]
+    inputs[0].set_data_from_numpy(input0_data)
+    inputs[1].set_data_from_numpy(input1_data)
+
+    def callback(result, error):
+        results.put((result, error))
+
+    for i in range(args.requests):
+        client.async_infer("simple", inputs, callback, request_id=str(i))
+
+    for _ in range(args.requests):
+        result, error = results.get(timeout=120)
+        if error is not None:
+            sys.exit(f"error: {error}")
+        if not np.array_equal(result.as_numpy("OUTPUT0"),
+                              input0_data + input1_data):
+            sys.exit("error: incorrect sum")
+
+print(f"PASS: {args.requests} async requests")

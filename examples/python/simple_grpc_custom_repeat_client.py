@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Decoupled-model client: one request to `simple_repeat` yields N streamed
+responses on the bidi stream (the reference's custom repeat model flow,
+src/python/examples/simple_grpc_custom_repeat.py — decoupled transaction
+policy, one-to-many responses).
+"""
+
+import argparse
+import queue
+import sys
+
+import numpy as np
+
+from client_tpu.grpc import InferenceServerClient, InferInput
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8001")
+parser.add_argument("-n", "--repeat", type=int, default=4)
+args = parser.parse_args()
+
+responses: "queue.Queue" = queue.Queue()
+
+
+def callback(result, error):
+    responses.put((result, error))
+
+
+with InferenceServerClient(args.url) as client:
+    client.start_stream(callback)
+    values = np.arange(args.repeat, dtype=np.int32)
+    inp = InferInput("IN", [args.repeat], "INT32")
+    inp.set_data_from_numpy(values)
+    client.async_stream_infer("simple_repeat", [inp], request_id="r1")
+
+    got = []
+    for _ in range(args.repeat):
+        result, error = responses.get(timeout=120)
+        if error is not None:
+            sys.exit(f"error: {error}")
+        got.append(int(result.as_numpy("OUT")[0]))
+    client.stop_stream()
+
+    if got != list(values):
+        sys.exit(f"error: {got} != {list(values)}")
+
+print(f"PASS: decoupled repeat ({args.repeat} responses from one request)")

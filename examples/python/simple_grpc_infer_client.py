@@ -1,0 +1,43 @@
+#!/usr/bin/env python3
+"""Value-asserting add/sub client over gRPC.
+
+Reference counterpart: src/python/examples/simple_grpc_infer_client.py.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+from client_tpu.grpc import InferenceServerClient, InferInput, \
+    InferRequestedOutput
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8001")
+parser.add_argument("-v", "--verbose", action="store_true")
+args = parser.parse_args()
+
+with InferenceServerClient(args.url, verbose=args.verbose) as client:
+    input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1_data = np.ones((1, 16), dtype=np.int32)
+    inputs = [InferInput("INPUT0", [1, 16], "INT32"),
+              InferInput("INPUT1", [1, 16], "INT32")]
+    inputs[0].set_data_from_numpy(input0_data)
+    # use_contents exercises the typed-contents (non-raw) proto path
+    inputs[1].set_data_from_numpy(input1_data, use_contents=True)
+
+    outputs = [InferRequestedOutput("OUTPUT0"),
+               InferRequestedOutput("OUTPUT1")]
+    result = client.infer("simple", inputs, outputs=outputs, request_id="1")
+
+    output0 = result.as_numpy("OUTPUT0")
+    output1 = result.as_numpy("OUTPUT1")
+    if not np.array_equal(output0, input0_data + input1_data):
+        sys.exit("error: incorrect sum")
+    if not np.array_equal(output1, input0_data - input1_data):
+        sys.exit("error: incorrect difference")
+    if args.verbose:
+        print("OUTPUT0:", output0)
+        print("OUTPUT1:", output1)
+
+print("PASS: infer")

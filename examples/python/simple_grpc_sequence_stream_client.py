@@ -1,0 +1,65 @@
+#!/usr/bin/env python3
+"""Stateful sequences over one bidi stream: two interleaved accumulator
+sequences with start/end flags, validated per-sequence running totals.
+
+Reference counterpart:
+src/python/examples/simple_grpc_sequence_stream_infer_client.py.
+"""
+
+import argparse
+import queue
+import sys
+
+import numpy as np
+
+from client_tpu.grpc import InferenceServerClient, InferInput
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8001")
+args = parser.parse_args()
+
+responses: "queue.Queue" = queue.Queue()
+
+
+def callback(result, error):
+    responses.put((result, error))
+
+
+with InferenceServerClient(args.url) as client:
+    client.start_stream(callback)
+
+    seq_a, seq_b = 1001, 1002
+    a_vals, b_vals = [1, 2, 3], [10, 20, 30]
+    expected = {}
+    a_total = b_total = 0
+    for i in range(3):
+        for seq, vals in ((seq_a, a_vals), (seq_b, b_vals)):
+            value = vals[i]
+            if seq == seq_a:
+                a_total += value
+                expected[f"A{i}"] = a_total
+                rid = f"A{i}"
+            else:
+                b_total += value
+                expected[f"B{i}"] = b_total
+                rid = f"B{i}"
+            inp = InferInput("INPUT", [1], "INT32")
+            inp.set_data_from_numpy(np.array([value], dtype=np.int32))
+            client.async_stream_infer("simple_sequence", [inp],
+                                      request_id=rid, sequence_id=seq,
+                                      sequence_start=i == 0,
+                                      sequence_end=i == 2)
+
+    got = {}
+    for _ in range(len(expected)):
+        result, error = responses.get(timeout=120)
+        if error is not None:
+            sys.exit(f"error: {error}")
+        rid = result.get_response().id
+        got[rid] = int(result.as_numpy("OUTPUT")[0])
+    client.stop_stream()
+
+    if got != expected:
+        sys.exit(f"error: {got} != {expected}")
+
+print("PASS: sequence streaming")

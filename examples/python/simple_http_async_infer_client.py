@@ -1,0 +1,39 @@
+#!/usr/bin/env python3
+"""Async HTTP client: N concurrent requests through the client's thread
+pool, results gathered from futures.
+
+Reference counterpart: src/python/examples/simple_http_async_infer_client.py
+(greenlet pool there; a thread pool here).
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+from client_tpu.http import InferenceServerClient, InferInput
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8000")
+parser.add_argument("-n", "--requests", type=int, default=8)
+args = parser.parse_args()
+
+with InferenceServerClient(args.url, concurrency=4) as client:
+    input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1_data = np.full((1, 16), 3, dtype=np.int32)
+    inputs = [InferInput("INPUT0", [1, 16], "INT32"),
+              InferInput("INPUT1", [1, 16], "INT32")]
+    inputs[0].set_data_from_numpy(input0_data)
+    inputs[1].set_data_from_numpy(input1_data)
+
+    async_requests = [
+        client.async_infer("simple", inputs, request_id=str(i))
+        for i in range(args.requests)
+    ]
+    for req in async_requests:
+        result = req.get_result(timeout=120)
+        if not np.array_equal(result.as_numpy("OUTPUT0"),
+                              input0_data + input1_data):
+            sys.exit("error: incorrect sum")
+
+print(f"PASS: {args.requests} async requests")

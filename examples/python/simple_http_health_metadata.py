@@ -1,0 +1,36 @@
+#!/usr/bin/env python3
+"""Control plane over HTTP: liveness, readiness, metadata, config,
+repository index, statistics.
+
+Reference counterpart: src/python/examples/simple_http_health_metadata.py.
+"""
+
+import argparse
+import sys
+
+from client_tpu.http import InferenceServerClient
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8000")
+args = parser.parse_args()
+
+with InferenceServerClient(args.url) as client:
+    if not client.is_server_live():
+        sys.exit("error: server not live")
+    if not client.is_server_ready():
+        sys.exit("error: server not ready")
+    if not client.is_model_ready("simple"):
+        sys.exit("error: model not ready")
+
+    meta = client.get_server_metadata()
+    print(f"server: {meta['name']} {meta['version']}")
+    model_meta = client.get_model_metadata("simple")
+    assert model_meta["name"] == "simple", model_meta
+    config = client.get_model_config("simple")
+    assert config["name"] == "simple", config
+    index = client.get_model_repository_index()
+    assert any(m["name"] == "simple" for m in index), index
+    stats = client.get_inference_statistics("simple")
+    assert "model_stats" in stats, stats
+
+print("PASS: health and metadata")

@@ -1,0 +1,47 @@
+#!/usr/bin/env python3
+"""Value-asserting add/sub client over HTTP.
+
+Reference counterpart: src/python/examples/simple_http_infer_client.py —
+sends two INT32[1,16] tensors to `simple` and validates OUTPUT0=a+b,
+OUTPUT1=a-b elementwise.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+from client_tpu.http import InferenceServerClient, InferInput, \
+    InferRequestedOutput
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8000")
+parser.add_argument("-v", "--verbose", action="store_true")
+args = parser.parse_args()
+
+with InferenceServerClient(args.url, verbose=args.verbose) as client:
+    inputs = []
+    input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1_data = np.ones((1, 16), dtype=np.int32)
+    inputs.append(InferInput("INPUT0", [1, 16], "INT32"))
+    inputs.append(InferInput("INPUT1", [1, 16], "INT32"))
+    inputs[0].set_data_from_numpy(input0_data, binary_data=True)
+    inputs[1].set_data_from_numpy(input1_data, binary_data=False)
+
+    outputs = [InferRequestedOutput("OUTPUT0", binary_data=True),
+               InferRequestedOutput("OUTPUT1", binary_data=False)]
+
+    result = client.infer("simple", inputs, outputs=outputs, request_id="1")
+
+    output0 = result.as_numpy("OUTPUT0")
+    output1 = result.as_numpy("OUTPUT1")
+    for i in range(16):
+        if args.verbose:
+            print(f"{input0_data[0][i]} + {input1_data[0][i]} = "
+                  f"{output0[0][i]}")
+        if output0[0][i] != input0_data[0][i] + input1_data[0][i]:
+            sys.exit("error: incorrect sum")
+        if output1[0][i] != input0_data[0][i] - input1_data[0][i]:
+            sys.exit("error: incorrect difference")
+
+print("PASS: infer")

@@ -1,0 +1,32 @@
+#!/usr/bin/env python3
+"""Explicit model control over HTTP: unload then load a model, checking
+readiness transitions.
+
+Reference counterpart: src/python/examples/simple_http_model_control.py
+(load/unload/ready flow, grpc variant identical in spirit).
+"""
+
+import argparse
+import sys
+
+from client_tpu.http import InferenceServerClient
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8000")
+parser.add_argument("-m", "--model", default="simple")
+args = parser.parse_args()
+
+with InferenceServerClient(args.url) as client:
+    if not client.is_model_ready(args.model):
+        client.load_model(args.model)
+    assert client.is_model_ready(args.model)
+
+    client.unload_model(args.model)
+    if client.is_model_ready(args.model):
+        sys.exit("error: model still ready after unload")
+
+    client.load_model(args.model)
+    if not client.is_model_ready(args.model):
+        sys.exit("error: model not ready after load")
+
+print("PASS: model control")

@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""System shared-memory data plane over HTTP: tensors travel through
+/dev/shm, only region references on the wire.
+
+Reference counterpart: src/python/examples/simple_http_shm_client.py
+(create/register regions, infer, read outputs from shm, unregister).
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.utils.shared_memory as shm
+from client_tpu.http import InferenceServerClient, InferInput, \
+    InferRequestedOutput
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8000")
+args = parser.parse_args()
+
+with InferenceServerClient(args.url) as client:
+    client.unregister_system_shared_memory()
+
+    input0_data = np.arange(16, dtype=np.int32)
+    input1_data = np.ones(16, dtype=np.int32)
+    byte_size = input0_data.nbytes
+
+    shm_ip = shm.create_shared_memory_region("input_data", "/py_shm_input",
+                                             byte_size * 2)
+    shm.set_shared_memory_region(shm_ip, [input0_data])
+    shm.set_shared_memory_region(shm_ip, [input1_data], offset=byte_size)
+    shm_op = shm.create_shared_memory_region("output_data", "/py_shm_output",
+                                             byte_size * 2)
+    client.register_system_shared_memory("input_data", "/py_shm_input",
+                                         byte_size * 2)
+    client.register_system_shared_memory("output_data", "/py_shm_output",
+                                         byte_size * 2)
+
+    inputs = [InferInput("INPUT0", [1, 16], "INT32"),
+              InferInput("INPUT1", [1, 16], "INT32")]
+    inputs[0].set_shared_memory("input_data", byte_size)
+    inputs[1].set_shared_memory("input_data", byte_size, offset=byte_size)
+    outputs = [InferRequestedOutput("OUTPUT0"),
+               InferRequestedOutput("OUTPUT1")]
+    outputs[0].set_shared_memory("output_data", byte_size)
+    outputs[1].set_shared_memory("output_data", byte_size, offset=byte_size)
+
+    client.infer("simple", inputs, outputs=outputs)
+
+    output0 = shm.get_contents_as_numpy(shm_op, np.int32, [1, 16])
+    output1 = shm.get_contents_as_numpy(shm_op, np.int32, [1, 16],
+                                        offset=byte_size)
+    if not np.array_equal(output0[0], input0_data + input1_data):
+        sys.exit("error: incorrect sum")
+    if not np.array_equal(output1[0], input0_data - input1_data):
+        sys.exit("error: incorrect difference")
+
+    status = client.get_system_shared_memory_status()
+    client.unregister_system_shared_memory()
+    shm.destroy_shared_memory_region(shm_ip)
+    shm.destroy_shared_memory_region(shm_op)
+
+print("PASS: system shared memory")

@@ -1,0 +1,34 @@
+#!/usr/bin/env python3
+"""BYTES (string tensor) client: decimal strings through the 4-byte-LE
+length-prefixed codec, validated add/sub results.
+
+Reference counterpart: src/python/examples/simple_http_string_infer_client.py.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+from client_tpu.http import InferenceServerClient, InferInput
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8000")
+args = parser.parse_args()
+
+with InferenceServerClient(args.url) as client:
+    in0 = np.array([[str(i) for i in range(16)]], dtype=object)
+    in1 = np.array([["1"] * 16], dtype=object)
+    inputs = [InferInput("INPUT0", [1, 16], "BYTES"),
+              InferInput("INPUT1", [1, 16], "BYTES")]
+    inputs[0].set_data_from_numpy(in0)
+    inputs[1].set_data_from_numpy(in1, binary_data=False)
+
+    result = client.infer("simple_string", inputs)
+    out0 = result.as_numpy("OUTPUT0")
+    out1 = result.as_numpy("OUTPUT1")
+    for i in range(16):
+        if int(out0[0][i]) != i + 1 or int(out1[0][i]) != i - 1:
+            sys.exit(f"error: bad result at {i}: {out0[0][i]} {out1[0][i]}")
+
+print("PASS: string infer")

@@ -50,8 +50,10 @@ std::string FetchPyError() {
 }
 
 void InitializePython(const char* repo_root) {
+  bool did_initialize = false;
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);
+    did_initialize = true;
   }
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject* sys_path = PySys_GetObject("path");  // borrowed
@@ -71,9 +73,11 @@ void InitializePython(const char* repo_root) {
     g_init_error = "failed to import client_tpu.capi_embed: " + FetchPyError();
   }
   PyGILState_Release(gil);
-  // Release the GIL from this (embedding) thread so worker threads can
-  // acquire it via PyGILState_Ensure.
-  if (PyGILState_Check()) {
+  // Only when THIS code booted the interpreter does the thread still hold
+  // the GIL (from Py_InitializeEx) — release it so worker threads can use
+  // PyGILState_Ensure. When loaded into an already-running Python process
+  // (ctypes), the caller owns the GIL and it must be left alone.
+  if (did_initialize && PyGILState_Check()) {
     PyEval_SaveThread();
   }
 }
@@ -222,17 +226,21 @@ char* TpuServerInfer(TpuServer* server, const char* request_json,
     return DupString(error);
   }
 
-  // result = (response_json: str, arrays: list[np.ndarray])
-  if (!PyTuple_Check(result) || PyTuple_Size(result) != 2 ||
-      !PyList_Check(PyTuple_GetItem(result, 1))) {
+  // result = (response_json: str, arrays: list[np.ndarray],
+  //           metas: list[(name, datatype, shape)]) — the metadata tuples
+  // avoid any JSON parsing on this hot path.
+  if (!PyTuple_Check(result) || PyTuple_Size(result) != 3 ||
+      !PyList_Check(PyTuple_GetItem(result, 1)) ||
+      !PyList_Check(PyTuple_GetItem(result, 2))) {
     Py_DECREF(result);
     PyErr_Clear();
     PyGILState_Release(gil);
     return DupString("capi_embed.infer returned an unexpected shape "
-                     "(want (json_str, list))");
+                     "(want (json_str, list, list))");
   }
   PyObject* json_obj = PyTuple_GetItem(result, 0);   // borrowed
   PyObject* arrays = PyTuple_GetItem(result, 1);     // borrowed
+  PyObject* metas = PyTuple_GetItem(result, 2);      // borrowed
   auto* resp = new TpuServerResponse();
   const char* jc =
       PyUnicode_Check(json_obj) ? PyUnicode_AsUTF8(json_obj) : nullptr;
@@ -240,33 +248,26 @@ char* TpuServerInfer(TpuServer* server, const char* request_json,
   Py_INCREF(arrays);
   resp->arrays = arrays;
 
-  // Parse output metadata out of the returned JSON on the Python side once:
-  // names/datatypes/shapes are in resp->json; the C side exposes views in
-  // list order, so we re-walk the "outputs" array with Python's json to
-  // avoid duplicating a JSON parser here.
-  PyObject* json_mod = PyImport_ImportModule("json");
-  PyObject* loads = json_mod ? PyObject_GetAttrString(json_mod, "loads")
-                             : nullptr;
-  PyObject* parsed =
-      loads ? PyObject_CallFunction(loads, "s", resp->json.c_str()) : nullptr;
-  PyObject* outs =
-      parsed ? PyDict_GetItemString(parsed, "outputs") : nullptr;  // borrowed
-  Py_ssize_t n = arrays ? PyList_Size(arrays) : 0;
+  Py_ssize_t n = PyList_Size(arrays);
   for (Py_ssize_t i = 0; i < n; ++i) {
     TpuServerResponse::Output out;
-    if (outs != nullptr && i < PyList_Size(outs)) {
-      PyObject* meta = PyList_GetItem(outs, i);  // borrowed
-      PyObject* name = PyDict_GetItemString(meta, "name");
-      PyObject* dtype = PyDict_GetItemString(meta, "datatype");
-      PyObject* shape = PyDict_GetItemString(meta, "shape");
-      const char* nc = name ? PyUnicode_AsUTF8(name) : nullptr;
-      const char* dc = dtype ? PyUnicode_AsUTF8(dtype) : nullptr;
-      if (nc) out.name = nc;
-      if (dc) out.datatype = dc;
-      if (shape != nullptr) {
-        for (Py_ssize_t d = 0; d < PyList_Size(shape); ++d) {
-          out.shape.push_back(
-              PyLong_AsLongLong(PyList_GetItem(shape, d)));
+    if (i < PyList_Size(metas)) {
+      PyObject* meta = PyList_GetItem(metas, i);  // borrowed
+      if (PyTuple_Check(meta) && PyTuple_Size(meta) == 3) {
+        PyObject* name = PyTuple_GetItem(meta, 0);
+        PyObject* dtype = PyTuple_GetItem(meta, 1);
+        PyObject* shape = PyTuple_GetItem(meta, 2);
+        const char* nc =
+            PyUnicode_Check(name) ? PyUnicode_AsUTF8(name) : nullptr;
+        const char* dc =
+            PyUnicode_Check(dtype) ? PyUnicode_AsUTF8(dtype) : nullptr;
+        if (nc) out.name = nc;
+        if (dc) out.datatype = dc;
+        if (PyList_Check(shape)) {
+          for (Py_ssize_t d = 0; d < PyList_Size(shape); ++d) {
+            out.shape.push_back(
+                PyLong_AsLongLong(PyList_GetItem(shape, d)));
+          }
         }
       }
     }
@@ -278,9 +279,6 @@ char* TpuServerInfer(TpuServer* server, const char* request_json,
     }
     resp->outputs.push_back(std::move(out));
   }
-  Py_XDECREF(parsed);
-  Py_XDECREF(loads);
-  Py_XDECREF(json_mod);
   Py_DECREF(result);
   PyGILState_Release(gil);
   *response = resp;

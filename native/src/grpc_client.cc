@@ -103,11 +103,14 @@ Error GrpcStatusFromStream(const h2::Connection::Stream& s, bool* found) {
 }
 
 // Extracts status + the single framed message from a finished unary stream
-// (shared by Rpc, Infer, and the async completion worker).
-Error ExtractUnaryResult(const h2::Connection::Stream& s, std::string* msg) {
+// (shared by Rpc, Infer, and the async completion worker). conn_error
+// carries the connection-level failure reason (GOAWAY text, socket error)
+// so reset diagnostics keep their root cause.
+Error ExtractUnaryResult(const h2::Connection::Stream& s,
+                         const std::string& conn_error, std::string* msg) {
   if (s.reset && !s.end_stream) {
     return Error("gRPC: stream reset (code " + std::to_string(s.reset_code) +
-                 ")");
+                 ")" + (conn_error.empty() ? "" : ": " + conn_error));
   }
   bool have = false;
   Error status = GrpcStatusFromStream(s, &have);
@@ -355,8 +358,11 @@ Error InferenceServerGrpcClient::Rpc(const std::string& method,
   }
   std::string msg;
   Error status("stream vanished");
+  // ConnectionError() locks the connection state mutex, which WithStream's
+  // callback already holds — read it before entering the callback.
+  std::string conn_error = conn_->ConnectionError();
   conn_->WithStream(sid, [&](h2::Connection::Stream& s) {
-    status = ExtractUnaryResult(s, &msg);
+    status = ExtractUnaryResult(s, conn_error, &msg);
   });
   conn_->CloseStream(sid);
   if (!status.IsOk()) return status;
@@ -609,9 +615,10 @@ Error InferenceServerGrpcClient::Infer(
   timers.Capture(RequestTimers::Kind::RECV_START);
   auto response = std::make_shared<inference::ModelInferResponse>();
   Error status("stream vanished");
+  std::string conn_error = conn_->ConnectionError();
   conn_->WithStream(sid, [&](h2::Connection::Stream& s) {
     std::string msg;
-    status = ExtractUnaryResult(s, &msg);
+    status = ExtractUnaryResult(s, conn_error, &msg);
     if (status.IsOk() && !response->ParseFromString(msg)) {
       status = Error("failed to parse infer response");
     }
@@ -720,12 +727,13 @@ void InferenceServerGrpcClient::AsyncWorker() {
       bool done = false;
       Error status("stream vanished");
       auto response = std::make_shared<inference::ModelInferResponse>();
+      std::string conn_error = conn_->ConnectionError();
       bool present = conn_->WithStream(
           job->sid, [&](h2::Connection::Stream& s) {
             if (!s.end_stream && !s.reset) return;
             done = true;
             std::string msg;
-            status = ExtractUnaryResult(s, &msg);
+            status = ExtractUnaryResult(s, conn_error, &msg);
             if (status.IsOk() && !response->ParseFromString(msg)) {
               status = Error("failed to parse infer response");
             }

@@ -1,0 +1,105 @@
+"""Python examples as conformance tests: each script in examples/python is
+run as a real subprocess client against in-process HTTP/gRPC servers —
+the reference's example-as-test strategy (SURVEY.md §4: every simple_*
+example hard-asserts result values).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from client_tpu.engine import TpuEngine
+from client_tpu.models import build_repository
+from client_tpu.server import HttpInferenceServer
+from client_tpu.server.grpc_server import GrpcInferenceServer
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "examples",
+                            "python")
+REPO_ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.fixture(scope="module")
+def servers():
+    eng = TpuEngine(build_repository([
+        "simple", "simple_string", "simple_identity", "simple_sequence",
+        "simple_repeat", "resnet50", "image_preprocess", "ensemble_image",
+        "ssd_mobilenet_v2_coco_quantized",
+    ]))
+    http_srv = HttpInferenceServer(eng, port=0).start()
+    grpc_srv = GrpcInferenceServer(eng, port=0).start()
+    yield http_srv, grpc_srv
+    grpc_srv.stop()
+    http_srv.stop()
+    eng.shutdown()
+
+
+def run_example(script, servers, extra=None):
+    http_srv, grpc_srv = servers
+    url = (f"127.0.0.1:{grpc_srv.port}" if "grpc" in script
+           else http_srv.url)
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    cmd = [sys.executable, os.path.join(EXAMPLES_DIR, script), "-u", url]
+    cmd += extra or []
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300,
+                          env=env)
+    assert proc.returncode == 0, f"{script}: {proc.stdout}{proc.stderr}"
+    assert "PASS" in proc.stdout, proc.stdout
+    return proc.stdout
+
+
+@pytest.mark.parametrize("script", [
+    "simple_http_infer_client.py",
+    "simple_grpc_infer_client.py",
+    "simple_http_async_infer_client.py",
+    "simple_grpc_async_infer_client.py",
+    "simple_http_string_infer_client.py",
+    "simple_http_shm_client.py",
+    "simple_grpc_shm_client.py",
+    "simple_grpc_tpushm_client.py",
+    "simple_http_sequence_sync_client.py",
+    "simple_grpc_sequence_stream_client.py",
+    "simple_grpc_custom_repeat_client.py",
+    "simple_http_health_metadata.py",
+    "simple_http_model_control.py",
+])
+def test_simple_example(servers, script):
+    run_example(script, servers)
+
+
+def test_image_client(servers):
+    out = run_example("image_client.py", servers,
+                      extra=["--synthetic", "-c", "3"])
+    assert "image 0:" in out
+
+
+def test_ensemble_image_client(servers):
+    run_example("ensemble_image_client.py", servers)
+
+
+def test_ssd_client(servers):
+    out = run_example("grpc_image_ssd_client.py", servers)
+    assert "detections" in out
+
+
+def test_reuse_infer_objects(servers):
+    http_srv, grpc_srv = servers
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(EXAMPLES_DIR, "reuse_infer_objects_client.py"),
+         "-u", http_srv.url, "-g", f"127.0.0.1:{grpc_srv.port}",
+         "-n", "5"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_memory_growth(servers):
+    http_srv, _ = servers
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES_DIR, "memory_growth_test.py"),
+         "-u", http_srv.url, "-n", "200"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
