@@ -1,0 +1,15 @@
+#!/bin/bash
+# Generates Go stubs for the v2 inference gRPC service from the proto shared
+# with the Python/C++ stacks (reference gen_go_stubs.sh:38 fetches protos
+# from a separate repo; ours live in-tree).
+set -euo pipefail
+cd "$(dirname "$0")"
+mkdir -p inference
+protoc \
+  -I ../client_tpu/protocol/protos \
+  --go_out=inference --go_opt=paths=source_relative \
+  --go_opt=Mgrpc_service.proto=./inference \
+  --go-grpc_out=inference --go-grpc_opt=paths=source_relative \
+  --go-grpc_opt=Mgrpc_service.proto=./inference \
+  grpc_service.proto
+echo "stubs written to go/inference/"

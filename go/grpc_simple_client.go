@@ -1,0 +1,99 @@
+// Value-asserting add/sub client over raw generated gRPC stubs.
+//
+// Counterpart of the reference's grpc_simple_client.go:255 (SURVEY.md §2.6):
+// no client library — the generated stub is driven directly, with manual
+// little-endian INT32 (de)serialization into RawInputContents /
+// RawOutputContents. Run gen_go_stubs.sh first to produce the `inference`
+// package from the in-tree proto.
+package main
+
+import (
+	"context"
+	"encoding/binary"
+	"flag"
+	"fmt"
+	"log"
+	"time"
+
+	"google.golang.org/grpc"
+	"google.golang.org/grpc/credentials/insecure"
+
+	pb "tpu.client/go/inference"
+)
+
+func int32sToLE(values []int32) []byte {
+	out := make([]byte, 4*len(values))
+	for i, v := range values {
+		binary.LittleEndian.PutUint32(out[4*i:], uint32(v))
+	}
+	return out
+}
+
+func leToInt32s(raw []byte) []int32 {
+	out := make([]int32, len(raw)/4)
+	for i := range out {
+		out[i] = int32(binary.LittleEndian.Uint32(raw[4*i:]))
+	}
+	return out
+}
+
+func main() {
+	url := flag.String("u", "localhost:8001", "server host:port")
+	flag.Parse()
+
+	conn, err := grpc.NewClient(*url,
+		grpc.WithTransportCredentials(insecure.NewCredentials()))
+	if err != nil {
+		log.Fatalf("connect: %v", err)
+	}
+	defer conn.Close()
+	client := pb.NewGRPCInferenceServiceClient(conn)
+
+	ctx, cancel := context.WithTimeout(context.Background(), 2*time.Minute)
+	defer cancel()
+
+	live, err := client.ServerLive(ctx, &pb.ServerLiveRequest{})
+	if err != nil || !live.Live {
+		log.Fatalf("server not live: %v", err)
+	}
+
+	a := make([]int32, 16)
+	b := make([]int32, 16)
+	for i := range a {
+		a[i] = int32(i)
+		b[i] = 1
+	}
+
+	request := &pb.ModelInferRequest{
+		ModelName: "simple",
+		Id:        "go-1",
+		Inputs: []*pb.ModelInferRequest_InferInputTensor{
+			{Name: "INPUT0", Datatype: "INT32", Shape: []int64{1, 16}},
+			{Name: "INPUT1", Datatype: "INT32", Shape: []int64{1, 16}},
+		},
+		Outputs: []*pb.ModelInferRequest_InferRequestedOutputTensor{
+			{Name: "OUTPUT0"},
+			{Name: "OUTPUT1"},
+		},
+		RawInputContents: [][]byte{int32sToLE(a), int32sToLE(b)},
+	}
+
+	response, err := client.ModelInfer(ctx, request)
+	if err != nil {
+		log.Fatalf("infer: %v", err)
+	}
+	if len(response.RawOutputContents) != 2 {
+		log.Fatalf("expected 2 raw outputs, got %d",
+			len(response.RawOutputContents))
+	}
+	sum := leToInt32s(response.RawOutputContents[0])
+	diff := leToInt32s(response.RawOutputContents[1])
+	for i := range a {
+		if sum[i] != a[i]+b[i] || diff[i] != a[i]-b[i] {
+			log.Fatalf("mismatch at %d: %d / %d", i, sum[i], diff[i])
+		}
+		fmt.Printf("%d + %d = %d, %d - %d = %d\n",
+			a[i], b[i], sum[i], a[i], b[i], diff[i])
+	}
+	fmt.Println("PASS: grpc_simple_client")
+}

@@ -1,0 +1,135 @@
+package tpu.client;
+
+import java.io.ByteArrayOutputStream;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.List;
+
+/**
+ * Little-endian tensor (de)serialization, including the BYTES codec
+ * (4-byte LE length prefix per element). Counterpart of the reference's
+ * BinaryProtocol.java:52-104 encoders and Util.intToBytes; wire-identical
+ * to client_tpu/protocol/codec.py.
+ */
+public final class BinaryProtocol {
+
+    private BinaryProtocol() {
+    }
+
+    public static byte[] toBytes(int[] values) {
+        ByteBuffer buf = ByteBuffer.allocate(values.length * 4)
+                .order(ByteOrder.LITTLE_ENDIAN);
+        for (int v : values) {
+            buf.putInt(v);
+        }
+        return buf.array();
+    }
+
+    public static byte[] toBytes(long[] values) {
+        ByteBuffer buf = ByteBuffer.allocate(values.length * 8)
+                .order(ByteOrder.LITTLE_ENDIAN);
+        for (long v : values) {
+            buf.putLong(v);
+        }
+        return buf.array();
+    }
+
+    public static byte[] toBytes(float[] values) {
+        ByteBuffer buf = ByteBuffer.allocate(values.length * 4)
+                .order(ByteOrder.LITTLE_ENDIAN);
+        for (float v : values) {
+            buf.putFloat(v);
+        }
+        return buf.array();
+    }
+
+    public static byte[] toBytes(double[] values) {
+        ByteBuffer buf = ByteBuffer.allocate(values.length * 8)
+                .order(ByteOrder.LITTLE_ENDIAN);
+        for (double v : values) {
+            buf.putDouble(v);
+        }
+        return buf.array();
+    }
+
+    public static byte[] toBytes(boolean[] values) {
+        byte[] out = new byte[values.length];
+        for (int i = 0; i < values.length; i++) {
+            out[i] = (byte) (values[i] ? 1 : 0);
+        }
+        return out;
+    }
+
+    /** BYTES tensor: each element is 4-byte LE length + UTF-8 payload. */
+    public static byte[] toBytes(String[] values) {
+        ByteArrayOutputStream out = new ByteArrayOutputStream();
+        for (String s : values) {
+            byte[] payload = s.getBytes(StandardCharsets.UTF_8);
+            ByteBuffer len = ByteBuffer.allocate(4)
+                    .order(ByteOrder.LITTLE_ENDIAN).putInt(payload.length);
+            out.writeBytes(len.array());
+            out.writeBytes(payload);
+        }
+        return out.toByteArray();
+    }
+
+    public static int[] toIntArray(byte[] data) {
+        ByteBuffer buf = ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+        int[] out = new int[data.length / 4];
+        for (int i = 0; i < out.length; i++) {
+            out[i] = buf.getInt();
+        }
+        return out;
+    }
+
+    public static long[] toLongArray(byte[] data) {
+        ByteBuffer buf = ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+        long[] out = new long[data.length / 8];
+        for (int i = 0; i < out.length; i++) {
+            out[i] = buf.getLong();
+        }
+        return out;
+    }
+
+    public static float[] toFloatArray(byte[] data) {
+        ByteBuffer buf = ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+        float[] out = new float[data.length / 4];
+        for (int i = 0; i < out.length; i++) {
+            out[i] = buf.getFloat();
+        }
+        return out;
+    }
+
+    public static double[] toDoubleArray(byte[] data) {
+        ByteBuffer buf = ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+        double[] out = new double[data.length / 8];
+        for (int i = 0; i < out.length; i++) {
+            out[i] = buf.getDouble();
+        }
+        return out;
+    }
+
+    /** Decodes a BYTES tensor payload into its string elements. */
+    public static String[] toStringArray(byte[] data)
+            throws InferenceException {
+        ByteBuffer buf = ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+        List<String> out = new ArrayList<>();
+        while (buf.remaining() >= 4) {
+            int len = buf.getInt();
+            if (len < 0 || len > buf.remaining()) {
+                throw new InferenceException(
+                        "malformed BYTES tensor: element length " + len);
+            }
+            byte[] payload = new byte[len];
+            buf.get(payload);
+            out.add(new String(payload, StandardCharsets.UTF_8));
+        }
+        if (buf.remaining() != 0) {
+            throw new InferenceException(
+                    "malformed BYTES tensor: trailing bytes");
+        }
+        return out.toArray(new String[0]);
+    }
+}

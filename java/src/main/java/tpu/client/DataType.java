@@ -1,0 +1,28 @@
+package tpu.client;
+
+/**
+ * v2 wire datatypes with element byte sizes (reference DataType POJO,
+ * /root/reference/src/java .../DataType.java; dtype table mirrors
+ * client_tpu/protocol/dtypes.py).
+ */
+public enum DataType {
+    BOOL(1), UINT8(1), UINT16(2), UINT32(4), UINT64(8),
+    INT8(1), INT16(2), INT32(4), INT64(8),
+    FP16(2), BF16(2), FP32(4), FP64(8),
+    BYTES(0);
+
+    private final int byteSize;
+
+    DataType(int byteSize) {
+        this.byteSize = byteSize;
+    }
+
+    /** Element size in bytes; 0 for BYTES (variable length). */
+    public int byteSize() {
+        return byteSize;
+    }
+
+    public static DataType fromWire(String name) {
+        return DataType.valueOf(name);
+    }
+}

@@ -1,0 +1,42 @@
+package tpu.client;
+
+import java.time.Duration;
+
+/**
+ * Client transport knobs (reference HttpConfig,
+ * InferenceServerClient.java:76-167: ioThreads/timeouts/keepalive). The
+ * JDK HttpClient manages its own IO threads and keep-alive pool, so the
+ * surviving knobs are the timeouts and async concurrency.
+ */
+public class HttpConfig {
+    private Duration connectTimeout = Duration.ofSeconds(10);
+    private Duration requestTimeout = Duration.ofSeconds(120);
+    private int maxAsyncRequests = 8;
+
+    public Duration getConnectTimeout() {
+        return connectTimeout;
+    }
+
+    public HttpConfig setConnectTimeout(Duration timeout) {
+        this.connectTimeout = timeout;
+        return this;
+    }
+
+    public Duration getRequestTimeout() {
+        return requestTimeout;
+    }
+
+    public HttpConfig setRequestTimeout(Duration timeout) {
+        this.requestTimeout = timeout;
+        return this;
+    }
+
+    public int getMaxAsyncRequests() {
+        return maxAsyncRequests;
+    }
+
+    public HttpConfig setMaxAsyncRequests(int n) {
+        this.maxAsyncRequests = n;
+        return this;
+    }
+}

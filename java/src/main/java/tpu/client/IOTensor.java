@@ -1,0 +1,41 @@
+package tpu.client;
+
+/**
+ * Tensor metadata POJO (reference IOTensor, SURVEY.md §2.5): name, wire
+ * datatype, shape.
+ */
+public class IOTensor {
+    private final String name;
+    private final String datatype;
+    private final long[] shape;
+
+    public IOTensor(String name, String datatype, long[] shape) {
+        this.name = name;
+        this.datatype = datatype;
+        this.shape = shape;
+    }
+
+    public String getName() {
+        return name;
+    }
+
+    public String getDatatype() {
+        return datatype;
+    }
+
+    public long[] getShape() {
+        return shape;
+    }
+
+    public DataType dataType() {
+        return DataType.fromWire(datatype);
+    }
+
+    public long elementCount() {
+        long n = 1;
+        for (long d : shape) {
+            n *= d;
+        }
+        return n;
+    }
+}

@@ -1,0 +1,108 @@
+package tpu.client;
+
+import java.util.LinkedHashMap;
+import java.util.Map;
+
+/**
+ * Input tensor: shape/dtype metadata plus binary payload (reference
+ * InferInput.java:335 with BinaryProtocol LE encoders). Data always rides
+ * the binary extension (JSON head + binary tail).
+ */
+public class InferInput {
+    private final String name;
+    private final long[] shape;
+    private final DataType datatype;
+    private byte[] data;
+    private String shmRegion;
+    private long shmByteSize;
+    private long shmOffset;
+
+    public InferInput(String name, long[] shape, DataType datatype) {
+        this.name = name;
+        this.shape = shape;
+        this.datatype = datatype;
+    }
+
+    public String getName() {
+        return name;
+    }
+
+    public DataType getDatatype() {
+        return datatype;
+    }
+
+    public long[] getShape() {
+        return shape;
+    }
+
+    public void setData(int[] values) {
+        this.data = BinaryProtocol.toBytes(values);
+    }
+
+    public void setData(long[] values) {
+        this.data = BinaryProtocol.toBytes(values);
+    }
+
+    public void setData(float[] values) {
+        this.data = BinaryProtocol.toBytes(values);
+    }
+
+    public void setData(double[] values) {
+        this.data = BinaryProtocol.toBytes(values);
+    }
+
+    public void setData(boolean[] values) {
+        this.data = BinaryProtocol.toBytes(values);
+    }
+
+    /** BYTES tensors: 4-byte-LE length-prefixed elements. */
+    public void setData(String[] values) {
+        this.data = BinaryProtocol.toBytes(values);
+    }
+
+    /** Raw little-endian bytes, caller-encoded. */
+    public void setRawData(byte[] raw) {
+        this.data = raw;
+    }
+
+    public void setSharedMemory(String regionName, long byteSize,
+                                long offset) {
+        this.shmRegion = regionName;
+        this.shmByteSize = byteSize;
+        this.shmOffset = offset;
+        this.data = null;
+    }
+
+    public byte[] getData() {
+        return data;
+    }
+
+    public boolean isSharedMemory() {
+        return shmRegion != null;
+    }
+
+    /** JSON head entry for this input. */
+    Map<String, Object> toJson() {
+        Map<String, Object> out = new LinkedHashMap<>();
+        out.put("name", name);
+        out.put("shape", shape);
+        out.put("datatype", datatype.name());
+        Map<String, Object> params = new LinkedHashMap<>();
+        if (shmRegion != null) {
+            params.put("shared_memory_region", shmRegion);
+            params.put("shared_memory_byte_size", shmByteSize);
+            if (shmOffset != 0) {
+                params.put("shared_memory_offset", shmOffset);
+            }
+        } else {
+            if (data == null) {
+                throw new IllegalStateException("input '" + name
+                        + "' has no data: call setData() or "
+                        + "setSharedMemory() before infer()");
+            }
+            params.put("binary_data_size", (long) data.length);
+        }
+        out.put("parameters", params);
+        return out;
+    }
+}

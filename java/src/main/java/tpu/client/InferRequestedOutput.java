@@ -1,0 +1,64 @@
+package tpu.client;
+
+import java.util.LinkedHashMap;
+import java.util.Map;
+
+/**
+ * Requested output: binary placement by default, optional classification
+ * extension or shared-memory placement (reference InferRequestedOutput
+ * semantics, common.h:359-431 wire shape).
+ */
+public class InferRequestedOutput {
+    private final String name;
+    private final boolean binaryData;
+    private final int classCount;
+    private String shmRegion;
+    private long shmByteSize;
+    private long shmOffset;
+
+    public InferRequestedOutput(String name) {
+        this(name, true, 0);
+    }
+
+    public InferRequestedOutput(String name, boolean binaryData,
+                                int classCount) {
+        this.name = name;
+        this.binaryData = binaryData;
+        this.classCount = classCount;
+    }
+
+    public String getName() {
+        return name;
+    }
+
+    public void setSharedMemory(String regionName, long byteSize,
+                                long offset) {
+        this.shmRegion = regionName;
+        this.shmByteSize = byteSize;
+        this.shmOffset = offset;
+    }
+
+    Map<String, Object> toJson() {
+        Map<String, Object> out = new LinkedHashMap<>();
+        out.put("name", name);
+        Map<String, Object> params = new LinkedHashMap<>();
+        if (shmRegion != null) {
+            params.put("shared_memory_region", shmRegion);
+            params.put("shared_memory_byte_size", shmByteSize);
+            if (shmOffset != 0) {
+                params.put("shared_memory_offset", shmOffset);
+            }
+        } else {
+            if (binaryData) {
+                params.put("binary_data", Boolean.TRUE);
+            }
+            if (classCount > 0) {
+                params.put("classification", (long) classCount);
+            }
+        }
+        if (!params.isEmpty()) {
+            out.put("parameters", params);
+        }
+        return out;
+    }
+}

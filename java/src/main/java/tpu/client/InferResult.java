@@ -1,0 +1,157 @@
+package tpu.client;
+
+import java.nio.charset.StandardCharsets;
+import java.util.Arrays;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * Inference response: JSON head sized by Inference-Header-Content-Length,
+ * followed by concatenated binary output tails addressed in declaration
+ * order (reference InferResult.java:293 split-body parsing; wire contract
+ * identical to http_client.cc:752-835).
+ */
+public class InferResult {
+
+    private final Map<String, Object> head;
+    private final Map<String, IOTensor> tensors = new LinkedHashMap<>();
+    private final Map<String, byte[]> binary = new LinkedHashMap<>();
+    private final Map<String, List<Object>> jsonData = new LinkedHashMap<>();
+
+    @SuppressWarnings("unchecked")
+    public InferResult(byte[] body, int headerLength)
+            throws InferenceException {
+        int headLen = headerLength > 0 ? headerLength : body.length;
+        String headText =
+                new String(body, 0, headLen, StandardCharsets.UTF_8);
+        head = Json.parseObject(headText);
+
+        int offset = headLen;
+        Object outputs = head.get("outputs");
+        if (!(outputs instanceof List)) {
+            return;
+        }
+        for (Object entry : (List<Object>) outputs) {
+            Map<String, Object> out = (Map<String, Object>) entry;
+            String name = (String) out.get("name");
+            String datatype = (String) out.get("datatype");
+            List<Object> shapeList = (List<Object>) out.get("shape");
+            long[] shape = new long[shapeList.size()];
+            for (int i = 0; i < shape.length; i++) {
+                shape[i] = ((Number) shapeList.get(i)).longValue();
+            }
+            tensors.put(name, new IOTensor(name, datatype, shape));
+
+            Map<String, Object> params =
+                    (Map<String, Object>) out.get("parameters");
+            Long binSize = null;
+            if (params != null && params.get("binary_data_size") != null) {
+                binSize = ((Number) params.get("binary_data_size"))
+                        .longValue();
+            }
+            if (binSize != null) {
+                if (offset + binSize > body.length) {
+                    throw new InferenceException(
+                            "binary tail overruns body for '" + name + "'");
+                }
+                binary.put(name, Arrays.copyOfRange(
+                        body, offset, offset + binSize.intValue()));
+                offset += binSize.intValue();
+            } else if (out.get("data") instanceof List) {
+                jsonData.put(name, (List<Object>) out.get("data"));
+            }
+        }
+    }
+
+    public String getModelName() {
+        return (String) head.get("model_name");
+    }
+
+    public String getId() {
+        return (String) head.get("id");
+    }
+
+    public IOTensor getOutput(String name) {
+        return tensors.get(name);
+    }
+
+    /** Raw little-endian bytes of a binary output (null if JSON/shm). */
+    public byte[] getRawOutput(String name) {
+        return binary.get(name);
+    }
+
+    public int[] getOutputAsInt(String name) throws InferenceException {
+        byte[] raw = binary.get(name);
+        if (raw != null) {
+            return BinaryProtocol.toIntArray(raw);
+        }
+        List<Object> data = jsonDataFor(name);
+        int[] out = new int[data.size()];
+        for (int i = 0; i < out.length; i++) {
+            out[i] = ((Number) data.get(i)).intValue();
+        }
+        return out;
+    }
+
+    public long[] getOutputAsLong(String name) throws InferenceException {
+        byte[] raw = binary.get(name);
+        if (raw != null) {
+            return BinaryProtocol.toLongArray(raw);
+        }
+        List<Object> data = jsonDataFor(name);
+        long[] out = new long[data.size()];
+        for (int i = 0; i < out.length; i++) {
+            out[i] = ((Number) data.get(i)).longValue();
+        }
+        return out;
+    }
+
+    public float[] getOutputAsFloat(String name) throws InferenceException {
+        byte[] raw = binary.get(name);
+        if (raw != null) {
+            return BinaryProtocol.toFloatArray(raw);
+        }
+        List<Object> data = jsonDataFor(name);
+        float[] out = new float[data.size()];
+        for (int i = 0; i < out.length; i++) {
+            out[i] = ((Number) data.get(i)).floatValue();
+        }
+        return out;
+    }
+
+    public double[] getOutputAsDouble(String name) throws InferenceException {
+        byte[] raw = binary.get(name);
+        if (raw != null) {
+            return BinaryProtocol.toDoubleArray(raw);
+        }
+        List<Object> data = jsonDataFor(name);
+        double[] out = new double[data.size()];
+        for (int i = 0; i < out.length; i++) {
+            out[i] = ((Number) data.get(i)).doubleValue();
+        }
+        return out;
+    }
+
+    public String[] getOutputAsString(String name) throws InferenceException {
+        byte[] raw = binary.get(name);
+        if (raw != null) {
+            return BinaryProtocol.toStringArray(raw);
+        }
+        List<Object> data = jsonDataFor(name);
+        String[] out = new String[data.size()];
+        for (int i = 0; i < out.length; i++) {
+            out[i] = String.valueOf(data.get(i));
+        }
+        return out;
+    }
+
+    private List<Object> jsonDataFor(String name) throws InferenceException {
+        List<Object> data = jsonData.get(name);
+        if (data == null) {
+            throw new InferenceException("output '" + name
+                    + "' has no inline data (shared memory?)");
+        }
+        return data;
+    }
+}

@@ -1,0 +1,25 @@
+package tpu.client;
+
+/** Client error carrying the HTTP status when one is available. */
+public class InferenceException extends Exception {
+    private final int status;
+
+    public InferenceException(String message) {
+        this(message, 0);
+    }
+
+    public InferenceException(String message, int status) {
+        super(message);
+        this.status = status;
+    }
+
+    public InferenceException(String message, Throwable cause) {
+        super(message, cause);
+        this.status = 0;
+    }
+
+    /** HTTP status code, or 0 when the failure was not an HTTP error. */
+    public int getStatus() {
+        return status;
+    }
+}

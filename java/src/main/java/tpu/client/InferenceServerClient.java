@@ -1,0 +1,291 @@
+package tpu.client;
+
+import java.io.ByteArrayOutputStream;
+import java.net.URI;
+import java.net.http.HttpClient;
+import java.net.http.HttpRequest;
+import java.net.http.HttpResponse;
+import java.nio.charset.StandardCharsets;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+import java.util.concurrent.CompletableFuture;
+import java.util.concurrent.ExecutorService;
+import java.util.concurrent.Executors;
+
+import tpu.client.endpoint.AbstractEndpoint;
+import tpu.client.endpoint.FixedEndpoint;
+
+/**
+ * HTTP/REST client for the v2 inference protocol (reference
+ * InferenceServerClient.java:72+ on Apache HttpAsyncClient; this one rides
+ * the JDK's HttpClient). Sync + async infer with the binary tensor
+ * extension (JSON head + concatenated binary tails framed by
+ * Inference-Header-Content-Length), plus the control plane: health,
+ * metadata, config, repository index/load/unload, statistics, and
+ * system/TPU shared-memory registration.
+ */
+public class InferenceServerClient implements AutoCloseable {
+
+    private final AbstractEndpoint endpoint;
+    private final HttpConfig config;
+    private final HttpClient http;
+    private final ExecutorService asyncPool;
+
+    public InferenceServerClient(String url) {
+        this(new FixedEndpoint(url), new HttpConfig());
+    }
+
+    public InferenceServerClient(String url, HttpConfig config) {
+        this(new FixedEndpoint(url), config);
+    }
+
+    public InferenceServerClient(AbstractEndpoint endpoint,
+                                 HttpConfig config) {
+        this.endpoint = endpoint;
+        this.config = config;
+        this.http = HttpClient.newBuilder()
+                .connectTimeout(config.getConnectTimeout())
+                .build();
+        this.asyncPool =
+                Executors.newFixedThreadPool(config.getMaxAsyncRequests());
+    }
+
+    @Override
+    public void close() {
+        asyncPool.shutdown();
+    }
+
+    // ------------------------------------------------------ health ----------
+
+    public boolean isServerLive() throws InferenceException {
+        return get("/v2/health/live").statusCode() == 200;
+    }
+
+    public boolean isServerReady() throws InferenceException {
+        return get("/v2/health/ready").statusCode() == 200;
+    }
+
+    public boolean isModelReady(String modelName) throws InferenceException {
+        return get("/v2/models/" + modelName + "/ready").statusCode() == 200;
+    }
+
+    // ---------------------------------------------------- metadata ----------
+
+    public Map<String, Object> getServerMetadata() throws InferenceException {
+        return Json.parseObject(bodyOf(checked(get("/v2"))));
+    }
+
+    public Map<String, Object> getModelMetadata(String modelName)
+            throws InferenceException {
+        return Json.parseObject(
+                bodyOf(checked(get("/v2/models/" + modelName))));
+    }
+
+    public Map<String, Object> getModelConfig(String modelName)
+            throws InferenceException {
+        return Json.parseObject(
+                bodyOf(checked(get("/v2/models/" + modelName + "/config"))));
+    }
+
+    public Object getModelRepositoryIndex() throws InferenceException {
+        return Json.parse(
+                bodyOf(checked(post("/v2/repository/index", "{}"))));
+    }
+
+    public void loadModel(String modelName) throws InferenceException {
+        checked(post("/v2/repository/models/" + modelName + "/load", "{}"));
+    }
+
+    public void unloadModel(String modelName) throws InferenceException {
+        checked(post("/v2/repository/models/" + modelName + "/unload", "{}"));
+    }
+
+    public Map<String, Object> getInferenceStatistics(String modelName)
+            throws InferenceException {
+        return Json.parseObject(
+                bodyOf(checked(get("/v2/models/" + modelName + "/stats"))));
+    }
+
+    // ----------------------------------------------- shared memory ----------
+
+    public void registerSystemSharedMemory(String name, String key,
+                                           long byteSize, long offset)
+            throws InferenceException {
+        Map<String, Object> body = new LinkedHashMap<>();
+        body.put("key", key);
+        body.put("offset", offset);
+        body.put("byte_size", byteSize);
+        checked(post("/v2/systemsharedmemory/region/" + name + "/register",
+                Json.write(body)));
+    }
+
+    public void unregisterSystemSharedMemory(String name)
+            throws InferenceException {
+        checked(post("/v2/systemsharedmemory/region/" + name + "/unregister",
+                "{}"));
+    }
+
+    public void registerTpuSharedMemory(String name, String rawHandleB64,
+                                        long deviceId, long byteSize)
+            throws InferenceException {
+        Map<String, Object> body = new LinkedHashMap<>();
+        body.put("raw_handle", Map.of("b64", rawHandleB64));
+        body.put("device_id", deviceId);
+        body.put("byte_size", byteSize);
+        checked(post("/v2/tpusharedmemory/region/" + name + "/register",
+                Json.write(body)));
+    }
+
+    public void unregisterTpuSharedMemory(String name)
+            throws InferenceException {
+        checked(post("/v2/tpusharedmemory/region/" + name + "/unregister",
+                "{}"));
+    }
+
+    // -------------------------------------------------------- infer ---------
+
+    public InferResult infer(String modelName, List<InferInput> inputs,
+                             List<InferRequestedOutput> outputs)
+            throws InferenceException {
+        return infer(modelName, inputs, outputs, null);
+    }
+
+    public InferResult infer(String modelName, List<InferInput> inputs,
+                             List<InferRequestedOutput> outputs,
+                             String requestId) throws InferenceException {
+        // Head serialized ONCE; its byte length frames the binary tails.
+        byte[] head = requestHead(inputs, outputs, requestId)
+                .getBytes(StandardCharsets.UTF_8);
+        byte[] body = buildRequestBody(head, inputs);
+        int headLen = head.length;
+        HttpRequest request = HttpRequest.newBuilder()
+                .uri(URI.create(endpoint.next() + "/v2/models/" + modelName
+                        + "/infer"))
+                .timeout(config.getRequestTimeout())
+                .header("Content-Type", "application/octet-stream")
+                .header("Inference-Header-Content-Length",
+                        String.valueOf(headLen))
+                .POST(HttpRequest.BodyPublishers.ofByteArray(body))
+                .build();
+        HttpResponse<byte[]> response;
+        try {
+            response = http.send(request,
+                    HttpResponse.BodyHandlers.ofByteArray());
+        } catch (Exception e) {
+            throw new InferenceException("infer request failed", e);
+        }
+        return parseInferResponse(response);
+    }
+
+    /** Callback-style async infer on the client's thread pool. */
+    public CompletableFuture<InferResult> asyncInfer(
+            String modelName, List<InferInput> inputs,
+            List<InferRequestedOutput> outputs) {
+        CompletableFuture<InferResult> future = new CompletableFuture<>();
+        asyncPool.submit(() -> {
+            try {
+                future.complete(infer(modelName, inputs, outputs));
+            } catch (Throwable t) {
+                future.completeExceptionally(t);
+            }
+        });
+        return future;
+    }
+
+    // ----------------------------------------------------- plumbing ---------
+
+    private String requestHead(List<InferInput> inputs,
+                               List<InferRequestedOutput> outputs,
+                               String requestId) {
+        Map<String, Object> head = new LinkedHashMap<>();
+        if (requestId != null) {
+            head.put("id", requestId);
+        }
+        java.util.List<Object> ins = new java.util.ArrayList<>();
+        for (InferInput input : inputs) {
+            ins.add(input.toJson());
+        }
+        head.put("inputs", ins);
+        if (outputs != null && !outputs.isEmpty()) {
+            java.util.List<Object> outs = new java.util.ArrayList<>();
+            for (InferRequestedOutput output : outputs) {
+                outs.add(output.toJson());
+            }
+            head.put("outputs", outs);
+        }
+        return Json.write(head);
+    }
+
+    private byte[] buildRequestBody(byte[] head, List<InferInput> inputs) {
+        ByteArrayOutputStream out = new ByteArrayOutputStream();
+        out.writeBytes(head);
+        for (InferInput input : inputs) {
+            if (!input.isSharedMemory()) {
+                out.writeBytes(input.getData());
+            }
+        }
+        return out.toByteArray();
+    }
+
+    private InferResult parseInferResponse(HttpResponse<byte[]> response)
+            throws InferenceException {
+        byte[] body = response.body();
+        if (response.statusCode() >= 400) {
+            throw new InferenceException(
+                    new String(body, StandardCharsets.UTF_8),
+                    response.statusCode());
+        }
+        int headerLength;
+        try {
+            headerLength = response.headers()
+                    .firstValue("Inference-Header-Content-Length")
+                    .map(Integer::parseInt).orElse(0);
+        } catch (NumberFormatException e) {
+            throw new InferenceException(
+                    "bad Inference-Header-Content-Length header", e);
+        }
+        return new InferResult(body, headerLength);
+    }
+
+    private HttpResponse<byte[]> get(String path) throws InferenceException {
+        try {
+            HttpRequest request = HttpRequest.newBuilder()
+                    .uri(URI.create(endpoint.next() + path))
+                    .timeout(config.getRequestTimeout())
+                    .GET().build();
+            return http.send(request, HttpResponse.BodyHandlers.ofByteArray());
+        } catch (Exception e) {
+            throw new InferenceException("GET " + path + " failed", e);
+        }
+    }
+
+    private HttpResponse<byte[]> post(String path, String body)
+            throws InferenceException {
+        try {
+            HttpRequest request = HttpRequest.newBuilder()
+                    .uri(URI.create(endpoint.next() + path))
+                    .timeout(config.getRequestTimeout())
+                    .header("Content-Type", "application/json")
+                    .POST(HttpRequest.BodyPublishers.ofString(body))
+                    .build();
+            return http.send(request, HttpResponse.BodyHandlers.ofByteArray());
+        } catch (Exception e) {
+            throw new InferenceException("POST " + path + " failed", e);
+        }
+    }
+
+    private HttpResponse<byte[]> checked(HttpResponse<byte[]> response)
+            throws InferenceException {
+        if (response.statusCode() >= 400) {
+            throw new InferenceException(
+                    new String(response.body(), StandardCharsets.UTF_8),
+                    response.statusCode());
+        }
+        return response;
+    }
+
+    private static String bodyOf(HttpResponse<byte[]> response) {
+        return new String(response.body(), StandardCharsets.UTF_8);
+    }
+}

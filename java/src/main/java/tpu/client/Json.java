@@ -1,0 +1,296 @@
+package tpu.client;
+
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * Minimal JSON parser/writer sized for the v2 protocol (objects, arrays,
+ * strings, numbers, booleans, null). Replaces the external JSON library the
+ * reference depends on so this client builds with nothing but a JDK.
+ *
+ * Parsed values map to: Map&lt;String,Object&gt;, List&lt;Object&gt;,
+ * String, Long, Double, Boolean, null.
+ */
+public final class Json {
+
+    private final String text;
+    private int pos;
+
+    private Json(String text) {
+        this.text = text;
+    }
+
+    public static Object parse(String text) throws InferenceException {
+        Json p = new Json(text);
+        p.skipWhitespace();
+        Object value = p.parseValue();
+        p.skipWhitespace();
+        if (p.pos != text.length()) {
+            throw new InferenceException("trailing JSON content at " + p.pos);
+        }
+        return value;
+    }
+
+    @SuppressWarnings("unchecked")
+    public static Map<String, Object> parseObject(String text)
+            throws InferenceException {
+        Object value = parse(text);
+        if (!(value instanceof Map)) {
+            throw new InferenceException("expected JSON object");
+        }
+        return (Map<String, Object>) value;
+    }
+
+    // ---------------------------------------------------------- parsing ----
+
+    private Object parseValue() throws InferenceException {
+        if (pos >= text.length()) {
+            throw new InferenceException("unexpected end of JSON");
+        }
+        char c = text.charAt(pos);
+        switch (c) {
+            case '{':
+                return parseObjectValue();
+            case '[':
+                return parseArray();
+            case '"':
+                return parseString();
+            case 't':
+                expect("true");
+                return Boolean.TRUE;
+            case 'f':
+                expect("false");
+                return Boolean.FALSE;
+            case 'n':
+                expect("null");
+                return null;
+            default:
+                return parseNumber();
+        }
+    }
+
+    private Map<String, Object> parseObjectValue() throws InferenceException {
+        Map<String, Object> out = new LinkedHashMap<>();
+        pos++; // '{'
+        skipWhitespace();
+        if (peek() == '}') {
+            pos++;
+            return out;
+        }
+        while (true) {
+            skipWhitespace();
+            String key = parseString();
+            skipWhitespace();
+            if (peek() != ':') {
+                throw new InferenceException("expected ':' at " + pos);
+            }
+            pos++;
+            skipWhitespace();
+            out.put(key, parseValue());
+            skipWhitespace();
+            char c = peek();
+            if (c == ',') {
+                pos++;
+            } else if (c == '}') {
+                pos++;
+                return out;
+            } else {
+                throw new InferenceException("expected ',' or '}' at " + pos);
+            }
+        }
+    }
+
+    private List<Object> parseArray() throws InferenceException {
+        List<Object> out = new ArrayList<>();
+        pos++; // '['
+        skipWhitespace();
+        if (peek() == ']') {
+            pos++;
+            return out;
+        }
+        while (true) {
+            skipWhitespace();
+            out.add(parseValue());
+            skipWhitespace();
+            char c = peek();
+            if (c == ',') {
+                pos++;
+            } else if (c == ']') {
+                pos++;
+                return out;
+            } else {
+                throw new InferenceException("expected ',' or ']' at " + pos);
+            }
+        }
+    }
+
+    private String parseString() throws InferenceException {
+        if (peek() != '"') {
+            throw new InferenceException("expected string at " + pos);
+        }
+        pos++;
+        StringBuilder sb = new StringBuilder();
+        while (true) {
+            if (pos >= text.length()) {
+                throw new InferenceException("unterminated string");
+            }
+            char c = text.charAt(pos++);
+            if (c == '"') {
+                return sb.toString();
+            }
+            if (c != '\\') {
+                sb.append(c);
+                continue;
+            }
+            if (pos >= text.length()) {
+                throw new InferenceException("unterminated escape");
+            }
+            char esc = text.charAt(pos++);
+            switch (esc) {
+                case '"': sb.append('"'); break;
+                case '\\': sb.append('\\'); break;
+                case '/': sb.append('/'); break;
+                case 'b': sb.append('\b'); break;
+                case 'f': sb.append('\f'); break;
+                case 'n': sb.append('\n'); break;
+                case 'r': sb.append('\r'); break;
+                case 't': sb.append('\t'); break;
+                case 'u':
+                    if (pos + 4 > text.length()) {
+                        throw new InferenceException(
+                                "truncated \\u escape");
+                    }
+                    try {
+                        sb.append((char) Integer.parseInt(
+                                text.substring(pos, pos + 4), 16));
+                    } catch (NumberFormatException e) {
+                        throw new InferenceException(
+                                "bad \\u escape at " + pos);
+                    }
+                    pos += 4;
+                    break;
+                default:
+                    throw new InferenceException("bad escape \\" + esc);
+            }
+        }
+    }
+
+    private Object parseNumber() throws InferenceException {
+        int start = pos;
+        boolean isDouble = false;
+        while (pos < text.length()) {
+            char c = text.charAt(pos);
+            if (c == '-' || c == '+' || (c >= '0' && c <= '9')) {
+                pos++;
+            } else if (c == '.' || c == 'e' || c == 'E') {
+                isDouble = true;
+                pos++;
+            } else {
+                break;
+            }
+        }
+        String token = text.substring(start, pos);
+        try {
+            return isDouble ? (Object) Double.parseDouble(token)
+                            : (Object) Long.parseLong(token);
+        } catch (NumberFormatException e) {
+            throw new InferenceException("bad number '" + token + "'");
+        }
+    }
+
+    private char peek() throws InferenceException {
+        if (pos >= text.length()) {
+            throw new InferenceException("unexpected end of JSON");
+        }
+        return text.charAt(pos);
+    }
+
+    private void expect(String literal) throws InferenceException {
+        if (!text.startsWith(literal, pos)) {
+            throw new InferenceException("bad literal at " + pos);
+        }
+        pos += literal.length();
+    }
+
+    private void skipWhitespace() {
+        while (pos < text.length()
+                && Character.isWhitespace(text.charAt(pos))) {
+            pos++;
+        }
+    }
+
+    // ---------------------------------------------------------- writing ----
+
+    public static void write(Object value, StringBuilder sb) {
+        if (value == null) {
+            sb.append("null");
+        } else if (value instanceof String) {
+            writeString((String) value, sb);
+        } else if (value instanceof Map) {
+            sb.append('{');
+            boolean first = true;
+            for (Map.Entry<?, ?> e : ((Map<?, ?>) value).entrySet()) {
+                if (!first) {
+                    sb.append(',');
+                }
+                first = false;
+                writeString(String.valueOf(e.getKey()), sb);
+                sb.append(':');
+                write(e.getValue(), sb);
+            }
+            sb.append('}');
+        } else if (value instanceof Iterable) {
+            sb.append('[');
+            boolean first = true;
+            for (Object item : (Iterable<?>) value) {
+                if (!first) {
+                    sb.append(',');
+                }
+                first = false;
+                write(item, sb);
+            }
+            sb.append(']');
+        } else if (value instanceof long[]) {
+            sb.append('[');
+            long[] arr = (long[]) value;
+            for (int i = 0; i < arr.length; i++) {
+                if (i > 0) {
+                    sb.append(',');
+                }
+                sb.append(arr[i]);
+            }
+            sb.append(']');
+        } else {
+            sb.append(value); // Number / Boolean
+        }
+    }
+
+    public static String write(Object value) {
+        StringBuilder sb = new StringBuilder();
+        write(value, sb);
+        return sb.toString();
+    }
+
+    private static void writeString(String s, StringBuilder sb) {
+        sb.append('"');
+        for (int i = 0; i < s.length(); i++) {
+            char c = s.charAt(i);
+            switch (c) {
+                case '"': sb.append("\\\""); break;
+                case '\\': sb.append("\\\\"); break;
+                case '\n': sb.append("\\n"); break;
+                case '\r': sb.append("\\r"); break;
+                case '\t': sb.append("\\t"); break;
+                default:
+                    if (c < 0x20) {
+                        sb.append(String.format("\\u%04x", (int) c));
+                    } else {
+                        sb.append(c);
+                    }
+            }
+        }
+        sb.append('"');
+    }
+}

@@ -1,0 +1,11 @@
+package tpu.client.endpoint;
+
+/**
+ * Pluggable URL provider (reference endpoint/ layer, SURVEY.md §2.5):
+ * each request asks for the next base URL, enabling client-side rotation
+ * over replicas.
+ */
+public abstract class AbstractEndpoint {
+    /** Returns the base URL (e.g. "http://host:8000") for the next call. */
+    public abstract String next();
+}

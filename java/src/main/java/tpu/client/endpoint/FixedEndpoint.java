@@ -1,0 +1,16 @@
+package tpu.client.endpoint;
+
+/** Single fixed base URL. */
+public class FixedEndpoint extends AbstractEndpoint {
+    private final String url;
+
+    public FixedEndpoint(String url) {
+        // tolerate bare host:port
+        this.url = url.contains("://") ? url : "http://" + url;
+    }
+
+    @Override
+    public String next() {
+        return url;
+    }
+}

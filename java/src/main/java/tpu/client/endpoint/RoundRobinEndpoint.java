@@ -1,0 +1,26 @@
+package tpu.client.endpoint;
+
+import java.util.ArrayList;
+import java.util.List;
+import java.util.concurrent.atomic.AtomicInteger;
+
+/** Rotates across a fixed replica list, one URL per request. */
+public class RoundRobinEndpoint extends AbstractEndpoint {
+    private final List<String> urls = new ArrayList<>();
+    private final AtomicInteger index = new AtomicInteger();
+
+    public RoundRobinEndpoint(List<String> urls) {
+        for (String u : urls) {
+            this.urls.add(u.contains("://") ? u : "http://" + u);
+        }
+        if (this.urls.isEmpty()) {
+            throw new IllegalArgumentException("no endpoints");
+        }
+    }
+
+    @Override
+    public String next() {
+        int i = Math.floorMod(index.getAndIncrement(), urls.size());
+        return urls.get(i);
+    }
+}

@@ -1,0 +1,70 @@
+package tpu.client.examples;
+
+import java.util.List;
+
+import tpu.client.DataType;
+import tpu.client.InferInput;
+import tpu.client.InferRequestedOutput;
+import tpu.client.InferenceServerClient;
+
+/**
+ * Heap-stability loop (reference MemoryGrowthTest.java): many inferences
+ * while sampling used heap; fails when growth exceeds the bound after
+ * steady state.
+ */
+public final class MemoryGrowthTest {
+
+    private MemoryGrowthTest() {
+    }
+
+    private static long usedHeap() {
+        Runtime rt = Runtime.getRuntime();
+        return rt.totalMemory() - rt.freeMemory();
+    }
+
+    public static void main(String[] args) throws Exception {
+        String url = args.length > 0 ? args[0] : "http://localhost:8000";
+        int iterations = args.length > 1 ? Integer.parseInt(args[1]) : 1000;
+        long maxGrowthBytes = 64L * 1024 * 1024;
+
+        try (InferenceServerClient client = new InferenceServerClient(url)) {
+            int[] a = new int[16];
+            int[] b = new int[16];
+            for (int i = 0; i < 16; i++) {
+                a[i] = i;
+                b[i] = 1;
+            }
+            InferInput input0 = new InferInput("INPUT0", new long[]{1, 16},
+                    DataType.INT32);
+            InferInput input1 = new InferInput("INPUT1", new long[]{1, 16},
+                    DataType.INT32);
+            input0.setData(a);
+            input1.setData(b);
+            List<InferInput> inputs = List.of(input0, input1);
+            List<InferRequestedOutput> outputs =
+                    List.of(new InferRequestedOutput("OUTPUT0"));
+
+            for (int i = 0; i < 100; i++) {
+                client.infer("simple", inputs, outputs);
+            }
+            System.gc();
+            long base = usedHeap();
+            for (int i = 0; i < iterations; i++) {
+                client.infer("simple", inputs, outputs);
+                if (i % 200 == 0) {
+                    System.out.printf("iter %d: heap %d MB%n", i,
+                            usedHeap() >> 20);
+                }
+            }
+            System.gc();
+            long growth = usedHeap() - base;
+            System.out.printf("Heap growth over %d inferences: %d MB%n",
+                    iterations, growth >> 20);
+            if (growth > maxGrowthBytes) {
+                System.err.println("FAIL: heap growth exceeds bound");
+                System.exit(1);
+            }
+            System.out.println("PASS: MemoryGrowthTest");
+        }
+    }
+}

@@ -1,0 +1,61 @@
+package tpu.client.examples;
+
+import java.util.List;
+
+import tpu.client.InferInput;
+import tpu.client.InferRequestedOutput;
+import tpu.client.InferResult;
+import tpu.client.InferenceServerClient;
+import tpu.client.DataType;
+
+/**
+ * Value-asserting add/sub conformance client (reference
+ * SimpleInferClient.java, SURVEY.md §2.5): INT32[1,16] through `simple`,
+ * OUTPUT0=a+b and OUTPUT1=a-b checked elementwise.
+ */
+public final class SimpleInferClient {
+
+    private SimpleInferClient() {
+    }
+
+    public static void main(String[] args) throws Exception {
+        String url = args.length > 0 ? args[0] : "http://localhost:8000";
+        try (InferenceServerClient client = new InferenceServerClient(url)) {
+            if (!client.isServerLive()) {
+                throw new IllegalStateException("server not live");
+            }
+
+            int[] a = new int[16];
+            int[] b = new int[16];
+            for (int i = 0; i < 16; i++) {
+                a[i] = i;
+                b[i] = 1;
+            }
+            InferInput input0 =
+                    new InferInput("INPUT0", new long[]{1, 16},
+                            DataType.INT32);
+            InferInput input1 =
+                    new InferInput("INPUT1", new long[]{1, 16},
+                            DataType.INT32);
+            input0.setData(a);
+            input1.setData(b);
+
+            InferResult result = client.infer("simple",
+                    List.of(input0, input1),
+                    List.of(new InferRequestedOutput("OUTPUT0"),
+                            new InferRequestedOutput("OUTPUT1")),
+                    "1");
+
+            int[] sum = result.getOutputAsInt("OUTPUT0");
+            int[] diff = result.getOutputAsInt("OUTPUT1");
+            for (int i = 0; i < 16; i++) {
+                if (sum[i] != a[i] + b[i] || diff[i] != a[i] - b[i]) {
+                    System.err.println("mismatch at " + i + ": " + sum[i]
+                            + " / " + diff[i]);
+                    System.exit(1);
+                }
+            }
+            System.out.println("PASS: SimpleInferClient");
+        }
+    }
+}

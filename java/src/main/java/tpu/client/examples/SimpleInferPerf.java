@@ -1,0 +1,86 @@
+package tpu.client.examples;
+
+import java.util.ArrayList;
+import java.util.Collections;
+import java.util.List;
+import java.util.concurrent.CompletableFuture;
+
+import tpu.client.DataType;
+import tpu.client.InferInput;
+import tpu.client.InferRequestedOutput;
+import tpu.client.InferResult;
+import tpu.client.InferenceServerClient;
+
+/**
+ * Latency/throughput micro-benchmark (reference SimpleInferPerf.java):
+ * fixed request count with bounded async concurrency; prints throughput
+ * and latency percentiles.
+ */
+public final class SimpleInferPerf {
+
+    private SimpleInferPerf() {
+    }
+
+    public static void main(String[] args) throws Exception {
+        String url = args.length > 0 ? args[0] : "http://localhost:8000";
+        int requests = args.length > 1 ? Integer.parseInt(args[1]) : 200;
+        int concurrency = args.length > 2 ? Integer.parseInt(args[2]) : 4;
+
+        try (InferenceServerClient client = new InferenceServerClient(url)) {
+            int[] a = new int[16];
+            int[] b = new int[16];
+            for (int i = 0; i < 16; i++) {
+                a[i] = i;
+                b[i] = 2;
+            }
+            InferInput input0 = new InferInput("INPUT0", new long[]{1, 16},
+                    DataType.INT32);
+            InferInput input1 = new InferInput("INPUT1", new long[]{1, 16},
+                    DataType.INT32);
+            input0.setData(a);
+            input1.setData(b);
+            List<InferInput> inputs = List.of(input0, input1);
+            List<InferRequestedOutput> outputs =
+                    List.of(new InferRequestedOutput("OUTPUT0"));
+
+            // warmup
+            for (int i = 0; i < 10; i++) {
+                client.infer("simple", inputs, outputs);
+            }
+
+            // Latencies come back as dependent futures joined explicitly —
+            // collecting them in callbacks would race the final sort.
+            List<CompletableFuture<Long>> latencyFutures = new ArrayList<>();
+            long start = System.nanoTime();
+            List<CompletableFuture<Long>> inflight = new ArrayList<>();
+            for (int i = 0; i < requests; i++) {
+                long t0 = System.nanoTime();
+                CompletableFuture<Long> lat =
+                        client.asyncInfer("simple", inputs, outputs)
+                                .thenApply(r ->
+                                        (System.nanoTime() - t0) / 1000);
+                latencyFutures.add(lat);
+                inflight.add(lat);
+                if (inflight.size() >= concurrency) {
+                    CompletableFuture.anyOf(
+                            inflight.toArray(new CompletableFuture[0])).join();
+                    inflight.removeIf(CompletableFuture::isDone);
+                }
+            }
+            List<Long> sorted = new ArrayList<>();
+            for (CompletableFuture<Long> lat : latencyFutures) {
+                sorted.add(lat.join());
+            }
+            double seconds = (System.nanoTime() - start) / 1e9;
+            Collections.sort(sorted);
+            System.out.printf("Requests: %d, concurrency %d%n", requests,
+                    concurrency);
+            System.out.printf("Throughput: %.1f infer/sec%n",
+                    requests / seconds);
+            System.out.printf("Latency p50/p90/p99: %d / %d / %d usec%n",
+                    sorted.get(sorted.size() / 2),
+                    sorted.get(sorted.size() * 9 / 10),
+                    sorted.get(Math.max(0, sorted.size() * 99 / 100 - 1)));
+        }
+    }
+}

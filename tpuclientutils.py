@@ -1,0 +1,12 @@
+"""Deprecated alias for :mod:`client_tpu.utils`.
+
+Compat-shim pattern of the reference's tritonclientutils module.
+"""
+
+import warnings
+
+from client_tpu.utils import *  # noqa: F401,F403
+
+warnings.warn(
+    "tpuclientutils is deprecated; import client_tpu.utils instead",
+    DeprecationWarning, stacklevel=2)

@@ -1,0 +1,15 @@
+"""Deprecated alias for :mod:`client_tpu.grpc`.
+
+Compat-shim pattern of the reference's tritongrpcclient module
+(tritongrpcclient/__init__.py:28-36).
+"""
+
+import warnings
+
+from client_tpu.grpc import *  # noqa: F401,F403
+from client_tpu.grpc import InferenceServerClient, InferInput, \
+    InferRequestedOutput, InferResult  # noqa: F401
+
+warnings.warn(
+    "tpugrpcclient is deprecated; import client_tpu.grpc instead",
+    DeprecationWarning, stacklevel=2)

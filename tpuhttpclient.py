@@ -1,0 +1,16 @@
+"""Deprecated alias for :mod:`client_tpu.http`.
+
+Compat-shim pattern of the reference's tritonhttpclient module
+(/root/reference/src/python/library/tritonhttpclient/__init__.py:28-36:
+DeprecationWarning + star re-export).
+"""
+
+import warnings
+
+from client_tpu.http import *  # noqa: F401,F403
+from client_tpu.http import InferenceServerClient, InferInput, \
+    InferRequestedOutput, InferResult  # noqa: F401
+
+warnings.warn(
+    "tpuhttpclient is deprecated; import client_tpu.http instead",
+    DeprecationWarning, stacklevel=2)

@@ -1,0 +1,20 @@
+"""Deprecated alias for the shared-memory utility modules.
+
+Compat-shim pattern of the reference's tritonshmutils package: exposes
+``system_shared_memory`` and ``tpu_shared_memory`` (the CUDA-equivalent
+device data plane) under one legacy name.
+"""
+
+import warnings
+
+import client_tpu.utils.shared_memory as system_shared_memory  # noqa: F401
+import client_tpu.utils.tpu_shared_memory as tpu_shared_memory  # noqa: F401
+
+# CUDA-named alias kept for reference-code compatibility: TPU regions serve
+# the same role (register-by-handle device memory).
+cuda_shared_memory = tpu_shared_memory
+
+warnings.warn(
+    "tpushmutils is deprecated; import client_tpu.utils.shared_memory / "
+    "tpu_shared_memory instead",
+    DeprecationWarning, stacklevel=2)
