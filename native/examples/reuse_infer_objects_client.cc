@@ -1,0 +1,106 @@
+// Object-lifecycle conformance client: the same InferInput /
+// InferRequestedOutput / options objects reused across many requests and
+// across BOTH protocol clients, with value assertions each iteration.
+//
+// Reference counterpart: reuse_infer_objects_client.cc:482 (object
+// lifecycle across protocols).
+#include <unistd.h>
+
+#include <cstdint>
+#include <iostream>
+#include <vector>
+
+#include "tpuclient/grpc_client.h"
+#include "tpuclient/http_client.h"
+
+namespace tc = tpuclient;
+
+namespace {
+
+template <typename Client>
+int Run(Client* client, const char* label, int iterations) {
+  tc::InferInput *input0, *input1;
+  tc::InferInput::Create(&input0, "INPUT0", {1, 16}, "INT32");
+  tc::InferInput::Create(&input1, "INPUT1", {1, 16}, "INT32");
+  std::unique_ptr<tc::InferInput> i0(input0), i1(input1);
+  tc::InferRequestedOutput *o0, *o1;
+  tc::InferRequestedOutput::Create(&o0, "OUTPUT0");
+  tc::InferRequestedOutput::Create(&o1, "OUTPUT1");
+  std::unique_ptr<tc::InferRequestedOutput> oo0(o0), oo1(o1);
+  tc::InferOptions options("simple");
+
+  std::vector<int32_t> a(16), b(16);
+  for (int iter = 0; iter < iterations; ++iter) {
+    // Fresh data through the SAME objects: Reset + AppendRaw each round.
+    for (int i = 0; i < 16; ++i) {
+      a[i] = iter + i;
+      b[i] = 2 * iter + 1;
+    }
+    input0->Reset();
+    input1->Reset();
+    input0->SetShape({1, 16});
+    input1->SetShape({1, 16});
+    input0->AppendRaw(reinterpret_cast<uint8_t*>(a.data()), 64);
+    input1->AppendRaw(reinterpret_cast<uint8_t*>(b.data()), 64);
+    options.request_id = std::to_string(iter);
+
+    tc::InferResult* result = nullptr;
+    tc::Error err = client->Infer(&result, options, {input0, input1},
+                                  {o0, o1});
+    if (!err.IsOk()) {
+      std::cerr << label << " iter " << iter << ": " << err << std::endl;
+      return 1;
+    }
+    std::unique_ptr<tc::InferResult> owner(result);
+    const uint8_t* buf;
+    size_t n;
+    if (!result->RawData("OUTPUT0", &buf, &n).IsOk() || n != 64) {
+      std::cerr << label << " iter " << iter << ": bad OUTPUT0" << std::endl;
+      return 1;
+    }
+    const int32_t* sum = reinterpret_cast<const int32_t*>(buf);
+    for (int i = 0; i < 16; ++i) {
+      if (sum[i] != a[i] + b[i]) {
+        std::cerr << label << " iter " << iter << ": mismatch at " << i
+                  << std::endl;
+        return 1;
+      }
+    }
+  }
+  std::cout << label << ": " << iterations << " iterations OK" << std::endl;
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string http_url = "localhost:8000";
+  std::string grpc_url = "localhost:8001";
+  int iterations = 10;
+  int opt;
+  while ((opt = getopt(argc, argv, "u:g:n:")) != -1) {
+    if (opt == 'u') http_url = optarg;
+    if (opt == 'g') grpc_url = optarg;
+    if (opt == 'n') iterations = atoi(optarg);
+  }
+
+  int rc = 0;
+  {
+    std::unique_ptr<tc::InferenceServerHttpClient> client;
+    if (!tc::InferenceServerHttpClient::Create(&client, http_url).IsOk()) {
+      std::cerr << "http create failed" << std::endl;
+      return 1;
+    }
+    rc |= Run(client.get(), "http", iterations);
+  }
+  {
+    std::unique_ptr<tc::InferenceServerGrpcClient> client;
+    if (!tc::InferenceServerGrpcClient::Create(&client, grpc_url).IsOk()) {
+      std::cerr << "grpc create failed" << std::endl;
+      return 1;
+    }
+    rc |= Run(client.get(), "grpc", iterations);
+  }
+  if (rc == 0) std::cout << "PASS : reuse_infer_objects_client" << std::endl;
+  return rc;
+}

@@ -1,0 +1,67 @@
+// Explicit model control over gRPC: unload/load with readiness transitions
+// and repository index checks.
+//
+// Reference counterpart: simple_grpc_model_control example (§2.7
+// load/unload pairs; control plane surface grpc_client.h:195-213).
+#include <unistd.h>
+
+#include <iostream>
+
+#include "tpuclient/grpc_client.h"
+
+namespace tc = tpuclient;
+
+#define FAIL_IF_ERR(X, MSG)                                          \
+  do {                                                               \
+    tc::Error err__ = (X);                                           \
+    if (!err__.IsOk()) {                                             \
+      std::cerr << "error: " << (MSG) << ": " << err__ << std::endl; \
+      exit(1);                                                       \
+    }                                                                \
+  } while (false)
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8001";
+  std::string model = "simple";
+  int opt;
+  while ((opt = getopt(argc, argv, "u:m:")) != -1) {
+    if (opt == 'u') url = optarg;
+    if (opt == 'm') model = optarg;
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(tc::InferenceServerGrpcClient::Create(&client, url),
+              "create client");
+
+  bool ready = false;
+  FAIL_IF_ERR(client->IsModelReady(&ready, model), "initial ready");
+  if (!ready) {
+    FAIL_IF_ERR(client->LoadModel(model), "initial load");
+  }
+
+  FAIL_IF_ERR(client->UnloadModel(model), "unload");
+  ready = true;
+  // Unloaded models report not-ready (the call may also error; both accept).
+  if (client->IsModelReady(&ready, model).IsOk() && ready) {
+    std::cerr << "error: model still ready after unload" << std::endl;
+    return 1;
+  }
+  inference::RepositoryIndexResponse index;
+  FAIL_IF_ERR(client->ModelRepositoryIndex(&index), "repository index");
+  for (const auto& m : index.models()) {
+    if (m.name() == model && m.state() == "READY") {
+      std::cerr << "error: index still READY after unload" << std::endl;
+      return 1;
+    }
+  }
+
+  FAIL_IF_ERR(client->LoadModel(model), "reload");
+  FAIL_IF_ERR(client->IsModelReady(&ready, model), "ready after load");
+  if (!ready) {
+    std::cerr << "error: model not ready after load" << std::endl;
+    return 1;
+  }
+
+  std::cout << "PASS : simple_grpc_model_control" << std::endl;
+  return 0;
+}

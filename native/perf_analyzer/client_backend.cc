@@ -147,6 +147,8 @@ Error ClientBackendFactory::Create(
     case BackendKind::TPU_HTTP:
       return HttpClientBackend::Create(url_, verbose_, max_async_concurrency_,
                                        backend);
+    case BackendKind::TPU_GRPC:
+      return CreateGrpcBackend(url_, verbose_, backend);
     case BackendKind::TPU_CAPI:
       return CreateCApiBackend(capi_lib_path_, capi_models_, capi_repo_root_,
                                backend);

@@ -20,7 +20,7 @@
 
 namespace tpuperf {
 
-enum class BackendKind { TPU_HTTP, TPU_CAPI };
+enum class BackendKind { TPU_HTTP, TPU_GRPC, TPU_CAPI };
 
 // Server-side per-model statistics snapshot (reference ModelStatistics,
 // client_backend.h:148-168), pulled from the v2 statistics endpoint.
@@ -119,6 +119,10 @@ tpuclient::Error ParseModelStatsJson(
 tpuclient::Error CreateCApiBackend(const std::string& lib_path,
                                    const std::string& models,
                                    const std::string& repo_root,
+                                   std::unique_ptr<ClientBackend>* backend);
+
+// Defined in grpc_backend.cc.
+tpuclient::Error CreateGrpcBackend(const std::string& url, bool verbose,
                                    std::unique_ptr<ClientBackend>* backend);
 
 }  // namespace tpuperf

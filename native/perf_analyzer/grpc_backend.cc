@@ -1,0 +1,181 @@
+// Kind=TPU_GRPC: perf harness over the native gRPC client (in-tree HTTP/2
+// transport). Counterpart of the reference's protocol-switched Triton
+// backend (triton_client_backend.h:61-199 holds both HTTP and gRPC clients;
+// here each protocol is its own kind selected by -i/--service-kind).
+
+#include "client_backend.h"
+#include "tpuclient/grpc_client.h"
+
+using tpuclient::Error;
+using tpuclient::JsonPtr;
+
+namespace tpuperf {
+
+namespace {
+
+// Converts a protobuf-typed response into the in-tree JSON DOM so the
+// model parser / profiler consume one shape regardless of protocol.
+JsonPtr TensorMetaToJson(const std::string& name, const std::string& dtype,
+                         const google::protobuf::RepeatedField<int64_t>&
+                             shape) {
+  JsonPtr t = tpuclient::Json::MakeObject();
+  t->Set("name", name);
+  t->Set("datatype", dtype);
+  JsonPtr dims = tpuclient::Json::MakeArray();
+  for (int64_t d : shape) dims->Append(tpuclient::Json::MakeInt(d));
+  t->Set("shape", dims);
+  return t;
+}
+
+class GrpcClientBackend : public ClientBackend {
+ public:
+  static Error Create(const std::string& url, bool verbose,
+                      std::unique_ptr<ClientBackend>* backend) {
+    auto b = std::unique_ptr<GrpcClientBackend>(new GrpcClientBackend());
+    Error err = tpuclient::InferenceServerGrpcClient::Create(&b->client_, url,
+                                                             verbose);
+    if (!err.IsOk()) return err;
+    *backend = std::move(b);
+    return Error::Success();
+  }
+
+  Error ServerExtensions(std::vector<std::string>* extensions) override {
+    inference::ServerMetadataResponse meta;
+    Error err = client_->ServerMetadata(&meta);
+    if (!err.IsOk()) return err;
+    extensions->assign(meta.extensions().begin(), meta.extensions().end());
+    return Error::Success();
+  }
+
+  Error ModelMetadata(JsonPtr* metadata, const std::string& model_name,
+                      const std::string& version) override {
+    inference::ModelMetadataResponse meta;
+    Error err = client_->ModelMetadata(&meta, model_name, version);
+    if (!err.IsOk()) return err;
+    JsonPtr out = tpuclient::Json::MakeObject();
+    out->Set("name", meta.name());
+    out->Set("platform", meta.platform());
+    JsonPtr versions = tpuclient::Json::MakeArray();
+    for (const auto& v : meta.versions())
+      versions->Append(tpuclient::Json::MakeString(v));
+    out->Set("versions", versions);
+    JsonPtr inputs = tpuclient::Json::MakeArray();
+    for (const auto& io : meta.inputs())
+      inputs->Append(TensorMetaToJson(io.name(), io.datatype(), io.shape()));
+    out->Set("inputs", inputs);
+    JsonPtr outputs = tpuclient::Json::MakeArray();
+    for (const auto& io : meta.outputs())
+      outputs->Append(TensorMetaToJson(io.name(), io.datatype(), io.shape()));
+    out->Set("outputs", outputs);
+    *metadata = out;
+    return Error::Success();
+  }
+
+  Error ModelConfig(JsonPtr* config, const std::string& model_name,
+                    const std::string& version) override {
+    inference::ModelConfigResponse resp;
+    Error err = client_->ModelConfig(&resp, model_name, version);
+    if (!err.IsOk()) return err;
+    const inference::ModelConfig& c = resp.config();
+    JsonPtr out = tpuclient::Json::MakeObject();
+    out->Set("name", c.name());
+    out->Set("platform", c.platform());
+    out->Set("max_batch_size", int64_t(c.max_batch_size()));
+    if (c.has_dynamic_batching()) {
+      JsonPtr db = tpuclient::Json::MakeObject();
+      JsonPtr preferred = tpuclient::Json::MakeArray();
+      for (int32_t p : c.dynamic_batching().preferred_batch_size())
+        preferred->Append(tpuclient::Json::MakeInt(p));
+      db->Set("preferred_batch_size", preferred);
+      db->Set("max_queue_delay_microseconds",
+              uint64_t(c.dynamic_batching().max_queue_delay_microseconds()));
+      out->Set("dynamic_batching", db);
+    }
+    if (c.has_sequence_batching()) {
+      out->Set("sequence_batching", tpuclient::Json::MakeObject());
+    }
+    if (c.has_model_transaction_policy() &&
+        c.model_transaction_policy().decoupled()) {
+      JsonPtr mtp = tpuclient::Json::MakeObject();
+      mtp->Set("decoupled", true);
+      out->Set("model_transaction_policy", mtp);
+    }
+    if (c.has_ensemble_scheduling()) {
+      JsonPtr ens = tpuclient::Json::MakeObject();
+      JsonPtr steps = tpuclient::Json::MakeArray();
+      for (const auto& step : c.ensemble_scheduling().step()) {
+        JsonPtr s = tpuclient::Json::MakeObject();
+        s->Set("model_name", step.model_name());
+        steps->Append(s);
+      }
+      ens->Set("step", steps);
+      out->Set("ensemble_scheduling", ens);
+    }
+    *config = out;
+    return Error::Success();
+  }
+
+  Error Infer(tpuclient::InferResult** result,
+              const tpuclient::InferOptions& options,
+              const std::vector<tpuclient::InferInput*>& inputs,
+              const std::vector<const tpuclient::InferRequestedOutput*>&
+                  outputs) override {
+    return client_->Infer(result, options, inputs, outputs);
+  }
+
+  Error AsyncInfer(tpuclient::OnCompleteFn callback,
+                   const tpuclient::InferOptions& options,
+                   const std::vector<tpuclient::InferInput*>& inputs,
+                   const std::vector<const tpuclient::InferRequestedOutput*>&
+                       outputs) override {
+    return client_->AsyncInfer(std::move(callback), options, inputs, outputs);
+  }
+
+  Error ModelInferenceStatistics(std::map<std::string, ModelStatistics>* stats,
+                                 const std::string& model_name) override {
+    inference::ModelStatisticsResponse resp;
+    Error err = client_->ModelInferenceStatistics(&resp, model_name);
+    if (!err.IsOk()) return err;
+    stats->clear();
+    for (const auto& m : resp.model_stats()) {
+      ModelStatistics ms;
+      ms.inference_count = m.inference_count();
+      ms.execution_count = m.execution_count();
+      ms.success_count = m.inference_stats().success().count();
+      ms.cumulative_request_time_ns = m.inference_stats().success().ns();
+      ms.queue_time_ns = m.inference_stats().queue().ns();
+      ms.compute_input_time_ns = m.inference_stats().compute_input().ns();
+      ms.compute_infer_time_ns = m.inference_stats().compute_infer().ns();
+      ms.compute_output_time_ns = m.inference_stats().compute_output().ns();
+      (*stats)[m.name()] = ms;
+    }
+    return Error::Success();
+  }
+
+  Error ClientInferStat(tpuclient::InferStat* stat) override {
+    return client_->ClientInferStat(stat);
+  }
+
+  Error RegisterSystemSharedMemory(const std::string& name,
+                                   const std::string& key,
+                                   size_t byte_size) override {
+    return client_->RegisterSystemSharedMemory(name, key, byte_size);
+  }
+
+  Error UnregisterSystemSharedMemory(const std::string& name) override {
+    return client_->UnregisterSystemSharedMemory(name);
+  }
+
+ private:
+  GrpcClientBackend() = default;
+  std::unique_ptr<tpuclient::InferenceServerGrpcClient> client_;
+};
+
+}  // namespace
+
+Error CreateGrpcBackend(const std::string& url, bool verbose,
+                        std::unique_ptr<ClientBackend>* backend) {
+  return GrpcClientBackend::Create(url, verbose, backend);
+}
+
+}  // namespace tpuperf

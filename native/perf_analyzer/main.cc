@@ -29,8 +29,9 @@ void Usage(const char* msg = nullptr) {
 Options:
   -m <name>              model name (required)
   -x <version>           model version
-  -u <url>               server url (default localhost:8000)
-  -i <protocol>          protocol: http (default)
+  -u <url>               server url (default localhost:8000 http,
+                         localhost:8001 grpc)
+  -i <protocol>          protocol: http (default) | grpc
   -b <n>                 batch size (default 1)
   -a                     async mode
   --concurrency-range <start:end:step>
@@ -54,7 +55,8 @@ Options:
   --shared-memory <none|system>   tensor transport (default none)
   --output-shared-memory-size <bytes>
   --max-threads <n>      worker thread cap (default 16)
-  --service-kind <tpu_http|tpu_capi>   endpoint kind (default tpu_http);
+  --service-kind <tpu_http|tpu_grpc|tpu_capi>  endpoint kind (default
+                         tpu_http; -i grpc implies tpu_grpc);
                          tpu_capi runs the engine in-process via
                          libtpuserver.so — no network, sync only
   --capi-library-path <path>   libtpuserver.so location
@@ -72,6 +74,7 @@ struct Args {
   std::string model;
   std::string version;
   std::string url = "localhost:8000";
+  bool url_set = false;
   std::string protocol = "http";
   int batch_size = 1;
   bool async = false;
@@ -224,7 +227,7 @@ int main(int argc, char** argv) {
     switch (opt) {
       case 'm': args.model = optarg; break;
       case 'x': args.version = optarg; break;
-      case 'u': args.url = optarg; break;
+      case 'u': args.url = optarg; args.url_set = true; break;
       case 'i': args.protocol = optarg; break;
       case 'b': args.batch_size = atoi(optarg); break;
       case 'a': args.async = true; break;
@@ -298,8 +301,10 @@ int main(int argc, char** argv) {
       case 1016: args.max_threads = strtoull(optarg, nullptr, 10); break;
       case 1017:
         if (strcmp(optarg, "tpu_capi") == 0) args.kind = BackendKind::TPU_CAPI;
+        else if (strcmp(optarg, "tpu_grpc") == 0)
+          args.kind = BackendKind::TPU_GRPC;
         else if (strcmp(optarg, "tpu_http") != 0)
-          Usage("--service-kind must be tpu_http|tpu_capi");
+          Usage("--service-kind must be tpu_http|tpu_grpc|tpu_capi");
         break;
       case 1018: args.capi_lib = optarg; break;
       case 1019: args.capi_models = optarg; break;
@@ -308,7 +313,12 @@ int main(int argc, char** argv) {
     }
   }
   if (args.model.empty()) Usage("-m <model> is required");
-  if (args.protocol != "http") Usage("only -i http is available");
+  if (args.protocol == "grpc") {
+    if (args.kind == BackendKind::TPU_HTTP) args.kind = BackendKind::TPU_GRPC;
+    if (!args.url_set) args.url = "localhost:8001";
+  } else if (args.protocol != "http") {
+    Usage("-i must be http or grpc");
+  }
   if (args.kind == BackendKind::TPU_CAPI) {
     // Same restrictions as the reference's C-API kind (main.cc:1227-1248):
     // in-process path is sync-only and has no shm control plane (in-process

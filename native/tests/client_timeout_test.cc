@@ -1,0 +1,192 @@
+// Client-timeout integration test: sync/async/streaming infer over both
+// protocols with microsecond client deadlines must surface timeout errors
+// (status 499), and generous deadlines must succeed with validated values.
+//
+// Reference counterpart: client_timeout_test.cc:391 (drives model `simple`
+// over HTTP+gRPC with tiny timeouts, asserting "Deadline Exceeded";
+// ValidateShapeAndDatatype/ValidateResult oracle at :48-103).
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstdint>
+#include <iostream>
+#include <mutex>
+
+#include "tpuclient/grpc_client.h"
+#include "tpuclient/http_client.h"
+
+namespace tc = tpuclient;
+
+static int failures = 0;
+#define CHECK(cond, what)                                   \
+  do {                                                      \
+    if (!(cond)) {                                          \
+      std::cerr << "FAIL: " << what << std::endl;           \
+      ++failures;                                           \
+    }                                                       \
+  } while (false)
+
+namespace {
+
+std::vector<int32_t> g_input0(16), g_input1(16);
+
+void BuildInputs(tc::InferInput** input0, tc::InferInput** input1) {
+  for (int i = 0; i < 16; ++i) {
+    g_input0[i] = i;
+    g_input1[i] = 1;
+  }
+  tc::InferInput::Create(input0, "INPUT0", {1, 16}, "INT32");
+  tc::InferInput::Create(input1, "INPUT1", {1, 16}, "INT32");
+  (*input0)->AppendRaw(reinterpret_cast<uint8_t*>(g_input0.data()),
+                       16 * sizeof(int32_t));
+  (*input1)->AppendRaw(reinterpret_cast<uint8_t*>(g_input1.data()),
+                       16 * sizeof(int32_t));
+}
+
+// Validates OUTPUT0=a+b on a successful result (reference ValidateResult).
+bool ValidateResult(tc::InferResult* result) {
+  if (!result->RequestStatus().IsOk()) return false;
+  std::vector<int64_t> shape;
+  std::string dtype;
+  if (!result->Shape("OUTPUT0", &shape).IsOk() ||
+      !result->Datatype("OUTPUT0", &dtype).IsOk()) {
+    return false;
+  }
+  if (shape != std::vector<int64_t>({1, 16}) || dtype != "INT32") {
+    return false;
+  }
+  const uint8_t* buf;
+  size_t n;
+  if (!result->RawData("OUTPUT0", &buf, &n).IsOk() ||
+      n != 16 * sizeof(int32_t)) {
+    return false;
+  }
+  const int32_t* vals = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) {
+    if (vals[i] != g_input0[i] + g_input1[i]) return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string http_url = "localhost:8000";
+  std::string grpc_url = "localhost:8001";
+  int opt;
+  while ((opt = getopt(argc, argv, "u:g:")) != -1) {
+    if (opt == 'u') http_url = optarg;
+    if (opt == 'g') grpc_url = optarg;
+  }
+
+  tc::InferInput *input0, *input1;
+  BuildInputs(&input0, &input1);
+  std::unique_ptr<tc::InferInput> i0(input0), i1(input1);
+
+  // ---- HTTP sync: tiny timeout fails with 499, generous succeeds --------
+  {
+    std::unique_ptr<tc::InferenceServerHttpClient> client;
+    CHECK(tc::InferenceServerHttpClient::Create(&client, http_url).IsOk(),
+          "http client create");
+    tc::InferOptions options("simple");
+    options.client_timeout_us = 1;  // microsecond deadline: must fail
+    tc::InferResult* result = nullptr;
+    tc::Error err = client->Infer(&result, options, {input0, input1});
+    CHECK(!err.IsOk() && err.StatusCode() == 499,
+          "http sync tiny timeout -> 499 (got " + err.Message() + ")");
+    delete result;
+
+    options.client_timeout_us = 60 * 1000 * 1000;
+    result = nullptr;
+    err = client->Infer(&result, options, {input0, input1});
+    CHECK(err.IsOk(), "http sync generous timeout succeeds");
+    if (err.IsOk()) {
+      CHECK(ValidateResult(result), "http sync result values");
+      delete result;
+    }
+  }
+
+  // ---- gRPC sync ---------------------------------------------------------
+  {
+    std::unique_ptr<tc::InferenceServerGrpcClient> client;
+    CHECK(tc::InferenceServerGrpcClient::Create(&client, grpc_url).IsOk(),
+          "grpc client create");
+    tc::InferOptions options("simple");
+    options.client_timeout_us = 1;
+    tc::InferResult* result = nullptr;
+    tc::Error err = client->Infer(&result, options, {input0, input1});
+    CHECK(!err.IsOk() && err.StatusCode() == 499,
+          "grpc sync tiny timeout -> 499 (got " + err.Message() + ")");
+    delete result;
+
+    options.client_timeout_us = 60 * 1000 * 1000;
+    result = nullptr;
+    err = client->Infer(&result, options, {input0, input1});
+    CHECK(err.IsOk(), "grpc sync generous timeout succeeds");
+    if (err.IsOk()) {
+      CHECK(ValidateResult(result), "grpc sync result values");
+      delete result;
+    }
+  }
+
+  // ---- gRPC async: generous deadline completes with valid values --------
+  {
+    std::unique_ptr<tc::InferenceServerGrpcClient> client;
+    CHECK(tc::InferenceServerGrpcClient::Create(&client, grpc_url).IsOk(),
+          "grpc async client create");
+    tc::InferOptions options("simple");
+    options.client_timeout_us = 60 * 1000 * 1000;
+    std::mutex mtx;
+    std::condition_variable cv;
+    bool done = false, ok = false;
+    tc::Error err = client->AsyncInfer(
+        [&](tc::InferResult* result) {
+          std::unique_ptr<tc::InferResult> owner(result);
+          std::lock_guard<std::mutex> lk(mtx);
+          ok = ValidateResult(result);
+          done = true;
+          cv.notify_all();
+        },
+        options, {input0, input1});
+    CHECK(err.IsOk(), "grpc async submit");
+    std::unique_lock<std::mutex> lk(mtx);
+    CHECK(cv.wait_for(lk, std::chrono::seconds(120), [&] { return done; }),
+          "grpc async completion");
+    CHECK(ok, "grpc async result values");
+  }
+
+  // ---- gRPC streaming: request on stream completes and validates --------
+  {
+    std::unique_ptr<tc::InferenceServerGrpcClient> client;
+    CHECK(tc::InferenceServerGrpcClient::Create(&client, grpc_url).IsOk(),
+          "grpc stream client create");
+    std::mutex mtx;
+    std::condition_variable cv;
+    bool done = false, ok = false;
+    tc::Error err = client->StartStream([&](tc::InferResult* result) {
+      std::unique_ptr<tc::InferResult> owner(result);
+      std::lock_guard<std::mutex> lk(mtx);
+      ok = ValidateResult(result);
+      done = true;
+      cv.notify_all();
+    });
+    CHECK(err.IsOk(), "grpc stream start");
+    tc::InferOptions options("simple");
+    CHECK(client->AsyncStreamInfer(options, {input0, input1}).IsOk(),
+          "grpc stream submit");
+    {
+      std::unique_lock<std::mutex> lk(mtx);
+      CHECK(cv.wait_for(lk, std::chrono::seconds(120), [&] { return done; }),
+            "grpc stream completion");
+      CHECK(ok, "grpc stream result values");
+    }
+    client->StopStream();
+  }
+
+  if (failures == 0) {
+    std::cout << "PASS : client_timeout_test" << std::endl;
+    return 0;
+  }
+  std::cerr << failures << " FAILURES" << std::endl;
+  return 1;
+}

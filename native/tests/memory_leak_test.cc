@@ -1,0 +1,142 @@
+// Memory-leak integration test: many inferences with or without object
+// reuse; fails if process RSS keeps climbing after steady state.
+//
+// Reference counterpart: memory_leak_test.cc:301 (`repetitions` inferences
+// with optional object `reuse`, RunSynchronousInference :109-175), paired
+// with the Python memory_growth_test.
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <iostream>
+#include <vector>
+
+#include "tpuclient/grpc_client.h"
+#include "tpuclient/http_client.h"
+
+namespace tc = tpuclient;
+
+namespace {
+
+long RssKb() {
+  FILE* f = fopen("/proc/self/status", "r");
+  if (f == nullptr) return 0;
+  char line[256];
+  long kb = 0;
+  while (fgets(line, sizeof(line), f) != nullptr) {
+    if (strncmp(line, "VmRSS:", 6) == 0) {
+      sscanf(line + 6, "%ld", &kb);
+      break;
+    }
+  }
+  fclose(f);
+  return kb;
+}
+
+template <typename Client>
+int RunLoop(Client* client, int repetitions, bool reuse, long max_growth_kb,
+            const char* label) {
+  std::vector<int32_t> a(16), b(16);
+  for (int i = 0; i < 16; ++i) {
+    a[i] = i;
+    b[i] = 1;
+  }
+
+  auto make_inputs = [&](tc::InferInput** i0, tc::InferInput** i1) {
+    tc::InferInput::Create(i0, "INPUT0", {1, 16}, "INT32");
+    tc::InferInput::Create(i1, "INPUT1", {1, 16}, "INT32");
+    (*i0)->AppendRaw(reinterpret_cast<uint8_t*>(a.data()), 64);
+    (*i1)->AppendRaw(reinterpret_cast<uint8_t*>(b.data()), 64);
+  };
+
+  tc::InferInput *ri0 = nullptr, *ri1 = nullptr;
+  if (reuse) make_inputs(&ri0, &ri1);
+  tc::InferOptions options("simple");
+
+  auto one = [&]() -> bool {
+    tc::InferInput *i0 = ri0, *i1 = ri1;
+    if (!reuse) make_inputs(&i0, &i1);
+    tc::InferResult* result = nullptr;
+    tc::Error err = client->Infer(&result, options, {i0, i1});
+    bool ok = err.IsOk() && result->RequestStatus().IsOk();
+    delete result;
+    if (!reuse) {
+      delete i0;
+      delete i1;
+    }
+    return ok;
+  };
+
+  // Warmup to allocator steady state, then measure.
+  for (int i = 0; i < 100; ++i) {
+    if (!one()) {
+      std::cerr << label << ": warmup inference failed" << std::endl;
+      return 1;
+    }
+  }
+  long base = RssKb();
+  for (int i = 0; i < repetitions; ++i) {
+    if (!one()) {
+      std::cerr << label << ": inference " << i << " failed" << std::endl;
+      return 1;
+    }
+  }
+  long growth = RssKb() - base;
+  std::cout << label << " (reuse=" << reuse << "): RSS growth " << growth
+            << " kB over " << repetitions << " inferences" << std::endl;
+  if (growth > max_growth_kb) {
+    std::cerr << label << ": FAIL, growth " << growth << " kB > "
+              << max_growth_kb << " kB" << std::endl;
+    return 1;
+  }
+  if (reuse) {
+    delete ri0;
+    delete ri1;
+  }
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string http_url = "localhost:8000";
+  std::string grpc_url = "localhost:8001";
+  int repetitions = 1000;
+  long max_growth_kb = 20 * 1024;
+  int opt;
+  while ((opt = getopt(argc, argv, "u:g:r:")) != -1) {
+    if (opt == 'u') http_url = optarg;
+    if (opt == 'g') grpc_url = optarg;
+    if (opt == 'r') repetitions = atoi(optarg);
+  }
+
+  int rc = 0;
+  {
+    std::unique_ptr<tc::InferenceServerHttpClient> client;
+    if (!tc::InferenceServerHttpClient::Create(&client, http_url).IsOk()) {
+      std::cerr << "http client create failed" << std::endl;
+      return 1;
+    }
+    rc |= RunLoop(client.get(), repetitions, /*reuse=*/true, max_growth_kb,
+                  "http");
+    rc |= RunLoop(client.get(), repetitions, /*reuse=*/false, max_growth_kb,
+                  "http");
+  }
+  {
+    std::unique_ptr<tc::InferenceServerGrpcClient> client;
+    if (!tc::InferenceServerGrpcClient::Create(&client, grpc_url).IsOk()) {
+      std::cerr << "grpc client create failed" << std::endl;
+      return 1;
+    }
+    rc |= RunLoop(client.get(), repetitions, /*reuse=*/true, max_growth_kb,
+                  "grpc");
+    rc |= RunLoop(client.get(), repetitions, /*reuse=*/false, max_growth_kb,
+                  "grpc");
+  }
+
+  if (rc == 0) {
+    std::cout << "PASS : memory_leak_test" << std::endl;
+  }
+  return rc;
+}

@@ -70,7 +70,7 @@ def test_unit_tests(native_build):
 @pytest.fixture(scope="module")
 def grpc_server():
     eng = TpuEngine(build_repository(
-        ["simple", "simple_string", "simple_sequence"]))
+        ["simple", "simple_string", "simple_sequence", "resnet50"]))
     srv = GrpcInferenceServer(eng, port=0).start()
     yield srv
     srv.stop()
@@ -115,6 +115,80 @@ def test_perf_analyzer_smoke(native_build, server, tmp_path):
     row = lines[1].split(",")
     ips = float(row[header.index("Inferences/Second")])
     assert ips > 0
+
+
+def test_client_timeout_binary(native_build, server, grpc_server):
+    """Reference test parity: client_timeout_test drives sync/async/stream
+    over both protocols with microsecond and generous deadlines
+    (reference src/c++/tests/client_timeout_test.cc:391)."""
+    proc = subprocess.run(
+        [os.path.join(native_build, "client_timeout_test"),
+         "-u", server.url, "-g", f"127.0.0.1:{grpc_server.port}"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
+
+
+def test_memory_leak_binary(native_build, server, grpc_server):
+    """Reference test parity: memory_leak_test loops inferences with and
+    without object reuse, bounding RSS growth (reference
+    memory_leak_test.cc:301)."""
+    proc = subprocess.run(
+        [os.path.join(native_build, "memory_leak_test"),
+         "-u", server.url, "-g", f"127.0.0.1:{grpc_server.port}",
+         "-r", "300"],
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
+
+
+def test_reuse_infer_objects_binary(native_build, server, grpc_server):
+    proc = subprocess.run(
+        [os.path.join(native_build, "reuse_infer_objects_client"),
+         "-u", server.url, "-g", f"127.0.0.1:{grpc_server.port}", "-n", "8"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
+
+
+def test_model_control_binary(native_build, grpc_server):
+    proc = subprocess.run(
+        [os.path.join(native_build, "simple_grpc_model_control"),
+         "-u", f"127.0.0.1:{grpc_server.port}"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
+
+
+def test_image_client_binary(native_build, grpc_server):
+    """image_client over gRPC with the classification extension, batch 2."""
+    proc = subprocess.run(
+        [os.path.join(native_build, "image_client"),
+         "-u", f"127.0.0.1:{grpc_server.port}", "-i", "grpc",
+         "-m", "resnet50", "-b", "2", "-c", "3"],
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Image 1:" in proc.stdout
+
+
+def test_perf_analyzer_grpc_smoke(native_build, grpc_server, tmp_path):
+    """tpu_perf_analyzer -i grpc: async concurrency sweep over the native
+    gRPC client against the grpcio server (reference protocol-switched
+    backend, triton_client_backend.h:61-199)."""
+    csv = tmp_path / "perf_grpc.csv"
+    proc = subprocess.run(
+        [os.path.join(native_build, "tpu_perf_analyzer"),
+         "-m", "simple", "-i", "grpc", "-u",
+         f"127.0.0.1:{grpc_server.port}", "-a",
+         "-p", "600", "-r", "6", "-s", "70",
+         "--concurrency-range", "4:4", "-f", str(csv)],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Throughput" in proc.stdout
+    assert "Inference count" in proc.stdout  # server stats over gRPC too
+    header, row = [ln.split(",") for ln in
+                   csv.read_text().strip().splitlines()[:2]]
+    assert float(row[header.index("Inferences/Second")]) > 0
 
 
 def test_perf_analyzer_capi_inprocess(native_build, tmp_path):
