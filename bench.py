@@ -1,22 +1,57 @@
 """Benchmark entry point — prints ONE JSON line with the headline metric.
 
-Round-1 metric: in-process engine throughput (infer/sec) on the `simple`
-INT32[16] add/sub conformance model with dynamic batching, concurrency 32 —
-the C-API-style no-network path (reference perf_analyzer's TRITON_C_API
-mode, SURVEY.md §3.5). Later rounds move to the BASELINE.md north star:
-perf_analyzer ips + p99 on ssd_mobilenet_v2 with tpu-shm I/O.
+Headline: in-process engine throughput (infer/sec) on the `simple` INT32[16]
+add/sub conformance model with dynamic batching, concurrency 32 — the
+C-API-style no-network path (reference perf_analyzer's TRITON_C_API mode,
+SURVEY.md §3.5). Also measures flagship BERT-base batch-8 step time and MFU
+(achieved FLOP/s vs. chip peak) so "actually fast" has a denominator.
 
-The baseline reference publishes no numbers (BASELINE.md), so vs_baseline is
-reported against the best previously recorded value of this same metric in
-BENCH_HISTORY.json (1.0 on first run).
+All progress goes to stderr: backend-init seconds, per-bucket compile times,
+phase transitions. The JSON line on stdout is the only stdout output.
+Reference metric definition: inferences/sec over a stable window
+(/root/reference/src/c++/perf_analyzer/inference_profiler.cc:793-835).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 import time
+
+_T0 = time.monotonic()
+
+
+def log(msg: str) -> None:
+    print(f"[bench +{time.monotonic() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+# bf16 peak FLOP/s per chip by TPU generation (public spec sheets).
+_PEAK_FLOPS = {"v5e": 197e12, "v5litepod": 197e12, "v4": 275e12,
+               "v5p": 459e12, "v6e": 918e12}
+
+
+def peak_flops() -> float | None:
+    env = os.environ.get("BENCH_PEAK_FLOPS")
+    if env:
+        return float(env)
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    return _PEAK_FLOPS.get(gen)
+
+
+def preflight():
+    """Eager, logged, main-thread backend init (round-1 fix: this used to
+    happen lazily on a scheduler worker thread and hang invisibly)."""
+    log(f"preflight: initializing JAX backend "
+        f"(JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', 'auto')})...")
+    from client_tpu.engine.backend_init import ensure_backend, init_seconds
+
+    devices = ensure_backend()
+    log(f"preflight: backend up in {init_seconds():.1f}s — "
+        f"{len(devices)}x {devices[0].platform}")
+    return devices
 
 
 def bench_inproc_simple(duration_s: float = 5.0, concurrency: int = 32):
@@ -25,7 +60,10 @@ def bench_inproc_simple(duration_s: float = 5.0, concurrency: int = 32):
     from client_tpu.engine import InferRequest, TpuEngine
     from client_tpu.models import build_repository
 
-    engine = TpuEngine(build_repository(["simple"]))
+    log("building engine (simple model, warmup=True pre-compiles buckets)...")
+    t0 = time.monotonic()
+    engine = TpuEngine(build_repository(["simple"]), warmup=True)
+    log(f"engine ready (load+warmup {time.monotonic() - t0:.1f}s)")
 
     a = np.arange(16, dtype=np.int32).reshape(1, 16)
     b = np.ones((1, 16), dtype=np.int32)
@@ -34,9 +72,12 @@ def bench_inproc_simple(duration_s: float = 5.0, concurrency: int = 32):
         return InferRequest(model_name="simple",
                             inputs={"INPUT0": a, "INPUT1": b})
 
-    # warmup (compile every bucket)
+    log("warmup inferences (8x batch-1 through the full engine path)...")
+    t0 = time.monotonic()
     for _ in range(8):
-        engine.infer(make_req(), timeout_s=120)
+        engine.infer(make_req(), timeout_s=300)
+    log(f"warmup done ({time.monotonic() - t0:.1f}s); "
+        f"measuring {duration_s}s at concurrency {concurrency}")
 
     stop = time.monotonic() + duration_s
     counts = [0] * concurrency
@@ -66,36 +107,107 @@ def bench_inproc_simple(duration_s: float = 5.0, concurrency: int = 32):
 
     lat_ns.sort()
     p99 = lat_ns[int(len(lat_ns) * 0.99) - 1] / 1e3 if lat_ns else 0.0
+    log(f"simple: {total} inferences in {elapsed:.2f}s = "
+        f"{total / elapsed:.1f} ips, p99 {p99:.0f}us")
     return total / elapsed, p99
 
 
+def bert_flops_per_example(seq_len=128, hidden=768, n_layers=12, ffn=3072):
+    """Analytic forward FLOPs for one BERT-base example (2*MAC convention):
+    per layer 4 QKVO projections + 2 attention einsums + 2 FFN matmuls."""
+    s, h, f = seq_len, hidden, ffn
+    per_layer = 8 * s * h * h + 4 * s * s * h + 4 * s * h * f
+    return n_layers * per_layer
+
+
+def bench_bert_mfu(batch: int = 8, iters: int = 30):
+    """Flagship step time at the Model level (no scheduler) — pure
+    stage+execute+fetch of BERT-base batch 8, the denominator for MFU."""
+    import numpy as np
+
+    from client_tpu.engine.model import Model
+    from client_tpu.models.bert import BertBackend
+
+    log("building BERT-base (random weights, bf16)...")
+    backend = BertBackend(max_batch_size=batch)
+    backend.config.batch_buckets = [batch]  # only compile the bucket we time
+    model = Model(backend)
+    ids = np.random.randint(0, 30522, size=(batch, 128), dtype=np.int32)
+    mask = np.ones((batch, 128), dtype=np.int32)
+    inputs = {"input_ids": ids, "attention_mask": mask}
+
+    t0 = time.monotonic()
+    model.execute(inputs, batch_size=batch)  # compile
+    log(f"bert: bucket={batch} compiled+run in {time.monotonic() - t0:.1f}s")
+
+    times = []
+    for _ in range(iters):
+        _, phases = model.execute_timed(inputs, batch_size=batch)
+        times.append((phases.output_end - phases.start) / 1e9)
+    times.sort()
+    # median end-to-end (stage+infer+fetch) — what serving actually gets
+    step = times[len(times) // 2]
+    flops = bert_flops_per_example() * batch
+    achieved = flops / step
+    peak = peak_flops()
+    mfu = achieved / peak if peak else None
+    log(f"bert: median step {step * 1e3:.2f}ms, achieved "
+        f"{achieved / 1e12:.2f} TFLOP/s"
+        + (f", MFU {mfu * 100:.1f}% of {peak / 1e12:.0f} TFLOP/s peak"
+           if peak else " (no peak known for platform; MFU omitted)"))
+    return batch / step, mfu, step
+
+
 def main():
+    devices = preflight()
+    platform = devices[0].platform
     ips, p99_us = bench_inproc_simple()
+    try:
+        bert_ips, mfu, bert_step_s = bench_bert_mfu()
+    except Exception as exc:  # noqa: BLE001 — headline metric still reports
+        log(f"bert mfu measurement failed: {exc!r}")
+        bert_ips, mfu, bert_step_s = None, None, None
 
     hist_path = os.path.join(os.path.dirname(__file__), "BENCH_HISTORY.json")
-    best = None
     try:
         with open(hist_path) as f:
             hist = json.load(f)
-        best = max(h["value"] for h in hist
-                   if h.get("metric") == "inproc_simple_ips")
+        if not isinstance(hist, list):
+            hist = []
     except Exception:  # noqa: BLE001 — first run
         hist = []
+    # vs_baseline compares only same-platform runs — a CPU dev-box number is
+    # not a baseline for the TPU chip or vice versa. Entries without a
+    # platform tag (or malformed ones) are excluded rather than grandfathered.
+    best = max((h["value"] for h in hist
+                if isinstance(h, dict)
+                and h.get("metric") == "inproc_simple_ips"
+                and isinstance(h.get("value"), (int, float))
+                and h.get("platform") == platform),
+               default=None)
     vs = ips / best if best else 1.0
     hist.append({"metric": "inproc_simple_ips", "value": ips,
-                 "p99_us": p99_us, "ts": time.time()})
+                 "p99_us": p99_us, "bert_ips": bert_ips, "mfu": mfu,
+                 "platform": platform, "ts": time.time()})
     try:
         with open(hist_path, "w") as f:
             json.dump(hist, f, indent=1)
     except OSError:
         pass
 
-    print(json.dumps({
+    out = {
         "metric": "inproc_simple_ips",
         "value": round(ips, 2),
         "unit": "infer/sec",
         "vs_baseline": round(vs, 4),
-    }))
+        "p99_us": round(p99_us, 1),
+    }
+    if bert_ips is not None:
+        out["bert_b8_ips"] = round(bert_ips, 2)
+        out["bert_b8_step_ms"] = round(bert_step_s * 1e3, 3)
+    if mfu is not None:
+        out["bert_b8_mfu"] = round(mfu, 4)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,100 @@
+"""Eager, logged JAX backend initialization.
+
+Round-1 postmortem: the first ``jax.device_put`` used to happen lazily on a
+scheduler *worker* thread, so PjRt client creation (20s+ on a contended TPU)
+ran invisibly inside the first inference, and callers saw only a bare 504
+timeout with no way to distinguish "compiling" from "dead".  The fix is to
+initialize the backend eagerly on the *calling* (normally main) thread, with
+progress logged to stderr, before any scheduler thread exists.
+
+``ensure_backend`` is idempotent and thread-safe; ``TpuEngine.__init__`` and
+``bench.py`` both call it first thing.  A watchdog thread logs every few
+seconds while PjRt initialization is in flight so a hang is visible and
+attributable (a hung native call cannot be interrupted from Python, so past
+``hard_timeout_s`` the watchdog escalates its log level rather than raising
+into a stack that could not unwind anyway).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+log = logging.getLogger("client_tpu.engine")
+if not log.handlers:  # default to visible stderr progress; apps may override
+    _h = logging.StreamHandler()
+    _h.setFormatter(logging.Formatter("[client_tpu] %(asctime)s %(message)s"))
+    log.addHandler(_h)
+    log.setLevel(os.environ.get("CLIENT_TPU_LOGLEVEL", "INFO"))
+
+_lock = threading.Lock()
+_devices: list | None = None
+_init_seconds: float | None = None
+
+
+def backend_ready() -> bool:
+    return _devices is not None
+
+
+def init_seconds() -> float | None:
+    """Wall seconds the PjRt client took to come up (None before init)."""
+    return _init_seconds
+
+
+def ensure_backend(hard_timeout_s: float = 300.0) -> list:
+    """Initialize the JAX backend on the calling thread, with progress logs.
+
+    Returns ``jax.devices()``.  Safe to call repeatedly/concurrently; only the
+    first call pays the cost.  The reference counterpart is tritonserver's
+    eager CUDA context creation at server start (the piece the reference
+    dlopens; our engine owns it, SURVEY.md §7 step 3).
+    """
+    global _devices, _init_seconds
+    if _devices is not None:
+        return _devices
+    with _lock:
+        if _devices is not None:
+            return _devices
+        t0 = time.monotonic()
+        done = threading.Event()
+
+        def _watchdog() -> None:
+            warned_hard = False
+            while not done.wait(5.0):
+                waited = time.monotonic() - t0
+                if waited > hard_timeout_s and not warned_hard:
+                    warned_hard = True
+                    log.error(
+                        "JAX backend init exceeded %.0fs — the PjRt plugin "
+                        "is likely hung or the chip is held by another "
+                        "process; thread stuck in make_c_api_client",
+                        hard_timeout_s)
+                else:
+                    log.info("JAX backend still initializing (%.0fs)...",
+                             waited)
+
+        wd = threading.Thread(target=_watchdog, name="jax-init-watchdog",
+                              daemon=True)
+        wd.start()
+        try:
+            import jax
+
+            # The runtime image pre-imports jax from a sitecustomize hook
+            # that registers the TPU plugin, so JAX_PLATFORMS in the env is
+            # not always enough to restrict platform selection — force it
+            # through jax.config too (same workaround as tests/conftest.py).
+            plat = os.environ.get("JAX_PLATFORMS")
+            if plat:
+                jax.config.update("jax_platforms", plat)
+            log.info("initializing JAX backend (platform=%s)...",
+                     plat or "auto")
+            devices = jax.devices()
+        finally:
+            done.set()
+        _init_seconds = time.monotonic() - t0
+        _devices = devices
+        log.info("JAX backend ready in %.1fs: %d device(s), platform=%s",
+                 _init_seconds, len(devices), devices[0].platform)
+        return devices

@@ -43,7 +43,15 @@ SERVER_EXTENSIONS = [
 class TpuEngine:
     def __init__(self, repository: ModelRepository | None = None, *,
                  jit: bool = True, warmup: bool = False,
-                 load_all: bool = True):
+                 load_all: bool = True, eager_init: bool = True):
+        if eager_init and jit:
+            # Pay PjRt client creation here, on the constructing thread, with
+            # progress logged — never lazily inside a scheduler worker where
+            # a slow TPU attach is indistinguishable from a hang (round-1
+            # failure mode: first device_put on a daemon thread → opaque 504).
+            from client_tpu.engine.backend_init import ensure_backend
+
+            ensure_backend()
         self.repository = repository or ModelRepository(jit=jit)
         self._schedulers: dict[str, Scheduler] = {}
         self._stats: dict[str, ModelStats] = {}
@@ -147,8 +155,20 @@ class TpuEngine:
             sched.stop()
         self.repository.unload(name)
         for dep in dependents:
-            if dep != name:
+            if dep != name and not self._referenced_by_loaded_ensemble(dep):
                 self.unload_model(dep, unload_dependents=True)
+
+    def _referenced_by_loaded_ensemble(self, name: str) -> bool:
+        """A composing model shared by several ensembles survives until its
+        last referencing ensemble unloads (round-1 bug: unload_dependents
+        tore shared components out from under still-loaded ensembles)."""
+        with self._lock:
+            scheds = list(self._schedulers.values())
+        for sched in scheds:
+            for step in sched.model.config.ensemble_scheduling:
+                if step.model_name == name:
+                    return True
+        return False
 
     def repository_index(self) -> list[dict]:
         return self.repository.index()
@@ -209,7 +229,29 @@ class TpuEngine:
 
         self.async_infer(req, _cb)
         if not done.wait(timeout=timeout_s):
-            raise EngineError("inference timed out", 504)
+            # Attribute the timeout: a first-request XLA compile and a dead
+            # backend look identical from the caller; the model's live
+            # execution state distinguishes them. Ensembles execute through
+            # their composing models' schedulers, so report those states.
+            state = "unknown"
+            with self._lock:
+                sched = self._schedulers.get(req.model_name)
+            if sched is not None:
+                steps = sched.model.config.ensemble_scheduling
+                if steps:
+                    parts = []
+                    for step in steps:
+                        m = self.repository.get(step.model_name)
+                        if m is not None and m.state != "idle":
+                            parts.append(f"{step.model_name}: {m.state}")
+                    state = "; ".join(parts) if parts else "idle (ensemble)"
+                else:
+                    state = sched.model.state
+            raise EngineError(
+                f"inference timed out after {timeout_s}s "
+                f"(model '{req.model_name}' state: {state}; first requests "
+                "pay XLA compilation — warm up with TpuEngine(warmup=True) "
+                "or Model.warmup())", 504)
         resp = box[0]
         if resp.error is not None:
             raise resp.error

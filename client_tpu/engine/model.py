@@ -20,13 +20,32 @@ Backends implement the small :class:`ModelBackend` protocol; the model zoo in
 from __future__ import annotations
 
 import threading
+from dataclasses import dataclass
 from typing import Any, Callable, Iterator
 
 import numpy as np
 
+from client_tpu.engine.backend_init import log as _log
 from client_tpu.engine.config import ModelConfig
-from client_tpu.engine.types import EngineError
+from client_tpu.engine.types import EngineError, now_ns
 from client_tpu.protocol.dtypes import wire_to_np_dtype
+
+
+@dataclass
+class ExecPhases:
+    """Absolute-ns boundaries of one execution's three device phases.
+
+    Measured (not fabricated): staging blocks until inputs are committed to
+    HBM, infer blocks until the executable finishes, fetch covers the D2H
+    copies.  This is the per-execution truth behind the statistics RPC's
+    compute_input / compute_infer / compute_output split (reference
+    inference_profiler.cc:836-908 differences these per window).
+    """
+
+    start: int = 0        # staging begins (device_put)
+    input_end: int = 0    # inputs resident in HBM
+    infer_end: int = 0    # XLA executable complete
+    output_end: int = 0   # outputs on host (or staged to shm)
 
 
 class ModelBackend:
@@ -69,6 +88,23 @@ class Model:
             self._jitted = jit and jittable
             self._apply = jax.jit(apply_fn) if self._jitted else apply_fn
         self._jax = jax
+        # Live execution states for timeout diagnostics ("compiling" vs
+        # "dead"), keyed by executing thread so concurrent instances don't
+        # clobber each other (dict ops are GIL-atomic). Read via `.state`.
+        self._states: dict[int, str] = {}
+        self._compiled: set = set()  # input-signature tuples already traced
+
+    @property
+    def state(self) -> str:
+        """Summary of in-flight executions ('idle' when none)."""
+        active = list(self._states.values())
+        return "; ".join(active) if active else "idle"
+
+    def _set_state(self, s: str) -> None:
+        self._states[threading.get_ident()] = s
+
+    def _clear_state(self) -> None:
+        self._states.pop(threading.get_ident(), None)
 
     # -- shape/validation helpers -------------------------------------------
 
@@ -128,58 +164,98 @@ class Model:
 
     def execute(self, inputs: dict[str, np.ndarray],
                 batch_size: int | None = None) -> dict[str, np.ndarray]:
+        """Run one (possibly padded) batch; see :meth:`execute_timed`."""
+        outputs, _ = self.execute_timed(inputs, batch_size=batch_size)
+        return outputs
+
+    def execute_timed(
+        self, inputs: dict[str, np.ndarray], batch_size: int | None = None,
+    ) -> tuple[dict[str, np.ndarray], ExecPhases]:
         """Run one (possibly padded) batch through the jitted executable.
 
         ``batch_size``: true batch before padding; outputs are sliced back.
-        Timing of the three compute phases is the caller's job (scheduler) —
-        this method just stages, runs, and fetches.
+        Returns the outputs plus measured :class:`ExecPhases` — each phase is
+        bounded by a real device sync (device_put committed / executable
+        done / D2H complete), so the statistics the scheduler records are
+        observations, not allocations of a single wall-time number.
         """
         if self._apply is None:
             raise EngineError(
                 f"model '{self.config.name}' is an ensemble; "
                 "execute composing models instead", 500)
         cfg = self.config
+        phases = ExecPhases(start=now_ns())
         pad_to = None
         if cfg.max_batch_size > 0 and batch_size is not None:
             pad_to = self.pick_bucket(batch_size)
 
-        staged = {}
-        for name, arr in inputs.items():
-            if arr.dtype == np.object_ or not self._jitted:
-                staged[name] = arr  # BYTES / host models stay host-side
-                continue
-            if pad_to is not None and arr.shape[0] < pad_to:
-                pad_width = [(0, pad_to - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
-                if isinstance(arr, self._jax.Array):
-                    # device-resident (tpu-shm region): pad on device, don't
-                    # round-trip through host
-                    import jax.numpy as jnp
+        try:
+            self._set_state(f"staging inputs (bucket={pad_to})")
+            staged = {}
+            for name, arr in inputs.items():
+                if arr.dtype == np.object_ or not self._jitted:
+                    staged[name] = arr  # BYTES / host models stay host-side
+                    continue
+                if pad_to is not None and arr.shape[0] < pad_to:
+                    pad_width = [(0, pad_to - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+                    if isinstance(arr, self._jax.Array):
+                        # device-resident (tpu-shm region): pad on device,
+                        # don't round-trip through host
+                        import jax.numpy as jnp
 
-                    arr = jnp.pad(arr, pad_width)
-                else:
-                    arr = np.pad(arr, pad_width)
-            staged[name] = self._jax.device_put(arr)
+                        arr = jnp.pad(arr, pad_width)
+                    else:
+                        arr = np.pad(arr, pad_width)
+                staged[name] = self._jax.device_put(arr)
+            # No device sync here: the H2D commit pipelines with executable
+            # dispatch under async dispatch, so input_end bounds the *host*
+            # staging work (concat/pad/enqueue); syncing would add a device
+            # round-trip per batch just to sharpen a timestamp.
+            phases.input_end = now_ns()
 
-        outputs = self._apply(staged)
-        if not isinstance(outputs, dict):
-            raise EngineError(
-                f"model '{cfg.name}' returned {type(outputs)}, expected dict", 500)
-
-        # Start all device→host copies before blocking on any: per-buffer
-        # fetch latency through the device transport is ~10-100x the
-        # streaming cost, so overlapping the copies amortizes it to one
-        # round-trip per batch instead of one per output tensor.
-        for val in outputs.values():
-            if isinstance(val, self._jax.Array):
+            sig = tuple(sorted((n, tuple(a.shape), str(getattr(a, "dtype", "")))
+                               for n, a in staged.items()))
+            first = self._jitted and sig not in self._compiled
+            self._set_state(
+                f"compiling bucket={pad_to} (first call, XLA compile can "
+                "take 20-40s on TPU)" if first
+                else f"executing (bucket={pad_to})")
+            outputs = self._apply(staged)
+            if not isinstance(outputs, dict):
+                raise EngineError(
+                    f"model '{cfg.name}' returned {type(outputs)}, "
+                    "expected dict", 500)
+            device_outs = [v for v in outputs.values()
+                           if isinstance(v, self._jax.Array)]
+            # Enqueue all D2H copies *before* waiting on compute: each copy
+            # starts the moment its buffer is ready, exactly as the untimed
+            # path pipelined it, so the block below costs one host wake-up,
+            # not a serialization of compute against transfer.
+            for val in device_outs:
                 val.copy_to_host_async()
-        host: dict[str, np.ndarray] = {}
-        for name, val in outputs.items():
-            arr = np.asarray(val)
-            if pad_to is not None and batch_size is not None and arr.ndim >= 1 \
-                    and arr.shape[0] == pad_to:
-                arr = arr[:batch_size]
-            host[name] = arr
-        return host
+            if device_outs:
+                # Executable-complete boundary (device buffers ready).
+                self._jax.block_until_ready(device_outs)
+            if first:
+                self._compiled.add(sig)
+                _log.info("model '%s': compiled bucket=%s in %.1fs",
+                          cfg.name, pad_to,
+                          (now_ns() - phases.input_end) / 1e9)
+            phases.infer_end = now_ns()
+            self._set_state("fetching outputs")
+            host: dict[str, np.ndarray] = {}
+            for name, val in outputs.items():
+                arr = np.asarray(val)
+                if pad_to is not None and batch_size is not None \
+                        and arr.ndim >= 1 and arr.shape[0] == pad_to:
+                    arr = arr[:batch_size]
+                host[name] = arr
+            phases.output_end = now_ns()
+            return host, phases
+        finally:
+            # Always clear: a raise mid-compile must not leave a stale
+            # "compiling" state to misdirect later timeout diagnostics.
+            self._clear_state()
 
     def execute_stateful(self, state, inputs: dict[str, np.ndarray]):
         """Sequence-model step: ``apply(state, inputs) -> (state, outputs)``.
@@ -194,16 +270,20 @@ class Model:
             name: arr if arr.dtype == np.object_ else self._jax.device_put(arr)
             for name, arr in inputs.items()
         }
-        new_state, outputs = self._apply(state, staged)
-        if not isinstance(outputs, dict):
-            raise EngineError(
-                f"model '{self.config.name}' returned {type(outputs)}, "
-                "expected dict", 500)
-        for val in outputs.values():
-            if isinstance(val, self._jax.Array):
-                val.copy_to_host_async()
-        host = {name: np.asarray(val) for name, val in outputs.items()}
-        return new_state, host
+        try:
+            self._set_state("executing sequence step")
+            new_state, outputs = self._apply(state, staged)
+            if not isinstance(outputs, dict):
+                raise EngineError(
+                    f"model '{self.config.name}' returned {type(outputs)}, "
+                    "expected dict", 500)
+            for val in outputs.values():
+                if isinstance(val, self._jax.Array):
+                    val.copy_to_host_async()
+            host = {name: np.asarray(val) for name, val in outputs.items()}
+            return new_state, host
+        finally:
+            self._clear_state()
 
     def warmup(self) -> None:
         """Pre-compile every bucket with zero inputs so first real requests
@@ -211,6 +291,8 @@ class Model:
         cfg = self.config
         if self._apply is None:
             return
+        _log.info("model '%s': warmup over buckets %s",
+                  cfg.name, cfg.effective_buckets())
         for bucket in cfg.effective_buckets():
             inputs = {}
             for tc in cfg.input:

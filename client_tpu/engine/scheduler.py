@@ -9,6 +9,7 @@ buckets so the jitted XLA executable sees only static shapes.
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 from typing import Callable
@@ -27,13 +28,48 @@ from client_tpu.engine.types import (
 _SHUTDOWN = object()
 
 
+class _ReqQueue:
+    """FIFO queue with front-pushback.
+
+    Dynamic-batch gathering must be able to return a request that doesn't fit
+    the current batch to the *head* of the queue: round 1 re-queued it to the
+    tail, which reordered FIFO under mixed shapes and could starve a request
+    indefinitely with one worker. ``get`` blocks like ``queue.Queue.get`` and
+    raises ``queue.Empty`` on timeout.
+    """
+
+    def __init__(self):
+        self._d: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+
+    def put(self, item) -> None:
+        with self._cv:
+            self._d.append(item)
+            self._cv.notify()
+
+    def put_front(self, item) -> None:
+        with self._cv:
+            self._d.appendleft(item)
+            self._cv.notify()
+
+    def get(self, timeout: float | None = None):
+        with self._cv:
+            if not self._cv.wait_for(lambda: len(self._d) > 0, timeout=timeout):
+                raise queue.Empty
+            return self._d.popleft()
+
+    def qsize(self) -> int:
+        with self._cv:
+            return len(self._d)
+
+
 class Scheduler:
     """Base scheduler: owns the request queue and worker threads."""
 
     def __init__(self, model: Model, stats: ModelStats):
         self.model = model
         self.stats = stats
-        self.queue: queue.Queue = queue.Queue()
+        self.queue = _ReqQueue()
         self.workers: list[threading.Thread] = []
         self._stopping = False
         n = max(1, model.config.instance_count)
@@ -132,8 +168,9 @@ class DefaultScheduler(Scheduler):
             if self._check_timeout(nxt):
                 continue
             if total + _request_batch(nxt) > max_batch or not _compatible(first, nxt):
-                # Doesn't fit this batch: push back and stop gathering.
-                self.queue.put(nxt)
+                # Doesn't fit this batch: push back to the *head* so arrival
+                # order is preserved and the next gather starts with it.
+                self.queue.put_front(nxt)
                 break
             batch.append(nxt)
             total += _request_batch(nxt)
@@ -153,26 +190,27 @@ class DefaultScheduler(Scheduler):
                 if len(batch) > 1 else batch[0].inputs[name]
                 for name in batch[0].inputs
             }
-            outputs = self.model.execute(merged, batch_size=total)
+            outputs, phases = self.model.execute_timed(merged, batch_size=total)
             self.stats.record_execution(total)
-            t_in = start  # input staging is inside execute; split below
-            end = now_ns()
             offset = 0
             for r, sz in zip(batch, sizes):
                 per = {k: v[offset:offset + sz] for k, v in outputs.items()}
                 offset += sz
-                self._finish(r, per, end)
+                self._finish(r, per, phases)
         else:
-            outputs = self.model.execute(batch[0].inputs, batch_size=None)
+            outputs, phases = self.model.execute_timed(
+                batch[0].inputs, batch_size=None)
             self.stats.record_execution(1)
-            self._finish(batch[0], outputs, now_ns())
+            self._finish(batch[0], outputs, phases)
 
-    def _finish(self, req: InferRequest, outputs: dict, end_ns: int) -> None:
-        # Phase split inside execute() isn't surfaced per-request yet; charge
-        # the whole device round-trip to compute_infer (input/output staging
-        # are measured once shm paths land and stage explicitly).
-        req.times.compute_input_end = req.times.compute_start
-        req.times.compute_infer_end = end_ns
+    def _finish(self, req: InferRequest, outputs: dict, phases) -> None:
+        # Measured phase boundaries from Model.execute_timed: host batch
+        # assembly counts toward compute_input (compute_start predates
+        # phases.start by the concatenate), the executable interval is
+        # device-synced, and per-request response slicing lands in
+        # compute_output after the shared fetch.
+        req.times.compute_input_end = phases.input_end
+        req.times.compute_infer_end = phases.infer_end
         req.times.compute_output_end = now_ns()
         if req.outputs:
             requested = {o.name for o in req.outputs}

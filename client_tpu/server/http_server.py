@@ -359,7 +359,11 @@ class HttpInferenceServer:
         handler = type("BoundHandler", (_Handler,),
                        {"engine": engine, "verbose": verbose})
         self.engine = engine
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+        # socketserver's default accept backlog (5) drops connections under
+        # concurrent-client bursts — raise it before the socket listens.
+        server_cls = type("_Httpd", (ThreadingHTTPServer,),
+                          {"request_queue_size": 128})
+        self.httpd = server_cls((host, port), handler)
         self.httpd.daemon_threads = True
         self._thread: threading.Thread | None = None
 
