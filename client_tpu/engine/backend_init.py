@@ -82,12 +82,21 @@ def ensure_backend(hard_timeout_s: float = 300.0) -> list:
             import jax
 
             # The runtime image pre-imports jax from a sitecustomize hook
-            # that registers the TPU plugin, so JAX_PLATFORMS in the env is
-            # not always enough to restrict platform selection — force it
-            # through jax.config too (same workaround as tests/conftest.py).
-            plat = os.environ.get("JAX_PLATFORMS")
-            if plat:
-                jax.config.update("jax_platforms", plat)
+            # that registers the TPU plugin and may set jax_platforms
+            # programmatically (e.g. "axon,cpu"), so JAX_PLATFORMS in the
+            # env is not always enough to restrict platform selection.
+            # Resolution: an env value that names a subset of the configured
+            # platform list is a *restriction* — apply it; an env value the
+            # config doesn't contain means the caller overrode the config
+            # explicitly (tests forcing cpu while env says axon) — keep the
+            # config. Same workaround family as tests/conftest.py.
+            env_plat = os.environ.get("JAX_PLATFORMS")
+            cur = getattr(jax.config, "jax_platforms", None)
+            if env_plat and (
+                    not cur or cur == env_plat
+                    or set(env_plat.split(",")) <= set(cur.split(","))):
+                jax.config.update("jax_platforms", env_plat)
+            plat = getattr(jax.config, "jax_platforms", None) or env_plat
             log.info("initializing JAX backend (platform=%s)...",
                      plat or "auto")
             devices = jax.devices()

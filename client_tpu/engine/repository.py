@@ -1,18 +1,41 @@
-"""Model repository: registration, load/unload, index.
+"""Model repository: registration, load/unload, index, directory loading.
 
 Mirrors the reference's model-repository control surface
 (LoadModel/UnloadModel/ModelRepositoryIndex, /root/reference/src/c++/library/
 grpc_client.h:195-213) for an in-process engine. Models are registered as
 builder callables so load/unload controls weight residency in HBM.
+
+``from_directory`` serves a Triton-style on-disk repository — one
+subdirectory per model with a ``config.pbtxt`` (text-format ModelConfig, like
+/root/reference/models/ssd_mobilenet_v2_coco_quantized/config.pbtxt) or a
+``config.json``. The file is the authoritative serving contract; the
+executable backend comes from the zoo registry under the model's name (or
+``parameters["zoo_builder"]``), with ensembles needing no backend at all.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 from typing import Callable
 
 from client_tpu.engine.model import Model, ModelBackend
 from client_tpu.engine.types import EngineError
+
+
+class ConfigOnlyBackend(ModelBackend):
+    """Backend carrying only a config — ensembles execute via their
+    composing models, so they never need an executable."""
+
+    def __init__(self, config):
+        self.config = config
+
+    def make_apply(self):
+        raise EngineError(
+            f"model '{self.config.name}' has no executable (platform "
+            f"'{self.config.platform}' with no ensemble_scheduling steps)",
+            400)
 
 
 class ModelRepository:
@@ -74,6 +97,78 @@ class ModelRepository:
         with self._lock:
             return name in self._loaded
 
+    # -- directory repository ------------------------------------------------
+
+    @classmethod
+    def from_directory(cls, path: str, jit: bool = True) -> "ModelRepository":
+        repo = cls(jit=jit)
+        repo.add_directory(path)
+        return repo
+
+    def add_directory(self, path: str) -> list[str]:
+        """Register every model subdirectory of ``path``; returns the names.
+
+        Layout per model: ``<path>/<name>/config.pbtxt`` (or config.json),
+        optional label files referenced by per-output ``label_filename``
+        (resolved relative to the model directory into
+        ``parameters["labels"][output_name]`` for the classification
+        extension).
+        """
+        if not os.path.isdir(path):
+            raise EngineError(f"model repository '{path}' is not a directory",
+                              404)
+        names = []
+        for entry in sorted(os.listdir(path)):
+            mdir = os.path.join(path, entry)
+            if not os.path.isdir(mdir):
+                continue
+            try:
+                d = self._read_config(mdir)
+            except Exception as exc:  # noqa: BLE001 — surface per-model
+                # A corrupt config must not abort the rest of the repository:
+                # register a builder that reports the parse failure, so the
+                # index shows UNAVAILABLE with the reason (Triton behavior).
+                msg = f"failed to parse config in '{mdir}': {exc}"
+                self.register(entry, _failing_builder(msg))
+                names.append(entry)
+                continue
+            if d is None:
+                continue
+            if not d.get("name"):
+                d["name"] = entry  # directory name is canonical in Triton
+            self._resolve_labels(d, mdir)
+            self.register(d["name"], _directory_builder(d))
+            names.append(d["name"])
+        return names
+
+    @staticmethod
+    def _read_config(mdir: str) -> dict | None:
+        pbtxt = os.path.join(mdir, "config.pbtxt")
+        cfg_json = os.path.join(mdir, "config.json")
+        if os.path.exists(pbtxt):
+            from client_tpu.protocol.model_config import load_pbtxt
+
+            return load_pbtxt(pbtxt)
+        if os.path.exists(cfg_json):
+            with open(cfg_json) as f:
+                return json.load(f)
+        return None
+
+    @staticmethod
+    def _resolve_labels(d: dict, mdir: str) -> None:
+        labels = {}
+        for out in d.get("output", []):
+            fname = out.get("label_filename")
+            if not fname:
+                continue
+            fpath = os.path.join(mdir, fname)
+            if os.path.exists(fpath):
+                with open(fpath) as f:
+                    labels[out["name"]] = [ln.rstrip("\n") for ln in f]
+        if labels:
+            d.setdefault("parameters", {}).setdefault("labels", {}).update(
+                labels)
+
     def index(self) -> list[dict]:
         with self._lock:
             out = []
@@ -88,3 +183,49 @@ class ModelRepository:
                     entry["reason"] = reason
                 out.append(entry)
             return out
+
+
+def _failing_builder(message: str) -> Callable[[], ModelBackend]:
+    def build() -> ModelBackend:
+        raise EngineError(message, 400)
+
+    return build
+
+
+def _directory_builder(d: dict) -> Callable[[], ModelBackend]:
+    """Builder for a config-file model: the file is the serving contract,
+    the zoo registry supplies the executable under the model's name (or
+    ``parameters["zoo_builder"]``)."""
+
+    def build() -> ModelBackend:
+        from client_tpu.engine.config import ModelConfig
+
+        cfg = ModelConfig.from_dict(d)
+        if cfg.platform == "ensemble" and not cfg.ensemble_scheduling:
+            raise EngineError(
+                f"model '{cfg.name}': platform 'ensemble' requires "
+                "ensemble_scheduling steps", 400)
+        if cfg.ensemble_scheduling:
+            return ConfigOnlyBackend(cfg)
+
+        import client_tpu.models as zoo
+
+        zoo._import_all()
+        builder_name = str(cfg.parameters.get("zoo_builder", cfg.name))
+        builder = zoo._REGISTRY.get(builder_name)
+        if builder is None:
+            raise EngineError(
+                f"no executable backend for model '{cfg.name}' (platform "
+                f"'{cfg.platform}'): register one with "
+                f"client_tpu.models.register_model('{builder_name}') or set "
+                "parameters.zoo_builder in its config", 400)
+        backend = builder()
+        # File config is authoritative; batch_buckets aren't expressible in
+        # pbtxt, so inherit the zoo's bucket plan when the batch limit agrees.
+        if (cfg.batch_buckets is None
+                and backend.config.max_batch_size == cfg.max_batch_size):
+            cfg.batch_buckets = backend.config.batch_buckets
+        backend.config = cfg
+        return backend
+
+    return build

@@ -51,6 +51,9 @@ def config_dict_to_proto(d: dict) -> "pb.ModelConfig":
         cfg.instance_group.add(count=int(g.get("count", 1)))
     if (d.get("model_transaction_policy") or {}).get("decoupled"):
         cfg.model_transaction_policy.decoupled = True
+    for key, val in (d.get("parameters") or {}).items():
+        if isinstance(val, (str, int, float, bool)):
+            cfg.parameters[key].string_value = str(val)
     return cfg
 
 
@@ -81,6 +84,8 @@ def proto_to_config_dict(cfg: "pb.ModelConfig") -> dict:
         }
         if t.reshape.shape:
             entry["reshape"] = {"shape": list(t.reshape.shape)}
+        if t.label_filename:
+            entry["label_filename"] = t.label_filename
         d["output"].append(entry)
     if cfg.HasField("dynamic_batching"):
         d["dynamic_batching"] = {
@@ -113,6 +118,9 @@ def proto_to_config_dict(cfg: "pb.ModelConfig") -> dict:
                                for g in cfg.instance_group]
     if cfg.model_transaction_policy.decoupled:
         d["model_transaction_policy"] = {"decoupled": True}
+    if cfg.parameters:
+        d["parameters"] = {k: v.string_value
+                           for k, v in cfg.parameters.items()}
     return d
 
 

@@ -1,0 +1,100 @@
+"""Server launcher: ``python -m client_tpu.server``.
+
+The stand-alone process the reference's clients assume is already running
+(tritonserver with ``--model-repository``; our engine is in-process, SURVEY.md
+§7 step 3 — this wraps it in the two network frontends).
+
+    python -m client_tpu.server --model-repository models/ \
+        --http-port 8000 --grpc-port 8001
+    python -m client_tpu.server --zoo simple,bert_base --warmup
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="client_tpu.server",
+        description="TPU-native inference server (KServe v2 HTTP + gRPC)")
+    ap.add_argument("--model-repository", metavar="DIR", default=None,
+                    help="directory of <model>/config.pbtxt model configs")
+    ap.add_argument("--zoo", metavar="NAMES", default=None,
+                    help="comma-separated zoo models to serve "
+                         "(default: all, when no --model-repository)")
+    ap.add_argument("--http-port", type=int, default=8000)
+    ap.add_argument("--grpc-port", type=int, default=8001)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--no-http", action="store_true")
+    ap.add_argument("--no-grpc", action="store_true")
+    ap.add_argument("--warmup", action="store_true",
+                    help="pre-compile every model's batch buckets at load")
+    ap.add_argument("--no-jit", action="store_true",
+                    help="skip XLA jit (host execution; for debugging)")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    from client_tpu.engine import TpuEngine
+    from client_tpu.engine.repository import ModelRepository
+    from client_tpu.models import build_repository
+
+    jit = not args.no_jit
+    zoo_names = None
+    if args.zoo:
+        from client_tpu.models import model_names
+
+        zoo_names = [n.strip() for n in args.zoo.split(",") if n.strip()]
+        unknown = sorted(set(zoo_names) - set(model_names()))
+        if unknown:
+            ap.error(f"unknown zoo model(s) {unknown}; "
+                     f"available: {', '.join(model_names())}")
+    if args.model_repository:
+        repo = ModelRepository.from_directory(args.model_repository, jit=jit)
+        if zoo_names:
+            from client_tpu.models import _REGISTRY
+
+            for name in zoo_names:
+                repo.register(name, _REGISTRY[name])
+    else:
+        repo = build_repository(zoo_names, jit=jit)
+
+    engine = TpuEngine(repo, jit=jit, warmup=args.warmup)
+    for entry in engine.repository_index():
+        line = f"model {entry['name']}: {entry['state']}"
+        if entry.get("reason"):
+            line += f" ({entry['reason']})"
+        print(line, file=sys.stderr, flush=True)
+
+    servers = []
+    if not args.no_http:
+        from client_tpu.server import HttpInferenceServer
+
+        http_srv = HttpInferenceServer(engine, host=args.host,
+                                       port=args.http_port,
+                                       verbose=args.verbose).start()
+        servers.append(("http", http_srv.url))
+    if not args.no_grpc:
+        from client_tpu.server import GrpcInferenceServer
+
+        grpc_srv = GrpcInferenceServer(engine, host=args.host,
+                                       port=args.grpc_port).start()
+        servers.append(("grpc", grpc_srv.url))
+    for kind, url in servers:
+        print(f"serving {kind} at {url}", file=sys.stderr, flush=True)
+    if not servers:
+        print("nothing to serve (--no-http and --no-grpc)", file=sys.stderr)
+        return 2
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("shutting down", file=sys.stderr)
+        engine.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
