@@ -191,6 +191,10 @@ class Model:
 
         try:
             self._set_state(f"staging inputs (bucket={pad_to})")
+            # Multi-chip backends declare per-input shardings (e.g. batch
+            # over "dp"); device_put then scatters straight onto the mesh
+            # and GSPMD propagates layouts from there (parallel/serving.py).
+            shardings = getattr(self.backend, "input_shardings", None) or {}
             staged = {}
             for name, arr in inputs.items():
                 if arr.dtype == np.object_ or not self._jitted:
@@ -206,7 +210,10 @@ class Model:
                         arr = jnp.pad(arr, pad_width)
                     else:
                         arr = np.pad(arr, pad_width)
-                staged[name] = self._jax.device_put(arr)
+                sharding = shardings.get(name)
+                staged[name] = (self._jax.device_put(arr, sharding)
+                                if sharding is not None
+                                else self._jax.device_put(arr))
             # No device sync here: the H2D commit pipelines with executable
             # dispatch under async dispatch, so input_end bounds the *host*
             # staging work (concat/pad/enqueue); syncing would add a device

@@ -26,11 +26,14 @@ from client_tpu.engine.model import ModelBackend
 from client_tpu.engine.repository import ModelRepository
 
 _REGISTRY: dict[str, Callable[[], ModelBackend]] = {}
+_NON_DEFAULT: set[str] = set()  # listed/loadable by name, excluded from "all"
 
 
-def register_model(name: str):
+def register_model(name: str, default: bool = True):
     def deco(builder: Callable[[], ModelBackend]):
         _REGISTRY[name] = builder
+        if not default:
+            _NON_DEFAULT.add(name)
         return builder
     return deco
 
@@ -46,8 +49,12 @@ def build_repository(names: list[str] | None = None,
     _import_all()
     repo = ModelRepository(jit=jit)
     for name, builder in _REGISTRY.items():
-        if names is None or name in names:
-            repo.register(name, builder)
+        if names is None:
+            if name in _NON_DEFAULT:
+                continue
+        elif name not in names:
+            continue
+        repo.register(name, builder)
     return repo
 
 
@@ -59,3 +66,8 @@ def _import_all() -> None:
             __import__(f"client_tpu.models.{mod}")
         except ImportError:
             pass
+    # Multi-chip serving models live with the parallelism code.
+    try:
+        __import__("client_tpu.parallel.serving")
+    except ImportError:
+        pass

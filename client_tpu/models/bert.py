@@ -110,9 +110,20 @@ class BertBackend(ModelBackend):
         return params
 
     def make_apply(self):
-        params = self._init_params()
+        return self._build_apply(self._init_params())
+
+    def _build_apply(self, params, constrain=None):
+        """Build the pure apply over a (possibly sharded) params pytree.
+
+        ``constrain(x, spec)`` inserts sharding constraints at activation
+        boundaries for multi-chip serving (ShardedBertBackend); None means
+        single-device and the hooks are no-ops.
+        """
         n_heads = self.n_heads
         head_dim = self.hidden // n_heads
+        if constrain is None:
+            def constrain(x, spec):  # noqa: ARG001 — single-device no-op
+                return x
 
         def layer_norm(x, p):
             import jax
@@ -135,6 +146,9 @@ class BertBackend(ModelBackend):
             q = proj(x, lp["wq"]).reshape(b, s, n_heads, head_dim)
             k = proj(x, lp["wk"]).reshape(b, s, n_heads, head_dim)
             v = proj(x, lp["wv"]).reshape(b, s, n_heads, head_dim)
+            q = constrain(q, ("dp", None, "tp", None))
+            k = constrain(k, ("dp", None, "tp", None))
+            v = constrain(v, ("dp", None, "tp", None))
             # [B, heads, S, S] scores, fp32 softmax accumulation
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
             scores = scores / np.sqrt(head_dim) + mask_bias
@@ -153,10 +167,14 @@ class BertBackend(ModelBackend):
 
             x = params["tok_embed"][ids] + params["pos_embed"][None, :, :]
             x = layer_norm(x, params["embed_ln"])
+            x = constrain(x, ("dp", None, None))
             for lp in params["layers"]:
                 x = layer_norm(x + attention(x, mask_bias, lp), lp["ln1"])
+                x = constrain(x, ("dp", None, None))
                 y = jax.nn.gelu(proj(x, lp["w1"]))
+                y = constrain(y, ("dp", None, "tp"))
                 x = layer_norm(x + proj(y, lp["w2"]), lp["ln2"])
+                x = constrain(x, ("dp", None, None))
 
             cls = x[:, 0, :].astype(jnp.float32)
             pooler = params["pooler"]

@@ -1,0 +1,128 @@
+"""Multi-chip *inference*: zoo models sharded over a device mesh and served
+through the ordinary engine path.
+
+The reference has no counterpart (its servers are single-process black
+boxes); this is the TPU-native promise of the project — the same
+``TpuEngine``/scheduler/statistics stack, but the executable is partitioned
+over a ``jax.sharding.Mesh``:
+
+- parameters tensor-parallel on ``tp`` (megatron column/row splits for
+  attention QKVO and the FFN pair),
+- request batches data-parallel on ``dp`` (the scheduler's dynamic batches
+  pad to buckets that are multiples of the dp degree),
+- activations pinned at layer boundaries with sharding constraints so XLA
+  places psum/all-gather collectives on ICI.
+
+The engine needs no special casing: a backend that declares
+``input_shardings`` gets its staged inputs ``device_put`` onto the mesh, and
+GSPMD propagates everything else (see Model.execute_timed).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from client_tpu.models.bert import BertBackend
+
+
+def bert_param_specs(P, n_layers: int):
+    """PartitionSpec tree matching BertBackend._init_params.
+
+    Embeddings and layer-norms replicate (small); attention and FFN weights
+    split megatron-style over ``tp``: column-parallel into the head/hidden
+    dimension, row-parallel back out, so each matmul pair needs exactly one
+    psum on ICI.
+    """
+    def dense_col():  # [in, out] split on out
+        return {"w": P(None, "tp"), "b": P("tp")}
+
+    def dense_row():  # [in, out] split on in; output needs the psum
+        return {"w": P("tp", None), "b": P()}
+
+    def ln():
+        return {"scale": P(), "bias": P()}
+
+    layer = {
+        "wq": dense_col(), "wk": dense_col(), "wv": dense_col(),
+        "wo": dense_row(),
+        "ln1": ln(),
+        "w1": dense_col(), "w2": dense_row(),
+        "ln2": ln(),
+    }
+    return {
+        "tok_embed": P(),
+        "pos_embed": P(),
+        "embed_ln": ln(),
+        "layers": [dict(layer) for _ in range(n_layers)],
+        "pooler": {"w": P(), "b": P()},
+        "classifier": {"w": P(), "b": P()},
+    }
+
+
+class ShardedBertBackend(BertBackend):
+    """BERT-base partitioned over a (dp, tp) mesh for serving.
+
+    ``mesh`` defaults to all visible devices. Batch buckets are multiples of
+    the dp degree so every dynamic batch shards evenly.
+    """
+
+    def __init__(self, mesh=None, name: str = "bert_base_mc",
+                 max_batch_size: int = 16, **kw):
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from client_tpu.parallel.mesh import make_mesh
+
+        if mesh is None:
+            mesh = make_mesh(axes=("dp", "tp"))
+        self.mesh = mesh
+        super().__init__(name=name, max_batch_size=max_batch_size, **kw)
+        # Every bucket (including the top one) must be a dp multiple or the
+        # batch device_put can't scatter evenly over the mesh.
+        dp = int(mesh.shape["dp"])
+        top = ((max_batch_size + dp - 1) // dp) * dp
+        buckets, b = [top], dp
+        while b < top:
+            buckets.append(b)
+            b *= 2
+        self.config.max_batch_size = top
+        self.config.batch_buckets = sorted(set(buckets))
+        # Computed once: Model.execute_timed reads this per batch on the
+        # latency path.
+        batch_spec = NamedSharding(mesh, P("dp", None))
+        self.input_shardings = {"input_ids": batch_spec,
+                                "attention_mask": batch_spec}
+
+    def make_apply(self):
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        params = self._init_params()
+        specs = bert_param_specs(P, self.n_layers)
+        mesh = self.mesh
+
+        def place(x, s):
+            # Drop tp from specs when the mesh doesn't carry it (dp-only).
+            if "tp" not in mesh.shape:
+                s = P(*(a if a != "tp" else None for a in s))
+            return jax.device_put(x, NamedSharding(mesh, s))
+
+        params = jax.tree.map(place, params, specs)
+
+        def constrain(x, spec):
+            # Drop axes the mesh doesn't carry (a dp-only mesh ignores tp).
+            spec = tuple(a if (a is None or a in mesh.shape) else None
+                         for a in spec)
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(*spec)))
+
+        return self._build_apply(params, constrain=constrain)
+
+
+# Zoo registration: opt-in (default=False) — a default load-all server
+# should not pay a second full BERT-base load; reach it explicitly via
+# build_repository(["bert_base_mc"]) or `--zoo bert_base_mc`.
+from client_tpu.models import register_model  # noqa: E402
+
+register_model("bert_base_mc", default=False)(ShardedBertBackend)

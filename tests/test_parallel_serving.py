@@ -1,0 +1,85 @@
+"""Multi-chip inference through the engine on the 8-device CPU mesh.
+
+No reference counterpart (the reference's distributed surface is
+client-server transport, SURVEY.md §2.9); this validates the TPU-native
+sharded-serving path: tp/dp-partitioned zoo model behind the ordinary
+scheduler, numerically equal to the single-device model.
+"""
+
+import numpy as np
+import pytest
+
+from client_tpu.engine import InferRequest, TpuEngine
+from client_tpu.engine.model import Model
+from client_tpu.engine.repository import ModelRepository
+from client_tpu.parallel.mesh import make_mesh
+from client_tpu.parallel.serving import ShardedBertBackend
+
+TINY = dict(seq_len=16, hidden=64, n_layers=2, n_heads=4, ffn=128, vocab=512)
+
+
+@pytest.fixture(scope="module")
+def sharded_engine():
+    mesh = make_mesh(8, axes=("dp", "tp"))
+    backend = ShardedBertBackend(mesh, name="bert_tiny_mc",
+                                 max_batch_size=8, **TINY)
+    repo = ModelRepository()
+    repo.register_backend(backend)
+    eng = TpuEngine(repo)
+    yield eng
+    eng.shutdown()
+
+
+def _mk_inputs(batch, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "input_ids": rng.integers(0, 512, size=(batch, seq)).astype(np.int32),
+        "attention_mask": np.ones((batch, seq), dtype=np.int32),
+    }
+
+
+def test_sharded_inference_through_engine(sharded_engine):
+    resp = sharded_engine.infer(
+        InferRequest(model_name="bert_tiny_mc", inputs=_mk_inputs(4)),
+        timeout_s=300)
+    assert resp.outputs["logits"].shape == (4, 2)
+    assert resp.outputs["pooled_output"].shape == (4, 64)
+    assert np.all(np.isfinite(resp.outputs["logits"]))
+
+
+def test_sharded_matches_single_device(sharded_engine):
+    from client_tpu.models.bert import BertBackend
+
+    inputs = _mk_inputs(4, seed=1)
+    resp = sharded_engine.infer(
+        InferRequest(model_name="bert_tiny_mc", inputs=dict(inputs)),
+        timeout_s=300)
+    ref = Model(BertBackend(name="bert_tiny_ref", max_batch_size=8, **TINY))
+    ref_out = ref.execute(dict(inputs), batch_size=4)
+    # same PRNG seed -> identical params; only collective reassociation
+    # (bf16 matmuls) separates the two
+    np.testing.assert_allclose(resp.outputs["logits"], ref_out["logits"],
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_odd_batch_pads_to_dp_bucket(sharded_engine):
+    # dp degree divides every bucket, so an odd batch must still serve
+    resp = sharded_engine.infer(
+        InferRequest(model_name="bert_tiny_mc", inputs=_mk_inputs(3)),
+        timeout_s=300)
+    assert resp.outputs["logits"].shape == (3, 2)
+
+
+def test_buckets_are_dp_multiples():
+    mesh = make_mesh(8, axes=("dp", "tp"))
+    backend = ShardedBertBackend(mesh, name="bert_buckets_mc",
+                                 max_batch_size=16, **TINY)
+    dp = int(mesh.shape["dp"])
+    assert all(b % dp == 0 for b in backend.config.batch_buckets), \
+        backend.config.batch_buckets
+
+
+def test_zoo_registration():
+    from client_tpu.models import model_names
+
+    assert "bert_base_mc" in model_names()
