@@ -112,6 +112,87 @@ def bench_inproc_simple(duration_s: float = 5.0, concurrency: int = 32):
     return total / elapsed, p99
 
 
+def bench_tpushm_simple(duration_s: float = 3.0, concurrency: int = 16):
+    """North-star data plane: inference with tpu-shm region I/O, in-process
+    (BASELINE.md config 2 — the cudashm add/sub client, zero network bytes
+    for tensors). Uses the same capi_embed entry points libtpuserver.so
+    binds, so this measures exactly what the perf harness's
+    --shared-memory tpu path measures."""
+    import numpy as np
+
+    from client_tpu import capi_embed
+    from client_tpu.utils import tpu_shared_memory as tshm
+
+    engine = capi_embed.create_engine("simple")
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+
+    regions = []
+    try:
+        for name, arr in (("in0", a), ("in1", b)):
+            r = tshm.create_shared_memory_region(name, arr.nbytes)
+            tshm.set_shared_memory_region(r, [arr])
+            capi_embed.register_tpu_shm(engine, name, tshm.get_raw_handle(r),
+                                        0, arr.nbytes)
+            regions.append(r)
+        for name in ("out0", "out1"):
+            r = tshm.create_shared_memory_region(name, 64)
+            capi_embed.register_tpu_shm(engine, name, tshm.get_raw_handle(r),
+                                        0, 64)
+            regions.append(r)
+
+        req = json.dumps({
+            "model_name": "simple",
+            "inputs": [
+                {"name": "INPUT0", "datatype": "INT32", "shape": [1, 16],
+                 "parameters": {"shared_memory_region": "in0",
+                                "shared_memory_byte_size": 64}},
+                {"name": "INPUT1", "datatype": "INT32", "shape": [1, 16],
+                 "parameters": {"shared_memory_region": "in1",
+                                "shared_memory_byte_size": 64}},
+            ],
+            "outputs": [
+                {"name": "OUTPUT0", "parameters": {
+                    "shared_memory_region": "out0",
+                    "shared_memory_byte_size": 64}},
+                {"name": "OUTPUT1", "parameters": {
+                    "shared_memory_region": "out1",
+                    "shared_memory_byte_size": 64}},
+            ],
+        })
+        for _ in range(8):  # warmup
+            capi_embed.infer(engine, req, [None, None])
+
+        stop = time.monotonic() + duration_s
+        counts = [0] * concurrency
+
+        def worker(i):
+            while time.monotonic() < stop:
+                capi_embed.infer(engine, req, [None, None])
+                counts[i] += 1
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(concurrency)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.monotonic() - t0
+        total = sum(counts)
+        log(f"tpushm: {total} inferences in {elapsed:.2f}s = "
+            f"{total / elapsed:.1f} ips (region I/O, zero tensor bytes "
+            "through the request path)")
+        return total / elapsed
+    finally:
+        capi_embed.shutdown_engine(engine)
+        for r in regions:
+            try:
+                tshm.destroy_shared_memory_region(r)
+            except Exception:  # noqa: BLE001
+                pass
+
+
 def bert_flops_per_example(seq_len=128, hidden=768, n_layers=12, ffn=3072):
     """Analytic forward FLOPs for one BERT-base example (2*MAC convention):
     per layer 4 QKVO projections + 2 attention einsums + 2 FFN matmuls."""
@@ -167,6 +248,11 @@ def main():
     except Exception as exc:  # noqa: BLE001 — headline metric still reports
         log(f"bert mfu measurement failed: {exc!r}")
         bert_ips, mfu, bert_step_s = None, None, None
+    try:
+        tpushm_ips = bench_tpushm_simple()
+    except Exception as exc:  # noqa: BLE001
+        log(f"tpushm bench failed: {exc!r}")
+        tpushm_ips = None
 
     hist_path = os.path.join(os.path.dirname(__file__), "BENCH_HISTORY.json")
     try:
@@ -188,7 +274,8 @@ def main():
     vs = ips / best if best else 1.0
     hist.append({"metric": "inproc_simple_ips", "value": ips,
                  "p99_us": p99_us, "bert_ips": bert_ips, "mfu": mfu,
-                 "platform": platform, "ts": time.time()})
+                 "tpushm_ips": tpushm_ips, "platform": platform,
+                 "ts": time.time()})
     try:
         with open(hist_path, "w") as f:
             json.dump(hist, f, indent=1)
@@ -207,6 +294,8 @@ def main():
         out["bert_b8_step_ms"] = round(bert_step_s * 1e3, 3)
     if mfu is not None:
         out["bert_b8_mfu"] = round(mfu, 4)
+    if tpushm_ips is not None:
+        out["tpushm_ips"] = round(tpushm_ips, 2)
     print(json.dumps(out))
 
 

@@ -73,6 +73,33 @@ def server_metadata_json(engine: TpuEngine) -> str:
     return json.dumps(engine.server_metadata())
 
 
+def register_system_shm(engine: TpuEngine, name: str, key: str,
+                        byte_size: int) -> None:
+    engine.system_shm.register(name, key, 0, int(byte_size))
+
+
+def unregister_system_shm(engine: TpuEngine, name: str = "") -> None:
+    engine.system_shm.unregister(name or None)
+
+
+def register_tpu_shm(engine: TpuEngine, name: str, raw_handle: bytes,
+                     device_id: int, byte_size: int) -> None:
+    engine.tpu_shm.register_handle(name, raw_handle, int(device_id),
+                                   int(byte_size))
+
+
+def unregister_tpu_shm(engine: TpuEngine, name: str = "") -> None:
+    engine.tpu_shm.unregister(name or None)
+
+
+def _read_shm_input(engine: TpuEngine, meta: dict) -> np.ndarray:
+    p = meta.get("parameters") or {}
+    return engine.read_shm_tensor(
+        p["shared_memory_region"], int(p.get("shared_memory_offset", 0)),
+        int(p.get("shared_memory_byte_size", 0)), meta["datatype"],
+        meta["shape"])
+
+
 def _input_array(meta: dict, buf) -> np.ndarray:
     dtype = meta["datatype"]
     shape = meta["shape"]
@@ -91,12 +118,23 @@ def infer(engine: TpuEngine, request_json: str, buffers: list):
     if len(inputs_meta) != len(buffers):
         raise ValueError(
             f"{len(inputs_meta)} input descriptors but {len(buffers)} buffers")
-    inputs = {m["name"]: _input_array(m, b)
-              for m, b in zip(inputs_meta, buffers)}
-    outputs = [OutputRequest(name=o["name"],
-                             classification_count=int(o.get("classification",
-                                                            0)))
-               for o in req_d.get("outputs", [])]
+    inputs = {}
+    for m, b in zip(inputs_meta, buffers):
+        if b is None or (m.get("parameters") or {}).get(
+                "shared_memory_region"):
+            inputs[m["name"]] = _read_shm_input(engine, m)
+        else:
+            inputs[m["name"]] = _input_array(m, b)
+    outputs = []
+    for o in req_d.get("outputs", []):
+        p = o.get("parameters") or {}
+        outputs.append(OutputRequest(
+            name=o["name"],
+            classification_count=int(o.get("classification", 0)),
+            shm_region=p.get("shared_memory_region"),
+            shm_offset=int(p.get("shared_memory_offset", 0)),
+            shm_byte_size=int(p.get("shared_memory_byte_size", 0)),
+        ))
     req = InferRequest(
         model_name=req_d["model_name"],
         model_version=req_d.get("model_version", ""),
@@ -114,7 +152,26 @@ def infer(engine: TpuEngine, request_json: str, buffers: list):
 
     out_meta = []
     out_arrays = []
+    out_req = {o.name: o for o in outputs}
     for name, arr in resp.outputs.items():
+        o = out_req.get(name)
+        if o is not None and o.shm_region:
+            # shm-placed output: write into the region, return parameters
+            # instead of a data view (the caller owns the mapping).
+            written = engine.write_shm_tensor(o.shm_region, o.shm_offset,
+                                              o.shm_byte_size, arr)
+            out_meta.append({
+                "name": name,
+                "datatype": np_to_wire_dtype(arr.dtype) or "BYTES",
+                "shape": list(arr.shape),
+                "parameters": {
+                    "shared_memory_region": o.shm_region,
+                    "shared_memory_offset": o.shm_offset,
+                    "shared_memory_byte_size": written,
+                },
+            })
+            out_arrays.append(None)
+            continue
         wire = np_to_wire_dtype(arr.dtype)
         if wire is None or arr.dtype.kind in ("S", "U", "O"):
             data = np.frombuffer(serialize_bytes_tensor(arr), dtype=np.uint8)

@@ -257,6 +257,30 @@ class TpuEngine:
             raise resp.error
         return resp
 
+    # -- shared-memory data plane --------------------------------------------
+
+    def read_shm_tensor(self, region: str, offset: int, byte_size: int,
+                        datatype: str, shape) -> "object":
+        """Resolve a region-referenced input tensor (tpu regions shadow
+        system regions, matching the register namespaces). Shared by every
+        frontend (HTTP, gRPC, in-process C API)."""
+        for mgr in (self.tpu_shm, self.system_shm):
+            if mgr is not None and mgr.has_region(region):
+                return mgr.read_tensor(region, offset, byte_size, datatype,
+                                       shape)
+        raise EngineError(
+            f"shared memory region '{region}' not registered", 400)
+
+    def write_shm_tensor(self, region: str, offset: int, byte_size: int,
+                         arr) -> int:
+        """Place an output tensor into a registered region; returns the
+        bytes written."""
+        for mgr in (self.tpu_shm, self.system_shm):
+            if mgr is not None and mgr.has_region(region):
+                return mgr.write_tensor(region, offset, byte_size, arr)
+        raise EngineError(
+            f"shared memory region '{region}' not registered", 400)
+
     # -- lifecycle -----------------------------------------------------------
 
     def shutdown(self) -> None:

@@ -89,14 +89,11 @@ def _proto_to_request(engine: TpuEngine,
 
 
 def _read_shm_input(engine, tensor, params) -> np.ndarray:
-    region = params["shared_memory_region"]
-    offset = int(params.get("shared_memory_offset", 0))
-    size = int(params.get("shared_memory_byte_size", 0))
-    for mgr in (engine.tpu_shm, engine.system_shm):
-        if mgr is not None and mgr.has_region(region):
-            return mgr.read_tensor(region, offset, size, tensor.datatype,
-                                   tensor.shape)
-    raise EngineError(f"shared memory region '{region}' not registered", 400)
+    return engine.read_shm_tensor(
+        params["shared_memory_region"],
+        int(params.get("shared_memory_offset", 0)),
+        int(params.get("shared_memory_byte_size", 0)),
+        tensor.datatype, tensor.shape)
 
 
 def _response_to_proto(engine: TpuEngine, req: InferRequest, resp,
@@ -143,12 +140,8 @@ def _response_to_proto(engine: TpuEngine, req: InferRequest, resp,
 
 
 def _write_shm_output(engine, o: OutputRequest, arr: np.ndarray) -> int:
-    for mgr in (engine.tpu_shm, engine.system_shm):
-        if mgr is not None and mgr.has_region(o.shm_region):
-            return mgr.write_tensor(o.shm_region, o.shm_offset,
-                                    o.shm_byte_size, arr)
-    raise EngineError(
-        f"shared memory region '{o.shm_region}' not registered", 400)
+    return engine.write_shm_tensor(o.shm_region, o.shm_offset,
+                                   o.shm_byte_size, arr)
 
 
 class _Servicer(GRPCInferenceServiceServicer):

@@ -53,6 +53,19 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # Nagle on the server side interacts with client delayed-ACK to add a
+    # ~40ms stall per response (the C++ client sets TCP_NODELAY; the server
+    # must too — measured 44ms -> <2ms round-trip on the perf harness).
+    disable_nagle_algorithm = True
+    # Buffer response writes so header+body leave in one segment.
+    wbufsize = 64 * 1024
+
+    def handle_expect_100(self):
+        # With buffered wfile the interim '100 Continue' would sit in the
+        # buffer while we block reading the body — flush it out explicitly.
+        result = super().handle_expect_100()
+        self.wfile.flush()
+        return result
     engine: TpuEngine = None  # patched onto the subclass by HttpInferenceServer
     verbose = False
 
@@ -269,16 +282,11 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_infer_response(req, resp)
 
     def _read_shm_input(self, wire) -> np.ndarray:
-        mgr_sys = self.engine.system_shm
-        mgr_tpu = self.engine.tpu_shm
-        region = wire.parameters["shared_memory_region"]
-        offset = int(wire.parameters.get("shared_memory_offset", 0))
-        size = int(wire.parameters.get("shared_memory_byte_size", 0))
-        for mgr in (mgr_tpu, mgr_sys):
-            if mgr is not None and mgr.has_region(region):
-                return mgr.read_tensor(region, offset, size,
-                                       wire.datatype, wire.shape)
-        raise EngineError(f"shared memory region '{region}' not registered", 400)
+        return self.engine.read_shm_tensor(
+            wire.parameters["shared_memory_region"],
+            int(wire.parameters.get("shared_memory_offset", 0)),
+            int(wire.parameters.get("shared_memory_byte_size", 0)),
+            wire.datatype, wire.shape)
 
     def _send_infer_response(self, req: InferRequest, resp) -> None:
         entries = []
@@ -343,12 +351,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(200, body, content_type=ctype, extra_headers=headers)
 
     def _write_shm_output(self, o: OutputRequest, arr: np.ndarray) -> int:
-        for mgr in (self.engine.tpu_shm, self.engine.system_shm):
-            if mgr is not None and mgr.has_region(o.shm_region):
-                return mgr.write_tensor(o.shm_region, o.shm_offset,
-                                        o.shm_byte_size, arr)
-        raise EngineError(
-            f"shared memory region '{o.shm_region}' not registered", 400)
+        return self.engine.write_shm_tensor(o.shm_region, o.shm_offset,
+                                            o.shm_byte_size, arr)
 
 
 class HttpInferenceServer:

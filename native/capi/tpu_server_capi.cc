@@ -9,6 +9,7 @@
 
 #include "tpu_server_capi.h"
 
+#define PY_SSIZE_T_CLEAN  // '#' length args are Py_ssize_t (required 3.12+)
 #include <Python.h>
 
 #include <cstdlib>
@@ -195,6 +196,55 @@ char* TpuServerModelStatisticsJson(TpuServer* server, const char* model,
                   json_out);
 }
 
+// Shared helper for the shm control-plane calls: fn(engine, ...) -> None.
+static char* VoidCall(TpuServer* server, const char* fn, PyObject* args) {
+  std::string error;
+  PyObject* result = CallEmbed(fn, args, &error);
+  Py_XDECREF(args);
+  if (result == nullptr) return DupString(error);
+  Py_DECREF(result);
+  return nullptr;
+}
+
+char* TpuServerRegisterSystemShm(TpuServer* server, const char* name,
+                                 const char* key, size_t byte_size) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(Ossn)", server->engine, name, key,
+                                 Py_ssize_t(byte_size));
+  char* err = VoidCall(server, "register_system_shm", args);
+  PyGILState_Release(gil);
+  return err;
+}
+
+char* TpuServerUnregisterSystemShm(TpuServer* server, const char* name) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(Os)", server->engine, name ? name : "");
+  char* err = VoidCall(server, "unregister_system_shm", args);
+  PyGILState_Release(gil);
+  return err;
+}
+
+char* TpuServerRegisterTpuShm(TpuServer* server, const char* name,
+                              const void* raw_handle, size_t handle_len,
+                              int64_t device_id, size_t byte_size) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue(
+      "(Osy#Ln)", server->engine, name,
+      static_cast<const char*>(raw_handle), Py_ssize_t(handle_len),
+      static_cast<long long>(device_id), Py_ssize_t(byte_size));
+  char* err = VoidCall(server, "register_tpu_shm", args);
+  PyGILState_Release(gil);
+  return err;
+}
+
+char* TpuServerUnregisterTpuShm(TpuServer* server, const char* name) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(Os)", server->engine, name ? name : "");
+  char* err = VoidCall(server, "unregister_tpu_shm", args);
+  PyGILState_Release(gil);
+  return err;
+}
+
 char* TpuServerInfer(TpuServer* server, const char* request_json,
                      const TpuServerTensor* inputs, size_t input_count,
                      TpuServerResponse** response) {
@@ -203,6 +253,13 @@ char* TpuServerInfer(TpuServer* server, const char* request_json,
 
   PyObject* buffers = PyList_New(Py_ssize_t(input_count));
   for (size_t i = 0; i < input_count; ++i) {
+    if (inputs[i].data == nullptr) {
+      // shm-referenced input: bytes come from the registered region, the
+      // JSON meta carries the shared_memory_* parameters.
+      Py_INCREF(Py_None);
+      PyList_SET_ITEM(buffers, Py_ssize_t(i), Py_None);
+      continue;
+    }
     // Zero-copy read-only view of caller memory; valid for this call only
     // (capi_embed._input_array documents the lifetime contract).
     PyObject* mv = PyMemoryView_FromMemory(

@@ -50,12 +50,27 @@ char* TpuServerModelConfigJson(TpuServer* server, const char* model,
 char* TpuServerModelStatisticsJson(TpuServer* server, const char* model,
                                    char** json_out);
 
+// Shared-memory control plane: the in-process analogs of the network
+// Register*SharedMemory RPCs, so a perf harness can exercise the shm data
+// planes with zero network. raw_handle carries the serialized TPU region
+// handle bytes (same schema the gRPC/HTTP register calls transport).
+char* TpuServerRegisterSystemShm(TpuServer* server, const char* name,
+                                 const char* key, size_t byte_size);
+char* TpuServerUnregisterSystemShm(TpuServer* server, const char* name);
+char* TpuServerRegisterTpuShm(TpuServer* server, const char* name,
+                              const void* raw_handle, size_t handle_len,
+                              int64_t device_id, size_t byte_size);
+char* TpuServerUnregisterTpuShm(TpuServer* server, const char* name);
+
 // Synchronous inference. request_json carries model/id/sequence options and
 // the input/output descriptors:
 //   {"model_name": ..., "id": ..., "sequence_id": ..., ...,
-//    "inputs": [{"name","datatype","shape"}...],
-//    "outputs": [{"name","classification"}...]}
-// inputs[i].data supplies the raw bytes for request_json["inputs"][i].
+//    "inputs": [{"name","datatype","shape", "parameters": {...}}...],
+//    "outputs": [{"name","classification","parameters": {...}}...]}
+// inputs[i].data supplies the raw bytes for request_json["inputs"][i]; an
+// input whose parameters name a shared_memory_region passes data=NULL and
+// the engine reads the bytes from the registered region (outputs
+// symmetrically write into their region and return no data view).
 char* TpuServerInfer(TpuServer* server, const char* request_json,
                      const TpuServerTensor* inputs, size_t input_count,
                      TpuServerResponse** response);

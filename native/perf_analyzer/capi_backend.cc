@@ -5,8 +5,10 @@
 // libtritonserver.so and binds ~45 TRITONSERVER_* entrypoints
 // (/root/reference/src/c++/perf_analyzer/client_backend/triton_c_api/
 // shared_library.cc:37-89, triton_loader.h:83-255, triton_loader.cc:251).
-// Like the reference (main.cc:1227-1248): sync-only, no shared memory —
-// in-process tensors are already zero-copy by construction.
+// Like the reference (main.cc:1227-1248): sync-only. Unlike the reference,
+// the shm control plane IS exposed in-process (system + tpu regions), so
+// the harness's --shared-memory modes measure the engine's shm data path
+// with zero network; plain in-process tensors are zero-copy by construction.
 
 #include <dlfcn.h>
 
@@ -66,6 +68,15 @@ class TpuServerLibrary {
         bind("TpuServerResponseDelete"));
     free_ = reinterpret_cast<decltype(&TpuServerFreeString)>(
         bind("TpuServerFreeString"));
+    reg_sys_shm_ = reinterpret_cast<decltype(&TpuServerRegisterSystemShm)>(
+        bind("TpuServerRegisterSystemShm"));
+    unreg_sys_shm_ =
+        reinterpret_cast<decltype(&TpuServerUnregisterSystemShm)>(
+            bind("TpuServerUnregisterSystemShm"));
+    reg_tpu_shm_ = reinterpret_cast<decltype(&TpuServerRegisterTpuShm)>(
+        bind("TpuServerRegisterTpuShm"));
+    unreg_tpu_shm_ = reinterpret_cast<decltype(&TpuServerUnregisterTpuShm)>(
+        bind("TpuServerUnregisterTpuShm"));
     if (!bind_error_.empty()) {
       return Error("missing symbol in " + lib_path + ": " + bind_error_);
     }
@@ -99,6 +110,10 @@ class TpuServerLibrary {
   decltype(&TpuServerResponseOutput) resp_output_ = nullptr;
   decltype(&TpuServerResponseDelete) resp_delete_ = nullptr;
   decltype(&TpuServerFreeString) free_ = nullptr;
+  decltype(&TpuServerRegisterSystemShm) reg_sys_shm_ = nullptr;
+  decltype(&TpuServerUnregisterSystemShm) unreg_sys_shm_ = nullptr;
+  decltype(&TpuServerRegisterTpuShm) reg_tpu_shm_ = nullptr;
+  decltype(&TpuServerUnregisterTpuShm) unreg_tpu_shm_ = nullptr;
 
  private:
   TpuServerLibrary() = default;
@@ -271,6 +286,20 @@ class CApiClientBackend : public ClientBackend {
       t.datatype = nullptr;
       t.shape = nullptr;
       t.dims = 0;
+      if (input->IsSharedMemory()) {
+        // Region-referenced input: no bytes cross the boundary; the engine
+        // reads from the registered region (data=NULL marks it).
+        JsonPtr params = tpuclient::Json::MakeObject();
+        params->Set("shared_memory_region", input->SharedMemoryName());
+        params->Set("shared_memory_offset",
+                    uint64_t(input->SharedMemoryOffset()));
+        params->Set("shared_memory_byte_size",
+                    uint64_t(input->SharedMemoryByteSize()));
+        meta->Set("parameters", params);
+        t.data = nullptr;
+        t.byte_size = 0;
+        continue;
+      }
       const auto& bufs = input->Buffers();
       if (bufs.size() == 1) {
         t.data = bufs[0].first;
@@ -288,6 +317,15 @@ class CApiClientBackend : public ClientBackend {
       meta->Set("name", output->Name());
       if (output->ClassCount() > 0)
         meta->Set("classification", uint64_t(output->ClassCount()));
+      if (output->IsSharedMemory()) {
+        JsonPtr params = tpuclient::Json::MakeObject();
+        params->Set("shared_memory_region", output->SharedMemoryName());
+        params->Set("shared_memory_offset",
+                    uint64_t(output->SharedMemoryOffset()));
+        params->Set("shared_memory_byte_size",
+                    uint64_t(output->SharedMemoryByteSize()));
+        meta->Set("parameters", params);
+      }
       out_list->Append(meta);
     }
     req->Set("outputs", out_list);
@@ -342,6 +380,34 @@ class CApiClientBackend : public ClientBackend {
     std::lock_guard<std::mutex> lk(stat_mutex_);
     *stat = stat_;
     return Error::Success();
+  }
+
+  Error RegisterSystemSharedMemory(const std::string& name,
+                                   const std::string& key,
+                                   size_t byte_size) override {
+    auto& lib = TpuServerLibrary::Get();
+    return lib.Wrap(lib.reg_sys_shm_(lib.server(), name.c_str(), key.c_str(),
+                                     byte_size));
+  }
+
+  Error UnregisterSystemSharedMemory(const std::string& name) override {
+    auto& lib = TpuServerLibrary::Get();
+    return lib.Wrap(lib.unreg_sys_shm_(lib.server(), name.c_str()));
+  }
+
+  Error RegisterTpuSharedMemory(const std::string& name,
+                                const std::string& raw_handle,
+                                int64_t device_id,
+                                size_t byte_size) override {
+    auto& lib = TpuServerLibrary::Get();
+    return lib.Wrap(lib.reg_tpu_shm_(lib.server(), name.c_str(),
+                                     raw_handle.data(), raw_handle.size(),
+                                     device_id, byte_size));
+  }
+
+  Error UnregisterTpuSharedMemory(const std::string& name) override {
+    auto& lib = TpuServerLibrary::Get();
+    return lib.Wrap(lib.unreg_tpu_shm_(lib.server(), name.c_str()));
   }
 
   bool SupportsAsync() const override { return false; }

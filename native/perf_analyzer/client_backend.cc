@@ -16,6 +16,16 @@ Error ClientBackend::UnregisterSystemSharedMemory(const std::string&) {
   return Error("shared memory not supported by this backend", 400);
 }
 
+Error ClientBackend::RegisterTpuSharedMemory(const std::string&,
+                                             const std::string&, int64_t,
+                                             size_t) {
+  return Error("tpu shared memory not supported by this backend", 400);
+}
+
+Error ClientBackend::UnregisterTpuSharedMemory(const std::string&) {
+  return Error("tpu shared memory not supported by this backend", 400);
+}
+
 namespace {
 
 class HttpClientBackend : public ClientBackend {
@@ -92,6 +102,18 @@ class HttpClientBackend : public ClientBackend {
 
   Error UnregisterSystemSharedMemory(const std::string& name) override {
     return client_->UnregisterSystemSharedMemory(name);
+  }
+
+  Error RegisterTpuSharedMemory(const std::string& name,
+                                const std::string& raw_handle,
+                                int64_t device_id,
+                                size_t byte_size) override {
+    return client_->RegisterTpuSharedMemory(name, raw_handle, byte_size,
+                                            static_cast<int>(device_id));
+  }
+
+  Error UnregisterTpuSharedMemory(const std::string& name) override {
+    return client_->UnregisterTpuSharedMemory(name);
   }
 
  private:

@@ -73,6 +73,13 @@ class ClientBackend {
                                                       size_t byte_size);
   virtual tpuclient::Error UnregisterSystemSharedMemory(
       const std::string& name);
+  // TPU-shm data plane (the cudashm counterpart, reference
+  // client_backend.h:341-356): raw_handle carries the serialized region
+  // handle, exactly as the reference transports cudaIpcMemHandle_t bytes.
+  virtual tpuclient::Error RegisterTpuSharedMemory(
+      const std::string& name, const std::string& raw_handle,
+      int64_t device_id, size_t byte_size);
+  virtual tpuclient::Error UnregisterTpuSharedMemory(const std::string& name);
 
   virtual bool SupportsAsync() const { return true; }
 };

@@ -11,8 +11,6 @@ Error ConcurrencyManager::Create(const LoadOptions& options,
                                  std::unique_ptr<ConcurrencyManager>* manager) {
   auto m = std::unique_ptr<ConcurrencyManager>(new ConcurrencyManager(
       options, factory, std::move(parser), std::move(data_loader)));
-  Error err = m->InitManager();
-  if (!err.IsOk()) return err;
   *manager = std::move(m);
   return Error::Success();
 }

@@ -15,9 +15,7 @@ Error CustomLoadManager::Create(
   auto m = std::unique_ptr<CustomLoadManager>(new CustomLoadManager(
       options, intervals_file, factory, std::move(parser),
       std::move(data_loader)));
-  Error err = m->InitManager();
-  if (!err.IsOk()) return err;
-  err = m->InitCustomIntervals();
+  Error err = m->InitCustomIntervals();
   if (!err.IsOk()) return err;
   *manager = std::move(m);
   return Error::Success();

@@ -40,15 +40,25 @@ LoadManager::~LoadManager() {
   }
 }
 
-Error LoadManager::InitManager() {
+// Serialized host-staged TPU region handle — must match the server's
+// make_tpu_handle schema (client_tpu/engine/shm.py): the TPU analog of the
+// reference's cudaIpcMemHandle_t byte transport (grpc_client.cc:811).
+std::string LoadManager::MakeTpuHandle(const std::string& key,
+                                       size_t byte_size, int device_id) {
+  return std::string("{\"kind\": \"host_staged\", \"key\": \"") + key +
+         "\", \"byte_size\": " + std::to_string(byte_size) +
+         ", \"device_id\": " + std::to_string(device_id) + "}";
+}
+
+Error LoadManager::RegisterShmRegion(ClientBackend* backend,
+                                     const ShmRegion& region) {
   if (options_.shm_type == SharedMemoryType::TPU) {
-    return Error(
-        "tpu shared memory staging requires device buffers on the client "
-        "host; use --shared-memory system for host staging (tpu-shm is "
-        "exercised via the Python tooling)",
-        400);
+    return backend->RegisterTpuSharedMemory(
+        region.name, MakeTpuHandle(region.key, region.byte_size, 0),
+        /*device_id=*/0, region.byte_size);
   }
-  return Error::Success();
+  return backend->RegisterSystemSharedMemory(region.name, region.key,
+                                             region.byte_size);
 }
 
 std::string LoadManager::ShmRegionName(const std::string& input, size_t stream,
@@ -86,8 +96,7 @@ Error LoadManager::InitSharedMemory(ClientBackend* backend) {
           memcpy(static_cast<uint8_t*>(region.base) + b * byte_size, data,
                  byte_size);
         }
-        err = backend->RegisterSystemSharedMemory(region.name, region.key,
-                                                  region_size);
+        err = RegisterShmRegion(backend, region);
         if (!err.IsOk()) return err;
         shm_regions_.push_back(region);
       }
@@ -104,8 +113,7 @@ Error LoadManager::InitSharedMemory(ClientBackend* backend) {
     err = tpuclient::MapSharedMemory(region.fd, 0, region.byte_size,
                                      &region.base);
     if (!err.IsOk()) return err;
-    err = backend->RegisterSystemSharedMemory(region.name, region.key,
-                                              region.byte_size);
+    err = RegisterShmRegion(backend, region);
     if (!err.IsOk()) return err;
     shm_regions_.push_back(region);
   }
@@ -115,7 +123,10 @@ Error LoadManager::InitSharedMemory(ClientBackend* backend) {
 
 void LoadManager::CleanupSharedMemory(ClientBackend* backend) {
   for (auto& region : shm_regions_) {
-    backend->UnregisterSystemSharedMemory(region.name);
+    if (options_.shm_type == SharedMemoryType::TPU)
+      backend->UnregisterTpuSharedMemory(region.name);
+    else
+      backend->UnregisterSystemSharedMemory(region.name);
     if (region.base != nullptr)
       tpuclient::UnmapSharedMemory(region.base, region.byte_size);
     if (region.fd >= 0) tpuclient::CloseSharedMemory(region.fd);
@@ -153,7 +164,7 @@ Error LoadManager::MakeContext(ThreadConfig* config, InferContext** out) {
     InferRequestedOutput* output = nullptr;
     Error err = InferRequestedOutput::Create(&output, kv.first);
     if (!err.IsOk()) return err;
-    if (options_.shm_type == SharedMemoryType::SYSTEM) {
+    if (options_.shm_type != SharedMemoryType::NONE) {
       output->SetSharedMemory("perf_out_" + kv.first,
                               options_.output_shm_size);
     }
@@ -189,7 +200,7 @@ Error LoadManager::PrepareRequest(InferContext* ctx) {
   size_t step = steps > 0 ? ctx->step % steps : 0;
 
   for (auto* input : ctx->inputs) {
-    if (options_.shm_type == SharedMemoryType::SYSTEM) {
+    if (options_.shm_type != SharedMemoryType::NONE) {
       const uint8_t* data = nullptr;
       size_t byte_size = 0;
       Error err = data_loader_->GetInputData(input->Name(), ctx->stream, step,

@@ -101,7 +101,6 @@ class LoadManager {
     int fd = -1;
   };
 
-  tpuclient::Error InitManager();
   tpuclient::Error MakeContext(ThreadConfig* config, InferContext** out);
   // Points ctx inputs at the (stream, step) data (or its shm region) and
   // sets sequence options when the model is sequence-batched.
@@ -113,6 +112,10 @@ class LoadManager {
   // shm staging (reference InitSharedMemory, load_manager.cc:256-446)
   tpuclient::Error InitSharedMemory(ClientBackend* backend);
   void CleanupSharedMemory(ClientBackend* backend);
+  tpuclient::Error RegisterShmRegion(ClientBackend* backend,
+                                     const ShmRegion& region);
+  static std::string MakeTpuHandle(const std::string& key, size_t byte_size,
+                                   int device_id);
   std::string ShmRegionName(const std::string& input, size_t stream,
                             size_t step) const;
 

@@ -52,7 +52,7 @@ Options:
   --string-data <s>      fixed BYTES element value
   --sequence-length <n>  requests per sequence (default 20)
   --start-sequence-id <n>
-  --shared-memory <none|system>   tensor transport (default none)
+  --shared-memory <none|system|tpu>   tensor transport (default none)
   --output-shared-memory-size <bytes>
   --max-threads <n>      worker thread cap (default 16)
   --service-kind <tpu_http|tpu_grpc|tpu_capi>  endpoint kind (default
@@ -320,12 +320,11 @@ int main(int argc, char** argv) {
     Usage("-i must be http or grpc");
   }
   if (args.kind == BackendKind::TPU_CAPI) {
-    // Same restrictions as the reference's C-API kind (main.cc:1227-1248):
-    // in-process path is sync-only and has no shm control plane (in-process
-    // tensors are already zero-copy).
+    // Sync-only like the reference's C-API kind (main.cc:1227-1248) —
+    // but unlike the reference, the in-process engine has a full shm
+    // control plane (system + tpu regions), so --shared-memory works here
+    // and measures the no-network shm data path.
     if (args.async) Usage("--service-kind tpu_capi is sync-only");
-    if (args.shm != SharedMemoryType::NONE)
-      Usage("--shared-memory is not applicable to tpu_capi");
     if (args.capi_models.empty()) args.capi_models = args.model;
   }
 

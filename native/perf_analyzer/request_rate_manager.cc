@@ -12,8 +12,6 @@ Error RequestRateManager::Create(
   auto m = std::unique_ptr<RequestRateManager>(new RequestRateManager(
       options, distribution, factory, std::move(parser),
       std::move(data_loader)));
-  Error err = m->InitManager();
-  if (!err.IsOk()) return err;
   *manager = std::move(m);
   return Error::Success();
 }

@@ -215,6 +215,45 @@ def test_perf_analyzer_capi_inprocess(native_build, tmp_path):
     assert float(row[header.index("Inferences/Second")]) > 0
 
 
+@pytest.mark.parametrize("shm_mode", ["system", "tpu"])
+def test_perf_analyzer_shm_modes(native_build, server, tmp_path, shm_mode):
+    """--shared-memory system|tpu over HTTP: the north-star data planes
+    (BASELINE.md config 2, reference cudashm path load_manager.cc:287-446)
+    driven by the native harness against the live server."""
+    csv = tmp_path / f"shm_{shm_mode}.csv"
+    proc = subprocess.run(
+        [os.path.join(native_build, "tpu_perf_analyzer"),
+         "-m", "simple", "-u", server.url, "-p", "600", "-r", "6",
+         "-s", "70", "--concurrency-range", "2:2",
+         "--shared-memory", shm_mode, "-f", str(csv)],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = csv.read_text().strip().splitlines()
+    header, row = lines[0].split(","), lines[1].split(",")
+    assert float(row[header.index("Inferences/Second")]) > 0
+
+
+def test_perf_analyzer_capi_tpushm(native_build, tmp_path):
+    """In-process engine + tpu-shm regions: the full north-star config with
+    zero network anywhere (reference has no counterpart — its C-API kind
+    cannot do shm, main.cc:1227-1248)."""
+    csv = tmp_path / "capi_tpushm.csv"
+    env = dict(os.environ, CLIENT_TPU_PLATFORM="cpu")
+    proc = subprocess.run(
+        [os.path.join(native_build, "tpu_perf_analyzer"),
+         "-m", "simple", "--service-kind", "tpu_capi",
+         "--capi-library-path", os.path.join(native_build, "libtpuserver.so"),
+         "--capi-repo-root", os.path.join(NATIVE, ".."),
+         "-p", "600", "-r", "6", "-s", "70",
+         "--concurrency-range", "2:2", "--shared-memory", "tpu",
+         "-f", str(csv)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = csv.read_text().strip().splitlines()
+    header, row = lines[0].split(","), lines[1].split(",")
+    assert float(row[header.index("Inferences/Second")]) > 0
+
+
 def test_libcshm_ctypes(native_build):
     """The C shm extension loads via ctypes and round-trips data
     (reference shared_memory ctypes bindings,
