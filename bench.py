@@ -201,9 +201,20 @@ def bert_flops_per_example(seq_len=128, hidden=768, n_layers=12, ffn=3072):
     return n_layers * per_layer
 
 
-def bench_bert_mfu(batch: int = 8, iters: int = 30):
-    """Flagship step time at the Model level (no scheduler) — pure
-    stage+execute+fetch of BERT-base batch 8, the denominator for MFU."""
+def bench_bert_mfu(batch: int = 8, iters: int = 30, pipeline_n: int = 100):
+    """Flagship BERT-base batch-8 at the Model level (no scheduler).
+
+    Two numbers with different denominators:
+
+    - **device step** (the MFU numerator): N jitted executions dispatched
+      back-to-back with one final host fetch, total/N.  Back-to-back dispatch
+      keeps the device pipeline full, so this converges on the executable's
+      true step time — what a TPU-VM-local server would see — instead of
+      charging the transport round trip (tens of ms through the dev tunnel)
+      to every step.
+    - **e2e step**: one stage+execute+fetch round trip per call, the
+      per-request serving latency on this transport.
+    """
     import numpy as np
 
     from client_tpu.engine.model import Model
@@ -227,16 +238,37 @@ def bench_bert_mfu(batch: int = 8, iters: int = 30):
         times.append((phases.output_end - phases.start) / 1e9)
     times.sort()
     # median end-to-end (stage+infer+fetch) — what serving actually gets
-    step = times[len(times) // 2]
+    e2e_step = times[len(times) // 2]
+
+    # Pipelined device step: params/inputs device-resident, N async
+    # dispatches, one fetch. Subtract one fetch round trip (measured as the
+    # n=1 time) so the fixed transport latency isn't amortized into the step.
+    import jax
+
+    apply_j = model._apply
+    params = model._params
+    staged = {k: jax.device_put(v) for k, v in inputs.items()}
+    np.asarray(apply_j(params, staged)["logits"])  # warm
+    t0 = time.perf_counter()
+    np.asarray(apply_j(params, staged)["logits"])
+    t_one = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    r = None
+    for _ in range(pipeline_n):
+        r = apply_j(params, staged)
+    np.asarray(r["logits"])
+    t_total = time.perf_counter() - t0
+    step = max(t_total - t_one, 1e-9) / max(pipeline_n - 1, 1)
+
     flops = bert_flops_per_example() * batch
     achieved = flops / step
     peak = peak_flops()
     mfu = achieved / peak if peak else None
-    log(f"bert: median step {step * 1e3:.2f}ms, achieved "
-        f"{achieved / 1e12:.2f} TFLOP/s"
+    log(f"bert: device step {step * 1e3:.2f}ms ({achieved / 1e12:.2f} "
+        f"TFLOP/s pipelined), e2e step {e2e_step * 1e3:.2f}ms"
         + (f", MFU {mfu * 100:.1f}% of {peak / 1e12:.0f} TFLOP/s peak"
            if peak else " (no peak known for platform; MFU omitted)"))
-    return batch / step, mfu, step
+    return batch / e2e_step, mfu, step, e2e_step
 
 
 def main():
@@ -244,10 +276,10 @@ def main():
     platform = devices[0].platform
     ips, p99_us = bench_inproc_simple()
     try:
-        bert_ips, mfu, bert_step_s = bench_bert_mfu()
+        bert_ips, mfu, bert_step_s, bert_e2e_s = bench_bert_mfu()
     except Exception as exc:  # noqa: BLE001 — headline metric still reports
         log(f"bert mfu measurement failed: {exc!r}")
-        bert_ips, mfu, bert_step_s = None, None, None
+        bert_ips, mfu, bert_step_s, bert_e2e_s = None, None, None, None
     try:
         tpushm_ips = bench_tpushm_simple()
     except Exception as exc:  # noqa: BLE001
@@ -292,6 +324,7 @@ def main():
     if bert_ips is not None:
         out["bert_b8_ips"] = round(bert_ips, 2)
         out["bert_b8_step_ms"] = round(bert_step_s * 1e3, 3)
+        out["bert_b8_e2e_ms"] = round(bert_e2e_s * 1e3, 3)
     if mfu is not None:
         out["bert_b8_mfu"] = round(mfu, 4)
     if tpushm_ips is not None:

@@ -27,7 +27,7 @@ import os
 import numpy as np
 
 from client_tpu.engine import InferRequest, TpuEngine
-from client_tpu.engine.types import OutputRequest
+from client_tpu.engine.types import EngineError, OutputRequest
 from client_tpu.models import build_repository
 from client_tpu.protocol.codec import (
     deserialize_bytes_tensor,
@@ -94,6 +94,13 @@ def unregister_tpu_shm(engine: TpuEngine, name: str = "") -> None:
 
 def _read_shm_input(engine: TpuEngine, meta: dict) -> np.ndarray:
     p = meta.get("parameters") or {}
+    if "shared_memory_region" not in p:
+        # data=NULL is the C API's shm marker (tpu_server_capi.h); a NULL
+        # buffer without the parameters is a caller wiring bug — surface it
+        # as a clean 400, not a KeyError traceback.
+        raise EngineError(
+            f"input '{meta.get('name')}': NULL data pointer but no "
+            "shared_memory_region/byte_size parameters", 400)
     return engine.read_shm_tensor(
         p["shared_memory_region"], int(p.get("shared_memory_offset", 0)),
         int(p.get("shared_memory_byte_size", 0)), meta["datatype"],

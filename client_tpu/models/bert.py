@@ -109,12 +109,20 @@ class BertBackend(ModelBackend):
             })
         return params
 
-    def make_apply(self):
-        return self._build_apply(self._init_params())
+    def place_params(self, params):
+        """Device placement for the weights (sharded in subclasses)."""
+        import jax
 
-    def _build_apply(self, params, constrain=None):
-        """Build the pure apply over a (possibly sharded) params pytree.
+        return jax.device_put(params)
 
+    def make_apply_params(self):
+        return self._build_apply(), self.place_params(self._init_params())
+
+    def _build_apply(self, constrain=None):
+        """Build the pure ``apply(params, inputs)`` over a params pytree.
+
+        Params are a jit *argument* (engine passes the placed tree each call),
+        not closure constants — see ModelBackend.make_apply_params for why.
         ``constrain(x, spec)`` inserts sharding constraints at activation
         boundaries for multi-chip serving (ShardedBertBackend); None means
         single-device and the hooks are no-ops.
@@ -156,7 +164,7 @@ class BertBackend(ModelBackend):
             ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, h)
             return proj(ctx, lp["wo"])
 
-        def apply(inputs):
+        def apply(params, inputs):
             import jax
             import jax.numpy as jnp
 

@@ -27,7 +27,7 @@ class AddSubBackend(ModelBackend):
     """INT32[16] -> OUTPUT0=sum, OUTPUT1=diff. The canonical `simple` model."""
 
     def __init__(self, name: str = "simple", n: int = 16,
-                 max_batch_size: int = 8):
+                 max_batch_size: int = 64):
         self.config = ModelConfig(
             name=name,
             platform="jax",
@@ -41,9 +41,15 @@ class AddSubBackend(ModelBackend):
                 TensorConfig("OUTPUT1", "INT32", [n]),
             ],
             dynamic_batching=DynamicBatchingConfig(
-                preferred_batch_size=[4, max_batch_size],
+                preferred_batch_size=[8, max_batch_size],
                 max_queue_delay_microseconds=100,
             ),
+            # A deep batching ceiling matters more than compute here: each
+            # device round trip has fixed transport latency (tens of ms when
+            # the chip sits behind a network tunnel), so throughput scales
+            # with how many requests ride one dispatch.  Small bucket set
+            # keeps warmup compiles cheap.
+            batch_buckets=[1, 8, 64],
             # Several executor instances keep multiple batches in flight so
             # device round-trips overlap (the device transport pipelines
             # concurrent dispatch+fetch; serialized batches leave it idle).

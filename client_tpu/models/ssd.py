@@ -122,8 +122,6 @@ class SsdMobileNetV2Backend(ModelBackend):
                     "bn2": _bn_params(nk(), mid, dt),
                     "wp": _conv_init(nk(), 1, 1, mid, cout, dt),
                     "bn3": _bn_params(nk(), cout, dt),
-                    "stride": stride if i == 0 else 1,
-                    "residual": (i > 0 or stride == 1) and cin == cout,
                 }
                 if expansion != 1:
                     blk["we"] = _conv_init(nk(), 1, 1, cin, mid, dt)
@@ -147,18 +145,28 @@ class SsdMobileNetV2Backend(ModelBackend):
             })
         return params
 
-    def make_apply(self):
+    def make_apply_params(self):
         import jax
 
-        params = self._init_params()
         anchors = self._anchors
         n_anchors_total = anchors.shape[0]
+        # Per-block static structure (conv strides, residual flags) stays
+        # host-side: it parameterizes the traced program and must not ride in
+        # the params argument, where leaves become traced arrays.
+        statics = []
+        cin = 32
+        for expansion, cout, n, stride in _MBV2_SPEC:
+            for i in range(n):
+                statics.append((stride if i == 0 else 1,
+                                (i > 0 or stride == 1) and cin == cout))
+                cin = cout
 
-        def backbone_feats(x):
+        def backbone_feats(params, x):
             feats = []
             y = jax.nn.relu6(_bn(_conv(x, params["stem"]["w"], stride=2),
                                  params["stem"]["bn"]))
-            for bi, blk in enumerate(params["blocks"]):
+            for bi, (blk, (stride, residual)) in enumerate(
+                    zip(params["blocks"], statics)):
                 inp = y
                 if "we" in blk:
                     expanded = jax.nn.relu6(
@@ -167,10 +175,10 @@ class SsdMobileNetV2Backend(ModelBackend):
                     expanded = y
                 mid = expanded.shape[-1]
                 y = jax.nn.relu6(_bn(
-                    _conv(expanded, blk["wd"], stride=blk["stride"],
+                    _conv(expanded, blk["wd"], stride=stride,
                           feature_group_count=mid), blk["bn2"]))
                 y = _bn(_conv(y, blk["wp"]), blk["bn3"])
-                if blk["residual"]:
+                if residual:
                     y = y + inp
                 if bi == 13 and "we" in blk:
                     # 19x19 tap: expansion conv of the first 160-stage block
@@ -237,7 +245,7 @@ class SsdMobileNetV2Backend(ModelBackend):
             count = jnp.sum((out_scores > 0).astype(jnp.float32))
             return out_boxes, out_cls, out_scores, count
 
-        def apply(inputs):
+        def apply(params, inputs):
             import jax.numpy as jnp
 
             # Engine always supplies the batch dim when max_batch_size > 0
@@ -246,7 +254,7 @@ class SsdMobileNetV2Backend(ModelBackend):
             # singleton is inserted per sample below.
             img = inputs["normalized_input_image_tensor"]
             x = (img.astype(jnp.bfloat16) - 127.5) / 127.5
-            feats = backbone_feats(x)
+            feats = backbone_feats(params, x)
 
             b = x.shape[0]
             box_parts, cls_parts = [], []
@@ -270,7 +278,7 @@ class SsdMobileNetV2Backend(ModelBackend):
                 "TFLite_Detection_PostProcess:3": count[:, None],
             }
 
-        return apply
+        return apply, jax.device_put(self._init_params())
 
 
 class SsdMobileNetV2TpuBackend(SsdMobileNetV2Backend):

@@ -158,12 +158,10 @@ class ResNet50Backend(ModelBackend):
         }
         return params
 
-    def make_apply(self):
-        params = self._init_params()
+    def make_apply_params(self):
+        import jax
 
         def bottleneck(x, blk, stride):
-            import jax
-
             y = jax.nn.relu(_bn(_conv(x, blk["w1"]), blk["bn1"]))
             y = jax.nn.relu(_bn(_conv(y, blk["w2"], stride=stride), blk["bn2"]))
             y = _bn(_conv(y, blk["w3"]), blk["bn3"])
@@ -171,7 +169,7 @@ class ResNet50Backend(ModelBackend):
                 x = _bn(_conv(x, blk["wproj"], stride=stride), blk["bnproj"])
             return jax.nn.relu(x + y)
 
-        def apply(inputs):
+        def apply(params, inputs):
             import jax
             import jax.numpy as jnp
 
@@ -188,7 +186,7 @@ class ResNet50Backend(ModelBackend):
             logits = pooled @ fc["w"].astype(jnp.float32) + fc["b"]
             return {"OUTPUT": logits}
 
-        return apply
+        return apply, jax.device_put(self._init_params())
 
 
 # ---------------------------------------------------------------------------
@@ -266,17 +264,15 @@ class DenseNet121Backend(ModelBackend):
         }
         return params
 
-    def make_apply(self):
-        params = self._init_params()
+    def make_apply_params(self):
+        import jax
 
         def dense_layer(x, lyr):
-            import jax
-
             y = _conv(jax.nn.relu(_bn(x, lyr["bn1"])), lyr["w1"])
             y = _conv(jax.nn.relu(_bn(y, lyr["bn2"])), lyr["w2"])
             return y
 
-        def apply(inputs):
+        def apply(params, inputs):
             import jax
             import jax.numpy as jnp
 
@@ -298,7 +294,7 @@ class DenseNet121Backend(ModelBackend):
             logits = pooled @ fc["w"].astype(jnp.float32) + fc["b"]
             return {"OUTPUT": logits}
 
-        return apply
+        return apply, jax.device_put(self._init_params())
 
 
 def _avg_pool2(x):

@@ -93,12 +93,11 @@ class ShardedBertBackend(BertBackend):
         self.input_shardings = {"input_ids": batch_spec,
                                 "attention_mask": batch_spec}
 
-    def make_apply(self):
+    def place_params(self, params):
         import jax
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
-        params = self._init_params()
         specs = bert_param_specs(P, self.n_layers)
         mesh = self.mesh
 
@@ -108,7 +107,14 @@ class ShardedBertBackend(BertBackend):
                 s = P(*(a if a != "tp" else None for a in s))
             return jax.device_put(x, NamedSharding(mesh, s))
 
-        params = jax.tree.map(place, params, specs)
+        return jax.tree.map(place, params, specs)
+
+    def make_apply_params(self):
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
 
         def constrain(x, spec):
             # Drop axes the mesh doesn't carry (a dp-only mesh ignores tp).
@@ -117,7 +123,8 @@ class ShardedBertBackend(BertBackend):
             return jax.lax.with_sharding_constraint(
                 x, NamedSharding(mesh, P(*spec)))
 
-        return self._build_apply(params, constrain=constrain)
+        return (self._build_apply(constrain=constrain),
+                self.place_params(self._init_params()))
 
 
 # Zoo registration: opt-in (default=False) — a default load-all server

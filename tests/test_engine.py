@@ -47,8 +47,8 @@ class TestMetadata:
 
     def test_model_config(self, engine):
         cfg = engine.model_config("simple")
-        assert cfg["max_batch_size"] == 8
-        assert cfg["dynamic_batching"]["preferred_batch_size"] == [4, 8]
+        assert cfg["max_batch_size"] == 64
+        assert cfg["dynamic_batching"]["preferred_batch_size"] == [8, 64]
 
     def test_unknown_model_404(self, engine):
         with pytest.raises(EngineError) as ei:
@@ -100,7 +100,7 @@ class TestAddSub:
             _infer(engine, "simple", {"INPUT0": a, "INPUT1": a})
 
     def test_batch_too_large(self, engine):
-        a = np.zeros((9, 16), dtype=np.int32)
+        a = np.zeros((65, 16), dtype=np.int32)
         with pytest.raises(EngineError):
             _infer(engine, "simple", {"INPUT0": a, "INPUT1": a})
 

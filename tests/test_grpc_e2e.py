@@ -64,8 +64,8 @@ class TestControlPlane:
 
     def test_model_config(self, client):
         cfg = client.get_model_config("simple")
-        assert cfg.config.max_batch_size == 8
-        assert list(cfg.config.dynamic_batching.preferred_batch_size) == [4, 8]
+        assert cfg.config.max_batch_size == 64
+        assert list(cfg.config.dynamic_batching.preferred_batch_size) == [8, 64]
 
     def test_repository(self, client):
         idx = client.get_model_repository_index()

@@ -63,7 +63,7 @@ class TestControlPlane:
 
     def test_model_config(self, client):
         cfg = client.get_model_config("simple")
-        assert cfg["max_batch_size"] == 8
+        assert cfg["max_batch_size"] == 64
 
     def test_repository_index(self, client):
         idx = client.get_model_repository_index()
