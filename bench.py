@@ -245,17 +245,16 @@ def bench_bert_mfu(batch: int = 8, iters: int = 30, pipeline_n: int = 100):
     # n=1 time) so the fixed transport latency isn't amortized into the step.
     import jax
 
-    apply_j = model._apply
-    params = model._params
+    apply_j = model.raw_apply()
     staged = {k: jax.device_put(v) for k, v in inputs.items()}
-    np.asarray(apply_j(params, staged)["logits"])  # warm
+    np.asarray(apply_j(staged)["logits"])  # warm
     t0 = time.perf_counter()
-    np.asarray(apply_j(params, staged)["logits"])
+    np.asarray(apply_j(staged)["logits"])
     t_one = time.perf_counter() - t0
     t0 = time.perf_counter()
     r = None
     for _ in range(pipeline_n):
-        r = apply_j(params, staged)
+        r = apply_j(staged)
     np.asarray(r["logits"])
     t_total = time.perf_counter() - t0
     step = max(t_total - t_one, 1e-9) / max(pipeline_n - 1, 1)

@@ -129,6 +129,18 @@ class Model:
         self._states: dict[int, str] = {}
         self._compiled: set = set()  # input-signature tuples already traced
 
+    def raw_apply(self) -> Callable[[dict], Any]:
+        """The jitted executable with the calling convention resolved:
+        ``raw_apply()(staged_inputs)`` regardless of whether weights travel
+        as a jit argument. For benchmarking/diagnostics that bypass the
+        scheduler; staging and fetch are the caller's business."""
+        if self._apply is None:
+            raise EngineError(
+                f"model '{self.config.name}' has no executable", 500)
+        if self._takes_params:
+            return lambda inputs: self._apply(self._params, inputs)
+        return self._apply
+
     @property
     def state(self) -> str:
         """Summary of in-flight executions ('idle' when none)."""

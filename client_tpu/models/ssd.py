@@ -53,6 +53,22 @@ _FEATURE_MAPS = ((19, 3), (10, 6), (5, 6), (3, 6), (2, 6), (1, 6))
 _SCALES = (0.2, 0.35, 0.5, 0.65, 0.8, 0.95)
 
 
+def _mbv2_blocks():
+    """Flattened per-block structure of ``_MBV2_SPEC``:
+    (cin, cout, expansion, stride, residual) — the single source both the
+    weight init and the traced apply iterate, so the params list and the
+    static stride/residual flags can't drift out of lockstep."""
+    out = []
+    cin = 32
+    for expansion, cout, n, stride in _MBV2_SPEC:
+        for i in range(n):
+            out.append((cin, cout, expansion,
+                        stride if i == 0 else 1,
+                        (i > 0 or stride == 1) and cin == cout))
+            cin = cout
+    return out
+
+
 def _make_anchors():
     """Static [N,4] anchor boxes (cy, cx, h, w) in normalized coords."""
     all_anchors = []
@@ -113,20 +129,19 @@ class SsdMobileNetV2Backend(ModelBackend):
                            "bn": _bn_params(nk(), 32, dt)},
                   "blocks": [], "heads": [], "extras": []}
         cin = 32
-        for expansion, cout, n, stride in _MBV2_SPEC:
-            for i in range(n):
-                mid = cin * expansion
-                blk = {
-                    "bn1": _bn_params(nk(), mid, dt),
-                    "wd": _conv_init(nk(), 3, 3, 1, mid, dt),  # depthwise HWI(1)O
-                    "bn2": _bn_params(nk(), mid, dt),
-                    "wp": _conv_init(nk(), 1, 1, mid, cout, dt),
-                    "bn3": _bn_params(nk(), cout, dt),
-                }
-                if expansion != 1:
-                    blk["we"] = _conv_init(nk(), 1, 1, cin, mid, dt)
-                params["blocks"].append(blk)
-                cin = cout
+        for cin, cout, expansion, _stride, _residual in _mbv2_blocks():
+            mid = cin * expansion
+            blk = {
+                "bn1": _bn_params(nk(), mid, dt),
+                "wd": _conv_init(nk(), 3, 3, 1, mid, dt),  # depthwise HWI(1)O
+                "bn2": _bn_params(nk(), mid, dt),
+                "wp": _conv_init(nk(), 1, 1, mid, cout, dt),
+                "bn3": _bn_params(nk(), cout, dt),
+            }
+            if expansion != 1:
+                blk["we"] = _conv_init(nk(), 1, 1, cin, mid, dt)
+            params["blocks"].append(blk)
+        cin = _mbv2_blocks()[-1][1]
         # extra feature layers down to 1x1 (channels cin -> 256 each)
         for _ in range(len(_FEATURE_MAPS) - 2):
             params["extras"].append({
@@ -153,13 +168,8 @@ class SsdMobileNetV2Backend(ModelBackend):
         # Per-block static structure (conv strides, residual flags) stays
         # host-side: it parameterizes the traced program and must not ride in
         # the params argument, where leaves become traced arrays.
-        statics = []
-        cin = 32
-        for expansion, cout, n, stride in _MBV2_SPEC:
-            for i in range(n):
-                statics.append((stride if i == 0 else 1,
-                                (i > 0 or stride == 1) and cin == cout))
-                cin = cout
+        statics = [(stride, residual)
+                   for _cin, _cout, _exp, stride, residual in _mbv2_blocks()]
 
         def backbone_feats(params, x):
             feats = []

@@ -167,7 +167,14 @@ static char* JsonCall(TpuServer* server, const char* fn, const char* a1,
     err = DupString(error);
   } else {
     const char* c = PyUnicode_AsUTF8(result);
-    *json_out = DupString(c ? c : "{}");
+    if (c == nullptr) {
+      // Non-string return: PyUnicode_AsUTF8 raised — clear it so the
+      // pending exception can't poison the next C-API call on this thread.
+      PyErr_Clear();
+      err = DupString("embed function returned a non-string result");
+    } else {
+      *json_out = DupString(c);
+    }
     Py_DECREF(result);
   }
   PyGILState_Release(gil);

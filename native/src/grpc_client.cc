@@ -136,7 +136,30 @@ h2::HeaderList CallHeaders(const std::string& authority,
       {"user-agent", "tpuclient-grpc/1.0"},
   };
   if (timeout_us > 0) {
-    h.emplace_back("grpc-timeout", std::to_string(timeout_us) + "u");
+    // gRPC-over-HTTP/2 caps TimeoutValue at 8 ASCII digits; scale to
+    // coarser units (m/S/M) when microseconds would overflow that, the
+    // same way grpc-core's timeout encoder does.
+    uint64_t v = timeout_us;
+    const char* unit = "u";
+    if (v > 99999999ULL) {
+      v = (v + 999) / 1000;  // milliseconds, round up
+      unit = "m";
+    }
+    if (v > 99999999ULL) {
+      v = (v + 999) / 1000;  // seconds
+      unit = "S";
+    }
+    if (v > 99999999ULL) {
+      v = (v + 59) / 60;  // minutes
+      unit = "M";
+    }
+    if (v > 99999999ULL) {
+      v = (v + 59) / 60;  // hours
+      unit = "H";
+    }
+    // Coarsest unit exhausted: clamp like grpc-core ("infinite" deadline).
+    if (v > 99999999ULL) v = 99999999ULL;
+    h.emplace_back("grpc-timeout", std::to_string(v) + unit);
   }
   for (const auto& kv : extra) h.emplace_back(kv.first, kv.second);
   return h;
@@ -291,12 +314,29 @@ Error InferenceServerGrpcClient::Connect(const std::string& url,
   if (scheme != std::string::npos) hostport = hostport.substr(scheme + 3);
   std::string host = hostport;
   int port = 8001;
-  auto colon = hostport.rfind(':');
-  if (colon != std::string::npos) {
-    host = hostport.substr(0, colon);
-    port = atoi(hostport.c_str() + colon + 1);
+  if (!hostport.empty() && hostport[0] == '[') {
+    // Bracketed IPv6 literal: "[::1]:8001" — split after the bracket and
+    // strip it so getaddrinfo sees the bare address.
+    auto rb = hostport.find(']');
+    if (rb != std::string::npos) {
+      host = hostport.substr(1, rb - 1);
+      if (rb + 1 < hostport.size() && hostport[rb + 1] == ':') {
+        port = atoi(hostport.c_str() + rb + 2);
+      }
+    }
+  } else if (std::count(hostport.begin(), hostport.end(), ':') > 1) {
+    // Bare IPv6 literal ("::1") — no port suffix to split off.
+    host = hostport;
+  } else {
+    auto colon = hostport.rfind(':');
+    if (colon != std::string::npos) {
+      host = hostport.substr(0, colon);
+      port = atoi(hostport.c_str() + colon + 1);
+    }
   }
-  authority_ = host + ":" + std::to_string(port);
+  authority_ = host.find(':') != std::string::npos
+                   ? "[" + host + "]:" + std::to_string(port)
+                   : host + ":" + std::to_string(port);
 
   if (use_cached_channel) {
     {

@@ -315,6 +315,11 @@ Error HpackDecoder::Decode(const uint8_t* data, size_t len, HeaderList* out) {
     } else if ((b & 0xE0) == 0x20) {  // Dynamic Table Size Update (§6.3)
       err = ReadInt(data, len, &pos, 5, &index);
       if (!err.IsOk()) return err;
+      if (index > configured_max_) {
+        return Error("HPACK dynamic table size update " +
+                     std::to_string(index) + " exceeds configured limit " +
+                     std::to_string(configured_max_));
+      }
       max_dynamic_size_ = index;
       EvictToFit();
     } else {  // Literal without Indexing / Never Indexed (§6.2.2/§6.2.3)
@@ -623,6 +628,13 @@ void Connection::ReaderLoop() {
 
 void Connection::HandleFrame(uint8_t type, uint8_t flags, int32_t sid,
                              const uint8_t* payload, size_t len) {
+  // RFC 7540 §4.3: a header block (HEADERS .. CONTINUATIONs) is a single
+  // unit; any other frame interleaved before END_HEADERS is a connection
+  // error. Silently accepting one would desync the shared HPACK decoder.
+  if (continuation_sid_ != 0 &&
+      (type != kContinuation || sid != continuation_sid_)) {
+    return FailConnection("frame interleaved inside a header block");
+  }
   switch (type) {
     case kData: {
       size_t off = 0, dlen = len;

@@ -42,7 +42,8 @@ void HpackEncode(const HeaderList& headers, std::string* out);
 class HpackDecoder {
  public:
   explicit HpackDecoder(size_t max_dynamic_size = 4096)
-      : max_dynamic_size_(max_dynamic_size) {}
+      : max_dynamic_size_(max_dynamic_size),
+        configured_max_(max_dynamic_size) {}
 
   // Decodes one complete header block (HEADERS + CONTINUATIONs payload).
   Error Decode(const uint8_t* data, size_t len, HeaderList* out);
@@ -59,6 +60,11 @@ class HpackDecoder {
   std::deque<std::pair<std::string, std::string>> dynamic_;  // newest front
   size_t dynamic_size_ = 0;
   size_t max_dynamic_size_;
+  // Ceiling for Dynamic Table Size Updates (RFC 7541 §6.3): since we never
+  // advertise SETTINGS_HEADER_TABLE_SIZE, a peer may not raise the table
+  // beyond the configured default — otherwise it could grow client memory
+  // without bound via incremental-indexing literals.
+  size_t configured_max_;
 };
 
 // Huffman primitives exposed for unit tests.

@@ -247,6 +247,20 @@ static void TestHpack() {
                               {"custom-key", "custom-value"}};
     CHECK(h == expect3);
   }
+  {
+    // RFC 7541 §6.3: a Dynamic Table Size Update above the decoder's
+    // configured limit is a connection error, not an allocation grant.
+    h2::HpackDecoder small(64);
+    // Update to exactly the configured limit (5-bit prefix: 31 + 33 = 64)
+    // must be accepted — guards the > vs >= boundary.
+    const uint8_t shrink[] = {0x3f, 0x21, 0x82};  // update to 64, then GET
+    h2::HeaderList h;
+    CHECK(small.Decode(shrink, sizeof(shrink), &h).IsOk());
+    // 5-bit prefix int 8192 = 0x3f followed by varint(8192-31)
+    const uint8_t grow[] = {0x3f, 0xe1, 0x3f, 0x82};
+    h2::HeaderList h2l;
+    CHECK(!small.Decode(grow, sizeof(grow), &h2l).IsOk());
+  }
 }
 
 int main() {
