@@ -420,17 +420,39 @@ class _Servicer(GRPCInferenceServiceServicer):
 
 class GrpcInferenceServer:
     def __init__(self, engine: TpuEngine, host: str = "127.0.0.1",
-                 port: int = 8001, max_workers: int = 16):
+                 port: int = 8001, max_workers: int = 16,
+                 certfile: str | None = None, keyfile: str | None = None):
         self.engine = engine
         self.server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=[
                 ("grpc.max_send_message_length", -1),
                 ("grpc.max_receive_message_length", -1),
+                # Tolerate client transport keepalive (KeepAliveOptions on
+                # the native client): without these, gRPC core's default
+                # policy GOAWAYs "too_many_pings" after 2 data-less pings,
+                # killing exactly the idle channels keepalive protects.
+                ("grpc.keepalive_permit_without_calls", 1),
+                ("grpc.http2.min_ping_interval_without_data_ms", 500),
+                ("grpc.http2.max_ping_strikes", 0),
             ])
         add_GRPCInferenceServiceServicer_to_server(_Servicer(engine),
                                                    self.server)
-        self.port = self.server.add_insecure_port(f"{host}:{port}")
+        if certfile:
+            # TLS endpoint for grpcs:// clients (reference SslOptions path).
+            if not keyfile:
+                raise ValueError(
+                    "GrpcInferenceServer: certfile requires keyfile "
+                    "(grpc.ssl_server_credentials takes the key and the "
+                    "certificate chain as separate PEMs)")
+            with open(keyfile, "rb") as f:
+                key = f.read()
+            with open(certfile, "rb") as f:
+                crt = f.read()
+            creds = grpc.ssl_server_credentials([(key, crt)])
+            self.port = self.server.add_secure_port(f"{host}:{port}", creds)
+        else:
+            self.port = self.server.add_insecure_port(f"{host}:{port}")
         self.host = host
 
     @property

@@ -359,7 +359,8 @@ class HttpInferenceServer:
     """Threaded v2 REST server over a TpuEngine."""
 
     def __init__(self, engine: TpuEngine, host: str = "127.0.0.1",
-                 port: int = 8000, verbose: bool = False):
+                 port: int = 8000, verbose: bool = False,
+                 certfile: str | None = None, keyfile: str | None = None):
         handler = type("BoundHandler", (_Handler,),
                        {"engine": engine, "verbose": verbose})
         self.engine = engine
@@ -368,6 +369,15 @@ class HttpInferenceServer:
         server_cls = type("_Httpd", (ThreadingHTTPServer,),
                           {"request_queue_size": 128})
         self.httpd = server_cls((host, port), handler)
+        if certfile:
+            # HTTPS endpoint (exercised by the native client's https://
+            # support; the reference terminates TLS in libcurl).
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            self.httpd.socket = ctx.wrap_socket(self.httpd.socket,
+                                                server_side=True)
         self.httpd.daemon_threads = True
         self._thread: threading.Thread | None = None
 

@@ -26,8 +26,9 @@ namespace tc = tpuclient;
 int main(int argc, char** argv) {
   std::string url = "localhost:8001";
   bool verbose = false;
+  std::string ca_file;  // -C: CA bundle; implies TLS (as does grpcs://)
   int opt;
-  while ((opt = getopt(argc, argv, "vu:")) != -1) {
+  while ((opt = getopt(argc, argv, "vu:C:")) != -1) {
     switch (opt) {
       case 'u':
         url = optarg;
@@ -35,16 +36,24 @@ int main(int argc, char** argv) {
       case 'v':
         verbose = true;
         break;
+      case 'C':
+        ca_file = optarg;
+        break;
       default:
-        std::cerr << "usage: " << argv[0] << " [-v] [-u host:port]"
-                  << std::endl;
+        std::cerr << "usage: " << argv[0]
+                  << " [-v] [-u host:port] [-C ca.pem]" << std::endl;
         return 2;
     }
   }
 
+  tc::SslOptions ssl;
+  ssl.root_certificates = ca_file;
   std::unique_ptr<tc::InferenceServerGrpcClient> client;
-  FAIL_IF_ERR(tc::InferenceServerGrpcClient::Create(&client, url, verbose),
-              "unable to create client");
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url, verbose,
+                                            /*use_cached_channel=*/true,
+                                            /*use_ssl=*/!ca_file.empty(), ssl),
+      "unable to create client");
 
   bool live = false;
   FAIL_IF_ERR(client->IsServerLive(&live), "server live check");

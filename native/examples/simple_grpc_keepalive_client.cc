@@ -1,0 +1,115 @@
+// gRPC keepalive conformance client.
+//
+// Counterpart of the reference's simple_grpc_keepalive_client
+// (/root/reference/src/c++/examples/simple_grpc_keepalive_client.cc):
+// creates a channel with aggressive KeepAliveOptions, idles across several
+// ping periods, then infers — proving the transport-level PING/ack cycle
+// keeps the connection healthy instead of letting it rot. Exit 0 only if
+// the post-idle inference round-trips with correct values.
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdint>
+#include <iostream>
+#include <thread>
+#include <vector>
+
+#include "tpuclient/grpc_client.h"
+
+namespace tc = tpuclient;
+
+#define FAIL_IF_ERR(X, MSG)                                            \
+  do {                                                                 \
+    tc::Error err__ = (X);                                             \
+    if (!err__.IsOk()) {                                               \
+      std::cerr << "error: " << (MSG) << ": " << err__ << std::endl;   \
+      exit(1);                                                         \
+    }                                                                  \
+  } while (false)
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8001";
+  bool verbose = false;
+  int opt;
+  while ((opt = getopt(argc, argv, "vu:")) != -1) {
+    switch (opt) {
+      case 'u':
+        url = optarg;
+        break;
+      case 'v':
+        verbose = true;
+        break;
+      default:
+        std::cerr << "usage: " << argv[0] << " [-v] [-u host:port]"
+                  << std::endl;
+        return 2;
+    }
+  }
+
+  // Reference values: keepalive_time 1s, timeout 1s, ping when idle
+  // (permit_without_calls), unlimited data-less pings.
+  tc::KeepAliveOptions keepalive;
+  keepalive.keepalive_time_ms = 1000;
+  keepalive.keepalive_timeout_ms = 1000;
+  keepalive.keepalive_permit_without_calls = true;
+  keepalive.http2_max_pings_without_data = 0;
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  // Dedicated (uncached) channel so this client's keepalive cadence can't
+  // leak into other tests' shared channel.
+  FAIL_IF_ERR(tc::InferenceServerGrpcClient::Create(
+                  &client, url, verbose, /*use_cached_channel=*/false,
+                  /*use_ssl=*/false, tc::SslOptions(), keepalive),
+              "unable to create keepalive client");
+
+  bool live = false;
+  FAIL_IF_ERR(client->IsServerLive(&live), "server live check");
+  if (!live) {
+    std::cerr << "error: server not live" << std::endl;
+    return 1;
+  }
+
+  // Idle across ~3 ping periods: with keepalive_time_ms=1000 the transport
+  // must exchange PINGs during this window or fail the connection.
+  std::this_thread::sleep_for(std::chrono::milliseconds(3200));
+
+  std::vector<int32_t> in0(16), in1(16);
+  for (int i = 0; i < 16; ++i) {
+    in0[i] = i;
+    in1[i] = 2;
+  }
+  tc::InferInput* input0;
+  tc::InferInput* input1;
+  FAIL_IF_ERR(tc::InferInput::Create(&input0, "INPUT0", {1, 16}, "INT32"),
+              "create INPUT0");
+  FAIL_IF_ERR(tc::InferInput::Create(&input1, "INPUT1", {1, 16}, "INT32"),
+              "create INPUT1");
+  std::unique_ptr<tc::InferInput> i0(input0), i1(input1);
+  FAIL_IF_ERR(input0->AppendRaw(reinterpret_cast<uint8_t*>(in0.data()),
+                                in0.size() * sizeof(int32_t)),
+              "INPUT0 data");
+  FAIL_IF_ERR(input1->AppendRaw(reinterpret_cast<uint8_t*>(in1.data()),
+                                in1.size() * sizeof(int32_t)),
+              "INPUT1 data");
+
+  tc::InferOptions options("simple");
+  tc::InferResult* result;
+  FAIL_IF_ERR(client->Infer(&result, options, {input0, input1}),
+              "infer after idle");
+  std::unique_ptr<tc::InferResult> owner(result);
+  FAIL_IF_ERR(result->RequestStatus(), "request status");
+
+  const uint8_t* buf;
+  size_t n;
+  FAIL_IF_ERR(result->RawData("OUTPUT0", &buf, &n), "OUTPUT0 data");
+  const int32_t* vals = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) {
+    if (vals[i] != in0[i] + in1[i]) {
+      std::cerr << "error: OUTPUT0[" << i << "] = " << vals[i] << ", expected "
+                << in0[i] + in1[i] << std::endl;
+      return 1;
+    }
+  }
+  std::cout << "PASS : keepalive" << std::endl;
+  return 0;
+}

@@ -28,8 +28,10 @@ namespace tc = tpuclient;
 int main(int argc, char** argv) {
   std::string url = "localhost:8000";
   bool verbose = false;
+  std::string ca_file;  // -C: CA bundle for https:// URLs
+  auto compress = tc::InferenceServerHttpClient::CompressionType::NONE;
   int opt;
-  while ((opt = getopt(argc, argv, "vu:")) != -1) {
+  while ((opt = getopt(argc, argv, "vu:C:z:")) != -1) {
     switch (opt) {
       case 'u':
         url = optarg;
@@ -37,16 +39,30 @@ int main(int argc, char** argv) {
       case 'v':
         verbose = true;
         break;
+      case 'C':
+        ca_file = optarg;
+        break;
+      case 'z':
+        if (std::string(optarg) == "gzip") {
+          compress = tc::InferenceServerHttpClient::CompressionType::GZIP;
+        } else if (std::string(optarg) == "deflate") {
+          compress = tc::InferenceServerHttpClient::CompressionType::DEFLATE;
+        }
+        break;
       default:
-        std::cerr << "usage: " << argv[0] << " [-v] [-u host:port]"
+        std::cerr << "usage: " << argv[0]
+                  << " [-v] [-u url] [-C ca.pem] [-z gzip|deflate]"
                   << std::endl;
         return 2;
     }
   }
 
+  tc::HttpSslOptions ssl;
+  ssl.ca_info = ca_file;
   std::unique_ptr<tc::InferenceServerHttpClient> client;
-  FAIL_IF_ERR(tc::InferenceServerHttpClient::Create(&client, url, verbose),
-              "unable to create client");
+  FAIL_IF_ERR(
+      tc::InferenceServerHttpClient::Create(&client, url, verbose, ssl),
+      "unable to create client");
 
   bool live = false;
   FAIL_IF_ERR(client->IsServerLive(&live), "server live check");
@@ -92,7 +108,7 @@ int main(int argc, char** argv) {
 
   tc::InferResult* result;
   FAIL_IF_ERR(client->Infer(&result, options, {input0, input1},
-                            {output0, output1}),
+                            {output0, output1}, {}, compress, compress),
               "infer");
   std::unique_ptr<tc::InferResult> result_owner(result);
   FAIL_IF_ERR(result->RequestStatus(), "request status");

@@ -17,6 +17,7 @@
 #pragma once
 
 #include <atomic>
+#include <climits>
 #include <deque>
 #include <map>
 #include <memory>
@@ -35,6 +36,24 @@ class Connection;
 }
 
 using GrpcHeaders = std::map<std::string, std::string>;
+
+// TLS settings for encrypted channels (reference SslOptions,
+// grpc_client.h:42-58): PEM file paths; empty root_certificates = system
+// default verify paths.
+struct SslOptions {
+  std::string root_certificates;  // server root CA bundle (PEM file)
+  std::string private_key;        // client private key (PEM file)
+  std::string certificate_chain;  // client certificate chain (PEM file)
+};
+
+// Transport keepalive (reference KeepAliveOptions, grpc_client.h:61-81,
+// semantics per gRPC core's keepalive doc): defaults disable pinging.
+struct KeepAliveOptions {
+  int keepalive_time_ms = INT_MAX;     // ping period; INT_MAX = off
+  int keepalive_timeout_ms = 20000;    // wait for ack before failing
+  bool keepalive_permit_without_calls = false;
+  int http2_max_pings_without_data = 2;  // 0 = unlimited
+};
 
 // Result wrapper over the response protobuf: output lookups index straight
 // into raw_output_contents with no copies (reference InferResultGrpc,
@@ -73,12 +92,19 @@ class InferResultGrpc : public InferResult {
 
 class InferenceServerGrpcClient : public InferenceServerClient {
  public:
-  // url: "host:port" (an "http://" prefix is tolerated and stripped).
+  // url: "host:port" (an "http://"/"grpc://" prefix is tolerated and
+  // stripped; "https://"/"grpcs://" implies use_ssl).
   // use_cached_channel: reuse one HTTP/2 connection per URL process-wide
-  // (reference grpc_client.cc:48-123 channel cache).
+  // (reference grpc_client.cc:48-123 channel cache; TLS and cleartext
+  // channels cache under distinct keys).
+  // use_ssl + ssl_options: TLS with ALPN "h2" (reference
+  // grpc_client.h:108-118). keepalive_options: transport PING keepalive.
   static Error Create(std::unique_ptr<InferenceServerGrpcClient>* client,
                       const std::string& url, bool verbose = false,
-                      bool use_cached_channel = true);
+                      bool use_cached_channel = true, bool use_ssl = false,
+                      const SslOptions& ssl_options = SslOptions(),
+                      const KeepAliveOptions& keepalive_options =
+                          KeepAliveOptions());
   ~InferenceServerGrpcClient() override;
 
   // -- control plane (reference grpc_client.h:125-312) --
@@ -137,7 +163,9 @@ class InferenceServerGrpcClient : public InferenceServerClient {
  private:
   explicit InferenceServerGrpcClient(bool verbose);
 
-  Error Connect(const std::string& url, bool use_cached_channel);
+  Error Connect(const std::string& url, bool use_cached_channel,
+                bool use_ssl, const SslOptions& ssl_options,
+                const KeepAliveOptions& keepalive_options);
   // Unary gRPC call: serialize request, open stream, send, await trailers.
   Error Rpc(const std::string& method,
             const google::protobuf::Message& request,

@@ -21,11 +21,23 @@
 
 #include "tpuclient/common.h"
 #include "tpuclient/json.h"
+#include "tpuclient/tls.h"
 
 namespace tpuclient {
 
 using Headers = std::map<std::string, std::string>;
 using Parameters = std::map<std::string, std::string>;
+
+// TLS settings for https:// endpoints. The reference client gets these
+// semantics from libcurl (CURLOPT_SSL_VERIFYPEER/-HOST, CURLOPT_CAINFO,
+// CURLOPT_SSLCERT/-KEY); exposed here explicitly.
+struct HttpSslOptions {
+  bool verify_peer = true;  // verify the server certificate chain
+  bool verify_host = true;  // match hostname against the certificate
+  std::string ca_info;      // CA bundle PEM path; empty = system defaults
+  std::string cert;         // client certificate PEM path
+  std::string key;          // client private key PEM path
+};
 
 // One pooled HTTP/1.1 keep-alive connection.
 class HttpConnection;
@@ -69,8 +81,15 @@ class InferResultHttp : public InferResult {
 
 class InferenceServerHttpClient : public InferenceServerClient {
  public:
+  // Request/response body compression (reference CompressionType +
+  // CompressData, http_client.cc:122-198; zlib deflate and gzip framings).
+  enum class CompressionType { NONE, DEFLATE, GZIP };
+
+  // server_url: "host:port", "http://host:port" or "https://host:port"
+  // (https implies TLS using ssl_options).
   static Error Create(std::unique_ptr<InferenceServerHttpClient>* client,
-                      const std::string& server_url, bool verbose = false);
+                      const std::string& server_url, bool verbose = false,
+                      const HttpSslOptions& ssl_options = HttpSslOptions());
   ~InferenceServerHttpClient() override;
 
   // -- control plane (reference http_client.h:112-341) ---------------------
@@ -119,10 +138,18 @@ class InferenceServerHttpClient : public InferenceServerClient {
                                   const Headers& headers = {});
 
   // -- inference -----------------------------------------------------------
+  // request_compression_algorithm: deflate/gzip-compress the request body
+  // (Content-Encoding); response_compression_algorithm: advertise
+  // Accept-Encoding and transparently decompress the response (reference
+  // http_client.h:354-373).
   Error Infer(InferResult** result, const InferOptions& options,
               const std::vector<InferInput*>& inputs,
               const std::vector<const InferRequestedOutput*>& outputs = {},
-              const Headers& headers = {});
+              const Headers& headers = {},
+              CompressionType request_compression_algorithm =
+                  CompressionType::NONE,
+              CompressionType response_compression_algorithm =
+                  CompressionType::NONE);
 
   // Async: request is sent on a worker connection; callback fires from the
   // worker thread (reference AsyncInfer + AsyncTransfer curl-multi loop,
@@ -131,7 +158,11 @@ class InferenceServerHttpClient : public InferenceServerClient {
   Error AsyncInfer(OnCompleteFn callback, const InferOptions& options,
                    const std::vector<InferInput*>& inputs,
                    const std::vector<const InferRequestedOutput*>& outputs = {},
-                   const Headers& headers = {});
+                   const Headers& headers = {},
+                   CompressionType request_compression_algorithm =
+                       CompressionType::NONE,
+                   CompressionType response_compression_algorithm =
+                       CompressionType::NONE);
 
   // Sizes the async worker-connection pool (one in-flight request per
   // worker). Takes effect for workers not yet spawned; call before the
@@ -147,7 +178,8 @@ class InferenceServerHttpClient : public InferenceServerClient {
              JsonPtr* response, const Headers& headers = {});
 
  private:
-  InferenceServerHttpClient(const std::string& host, int port, bool verbose);
+  InferenceServerHttpClient(const std::string& host, int port, bool verbose,
+                            const TlsOptions& tls);
 
   struct PreparedRequest {
     std::string path;
@@ -157,7 +189,17 @@ class InferenceServerHttpClient : public InferenceServerClient {
     std::vector<std::pair<const uint8_t*, size_t>> tail;
     size_t total_body = 0;
     uint64_t timeout_us = 0;
+    // Compression: when content_encoding is set, `compressed` replaces
+    // head+tail as the single body segment (header_length still names the
+    // uncompressed JSON head size for the server's split).
+    std::string compressed;
+    std::string content_encoding;
+    std::string accept_encoding;
   };
+
+  // Collapses prep's head+tail into one deflate/gzip body (reference
+  // CompressData, http_client.cc:122-198).
+  static Error CompressRequest(PreparedRequest* prep, CompressionType type);
 
   Error PrepareInferRequest(
       PreparedRequest* prep, const InferOptions& options,
@@ -187,6 +229,7 @@ class InferenceServerHttpClient : public InferenceServerClient {
 
   std::string host_;
   int port_;
+  TlsOptions tls_;
 
   std::mutex pool_mutex_;
   std::deque<std::unique_ptr<HttpConnection>> pool_;

@@ -1,0 +1,77 @@
+// TLS client transport shim shared by the HTTP/1.1 and HTTP/2 clients.
+//
+// Plays the role libcurl's TLS integration and grpc++'s SslCredentials play
+// for the reference clients (https URLs via CURLOPT defaults,
+// /root/reference/src/c++/library/http_client.cc; SslOptions
+// grpc_client.h:42-58). The build image ships OpenSSL *runtime* libraries
+// (libssl.so.3 / libcrypto.so.3) but no development headers, so this shim
+// binds the dozen stable OpenSSL 3 entry points it needs at runtime with
+// dlopen/dlsym. When the library is absent, Handshake fails with a clear
+// error and cleartext operation is unaffected.
+#pragma once
+
+#include <cstddef>
+#include <string>
+#include <sys/types.h>
+
+#include "tpuclient/error.h"
+
+namespace tpuclient {
+
+// Transport-level TLS settings, the union of what the two public option
+// structs (SslOptions for gRPC, https defaults for HTTP) need.
+struct TlsOptions {
+  bool use_ssl = false;
+  // PEM file paths (reference SslOptions semantics, grpc_client.h:46-57):
+  // empty root file = OpenSSL default verify paths.
+  std::string root_certificates;
+  std::string private_key;
+  std::string certificate_chain;
+  bool verify_peer = true;  // verify the server certificate chain
+  bool verify_host = true;  // match hostname against SAN/CN
+  std::string alpn;         // ALPN protocol to offer ("h2" for gRPC)
+  std::string server_name;  // SNI/verification override; empty = host
+};
+
+// One TLS session over an already-connected TCP socket (blocking IO).
+class TlsSession {
+ public:
+  TlsSession() = default;
+  ~TlsSession();
+  TlsSession(const TlsSession&) = delete;
+  TlsSession& operator=(const TlsSession&) = delete;
+
+  // Whether libssl could be loaded on this machine.
+  static bool Available();
+
+  // Client handshake on fd. host is used for SNI and hostname verification
+  // unless opts.server_name overrides it.
+  Error Handshake(int fd, const std::string& host, const TlsOptions& opts);
+
+  // recv/send-shaped IO. Return >0 bytes moved, 0 on clean TLS close,
+  // kWantRead/kWantWrite when the socket is non-blocking and the operation
+  // must be retried after the fd is readable/writable, or -1 on error with
+  // *err filled. NOTE: one TlsSession must not be used from two threads at
+  // once (OpenSSL SSL objects are not thread-safe) — callers with a reader
+  // thread serialize access and use a non-blocking fd (see h2.cc).
+  static constexpr ssize_t kWantRead = -2;
+  static constexpr ssize_t kWantWrite = -3;
+  ssize_t Read(void* buf, size_t n, Error* err);
+  ssize_t Write(const void* buf, size_t n, Error* err);
+
+  // Bytes already decrypted and buffered inside the TLS layer — readable
+  // immediately even though poll() on the fd would block.
+  size_t Pending();
+
+  bool Active() const { return ssl_ != nullptr; }
+
+  // Best-effort close_notify, then frees the session (keeps the fd open —
+  // the socket owner closes it).
+  void Close();
+
+ private:
+  void* ssl_ = nullptr;
+  void* ctx_ = nullptr;
+};
+
+}  // namespace tpuclient

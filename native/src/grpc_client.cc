@@ -293,9 +293,11 @@ InferenceServerGrpcClient::InferenceServerGrpcClient(bool verbose)
 
 Error InferenceServerGrpcClient::Create(
     std::unique_ptr<InferenceServerGrpcClient>* client, const std::string& url,
-    bool verbose, bool use_cached_channel) {
+    bool verbose, bool use_cached_channel, bool use_ssl,
+    const SslOptions& ssl_options, const KeepAliveOptions& keepalive_options) {
   client->reset(new InferenceServerGrpcClient(verbose));
-  Error err = (*client)->Connect(url, use_cached_channel);
+  Error err = (*client)->Connect(url, use_cached_channel, use_ssl,
+                                 ssl_options, keepalive_options);
   if (!err.IsOk()) client->reset();
   return err;
 }
@@ -307,11 +309,17 @@ InferenceServerGrpcClient::~InferenceServerGrpcClient() {
   if (async_worker_.joinable()) async_worker_.join();
 }
 
-Error InferenceServerGrpcClient::Connect(const std::string& url,
-                                         bool use_cached_channel) {
+Error InferenceServerGrpcClient::Connect(
+    const std::string& url, bool use_cached_channel, bool use_ssl,
+    const SslOptions& ssl_options,
+    const KeepAliveOptions& keepalive_options) {
   std::string hostport = url;
   auto scheme = hostport.find("://");
-  if (scheme != std::string::npos) hostport = hostport.substr(scheme + 3);
+  if (scheme != std::string::npos) {
+    std::string proto = hostport.substr(0, scheme);
+    if (proto == "https" || proto == "grpcs") use_ssl = true;
+    hostport = hostport.substr(scheme + 3);
+  }
   std::string host = hostport;
   int port = 8001;
   if (!hostport.empty() && hostport[0] == '[') {
@@ -338,12 +346,33 @@ Error InferenceServerGrpcClient::Connect(const std::string& url,
                    ? "[" + host + "]:" + std::to_string(port)
                    : host + ":" + std::to_string(port);
 
+  TlsOptions tls;
+  tls.use_ssl = use_ssl;
+  tls.root_certificates = ssl_options.root_certificates;
+  tls.private_key = ssl_options.private_key;
+  tls.certificate_chain = ssl_options.certificate_chain;
+  tls.alpn = "h2";
+  const TlsOptions* tls_ptr = use_ssl ? &tls : nullptr;
+  auto start_keepalive = [&keepalive_options](h2::Connection* c) {
+    c->StartKeepalive(keepalive_options.keepalive_time_ms,
+                      keepalive_options.keepalive_timeout_ms,
+                      keepalive_options.keepalive_permit_without_calls,
+                      keepalive_options.http2_max_pings_without_data);
+  };
+
   if (use_cached_channel) {
+    // TLS and cleartext channels to the same authority are distinct.
+    const std::string cache_key =
+        (use_ssl ? "grpcs://" : "grpc://") + authority_;
     {
       std::lock_guard<std::mutex> lk(CacheMutex());
-      auto it = ChannelCache().find(authority_);
+      auto it = ChannelCache().find(cache_key);
       if (it != ChannelCache().end() && it->second->Alive()) {
         conn_ = it->second;
+        // Adopting a cached channel must still honor this client's
+        // keepalive request (first requester wins; StartKeepalive is
+        // idempotent).
+        start_keepalive(conn_.get());
         return Error::Success();
       }
     }
@@ -351,20 +380,25 @@ Error InferenceServerGrpcClient::Connect(const std::string& url,
     // stall unrelated clients' Create calls. Losing the insert race just
     // means adopting the winner's connection.
     auto conn = std::make_shared<h2::Connection>();
-    Error err = conn->Connect(host, port);
+    Error err = conn->Connect(host, port, tls_ptr);
     if (!err.IsOk()) return err;
+    start_keepalive(conn.get());
     std::lock_guard<std::mutex> lk(CacheMutex());
-    auto it = ChannelCache().find(authority_);
+    auto it = ChannelCache().find(cache_key);
     if (it != ChannelCache().end() && it->second->Alive()) {
       conn_ = it->second;  // another thread won; drop ours
+      start_keepalive(conn_.get());
       return Error::Success();
     }
-    ChannelCache()[authority_] = conn;
+    ChannelCache()[cache_key] = conn;
     conn_ = conn;
     return Error::Success();
   }
   conn_ = std::make_shared<h2::Connection>();
-  return conn_->Connect(host, port);
+  Error err = conn_->Connect(host, port, tls_ptr);
+  if (!err.IsOk()) return err;
+  start_keepalive(conn_.get());
+  return Error::Success();
 }
 
 Error InferenceServerGrpcClient::Rpc(const std::string& method,

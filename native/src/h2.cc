@@ -7,12 +7,16 @@
 #include "h2.h"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netdb.h>
+#include <poll.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <chrono>
+#include <climits>
 #include <cstring>
 #include <unordered_map>
 
@@ -345,11 +349,19 @@ Error HpackDecoder::Decode(const uint8_t* data, size_t len, HeaderList* out) {
 
 Connection::~Connection() {
   FailConnection("connection destroyed");
+  {
+    std::lock_guard<std::mutex> sl(state_mutex_);
+    ka_stop_ = true;
+  }
+  state_cv_.notify_all();
+  if (ka_thread_.joinable()) ka_thread_.join();
   if (reader_.joinable()) reader_.join();
+  if (tls_) tls_->Close();
   if (fd_ >= 0) ::close(fd_);
 }
 
-Error Connection::Connect(const std::string& host, int port) {
+Error Connection::Connect(const std::string& host, int port,
+                          const TlsOptions* tls) {
   struct addrinfo hints{};
   hints.ai_family = AF_UNSPEC;
   hints.ai_socktype = SOCK_STREAM;
@@ -372,6 +384,22 @@ Error Connection::Connect(const std::string& host, int port) {
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   fd_ = fd;
+
+  if (tls != nullptr && tls->use_ssl) {
+    tls_ = std::make_unique<TlsSession>();
+    Error terr = tls_->Handshake(fd_, host, *tls);
+    if (!terr.IsOk()) {
+      tls_.reset();
+      ::close(fd_);
+      fd_ = -1;
+      return terr;
+    }
+    // Handshake ran blocking; switch to non-blocking so the reader thread's
+    // SSL_read can't camp inside the TLS layer while holding tls_mutex_
+    // (reader and writers share one SSL object — see tls.h).
+    int fl = ::fcntl(fd_, F_GETFL, 0);
+    ::fcntl(fd_, F_SETFL, fl | O_NONBLOCK);
+  }
 
   // Client preface + SETTINGS + connection window bump (RFC 7540 §3.5).
   static const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
@@ -404,10 +432,30 @@ Error Connection::Connect(const std::string& host, int port) {
 Error Connection::SendRaw(const uint8_t* data, size_t len) {
   size_t off = 0;
   while (off < len) {
-    ssize_t n = ::send(fd_, data + off, len - off, MSG_NOSIGNAL);
-    if (n <= 0) {
-      return Error("h2 send failed: " +
-                   std::string(n < 0 ? strerror(errno) : "closed"));
+    ssize_t n;
+    if (tls_) {
+      Error terr;
+      {
+        std::lock_guard<std::mutex> tl(tls_mutex_);
+        n = tls_->Write(data + off, len - off, &terr);
+      }
+      if (n == TlsSession::kWantWrite || n == TlsSession::kWantRead) {
+        // Non-blocking fd: wait for socket readiness outside the TLS lock.
+        struct pollfd pfd{fd_,
+                          short(n == TlsSession::kWantWrite ? POLLOUT
+                                                            : POLLIN),
+                          0};
+        ::poll(&pfd, 1, 1000);
+        continue;
+      }
+      if (n <= 0) return terr.IsOk() ? Error("h2 TLS send closed") : terr;
+    } else {
+      n = ::send(fd_, data + off, len - off, MSG_NOSIGNAL);
+      if (n <= 0) {
+        if (n < 0 && errno == EINTR) continue;
+        return Error("h2 send failed: " +
+                     std::string(n < 0 ? strerror(errno) : "closed"));
+      }
     }
     off += size_t(n);
   }
@@ -436,6 +484,10 @@ Error Connection::StartStream(const HeaderList& headers, bool end_stream,
                               int32_t* sid) {
   std::string block;
   HpackEncode(headers, &block);
+  {
+    std::lock_guard<std::mutex> sl(state_mutex_);
+    ka_data_since_ping_ = true;  // HEADERS counts against the ping cap
+  }
 
   // Hold the write lock across id allocation + HEADERS so stream ids appear
   // on the wire in increasing order (RFC 7540 §5.1.1).
@@ -479,6 +531,10 @@ Error Connection::StartStream(const HeaderList& headers, bool end_stream,
 
 Error Connection::SendData(int32_t sid, const uint8_t* data, size_t len,
                            bool end_stream, uint64_t deadline_ns) {
+  {
+    std::lock_guard<std::mutex> sl(state_mutex_);
+    ka_data_since_ping_ = true;
+  }
   size_t off = 0;
   while (off < len || (end_stream && off == 0 && len == 0)) {
     size_t chunk = 0;
@@ -582,6 +638,55 @@ bool Connection::Alive() {
   return !dead_;
 }
 
+void Connection::StartKeepalive(int time_ms, int timeout_ms,
+                                bool permit_without_calls,
+                                int max_pings_without_data) {
+  if (time_ms <= 0 || time_ms == INT_MAX) return;
+  {
+    // Idempotent + thread-safe: cached channels may be adopted by several
+    // clients; the first keepalive-requesting one starts the ping thread.
+    std::lock_guard<std::mutex> sl(state_mutex_);
+    if (ka_started_ || dead_) return;
+    ka_started_ = true;
+  }
+  ka_thread_ = std::thread([this, time_ms, timeout_ms, permit_without_calls,
+                            max_pings_without_data] {
+    std::unique_lock<std::mutex> sl(state_mutex_);
+    while (true) {
+      state_cv_.wait_for(sl, std::chrono::milliseconds(time_ms),
+                         [this] { return ka_stop_ || dead_; });
+      if (ka_stop_ || dead_) return;
+      if (!permit_without_calls && streams_.empty()) continue;
+      if (ka_data_since_ping_) {
+        ka_pings_without_data_ = 0;
+      } else if (max_pings_without_data > 0 &&
+                 ka_pings_without_data_ >= max_pings_without_data) {
+        continue;  // gRPC-core: stop pinging an idle transport at the cap
+      }
+      ka_data_since_ping_ = false;
+      ka_pings_without_data_++;
+      ka_ack_pending_ = true;
+      sl.unlock();
+      {
+        std::lock_guard<std::mutex> wl(write_mutex_);
+        static const uint8_t kKaPayload[8] = {'k', 'e', 'e', 'p',
+                                              'a', 'l', 'v', '1'};
+        SendFrame(kPing, 0, 0, kKaPayload, sizeof(kKaPayload));
+      }
+      sl.lock();
+      bool acked = state_cv_.wait_for(
+          sl, std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 20000),
+          [this] { return !ka_ack_pending_ || ka_stop_ || dead_; });
+      if (ka_stop_ || dead_) return;
+      if (!acked) {
+        sl.unlock();
+        FailConnection("keepalive ping timeout");
+        return;
+      }
+    }
+  });
+}
+
 const std::string& Connection::ConnectionError() {
   std::lock_guard<std::mutex> sl(state_mutex_);
   return error_;
@@ -590,7 +695,28 @@ const std::string& Connection::ConnectionError() {
 bool Connection::ReadN(uint8_t* buf, size_t n) {
   size_t off = 0;
   while (off < n) {
-    ssize_t r = ::recv(fd_, buf + off, n - off, 0);
+    ssize_t r;
+    if (tls_) {
+      {
+        std::lock_guard<std::mutex> tl(tls_mutex_);
+        r = tls_->Read(buf + off, n - off, nullptr);
+      }
+      if (r == TlsSession::kWantRead || r == TlsSession::kWantWrite) {
+        struct pollfd pfd{fd_,
+                          short(r == TlsSession::kWantRead ? POLLIN
+                                                           : POLLOUT),
+                          0};
+        ::poll(&pfd, 1, 1000);
+        {
+          std::lock_guard<std::mutex> sl(state_mutex_);
+          if (dead_) return false;
+        }
+        continue;
+      }
+    } else {
+      r = ::recv(fd_, buf + off, n - off, 0);
+      if (r < 0 && errno == EINTR) continue;
+    }
     if (r <= 0) return false;
     off += size_t(r);
   }
@@ -778,7 +904,13 @@ void Connection::HandleFrame(uint8_t type, uint8_t flags, int32_t sid,
       break;
     }
     case kPing: {
-      if (!(flags & kFlagAck) && len == 8) {
+      if (flags & kFlagAck) {
+        {
+          std::lock_guard<std::mutex> sl(state_mutex_);
+          ka_ack_pending_ = false;
+        }
+        state_cv_.notify_all();
+      } else if (len == 8) {
         std::lock_guard<std::mutex> wl(write_mutex_);
         SendFrame(kPing, kFlagAck, 0, payload, len);
       }

@@ -24,6 +24,7 @@
 #include <vector>
 
 #include "tpuclient/error.h"
+#include "tpuclient/tls.h"
 
 namespace tpuclient {
 namespace h2 {
@@ -101,7 +102,18 @@ class Connection {
 
   // TCP connect + preface + SETTINGS exchange kickoff (does not wait for the
   // server SETTINGS ack). host may be an IPv4 literal or DNS name.
-  Error Connect(const std::string& host, int port);
+  // tls != nullptr: TLS handshake (ALPN per tls->alpn) before the preface.
+  Error Connect(const std::string& host, int port,
+                const TlsOptions* tls = nullptr);
+
+  // gRPC-core-style transport keepalive (reference KeepAliveOptions,
+  // grpc_client.h:61-81): a PING every time_ms; the connection fails if no
+  // ack arrives within timeout_ms. permit_without_calls allows pings with
+  // no open streams; max_pings_without_data caps consecutive pings sent
+  // with no intervening DATA/HEADERS (0 = unlimited). time_ms <= 0 or
+  // INT_MAX disables. Call once, after Connect.
+  void StartKeepalive(int time_ms, int timeout_ms, bool permit_without_calls,
+                      int max_pings_without_data);
 
   // Opens a stream with the given request headers. end_stream=true for
   // requests with no body. Returns the stream id.
@@ -138,7 +150,19 @@ class Connection {
   bool ReadN(uint8_t* buf, size_t n);
 
   int fd_ = -1;
+  std::unique_ptr<TlsSession> tls_;  // non-null once a TLS handshake is done
+  // OpenSSL SSL objects are not thread-safe even for concurrent read+write;
+  // with TLS active the fd is non-blocking and every SSL call runs under
+  // this mutex (reader polls outside it, so writers never starve).
+  std::mutex tls_mutex_;
   std::thread reader_;
+  // Keepalive state (all under state_mutex_ unless noted).
+  std::thread ka_thread_;
+  bool ka_started_ = false;
+  bool ka_stop_ = false;
+  bool ka_ack_pending_ = false;
+  int ka_pings_without_data_ = 0;
+  bool ka_data_since_ping_ = false;
   std::mutex write_mutex_;   // serializes socket writes
   std::mutex state_mutex_;   // streams_, windows, settings, error
   std::condition_variable state_cv_;

@@ -1,9 +1,14 @@
 #include "tpuclient/http_client.h"
 
+#include <zlib.h>
+
+#include <algorithm>
+
 #include <arpa/inet.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <fcntl.h>
 #include <poll.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
@@ -26,11 +31,15 @@ namespace tpuclient {
 
 class HttpConnection {
  public:
-  HttpConnection(const std::string& host, int port)
-      : host_(host), port_(port), fd_(-1) {}
+  HttpConnection(const std::string& host, int port, const TlsOptions& tls)
+      : host_(host), port_(port), fd_(-1), tls_opts_(tls) {}
   ~HttpConnection() { Close(); }
 
   void Close() {
+    if (tls_) {
+      tls_->Close();
+      tls_.reset();
+    }
     if (fd_ >= 0) {
       ::close(fd_);
       fd_ = -1;
@@ -64,6 +73,19 @@ class HttpConnection {
     }
     int one = 1;
     setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (tls_opts_.use_ssl) {
+      tls_ = std::make_unique<TlsSession>();
+      Error err = tls_->Handshake(fd_, host_, tls_opts_);
+      if (!err.IsOk()) {
+        Close();
+        return err;
+      }
+      // Non-blocking after the (blocking) handshake: a partial TLS record
+      // must surface as kWantRead back to Fill's deadline loop, not as an
+      // SSL_read that camps past the request timeout.
+      int fl = ::fcntl(fd_, F_GETFL, 0);
+      ::fcntl(fd_, F_SETFL, fl | O_NONBLOCK);
+    }
     return Error::Success();
   }
 
@@ -80,6 +102,29 @@ class HttpConnection {
         iov.push_back({const_cast<uint8_t*>(s.first), s.second});
     }
     size_t idx = 0;
+    if (tls_) {
+      for (const auto& v : iov) {
+        size_t off = 0;
+        while (off < v.iov_len) {
+          Error werr;
+          ssize_t n = tls_->Write(static_cast<char*>(v.iov_base) + off,
+                                  v.iov_len - off, &werr);
+          if (n == TlsSession::kWantWrite || n == TlsSession::kWantRead) {
+            struct pollfd pfd{
+                fd_, short(n == TlsSession::kWantWrite ? POLLOUT : POLLIN),
+                0};
+            ::poll(&pfd, 1, 1000);
+            continue;
+          }
+          if (n <= 0) {
+            Close();
+            return werr.IsOk() ? Error("TLS send closed", 400) : werr;
+          }
+          off += static_cast<size_t>(n);
+        }
+      }
+      return Error::Success();
+    }
     while (idx < iov.size()) {
       ssize_t n = ::writev(fd_, iov.data() + idx,
                            static_cast<int>(
@@ -230,36 +275,75 @@ class HttpConnection {
   }
 
  private:
-  Error Fill(uint64_t deadline_ns) {
-    if (fd_ < 0) return Error("connection closed", 400);
+  // Waits (≤ deadline) for the fd to become readable/writable. Returns a
+  // 499 on deadline expiry; EINTR and spurious wakeups return Success (the
+  // caller's read loop re-enters).
+  Error PollFd(short events, uint64_t deadline_ns) {
+    int timeout_ms = -1;
     if (deadline_ns) {
       uint64_t now = RequestTimers::Now();
       if (now >= deadline_ns) {
         Close();
         return Error("Deadline Exceeded", 499);
       }
-      struct pollfd pfd {fd_, POLLIN, 0};
-      int timeout_ms = static_cast<int>((deadline_ns - now) / 1000000) + 1;
-      int prc = ::poll(&pfd, 1, timeout_ms);
-      if (prc == 0) {
-        Close();
-        return Error("Deadline Exceeded", 499);
-      }
-      if (prc < 0 && errno != EINTR) {
-        Close();
-        return Error(std::string("poll failed: ") + strerror(errno), 400);
-      }
+      timeout_ms = static_cast<int>((deadline_ns - now) / 1000000) + 1;
     }
+    struct pollfd pfd{fd_, events, 0};
+    int prc = ::poll(&pfd, 1, timeout_ms);
+    if (prc == 0) {
+      Close();
+      return Error("Deadline Exceeded", 499);
+    }
+    if (prc < 0 && errno != EINTR) {
+      Close();
+      return Error(std::string("poll failed: ") + strerror(errno), 400);
+    }
+    return Error::Success();
+  }
+
+  Error Fill(uint64_t deadline_ns) {
+    if (fd_ < 0) return Error("connection closed", 400);
     char buf[65536];
-    ssize_t n = ::recv(fd_, buf, sizeof(buf), 0);
-    if (n == 0) {
-      Close();
-      return Error("connection closed by server", 400);
-    }
-    if (n < 0) {
-      if (errno == EINTR) return Error::Success();
-      Close();
-      return Error(std::string("recv failed: ") + strerror(errno), 400);
+    ssize_t n;
+    if (tls_) {
+      // Bytes already decrypted inside the TLS layer are readable now even
+      // though poll() on the fd would block; otherwise the non-blocking
+      // SSL_read surfaces kWantRead/kWantWrite and the deadline-aware poll
+      // decides how long to wait for the rest of the record.
+      while (true) {
+        Error rerr;
+        n = tls_->Read(buf, sizeof(buf), &rerr);
+        if (n == TlsSession::kWantRead || n == TlsSession::kWantWrite) {
+          Error perr = PollFd(
+              n == TlsSession::kWantRead ? POLLIN : POLLOUT, deadline_ns);
+          if (!perr.IsOk()) return perr;
+          continue;
+        }
+        if (n == 0) {
+          Close();
+          return Error("connection closed by server", 400);
+        }
+        if (n < 0) {
+          Close();
+          return rerr.IsOk() ? Error("TLS read failed", 400) : rerr;
+        }
+        break;
+      }
+    } else {
+      if (deadline_ns) {
+        Error perr = PollFd(POLLIN, deadline_ns);
+        if (!perr.IsOk()) return perr;
+      }
+      n = ::recv(fd_, buf, sizeof(buf), 0);
+      if (n == 0) {
+        Close();
+        return Error("connection closed by server", 400);
+      }
+      if (n < 0) {
+        if (errno == EINTR) return Error::Success();
+        Close();
+        return Error(std::string("recv failed: ") + strerror(errno), 400);
+      }
     }
     rbuf_.append(buf, n);
     if (!got_bytes_) first_byte_ns_ = RequestTimers::Now();
@@ -296,6 +380,8 @@ class HttpConnection {
   std::string host_;
   int port_;
   int fd_;
+  TlsOptions tls_opts_;
+  std::unique_ptr<TlsSession> tls_;
   std::string rbuf_;
   // whether any response byte arrived for the in-flight request (guards the
   // RoundTrip stale-connection retry against replaying a half-answered call)
@@ -489,30 +575,136 @@ std::string InferResultHttp::DebugString() const {
   return head_ ? head_->Serialize() : "<empty>";
 }
 
+
+// ---------------------------------------------------------------------------
+// Compression (reference CompressData / CURLOPT_ACCEPT_ENCODING,
+// http_client.cc:122-198, 1547-1557)
+// ---------------------------------------------------------------------------
+
+static Error DeflateBuffer(const std::string& in, bool gzip,
+                           std::string* out) {
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  if (deflateInit2(&zs, Z_DEFAULT_COMPRESSION, Z_DEFLATED,
+                   gzip ? 15 | 16 : 15, 8, Z_DEFAULT_STRATEGY) != Z_OK) {
+    return Error("failed to initialize compression", 400);
+  }
+  out->resize(deflateBound(&zs, in.size()));
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  zs.avail_in = static_cast<uInt>(in.size());
+  zs.next_out = reinterpret_cast<Bytef*>(&(*out)[0]);
+  zs.avail_out = static_cast<uInt>(out->size());
+  int rc = deflate(&zs, Z_FINISH);
+  deflateEnd(&zs);
+  if (rc != Z_STREAM_END) {
+    return Error("request compression failed (zlib rc " + std::to_string(rc) +
+                     ")",
+                 400);
+  }
+  out->resize(zs.total_out);
+  return Error::Success();
+}
+
+static Error InflateBuffer(const std::string& in, std::string* out) {
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  // 15 | 32: auto-detect zlib vs gzip framing.
+  if (inflateInit2(&zs, 15 | 32) != Z_OK) {
+    return Error("failed to initialize decompression", 400);
+  }
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  zs.avail_in = static_cast<uInt>(in.size());
+  std::string buf(std::max<size_t>(in.size() * 4, 16384), '\0');
+  int rc = Z_OK;
+  while (rc == Z_OK) {
+    zs.next_out = reinterpret_cast<Bytef*>(&buf[0]);
+    zs.avail_out = static_cast<uInt>(buf.size());
+    rc = inflate(&zs, Z_NO_FLUSH);
+    if (rc == Z_OK || rc == Z_STREAM_END) {
+      out->append(buf.data(), buf.size() - zs.avail_out);
+    }
+    if (rc == Z_OK && zs.avail_in == 0 && zs.avail_out != 0) break;
+  }
+  inflateEnd(&zs);
+  if (rc != Z_STREAM_END) {
+    return Error("response decompression failed (zlib rc " +
+                     std::to_string(rc) + ")",
+                 400);
+  }
+  return Error::Success();
+}
+
+Error InferenceServerHttpClient::CompressRequest(PreparedRequest* prep,
+                                                 CompressionType type) {
+  if (type == CompressionType::NONE) return Error::Success();
+  std::string whole;
+  whole.reserve(prep->total_body);
+  whole.append(prep->json_head);
+  for (const auto& seg : prep->tail)
+    whole.append(reinterpret_cast<const char*>(seg.first), seg.second);
+  Error err =
+      DeflateBuffer(whole, type == CompressionType::GZIP, &prep->compressed);
+  if (!err.IsOk()) return err;
+  prep->content_encoding =
+      type == CompressionType::GZIP ? "gzip" : "deflate";
+  // Inference-Header-Content-Length still names the *uncompressed* JSON
+  // head size; the server decompresses first, then splits.
+  prep->total_body = prep->compressed.size();
+  prep->tail.clear();
+  return Error::Success();
+}
+
 // ---------------------------------------------------------------------------
 // InferenceServerHttpClient
 // ---------------------------------------------------------------------------
 
 Error InferenceServerHttpClient::Create(
     std::unique_ptr<InferenceServerHttpClient>* client,
-    const std::string& server_url, bool verbose) {
+    const std::string& server_url, bool verbose,
+    const HttpSslOptions& ssl_options) {
   std::string url = server_url;
+  bool use_ssl = false;
   size_t scheme = url.find("://");
-  if (scheme != std::string::npos) url = url.substr(scheme + 3);
-  int port = 8000;
-  std::string host = url;
-  size_t colon = url.rfind(':');
-  if (colon != std::string::npos) {
-    host = url.substr(0, colon);
-    port = atoi(url.c_str() + colon + 1);
+  if (scheme != std::string::npos) {
+    use_ssl = url.compare(0, scheme, "https") == 0;
+    url = url.substr(scheme + 3);
   }
-  client->reset(new InferenceServerHttpClient(host, port, verbose));
+  int port = use_ssl ? 443 : 8000;
+  std::string host = url;
+  if (!url.empty() && url[0] == '[') {
+    // Bracketed IPv6 literal: "[::1]:8000" — strip the brackets so
+    // getaddrinfo and TLS hostname verification see the bare address.
+    auto rb = url.find(']');
+    if (rb != std::string::npos) {
+      host = url.substr(1, rb - 1);
+      if (rb + 1 < url.size() && url[rb + 1] == ':') {
+        port = atoi(url.c_str() + rb + 2);
+      }
+    }
+  } else if (std::count(url.begin(), url.end(), ':') > 1) {
+    host = url;  // bare IPv6 literal, no port suffix
+  } else {
+    size_t colon = url.rfind(':');
+    if (colon != std::string::npos) {
+      host = url.substr(0, colon);
+      port = atoi(url.c_str() + colon + 1);
+    }
+  }
+  TlsOptions tls;
+  tls.use_ssl = use_ssl;
+  tls.verify_peer = ssl_options.verify_peer;
+  tls.verify_host = ssl_options.verify_host;
+  tls.root_certificates = ssl_options.ca_info;
+  tls.certificate_chain = ssl_options.cert;
+  tls.private_key = ssl_options.key;
+  client->reset(new InferenceServerHttpClient(host, port, verbose, tls));
   return Error::Success();
 }
 
 InferenceServerHttpClient::InferenceServerHttpClient(const std::string& host,
-                                                     int port, bool verbose)
-    : InferenceServerClient(verbose), host_(host), port_(port) {}
+                                                     int port, bool verbose,
+                                                     const TlsOptions& tls)
+    : InferenceServerClient(verbose), host_(host), port_(port), tls_(tls) {}
 
 InferenceServerHttpClient::~InferenceServerHttpClient() {
   {
@@ -535,7 +727,7 @@ std::unique_ptr<HttpConnection> InferenceServerHttpClient::BorrowConnection() {
     pool_.pop_front();
     return conn;
   }
-  return std::make_unique<HttpConnection>(host_, port_);
+  return std::make_unique<HttpConnection>(host_, port_, tls_);
 }
 
 void InferenceServerHttpClient::ReturnConnection(
@@ -871,13 +1063,24 @@ Error InferenceServerHttpClient::DoInfer(HttpConnection* conn,
                                          const Headers& headers,
                                          RequestTimers* timers,
                                          InferResult** result) {
+  Headers all_headers = headers;
+  if (!prep.content_encoding.empty())
+    all_headers["Content-Encoding"] = prep.content_encoding;
+  if (!prep.accept_encoding.empty())
+    all_headers["Accept-Encoding"] = prep.accept_encoding;
   std::string http_head =
-      BuildHttpHead("POST", prep.path, host_, headers, prep.total_body,
+      BuildHttpHead("POST", prep.path, host_, all_headers, prep.total_body,
                     prep.header_length, true);
   std::vector<std::pair<const uint8_t*, size_t>> segs;
-  segs.emplace_back(reinterpret_cast<const uint8_t*>(prep.json_head.data()),
-                    prep.json_head.size());
-  for (const auto& seg : prep.tail) segs.push_back(seg);
+  if (!prep.content_encoding.empty()) {
+    segs.emplace_back(
+        reinterpret_cast<const uint8_t*>(prep.compressed.data()),
+        prep.compressed.size());
+  } else {
+    segs.emplace_back(reinterpret_cast<const uint8_t*>(prep.json_head.data()),
+                      prep.json_head.size());
+    for (const auto& seg : prep.tail) segs.push_back(seg);
+  }
 
   int status;
   Headers resp_headers;
@@ -885,6 +1088,15 @@ Error InferenceServerHttpClient::DoInfer(HttpConnection* conn,
   Error err = conn->RoundTrip(http_head, segs, prep.timeout_us, &status,
                               &resp_headers, &body, timers);
   if (!err.IsOk()) return err;
+
+  auto ce = resp_headers.find("content-encoding");
+  if (ce != resp_headers.end() && !ce->second.empty() &&
+      ce->second != "identity") {
+    std::string plain;
+    err = InflateBuffer(body, &plain);
+    if (!err.IsOk()) return err;
+    body.swap(plain);
+  }
 
   size_t header_length = 0;
   auto it = resp_headers.find("inference-header-content-length");
@@ -897,13 +1109,20 @@ Error InferenceServerHttpClient::Infer(
     InferResult** result, const InferOptions& options,
     const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs,
-    const Headers& headers) {
+    const Headers& headers, CompressionType request_compression_algorithm,
+    CompressionType response_compression_algorithm) {
   RequestTimers timers;
   timers.Capture(RequestTimers::Kind::REQUEST_START);
 
   PreparedRequest prep;
   Error err = PrepareInferRequest(&prep, options, inputs, outputs);
   if (!err.IsOk()) return err;
+  err = CompressRequest(&prep, request_compression_algorithm);
+  if (!err.IsOk()) return err;
+  if (response_compression_algorithm == CompressionType::GZIP)
+    prep.accept_encoding = "gzip";
+  else if (response_compression_algorithm == CompressionType::DEFLATE)
+    prep.accept_encoding = "deflate";
 
   auto conn = BorrowConnection();
   err = DoInfer(conn.get(), prep, headers, &timers, result);
@@ -919,13 +1138,20 @@ Error InferenceServerHttpClient::AsyncInfer(
     OnCompleteFn callback, const InferOptions& options,
     const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs,
-    const Headers& headers) {
+    const Headers& headers, CompressionType request_compression_algorithm,
+    CompressionType response_compression_algorithm) {
   if (callback == nullptr)
     return Error("callback is required for AsyncInfer", 400);
 
   auto job = std::make_unique<AsyncJob>();
   Error err = PrepareInferRequest(&job->prep, options, inputs, outputs);
   if (!err.IsOk()) return err;
+  err = CompressRequest(&job->prep, request_compression_algorithm);
+  if (!err.IsOk()) return err;
+  if (response_compression_algorithm == CompressionType::GZIP)
+    job->prep.accept_encoding = "gzip";
+  else if (response_compression_algorithm == CompressionType::DEFLATE)
+    job->prep.accept_encoding = "deflate";
   job->headers = headers;
   job->callback = std::move(callback);
 
@@ -959,7 +1185,7 @@ Error InferenceServerHttpClient::AsyncInfer(
 void InferenceServerHttpClient::AsyncWorkerLoop() {
   // Each worker owns one keep-alive connection; one in-flight request per
   // worker gives up to max_async_workers_ concurrent requests.
-  HttpConnection conn(host_, port_);
+  HttpConnection conn(host_, port_, tls_);
   while (true) {
     std::unique_ptr<AsyncJob> job;
     {

@@ -1,0 +1,259 @@
+// Runtime-bound OpenSSL 3 client shim — see tls.h for why dlopen.
+//
+// Only stable, ABI-frozen entry points are used (the same set every
+// libssl-linked program of the last decade calls); prototypes are declared
+// here by hand because the image has no openssl headers.
+
+#include "tpuclient/tls.h"
+
+#include <arpa/inet.h>
+#include <dlfcn.h>
+
+#include <cstdio>
+#include <mutex>
+
+namespace tpuclient {
+
+namespace {
+
+// ---- minimal OpenSSL ABI surface (opaque pointers throughout) -------------
+struct OpenSsl {
+  // libssl
+  const void* (*TLS_client_method)();
+  void* (*SSL_CTX_new)(const void*);
+  void (*SSL_CTX_free)(void*);
+  void (*SSL_CTX_set_verify)(void*, int, void*);
+  int (*SSL_CTX_set_default_verify_paths)(void*);
+  int (*SSL_CTX_load_verify_locations)(void*, const char*, const char*);
+  int (*SSL_CTX_use_certificate_chain_file)(void*, const char*);
+  int (*SSL_CTX_use_PrivateKey_file)(void*, const char*, int);
+  int (*SSL_CTX_set_alpn_protos)(void*, const unsigned char*, unsigned);
+  void* (*SSL_new)(void*);
+  void (*SSL_free)(void*);
+  int (*SSL_set_fd)(void*, int);
+  int (*SSL_set1_host)(void*, const char*);
+  void* (*SSL_get0_param)(void*);
+  long (*SSL_ctrl)(void*, int, long, void*);  // NOLINT(runtime/int)
+  int (*SSL_connect)(void*);
+  int (*SSL_read)(void*, void*, int);
+  int (*SSL_write)(void*, const void*, int);
+  int (*SSL_pending)(const void*);
+  int (*SSL_shutdown)(void*);
+  int (*SSL_get_error)(const void*, int);
+  // libcrypto
+  unsigned long (*ERR_get_error)();  // NOLINT(runtime/int)
+  void (*ERR_error_string_n)(unsigned long, char*, size_t);  // NOLINT
+  int (*X509_VERIFY_PARAM_set1_ip_asc)(void*, const char*);
+
+  bool ok = false;
+};
+
+constexpr int kSslVerifyNone = 0;
+constexpr int kSslVerifyPeer = 1;
+constexpr int kSslFiletypePem = 1;
+constexpr int kSslCtrlSetTlsextHostname = 55;  // SSL_CTRL_SET_TLSEXT_HOSTNAME
+constexpr long kTlsextNametypeHostName = 0;    // NOLINT(runtime/int)
+constexpr int kSslErrorWantRead = 2;
+constexpr int kSslErrorWantWrite = 3;
+constexpr int kSslErrorZeroReturn = 6;
+
+const OpenSsl& Lib() {
+  static OpenSsl lib;
+  static std::once_flag once;
+  std::call_once(once, [] {
+    void* ssl = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (ssl == nullptr) ssl = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+    void* crypto = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (crypto == nullptr) crypto = dlopen("libcrypto.so", RTLD_NOW);
+    if (ssl == nullptr || crypto == nullptr) return;
+    bool all = true;
+    auto bind = [&all](void* lib_handle, const char* name) -> void* {
+      void* sym = dlsym(lib_handle, name);
+      if (sym == nullptr) all = false;
+      return sym;
+    };
+#define TPU_BIND(handle, field) \
+  lib.field = reinterpret_cast<decltype(lib.field)>(bind(handle, #field))
+    TPU_BIND(ssl, TLS_client_method);
+    TPU_BIND(ssl, SSL_CTX_new);
+    TPU_BIND(ssl, SSL_CTX_free);
+    TPU_BIND(ssl, SSL_CTX_set_verify);
+    TPU_BIND(ssl, SSL_CTX_set_default_verify_paths);
+    TPU_BIND(ssl, SSL_CTX_load_verify_locations);
+    TPU_BIND(ssl, SSL_CTX_use_certificate_chain_file);
+    TPU_BIND(ssl, SSL_CTX_use_PrivateKey_file);
+    TPU_BIND(ssl, SSL_CTX_set_alpn_protos);
+    TPU_BIND(ssl, SSL_new);
+    TPU_BIND(ssl, SSL_free);
+    TPU_BIND(ssl, SSL_set_fd);
+    TPU_BIND(ssl, SSL_set1_host);
+    TPU_BIND(ssl, SSL_get0_param);
+    TPU_BIND(ssl, SSL_ctrl);
+    TPU_BIND(ssl, SSL_connect);
+    TPU_BIND(ssl, SSL_read);
+    TPU_BIND(ssl, SSL_write);
+    TPU_BIND(ssl, SSL_pending);
+    TPU_BIND(ssl, SSL_shutdown);
+    TPU_BIND(ssl, SSL_get_error);
+    TPU_BIND(crypto, ERR_get_error);
+    TPU_BIND(crypto, X509_VERIFY_PARAM_set1_ip_asc);
+    TPU_BIND(crypto, ERR_error_string_n);
+#undef TPU_BIND
+    lib.ok = all;
+  });
+  return lib;
+}
+
+std::string LastSslError(const OpenSsl& ssl, const char* fallback) {
+  unsigned long code = ssl.ERR_get_error ? ssl.ERR_get_error() : 0;
+  if (code == 0) return fallback;
+  char buf[256];
+  ssl.ERR_error_string_n(code, buf, sizeof(buf));
+  return std::string(buf);
+}
+
+}  // namespace
+
+bool TlsSession::Available() { return Lib().ok; }
+
+TlsSession::~TlsSession() { Close(); }
+
+void TlsSession::Close() {
+  const OpenSsl& lib = Lib();
+  if (ssl_ != nullptr && lib.ok) {
+    lib.SSL_shutdown(ssl_);  // best-effort close_notify, ignore result
+    lib.SSL_free(ssl_);
+  }
+  ssl_ = nullptr;
+  if (ctx_ != nullptr && lib.ok) lib.SSL_CTX_free(ctx_);
+  ctx_ = nullptr;
+}
+
+Error TlsSession::Handshake(int fd, const std::string& host,
+                            const TlsOptions& opts) {
+  const OpenSsl& lib = Lib();
+  if (!lib.ok) {
+    return Error(
+        "TLS requested but libssl.so.3 could not be loaded on this machine",
+        400);
+  }
+  ctx_ = lib.SSL_CTX_new(lib.TLS_client_method());
+  if (ctx_ == nullptr) {
+    return Error("SSL_CTX_new failed: " + LastSslError(lib, "unknown"), 400);
+  }
+  if (!opts.root_certificates.empty()) {
+    if (lib.SSL_CTX_load_verify_locations(
+            ctx_, opts.root_certificates.c_str(), nullptr) != 1) {
+      Error err("failed to load root certificates '" +
+                    opts.root_certificates +
+                    "': " + LastSslError(lib, "unknown"),
+                400);
+      Close();
+      return err;
+    }
+  } else {
+    lib.SSL_CTX_set_default_verify_paths(ctx_);
+  }
+  if (!opts.certificate_chain.empty() &&
+      lib.SSL_CTX_use_certificate_chain_file(
+          ctx_, opts.certificate_chain.c_str()) != 1) {
+    Error err("failed to load certificate chain '" + opts.certificate_chain +
+                  "': " + LastSslError(lib, "unknown"),
+              400);
+    Close();
+    return err;
+  }
+  if (!opts.private_key.empty() &&
+      lib.SSL_CTX_use_PrivateKey_file(ctx_, opts.private_key.c_str(),
+                                      kSslFiletypePem) != 1) {
+    Error err("failed to load private key '" + opts.private_key +
+                  "': " + LastSslError(lib, "unknown"),
+              400);
+    Close();
+    return err;
+  }
+  lib.SSL_CTX_set_verify(
+      ctx_, opts.verify_peer ? kSslVerifyPeer : kSslVerifyNone, nullptr);
+  if (!opts.alpn.empty()) {
+    // Wire format: length-prefixed protocol list.
+    std::string wire;
+    wire.push_back(static_cast<char>(opts.alpn.size()));
+    wire += opts.alpn;
+    lib.SSL_CTX_set_alpn_protos(
+        ctx_, reinterpret_cast<const unsigned char*>(wire.data()),
+        static_cast<unsigned>(wire.size()));
+  }
+
+  ssl_ = lib.SSL_new(ctx_);
+  if (ssl_ == nullptr) {
+    Error err("SSL_new failed: " + LastSslError(lib, "unknown"), 400);
+    Close();
+    return err;
+  }
+  lib.SSL_set_fd(ssl_, fd);
+  const std::string& name =
+      opts.server_name.empty() ? host : opts.server_name;
+  // SNI (harmless for IP literals — servers ignore unknown names).
+  lib.SSL_ctrl(ssl_, kSslCtrlSetTlsextHostname, kTlsextNametypeHostName,
+               const_cast<char*>(name.c_str()));
+  if (opts.verify_peer && opts.verify_host) {
+    // IP literals match against IP SANs (X509_VERIFY_PARAM_set1_ip_asc);
+    // SSL_set1_host only checks DNS names.
+    unsigned char ipbuf[16];
+    bool is_ip = inet_pton(AF_INET, name.c_str(), ipbuf) == 1 ||
+                 inet_pton(AF_INET6, name.c_str(), ipbuf) == 1;
+    if (is_ip) {
+      lib.X509_VERIFY_PARAM_set1_ip_asc(lib.SSL_get0_param(ssl_),
+                                        name.c_str());
+    } else {
+      lib.SSL_set1_host(ssl_, name.c_str());
+    }
+  }
+  if (lib.SSL_connect(ssl_) != 1) {
+    Error err("TLS handshake with " + host +
+                  " failed: " + LastSslError(lib, "handshake error"),
+              400);
+    Close();
+    return err;
+  }
+  return Error::Success();
+}
+
+ssize_t TlsSession::Read(void* buf, size_t n, Error* err) {
+  const OpenSsl& lib = Lib();
+  int rc = lib.SSL_read(ssl_, buf,
+                        static_cast<int>(n > 1 << 30 ? 1 << 30 : n));
+  if (rc > 0) return rc;
+  int code = lib.SSL_get_error(ssl_, rc);
+  if (code == kSslErrorZeroReturn) return 0;  // clean TLS close
+  if (code == kSslErrorWantRead) return kWantRead;
+  if (code == kSslErrorWantWrite) return kWantWrite;
+  if (err != nullptr) {
+    *err = Error("TLS read failed: " + LastSslError(lib, "connection error"),
+                 400);
+  }
+  return -1;
+}
+
+ssize_t TlsSession::Write(const void* buf, size_t n, Error* err) {
+  const OpenSsl& lib = Lib();
+  int rc = lib.SSL_write(ssl_, buf,
+                         static_cast<int>(n > 1 << 30 ? 1 << 30 : n));
+  if (rc > 0) return rc;
+  int code = lib.SSL_get_error(ssl_, rc);
+  if (code == kSslErrorWantRead) return kWantRead;
+  if (code == kSslErrorWantWrite) return kWantWrite;
+  if (err != nullptr) {
+    *err = Error("TLS write failed: " + LastSslError(lib, "connection error"),
+                 400);
+  }
+  return -1;
+}
+
+size_t TlsSession::Pending() {
+  const OpenSsl& lib = Lib();
+  int n = lib.SSL_pending(ssl_);
+  return n > 0 ? static_cast<size_t>(n) : 0;
+}
+
+}  // namespace tpuclient

@@ -280,3 +280,95 @@ def test_libcshm_ctypes(native_build):
     assert lib.SharedMemoryRegionSet(
         handle, ctypes.c_uint64(1021), ctypes.c_uint64(4), data) != 0
     assert lib.SharedMemoryRegionDestroy(handle) == 0
+
+
+# ---------------------------------------------------------------------------
+# TLS, compression, keepalive (reference SslOptions grpc_client.h:42-58,
+# CompressData http_client.cc:122-198, KeepAliveOptions grpc_client.h:61-81)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tls_cert(tmp_path_factory):
+    """Self-signed cert with SANs for localhost and 127.0.0.1."""
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout", key,
+         "-out", cert, "-days", "2", "-nodes", "-subj", "/CN=localhost",
+         "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1"],
+        check=True, capture_output=True)
+    return cert, key
+
+
+@pytest.fixture(scope="module")
+def tls_server(tls_cert):
+    cert, key = tls_cert
+    eng = TpuEngine(build_repository(["simple"]))
+    srv = HttpInferenceServer(eng, port=0, certfile=cert, keyfile=key).start()
+    yield srv
+    srv.stop()
+    eng.shutdown()
+
+
+@pytest.fixture(scope="module")
+def tls_grpc_server(tls_cert):
+    cert, key = tls_cert
+    eng = TpuEngine(build_repository(["simple"]))
+    srv = GrpcInferenceServer(eng, port=0, certfile=cert, keyfile=key).start()
+    yield srv
+    srv.stop()
+    eng.shutdown()
+
+
+def test_https_infer(native_build, tls_server, tls_cert):
+    """Native HTTP client over https:// with peer+host verification against
+    the provided CA (the self-signed cert doubles as its own root)."""
+    binary = os.path.join(native_build, "simple_http_infer_client")
+    proc = subprocess.run(
+        [binary, "-u", f"https://127.0.0.1:{tls_server.port}",
+         "-C", tls_cert[0]],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_https_rejects_unknown_ca(native_build, tls_server):
+    """Without the CA, verification must fail (no silent insecure fallback)."""
+    binary = os.path.join(native_build, "simple_http_infer_client")
+    proc = subprocess.run(
+        [binary, "-u", f"https://127.0.0.1:{tls_server.port}"],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode != 0
+    assert "TLS" in proc.stderr or "certificate" in proc.stderr.lower()
+
+
+def test_grpcs_infer(native_build, tls_grpc_server, tls_cert):
+    """Native gRPC client (h2 over TLS, ALPN h2) against the grpcio server's
+    secure port."""
+    binary = os.path.join(native_build, "simple_grpc_infer_client")
+    proc = subprocess.run(
+        [binary, "-u", f"grpcs://127.0.0.1:{tls_grpc_server.port}",
+         "-C", tls_cert[0]],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
+
+
+@pytest.mark.parametrize("algo", ["gzip", "deflate"])
+def test_http_compression(native_build, server, algo):
+    """Request body compressed (Content-Encoding) and response compression
+    negotiated (Accept-Encoding) end to end; values still assert."""
+    binary = os.path.join(native_build, "simple_http_infer_client")
+    proc = subprocess.run([binary, "-u", server.url, "-z", algo],
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_grpc_keepalive(native_build, grpc_server):
+    """Transport keepalive: aggressive PING cadence across an idle window,
+    then a value-asserting inference on the same channel."""
+    binary = os.path.join(native_build, "simple_grpc_keepalive_client")
+    proc = subprocess.run([binary, "-u", f"127.0.0.1:{grpc_server.port}"],
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
