@@ -1,0 +1,91 @@
+// Ensemble pipeline conformance client: raw HxWx3 bytes through the
+// image_preprocess → resnet50 ensemble in one request.
+//
+// Reference counterpart: ensemble_image_client.cc
+// (/root/reference/src/c++/examples/ensemble_image_client.cc:365) — there,
+// OpenCV-decoded images into the preprocess+inception ensemble; here a
+// deterministic synthetic image (no OpenCV in the dependency-free tree), the
+// same single-request many-model flow, asserting a full finite logits
+// vector comes back.
+#include <unistd.h>
+
+#include <cmath>
+#include <cstdint>
+#include <iostream>
+#include <vector>
+
+#include "tpuclient/http_client.h"
+
+namespace tc = tpuclient;
+
+#define FAIL_IF_ERR(X, MSG)                                          \
+  do {                                                               \
+    tc::Error err__ = (X);                                           \
+    if (!err__.IsOk()) {                                             \
+      std::cerr << "error: " << (MSG) << ": " << err__ << std::endl; \
+      exit(1);                                                       \
+    }                                                                \
+  } while (false)
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  int opt;
+  while ((opt = getopt(argc, argv, "u:")) != -1)
+    if (opt == 'u') url = optarg;
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  FAIL_IF_ERR(tc::InferenceServerHttpClient::Create(&client, url),
+              "create client");
+
+  // Deterministic synthetic 480x640 RGB image.
+  constexpr int kH = 480, kW = 640;
+  std::vector<uint8_t> image(size_t(kH) * kW * 3);
+  uint32_t state = 11;
+  for (auto& px : image) {
+    state = state * 1664525u + 1013904223u;  // LCG
+    px = uint8_t(state >> 24);
+  }
+
+  tc::InferInput* raw;
+  FAIL_IF_ERR(tc::InferInput::Create(&raw, "RAW_IMAGE", {1, kH, kW, 3},
+                                     "UINT8"),
+              "create RAW_IMAGE");
+  std::unique_ptr<tc::InferInput> owner_in(raw);
+  FAIL_IF_ERR(raw->AppendRaw(image.data(), image.size()), "RAW_IMAGE data");
+
+  tc::InferOptions options("ensemble_image");
+  tc::InferResult* result;
+  FAIL_IF_ERR(client->Infer(&result, options, {raw}), "ensemble infer");
+  std::unique_ptr<tc::InferResult> owner(result);
+  FAIL_IF_ERR(result->RequestStatus(), "request status");
+
+  std::vector<int64_t> shape;
+  std::string datatype;
+  FAIL_IF_ERR(result->Shape("CLASS_LOGITS", &shape), "logits shape");
+  FAIL_IF_ERR(result->Datatype("CLASS_LOGITS", &datatype), "logits dtype");
+  if (shape != std::vector<int64_t>({1, 1000}) || datatype != "FP32") {
+    std::cerr << "error: unexpected CLASS_LOGITS shape/dtype" << std::endl;
+    return 1;
+  }
+  const uint8_t* buf;
+  size_t byte_size;
+  FAIL_IF_ERR(result->RawData("CLASS_LOGITS", &buf, &byte_size),
+              "logits data");
+  if (byte_size != 1000 * sizeof(float)) {
+    std::cerr << "error: unexpected logits byte size " << byte_size
+              << std::endl;
+    return 1;
+  }
+  const float* logits = reinterpret_cast<const float*>(buf);
+  int best = 0;
+  for (int i = 0; i < 1000; ++i) {
+    if (!std::isfinite(logits[i])) {
+      std::cerr << "error: non-finite logit at " << i << std::endl;
+      return 1;
+    }
+    if (logits[i] > logits[best]) best = i;
+  }
+  std::cout << "top class: " << best << std::endl;
+  std::cout << "PASS : ensemble image" << std::endl;
+  return 0;
+}

@@ -1,0 +1,109 @@
+// Decoupled-model conformance client: one request to `simple_repeat`
+// produces N ordered responses on the bidi stream.
+//
+// Reference counterpart: simple_grpc_custom_repeat_client
+// (/root/reference/src/c++/examples/, the custom repeat/decoupled model
+// flow): a repeat model with a decoupled transaction policy answers a single
+// request with one response per input element, then an empty final-flagged
+// response. Exit 0 only if all N values arrive in order.
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <iostream>
+#include <mutex>
+#include <vector>
+
+#include "tpuclient/grpc_client.h"
+
+namespace tc = tpuclient;
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8001";
+  int repeat = 4;
+  int opt;
+  while ((opt = getopt(argc, argv, "u:n:")) != -1) {
+    if (opt == 'u') url = optarg;
+    if (opt == 'n') repeat = atoi(optarg);
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  if (!tc::InferenceServerGrpcClient::Create(&client, url).IsOk()) return 1;
+
+  std::mutex mtx;
+  std::condition_variable cv;
+  std::vector<int32_t> got;
+  bool done = false, stream_error = false;
+
+  tc::Error err = client->StartStream([&](tc::InferResult* result) {
+    std::unique_ptr<tc::InferResult> owner(result);
+    std::lock_guard<std::mutex> lk(mtx);
+    if (!result->RequestStatus().IsOk()) {
+      std::cerr << "stream response error: " << result->RequestStatus()
+                << std::endl;
+      stream_error = true;
+    } else {
+      const uint8_t* buf;
+      size_t sz;
+      if (result->RawData("OUT", &buf, &sz).IsOk() && sz == sizeof(int32_t)) {
+        got.push_back(*reinterpret_cast<const int32_t*>(buf));
+      } else {
+        // Empty response: the decoupled stream's final-flag terminator.
+        done = true;
+      }
+    }
+    cv.notify_all();
+  });
+  if (!err.IsOk()) {
+    std::cerr << "StartStream failed: " << err << std::endl;
+    return 1;
+  }
+
+  std::vector<int32_t> values(repeat);
+  for (int i = 0; i < repeat; ++i) values[i] = i * 11;
+
+  tc::InferInput* input;
+  tc::InferInput::Create(&input, "IN", {repeat}, "INT32");
+  std::unique_ptr<tc::InferInput> owner_in(input);
+  input->AppendRaw(reinterpret_cast<uint8_t*>(values.data()),
+                   values.size() * sizeof(int32_t));
+
+  tc::InferOptions options("simple_repeat");
+  options.request_id = "r1";
+  tc::Error serr = client->AsyncStreamInfer(options, {input});
+  if (!serr.IsOk()) {
+    std::cerr << "AsyncStreamInfer failed: " << serr << std::endl;
+    return 1;
+  }
+
+  {
+    std::unique_lock<std::mutex> lk(mtx);
+    if (!cv.wait_for(lk, std::chrono::seconds(60), [&] {
+          return stream_error ||
+                 (got.size() >= size_t(repeat) && done);
+        })) {
+      std::cerr << "error: timed out (" << got.size() << "/" << repeat
+                << " responses, final=" << done << ")" << std::endl;
+      return 1;
+    }
+    if (stream_error) return 1;
+    if (got.size() != size_t(repeat)) {
+      std::cerr << "error: " << got.size() << " responses, expected "
+                << repeat << std::endl;
+      return 1;
+    }
+    for (int i = 0; i < repeat; ++i) {
+      if (got[i] != values[i]) {
+        std::cerr << "error: response " << i << " = " << got[i]
+                  << ", expected " << values[i] << std::endl;
+        return 1;
+      }
+    }
+  }
+  client->StopStream();
+
+  std::cout << "PASS : decoupled repeat (" << repeat
+            << " responses from one request)" << std::endl;
+  return 0;
+}

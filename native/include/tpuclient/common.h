@@ -227,6 +227,13 @@ struct RequestTimers {
 };
 
 // Cumulative client-side statistics (reference InferStat, common.h:92-113).
+// Splits a server URL into host + port: tolerates "scheme://" prefixes,
+// bracketed IPv6 literals ("[::1]:8001"), bare IPv6 literals, and missing
+// ports (default_port). Returns the scheme ("" when absent) so callers can
+// derive TLS intent ("https"/"grpcs").
+std::string SplitUrl(const std::string& url, int default_port,
+                     std::string* host, int* port);
+
 struct InferStat {
   size_t completed_request_count = 0;
   uint64_t cumulative_total_request_time_ns = 0;

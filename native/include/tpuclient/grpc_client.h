@@ -37,6 +37,18 @@ class Connection;
 
 using GrpcHeaders = std::map<std::string, std::string>;
 
+// Generic unary gRPC call over an established h2 connection: frames the
+// request message, drives one stream to half-close, parses the single
+// response message, maps grpc-status. Lets auxiliary gRPC service clients
+// (the perf harness's TENSORFLOW_SERVING kind speaking
+// /tensorflow.serving.PredictionService/*) reuse the in-tree transport.
+Error GrpcUnaryCall(h2::Connection* conn, const std::string& authority,
+                    const std::string& method_path,
+                    const google::protobuf::Message& request,
+                    google::protobuf::Message* response,
+                    uint64_t timeout_us = 0,
+                    const GrpcHeaders& headers = {});
+
 // TLS settings for encrypted channels (reference SslOptions,
 // grpc_client.h:42-58): PEM file paths; empty root_certificates = system
 // default verify paths.

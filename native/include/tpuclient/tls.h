@@ -59,9 +59,6 @@ class TlsSession {
   ssize_t Read(void* buf, size_t n, Error* err);
   ssize_t Write(const void* buf, size_t n, Error* err);
 
-  // Bytes already decrypted and buffered inside the TLS layer — readable
-  // immediately even though poll() on the fd would block.
-  size_t Pending();
 
   bool Active() const { return ssl_ != nullptr; }
 

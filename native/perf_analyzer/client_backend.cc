@@ -174,6 +174,10 @@ Error ClientBackendFactory::Create(
     case BackendKind::TPU_CAPI:
       return CreateCApiBackend(capi_lib_path_, capi_models_, capi_repo_root_,
                                backend);
+    case BackendKind::TENSORFLOW_SERVING:
+      return CreateTfServeBackend(url_, verbose_, backend);
+    case BackendKind::TORCHSERVE:
+      return CreateTorchServeBackend(url_, verbose_, backend);
   }
   return Error("unknown backend kind", 400);
 }

@@ -20,7 +20,16 @@
 
 namespace tpuperf {
 
-enum class BackendKind { TPU_HTTP, TPU_GRPC, TPU_CAPI };
+enum class BackendKind {
+  TPU_HTTP,
+  TPU_GRPC,
+  TPU_CAPI,
+  // Non-TPU service kinds, for harness parity with the reference's four-way
+  // abstraction (client_backend.h:101-106): TFS PredictionService over the
+  // in-tree gRPC transport, and TorchServe's prediction REST API.
+  TENSORFLOW_SERVING,
+  TORCHSERVE,
+};
 
 // Server-side per-model statistics snapshot (reference ModelStatistics,
 // client_backend.h:148-168), pulled from the v2 statistics endpoint.
@@ -129,6 +138,12 @@ tpuclient::Error CreateCApiBackend(const std::string& lib_path,
                                    std::unique_ptr<ClientBackend>* backend);
 
 // Defined in grpc_backend.cc.
+tpuclient::Error CreateTfServeBackend(
+    const std::string& url, bool verbose,
+    std::unique_ptr<ClientBackend>* backend);
+tpuclient::Error CreateTorchServeBackend(
+    const std::string& url, bool verbose,
+    std::unique_ptr<ClientBackend>* backend);
 tpuclient::Error CreateGrpcBackend(const std::string& url, bool verbose,
                                    std::unique_ptr<ClientBackend>* backend);
 

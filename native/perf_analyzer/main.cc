@@ -55,7 +55,8 @@ Options:
   --shared-memory <none|system|tpu>   tensor transport (default none)
   --output-shared-memory-size <bytes>
   --max-threads <n>      worker thread cap (default 16)
-  --service-kind <tpu_http|tpu_grpc|tpu_capi>  endpoint kind (default
+  --service-kind <tpu_http|tpu_grpc|tpu_capi|tfserving|torchserve>
+                         endpoint kind (default
                          tpu_http; -i grpc implies tpu_grpc);
                          tpu_capi runs the engine in-process via
                          libtpuserver.so — no network, sync only
@@ -303,8 +304,13 @@ int main(int argc, char** argv) {
         if (strcmp(optarg, "tpu_capi") == 0) args.kind = BackendKind::TPU_CAPI;
         else if (strcmp(optarg, "tpu_grpc") == 0)
           args.kind = BackendKind::TPU_GRPC;
+        else if (strcmp(optarg, "tfserving") == 0)
+          args.kind = BackendKind::TENSORFLOW_SERVING;
+        else if (strcmp(optarg, "torchserve") == 0)
+          args.kind = BackendKind::TORCHSERVE;
         else if (strcmp(optarg, "tpu_http") != 0)
-          Usage("--service-kind must be tpu_http|tpu_grpc|tpu_capi");
+          Usage("--service-kind must be "
+                "tpu_http|tpu_grpc|tpu_capi|tfserving|torchserve");
         break;
       case 1018: args.capi_lib = optarg; break;
       case 1019: args.capi_models = optarg; break;
@@ -318,6 +324,24 @@ int main(int argc, char** argv) {
     if (!args.url_set) args.url = "localhost:8001";
   } else if (args.protocol != "http") {
     Usage("-i must be http or grpc");
+  }
+  if (args.kind == BackendKind::TENSORFLOW_SERVING ||
+      args.kind == BackendKind::TORCHSERVE) {
+    // Capability guards mirroring the reference (main.cc:1197-1216): both
+    // kinds are sync-only and have no shared-memory control plane;
+    // torchserve additionally needs --input-data files to upload.
+    if (args.async)
+      Usage("--service-kind tfserving/torchserve is sync-only");
+    if (args.shm != SharedMemoryType::NONE)
+      Usage("--shared-memory is not supported with "
+            "tfserving/torchserve kinds");
+    if (args.kind == BackendKind::TORCHSERVE &&
+        (args.input_data == "random" || args.input_data == "zero"))
+      Usage("--service-kind torchserve requires --input-data with file "
+            "paths");
+    if (!args.url_set)
+      args.url = args.kind == BackendKind::TENSORFLOW_SERVING
+                     ? "localhost:8500" : "localhost:8080";
   }
   if (args.kind == BackendKind::TPU_CAPI) {
     // Sync-only like the reference's C-API kind (main.cc:1227-1248) —

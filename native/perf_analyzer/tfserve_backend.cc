@@ -1,0 +1,396 @@
+// Kind=TENSORFLOW_SERVING: the perf harness speaking TFS PredictionService
+// over the in-tree gRPC transport.
+//
+// Counterpart of the reference's tensorflow_serving backend
+// (/root/reference/src/c++/perf_analyzer/client_backend/tensorflow_serving/
+// tfserve_client_backend.h:52-110, tfserve_grpc_client.{h,cc} — a dedicated
+// grpc++ PredictionService client with perf↔TFS dtype conversion,
+// perf_utils.h:78-79). Here the messages are the re-authored minimal protos
+// (protocol/protos/tfs_predict.proto) and the transport is GrpcUnaryCall
+// over src/h2.cc. Design difference: instead of special-casing the model
+// parser (reference InitTFServe, model_parser.cc:208-296), this backend
+// converts TFS GetModelMetadata signature_defs into v2-shaped metadata JSON
+// so the generic parser path handles all kinds uniformly.
+
+#include <cstring>
+
+#include "client_backend.h"
+#include "tfs_predict.pb.h"
+#include "tpuclient/grpc_client.h"
+
+// h2.h lives in src/ (internal transport header).
+#include "../src/h2.h"
+
+using tpuclient::Error;
+using tpuclient::JsonPtr;
+
+namespace tpuperf {
+
+namespace {
+
+namespace tfs = tensorflow::serving;
+
+constexpr const char* kSignatureName = "serving_default";
+
+struct DtypePair { const char* v2; tfs::DataType tf; };
+constexpr DtypePair kDtypes[] = {
+    {"FP32", tfs::DT_FLOAT},   {"FP64", tfs::DT_DOUBLE},
+    {"INT32", tfs::DT_INT32},  {"UINT8", tfs::DT_UINT8},
+    {"INT16", tfs::DT_INT16},  {"INT8", tfs::DT_INT8},
+    {"BYTES", tfs::DT_STRING}, {"INT64", tfs::DT_INT64},
+    {"BOOL", tfs::DT_BOOL},    {"UINT16", tfs::DT_UINT16},
+    {"FP16", tfs::DT_HALF},    {"UINT32", tfs::DT_UINT32},
+    {"UINT64", tfs::DT_UINT64},
+};
+
+tfs::DataType V2ToTfs(const std::string& v2) {
+  for (const auto& p : kDtypes)
+    if (v2 == p.v2) return p.tf;
+  return tfs::DT_INVALID;
+}
+
+const char* TfsToV2(tfs::DataType tf) {
+  for (const auto& p : kDtypes)
+    if (tf == p.tf) return p.v2;
+  return nullptr;
+}
+
+size_t TfsDtypeSize(tfs::DataType tf) {
+  switch (tf) {
+    case tfs::DT_FLOAT: return 4;
+    case tfs::DT_DOUBLE: return 8;
+    case tfs::DT_INT32: return 4;
+    case tfs::DT_UINT8: return 1;
+    case tfs::DT_INT16: return 2;
+    case tfs::DT_INT8: return 1;
+    case tfs::DT_INT64: return 8;
+    case tfs::DT_BOOL: return 1;
+    case tfs::DT_UINT16: return 2;
+    case tfs::DT_HALF: return 2;
+    case tfs::DT_UINT32: return 4;
+    case tfs::DT_UINT64: return 8;
+    default: return 0;
+  }
+}
+
+// Packs a TensorProto's payload into contiguous little-endian bytes: the
+// fast path is tensor_content verbatim; typed repeated fields are
+// materialized (TFS answers with either form).
+void PackTensor(const tfs::TensorProto& t, std::string* out) {
+  if (!t.tensor_content().empty()) {
+    *out = t.tensor_content();
+    return;
+  }
+  auto append = [out](const void* p, size_t n) {
+    out->append(reinterpret_cast<const char*>(p), n);
+  };
+  switch (t.dtype()) {
+    case tfs::DT_FLOAT:
+      for (float v : t.float_val()) append(&v, 4);
+      break;
+    case tfs::DT_DOUBLE:
+      for (double v : t.double_val()) append(&v, 8);
+      break;
+    case tfs::DT_INT32:
+    case tfs::DT_INT16:
+    case tfs::DT_INT8:
+    case tfs::DT_UINT8:
+    case tfs::DT_UINT16: {
+      size_t sz = TfsDtypeSize(t.dtype());
+      for (int32_t v : t.int_val()) append(&v, sz);  // LE truncation
+      break;
+    }
+    case tfs::DT_HALF:
+      // half_val carries one fp16 pattern in the low 16 bits per element.
+      for (int32_t v : t.half_val()) append(&v, 2);
+      break;
+    case tfs::DT_INT64:
+      for (int64_t v : t.int64_val()) append(&v, 8);
+      break;
+    case tfs::DT_BOOL:
+      for (bool v : t.bool_val()) {
+        char b = v ? 1 : 0;
+        append(&b, 1);
+      }
+      break;
+    case tfs::DT_UINT32:
+      for (uint32_t v : t.uint32_val()) append(&v, 4);
+      break;
+    case tfs::DT_UINT64:
+      for (uint64_t v : t.uint64_val()) append(&v, 8);
+      break;
+    case tfs::DT_STRING:
+      for (const std::string& s : t.string_val()) {
+        uint32_t len = uint32_t(s.size());
+        append(&len, 4);  // v2 BYTES framing: 4-byte LE length prefix
+        out->append(s);
+      }
+      break;
+    default:
+      break;
+  }
+}
+
+class TfsInferResult : public tpuclient::InferResult {
+ public:
+  TfsInferResult(std::shared_ptr<tfs::PredictResponse> resp, Error status,
+                 std::string request_id)
+      : resp_(std::move(resp)), status_(std::move(status)),
+        request_id_(std::move(request_id)) {
+    if (resp_ != nullptr) {
+      for (const auto& kv : resp_->outputs()) {
+        PackTensor(kv.second, &packed_[kv.first]);
+      }
+    }
+  }
+
+  Error ModelName(std::string* name) const override {
+    *name = resp_ != nullptr ? resp_->model_spec().name() : "";
+    return Error::Success();
+  }
+  Error ModelVersion(std::string* version) const override {
+    *version = resp_ != nullptr && resp_->model_spec().has_version()
+                   ? std::to_string(resp_->model_spec().version().value())
+                   : "";
+    return Error::Success();
+  }
+  Error Id(std::string* id) const override {
+    *id = request_id_;  // TFS carries no request id; echo the client's
+    return Error::Success();
+  }
+  Error Shape(const std::string& output_name,
+              std::vector<int64_t>* shape) const override {
+    const tfs::TensorProto* t = Find(output_name);
+    if (t == nullptr) return Error("no output '" + output_name + "'", 400);
+    shape->clear();
+    for (const auto& d : t->tensor_shape().dim()) shape->push_back(d.size());
+    return Error::Success();
+  }
+  Error Datatype(const std::string& output_name,
+                 std::string* datatype) const override {
+    const tfs::TensorProto* t = Find(output_name);
+    if (t == nullptr) return Error("no output '" + output_name + "'", 400);
+    const char* v2 = TfsToV2(t->dtype());
+    *datatype = v2 != nullptr ? v2 : "UNKNOWN";
+    return Error::Success();
+  }
+  Error RawData(const std::string& output_name, const uint8_t** buf,
+                size_t* byte_size) const override {
+    auto it = packed_.find(output_name);
+    if (it == packed_.end())
+      return Error("no output '" + output_name + "'", 400);
+    *buf = reinterpret_cast<const uint8_t*>(it->second.data());
+    *byte_size = it->second.size();
+    return Error::Success();
+  }
+  Error RequestStatus() const override { return status_; }
+  std::string DebugString() const override {
+    return resp_ != nullptr ? resp_->ShortDebugString() : status_.Message();
+  }
+
+ private:
+  const tfs::TensorProto* Find(const std::string& name) const {
+    if (resp_ == nullptr) return nullptr;
+    auto it = resp_->outputs().find(name);
+    return it == resp_->outputs().end() ? nullptr : &it->second;
+  }
+
+  std::shared_ptr<tfs::PredictResponse> resp_;
+  Error status_;
+  std::string request_id_;
+  std::map<std::string, std::string> packed_;
+};
+
+class TfServeClientBackend : public ClientBackend {
+ public:
+  static Error Create(const std::string& url, bool verbose,
+                      std::unique_ptr<ClientBackend>* backend) {
+    (void)verbose;
+    auto b = std::unique_ptr<TfServeClientBackend>(new TfServeClientBackend());
+    std::string host;
+    int port;
+    tpuclient::SplitUrl(url, /*default_port=*/8500, &host, &port);
+    b->authority_ = host.find(':') != std::string::npos
+                        ? "[" + host + "]:" + std::to_string(port)
+                        : host + ":" + std::to_string(port);
+    b->conn_ = std::make_shared<tpuclient::h2::Connection>();
+    Error err = b->conn_->Connect(host, port);
+    if (!err.IsOk()) return err;
+    *backend = std::move(b);
+    return Error::Success();
+  }
+
+  Error ServerExtensions(std::vector<std::string>* extensions) override {
+    extensions->clear();  // TFS has no v2 extension discovery
+    return Error::Success();
+  }
+
+  // TFS GetModelMetadata(signature_def) → v2-shaped metadata JSON, so the
+  // generic model parser consumes one format for every kind.
+  Error ModelMetadata(JsonPtr* metadata, const std::string& model_name,
+                      const std::string& version) override {
+    tfs::GetModelMetadataRequest req;
+    req.mutable_model_spec()->set_name(model_name);
+    req.mutable_model_spec()->set_signature_name(kSignatureName);
+    if (!version.empty())
+      req.mutable_model_spec()->mutable_version()->set_value(
+          atoll(version.c_str()));
+    req.add_metadata_field("signature_def");
+    tfs::GetModelMetadataResponse resp;
+    Error err = tpuclient::GrpcUnaryCall(
+        conn_.get(), authority_,
+        "/tensorflow.serving.PredictionService/GetModelMetadata", req, &resp);
+    if (!err.IsOk()) return err;
+
+    auto it = resp.metadata().find("signature_def");
+    if (it == resp.metadata().end())
+      return Error("TFS metadata carries no signature_def", 400);
+    tfs::SignatureDefMap sigmap;
+    if (!it->second.UnpackTo(&sigmap))
+      return Error("failed to unpack SignatureDefMap", 400);
+    auto sit = sigmap.signature_def().find(kSignatureName);
+    if (sit == sigmap.signature_def().end())
+      return Error("signature '" + std::string(kSignatureName) +
+                       "' not found in TFS metadata",
+                   400);
+
+    auto tensor_json = [](const std::string& name,
+                          const tfs::TensorInfo& info) {
+      JsonPtr t = tpuclient::Json::MakeObject();
+      t->Set("name", name);
+      const char* v2 = TfsToV2(info.dtype());
+      t->Set("datatype", v2 != nullptr ? v2 : "UNKNOWN");
+      JsonPtr dims = tpuclient::Json::MakeArray();
+      if (!info.tensor_shape().unknown_rank()) {
+        for (const auto& d : info.tensor_shape().dim())
+          dims->Append(tpuclient::Json::MakeInt(d.size()));
+      }
+      t->Set("shape", dims);
+      return t;
+    };
+    JsonPtr out = tpuclient::Json::MakeObject();
+    out->Set("name", model_name);
+    out->Set("platform", "tensorflow_serving");
+    JsonPtr inputs = tpuclient::Json::MakeArray();
+    for (const auto& kv : sit->second.inputs())
+      inputs->Append(tensor_json(kv.first, kv.second));
+    out->Set("inputs", inputs);
+    JsonPtr outputs = tpuclient::Json::MakeArray();
+    for (const auto& kv : sit->second.outputs())
+      outputs->Append(tensor_json(kv.first, kv.second));
+    out->Set("outputs", outputs);
+    *metadata = out;
+    return Error::Success();
+  }
+
+  Error ModelConfig(JsonPtr* config, const std::string& model_name,
+                    const std::string& version) override {
+    (void)version;
+    // TFS exposes no Triton-style config; minimal object (no batching
+    // metadata — the harness's --batch-size flag governs, as in the
+    // reference's InitTFServe, model_parser.cc:221-223).
+    JsonPtr out = tpuclient::Json::MakeObject();
+    out->Set("name", model_name);
+    out->Set("max_batch_size", int64_t(0));
+    *config = out;
+    return Error::Success();
+  }
+
+  Error Infer(tpuclient::InferResult** result,
+              const tpuclient::InferOptions& options,
+              const std::vector<tpuclient::InferInput*>& inputs,
+              const std::vector<const tpuclient::InferRequestedOutput*>&
+                  outputs) override {
+    tfs::PredictRequest req;
+    req.mutable_model_spec()->set_name(options.model_name);
+    req.mutable_model_spec()->set_signature_name(kSignatureName);
+    if (!options.model_version.empty())
+      req.mutable_model_spec()->mutable_version()->set_value(
+          atoll(options.model_version.c_str()));
+
+    for (const tpuclient::InferInput* input : inputs) {
+      if (input->IsSharedMemory())
+        return Error("shared memory is not supported with the "
+                     "tensorflow_serving kind",
+                     400);
+      tfs::TensorProto& t = (*req.mutable_inputs())[input->Name()];
+      tfs::DataType dt = V2ToTfs(input->Datatype());
+      if (dt == tfs::DT_INVALID)
+        return Error("dtype " + input->Datatype() +
+                         " unsupported for tensorflow_serving",
+                     400);
+      t.set_dtype(dt);
+      for (int64_t d : input->Shape())
+        t.mutable_tensor_shape()->add_dim()->set_size(d);
+      if (dt == tfs::DT_STRING) {
+        // Re-split the v2 length-prefixed BYTES stream into string_val.
+        std::string flat;
+        input->CopyTo(&flat);
+        size_t pos = 0;
+        while (pos + 4 <= flat.size()) {
+          uint32_t len;
+          memcpy(&len, flat.data() + pos, 4);
+          pos += 4;
+          if (pos + len > flat.size())
+            return Error("malformed BYTES input '" + input->Name() + "'",
+                         400);
+          t.add_string_val(flat.substr(pos, len));
+          pos += len;
+        }
+      } else {
+        std::string* content = t.mutable_tensor_content();
+        content->reserve(input->TotalByteSize());
+        for (const auto& seg : input->Buffers())
+          content->append(reinterpret_cast<const char*>(seg.first),
+                          seg.second);
+      }
+    }
+    for (const tpuclient::InferRequestedOutput* o : outputs)
+      req.add_output_filter(o->Name());
+
+    auto resp = std::make_shared<tfs::PredictResponse>();
+    Error err = tpuclient::GrpcUnaryCall(
+        conn_.get(), authority_,
+        "/tensorflow.serving.PredictionService/Predict", req, resp.get(),
+        options.client_timeout_us);
+    *result = new TfsInferResult(err.IsOk() ? resp : nullptr, err,
+                                 options.request_id);
+    return err;
+  }
+
+  Error AsyncInfer(tpuclient::OnCompleteFn, const tpuclient::InferOptions&,
+                   const std::vector<tpuclient::InferInput*>&,
+                   const std::vector<const tpuclient::InferRequestedOutput*>&)
+      override {
+    return Error("async is not supported with the tensorflow_serving kind "
+                 "(reference main.cc:1197-1206)",
+                 400);
+  }
+
+  Error ModelInferenceStatistics(std::map<std::string, ModelStatistics>*,
+                                 const std::string&) override {
+    return Error("server-side statistics are not available from "
+                 "TensorFlow Serving",
+                 400);
+  }
+
+  Error ClientInferStat(tpuclient::InferStat* stat) override {
+    *stat = tpuclient::InferStat();
+    return Error::Success();
+  }
+
+  bool SupportsAsync() const override { return false; }
+
+ private:
+  std::shared_ptr<tpuclient::h2::Connection> conn_;
+  std::string authority_;
+};
+
+}  // namespace
+
+Error CreateTfServeBackend(const std::string& url, bool verbose,
+                           std::unique_ptr<ClientBackend>* backend) {
+  return TfServeClientBackend::Create(url, verbose, backend);
+}
+
+}  // namespace tpuperf

@@ -1,0 +1,201 @@
+// Kind=TORCHSERVE: HTTP-only mini-client for TorchServe's inference REST
+// API (POST /predictions/<model>).
+//
+// Counterpart of the reference's torchserve backend
+// (/root/reference/src/c++/perf_analyzer/client_backend/torchserve/
+// torchserve_client_backend.h:52-89, torchserve_http_client.{h,cc};
+// requires --input-data with file paths, main.cc:1210-1216). TorchServe
+// exposes no model metadata, so the backend synthesizes the single-BYTES
+// "TORCHSERVE_INPUT" tensor the reference's InitTorchServe hardcodes
+// (model_parser.cc:298-317) — as v2 JSON here, so the generic parser path
+// applies. The BYTES element carries the path of the file to upload.
+
+#include <cstring>
+#include <fstream>
+#include <sstream>
+
+#include "client_backend.h"
+#include "tpuclient/http_client.h"
+
+using tpuclient::Error;
+using tpuclient::JsonPtr;
+
+namespace tpuperf {
+
+namespace {
+
+class TorchServeInferResult : public tpuclient::InferResult {
+ public:
+  TorchServeInferResult(std::string body, Error status, std::string model,
+                        std::string request_id)
+      : body_(std::move(body)), status_(std::move(status)),
+        model_(std::move(model)), request_id_(std::move(request_id)) {}
+
+  Error ModelName(std::string* name) const override {
+    *name = model_;
+    return Error::Success();
+  }
+  Error ModelVersion(std::string* version) const override {
+    version->clear();
+    return Error::Success();
+  }
+  Error Id(std::string* id) const override {
+    *id = request_id_;
+    return Error::Success();
+  }
+  Error Shape(const std::string&, std::vector<int64_t>* shape) const override {
+    // The prediction body is opaque (model-dependent JSON/bytes).
+    *shape = {int64_t(body_.size())};
+    return Error::Success();
+  }
+  Error Datatype(const std::string&, std::string* datatype) const override {
+    *datatype = "BYTES";
+    return Error::Success();
+  }
+  Error RawData(const std::string&, const uint8_t** buf,
+                size_t* byte_size) const override {
+    *buf = reinterpret_cast<const uint8_t*>(body_.data());
+    *byte_size = body_.size();
+    return Error::Success();
+  }
+  Error RequestStatus() const override { return status_; }
+  std::string DebugString() const override { return body_; }
+
+ private:
+  std::string body_;
+  Error status_;
+  std::string model_;
+  std::string request_id_;
+};
+
+class TorchServeClientBackend : public ClientBackend {
+ public:
+  static Error Create(const std::string& url, bool verbose,
+                      std::unique_ptr<ClientBackend>* backend) {
+    auto b = std::unique_ptr<TorchServeClientBackend>(
+        new TorchServeClientBackend());
+    // TorchServe inference API default port is 8080; honor explicit ports.
+    std::string host;
+    int port;
+    tpuclient::SplitUrl(url, /*default_port=*/8080, &host, &port);
+    Error err = tpuclient::InferenceServerHttpClient::Create(
+        &b->client_, host + ":" + std::to_string(port), verbose);
+    if (!err.IsOk()) return err;
+    *backend = std::move(b);
+    return Error::Success();
+  }
+
+  Error ServerExtensions(std::vector<std::string>* extensions) override {
+    extensions->clear();
+    return Error::Success();
+  }
+
+  Error ModelMetadata(JsonPtr* metadata, const std::string& model_name,
+                      const std::string&) override {
+    // Synthesized: TorchServe returns no metadata (reference
+    // model_parser.cc:302-314).
+    JsonPtr out = tpuclient::Json::MakeObject();
+    out->Set("name", model_name);
+    out->Set("platform", "torchserve");
+    JsonPtr inputs = tpuclient::Json::MakeArray();
+    JsonPtr in = tpuclient::Json::MakeObject();
+    in->Set("name", "TORCHSERVE_INPUT");
+    in->Set("datatype", "BYTES");
+    JsonPtr dims = tpuclient::Json::MakeArray();
+    dims->Append(tpuclient::Json::MakeInt(1));
+    in->Set("shape", dims);
+    inputs->Append(in);
+    out->Set("inputs", inputs);
+    out->Set("outputs", tpuclient::Json::MakeArray());
+    *metadata = out;
+    return Error::Success();
+  }
+
+  Error ModelConfig(JsonPtr* config, const std::string& model_name,
+                    const std::string&) override {
+    JsonPtr out = tpuclient::Json::MakeObject();
+    out->Set("name", model_name);
+    out->Set("max_batch_size", int64_t(0));
+    *config = out;
+    return Error::Success();
+  }
+
+  Error Infer(tpuclient::InferResult** result,
+              const tpuclient::InferOptions& options,
+              const std::vector<tpuclient::InferInput*>& inputs,
+              const std::vector<const tpuclient::InferRequestedOutput*>&)
+      override {
+    if (inputs.size() != 1)
+      return Error("torchserve expects exactly one BYTES input holding the "
+                   "file path (--input-data json)",
+                   400);
+    // Decode the first element of the length-prefixed BYTES stream: the
+    // path of the file to upload (reference torchserve flow).
+    std::string flat;
+    inputs[0]->CopyTo(&flat);
+    if (flat.size() < 4)
+      return Error("empty TORCHSERVE_INPUT", 400);
+    uint32_t len;
+    memcpy(&len, flat.data(), 4);
+    if (4 + size_t(len) > flat.size())
+      return Error("malformed TORCHSERVE_INPUT BYTES element", 400);
+    std::string path = flat.substr(4, len);
+
+    // Cache file contents per path: the --input-data path set is fixed for
+    // the run, and re-reading inside the timed request path would charge
+    // disk I/O to the measured latency.
+    auto cached = file_cache_.find(path);
+    if (cached == file_cache_.end()) {
+      std::ifstream f(path, std::ios::binary);
+      if (!f.good())
+        return Error("torchserve input file '" + path + "' not readable",
+                     400);
+      std::ostringstream content;
+      content << f.rdbuf();
+      cached = file_cache_.emplace(path, content.str()).first;
+    }
+
+    // Raw-body POST (TorchServe accepts raw bodies alongside multipart
+    // form uploads; the reference uses the multipart form).
+    JsonPtr resp;
+    Error err = client_->Post("/predictions/" + options.model_name,
+                              cached->second, &resp);
+    std::string body = resp != nullptr ? resp->Serialize() : "";
+    *result = new TorchServeInferResult(std::move(body), err,
+                                        options.model_name,
+                                        options.request_id);
+    return err;
+  }
+
+  Error AsyncInfer(tpuclient::OnCompleteFn, const tpuclient::InferOptions&,
+                   const std::vector<tpuclient::InferInput*>&,
+                   const std::vector<const tpuclient::InferRequestedOutput*>&)
+      override {
+    return Error("async is not supported with the torchserve kind", 400);
+  }
+
+  Error ModelInferenceStatistics(std::map<std::string, ModelStatistics>*,
+                                 const std::string&) override {
+    return Error("server-side statistics are not available from TorchServe",
+                 400);
+  }
+
+  Error ClientInferStat(tpuclient::InferStat* stat) override {
+    return client_->ClientInferStat(stat);
+  }
+
+  bool SupportsAsync() const override { return false; }
+
+ private:
+  std::unique_ptr<tpuclient::InferenceServerHttpClient> client_;
+  std::map<std::string, std::string> file_cache_;
+};
+
+}  // namespace
+
+Error CreateTorchServeBackend(const std::string& url, bool verbose,
+                              std::unique_ptr<ClientBackend>* backend) {
+  return TorchServeClientBackend::Create(url, verbose, backend);
+}
+
+}  // namespace tpuperf

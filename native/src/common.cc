@@ -1,5 +1,8 @@
 #include "tpuclient/common.h"
 
+#include <algorithm>
+#include <cstdlib>
+
 namespace tpuclient {
 
 size_t DtypeByteSize(const std::string& datatype) {
@@ -18,6 +21,38 @@ size_t DtypeByteSize(const std::string& datatype) {
 // ---------------------------------------------------------------------------
 // InferInput
 // ---------------------------------------------------------------------------
+
+std::string SplitUrl(const std::string& url, int default_port,
+                     std::string* host, int* port) {
+  std::string scheme;
+  std::string hostport = url;
+  auto sep = hostport.find("://");
+  if (sep != std::string::npos) {
+    scheme = hostport.substr(0, sep);
+    hostport = hostport.substr(sep + 3);
+  }
+  *host = hostport;
+  *port = default_port;
+  if (!hostport.empty() && hostport[0] == '[') {
+    // Bracketed IPv6 literal — strip brackets for getaddrinfo/TLS checks.
+    auto rb = hostport.find(']');
+    if (rb != std::string::npos) {
+      *host = hostport.substr(1, rb - 1);
+      if (rb + 1 < hostport.size() && hostport[rb + 1] == ':') {
+        *port = atoi(hostport.c_str() + rb + 2);
+      }
+    }
+  } else if (std::count(hostport.begin(), hostport.end(), ':') > 1) {
+    *host = hostport;  // bare IPv6 literal, no port suffix
+  } else {
+    auto colon = hostport.rfind(':');
+    if (colon != std::string::npos) {
+      *host = hostport.substr(0, colon);
+      *port = atoi(hostport.c_str() + colon + 1);
+    }
+  }
+  return scheme;
+}
 
 Error InferInput::Create(InferInput** input, const std::string& name,
                          const std::vector<int64_t>& dims,

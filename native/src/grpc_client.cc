@@ -124,12 +124,15 @@ Error ExtractUnaryResult(const h2::Connection::Stream& s,
 }
 
 h2::HeaderList CallHeaders(const std::string& authority,
-                           const std::string& method, uint64_t timeout_us,
-                           const GrpcHeaders& extra) {
+                           const std::string& method_path,
+                           uint64_t timeout_us, const GrpcHeaders& extra,
+                           bool secure = false) {
   h2::HeaderList h = {
       {":method", "POST"},
-      {":scheme", "http"},
-      {":path", std::string(kServicePrefix) + method},
+      // gRPC-over-HTTP/2 mapping: :scheme reflects the transport; strict
+      // intermediaries (Envoy, grpc-go) validate it.
+      {":scheme", secure ? "https" : "http"},
+      {":path", method_path},
       {":authority", authority},
       {"te", "trailers"},
       {"content-type", "application/grpc"},
@@ -313,35 +316,10 @@ Error InferenceServerGrpcClient::Connect(
     const std::string& url, bool use_cached_channel, bool use_ssl,
     const SslOptions& ssl_options,
     const KeepAliveOptions& keepalive_options) {
-  std::string hostport = url;
-  auto scheme = hostport.find("://");
-  if (scheme != std::string::npos) {
-    std::string proto = hostport.substr(0, scheme);
-    if (proto == "https" || proto == "grpcs") use_ssl = true;
-    hostport = hostport.substr(scheme + 3);
-  }
-  std::string host = hostport;
+  std::string host;
   int port = 8001;
-  if (!hostport.empty() && hostport[0] == '[') {
-    // Bracketed IPv6 literal: "[::1]:8001" — split after the bracket and
-    // strip it so getaddrinfo sees the bare address.
-    auto rb = hostport.find(']');
-    if (rb != std::string::npos) {
-      host = hostport.substr(1, rb - 1);
-      if (rb + 1 < hostport.size() && hostport[rb + 1] == ':') {
-        port = atoi(hostport.c_str() + rb + 2);
-      }
-    }
-  } else if (std::count(hostport.begin(), hostport.end(), ':') > 1) {
-    // Bare IPv6 literal ("::1") — no port suffix to split off.
-    host = hostport;
-  } else {
-    auto colon = hostport.rfind(':');
-    if (colon != std::string::npos) {
-      host = hostport.substr(0, colon);
-      port = atoi(hostport.c_str() + colon + 1);
-    }
-  }
+  std::string proto = SplitUrl(url, 8001, &host, &port);
+  if (proto == "https" || proto == "grpcs") use_ssl = true;
   authority_ = host.find(':') != std::string::npos
                    ? "[" + host + "]:" + std::to_string(port)
                    : host + ":" + std::to_string(port);
@@ -401,49 +379,60 @@ Error InferenceServerGrpcClient::Connect(
   return Error::Success();
 }
 
-Error InferenceServerGrpcClient::Rpc(const std::string& method,
-                                     const google::protobuf::Message& request,
-                                     google::protobuf::Message* response,
-                                     uint64_t timeout_us,
-                                     const GrpcHeaders& headers) {
+Error GrpcUnaryCall(h2::Connection* conn, const std::string& authority,
+                    const std::string& method_path,
+                    const google::protobuf::Message& request,
+                    google::protobuf::Message* response, uint64_t timeout_us,
+                    const GrpcHeaders& headers) {
   std::string payload;
   if (!request.SerializeToString(&payload)) {
-    return Error("failed to serialize " + method + " request");
+    return Error("failed to serialize " + method_path + " request");
   }
   std::string body;
   FrameMessage(payload, &body);
 
   uint64_t deadline = DeadlineNs(timeout_us);
   int32_t sid = 0;
-  Error err = conn_->StartStream(
-      CallHeaders(authority_, method, timeout_us, headers), false, &sid);
+  Error err = conn->StartStream(
+      CallHeaders(authority, method_path, timeout_us, headers, conn->Tls()),
+      false, &sid);
   if (!err.IsOk()) return err;
-  err = conn_->SendData(sid, reinterpret_cast<const uint8_t*>(body.data()),
-                        body.size(), true, deadline);
+  err = conn->SendData(sid, reinterpret_cast<const uint8_t*>(body.data()),
+                       body.size(), true, deadline);
   if (!err.IsOk()) {
-    conn_->CloseStream(sid);
+    conn->CloseStream(sid);
     return err;
   }
   // Unary: wait for the peer half-close (SIZE_MAX min_bytes can never be
   // satisfied by data alone, so this unblocks on end_stream/reset/deadline).
-  if (!conn_->WaitStream(sid, SIZE_MAX, deadline)) {
-    conn_->CloseStream(sid);
+  if (!conn->WaitStream(sid, SIZE_MAX, deadline)) {
+    conn->CloseStream(sid);
     return Error("Deadline Exceeded", 499);
   }
   std::string msg;
   Error status("stream vanished");
   // ConnectionError() locks the connection state mutex, which WithStream's
   // callback already holds — read it before entering the callback.
-  std::string conn_error = conn_->ConnectionError();
-  conn_->WithStream(sid, [&](h2::Connection::Stream& s) {
+  std::string conn_error = conn->ConnectionError();
+  conn->WithStream(sid, [&](h2::Connection::Stream& s) {
     status = ExtractUnaryResult(s, conn_error, &msg);
   });
-  conn_->CloseStream(sid);
+  conn->CloseStream(sid);
   if (!status.IsOk()) return status;
   if (!response->ParseFromString(msg)) {
-    return Error("failed to parse " + method + " response");
+    return Error("failed to parse " + method_path + " response");
   }
   return Error::Success();
+}
+
+Error InferenceServerGrpcClient::Rpc(const std::string& method,
+                                     const google::protobuf::Message& request,
+                                     google::protobuf::Message* response,
+                                     uint64_t timeout_us,
+                                     const GrpcHeaders& headers) {
+  return GrpcUnaryCall(conn_.get(), authority_,
+                       std::string(kServicePrefix) + method, request,
+                       response, timeout_us, headers);
 }
 
 // -- control plane -----------------------------------------------------------
@@ -671,8 +660,8 @@ Error InferenceServerGrpcClient::Infer(
   int32_t sid = 0;
   timers.Capture(RequestTimers::Kind::SEND_START);
   Error err = conn_->StartStream(
-      CallHeaders(authority_, "ModelInfer", options.client_timeout_us,
-                  headers),
+      CallHeaders(authority_, std::string(kServicePrefix) + "ModelInfer",
+                  options.client_timeout_us, headers, conn_->Tls()),
       false, &sid);
   if (!err.IsOk()) return err;
   err = conn_->SendData(sid, reinterpret_cast<const uint8_t*>(body.data()),
@@ -739,8 +728,8 @@ Error InferenceServerGrpcClient::AsyncInfer(
   uint64_t deadline = DeadlineNs(options.client_timeout_us);
   job->timers.Capture(RequestTimers::Kind::SEND_START);
   Error err = conn_->StartStream(
-      CallHeaders(authority_, "ModelInfer", options.client_timeout_us,
-                  headers),
+      CallHeaders(authority_, std::string(kServicePrefix) + "ModelInfer",
+                  options.client_timeout_us, headers, conn_->Tls()),
       false, &job->sid);
   if (!err.IsOk()) return err;
   // Completion signal: the h2 reader calls on_event with its stream lock
@@ -844,7 +833,9 @@ Error InferenceServerGrpcClient::StartStream(OnCompleteFn callback,
   if (stream_active_) return Error("stream already active");
   int32_t sid = 0;
   Error err = conn_->StartStream(
-      CallHeaders(authority_, "ModelStreamInfer", 0, headers), false, &sid);
+      CallHeaders(authority_, std::string(kServicePrefix) + "ModelStreamInfer",
+                  0, headers, conn_->Tls()),
+      false, &sid);
   if (!err.IsOk()) return err;
   stream_sid_ = sid;
   stream_callback_ = std::move(callback);

@@ -137,6 +137,8 @@ class Connection {
   void CloseStream(int32_t sid);
 
   bool Alive();
+  // Whether this connection is TLS (stable after Connect returns).
+  bool Tls() const { return tls_ != nullptr; }
   const std::string& ConnectionError();  // non-empty once dead
 
  private:

@@ -662,34 +662,11 @@ Error InferenceServerHttpClient::Create(
     std::unique_ptr<InferenceServerHttpClient>* client,
     const std::string& server_url, bool verbose,
     const HttpSslOptions& ssl_options) {
-  std::string url = server_url;
-  bool use_ssl = false;
-  size_t scheme = url.find("://");
-  if (scheme != std::string::npos) {
-    use_ssl = url.compare(0, scheme, "https") == 0;
-    url = url.substr(scheme + 3);
-  }
-  int port = use_ssl ? 443 : 8000;
-  std::string host = url;
-  if (!url.empty() && url[0] == '[') {
-    // Bracketed IPv6 literal: "[::1]:8000" — strip the brackets so
-    // getaddrinfo and TLS hostname verification see the bare address.
-    auto rb = url.find(']');
-    if (rb != std::string::npos) {
-      host = url.substr(1, rb - 1);
-      if (rb + 1 < url.size() && url[rb + 1] == ':') {
-        port = atoi(url.c_str() + rb + 2);
-      }
-    }
-  } else if (std::count(url.begin(), url.end(), ':') > 1) {
-    host = url;  // bare IPv6 literal, no port suffix
-  } else {
-    size_t colon = url.rfind(':');
-    if (colon != std::string::npos) {
-      host = url.substr(0, colon);
-      port = atoi(url.c_str() + colon + 1);
-    }
-  }
+  std::string host;
+  int port;
+  std::string scheme = SplitUrl(server_url, /*default_port=*/-1, &host, &port);
+  bool use_ssl = scheme == "https";
+  if (port < 0) port = use_ssl ? 443 : 8000;
   TlsOptions tls;
   tls.use_ssl = use_ssl;
   tls.verify_peer = ssl_options.verify_peer;

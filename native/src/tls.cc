@@ -37,7 +37,6 @@ struct OpenSsl {
   int (*SSL_connect)(void*);
   int (*SSL_read)(void*, void*, int);
   int (*SSL_write)(void*, const void*, int);
-  int (*SSL_pending)(const void*);
   int (*SSL_shutdown)(void*);
   int (*SSL_get_error)(const void*, int);
   // libcrypto
@@ -92,7 +91,6 @@ const OpenSsl& Lib() {
     TPU_BIND(ssl, SSL_connect);
     TPU_BIND(ssl, SSL_read);
     TPU_BIND(ssl, SSL_write);
-    TPU_BIND(ssl, SSL_pending);
     TPU_BIND(ssl, SSL_shutdown);
     TPU_BIND(ssl, SSL_get_error);
     TPU_BIND(crypto, ERR_get_error);
@@ -250,10 +248,5 @@ ssize_t TlsSession::Write(const void* buf, size_t n, Error* err) {
   return -1;
 }
 
-size_t TlsSession::Pending() {
-  const OpenSsl& lib = Lib();
-  int n = lib.SSL_pending(ssl_);
-  return n > 0 ? static_cast<size_t>(n) : 0;
-}
 
 }  // namespace tpuclient

@@ -33,7 +33,9 @@ GRPC_EXAMPLES = [
     "simple_grpc_async_infer_client",
     "simple_grpc_string_infer_client",
     "simple_grpc_shm_client",
+    "simple_grpc_tpushm_client",
     "simple_grpc_sequence_stream_client",
+    "simple_grpc_custom_repeat_client",
     "simple_grpc_health_metadata",
 ]
 
@@ -70,8 +72,19 @@ def test_unit_tests(native_build):
 @pytest.fixture(scope="module")
 def grpc_server():
     eng = TpuEngine(build_repository(
-        ["simple", "simple_string", "simple_sequence", "resnet50"]))
+        ["simple", "simple_string", "simple_sequence", "simple_repeat",
+         "resnet50"]))
     srv = GrpcInferenceServer(eng, port=0).start()
+    yield srv
+    srv.stop()
+    eng.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ensemble_server():
+    eng = TpuEngine(build_repository(
+        ["image_preprocess", "resnet50", "ensemble_image"]))
+    srv = HttpInferenceServer(eng, port=0).start()
     yield srv
     srv.stop()
     eng.shutdown()
@@ -92,6 +105,16 @@ def test_grpc_example_conformance(native_build, grpc_server, example):
     url = f"127.0.0.1:{grpc_server.port}"
     proc = subprocess.run([binary, "-u", url], capture_output=True,
                           text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
+
+
+def test_ensemble_image_client(native_build, ensemble_server):
+    """C++ ensemble client: raw image -> preprocess -> resnet50 in one
+    request (reference ensemble_image_client.cc:365)."""
+    binary = os.path.join(native_build, "ensemble_image_client")
+    proc = subprocess.run([binary, "-u", ensemble_server.url],
+                          capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "PASS" in proc.stdout
 
@@ -372,3 +395,157 @@ def test_grpc_keepalive(native_build, grpc_server):
                           capture_output=True, text=True, timeout=180)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "PASS" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# TENSORFLOW_SERVING + TORCHSERVE backend kinds (reference
+# client_backend.h:101-106, tfserve_grpc_client.{h,cc},
+# torchserve_http_client.{h,cc}) against hermetic fake servers.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tfs_pb2(tmp_path_factory):
+    """Python message classes generated from the same re-authored TFS protos
+    the C++ backend compiles — the test proves both sides share one wire."""
+    import sys
+
+    d = tmp_path_factory.mktemp("tfs_pb")
+    proto_dir = os.path.join(NATIVE, "..", "client_tpu", "protocol", "protos")
+    subprocess.run(
+        ["protoc", f"--python_out={d}", "-I", proto_dir,
+         os.path.join(proto_dir, "tfs_predict.proto")],
+        check=True, capture_output=True)
+    sys.path.insert(0, str(d))
+    try:
+        import tfs_predict_pb2
+    finally:
+        sys.path.remove(str(d))
+    return tfs_predict_pb2
+
+
+@pytest.fixture(scope="module")
+def fake_tfs_server(tfs_pb2):
+    """Minimal TFS PredictionService: y = 2x, serving_default signature."""
+    from concurrent import futures as cf
+
+    import grpc
+    import numpy as np
+
+    pb = tfs_pb2
+
+    def predict(req, ctx):
+        resp = pb.PredictResponse()
+        resp.model_spec.name = req.model_spec.name
+        x = np.frombuffer(req.inputs["x"].tensor_content, np.float32)
+        out = resp.outputs["y"]
+        out.dtype = pb.DT_FLOAT
+        out.tensor_shape.dim.add().size = len(x)
+        out.tensor_content = (2 * x).astype(np.float32).tobytes()
+        return resp
+
+    def metadata(req, ctx):
+        resp = pb.GetModelMetadataResponse()
+        resp.model_spec.name = req.model_spec.name
+        sigmap = pb.SignatureDefMap()
+        sig = sigmap.signature_def["serving_default"]
+        ti = sig.inputs["x"]
+        ti.name, ti.dtype = "x", pb.DT_FLOAT
+        ti.tensor_shape.dim.add().size = 4
+        to = sig.outputs["y"]
+        to.name, to.dtype = "y", pb.DT_FLOAT
+        to.tensor_shape.dim.add().size = 4
+        resp.metadata["signature_def"].Pack(sigmap)
+        return resp
+
+    handler = grpc.method_handlers_generic_handler(
+        "tensorflow.serving.PredictionService", {
+            "Predict": grpc.unary_unary_rpc_method_handler(
+                predict,
+                request_deserializer=pb.PredictRequest.FromString,
+                response_serializer=pb.PredictResponse.SerializeToString),
+            "GetModelMetadata": grpc.unary_unary_rpc_method_handler(
+                metadata,
+                request_deserializer=pb.GetModelMetadataRequest.FromString,
+                response_serializer=(
+                    pb.GetModelMetadataResponse.SerializeToString)),
+        })
+    server = grpc.server(cf.ThreadPoolExecutor(max_workers=8),
+                         handlers=(handler,))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    yield port
+    server.stop(1)
+
+
+def test_perf_analyzer_tfserving(native_build, fake_tfs_server, tmp_path):
+    """Harness drives the TFS kind end to end: metadata via signature_def,
+    Predict with tensor_content I/O, a short stable sweep."""
+    csv = tmp_path / "tfs.csv"
+    proc = subprocess.run(
+        [os.path.join(native_build, "tpu_perf_analyzer"),
+         "-m", "toy", "--service-kind", "tfserving",
+         "-u", f"127.0.0.1:{fake_tfs_server}",
+         "-p", "300", "-r", "4", "-s", "70",
+         "--concurrency-range", "1:1", "-f", str(csv)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = csv.read_text().strip().splitlines()
+    header, row = lines[0].split(","), lines[1].split(",")
+    assert float(row[header.index("Inferences/Second")]) > 0
+
+
+@pytest.fixture(scope="module")
+def fake_torchserve_server():
+    """Minimal TorchServe inference API: POST /predictions/<model>."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):  # noqa: N802
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            if not self.path.startswith("/predictions/") or not body:
+                self.send_response(400)
+                self.end_headers()
+                return
+            resp = (b'{"prediction": [0.1, 0.9], "bytes": %d}'
+                    % len(body))
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(resp)))
+            self.end_headers()
+            self.wfile.write(resp)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield httpd.server_address[1]
+    httpd.shutdown()
+
+
+def test_perf_analyzer_torchserve(native_build, fake_torchserve_server,
+                                  tmp_path):
+    """Harness drives the TorchServe kind: BYTES input names an upload file
+    (reference --input-data flow, main.cc:1210-1216)."""
+    upload = tmp_path / "payload.bin"
+    upload.write_bytes(b"\x00\x01fake-image-bytes" * 64)
+    data = tmp_path / "input.json"
+    data.write_text(
+        '{"data": [{"TORCHSERVE_INPUT": ["%s"]}]}' % upload)
+    csv = tmp_path / "ts.csv"
+    proc = subprocess.run(
+        [os.path.join(native_build, "tpu_perf_analyzer"),
+         "-m", "toy", "--service-kind", "torchserve",
+         "-u", f"127.0.0.1:{fake_torchserve_server}",
+         "--input-data", str(data),
+         "-p", "300", "-r", "4", "-s", "70",
+         "--concurrency-range", "1:1", "-f", str(csv)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = csv.read_text().strip().splitlines()
+    header, row = lines[0].split(","), lines[1].split(",")
+    assert float(row[header.index("Inferences/Second")]) > 0
