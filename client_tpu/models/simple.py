@@ -27,29 +27,32 @@ class AddSubBackend(ModelBackend):
     """INT32[16] -> OUTPUT0=sum, OUTPUT1=diff. The canonical `simple` model."""
 
     def __init__(self, name: str = "simple", n: int = 16,
-                 max_batch_size: int = 64):
+                 max_batch_size: int = 64, datatype: str = "INT32"):
         self.config = ModelConfig(
             name=name,
             platform="jax",
             max_batch_size=max_batch_size,
             input=[
-                TensorConfig("INPUT0", "INT32", [n]),
-                TensorConfig("INPUT1", "INT32", [n]),
+                TensorConfig("INPUT0", datatype, [n]),
+                TensorConfig("INPUT1", datatype, [n]),
             ],
             output=[
-                TensorConfig("OUTPUT0", "INT32", [n]),
-                TensorConfig("OUTPUT1", "INT32", [n]),
+                TensorConfig("OUTPUT0", datatype, [n]),
+                TensorConfig("OUTPUT1", datatype, [n]),
             ],
             dynamic_batching=DynamicBatchingConfig(
-                preferred_batch_size=[8, max_batch_size],
+                preferred_batch_size=sorted(
+                    {min(8, max_batch_size), max_batch_size}),
                 max_queue_delay_microseconds=100,
             ),
             # A deep batching ceiling matters more than compute here: each
             # device round trip has fixed transport latency (tens of ms when
             # the chip sits behind a network tunnel), so throughput scales
             # with how many requests ride one dispatch.  Small bucket set
-            # keeps warmup compiles cheap.
-            batch_buckets=[1, 8, 64],
+            # (clamped to the configured ceiling) keeps warmup compiles cheap.
+            batch_buckets=sorted(
+                {b for b in (1, 8, 64) if b <= max_batch_size}
+                | {max_batch_size}),
             # Several executor instances keep multiple batches in flight so
             # device round-trips overlap (the device transport pipelines
             # concurrent dispatch+fetch; serialized batches leave it idle).
@@ -192,4 +195,9 @@ register_model("simple")(AddSubBackend)
 register_model("simple_string")(StringAddSubBackend)
 register_model("simple_identity")(IdentityBackend)
 register_model("simple_sequence")(SequenceAccumulateBackend)
+# INT8 add/sub variant (reference simple_int8 model, exercised by the
+# explicit-content raw-stub clients).
+register_model("simple_int8")(
+    lambda: AddSubBackend(name="simple_int8", max_batch_size=8,
+                          datatype="INT8"))
 register_model("simple_repeat")(RepeatBackend)

@@ -1,0 +1,41 @@
+#!/usr/bin/env python3
+"""Raw-stub client: BYTES tensors through explicit bytes_contents against
+the `simple_identity` passthrough model.
+
+Reference counterpart: grpc_explicit_byte_content_client.py
+(/root/reference/src/python/examples/).
+"""
+
+import argparse
+import sys
+
+import grpc
+import numpy as np
+
+from client_tpu.protocol import grpc_service_pb2 as pb
+from client_tpu.protocol.codec import deserialize_bytes_tensor
+from client_tpu.protocol.grpc_stub import GRPCInferenceServiceStub
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8001")
+args = parser.parse_args()
+
+channel = grpc.insecure_channel(args.url)
+stub = GRPCInferenceServiceStub(channel)
+
+values = [b"tpu", b"native", b"framework", b"bytes-content"]
+request = pb.ModelInferRequest(model_name="simple_identity",
+                               id="explicit-bytes")
+t = request.inputs.add(name="INPUT0", datatype="BYTES",
+                       shape=[1, len(values)])
+t.contents.bytes_contents.extend(values)
+request.outputs.add(name="OUTPUT0")
+
+response = stub.ModelInfer(request)
+
+raw = response.raw_output_contents[0]
+got = [bytes(x) for x in np.ravel(deserialize_bytes_tensor(raw))]
+if got != values:
+    sys.exit(f"error: {got} != {values}")
+
+print("PASS: explicit byte content")

@@ -1,0 +1,44 @@
+#!/usr/bin/env python3
+"""Raw-stub client: INT8 tensors through explicit int_contents against the
+`simple_int8` add/sub model.
+
+Reference counterpart: grpc_explicit_int8_content_client.py
+(/root/reference/src/python/examples/).
+"""
+
+import argparse
+import sys
+
+import grpc
+import numpy as np
+
+from client_tpu.protocol import grpc_service_pb2 as pb
+from client_tpu.protocol.grpc_stub import GRPCInferenceServiceStub
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8001")
+args = parser.parse_args()
+
+channel = grpc.insecure_channel(args.url)
+stub = GRPCInferenceServiceStub(channel)
+
+request = pb.ModelInferRequest(model_name="simple_int8", id="explicit-int8")
+in0 = np.arange(16, dtype=np.int8)          # small values: no overflow
+in1 = np.full(16, 3, dtype=np.int8)
+for name, arr in (("INPUT0", in0), ("INPUT1", in1)):
+    t = request.inputs.add(name=name, datatype="INT8", shape=[1, 16])
+    t.contents.int_contents.extend(int(x) for x in arr)
+request.outputs.add(name="OUTPUT0")
+request.outputs.add(name="OUTPUT1")
+
+response = stub.ModelInfer(request)
+
+outputs = {}
+for tensor, raw in zip(response.outputs, response.raw_output_contents):
+    outputs[tensor.name] = np.frombuffer(raw, np.int8)
+if not np.array_equal(outputs["OUTPUT0"], in0 + in1):
+    sys.exit(f"error: bad sum {outputs['OUTPUT0']}")
+if not np.array_equal(outputs["OUTPUT1"], in0 - in1):
+    sys.exit(f"error: bad difference {outputs['OUTPUT1']}")
+
+print("PASS: explicit int8 content")

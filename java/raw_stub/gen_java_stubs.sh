@@ -1,0 +1,17 @@
+#!/bin/bash
+# Generates Java gRPC stubs for the v2 inference service from the proto
+# shared with the Python/C++/Go stacks (reference: the grpc_generated/java
+# library pom protoc-compiles protos dropped into library/src/main/proto,
+# /root/reference/src/grpc_generated/java/README.md:149).
+#
+# Requires protoc with the protoc-gen-grpc-java plugin (not in this build
+# image — see README.md for the toolchain caveat).
+set -euo pipefail
+cd "$(dirname "$0")"
+mkdir -p inference
+protoc \
+  -I ../../client_tpu/protocol/protos \
+  --java_out=inference \
+  --grpc-java_out=inference \
+  grpc_service.proto
+echo "stubs written to java/raw_stub/inference/"

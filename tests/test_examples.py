@@ -24,7 +24,8 @@ REPO_ROOT = os.path.join(os.path.dirname(__file__), "..")
 def servers():
     eng = TpuEngine(build_repository([
         "simple", "simple_string", "simple_identity", "simple_sequence",
-        "simple_repeat", "resnet50", "image_preprocess", "ensemble_image",
+        "simple_int8", "simple_repeat", "resnet50", "image_preprocess",
+        "ensemble_image",
         "ssd_mobilenet_v2_coco_quantized",
     ]))
     http_srv = HttpInferenceServer(eng, port=0).start()
@@ -58,6 +59,9 @@ def run_example(script, servers, extra=None):
     "simple_http_shm_client.py",
     "simple_grpc_shm_client.py",
     "simple_grpc_tpushm_client.py",
+    "grpc_explicit_int_content_client.py",
+    "grpc_explicit_int8_content_client.py",
+    "grpc_explicit_byte_content_client.py",
     "simple_http_sequence_sync_client.py",
     "simple_grpc_sequence_stream_client.py",
     "simple_grpc_custom_repeat_client.py",
