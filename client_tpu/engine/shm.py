@@ -19,11 +19,13 @@ not export cross-process HBM handles), so a TPU region is:
   name directly to a device-resident ``jax.Array`` — true zero-copy: the
   engine executes straight from HBM and leaves outputs there;
 - *cross-process*: the opaque ``raw_handle`` describes a host-shm staging
-  buffer (key + byte_size); the server mmaps it and keeps a persistent
-  device buffer per region, so per-inference cost is one host↔HBM DMA and
-  zero network bytes — the best available contract without PjRt
-  cross-process buffer export, and the direct analog of the reference's
-  cudaMemcpy-based ``set``/``get`` (cuda_shared_memory.cc:63-123).
+  buffer (key + byte_size); the server mmaps it and serves tensor reads as
+  zero-copy host views, so the dynamic batcher assembles whole batches on
+  host and pays ONE host→HBM DMA per batch (inside the engine's
+  device_put) with zero network bytes — the best available contract
+  without PjRt cross-process buffer export, and the analog of the
+  reference's cudaMemcpy-based ``set``/``get``
+  (cuda_shared_memory.cc:63-123).
 
 Handles serialize as JSON (transported as raw bytes over gRPC, base64 over
 HTTP, exactly like the reference's cudaIpcMemHandle_t).
@@ -330,9 +332,15 @@ class TpuShmManager:
     # -- data plane ----------------------------------------------------------
 
     def read_tensor(self, name, offset, byte_size, datatype, shape):
-        """Returns a device array (zero-copy for 'device' regions; one
-        host→HBM DMA for staged regions). The engine passes jax arrays
-        through device_put untouched."""
+        """'device' regions return their HBM-resident array (true zero-copy).
+
+        Host-staged regions return a zero-copy *host* view: the dynamic
+        batcher concatenates request tensors on host and issues ONE
+        device_put per assembled batch (Model.execute_timed), so staging
+        each request's inputs to HBM here would both serialize a device
+        round trip per request ahead of the queue and force the batcher to
+        fetch the arrays straight back — measured 19 ips vs 358 ips at
+        concurrency 32 on a v5e chip behind the dev tunnel."""
         region = self._get(name)
         shape = tuple(int(d) for d in shape)
         if region.kind == "device":
@@ -344,12 +352,10 @@ class TpuShmManager:
             if tuple(arr.shape) != shape:
                 arr = arr.reshape(shape)
             return arr
-        host = region.staging.read_ndarray(offset, byte_size, datatype, shape)
-        if datatype == DataType.BYTES:
-            return host
-        import jax
-
-        return jax.device_put(host, self._device(region.device_id))
+        # Validate the registered device ordinal even though staging reads
+        # stay host-side (placement happens per batch in the engine).
+        self._device(region.device_id)
+        return region.staging.read_ndarray(offset, byte_size, datatype, shape)
 
     def write_tensor(self, name, offset, byte_size, arr) -> int:
         region = self._get(name)
