@@ -54,7 +54,14 @@ def preflight():
     return devices
 
 
-def bench_inproc_simple(duration_s: float = 5.0, concurrency: int = 256):
+# Headline bench configuration — the history tag in main() derives from
+# these, so changing them can never masquerade as a perf delta.
+BENCH_MAX_BATCH = 256
+BENCH_CONCURRENCY = 256
+
+
+def bench_inproc_simple(duration_s: float = 5.0,
+                        concurrency: int = BENCH_CONCURRENCY):
     import numpy as np
 
     from client_tpu.engine import InferRequest, TpuEngine
@@ -68,7 +75,7 @@ def bench_inproc_simple(duration_s: float = 5.0, concurrency: int = 256):
     # with matching client concurrency measured 1476 ips vs 356 at the zoo
     # default 64/32 on the v5e chip (the zoo default stays conservative for
     # interactive latency).
-    backend = AddSubBackend(max_batch_size=256)
+    backend = AddSubBackend(max_batch_size=BENCH_MAX_BATCH)
     repo = ModelRepository()
     repo.register_backend(backend)
     engine = TpuEngine(repo, warmup=True)
@@ -308,7 +315,7 @@ def main():
     # Same-config comparisons only: entries tagged with a different (or
     # absent) bench config measured a different thing — a concurrency or
     # batch-ceiling change must not masquerade as a perf delta.
-    config = "mb256-c256"
+    config = f"mb{BENCH_MAX_BATCH}-c{BENCH_CONCURRENCY}"
     best = max((h["value"] for h in hist
                 if isinstance(h, dict)
                 and h.get("metric") == "inproc_simple_ips"

@@ -43,7 +43,11 @@ def bert_param_specs(P, n_layers: int):
         return {"scale": P(), "bias": P()}
 
     layer = {
-        "wq": dense_col(), "wk": dense_col(), "wv": dense_col(),
+        # Fused QKV is column-parallel over its 3h output; bert.py's
+        # head-major (b, s, heads, 3, hd) reshape means each tp shard holds
+        # complete q/k/v triples for its heads, so the per-head activation
+        # constraint matches the matmul's output sharding (no reshard).
+        "wqkv": dense_col(),
         "wo": dense_row(),
         "ln1": ln(),
         "w1": dense_col(), "w2": dense_row(),
@@ -95,11 +99,27 @@ class ShardedBertBackend(BertBackend):
 
     def place_params(self, params):
         import jax
+        import numpy as np
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
         specs = bert_param_specs(P, self.n_layers)
         mesh = self.mesh
+
+        # Canonical wqkv storage is qkv-major ([q | k | v] column blocks,
+        # the fast single-device layout); the sharded apply reads the fused
+        # output head-major so tp column splits land whole heads per shard.
+        # Permuting the columns here keeps both modes the *same function* of
+        # one canonical checkpoint — layout is purely a placement detail.
+        h, hd = self.hidden, self.hidden // self.n_heads
+        perm = np.empty(3 * h, dtype=np.int64)
+        for i in range(3 * h):
+            head, rem = divmod(i, 3 * hd)
+            which, d = divmod(rem, hd)
+            perm[i] = which * h + head * hd + d
+        for lp in params["layers"]:
+            lp["wqkv"]["w"] = np.asarray(lp["wqkv"]["w"])[:, perm]
+            lp["wqkv"]["b"] = np.asarray(lp["wqkv"]["b"])[perm]
 
         def place(x, s):
             # Drop tp from specs when the mesh doesn't carry it (dp-only).
