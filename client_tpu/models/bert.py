@@ -37,7 +37,12 @@ class BertBackend(ModelBackend):
     def __init__(self, name: str = "bert_base", seq_len: int = 128,
                  hidden: int = 768, n_layers: int = 12, n_heads: int = 12,
                  ffn: int = 3072, num_labels: int = 2,
-                 vocab: int = VOCAB_SIZE, max_batch_size: int = 16):
+                 vocab: int = VOCAB_SIZE, max_batch_size: int = 16,
+                 attention_impl: str = "einsum"):
+        # "einsum": XLA-scheduled O(S^2) scores — right up to ~512 tokens.
+        # "flash": the Pallas kernel (client_tpu.ops.flash_attention) —
+        # O(block) score memory, the long-context single-chip path.
+        self.attention_impl = attention_impl
         self.seq_len = seq_len
         self.hidden = hidden
         self.n_layers = n_layers
@@ -118,10 +123,49 @@ class BertBackend(ModelBackend):
 
         return jax.device_put(params)
 
+    def make_attend(self, head_dim):
+        """Attention primitive: [B,S,H,D] q/k/v + [B,S] additive key bias
+        → [B,S,H,D]. Overridden by the parallel serving backends (ring
+        attention over a sequence-sharded mesh)."""
+        attention_impl = self.attention_impl
+
+        def attend(q, k, v, bias2d):
+            import jax
+            import jax.numpy as jnp
+
+            if attention_impl == "flash":
+                from client_tpu.ops.flash_attention import flash_attention
+
+                # Bigger tiles amortize the per-grid-step overhead at long
+                # sequence (512/1024 measured fastest at s=2048 on v5e);
+                # clamp to divisors of the actual sequence length so any
+                # seq_len works. interpret=True off-TPU keeps the hermetic
+                # CPU suite on the same kernel code path the chip compiles.
+                def pick_block(s_len, cap):
+                    best = 1
+                    for cand in range(1, min(cap, s_len) + 1):
+                        if s_len % cand == 0:
+                            best = cand
+                    return best
+
+                s_len = q.shape[1]
+                return flash_attention(
+                    q, k, v, bias2d,
+                    block_q=pick_block(s_len, 512),
+                    block_k=pick_block(s_len, 1024),
+                    interpret=jax.default_backend() != "tpu")
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+            scores = (scores / np.sqrt(head_dim)
+                      + bias2d[:, None, None, :].astype(jnp.float32))
+            probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
+            return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+        return attend
+
     def make_apply_params(self):
         return self._build_apply(), self.place_params(self._init_params())
 
-    def _build_apply(self, constrain=None):
+    def _build_apply(self, constrain=None, head_major=False):
         """Build the pure ``apply(params, inputs)`` over a params pytree.
 
         Params are a jit *argument* (engine passes the placed tree each call),
@@ -133,16 +177,17 @@ class BertBackend(ModelBackend):
         n_heads = self.n_heads
         head_dim = self.hidden // n_heads
         # Fused-QKV output layout, chosen by execution mode:
-        # - single device: qkv-major (b, s, 3, heads, hd) — leading-axis
+        # - default: qkv-major (b, s, 3, heads, hd) — leading-axis
         #   slices are contiguous, measured 1.24 ms vs 1.51 ms per b8 step
         #   on v5e for the head-major variant;
-        # - sharded (constrain active): head-major (b, s, heads, 3, hd) so a
-        #   tensor-parallel column split of wqkv lands whole heads per shard
+        # - head_major (tensor-parallel backends): (b, s, heads, 3, hd) so a
+        #   tp column split of wqkv lands whole heads per shard
         #   and the heads-axis constraint matches the matmul's natural
         #   output sharding (no per-layer reshard collective).
         # Weights are random here; a pretrained-checkpoint loader must
-        # interleave wq/wk/wv to match the layout in use.
-        head_major = constrain is not None
+        # interleave wq/wk/wv to match the layout in use. head_major is
+        # requested only by tp-sharding backends, which permute the
+        # canonical weights at placement (ShardedBertBackend.place_params).
         if constrain is None:
             def constrain(x, spec):  # noqa: ARG001 — single-device no-op
                 return x
@@ -160,10 +205,9 @@ class BertBackend(ModelBackend):
         def proj(x, p):
             return x @ p["w"] + p["b"]
 
-        def attention(x, mask_bias, lp):
-            import jax
-            import jax.numpy as jnp
+        attend = self.make_attend(head_dim)
 
+        def attention(x, bias2d, lp):
             b, s, h = x.shape
             if head_major:
                 qkv = proj(x, lp["wqkv"]).reshape(b, s, n_heads, 3, head_dim)
@@ -174,11 +218,7 @@ class BertBackend(ModelBackend):
             else:
                 qkv = proj(x, lp["wqkv"]).reshape(b, s, 3, n_heads, head_dim)
                 q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            # [B, heads, S, S] scores, fp32 softmax accumulation
-            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-            scores = scores / np.sqrt(head_dim) + mask_bias
-            probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
-            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, h)
+            ctx = attend(q, k, v, bias2d).reshape(b, s, h)
             return proj(ctx, lp["wo"])
 
         def apply(params, inputs):
@@ -188,13 +228,13 @@ class BertBackend(ModelBackend):
             ids = inputs["input_ids"]
             mask = inputs["attention_mask"].astype(jnp.float32)
             # additive attention bias: 0 where attended, -1e9 where masked
-            mask_bias = (mask[:, None, None, :] - 1.0) * 1e9
+            bias2d = (mask - 1.0) * 1e9
 
             x = params["tok_embed"][ids] + params["pos_embed"][None, :, :]
             x = layer_norm(x, params["embed_ln"])
             x = constrain(x, ("dp", None, None))
             for lp in params["layers"]:
-                x = layer_norm(x + attention(x, mask_bias, lp), lp["ln1"])
+                x = layer_norm(x + attention(x, bias2d, lp), lp["ln1"])
                 x = constrain(x, ("dp", None, None))
                 y = jax.nn.gelu(proj(x, lp["w1"]))
                 y = constrain(y, ("dp", None, "tp"))
@@ -214,3 +254,9 @@ class BertBackend(ModelBackend):
 
 
 register_model("bert_base")(BertBackend)
+# Long-context single-chip variant: seq 2048 through the Pallas flash
+# attention kernel — the O(S^2) score tensor never exists. Opt-in (a
+# default load-all server shouldn't pay a second BERT load).
+register_model("bert_long", default=False)(
+    lambda: BertBackend(name="bert_long", seq_len=2048, max_batch_size=4,
+                        attention_impl="flash"))

@@ -1,0 +1,9 @@
+"""TPU kernels for the hot ops (Pallas) and their reference fallbacks.
+
+The reference stack has no counterpart — its compute lives behind the
+dlopen'd server. Here the serving engine owns the compute path, so the ops
+layer is where hand-written TPU kernels live: memory-bound or
+fusion-resistant pieces XLA doesn't schedule optimally on its own.
+"""
+
+from client_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -25,6 +25,17 @@ import numpy as np
 from client_tpu.models.bert import BertBackend
 
 
+def dp_batch_buckets(dp: int, max_batch_size: int) -> tuple[int, list[int]]:
+    """(rounded max batch, bucket series): every bucket a dp multiple so
+    dynamic batches scatter evenly over the mesh, doubling up to the top."""
+    top = ((max_batch_size + dp - 1) // dp) * dp
+    buckets, b = [top], dp
+    while b < top:
+        buckets.append(b)
+        b *= 2
+    return top, sorted(set(buckets))
+
+
 def bert_param_specs(P, n_layers: int):
     """PartitionSpec tree matching BertBackend._init_params.
 
@@ -83,14 +94,10 @@ class ShardedBertBackend(BertBackend):
         super().__init__(name=name, max_batch_size=max_batch_size, **kw)
         # Every bucket (including the top one) must be a dp multiple or the
         # batch device_put can't scatter evenly over the mesh.
-        dp = int(mesh.shape["dp"])
-        top = ((max_batch_size + dp - 1) // dp) * dp
-        buckets, b = [top], dp
-        while b < top:
-            buckets.append(b)
-            b *= 2
+        top, buckets = dp_batch_buckets(int(mesh.shape["dp"]),
+                                        max_batch_size)
         self.config.max_batch_size = top
-        self.config.batch_buckets = sorted(set(buckets))
+        self.config.batch_buckets = buckets
         # Computed once: Model.execute_timed reads this per batch on the
         # latency path.
         batch_spec = NamedSharding(mesh, P("dp", None))
@@ -143,7 +150,7 @@ class ShardedBertBackend(BertBackend):
             return jax.lax.with_sharding_constraint(
                 x, NamedSharding(mesh, P(*spec)))
 
-        return (self._build_apply(constrain=constrain),
+        return (self._build_apply(constrain=constrain, head_major=True),
                 self.place_params(self._init_params()))
 
 
@@ -153,3 +160,84 @@ class ShardedBertBackend(BertBackend):
 from client_tpu.models import register_model  # noqa: E402
 
 register_model("bert_base_mc", default=False)(ShardedBertBackend)
+
+
+class LongContextBertBackend(BertBackend):
+    """Long-context BERT served sequence-parallel over a ("dp", "sp") mesh.
+
+    The sequence axis of every activation is sharded over "sp"; attention is
+    exact ring attention (client_tpu.parallel.ring_attention): K/V shards
+    rotate via ppermute on ICI while each device folds visiting blocks into
+    a flash-style online softmax — no [S, S] score tensor, no single-device
+    sequence residency. Parameters replicate (BERT-base fits one chip); for
+    larger models compose with the tp splits above.
+    """
+
+    def __init__(self, mesh=None, name: str = "bert_long_mc",
+                 seq_len: int = 2048, max_batch_size: int = 4, **kw):
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from client_tpu.parallel.mesh import make_mesh
+
+        if mesh is None:
+            mesh = make_mesh(axes=("dp", "sp"))
+        self.mesh = mesh
+        sp = int(mesh.shape["sp"])
+        if seq_len % sp:
+            raise ValueError(
+                f"seq_len {seq_len} must be a multiple of the sp mesh "
+                f"axis ({sp})")
+        super().__init__(name=name, seq_len=seq_len,
+                         max_batch_size=max_batch_size, **kw)
+        top, buckets = dp_batch_buckets(int(mesh.shape["dp"]),
+                                        max_batch_size)
+        self.config.max_batch_size = top
+        self.config.batch_buckets = buckets
+        seq_spec = NamedSharding(mesh, P("dp", "sp"))
+        self.input_shardings = {"input_ids": seq_spec,
+                                "attention_mask": seq_spec}
+
+    def place_params(self, params):
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        # Replicated across the mesh (sequence parallelism shards
+        # activations, not weights).
+        return jax.device_put(params, NamedSharding(self.mesh, P()))
+
+    def make_attend(self, head_dim):
+        from client_tpu.parallel.ring_attention import (
+            sequence_parallel_attention,
+        )
+
+        mesh = self.mesh
+
+        def attend(q, k, v, bias2d):
+            return sequence_parallel_attention(mesh, q, k, v, bias2d,
+                                               axis_name="sp")
+
+        return attend
+
+    def make_apply_params(self):
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+
+        def constrain(x, spec):
+            # Pin the sequence axis (position 1) to "sp"; ignore tp hints
+            # (this mesh doesn't carry tp — weights replicate).
+            out = ["dp" if a == "dp" else None for a in spec]
+            if len(out) >= 2:
+                out[1] = "sp"
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(*out)))
+
+        return (self._build_apply(constrain=constrain),
+                self.place_params(self._init_params()))
+
+
+register_model("bert_long_mc", default=False)(LongContextBertBackend)
