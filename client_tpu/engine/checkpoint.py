@@ -1,0 +1,46 @@
+"""Model weight checkpointing (orbax).
+
+The reference is a client stack and has no weight persistence (SURVEY.md
+§5.4: model state lives behind the dlopen'd server). Here the engine owns
+the models, so it owns their weights: a params pytree round-trips through
+orbax's StandardCheckpointer, and any zoo backend can be pointed at a
+saved checkpoint via ``weights_path`` (or the ``weights_path`` parameter of
+a directory-repository ``config.pbtxt``) instead of its random init.
+
+Restore is structure-checked: the checkpoint must match the backend's
+params tree (shapes + dtypes), so a config/weights mismatch fails at model
+load with a clear error, not at inference time with garbage.
+"""
+
+from __future__ import annotations
+
+import os
+
+from client_tpu.engine.types import EngineError
+
+
+def save_params(path: str, params) -> str:
+    """Write a params pytree to ``path`` (created; must not exist)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, params)
+    ckptr.wait_until_finished()
+    return path
+
+
+def load_params(path: str, like):
+    """Restore a params pytree matching the structure/shapes of ``like``."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise EngineError(f"weights checkpoint '{path}' not found", 400)
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        return ckptr.restore(path, like)
+    except Exception as exc:  # noqa: BLE001 — surface as a load error
+        raise EngineError(
+            f"weights checkpoint '{path}' does not match the model's "
+            f"parameter tree: {exc}", 400) from exc

@@ -58,6 +58,27 @@ class ModelBackend:
 
     config: ModelConfig
 
+    # Optional orbax checkpoint directory; when set, param-backends restore
+    # their weights from it instead of using the random init (see
+    # client_tpu.engine.checkpoint and load_or_init_params).
+    weights_path: str | None = None
+
+    def load_or_init_params(self, init_fn):
+        """``init_fn()`` builds the params tree (random init); when
+        ``weights_path`` is set, the same-structured tree is restored from
+        the checkpoint instead (structure/shape mismatches fail the model
+        load with a clear error)."""
+        if self.weights_path:
+            import jax
+
+            from client_tpu.engine.checkpoint import load_params
+
+            # Abstract target: same structure/shape/dtype check without
+            # materializing (and immediately discarding) the random init.
+            abstract = jax.eval_shape(init_fn)
+            return load_params(self.weights_path, abstract)
+        return init_fn()
+
     def make_apply_params(
         self,
     ) -> tuple[Callable[[Any, dict], dict], Any] | None:

@@ -137,6 +137,7 @@ class ModelRepository:
             if not d.get("name"):
                 d["name"] = entry  # directory name is canonical in Triton
             self._resolve_labels(d, mdir)
+            d["_model_dir"] = mdir  # for relative weights_path resolution
             self.register(d["name"], _directory_builder(d))
             names.append(d["name"])
         return names
@@ -226,6 +227,15 @@ def _directory_builder(d: dict) -> Callable[[], ModelBackend]:
                 and backend.config.max_batch_size == cfg.max_batch_size):
             cfg.batch_buckets = backend.config.batch_buckets
         backend.config = cfg
+        # parameters { key: "weights_path" value: "..." }: restore weights
+        # from an orbax checkpoint (relative paths resolve against the
+        # model directory) instead of the zoo's random init.
+        wp = cfg.parameters.get("weights_path")
+        if wp:
+            wp = str(wp)
+            if not os.path.isabs(wp):
+                wp = os.path.join(d.get("_model_dir", ""), wp)
+            backend.weights_path = wp
         return backend
 
     return build

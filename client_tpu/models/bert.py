@@ -38,11 +38,13 @@ class BertBackend(ModelBackend):
                  hidden: int = 768, n_layers: int = 12, n_heads: int = 12,
                  ffn: int = 3072, num_labels: int = 2,
                  vocab: int = VOCAB_SIZE, max_batch_size: int = 16,
-                 attention_impl: str = "einsum"):
+                 attention_impl: str = "einsum",
+                 weights_path: str | None = None):
         # "einsum": XLA-scheduled O(S^2) scores — right up to ~512 tokens.
         # "flash": the Pallas kernel (client_tpu.ops.flash_attention) —
         # O(block) score memory, the long-context single-chip path.
         self.attention_impl = attention_impl
+        self.weights_path = weights_path
         self.seq_len = seq_len
         self.hidden = hidden
         self.n_layers = n_layers
@@ -142,11 +144,14 @@ class BertBackend(ModelBackend):
                 # seq_len works. interpret=True off-TPU keeps the hermetic
                 # CPU suite on the same kernel code path the chip compiles.
                 def pick_block(s_len, cap):
-                    best = 1
-                    for cand in range(1, min(cap, s_len) + 1):
+                    # Largest divisor of s_len that is <= cap AND a legal
+                    # TPU tile height (multiple of 8); fall back to the
+                    # full sequence (always legal) when none exists.
+                    best = None
+                    for cand in range(8, min(cap, s_len) + 1, 8):
                         if s_len % cand == 0:
                             best = cand
-                    return best
+                    return best if best is not None else s_len
 
                 s_len = q.shape[1]
                 return flash_attention(
@@ -163,7 +168,8 @@ class BertBackend(ModelBackend):
         return attend
 
     def make_apply_params(self):
-        return self._build_apply(), self.place_params(self._init_params())
+        return (self._build_apply(),
+                self.place_params(self.load_or_init_params(self._init_params)))
 
     def _build_apply(self, constrain=None, head_major=False):
         """Build the pure ``apply(params, inputs)`` over a params pytree.

@@ -288,7 +288,7 @@ class SsdMobileNetV2Backend(ModelBackend):
                 "TFLite_Detection_PostProcess:3": count[:, None],
             }
 
-        return apply, jax.device_put(self._init_params())
+        return apply, jax.device_put(self.load_or_init_params(self._init_params))
 
 
 class SsdMobileNetV2TpuBackend(SsdMobileNetV2Backend):

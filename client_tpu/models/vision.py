@@ -186,7 +186,7 @@ class ResNet50Backend(ModelBackend):
             logits = pooled @ fc["w"].astype(jnp.float32) + fc["b"]
             return {"OUTPUT": logits}
 
-        return apply, jax.device_put(self._init_params())
+        return apply, jax.device_put(self.load_or_init_params(self._init_params))
 
 
 # ---------------------------------------------------------------------------
@@ -294,7 +294,7 @@ class DenseNet121Backend(ModelBackend):
             logits = pooled @ fc["w"].astype(jnp.float32) + fc["b"]
             return {"OUTPUT": logits}
 
-        return apply, jax.device_put(self._init_params())
+        return apply, jax.device_put(self.load_or_init_params(self._init_params))
 
 
 def _avg_pool2(x):

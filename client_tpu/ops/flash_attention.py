@@ -106,8 +106,8 @@ def flash_attention(q, k, v, bias=None, *, causal: bool = False,
     block_k = min(block_k, s)
     if s % block_q or s % block_k:
         raise ValueError(
-            f"sequence length {s} must divide block sizes "
-            f"({block_q}/{block_k})")
+            f"block sizes ({block_q}/{block_k}) must divide the sequence "
+            f"length {s}")
     if bias is None:
         bias = jnp.zeros((b, s), jnp.float32)
     sm_scale = 1.0 / np.sqrt(d)

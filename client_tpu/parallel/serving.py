@@ -151,7 +151,7 @@ class ShardedBertBackend(BertBackend):
                 x, NamedSharding(mesh, P(*spec)))
 
         return (self._build_apply(constrain=constrain, head_major=True),
-                self.place_params(self._init_params()))
+                self.place_params(self.load_or_init_params(self._init_params)))
 
 
 # Zoo registration: opt-in (default=False) — a default load-all server
@@ -237,7 +237,7 @@ class LongContextBertBackend(BertBackend):
                 x, NamedSharding(mesh, P(*out)))
 
         return (self._build_apply(constrain=constrain),
-                self.place_params(self._init_params()))
+                self.place_params(self.load_or_init_params(self._init_params)))
 
 
 register_model("bert_long_mc", default=False)(LongContextBertBackend)

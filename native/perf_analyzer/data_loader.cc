@@ -1,5 +1,7 @@
 #include "data_loader.h"
 
+#include "tpuclient/base64.h"
+
 #include <fstream>
 #include <random>
 #include <sstream>
@@ -106,8 +108,8 @@ Error DataLoader::GenerateData(const ModelParser& parser,
 
 // One JSON step object {input_name: value} -> wire tensors. Value forms:
 // flat array, nested array (shape inferred), {"content": [...],
-// "shape": [...]}, or {"b64": "..."} is NOT supported (reference supports
-// b64; tracked as a gap).
+// "shape": [...]}, or {"b64": "..."} (base64-encoded raw little-endian
+// bytes, the reference's binary JSON form).
 static Error ParseStep(const ModelParser& parser, const JsonPtr& step_obj,
                        const DataLoader::Options& opts,
                        std::map<std::string, std::string>* raw,
@@ -202,6 +204,30 @@ static Error ParseStep(const ModelParser& parser, const JsonPtr& step_obj,
       if (sh && sh->IsArray()) {
         for (size_t i = 0; i < sh->Size(); ++i)
           shape.push_back(sh->At(i)->AsInt());
+      }
+      // {"b64": "..."}: raw little-endian tensor bytes, base64-encoded
+      // (reference data_loader.cc binary content form).
+      JsonPtr b64 = value->Get("b64");
+      if (b64 && b64->IsString()) {
+        std::vector<uint8_t> decoded;
+        if (!tpuclient::Base64Decode(b64->AsString(), &decoded))
+          return Error("invalid b64 content for input '" + name + "'", 400);
+        if (shape.empty()) {
+          Error err = ResolveShape(tensor, opts, &shape);
+          if (!err.IsOk()) return err;
+        }
+        int64_t want = tpuclient::ElementCount(shape);
+        size_t elem = tpuclient::DtypeByteSize(tensor.datatype);
+        if (tensor.datatype != "BYTES" && want >= 0 && elem > 0 &&
+            static_cast<size_t>(want) * elem != decoded.size()) {
+          return Error("b64 data for '" + name + "' is " +
+                           std::to_string(decoded.size()) + "B, shape wants " +
+                           std::to_string(want * int64_t(elem)) + "B",
+                       400);
+        }
+        (*raw)[name] = std::string(decoded.begin(), decoded.end());
+        (*shapes)[name] = std::move(shape);
+        continue;
       }
       content = value->Get("content");
       if (!content) return Error("data object missing 'content'", 400);

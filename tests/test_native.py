@@ -549,3 +549,29 @@ def test_perf_analyzer_torchserve(native_build, fake_torchserve_server,
     lines = csv.read_text().strip().splitlines()
     header, row = lines[0].split(","), lines[1].split(",")
     assert float(row[header.index("Inferences/Second")]) > 0
+
+
+def test_perf_analyzer_b64_input_data(native_build, server, tmp_path):
+    """--input-data JSON with {"b64": ...} binary content (reference's
+    base64 raw form) drives the sweep end to end."""
+    import base64
+
+    import numpy as np
+
+    vals = np.arange(16, dtype=np.int32)
+    b64 = base64.b64encode(vals.tobytes()).decode()
+    data = tmp_path / "b64.json"
+    data.write_text(
+        '{"data": [{"INPUT0": {"b64": "%s", "shape": [16]}, '
+        '"INPUT1": {"b64": "%s", "shape": [16]}}]}' % (b64, b64))
+    csv = tmp_path / "b64.csv"
+    proc = subprocess.run(
+        [os.path.join(native_build, "tpu_perf_analyzer"),
+         "-m", "simple", "-u", server.url, "--input-data", str(data),
+         "-p", "300", "-r", "4", "-s", "70",
+         "--concurrency-range", "1:1", "-f", str(csv)],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = csv.read_text().strip().splitlines()
+    header, row = lines[0].split(","), lines[1].split(",")
+    assert float(row[header.index("Inferences/Second")]) > 0

@@ -83,6 +83,27 @@ def test_ring_attention_sp_only_mesh():
     assert float(jnp.max(jnp.abs(out - ref))) < 1e-5
 
 
+def test_bert_flash_impl_matches_einsum():
+    """BertBackend(attention_impl='flash') — the bert_long path — matches
+    the einsum implementation (interpret mode runs the same kernel)."""
+    from client_tpu.models.bert import BertBackend
+
+    kw = dict(seq_len=64, hidden=64, n_layers=2, n_heads=4, ffn=128,
+              vocab=512, max_batch_size=2)
+    outs = {}
+    for impl in ("einsum", "flash"):
+        backend = BertBackend(name=f"b_{impl}", attention_impl=impl, **kw)
+        fn, params = backend.make_apply_params()
+        rng = np.random.default_rng(5)
+        inputs = {
+            "input_ids": rng.integers(0, 512, (2, 64)).astype(np.int32),
+            "attention_mask": np.ones((2, 64), np.int32),
+        }
+        inputs["attention_mask"][:, -11:] = 0
+        outs[impl] = np.asarray(fn(params, inputs)["logits"])
+    assert np.allclose(outs["einsum"], outs["flash"], atol=2e-2)  # bf16
+
+
 def test_long_context_bert_through_engine():
     """Sequence-parallel BERT infers through the full engine path and
     matches the single-device model (same canonical weights)."""
