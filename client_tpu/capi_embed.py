@@ -49,7 +49,12 @@ def create_engine(models_csv: str = "") -> TpuEngine:
         except Exception:  # noqa: BLE001 — backend already initialized
             pass
     names = [n.strip() for n in models_csv.split(",") if n.strip()] or None
-    return TpuEngine(build_repository(names))
+    # CLIENT_TPU_WARMUP=1: pre-compile every batch bucket at load so no
+    # XLA compile ever lands inside a perf-harness measurement window
+    # (pair with tpu_perf_analyzer --warmup-request-count for the
+    # request-path caches).
+    warmup = os.environ.get("CLIENT_TPU_WARMUP", "") not in ("", "0")
+    return TpuEngine(build_repository(names), warmup=warmup)
 
 
 def shutdown_engine(engine: TpuEngine) -> None:

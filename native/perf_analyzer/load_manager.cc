@@ -28,10 +28,17 @@ LoadManager::LoadManager(const LoadOptions& options,
 
 LoadManager::~LoadManager() {
   StopWorkerThreads();
-  if (shm_ready_ && !thread_configs_.empty() &&
-      thread_configs_[0]->backend != nullptr) {
-    CleanupSharedMemory(thread_configs_[0]->backend.get());
+  ClientBackend* shm_backend = nullptr;
+  if (!thread_configs_.empty() && thread_configs_[0]->backend != nullptr) {
+    shm_backend = thread_configs_[0]->backend.get();
+  } else if (warmup_config_ != nullptr &&
+             warmup_config_->backend != nullptr) {
+    shm_backend = warmup_config_->backend.get();
   }
+  if (shm_ready_ && shm_backend != nullptr) {
+    CleanupSharedMemory(shm_backend);
+  }
+  if (warmup_config_ != nullptr) thread_configs_.push_back(warmup_config_);
   for (auto& ctx_cfg : thread_configs_) {
     for (auto& ctx : ctx_cfg->ctxs) {
       for (auto* input : ctx->inputs) delete input;
@@ -134,6 +141,36 @@ void LoadManager::CleanupSharedMemory(ClientBackend* backend) {
   }
   shm_regions_.clear();
   shm_ready_ = false;
+}
+
+Error LoadManager::WarmUp(size_t n) {
+  if (n == 0) return Error::Success();
+  warmup_config_ = std::make_shared<ThreadConfig>();
+  warmup_config_->index = 0;
+  Error err = factory_.Create(&warmup_config_->backend);
+  if (!err.IsOk()) return err;
+  // Same once-only shm setup the worker paths use (regions stay
+  // registered for the measurement phase; the destructor cleans up).
+  if (options_.shm_type != SharedMemoryType::NONE && !shm_ready_) {
+    err = InitSharedMemory(warmup_config_->backend.get());
+    if (!err.IsOk()) return err;
+  }
+  InferContext* ctx = nullptr;
+  err = MakeContext(warmup_config_.get(), &ctx);
+  if (!err.IsOk()) return err;
+  for (size_t i = 0; i < n && err.IsOk(); ++i) {
+    err = PrepareRequest(ctx);
+    if (!err.IsOk()) break;
+    tpuclient::InferResult* result = nullptr;
+    err = warmup_config_->backend->Infer(&result, *ctx->options, ctx->inputs,
+                                         ctx->outputs);
+    if (err.IsOk() && result != nullptr) {
+      // HTTP-kind failures ride the result, not the call status.
+      err = result->RequestStatus();
+    }
+    delete result;
+  }
+  return err;
 }
 
 Error LoadManager::MakeContext(ThreadConfig* config, InferContext** out) {

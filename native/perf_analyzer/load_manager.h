@@ -52,6 +52,13 @@ class LoadManager {
 
   int32_t BatchSize() const { return options_.batch_size; }
 
+  // Sends n unmeasured synchronous inferences on a dedicated backend so
+  // first-request server-side compilation (XLA warms one executable per
+  // batch bucket) never lands inside a measurement window. Reference
+  // perf_analyzer relies on stability-window rejection instead; explicit
+  // warmup converges far faster when compile takes tens of seconds.
+  tpuclient::Error WarmUp(size_t n);
+
  protected:
   LoadManager(const LoadOptions& options, ClientBackendFactory factory,
               std::shared_ptr<ModelParser> parser,
@@ -127,6 +134,10 @@ class LoadManager {
 
   std::vector<std::shared_ptr<ThreadStat>> thread_stats_;
   std::vector<std::shared_ptr<ThreadConfig>> thread_configs_;
+  // WarmUp's dedicated backend/context — kept for the manager's lifetime so
+  // the destructor's shm cleanup and tensor frees cover it (the warmup shm
+  // registrations outlive WarmUp by design: workers reuse them).
+  std::shared_ptr<ThreadConfig> warmup_config_;
   std::vector<std::thread> threads_;
   std::atomic<bool> exit_{false};
 

@@ -55,6 +55,9 @@ Options:
   --shared-memory <none|system|tpu>   tensor transport (default none)
   --output-shared-memory-size <bytes>
   --max-threads <n>      worker thread cap (default 16)
+  --warmup-request-count <n>  unmeasured requests before profiling (lets
+                         the server compile per-bucket executables outside
+                         the measurement windows; default 0)
   --service-kind <tpu_http|tpu_grpc|tpu_capi|tfserving|torchserve>
                          endpoint kind (default
                          tpu_http; -i grpc implies tpu_grpc);
@@ -106,6 +109,7 @@ struct Args {
   std::string capi_lib = "./build/libtpuserver.so";
   std::string capi_models;
   std::string capi_repo_root = ".";
+  size_t warmup_requests = 0;
 };
 
 bool ParseRange(const char* s, double* a, double* b, double* c) {
@@ -215,6 +219,7 @@ int main(int argc, char** argv) {
       {"output-shared-memory-size", required_argument, nullptr, 1015},
       {"max-threads", required_argument, nullptr, 1016},
       {"service-kind", required_argument, nullptr, 1017},
+      {"warmup-request-count", required_argument, nullptr, 1021},
       {"capi-library-path", required_argument, nullptr, 1018},
       {"capi-models", required_argument, nullptr, 1019},
       {"capi-repo-root", required_argument, nullptr, 1020},
@@ -315,6 +320,7 @@ int main(int argc, char** argv) {
       case 1018: args.capi_lib = optarg; break;
       case 1019: args.capi_models = optarg; break;
       case 1020: args.capi_repo_root = optarg; break;
+      case 1021: args.warmup_requests = strtoull(optarg, nullptr, 10); break;
       default: Usage("unknown option");
     }
   }
@@ -431,6 +437,16 @@ int main(int argc, char** argv) {
     fprintf(stderr, "failed to create load manager: %s\n",
             err.Message().c_str());
     return 1;
+  }
+
+  if (args.warmup_requests > 0) {
+    fprintf(stderr, "sending %zu warmup request(s)...\n",
+            args.warmup_requests);
+    err = manager->WarmUp(args.warmup_requests);
+    if (!err.IsOk()) {
+      fprintf(stderr, "warmup error: %s\n", err.Message().c_str());
+      return 1;
+    }
   }
 
   // --- profiler -------------------------------------------------------------

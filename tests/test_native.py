@@ -575,3 +575,20 @@ def test_perf_analyzer_b64_input_data(native_build, server, tmp_path):
     lines = csv.read_text().strip().splitlines()
     header, row = lines[0].split(","), lines[1].split(",")
     assert float(row[header.index("Inferences/Second")]) > 0
+
+
+def test_perf_analyzer_warmup_flag(native_build, server, tmp_path):
+    """--warmup-request-count sends unmeasured requests first (keeps XLA
+    per-bucket compiles out of the measurement windows)."""
+    csv = tmp_path / "warm.csv"
+    proc = subprocess.run(
+        [os.path.join(native_build, "tpu_perf_analyzer"),
+         "-m", "simple", "-u", server.url, "--warmup-request-count", "4",
+         "-p", "300", "-r", "4", "-s", "70",
+         "--concurrency-range", "1:1", "-f", str(csv)],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "warmup" in proc.stderr
+    lines = csv.read_text().strip().splitlines()
+    header, row = lines[0].split(","), lines[1].split(",")
+    assert float(row[header.index("Inferences/Second")]) > 0
