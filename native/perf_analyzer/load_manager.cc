@@ -145,6 +145,16 @@ void LoadManager::CleanupSharedMemory(ClientBackend* backend) {
 
 Error LoadManager::WarmUp(size_t n) {
   if (n == 0) return Error::Success();
+  if (is_sequence_) {
+    // A warmup request would open a server-side sequence slot
+    // (sequence_start without ever reaching sequence_end) that then sits
+    // orphaned through the measurement run. Sequence models warm through
+    // the stability search instead.
+    fprintf(stderr,
+            "warning: --warmup-request-count ignored for sequence-scheduled "
+            "models (a warmup sequence would be left open server-side)\n");
+    return Error::Success();
+  }
   warmup_config_ = std::make_shared<ThreadConfig>();
   warmup_config_->index = 0;
   Error err = factory_.Create(&warmup_config_->backend);
