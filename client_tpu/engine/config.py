@@ -49,9 +49,44 @@ class TensorConfig:
 
 
 @dataclass
+class QueuePolicy:
+    """Triton ModelQueuePolicy semantics (the `schedule_policy` extension):
+    what happens to a request that waits too long or arrives at a full
+    queue."""
+
+    timeout_action: str = "REJECT"  # REJECT | DELAY (execute anyway)
+    default_timeout_microseconds: int = 0  # 0 = no queue timeout
+    allow_timeout_override: bool = True    # request timeout_us may override
+    max_queue_size: int = 0                # 0 = unbounded
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "QueuePolicy":
+        return cls(
+            timeout_action=str(d.get("timeout_action", "REJECT")).upper(),
+            default_timeout_microseconds=int(
+                d.get("default_timeout_microseconds", 0)),
+            allow_timeout_override=bool(d.get("allow_timeout_override",
+                                              True)),
+            max_queue_size=int(d.get("max_queue_size", 0)),
+        )
+
+
+@dataclass
 class DynamicBatchingConfig:
     preferred_batch_size: list[int] = field(default_factory=list)
     max_queue_delay_microseconds: int = 0
+    # Priority scheduling (lower number = higher priority, Triton
+    # convention; request priority 0 maps to default_priority_level).
+    priority_levels: int = 0
+    default_priority_level: int = 0
+    default_queue_policy: QueuePolicy | None = None
+    # per-level overrides: level -> policy
+    priority_queue_policy: dict[int, QueuePolicy] = field(
+        default_factory=dict)
+
+    def policy_for(self, level: int) -> QueuePolicy | None:
+        return self.priority_queue_policy.get(level,
+                                              self.default_queue_policy)
 
 
 @dataclass
@@ -121,6 +156,16 @@ class ModelConfig:
             db = DynamicBatchingConfig(
                 preferred_batch_size=[int(x) for x in raw.get("preferred_batch_size", [])],
                 max_queue_delay_microseconds=int(raw.get("max_queue_delay_microseconds", 0)),
+                priority_levels=int(raw.get("priority_levels", 0)),
+                default_priority_level=int(
+                    raw.get("default_priority_level", 0)),
+                default_queue_policy=QueuePolicy.from_dict(
+                    raw["default_queue_policy"])
+                if raw.get("default_queue_policy") else None,
+                priority_queue_policy={
+                    int(k): QueuePolicy.from_dict(v)
+                    for k, v in (raw.get("priority_queue_policy")
+                                 or {}).items()},
             )
         sb = None
         if "sequence_batching" in d:
@@ -193,11 +238,33 @@ class ModelConfig:
             ],
         }
         if self.dynamic_batching is not None:
+            db = self.dynamic_batching
             out["dynamic_batching"] = {
-                "preferred_batch_size": self.dynamic_batching.preferred_batch_size,
+                "preferred_batch_size": db.preferred_batch_size,
                 "max_queue_delay_microseconds":
-                    self.dynamic_batching.max_queue_delay_microseconds,
+                    db.max_queue_delay_microseconds,
             }
+            if db.priority_levels:
+                out["dynamic_batching"]["priority_levels"] = \
+                    db.priority_levels
+                out["dynamic_batching"]["default_priority_level"] = \
+                    db.default_priority_level
+            def _qp_dict(qp: QueuePolicy) -> dict:
+                return {
+                    "timeout_action": qp.timeout_action,
+                    "default_timeout_microseconds":
+                        qp.default_timeout_microseconds,
+                    "allow_timeout_override": qp.allow_timeout_override,
+                    "max_queue_size": qp.max_queue_size,
+                }
+
+            if db.default_queue_policy is not None:
+                out["dynamic_batching"]["default_queue_policy"] = _qp_dict(
+                    db.default_queue_policy)
+            if db.priority_queue_policy:
+                out["dynamic_batching"]["priority_queue_policy"] = {
+                    int(k): _qp_dict(v)
+                    for k, v in db.priority_queue_policy.items()}
         if self.instance_count != 1:
             out["instance_group"] = [{"count": self.instance_count}]
         if self.sequence_batching is not None:

@@ -9,7 +9,7 @@ buckets so the jitted XLA executable sees only static shapes.
 
 from __future__ import annotations
 
-import collections
+import heapq
 import queue
 import threading
 from typing import Callable
@@ -26,41 +26,64 @@ from client_tpu.engine.types import (
 )
 
 _SHUTDOWN = object()
+# Shutdown drains behind every queued request regardless of its priority.
+_SHUTDOWN_LEVEL = 1 << 30
 
 
 class _ReqQueue:
-    """FIFO queue with front-pushback.
+    """Priority-ordered queue with FIFO order within a level and
+    front-pushback.
 
-    Dynamic-batch gathering must be able to return a request that doesn't fit
-    the current batch to the *head* of the queue: round 1 re-queued it to the
-    tail, which reordered FIFO under mixed shapes and could starve a request
-    indefinitely with one worker. ``get`` blocks like ``queue.Queue.get`` and
-    raises ``queue.Empty`` on timeout.
+    Levels follow the Triton convention (lower number = higher priority);
+    FIFO-only models use a single level. Dynamic-batch gathering must be
+    able to return a request that doesn't fit the current batch to the
+    *head* of its level: round 1 re-queued it to the tail, which reordered
+    FIFO under mixed shapes and could starve a request indefinitely with
+    one worker. ``get`` blocks like ``queue.Queue.get`` and raises
+    ``queue.Empty`` on timeout.
     """
 
     def __init__(self):
-        self._d: collections.deque = collections.deque()
+        self._h: list = []  # (level, seq, item)
         self._cv = threading.Condition()
+        self._seq = 0        # arrival order within a level
+        self._front_seq = 0  # decreasing: pushback lands ahead of arrivals
+        self._level_counts: dict[int, int] = {}
 
-    def put(self, item) -> None:
+    def put(self, item, level: int = 0, max_level_size: int = 0) -> bool:
+        """Enqueue; with ``max_level_size`` > 0 the admission check against
+        that *level's* depth happens under the queue lock (atomic — Triton's
+        per-level ModelQueuePolicy.max_queue_size semantics). Returns False
+        when the level is full."""
         with self._cv:
-            self._d.append(item)
+            if max_level_size > 0 and \
+                    self._level_counts.get(level, 0) >= max_level_size:
+                return False
+            self._seq += 1
+            heapq.heappush(self._h, (level, self._seq, item))
+            self._level_counts[level] = self._level_counts.get(level, 0) + 1
             self._cv.notify()
+            return True
 
-    def put_front(self, item) -> None:
+    def put_front(self, item, level: int = 0) -> None:
         with self._cv:
-            self._d.appendleft(item)
+            self._front_seq -= 1
+            heapq.heappush(self._h, (level, self._front_seq, item))
+            self._level_counts[level] = self._level_counts.get(level, 0) + 1
             self._cv.notify()
 
     def get(self, timeout: float | None = None):
         with self._cv:
-            if not self._cv.wait_for(lambda: len(self._d) > 0, timeout=timeout):
+            if not self._cv.wait_for(lambda: len(self._h) > 0,
+                                     timeout=timeout):
                 raise queue.Empty
-            return self._d.popleft()
+            level, _seq, item = heapq.heappop(self._h)
+            self._level_counts[level] = self._level_counts.get(level, 1) - 1
+            return item
 
     def qsize(self) -> int:
         with self._cv:
-            return len(self._d)
+            return len(self._h)
 
 
 class Scheduler:
@@ -82,14 +105,33 @@ class Scheduler:
             t.start()
             self.workers.append(t)
 
+    def _priority_level(self, req: InferRequest) -> int:
+        """Triton semantics: priority <= 0 means the model's default level;
+        priorities beyond priority_levels clamp to the lowest level."""
+        dyn = self.model.config.dynamic_batching
+        if dyn is None or dyn.priority_levels <= 0:
+            return 0
+        level = int(req.priority)
+        if level <= 0:
+            level = int(dyn.default_priority_level) or \
+                (dyn.priority_levels + 1) // 2
+        return max(1, min(level, dyn.priority_levels))
+
     def submit(self, req: InferRequest) -> None:
+        level = self._priority_level(req)
+        dyn = self.model.config.dynamic_batching
+        policy = dyn.policy_for(level) if dyn is not None else None
+        max_size = policy.max_queue_size if policy is not None else 0
         req.times.queue_start = now_ns()
-        self.queue.put(req)
+        if not self.queue.put(req, level, max_level_size=max_size):
+            raise EngineError(
+                f"exceeds maximum queue size ({max_size}) for priority "
+                f"level {level} of model '{self.model.config.name}'", 429)
 
     def stop(self) -> None:
         self._stopping = True
         for _ in self.workers:
-            self.queue.put(_SHUTDOWN)
+            self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)
         for t in self.workers:
             t.join(timeout=5.0)
 
@@ -109,10 +151,20 @@ class Scheduler:
 
     def _check_timeout(self, req: InferRequest) -> bool:
         """Server-side request timeout while queued (InferOptions
-        server_timeout, reference common.h:199-204)."""
-        if req.timeout_us > 0:
+        server_timeout, reference common.h:199-204, composed with the
+        model's queue policy — the `schedule_policy` extension)."""
+        dyn = self.model.config.dynamic_batching
+        policy = (dyn.policy_for(self._priority_level(req))
+                  if dyn is not None else None)
+        timeout_us = req.timeout_us
+        if policy is not None:
+            if timeout_us <= 0 or not policy.allow_timeout_override:
+                timeout_us = policy.default_timeout_microseconds
+        if timeout_us > 0:
             waited_us = (now_ns() - req.times.queue_start) // 1000
-            if waited_us > req.timeout_us:
+            if waited_us > timeout_us:
+                if policy is not None and policy.timeout_action == "DELAY":
+                    return False  # execute anyway (Triton DELAY action)
                 self._fail(req, EngineError("request timed out in queue", 504))
                 return True
         return False
@@ -162,15 +214,16 @@ class DefaultScheduler(Scheduler):
             except queue.Empty:
                 break
             if item is _SHUTDOWN:
-                self.queue.put(_SHUTDOWN)  # re-post for siblings
+                self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)  # re-post for siblings
                 break
             nxt: InferRequest = item
             if self._check_timeout(nxt):
                 continue
             if total + _request_batch(nxt) > max_batch or not _compatible(first, nxt):
-                # Doesn't fit this batch: push back to the *head* so arrival
-                # order is preserved and the next gather starts with it.
-                self.queue.put_front(nxt)
+                # Doesn't fit this batch: push back to the *head* of its
+                # level so arrival order is preserved and the next gather
+                # starts with it.
+                self.queue.put_front(nxt, self._priority_level(nxt))
                 break
             batch.append(nxt)
             total += _request_batch(nxt)

@@ -34,6 +34,27 @@ def config_dict_to_proto(d: dict) -> "pb.ModelConfig":
             int(x) for x in db.get("preferred_batch_size", []))
         cfg.dynamic_batching.max_queue_delay_microseconds = int(
             db.get("max_queue_delay_microseconds", 0))
+        cfg.dynamic_batching.priority_levels = int(
+            db.get("priority_levels", 0))
+        cfg.dynamic_batching.default_priority_level = int(
+            db.get("default_priority_level", 0))
+
+        def fill_policy(dst, src: dict) -> None:
+            dst.timeout_action = pb.ModelQueuePolicy.TimeoutAction.Value(
+                str(src.get("timeout_action", "REJECT")).upper())
+            dst.default_timeout_microseconds = int(
+                src.get("default_timeout_microseconds", 0))
+            dst.allow_timeout_override = bool(
+                src.get("allow_timeout_override", True))
+            dst.max_queue_size = int(src.get("max_queue_size", 0))
+
+        if db.get("default_queue_policy"):
+            fill_policy(cfg.dynamic_batching.default_queue_policy,
+                        db["default_queue_policy"])
+        for level, policy in (db.get("priority_queue_policy") or {}).items():
+            fill_policy(
+                cfg.dynamic_batching.priority_queue_policy[int(level)],
+                policy)
     if "sequence_batching" in d:
         sb = d["sequence_batching"] or {}
         if sb.get("strategy") == "oldest":
@@ -88,12 +109,34 @@ def proto_to_config_dict(cfg: "pb.ModelConfig") -> dict:
             entry["label_filename"] = t.label_filename
         d["output"].append(entry)
     if cfg.HasField("dynamic_batching"):
+        db = cfg.dynamic_batching
         d["dynamic_batching"] = {
-            "preferred_batch_size": list(
-                cfg.dynamic_batching.preferred_batch_size),
+            "preferred_batch_size": list(db.preferred_batch_size),
             "max_queue_delay_microseconds":
-                cfg.dynamic_batching.max_queue_delay_microseconds,
+                db.max_queue_delay_microseconds,
         }
+
+        def policy_dict(qp) -> dict:
+            return {
+                "timeout_action":
+                    pb.ModelQueuePolicy.TimeoutAction.Name(qp.timeout_action),
+                "default_timeout_microseconds":
+                    qp.default_timeout_microseconds,
+                "allow_timeout_override": qp.allow_timeout_override,
+                "max_queue_size": qp.max_queue_size,
+            }
+
+        if db.priority_levels:
+            d["dynamic_batching"]["priority_levels"] = db.priority_levels
+            d["dynamic_batching"]["default_priority_level"] = \
+                db.default_priority_level
+        if db.HasField("default_queue_policy"):
+            d["dynamic_batching"]["default_queue_policy"] = policy_dict(
+                db.default_queue_policy)
+        if db.priority_queue_policy:
+            d["dynamic_batching"]["priority_queue_policy"] = {
+                int(k): policy_dict(v)
+                for k, v in db.priority_queue_policy.items()}
     if cfg.HasField("sequence_batching"):
         sb: dict = {"max_sequence_idle_microseconds":
                     cfg.sequence_batching.max_sequence_idle_microseconds

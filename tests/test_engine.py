@@ -6,6 +6,7 @@ assertions mirror the reference examples' hard-coded add/sub checks.
 """
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -280,3 +281,165 @@ class TestTimeout:
                     "INPUT1": np.zeros((1, 16), np.int32)},
                    timeout_us=1)
         assert ei.value.status == 504
+
+
+class TestSchedulePolicy:
+    """Priority levels + queue policy (the `schedule_policy` extension;
+    Triton ModelQueuePolicy semantics)."""
+
+    @staticmethod
+    def _backend(block_event=None, running_event=None, **dyn_kw):
+        """AddSub with one worker; when events are given, the first request
+        signals `running_event` and waits on `block_event` — a deterministic
+        head-of-line blocker (host-side apply, no XLA)."""
+        from client_tpu.engine.config import DynamicBatchingConfig
+        from client_tpu.models.simple import AddSubBackend
+
+        backend = AddSubBackend(name="prio", max_batch_size=4)
+        backend.config.dynamic_batching = DynamicBatchingConfig(
+            preferred_batch_size=[4],
+            max_queue_delay_microseconds=0,
+            **dyn_kw)
+        backend.config.instance_count = 1
+        backend.config.batch_buckets = [1, 4]
+        if block_event is not None:
+            backend.jittable = False
+            first = {"seen": False}
+
+            def make_apply():
+                def apply(inputs):
+                    if not first["seen"]:
+                        first["seen"] = True
+                        running_event.set()
+                        assert block_event.wait(60)
+                    a, b = inputs["INPUT0"], inputs["INPUT1"]
+                    return {"OUTPUT0": a + b, "OUTPUT1": a - b}
+                return apply
+
+            backend.make_apply = make_apply
+        return backend
+
+    def test_priority_orders_queue(self):
+        """With the single worker busy, a later high-priority request
+        overtakes earlier low-priority ones."""
+        import threading
+
+        from client_tpu.engine.repository import ModelRepository
+
+        block = threading.Event()
+        running = threading.Event()
+        backend = self._backend(block_event=block, running_event=running,
+                                priority_levels=2, default_priority_level=2)
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        engine = TpuEngine(repo)
+        try:
+            a = np.zeros((1, 16), np.int32)
+            order = []
+            lock = threading.Lock()
+            done = threading.Event()
+
+            def submit(tag, priority):
+                def cb(resp):
+                    with lock:
+                        order.append(tag)
+                    if len(order) >= 4:
+                        done.set()
+                engine.async_infer(
+                    InferRequest(model_name="prio",
+                                 inputs={"INPUT0": a, "INPUT1": a},
+                                 priority=priority),
+                    cb)
+
+            # Head-of-line blocker holds the single worker...
+            submit("first", 0)
+            assert running.wait(30)
+            # ...then two low-priority and one high-priority queue behind it.
+            submit("low1", 2)
+            submit("low2", 2)
+            submit("high", 1)
+            block.set()
+            assert done.wait(60)
+            assert order[0] == "first"
+            assert order.index("high") < order.index("low1")
+            assert order.index("high") < order.index("low2")
+        finally:
+            block.set()
+            engine.shutdown()
+
+    def test_max_queue_size_rejects(self):
+        from client_tpu.engine.repository import ModelRepository
+
+        from client_tpu.engine.config import QueuePolicy
+
+        block = threading.Event()
+        running = threading.Event()
+        backend = self._backend(
+            block_event=block, running_event=running,
+            priority_levels=1, default_priority_level=1,
+            default_queue_policy=QueuePolicy(max_queue_size=1))
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        engine = TpuEngine(repo)
+        try:
+            a = np.zeros((1, 16), np.int32)
+
+            def submit_async():
+                engine.async_infer(
+                    InferRequest(model_name="prio",
+                                 inputs={"INPUT0": a, "INPUT1": a}),
+                    lambda resp: None)
+
+            submit_async()            # occupies the single worker...
+            assert running.wait(30)
+            submit_async()            # ...fills the one queue slot...
+            with pytest.raises(EngineError, match="maximum queue size"):
+                submit_async()        # ...and the third is rejected.
+        finally:
+            block.set()
+            engine.shutdown()
+
+    def test_queue_timeout_reject_and_delay(self):
+        import threading
+
+        from client_tpu.engine.repository import ModelRepository
+
+        from client_tpu.engine.config import QueuePolicy
+
+        for action, expect_error in (("REJECT", True), ("DELAY", False)):
+            block = threading.Event()
+            running = threading.Event()
+            # Per-level policy (priority_queue_policy): only level 2 carries
+            # the 1us queue timeout; the level-1 blocker is unconstrained.
+            backend = self._backend(
+                block_event=block, running_event=running,
+                priority_levels=2, default_priority_level=1,
+                priority_queue_policy={2: QueuePolicy(
+                    timeout_action=action,
+                    default_timeout_microseconds=1,  # expires immediately
+                    allow_timeout_override=False)})
+            repo = ModelRepository()
+            repo.register_backend(backend)
+            engine = TpuEngine(repo)
+            try:
+                a = np.zeros((1, 16), np.int32)
+
+                engine.async_infer(
+                    InferRequest(model_name="prio",
+                                 inputs={"INPUT0": a, "INPUT1": a}),
+                    lambda resp: None)
+                assert running.wait(30)
+                # Second request (level 2) queues behind the blocked first;
+                # its 1us queue timeout certainly expires before release.
+                threading.Timer(0.2, block.set).start()
+                if expect_error:
+                    with pytest.raises(EngineError, match="timed out"):
+                        _infer(engine, "prio",
+                               {"INPUT0": a, "INPUT1": a}, priority=2)
+                else:
+                    resp = _infer(engine, "prio",
+                                  {"INPUT0": a, "INPUT1": a}, priority=2)
+                    assert np.array_equal(resp.outputs["OUTPUT0"], a + a)
+            finally:
+                block.set()
+                engine.shutdown()
