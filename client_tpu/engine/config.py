@@ -75,6 +75,9 @@ class QueuePolicy:
 class DynamicBatchingConfig:
     preferred_batch_size: list[int] = field(default_factory=list)
     max_queue_delay_microseconds: int = 0
+    # Responses release in request-arrival order even when several executor
+    # instances complete batches out of order (Triton preserve_ordering).
+    preserve_ordering: bool = False
     # Priority scheduling (lower number = higher priority, Triton
     # convention; request priority 0 maps to default_priority_level).
     priority_levels: int = 0
@@ -156,6 +159,7 @@ class ModelConfig:
             db = DynamicBatchingConfig(
                 preferred_batch_size=[int(x) for x in raw.get("preferred_batch_size", [])],
                 max_queue_delay_microseconds=int(raw.get("max_queue_delay_microseconds", 0)),
+                preserve_ordering=bool(raw.get("preserve_ordering", False)),
                 priority_levels=int(raw.get("priority_levels", 0)),
                 default_priority_level=int(
                     raw.get("default_priority_level", 0)),
@@ -244,6 +248,8 @@ class ModelConfig:
                 "max_queue_delay_microseconds":
                     db.max_queue_delay_microseconds,
             }
+            if db.preserve_ordering:
+                out["dynamic_batching"]["preserve_ordering"] = True
             if db.priority_levels:
                 out["dynamic_batching"]["priority_levels"] = \
                     db.priority_levels

@@ -10,6 +10,7 @@ buckets so the jitted XLA executable sees only static shapes.
 from __future__ import annotations
 
 import heapq
+import logging
 import queue
 import threading
 from typing import Callable
@@ -89,12 +90,35 @@ class _ReqQueue:
 class Scheduler:
     """Base scheduler: owns the request queue and worker threads."""
 
+    # preserve_ordering applies only to the one-response-per-request default
+    # scheduler; decoupled streams and sequence slots have their own ordering
+    # contracts (Triton likewise scopes it to the dynamic batcher).
+    supports_preserve_ordering = False
+
     def __init__(self, model: Model, stats: ModelStats):
         self.model = model
         self.stats = stats
         self.queue = _ReqQueue()
         self.workers: list[threading.Thread] = []
         self._stopping = False
+        # preserve_ordering (Triton ModelDynamicBatching): responses release
+        # in arrival order even when instances complete out of order.
+        dyn = model.config.dynamic_batching
+        self._preserve_ordering = bool(
+            dyn and dyn.preserve_ordering and self.supports_preserve_ordering
+            and not model.config.decoupled)
+        if self._preserve_ordering and dyn.priority_levels > 0:
+            # Arrival-order release and priority overtaking contradict each
+            # other (a held high-priority response would wait on every older
+            # low-priority request — unbounded holds). Triton rejects the
+            # combination too.
+            raise EngineError(
+                f"model '{model.config.name}': preserve_ordering cannot be "
+                "combined with priority_levels", 400)
+        self._order_lock = threading.Lock()
+        self._arrival_seq = 0        # assigned at submit
+        self._release_seq = 0        # next sequence allowed to respond
+        self._held: dict[int, tuple] = {}  # seq -> (req, resp)
         n = max(1, model.config.instance_count)
         for i in range(n):
             t = threading.Thread(
@@ -123,7 +147,15 @@ class Scheduler:
         policy = dyn.policy_for(level) if dyn is not None else None
         max_size = policy.max_queue_size if policy is not None else 0
         req.times.queue_start = now_ns()
+        if self._preserve_ordering:
+            with self._order_lock:
+                req.arrival_seq = self._arrival_seq
+                self._arrival_seq += 1
         if not self.queue.put(req, level, max_level_size=max_size):
+            if self._preserve_ordering:
+                # The rejected request's arrival slot must not dam the
+                # release sequence: mark it done with a hole sentinel.
+                self._release_in_order(req.arrival_seq, (None, None))
             raise EngineError(
                 f"exceeds maximum queue size ({max_size}) for priority "
                 f"level {level} of model '{self.model.config.name}'", 429)
@@ -140,7 +172,31 @@ class Scheduler:
     def _worker_loop(self) -> None:
         raise NotImplementedError
 
+    def _release_in_order(self, seq: int, entry: tuple) -> None:
+        """Park (req, resp) under its arrival slot; deliver the contiguous
+        run of now-unblocked responses. Callbacks run outside the lock (a
+        synchronous re-submit from a callback must not deadlock), and one
+        raising callback cannot drop the rest of the run."""
+        ready: list[tuple] = []
+        with self._order_lock:
+            self._held[seq] = entry
+            while self._release_seq in self._held:
+                ready.append(self._held.pop(self._release_seq))
+                self._release_seq += 1
+        for r, rp in ready:
+            if r is not None and r.response_callback is not None:
+                try:
+                    r.response_callback(rp)
+                except Exception:  # noqa: BLE001 — isolate client callbacks
+                    logging.getLogger("client_tpu").exception(
+                        "response callback raised (model '%s')",
+                        self.model.config.name)
+
     def _respond(self, req: InferRequest, resp: InferResponse) -> None:
+        if self._preserve_ordering and getattr(req, "arrival_seq",
+                                               None) is not None:
+            self._release_in_order(req.arrival_seq, (req, resp))
+            return
         if req.response_callback is not None:
             req.response_callback(resp)
 
@@ -178,6 +234,8 @@ class DefaultScheduler(Scheduler):
     concatenates along the batch axis, pads to the shape bucket, and runs one
     XLA execution for the whole batch.
     """
+
+    supports_preserve_ordering = True
 
     def _worker_loop(self) -> None:
         cfg = self.model.config

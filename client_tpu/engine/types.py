@@ -81,6 +81,8 @@ class InferRequest:
     sequence_start: bool = False
     sequence_end: bool = False
     priority: int = 0
+    # Assigned by the scheduler under preserve_ordering (arrival index).
+    arrival_seq: int | None = None
     timeout_us: int = 0
     times: RequestTimes = field(default_factory=RequestTimes)
     # Decoupled models invoke this once per streamed response; the final

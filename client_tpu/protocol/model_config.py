@@ -34,6 +34,8 @@ def config_dict_to_proto(d: dict) -> "pb.ModelConfig":
             int(x) for x in db.get("preferred_batch_size", []))
         cfg.dynamic_batching.max_queue_delay_microseconds = int(
             db.get("max_queue_delay_microseconds", 0))
+        cfg.dynamic_batching.preserve_ordering = bool(
+            db.get("preserve_ordering", False))
         cfg.dynamic_batching.priority_levels = int(
             db.get("priority_levels", 0))
         cfg.dynamic_batching.default_priority_level = int(
@@ -126,6 +128,8 @@ def proto_to_config_dict(cfg: "pb.ModelConfig") -> dict:
                 "max_queue_size": qp.max_queue_size,
             }
 
+        if db.preserve_ordering:
+            d["dynamic_batching"]["preserve_ordering"] = True
         if db.priority_levels:
             d["dynamic_batching"]["priority_levels"] = db.priority_levels
             d["dynamic_batching"]["default_priority_level"] = \

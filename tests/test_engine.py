@@ -443,3 +443,70 @@ class TestSchedulePolicy:
             finally:
                 block.set()
                 engine.shutdown()
+
+
+class TestPreserveOrdering:
+    def test_out_of_order_completions_release_in_arrival_order(self):
+        """Two instances complete out of order; responses still arrive in
+        request-arrival order (Triton preserve_ordering)."""
+        from client_tpu.engine.config import DynamicBatchingConfig
+        from client_tpu.engine.repository import ModelRepository
+        from client_tpu.models.simple import AddSubBackend
+
+        backend = AddSubBackend(name="ordered", max_batch_size=1)
+        backend.config.dynamic_batching = DynamicBatchingConfig(
+            preferred_batch_size=[1], max_queue_delay_microseconds=0,
+            preserve_ordering=True)
+        backend.config.instance_count = 2
+        backend.config.batch_buckets = [1]
+        backend.jittable = False
+        gates = {0: threading.Event(), 1: threading.Event()}
+        counter = {"n": 0}
+        lock = threading.Lock()
+
+        def make_apply():
+            def apply(inputs):
+                with lock:
+                    i = counter["n"]
+                    counter["n"] += 1
+                if i in gates:
+                    assert gates[i].wait(60)
+                a, b = inputs["INPUT0"], inputs["INPUT1"]
+                return {"OUTPUT0": a + b, "OUTPUT1": a - b}
+            return apply
+
+        backend.make_apply = make_apply
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        engine = TpuEngine(repo)
+        try:
+            a = np.zeros((1, 16), np.int32)
+            order = []
+            done = threading.Event()
+
+            def submit(tag):
+                def cb(resp):
+                    with lock:
+                        order.append(tag)
+                    if len(order) >= 2:
+                        done.set()
+                engine.async_infer(
+                    InferRequest(model_name="ordered",
+                                 inputs={"INPUT0": a, "INPUT1": a}),
+                    cb)
+
+            submit("first")
+            time.sleep(0.2)  # ensure arrival order first < second
+            submit("second")
+            time.sleep(0.2)
+            # Release the SECOND request's execution before the first:
+            gates[1].set()
+            time.sleep(0.3)
+            assert order == []  # second's response is held
+            gates[0].set()
+            assert done.wait(30)
+            assert order == ["first", "second"]
+        finally:
+            for g in gates.values():
+                g.set()
+            engine.shutdown()
