@@ -119,6 +119,7 @@ class Scheduler:
         self._arrival_seq = 0        # assigned at submit
         self._release_seq = 0        # next sequence allowed to respond
         self._held: dict[int, tuple] = {}  # seq -> (req, resp)
+        self._draining = False       # one thread flushes ready runs at a time
         n = max(1, model.config.instance_count)
         for i in range(n):
             t = threading.Thread(
@@ -174,27 +175,37 @@ class Scheduler:
 
     def _release_in_order(self, seq: int, entry: tuple) -> None:
         """Park (req, resp) under its arrival slot; deliver the contiguous
-        run of now-unblocked responses. Callbacks run outside the lock (a
-        synchronous re-submit from a callback must not deadlock), and one
+        run of now-unblocked responses.
+
+        Single-drainer: exactly one thread flushes at a time, popping one
+        slot per lock acquisition and invoking the callback outside the
+        lock — so deliveries are globally ordered (two workers completing
+        back-to-back runs cannot race each other's callbacks), a
+        synchronous re-submit from a callback cannot deadlock, and one
         raising callback cannot drop the rest of the run."""
-        ready: list[tuple] = []
         with self._order_lock:
             self._held[seq] = entry
-            while self._release_seq in self._held:
-                ready.append(self._held.pop(self._release_seq))
+            if self._draining:
+                return  # the active drainer will pick this up
+            self._draining = True
+        log_ = logging.getLogger("client_tpu")
+        while True:
+            with self._order_lock:
+                if self._release_seq not in self._held:
+                    self._draining = False
+                    return
+                r, rp = self._held.pop(self._release_seq)
                 self._release_seq += 1
-        for r, rp in ready:
             if r is not None and r.response_callback is not None:
                 try:
                     r.response_callback(rp)
                 except Exception:  # noqa: BLE001 — isolate client callbacks
-                    logging.getLogger("client_tpu").exception(
+                    log_.exception(
                         "response callback raised (model '%s')",
                         self.model.config.name)
 
     def _respond(self, req: InferRequest, resp: InferResponse) -> None:
-        if self._preserve_ordering and getattr(req, "arrival_seq",
-                                               None) is not None:
+        if self._preserve_ordering and req.arrival_seq is not None:
             self._release_in_order(req.arrival_seq, (req, resp))
             return
         if req.response_callback is not None:

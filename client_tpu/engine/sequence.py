@@ -12,6 +12,13 @@ HBM between requests. The 'direct' strategy pins each live sequence to a
 serialized execution lane (a per-sequence lock), mirroring the reference's
 1-context-per-sequence concurrency rule
 (concurrency_manager.cc:148-152, 302-335).
+
+Strategy note: configs may declare the 'oldest' strategy (Triton's
+oldest-sequence batcher) and it is accepted and correctness-equivalent
+here — per-sequence ordering and state routing are identical — but steps
+currently execute per sequence rather than cross-sequence batched; stacking
+live sequences' states into one batched [B, ...] pytree step is the pending
+throughput optimization for many-concurrent-sequence workloads.
 """
 
 from __future__ import annotations
