@@ -58,6 +58,11 @@ def preflight():
 # these, so changing them can never masquerade as a perf delta.
 BENCH_MAX_BATCH = 256
 BENCH_CONCURRENCY = 256
+# Executor instances = concurrent in-flight device round trips. On a
+# high-latency transport (dev tunnel ~70 ms RTT) many overlapping small
+# batches beat few large ones: measured ips at concurrency 256 was
+# 2212 (2 instances) / 2746 (4) / 4090 (10) / 3201 (14) on the v5e chip.
+BENCH_INSTANCES = 10
 
 
 def bench_inproc_simple(duration_s: float = 4.0,
@@ -77,6 +82,7 @@ def bench_inproc_simple(duration_s: float = 4.0,
     # default 64/32 on the v5e chip (the zoo default stays conservative for
     # interactive latency).
     backend = AddSubBackend(max_batch_size=BENCH_MAX_BATCH)
+    backend.config.instance_count = BENCH_INSTANCES
     repo = ModelRepository()
     repo.register_backend(backend)
     engine = TpuEngine(repo, warmup=True)
@@ -331,7 +337,7 @@ def main():
     # Same-config comparisons only: entries tagged with a different (or
     # absent) bench config measured a different thing — a concurrency or
     # batch-ceiling change must not masquerade as a perf delta.
-    config = f"mb{BENCH_MAX_BATCH}-c{BENCH_CONCURRENCY}"
+    config = f"mb{BENCH_MAX_BATCH}-c{BENCH_CONCURRENCY}-i{BENCH_INSTANCES}"
     best = max((h["value"] for h in hist
                 if isinstance(h, dict)
                 and h.get("metric") == "inproc_simple_ips"

@@ -74,13 +74,25 @@ class _ReqQueue:
             self._cv.notify()
 
     def get(self, timeout: float | None = None):
+        return self.get_many(1, timeout=timeout)[0]
+
+    def get_many(self, max_items: int, timeout: float | None = None) -> list:
+        """Pop up to ``max_items`` in priority/FIFO order under ONE lock
+        acquisition; blocks (bounded by ``timeout``) for the first item only.
+        Dynamic-batch gathering drains its backlog through this — per-item
+        ``get`` costs a lock round trip each, which under a few hundred
+        client threads lets the delay window expire after a handful of pops."""
         with self._cv:
             if not self._cv.wait_for(lambda: len(self._h) > 0,
                                      timeout=timeout):
                 raise queue.Empty
-            level, _seq, item = heapq.heappop(self._h)
-            self._level_counts[level] = self._level_counts.get(level, 1) - 1
-            return item
+            out = []
+            while self._h and len(out) < max_items:
+                level, _seq, item = heapq.heappop(self._h)
+                self._level_counts[level] = \
+                    self._level_counts.get(level, 1) - 1
+                out.append(item)
+            return out
 
     def qsize(self) -> int:
         with self._cv:
@@ -275,27 +287,48 @@ class DefaultScheduler(Scheduler):
         batch = [first]
         total = _request_batch(first)
         while total < prefer:
-            timeout = (deadline_ns - now_ns()) / 1e9
-            if timeout <= 0:
-                break
+            # Within the delay window this blocks for arrivals; past it
+            # (timeout 0) it only drains what is already queued — the delay
+            # bounds *waiting*, not backlog draining (Triton max_queue_delay
+            # semantics). One lock acquisition per slab, not per request.
+            timeout = max((deadline_ns - now_ns()) / 1e9, 0.0)
             try:
-                item = self.queue.get(timeout=timeout)
+                items = self.queue.get_many(prefer - total, timeout=timeout)
             except queue.Empty:
                 break
-            if item is _SHUTDOWN:
-                self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)  # re-post for siblings
+            stop = False
+            for idx, item in enumerate(items):
+                if item is _SHUTDOWN:
+                    # Heap order sorts the shutdown level behind every real
+                    # request, so the slab's tail is all sentinels: re-post
+                    # each one for the sibling workers.
+                    for _ in items[idx:]:
+                        self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)
+                    stop = True
+                    break
+                nxt: InferRequest = item
+                if self._check_timeout(nxt):
+                    continue
+                if total >= prefer \
+                        or total + _request_batch(nxt) > max_batch \
+                        or not _compatible(first, nxt):
+                    # Batch is full (multi-element requests can reach the
+                    # preferred size mid-slab) or this request doesn't fit:
+                    # push it and everything behind it back to the *head* of
+                    # their levels (reverse order keeps FIFO) so the next
+                    # gather starts with them.
+                    for later in reversed(items[idx:]):
+                        if later is _SHUTDOWN:
+                            self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)
+                        else:
+                            self.queue.put_front(
+                                later, self._priority_level(later))
+                    stop = True
+                    break
+                batch.append(nxt)
+                total += _request_batch(nxt)
+            if stop:
                 break
-            nxt: InferRequest = item
-            if self._check_timeout(nxt):
-                continue
-            if total + _request_batch(nxt) > max_batch or not _compatible(first, nxt):
-                # Doesn't fit this batch: push back to the *head* of its
-                # level so arrival order is preserved and the next gather
-                # starts with it.
-                self.queue.put_front(nxt, self._priority_level(nxt))
-                break
-            batch.append(nxt)
-            total += _request_batch(nxt)
         return batch
 
     def _execute_batch(self, batch: list[InferRequest]) -> None:

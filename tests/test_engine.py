@@ -510,3 +510,118 @@ class TestPreserveOrdering:
             for g in gates.values():
                 g.set()
             engine.shutdown()
+
+
+class TestBatchGather:
+    """Dynamic-batch gather semantics: the delay window bounds *waiting*,
+    never backlog draining, and the preferred size caps slab accepts."""
+
+    @staticmethod
+    def _blocking_backend(running_event, block_event, sizes, prefer=4,
+                          max_batch=16):
+        from client_tpu.engine.config import DynamicBatchingConfig
+        from client_tpu.models.simple import AddSubBackend
+
+        backend = AddSubBackend(name="gather", max_batch_size=max_batch)
+        backend.config.dynamic_batching = DynamicBatchingConfig(
+            preferred_batch_size=[prefer],
+            max_queue_delay_microseconds=0)
+        backend.config.instance_count = 1
+        backend.jittable = False
+        first = {"seen": False}
+
+        def make_apply():
+            def apply(inputs):
+                if not first["seen"]:
+                    first["seen"] = True
+                    running_event.set()
+                    assert block_event.wait(60)
+                a, b = inputs["INPUT0"], inputs["INPUT1"]
+                sizes.append(int(a.shape[0]))
+                return {"OUTPUT0": a + b, "OUTPUT1": a - b}
+            return apply
+
+        backend.make_apply = make_apply
+        return backend
+
+    def _run(self, reqs_batch, n_reqs, prefer=4):
+        """Block the single worker, queue n_reqs of batch reqs_batch behind
+        it, release, and return the per-execution batch sizes."""
+        from client_tpu.engine.repository import ModelRepository
+
+        running, block = threading.Event(), threading.Event()
+        sizes: list[int] = []
+        backend = self._blocking_backend(running, block, sizes, prefer=prefer)
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        engine = TpuEngine(repo)
+        try:
+            a = np.zeros((reqs_batch, 16), np.int32)
+            done = threading.Event()
+            remaining = [n_reqs + 1]
+            lock = threading.Lock()
+
+            def cb(resp):
+                assert resp.error is None
+                with lock:
+                    remaining[0] -= 1
+                    if remaining[0] == 0:
+                        done.set()
+
+            engine.async_infer(
+                InferRequest(model_name="gather",
+                             inputs={"INPUT0": a, "INPUT1": a}), cb)
+            assert running.wait(30)
+            for _ in range(n_reqs):
+                engine.async_infer(
+                    InferRequest(model_name="gather",
+                                 inputs={"INPUT0": a, "INPUT1": a}), cb)
+            block.set()
+            assert done.wait(60)
+            return sizes
+        finally:
+            block.set()
+            engine.shutdown()
+
+    def test_backlog_drained_despite_zero_delay(self):
+        """max_queue_delay=0: already-queued requests still batch together
+        (round-2 fix: the delay deadline used to cap the drain loop, so
+        backlogs dispatched in fragments of ~1 at full batch-slot cost)."""
+        sizes = self._run(reqs_batch=1, n_reqs=8, prefer=4)
+        # blocker alone (queue was empty at its gather), then 8/prefer=2 full
+        # preferred batches
+        assert sizes == [1, 4, 4]
+
+    def test_preferred_size_not_overshot_by_multielement_requests(self):
+        """A slab of multi-element requests stops accepting at the preferred
+        size instead of running on toward max_batch."""
+        sizes = self._run(reqs_batch=2, n_reqs=6, prefer=4)
+        assert sizes[0] == 2  # blocker
+        assert all(s <= 4 for s in sizes[1:])
+        assert sum(sizes) == 14
+
+    def test_shutdown_joins_all_instances(self):
+        """Every sentinel in a drained slab is re-posted, so shutdown with
+        many instances terminates every worker (round-2 fix: a gathering
+        worker could swallow several sentinels and starve its siblings)."""
+        from client_tpu.engine.repository import ModelRepository
+        from client_tpu.engine.config import DynamicBatchingConfig
+        from client_tpu.models.simple import AddSubBackend
+
+        backend = AddSubBackend(name="many", max_batch_size=8)
+        backend.config.dynamic_batching = DynamicBatchingConfig(
+            preferred_batch_size=[8],
+            max_queue_delay_microseconds=2000)
+        backend.config.instance_count = 10
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        engine = TpuEngine(repo)
+        sched = engine._schedulers["many"]
+        a = np.zeros((1, 16), np.int32)
+        for _ in range(30):
+            engine.async_infer(
+                InferRequest(model_name="many",
+                             inputs={"INPUT0": a, "INPUT1": a}),
+                lambda resp: None)
+        engine.shutdown()
+        assert not any(t.is_alive() for t in sched.workers)
