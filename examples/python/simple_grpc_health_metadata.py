@@ -1,0 +1,41 @@
+#!/usr/bin/env python3
+"""Health and metadata control plane over gRPC: live/ready, model ready,
+server and model metadata, model config.
+
+Reference counterpart: src/python/examples/simple_grpc_health_metadata.py.
+"""
+
+import argparse
+import sys
+
+from client_tpu.grpc import InferenceServerClient
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8001")
+parser.add_argument("-m", "--model", default="simple")
+args = parser.parse_args()
+
+with InferenceServerClient(args.url) as client:
+    if not client.is_server_live():
+        sys.exit("error: server not live")
+    if not client.is_server_ready():
+        sys.exit("error: server not ready")
+    if not client.is_model_ready(args.model):
+        sys.exit(f"error: model {args.model} not ready")
+
+    meta = client.get_server_metadata()
+    print(f"server: {meta.name} {meta.version}")
+
+    model_meta = client.get_model_metadata(args.model)
+    if model_meta.name != args.model:
+        sys.exit("error: model metadata name mismatch")
+    print(f"model inputs: {[t.name for t in model_meta.inputs]}")
+
+    config = client.get_model_config(args.model)
+    if config.config.name != args.model:
+        sys.exit("error: model config name mismatch")
+
+    stats = client.get_inference_statistics(args.model)
+    print(f"model stats entries: {len(stats.model_stats)}")
+
+print("PASS: health metadata (grpc)")

@@ -1,0 +1,42 @@
+#!/usr/bin/env python3
+"""gRPC client with explicit keepalive options: HTTP/2 PING-based liveness
+on the channel, then a value-asserted inference.
+
+Reference counterpart: src/python/examples/simple_grpc_keepalive_client.py
+(KeepAliveOptions mirroring reference grpc/__init__.py:104-144).
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+from client_tpu.grpc import InferenceServerClient, InferInput, KeepAliveOptions
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8001")
+args = parser.parse_args()
+
+keepalive = KeepAliveOptions(
+    keepalive_time_ms=2**31 - 1,
+    keepalive_timeout_ms=20000,
+    keepalive_permit_without_calls=False,
+    http2_max_pings_without_data=2,
+)
+
+with InferenceServerClient(args.url, keepalive_options=keepalive) as client:
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.ones((1, 16), dtype=np.int32)
+    inputs = [InferInput("INPUT0", [1, 16], "INT32"),
+              InferInput("INPUT1", [1, 16], "INT32")]
+    inputs[0].set_data_from_numpy(in0)
+    inputs[1].set_data_from_numpy(in1)
+
+    result = client.infer("simple", inputs)
+
+    if not np.array_equal(result.as_numpy("OUTPUT0"), in0 + in1):
+        sys.exit("error: incorrect sum")
+    if not np.array_equal(result.as_numpy("OUTPUT1"), in0 - in1):
+        sys.exit("error: incorrect difference")
+
+print("PASS: keepalive (grpc)")

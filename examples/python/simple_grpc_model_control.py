@@ -1,0 +1,36 @@
+#!/usr/bin/env python3
+"""Explicit model control over gRPC: unload then load a model, checking
+readiness transitions and the repository index.
+
+Reference counterpart: src/python/examples/simple_grpc_model_control.py.
+"""
+
+import argparse
+import sys
+
+from client_tpu.grpc import InferenceServerClient
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8001")
+parser.add_argument("-m", "--model", default="simple")
+args = parser.parse_args()
+
+with InferenceServerClient(args.url) as client:
+    if not client.is_model_ready(args.model):
+        client.load_model(args.model)
+    assert client.is_model_ready(args.model)
+
+    client.unload_model(args.model)
+    if client.is_model_ready(args.model):
+        sys.exit("error: model still ready after unload")
+
+    index = client.get_model_repository_index()
+    names = [m.name for m in index.models]
+    if args.model not in names:
+        sys.exit(f"error: {args.model} missing from repository index")
+
+    client.load_model(args.model)
+    if not client.is_model_ready(args.model):
+        sys.exit("error: model not ready after load")
+
+print("PASS: model control (grpc)")

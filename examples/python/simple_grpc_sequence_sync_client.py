@@ -1,0 +1,42 @@
+#!/usr/bin/env python3
+"""Synchronous stateful sequences over gRPC: two interleaved accumulator
+sequences with correlation ids and start/end flags.
+
+Reference counterpart:
+src/python/examples/simple_grpc_sequence_sync_infer_client.py.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+from client_tpu.grpc import InferenceServerClient, InferInput
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8001")
+args = parser.parse_args()
+
+
+def step(client, seq_id, start, end, value):
+    inp = InferInput("INPUT", [1], "INT32")
+    inp.set_data_from_numpy(np.array([value], dtype=np.int32))
+    result = client.infer("simple_sequence", [inp], sequence_id=seq_id,
+                          sequence_start=start, sequence_end=end)
+    return int(result.as_numpy("OUTPUT")[0])
+
+
+with InferenceServerClient(args.url) as client:
+    seq_a, seq_b = 201, 202
+    a_total = b_total = 0
+    values = [(1, 100), (2, 200), (3, 300)]
+    for i, (a, b) in enumerate(values):
+        a_total += a
+        b_total += b
+        got_a = step(client, seq_a, i == 0, i == len(values) - 1, a)
+        got_b = step(client, seq_b, i == 0, i == len(values) - 1, b)
+        if got_a != a_total or got_b != b_total:
+            sys.exit(f"error: state mismatch at step {i}: "
+                     f"{got_a}/{a_total}, {got_b}/{b_total}")
+
+print("PASS: sequence sync (grpc)")

@@ -1,0 +1,42 @@
+#!/usr/bin/env python3
+"""BYTES (string) tensor inference over gRPC: decimal-string add/sub through
+the 4-byte-length-prefixed BYTES codec.
+
+Reference counterpart: src/python/examples/simple_grpc_string_infer_client.py.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+from client_tpu.grpc import InferenceServerClient, InferInput
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8001")
+args = parser.parse_args()
+
+in0 = np.arange(16, dtype=np.int32)
+in1 = np.ones(16, dtype=np.int32)
+in0_str = np.array([str(x).encode() for x in in0],
+                   dtype=np.object_).reshape(1, 16)
+in1_str = np.array([str(x).encode() for x in in1],
+                   dtype=np.object_).reshape(1, 16)
+
+with InferenceServerClient(args.url) as client:
+    inputs = [InferInput("INPUT0", [1, 16], "BYTES"),
+              InferInput("INPUT1", [1, 16], "BYTES")]
+    inputs[0].set_data_from_numpy(in0_str)
+    inputs[1].set_data_from_numpy(in1_str)
+
+    result = client.infer("simple_string", inputs)
+
+    out0 = result.as_numpy("OUTPUT0").reshape(-1)
+    out1 = result.as_numpy("OUTPUT1").reshape(-1)
+    for i in range(16):
+        if int(out0[i]) != in0[i] + in1[i]:
+            sys.exit(f"error: bad sum at {i}: {out0[i]}")
+        if int(out1[i]) != in0[i] - in1[i]:
+            sys.exit(f"error: bad difference at {i}: {out1[i]}")
+
+print("PASS: string infer (grpc)")

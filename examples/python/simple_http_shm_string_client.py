@@ -1,0 +1,74 @@
+#!/usr/bin/env python3
+"""BYTES (string) tensors through system shared memory over HTTP.
+
+Reference counterpart: src/python/examples/simple_http_shm_string_client.py.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.utils.shared_memory as shm
+from client_tpu.http import InferenceServerClient, InferInput, \
+    InferRequestedOutput
+from client_tpu.utils import serialize_byte_tensor, serialized_byte_size
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8000")
+args = parser.parse_args()
+
+in0 = np.arange(16, dtype=np.int32)
+in1 = np.ones(16, dtype=np.int32)
+in0_str = np.array([str(x).encode() for x in in0], dtype=np.object_)
+in1_str = np.array([str(x).encode() for x in in1], dtype=np.object_)
+
+in0_ser = serialize_byte_tensor(in0_str)
+in1_ser = serialize_byte_tensor(in1_str)
+in0_size = serialized_byte_size(in0_ser)
+in1_size = serialized_byte_size(in1_ser)
+out_size = max(in0_size, in1_size) + 16
+
+with InferenceServerClient(args.url) as client:
+    client.unregister_system_shared_memory()
+
+    shm_ip = shm.create_shared_memory_region(
+        "input_data", "/py_http_shm_str_in", in0_size + in1_size)
+    shm.set_shared_memory_region(shm_ip, [in0_str])
+    shm.set_shared_memory_region(shm_ip, [in1_str], offset=in0_size)
+    shm_op0 = shm.create_shared_memory_region(
+        "output0_data", "/py_http_shm_str_out0", out_size)
+    shm_op1 = shm.create_shared_memory_region(
+        "output1_data", "/py_http_shm_str_out1", out_size)
+
+    client.register_system_shared_memory(
+        "input_data", "/py_http_shm_str_in", in0_size + in1_size)
+    client.register_system_shared_memory(
+        "output0_data", "/py_http_shm_str_out0", out_size)
+    client.register_system_shared_memory(
+        "output1_data", "/py_http_shm_str_out1", out_size)
+
+    inputs = [InferInput("INPUT0", [1, 16], "BYTES"),
+              InferInput("INPUT1", [1, 16], "BYTES")]
+    inputs[0].set_shared_memory("input_data", in0_size)
+    inputs[1].set_shared_memory("input_data", in1_size, offset=in0_size)
+    outputs = [InferRequestedOutput("OUTPUT0"),
+               InferRequestedOutput("OUTPUT1")]
+    outputs[0].set_shared_memory("output0_data", out_size)
+    outputs[1].set_shared_memory("output1_data", out_size)
+
+    client.infer("simple_string", inputs, outputs=outputs)
+
+    out0 = shm.get_contents_as_numpy(shm_op0, np.object_, [1, 16]).reshape(-1)
+    out1 = shm.get_contents_as_numpy(shm_op1, np.object_, [1, 16]).reshape(-1)
+    for i in range(16):
+        if int(out0[i]) != in0[i] + in1[i]:
+            sys.exit(f"error: bad sum at {i}: {out0[i]}")
+        if int(out1[i]) != in0[i] - in1[i]:
+            sys.exit(f"error: bad difference at {i}: {out1[i]}")
+
+    client.unregister_system_shared_memory()
+    for h in (shm_ip, shm_op0, shm_op1):
+        shm.destroy_shared_memory_region(h)
+
+print("PASS: shm string (http)")

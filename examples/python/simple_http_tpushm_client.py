@@ -1,0 +1,72 @@
+#!/usr/bin/env python3
+"""TPU shared-memory data plane over HTTP: device-resident I/O regions
+registered by base64-serialized buffer handle — the TPU-native replacement
+for the reference's CUDA-IPC flow over REST.
+
+Reference counterpart: src/python/examples/simple_http_cudashm_client.py
+(cudaMalloc -> cudaIpcGetMemHandle -> base64 handle -> register -> infer ->
+cudaMemcpy back; here the handle comes from tpu_shared_memory.get_raw_handle).
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.utils.tpu_shared_memory as tpushm
+from client_tpu.http import InferenceServerClient, InferInput, \
+    InferRequestedOutput
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8000")
+args = parser.parse_args()
+
+with InferenceServerClient(args.url) as client:
+    client.unregister_tpu_shared_memory()
+
+    input0_data = np.arange(16, dtype=np.int32)
+    input1_data = np.ones(16, dtype=np.int32)
+    byte_size = input0_data.nbytes
+
+    shm_ip0 = tpushm.create_shared_memory_region("input0_data", byte_size, 0)
+    shm_ip1 = tpushm.create_shared_memory_region("input1_data", byte_size, 0)
+    shm_op = tpushm.create_shared_memory_region("output_data", byte_size * 2,
+                                                0)
+    tpushm.set_shared_memory_region(shm_ip0, [input0_data])
+    tpushm.set_shared_memory_region(shm_ip1, [input1_data])
+
+    client.register_tpu_shared_memory(
+        "input0_data", tpushm.get_raw_handle(shm_ip0), 0, byte_size)
+    client.register_tpu_shared_memory(
+        "input1_data", tpushm.get_raw_handle(shm_ip1), 0, byte_size)
+    client.register_tpu_shared_memory(
+        "output_data", tpushm.get_raw_handle(shm_op), 0, byte_size * 2)
+
+    status = client.get_tpu_shared_memory_status()
+    if len(status.get("regions", status)) < 3:
+        sys.exit("error: regions missing from status")
+
+    inputs = [InferInput("INPUT0", [1, 16], "INT32"),
+              InferInput("INPUT1", [1, 16], "INT32")]
+    inputs[0].set_shared_memory("input0_data", byte_size)
+    inputs[1].set_shared_memory("input1_data", byte_size)
+    outputs = [InferRequestedOutput("OUTPUT0"),
+               InferRequestedOutput("OUTPUT1")]
+    outputs[0].set_shared_memory("output_data", byte_size)
+    outputs[1].set_shared_memory("output_data", byte_size, offset=byte_size)
+
+    client.infer("simple", inputs, outputs=outputs)
+
+    output0 = tpushm.get_contents_as_numpy(shm_op, np.int32, [1, 16])
+    output1 = tpushm.get_contents_as_numpy(shm_op, np.int32, [1, 16],
+                                           offset=byte_size)
+    if not np.array_equal(output0[0], input0_data + input1_data):
+        sys.exit("error: incorrect sum")
+    if not np.array_equal(output1[0], input0_data - input1_data):
+        sys.exit("error: incorrect difference")
+
+    client.unregister_tpu_shared_memory()
+    for h in (shm_ip0, shm_ip1, shm_op):
+        tpushm.destroy_shared_memory_region(h)
+
+print("PASS: tpu shared memory (http)")

@@ -1,0 +1,62 @@
+// Explicit model control over HTTP: unload then load a model, checking
+// readiness transitions and the repository index.
+//
+// Reference counterpart: simple_http_model_control.cc
+// (/root/reference/src/c++/examples/): LoadModel/UnloadModel/IsModelReady
+// against the `simple` model.
+#include <unistd.h>
+
+#include <iostream>
+#include <string>
+
+#include "tpuclient/http_client.h"
+
+namespace tc = tpuclient;
+
+#define FAIL_IF_ERR(X, MSG)                                          \
+  do {                                                               \
+    tc::Error err__ = (X);                                           \
+    if (!err__.IsOk()) {                                             \
+      std::cerr << "error: " << (MSG) << ": " << err__ << std::endl; \
+      exit(1);                                                       \
+    }                                                                \
+  } while (false)
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  std::string model = "simple";
+  int opt;
+  while ((opt = getopt(argc, argv, "u:m:")) != -1) {
+    if (opt == 'u') url = optarg;
+    if (opt == 'm') model = optarg;
+  }
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  FAIL_IF_ERR(tc::InferenceServerHttpClient::Create(&client, url),
+              "create client");
+
+  bool ready = false;
+  FAIL_IF_ERR(client->IsModelReady(&ready, model), "initial ready check");
+  if (!ready) FAIL_IF_ERR(client->LoadModel(model), "initial load");
+
+  FAIL_IF_ERR(client->UnloadModel(model), "unload");
+  FAIL_IF_ERR(client->IsModelReady(&ready, model), "ready after unload");
+  if (ready) {
+    std::cerr << "error: model still ready after unload" << std::endl;
+    return 1;
+  }
+
+  // The unloaded model must still appear in the repository index.
+  tc::JsonPtr index;
+  FAIL_IF_ERR(client->ModelRepositoryIndex(&index), "repository index");
+
+  FAIL_IF_ERR(client->LoadModel(model), "load");
+  FAIL_IF_ERR(client->IsModelReady(&ready, model), "ready after load");
+  if (!ready) {
+    std::cerr << "error: model not ready after load" << std::endl;
+    return 1;
+  }
+
+  std::cout << "PASS : simple_http_model_control" << std::endl;
+  return 0;
+}

@@ -1,0 +1,143 @@
+// TPU shared-memory data-plane conformance client over HTTP — the REST
+// flavor of the north-star zero-copy path.
+//
+// Reference counterpart: simple_http_cudashm_client.cc
+// (/root/reference/src/c++/examples/): there, cudaMalloc →
+// cudaIpcGetMemHandle → base64 handle → RegisterCudaSharedMemory → infer →
+// cudaMemcpy back. Here the handle is the framework's opaque TPU region
+// descriptor (host-staged flavor), base64-encoded by the client for REST
+// transport exactly as the reference encodes cudaIpcMemHandle_t. Tensor
+// bytes never ride the HTTP request/response.
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <iostream>
+
+#include "tpuclient/http_client.h"
+#include "tpuclient/shm_utils.h"
+
+namespace tc = tpuclient;
+
+#define FAIL_IF_ERR(X, MSG)                                          \
+  do {                                                               \
+    tc::Error err__ = (X);                                           \
+    if (!err__.IsOk()) {                                             \
+      std::cerr << "error: " << (MSG) << ": " << err__ << std::endl; \
+      exit(1);                                                       \
+    }                                                                \
+  } while (false)
+
+// Opaque TPU region handle: the host-staged JSON descriptor the server's
+// tpu_shared_memory registry understands (client_tpu/engine/shm.py
+// register_handle's host_staged schema).
+static std::string MakeTpuHandle(const std::string& key, size_t byte_size) {
+  return std::string("{\"kind\": \"host_staged\", \"key\": \"") + key +
+         "\", \"byte_size\": " + std::to_string(byte_size) + "}";
+}
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  int opt;
+  while ((opt = getopt(argc, argv, "u:")) != -1)
+    if (opt == 'u') url = optarg;
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  FAIL_IF_ERR(tc::InferenceServerHttpClient::Create(&client, url),
+              "create client");
+
+  constexpr size_t kTensorBytes = 16 * sizeof(int32_t);
+  const char* input_key = "/simple_http_tpushm_input";
+  const char* output_key = "/simple_http_tpushm_output";
+
+  client->UnregisterTpuSharedMemory();  // fresh slate, ignore errors
+  tc::UnlinkSharedMemoryRegion(input_key);
+  tc::UnlinkSharedMemoryRegion(output_key);
+
+  int input_fd, output_fd;
+  void *input_addr, *output_addr;
+  FAIL_IF_ERR(tc::CreateSharedMemoryRegion(input_key, 2 * kTensorBytes,
+                                           &input_fd),
+              "create input region");
+  FAIL_IF_ERR(tc::MapSharedMemory(input_fd, 0, 2 * kTensorBytes, &input_addr),
+              "map input region");
+  FAIL_IF_ERR(tc::CreateSharedMemoryRegion(output_key, 2 * kTensorBytes,
+                                           &output_fd),
+              "create output region");
+  FAIL_IF_ERR(tc::MapSharedMemory(output_fd, 0, 2 * kTensorBytes,
+                                  &output_addr),
+              "map output region");
+
+  int32_t* input0_stage = reinterpret_cast<int32_t*>(input_addr);
+  int32_t* input1_stage = input0_stage + 16;
+  for (int i = 0; i < 16; ++i) {
+    input0_stage[i] = i;
+    input1_stage[i] = 7;
+  }
+
+  FAIL_IF_ERR(client->RegisterTpuSharedMemory(
+                  "tpu_input_data", MakeTpuHandle(input_key, 2 * kTensorBytes),
+                  2 * kTensorBytes, /*device_id=*/0),
+              "register input region");
+  FAIL_IF_ERR(
+      client->RegisterTpuSharedMemory(
+          "tpu_output_data", MakeTpuHandle(output_key, 2 * kTensorBytes),
+          2 * kTensorBytes, /*device_id=*/0),
+      "register output region");
+
+  tc::JsonPtr status;
+  FAIL_IF_ERR(client->TpuSharedMemoryStatus(&status), "tpushm status");
+
+  tc::InferInput *input0, *input1;
+  tc::InferInput::Create(&input0, "INPUT0", {1, 16}, "INT32");
+  tc::InferInput::Create(&input1, "INPUT1", {1, 16}, "INT32");
+  std::unique_ptr<tc::InferInput> i0(input0), i1(input1);
+  FAIL_IF_ERR(input0->SetSharedMemory("tpu_input_data", kTensorBytes, 0),
+              "INPUT0 shm");
+  FAIL_IF_ERR(input1->SetSharedMemory("tpu_input_data", kTensorBytes,
+                                      kTensorBytes),
+              "INPUT1 shm");
+
+  tc::InferRequestedOutput *output0, *output1;
+  tc::InferRequestedOutput::Create(&output0, "OUTPUT0");
+  tc::InferRequestedOutput::Create(&output1, "OUTPUT1");
+  std::unique_ptr<tc::InferRequestedOutput> o0(output0), o1(output1);
+  FAIL_IF_ERR(output0->SetSharedMemory("tpu_output_data", kTensorBytes, 0),
+              "OUTPUT0 shm");
+  FAIL_IF_ERR(output1->SetSharedMemory("tpu_output_data", kTensorBytes,
+                                       kTensorBytes),
+              "OUTPUT1 shm");
+
+  tc::InferOptions options("simple");
+  tc::InferResult* result;
+  FAIL_IF_ERR(client->Infer(&result, options, {input0, input1},
+                            {output0, output1}),
+              "infer");
+  std::unique_ptr<tc::InferResult> owner(result);
+  FAIL_IF_ERR(result->RequestStatus(), "request status");
+
+  const int32_t* out0 = reinterpret_cast<const int32_t*>(output_addr);
+  const int32_t* out1 = out0 + 16;
+  for (int i = 0; i < 16; ++i) {
+    if (out0[i] != input0_stage[i] + input1_stage[i] ||
+        out1[i] != input0_stage[i] - input1_stage[i]) {
+      std::cerr << "error: tpushm output mismatch at " << i << ": "
+                << out0[i] << "/" << out1[i] << std::endl;
+      return 1;
+    }
+  }
+
+  FAIL_IF_ERR(client->UnregisterTpuSharedMemory("tpu_input_data"),
+              "unregister input");
+  FAIL_IF_ERR(client->UnregisterTpuSharedMemory("tpu_output_data"),
+              "unregister output");
+  tc::UnmapSharedMemory(input_addr, 2 * kTensorBytes);
+  tc::UnmapSharedMemory(output_addr, 2 * kTensorBytes);
+  tc::CloseSharedMemory(input_fd);
+  tc::CloseSharedMemory(output_fd);
+  tc::UnlinkSharedMemoryRegion(input_key);
+  tc::UnlinkSharedMemoryRegion(output_key);
+
+  std::cout << "PASS : simple_http_tpushm_client" << std::endl;
+  return 0;
+}

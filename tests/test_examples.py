@@ -56,17 +56,26 @@ def run_example(script, servers, extra=None):
     "simple_http_async_infer_client.py",
     "simple_grpc_async_infer_client.py",
     "simple_http_string_infer_client.py",
+    "simple_grpc_string_infer_client.py",
     "simple_http_shm_client.py",
     "simple_grpc_shm_client.py",
+    "simple_http_shm_string_client.py",
+    "simple_grpc_shm_string_client.py",
     "simple_grpc_tpushm_client.py",
+    "simple_http_tpushm_client.py",
+    "grpc_client.py",
     "grpc_explicit_int_content_client.py",
     "grpc_explicit_int8_content_client.py",
     "grpc_explicit_byte_content_client.py",
     "simple_http_sequence_sync_client.py",
+    "simple_grpc_sequence_sync_client.py",
     "simple_grpc_sequence_stream_client.py",
     "simple_grpc_custom_repeat_client.py",
+    "simple_grpc_keepalive_client.py",
     "simple_http_health_metadata.py",
+    "simple_grpc_health_metadata.py",
     "simple_http_model_control.py",
+    "simple_grpc_model_control.py",
 ])
 def test_simple_example(servers, script):
     run_example(script, servers)
@@ -74,6 +83,12 @@ def test_simple_example(servers, script):
 
 def test_image_client(servers):
     out = run_example("image_client.py", servers,
+                      extra=["--synthetic", "-c", "3"])
+    assert "image 0:" in out
+
+
+def test_grpc_image_client_raw_stub(servers):
+    out = run_example("grpc_image_client.py", servers,
                       extra=["--synthetic", "-c", "3"])
     assert "image 0:" in out
 

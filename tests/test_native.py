@@ -24,6 +24,8 @@ EXAMPLES = [
     "simple_http_shm_client",
     "simple_http_sequence_client",
     "simple_http_health_metadata",
+    "simple_http_model_control",
+    "simple_http_tpushm_client",
 ]
 
 # gRPC conformance clients: the in-tree C++ HTTP/2+HPACK transport driven
@@ -34,6 +36,7 @@ GRPC_EXAMPLES = [
     "simple_grpc_string_infer_client",
     "simple_grpc_shm_client",
     "simple_grpc_tpushm_client",
+    "simple_grpc_sequence_sync_client",
     "simple_grpc_sequence_stream_client",
     "simple_grpc_custom_repeat_client",
     "simple_grpc_health_metadata",
