@@ -470,9 +470,15 @@ int main(int argc, char** argv) {
   InferenceProfiler profiler(popts, parser, std::move(stats_backend),
                              manager.get());
 
+  const char* kind_name =
+      args.kind == BackendKind::TPU_GRPC            ? "grpc"
+      : args.kind == BackendKind::TPU_CAPI          ? "in-process C API"
+      : args.kind == BackendKind::TENSORFLOW_SERVING ? "tfserving (grpc)"
+      : args.kind == BackendKind::TORCHSERVE        ? "torchserve (http)"
+                                                    : "http";
   printf("*** Measurement Settings ***\n");
   printf("  Model: %s, batch size: %d, protocol: %s, mode: %s\n",
-         args.model.c_str(), args.batch_size, args.protocol.c_str(),
+         args.model.c_str(), args.batch_size, kind_name,
          args.async ? "async" : "sync");
   printf("  Window: %lu ms (%s), stability: %.0f%%, max trials: %zu\n\n",
          static_cast<unsigned long>(args.window_ms),
