@@ -98,6 +98,12 @@ class SequenceBatchingConfig:
     # mirrors Triton's two sequence-batcher strategies.
     strategy: str = "direct"
     max_sequence_idle_microseconds: int = 1_000_000_000
+    # 'oldest' strategy knobs (Triton oldest.max_candidate_sequences /
+    # oldest.max_queue_delay_microseconds): arena capacity for concurrently
+    # live sequences, and how long a forming step batch waits for more
+    # candidates.
+    max_candidate_sequences: int = 64
+    max_queue_delay_microseconds: int = 1000
 
 
 @dataclass
@@ -175,10 +181,17 @@ class ModelConfig:
         if "sequence_batching" in d:
             raw = d["sequence_batching"] or {}
             strategy = "oldest" if "oldest" in raw else raw.get("strategy", "direct")
+            oldest = raw.get("oldest") or {}
             sb = SequenceBatchingConfig(
                 strategy=strategy,
                 max_sequence_idle_microseconds=int(
                     raw.get("max_sequence_idle_microseconds", 1_000_000_000)),
+                max_candidate_sequences=int(
+                    oldest.get("max_candidate_sequences",
+                               raw.get("max_candidate_sequences", 64))),
+                max_queue_delay_microseconds=int(
+                    oldest.get("max_queue_delay_microseconds",
+                               raw.get("max_queue_delay_microseconds", 1000))),
             )
         steps = []
         ens = d.get("ensemble_scheduling")

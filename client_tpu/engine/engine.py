@@ -131,11 +131,11 @@ class TpuEngine:
                 stats = ModelStats(name, str(model.config.version))
                 self._stats[name] = stats
             from client_tpu.engine.ensemble import EnsembleScheduler
-            from client_tpu.engine.sequence import SequenceScheduler
+            from client_tpu.engine.sequence import make_sequence_scheduler
 
             self._schedulers[name] = make_scheduler(
                 model, stats,
-                sequence_cls=SequenceScheduler,
+                sequence_cls=make_sequence_scheduler,
                 ensemble_cls=EnsembleScheduler,
                 engine=self,
             )

@@ -106,6 +106,10 @@ class Scheduler:
     # scheduler; decoupled streams and sequence slots have their own ordering
     # contracts (Triton likewise scopes it to the dynamic batcher).
     supports_preserve_ordering = False
+    # Schedulers that own exclusive mutable state (the oldest-sequence
+    # batcher's HBM arena) run exactly one worker regardless of
+    # instance_count — their parallelism comes from batching.
+    single_instance = False
 
     def __init__(self, model: Model, stats: ModelStats):
         self.model = model
@@ -132,7 +136,7 @@ class Scheduler:
         self._release_seq = 0        # next sequence allowed to respond
         self._held: dict[int, tuple] = {}  # seq -> (req, resp)
         self._draining = False       # one thread flushes ready runs at a time
-        n = max(1, model.config.instance_count)
+        n = 1 if self.single_instance else max(1, model.config.instance_count)
         for i in range(n):
             t = threading.Thread(
                 target=self._worker_loop,

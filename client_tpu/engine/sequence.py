@@ -13,21 +13,23 @@ serialized execution lane (a per-sequence lock), mirroring the reference's
 1-context-per-sequence concurrency rule
 (concurrency_manager.cc:148-152, 302-335).
 
-Strategy note: configs may declare the 'oldest' strategy (Triton's
-oldest-sequence batcher) and it is accepted and correctness-equivalent
-here — per-sequence ordering and state routing are identical — but steps
-currently execute per sequence rather than cross-sequence batched; stacking
-live sequences' states into one batched [B, ...] pytree step is the pending
-throughput optimization for many-concurrent-sequence workloads.
+The 'oldest' strategy (Triton's oldest-sequence batcher) batches steps of
+*different* live sequences into one XLA execution: sequence states live in a
+fixed-capacity HBM **arena** (one pytree with leading dim = capacity + 1
+dummy row), and a single jitted program gathers the batch's rows, applies
+the vmapped step, and scatters the new states back — so N concurrent
+sequences cost one device round trip per step wave instead of N
+(:class:`OldestSequenceScheduler`).
 """
 
 from __future__ import annotations
 
+import queue as _queue
 import threading
 
 import numpy as np
 
-from client_tpu.engine.scheduler import Scheduler, _SHUTDOWN
+from client_tpu.engine.scheduler import Scheduler, _SHUTDOWN, _SHUTDOWN_LEVEL
 from client_tpu.engine.types import (
     EngineError,
     InferRequest,
@@ -126,3 +128,284 @@ class SequenceScheduler(Scheduler):
     def active_sequences(self) -> int:
         with self._slots_lock:
             return len(self._slots)
+
+
+class OldestSequenceScheduler(Scheduler):
+    """Triton's OLDEST sequence-batcher strategy, TPU-first.
+
+    Design: sequence state is a fixed-capacity arena pytree in HBM
+    (leading dim ``max_candidate_sequences`` + 1; the extra row absorbs
+    padded lanes so masked scatters never touch a live sequence). One
+    jitted executable per batch bucket does gather(rows) → where(reset,
+    initial_state, state) → vmap(apply) → scatter(rows), with the arena
+    donated (``donate_argnums``) so state updates happen in place. A step
+    wave over N live sequences is ONE device round trip; the reference's
+    direct strategy (and ours, above) pays one per sequence.
+    """
+
+    single_instance = True  # one worker owns the arena; batching, not
+    # instance replication, provides the parallelism here.
+
+    def __init__(self, model, stats):
+        import jax
+        import jax.numpy as jnp
+
+        self._jax = jax
+        sb = model.config.sequence_batching
+        self._cap = max(1, sb.max_candidate_sequences)
+        self._delay_ns = sb.max_queue_delay_microseconds * 1000
+        init = jax.tree.map(np.asarray, model.backend.initial_state())
+        self._arena = jax.tree.map(
+            lambda x: jnp.zeros((self._cap + 1,) + x.shape, dtype=x.dtype),
+            init)
+        init_dev = jax.tree.map(jnp.asarray, init)
+        vapply = jax.vmap(model.backend.make_apply())
+
+        def step(arena, rows, reset, inputs):
+            state_in = jax.tree.map(lambda a: a[rows], arena)
+
+            def pick(s, i0):
+                r = reset.reshape((-1,) + (1,) * (s.ndim - 1))
+                return jnp.where(r, jnp.broadcast_to(i0, s.shape), s)
+
+            state_in = jax.tree.map(pick, state_in, init_dev)
+            new_state, outputs = vapply(state_in, inputs)
+            arena = jax.tree.map(lambda a, ns: a.at[rows].set(ns),
+                                 arena, new_state)
+            return arena, outputs
+
+        self._step = jax.jit(step, donate_argnums=(0,))
+        self._buckets = []
+        b = 1
+        while b < self._cap:
+            self._buckets.append(b)
+            b *= 2
+        self._buckets.append(self._cap)
+        self._free = list(range(self._cap))
+        self._rows: dict[int, int] = {}       # sequence_id -> arena row
+        self._last_used: dict[int, int] = {}  # sequence_id -> ns
+        self._arena_lock = threading.Lock()
+        self._compiled_buckets: set[int] = set()
+        super().__init__(model, stats)
+
+    # -- slot management -----------------------------------------------------
+
+    def _acquire_row(self, req: InferRequest) -> tuple[int, bool]:
+        """Returns (arena row, reset-state?) for the request's sequence."""
+        sid = req.sequence_id
+        if sid == 0:
+            raise EngineError(
+                f"model '{self.model.config.name}' uses sequence batching; "
+                "requests must carry a non-zero sequence id", 400)
+        with self._arena_lock:
+            row = self._rows.get(sid)
+            if row is None:
+                if not req.sequence_start:
+                    raise EngineError(
+                        f"sequence {sid}: request without start flag for an "
+                        "inactive sequence", 400)
+                self._gc_idle_locked()
+                if not self._free:
+                    raise EngineError(
+                        f"max candidate sequences "
+                        f"({self._cap}) exceeded", 429)
+                row = self._free.pop()
+                self._rows[sid] = row
+            self._last_used[sid] = now_ns()
+            return row, bool(req.sequence_start)
+
+    def _release_row(self, sid: int) -> None:
+        with self._arena_lock:
+            row = self._rows.pop(sid, None)
+            self._last_used.pop(sid, None)
+            if row is not None:
+                self._free.append(row)
+
+    def _gc_idle_locked(self) -> None:
+        sb = self.model.config.sequence_batching
+        cutoff = now_ns() - sb.max_sequence_idle_microseconds * 1000
+        dead = [sid for sid, ts in self._last_used.items() if ts < cutoff]
+        for sid in dead:
+            row = self._rows.pop(sid, None)
+            self._last_used.pop(sid, None)
+            if row is not None:
+                self._free.append(row)
+
+    # -- scheduling ----------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            item = self.queue.get()
+            if item is _SHUTDOWN:
+                return
+            req: InferRequest = item
+            if self._check_timeout(req):
+                continue
+            batch = self._gather_candidates(req)
+            try:
+                self._execute_wave(batch)
+            except EngineError as exc:
+                for r in batch:
+                    self._fail(r, exc)
+            except Exception as exc:  # noqa: BLE001 — isolate worker
+                for r in batch:
+                    self._fail(r, exc)
+
+    def _gather_candidates(self, first: InferRequest) -> list[InferRequest]:
+        """Collect one queued request per *distinct* live-or-starting
+        sequence (a second request of a sequence already in the wave goes
+        back to the queue head: per-sequence order is step order)."""
+        deadline = now_ns() + self._delay_ns
+        batch = [first]
+        seen = {first.sequence_id}
+        pushback: list[InferRequest] = []
+        while len(batch) < self._cap:
+            timeout = max((deadline - now_ns()) / 1e9, 0.0)
+            try:
+                items = self.queue.get_many(self._cap - len(batch),
+                                            timeout=timeout)
+            except _queue.Empty:
+                break
+            stop = False
+            for i, item in enumerate(items):
+                if item is _SHUTDOWN:
+                    for _ in items[i:]:
+                        self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)
+                    stop = True
+                    break
+                nxt: InferRequest = item
+                if self._check_timeout(nxt):
+                    continue
+                if nxt.sequence_id in seen or not _same_signature(first, nxt):
+                    pushback.append(nxt)
+                    continue
+                seen.add(nxt.sequence_id)
+                batch.append(nxt)
+            if stop:
+                break
+        for later in reversed(pushback):
+            self.queue.put_front(later, self._priority_level(later))
+        return batch
+
+    def _execute_wave(self, batch: list[InferRequest]) -> None:
+        start = now_ns()
+        rows, resets, live = [], [], []
+        for r in batch:
+            r.times.compute_start = start
+            try:
+                row, reset = self._acquire_row(r)
+            except EngineError as exc:
+                self._fail(r, exc)
+                continue
+            rows.append(row)
+            resets.append(reset)
+            live.append(r)
+        if not live:
+            return
+        bucket = next(b for b in self._buckets if b >= len(live))
+        pad = bucket - len(live)
+        rows += [self._cap] * pad      # dummy row absorbs padded lanes
+        resets += [True] * pad
+        inputs = {}
+        for name in live[0].inputs:
+            arrs = [r.inputs[name] for r in live]
+            arrs += [np.zeros_like(arrs[0])] * pad
+            inputs[name] = np.stack(arrs)
+        t_stacked = now_ns()
+
+        first = bucket not in self._compiled_buckets
+        self.model._set_state(
+            f"compiling oldest-batch step (bucket={bucket}, first call)"
+            if first else f"executing oldest-batch step (bucket={bucket})")
+        try:
+            self._arena, outputs = self._step(
+                self._arena, np.asarray(rows, np.int32),
+                np.asarray(resets), inputs)
+            for val in outputs.values():
+                if isinstance(val, self._jax.Array):
+                    val.copy_to_host_async()
+            host = {name: np.asarray(val) for name, val in outputs.items()}
+        except Exception:
+            # The step donates the arena (donate_argnums), so a failed
+            # execution may have invalidated the old buffers: rebuild a
+            # fresh arena and drop every live sequence rather than serving
+            # from a deleted array forever. Affected sequences must restart
+            # (their next request without a start flag gets a 400).
+            import logging
+
+            logging.getLogger("client_tpu").exception(
+                "model '%s': oldest-batch step failed; resetting sequence "
+                "arena (%d live sequences dropped)",
+                self.model.config.name, len(self._rows))
+            import jax.numpy as jnp
+
+            with self._arena_lock:
+                self._arena = self._jax.tree.map(
+                    lambda a: jnp.zeros(a.shape, a.dtype), self._arena)
+                self._rows.clear()
+                self._last_used.clear()
+                self._free = list(range(self._cap))
+            raise
+        finally:
+            self.model._clear_state()
+        if first:
+            self._compiled_buckets.add(bucket)
+        t_done = now_ns()
+
+        self.stats.record_execution(len(live))
+        for i, r in enumerate(live):
+            if r.sequence_end:
+                self._release_row(r.sequence_id)
+            outs = {k: v[i] for k, v in host.items()}
+            if r.outputs:
+                requested = {o.name for o in r.outputs}
+                outs = {k: v for k, v in outs.items() if k in requested}
+            r.times.compute_input_end = t_stacked
+            r.times.compute_infer_end = t_done
+            r.times.compute_output_end = now_ns()
+            self.stats.record_request(r.times, success=True)
+            self._respond(r, InferResponse(
+                model_name=r.model_name,
+                model_version=r.model_version or
+                str(self.model.config.version),
+                request_id=r.request_id,
+                outputs=outs,
+                times=r.times,
+            ))
+
+    def active_sequences(self) -> int:
+        with self._arena_lock:
+            return len(self._rows)
+
+
+def _same_signature(a: InferRequest, b: InferRequest) -> bool:
+    """Steppable in one wave: same input names, shapes, and dtypes."""
+    if a.inputs.keys() != b.inputs.keys():
+        return False
+    for name in a.inputs:
+        x, y = a.inputs[name], b.inputs[name]
+        if x.shape != y.shape or x.dtype != y.dtype:
+            return False
+    return True
+
+
+def make_sequence_scheduler(model, stats) -> Scheduler:
+    """Strategy dispatch: 'oldest' gets the arena batcher when the model is
+    jittable (pure-JAX step, no BYTES state I/O); everything else — and the
+    'direct' strategy — uses the slot-pinned scheduler above."""
+    sb = model.config.sequence_batching
+    jittable = getattr(model.backend, "jittable", True)
+    has_bytes = any(t.data_type == "BYTES"
+                    for t in model.config.input + model.config.output)
+    if sb is not None and sb.strategy == "oldest":
+        if jittable and not has_bytes:
+            return OldestSequenceScheduler(model, stats)
+        import logging
+
+        logging.getLogger("client_tpu").warning(
+            "model '%s': sequence strategy 'oldest' requested but the step "
+            "is not arena-batchable (%s); falling back to the direct "
+            "scheduler (no max_candidate_sequences cap, per-sequence "
+            "executions)", model.config.name,
+            "BYTES tensors" if has_bytes else "non-jittable backend")
+    return SequenceScheduler(model, stats)

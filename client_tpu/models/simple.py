@@ -124,16 +124,24 @@ class IdentityBackend(ModelBackend):
 
 class SequenceAccumulateBackend(ModelBackend):
     """Stateful accumulator (`simple_sequence` semantics): OUTPUT = running
-    sum of INPUT across the sequence. State = INT32[1] pytree in HBM."""
+    sum of INPUT across the sequence. State = INT32[1] pytree in HBM.
 
-    def __init__(self, name: str = "simple_sequence"):
+    ``strategy="oldest"`` serves the same model through the arena-batched
+    oldest-sequence scheduler (steps of distinct sequences share one XLA
+    execution; see engine/sequence.py OldestSequenceScheduler)."""
+
+    def __init__(self, name: str = "simple_sequence",
+                 strategy: str = "direct",
+                 max_candidate_sequences: int = 64):
         self.config = ModelConfig(
             name=name,
             platform="jax",
             max_batch_size=0,  # sequence requests are shape [1]
             input=[TensorConfig("INPUT", "INT32", [1])],
             output=[TensorConfig("OUTPUT", "INT32", [1])],
-            sequence_batching=SequenceBatchingConfig(strategy="direct"),
+            sequence_batching=SequenceBatchingConfig(
+                strategy=strategy,
+                max_candidate_sequences=max_candidate_sequences),
         )
 
     def initial_state(self):
@@ -195,6 +203,9 @@ register_model("simple")(AddSubBackend)
 register_model("simple_string")(StringAddSubBackend)
 register_model("simple_identity")(IdentityBackend)
 register_model("simple_sequence")(SequenceAccumulateBackend)
+register_model("simple_sequence_oldest")(
+    lambda: SequenceAccumulateBackend(name="simple_sequence_oldest",
+                                      strategy="oldest"))
 # INT8 add/sub variant (reference simple_int8 model, exercised by the
 # explicit-content raw-stub clients).
 register_model("simple_int8")(

@@ -625,3 +625,171 @@ class TestBatchGather:
                 lambda resp: None)
         engine.shutdown()
         assert not any(t.is_alive() for t in sched.workers)
+
+
+class TestOldestSequenceBatcher:
+    """OldestSequenceScheduler: arena-batched cross-sequence steps match the
+    direct strategy's per-sequence semantics exactly."""
+
+    @pytest.fixture()
+    def oldest_engine(self):
+        eng = TpuEngine(build_repository(["simple_sequence_oldest"]))
+        yield eng
+        eng.shutdown()
+
+    @staticmethod
+    def _step(engine, sid, value, start=False, end=False):
+        resp = engine.infer(
+            InferRequest(model_name="simple_sequence_oldest",
+                         inputs={"INPUT": np.array([value], np.int32)},
+                         sequence_id=sid, sequence_start=start,
+                         sequence_end=end),
+            timeout_s=60)
+        return int(resp.outputs["OUTPUT"][0])
+
+    def test_scheduler_selected(self, oldest_engine):
+        from client_tpu.engine.sequence import OldestSequenceScheduler
+
+        sched = oldest_engine._schedulers["simple_sequence_oldest"]
+        assert isinstance(sched, OldestSequenceScheduler)
+        assert len(sched.workers) == 1  # single arena owner
+
+    def test_accumulates_in_order(self, oldest_engine):
+        assert self._step(oldest_engine, 1, 5, start=True) == 5
+        assert self._step(oldest_engine, 1, 7) == 12
+        assert self._step(oldest_engine, 1, 3, end=True) == 15
+
+    def test_many_concurrent_sequences_batch_into_waves(self):
+        """64 sequences x 3 steps each: values must accumulate per sequence
+        while the engine batches steps of distinct sequences into shared
+        executions (execution stat count << request count). A generous
+        50 ms candidate window makes wave formation robust to slow CI
+        thread scheduling."""
+        from client_tpu.engine.repository import ModelRepository
+        from client_tpu.models.simple import SequenceAccumulateBackend
+
+        backend = SequenceAccumulateBackend(name="waves", strategy="oldest")
+        backend.config.sequence_batching.max_queue_delay_microseconds = 50_000
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        engine = TpuEngine(repo)
+        n_seq, n_steps = 64, 3
+        errs = []
+
+        def step(sid, v, **kw):
+            return int(engine.infer(
+                InferRequest(model_name="waves",
+                             inputs={"INPUT": np.array([v], np.int32)},
+                             sequence_id=sid, **kw),
+                timeout_s=60).outputs["OUTPUT"][0])
+
+        def run_sequence(sid):
+            try:
+                total = 0
+                for s in range(n_steps):
+                    total += sid + s
+                    got = step(sid, sid + s, sequence_start=(s == 0),
+                               sequence_end=(s == n_steps - 1))
+                    if got != total:
+                        errs.append((sid, s, got, total))
+            except Exception as exc:  # noqa: BLE001
+                errs.append((sid, repr(exc)))
+
+        try:
+            threads = [threading.Thread(target=run_sequence, args=(sid,))
+                       for sid in range(1, n_seq + 1)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs, errs[:5]
+            stats = engine.model_statistics("waves")["model_stats"][0]
+            assert stats["inference_count"] == n_seq * n_steps
+            # Cross-sequence batching: far fewer executions than requests.
+            assert stats["execution_count"] < n_seq * n_steps / 2
+        finally:
+            engine.shutdown()
+
+    def test_inactive_sequence_without_start_rejected(self, oldest_engine):
+        with pytest.raises(EngineError) as ei:
+            self._step(oldest_engine, 777, 1)  # no start flag, not active
+        assert ei.value.status == 400
+
+    def test_zero_sequence_id_rejected(self, oldest_engine):
+        with pytest.raises(EngineError) as ei:
+            self._step(oldest_engine, 0, 1, start=True)
+        assert ei.value.status == 400
+
+    def test_capacity_exhaustion_429_and_end_frees_rows(self):
+        from client_tpu.engine.repository import ModelRepository
+        from client_tpu.models.simple import SequenceAccumulateBackend
+
+        backend = SequenceAccumulateBackend(
+            name="tiny_oldest", strategy="oldest", max_candidate_sequences=2)
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        eng = TpuEngine(repo)
+        try:
+            def step(sid, v, **kw):
+                return int(eng.infer(
+                    InferRequest(model_name="tiny_oldest",
+                                 inputs={"INPUT": np.array([v], np.int32)},
+                                 sequence_id=sid, **kw),
+                    timeout_s=60).outputs["OUTPUT"][0])
+
+            assert step(1, 1, sequence_start=True) == 1
+            assert step(2, 2, sequence_start=True) == 2
+            with pytest.raises(EngineError) as ei:
+                step(3, 3, sequence_start=True)
+            assert ei.value.status == 429
+            # Ending a sequence frees its arena row for a new one.
+            assert step(1, 9, sequence_end=True) == 10
+            assert step(3, 3, sequence_start=True) == 3
+        finally:
+            eng.shutdown()
+
+    def test_restart_resets_state(self, oldest_engine):
+        assert self._step(oldest_engine, 55, 4, start=True) == 4
+        # start flag on a live sequence restarts it (state reset)
+        assert self._step(oldest_engine, 55, 10, start=True) == 10
+        assert self._step(oldest_engine, 55, 1, end=True) == 11
+
+    def test_failed_wave_resets_arena_and_keeps_serving(self):
+        """A raising step execution must not brick the scheduler: the
+        donated arena is rebuilt and new sequences serve normally (live
+        ones are dropped and must restart)."""
+        from client_tpu.engine.repository import ModelRepository
+        from client_tpu.models.simple import SequenceAccumulateBackend
+
+        backend = SequenceAccumulateBackend(name="reset", strategy="oldest")
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        engine = TpuEngine(repo)
+        try:
+            def step(sid, v, **kw):
+                return int(engine.infer(
+                    InferRequest(model_name="reset",
+                                 inputs={"INPUT": np.array([v], np.int32)},
+                                 sequence_id=sid, **kw),
+                    timeout_s=60).outputs["OUTPUT"][0])
+
+            assert step(1, 5, sequence_start=True) == 5
+            sched = engine._schedulers["reset"]
+            real_step = sched._step
+
+            def boom(*a, **kw):
+                sched._step = real_step  # fail exactly once
+                raise RuntimeError("injected device failure")
+
+            sched._step = boom
+            with pytest.raises(EngineError):
+                step(1, 1)
+            # Live sequences were dropped with the arena...
+            with pytest.raises(EngineError) as ei:
+                step(1, 1)  # no start flag -> inactive
+            assert ei.value.status == 400
+            # ...but the scheduler still serves fresh sequences.
+            assert step(2, 3, sequence_start=True) == 3
+            assert step(2, 4, sequence_end=True) == 7
+        finally:
+            engine.shutdown()
