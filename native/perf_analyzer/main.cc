@@ -11,6 +11,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <fstream>
+#include <set>
 #include <sstream>
 
 #include "inference_profiler.h"
@@ -193,6 +194,38 @@ void WriteCsv(const Args& args, const std::vector<PerfStatus>& results) {
       << c.avg_latency_ns / 1000 << "\n";
   }
   printf("CSV written to %s\n", args.csv_path.c_str());
+
+  // Ensembles additionally get one CSV per composing model with the
+  // server-side phase breakdown (the reference writes `<path>.<model>`
+  // files for composing models, main.cc:1503-1668).
+  std::set<std::string> composing_names;
+  for (const auto& st : results)
+    for (const auto& kv : st.server_stats.composing)
+      composing_names.insert(kv.first);
+  for (const auto& name : composing_names) {
+    std::string path = args.csv_path + "." + name;
+    std::ofstream cf(path);
+    if (!cf.good()) {
+      fprintf(stderr, "cannot write CSV to %s\n", path.c_str());
+      continue;
+    }
+    cf << "Concurrency,Request Rate,Inference Count,Execution Count,"
+       << "Server Queue,Server Compute Input,Server Compute Infer,"
+       << "Server Compute Output\n";
+    for (const auto& st : results) {
+      auto it = st.server_stats.composing.find(name);
+      if (it == st.server_stats.composing.end()) continue;
+      const auto& s = it->second;
+      uint64_t n = std::max<uint64_t>(1, s.success_count);
+      cf << st.concurrency << "," << st.request_rate << ","
+         << s.success_count << "," << s.execution_count << ","
+         << s.queue_time_ns / 1000 / n << ","
+         << s.compute_input_time_ns / 1000 / n << ","
+         << s.compute_infer_time_ns / 1000 / n << ","
+         << s.compute_output_time_ns / 1000 / n << "\n";
+    }
+    printf("CSV written to %s\n", path.c_str());
+  }
 }
 
 }  // namespace

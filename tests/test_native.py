@@ -595,3 +595,31 @@ def test_perf_analyzer_warmup_flag(native_build, server, tmp_path):
     lines = csv.read_text().strip().splitlines()
     header, row = lines[0].split(","), lines[1].split(",")
     assert float(row[header.index("Inferences/Second")]) > 0
+
+
+def test_perf_analyzer_ensemble_composing_csv(native_build, tmp_path):
+    """Ensemble sweeps export one CSV per composing model with the
+    server-side phase breakdown (reference main.cc:1503-1668 writes
+    `<path>.<model>` files)."""
+    csv = tmp_path / "ens.csv"
+    env = dict(os.environ, CLIENT_TPU_PLATFORM="cpu")
+    proc = subprocess.run(
+        [os.path.join(native_build, "tpu_perf_analyzer"),
+         "-m", "ensemble_image",
+         "--capi-models", "ensemble_image,image_preprocess,resnet50",
+         "--service-kind", "tpu_capi",
+         "--capi-library-path", os.path.join(native_build, "libtpuserver.so"),
+         "--capi-repo-root", os.path.join(NATIVE, ".."),
+         "--shape", "RAW_IMAGE:256,256,3",
+         "-p", "800", "-r", "4", "-s", "90",
+         "--concurrency-range", "2:2", "-f", str(csv)],
+        capture_output=True, text=True, timeout=400, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Composing model" in proc.stdout
+    for composing in ("image_preprocess", "resnet50"):
+        child = tmp_path / f"ens.csv.{composing}"
+        assert child.exists(), f"missing {child}"
+        header, row = child.read_text().strip().splitlines()[:2]
+        assert "Server Compute Infer" in header
+        cols = dict(zip(header.split(","), row.split(",")))
+        assert int(cols["Inference Count"]) > 0
