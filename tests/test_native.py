@@ -623,3 +623,49 @@ def test_perf_analyzer_ensemble_composing_csv(native_build, tmp_path):
         assert "Server Compute Infer" in header
         cols = dict(zip(header.split(","), row.split(",")))
         assert int(cols["Inference Count"]) > 0
+
+
+@pytest.fixture(scope="module")
+def sanitizer_builds():
+    """ASan + TSan builds of the native tree (the reference ships no
+    sanitizer configuration at all, SURVEY.md §5.2)."""
+    outs = {}
+    for san in ("address", "thread"):
+        bdir = f"build-{san[:4] if san == 'address' else san}"
+        bdir = {"address": "build-asan", "thread": "build-tsan"}[san]
+        subprocess.run(
+            ["cmake", "-B", bdir, "-G", "Ninja",
+             "-DCMAKE_BUILD_TYPE=RelWithDebInfo",
+             f"-DTPUCLIENT_SANITIZE={san}"],
+            cwd=NATIVE, check=True, capture_output=True)
+        proc = subprocess.run(
+            ["ninja", "-C", bdir, "tpuclient_unit_tests",
+             "simple_grpc_async_infer_client"],
+            cwd=NATIVE, capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        outs[san] = os.path.join(NATIVE, bdir)
+    return outs
+
+
+@pytest.mark.parametrize("san", ["address", "thread"])
+def test_unit_tests_under_sanitizer(sanitizer_builds, san):
+    proc = subprocess.run(
+        [os.path.join(sanitizer_builds[san], "tpuclient_unit_tests")],
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ALL UNIT TESTS PASSED" in proc.stdout
+
+
+@pytest.mark.parametrize("san", ["address", "thread"])
+def test_async_grpc_client_under_sanitizer(sanitizer_builds, grpc_server,
+                                           san):
+    """The async gRPC client (h2 transport + completion worker threads)
+    against a live server under ASan/TSan — the hot concurrent paths the
+    reference documents as thread-safety contracts but never checks."""
+    proc = subprocess.run(
+        [os.path.join(sanitizer_builds[san],
+                      "simple_grpc_async_infer_client"),
+         "-u", f"127.0.0.1:{grpc_server.port}"],
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
