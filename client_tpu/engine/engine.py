@@ -37,6 +37,7 @@ SERVER_EXTENSIONS = [
     "binary_tensor_data",
     "parameters",
     "statistics",
+    "trace",
 ]
 
 
@@ -61,9 +62,11 @@ class TpuEngine:
         # Shared-memory data planes (SURVEY.md §5.8); frontends reach them
         # uniformly through these attributes.
         from client_tpu.engine.shm import SystemShmManager, TpuShmManager
+        from client_tpu.engine.trace import TraceManager
 
         self.system_shm = SystemShmManager()
         self.tpu_shm = TpuShmManager()
+        self.trace = TraceManager()
         if load_all:
             for name in self.repository.names():
                 try:
@@ -281,10 +284,20 @@ class TpuEngine:
         raise EngineError(
             f"shared memory region '{region}' not registered", 400)
 
+    # -- trace (device profiling) --------------------------------------------
+
+    def trace_setting(self) -> dict:
+        return self.trace.setting()
+
+    def update_trace_setting(self, d: dict) -> dict:
+        return self.trace.update(d or {})
+
     # -- lifecycle -----------------------------------------------------------
 
     def shutdown(self) -> None:
         self._live = False
+        if getattr(self, "trace", None) is not None:
+            self.trace.shutdown()
         with self._lock:
             scheds = list(self._schedulers.values())
             self._schedulers.clear()

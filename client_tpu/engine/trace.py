@@ -1,0 +1,78 @@
+"""Trace extension: device-level profiling behind the KServe-style
+``/v2/trace/setting`` route.
+
+The reference stack has only hand-rolled client timers (SURVEY.md §5.1 —
+RequestTimers, common.h:509-589); the server side it talks to exposes
+Triton's trace-setting extension. Here the TPU-native equivalent wraps
+``jax.profiler``: activating the trace captures XLA/TPU device events
+(executable launches, HBM transfers, per-op device time) into a TensorBoard/
+Perfetto-compatible log directory, covering every model the engine serves
+while active.
+
+Settings vocabulary (mirrors Triton's trace_setting fields where they make
+sense): ``trace_level`` — ``["OFF"]`` or ``["TIMESTAMPS"]`` (device events);
+``log_dir`` — where the trace is written (``trace_file`` accepted as an
+alias on update).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from client_tpu.engine.types import EngineError
+
+
+class TraceManager:
+    """Engine-wide device trace control (jax.profiler start/stop)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._log_dir = ""
+        self._active = False
+
+    def setting(self) -> dict:
+        with self._lock:
+            return {
+                "trace_level": ["TIMESTAMPS"] if self._active else ["OFF"],
+                "log_dir": self._log_dir,
+            }
+
+    def update(self, d: dict) -> dict:
+        """Apply a settings delta; returns the resulting settings."""
+        level = d.get("trace_level")
+        log_dir = d.get("log_dir", d.get("trace_file"))
+        with self._lock:
+            if log_dir:
+                if self._active:
+                    raise EngineError(
+                        "cannot change log_dir while a trace is active", 400)
+                self._log_dir = str(log_dir)
+            if level is not None:
+                if isinstance(level, str):
+                    level = [level]
+                want_active = any(lv and lv.upper() != "OFF" for lv in level)
+                if want_active and not self._active:
+                    if not self._log_dir:
+                        raise EngineError(
+                            "trace activation requires a log_dir", 400)
+                    import jax
+
+                    jax.profiler.start_trace(self._log_dir)
+                    self._active = True
+                elif not want_active and self._active:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                    self._active = False
+        return self.setting()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._active:
+                import jax
+
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001 — best-effort on teardown
+                    pass
+                self._active = False

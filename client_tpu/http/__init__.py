@@ -456,6 +456,21 @@ class InferenceServerClient:
     register_cuda_shared_memory = register_tpu_shared_memory
     unregister_cuda_shared_memory = unregister_tpu_shared_memory
 
+    # -- trace (device profiling) --------------------------------------------
+
+    def get_trace_settings(self, model_name="", headers=None,
+                           query_params=None):
+        """Server trace settings (engine-wide; ``model_name`` accepted for
+        API compatibility)."""
+        return self._get_json("/v2/trace/setting", query_params, headers)
+
+    def update_trace_settings(self, model_name="", settings=None,
+                              headers=None, query_params=None):
+        """Update trace settings; activating (trace_level != OFF) starts a
+        jax.profiler device trace into ``log_dir``."""
+        return self._post_json("/v2/trace/setting", settings or {},
+                               query_params, headers)
+
     # -- inference -----------------------------------------------------------
 
     @staticmethod

@@ -48,6 +48,8 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
                         r"/region/([^/]+)/register$"), "shm_register"),
     ("POST", re.compile(r"^/v2/(systemsharedmemory|cudasharedmemory|tpusharedmemory)"
                         r"(?:/region/([^/]+))?/unregister$"), "shm_unregister"),
+    ("GET", re.compile(r"^/v2/trace/setting$"), "trace_setting"),
+    ("POST", re.compile(r"^/v2/trace/setting$"), "trace_update"),
 ]
 
 
@@ -218,6 +220,13 @@ class _Handler(BaseHTTPRequestHandler):
         if mgr is None:
             raise EngineError(f"{kind} is not enabled on this server", 400)
         return mgr
+
+    def h_trace_setting(self):
+        self._send_json(self.engine.trace_setting())
+
+    def h_trace_update(self):
+        body = json.loads(self._read_body() or b"{}")
+        self._send_json(self.engine.update_trace_setting(body))
 
     def h_shm_status(self, kind, region=None):
         self._send_json(self._shm_manager(kind).status(region))

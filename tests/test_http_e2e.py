@@ -205,3 +205,44 @@ class TestClassification:
         first = top[0, 0].decode()
         assert first.endswith(":1:dog")
         c.close()
+
+
+class TestTrace:
+    """The trace extension: /v2/trace/setting wraps jax.profiler so an
+    activated window captures device events for every model served."""
+
+    def test_trace_capture_cycle(self, client, tmp_path):
+        setting = client.get_trace_settings()
+        assert setting["trace_level"] == ["OFF"]
+
+        log_dir = str(tmp_path / "trace")
+        got = client.update_trace_settings(
+            settings={"log_dir": log_dir, "trace_level": ["TIMESTAMPS"]})
+        assert got["trace_level"] == ["TIMESTAMPS"]
+        assert got["log_dir"] == log_dir
+
+        # Traced inference traffic...
+        _a, _b, inputs = _simple_inputs()
+        client.infer("simple", inputs)
+
+        got = client.update_trace_settings(settings={"trace_level": ["OFF"]})
+        assert got["trace_level"] == ["OFF"]
+        # ...lands in a TensorBoard/Perfetto-compatible log dir.
+        import glob
+        files = glob.glob(log_dir + "/**/*", recursive=True)
+        assert any("plugins" in f or f.endswith((".pb", ".json.gz",
+                                                 ".trace.json.gz"))
+                   for f in files), files
+
+    def test_activation_without_log_dir_rejected(self):
+        from client_tpu.engine.trace import TraceManager
+        from client_tpu.engine.types import EngineError
+
+        tm = TraceManager()
+        with pytest.raises(EngineError) as ei:
+            tm.update({"trace_level": ["TIMESTAMPS"]})
+        assert ei.value.status == 400
+
+    def test_trace_extension_advertised(self, client):
+        meta = client.get_server_metadata()
+        assert "trace" in meta["extensions"]
