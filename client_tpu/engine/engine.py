@@ -37,7 +37,6 @@ SERVER_EXTENSIONS = [
     "binary_tensor_data",
     "parameters",
     "statistics",
-    "trace",
 ]
 
 

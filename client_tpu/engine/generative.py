@@ -1,0 +1,261 @@
+"""Continuous (iteration-level) batching for generative models.
+
+The decoupled scheduler streams one model's responses per request
+(scheduler.py DecoupledScheduler); this scheduler goes further for
+autoregressive backends: every *decode step* is shared across all live
+generation streams. Design, TPU-first:
+
+- The KV cache is a fixed-capacity HBM **arena** pytree owned by one worker
+  (``backend.init_arena``; +1 dummy row absorbs padded lanes), donated into
+  every jitted call so updates are in-place.
+- **Prefill** (one jit per prompt bucket) writes a prompt's K/V into its
+  arena row and emits the first token.
+- **Decode waves** (one jit per stream-count bucket) advance every live
+  stream one token in a single XLA execution: scatter new K/V at each
+  stream's position, masked attention over the static sequence axis, argmax.
+- Streams are admitted whenever a row is free — new requests join the next
+  wave (iteration-level batching), they never wait for a running stream to
+  finish (request-level batching would).
+
+Tokens stream out through the ordinary decoupled response protocol
+(``triton_final_response`` terminates), so the gRPC stream frontend and the
+C API serve generative models without modification.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as _queue
+import threading
+
+import numpy as np
+
+from client_tpu.engine.scheduler import Scheduler, _SHUTDOWN, _SHUTDOWN_LEVEL
+from client_tpu.engine.types import (
+    EngineError,
+    InferRequest,
+    InferResponse,
+    now_ns,
+)
+
+_log = logging.getLogger("client_tpu")
+
+
+class _Stream:
+    __slots__ = ("req", "row", "length", "last_token", "emitted", "max_new")
+
+    def __init__(self, req, row, length, last_token, max_new):
+        self.req = req
+        self.row = row
+        self.length = length          # positions filled in the KV row
+        self.last_token = last_token  # next decode step's input token
+        self.emitted = 0
+        self.max_new = max_new
+
+
+class GenerativeScheduler(Scheduler):
+    """Arena-owned single worker; batching provides the parallelism."""
+
+    single_instance = True
+
+    def __init__(self, model, stats):
+        import jax
+
+        self._jax = jax
+        backend = model.backend
+        self._cap = int(backend.max_streams)
+        self._max_seq = int(backend.max_seq_len)
+        self._arena = backend.init_arena(self._cap)
+        self._prefill = jax.jit(backend.prefill_fn(), donate_argnums=(1,))
+        self._decode = jax.jit(backend.decode_fn(), donate_argnums=(1,))
+        self._prompt_buckets = _buckets_up_to(self._max_seq)
+        self._wave_buckets = _buckets_up_to(self._cap)
+        self._streams: list[_Stream] = []
+        self._free = list(range(self._cap))
+        self._stopping_worker = False
+        super().__init__(model, stats)
+
+    # -- worker ---------------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            # Blocking admit when idle; opportunistic admits otherwise —
+            # a new request joins the *next* wave, never waits for a
+            # stream to finish.
+            if not self._streams:
+                item = self.queue.get()
+                if item is _SHUTDOWN:
+                    return
+                self._try_admit(item)
+                continue
+            while self._free:
+                try:
+                    item = self.queue.get(timeout=0)
+                except _queue.Empty:
+                    break
+                if item is _SHUTDOWN:
+                    self._abort_streams("server shutting down")
+                    return
+                self._try_admit(item)
+            if self._streams:
+                try:
+                    self._decode_wave()
+                except Exception as exc:  # noqa: BLE001
+                    self._reset_arena(exc)
+
+    def _try_admit(self, item) -> None:
+        req: InferRequest = item
+        if self._check_timeout(req):
+            return
+        try:
+            self._admit(req)
+        except EngineError as exc:
+            self._fail(req, exc)
+        except Exception as exc:  # noqa: BLE001
+            self._reset_arena(exc, failing=req)
+
+    def _admit(self, req: InferRequest) -> None:
+        ids = np.ravel(np.asarray(req.inputs["INPUT_IDS"])).astype(np.int32)
+        try:
+            max_new = int(req.parameters.get(
+                "max_tokens", self.model.backend.default_max_tokens))
+        except (TypeError, ValueError):
+            raise EngineError(
+                f"max_tokens must be an integer, got "
+                f"{req.parameters.get('max_tokens')!r}", 400) from None
+        if max_new < 1:
+            raise EngineError("max_tokens must be >= 1", 400)
+        if len(ids) < 1:
+            raise EngineError("INPUT_IDS must contain at least one id", 400)
+        if len(ids) + max_new > self._max_seq:
+            raise EngineError(
+                f"prompt ({len(ids)}) + max_tokens ({max_new}) exceeds "
+                f"max_seq_len ({self._max_seq})", 400)
+        vocab = self.model.backend.vocab
+        if (ids < 0).any() or (ids >= vocab).any():
+            raise EngineError(f"token ids must be in [0, {vocab})", 400)
+        req.times.compute_start = now_ns()
+        row = self._free.pop()
+        try:
+            bucket = next(b for b in self._prompt_buckets if b >= len(ids))
+            padded = np.zeros(bucket, np.int32)
+            padded[:len(ids)] = ids
+            self.model._set_state(
+                f"generative prefill (prompt bucket={bucket})")
+            try:
+                self._arena, token = self._prefill(
+                    self.model._params, self._arena, np.int32(row), padded,
+                    np.int32(len(ids)))
+                token = int(token)
+            finally:
+                self.model._clear_state()
+        except Exception:
+            self._free.append(row)
+            raise
+        stream = _Stream(req, row, len(ids) , token, max_new)
+        self._streams.append(stream)
+        self._emit_token(stream, token)
+        self.stats.record_execution(1)
+        self._finish_if_done(stream)
+
+    def _decode_wave(self) -> None:
+        live = self._streams
+        bucket = next(b for b in self._wave_buckets if b >= len(live))
+        pad = bucket - len(live)
+        rows = np.asarray([s.row for s in live] + [self._cap] * pad, np.int32)
+        tokens = np.asarray([s.last_token for s in live] + [0] * pad,
+                            np.int32)
+        lens = np.asarray([s.length for s in live] + [0] * pad, np.int32)
+        self.model._set_state(
+            f"generative decode wave ({len(live)} streams, bucket={bucket})")
+        try:
+            self._arena, nxt = self._decode(
+                self.model._params, self._arena, rows, tokens, lens)
+            nxt = np.asarray(nxt)
+        finally:
+            self.model._clear_state()
+        self.stats.record_execution(len(live))
+        finished = []
+        for i, s in enumerate(live):
+            s.length += 1          # the token just consumed now occupies a slot
+            s.last_token = int(nxt[i])
+            self._emit_token(s, s.last_token)
+            if self._stream_done(s):
+                finished.append(s)
+        for s in finished:
+            self._retire(s)
+
+    # -- stream lifecycle ------------------------------------------------------
+
+    def _emit_token(self, s: _Stream, token: int) -> None:
+        self._respond(s.req, InferResponse(
+            model_name=s.req.model_name,
+            model_version=s.req.model_version or
+            str(self.model.config.version),
+            request_id=s.req.request_id,
+            outputs={"TOKEN": np.array([token], np.int32),
+                     "INDEX": np.array([s.emitted], np.uint32)},
+            parameters={"triton_final_response": False},
+            final=False,
+            times=s.req.times,
+        ))
+        s.emitted += 1
+
+    def _stream_done(self, s: _Stream) -> bool:
+        return s.emitted >= s.max_new or s.length + 1 >= self._max_seq
+
+    def _finish_if_done(self, s: _Stream) -> None:
+        if self._stream_done(s):
+            self._retire(s)
+
+    def _retire(self, s: _Stream) -> None:
+        if s in self._streams:
+            self._streams.remove(s)
+        self._free.append(s.row)
+        s.req.times.compute_input_end = s.req.times.compute_start
+        s.req.times.compute_infer_end = now_ns()
+        s.req.times.compute_output_end = s.req.times.compute_infer_end
+        self.stats.record_request(s.req.times, success=True)
+        self._respond(s.req, InferResponse(
+            model_name=s.req.model_name,
+            model_version=s.req.model_version or
+            str(self.model.config.version),
+            request_id=s.req.request_id,
+            outputs={},
+            parameters={"triton_final_response": True},
+            final=True,
+            times=s.req.times,
+        ))
+
+    def _abort_streams(self, why: str) -> None:
+        for s in list(self._streams):
+            self._fail(s.req, EngineError(why, 503))
+        self._streams.clear()
+        self._free = list(range(self._cap))
+        self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)  # other sentinels may wait
+
+    def _reset_arena(self, exc: Exception, failing=None) -> None:
+        """A failed donated call may have invalidated the arena buffers:
+        rebuild and drop every live stream (mirrors the oldest-sequence
+        batcher's recovery)."""
+        _log.exception(
+            "model '%s': generative step failed; resetting KV arena "
+            "(%d live streams dropped)", self.model.config.name,
+            len(self._streams))
+        if failing is not None:
+            self._fail(failing, exc)
+        for s in list(self._streams):
+            self._fail(s.req, EngineError(
+                f"generation aborted: {exc}", 500))
+        self._streams.clear()
+        self._free = list(range(self._cap))
+        self._arena = self.model.backend.init_arena(self._cap)
+
+
+def _buckets_up_to(n: int) -> list[int]:
+    out, b = [], 1
+    while b < n:
+        out.append(b)
+        b *= 2
+    out.append(n)
+    return out

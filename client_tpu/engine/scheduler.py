@@ -225,7 +225,14 @@ class Scheduler:
             self._release_in_order(req.arrival_seq, (req, resp))
             return
         if req.response_callback is not None:
-            req.response_callback(resp)
+            try:
+                req.response_callback(resp)
+            except Exception:  # noqa: BLE001 — one client's broken callback
+                # must not fail the batch it shares (or, for single-worker
+                # schedulers, kill the worker thread).
+                logging.getLogger("client_tpu").exception(
+                    "response callback raised (model '%s')",
+                    self.model.config.name)
 
     def _fail(self, req: InferRequest, exc: Exception) -> None:
         req.times.compute_output_end = now_ns()
@@ -471,5 +478,11 @@ def make_scheduler(model: Model, stats: ModelStats,
             raise EngineError("sequence scheduling not wired", 500)
         return sequence_cls(model, stats)
     if model.config.decoupled:
+        if getattr(model.backend, "generative", False):
+            # Autoregressive backends (prefill/decode over a KV arena) get
+            # iteration-level batching across streams.
+            from client_tpu.engine.generative import GenerativeScheduler
+
+            return GenerativeScheduler(model, stats)
         return DecoupledScheduler(model, stats)
     return DefaultScheduler(model, stats)

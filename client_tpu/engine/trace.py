@@ -41,29 +41,31 @@ class TraceManager:
         """Apply a settings delta; returns the resulting settings."""
         level = d.get("trace_level")
         log_dir = d.get("log_dir", d.get("trace_file"))
+        if isinstance(level, str):
+            level = [level]
+        want_active = (None if level is None
+                       else any(lv and lv.upper() != "OFF" for lv in level))
         with self._lock:
+            # Deactivation first: {"trace_level": ["OFF"], "log_dir": new}
+            # is the natural stop-and-redirect call and must succeed.
+            if want_active is False and self._active:
+                import jax
+
+                jax.profiler.stop_trace()
+                self._active = False
             if log_dir:
                 if self._active:
                     raise EngineError(
                         "cannot change log_dir while a trace is active", 400)
                 self._log_dir = str(log_dir)
-            if level is not None:
-                if isinstance(level, str):
-                    level = [level]
-                want_active = any(lv and lv.upper() != "OFF" for lv in level)
-                if want_active and not self._active:
-                    if not self._log_dir:
-                        raise EngineError(
-                            "trace activation requires a log_dir", 400)
-                    import jax
+            if want_active and not self._active:
+                if not self._log_dir:
+                    raise EngineError(
+                        "trace activation requires a log_dir", 400)
+                import jax
 
-                    jax.profiler.start_trace(self._log_dir)
-                    self._active = True
-                elif not want_active and self._active:
-                    import jax
-
-                    jax.profiler.stop_trace()
-                    self._active = False
+                jax.profiler.start_trace(self._log_dir)
+                self._active = True
         return self.setting()
 
     def shutdown(self) -> None:

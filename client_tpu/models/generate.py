@@ -1,0 +1,239 @@
+"""Autoregressive decoder LM (`tiny_gpt`) for generative serving.
+
+No reference counterpart exists (the reference's only streaming model is the
+repeat/decoupled demo, src/python/examples/simple_grpc_custom_repeat.py);
+this is the framework's generative workload: a decoder-only transformer
+served token-by-token through the decoupled response protocol, with
+**iteration-level (continuous) batching** — concurrent generation streams
+share each decode step via a KV-cache arena in HBM
+(client_tpu/engine/generative.py).
+
+TPU-first shapes: the KV cache is one pytree with leading dims
+``[n_layers, capacity+1, max_seq_len, heads, head_dim]`` (the +1 row absorbs
+padded decode lanes); prefill writes a whole row, each decode wave scatters
+one position per active stream and computes masked attention over the static
+``max_seq_len`` axis — no dynamic shapes anywhere, so XLA compiles one
+executable per (prompt bucket | wave bucket).
+
+Weights are random (seeded) — generation is deterministic nonsense, which is
+exactly what the correctness tests need: batched decode must produce
+bit-identical token streams to solo decode.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from client_tpu.engine.config import ModelConfig, TensorConfig
+from client_tpu.engine.model import ModelBackend
+from client_tpu.models import register_model
+
+
+def _ln(x, g, b, eps=1e-5):
+    import jax.numpy as jnp
+
+    m = x.mean(-1, keepdims=True)
+    v = ((x - m) ** 2).mean(-1, keepdims=True)
+    return (x - m) / jnp.sqrt(v + eps) * g + b
+
+
+class TinyGptBackend(ModelBackend):
+    """Decoder-only LM: INPUT_IDS [-1] -> streamed (TOKEN, INDEX) responses.
+
+    ``max_tokens`` request parameter bounds generation (default 16); the
+    stream terminates with an empty ``triton_final_response`` like every
+    decoupled model here.
+    """
+
+    generative = True
+
+    def __init__(self, name: str = "tiny_gpt", n_layers: int = 4,
+                 d_model: int = 256, n_heads: int = 4, d_ff: int = 1024,
+                 vocab: int = 512, max_seq_len: int = 128,
+                 max_streams: int = 64, seed: int = 0):
+        self.n_layers, self.d_model = n_layers, d_model
+        self.n_heads, self.d_ff = n_heads, d_ff
+        self.head_dim = d_model // n_heads
+        self.vocab, self.max_seq_len = vocab, max_seq_len
+        self.max_streams = max_streams
+        self.default_max_tokens = 16
+        self._seed = seed
+        self.config = ModelConfig(
+            name=name,
+            platform="jax",
+            max_batch_size=0,
+            input=[TensorConfig("INPUT_IDS", "INT32", [-1])],
+            output=[
+                TensorConfig("TOKEN", "INT32", [1]),
+                TensorConfig("INDEX", "UINT32", [1]),
+            ],
+            decoupled=True,
+        )
+
+    # -- params --------------------------------------------------------------
+
+    def _init_params(self):
+        rng = np.random.default_rng(self._seed)
+        d, f, v = self.d_model, self.d_ff, self.vocab
+
+        def w(*shape, scale=None):
+            scale = scale or 1.0 / math.sqrt(shape[0])
+            return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+        layers = []
+        for _ in range(self.n_layers):
+            layers.append({
+                "ln1g": np.ones(d, np.float32), "ln1b": np.zeros(d, np.float32),
+                "wq": w(d, d), "wk": w(d, d), "wv": w(d, d), "wo": w(d, d),
+                "ln2g": np.ones(d, np.float32), "ln2b": np.zeros(d, np.float32),
+                "w1": w(d, f), "w2": w(f, d),
+            })
+        return {
+            "embed": w(v, d, scale=0.02), "pos": w(self.max_seq_len, d, scale=0.02),
+            "layers": layers,
+            "lnfg": np.ones(d, np.float32), "lnfb": np.zeros(d, np.float32),
+            "head": w(d, v),
+        }
+
+    def make_apply_params(self):
+        """Full-context forward (no cache): logits for every position.
+        Model-level entry for warmup/diagnostics; serving goes through
+        prefill/decode below."""
+        import jax
+
+        params = jax.device_put(self.load_or_init_params(self._init_params))
+
+        def apply(p, inputs):
+            ids = inputs["INPUT_IDS"].astype("int32")
+            x, _ = self._embed_positions(p, ids, 0)
+            x = self._stack(p, x, causal=True)
+            logits = _ln(x, p["lnfg"], p["lnfb"]) @ p["head"]
+            return {"logits": logits}
+
+        return apply, params
+
+    # -- shared blocks --------------------------------------------------------
+
+    def _embed_positions(self, p, ids, start):
+        import jax.numpy as jnp
+
+        n = ids.shape[0]
+        pos = jnp.arange(n) + start
+        return p["embed"][ids] + p["pos"][pos], pos
+
+    def _stack(self, p, x, causal):
+        """Plain full-context transformer stack (no KV cache)."""
+        import jax
+        import jax.numpy as jnp
+
+        n = x.shape[0]
+        h_, d_ = self.n_heads, self.head_dim
+        pos = jnp.arange(n)
+        mask = pos[None, :] <= pos[:, None] if causal else None
+        for lp in p["layers"]:
+            h = _ln(x, lp["ln1g"], lp["ln1b"])
+            q = (h @ lp["wq"]).reshape(n, h_, d_)
+            k = (h @ lp["wk"]).reshape(n, h_, d_)
+            v = (h @ lp["wv"]).reshape(n, h_, d_)
+            s = jnp.einsum("qhd,khd->hqk", q, k) / math.sqrt(d_)
+            if mask is not None:
+                s = jnp.where(mask[None], s, -1e30)
+            o = jnp.einsum("hqk,khd->qhd", jax.nn.softmax(s), v)
+            x = x + o.reshape(n, self.d_model) @ lp["wo"]
+            h2 = _ln(x, lp["ln2g"], lp["ln2b"])
+            x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+        return x
+
+    # -- generative interface (used by GenerativeScheduler) -------------------
+
+    def init_arena(self, capacity: int):
+        """KV arena pytree: k/v of shape [L, capacity+1, S, H, D] (the +1
+        dummy row absorbs padded decode lanes)."""
+        import jax.numpy as jnp
+
+        shape = (self.n_layers, capacity + 1, self.max_seq_len,
+                 self.n_heads, self.head_dim)
+        return {"k": jnp.zeros(shape, jnp.float32),
+                "v": jnp.zeros(shape, jnp.float32)}
+
+    def prefill_fn(self):
+        """(params, arena, row, ids[S_pad], length) -> (arena, first_token).
+
+        Writes the prompt's K/V into the arena row and returns the argmax
+        token after the last real position. Causal masking makes the padded
+        tail invisible to every valid query.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        h_, d_ = self.n_heads, self.head_dim
+
+        def prefill(p, arena, row, ids, length):
+            n = ids.shape[0]
+            x, pos = self._embed_positions(p, ids, 0)
+            causal = pos[None, :] <= pos[:, None]
+            for li, lp in enumerate(p["layers"]):
+                h = _ln(x, lp["ln1g"], lp["ln1b"])
+                q = (h @ lp["wq"]).reshape(n, h_, d_)
+                k = (h @ lp["wk"]).reshape(n, h_, d_)
+                v = (h @ lp["wv"]).reshape(n, h_, d_)
+                arena = {
+                    "k": arena["k"].at[li, row, :n].set(k),
+                    "v": arena["v"].at[li, row, :n].set(v),
+                }
+                s = jnp.einsum("qhd,khd->hqk", q, k) / math.sqrt(d_)
+                s = jnp.where(causal[None], s, -1e30)
+                o = jnp.einsum("hqk,khd->qhd", jax.nn.softmax(s), v)
+                x = x + o.reshape(n, self.d_model) @ lp["wo"]
+                h2 = _ln(x, lp["ln2g"], lp["ln2b"])
+                x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+            xf = _ln(x[length - 1], p["lnfg"], p["lnfb"])
+            token = jnp.argmax(xf @ p["head"]).astype(jnp.int32)
+            return arena, token
+
+        return prefill
+
+    def decode_fn(self):
+        """(params, arena, rows[B], tokens[B], lens[B]) -> (arena, next[B]).
+
+        One batched decode step: scatter each stream's new K/V at its
+        current position, masked attention over the static max_seq_len
+        axis, argmax next token per stream.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        h_, d_ = self.n_heads, self.head_dim
+
+        def decode(p, arena, rows, tokens, lens):
+            b = rows.shape[0]
+            x = p["embed"][tokens] + p["pos"][lens]          # [B, d]
+            for li, lp in enumerate(p["layers"]):
+                h = _ln(x, lp["ln1g"], lp["ln1b"])
+                q = (h @ lp["wq"]).reshape(b, h_, d_)
+                k = (h @ lp["wk"]).reshape(b, h_, d_)
+                v = (h @ lp["wv"]).reshape(b, h_, d_)
+                arena = {
+                    "k": arena["k"].at[li, rows, lens].set(k),
+                    "v": arena["v"].at[li, rows, lens].set(v),
+                }
+                ck = arena["k"][li, rows]                    # [B, S, H, D]
+                cv = arena["v"][li, rows]
+                s = jnp.einsum("bhd,bshd->bhs", q, ck) / math.sqrt(d_)
+                mask = jnp.arange(self.max_seq_len)[None, :] <= lens[:, None]
+                s = jnp.where(mask[:, None, :], s, -1e30)
+                o = jnp.einsum("bhs,bshd->bhd", jax.nn.softmax(s), cv)
+                x = x + o.reshape(b, self.d_model) @ lp["wo"]
+                h2 = _ln(x, lp["ln2g"], lp["ln2b"])
+                x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+            xf = _ln(x, p["lnfg"], p["lnfb"])
+            nxt = jnp.argmax(xf @ p["head"], axis=-1).astype(jnp.int32)
+            return arena, nxt
+
+        return decode
+
+
+
+register_model("tiny_gpt")(TinyGptBackend)

@@ -159,7 +159,11 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(200 if self.engine.is_ready() else 400, b"")
 
     def h_server_metadata(self):
-        self._send_json(self.engine.server_metadata())
+        md = self.engine.server_metadata()
+        # The trace extension (/v2/trace/setting) is an HTTP-frontend route,
+        # so only this frontend advertises it.
+        md["extensions"] = list(md["extensions"]) + ["trace"]
+        self._send_json(md)
 
     def h_model_ready(self, name, version=None):
         ready = self.engine.model_is_ready(name, version or "")

@@ -1,0 +1,210 @@
+"""Generative serving: tiny_gpt through the continuous-batching scheduler.
+
+The defining property under test: iteration-level batching must be
+*invisible* — a stream generated while sharing decode waves with other
+streams is bit-identical to the same prompt generated alone.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from client_tpu.engine import EngineError, InferRequest, TpuEngine
+from client_tpu.models import build_repository
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = TpuEngine(build_repository(["tiny_gpt"]))
+    yield eng
+    eng.shutdown()
+
+
+def generate(engine, prompt, max_tokens, timeout=120):
+    """Run one stream to completion; returns the token list."""
+    tokens: list[int] = []
+    err: list = []
+    done = threading.Event()
+
+    def cb(resp):
+        if resp.error is not None:
+            err.append(resp.error)
+            done.set()
+            return
+        if resp.final:
+            done.set()
+            return
+        assert int(resp.outputs["INDEX"][0]) == len(tokens)
+        tokens.append(int(resp.outputs["TOKEN"][0]))
+
+    engine.async_infer(
+        InferRequest(model_name="tiny_gpt",
+                     inputs={"INPUT_IDS": np.asarray(prompt, np.int32)},
+                     parameters={"max_tokens": max_tokens}),
+        cb)
+    assert done.wait(timeout), "stream did not finish"
+    if err:
+        raise err[0]
+    return tokens
+
+
+class TestGenerative:
+    def test_scheduler_selected(self, engine):
+        from client_tpu.engine.generative import GenerativeScheduler
+
+        assert isinstance(engine._schedulers["tiny_gpt"],
+                          GenerativeScheduler)
+
+    def test_stream_shape_and_determinism(self, engine):
+        t1 = generate(engine, [1, 2, 3], 8)
+        assert len(t1) == 8
+        assert all(0 <= t < 512 for t in t1)
+        assert generate(engine, [1, 2, 3], 8) == t1
+
+    def test_batch_invariance(self, engine):
+        """Streams sharing decode waves == the same streams generated solo."""
+        prompts = [[i, i + 1, i + 2, i + 3] for i in range(1, 13)]
+        solo = [generate(engine, p, 6) for p in prompts]
+        results: list = [None] * len(prompts)
+        errs: list = []
+
+        def run(i):
+            try:
+                results[i] = generate(engine, prompts[i], 6)
+            except Exception as exc:  # noqa: BLE001
+                errs.append((i, repr(exc)))
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        assert results == solo
+
+    def test_more_streams_than_slots_all_complete(self):
+        from client_tpu.engine.repository import ModelRepository
+        from client_tpu.models.generate import TinyGptBackend
+
+        backend = TinyGptBackend(name="tiny_gpt_small", max_streams=4,
+                                 n_layers=2, max_seq_len=64)
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        eng = TpuEngine(repo)
+        try:
+            results: list = [None] * 12
+            errs: list = []
+
+            def run(i):
+                try:
+                    tokens, done = [], threading.Event()
+
+                    def cb(resp):
+                        if resp.error is not None:
+                            errs.append((i, str(resp.error)))
+                            done.set()
+                        elif resp.final:
+                            done.set()
+                        else:
+                            tokens.append(int(resp.outputs["TOKEN"][0]))
+
+                    eng.async_infer(InferRequest(
+                        model_name="tiny_gpt_small",
+                        inputs={"INPUT_IDS": np.asarray([i + 1], np.int32)},
+                        parameters={"max_tokens": 5}), cb)
+                    assert done.wait(120)
+                    results[i] = tokens
+                except Exception as exc:  # noqa: BLE001
+                    errs.append((i, repr(exc)))
+
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(12)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs, errs[:4]
+            assert all(r is not None and len(r) == 5 for r in results)
+        finally:
+            eng.shutdown()
+
+    def test_prompt_plus_budget_over_max_seq_rejected(self, engine):
+        with pytest.raises(EngineError) as ei:
+            generate(engine, list(range(120)), 16)
+        assert ei.value.status == 400
+
+    def test_bad_token_ids_rejected(self, engine):
+        with pytest.raises(EngineError) as ei:
+            generate(engine, [1, 99999], 4)
+        assert ei.value.status == 400
+
+    def test_zero_max_tokens_rejected(self, engine):
+        with pytest.raises(EngineError) as ei:
+            generate(engine, [1], 0)
+        assert ei.value.status == 400
+
+    def test_sync_infer_rejected(self, engine):
+        with pytest.raises(EngineError):
+            engine.infer(InferRequest(
+                model_name="tiny_gpt",
+                inputs={"INPUT_IDS": np.asarray([1], np.int32)}),
+                timeout_s=10)
+
+    def test_wave_batching_observable_in_stats(self, engine):
+        """Concurrent streams share executions: per-token executions must be
+        well under streams x tokens once waves form."""
+        s0 = engine.model_statistics("tiny_gpt")["model_stats"][0]
+        prompts = [[i] for i in range(1, 17)]
+        threads = [threading.Thread(target=generate,
+                                    args=(engine, p, 8)) for p in prompts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        s1 = engine.model_statistics("tiny_gpt")["model_stats"][0]
+        reqs = s1["inference_count"] - s0["inference_count"]
+        execs = s1["execution_count"] - s0["execution_count"]
+        assert reqs == 16  # one completed request per stream
+        # 16 prefills + decode waves; without wave sharing the 16 streams'
+        # 7 post-prefill tokens each would need 112 decode executions.
+        assert execs - 16 < 60, execs
+
+
+class TestGenerativeGrpcStream:
+    def test_tokens_stream_over_grpc(self):
+        import client_tpu.grpc as grpcclient
+        from client_tpu.server import GrpcInferenceServer
+
+        eng = TpuEngine(build_repository(["tiny_gpt"]))
+        srv = GrpcInferenceServer(eng, port=0).start()
+        try:
+            expected = generate(eng, [7, 8, 9], 6)
+
+            c = grpcclient.InferenceServerClient(f"127.0.0.1:{srv.port}")
+            tokens = []
+            done = threading.Event()
+
+            def cb(result, error):
+                assert error is None, error
+                params = result.get_response().parameters
+                final = ("triton_final_response" in params
+                         and params["triton_final_response"].bool_param)
+                if result.get_response().outputs:
+                    tokens.append(int(result.as_numpy("TOKEN")[0]))
+                if final:
+                    done.set()
+
+            c.start_stream(cb)
+            inp = grpcclient.InferInput("INPUT_IDS", [3], "INT32")
+            inp.set_data_from_numpy(np.array([7, 8, 9], dtype=np.int32))
+            c.async_stream_infer("tiny_gpt", [inp], request_id="g1",
+                                 parameters={"max_tokens": 6})
+            assert done.wait(timeout=120)
+            c.stop_stream()
+            c.close()
+            assert tokens == expected
+        finally:
+            srv.stop()
+            eng.shutdown()
