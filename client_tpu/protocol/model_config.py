@@ -60,9 +60,18 @@ def config_dict_to_proto(d: dict) -> "pb.ModelConfig":
     if "sequence_batching" in d:
         sb = d["sequence_batching"] or {}
         if sb.get("strategy") == "oldest":
+            oldest = sb.get("oldest") or {}
             cfg.sequence_batching.oldest.SetInParent()
+            cfg.sequence_batching.oldest.max_candidate_sequences = int(
+                oldest.get("max_candidate_sequences",
+                           sb.get("max_candidate_sequences", 0)))
+            cfg.sequence_batching.oldest.max_queue_delay_microseconds = int(
+                oldest.get("max_queue_delay_microseconds",
+                           sb.get("max_queue_delay_microseconds", 0)))
         else:
             cfg.sequence_batching.direct.SetInParent()
+        cfg.sequence_batching.max_sequence_idle_microseconds = int(
+            sb.get("max_sequence_idle_microseconds", 0))
     if d.get("ensemble_scheduling"):
         for s in d["ensemble_scheduling"].get("step", []):
             step = cfg.ensemble_scheduling.step.add(
@@ -147,6 +156,13 @@ def proto_to_config_dict(cfg: "pb.ModelConfig") -> dict:
                     or 1_000_000_000}
         if cfg.sequence_batching.WhichOneof("strategy_choice") == "oldest":
             sb["strategy"] = "oldest"
+            oldest = cfg.sequence_batching.oldest
+            sb["oldest"] = {
+                "max_candidate_sequences":
+                    oldest.max_candidate_sequences or 64,
+                "max_queue_delay_microseconds":
+                    oldest.max_queue_delay_microseconds or 1000,
+            }
         d["sequence_batching"] = sb
     if cfg.ensemble_scheduling.step:
         d["ensemble_scheduling"] = {

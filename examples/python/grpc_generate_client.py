@@ -1,0 +1,69 @@
+#!/usr/bin/env python3
+"""Token-streaming generation client: drives the `tiny_gpt` generative
+model over the gRPC bidi stream, printing tokens as they arrive.
+
+No reference counterpart (the reference's only decoupled example is the
+repeat demo, src/python/examples/simple_grpc_custom_repeat.py) — this is
+the framework's generative-serving demo: the server batches every decode
+step across all concurrent streams (continuous batching over a KV-cache
+arena), and this client shows that the stream protocol is the ordinary
+decoupled one.
+"""
+
+import argparse
+import sys
+import threading
+
+import numpy as np
+
+from client_tpu.grpc import InferenceServerClient, InferInput
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8001")
+parser.add_argument("-p", "--prompt", default="7,8,9",
+                    help="comma-separated token ids")
+parser.add_argument("-n", "--max-tokens", type=int, default=8)
+args = parser.parse_args()
+
+prompt = np.array([int(x) for x in args.prompt.split(",")], dtype=np.int32)
+
+tokens: list[int] = []
+done = threading.Event()
+errors: list[str] = []
+
+
+def callback(result, error):
+    if error is not None:
+        errors.append(str(error))
+        done.set()
+        return
+    response = result.get_response()
+    params = response.parameters
+    if response.outputs:
+        idx = int(result.as_numpy("INDEX")[0])
+        tok = int(result.as_numpy("TOKEN")[0])
+        if idx != len(tokens):
+            errors.append(f"out-of-order token index {idx}")
+        tokens.append(tok)
+        print(f"token[{idx}] = {tok}", flush=True)
+    if ("triton_final_response" in params
+            and params["triton_final_response"].bool_param):
+        done.set()
+
+
+with InferenceServerClient(args.url) as client:
+    client.start_stream(callback)
+    inp = InferInput("INPUT_IDS", [len(prompt)], "INT32")
+    inp.set_data_from_numpy(prompt)
+    client.async_stream_infer("tiny_gpt", [inp], request_id="gen-0",
+                              parameters={"max_tokens": args.max_tokens})
+    if not done.wait(timeout=300):
+        sys.exit("error: stream did not finish")
+    client.stop_stream()
+
+if errors:
+    sys.exit(f"error: {errors[0]}")
+if len(tokens) != args.max_tokens:
+    sys.exit(f"error: expected {args.max_tokens} tokens, got {len(tokens)}")
+
+print(f"PASS: streamed {len(tokens)} generated tokens")

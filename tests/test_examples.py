@@ -26,7 +26,7 @@ def servers():
         "simple", "simple_string", "simple_identity", "simple_sequence",
         "simple_int8", "simple_repeat", "resnet50", "image_preprocess",
         "ensemble_image",
-        "ssd_mobilenet_v2_coco_quantized",
+        "ssd_mobilenet_v2_coco_quantized", "tiny_gpt",
     ]))
     http_srv = HttpInferenceServer(eng, port=0).start()
     grpc_srv = GrpcInferenceServer(eng, port=0).start()
@@ -71,6 +71,7 @@ def run_example(script, servers, extra=None):
     "simple_grpc_sequence_sync_client.py",
     "simple_grpc_sequence_stream_client.py",
     "simple_grpc_custom_repeat_client.py",
+    "grpc_generate_client.py",
     "simple_grpc_keepalive_client.py",
     "simple_http_health_metadata.py",
     "simple_grpc_health_metadata.py",

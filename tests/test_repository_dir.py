@@ -105,3 +105,29 @@ def test_label_filename_resolution(tmp_path):
     model = repo.load("labeled")
     assert model.config.parameters["labels"]["OUTPUT0"] == [
         "cat", "dog", "bird"]
+
+
+def test_pbtxt_sequence_oldest_knobs(tmp_path):
+    """The oldest-strategy sub-message round-trips from config.pbtxt into
+    the engine config (max_candidate_sequences caps the state arena)."""
+    mdir = tmp_path / "seqmodel"
+    mdir.mkdir()
+    (mdir / "config.pbtxt").write_text('''
+name: "seqmodel"
+platform: "jax"
+sequence_batching {
+  max_sequence_idle_microseconds: 5000000
+  oldest { max_candidate_sequences: 12 max_queue_delay_microseconds: 500 }
+}
+input [ { name: "INPUT" data_type: TYPE_INT32 dims: [ 1 ] } ]
+output [ { name: "OUTPUT" data_type: TYPE_INT32 dims: [ 1 ] } ]
+''')
+    from client_tpu.engine.config import ModelConfig
+    from client_tpu.protocol.model_config import load_pbtxt
+
+    cfg = ModelConfig.from_dict(load_pbtxt(str(mdir / "config.pbtxt")))
+    sb = cfg.sequence_batching
+    assert sb.strategy == "oldest"
+    assert sb.max_candidate_sequences == 12
+    assert sb.max_queue_delay_microseconds == 500
+    assert sb.max_sequence_idle_microseconds == 5_000_000
