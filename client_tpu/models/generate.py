@@ -97,13 +97,18 @@ class TinyGptBackend(ModelBackend):
             "head": w(d, v),
         }
 
+    def place_params(self, params):
+        """Device placement hook; sharded variants override with
+        per-tensor NamedShardings (parallel/serving.py)."""
+        import jax
+
+        return jax.device_put(params)
+
     def make_apply_params(self):
         """Full-context forward (no cache): logits for every position.
         Model-level entry for warmup/diagnostics; serving goes through
         prefill/decode below."""
-        import jax
-
-        params = jax.device_put(self.load_or_init_params(self._init_params))
+        params = self.place_params(self.load_or_init_params(self._init_params))
 
         def apply(p, inputs):
             ids = inputs["INPUT_IDS"].astype("int32")

@@ -23,6 +23,7 @@ from __future__ import annotations
 import numpy as np
 
 from client_tpu.models.bert import BertBackend
+from client_tpu.models.generate import TinyGptBackend
 
 
 def dp_batch_buckets(dp: int, max_batch_size: int) -> tuple[int, list[int]]:
@@ -241,3 +242,64 @@ class LongContextBertBackend(BertBackend):
 
 
 register_model("bert_long_mc", default=False)(LongContextBertBackend)
+
+
+class ShardedTinyGptBackend(TinyGptBackend):
+    """tiny_gpt tensor-parallel over a ``tp`` mesh axis for generative
+    serving: attention/FFN weights column/row-split over tp, and the KV
+    arena sharded on its heads axis — the GenerativeScheduler's
+    prefill/decode programs are unchanged (GSPMD inserts the collectives).
+
+    Requires ``n_heads`` divisible by the tp degree so column splits land
+    whole heads per shard.
+    """
+
+    def __init__(self, mesh=None, name: str = "tiny_gpt_mc",
+                 n_heads: int = 8, **kw):
+        from client_tpu.parallel.mesh import make_mesh
+
+        if mesh is None:
+            mesh = make_mesh(axes=("tp",))
+        self.mesh = mesh
+        super().__init__(name=name, n_heads=n_heads, **kw)
+        tp = int(mesh.shape["tp"])
+        if self.n_heads % tp:
+            raise ValueError(
+                f"n_heads ({self.n_heads}) must divide by tp ({tp})")
+
+    def _param_specs(self, P):
+        layer = {
+            "ln1g": P(), "ln1b": P(),
+            "wq": P(None, "tp"), "wk": P(None, "tp"), "wv": P(None, "tp"),
+            "wo": P("tp", None),
+            "ln2g": P(), "ln2b": P(),
+            "w1": P(None, "tp"), "w2": P("tp", None),
+        }
+        return {
+            "embed": P(), "pos": P(),
+            "layers": [dict(layer) for _ in range(self.n_layers)],
+            "lnfg": P(), "lnfb": P(), "head": P(),
+        }
+
+    def place_params(self, params):
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, self._param_specs(P))
+
+    def init_arena(self, capacity: int):
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        arena = super().init_arena(capacity)
+        # [L, cap+1, S, H, D]: shard the heads axis with the weights.
+        sh = NamedSharding(self.mesh, P(None, None, None, "tp", None))
+        return jax.tree.map(lambda a: jax.device_put(a, sh), arena)
+
+
+register_model("tiny_gpt_mc", default=False)(ShardedTinyGptBackend)
