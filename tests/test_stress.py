@@ -1,0 +1,162 @@
+"""Cross-scheduler stress: every scheduler kind drives one engine at once.
+
+Dynamic batching, oldest-sequence waves, direct sequences, decoupled
+streams, continuous-batching generation, and ensembles all share the
+engine (and the GIL, and on real hardware the device) — this shakes out
+cross-model races that single-model tests can't see. Values are still
+hard-asserted per request; nothing is a smoke check.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from client_tpu.engine import InferRequest, TpuEngine
+from client_tpu.models import build_repository
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = TpuEngine(build_repository([
+        "simple", "simple_sequence", "simple_sequence_oldest",
+        "simple_repeat", "tiny_gpt", "image_preprocess", "resnet50",
+        "ensemble_image",
+    ]))
+    yield eng
+    eng.shutdown()
+
+
+def _addsub_worker(engine, i, n, errs):
+    try:
+        for j in range(n):
+            a = np.full((1, 16), i * 31 + j, np.int32)
+            b = np.full((1, 16), 3, np.int32)
+            resp = engine.infer(
+                InferRequest(model_name="simple",
+                             inputs={"INPUT0": a, "INPUT1": b}),
+                timeout_s=120)
+            if not (resp.outputs["OUTPUT0"] == a + b).all():
+                errs.append(("simple", i, j))
+    except Exception as exc:  # noqa: BLE001
+        errs.append(("simple", i, repr(exc)))
+
+
+def _sequence_worker(engine, model, sid, n, errs):
+    try:
+        total = 0
+        for j in range(n):
+            total += j + 1
+            resp = engine.infer(
+                InferRequest(model_name=model,
+                             inputs={"INPUT": np.array([j + 1], np.int32)},
+                             sequence_id=sid,
+                             sequence_start=(j == 0),
+                             sequence_end=(j == n - 1)),
+                timeout_s=120)
+            if int(resp.outputs["OUTPUT"][0]) != total:
+                errs.append((model, sid, j,
+                             int(resp.outputs["OUTPUT"][0]), total))
+    except Exception as exc:  # noqa: BLE001
+        errs.append((model, sid, repr(exc)))
+
+
+def _repeat_worker(engine, i, errs):
+    try:
+        vals = [i, i + 1, i + 2]
+        got, done = [], threading.Event()
+
+        def cb(resp):
+            if resp.error is not None:
+                errs.append(("repeat", i, str(resp.error)))
+                done.set()
+            elif resp.final:
+                done.set()
+            else:
+                got.append(int(resp.outputs["OUT"][0]))
+
+        engine.async_infer(InferRequest(
+            model_name="simple_repeat",
+            inputs={"IN": np.asarray(vals, np.int32)}), cb)
+        if not done.wait(120):
+            errs.append(("repeat", i, "stalled"))
+        elif got != vals:
+            errs.append(("repeat", i, got))
+    except Exception as exc:  # noqa: BLE001
+        errs.append(("repeat", i, repr(exc)))
+
+
+def _generate_worker(engine, i, expected_cache, errs):
+    try:
+        prompt = [1 + (i % 5), 2, 3]
+        got, done = [], threading.Event()
+
+        def cb(resp):
+            if resp.error is not None:
+                errs.append(("gpt", i, str(resp.error)))
+                done.set()
+            elif resp.final:
+                done.set()
+            else:
+                got.append(int(resp.outputs["TOKEN"][0]))
+
+        engine.async_infer(InferRequest(
+            model_name="tiny_gpt",
+            inputs={"INPUT_IDS": np.asarray(prompt, np.int32)},
+            parameters={"max_tokens": 5}), cb)
+        if not done.wait(120):
+            errs.append(("gpt", i, "stalled"))
+            return
+        key = tuple(prompt)
+        with expected_cache["lock"]:
+            prev = expected_cache.setdefault(key, got)
+        if got != prev:
+            errs.append(("gpt", i, "nondeterministic", got, prev))
+    except Exception as exc:  # noqa: BLE001
+        errs.append(("gpt", i, repr(exc)))
+
+
+def _ensemble_worker(engine, i, errs):
+    try:
+        rng = np.random.default_rng(i)
+        img = rng.integers(0, 255, size=(1, 64, 64, 3)).astype(np.uint8)
+        resp = engine.infer(
+            InferRequest(model_name="ensemble_image",
+                         inputs={"RAW_IMAGE": img}),
+            timeout_s=300)
+        logits = resp.outputs["CLASS_LOGITS"]
+        if not np.all(np.isfinite(logits)):
+            errs.append(("ensemble", i, "non-finite"))
+    except Exception as exc:  # noqa: BLE001
+        errs.append(("ensemble", i, repr(exc)))
+
+
+def test_all_scheduler_kinds_concurrently(engine):
+    errs: list = []
+    cache = {"lock": threading.Lock()}
+    threads = []
+    for i in range(12):
+        threads.append(threading.Thread(
+            target=_addsub_worker, args=(engine, i, 6, errs)))
+    for sid in range(1, 9):
+        threads.append(threading.Thread(
+            target=_sequence_worker,
+            args=(engine, "simple_sequence", 100 + sid, 4, errs)))
+        threads.append(threading.Thread(
+            target=_sequence_worker,
+            args=(engine, "simple_sequence_oldest", 200 + sid, 4, errs)))
+    for i in range(6):
+        threads.append(threading.Thread(
+            target=_repeat_worker, args=(engine, i, errs)))
+    for i in range(10):
+        threads.append(threading.Thread(
+            target=_generate_worker, args=(engine, i, cache, errs)))
+    for i in range(3):
+        threads.append(threading.Thread(
+            target=_ensemble_worker, args=(engine, i, errs)))
+
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs[:8]
