@@ -1,0 +1,113 @@
+// Token-streaming generation client (native): drives the `tiny_gpt`
+// generative model over the bidi gRPC stream, printing tokens as they
+// arrive and asserting stream-protocol invariants (ordered INDEX values,
+// final-flag termination, exact token count).
+//
+// No reference counterpart — the reference's only decoupled example is the
+// repeat demo (simple_grpc_custom_repeat.cc). Server-side, every decode
+// step is shared across all live streams (continuous batching over a
+// KV-cache arena); this client shows the wire protocol is the ordinary
+// decoupled one, reachable from the dependency-free native transport.
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstdint>
+#include <iostream>
+#include <mutex>
+#include <vector>
+
+#include "tpuclient/grpc_client.h"
+
+namespace tc = tpuclient;
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8001";
+  int max_tokens = 8;
+  int opt;
+  while ((opt = getopt(argc, argv, "u:n:")) != -1) {
+    if (opt == 'u') url = optarg;
+    if (opt == 'n') max_tokens = atoi(optarg);
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  if (!tc::InferenceServerGrpcClient::Create(&client, url).IsOk()) return 1;
+
+  std::mutex mtx;
+  std::condition_variable cv;
+  std::vector<int32_t> tokens;
+  bool done = false, stream_error = false;
+
+  tc::Error err = client->StartStream([&](tc::InferResult* result) {
+    std::unique_ptr<tc::InferResult> owner(result);
+    std::lock_guard<std::mutex> lk(mtx);
+    if (!result->RequestStatus().IsOk()) {
+      std::cerr << "stream response error: " << result->RequestStatus()
+                << std::endl;
+      stream_error = true;
+    } else {
+      const uint8_t* tok_buf;
+      size_t tok_sz;
+      if (result->RawData("TOKEN", &tok_buf, &tok_sz).IsOk() &&
+          tok_sz == sizeof(int32_t)) {
+        const uint8_t* idx_buf;
+        size_t idx_sz;
+        uint32_t idx = 0;
+        if (result->RawData("INDEX", &idx_buf, &idx_sz).IsOk() &&
+            idx_sz == sizeof(uint32_t)) {
+          idx = *reinterpret_cast<const uint32_t*>(idx_buf);
+        }
+        if (idx != tokens.size()) {
+          std::cerr << "out-of-order token index " << idx << std::endl;
+          stream_error = true;
+        }
+        int32_t tok = *reinterpret_cast<const int32_t*>(tok_buf);
+        tokens.push_back(tok);
+        std::cout << "token[" << idx << "] = " << tok << std::endl;
+      } else {
+        // Empty response: the decoupled stream's final-flag terminator.
+        done = true;
+      }
+    }
+    cv.notify_all();
+  });
+  if (!err.IsOk()) {
+    std::cerr << "StartStream failed: " << err << std::endl;
+    return 1;
+  }
+
+  std::vector<int32_t> prompt = {7, 8, 9};
+  tc::InferInput* input;
+  tc::InferInput::Create(&input, "INPUT_IDS",
+                         {static_cast<int64_t>(prompt.size())}, "INT32");
+  std::unique_ptr<tc::InferInput> owner_in(input);
+  input->AppendRaw(reinterpret_cast<uint8_t*>(prompt.data()),
+                   prompt.size() * sizeof(int32_t));
+
+  tc::InferOptions options("tiny_gpt");
+  options.request_id = "gen-0";
+  options.int_parameters["max_tokens"] = max_tokens;
+  err = client->AsyncStreamInfer(options, {input});
+  if (!err.IsOk()) {
+    std::cerr << "AsyncStreamInfer failed: " << err << std::endl;
+    return 1;
+  }
+
+  {
+    std::unique_lock<std::mutex> lk(mtx);
+    cv.wait_for(lk, std::chrono::seconds(300),
+                [&] { return done || stream_error; });
+    if (stream_error || !done) {
+      std::cerr << "stream did not finish cleanly" << std::endl;
+      return 1;
+    }
+    if (static_cast<int>(tokens.size()) != max_tokens) {
+      std::cerr << "expected " << max_tokens << " tokens, got "
+                << tokens.size() << std::endl;
+      return 1;
+    }
+  }
+  client->StopStream();
+  std::cout << "PASS : grpc_generate_client (" << tokens.size()
+            << " streamed tokens)" << std::endl;
+  return 0;
+}

@@ -56,6 +56,13 @@ struct InferOptions {
   uint64_t server_timeout_us = 0;
   // Client-side transport timeout, microseconds (0 = none).
   uint64_t client_timeout_us = 0;
+  // Custom request parameters (v2 `parameters` object / InferParameter
+  // map), e.g. {"max_tokens": 8} for generative models. Reserved protocol
+  // keys (sequence_*, priority, timeout, binary_data_output) are set via
+  // the typed fields above and must not be duplicated here.
+  std::map<std::string, int64_t> int_parameters;
+  std::map<std::string, std::string> string_parameters;
+  std::map<std::string, bool> bool_parameters;
 };
 
 // Input tensor: shape/dtype plus either scatter-gather host buffers or a

@@ -592,6 +592,15 @@ void InferenceServerGrpcClient::BuildRequest(
   if (options.server_timeout_us != 0) {
     SetParamU64(params, "timeout", options.server_timeout_us);
   }
+  for (const auto& kv : options.int_parameters) {
+    (*params)[kv.first].set_int64_param(kv.second);
+  }
+  for (const auto& kv : options.string_parameters) {
+    (*params)[kv.first].set_string_param(kv.second);
+  }
+  for (const auto& kv : options.bool_parameters) {
+    (*params)[kv.first].set_bool_param(kv.second);
+  }
   for (const InferInput* input : inputs) {
     auto* tensor = request->add_inputs();
     tensor->set_name(input->Name());

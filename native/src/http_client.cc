@@ -971,6 +971,12 @@ Error InferenceServerHttpClient::PrepareInferRequest(
   if (options.priority != 0) params->Set("priority", options.priority);
   if (options.server_timeout_us != 0)
     params->Set("timeout", options.server_timeout_us);
+  for (const auto& kv : options.int_parameters)
+    params->Set(kv.first, kv.second);
+  for (const auto& kv : options.string_parameters)
+    params->Set(kv.first, kv.second);
+  for (const auto& kv : options.bool_parameters)
+    params->Set(kv.first, kv.second);
   // With no explicit output list, ask for all outputs as binary tails
   // rather than JSON data arrays (reference `binary_data_output` request
   // parameter, http_client.cc:334).

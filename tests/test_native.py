@@ -39,6 +39,7 @@ GRPC_EXAMPLES = [
     "simple_grpc_sequence_sync_client",
     "simple_grpc_sequence_stream_client",
     "simple_grpc_custom_repeat_client",
+    "grpc_generate_client",
     "simple_grpc_health_metadata",
 ]
 
@@ -76,7 +77,7 @@ def test_unit_tests(native_build):
 def grpc_server():
     eng = TpuEngine(build_repository(
         ["simple", "simple_string", "simple_sequence", "simple_repeat",
-         "resnet50"]))
+         "resnet50", "tiny_gpt"]))
     srv = GrpcInferenceServer(eng, port=0).start()
     yield srv
     srv.stop()
