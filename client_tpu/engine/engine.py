@@ -283,6 +283,56 @@ class TpuEngine:
         raise EngineError(
             f"shared memory region '{region}' not registered", 400)
 
+    def prometheus_metrics(self) -> str:
+        """Prometheus text exposition of the per-model statistics — the
+        equivalent of the metrics endpoint the Triton *server* exposes
+        (the reference client stack consumes server stats; here the engine
+        IS the server, so it exports both the statistics RPC and this).
+        Metric names mirror Triton's nv_inference_* vocabulary with a
+        tpu_ prefix."""
+        with self._lock:
+            stats = [s.to_dict() for _, s in sorted(self._stats.items())]
+        lines: list[str] = []
+
+        def metric(name, kind, help_text, rows):
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {kind}")
+            for labels, value in rows:
+                lines.append(f"{name}{{{labels}}} {value}")
+
+        def rows(getter):
+            out = []
+            for s in stats:
+                labels = (f'model="{s["name"]}",'
+                          f'version="{s["version"]}"')
+                out.append((labels, getter(s)))
+            return out
+
+        metric("tpu_inference_request_success", "counter",
+               "Successful inference requests",
+               rows(lambda s: s["inference_stats"]["success"]["count"]))
+        metric("tpu_inference_request_failure", "counter",
+               "Failed inference requests",
+               rows(lambda s: s["inference_stats"]["fail"]["count"]))
+        metric("tpu_inference_count", "counter",
+               "Inferences performed (batched requests count each)",
+               rows(lambda s: s["inference_count"]))
+        metric("tpu_inference_exec_count", "counter",
+               "Model executions (batches)",
+               rows(lambda s: s["execution_count"]))
+        for phase, help_text in (
+                ("success", "Cumulative end-to-end request duration"),
+                ("queue", "Cumulative queue duration"),
+                ("compute_input", "Cumulative input staging duration"),
+                ("compute_infer", "Cumulative executable duration"),
+                ("compute_output", "Cumulative output fetch duration")):
+            name = ("tpu_inference_request_duration_us" if phase == "success"
+                    else f"tpu_inference_{phase}_duration_us")
+            metric(name, "counter", help_text + " (microseconds)",
+                   rows(lambda s, p=phase:
+                        s["inference_stats"][p]["ns"] // 1000))
+        return "\n".join(lines) + "\n"
+
     # -- trace (device profiling) --------------------------------------------
 
     def trace_setting(self) -> dict:

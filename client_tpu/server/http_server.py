@@ -50,6 +50,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
                         r"(?:/region/([^/]+))?/unregister$"), "shm_unregister"),
     ("GET", re.compile(r"^/v2/trace/setting$"), "trace_setting"),
     ("POST", re.compile(r"^/v2/trace/setting$"), "trace_update"),
+    ("GET", re.compile(r"^/metrics$"), "metrics"),
 ]
 
 
@@ -224,6 +225,10 @@ class _Handler(BaseHTTPRequestHandler):
         if mgr is None:
             raise EngineError(f"{kind} is not enabled on this server", 400)
         return mgr
+
+    def h_metrics(self):
+        self._send(200, self.engine.prometheus_metrics().encode("utf-8"),
+                   content_type="text/plain; version=0.0.4")
 
     def h_trace_setting(self):
         self._send_json(self.engine.trace_setting())

@@ -246,3 +246,34 @@ class TestTrace:
     def test_trace_extension_advertised(self, client):
         meta = client.get_server_metadata()
         assert "trace" in meta["extensions"]
+
+
+class TestMetrics:
+    """Prometheus exposition: /metrics mirrors the statistics RPC with
+    Triton's nv_inference_* vocabulary (tpu_ prefix)."""
+
+    def test_metrics_counts_requests(self, server, client):
+        import http.client as hc
+
+        a, b, inputs = _simple_inputs()
+        client.infer("simple", inputs)
+        client.infer("simple", inputs)
+
+        host, port = server.url.split(":")
+        conn = hc.HTTPConnection(host, int(port))
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        conn.close()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type").startswith("text/plain")
+        assert "# TYPE tpu_inference_request_success counter" in body
+        success = {}
+        for line in body.splitlines():
+            if line.startswith("tpu_inference_request_success{"):
+                labels, value = line.rsplit(" ", 1)
+                success[labels] = float(value)
+        simple = [v for k, v in success.items() if 'model="simple"' in k]
+        assert simple and simple[0] >= 2
+        assert "tpu_inference_queue_duration_us" in body
+        assert "tpu_inference_exec_count" in body
