@@ -290,8 +290,7 @@ class TpuEngine:
         IS the server, so it exports both the statistics RPC and this).
         Metric names mirror Triton's nv_inference_* vocabulary with a
         tpu_ prefix."""
-        with self._lock:
-            stats = [s.to_dict() for _, s in sorted(self._stats.items())]
+        stats = self.model_statistics()["model_stats"]
         lines: list[str] = []
 
         def metric(name, kind, help_text, rows):
@@ -300,11 +299,14 @@ class TpuEngine:
             for labels, value in rows:
                 lines.append(f"{name}{{{labels}}} {value}")
 
+        def esc(v: str) -> str:
+            return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
         def rows(getter):
             out = []
             for s in stats:
-                labels = (f'model="{s["name"]}",'
-                          f'version="{s["version"]}"')
+                labels = (f'model="{esc(s["name"])}",'
+                          f'version="{esc(s["version"])}"')
                 out.append((labels, getter(s)))
             return out
 

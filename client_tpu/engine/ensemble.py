@@ -35,7 +35,7 @@ class EnsembleScheduler(Scheduler):
             if item is _SHUTDOWN:
                 return
             req: InferRequest = item
-            if self._check_timeout(req):
+            if self._check_timeout(req) or self._check_cancelled(req):
                 continue
             try:
                 self._run_dag(req)

@@ -97,6 +97,13 @@ class GenerativeScheduler(Scheduler):
                     self._abort_streams("server shutting down")
                     return
                 self._try_admit(item)
+            # Client-abandoned streams stop consuming decode slots at the
+            # next wave boundary (frontends set `cancelled` on disconnect).
+            for s in list(self._streams):
+                if s.req.cancelled:
+                    self._streams.remove(s)
+                    self._free.append(s.row)
+                    self._fail(s.req, EngineError("request cancelled", 499))
             if self._streams:
                 try:
                     self._decode_wave()
@@ -105,7 +112,7 @@ class GenerativeScheduler(Scheduler):
 
     def _try_admit(self, item) -> None:
         req: InferRequest = item
-        if self._check_timeout(req):
+        if self._check_timeout(req) or self._check_cancelled(req):
             return
         try:
             self._admit(req)

@@ -239,6 +239,14 @@ class Scheduler:
         self.stats.record_request(req.times, success=False)
         self._respond(req, InferResponse.make_error(req, exc))
 
+    def _check_cancelled(self, req: InferRequest) -> bool:
+        """Client-abandoned request: fail with 499 before spending device
+        time on it (frontends set `cancelled` on disconnect)."""
+        if req.cancelled:
+            self._fail(req, EngineError("request cancelled", 499))
+            return True
+        return False
+
     def _check_timeout(self, req: InferRequest) -> bool:
         """Server-side request timeout while queued (InferOptions
         server_timeout, reference common.h:199-204, composed with the
@@ -279,7 +287,7 @@ class DefaultScheduler(Scheduler):
             if item is _SHUTDOWN:
                 return
             req: InferRequest = item
-            if self._check_timeout(req):
+            if self._check_timeout(req) or self._check_cancelled(req):
                 continue
             batch = [req]
             if dyn is not None and cfg.max_batch_size > 0:
@@ -318,7 +326,7 @@ class DefaultScheduler(Scheduler):
                     stop = True
                     break
                 nxt: InferRequest = item
-                if self._check_timeout(nxt):
+                if self._check_timeout(nxt) or self._check_cancelled(nxt):
                     continue
                 if total >= prefer \
                         or total + _request_batch(nxt) > max_batch \
@@ -409,7 +417,7 @@ class DecoupledScheduler(Scheduler):
             if item is _SHUTDOWN:
                 return
             req: InferRequest = item
-            if self._check_timeout(req):
+            if self._check_timeout(req) or self._check_cancelled(req):
                 continue
             req.times.compute_start = now_ns()
             try:

@@ -62,7 +62,7 @@ class SequenceScheduler(Scheduler):
             if item is _SHUTDOWN:
                 return
             req: InferRequest = item
-            if self._check_timeout(req):
+            if self._check_timeout(req) or self._check_cancelled(req):
                 continue
             try:
                 self._run_one(req)
@@ -239,7 +239,7 @@ class OldestSequenceScheduler(Scheduler):
             if item is _SHUTDOWN:
                 return
             req: InferRequest = item
-            if self._check_timeout(req):
+            if self._check_timeout(req) or self._check_cancelled(req):
                 continue
             batch = self._gather_candidates(req)
             try:
@@ -274,7 +274,7 @@ class OldestSequenceScheduler(Scheduler):
                     stop = True
                     break
                 nxt: InferRequest = item
-                if self._check_timeout(nxt):
+                if self._check_timeout(nxt) or self._check_cancelled(nxt):
                     continue
                 if nxt.sequence_id in seen or not _same_signature(first, nxt):
                     pushback.append(nxt)

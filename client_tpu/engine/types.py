@@ -88,6 +88,15 @@ class InferRequest:
     # Decoupled models invoke this once per streamed response; the final
     # response (or the only one, for non-decoupled) resolves the future too.
     response_callback: Callable[["InferResponse"], None] | None = None
+    # Cooperative cancellation: frontends set this when the client goes
+    # away (gRPC context termination); schedulers poll it before queueing
+    # work and between generation waves, failing the request with 499.
+    # Plain bool — writes are GIL-atomic and stale reads only delay the
+    # cancel by one wave.
+    cancelled: bool = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
 
     def requested_output_names(self) -> list[str]:
         return [o.name for o in self.outputs]

@@ -340,6 +340,12 @@ class _Servicer(GRPCInferenceServiceServicer):
     def ModelInfer(self, request, context):  # noqa: N802
         try:
             req = _proto_to_request(self.engine, request)
+            # Client disconnect/cancel marks the request so the scheduler
+            # skips it instead of spending device time on a dead caller.
+            # add_callback returns False when the RPC already terminated —
+            # the callback will never fire, so cancel inline.
+            if not context.add_callback(req.cancel):
+                req.cancel()
             resp = self.engine.infer(req)
             return _response_to_proto(self.engine, req, resp)
         except Exception as exc:  # noqa: BLE001
@@ -352,6 +358,14 @@ class _Servicer(GRPCInferenceServiceServicer):
         inflight = [0]
         lock = threading.Lock()
         done_reading = threading.Event()
+        live_reqs: dict = {}  # id(req) -> req (InferRequest is unhashable)
+        # When the stream dies (client cancel/disconnect), every in-flight
+        # request on it is abandoned: mark them so schedulers stop spending
+        # device time (generation streams retire at the next wave). If the
+        # RPC already terminated, add_callback returns False and will never
+        # fire; requests are then cancelled at insertion below.
+        stream_dead = not context.add_callback(
+            lambda: [r.cancel() for r in list(live_reqs.values())])
 
         def pump_requests():
             try:
@@ -365,6 +379,12 @@ class _Servicer(GRPCInferenceServiceServicer):
 
                     with lock:
                         inflight[0] += 1
+                        live_reqs[id(req)] = req
+                    # Close the insertion race: a termination callback that
+                    # fired before this request landed in live_reqs missed
+                    # it — re-check liveness after insertion.
+                    if stream_dead or not context.is_active():
+                        req.cancel()
 
                     def make_cb(req):
                         def cb(resp):
@@ -385,6 +405,7 @@ class _Servicer(GRPCInferenceServiceServicer):
                             if resp.final:
                                 with lock:
                                     inflight[0] -= 1
+                                    live_reqs.pop(id(req), None)
                                     rem = inflight[0]
                                 if rem == 0 and done_reading.is_set():
                                     out_q.put(None)  # wake writer to exit

@@ -208,3 +208,127 @@ class TestGenerativeGrpcStream:
         finally:
             srv.stop()
             eng.shutdown()
+
+
+class TestCancellation:
+    def test_cancel_mid_generation_frees_the_slot(self):
+        """Cancelling a stream stops decoding at the next wave, fails the
+        request with 499, and returns its arena row to the free list."""
+        from client_tpu.engine.repository import ModelRepository
+        from client_tpu.models.generate import TinyGptBackend
+
+        backend = TinyGptBackend(name="gpt_cancel", max_streams=2,
+                                 n_layers=2, max_seq_len=64)
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        eng = TpuEngine(repo)
+        try:
+            got = []
+            status = []
+            done = threading.Event()
+            req = InferRequest(
+                model_name="gpt_cancel",
+                inputs={"INPUT_IDS": np.asarray([1, 2], np.int32)},
+                parameters={"max_tokens": 40})
+
+            def cb(resp):
+                if resp.error is not None:
+                    status.append(resp.error.status)
+                    done.set()
+                elif resp.final:
+                    status.append(200)
+                    done.set()
+                else:
+                    got.append(int(resp.outputs["TOKEN"][0]))
+                    if len(got) == 3:
+                        req.cancel()
+
+            eng.async_infer(req, cb)
+            assert done.wait(120)
+            assert status == [499]
+            assert len(got) < 40  # stopped early
+            # The slot is free again: two fresh streams fit (capacity 2).
+            sched = eng._schedulers["gpt_cancel"]
+            deadline = threading.Event()
+            for _ in range(50):
+                if len(sched._free) == 2:
+                    break
+                deadline.wait(0.05)
+            assert len(sched._free) == 2
+            # ...and the scheduler still serves fresh streams.
+            after, fin = [], threading.Event()
+
+            def cb2(resp):
+                if resp.final or resp.error is not None:
+                    fin.set()
+                else:
+                    after.append(int(resp.outputs["TOKEN"][0]))
+
+            eng.async_infer(InferRequest(
+                model_name="gpt_cancel",
+                inputs={"INPUT_IDS": np.asarray([5], np.int32)},
+                parameters={"max_tokens": 4}), cb2)
+            assert fin.wait(120)
+            assert len(after) == 4
+        finally:
+            eng.shutdown()
+
+    def test_queued_cancelled_request_never_admits(self, engine):
+        req = InferRequest(
+            model_name="tiny_gpt",
+            inputs={"INPUT_IDS": np.asarray([1], np.int32)},
+            parameters={"max_tokens": 4})
+        req.cancel()
+        status = []
+        done = threading.Event()
+
+        def cb(resp):
+            if resp.error is not None:
+                status.append(resp.error.status)
+            done.set()
+
+        engine.async_infer(req, cb)
+        assert done.wait(60)
+        assert status == [499]
+
+    def test_stream_close_cancels_generation_serverside(self):
+        """Closing the gRPC stream mid-generation frees the server's
+        arena slot (the scheduler stops decoding for the dead client)."""
+        import client_tpu.grpc as grpcclient
+        from client_tpu.engine.repository import ModelRepository
+        from client_tpu.models.generate import TinyGptBackend
+        from client_tpu.server import GrpcInferenceServer
+
+        backend = TinyGptBackend(name="gpt_c2", max_streams=2,
+                                 n_layers=2, max_seq_len=64)
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        eng = TpuEngine(repo)
+        srv = GrpcInferenceServer(eng, port=0).start()
+        try:
+            c = grpcclient.InferenceServerClient(f"127.0.0.1:{srv.port}")
+            got_one = threading.Event()
+
+            def cb(result, error):
+                if error is None and result.get_response().outputs:
+                    got_one.set()
+
+            c.start_stream(cb)
+            inp = grpcclient.InferInput("INPUT_IDS", [2], "INT32")
+            inp.set_data_from_numpy(np.array([1, 2], dtype=np.int32))
+            c.async_stream_infer("gpt_c2", [inp],
+                                 parameters={"max_tokens": 50})
+            assert got_one.wait(60)
+            c.stop_stream(cancel_requests=True)
+            c.close()
+            # The server notices the dead stream at the next wave and
+            # returns the arena row.
+            sched = eng._schedulers["gpt_c2"]
+            for _ in range(100):
+                if len(sched._free) == 2:
+                    break
+                threading.Event().wait(0.05)
+            assert len(sched._free) == 2
+        finally:
+            srv.stop()
+            eng.shutdown()
