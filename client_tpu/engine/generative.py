@@ -30,7 +30,12 @@ import threading
 
 import numpy as np
 
-from client_tpu.engine.scheduler import Scheduler, _SHUTDOWN, _SHUTDOWN_LEVEL
+from client_tpu.engine.scheduler import (
+    Scheduler,
+    _SHUTDOWN,
+    _SHUTDOWN_LEVEL,
+    power_buckets,
+)
 from client_tpu.engine.types import (
     EngineError,
     InferRequest,
@@ -68,11 +73,10 @@ class GenerativeScheduler(Scheduler):
         self._arena = backend.init_arena(self._cap)
         self._prefill = jax.jit(backend.prefill_fn(), donate_argnums=(1,))
         self._decode = jax.jit(backend.decode_fn(), donate_argnums=(1,))
-        self._prompt_buckets = _buckets_up_to(self._max_seq)
-        self._wave_buckets = _buckets_up_to(self._cap)
+        self._prompt_buckets = power_buckets(self._max_seq)
+        self._wave_buckets = power_buckets(self._cap)
         self._streams: list[_Stream] = []
         self._free = list(range(self._cap))
-        self._stopping_worker = False
         super().__init__(model, stats)
 
     # -- worker ---------------------------------------------------------------
@@ -159,7 +163,7 @@ class GenerativeScheduler(Scheduler):
         except Exception:
             self._free.append(row)
             raise
-        stream = _Stream(req, row, len(ids) , token, max_new)
+        stream = _Stream(req, row, len(ids), token, max_new)
         self._streams.append(stream)
         self._emit_token(stream, token)
         self.stats.record_execution(1)
@@ -258,11 +262,3 @@ class GenerativeScheduler(Scheduler):
         self._free = list(range(self._cap))
         self._arena = self.model.backend.init_arena(self._cap)
 
-
-def _buckets_up_to(n: int) -> list[int]:
-    out, b = [], 1
-    while b < n:
-        out.append(b)
-        b *= 2
-    out.append(n)
-    return out

@@ -30,6 +30,19 @@ _SHUTDOWN = object()
 # Shutdown drains behind every queued request regardless of its priority.
 _SHUTDOWN_LEVEL = 1 << 30
 
+_log = logging.getLogger("client_tpu")
+
+
+def power_buckets(n: int) -> list[int]:
+    """Power-of-two sizes up to and including ``n`` — the shared bucket
+    ladder for wave/batch compiles (one XLA executable per bucket)."""
+    out, b = [], 1
+    while b < n:
+        out.append(b)
+        b *= 2
+    out.append(n)
+    return out
+
 
 class _ReqQueue:
     """Priority-ordered queue with FIFO order within a level and
@@ -204,7 +217,6 @@ class Scheduler:
             if self._draining:
                 return  # the active drainer will pick this up
             self._draining = True
-        log_ = logging.getLogger("client_tpu")
         while True:
             with self._order_lock:
                 if self._release_seq not in self._held:
@@ -216,7 +228,7 @@ class Scheduler:
                 try:
                     r.response_callback(rp)
                 except Exception:  # noqa: BLE001 — isolate client callbacks
-                    log_.exception(
+                    _log.exception(
                         "response callback raised (model '%s')",
                         self.model.config.name)
 
@@ -230,7 +242,7 @@ class Scheduler:
             except Exception:  # noqa: BLE001 — one client's broken callback
                 # must not fail the batch it shares (or, for single-worker
                 # schedulers, kill the worker thread).
-                logging.getLogger("client_tpu").exception(
+                _log.exception(
                     "response callback raised (model '%s')",
                     self.model.config.name)
 

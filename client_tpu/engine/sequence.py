@@ -29,7 +29,12 @@ import threading
 
 import numpy as np
 
-from client_tpu.engine.scheduler import Scheduler, _SHUTDOWN, _SHUTDOWN_LEVEL
+from client_tpu.engine.scheduler import (
+    Scheduler,
+    _SHUTDOWN,
+    _SHUTDOWN_LEVEL,
+    power_buckets,
+)
 from client_tpu.engine.types import (
     EngineError,
     InferRequest,
@@ -175,12 +180,7 @@ class OldestSequenceScheduler(Scheduler):
             return arena, outputs
 
         self._step = jax.jit(step, donate_argnums=(0,))
-        self._buckets = []
-        b = 1
-        while b < self._cap:
-            self._buckets.append(b)
-            b *= 2
-        self._buckets.append(self._cap)
+        self._buckets = power_buckets(self._cap)
         self._free = list(range(self._cap))
         self._rows: dict[int, int] = {}       # sequence_id -> arena row
         self._last_used: dict[int, int] = {}  # sequence_id -> ns

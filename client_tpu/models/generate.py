@@ -128,8 +128,11 @@ class TinyGptBackend(ModelBackend):
         pos = jnp.arange(n) + start
         return p["embed"][ids] + p["pos"][pos], pos
 
-    def _stack(self, p, x, causal):
-        """Plain full-context transformer stack (no KV cache)."""
+    def _stack(self, p, x, causal, on_kv=None):
+        """Full-context transformer stack (no cache reads). ``on_kv(li, k,
+        v)`` observes each layer's K/V at trace time — the prefill path
+        uses it to populate the KV arena with the same math the plain
+        forward runs."""
         import jax
         import jax.numpy as jnp
 
@@ -137,11 +140,13 @@ class TinyGptBackend(ModelBackend):
         h_, d_ = self.n_heads, self.head_dim
         pos = jnp.arange(n)
         mask = pos[None, :] <= pos[:, None] if causal else None
-        for lp in p["layers"]:
+        for li, lp in enumerate(p["layers"]):
             h = _ln(x, lp["ln1g"], lp["ln1b"])
             q = (h @ lp["wq"]).reshape(n, h_, d_)
             k = (h @ lp["wk"]).reshape(n, h_, d_)
             v = (h @ lp["wv"]).reshape(n, h_, d_)
+            if on_kv is not None:
+                on_kv(li, k, v)
             s = jnp.einsum("qhd,khd->hqk", q, k) / math.sqrt(d_)
             if mask is not None:
                 s = jnp.where(mask[None], s, -1e30)
@@ -170,33 +175,22 @@ class TinyGptBackend(ModelBackend):
         token after the last real position. Causal masking makes the padded
         tail invisible to every valid query.
         """
-        import jax
         import jax.numpy as jnp
-
-        h_, d_ = self.n_heads, self.head_dim
 
         def prefill(p, arena, row, ids, length):
             n = ids.shape[0]
-            x, pos = self._embed_positions(p, ids, 0)
-            causal = pos[None, :] <= pos[:, None]
-            for li, lp in enumerate(p["layers"]):
-                h = _ln(x, lp["ln1g"], lp["ln1b"])
-                q = (h @ lp["wq"]).reshape(n, h_, d_)
-                k = (h @ lp["wk"]).reshape(n, h_, d_)
-                v = (h @ lp["wv"]).reshape(n, h_, d_)
-                arena = {
-                    "k": arena["k"].at[li, row, :n].set(k),
-                    "v": arena["v"].at[li, row, :n].set(v),
-                }
-                s = jnp.einsum("qhd,khd->hqk", q, k) / math.sqrt(d_)
-                s = jnp.where(causal[None], s, -1e30)
-                o = jnp.einsum("hqk,khd->qhd", jax.nn.softmax(s), v)
-                x = x + o.reshape(n, self.d_model) @ lp["wo"]
-                h2 = _ln(x, lp["ln2g"], lp["ln2b"])
-                x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+            x, _pos = self._embed_positions(p, ids, 0)
+            box = {"arena": arena}
+
+            def write_kv(li, k, v):
+                a = box["arena"]
+                box["arena"] = {"k": a["k"].at[li, row, :n].set(k),
+                                "v": a["v"].at[li, row, :n].set(v)}
+
+            x = self._stack(p, x, causal=True, on_kv=write_kv)
             xf = _ln(x[length - 1], p["lnfg"], p["lnfb"])
             token = jnp.argmax(xf @ p["head"]).astype(jnp.int32)
-            return arena, token
+            return box["arena"], token
 
         return prefill
 

@@ -78,6 +78,17 @@ def grpc_server():
     eng = TpuEngine(build_repository(
         ["simple", "simple_string", "simple_sequence", "simple_repeat",
          "resnet50", "tiny_gpt"]))
+    # Pre-compile the resnet50 bucket the image client hits: on a loaded CI
+    # machine an XLA compile inside a client's first request can outlast
+    # the client timeout and flake the conformance run.
+    import numpy as np
+
+    from client_tpu.engine import InferRequest
+
+    eng.infer(InferRequest(
+        model_name="resnet50",
+        inputs={"INPUT": np.zeros((2, 224, 224, 3), np.float32)}),
+        timeout_s=300)
     srv = GrpcInferenceServer(eng, port=0).start()
     yield srv
     srv.stop()
@@ -603,7 +614,8 @@ def test_perf_analyzer_ensemble_composing_csv(native_build, tmp_path):
     server-side phase breakdown (reference main.cc:1503-1668 writes
     `<path>.<model>` files)."""
     csv = tmp_path / "ens.csv"
-    env = dict(os.environ, CLIENT_TPU_PLATFORM="cpu")
+    env = dict(os.environ, CLIENT_TPU_PLATFORM="cpu",
+               CLIENT_TPU_WARMUP="1")
     proc = subprocess.run(
         [os.path.join(native_build, "tpu_perf_analyzer"),
          "-m", "ensemble_image",
@@ -612,7 +624,8 @@ def test_perf_analyzer_ensemble_composing_csv(native_build, tmp_path):
          "--capi-library-path", os.path.join(native_build, "libtpuserver.so"),
          "--capi-repo-root", os.path.join(NATIVE, ".."),
          "--shape", "RAW_IMAGE:256,256,3",
-         "-p", "800", "-r", "4", "-s", "90",
+         "--warmup-request-count", "2",
+         "-p", "800", "-r", "6", "-s", "90",
          "--concurrency-range", "2:2", "-f", str(csv)],
         capture_output=True, text=True, timeout=400, env=env)
     assert proc.returncode == 0, proc.stdout + proc.stderr
