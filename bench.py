@@ -407,7 +407,9 @@ def bench_bert_mfu(batch: int = 8, iters: int = 30, pipeline_n: int = 100):
     staged = {k: jax.device_put(v) for k, v in inputs.items()}
     np.asarray(apply_j(staged)["logits"])  # warm
     step = None
-    for _ in range(2):
+    # Best of three passes: the dev chip is shared, and one pass can land
+    # inside someone else's burst.
+    for _ in range(3):
         t0 = time.perf_counter()
         np.asarray(apply_j(staged)["logits"])
         t_one = time.perf_counter() - t0
