@@ -883,24 +883,32 @@ void Connection::HandleFrame(uint8_t type, uint8_t flags, int32_t sid,
     case kSettings: {
       if (flags & kFlagAck) break;
       {
-        std::lock_guard<std::mutex> sl(state_mutex_);
-        for (size_t p = 0; p + 6 <= len; p += 6) {
-          uint16_t id = (uint16_t(payload[p]) << 8) | payload[p + 1];
-          uint32_t value = GetU32(payload + p + 2);
-          if (id == kSettingsInitialWindowSize) {
-            int64_t delta = int64_t(value) - peer_initial_window_;
-            peer_initial_window_ = value;
-            for (auto& kv : streams_) kv.second->send_window += delta;
-          } else if (id == kSettingsMaxFrameSize) {
-            peer_max_frame_ = value;
+        // The peer may keep enforcing its PREVIOUS limits until it
+        // receives our ACK (RFC 7540 §6.5.3) — grpc-core does exactly
+        // that for max_frame_size. So the ACK must hit the wire before
+        // any frame sized under the new values: hold the write lock
+        // across the state update + ACK, so a sender that observed the
+        // updated settings cannot acquire the write lock (and thus reach
+        // the wire) until the ACK is out. Lock order (write -> state)
+        // matches StartStream.
+        std::lock_guard<std::mutex> wl(write_mutex_);
+        {
+          std::lock_guard<std::mutex> sl(state_mutex_);
+          for (size_t p = 0; p + 6 <= len; p += 6) {
+            uint16_t id = (uint16_t(payload[p]) << 8) | payload[p + 1];
+            uint32_t value = GetU32(payload + p + 2);
+            if (id == kSettingsInitialWindowSize) {
+              int64_t delta = int64_t(value) - peer_initial_window_;
+              peer_initial_window_ = value;
+              for (auto& kv : streams_) kv.second->send_window += delta;
+            } else if (id == kSettingsMaxFrameSize) {
+              peer_max_frame_ = value;
+            }
           }
         }
-      }
-      state_cv_.notify_all();
-      {
-        std::lock_guard<std::mutex> wl(write_mutex_);
         SendFrame(kSettings, kFlagAck, 0, nullptr, 0);
       }
+      state_cv_.notify_all();
       break;
     }
     case kPing: {
