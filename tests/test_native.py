@@ -683,3 +683,84 @@ def test_async_grpc_client_under_sanitizer(sanitizer_builds, grpc_server,
         capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "PASS" in proc.stdout
+
+
+def test_h2_settings_ack_precedes_frames_sized_under_new_limits(native_build):
+    """RFC 7540 §6.5.3 contract: the peer may enforce its OLD limits until
+    it receives our SETTINGS ACK (grpc-core does, for max_frame_size). A
+    fake server advertises max_frame=4MB and asserts that any DATA frame
+    larger than the 16384 default arrives only AFTER the client's ACK —
+    the regression test for an intermittent 'Failed parsing HTTP/2'
+    GOAWAY under load."""
+    import socket
+    import struct
+    import threading as th
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    order: list = []
+    done = th.Event()
+
+    def fake_server():
+        conn, _ = srv.accept()
+        conn.settimeout(30)
+        buf = b""
+
+        def read(n):
+            nonlocal buf
+            while len(buf) < n:
+                d = conn.recv(65536)
+                if not d:
+                    raise EOFError
+                buf += d
+            out, buf = buf[:n], buf[n:]
+            return out
+
+        try:
+            read(24)  # client preface
+            # Server SETTINGS: max_frame 4MB, initial window 4MB.
+            settings = (struct.pack(">HI", 5, 4 * 1024 * 1024) +
+                        struct.pack(">HI", 4, 4 * 1024 * 1024))
+            conn.sendall(struct.pack(">I", len(settings))[1:] +
+                         bytes([4, 0]) + struct.pack(">I", 0) + settings)
+            while not done.is_set():
+                hdr = read(9)
+                length = int.from_bytes(hdr[:3], "big")
+                typ, flags = hdr[3], hdr[4]
+                read(length)
+                if typ == 4 and flags & 1:
+                    order.append(("ack", 0))
+                elif typ == 0 and length > 16384:
+                    order.append(("big-data", length))
+                    done.set()
+                elif typ == 0 and length > 0:
+                    order.append(("data", length))
+                # Enough frames observed either way after the body flows.
+                if len(order) > 64:
+                    done.set()
+        except (EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    t = th.Thread(target=fake_server, daemon=True)
+    t.start()
+    # 1.2MB body: chunks of min(conn_window 65535, max_frame 4MB) exceed
+    # 16384 once the client applies the server's SETTINGS.
+    subprocess.run(
+        [os.path.join(native_build, "image_client"),
+         "-u", f"127.0.0.1:{port}", "-i", "grpc", "-m", "resnet50",
+         "-b", "2", "-c", "1"],
+        capture_output=True, text=True, timeout=60)
+    done.set()
+    t.join(timeout=30)
+    srv.close()
+    big = [i for i, (kind, _) in enumerate(order) if kind == "big-data"]
+    acks = [i for i, (kind, _) in enumerate(order) if kind == "ack"]
+    # The client must have applied the 4MB max frame (sent a big frame)...
+    assert big, order[:8]
+    # ...and the ACK must have reached the wire before the first big frame.
+    assert acks and acks[0] < big[0], order[:8]
