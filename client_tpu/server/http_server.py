@@ -39,6 +39,10 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/v2/models/stats$"), "all_stats"),
     ("GET", re.compile(r"^/v2/models/([^/]+)(?:/versions/([^/]+))?$"), "model_metadata"),
     ("POST", re.compile(r"^/v2/models/([^/]+)(?:/versions/([^/]+))?/infer$"), "infer"),
+    ("POST", re.compile(r"^/v2/models/([^/]+)(?:/versions/([^/]+))?"
+                        r"/generate$"), "generate"),
+    ("POST", re.compile(r"^/v2/models/([^/]+)(?:/versions/([^/]+))?"
+                        r"/generate_stream$"), "generate_stream"),
     ("POST", re.compile(r"^/v2/repository/index$"), "repo_index"),
     ("POST", re.compile(r"^/v2/repository/models/([^/]+)/load$"), "repo_load"),
     ("POST", re.compile(r"^/v2/repository/models/([^/]+)/unload$"), "repo_unload"),
@@ -253,6 +257,119 @@ class _Handler(BaseHTTPRequestHandler):
     # -- inference ----------------------------------------------------------
 
     def h_infer(self, name, version=None):
+        req = self._parse_infer_request(name, version)
+        resp = self.engine.infer(req)
+        self._send_infer_response(req, resp)
+
+    # Stall guard for the generate endpoints: how long to wait for the
+    # next response of an in-flight stream before cancelling it.
+    GENERATE_STALL_TIMEOUT_S = 300.0
+
+    def h_generate(self, name, version=None):
+        """Non-streaming generate: run a (possibly decoupled) model and
+        return every response as a JSON array. The streaming variant below
+        is the live-token path; this one is the curl-friendly collector."""
+        req = self._parse_generate_request(name, version)
+        out = []
+        for resp in self._stream_responses(req):
+            if resp.error is not None:
+                raise resp.error
+            if resp.final and not resp.outputs:
+                continue
+            out.append(self._json_response_dict(resp))
+        self._send_json({"model_name": name, "responses": out})
+
+    def h_generate_stream(self, name, version=None):
+        """Server-sent events: one `data: <v2 response JSON>` event per
+        decoupled response, chunked transfer, terminated by the final-flag
+        response. A dead client cancels the request (the generative
+        scheduler then frees its KV arena slot)."""
+        req = self._parse_generate_request(name, version)
+        responses = self._stream_responses(req)
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        self.wfile.flush()  # time-to-first-header, not time-to-first-token
+
+        def chunk(payload: bytes) -> None:
+            self.wfile.write(f"{len(payload):X}\r\n".encode() + payload +
+                             b"\r\n")
+            self.wfile.flush()
+
+        # Headers are out: from here every outcome must stay inside the
+        # chunked body (a second status line would desync the stream), and
+        # an abandoned request must stop generating.
+        try:
+            for resp in responses:
+                if resp.error is not None:
+                    chunk(b"data: " + json.dumps(
+                        {"error": str(resp.error)}).encode() + b"\n\n")
+                    break
+                if resp.outputs or not resp.final:
+                    chunk(b"data: " + json.dumps(
+                        self._json_response_dict(resp),
+                        separators=(",", ":")).encode() + b"\n\n")
+            chunk(b"")  # terminal chunk
+        except (BrokenPipeError, ConnectionResetError):
+            req.cancel()  # dead client: stop generating for it
+        except Exception as exc:  # noqa: BLE001 — mid-stream failure
+            req.cancel()
+            try:
+                chunk(b"data: " + json.dumps(
+                    {"error": str(exc)}).encode() + b"\n\n")
+                chunk(b"")
+            except OSError:
+                pass
+
+    def _parse_generate_request(self, name, version) -> InferRequest:
+        req = self._parse_infer_request(name, version)
+        for o in req.outputs:
+            if o.shm_region or o.classification_count > 0 or o.binary:
+                raise EngineError(
+                    "generate endpoints return JSON tensors only; output "
+                    "parameters (shared memory, classification, "
+                    "binary_data) are not supported", 400)
+        return req
+
+    def _stream_responses(self, req: InferRequest):
+        """Submit and yield responses until the final one; a stall cancels
+        the request and raises 504."""
+        import queue as q
+
+        out_q: q.Queue = q.Queue()
+        self.engine.async_infer(req, out_q.put)
+        while True:
+            try:
+                resp = out_q.get(timeout=self.GENERATE_STALL_TIMEOUT_S)
+            except q.Empty:
+                req.cancel()
+                raise EngineError("generation stalled", 504) from None
+            yield resp
+            if resp.error is not None or resp.final:
+                return
+
+    def _json_response_dict(self, resp) -> dict:
+        """v2 response head with all tensors as JSON data (no binary tails
+        — SSE events and collected arrays are text)."""
+        from client_tpu.protocol.dtypes import np_to_wire_dtype
+
+        head: dict = {"model_name": resp.model_name,
+                      "model_version": str(resp.model_version)}
+        if resp.request_id:
+            head["id"] = resp.request_id
+        if resp.parameters:
+            head["parameters"] = dict(resp.parameters)
+        head["outputs"] = [
+            rest.build_tensor_json(out_name, arr,
+                                   np_to_wire_dtype(arr.dtype), arr.shape,
+                                   binary=False)[0]
+            for out_name, arr in resp.outputs.items()
+        ]
+        return head
+
+    def _parse_infer_request(self, name, version=None) -> InferRequest:
         body = self._read_body()
         header_len = self.headers.get(rest.HEADER_INFERENCE_CONTENT_LENGTH)
         head, tail = rest.split_body(
@@ -296,8 +413,7 @@ class _Handler(BaseHTTPRequestHandler):
             priority=int(params.get("priority", 0)),
             timeout_us=int(params.get("timeout", 0)),
         )
-        resp = self.engine.infer(req)
-        self._send_infer_response(req, resp)
+        return req
 
     def _read_shm_input(self, wire) -> np.ndarray:
         return self.engine.read_shm_tensor(

@@ -277,3 +277,122 @@ class TestMetrics:
         assert simple and simple[0] >= 2
         assert "tpu_inference_queue_duration_us" in body
         assert "tpu_inference_exec_count" in body
+
+
+class TestGenerateEndpoints:
+    """HTTP generate extension: /generate collects a decoupled model's
+    responses; /generate_stream serves them as SSE events."""
+
+    @pytest.fixture(scope="class")
+    def gen_server(self):
+        from client_tpu.engine import TpuEngine
+        from client_tpu.models import build_repository
+        from client_tpu.server import HttpInferenceServer
+
+        eng = TpuEngine(build_repository(["tiny_gpt", "simple"]))
+        srv = HttpInferenceServer(eng, port=0).start()
+        yield srv
+        srv.stop()
+        eng.shutdown()
+
+    @staticmethod
+    def _body(prompt, n):
+        import json as j
+        return j.dumps({
+            "inputs": [{"name": "INPUT_IDS", "datatype": "INT32",
+                        "shape": [len(prompt)], "data": prompt}],
+            "parameters": {"max_tokens": n},
+        }).encode()
+
+    def test_generate_collects_all_tokens(self, gen_server):
+        import http.client as hc
+        import json as j
+
+        host, port = gen_server.url.split(":")
+        conn = hc.HTTPConnection(host, int(port), timeout=120)
+        conn.request("POST", "/v2/models/tiny_gpt/generate",
+                     body=self._body([1, 2, 3], 5))
+        resp = conn.getresponse()
+        data = j.loads(resp.read())
+        conn.close()
+        assert resp.status == 200
+        assert len(data["responses"]) == 5
+        toks = [r["outputs"][0]["data"][0] if r["outputs"][0]["name"] ==
+                "TOKEN" else r["outputs"][1]["data"][0]
+                for r in data["responses"]]
+        assert all(isinstance(t, int) for t in toks)
+
+    def test_generate_stream_sse(self, gen_server):
+        import http.client as hc
+        import json as j
+
+        host, port = gen_server.url.split(":")
+        conn = hc.HTTPConnection(host, int(port), timeout=120)
+        conn.request("POST", "/v2/models/tiny_gpt/generate_stream",
+                     body=self._body([1, 2, 3], 6))
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type").startswith("text/event-stream")
+        raw = resp.read().decode()  # http.client de-chunks
+        conn.close()
+        events = [ln[len("data: "):] for ln in raw.split("\n\n")
+                  if ln.startswith("data: ")]
+        assert len(events) == 6, raw
+        tokens = []
+        for e in events:
+            d = j.loads(e)
+            outs = {o["name"]: o["data"] for o in d["outputs"]}
+            tokens.append(outs["TOKEN"][0])
+        assert len(tokens) == 6
+
+        # Streamed tokens match the collected endpoint (determinism).
+        conn = hc.HTTPConnection(host, int(port), timeout=120)
+        conn.request("POST", "/v2/models/tiny_gpt/generate",
+                     body=self._body([1, 2, 3], 6))
+        data = j.loads(conn.getresponse().read())
+        conn.close()
+        collected = []
+        for r in data["responses"]:
+            outs = {o["name"]: o["data"] for o in r["outputs"]}
+            collected.append(outs["TOKEN"][0])
+        assert collected == tokens
+
+    def test_generate_works_for_single_response_models(self, gen_server):
+        import http.client as hc
+        import json as j
+
+        host, port = gen_server.url.split(":")
+        body = j.dumps({
+            "inputs": [
+                {"name": "INPUT0", "datatype": "INT32", "shape": [1, 16],
+                 "data": [[1] * 16]},
+                {"name": "INPUT1", "datatype": "INT32", "shape": [1, 16],
+                 "data": [[2] * 16]},
+            ],
+        }).encode()
+        conn = hc.HTTPConnection(host, int(port), timeout=120)
+        conn.request("POST", "/v2/models/simple/generate", body=body)
+        data = j.loads(conn.getresponse().read())
+        conn.close()
+        assert len(data["responses"]) == 1
+        outs = {o["name"]: o["data"] for o in data["responses"][0]["outputs"]}
+        assert outs["OUTPUT0"] == [3] * 16  # v2 JSON tensors are flat
+
+    def test_generate_rejects_output_directives(self, gen_server):
+        import http.client as hc
+        import json as j
+
+        host, port = gen_server.url.split(":")
+        body = j.dumps({
+            "inputs": [{"name": "INPUT_IDS", "datatype": "INT32",
+                        "shape": [1], "data": [1]}],
+            "outputs": [{"name": "TOKEN",
+                         "parameters": {"binary_data": True}}],
+            "parameters": {"max_tokens": 2},
+        }).encode()
+        conn = hc.HTTPConnection(host, int(port), timeout=60)
+        conn.request("POST", "/v2/models/tiny_gpt/generate", body=body)
+        resp = conn.getresponse()
+        resp.read()
+        conn.close()
+        assert resp.status == 400
