@@ -165,9 +165,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def h_server_metadata(self):
         md = self.engine.server_metadata()
-        # The trace extension (/v2/trace/setting) is an HTTP-frontend route,
-        # so only this frontend advertises it.
-        md["extensions"] = list(md["extensions"]) + ["trace"]
+        # trace (/v2/trace/setting) and generate (/v2/models/<m>/generate*)
+        # are HTTP-frontend routes, so only this frontend advertises them.
+        md["extensions"] = list(md["extensions"]) + ["trace", "generate"]
         self._send_json(md)
 
     def h_model_ready(self, name, version=None):
