@@ -147,6 +147,12 @@ def infer(engine: TpuEngine, request_json: str, buffers: list):
             shm_offset=int(p.get("shared_memory_offset", 0)),
             shm_byte_size=int(p.get("shared_memory_byte_size", 0)),
         ))
+    # True zero-copy output plane: if every requested output lands in a
+    # device-resident tpu region, the scheduler skips the D2H fetch and the
+    # shm write below stores the HBM-resident slice as-is.
+    keep_on_device = bool(outputs) and all(
+        o.shm_region and engine.tpu_shm.region_kind(o.shm_region) == "device"
+        for o in outputs)
     req = InferRequest(
         model_name=req_d["model_name"],
         model_version=req_d.get("model_version", ""),
@@ -158,6 +164,7 @@ def infer(engine: TpuEngine, request_json: str, buffers: list):
         sequence_end=bool(req_d.get("sequence_end", False)),
         priority=int(req_d.get("priority", 0)),
         timeout_us=int(req_d.get("timeout_us", 0)),
+        keep_outputs_on_device=keep_on_device,
     )
     timeout_s = req.timeout_us / 1e6 if req.timeout_us else None
     resp = engine.infer(req, timeout_s=timeout_s)

@@ -238,10 +238,16 @@ class Model:
 
     def execute_timed(
         self, inputs: dict[str, np.ndarray], batch_size: int | None = None,
+        fetch_outputs: bool = True,
     ) -> tuple[dict[str, np.ndarray], ExecPhases]:
         """Run one (possibly padded) batch through the jitted executable.
 
         ``batch_size``: true batch before padding; outputs are sliced back.
+        ``fetch_outputs=False`` (in-process device-resident tpu-shm plane):
+        skip the D2H fetch and return HBM-resident ``jax.Array`` outputs —
+        the caller is directing every output into a device region, so
+        pulling the batch to host only to ``device_put`` it straight back
+        would be pure staging waste.
         Returns the outputs plus measured :class:`ExecPhases` — each phase is
         bounded by a real device sync (device_put committed / executable
         done / D2H complete), so the statistics the scheduler records are
@@ -307,8 +313,9 @@ class Model:
             # starts the moment its buffer is ready, exactly as the untimed
             # path pipelined it, so the block below costs one host wake-up,
             # not a serialization of compute against transfer.
-            for val in device_outs:
-                val.copy_to_host_async()
+            if fetch_outputs:
+                for val in device_outs:
+                    val.copy_to_host_async()
             if device_outs:
                 # Executable-complete boundary (device buffers ready).
                 self._jax.block_until_ready(device_outs)
@@ -321,7 +328,8 @@ class Model:
             self._set_state("fetching outputs")
             host: dict[str, np.ndarray] = {}
             for name, val in outputs.items():
-                arr = np.asarray(val)
+                arr = val if not fetch_outputs and \
+                    isinstance(val, self._jax.Array) else np.asarray(val)
                 if pad_to is not None and batch_size is not None \
                         and arr.ndim >= 1 and arr.shape[0] == pad_to:
                     arr = arr[:batch_size]

@@ -44,12 +44,18 @@ from client_tpu.engine.types import (
 
 
 class _SequenceSlot:
-    __slots__ = ("state", "lock", "last_used_ns")
+    __slots__ = ("state", "lock", "last_used_ns", "inflight")
 
     def __init__(self, state):
         self.state = state
         self.lock = threading.Lock()
         self.last_used_ns = now_ns()
+        # Executions holding this slot right now. last_used_ns is only
+        # written AFTER a step completes, so idle-GC judging by timestamp
+        # alone would evict a slot whose step merely outlasts the idle
+        # window — silently resetting live sequence state. GC must skip
+        # any slot with inflight > 0.
+        self.inflight = 0
 
 
 class SequenceScheduler(Scheduler):
@@ -60,6 +66,17 @@ class SequenceScheduler(Scheduler):
         self._slots: dict[int, _SequenceSlot] = {}
         self._slots_lock = threading.Lock()
         super().__init__(model, stats)
+
+    def submit(self, req: InferRequest) -> None:
+        # Arrival IS a use: refresh liveness at enqueue so a request waiting
+        # in the queue can't watch its own sequence be idle-GC'd (queue
+        # delay is engine load, not client idleness).
+        if req.sequence_id:
+            with self._slots_lock:
+                slot = self._slots.get(req.sequence_id)
+                if slot is not None:
+                    slot.last_used_ns = now_ns()
+        super().submit(req)
 
     def _worker_loop(self) -> None:
         while True:
@@ -85,8 +102,16 @@ class SequenceScheduler(Scheduler):
                         "inactive sequence", 400)
                 slot = _SequenceSlot(self.model.backend.initial_state())
                 self._slots[sid] = slot
+            # Claim before GC runs so neither this slot nor any slot with a
+            # step in flight can be evicted out from under its execution.
+            slot.inflight += 1
             self._gc_idle_locked()
             return slot
+
+    def _put_slot(self, slot: _SequenceSlot) -> None:
+        with self._slots_lock:
+            slot.inflight -= 1
+            slot.last_used_ns = now_ns()
 
     def _gc_idle_locked(self) -> None:
         sb = self.model.config.sequence_batching
@@ -94,7 +119,8 @@ class SequenceScheduler(Scheduler):
             return
         idle_ns = sb.max_sequence_idle_microseconds * 1000
         cutoff = now_ns() - idle_ns
-        dead = [sid for sid, s in self._slots.items() if s.last_used_ns < cutoff]
+        dead = [sid for sid, s in self._slots.items()
+                if s.last_used_ns < cutoff and s.inflight == 0]
         for sid in dead:
             del self._slots[sid]
 
@@ -106,11 +132,13 @@ class SequenceScheduler(Scheduler):
         slot = self._get_slot(req)
         start = now_ns()
         req.times.compute_start = start
-        with slot.lock:  # in-order, one in-flight request per sequence
-            new_state, outputs = self.model.execute_stateful(
-                slot.state, req.inputs)
-            slot.state = new_state
-            slot.last_used_ns = now_ns()
+        try:
+            with slot.lock:  # in-order, one in-flight request per sequence
+                new_state, outputs = self.model.execute_stateful(
+                    slot.state, req.inputs)
+                slot.state = new_state
+        finally:
+            self._put_slot(slot)
         if req.sequence_end:
             with self._slots_lock:
                 self._slots.pop(req.sequence_id, None)
@@ -190,8 +218,15 @@ class OldestSequenceScheduler(Scheduler):
 
     # -- slot management -----------------------------------------------------
 
-    def _acquire_row(self, req: InferRequest) -> tuple[int, bool]:
-        """Returns (arena row, reset-state?) for the request's sequence."""
+    def _acquire_row(self, req: InferRequest,
+                     protect: set[int] | None = None) -> tuple[int, bool]:
+        """Returns (arena row, reset-state?) for the request's sequence.
+
+        ``protect`` — sequence ids that have a request in the wave being
+        assembled: idle-GC must not evict them even if their ``last_used``
+        timestamp is stale (their step is about to run, which IS a use;
+        evicting here would turn a queued request into a 400 and drop live
+        arena state)."""
         sid = req.sequence_id
         if sid == 0:
             raise EngineError(
@@ -204,7 +239,7 @@ class OldestSequenceScheduler(Scheduler):
                     raise EngineError(
                         f"sequence {sid}: request without start flag for an "
                         "inactive sequence", 400)
-                self._gc_idle_locked()
+                self._gc_idle_locked(protect)
                 if not self._free:
                     raise EngineError(
                         f"max candidate sequences "
@@ -221,10 +256,11 @@ class OldestSequenceScheduler(Scheduler):
             if row is not None:
                 self._free.append(row)
 
-    def _gc_idle_locked(self) -> None:
+    def _gc_idle_locked(self, protect: set[int] | None = None) -> None:
         sb = self.model.config.sequence_batching
         cutoff = now_ns() - sb.max_sequence_idle_microseconds * 1000
-        dead = [sid for sid, ts in self._last_used.items() if ts < cutoff]
+        dead = [sid for sid, ts in self._last_used.items()
+                if ts < cutoff and (protect is None or sid not in protect)]
         for sid in dead:
             row = self._rows.pop(sid, None)
             self._last_used.pop(sid, None)
@@ -232,6 +268,16 @@ class OldestSequenceScheduler(Scheduler):
                 self._free.append(row)
 
     # -- scheduling ----------------------------------------------------------
+
+    def submit(self, req: InferRequest) -> None:
+        # Arrival refreshes liveness (see SequenceScheduler.submit): a
+        # queued continuation must not lose its arena row to idle-GC while
+        # waiting behind a full wave.
+        if req.sequence_id:
+            with self._arena_lock:
+                if req.sequence_id in self._last_used:
+                    self._last_used[req.sequence_id] = now_ns()
+        super().submit(req)
 
     def _worker_loop(self) -> None:
         while True:
@@ -290,10 +336,11 @@ class OldestSequenceScheduler(Scheduler):
     def _execute_wave(self, batch: list[InferRequest]) -> None:
         start = now_ns()
         rows, resets, live = [], [], []
+        wave_sids = {r.sequence_id for r in batch}
         for r in batch:
             r.times.compute_start = start
             try:
-                row, reset = self._acquire_row(r)
+                row, reset = self._acquire_row(r, protect=wave_sids)
             except EngineError as exc:
                 self._fail(r, exc)
                 continue

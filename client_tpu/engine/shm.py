@@ -309,6 +309,12 @@ class TpuShmManager:
         with self._lock:
             return name in self._regions
 
+    def region_kind(self, name) -> str | None:
+        """'device' | 'host_staged' | None (not registered here)."""
+        with self._lock:
+            region = self._regions.get(name)
+            return region.kind if region is not None else None
+
     def status(self, name: str | None = None) -> dict:
         with self._lock:
             items = (

@@ -94,6 +94,11 @@ class InferRequest:
     # Plain bool — writes are GIL-atomic and stale reads only delay the
     # cancel by one wave.
     cancelled: bool = False
+    # Set by in-process callers whose every requested output is placed into
+    # a device-resident tpu-shm region: the batch executor then skips the
+    # D2H fetch entirely and responses carry HBM-resident jax.Arrays (the
+    # shm write stores them as-is — zero host bytes end to end).
+    keep_outputs_on_device: bool = False
 
     def cancel(self) -> None:
         self.cancelled = True

@@ -193,6 +193,56 @@ class TestSequence:
             _infer(engine, "simple_sequence",
                    {"INPUT": np.array([1], np.int32)}, sequence_id=999)
 
+    def test_step_outlasting_idle_window_survives_gc(self):
+        """A step that runs longer than max_sequence_idle_microseconds must
+        not lose its slot to a concurrent sequence's idle-GC (the r2 race:
+        last_used_ns is only written after the step completes, so a slow
+        in-flight step looked idle). State survives, never silent reset."""
+        import time as _time
+
+        from client_tpu.engine.repository import ModelRepository
+        from client_tpu.models.simple import SequenceAccumulateBackend
+
+        class SlowSeq(SequenceAccumulateBackend):
+            jittable = False
+
+            def make_apply(self):
+                inner = super().make_apply()
+
+                def apply(state, inputs):
+                    _time.sleep(0.4)  # outlasts the 100 ms idle window
+                    return inner(state, inputs)
+                return apply
+
+        backend = SlowSeq(name="slow_seq")
+        backend.config.sequence_batching.max_sequence_idle_microseconds = \
+            100_000
+        backend.config.instance_count = 2
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        eng = TpuEngine(repo)
+        try:
+            def step(sid, v, **kw):
+                return int(eng.infer(InferRequest(
+                    model_name="slow_seq",
+                    inputs={"INPUT": np.array([v], np.int32)},
+                    sequence_id=sid, **kw),
+                    timeout_s=60).outputs["OUTPUT"][0])
+
+            res: dict[str, int] = {}
+            t = threading.Thread(target=lambda: res.setdefault(
+                "a", step(1, 5, sequence_start=True)))
+            t.start()
+            _time.sleep(0.2)  # seq 1's step is in flight and "idle"-stale
+            # New sequence triggers slot GC while seq 1 executes.
+            step(2, 1, sequence_start=True, sequence_end=True)
+            t.join()
+            assert res.get("a") == 5
+            # Seq 1's state survived the concurrent GC: accumulation holds.
+            assert step(1, 3, sequence_end=True) == 8
+        finally:
+            eng.shutdown()
+
 
 class TestDecoupled:
     def test_streaming_responses(self, engine):
@@ -753,6 +803,65 @@ class TestOldestSequenceBatcher:
         # start flag on a live sequence restarts it (state reset)
         assert self._step(oldest_engine, 55, 10, start=True) == 10
         assert self._step(oldest_engine, 55, 1, end=True) == 11
+
+    def test_idle_sequence_with_queued_request_survives_gc(self):
+        """An idle-stale sequence whose next step is already in the forming
+        wave must not be evicted by a new sequence's row acquisition in that
+        same wave (the r2 arena race: time-based GC against queued work)."""
+        import time as _time
+
+        from client_tpu.engine.repository import ModelRepository
+        from client_tpu.models.simple import SequenceAccumulateBackend
+
+        backend = SequenceAccumulateBackend(
+            name="gc_oldest", strategy="oldest")
+        backend.config.sequence_batching.max_sequence_idle_microseconds = \
+            100_000
+        # Wide candidate window so both requests below join one wave.
+        backend.config.sequence_batching.max_queue_delay_microseconds = \
+            100_000
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        eng = TpuEngine(repo)
+        try:
+            def step(sid, v, **kw):
+                return int(eng.infer(InferRequest(
+                    model_name="gc_oldest",
+                    inputs={"INPUT": np.array([v], np.int32)},
+                    sequence_id=sid, **kw),
+                    timeout_s=60).outputs["OUTPUT"][0])
+
+            assert step(1, 5, sequence_start=True) == 5
+            _time.sleep(0.25)  # seq 1 now idle-stale
+
+            results: dict[int, object] = {}
+            done = {9: threading.Event(), 1: threading.Event()}
+
+            def cb(sid):
+                def _cb(resp):
+                    results[sid] = (resp.error if resp.error is not None
+                                    else int(resp.outputs["OUTPUT"][0]))
+                    done[sid].set()
+                return _cb
+
+            # New sequence enqueued FIRST: its row acquisition runs the GC
+            # with seq 1's (stale) step queued in the same wave.
+            eng.async_infer(InferRequest(
+                model_name="gc_oldest",
+                inputs={"INPUT": np.array([7], np.int32)},
+                sequence_id=9, sequence_start=True, sequence_end=True),
+                cb(9))
+            eng.async_infer(InferRequest(
+                model_name="gc_oldest",
+                inputs={"INPUT": np.array([3], np.int32)},
+                sequence_id=1, sequence_end=True), cb(1))
+            assert done[9].wait(60) and done[1].wait(60)
+            assert results[9] == 7
+            # Pre-fix this was an EngineError 400 (row evicted mid-wave);
+            # the queued step must see the accumulated state.
+            assert results[1] == 8
+        finally:
+            eng.shutdown()
 
     def test_failed_wave_resets_arena_and_keeps_serving(self):
         """A raising step execution must not brick the scheduler: the
