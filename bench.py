@@ -324,13 +324,28 @@ def _shm_ab_modes(engine, model_name: str, inputs: dict, output_specs: dict,
         def infer_device():
             capi_embed.infer(engine, req_dev, [None] * len(inputs))
 
+        def warm_mode(fn):
+            # Concurrent bursts of every power-of-two size up to the
+            # measured concurrency: drives each wave bucket through the
+            # scheduler so no XLA compile (batch apply OR device-concat)
+            # lands inside a measurement window.
+            k = 1
+            while True:
+                ts = [threading.Thread(target=fn) for _ in range(k)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                if k >= concurrency:
+                    break
+                k = min(k * 2, concurrency)
+
         modes = [("none", infer_none), ("system", infer_system),
                  ("tpu", infer_tpu), ("device", infer_device)]
         for mode, fn in modes:
-            for _ in range(8):  # warm request-path caches per mode
-                fn()
+            warm_mode(fn)
             res = run_stable_load(fn, concurrency, window_s=window_s,
-                                  max_windows=8, tag=f"{tag}-{mode}")
+                                  max_windows=10, tag=f"{tag}-{mode}")
             results[mode] = {"ips": round(res["ips"], 1),
                              "p99_us": round(res["p99_us"], 1),
                              "stable": res["stable"]}

@@ -47,15 +47,70 @@ _log = logging.getLogger("client_tpu")
 
 
 class _Stream:
-    __slots__ = ("req", "row", "length", "last_token", "emitted", "max_new")
+    __slots__ = ("req", "row", "length", "last_token", "emitted", "max_new",
+                 "seed", "temp", "top_k", "top_p", "stop")
 
-    def __init__(self, req, row, length, last_token, max_new):
+    def __init__(self, req, row, length, last_token, max_new,
+                 seed=0, temp=0.0, top_k=0, top_p=1.0, stop=frozenset()):
         self.req = req
         self.row = row
         self.length = length          # positions filled in the KV row
         self.last_token = last_token  # next decode step's input token
         self.emitted = 0
         self.max_new = max_new
+        self.seed = seed              # per-request PRNG seed
+        self.temp = temp              # 0 = greedy
+        self.top_k = top_k            # 0 = off
+        self.top_p = top_p            # 1.0 = off
+        self.stop = stop              # token ids terminating the stream
+
+
+def _parse_sampling(req: InferRequest, vocab: int):
+    """(seed, temp, top_k, top_p, stop_set) from request parameters.
+
+    Defaults are greedy (temperature 0), matching the pre-sampling engine
+    bit for bit. ``stop_token_ids`` accepts an int or a comma-separated
+    string (wire parameters are scalar); ``eos_id`` is its single-token
+    alias."""
+    p = req.parameters
+
+    def num(key, default, cast, lo=None, hi=None):
+        try:
+            v = cast(p.get(key, default))
+        except (TypeError, ValueError):
+            raise EngineError(
+                f"{key} must be {cast.__name__}, got {p.get(key)!r}",
+                400) from None
+        if (lo is not None and v < lo) or (hi is not None and v > hi):
+            raise EngineError(
+                f"{key} must be in [{lo}, {hi}], got {v}", 400)
+        return v
+
+    seed = num("seed", 0, int)
+    temp = num("temperature", 0.0, float, lo=0.0)
+    top_k = num("top_k", 0, int, lo=0)
+    top_p = num("top_p", 1.0, float, lo=0.0, hi=1.0)
+    if top_p == 0.0:
+        raise EngineError("top_p must be in (0, 1]", 400)
+    stop: set[int] = set()
+    raw_stop = p.get("stop_token_ids", None)
+    if raw_stop is None:
+        raw_stop = p.get("eos_id", None)
+    if raw_stop is not None:
+        parts = (str(raw_stop).split(",")
+                 if isinstance(raw_stop, str) else [raw_stop])
+        for part in parts:
+            try:
+                tok = int(part)
+            except (TypeError, ValueError):
+                raise EngineError(
+                    f"stop_token_ids must be ints, got {part!r}",
+                    400) from None
+            if not 0 <= tok < vocab:
+                raise EngineError(
+                    f"stop token {tok} outside vocab [0, {vocab})", 400)
+            stop.add(tok)
+    return seed, temp, top_k, top_p, frozenset(stop)
 
 
 class GenerativeScheduler(Scheduler):
@@ -71,10 +126,17 @@ class GenerativeScheduler(Scheduler):
         self._cap = int(backend.max_streams)
         self._max_seq = int(backend.max_seq_len)
         self._arena = backend.init_arena(self._cap)
-        self._prefill = jax.jit(backend.prefill_fn(), donate_argnums=(1,))
-        self._decode = jax.jit(backend.decode_fn(), donate_argnums=(1,))
+        # `sample` (arg 9) is static: all-greedy calls get an executable
+        # with no sampling pipeline in it.
+        self._prefill = jax.jit(backend.prefill_fn(), donate_argnums=(1,),
+                                static_argnums=(9,))
+        self._decode = jax.jit(backend.decode_fn(), donate_argnums=(1,),
+                               static_argnums=(9,))
         self._prompt_buckets = power_buckets(self._max_seq)
         self._wave_buckets = power_buckets(self._cap)
+        # Admit-batch ceiling: bounds (prompt bucket × admit bucket) compile
+        # pairs while still folding a burst of admits into few prefills.
+        self._admit_buckets = power_buckets(min(self._cap, 8))
         self._streams: list[_Stream] = []
         self._free = list(range(self._cap))
         super().__init__(model, stats)
@@ -83,24 +145,37 @@ class GenerativeScheduler(Scheduler):
 
     def _worker_loop(self) -> None:
         while True:
-            # Blocking admit when idle; opportunistic admits otherwise —
-            # a new request joins the *next* wave, never waits for a
-            # stream to finish.
+            # Blocking admit when idle; opportunistic admits otherwise — a
+            # new request joins the *next* wave, never waits for a stream
+            # to finish. Admits collected in one pass share batched
+            # prefills (grouped by prompt bucket), so an N-stream burst
+            # costs a handful of device round trips, not N.
+            pending = []
             if not self._streams:
                 item = self.queue.get()
                 if item is _SHUTDOWN:
                     return
-                self._try_admit(item)
-                continue
-            while self._free:
+                pending.append(item)
+            shutdown = False
+            while len(self._free) > len(pending):
                 try:
                     item = self.queue.get(timeout=0)
                 except _queue.Empty:
                     break
                 if item is _SHUTDOWN:
-                    self._abort_streams("server shutting down")
-                    return
-                self._try_admit(item)
+                    shutdown = True
+                    break
+                pending.append(item)
+            if pending:
+                try:
+                    self._admit_batch(pending)
+                except Exception as exc:  # noqa: BLE001 — sole worker:
+                    # an escape here would kill the scheduler thread and
+                    # hang the model permanently.
+                    self._reset_arena(exc)
+            if shutdown:
+                self._abort_streams("server shutting down")
+                return
             # Client-abandoned streams stop consuming decode slots at the
             # next wave boundary (frontends set `cancelled` on disconnect).
             for s in list(self._streams):
@@ -114,18 +189,8 @@ class GenerativeScheduler(Scheduler):
                 except Exception as exc:  # noqa: BLE001
                     self._reset_arena(exc)
 
-    def _try_admit(self, item) -> None:
-        req: InferRequest = item
-        if self._check_timeout(req) or self._check_cancelled(req):
-            return
-        try:
-            self._admit(req)
-        except EngineError as exc:
-            self._fail(req, exc)
-        except Exception as exc:  # noqa: BLE001
-            self._reset_arena(exc, failing=req)
-
-    def _admit(self, req: InferRequest) -> None:
+    def _validate(self, req: InferRequest):
+        """Parse + validate one admit; returns (ids, max_new, sampling)."""
         ids = np.ravel(np.asarray(req.inputs["INPUT_IDS"])).astype(np.int32)
         try:
             max_new = int(req.parameters.get(
@@ -145,29 +210,105 @@ class GenerativeScheduler(Scheduler):
         vocab = self.model.backend.vocab
         if (ids < 0).any() or (ids >= vocab).any():
             raise EngineError(f"token ids must be in [0, {vocab})", 400)
-        req.times.compute_start = now_ns()
-        row = self._free.pop()
-        try:
-            bucket = next(b for b in self._prompt_buckets if b >= len(ids))
-            padded = np.zeros(bucket, np.int32)
-            padded[:len(ids)] = ids
-            self.model._set_state(
-                f"generative prefill (prompt bucket={bucket})")
+        return ids, max_new, _parse_sampling(req, vocab)
+
+    def _admit_batch(self, items: list) -> None:
+        """Validate, group by prompt bucket, one batched prefill per chunk."""
+        ready = []  # (req, ids, max_new, sampling)
+        for req in items:
+            if self._check_timeout(req) or self._check_cancelled(req):
+                continue
             try:
-                self._arena, token = self._prefill(
-                    self.model._params, self._arena, np.int32(row), padded,
-                    np.int32(len(ids)))
-                token = int(token)
+                ids, max_new, sampling = self._validate(req)
+            except EngineError as exc:
+                self._fail(req, exc)
+                continue
+            except Exception as exc:  # noqa: BLE001 — malformed request
+                # reaching the scheduler must fail that request, not the
+                # admit batch (let alone the worker).
+                self._fail(req, EngineError(f"invalid request: {exc}", 400))
+                continue
+            req.times.compute_start = now_ns()
+            ready.append((req, ids, max_new, sampling))
+        by_bucket: dict[int, list] = {}
+        for entry in ready:
+            bucket = next(b for b in self._prompt_buckets
+                          if b >= len(entry[1]))
+            by_bucket.setdefault(bucket, []).append(entry)
+        chunks = []
+        for bucket, entries in sorted(by_bucket.items()):
+            cap = self._admit_buckets[-1]
+            chunks += [(bucket, entries[i:i + cap])
+                       for i in range(0, len(entries), cap)]
+        for ci, (bucket, chunk) in enumerate(chunks):
+            try:
+                self._prefill_chunk(bucket, chunk)
+            except EngineError as exc:
+                for req, *_ in chunk:
+                    self._fail(req, exc)
+            except Exception as exc:  # noqa: BLE001
+                # Donated-arena failure: everything queued behind this
+                # chunk fails too (the arena is being rebuilt).
+                for _, later in chunks[ci + 1:]:
+                    for req, *_ in later:
+                        self._fail(req, EngineError(
+                            f"generation aborted: {exc}", 500))
+                for req, *_ in chunk[1:]:
+                    self._fail(req, EngineError(
+                        f"generation aborted: {exc}", 500))
+                self._reset_arena(exc, failing=chunk[0][0])
+                return
+
+    def _prefill_chunk(self, prompt_bucket: int, chunk: list) -> None:
+        """One batched prefill: B admits -> ONE device round trip."""
+        n = len(chunk)
+        lane_bucket = next(b for b in self._admit_buckets if b >= n)
+        pad = lane_bucket - n
+        rows = [self._free.pop() for _ in range(n)]
+        try:
+            ids_mat = np.zeros((lane_bucket, prompt_bucket), np.int32)
+            lens = np.ones(lane_bucket, np.int32)
+            seeds = np.zeros(lane_bucket, np.uint32)
+            temps = np.zeros(lane_bucket, np.float32)
+            top_ks = np.zeros(lane_bucket, np.int32)
+            top_ps = np.ones(lane_bucket, np.float32)
+            for i, (req, ids, max_new, (seed, temp, top_k, top_p,
+                                        stop)) in enumerate(chunk):
+                ids_mat[i, :len(ids)] = ids
+                lens[i] = len(ids)
+                seeds[i] = seed & 0xFFFFFFFF
+                temps[i] = temp
+                top_ks[i] = top_k
+                top_ps[i] = top_p
+            seeds = seeds.astype(np.int32)
+            rows_arr = np.asarray(
+                rows + [self._cap] * pad, np.int32)  # dummy row pads
+            self.model._set_state(
+                f"generative prefill ({n} streams, prompt "
+                f"bucket={prompt_bucket})")
+            try:
+                self._arena, tokens = self._prefill(
+                    self.model._params, self._arena, rows_arr, ids_mat,
+                    lens, seeds, temps, top_ks, top_ps,
+                    bool((temps > 0.0).any()))
+                tokens = np.asarray(tokens)
             finally:
                 self.model._clear_state()
         except Exception:
-            self._free.append(row)
+            self._free.extend(rows)
             raise
-        stream = _Stream(req, row, len(ids), token, max_new)
-        self._streams.append(stream)
-        self._emit_token(stream, token)
-        self.stats.record_execution(1)
-        self._finish_if_done(stream)
+        self.stats.record_execution(n)
+        for i, (req, ids, max_new, (seed, temp, top_k, top_p,
+                                    stop)) in enumerate(chunk):
+            stream = _Stream(req, rows[i], len(ids), int(tokens[i]), max_new,
+                             seed=seed, temp=temp, top_k=top_k, top_p=top_p,
+                             stop=stop)
+            self._streams.append(stream)
+            if stream.last_token in stream.stop:
+                self._retire(stream)
+                continue
+            self._emit_token(stream, stream.last_token)
+            self._finish_if_done(stream)
 
     def _decode_wave(self) -> None:
         live = self._streams
@@ -177,11 +318,18 @@ class GenerativeScheduler(Scheduler):
         tokens = np.asarray([s.last_token for s in live] + [0] * pad,
                             np.int32)
         lens = np.asarray([s.length for s in live] + [0] * pad, np.int32)
+        seeds = np.asarray([s.seed & 0xFFFFFFFF for s in live] + [0] * pad,
+                           np.uint32).astype(np.int32)
+        temps = np.asarray([s.temp for s in live] + [0.0] * pad, np.float32)
+        top_ks = np.asarray([s.top_k for s in live] + [0] * pad, np.int32)
+        top_ps = np.asarray([s.top_p for s in live] + [1.0] * pad,
+                            np.float32)
         self.model._set_state(
             f"generative decode wave ({len(live)} streams, bucket={bucket})")
         try:
             self._arena, nxt = self._decode(
-                self.model._params, self._arena, rows, tokens, lens)
+                self.model._params, self._arena, rows, tokens, lens,
+                seeds, temps, top_ks, top_ps, bool((temps > 0.0).any()))
             nxt = np.asarray(nxt)
         finally:
             self.model._clear_state()
@@ -190,6 +338,10 @@ class GenerativeScheduler(Scheduler):
         for i, s in enumerate(live):
             s.length += 1          # the token just consumed now occupies a slot
             s.last_token = int(nxt[i])
+            if s.last_token in s.stop:
+                # Stop tokens terminate without being emitted.
+                finished.append(s)
+                continue
             self._emit_token(s, s.last_token)
             if self._stream_done(s):
                 finished.append(s)

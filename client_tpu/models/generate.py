@@ -39,6 +39,38 @@ def _ln(x, g, b, eps=1e-5):
     return (x - m) / jnp.sqrt(v + eps) * g + b
 
 
+def _sample_token(logits, seed, ctx_len, temp, top_k, top_p):
+    """Per-stream token choice, fully jit-traceable (vmap over streams).
+
+    - ``temp <= 0`` → greedy argmax (the default; bit-identical to the
+      pre-sampling engine).
+    - Otherwise: temperature-scaled logits, top-k rank cut (``top_k == 0``
+      keeps all), nucleus top-p cumulative cut (first token always kept),
+      then a categorical draw.
+
+    Determinism contract: the PRNG key is ``fold_in(PRNGKey(seed),
+    ctx_len)`` where ``ctx_len`` is the context length at sampling time —
+    a pure function of (request seed, position), NOT of batch composition,
+    so batched decode stays bit-identical to solo decode under sampling.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), ctx_len)
+    scaled = logits / jnp.maximum(temp, 1e-6)
+    order = jnp.argsort(-scaled)
+    sl = scaled[order]
+    probs = jax.nn.softmax(sl)
+    cum = jnp.cumsum(probs)
+    idx = jnp.arange(sl.shape[0])
+    keep = ((cum - probs) < top_p) & jnp.where(top_k > 0, idx < top_k, True)
+    keep = keep.at[0].set(True)
+    choice = jax.random.categorical(key, jnp.where(keep, sl, -jnp.inf))
+    sampled = order[choice].astype(jnp.int32)
+    return jnp.where(temp <= 0.0, greedy, sampled)
+
+
 class TinyGptBackend(ModelBackend):
     """Decoder-only LM: INPUT_IDS [-1] -> streamed (TOKEN, INDEX) responses.
 
@@ -169,44 +201,73 @@ class TinyGptBackend(ModelBackend):
                 "v": jnp.zeros(shape, jnp.float32)}
 
     def prefill_fn(self):
-        """(params, arena, row, ids[S_pad], length) -> (arena, first_token).
+        """(params, arena, rows[B], ids[B, S_pad], lens[B], seeds[B],
+        temps[B], top_ks[B], top_ps[B]) -> (arena, first_tokens[B]).
 
-        Writes the prompt's K/V into the arena row and returns the argmax
-        token after the last real position. Causal masking makes the padded
-        tail invisible to every valid query.
+        BATCHED prefill: writes each prompt's K/V into its arena row and
+        samples the first token after each prompt's last real position —
+        B admits cost ONE device round trip instead of B (round-2's
+        per-admit prefill stalled every live decode stream for each admit).
+        Causal masking makes the padded tail invisible to every valid
+        query; padded LANES (rows pointing at the dummy row) are absorbed
+        the same way decode waves absorb them.
         """
-        import jax.numpy as jnp
+        import jax
 
-        def prefill(p, arena, row, ids, length):
-            n = ids.shape[0]
-            x, _pos = self._embed_positions(p, ids, 0)
-            box = {"arena": arena}
+        def prefill(p, arena, rows, ids, lens, seeds, temps, top_ks, top_ps,
+                    sample=True):
+            n = ids.shape[1]
 
-            def write_kv(li, k, v):
-                a = box["arena"]
-                box["arena"] = {"k": a["k"].at[li, row, :n].set(k),
-                                "v": a["v"].at[li, row, :n].set(v)}
+            def one(ids_row):
+                x, _pos = self._embed_positions(p, ids_row, 0)
+                ks, vs = [], []
+                x = self._stack(p, x, causal=True,
+                                on_kv=lambda li, k, v:
+                                (ks.append(k), vs.append(v)))
+                import jax.numpy as jnp
 
-            x = self._stack(p, x, causal=True, on_kv=write_kv)
-            xf = _ln(x[length - 1], p["lnfg"], p["lnfb"])
-            token = jnp.argmax(xf @ p["head"]).astype(jnp.int32)
-            return box["arena"], token
+                return x, jnp.stack(ks), jnp.stack(vs)  # [S,d],[L,S,H,D]x2
+
+            xB, kB, vB = jax.vmap(one)(ids)              # [B,...]
+            # Scatter whole prompt rows: [B,L,S,H,D] -> arena [L,rows,:n]
+            arena = {
+                "k": arena["k"].at[:, rows, :n].set(
+                    kB.transpose(1, 0, 2, 3, 4)),
+                "v": arena["v"].at[:, rows, :n].set(
+                    vB.transpose(1, 0, 2, 3, 4)),
+            }
+            import jax.numpy as jnp
+
+            b = rows.shape[0]
+            xf = _ln(xB[jnp.arange(b), lens - 1], p["lnfg"], p["lnfb"])
+            logits = xf @ p["head"]                      # [B, vocab]
+            # `sample` is a STATIC arg: the all-greedy variant (the default
+            # workload) compiles without the sort/cumsum/PRNG pipeline —
+            # jnp.where alone would keep both branches in the executable.
+            if sample:
+                tokens = jax.vmap(_sample_token)(
+                    logits, seeds, lens, temps, top_ks, top_ps)
+            else:
+                tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return arena, tokens
 
         return prefill
 
     def decode_fn(self):
-        """(params, arena, rows[B], tokens[B], lens[B]) -> (arena, next[B]).
+        """(params, arena, rows[B], tokens[B], lens[B], seeds[B], temps[B],
+        top_ks[B], top_ps[B]) -> (arena, next[B]).
 
         One batched decode step: scatter each stream's new K/V at its
         current position, masked attention over the static max_seq_len
-        axis, argmax next token per stream.
+        axis, per-stream sampled (or greedy) next token.
         """
         import jax
         import jax.numpy as jnp
 
         h_, d_ = self.n_heads, self.head_dim
 
-        def decode(p, arena, rows, tokens, lens):
+        def decode(p, arena, rows, tokens, lens, seeds, temps, top_ks,
+                   top_ps, sample=True):
             b = rows.shape[0]
             x = p["embed"][tokens] + p["pos"][lens]          # [B, d]
             for li, lp in enumerate(p["layers"]):
@@ -228,7 +289,15 @@ class TinyGptBackend(ModelBackend):
                 h2 = _ln(x, lp["ln2g"], lp["ln2b"])
                 x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
             xf = _ln(x, p["lnfg"], p["lnfb"])
-            nxt = jnp.argmax(xf @ p["head"], axis=-1).astype(jnp.int32)
+            logits = xf @ p["head"]                          # [B, vocab]
+            # ctx at sampling = lens + 1 (the token just written occupies
+            # position lens) — continues the prefill fold sequence exactly.
+            # `sample` static: all-greedy waves skip the sampling pipeline.
+            if sample:
+                nxt = jax.vmap(_sample_token)(
+                    logits, seeds, lens + 1, temps, top_ks, top_ps)
+            else:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return arena, nxt
 
         return decode

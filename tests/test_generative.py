@@ -21,7 +21,7 @@ def engine():
     eng.shutdown()
 
 
-def generate(engine, prompt, max_tokens, timeout=120):
+def generate(engine, prompt, max_tokens, timeout=120, **params):
     """Run one stream to completion; returns the token list."""
     tokens: list[int] = []
     err: list = []
@@ -41,7 +41,7 @@ def generate(engine, prompt, max_tokens, timeout=120):
     engine.async_infer(
         InferRequest(model_name="tiny_gpt",
                      inputs={"INPUT_IDS": np.asarray(prompt, np.int32)},
-                     parameters={"max_tokens": max_tokens}),
+                     parameters={"max_tokens": max_tokens, **params}),
         cb)
     assert done.wait(timeout), "stream did not finish"
     if err:
@@ -170,6 +170,124 @@ class TestGenerative:
         # 16 prefills + decode waves; without wave sharing the 16 streams'
         # 7 post-prefill tokens each would need 112 decode executions.
         assert execs - 16 < 60, execs
+
+
+class TestSampling:
+    """Per-request sampling (temperature / top-k / top-p / seed) and stop
+    tokens — the r2 VERDICT #3 surface."""
+
+    def test_temp_zero_equals_greedy_default(self, engine):
+        base = generate(engine, [5, 6, 7], 8)
+        assert generate(engine, [5, 6, 7], 8, temperature=0.0,
+                        seed=123) == base
+        assert generate(engine, [5, 6, 7], 8, temperature=0.0, top_k=3,
+                        top_p=0.5) == base  # cuts are no-ops under greedy
+
+    def test_sampling_deterministic_per_seed(self, engine):
+        a = generate(engine, [5, 6, 7], 12, temperature=1.0, seed=42)
+        b = generate(engine, [5, 6, 7], 12, temperature=1.0, seed=42)
+        assert a == b
+        c = generate(engine, [5, 6, 7], 12, temperature=1.0, seed=43)
+        assert a != c  # 512-way categorical x12: collision ~ impossible
+
+    def test_sampling_differs_from_greedy_and_varies(self, engine):
+        greedy = generate(engine, [9, 9], 16)
+        hot = generate(engine, [9, 9], 16, temperature=5.0, seed=7)
+        assert hot != greedy
+        assert len(set(hot)) > 1  # high temperature explores the vocab
+
+    def test_top_k_one_is_greedy_regardless_of_temperature(self, engine):
+        base = generate(engine, [3, 1, 4], 8)
+        assert generate(engine, [3, 1, 4], 8, temperature=3.0, top_k=1,
+                        seed=99) == base
+
+    def test_batch_invariance_under_sampling(self, engine):
+        """The fold_in(seed, position) contract: sampled streams sharing
+        decode waves are bit-identical to the same request run solo."""
+        prompts = [[i, i + 1] for i in range(1, 9)]
+        solo = [generate(engine, p, 6, temperature=1.0, seed=100 + i)
+                for i, p in enumerate(prompts)]
+        results: list = [None] * len(prompts)
+        errs: list = []
+
+        def run(i):
+            try:
+                results[i] = generate(engine, prompts[i], 6,
+                                      temperature=1.0, seed=100 + i)
+            except Exception as exc:  # noqa: BLE001
+                errs.append((i, repr(exc)))
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        assert results == solo
+
+    def test_stop_token_terminates_stream(self, engine):
+        full = generate(engine, [2, 4, 6], 12)
+        stop = full[4]
+        got = generate(engine, [2, 4, 6], 12, stop_token_ids=stop)
+        # Tokens before the first stop occurrence, stop itself not emitted.
+        assert got == full[:full.index(stop)]
+
+    def test_stop_token_csv_and_eos_alias(self, engine):
+        full = generate(engine, [2, 4, 6], 12)
+        got = generate(engine, [2, 4, 6], 12,
+                       stop_token_ids=f"{full[3]},{full[5]}")
+        cut = min(full.index(full[3]), full.index(full[5]))
+        assert got == full[:cut]
+        got2 = generate(engine, [2, 4, 6], 12, eos_id=full[3])
+        assert got2 == full[:full.index(full[3])]
+
+    def test_invalid_sampling_params_rejected(self, engine):
+        for bad in ({"temperature": -1.0}, {"top_p": 0.0},
+                    {"top_p": 1.5}, {"top_k": -2},
+                    {"temperature": "hot"},
+                    {"stop_token_ids": "1,x"},
+                    {"stop_token_ids": 99999}):
+            with pytest.raises(EngineError) as ei:
+                generate(engine, [1], 4, **bad)
+            assert ei.value.status == 400, bad
+
+
+class TestBatchedPrefill:
+    def test_burst_admits_share_prefill_executions(self):
+        """A burst of N admits with same-bucket prompts must cost far fewer
+        prefill executions than N (r2: one prefill round trip per admit
+        stalled every live stream's decode)."""
+        eng = TpuEngine(build_repository(["tiny_gpt"]))
+        try:
+            generate(eng, [1, 2], 2)  # warm compile paths
+            s0 = eng.model_statistics("tiny_gpt")["model_stats"][0]
+            n = 16
+            barrier = threading.Barrier(n)
+            errs: list = []
+
+            def run(i):
+                try:
+                    barrier.wait(30)
+                    generate(eng, [i + 1, i + 2], 4)
+                except Exception as exc:  # noqa: BLE001
+                    errs.append(repr(exc))
+
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs, errs[:3]
+            s1 = eng.model_statistics("tiny_gpt")["model_stats"][0]
+            execs = s1["execution_count"] - s0["execution_count"]
+            # 16 admits in admit-bucket-8 chunks -> <= ~4 prefill
+            # executions (+1 per decode wave, ~4 waves): far under the 16
+            # prefills + 16*3 decodes the per-admit path would need.
+            assert execs <= 14, execs
+        finally:
+            eng.shutdown()
 
 
 class TestGenerativeGrpcStream:
