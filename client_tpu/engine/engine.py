@@ -96,53 +96,83 @@ class TpuEngine:
         }
 
     def model_is_ready(self, name: str, version: str = "") -> bool:
-        return self.repository.is_ready(name)
+        return self.repository.is_ready(name, version)
 
-    def _model(self, name: str):
-        model = self.repository.get(name)
+    @staticmethod
+    def _vkey(name: str, version: str | int = "") -> str:
+        """Scheduler/stats key: bare name = latest; 'name:v' per version."""
+        v = str(version).strip()
+        return f"{name}:{int(v)}" if v else name
+
+    def _model(self, name: str, version: str | int = ""):
+        model = self.repository.get(name, version)
         if model is None:
             if name in self.repository.names():
+                v = str(version).strip()
+                if v and self.repository.is_ready(name):
+                    raise EngineError(
+                        f"model '{name}' has no version '{v}'", 404)
                 raise EngineError(f"model '{name}' is not ready", 400)
             raise EngineError(f"unknown model '{name}'", 404)
         return model
 
     def model_metadata(self, name: str, version: str = "") -> dict:
-        return self._model(name).config.metadata_dict()
+        model = self._model(name, version)
+        versions = [str(v) for v in
+                    sorted(self.repository.loaded_versions(name))]
+        return model.config.metadata_dict(versions=versions or None)
 
     def model_config(self, name: str, version: str = "") -> dict:
-        return self._model(name).config.config_dict()
+        return self._model(name, version).config.config_dict()
 
     def model_statistics(self, name: str = "", version: str = "") -> dict:
         with self._lock:
+            # Versioned keys only — bare-name entries alias the latest
+            # version's stats object and would double-count.
+            items = sorted((k, s) for k, s in self._stats.items()
+                           if ":" in k)
             if name:
-                self._model(name)
-                stats = [self._stats[name].to_dict()] if name in self._stats else []
+                self._model(name, version)
+                vfilter = str(version).strip()
+                stats = [s.to_dict() for k, s in items
+                         if k.rsplit(":", 1)[0] == name
+                         and (not vfilter
+                              or k.rsplit(":", 1)[1] == str(int(vfilter)))]
             else:
-                stats = [s.to_dict() for _, s in sorted(self._stats.items())]
+                stats = [s.to_dict() for _, s in items]
         return {"model_stats": stats}
 
     # -- repository control --------------------------------------------------
 
     def load_model(self, name: str) -> None:
-        model = self.repository.load(name)
+        self.repository.load(name)
+        versions = self.repository.loaded_versions(name)
         with self._lock:
             if name in self._schedulers:
                 return
-            stats = self._stats.get(name)
-            if stats is None:
-                stats = ModelStats(name, str(model.config.version))
-                self._stats[name] = stats
             from client_tpu.engine.ensemble import EnsembleScheduler
             from client_tpu.engine.sequence import make_sequence_scheduler
 
-            self._schedulers[name] = make_scheduler(
-                model, stats,
-                sequence_cls=make_sequence_scheduler,
-                ensemble_cls=EnsembleScheduler,
-                engine=self,
-            )
+            for v, model in sorted(versions.items()):
+                key = self._vkey(name, v)
+                stats = self._stats.get(key)
+                if stats is None:
+                    stats = ModelStats(name, str(v))
+                    self._stats[key] = stats
+                self._schedulers[key] = make_scheduler(
+                    model, stats,
+                    sequence_cls=make_sequence_scheduler,
+                    ensemble_cls=EnsembleScheduler,
+                    engine=self,
+                )
+            latest = self._vkey(name, max(versions))
+            # Bare-name alias -> latest version (requests without an
+            # explicit version, and the pre-versioning internal API).
+            self._schedulers[name] = self._schedulers[latest]
+            self._stats[name] = self._stats[latest]
         if self._warmup:
-            model.warmup()
+            for _, model in sorted(versions.items()):
+                model.warmup()
 
     def unload_model(self, name: str, unload_dependents: bool = False) -> None:
         dependents: list[str] = []
@@ -152,9 +182,14 @@ class TpuEngine:
                 dependents = [s.model_name
                               for s in model.config.ensemble_scheduling]
         with self._lock:
-            sched = self._schedulers.pop(name, None)
-        if sched is not None:
-            sched.stop()
+            keys = [k for k in self._schedulers
+                    if k == name or k.rsplit(":", 1)[0] == name]
+            popped = [self._schedulers.pop(k) for k in keys]
+        seen: set[int] = set()
+        for sched in popped:
+            if id(sched) not in seen:
+                seen.add(id(sched))
+                sched.stop()
         self.repository.unload(name)
         for dep in dependents:
             if dep != name and not self._referenced_by_loaded_ensemble(dep):
@@ -186,14 +221,20 @@ class TpuEngine:
         if req.response_callback is None:
             raise EngineError("async_infer requires a response callback", 400)
         req.times.received = now_ns()
+        try:
+            key = self._vkey(req.model_name, req.model_version)
+        except (EngineError, ValueError):
+            req.response_callback(InferResponse.make_error(req, EngineError(
+                f"invalid model version '{req.model_version}'", 400)))
+            return
         with self._lock:
-            sched = self._schedulers.get(req.model_name)
+            sched = self._schedulers.get(key)
         if sched is None:
             # Resolve 404-vs-not-ready and deliver as a response, matching
             # how the wire protocols surface errors. (A model can be in the
             # repository but scheduler-less mid-load.)
             try:
-                self._model(req.model_name)
+                self._model(req.model_name, req.model_version)
                 raise EngineError(
                     f"model '{req.model_name}' is not ready", 400)
             except EngineError as exc:
@@ -216,7 +257,10 @@ class TpuEngine:
         decoupled model is an error) — their N-response streams are only
         reachable via :meth:`async_infer` / the gRPC stream frontend.
         """
-        model = self.repository.get(req.model_name)
+        try:
+            model = self.repository.get(req.model_name, req.model_version)
+        except EngineError:
+            model = None
         if model is not None and model.config.decoupled:
             raise EngineError(
                 f"model '{req.model_name}' is decoupled; use streaming "

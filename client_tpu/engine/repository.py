@@ -38,41 +38,75 @@ class ConfigOnlyBackend(ModelBackend):
             400)
 
 
+def _parse_version(version) -> int | None:
+    """'' / None -> None (latest); otherwise a positive int."""
+    if version is None:
+        return None
+    v = str(version).strip()
+    if not v:
+        return None
+    try:
+        n = int(v)
+    except ValueError:
+        raise EngineError(f"invalid model version '{version}'", 400) from None
+    if n < 1:
+        raise EngineError(f"invalid model version '{version}'", 400)
+    return n
+
+
 class ModelRepository:
+    """Versioned registry: each model name maps to one or more numbered
+    versions (reference route ``/v2/models/<m>/versions/<v>``,
+    /root/reference/src/c++/library/http_client.cc:1241-1245). Unversioned
+    registrations resolve their number at load from ``config.version``;
+    directory models get versions from numbered subdirectories filtered by
+    ``version_policy`` (latest / all / specific — Triton semantics,
+    default latest-1)."""
+
     def __init__(self, jit: bool = True):
-        self._builders: dict[str, Callable[[], ModelBackend]] = {}
-        self._loaded: dict[str, Model] = {}
+        # name -> {version-or-None: builder}; None = resolved at load.
+        self._builders: dict[str, dict[int | None,
+                                       Callable[[], ModelBackend]]] = {}
+        self._loaded: dict[str, dict[int, Model]] = {}
         self._state: dict[str, tuple[str, str]] = {}  # name -> (state, reason)
         self._lock = threading.RLock()
         self._jit = jit
 
-    def register(self, name: str,
-                 builder: Callable[[], ModelBackend]) -> None:
+    def register(self, name: str, builder: Callable[[], ModelBackend],
+                 version: int | None = None) -> None:
         with self._lock:
-            self._builders[name] = builder
+            self._builders.setdefault(name, {})[version] = builder
             self._state.setdefault(name, ("UNAVAILABLE", "unloaded"))
 
     def register_backend(self, backend: ModelBackend) -> None:
         self.register(backend.config.name, lambda: backend)
 
     def load(self, name: str) -> Model:
+        """Load every served version of ``name``; returns the latest."""
         with self._lock:
             if name in self._loaded:
-                return self._loaded[name]
-            builder = self._builders.get(name)
-            if builder is None:
+                vs = self._loaded[name]
+                return vs[max(vs)]
+            builders = self._builders.get(name)
+            if not builders:
                 raise EngineError(f"unknown model '{name}'", 404)
+            builders = dict(builders)
             self._state[name] = ("LOADING", "")
+        versions: dict[int, Model] = {}
         try:
-            model = Model(builder(), jit=self._jit)
+            for ver, builder in sorted(
+                    builders.items(), key=lambda kv: kv[0] or 0):
+                model = Model(builder(), jit=self._jit)
+                v = ver if ver is not None else int(model.config.version)
+                versions[v] = model
         except Exception as exc:
             with self._lock:
                 self._state[name] = ("UNAVAILABLE", str(exc))
             raise
         with self._lock:
-            self._loaded[name] = model
+            self._loaded[name] = versions
             self._state[name] = ("READY", "")
-        return model
+        return versions[max(versions)]
 
     def unload(self, name: str) -> None:
         with self._lock:
@@ -81,9 +115,19 @@ class ModelRepository:
             self._loaded.pop(name, None)
             self._state[name] = ("UNAVAILABLE", "unloaded")
 
-    def get(self, name: str) -> Model | None:
+    def get(self, name: str, version: str | int = "") -> Model | None:
+        v = _parse_version(version)
         with self._lock:
-            return self._loaded.get(name)
+            vs = self._loaded.get(name)
+            if not vs:
+                return None
+            if v is None:
+                return vs[max(vs)]
+            return vs.get(v)
+
+    def loaded_versions(self, name: str) -> dict[int, Model]:
+        with self._lock:
+            return dict(self._loaded.get(name, {}))
 
     def names(self) -> list[str]:
         with self._lock:
@@ -93,9 +137,11 @@ class ModelRepository:
         with self._lock:
             return sorted(self._loaded)
 
-    def is_ready(self, name: str) -> bool:
-        with self._lock:
-            return name in self._loaded
+    def is_ready(self, name: str, version: str | int = "") -> bool:
+        try:
+            return self.get(name, version) is not None
+        except EngineError:
+            return False
 
     # -- directory repository ------------------------------------------------
 
@@ -138,7 +184,17 @@ class ModelRepository:
                 d["name"] = entry  # directory name is canonical in Triton
             self._resolve_labels(d, mdir)
             d["_model_dir"] = mdir  # for relative weights_path resolution
-            self.register(d["name"], _directory_builder(d))
+            found = sorted(
+                int(e) for e in os.listdir(mdir)
+                if e.isdigit() and int(e) > 0
+                and os.path.isdir(os.path.join(mdir, e)))
+            if found:
+                for v in _apply_version_policy(
+                        found, d.get("version_policy")):
+                    self.register(d["name"], _directory_builder(d, v),
+                                  version=v)
+            else:
+                self.register(d["name"], _directory_builder(d))
             names.append(d["name"])
         return names
 
@@ -175,15 +231,48 @@ class ModelRepository:
             out = []
             for name in sorted(self._builders):
                 state, reason = self._state.get(name, ("UNAVAILABLE", ""))
-                version = "1"
-                model = self._loaded.get(name)
-                if model is not None:
-                    version = str(model.config.version)
-                entry = {"name": name, "version": version, "state": state}
+                loaded = self._loaded.get(name)
+                if loaded:
+                    # One row per served version, Triton-style.
+                    for v in sorted(loaded):
+                        out.append({"name": name, "version": str(v),
+                                    "state": state})
+                    continue
+                versions = [v for v in self._builders[name] if v is not None]
+                entry = {"name": name,
+                         "version": str(max(versions)) if versions else "1",
+                         "state": state}
                 if reason:
                     entry["reason"] = reason
                 out.append(entry)
             return out
+
+
+def _apply_version_policy(found: list[int], policy) -> list[int]:
+    """Triton version_policy semantics over the numbered subdirectories:
+    ``latest {num_versions: N}`` (default N=1), ``all {}``, or
+    ``specific {versions: [...]}``."""
+    if not policy or not isinstance(policy, dict):
+        return found[-1:]
+    if "all" in policy:
+        return found
+    if "specific" in policy:
+        spec = policy["specific"] or {}
+        want = spec.get("versions", [])
+        if not isinstance(want, list):
+            want = [want]
+        want = {int(v) for v in want}
+        missing = want - set(found)
+        if missing:
+            raise EngineError(
+                f"version_policy.specific requests versions "
+                f"{sorted(missing)} with no version directory", 400)
+        return sorted(want)
+    if "latest" in policy:
+        n = int((policy["latest"] or {}).get("num_versions", 1))
+        return found[-max(1, n):]
+    raise EngineError(
+        f"unknown version_policy {sorted(policy)}", 400)
 
 
 def _failing_builder(message: str) -> Callable[[], ModelBackend]:
@@ -193,15 +282,24 @@ def _failing_builder(message: str) -> Callable[[], ModelBackend]:
     return build
 
 
-def _directory_builder(d: dict) -> Callable[[], ModelBackend]:
+def _directory_builder(d: dict,
+                       version: int | None = None
+                       ) -> Callable[[], ModelBackend]:
     """Builder for a config-file model: the file is the serving contract,
     the zoo registry supplies the executable under the model's name (or
-    ``parameters["zoo_builder"]``)."""
+    ``parameters["zoo_builder"]``). With ``version``, the model serves as
+    that numbered version and its weights resolve inside the version
+    directory (``<model>/<v>/weights`` by convention, or the
+    ``weights_path`` parameter resolved against the version directory
+    first) — versions share the executable structure and differ by
+    weights, the TPU-native reading of Triton's per-version artifacts."""
 
     def build() -> ModelBackend:
         from client_tpu.engine.config import ModelConfig
 
         cfg = ModelConfig.from_dict(d)
+        if version is not None:
+            cfg.version = version
         if cfg.platform == "ensemble" and not cfg.ensemble_scheduling:
             raise EngineError(
                 f"model '{cfg.name}': platform 'ensemble' requires "
@@ -227,15 +325,22 @@ def _directory_builder(d: dict) -> Callable[[], ModelBackend]:
                 and backend.config.max_batch_size == cfg.max_batch_size):
             cfg.batch_buckets = backend.config.batch_buckets
         backend.config = cfg
+        mdir = d.get("_model_dir", "")
+        vdir = os.path.join(mdir, str(version)) if version is not None else ""
         # parameters { key: "weights_path" value: "..." }: restore weights
         # from an orbax checkpoint (relative paths resolve against the
-        # model directory) instead of the zoo's random init.
+        # version directory first, then the model directory) instead of
+        # the zoo's random init.
         wp = cfg.parameters.get("weights_path")
         if wp:
             wp = str(wp)
             if not os.path.isabs(wp):
-                wp = os.path.join(d.get("_model_dir", ""), wp)
+                cand = os.path.join(vdir, wp) if vdir else ""
+                wp = cand if cand and os.path.isdir(cand) \
+                    else os.path.join(mdir, wp)
             backend.weights_path = wp
+        elif vdir and os.path.isdir(os.path.join(vdir, "weights")):
+            backend.weights_path = os.path.join(vdir, "weights")
         return backend
 
     return build

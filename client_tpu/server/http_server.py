@@ -17,12 +17,15 @@ from __future__ import annotations
 
 import gzip
 import json
+import logging
 import re
 import threading
 import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
+
+_log = logging.getLogger("client_tpu")
 
 from client_tpu.engine.engine import TpuEngine
 from client_tpu.engine.types import EngineError, InferRequest, OutputRequest
@@ -333,13 +336,31 @@ class _Handler(BaseHTTPRequestHandler):
                     "binary_data) are not supported", 400)
         return req
 
+    # Slow-consumer bound for SSE streams: responses pending unread before
+    # the request is cancelled (the generative scheduler then stops
+    # producing at the next wave) — a stalled reader caps memory.
+    STREAM_PENDING_LIMIT = 1024
+
     def _stream_responses(self, req: InferRequest):
         """Submit and yield responses until the final one; a stall cancels
-        the request and raises 504."""
+        the request and raises 504; a backlog past STREAM_PENDING_LIMIT
+        cancels it too (logged)."""
         import queue as q
 
         out_q: q.Queue = q.Queue()
-        self.engine.async_infer(req, out_q.put)
+        choked = [False]
+
+        def enqueue(resp):
+            out_q.put(resp)
+            if not choked[0] and out_q.qsize() >= self.STREAM_PENDING_LIMIT:
+                choked[0] = True
+                _log.warning(
+                    "generate stream backlog exceeded %d pending "
+                    "responses; cancelling request (slow consumer)",
+                    self.STREAM_PENDING_LIMIT)
+                req.cancel()
+
+        self.engine.async_infer(req, enqueue)
         while True:
             try:
                 resp = out_q.get(timeout=self.GENERATE_STALL_TIMEOUT_S)
