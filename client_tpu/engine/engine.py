@@ -145,16 +145,25 @@ class TpuEngine:
     # -- repository control --------------------------------------------------
 
     def load_model(self, name: str) -> None:
+        """Load (or re-load) a model. Re-loading re-polls the repository
+        (Triton load semantics): schedulers are created for newly served
+        versions, retired for versions no longer selected, kept untouched
+        for unchanged ones, and the bare-name latest alias is refreshed."""
         self.repository.load(name)
         versions = self.repository.loaded_versions(name)
+        retired: list[Scheduler] = []
+        new_models = []
         with self._lock:
-            if name in self._schedulers:
-                return
             from client_tpu.engine.ensemble import EnsembleScheduler
             from client_tpu.engine.sequence import make_sequence_scheduler
 
             for v, model in sorted(versions.items()):
                 key = self._vkey(name, v)
+                sched = self._schedulers.get(key)
+                if sched is not None and sched.model is model:
+                    continue  # unchanged version keeps its scheduler
+                if sched is not None:
+                    retired.append(sched)
                 stats = self._stats.get(key)
                 if stats is None:
                     stats = ModelStats(name, str(v))
@@ -165,13 +174,23 @@ class TpuEngine:
                     ensemble_cls=EnsembleScheduler,
                     engine=self,
                 )
+                new_models.append(model)
+            valid = {self._vkey(name, v) for v in versions}
+            for key in [k for k in self._schedulers
+                        if ":" in k and k.rsplit(":", 1)[0] == name
+                        and k not in valid]:
+                retired.append(self._schedulers.pop(key))
             latest = self._vkey(name, max(versions))
             # Bare-name alias -> latest version (requests without an
             # explicit version, and the pre-versioning internal API).
             self._schedulers[name] = self._schedulers[latest]
             self._stats[name] = self._stats[latest]
+            still_referenced = {id(s) for s in self._schedulers.values()}
+        for sched in retired:
+            if id(sched) not in still_referenced:
+                sched.stop()
         if self._warmup:
-            for _, model in sorted(versions.items()):
+            for model in new_models:
                 model.warmup()
 
     def unload_model(self, name: str, unload_dependents: bool = False) -> None:

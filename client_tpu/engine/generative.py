@@ -25,6 +25,8 @@ C API serve generative models without modification.
 from __future__ import annotations
 
 import logging
+import math
+import os
 import queue as _queue
 import threading
 
@@ -77,16 +79,28 @@ def _parse_sampling(req: InferRequest, vocab: int):
     def num(key, default, cast, lo=None, hi=None):
         try:
             v = cast(p.get(key, default))
-        except (TypeError, ValueError):
+        except (TypeError, ValueError, OverflowError):
+            # OverflowError: int(float('inf')) — json accepts Infinity.
             raise EngineError(
                 f"{key} must be {cast.__name__}, got {p.get(key)!r}",
                 400) from None
+        if cast is float and not math.isfinite(v):
+            # NaN passes every range comparison (nan<lo and nan>hi are both
+            # False) and would silently poison the sampled logits.
+            raise EngineError(f"{key} must be finite, got {v!r}", 400)
         if (lo is not None and v < lo) or (hi is not None and v > hi):
             raise EngineError(
                 f"{key} must be in [{lo}, {hi}], got {v}", 400)
         return v
 
-    seed = num("seed", 0, int)
+    # Unseeded sampling draws a fresh per-request seed (vLLM-style): retries
+    # of the same prompt get different samples. An explicit seed keeps full
+    # determinism, and batch invariance holds either way because the seed is
+    # per-request (fold_in(seed, position) inside the kernels).
+    if "seed" in p:
+        seed = num("seed", 0, int)
+    else:
+        seed = int.from_bytes(os.urandom(4), "little")
     temp = num("temperature", 0.0, float, lo=0.0)
     top_k = num("top_k", 0, int, lo=0)
     top_p = num("top_p", 1.0, float, lo=0.0, hi=1.0)
@@ -195,7 +209,7 @@ class GenerativeScheduler(Scheduler):
         try:
             max_new = int(req.parameters.get(
                 "max_tokens", self.model.backend.default_max_tokens))
-        except (TypeError, ValueError):
+        except (TypeError, ValueError, OverflowError):
             raise EngineError(
                 f"max_tokens must be an integer, got "
                 f"{req.parameters.get('max_tokens')!r}", 400) from None

@@ -16,6 +16,7 @@ executable backend comes from the zoo registry under the model's name (or
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 from typing import Callable
@@ -68,43 +69,107 @@ class ModelRepository:
         self._builders: dict[str, dict[int | None,
                                        Callable[[], ModelBackend]]] = {}
         self._loaded: dict[str, dict[int, Model]] = {}
+        # name -> {builder-key: resolved version} for the loaded set, so a
+        # re-load can tell which builders are already materialized and only
+        # build the new ones (Triton's load re-polls the repository).
+        self._resolved: dict[str, dict[int | None, int]] = {}
         self._state: dict[str, tuple[str, str]] = {}  # name -> (state, reason)
+        # name -> model directory for directory-registered models: load()
+        # re-scans it so POST /v2/repository/models/<m>/load picks up
+        # version directories added after the initial scan (Triton re-poll).
+        self._dir_of: dict[str, str] = {}
+        # Per-name load serialization: load() drops the global lock while
+        # building models (XLA compiles are slow); without this, two
+        # concurrent loads of the same name would both build the new
+        # versions and race the _loaded write.
+        self._load_locks: dict[str, threading.Lock] = {}
         self._lock = threading.RLock()
         self._jit = jit
 
     def register(self, name: str, builder: Callable[[], ModelBackend],
                  version: int | None = None) -> None:
+        if ":" in name:
+            # ':' is the engine's name/version key separator (statistics,
+            # scheduler routing); a model literally named 'm:1' would
+            # collide with version 1 of model 'm'.
+            raise EngineError(
+                f"invalid model name '{name}': ':' is reserved", 400)
         with self._lock:
             self._builders.setdefault(name, {})[version] = builder
+            self._state.setdefault(name, ("UNAVAILABLE", "unloaded"))
+
+    def _set_builders(self, name: str,
+                      mapping: dict[int | None,
+                                    Callable[[], ModelBackend]]) -> None:
+        """Replace the registered builder set for ``name`` wholesale — the
+        re-scan path: versions that disappeared from the repository (or fell
+        out of version_policy) must retire on the next load, not linger."""
+        if ":" in name:
+            raise EngineError(
+                f"invalid model name '{name}': ':' is reserved", 400)
+        with self._lock:
+            self._builders[name] = dict(mapping)
             self._state.setdefault(name, ("UNAVAILABLE", "unloaded"))
 
     def register_backend(self, backend: ModelBackend) -> None:
         self.register(backend.config.name, lambda: backend)
 
     def load(self, name: str) -> Model:
-        """Load every served version of ``name``; returns the latest."""
+        """Load every served version of ``name``; returns the latest.
+
+        Re-loading an already-loaded model re-polls the repository (Triton
+        load semantics): directory models get their model directory
+        re-scanned (new version directories picked up, versions fallen out
+        of version_policy retired), versions registered since the first
+        load are materialized, and already-loaded versions are kept as-is
+        (no rebuild, no recompile)."""
         with self._lock:
-            if name in self._loaded:
-                vs = self._loaded[name]
-                return vs[max(vs)]
+            load_lock = self._load_locks.setdefault(name, threading.Lock())
+        with load_lock:
+            return self._load_serialized(name)
+
+    def _load_serialized(self, name: str) -> Model:
+        with self._lock:
+            mdir = self._dir_of.get(name)
+        if mdir and os.path.isdir(mdir):
+            # Re-poll the on-disk model directory through the public load
+            # API — the operator's "drop 3/ in and POST load" flow.
+            self._register_model_dir(mdir, os.path.basename(mdir))
+        with self._lock:
             builders = self._builders.get(name)
             if not builders:
                 raise EngineError(f"unknown model '{name}'", 404)
             builders = dict(builders)
+            prev_resolved = dict(self._resolved.get(name, {}))
+            prev_loaded = dict(self._loaded.get(name, {}))
+            if prev_loaded and set(prev_resolved) == set(builders):
+                # Nothing registered or retired since the last load.
+                return prev_loaded[max(prev_loaded)]
             self._state[name] = ("LOADING", "")
         versions: dict[int, Model] = {}
+        resolved: dict[int | None, int] = {}
         try:
             for ver, builder in sorted(
                     builders.items(), key=lambda kv: kv[0] or 0):
+                prev_v = prev_resolved.get(ver)
+                if prev_v is not None and prev_v in prev_loaded:
+                    versions[prev_v] = prev_loaded[prev_v]
+                    resolved[ver] = prev_v
+                    continue
                 model = Model(builder(), jit=self._jit)
                 v = ver if ver is not None else int(model.config.version)
                 versions[v] = model
+                resolved[ver] = v
         except Exception as exc:
             with self._lock:
-                self._state[name] = ("UNAVAILABLE", str(exc))
+                if prev_loaded:
+                    self._state[name] = ("READY", "")  # old set still serves
+                else:
+                    self._state[name] = ("UNAVAILABLE", str(exc))
             raise
         with self._lock:
             self._loaded[name] = versions
+            self._resolved[name] = resolved
             self._state[name] = ("READY", "")
         return versions[max(versions)]
 
@@ -113,6 +178,7 @@ class ModelRepository:
             if name not in self._builders:
                 raise EngineError(f"unknown model '{name}'", 404)
             self._loaded.pop(name, None)
+            self._resolved.pop(name, None)
             self._state[name] = ("UNAVAILABLE", "unloaded")
 
     def get(self, name: str, version: str | int = "") -> Model | None:
@@ -168,35 +234,60 @@ class ModelRepository:
             mdir = os.path.join(path, entry)
             if not os.path.isdir(mdir):
                 continue
-            try:
-                d = self._read_config(mdir)
-            except Exception as exc:  # noqa: BLE001 — surface per-model
-                # A corrupt config must not abort the rest of the repository:
-                # register a builder that reports the parse failure, so the
-                # index shows UNAVAILABLE with the reason (Triton behavior).
-                msg = f"failed to parse config in '{mdir}': {exc}"
-                self.register(entry, _failing_builder(msg))
-                names.append(entry)
-                continue
-            if d is None:
-                continue
-            if not d.get("name"):
-                d["name"] = entry  # directory name is canonical in Triton
-            self._resolve_labels(d, mdir)
-            d["_model_dir"] = mdir  # for relative weights_path resolution
-            found = sorted(
-                int(e) for e in os.listdir(mdir)
-                if e.isdigit() and int(e) > 0
-                and os.path.isdir(os.path.join(mdir, e)))
-            if found:
-                for v in _apply_version_policy(
-                        found, d.get("version_policy")):
-                    self.register(d["name"], _directory_builder(d, v),
-                                  version=v)
-            else:
-                self.register(d["name"], _directory_builder(d))
-            names.append(d["name"])
+            name = self._register_model_dir(mdir, entry)
+            if name is not None:
+                names.append(name)
         return names
+
+    def _register_model_dir(self, mdir: str, entry: str) -> str | None:
+        """(Re-)register one model directory; returns the model name, or
+        None when the directory holds no config. Any per-model failure is
+        contained: a corrupt config (or invalid name) must not abort the
+        rest of the repository — the model registers as a failing builder
+        so the index shows UNAVAILABLE with the reason (Triton behavior)."""
+        try:
+            d = self._read_config(mdir)
+        except Exception as exc:  # noqa: BLE001 — surface per-model
+            return self._register_broken(
+                entry, f"failed to parse config in '{mdir}': {exc}")
+        if d is None:
+            return None
+        if not d.get("name"):
+            d["name"] = entry  # directory name is canonical in Triton
+        if ":" in d["name"]:
+            return self._register_broken(
+                entry, f"invalid model name '{d['name']}': ':' is reserved")
+        self._resolve_labels(d, mdir)
+        d["_model_dir"] = mdir  # for relative weights_path resolution
+        found = sorted(
+            int(e) for e in os.listdir(mdir)
+            if e.isdigit() and int(e) > 0
+            and os.path.isdir(os.path.join(mdir, e)))
+        try:
+            if found:
+                self._set_builders(d["name"], {
+                    v: _directory_builder(d, v)
+                    for v in _apply_version_policy(
+                        found, d.get("version_policy"))})
+            else:
+                self._set_builders(d["name"],
+                                   {None: _directory_builder(d)})
+        except EngineError as exc:  # bad version_policy — contain per-model
+            return self._register_broken(d["name"], str(exc))
+        with self._lock:
+            self._dir_of[d["name"]] = mdir
+        return d["name"]
+
+    def _register_broken(self, entry: str, msg: str) -> str | None:
+        """Register a failure-reporting builder under the directory name so
+        the breakage is visible in the index; a directory name that itself
+        can't serve as a key is logged and skipped."""
+        if ":" in entry:
+            logging.getLogger("client_tpu").warning(
+                "skipping model directory '%s': %s", entry, msg)
+            return None
+        self._set_builders(entry, {None: _failing_builder(msg)})
+        return entry
 
     @staticmethod
     def _read_config(mdir: str) -> dict | None:

@@ -189,6 +189,14 @@ class Scheduler:
             raise EngineError(
                 f"exceeds maximum queue size ({max_size}) for priority "
                 f"level {level} of model '{self.model.config.name}'", 429)
+        if self._stopping and not any(t.is_alive() for t in self.workers):
+            # Submit raced stop() and the workers are already gone: nothing
+            # will ever pop this request. Fail whatever is queued
+            # (idempotent with stop()'s own drain). While workers live,
+            # heap order guarantees they pop real requests ahead of the
+            # shutdown sentinels, so the graceful-drain path is untouched.
+            self._fail_queued("model unloaded before the request was "
+                              "processed", 503)
 
     def stop(self) -> None:
         self._stopping = True
@@ -196,6 +204,31 @@ class Scheduler:
             self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)
         for t in self.workers:
             t.join(timeout=5.0)
+        # Workers drain real requests ahead of the shutdown sentinels (heap
+        # order), but anything enqueued after the workers exited — or left
+        # behind by a worker that timed out — must still get a response.
+        self._fail_queued("model unloaded before the request was processed",
+                          503)
+
+    def _fail_queued(self, why: str, status: int) -> None:
+        # Sentinels popped during the drain are re-put afterwards: a worker
+        # that outlived stop()'s join timeout (mid-compile) still needs its
+        # exit signal when it next reads the queue. Heap order pops real
+        # requests first, so the drain terminates: once only sentinels
+        # remain, the queue empties in one slab.
+        sentinels = 0
+        while True:
+            try:
+                items = self.queue.get_many(64, timeout=0)
+            except queue.Empty:
+                break
+            for item in items:
+                if item is _SHUTDOWN:
+                    sentinels += 1
+                else:
+                    self._fail(item, EngineError(why, status))
+        for _ in range(sentinels):
+            self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)
 
     # -- subclass API --------------------------------------------------------
 

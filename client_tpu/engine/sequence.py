@@ -58,14 +58,48 @@ class _SequenceSlot:
         self.inflight = 0
 
 
-class SequenceScheduler(Scheduler):
+class _PendingGuard:
+    """Queued-request counts per sequence id (mixin).
+
+    Arrival-time refresh narrows but cannot close the idle-GC race: a
+    request queued longer than the idle window (slow steps ahead of it)
+    still has inflight == 0 until execution starts, so GC judged by
+    timestamp alone would evict its slot mid-queue. GC must skip any
+    sequence with pending > 0. The host class supplies the guarding lock
+    via ``_pending_lock`` and initializes ``self._pending = {}``."""
+
+    _pending: dict[int, int]
+
+    def _pending_lock(self) -> threading.Lock:
+        raise NotImplementedError
+
+    def _pend_locked(self, sid: int) -> None:
+        """Caller holds ``_pending_lock()``."""
+        self._pending[sid] = self._pending.get(sid, 0) + 1
+
+    def _unpend(self, sid: int) -> None:
+        if not sid:
+            return
+        with self._pending_lock():
+            n = self._pending.get(sid, 0) - 1
+            if n > 0:
+                self._pending[sid] = n
+            else:
+                self._pending.pop(sid, None)
+
+
+class SequenceScheduler(_PendingGuard, Scheduler):
     """Routes requests to per-sequence state; executes via the stateful
     jitted apply."""
 
     def __init__(self, model, stats):
         self._slots: dict[int, _SequenceSlot] = {}
         self._slots_lock = threading.Lock()
+        self._pending: dict[int, int] = {}
         super().__init__(model, stats)
+
+    def _pending_lock(self):
+        return self._slots_lock
 
     def submit(self, req: InferRequest) -> None:
         # Arrival IS a use: refresh liveness at enqueue so a request waiting
@@ -76,7 +110,12 @@ class SequenceScheduler(Scheduler):
                 slot = self._slots.get(req.sequence_id)
                 if slot is not None:
                     slot.last_used_ns = now_ns()
-        super().submit(req)
+                self._pend_locked(req.sequence_id)
+        try:
+            super().submit(req)
+        except Exception:
+            self._unpend(req.sequence_id)  # rejected at enqueue
+            raise
 
     def _worker_loop(self) -> None:
         while True:
@@ -84,12 +123,20 @@ class SequenceScheduler(Scheduler):
             if item is _SHUTDOWN:
                 return
             req: InferRequest = item
-            if self._check_timeout(req) or self._check_cancelled(req):
-                continue
+            # Unpend only after processing: with several worker instances,
+            # unpending at dequeue would reopen the window (pending 0,
+            # inflight 0, stale timestamp) between dequeue and the slot's
+            # inflight claim in _run_one, letting a sibling worker's GC
+            # evict the slot out from under this request.
             try:
-                self._run_one(req)
-            except Exception as exc:  # noqa: BLE001
-                self._fail(req, exc)
+                if self._check_timeout(req) or self._check_cancelled(req):
+                    continue
+                try:
+                    self._run_one(req)
+                except Exception as exc:  # noqa: BLE001
+                    self._fail(req, exc)
+            finally:
+                self._unpend(req.sequence_id)
 
     def _get_slot(self, req: InferRequest) -> _SequenceSlot:
         sid = req.sequence_id
@@ -120,7 +167,8 @@ class SequenceScheduler(Scheduler):
         idle_ns = sb.max_sequence_idle_microseconds * 1000
         cutoff = now_ns() - idle_ns
         dead = [sid for sid, s in self._slots.items()
-                if s.last_used_ns < cutoff and s.inflight == 0]
+                if s.last_used_ns < cutoff and s.inflight == 0
+                and self._pending.get(sid, 0) == 0]
         for sid in dead:
             del self._slots[sid]
 
@@ -163,7 +211,7 @@ class SequenceScheduler(Scheduler):
             return len(self._slots)
 
 
-class OldestSequenceScheduler(Scheduler):
+class OldestSequenceScheduler(_PendingGuard, Scheduler):
     """Triton's OLDEST sequence-batcher strategy, TPU-first.
 
     Design: sequence state is a fixed-capacity arena pytree in HBM
@@ -212,6 +260,10 @@ class OldestSequenceScheduler(Scheduler):
         self._free = list(range(self._cap))
         self._rows: dict[int, int] = {}       # sequence_id -> arena row
         self._last_used: dict[int, int] = {}  # sequence_id -> ns
+        # idle-GC must not evict a sequence with a request still queued
+        # (`protect` only covers the wave being assembled, not
+        # continuations queued behind it) — see _PendingGuard.
+        self._pending: dict[int, int] = {}
         self._arena_lock = threading.Lock()
         self._compiled_buckets: set[int] = set()
         super().__init__(model, stats)
@@ -260,7 +312,8 @@ class OldestSequenceScheduler(Scheduler):
         sb = self.model.config.sequence_batching
         cutoff = now_ns() - sb.max_sequence_idle_microseconds * 1000
         dead = [sid for sid, ts in self._last_used.items()
-                if ts < cutoff and (protect is None or sid not in protect)]
+                if ts < cutoff and (protect is None or sid not in protect)
+                and self._pending.get(sid, 0) == 0]
         for sid in dead:
             row = self._rows.pop(sid, None)
             self._last_used.pop(sid, None)
@@ -268,6 +321,9 @@ class OldestSequenceScheduler(Scheduler):
                 self._free.append(row)
 
     # -- scheduling ----------------------------------------------------------
+
+    def _pending_lock(self):
+        return self._arena_lock
 
     def submit(self, req: InferRequest) -> None:
         # Arrival refreshes liveness (see SequenceScheduler.submit): a
@@ -277,7 +333,12 @@ class OldestSequenceScheduler(Scheduler):
             with self._arena_lock:
                 if req.sequence_id in self._last_used:
                     self._last_used[req.sequence_id] = now_ns()
-        super().submit(req)
+                self._pend_locked(req.sequence_id)
+        try:
+            super().submit(req)
+        except Exception:
+            self._unpend(req.sequence_id)  # rejected at enqueue
+            raise
 
     def _worker_loop(self) -> None:
         while True:
@@ -285,6 +346,7 @@ class OldestSequenceScheduler(Scheduler):
             if item is _SHUTDOWN:
                 return
             req: InferRequest = item
+            self._unpend(req.sequence_id)
             if self._check_timeout(req) or self._check_cancelled(req):
                 continue
             batch = self._gather_candidates(req)
@@ -320,6 +382,7 @@ class OldestSequenceScheduler(Scheduler):
                     stop = True
                     break
                 nxt: InferRequest = item
+                self._unpend(nxt.sequence_id)
                 if self._check_timeout(nxt) or self._check_cancelled(nxt):
                     continue
                 if nxt.sequence_id in seen or not _same_signature(first, nxt):
@@ -330,6 +393,11 @@ class OldestSequenceScheduler(Scheduler):
             if stop:
                 break
         for later in reversed(pushback):
+            # Returning to the queue: the request is pending again until the
+            # next gather dequeues it.
+            if later.sequence_id:
+                with self._arena_lock:
+                    self._pend_locked(later.sequence_id)
             self.queue.put_front(later, self._priority_level(later))
         return batch
 

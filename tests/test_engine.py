@@ -902,3 +902,103 @@ class TestOldestSequenceBatcher:
             assert step(2, 4, sequence_end=True) == 7
         finally:
             engine.shutdown()
+
+
+class TestQueuedRequestGcProtection:
+    """Advisor r3: a request QUEUED longer than the idle window (slow steps
+    ahead of it) still has inflight == 0 until execution starts — idle-GC
+    must skip sequences with pending queued work (the per-sid pending map)."""
+
+    def _direct_scheduler(self, idle_us=50_000):
+        from client_tpu.engine.repository import ModelRepository
+        from client_tpu.models.simple import SequenceAccumulateBackend
+
+        backend = SequenceAccumulateBackend(name="gc_pending")
+        backend.config.sequence_batching.max_sequence_idle_microseconds = \
+            idle_us
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        eng = TpuEngine(repo)
+        return eng, eng._schedulers["gc_pending"]
+
+    def test_direct_pending_sequence_survives_gc(self):
+        import time as _time
+
+        eng, sched = self._direct_scheduler()
+        try:
+            resp = _infer(eng, "gc_pending",
+                          {"INPUT": np.array([4], np.int32)},
+                          sequence_id=1, sequence_start=True)
+            assert int(resp.outputs["OUTPUT"][0]) == 4
+            # Simulate a continuation stuck in the queue past the idle
+            # window: mark it pending (what submit() does) and let the
+            # timestamp go stale.
+            with sched._slots_lock:
+                sched._pending[1] = 1
+            _time.sleep(0.12)
+            probe = InferRequest(model_name="gc_pending",
+                                 inputs={"INPUT": np.array([1], np.int32)},
+                                 sequence_id=2, sequence_start=True)
+            slot = sched._get_slot(probe)   # runs idle-GC
+            sched._put_slot(slot)
+            assert 1 in sched._slots, \
+                "pending sequence evicted by idle-GC while queued"
+            with sched._slots_lock:
+                sched._pending.pop(1, None)
+            _time.sleep(0.12)
+            probe2 = InferRequest(model_name="gc_pending",
+                                  inputs={"INPUT": np.array([1], np.int32)},
+                                  sequence_id=3, sequence_start=True)
+            slot = sched._get_slot(probe2)
+            sched._put_slot(slot)
+            assert 1 not in sched._slots, \
+                "idle sequence with no pending work should still be GC'd"
+        finally:
+            eng.shutdown()
+
+    def test_oldest_pending_sequence_survives_gc(self):
+        import time as _time
+
+        from client_tpu.engine.repository import ModelRepository
+        from client_tpu.models.simple import SequenceAccumulateBackend
+
+        backend = SequenceAccumulateBackend(
+            name="gc_pending_oldest", strategy="oldest")
+        backend.config.sequence_batching.max_sequence_idle_microseconds = \
+            50_000
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        eng = TpuEngine(repo)
+        try:
+            sched = eng._schedulers["gc_pending_oldest"]
+            resp = _infer(eng, "gc_pending_oldest",
+                          {"INPUT": np.array([4], np.int32)},
+                          sequence_id=1, sequence_start=True)
+            assert int(resp.outputs["OUTPUT"][0]) == 4
+            with sched._arena_lock:
+                sched._pending[1] = 1
+            _time.sleep(0.12)
+            probe = InferRequest(model_name="gc_pending_oldest",
+                                 inputs={"INPUT": np.array([1], np.int32)},
+                                 sequence_id=2, sequence_start=True)
+            row, reset = sched._acquire_row(probe, protect={2})  # runs GC
+            assert 1 in sched._rows, \
+                "pending sequence's arena row evicted while queued"
+            sched._release_row(2)
+            with sched._arena_lock:
+                sched._pending.pop(1, None)
+        finally:
+            eng.shutdown()
+
+
+class TestColonModelNameRejected:
+    def test_register_rejects_colon(self):
+        from client_tpu.engine.repository import ModelRepository
+        from client_tpu.models.simple import AddSubBackend
+
+        repo = ModelRepository()
+        backend = AddSubBackend()
+        backend.config.name = "m:1"
+        with pytest.raises(EngineError) as ei:
+            repo.register_backend(backend)
+        assert ei.value.status == 400 and "reserved" in str(ei.value)

@@ -498,3 +498,55 @@ class TestCancellation:
         finally:
             srv.stop()
             eng.shutdown()
+
+
+class TestSeedAndFiniteness:
+    """Advisor r3: unseeded temperature sampling must not be one fixed
+    'random' sequence for every request, and non-finite float parameters
+    must be rejected (NaN passes every range comparison)."""
+
+    def test_unseeded_sampling_varies_across_requests(self, engine):
+        runs = [generate(engine, [5, 6, 7], 12, temperature=1.5)
+                for _ in range(4)]
+        assert any(r != runs[0] for r in runs[1:]), \
+            f"unseeded sampling fully deterministic: {runs[0]}"
+
+    def test_explicit_seed_still_deterministic(self, engine):
+        a = generate(engine, [5, 6, 7], 12, temperature=1.5, seed=42)
+        b = generate(engine, [5, 6, 7], 12, temperature=1.5, seed=42)
+        assert a == b
+
+    def test_unseeded_greedy_still_deterministic(self, engine):
+        assert generate(engine, [8, 9], 8) == generate(engine, [8, 9], 8)
+
+    def test_non_finite_float_params_rejected(self, engine):
+        for bad in ({"temperature": float("nan")},
+                    {"temperature": float("inf")},
+                    {"top_p": float("nan")}):
+            with pytest.raises(EngineError) as ei:
+                generate(engine, [1], 4, **bad)
+            assert ei.value.status == 400, bad
+            assert "finite" in str(ei.value)
+
+    def test_infinite_int_params_rejected(self, engine):
+        # json.loads accepts Infinity; int(float('inf')) raises
+        # OverflowError, which must surface as a 400, not a 500.
+        for bad in ({"top_k": float("inf")}, {"seed": float("inf")}):
+            with pytest.raises(EngineError) as ei:
+                generate(engine, [1], 4, **bad)
+            assert ei.value.status == 400, bad
+        err: list = []
+        done = threading.Event()
+
+        def cb(resp):
+            if resp.error is not None:
+                err.append(resp.error)
+            if resp.final or resp.error is not None:
+                done.set()
+
+        engine.async_infer(InferRequest(
+            model_name="tiny_gpt",
+            inputs={"INPUT_IDS": np.asarray([1], np.int32)},
+            parameters={"max_tokens": float("inf")}), cb)
+        assert done.wait(60)
+        assert err and getattr(err[0], "status", None) == 400

@@ -241,3 +241,116 @@ class TestModelVersions:
             self._infer(eng, name, 1)
         finally:
             eng.shutdown()
+
+
+class TestReloadRepolls:
+    """Advisor r3: load of an already-loaded model re-polls the repository —
+    version directories added after the first load are picked up, versions
+    falling out of version_policy retire, unchanged versions keep their
+    loaded Model (no rebuild/recompile) — Triton load semantics."""
+
+    # Reuse the versioned-repo fixtures without inheriting (inheriting would
+    # re-collect the parent's tests under this class).
+    _make_versioned_repo = TestModelVersions._make_versioned_repo
+    _infer = TestModelVersions._infer
+    TINY = TestModelVersions.TINY
+
+    def _make_v1_only(self, tmp_path, policy):
+        root, name, expected = self._make_versioned_repo(tmp_path, policy)
+        import shutil
+
+        self._v2_backup = str(tmp_path / "_v2_backup")
+        shutil.move(str(tmp_path / name / "2"), self._v2_backup)
+        return root, name
+
+    def test_new_version_dir_served_after_reload(self, tmp_path):
+        import shutil
+
+        root, name = self._make_v1_only(tmp_path, {"all": {}})
+        repo = ModelRepository.from_directory(root)
+        eng = TpuEngine(repo)
+        try:
+            assert eng.model_metadata(name)["versions"] == ["1"]
+            v1_model = repo.get(name, 1)
+            v1_out = self._infer(eng, name, 1)
+            # Version 2 appears on disk after the first load; the public
+            # load API alone must pick it up (repository re-poll).
+            shutil.move(self._v2_backup, str(tmp_path / name / "2"))
+            eng.load_model(name)
+            assert eng.model_metadata(name)["versions"] == ["1", "2"]
+            assert repo.get(name, 1) is v1_model, \
+                "unchanged version was rebuilt on reload"
+            v2_out = self._infer(eng, name, 2)
+            latest = self._infer(eng, name)
+            assert not np.allclose(v1_out, v2_out)
+            assert np.array_equal(latest, v2_out), \
+                "bare-name alias not refreshed to the new latest"
+        finally:
+            eng.shutdown()
+
+    def test_latest_policy_retires_old_version_on_reload(self, tmp_path):
+        import shutil
+
+        root, name = self._make_v1_only(tmp_path, None)  # default latest-1
+        repo = ModelRepository.from_directory(root)
+        eng = TpuEngine(repo)
+        try:
+            assert eng.model_metadata(name)["versions"] == ["1"]
+            shutil.move(self._v2_backup, str(tmp_path / name / "2"))
+            eng.load_model(name)
+            assert eng.model_metadata(name)["versions"] == ["2"]
+            from client_tpu.engine.types import EngineError
+            with pytest.raises(EngineError):
+                self._infer(eng, name, 1)  # retired under latest-1
+            self._infer(eng, name, 2)
+        finally:
+            eng.shutdown()
+
+    def test_reload_without_changes_is_noop(self, tmp_path):
+        root, name, _ = self._make_versioned_repo(tmp_path, {"all": {}})
+        repo = ModelRepository.from_directory(root)
+        eng = TpuEngine(repo)
+        try:
+            m1, m2 = repo.get(name, 1), repo.get(name, 2)
+            s = eng._schedulers[f"{name}:1"]
+            eng.load_model(name)
+            assert repo.get(name, 1) is m1 and repo.get(name, 2) is m2
+            assert eng._schedulers[f"{name}:1"] is s
+        finally:
+            eng.shutdown()
+
+
+def test_colon_model_name_contained_per_model(tmp_path):
+    """A model whose configured name contains ':' must register as
+    UNAVAILABLE with a reason — not abort the directory scan (the other
+    models keep serving)."""
+    import json as _json
+
+    good = tmp_path / "simple"
+    good.mkdir()
+    (good / "config.json").write_text(_json.dumps({
+        "name": "simple", "platform": "jax", "max_batch_size": 4,
+        "input": [{"name": "INPUT0", "data_type": "TYPE_INT32",
+                   "dims": [16]},
+                  {"name": "INPUT1", "data_type": "TYPE_INT32",
+                   "dims": [16]}],
+        "output": [{"name": "OUTPUT0", "data_type": "TYPE_INT32",
+                    "dims": [16]},
+                   {"name": "OUTPUT1", "data_type": "TYPE_INT32",
+                    "dims": [16]}]}))
+    bad = tmp_path / "badname"
+    bad.mkdir()
+    (bad / "config.json").write_text(_json.dumps({
+        "name": "m:1", "platform": "jax", "max_batch_size": 1,
+        "input": [], "output": []}))
+    repo = ModelRepository.from_directory(str(tmp_path))
+    assert "simple" in repo.names()
+    rows = {e["name"]: e for e in ModelRepository.from_directory(
+        str(tmp_path)).index()}
+    assert "badname" in rows
+    assert "reserved" in rows["badname"].get("reason", "") or \
+        rows["badname"]["state"] == "UNAVAILABLE"
+    from client_tpu.engine.types import EngineError
+    with pytest.raises(EngineError) as ei:
+        repo.load("badname")
+    assert "reserved" in str(ei.value)
