@@ -518,7 +518,10 @@ def bench_generative(n_streams: int = 64, tokens: int = 32):
     from client_tpu.engine import InferRequest, TpuEngine
     from client_tpu.models import build_repository
 
-    engine = TpuEngine(build_repository(["tiny_gpt"]))
+    # warmup=True: the generative scheduler precompiles every (prompt
+    # bucket, wave bucket) executable up front — round 3 measured ~1-1.5s
+    # XLA compiles landing mid-burst as the TTFT p99.
+    engine = TpuEngine(build_repository(["tiny_gpt"]), warmup=True)
 
     def gen(prompt, n, counts, i, errs, ttft_ms, itl_ms):
         done = threading.Event()

@@ -153,6 +153,7 @@ class TpuEngine:
         versions = self.repository.loaded_versions(name)
         retired: list[Scheduler] = []
         new_models = []
+        new_scheds: list[Scheduler] = []
         with self._lock:
             from client_tpu.engine.ensemble import EnsembleScheduler
             from client_tpu.engine.sequence import make_sequence_scheduler
@@ -175,6 +176,7 @@ class TpuEngine:
                     engine=self,
                 )
                 new_models.append(model)
+                new_scheds.append(self._schedulers[key])
             valid = {self._vkey(name, v) for v in versions}
             for key in [k for k in self._schedulers
                         if ":" in k and k.rsplit(":", 1)[0] == name
@@ -192,6 +194,8 @@ class TpuEngine:
         if self._warmup:
             for model in new_models:
                 model.warmup()
+            for sched in new_scheds:
+                sched.warmup()
 
     def unload_model(self, name: str, unload_dependents: bool = False) -> None:
         dependents: list[str] = []

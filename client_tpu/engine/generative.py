@@ -7,12 +7,28 @@ generation streams. Design, TPU-first:
 
 - The KV cache is a fixed-capacity HBM **arena** pytree owned by one worker
   (``backend.init_arena``; +1 dummy row absorbs padded lanes), donated into
-  every jitted call so updates are in-place.
-- **Prefill** (one jit per prompt bucket) writes a prompt's K/V into its
-  arena row and emits the first token.
+  every jitted call so updates are in-place. The arena carries each row's
+  latest token ON DEVICE (``arena["tok"]``), so consecutive decode waves
+  chain with no host round trip between them.
+- **Prefill** (one jit per prompt bucket, admit lanes padded to one fixed
+  bucket) writes a batch of prompts' K/V into their arena rows and emits
+  each prompt's first token.
 - **Decode waves** (one jit per stream-count bucket) advance every live
-  stream one token in a single XLA execution: scatter new K/V at each
-  stream's position, masked attention over the static sequence axis, argmax.
+  stream one token in a single XLA execution: gather input tokens from the
+  device-side slots, scatter new K/V at each stream's position, masked
+  attention over the static sequence axis, sample/argmax, scatter the new
+  tokens back into the slots.
+- **Pipelined dispatch** (round-4): the worker dispatches prefills and
+  waves WITHOUT waiting for their results — JAX async dispatch queues them
+  on the device in order — and consumes the token fetches asynchronously
+  (``copy_to_host_async`` + ``is_ready``), bounded by a configurable
+  pipeline depth (``CLIENT_TPU_GEN_PIPELINE``, default 32). Emission,
+  stop-token checks, and retirement happen at fetch time, a few waves
+  behind dispatch; over-generated tokens past a stop are discarded (the
+  lanes are independent, so junk in a retired lane cannot perturb live
+  streams). On a transport with high host↔device latency this moves
+  inter-token latency from one round trip per token to the device step
+  time (measured 69 ms → ~2 ms per wave through the dev tunnel).
 - Streams are admitted whenever a row is free — new requests join the next
   wave (iteration-level batching), they never wait for a running stream to
   finish (request-level batching would).
@@ -24,6 +40,7 @@ C API serve generative models without modification.
 
 from __future__ import annotations
 
+import collections
 import logging
 import math
 import os
@@ -49,22 +66,46 @@ _log = logging.getLogger("client_tpu")
 
 
 class _Stream:
-    __slots__ = ("req", "row", "length", "last_token", "emitted", "max_new",
-                 "seed", "temp", "top_k", "top_p", "stop")
+    __slots__ = ("req", "row", "disp_len", "disp_tokens", "f_len",
+                 "emitted", "max_new", "seed", "temp", "top_k", "top_p",
+                 "stop", "dead")
 
-    def __init__(self, req, row, length, last_token, max_new,
+    def __init__(self, req, row, plen, max_new,
                  seed=0, temp=0.0, top_k=0, top_p=1.0, stop=frozenset()):
         self.req = req
         self.row = row
-        self.length = length          # positions filled in the KV row
-        self.last_token = last_token  # next decode step's input token
+        self.disp_len = plen      # context length at the next dispatch
+        self.disp_tokens = 1      # tokens whose generation is dispatched
+        self.f_len = plen         # fetch-side context length mirror
         self.emitted = 0
         self.max_new = max_new
-        self.seed = seed              # per-request PRNG seed
-        self.temp = temp              # 0 = greedy
-        self.top_k = top_k            # 0 = off
-        self.top_p = top_p            # 1.0 = off
-        self.stop = stop              # token ids terminating the stream
+        self.seed = seed          # per-request PRNG seed
+        self.temp = temp          # 0 = greedy
+        self.top_k = top_k        # 0 = off
+        self.top_p = top_p        # 1.0 = off
+        self.stop = stop          # token ids terminating the stream
+        self.dead = False         # retired/cancelled (skip pending lanes)
+
+
+class _Inflight:
+    """One dispatched execution whose token fetch is pending."""
+
+    __slots__ = ("kind", "streams", "tokens")
+
+    def __init__(self, kind, streams, tokens):
+        self.kind = kind          # 'prefill' | 'wave'
+        self.streams = streams    # lane order, real lanes only
+        self.tokens = tokens      # jax.Array future (copy_to_host_async'd)
+
+
+class _WarmupReq:
+    """Queue sentinel: precompile on the worker thread (serialized with
+    live traffic — compiling from the caller's thread would race the
+    arena)."""
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.error: Exception | None = None
 
 
 def _parse_sampling(req: InferRequest, vocab: int):
@@ -140,37 +181,87 @@ class GenerativeScheduler(Scheduler):
         self._cap = int(backend.max_streams)
         self._max_seq = int(backend.max_seq_len)
         self._arena = backend.init_arena(self._cap)
-        # `sample` (arg 9) is static: all-greedy calls get an executable
-        # with no sampling pipeline in it.
+        # `sample` is static: all-greedy calls get an executable with no
+        # sampling pipeline in it (prefill arg 9, decode arg 8).
         self._prefill = jax.jit(backend.prefill_fn(), donate_argnums=(1,),
                                 static_argnums=(9,))
         self._decode = jax.jit(backend.decode_fn(), donate_argnums=(1,),
-                               static_argnums=(9,))
+                               static_argnums=(8,))
         self._prompt_buckets = power_buckets(self._max_seq)
         self._wave_buckets = power_buckets(self._cap)
-        # Admit-batch ceiling: bounds (prompt bucket × admit bucket) compile
-        # pairs while still folding a burst of admits into few prefills.
-        self._admit_buckets = power_buckets(min(self._cap, 8))
+        # ONE admit lane bucket: every prefill chunk pads to this, so there
+        # is exactly one compiled prefill executable per prompt bucket
+        # (round-3's power-of-two admit lanes compiled per (lane, prompt)
+        # pair — a lane size first seen under load stalled every stream
+        # ~1s mid-measurement).
+        self._admit_lane = min(self._cap, 8)
+        # Dispatch-ahead bound: waves in flight before the worker blocks on
+        # the oldest fetch. Sized to hide the host↔device round trip
+        # (tunnel ~70 ms vs ~2 ms device step); each entry holds only a
+        # bucket-sized token vector.
+        self._depth = max(1, int(os.environ.get(
+            "CLIENT_TPU_GEN_PIPELINE", "32")))
         self._streams: list[_Stream] = []
+        self._inflight: collections.deque[_Inflight] = collections.deque()
         self._free = list(range(self._cap))
         super().__init__(model, stats)
+
+    # -- warmup ---------------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Precompile the greedy prefill executable for every prompt bucket
+        and the greedy decode executable for every wave bucket, on the
+        worker thread. Without this, the first burst that exercises a new
+        bucket pays a ~1s XLA compile mid-stream (measured as the round-3
+        TTFT p99)."""
+        req = _WarmupReq()
+        self.queue.put(req)
+        if not req.done.wait(600):
+            raise EngineError(
+                "generative warmup timed out (worker busy for 600s)", 500)
+        if req.error is not None:
+            raise EngineError(f"generative warmup failed: {req.error}", 500)
+
+    def _precompile(self) -> None:
+        lane = self._admit_lane
+        dummy = np.full(lane, self._cap, np.int32)  # all lanes padded
+        z_i = np.zeros(lane, np.int32)
+        z_f = np.zeros(lane, np.float32)
+        ones_f = np.ones(lane, np.float32)
+        for pb in self._prompt_buckets:
+            self.model._set_state(f"warmup: prefill prompt bucket={pb}")
+            self._arena, tokens = self._prefill(
+                self.model._params, self._arena, dummy,
+                np.zeros((lane, pb), np.int32), np.ones(lane, np.int32),
+                z_i, z_f, z_i, ones_f, False)
+        for wb in self._wave_buckets:
+            self.model._set_state(f"warmup: decode wave bucket={wb}")
+            rows = np.full(wb, self._cap, np.int32)
+            self._arena, tokens = self._decode(
+                self.model._params, self._arena, rows,
+                np.zeros(wb, np.int32), np.zeros(wb, np.int32),
+                np.zeros(wb, np.float32), np.zeros(wb, np.int32),
+                np.ones(wb, np.float32), False)
+        self._jax.block_until_ready(tokens)
+        self.model._clear_state()
 
     # -- worker ---------------------------------------------------------------
 
     def _worker_loop(self) -> None:
         while True:
-            # Blocking admit when idle; opportunistic admits otherwise — a
-            # new request joins the *next* wave, never waits for a stream
-            # to finish. Admits collected in one pass share batched
-            # prefills (grouped by prompt bucket), so an N-stream burst
-            # costs a handful of device round trips, not N.
             pending = []
-            if not self._streams:
+            shutdown = False
+            # Blocking admit only when fully idle; otherwise opportunistic —
+            # a new request joins the *next* wave, never waits for a stream
+            # to finish.
+            if not self._streams and not self._inflight:
                 item = self.queue.get()
                 if item is _SHUTDOWN:
                     return
+                if isinstance(item, _WarmupReq):
+                    self._run_warmup(item)
+                    continue
                 pending.append(item)
-            shutdown = False
             while len(self._free) > len(pending):
                 try:
                     item = self.queue.get(timeout=0)
@@ -179,6 +270,9 @@ class GenerativeScheduler(Scheduler):
                 if item is _SHUTDOWN:
                     shutdown = True
                     break
+                if isinstance(item, _WarmupReq):
+                    self._run_warmup(item)
+                    continue
                 pending.append(item)
             if pending:
                 try:
@@ -194,14 +288,31 @@ class GenerativeScheduler(Scheduler):
             # next wave boundary (frontends set `cancelled` on disconnect).
             for s in list(self._streams):
                 if s.req.cancelled:
-                    self._streams.remove(s)
-                    self._free.append(s.row)
+                    self._drop(s)
                     self._fail(s.req, EngineError("request cancelled", 499))
-            if self._streams:
+            live = [s for s in self._streams if self._has_budget(s)]
+            if live:
                 try:
-                    self._decode_wave()
+                    self._dispatch_wave(live)
                 except Exception as exc:  # noqa: BLE001
                     self._reset_arena(exc)
+            # Consume fetches: non-blocking while results are ready or the
+            # pipeline is over depth; forced (blocking on the oldest) when
+            # nothing was dispatched — every budget-exhausted stream has
+            # its final wave in flight, so this always makes progress.
+            self._drain_fetches(force_one=not live and not pending)
+
+    def _run_warmup(self, req: _WarmupReq) -> None:
+        try:
+            self._precompile()
+        except Exception as exc:  # noqa: BLE001 — surface to the caller
+            req.error = exc
+        finally:
+            req.done.set()
+
+    def _has_budget(self, s: _Stream) -> bool:
+        return (not s.dead and s.disp_tokens < s.max_new
+                and s.disp_len + 1 < self._max_seq)
 
     def _validate(self, req: InferRequest):
         """Parse + validate one admit; returns (ids, max_new, sampling)."""
@@ -227,7 +338,8 @@ class GenerativeScheduler(Scheduler):
         return ids, max_new, _parse_sampling(req, vocab)
 
     def _admit_batch(self, items: list) -> None:
-        """Validate, group by prompt bucket, one batched prefill per chunk."""
+        """Validate, group by prompt bucket, one batched prefill per chunk;
+        prefills are dispatched without waiting (tokens fetch async)."""
         ready = []  # (req, ids, max_new, sampling)
         for req in items:
             if self._check_timeout(req) or self._check_cancelled(req):
@@ -251,7 +363,7 @@ class GenerativeScheduler(Scheduler):
             by_bucket.setdefault(bucket, []).append(entry)
         chunks = []
         for bucket, entries in sorted(by_bucket.items()):
-            cap = self._admit_buckets[-1]
+            cap = self._admit_lane
             chunks += [(bucket, entries[i:i + cap])
                        for i in range(0, len(entries), cap)]
         for ci, (bucket, chunk) in enumerate(chunks):
@@ -274,18 +386,19 @@ class GenerativeScheduler(Scheduler):
                 return
 
     def _prefill_chunk(self, prompt_bucket: int, chunk: list) -> None:
-        """One batched prefill: B admits -> ONE device round trip."""
+        """One batched prefill dispatch: B admits -> ONE device execution,
+        no host sync (the first tokens arrive through the fetch queue)."""
         n = len(chunk)
-        lane_bucket = next(b for b in self._admit_buckets if b >= n)
-        pad = lane_bucket - n
+        lane = self._admit_lane
+        pad = lane - n
         rows = [self._free.pop() for _ in range(n)]
         try:
-            ids_mat = np.zeros((lane_bucket, prompt_bucket), np.int32)
-            lens = np.ones(lane_bucket, np.int32)
-            seeds = np.zeros(lane_bucket, np.uint32)
-            temps = np.zeros(lane_bucket, np.float32)
-            top_ks = np.zeros(lane_bucket, np.int32)
-            top_ps = np.ones(lane_bucket, np.float32)
+            ids_mat = np.zeros((lane, prompt_bucket), np.int32)
+            lens = np.ones(lane, np.int32)
+            seeds = np.zeros(lane, np.uint32)
+            temps = np.zeros(lane, np.float32)
+            top_ks = np.zeros(lane, np.int32)
+            top_ps = np.ones(lane, np.float32)
             for i, (req, ids, max_new, (seed, temp, top_k, top_p,
                                         stop)) in enumerate(chunk):
                 ids_mat[i, :len(ids)] = ids
@@ -305,33 +418,34 @@ class GenerativeScheduler(Scheduler):
                     self.model._params, self._arena, rows_arr, ids_mat,
                     lens, seeds, temps, top_ks, top_ps,
                     bool((temps > 0.0).any()))
-                tokens = np.asarray(tokens)
+                tokens.copy_to_host_async()
             finally:
                 self.model._clear_state()
         except Exception:
             self._free.extend(rows)
             raise
-        self.stats.record_execution(n)
+        streams = []
         for i, (req, ids, max_new, (seed, temp, top_k, top_p,
                                     stop)) in enumerate(chunk):
-            stream = _Stream(req, rows[i], len(ids), int(tokens[i]), max_new,
+            stream = _Stream(req, rows[i], len(ids), max_new,
                              seed=seed, temp=temp, top_k=top_k, top_p=top_p,
                              stop=stop)
+            streams.append(stream)
             self._streams.append(stream)
-            if stream.last_token in stream.stop:
-                self._retire(stream)
-                continue
-            self._emit_token(stream, stream.last_token)
-            self._finish_if_done(stream)
+        # Executions are counted at dispatch (round-3 semantics): fetch-time
+        # counting would drop waves whose lanes all retired before the
+        # fetch, and everything discarded by an arena reset.
+        self.stats.record_execution(n)
+        self._inflight.append(_Inflight("prefill", streams, tokens))
 
-    def _decode_wave(self) -> None:
-        live = self._streams
+    def _dispatch_wave(self, live: list) -> None:
+        """Dispatch one decode wave; input tokens come from the arena's
+        device-side slots, so no host value is needed."""
         bucket = next(b for b in self._wave_buckets if b >= len(live))
         pad = bucket - len(live)
-        rows = np.asarray([s.row for s in live] + [self._cap] * pad, np.int32)
-        tokens = np.asarray([s.last_token for s in live] + [0] * pad,
-                            np.int32)
-        lens = np.asarray([s.length for s in live] + [0] * pad, np.int32)
+        rows = np.asarray([s.row for s in live] + [self._cap] * pad,
+                          np.int32)
+        lens = np.asarray([s.disp_len for s in live] + [0] * pad, np.int32)
         seeds = np.asarray([s.seed & 0xFFFFFFFF for s in live] + [0] * pad,
                            np.uint32).astype(np.int32)
         temps = np.asarray([s.temp for s in live] + [0.0] * pad, np.float32)
@@ -342,25 +456,46 @@ class GenerativeScheduler(Scheduler):
             f"generative decode wave ({len(live)} streams, bucket={bucket})")
         try:
             self._arena, nxt = self._decode(
-                self.model._params, self._arena, rows, tokens, lens,
+                self.model._params, self._arena, rows, lens,
                 seeds, temps, top_ks, top_ps, bool((temps > 0.0).any()))
-            nxt = np.asarray(nxt)
+            nxt.copy_to_host_async()
         finally:
             self.model._clear_state()
+        for s in live:
+            s.disp_len += 1
+            s.disp_tokens += 1
         self.stats.record_execution(len(live))
-        finished = []
-        for i, s in enumerate(live):
-            s.length += 1          # the token just consumed now occupies a slot
-            s.last_token = int(nxt[i])
-            if s.last_token in s.stop:
-                # Stop tokens terminate without being emitted.
-                finished.append(s)
-                continue
-            self._emit_token(s, s.last_token)
-            if self._stream_done(s):
-                finished.append(s)
-        for s in finished:
-            self._retire(s)
+        self._inflight.append(_Inflight("wave", live, nxt))
+
+    def _drain_fetches(self, force_one: bool = False) -> None:
+        """Consume completed token fetches in dispatch order; emission,
+        stop-token checks, and retirement happen here (a few waves behind
+        dispatch)."""
+        while self._inflight:
+            head = self._inflight[0]
+            if not (force_one or len(self._inflight) > self._depth
+                    or head.tokens.is_ready()):
+                return
+            force_one = False
+            self._inflight.popleft()
+            try:
+                toks = np.asarray(head.tokens)
+            except Exception as exc:  # noqa: BLE001 — execution failed
+                self._reset_arena(exc)
+                return
+            for i, s in enumerate(head.streams):
+                if s.dead:
+                    continue  # retired/cancelled lanes: discard junk
+                tok = int(toks[i])
+                if head.kind == "wave":
+                    s.f_len += 1
+                if tok in s.stop:
+                    # Stop tokens terminate without being emitted.
+                    self._retire(s)
+                    continue
+                self._emit_token(s, tok)
+                if s.emitted >= s.max_new or s.f_len + 1 >= self._max_seq:
+                    self._retire(s)
 
     # -- stream lifecycle ------------------------------------------------------
 
@@ -378,17 +513,18 @@ class GenerativeScheduler(Scheduler):
         ))
         s.emitted += 1
 
-    def _stream_done(self, s: _Stream) -> bool:
-        return s.emitted >= s.max_new or s.length + 1 >= self._max_seq
-
-    def _finish_if_done(self, s: _Stream) -> None:
-        if self._stream_done(s):
-            self._retire(s)
-
-    def _retire(self, s: _Stream) -> None:
+    def _drop(self, s: _Stream) -> None:
+        """Remove from the active set and release the row. The row is safe
+        to reuse immediately: executions already dispatched with it run
+        BEFORE any later prefill into the same row (single device stream,
+        dispatch order), and their lanes are discarded at fetch."""
+        s.dead = True
         if s in self._streams:
             self._streams.remove(s)
         self._free.append(s.row)
+
+    def _retire(self, s: _Stream) -> None:
+        self._drop(s)
         s.req.times.compute_input_end = s.req.times.compute_start
         s.req.times.compute_infer_end = now_ns()
         s.req.times.compute_output_end = s.req.times.compute_infer_end
@@ -404,27 +540,40 @@ class GenerativeScheduler(Scheduler):
             times=s.req.times,
         ))
 
+    def _all_tracked_streams(self) -> list:
+        """Active streams plus any stream referenced only by in-flight
+        fetches (deduped)."""
+        seen: dict[int, _Stream] = {id(s): s for s in self._streams}
+        for inf in self._inflight:
+            for s in inf.streams:
+                if not s.dead:
+                    seen.setdefault(id(s), s)
+        return list(seen.values())
+
     def _abort_streams(self, why: str) -> None:
-        for s in list(self._streams):
+        for s in self._all_tracked_streams():
+            s.dead = True
             self._fail(s.req, EngineError(why, 503))
         self._streams.clear()
+        self._inflight.clear()
         self._free = list(range(self._cap))
         self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)  # other sentinels may wait
 
     def _reset_arena(self, exc: Exception, failing=None) -> None:
-        """A failed donated call may have invalidated the arena buffers:
-        rebuild and drop every live stream (mirrors the oldest-sequence
-        batcher's recovery)."""
+        """A failed donated call may have invalidated the arena buffers —
+        and every in-flight execution behind it: rebuild and drop every
+        live stream (mirrors the oldest-sequence batcher's recovery)."""
         _log.exception(
             "model '%s': generative step failed; resetting KV arena "
             "(%d live streams dropped)", self.model.config.name,
             len(self._streams))
         if failing is not None:
             self._fail(failing, exc)
-        for s in list(self._streams):
+        for s in self._all_tracked_streams():
+            s.dead = True
             self._fail(s.req, EngineError(
                 f"generation aborted: {exc}", 500))
         self._streams.clear()
+        self._inflight.clear()
         self._free = list(range(self._cap))
         self._arena = self.model.backend.init_arena(self._cap)
-

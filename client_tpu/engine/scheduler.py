@@ -225,12 +225,27 @@ class Scheduler:
             for item in items:
                 if item is _SHUTDOWN:
                     sentinels += 1
-                else:
+                elif isinstance(item, InferRequest):
                     self._fail(item, EngineError(why, status))
+                else:
+                    # Scheduler-internal control items (e.g. a warmup
+                    # request) carry a `done` event a caller is waiting on;
+                    # record the abort so the caller doesn't read the
+                    # unprocessed item as success.
+                    if hasattr(item, "error"):
+                        item.error = EngineError(why, status)
+                    done = getattr(item, "done", None)
+                    if done is not None:
+                        done.set()
         for _ in range(sentinels):
             self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)
 
     # -- subclass API --------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Scheduler-owned precompilation (beyond the model's bucket
+        warmup); no-op by default. The generative scheduler compiles its
+        prefill/decode executables here."""
 
     def _worker_loop(self) -> None:
         raise NotImplementedError

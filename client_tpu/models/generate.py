@@ -192,13 +192,17 @@ class TinyGptBackend(ModelBackend):
 
     def init_arena(self, capacity: int):
         """KV arena pytree: k/v of shape [L, capacity+1, S, H, D] (the +1
-        dummy row absorbs padded decode lanes)."""
+        dummy row absorbs padded decode lanes) plus ``tok`` [capacity+1] —
+        each row's latest token, kept ON DEVICE so decode waves chain
+        without a host round trip per step (the scheduler pipelines waves
+        and fetches emitted tokens asynchronously)."""
         import jax.numpy as jnp
 
         shape = (self.n_layers, capacity + 1, self.max_seq_len,
                  self.n_heads, self.head_dim)
         return {"k": jnp.zeros(shape, jnp.float32),
-                "v": jnp.zeros(shape, jnp.float32)}
+                "v": jnp.zeros(shape, jnp.float32),
+                "tok": jnp.zeros(capacity + 1, jnp.int32)}
 
     def prefill_fn(self):
         """(params, arena, rows[B], ids[B, S_pad], lens[B], seeds[B],
@@ -229,13 +233,6 @@ class TinyGptBackend(ModelBackend):
                 return x, jnp.stack(ks), jnp.stack(vs)  # [S,d],[L,S,H,D]x2
 
             xB, kB, vB = jax.vmap(one)(ids)              # [B,...]
-            # Scatter whole prompt rows: [B,L,S,H,D] -> arena [L,rows,:n]
-            arena = {
-                "k": arena["k"].at[:, rows, :n].set(
-                    kB.transpose(1, 0, 2, 3, 4)),
-                "v": arena["v"].at[:, rows, :n].set(
-                    vB.transpose(1, 0, 2, 3, 4)),
-            }
             import jax.numpy as jnp
 
             b = rows.shape[0]
@@ -249,26 +246,42 @@ class TinyGptBackend(ModelBackend):
                     logits, seeds, lens, temps, top_ks, top_ps)
             else:
                 tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # Scatter whole prompt rows: [B,L,S,H,D] -> arena [L,rows,:n];
+            # the first token lands in the device-side token slot so the
+            # first decode wave can start without the host fetch.
+            arena = {
+                **arena,
+                "k": arena["k"].at[:, rows, :n].set(
+                    kB.transpose(1, 0, 2, 3, 4)),
+                "v": arena["v"].at[:, rows, :n].set(
+                    vB.transpose(1, 0, 2, 3, 4)),
+                "tok": arena["tok"].at[rows].set(tokens),
+            }
             return arena, tokens
 
         return prefill
 
     def decode_fn(self):
-        """(params, arena, rows[B], tokens[B], lens[B], seeds[B], temps[B],
+        """(params, arena, rows[B], lens[B], seeds[B], temps[B],
         top_ks[B], top_ps[B]) -> (arena, next[B]).
 
-        One batched decode step: scatter each stream's new K/V at its
-        current position, masked attention over the static max_seq_len
-        axis, per-stream sampled (or greedy) next token.
+        One batched decode step: each stream's input token is GATHERED from
+        the arena's device-side token slots (written by prefill / the
+        previous wave), so consecutive waves chain on device with no host
+        round trip between them — the scheduler dispatches waves ahead and
+        fetches emitted tokens asynchronously. Scatter each stream's new
+        K/V at its current position, masked attention over the static
+        max_seq_len axis, per-stream sampled (or greedy) next token.
         """
         import jax
         import jax.numpy as jnp
 
         h_, d_ = self.n_heads, self.head_dim
 
-        def decode(p, arena, rows, tokens, lens, seeds, temps, top_ks,
+        def decode(p, arena, rows, lens, seeds, temps, top_ks,
                    top_ps, sample=True):
             b = rows.shape[0]
+            tokens = arena["tok"][rows]                      # [B]
             x = p["embed"][tokens] + p["pos"][lens]          # [B, d]
             for li, lp in enumerate(p["layers"]):
                 h = _ln(x, lp["ln1g"], lp["ln1b"])
@@ -276,6 +289,7 @@ class TinyGptBackend(ModelBackend):
                 k = (h @ lp["wk"]).reshape(b, h_, d_)
                 v = (h @ lp["wv"]).reshape(b, h_, d_)
                 arena = {
+                    **arena,
                     "k": arena["k"].at[li, rows, lens].set(k),
                     "v": arena["v"].at[li, rows, lens].set(v),
                 }
@@ -298,6 +312,8 @@ class TinyGptBackend(ModelBackend):
                     logits, seeds, lens + 1, temps, top_ks, top_ps)
             else:
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            arena = dict(arena)
+            arena["tok"] = arena["tok"].at[rows].set(nxt)
             return arena, nxt
 
         return decode

@@ -297,9 +297,14 @@ class ShardedTinyGptBackend(TinyGptBackend):
         from jax.sharding import PartitionSpec as P
 
         arena = super().init_arena(capacity)
-        # [L, cap+1, S, H, D]: shard the heads axis with the weights.
-        sh = NamedSharding(self.mesh, P(None, None, None, "tp", None))
-        return jax.tree.map(lambda a: jax.device_put(a, sh), arena)
+        # k/v [L, cap+1, S, H, D]: shard the heads axis with the weights;
+        # the per-row token slots replicate (tiny, read by every shard).
+        kv = NamedSharding(self.mesh, P(None, None, None, "tp", None))
+        rep = NamedSharding(self.mesh, P())
+        return {
+            name: jax.device_put(a, kv if a.ndim == 5 else rep)
+            for name, a in arena.items()
+        }
 
 
 register_model("tiny_gpt_mc", default=False)(ShardedTinyGptBackend)
