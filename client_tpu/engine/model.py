@@ -328,8 +328,14 @@ class Model:
             self._set_state("fetching outputs")
             host: dict[str, np.ndarray] = {}
             for name, val in outputs.items():
-                arr = val if not fetch_outputs and \
-                    isinstance(val, self._jax.Array) else np.asarray(val)
+                if not fetch_outputs and isinstance(val, self._jax.Array):
+                    # Device-resident return: skip the batch trim — slicing
+                    # a jax.Array dispatches an execution; the caller
+                    # windows per-request ranges with zero-dispatch views
+                    # (padding sits past every real request's range).
+                    host[name] = val
+                    continue
+                arr = np.asarray(val)
                 if pad_to is not None and batch_size is not None \
                         and arr.ndim >= 1 and arr.shape[0] == pad_to:
                     arr = arr[:batch_size]

@@ -425,18 +425,32 @@ class DefaultScheduler(Scheduler):
                 for name in batch[0].inputs
             }
             # When every request in the batch directs every output into a
-            # device-resident region, leave outputs in HBM (per-request
-            # slices below are lazy device views; the shm write stores them
-            # without a host round trip).
+            # device-resident region, leave outputs in HBM. Per-request
+            # windows are ZERO-DISPATCH views (engine/shm.py
+            # DeviceTensorView): slicing a jax.Array here would dispatch a
+            # tiny XLA execution per request per output — 2B extra device
+            # round trips for a B-request batch, the round-3 small-payload
+            # pathology.
             fetch = not all(r.keep_outputs_on_device for r in batch)
             outputs, phases = self.model.execute_timed(
                 merged, batch_size=total, fetch_outputs=fetch)
             self.stats.record_execution(total)
-            offset = 0
-            for r, sz in zip(batch, sizes):
-                per = {k: v[offset:offset + sz] for k, v in outputs.items()}
-                offset += sz
-                self._finish(r, per, phases)
+            if fetch:
+                offset = 0
+                for r, sz in zip(batch, sizes):
+                    per = {k: v[offset:offset + sz]
+                           for k, v in outputs.items()}
+                    offset += sz
+                    self._finish(r, per, phases)
+            else:
+                from client_tpu.engine.shm import DeviceTensorView
+
+                offset = 0
+                for r, sz in zip(batch, sizes):
+                    per = {k: DeviceTensorView(v, offset, offset + sz)
+                           for k, v in outputs.items()}
+                    offset += sz
+                    self._finish(r, per, phases)
         else:
             outputs, phases = self.model.execute_timed(
                 batch[0].inputs, batch_size=None)
@@ -498,6 +512,11 @@ class DecoupledScheduler(Scheduler):
         gen = self.model.backend.generate(req.inputs, req.parameters)
         count = 0
         for outputs in gen:
+            if req.cancelled:
+                # Client abandoned (disconnect) or server-side shedding
+                # (slow-consumer policy): stop producing mid-stream.
+                gen.close()
+                raise EngineError("request cancelled", 499)
             self._emit(req, outputs, final=False)
             count += 1
         req.times.compute_input_end = req.times.compute_start

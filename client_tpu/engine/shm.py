@@ -208,6 +208,55 @@ def _np_dtype(datatype: str):
 # ---------------------------------------------------------------------------
 
 
+class DeviceTensorView:
+    """A zero-dispatch window into a device-resident batch output.
+
+    The dynamic batcher's per-request output slices used to be lazy
+    ``jax.Array`` slices — each one DISPATCHES a tiny XLA execution, so a
+    64-request batch cost ~128 extra device executions just to split its
+    outputs (measured as the round-3 device-plane pathology: 379 ips /
+    p99 3.3 s on 64 B tensors vs 839 inline). A view carries only
+    (parent, start, stop) metadata; the actual gather runs once, on the
+    first reader, not per enqueued response."""
+
+    __slots__ = ("parent", "start", "stop", "_materialized")
+
+    def __init__(self, parent, start: int, stop: int):
+        self.parent = parent
+        self.start = int(start)
+        self.stop = int(stop)
+        self._materialized = None
+
+    @property
+    def shape(self):
+        return (self.stop - self.start,) + tuple(self.parent.shape[1:])
+
+    @property
+    def ndim(self) -> int:
+        return self.parent.ndim
+
+    @property
+    def dtype(self):
+        return self.parent.dtype
+
+    @property
+    def nbytes(self) -> int:
+        n = int(np.dtype(self.parent.dtype).itemsize)
+        for d in self.shape:
+            n *= int(d)
+        return n
+
+    def materialize(self):
+        """The device slice, dispatched once and cached."""
+        if self._materialized is None:
+            self._materialized = self.parent[self.start:self.stop]
+        return self._materialized
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self.materialize())
+        return arr.astype(dtype) if dtype is not None else arr
+
+
 def make_tpu_handle(key: str, byte_size: int, device_id: int = 0) -> bytes:
     """Serialize a cross-process TPU region handle (host-staged backing)."""
     return json.dumps({
@@ -350,7 +399,7 @@ class TpuShmManager:
         region = self._get(name)
         shape = tuple(int(d) for d in shape)
         if region.kind == "device":
-            arr = region.device_array
+            arr = self._resolve_device_array(region)
             if int(offset):
                 raise EngineError(
                     f"region '{name}': offsets unsupported for device "
@@ -377,6 +426,13 @@ class TpuShmManager:
                 raise EngineError(
                     f"output ({arr.nbytes}B) exceeds device region "
                     f"'{name}' ({region.byte_size}B)", 400)
+            if isinstance(arr, DeviceTensorView):
+                # Zero-dispatch store: the region holds the view; the
+                # gather out of the batch buffer runs on first read. The
+                # parent batch buffer stays alive until the next write —
+                # bounded by one batch's outputs.
+                region.device_array = arr
+                return int(arr.nbytes)
             import jax
 
             region.device_array = (
@@ -386,10 +442,24 @@ class TpuShmManager:
         return region.staging.write_ndarray(offset, byte_size,
                                             np.asarray(arr))
 
+    def _resolve_device_array(self, region: _TpuRegion):
+        """Materialize a stored output view (once). The store-back happens
+        under the manager lock and only when the region still holds the
+        SAME view — a concurrent write_tensor of a newer batch's output
+        must not be clobbered by this read's stale materialization."""
+        arr = region.device_array
+        if not isinstance(arr, DeviceTensorView):
+            return arr
+        materialized = arr.materialize()
+        with self._lock:
+            if region.device_array is arr:
+                region.device_array = materialized
+        return materialized
+
     def read_back(self, name):
         """In-process reader: current device array of a region."""
         region = self._get(name)
         if region.kind == "device":
-            return region.device_array
+            return self._resolve_device_array(region)
         raise EngineError(
             f"region '{name}' is host-staged; read via its shm key", 400)

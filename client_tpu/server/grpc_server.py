@@ -10,6 +10,7 @@ with correlation by request id, terminated per-request by the
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from concurrent import futures
@@ -149,8 +150,14 @@ def _write_shm_output(engine, o: OutputRequest, arr: np.ndarray) -> int:
 
 
 class _Servicer(GRPCInferenceServiceServicer):
-    def __init__(self, engine: TpuEngine):
+    def __init__(self, engine: TpuEngine,
+                 stream_pending_limit: int | None = None):
         self.engine = engine
+        if stream_pending_limit is None:
+            stream_pending_limit = int(os.environ.get(
+                "CLIENT_TPU_STREAM_PENDING_LIMIT",
+                str(self.STREAM_PENDING_LIMIT)))
+        self.STREAM_PENDING_LIMIT = max(1, stream_pending_limit)
 
     # -- health / metadata ---------------------------------------------------
 
@@ -355,10 +362,15 @@ class _Servicer(GRPCInferenceServiceServicer):
         except Exception as exc:  # noqa: BLE001
             _abort(context, exc)
 
-    # Slow-consumer high-water mark per stream RPC: when this many responses
-    # sit unread, every live request on the stream is cancelled (logged) —
-    # the schedulers then stop producing at the next wave, so a stalled
-    # reader bounds memory instead of growing it token by token.
+    # Slow-consumer high-water mark per stream RPC (default; configurable
+    # per server or via CLIENT_TPU_STREAM_PENDING_LIMIT): when this many
+    # responses sit unread, the request contributing the MOST pending
+    # responses is cancelled (logged) — and, while the backlog stays over
+    # the mark, further offenders one at a time — so one runaway stream
+    # on a multi-request RPC is shed without killing its siblings. The
+    # schedulers stop producing for a cancelled request at the next wave,
+    # so a stalled reader bounds memory instead of growing it token by
+    # token.
     STREAM_PENDING_LIMIT = 1024
 
     def ModelStreamInfer(self, request_iterator, context):  # noqa: N802
@@ -375,8 +387,8 @@ class _Servicer(GRPCInferenceServiceServicer):
         inflight = [0]
         lock = threading.Lock()
         done_reading = threading.Event()
-        choked = [False]
         live_reqs: dict = {}  # id(req) -> req (InferRequest is unhashable)
+        pending_by_req: dict = {}  # id(req) -> responses enqueued, unread
         # When the stream dies (client cancel/disconnect), every in-flight
         # request on it is abandoned: mark them so schedulers stop spending
         # device time (generation streams retire at the next wave). If the
@@ -385,17 +397,47 @@ class _Servicer(GRPCInferenceServiceServicer):
         stream_dead = not context.add_callback(
             lambda: [r.cancel() for r in list(live_reqs.values())])
 
+        choke_at = [self.STREAM_PENDING_LIMIT]
+
         def choke_if_backlogged():
-            if choked[0] or out_q.qsize() < self.STREAM_PENDING_LIMIT:
+            """Per-request shedding with escalation hysteresis: when the
+            RPC's backlog crosses the mark, cancel the live request with
+            the most pending responses — not every stream on the RPC. The
+            next shed triggers only if the backlog GROWS by another full
+            limit (a cancelled hog stops producing at its next wave, so a
+            merely-slow reader sheds one offender and the siblings keep
+            streaming; total memory stays bounded by limit x live
+            requests)."""
+            size = out_q.qsize()
+            if size < self.STREAM_PENDING_LIMIT:
+                choke_at[0] = self.STREAM_PENDING_LIMIT  # re-arm on drain
                 return
-            choked[0] = True
-            victims = list(live_reqs.values())
+            if size < choke_at[0]:
+                return
+            with lock:
+                # Re-check under the lock: two callbacks crossing the mark
+                # concurrently must shed ONE victim, not one each (the
+                # second would cancel a well-behaved sibling).
+                size = out_q.qsize()
+                if size < choke_at[0]:
+                    return
+                victim = None
+                worst = -1
+                for rid, r in live_reqs.items():
+                    if r.cancelled:
+                        continue  # already shedding; let it drain
+                    n = pending_by_req.get(rid, 0)
+                    if n > worst:
+                        victim, worst = r, n
+                if victim is not None:
+                    choke_at[0] = size + self.STREAM_PENDING_LIMIT
+            if victim is None:
+                return
             _log.warning(
-                "stream RPC backlog exceeded %d pending responses; "
-                "cancelling %d in-flight request(s) (slow consumer)",
-                self.STREAM_PENDING_LIMIT, len(victims))
-            for r in victims:
-                r.cancel()
+                "stream RPC backlog at %d pending responses (mark %d); "
+                "cancelling the heaviest in-flight request (%d pending) "
+                "(slow consumer)", size, self.STREAM_PENDING_LIMIT, worst)
+            victim.cancel()
 
         def pump_requests():
             try:
@@ -419,6 +461,9 @@ class _Servicer(GRPCInferenceServiceServicer):
                         def cb(resp):
                             # Scheduler-thread side: enqueue only — the
                             # writer encodes.
+                            with lock:
+                                pending_by_req[id(req)] = \
+                                    pending_by_req.get(id(req), 0) + 1
                             out_q.put(("resp", req, resp))
                             choke_if_backlogged()
                             if resp.final:
@@ -466,6 +511,14 @@ class _Servicer(GRPCInferenceServiceServicer):
         while True:
             item = out_q.get()
             if item is not None:
+                if item[0] == "resp":
+                    with lock:
+                        rid = id(item[1])
+                        n = pending_by_req.get(rid, 1) - 1
+                        if n > 0:
+                            pending_by_req[rid] = n
+                        else:
+                            pending_by_req.pop(rid, None)
                 try:
                     yield encode(item)
                 except Exception as exc:  # noqa: BLE001 — encode failure

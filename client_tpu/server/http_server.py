@@ -18,6 +18,7 @@ from __future__ import annotations
 import gzip
 import json
 import logging
+import os
 import re
 import threading
 import zlib
@@ -338,8 +339,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     # Slow-consumer bound for SSE streams: responses pending unread before
     # the request is cancelled (the generative scheduler then stops
-    # producing at the next wave) — a stalled reader caps memory.
+    # producing at the next wave) — a stalled reader caps memory. One SSE
+    # stream carries ONE request, so cancelling it is already per-request.
     STREAM_PENDING_LIMIT = 1024
+
+    def _stream_pending_limit(self) -> int:
+        """Read the env knob per stream (not at import) so it matches the
+        gRPC servicer's construction-time semantics."""
+        return max(1, int(os.environ.get(
+            "CLIENT_TPU_STREAM_PENDING_LIMIT",
+            str(self.STREAM_PENDING_LIMIT))))
 
     def _stream_responses(self, req: InferRequest):
         """Submit and yield responses until the final one; a stall cancels
@@ -349,15 +358,16 @@ class _Handler(BaseHTTPRequestHandler):
 
         out_q: q.Queue = q.Queue()
         choked = [False]
+        limit = self._stream_pending_limit()
 
         def enqueue(resp):
             out_q.put(resp)
-            if not choked[0] and out_q.qsize() >= self.STREAM_PENDING_LIMIT:
+            if not choked[0] and out_q.qsize() >= limit:
                 choked[0] = True
                 _log.warning(
                     "generate stream backlog exceeded %d pending "
                     "responses; cancelling request (slow consumer)",
-                    self.STREAM_PENDING_LIMIT)
+                    limit)
                 req.cancel()
 
         self.engine.async_infer(req, enqueue)

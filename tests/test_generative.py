@@ -628,3 +628,71 @@ def _time_wait_some(engine):
     import time as _t
 
     _t.sleep(0.05)  # let a few waves dispatch before the burst lands
+
+
+class TestPerRequestShedding:
+    """r3 VERDICT weak #6: a backlogged stream RPC must shed the request
+    producing the backlog, not every live request on the RPC."""
+
+    def test_fast_stream_survives_slow_sibling_shedding(self):
+        """One RPC, two decoupled requests: a hog flooding responses and a
+        well-behaved sibling trickling them. When the unread backlog
+        crosses the high-water mark, the hog is cancelled and the sibling
+        runs to completion."""
+        import time as _time
+
+        from client_tpu.engine.repository import ModelRepository
+        from client_tpu.models.simple import RepeatBackend
+        from client_tpu.protocol import grpc_service_pb2 as pb
+        from client_tpu.server.grpc_server import _Servicer
+
+        backend = RepeatBackend()
+        backend.config.instance_count = 2  # hog and sibling stream together
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        eng = TpuEngine(repo)
+        try:
+            servicer = _Servicer(eng, stream_pending_limit=16)
+
+            class FakeContext:
+                def add_callback(self, cb):
+                    return True
+
+                def is_active(self):
+                    return True
+
+            def repeat_req(rid, values, delay_us):
+                req = pb.ModelInferRequest(model_name="simple_repeat",
+                                           id=rid)
+                t = req.inputs.add()
+                t.name, t.datatype = "IN", "INT32"
+                t.shape.extend([len(values)])
+                t.contents.int_contents.extend(values)
+                d = req.inputs.add()
+                d.name, d.datatype = "DELAY", "UINT32"
+                d.shape.extend([len(values)])
+                d.contents.uint_contents.extend([delay_us] * len(values))
+                return req
+
+            hog = repeat_req("hog", list(range(500)), 1000)      # ~1ms/resp
+            meek = repeat_req("meek", list(range(10)), 30_000)   # 30ms/resp
+            stream = servicer.ModelStreamInfer(
+                iter([hog, meek]), FakeContext())
+            first = next(stream)  # starts the pump; then stop consuming
+            _time.sleep(2.0)      # hog floods past the mark; meek trickles
+            msgs = [first] + list(stream)
+            by_id: dict = {"hog": [], "meek": []}
+            errors = []
+            for m in msgs:
+                if m.error_message:
+                    errors.append(m.error_message)
+                    continue
+                by_id.setdefault(m.infer_response.id, []).append(m)
+            # The meek stream delivered everything: 10 responses + final.
+            assert len(by_id["meek"]) == 11, len(by_id["meek"])
+            # The hog was shed well before its 500 responses...
+            assert len(by_id["hog"]) < 300, len(by_id["hog"])
+            # ...and the cancellation surfaced as a stream error.
+            assert any("cancel" in e for e in errors), errors
+        finally:
+            eng.shutdown()
