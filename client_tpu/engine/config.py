@@ -97,7 +97,12 @@ class SequenceBatchingConfig:
     # 'direct' (slot-pinned) or 'oldest' (dynamic over active sequences) —
     # mirrors Triton's two sequence-batcher strategies.
     strategy: str = "direct"
-    max_sequence_idle_microseconds: int = 1_000_000_000
+    # Triton parity: model_config.proto documents 1000000 us (1 s) as the
+    # default idle window. Round 3 shipped 1000 s, which turned every
+    # killed client into a near-permanent arena-row leak (the cap then
+    # 429s fresh sequences); active sequences are protected from eviction
+    # by the inflight/pending guards regardless of this value.
+    max_sequence_idle_microseconds: int = 1_000_000
     # 'oldest' strategy knobs (Triton oldest.max_candidate_sequences /
     # oldest.max_queue_delay_microseconds): arena capacity for concurrently
     # live sequences, and how long a forming step batch waits for more
@@ -185,7 +190,7 @@ class ModelConfig:
             sb = SequenceBatchingConfig(
                 strategy=strategy,
                 max_sequence_idle_microseconds=int(
-                    raw.get("max_sequence_idle_microseconds", 1_000_000_000)),
+                    raw.get("max_sequence_idle_microseconds", 1_000_000)),
                 max_candidate_sequences=int(
                     oldest.get("max_candidate_sequences",
                                raw.get("max_candidate_sequences", 64))),

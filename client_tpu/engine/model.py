@@ -312,10 +312,13 @@ class Model:
             # Enqueue all D2H copies *before* waiting on compute: each copy
             # starts the moment its buffer is ready, exactly as the untimed
             # path pipelined it, so the block below costs one host wake-up,
-            # not a serialization of compute against transfer.
+            # not a serialization of compute against transfer. (Outputs
+            # spanning other processes' devices can't be host-copied here;
+            # they go through the allgather below instead.)
             if fetch_outputs:
                 for val in device_outs:
-                    val.copy_to_host_async()
+                    if val.is_fully_addressable:
+                        val.copy_to_host_async()
             if device_outs:
                 # Executable-complete boundary (device buffers ready).
                 self._jax.block_until_ready(device_outs)
@@ -335,7 +338,7 @@ class Model:
                     # (padding sits past every real request's range).
                     host[name] = val
                     continue
-                arr = np.asarray(val)
+                arr = self._fetch_host(val)
                 if pad_to is not None and batch_size is not None \
                         and arr.ndim >= 1 and arr.shape[0] == pad_to:
                     arr = arr[:batch_size]
@@ -346,6 +349,18 @@ class Model:
             # Always clear: a raise mid-compile must not leave a stale
             # "compiling" state to misdirect later timeout diagnostics.
             self._clear_state()
+
+    def _fetch_host(self, val) -> np.ndarray:
+        """Device→host fetch that works under multihost: an output sharded
+        over a global mesh spans devices this process cannot address, so a
+        plain ``np.asarray`` raises — allgather the shards first (one
+        compiled collective, cached per sharding/shape; on a pod it rides
+        DCN exactly like the data-parallel gradient traffic)."""
+        if isinstance(val, self._jax.Array) and not val.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            val = multihost_utils.process_allgather(val, tiled=True)
+        return np.asarray(val)
 
     def execute_stateful(self, state, inputs: dict[str, np.ndarray]):
         """Sequence-model step: ``apply(state, inputs) -> (state, outputs)``.
@@ -368,9 +383,11 @@ class Model:
                     f"model '{self.config.name}' returned {type(outputs)}, "
                     "expected dict", 500)
             for val in outputs.values():
-                if isinstance(val, self._jax.Array):
+                if isinstance(val, self._jax.Array) \
+                        and val.is_fully_addressable:
                     val.copy_to_host_async()
-            host = {name: np.asarray(val) for name, val in outputs.items()}
+            host = {name: self._fetch_host(val)
+                    for name, val in outputs.items()}
             return new_state, host
         finally:
             self._clear_state()

@@ -147,14 +147,19 @@ def make_train_step(mesh, vocab=256, d_model=128, d_ff=256, n_layers=2,
     return params, opt_state, train_step, data_sharding
 
 
-def dryrun_training_step(n_devices: int, batch=8, seq=32) -> None:
-    """Build the mesh, jit the full train step over it, run ONE step."""
+def dryrun_training_step(n_devices: int, batch=8, seq=32,
+                         mesh=None) -> None:
+    """Build the mesh, jit the full train step over it, run ONE step.
+
+    ``mesh`` overrides the auto-built one — the multihost test passes a
+    global mesh spanning several processes' devices."""
     import jax
     import jax.numpy as jnp
 
     from client_tpu.parallel.mesh import make_mesh
 
-    mesh = make_mesh(n_devices)
+    if mesh is None:
+        mesh = make_mesh(n_devices)
     params, opt_state, train_step, data_sharding = make_train_step(mesh)
     tokens = jax.device_put(
         jnp.asarray(

@@ -537,8 +537,12 @@ class _Servicer(GRPCInferenceServiceServicer):
 
 class GrpcInferenceServer:
     def __init__(self, engine: TpuEngine, host: str = "127.0.0.1",
-                 port: int = 8001, max_workers: int = 16,
+                 port: int = 8001, max_workers: int = 64,
                  certfile: str | None = None, keyfile: str | None = None):
+        # max_workers sizes grpcio's handler pool. Every live
+        # ModelStreamInfer RPC HOLDS one pool thread for its lifetime, so
+        # the pool bounds concurrent streams: at 16 (the old default) a
+        # 32-stream client starved the pool and hung with zero diagnostics.
         self.engine = engine
         self.server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),

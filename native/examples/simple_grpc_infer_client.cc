@@ -27,8 +27,9 @@ int main(int argc, char** argv) {
   std::string url = "localhost:8001";
   bool verbose = false;
   std::string ca_file;  // -C: CA bundle; implies TLS (as does grpcs://)
+  std::string compress;  // -z gzip|deflate: per-call message compression
   int opt;
-  while ((opt = getopt(argc, argv, "vu:C:")) != -1) {
+  while ((opt = getopt(argc, argv, "vu:C:z:")) != -1) {
     switch (opt) {
       case 'u':
         url = optarg;
@@ -39,9 +40,13 @@ int main(int argc, char** argv) {
       case 'C':
         ca_file = optarg;
         break;
+      case 'z':
+        compress = optarg;
+        break;
       default:
         std::cerr << "usage: " << argv[0]
-                  << " [-v] [-u host:port] [-C ca.pem]" << std::endl;
+                  << " [-v] [-u host:port] [-C ca.pem] [-z gzip|deflate]"
+                  << std::endl;
         return 2;
     }
   }
@@ -95,6 +100,15 @@ int main(int argc, char** argv) {
 
   tc::InferOptions options("simple");
   options.request_id = "1";
+  if (compress == "gzip") {
+    options.compression_algorithm = tc::GrpcCompression::GZIP;
+  } else if (compress == "deflate") {
+    options.compression_algorithm = tc::GrpcCompression::DEFLATE;
+  } else if (!compress.empty()) {
+    std::cerr << "error: unknown compression '" << compress << "'"
+              << std::endl;
+    return 2;
+  }
 
   tc::InferResult* result;
   FAIL_IF_ERR(client->Infer(&result, options, {input0, input1},

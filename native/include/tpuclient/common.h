@@ -39,6 +39,20 @@ inline int64_t ElementCount(const std::vector<int64_t>& shape) {
   return n;
 }
 
+// gRPC per-call message compression (reference grpc_client.h:323-382 takes
+// grpc_compression_algorithm on Infer/AsyncInfer/stream; here the algorithm
+// travels in InferOptions). GZIP/DEFLATE compress the framed request
+// message (flag byte 1 + `grpc-encoding` header); compressed responses are
+// inflated transparently.
+enum class GrpcCompression { NONE, GZIP, DEFLATE };
+
+// zlib helpers shared by the HTTP body compression and the gRPC message
+// compression paths (internal).
+namespace zutil {
+Error Deflate(const std::string& in, bool gzip, std::string* out);
+Error Inflate(const std::string& in, std::string* out);  // auto-detects
+}  // namespace zutil
+
 // Per-request options (reference InferOptions, common.h:156-208).
 struct InferOptions {
   explicit InferOptions(const std::string& model_name_)
@@ -63,6 +77,8 @@ struct InferOptions {
   std::map<std::string, int64_t> int_parameters;
   std::map<std::string, std::string> string_parameters;
   std::map<std::string, bool> bool_parameters;
+  // gRPC clients only: per-call message compression algorithm.
+  GrpcCompression compression_algorithm = GrpcCompression::NONE;
 };
 
 // Input tensor: shape/dtype plus either scatter-gather host buffers or a

@@ -165,7 +165,11 @@ class InferenceServerGrpcClient : public InferenceServerClient {
 
   // Bidirectional streaming: one ModelStreamInfer stream per client.
   // callback fires once per stream response, in stream order.
-  Error StartStream(OnCompleteFn callback, const GrpcHeaders& headers = {});
+  // `compression` declares the stream's grpc-encoding up front; subsequent
+  // AsyncStreamInfer calls whose options request that algorithm send
+  // compressed messages (reference grpc_client.h:364-382).
+  Error StartStream(OnCompleteFn callback, const GrpcHeaders& headers = {},
+                    GrpcCompression compression = GrpcCompression::NONE);
   Error AsyncStreamInfer(const InferOptions& options,
                          const std::vector<InferInput*>& inputs,
                          const std::vector<const InferRequestedOutput*>&
@@ -198,6 +202,7 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   void StreamWorker();
 
   std::shared_ptr<h2::Connection> conn_;
+  GrpcCompression stream_compression_ = GrpcCompression::NONE;
   std::string authority_;
 
   // Sync-path request proto, reused across calls (reference infer_request_

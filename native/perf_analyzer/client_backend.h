@@ -91,7 +91,38 @@ class ClientBackend {
   virtual tpuclient::Error UnregisterTpuSharedMemory(const std::string& name);
 
   virtual bool SupportsAsync() const { return true; }
+
+  // Bidirectional streaming (reference main.cc:610-748 drives sequence
+  // models over one gRPC stream with --streaming; only the TPU_GRPC kind
+  // implements it here). The callback fires once per STREAM RESPONSE — a
+  // decoupled model emits several per request, the last one carrying the
+  // triton_final_response parameter.
+  virtual bool SupportsStreaming() const { return false; }
+  virtual tpuclient::Error StartStream(tpuclient::OnCompleteFn callback) {
+    (void)callback;
+    return tpuclient::Error(
+        "streaming is not supported by this service kind");
+  }
+  virtual tpuclient::Error AsyncStreamInfer(
+      const tpuclient::InferOptions& options,
+      const std::vector<tpuclient::InferInput*>& inputs,
+      const std::vector<const tpuclient::InferRequestedOutput*>& outputs) {
+    (void)options;
+    (void)inputs;
+    (void)outputs;
+    return tpuclient::Error(
+        "streaming is not supported by this service kind");
+  }
+  virtual tpuclient::Error StopStream() {
+    return tpuclient::Error(
+        "streaming is not supported by this service kind");
+  }
 };
+
+// True when this stream response terminates its request: the response
+// carries no triton_final_response parameter (non-decoupled model — one
+// response per request) or carries it set. Implemented by the gRPC kind.
+bool IsFinalStreamResponse(tpuclient::InferResult* result);
 
 class ClientBackendFactory {
  public:

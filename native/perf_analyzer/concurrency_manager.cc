@@ -120,6 +120,78 @@ void ConcurrencyManager::WorkerLoop(std::shared_ptr<ThreadStat> stat,
       continue;
     }
 
+    // streaming: requests ride ONE bidi stream per worker; the stream
+    // callback multiplexes completions back to their contexts by request
+    // id (reference --streaming, main.cc:610-748). Mid-stream responses
+    // of decoupled models are counted only at the final response.
+    if (options_.streaming && !config->stream_started) {
+      ThreadStat* stat_ptr = stat.get();
+      ThreadConfig* cfg = config.get();
+      Error serr = config->backend->StartStream(
+          [this, cfg, stat_ptr, ongoing](tpuclient::InferResult* result) {
+            uint64_t end = NowNs();
+            Error status = result != nullptr ? result->RequestStatus()
+                                             : Error("null stream response");
+            bool final = IsFinalStreamResponse(result);
+            std::string id;
+            if (result != nullptr) result->Id(&id);
+            delete result;
+            if (!final) return;
+            StreamPending pending;
+            bool found = false;
+            {
+              std::lock_guard<std::mutex> lk(cfg->stream_mu);
+              auto it = cfg->stream_pending.find(id);
+              if (it != cfg->stream_pending.end()) {
+                pending = it->second;
+                cfg->stream_pending.erase(it);
+                found = true;
+              }
+            }
+            if (!found) {
+              if (!status.IsOk()) {
+                // Terminal stream failure (reset/disconnect): the dead
+                // stream will deliver no more callbacks, so every request
+                // still pending on it must be failed out here or the
+                // end-of-run drain (ongoing > 0) never terminates.
+                std::vector<StreamPending> orphans;
+                {
+                  std::lock_guard<std::mutex> lk(cfg->stream_mu);
+                  for (auto& kv : cfg->stream_pending)
+                    orphans.push_back(kv.second);
+                  cfg->stream_pending.clear();
+                }
+                if (!orphans.empty()) {
+                  {
+                    std::lock_guard<std::mutex> lk(stat_ptr->mu);
+                    stat_ptr->status = status;
+                  }
+                  for (auto& o : orphans) o.ctx->inflight = false;
+                  ongoing->fetch_sub(orphans.size());
+                  wake_cv_.notify_all();
+                }
+              }
+              return;  // late/unknown id (stream already failed)
+            }
+            if (status.IsOk()) {
+              RecordRequest(stat_ptr, pending.start_ns, end, pending.seq_end,
+                            false);
+            } else {
+              std::lock_guard<std::mutex> lk(stat_ptr->mu);
+              stat_ptr->status = status;
+            }
+            pending.ctx->inflight = false;
+            ongoing->fetch_sub(1);
+            wake_cv_.notify_all();
+          });
+      if (!serr.IsOk()) {
+        std::lock_guard<std::mutex> lk(stat->mu);
+        stat->status = serr;
+        return;
+      }
+      config->stream_started = true;
+    }
+
     // async: top up in-flight requests to the target share
     while (ongoing->load() < target && !exit_.load()) {
       // find or create a free context
@@ -148,6 +220,33 @@ void ConcurrencyManager::WorkerLoop(std::shared_ptr<ThreadStat> stat,
       ctx->start_ns = NowNs();
       bool seq_end = ctx->options->sequence_end;
       ThreadStat* stat_ptr = stat.get();
+      if (options_.streaming) {
+        // Unique id for completion routing (the stream callback is shared
+        // by every context on this worker).
+        std::string rid =
+            std::to_string(config->index) + "-" +
+            std::to_string(config->stream_seq.fetch_add(1));
+        ctx->options->request_id = rid;
+        {
+          std::lock_guard<std::mutex> lk(config->stream_mu);
+          config->stream_pending[rid] = {ctx, ctx->start_ns, seq_end};
+        }
+        ongoing->fetch_add(1);
+        err = config->backend->AsyncStreamInfer(*ctx->options, ctx->inputs,
+                                                ctx->outputs);
+        if (!err.IsOk()) {
+          {
+            std::lock_guard<std::mutex> lk(config->stream_mu);
+            config->stream_pending.erase(rid);
+          }
+          ctx->inflight = false;
+          ongoing->fetch_sub(1);
+          std::lock_guard<std::mutex> sk(stat->mu);
+          stat->status = err;
+          return;
+        }
+        continue;
+      }
       // count before dispatch: the callback may fire (and decrement) before
       // AsyncInfer returns
       ongoing->fetch_add(1);
@@ -186,6 +285,10 @@ void ConcurrencyManager::WorkerLoop(std::shared_ptr<ThreadStat> stat,
   // drain in-flight requests before the backend is destroyed
   while (ongoing->load() > 0) {
     std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+  if (options_.streaming && config->stream_started) {
+    config->backend->StopStream();
+    config->stream_started = false;
   }
 }
 

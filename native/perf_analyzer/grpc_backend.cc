@@ -131,6 +131,22 @@ class GrpcClientBackend : public ClientBackend {
     return client_->AsyncInfer(std::move(callback), options, inputs, outputs);
   }
 
+  bool SupportsStreaming() const override { return true; }
+
+  Error StartStream(tpuclient::OnCompleteFn callback) override {
+    return client_->StartStream(std::move(callback));
+  }
+
+  Error AsyncStreamInfer(
+      const tpuclient::InferOptions& options,
+      const std::vector<tpuclient::InferInput*>& inputs,
+      const std::vector<const tpuclient::InferRequestedOutput*>& outputs)
+      override {
+    return client_->AsyncStreamInfer(options, inputs, outputs);
+  }
+
+  Error StopStream() override { return client_->StopStream(); }
+
   Error ModelInferenceStatistics(std::map<std::string, ModelStatistics>* stats,
                                  const std::string& model_name) override {
     inference::ModelStatisticsResponse resp;
@@ -188,6 +204,19 @@ class GrpcClientBackend : public ClientBackend {
 Error CreateGrpcBackend(const std::string& url, bool verbose,
                         std::unique_ptr<ClientBackend>* backend) {
   return GrpcClientBackend::Create(url, verbose, backend);
+}
+
+bool IsFinalStreamResponse(tpuclient::InferResult* result) {
+  if (result == nullptr) return true;
+  // Error results carry no response proto (InferResultGrpc is built with a
+  // null message on stream errors) — they terminate their request.
+  if (!result->RequestStatus().IsOk()) return true;
+  auto* g = dynamic_cast<tpuclient::InferResultGrpc*>(result);
+  if (g == nullptr) return true;  // non-gRPC results: one-shot
+  const auto& params = g->Response().parameters();
+  auto it = params.find("triton_final_response");
+  if (it == params.end()) return true;  // non-decoupled model
+  return it->second.bool_param();
 }
 
 }  // namespace tpuperf

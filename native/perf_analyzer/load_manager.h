@@ -26,6 +26,10 @@ namespace tpuperf {
 struct LoadOptions {
   int32_t batch_size = 1;
   bool async = false;
+  // Drive requests over one bidi gRPC stream per worker instead of unary
+  // calls (reference --streaming, main.cc:610-748; sequence models keep
+  // per-context ordering because each context's requests are serialized).
+  bool streaming = false;
   size_t max_threads = 16;
   SharedMemoryType shm_type = SharedMemoryType::NONE;
   size_t output_shm_size = 100 * 1024;
@@ -89,6 +93,15 @@ class LoadManager {
     uint64_t start_ns = 0;
   };
 
+  // One dispatched-but-unanswered streaming request (keyed by request id:
+  // the bidi stream multiplexes every context's responses onto one
+  // callback).
+  struct StreamPending {
+    InferContext* ctx = nullptr;
+    uint64_t start_ns = 0;
+    bool seq_end = false;
+  };
+
   struct ThreadConfig {
     size_t index = 0;
     // Written by StartWorkers while a previously-started worker may still be
@@ -97,6 +110,11 @@ class LoadManager {
     std::atomic<size_t> stride{1};
     std::unique_ptr<ClientBackend> backend;
     std::vector<std::unique_ptr<InferContext>> ctxs;
+    // streaming mode state (one stream per worker/backend)
+    bool stream_started = false;
+    std::mutex stream_mu;
+    std::map<std::string, StreamPending> stream_pending;
+    std::atomic<uint64_t> stream_seq{0};
   };
 
   // Registered shm staging for one input data chunk.

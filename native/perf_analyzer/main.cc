@@ -7,12 +7,17 @@
 #include <getopt.h>
 #include <signal.h>
 
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <fstream>
+#include <mutex>
 #include <set>
 #include <sstream>
+#include <vector>
 
 #include "inference_profiler.h"
 
@@ -111,6 +116,12 @@ struct Args {
   std::string capi_models;
   std::string capi_repo_root = ".";
   size_t warmup_requests = 0;
+  // --streaming: drive requests over the bidi gRPC stream (reference
+  // main.cc:610-748); --generative additionally measures token streaming
+  // (TTFT / inter-token latency / tok/s) against a decoupled model.
+  bool streaming = false;
+  bool generative = false;
+  uint64_t gen_max_tokens = 32;
 };
 
 bool ParseRange(const char* s, double* a, double* b, double* c) {
@@ -228,6 +239,225 @@ void WriteCsv(const Args& args, const std::vector<PerfStatus>& results) {
   }
 }
 
+
+// ---------------------------------------------------------------------------
+// Generative (token-streaming) profile: N concurrent generation streams over
+// ONE bidi gRPC stream, measuring time-to-first-token, inter-token latency,
+// and aggregate tok/s through the networked stack. The reference profiler
+// has no token vocabulary (its decoupled mode just counts responses); a
+// token-serving framework must own these numbers end to end.
+// ---------------------------------------------------------------------------
+
+uint64_t Pct(std::vector<uint64_t>& v, double q) {
+  if (v.empty()) return 0;
+  std::sort(v.begin(), v.end());
+  size_t i = std::min(v.size() - 1, size_t(double(v.size()) * q));
+  return v[i];
+}
+
+int RunGenerativeProfile(const ClientBackendFactory& factory,
+                         const ModelParser& parser, const Args& args) {
+  if (!parser.IsDecoupled()) {
+    fprintf(stderr,
+            "--generative requires a decoupled (token-streaming) model; "
+            "'%s' is not decoupled\n", parser.Name().c_str());
+    return 1;
+  }
+  // The prompt tensor: first INT32 input with a dynamic last dim
+  // (tiny_gpt: INPUT_IDS INT32 [-1]).
+  std::string input_name;
+  for (const auto& kv : parser.Inputs()) {
+    if (kv.second.datatype == "INT32") { input_name = kv.first; break; }
+  }
+  if (input_name.empty()) {
+    fprintf(stderr, "--generative: model has no INT32 prompt input\n");
+    return 1;
+  }
+  size_t streams = args.has_concurrency ? std::max<size_t>(1, args.conc_start)
+                                        : 8;
+
+  std::unique_ptr<ClientBackend> backend;
+  Error err = factory.Create(&backend);
+  if (!err.IsOk()) {
+    fprintf(stderr, "backend: %s\n", err.Message().c_str());
+    return 1;
+  }
+
+  struct Slot {
+    std::atomic<bool> busy{false};
+    uint64_t start_ns = 0;
+    uint64_t last_ns = 0;
+    bool first_seen = false;
+  };
+  std::vector<Slot> slots(streams);
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<uint64_t> ttft_ns, itl_ns;
+  uint64_t tokens = 0, completed = 0, errors = 0;
+  std::string first_error;
+
+  err = backend->StartStream([&](tpuclient::InferResult* result) {
+    uint64_t now = NowNs();
+    Error status = result != nullptr ? result->RequestStatus()
+                                     : Error("null stream response");
+    bool final = IsFinalStreamResponse(result);
+    std::string id;
+    if (result != nullptr) result->Id(&id);
+    delete result;
+    if (!status.IsOk()) {
+      // Error results may carry no request id (the stream-level failure
+      // path builds them without a response proto), so attribution to a
+      // slot is unreliable — and any error aborts the profile anyway.
+      // Release every slot so the drain completes promptly.
+      std::lock_guard<std::mutex> lk(mu);
+      ++errors;
+      if (first_error.empty()) first_error = status.Message();
+      for (auto& sl : slots) sl.busy.store(false);
+      cv.notify_all();
+      return;
+    }
+    if (id.empty()) return;
+    size_t idx = strtoull(id.c_str(), nullptr, 10);
+    if (idx >= slots.size()) return;
+    Slot& sl = slots[idx];
+    std::lock_guard<std::mutex> lk(mu);
+    if (final) {
+      ++completed;
+      sl.busy.store(false);
+      cv.notify_all();
+      return;
+    }
+    ++tokens;
+    if (!sl.first_seen) {
+      sl.first_seen = true;
+      ttft_ns.push_back(now - sl.start_ns);
+    } else {
+      itl_ns.push_back(now - sl.last_ns);
+    }
+    sl.last_ns = now;
+  });
+  if (!err.IsOk()) {
+    fprintf(stderr, "StartStream: %s\n", err.Message().c_str());
+    return 1;
+  }
+
+  // Prompt length honors --shape <input>:N (the same CLI surface the
+  // load-manager path consumes); default 4 tokens.
+  size_t prompt_len = 4;
+  auto shape_it = args.data_opts.shapes.find(input_name);
+  if (shape_it != args.data_opts.shapes.end()) {
+    int64_t n = 1;
+    for (int64_t d : shape_it->second) n *= d;
+    if (n > 0) prompt_len = size_t(n);
+  }
+  std::vector<int32_t> prompt(prompt_len);
+  for (size_t i = 0; i < prompt_len; ++i) prompt[i] = 1 + int32_t(i % 100);
+  tpuclient::InferInput* raw_in = nullptr;
+  err = tpuclient::InferInput::Create(
+      &raw_in, input_name, {int64_t(prompt.size())}, "INT32");
+  if (!err.IsOk()) {
+    fprintf(stderr, "input: %s\n", err.Message().c_str());
+    return 1;
+  }
+  std::unique_ptr<tpuclient::InferInput> input(raw_in);
+  input->AppendRaw(reinterpret_cast<const uint8_t*>(prompt.data()),
+                   prompt.size() * sizeof(int32_t));
+
+  auto dispatch = [&](size_t idx) -> Error {
+    Slot& sl = slots[idx];
+    sl.first_seen = false;
+    sl.start_ns = NowNs();
+    sl.last_ns = sl.start_ns;
+    sl.busy.store(true);
+    tpuclient::InferOptions options(args.model);
+    options.model_version = args.version;
+    options.request_id = std::to_string(idx);
+    options.int_parameters["max_tokens"] = int64_t(args.gen_max_tokens);
+    return backend->AsyncStreamInfer(options, {input.get()}, {});
+  };
+
+  auto run_phase = [&](uint64_t duration_ms) -> Error {
+    uint64_t deadline = NowNs() + duration_ms * 1000000ull;
+    while (NowNs() < deadline) {
+      for (size_t i = 0; i < streams; ++i) {
+        if (!slots[i].busy.load()) {
+          Error derr = dispatch(i);
+          if (!derr.IsOk()) return derr;
+        }
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      cv.wait_for(lk, std::chrono::milliseconds(20));
+      if (!first_error.empty()) return Error(first_error);
+    }
+    // drain: no redispatch, wait for in-flight streams to finish
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait_for(lk, std::chrono::seconds(60), [&] {
+      for (const auto& sl : slots)
+        if (sl.busy.load()) return false;
+      return true;
+    });
+    return Error::Success();
+  };
+
+  // Warmup (compiles server-side executables; discarded), then the window.
+  err = run_phase(std::max<uint64_t>(args.window_ms / 2, 1000));
+  if (!err.IsOk()) {
+    fprintf(stderr, "generative warmup failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+  {
+    std::lock_guard<std::mutex> lk(mu);
+    ttft_ns.clear();
+    itl_ns.clear();
+    tokens = 0;
+    completed = 0;
+  }
+  uint64_t t0 = NowNs();
+  err = run_phase(args.window_ms);
+  uint64_t elapsed_ns = NowNs() - t0;
+  if (!err.IsOk()) {
+    fprintf(stderr, "generative profile failed: %s\n",
+            err.Message().c_str());
+    return 1;
+  }
+  backend->StopStream();
+
+  std::vector<uint64_t> ttft, itl;
+  uint64_t n_tokens, n_completed;
+  {
+    std::lock_guard<std::mutex> lk(mu);
+    ttft = ttft_ns;
+    itl = itl_ns;
+    n_tokens = tokens;
+    n_completed = completed;
+  }
+  double secs = double(elapsed_ns) / 1e9;
+  double tok_s = secs > 0 ? double(n_tokens) / secs : 0;
+  printf("Generative stream profile: model=%s, streams=%zu, "
+         "max_tokens=%lu, window %.1fs\n",
+         args.model.c_str(), streams,
+         static_cast<unsigned long>(args.gen_max_tokens), secs);
+  printf("  Completed streams: %lu, tokens: %lu, tok/s: %.1f\n",
+         static_cast<unsigned long>(n_completed),
+         static_cast<unsigned long>(n_tokens), tok_s);
+  printf("  TTFT usec: p50 %lu, p90 %lu, p99 %lu\n",
+         static_cast<unsigned long>(Pct(ttft, 0.50) / 1000),
+         static_cast<unsigned long>(Pct(ttft, 0.90) / 1000),
+         static_cast<unsigned long>(Pct(ttft, 0.99) / 1000));
+  printf("  ITL usec: p50 %lu, p90 %lu, p99 %lu\n",
+         static_cast<unsigned long>(Pct(itl, 0.50) / 1000),
+         static_cast<unsigned long>(Pct(itl, 0.90) / 1000),
+         static_cast<unsigned long>(Pct(itl, 0.99) / 1000));
+  printf("{\"tok_s\": %.1f, \"ttft_us_p50\": %lu, \"ttft_us_p99\": %lu, "
+         "\"itl_us_p50\": %lu, \"itl_us_p99\": %lu, \"streams\": %zu}\n",
+         tok_s,
+         static_cast<unsigned long>(Pct(ttft, 0.50) / 1000),
+         static_cast<unsigned long>(Pct(ttft, 0.99) / 1000),
+         static_cast<unsigned long>(Pct(itl, 0.50) / 1000),
+         static_cast<unsigned long>(Pct(itl, 0.99) / 1000), streams);
+  return errors > 0 ? 1 : 0;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -253,6 +483,9 @@ int main(int argc, char** argv) {
       {"max-threads", required_argument, nullptr, 1016},
       {"service-kind", required_argument, nullptr, 1017},
       {"warmup-request-count", required_argument, nullptr, 1021},
+      {"streaming", no_argument, nullptr, 1022},
+      {"generative", no_argument, nullptr, 1023},
+      {"generative-max-tokens", required_argument, nullptr, 1024},
       {"capi-library-path", required_argument, nullptr, 1018},
       {"capi-models", required_argument, nullptr, 1019},
       {"capi-repo-root", required_argument, nullptr, 1020},
@@ -354,10 +587,27 @@ int main(int argc, char** argv) {
       case 1019: args.capi_models = optarg; break;
       case 1020: args.capi_repo_root = optarg; break;
       case 1021: args.warmup_requests = strtoull(optarg, nullptr, 10); break;
+      case 1022: args.streaming = true; break;
+      case 1023: args.generative = true; args.streaming = true; break;
+      case 1024:
+        args.gen_max_tokens = strtoull(optarg, nullptr, 10);
+        break;
       default: Usage("unknown option");
     }
   }
   if (args.model.empty()) Usage("-m <model> is required");
+  if (args.streaming) {
+    // Streaming rides the gRPC bidi RPC; it is inherently async (the
+    // stream callback completes requests), mirroring the reference's
+    // constraint set (main.cc:1323).
+    if (args.kind != BackendKind::TPU_GRPC && args.protocol != "grpc")
+      Usage("--streaming requires --service-kind tpu_grpc (or -i grpc)");
+    args.kind = BackendKind::TPU_GRPC;
+    if (!args.url_set) args.url = "localhost:8001";
+    args.async = true;
+    if (args.shm != SharedMemoryType::NONE)
+      Usage("--streaming does not support --shared-memory");
+  }
   if (args.protocol == "grpc") {
     if (args.kind == BackendKind::TPU_HTTP) args.kind = BackendKind::TPU_GRPC;
     if (!args.url_set) args.url = "localhost:8001";
@@ -434,10 +684,15 @@ int main(int argc, char** argv) {
     return 1;
   }
 
+  if (args.generative) {
+    return RunGenerativeProfile(factory, *parser, args);
+  }
+
   // --- manager --------------------------------------------------------------
   LoadOptions load_opts;
   load_opts.batch_size = args.batch_size;
   load_opts.async = args.async;
+  load_opts.streaming = args.streaming;
   load_opts.max_threads = args.max_threads;
   load_opts.shm_type = args.shm;
   load_opts.output_shm_size = args.output_shm_size;

@@ -1,9 +1,66 @@
 #include "tpuclient/common.h"
 
+#include <zlib.h>
+
 #include <algorithm>
 #include <cstdlib>
+#include <cstring>
 
 namespace tpuclient {
+
+namespace zutil {
+
+Error Deflate(const std::string& in, bool gzip, std::string* out) {
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  if (deflateInit2(&zs, Z_DEFAULT_COMPRESSION, Z_DEFLATED,
+                   gzip ? 15 | 16 : 15, 8, Z_DEFAULT_STRATEGY) != Z_OK) {
+    return Error("failed to initialize compression", 400);
+  }
+  out->resize(deflateBound(&zs, in.size()));
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  zs.avail_in = static_cast<uInt>(in.size());
+  zs.next_out = reinterpret_cast<Bytef*>(&(*out)[0]);
+  zs.avail_out = static_cast<uInt>(out->size());
+  int rc = deflate(&zs, Z_FINISH);
+  deflateEnd(&zs);
+  if (rc != Z_STREAM_END) {
+    return Error("compression failed (zlib rc " + std::to_string(rc) + ")",
+                 400);
+  }
+  out->resize(zs.total_out);
+  return Error::Success();
+}
+
+Error Inflate(const std::string& in, std::string* out) {
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  // 15 | 32: auto-detect zlib vs gzip framing.
+  if (inflateInit2(&zs, 15 | 32) != Z_OK) {
+    return Error("failed to initialize decompression", 400);
+  }
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  zs.avail_in = static_cast<uInt>(in.size());
+  std::string buf(std::max<size_t>(in.size() * 4, 16384), '\0');
+  int rc = Z_OK;
+  while (rc == Z_OK) {
+    zs.next_out = reinterpret_cast<Bytef*>(&buf[0]);
+    zs.avail_out = static_cast<uInt>(buf.size());
+    rc = inflate(&zs, Z_NO_FLUSH);
+    if (rc == Z_OK || rc == Z_STREAM_END) {
+      out->append(buf.data(), buf.size() - zs.avail_out);
+    }
+    if (rc == Z_OK && zs.avail_in == 0 && zs.avail_out != 0) break;
+  }
+  inflateEnd(&zs);
+  if (rc != Z_STREAM_END) {
+    return Error("decompression failed (zlib rc " + std::to_string(rc) + ")",
+                 400);
+  }
+  return Error::Success();
+}
+
+}  // namespace zutil
 
 size_t DtypeByteSize(const std::string& datatype) {
   if (datatype == "BOOL" || datatype == "INT8" || datatype == "UINT8")

@@ -29,9 +29,10 @@ std::map<std::string, std::shared_ptr<h2::Connection>>& ChannelCache() {
 }
 
 // gRPC message framing: 1-byte compressed flag + 4-byte BE length.
-void FrameMessage(const std::string& payload, std::string* out) {
+void FrameMessage(const std::string& payload, std::string* out,
+                  bool compressed = false) {
   out->reserve(5 + payload.size());
-  out->push_back(0);
+  out->push_back(compressed ? 1 : 0);
   uint32_t n = uint32_t(payload.size());
   out->push_back(char(n >> 24));
   out->push_back(char(n >> 16));
@@ -40,19 +41,48 @@ void FrameMessage(const std::string& payload, std::string* out) {
   out->append(payload);
 }
 
+// Frames `payload`, compressing per `algo` (reference passes
+// grpc_compression_algorithm per call, grpc_client.h:323-382; here the
+// algorithm rides InferOptions). Sets *encoding to the grpc-encoding
+// header value, or nullptr when sending identity.
+Error BuildInferBody(const std::string& payload, GrpcCompression algo,
+                     std::string* body, const char** encoding) {
+  *encoding = nullptr;
+  if (algo == GrpcCompression::NONE) {
+    FrameMessage(payload, body);
+    return Error::Success();
+  }
+  std::string z;
+  Error err = zutil::Deflate(payload, algo == GrpcCompression::GZIP, &z);
+  if (!err.IsOk()) return err;
+  FrameMessage(z, body, true);
+  *encoding = algo == GrpcCompression::GZIP ? "gzip" : "deflate";
+  return Error::Success();
+}
+
 // Pops one complete framed message out of buf[*pos..]; false if incomplete.
+// Messages with the compressed flag set are inflated (the client always
+// advertises `grpc-accept-encoding: identity, deflate, gzip`; both wire
+// formats are auto-detected by the inflater).
 bool PopMessage(const std::string& buf, size_t* pos, std::string* msg,
                 Error* err) {
   if (buf.size() - *pos < 5) return false;
   const uint8_t* p = reinterpret_cast<const uint8_t*>(buf.data()) + *pos;
-  if (p[0] != 0) {
-    *err = Error("gRPC: compressed messages not supported");
-    return false;
-  }
   uint32_t len = (uint32_t(p[1]) << 24) | (uint32_t(p[2]) << 16) |
                  (uint32_t(p[3]) << 8) | uint32_t(p[4]);
   if (buf.size() - *pos - 5 < len) return false;
-  msg->assign(buf, *pos + 5, len);
+  if (p[0] != 0) {
+    std::string z;
+    z.assign(buf, *pos + 5, len);
+    msg->clear();
+    Error ierr = zutil::Inflate(z, msg);
+    if (!ierr.IsOk()) {
+      *err = Error("gRPC: failed to decompress message: " + ierr.Message());
+      return false;
+    }
+  } else {
+    msg->assign(buf, *pos + 5, len);
+  }
   *pos += 5 + len;
   return true;
 }
@@ -137,6 +167,9 @@ h2::HeaderList CallHeaders(const std::string& authority,
       {"te", "trailers"},
       {"content-type", "application/grpc"},
       {"user-agent", "tpuclient-grpc/1.0"},
+      // Always advertised: PopMessage inflates compressed responses
+      // (gzip and zlib framings auto-detected).
+      {"grpc-accept-encoding", "identity, deflate, gzip"},
   };
   if (timeout_us > 0) {
     // gRPC-over-HTTP/2 caps TimeoutValue at 8 ASCII digits; scale to
@@ -663,15 +696,21 @@ Error InferenceServerGrpcClient::Infer(
     }
   }
   std::string body;
-  FrameMessage(payload, &body);
+  const char* encoding = nullptr;
+  Error cerr = BuildInferBody(payload, options.compression_algorithm, &body,
+                              &encoding);
+  if (!cerr.IsOk()) return cerr;
 
   uint64_t deadline = DeadlineNs(options.client_timeout_us);
   int32_t sid = 0;
   timers.Capture(RequestTimers::Kind::SEND_START);
-  Error err = conn_->StartStream(
+  h2::HeaderList call_headers =
       CallHeaders(authority_, std::string(kServicePrefix) + "ModelInfer",
-                  options.client_timeout_us, headers, conn_->Tls()),
-      false, &sid);
+                  options.client_timeout_us, headers, conn_->Tls());
+  if (encoding != nullptr) {
+    call_headers.push_back({"grpc-encoding", encoding});
+  }
+  Error err = conn_->StartStream(call_headers, false, &sid);
   if (!err.IsOk()) return err;
   err = conn_->SendData(sid, reinterpret_cast<const uint8_t*>(body.data()),
                         body.size(), true, deadline);
@@ -732,14 +771,20 @@ Error InferenceServerGrpcClient::AsyncInfer(
     return Error("failed to serialize infer request");
   }
   std::string body;
-  FrameMessage(payload, &body);
+  const char* encoding = nullptr;
+  Error cerr = BuildInferBody(payload, options.compression_algorithm, &body,
+                              &encoding);
+  if (!cerr.IsOk()) return cerr;
 
   uint64_t deadline = DeadlineNs(options.client_timeout_us);
   job->timers.Capture(RequestTimers::Kind::SEND_START);
-  Error err = conn_->StartStream(
+  h2::HeaderList call_headers =
       CallHeaders(authority_, std::string(kServicePrefix) + "ModelInfer",
-                  options.client_timeout_us, headers, conn_->Tls()),
-      false, &job->sid);
+                  options.client_timeout_us, headers, conn_->Tls());
+  if (encoding != nullptr) {
+    call_headers.push_back({"grpc-encoding", encoding});
+  }
+  Error err = conn_->StartStream(call_headers, false, &job->sid);
   if (!err.IsOk()) return err;
   // Completion signal: the h2 reader calls on_event with its stream lock
   // held, so the handler must stay lock-free — it only pokes the worker cv.
@@ -836,16 +881,25 @@ void InferenceServerGrpcClient::AsyncWorker() {
 // -- streaming ---------------------------------------------------------------
 
 Error InferenceServerGrpcClient::StartStream(OnCompleteFn callback,
-                                             const GrpcHeaders& headers) {
+                                             const GrpcHeaders& headers,
+                                             GrpcCompression compression) {
   if (callback == nullptr) return Error("callback is required");
   std::lock_guard<std::mutex> lk(stream_mutex_);
   if (stream_active_) return Error("stream already active");
   int32_t sid = 0;
-  Error err = conn_->StartStream(
-      CallHeaders(authority_, std::string(kServicePrefix) + "ModelStreamInfer",
-                  0, headers, conn_->Tls()),
-      false, &sid);
+  h2::HeaderList call_headers = CallHeaders(
+      authority_, std::string(kServicePrefix) + "ModelStreamInfer", 0,
+      headers, conn_->Tls());
+  if (compression != GrpcCompression::NONE) {
+    // HTTP/2 declares the stream's message coding once, up front; each
+    // message's flag byte then says whether THAT message used it.
+    call_headers.push_back(
+        {"grpc-encoding",
+         compression == GrpcCompression::GZIP ? "gzip" : "deflate"});
+  }
+  Error err = conn_->StartStream(call_headers, false, &sid);
   if (!err.IsOk()) return err;
+  stream_compression_ = compression;
   stream_sid_ = sid;
   stream_callback_ = std::move(callback);
   stream_active_ = true;
@@ -869,8 +923,16 @@ Error InferenceServerGrpcClient::AsyncStreamInfer(
   if (!request.SerializeToString(&payload)) {
     return Error("failed to serialize stream infer request");
   }
+  GrpcCompression algo = options.compression_algorithm;
+  if (algo != GrpcCompression::NONE && algo != stream_compression_) {
+    return Error(
+        "stream compression mismatch: pass the algorithm to StartStream "
+        "(the stream's grpc-encoding is declared at stream start)");
+  }
   std::string body;
-  FrameMessage(payload, &body);
+  const char* encoding = nullptr;
+  Error cerr = BuildInferBody(payload, algo, &body, &encoding);
+  if (!cerr.IsOk()) return cerr;
   std::lock_guard<std::mutex> lk(stream_send_mutex_);
   return conn_->SendData(sid, reinterpret_cast<const uint8_t*>(body.data()),
                          body.size(), false,

@@ -532,8 +532,28 @@ Error Connection::StartStream(const HeaderList& headers, bool end_stream,
 Error Connection::SendData(int32_t sid, const uint8_t* data, size_t len,
                            bool end_stream, uint64_t deadline_ns) {
   {
-    std::lock_guard<std::mutex> sl(state_mutex_);
+    std::unique_lock<std::mutex> sl(state_mutex_);
     ka_data_since_ping_ = true;
+    // Wait (bounded) for the server's initial SETTINGS before the first
+    // DATA bytes: RFC 7540 doesn't require it, but sending a large body
+    // chunked at the 16384 default while the server's max_frame/window
+    // SETTINGS race down the pipe wastes frames — and the server's first
+    // frame after the preface MUST be SETTINGS (§3.5), so this costs at
+    // most one in-flight latency, once per connection.
+    if (!peer_settings_received_ && !dead_) {
+      // Capped by the caller's deadline: a short client timeout must not
+      // stretch to the 5s settings-wait ceiling.
+      uint64_t now = uint64_t(std::chrono::duration_cast<
+                                  std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now().time_since_epoch()).count());
+      uint64_t cap = now + uint64_t(5e9);
+      if (deadline_ns != 0 && deadline_ns < cap) cap = deadline_ns;
+      if (cap > now) {
+        state_cv_.wait_for(sl, std::chrono::nanoseconds(cap - now), [&] {
+          return peer_settings_received_ || dead_;
+        });
+      }
+    }
   }
   size_t off = 0;
   while (off < len || (end_stream && off == 0 && len == 0)) {
@@ -882,6 +902,10 @@ void Connection::HandleFrame(uint8_t type, uint8_t flags, int32_t sid,
     }
     case kSettings: {
       if (flags & kFlagAck) break;
+      {
+        std::lock_guard<std::mutex> sl(state_mutex_);
+        peer_settings_received_ = true;
+      }
       {
         // The peer may keep enforcing its PREVIOUS limits until it
         // receives our ACK (RFC 7540 §6.5.3) — grpc-core does exactly

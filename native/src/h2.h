@@ -172,6 +172,9 @@ class Connection {
   int32_t next_stream_id_ = 1;
   std::string error_;
   bool dead_ = false;
+  // First server SETTINGS seen: data senders briefly wait for it so the
+  // body is chunked under the server's real limits, not the defaults.
+  bool peer_settings_received_ = false;
 
   // Flow control / peer settings.
   int64_t conn_send_window_ = 65535;

@@ -581,59 +581,6 @@ std::string InferResultHttp::DebugString() const {
 // http_client.cc:122-198, 1547-1557)
 // ---------------------------------------------------------------------------
 
-static Error DeflateBuffer(const std::string& in, bool gzip,
-                           std::string* out) {
-  z_stream zs;
-  memset(&zs, 0, sizeof(zs));
-  if (deflateInit2(&zs, Z_DEFAULT_COMPRESSION, Z_DEFLATED,
-                   gzip ? 15 | 16 : 15, 8, Z_DEFAULT_STRATEGY) != Z_OK) {
-    return Error("failed to initialize compression", 400);
-  }
-  out->resize(deflateBound(&zs, in.size()));
-  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
-  zs.avail_in = static_cast<uInt>(in.size());
-  zs.next_out = reinterpret_cast<Bytef*>(&(*out)[0]);
-  zs.avail_out = static_cast<uInt>(out->size());
-  int rc = deflate(&zs, Z_FINISH);
-  deflateEnd(&zs);
-  if (rc != Z_STREAM_END) {
-    return Error("request compression failed (zlib rc " + std::to_string(rc) +
-                     ")",
-                 400);
-  }
-  out->resize(zs.total_out);
-  return Error::Success();
-}
-
-static Error InflateBuffer(const std::string& in, std::string* out) {
-  z_stream zs;
-  memset(&zs, 0, sizeof(zs));
-  // 15 | 32: auto-detect zlib vs gzip framing.
-  if (inflateInit2(&zs, 15 | 32) != Z_OK) {
-    return Error("failed to initialize decompression", 400);
-  }
-  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
-  zs.avail_in = static_cast<uInt>(in.size());
-  std::string buf(std::max<size_t>(in.size() * 4, 16384), '\0');
-  int rc = Z_OK;
-  while (rc == Z_OK) {
-    zs.next_out = reinterpret_cast<Bytef*>(&buf[0]);
-    zs.avail_out = static_cast<uInt>(buf.size());
-    rc = inflate(&zs, Z_NO_FLUSH);
-    if (rc == Z_OK || rc == Z_STREAM_END) {
-      out->append(buf.data(), buf.size() - zs.avail_out);
-    }
-    if (rc == Z_OK && zs.avail_in == 0 && zs.avail_out != 0) break;
-  }
-  inflateEnd(&zs);
-  if (rc != Z_STREAM_END) {
-    return Error("response decompression failed (zlib rc " +
-                     std::to_string(rc) + ")",
-                 400);
-  }
-  return Error::Success();
-}
-
 Error InferenceServerHttpClient::CompressRequest(PreparedRequest* prep,
                                                  CompressionType type) {
   if (type == CompressionType::NONE) return Error::Success();
@@ -643,8 +590,10 @@ Error InferenceServerHttpClient::CompressRequest(PreparedRequest* prep,
   for (const auto& seg : prep->tail)
     whole.append(reinterpret_cast<const char*>(seg.first), seg.second);
   Error err =
-      DeflateBuffer(whole, type == CompressionType::GZIP, &prep->compressed);
-  if (!err.IsOk()) return err;
+      zutil::Deflate(whole, type == CompressionType::GZIP, &prep->compressed);
+  if (!err.IsOk()) {
+    return Error("request compression failed: " + err.Message(), 400);
+  }
   prep->content_encoding =
       type == CompressionType::GZIP ? "gzip" : "deflate";
   // Inference-Header-Content-Length still names the *uncompressed* JSON
@@ -1076,8 +1025,10 @@ Error InferenceServerHttpClient::DoInfer(HttpConnection* conn,
   if (ce != resp_headers.end() && !ce->second.empty() &&
       ce->second != "identity") {
     std::string plain;
-    err = InflateBuffer(body, &plain);
-    if (!err.IsOk()) return err;
+    err = zutil::Inflate(body, &plain);
+    if (!err.IsOk()) {
+      return Error("response decompression failed: " + err.Message(), 400);
+    }
     body.swap(plain);
   }
 

@@ -229,6 +229,44 @@ def test_perf_analyzer_grpc_smoke(native_build, grpc_server, tmp_path):
     assert float(row[header.index("Inferences/Second")]) > 0
 
 
+def test_perf_analyzer_streaming_sequence(native_build, grpc_server):
+    """--streaming (reference main.cc:610-748): requests ride the bidi
+    gRPC stream, completions multiplex back by request id; sequence steps
+    keep per-context order. The report must show real measured load."""
+    proc = subprocess.run(
+        [os.path.join(native_build, "tpu_perf_analyzer"),
+         "-m", "simple_sequence", "-u", f"127.0.0.1:{grpc_server.port}",
+         "--service-kind", "tpu_grpc", "--streaming",
+         "-p", "600", "-r", "6", "-s", "70", "--sequence-length", "4",
+         "--concurrency-range", "4:4"],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Throughput" in proc.stdout
+    assert "Inference count" in proc.stdout
+
+
+def test_perf_analyzer_generative_profile(native_build, grpc_server):
+    """--generative: token-streaming measurement through the networked
+    gRPC stack — TTFT / inter-token latency percentiles and tok/s for a
+    decoupled model (the reference profiler has no token vocabulary)."""
+    proc = subprocess.run(
+        [os.path.join(native_build, "tpu_perf_analyzer"),
+         "-m", "tiny_gpt", "-u", f"127.0.0.1:{grpc_server.port}",
+         "--service-kind", "tpu_grpc", "--generative",
+         "--generative-max-tokens", "6", "--shape", "INPUT_IDS:4",
+         "-p", "1500", "--concurrency-range", "4:4"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "tok/s" in proc.stdout and "TTFT" in proc.stdout
+    import json as _json
+
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("{")][-1]
+    rep = _json.loads(line)
+    assert rep["tok_s"] > 0
+    assert rep["ttft_us_p50"] > 0 and rep["itl_us_p50"] >= 0
+
+
 def test_perf_analyzer_capi_inprocess(native_build, tmp_path):
     """--service-kind tpu_capi: perf harness dlopens libtpuserver.so, which
     embeds CPython hosting the engine — no server process, no network
@@ -400,6 +438,20 @@ def test_http_compression(native_build, server, algo):
     proc = subprocess.run([binary, "-u", server.url, "-z", algo],
                           capture_output=True, text=True, timeout=180)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.parametrize("algo", ["gzip", "deflate"])
+def test_grpc_message_compression(native_build, grpc_server, algo):
+    """Per-call gRPC message compression (reference grpc_client.h:323-382:
+    Infer takes grpc_compression_algorithm; here InferOptions carries it):
+    the framed request goes out with flag byte 1 + grpc-encoding, the
+    grpcio server inflates it natively, and the add/sub values assert."""
+    binary = os.path.join(native_build, "simple_grpc_infer_client")
+    proc = subprocess.run(
+        [binary, "-u", f"127.0.0.1:{grpc_server.port}", "-z", algo],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
 
 
 def test_grpc_keepalive(native_build, grpc_server):
