@@ -268,7 +268,13 @@ Error InferResultGrpc::ModelVersion(std::string* version) const {
 }
 
 Error InferResultGrpc::Id(std::string* id) const {
-  if (!status_.IsOk()) return status_;
+  // Usable on error results too (per-request stream errors carry the id
+  // so the caller can attribute the failure); only a missing proto makes
+  // the id unavailable.
+  if (response_ == nullptr) {
+    if (!status_.IsOk()) return status_;
+    return Error("no response");
+  }
   *id = response_->id();
   return Error::Success();
 }
@@ -1004,7 +1010,13 @@ void InferenceServerGrpcClient::StreamWorker() {
         InferResultGrpc::Create(&result, nullptr,
                                 Error("failed to parse stream response"));
       } else if (!stream_response.error_message().empty()) {
-        InferResultGrpc::Create(&result, nullptr,
+        // Keep the response proto: the server sets infer_response.id on
+        // per-request errors (grpc_server.py), and callers need the id to
+        // route the failure to ITS request instead of treating it as a
+        // terminal stream loss.
+        auto response = std::make_shared<inference::ModelInferResponse>(
+            std::move(*stream_response.mutable_infer_response()));
+        InferResultGrpc::Create(&result, std::move(response),
                                 Error(stream_response.error_message()));
       } else {
         auto response = std::make_shared<inference::ModelInferResponse>(
