@@ -64,6 +64,14 @@ Options:
   --warmup-request-count <n>  unmeasured requests before profiling (lets
                          the server compile per-bucket executables outside
                          the measurement windows; default 0)
+  --streaming            drive requests over one bidi gRPC stream per
+                         worker (implies -a and tpu_grpc; sequence steps
+                         keep per-context order)
+  --generative           token-streaming profile against a decoupled
+                         model: tok/s + TTFT / inter-token-latency
+                         percentiles through the gRPC stream (implies
+                         --streaming; streams = --concurrency-range start)
+  --generative-max-tokens <n>  tokens per generation stream (default 32)
   --service-kind <tpu_http|tpu_grpc|tpu_capi|tfserving|torchserve>
                          endpoint kind (default
                          tpu_http; -i grpc implies tpu_grpc);
