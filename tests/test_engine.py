@@ -1002,3 +1002,37 @@ class TestColonModelNameRejected:
         with pytest.raises(EngineError) as ei:
             repo.register_backend(backend)
         assert ei.value.status == 400 and "reserved" in str(ei.value)
+
+
+class TestSubmitAfterStop:
+    def test_request_racing_unload_gets_503_not_stranded(self):
+        """A request submitted after a scheduler's workers exited must be
+        failed (503) rather than sit in the dead queue forever (the reload
+        path can retire schedulers while async_infer holds a reference)."""
+        from client_tpu.engine.repository import ModelRepository
+        from client_tpu.models.simple import AddSubBackend
+
+        repo = ModelRepository()
+        repo.register_backend(AddSubBackend())
+        eng = TpuEngine(repo)
+        try:
+            sched = eng._schedulers["simple"]
+            sched.stop()  # workers exit; _stopping set
+            got: list = []
+            done = threading.Event()
+
+            def cb(resp):
+                got.append(resp)
+                done.set()
+
+            req = InferRequest(
+                model_name="simple",
+                inputs={"INPUT0": np.zeros((1, 16), np.int32),
+                        "INPUT1": np.zeros((1, 16), np.int32)},
+                response_callback=cb)
+            sched.submit(req)
+            assert done.wait(10), "request stranded in a dead queue"
+            assert got[0].error is not None
+            assert got[0].error.status == 503
+        finally:
+            eng.shutdown()

@@ -291,3 +291,78 @@ class TestShmEdgeCases:
         c.unregister_tpu_shared_memory("mix_out")
         tpushm.destroy_shared_memory_region(out_h)
         c.close()
+
+
+class TestDeviceViewOutputs:
+    """Round-4 zero-dispatch output plane: with every output directed into
+    a device region via the C-API path, the scheduler skips the D2H fetch
+    and the region stores a DeviceTensorView (no per-request slice
+    dispatch); the gather runs once, on first read — including when the
+    region is immediately reused as the next request's INPUT."""
+
+    def test_capi_device_outputs_roundtrip_and_chain(self):
+        import json as _json
+
+        import jax
+        import numpy as np
+
+        from client_tpu import capi_embed
+        from client_tpu.engine.shm import DeviceTensorView
+
+        eng = capi_embed.create_engine("simple")
+        try:
+            a = np.arange(16, dtype=np.int32).reshape(1, 16)
+            b = np.ones((1, 16), dtype=np.int32)
+            eng.tpu_shm.register_device_array("vin0", jax.device_put(a))
+            eng.tpu_shm.register_device_array("vin1", jax.device_put(b))
+            for name in ("vout0", "vout1"):
+                eng.tpu_shm.register_device_array(
+                    name, jax.device_put(np.zeros(16, np.int32)))
+
+            def req(in0, in1):
+                return _json.dumps({
+                    "model_name": "simple",
+                    "inputs": [
+                        {"name": "INPUT0", "datatype": "INT32",
+                         "shape": [1, 16], "parameters": {
+                             "shared_memory_region": in0,
+                             "shared_memory_byte_size": 64}},
+                        {"name": "INPUT1", "datatype": "INT32",
+                         "shape": [1, 16], "parameters": {
+                             "shared_memory_region": in1,
+                             "shared_memory_byte_size": 64}},
+                    ],
+                    "outputs": [
+                        {"name": "OUTPUT0", "parameters": {
+                            "shared_memory_region": "vout0",
+                            "shared_memory_byte_size": 64}},
+                        {"name": "OUTPUT1", "parameters": {
+                            "shared_memory_region": "vout1",
+                            "shared_memory_byte_size": 64}},
+                    ]})
+
+            resp_json, arrays, metas = capi_embed.infer(
+                eng, req("vin0", "vin1"), [None, None])
+            # shm-placed outputs return parameters, not data views.
+            assert arrays == [None, None]
+            d = _json.loads(resp_json)
+            assert {o["name"] for o in d["outputs"]} == {"OUTPUT0",
+                                                         "OUTPUT1"}
+            # The region holds a zero-dispatch view until someone reads it.
+            mgr = eng.tpu_shm
+            assert isinstance(
+                mgr._regions["vout0"].device_array, DeviceTensorView)
+            out0 = np.asarray(mgr.read_back("vout0"))
+            np.testing.assert_array_equal(out0.reshape(1, 16), a + b)
+            # After the read the materialized array is cached in place.
+            assert not isinstance(
+                mgr._regions["vout0"].device_array, DeviceTensorView)
+
+            # Chain: use the (view-stored) OUTPUT1 region as the next
+            # request's input — read_tensor must materialize it.
+            capi_embed.infer(eng, req("vout1", "vin1"), [None, None])
+            out0b = np.asarray(mgr.read_back("vout0"))
+            np.testing.assert_array_equal(
+                out0b.reshape(1, 16), (a - b) + b)  # (a-b) + 1
+        finally:
+            capi_embed.shutdown_engine(eng)
