@@ -744,38 +744,103 @@ def bench_bert_mfu(batch: int = 8, iters: int = 30, pipeline_n: int = 100):
 
 
 def main():
+    # Watchdog: the dev tunnel can go DOWN mid-run, hanging device calls
+    # indefinitely (observed round 4: jax.devices() blocked for >30 min).
+    # Device waits release the GIL, so a timer thread can still emit the
+    # sections that completed and exit — the driver then records a partial
+    # (but honest) BENCH json instead of a timeout with no output.
+    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "1500"))
+    finished = threading.Event()
+
+    def watchdog():
+        if finished.wait(deadline_s):
+            return
+        log(f"WATCHDOG: bench exceeded {deadline_s:.0f}s (device hang?); "
+            "emitting partial results")
+        partial = dict(_RESULT)
+        partial.setdefault("metric", "inproc_simple_ips")
+        partial.setdefault("unit", "infer/sec")
+        partial["partial"] = True
+        _emit(partial)
+        os._exit(0)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    try:
+        _main()
+    finally:
+        finished.set()
+
+
+# The ONE result dict: _main fills it section by section; the final emit
+# and the watchdog's partial emit both print THIS dict, so the schema
+# cannot diverge between the two paths.
+_RESULT: dict = {}
+_EMIT_LOCK = threading.Lock()
+_EMITTED = False
+
+
+def _emit(d: dict) -> None:
+    """Print the single stdout JSON line exactly once — the watchdog firing
+    while _main is mid-final-print must not produce two lines."""
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return
+        _EMITTED = True
+        print(json.dumps(d), flush=True)
+
+
+def _main():
     devices = preflight()
     platform = devices[0].platform
     simple = bench_inproc_simple()
     ips, p99_us = simple["ips"], simple["p99_us"]
+    _RESULT.update({"metric": "inproc_simple_ips",
+                    "value": round(ips, 2), "unit": "infer/sec",
+                    "p99_us": round(p99_us, 1),
+                    "stable": simple["stable"],
+                    "windows": simple["windows"]})
     try:
         bert_ips, mfu, bert_step_s, bert_e2e_s = bench_bert_mfu()
+        _RESULT["bert_b8_ips"] = round(bert_ips, 2)
+        _RESULT["bert_b8_step_ms"] = round(bert_step_s * 1e3, 3)
+        _RESULT["bert_b8_e2e_ms"] = round(bert_e2e_s * 1e3, 3)
+        if mfu is not None:
+            _RESULT["bert_b8_mfu"] = round(mfu, 4)
     except Exception as exc:  # noqa: BLE001 — headline metric still reports
         log(f"bert mfu measurement failed: {exc!r}")
-        bert_ips, mfu, bert_step_s, bert_e2e_s = None, None, None, None
+        bert_ips, mfu = None, None
     try:
         shm_ab = bench_shm_ab()
+        _RESULT["shm_ab"] = shm_ab
+        tpushm_ips = (shm_ab.get("tpu") or {}).get("ips")
+        if tpushm_ips is not None:
+            _RESULT["tpushm_ips"] = round(tpushm_ips, 2)
     except Exception as exc:  # noqa: BLE001
         log(f"shm A/B bench failed: {exc!r}")
         shm_ab = None
-    tpushm_ips = (shm_ab.get("tpu") or {}).get("ips") if shm_ab else None
     try:
         shm_ab_large = bench_shm_ab_large()
+        _RESULT["shm_ab_large"] = shm_ab_large
     except Exception as exc:  # noqa: BLE001
         log(f"large-tensor shm A/B bench failed: {exc!r}")
         shm_ab_large = None
     try:
         seq_steps_s = bench_sequence_oldest()
+        _RESULT["seq_oldest_steps_s"] = round(seq_steps_s, 1)
     except Exception as exc:  # noqa: BLE001
         log(f"sequence-oldest bench failed: {exc!r}")
         seq_steps_s = None
     try:
         gen = bench_generative()
+        _RESULT["gen"] = gen
+        _RESULT["gen_tok_s"] = gen["tok_s"]
     except Exception as exc:  # noqa: BLE001
         log(f"generative bench failed: {exc!r}")
         gen = None
     try:
         steady = bench_device_steady()
+        _RESULT["device_steady"] = steady
     except Exception as exc:  # noqa: BLE001
         log(f"device-steady bench failed: {exc!r}")
         steady = None
@@ -803,6 +868,7 @@ def main():
                 and h.get("config") == config),
                default=None)
     vs = ips / best if best else 1.0
+    _RESULT["vs_baseline"] = round(vs, 4)
     hist.append({"metric": "inproc_simple_ips", "value": ips,
                  "p99_us": p99_us, "stable": simple["stable"],
                  "windows": simple["windows"],
@@ -817,35 +883,7 @@ def main():
     except OSError:
         pass
 
-    out = {
-        "metric": "inproc_simple_ips",
-        "value": round(ips, 2),
-        "unit": "infer/sec",
-        "vs_baseline": round(vs, 4),
-        "p99_us": round(p99_us, 1),
-        "stable": simple["stable"],
-        "windows": simple["windows"],
-    }
-    if bert_ips is not None:
-        out["bert_b8_ips"] = round(bert_ips, 2)
-        out["bert_b8_step_ms"] = round(bert_step_s * 1e3, 3)
-        out["bert_b8_e2e_ms"] = round(bert_e2e_s * 1e3, 3)
-    if mfu is not None:
-        out["bert_b8_mfu"] = round(mfu, 4)
-    if shm_ab is not None:
-        out["shm_ab"] = shm_ab
-        if tpushm_ips is not None:
-            out["tpushm_ips"] = round(tpushm_ips, 2)
-    if shm_ab_large is not None:
-        out["shm_ab_large"] = shm_ab_large
-    if seq_steps_s is not None:
-        out["seq_oldest_steps_s"] = round(seq_steps_s, 1)
-    if gen is not None:
-        out["gen_tok_s"] = gen["tok_s"]
-        out["gen"] = gen
-    if steady is not None:
-        out["device_steady"] = steady
-    print(json.dumps(out))
+    _emit(_RESULT)
 
 
 if __name__ == "__main__":
