@@ -33,6 +33,23 @@ static Error ResolveShape(const ModelTensor& tensor,
   return Error::Success();
 }
 
+// Raw little-endian bytes for a fixed shape must match exactly — a wrong
+// byte count is a load-time error, never a silent truncation.
+static Error ValidateRawByteSize(const ModelTensor& tensor,
+                                 const std::vector<int64_t>& shape,
+                                 size_t byte_size, const std::string& what) {
+  int64_t want = tpuclient::ElementCount(shape);
+  size_t elem = tpuclient::DtypeByteSize(tensor.datatype);
+  if (tensor.datatype != "BYTES" && want >= 0 && elem > 0 &&
+      static_cast<size_t>(want) * elem != byte_size) {
+    return Error(what + " is " + std::to_string(byte_size) +
+                     "B, shape wants " + std::to_string(want * int64_t(elem)) +
+                     "B",
+                 400);
+  }
+  return Error::Success();
+}
+
 Error DataLoader::MakeTensor(const ModelTensor& tensor, const Options& opts,
                              uint64_t salt, TensorData* out) {
   Error err = ResolveShape(tensor, opts, &out->shape);
@@ -216,15 +233,9 @@ static Error ParseStep(const ModelParser& parser, const JsonPtr& step_obj,
           Error err = ResolveShape(tensor, opts, &shape);
           if (!err.IsOk()) return err;
         }
-        int64_t want = tpuclient::ElementCount(shape);
-        size_t elem = tpuclient::DtypeByteSize(tensor.datatype);
-        if (tensor.datatype != "BYTES" && want >= 0 && elem > 0 &&
-            static_cast<size_t>(want) * elem != decoded.size()) {
-          return Error("b64 data for '" + name + "' is " +
-                           std::to_string(decoded.size()) + "B, shape wants " +
-                           std::to_string(want * int64_t(elem)) + "B",
-                       400);
-        }
+        Error verr = ValidateRawByteSize(tensor, shape, decoded.size(),
+                                         "b64 data for '" + name + "'");
+        if (!verr.IsOk()) return verr;
         (*raw)[name] = std::string(decoded.begin(), decoded.end());
         (*shapes)[name] = std::move(shape);
         continue;
@@ -295,6 +306,52 @@ Error DataLoader::ReadDataFromJson(const ModelParser& parser,
         data_[s].back()[kv.first] = std::move(td);
       }
     }
+  }
+  return Error::Success();
+}
+
+Error DataLoader::ReadDataFromDir(const ModelParser& parser,
+                                  const std::string& dir,
+                                  const Options& opts) {
+  data_.clear();
+  data_.emplace_back();
+  data_[0].emplace_back();
+  for (const auto& kv : parser.Inputs()) {
+    const ModelTensor& tensor = kv.second;
+    const std::string path = dir + "/" + tensor.name;
+    std::ifstream f(path, std::ios::binary);
+    if (!f.good())
+      return Error("cannot open data file '" + path + "' for input '" +
+                       tensor.name + "'",
+                   400);
+    TensorData td;
+    Error err = ResolveShape(tensor, opts, &td.shape);
+    if (!err.IsOk()) return err;
+    if (tensor.datatype == "BYTES") {
+      std::vector<std::string> lines;
+      std::string line;
+      while (std::getline(f, line)) {
+        if (!line.empty() && line.back() == '\r') line.pop_back();
+        lines.push_back(line);
+      }
+      int64_t want = tpuclient::ElementCount(td.shape);
+      if (want >= 0 && want != static_cast<int64_t>(lines.size())) {
+        return Error("file '" + path + "' has " +
+                         std::to_string(lines.size()) +
+                         " lines, shape wants " + std::to_string(want) +
+                         " strings",
+                     400);
+      }
+      tpuclient::SerializeStringTensor(lines, &td.bytes);
+    } else {
+      std::stringstream ss;
+      ss << f.rdbuf();
+      td.bytes = ss.str();
+      Error verr = ValidateRawByteSize(tensor, td.shape, td.bytes.size(),
+                                       "file '" + path + "'");
+      if (!verr.IsOk()) return verr;
+    }
+    data_[0][0][kv.first] = std::move(td);
   }
   return Error::Success();
 }

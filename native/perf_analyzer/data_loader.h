@@ -41,6 +41,14 @@ class DataLoader {
                                     const std::string& path,
                                     const Options& opts);
 
+  // Load a directory of per-input files (reference ReadDataFromDir,
+  // data_loader.cc:41-69): one stream, one step; each non-BYTES input reads
+  // raw little-endian bytes from `<dir>/<input name>`, BYTES inputs read a
+  // text file of one string per line, serialized with length prefixes.
+  tpuclient::Error ReadDataFromDir(const ModelParser& parser,
+                                   const std::string& dir,
+                                   const Options& opts);
+
   size_t StreamCount() const { return data_.size(); }
   size_t StepCount(size_t stream) const {
     return stream < data_.size() ? data_[stream].size() : 0;

@@ -6,6 +6,7 @@
 // http (default, our native client), capi (in-process engine, when built).
 #include <getopt.h>
 #include <signal.h>
+#include <sys/stat.h>
 
 #include <algorithm>
 #include <atomic>
@@ -52,7 +53,8 @@ Options:
   -r <n>                 max trials per step (default 10)
   -l <us>                latency threshold; search stops above it
   --percentile <n>       use p<n> latency for stability (default: average)
-  --input-data <zero|random|path.json>  (default random)
+  --input-data <zero|random|path.json|dir>  (default random; a directory
+                reads raw bytes from <dir>/<input name>, text lines for BYTES)
   --shape <name:d1,d2,...>    concrete shape for dynamic input dims
   --string-length <n>    BYTES element length (default 16)
   --string-data <s>      fixed BYTES element value
@@ -684,8 +686,14 @@ int main(int argc, char** argv) {
   if (args.input_data == "zero" || args.input_data == "random") {
     err = data_loader->GenerateData(*parser, args.data_opts);
   } else {
-    err = data_loader->ReadDataFromJson(*parser, args.input_data,
-                                        args.data_opts);
+    struct stat st;
+    if (stat(args.input_data.c_str(), &st) == 0 && S_ISDIR(st.st_mode)) {
+      err = data_loader->ReadDataFromDir(*parser, args.input_data,
+                                         args.data_opts);
+    } else {
+      err = data_loader->ReadDataFromJson(*parser, args.input_data,
+                                          args.data_opts);
+    }
   }
   if (!err.IsOk()) {
     fprintf(stderr, "data error: %s\n", err.Message().c_str());

@@ -644,6 +644,39 @@ def test_perf_analyzer_b64_input_data(native_build, server, tmp_path):
     assert float(row[header.index("Inferences/Second")]) > 0
 
 
+def test_perf_analyzer_dir_input_data(native_build, server, tmp_path):
+    """--input-data <directory>: raw little-endian bytes per input-named file
+    (reference ReadDataFromDir, data_loader.cc:41-69)."""
+    import numpy as np
+
+    vals = np.arange(16, dtype=np.int32)
+    ddir = tmp_path / "data"
+    ddir.mkdir()
+    (ddir / "INPUT0").write_bytes(vals.tobytes())
+    (ddir / "INPUT1").write_bytes(vals.tobytes())
+    csv = tmp_path / "dir.csv"
+    proc = subprocess.run(
+        [os.path.join(native_build, "tpu_perf_analyzer"),
+         "-m", "simple", "-u", server.url, "--input-data", str(ddir),
+         "-p", "300", "-r", "4", "-s", "70",
+         "--concurrency-range", "1:1", "-f", str(csv)],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = csv.read_text().strip().splitlines()
+    header, row = lines[0].split(","), lines[1].split(",")
+    assert float(row[header.index("Inferences/Second")]) > 0
+
+    # Size mismatch is a load-time error, not a silent truncation.
+    (ddir / "INPUT0").write_bytes(vals.tobytes()[:-4])
+    proc = subprocess.run(
+        [os.path.join(native_build, "tpu_perf_analyzer"),
+         "-m", "simple", "-u", server.url, "--input-data", str(ddir),
+         "-p", "300", "-r", "4", "-s", "70", "--concurrency-range", "1:1"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0
+    assert "shape wants" in proc.stderr
+
+
 def test_perf_analyzer_warmup_flag(native_build, server, tmp_path):
     """--warmup-request-count sends unmeasured requests first (keeps XLA
     per-bucket compiles out of the measurement windows)."""
