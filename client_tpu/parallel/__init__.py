@@ -8,3 +8,11 @@ the reference has no NCCL/MPI; its transports map per §5.8).
 """
 
 from client_tpu.parallel.mesh import make_mesh, mesh_axes  # noqa: F401
+from client_tpu.parallel.moe import (  # noqa: F401
+    make_moe_train_step,
+    moe_ffn,
+)
+from client_tpu.parallel.pipeline import (  # noqa: F401
+    make_pipeline_train_step,
+    pipeline_apply,
+)

@@ -1,0 +1,199 @@
+"""Mixture-of-experts with expert parallelism over the ``ep`` mesh axis.
+
+TPU-first design (the reference — a Triton client fork — has no parallelism,
+SURVEY.md §2.9): Switch-style top-1 routing with a fixed per-expert capacity,
+expressed as one-hot dispatch/combine einsums over static shapes — the
+canonical TPU MoE formulation (Mesh-TensorFlow / Switch Transformer
+lineage). Expert weight stacks [E, ...] are sharded over ``ep`` (and their
+hidden dimension over ``tp``); the dispatch einsum contracts the token axis
+into an [E, C, D] expert batch, so under ``jit`` XLA lowers the resharding
+to all-to-all-style collectives on ICI. No gather/scatter with dynamic
+shapes anywhere; dropped tokens (capacity overflow) pass through on the
+residual path exactly as in Switch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from client_tpu.parallel.training import _attention, _rms_norm
+
+
+def moe_ffn(x, router_w, w1, w2, capacity, constrain=None):
+    """Top-1 routed expert FFN.
+
+    x: [B, S, D]; router_w: [D, E]; w1: [E, D, F]; w2: [E, F, D].
+    Returns (y [B, S, D], aux_loss scalar). ``constrain`` applies sharding
+    constraints to the expert-major intermediates (no-op when None).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if constrain is None:
+        def constrain(v, _spec):
+            return v
+
+    B, S, D = x.shape
+    E = router_w.shape[1]
+    T = B * S
+    flat = x.reshape(T, D)
+
+    logits = flat @ router_w                          # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = jnp.max(probs, axis=-1)                    # [T]
+    expert = jnp.argmax(probs, axis=-1)               # [T]
+    onehot = jax.nn.one_hot(expert, E, dtype=flat.dtype)      # [T, E]
+
+    # position of each token within its expert's queue; overflow is dropped
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot          # [T, E]
+    keep = jnp.where(pos < capacity, onehot, 0.0)              # [T, E]
+    slot = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)      # [T]
+    slot_oh = jax.nn.one_hot(slot, capacity, dtype=flat.dtype)  # [T, C]
+    dispatch = keep[:, :, None] * slot_oh[:, None, :]          # [T, E, C]
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, flat)      # [E, C, D]
+    expert_in = constrain(expert_in, ("ep", None, None))
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, w1))
+    h = constrain(h, ("ep", None, "tp"))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w2)             # [E, C, D]
+    expert_out = constrain(expert_out, ("ep", None, None))
+
+    combine = dispatch * gate[:, None, None]                   # [T, E, C]
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    # Switch load-balancing auxiliary: E * sum_e fraction_e * mean_prob_e
+    aux = E * jnp.sum(jnp.mean(onehot, axis=0) * jnp.mean(probs, axis=0))
+    return y.reshape(B, S, D), aux
+
+
+def _init_moe_params(rng, vocab, d_model, d_ff, n_layers, n_experts):
+    import jax
+
+    keys = jax.random.split(rng, 2 + n_layers * 7)
+    k = iter(keys)
+    scale = 0.02
+
+    def norm(shape):
+        return jax.random.normal(next(k), shape) * scale
+
+    params = {
+        "embed": norm((vocab, d_model)),
+        "unembed": norm((d_model, vocab)),
+        "layers": [],
+    }
+    for _ in range(n_layers):
+        params["layers"].append({
+            "wq": norm((d_model, d_model)),
+            "wk": norm((d_model, d_model)),
+            "wv": norm((d_model, d_model)),
+            "wo": norm((d_model, d_model)),
+            "router": norm((d_model, n_experts)),
+            "w1e": norm((n_experts, d_model, d_ff)),
+            "w2e": norm((n_experts, d_ff, d_model)),
+        })
+    return params
+
+
+def _moe_specs(P, n_layers):
+    layer = {
+        "wq": P(None, "tp"), "wk": P(None, "tp"), "wv": P(None, "tp"),
+        "wo": P("tp", None),
+        "router": P(None, None),
+        "w1e": P("ep", None, "tp"),
+        "w2e": P("ep", "tp", None),
+    }
+    return {
+        "embed": P(None, None),
+        "unembed": P(None, None),
+        "layers": [dict(layer) for _ in range(n_layers)],
+    }
+
+
+def _moe_forward(params, tokens, n_heads, capacity, constrain):
+    import jax
+    import jax.numpy as jnp
+
+    x = params["embed"][tokens]                      # [B, S, D]
+    x = constrain(x, ("dp", None, None))
+    S = x.shape[1]
+    mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+    aux_total = 0.0
+    for lp in params["layers"]:
+        x = x + _attention(lp, x, n_heads, mask, constrain,
+                           ("dp", None, "tp", None))
+        x = constrain(x, ("dp", None, None))
+        y, aux = moe_ffn(_rms_norm(x), lp["router"], lp["w1e"], lp["w2e"],
+                         capacity, constrain)
+        aux_total = aux_total + aux
+        x = x + y
+        x = constrain(x, ("dp", None, None))
+    x = _rms_norm(x)
+    return x @ params["unembed"], aux_total
+
+
+def make_moe_train_step(mesh, vocab=256, d_model=64, d_ff=128, n_layers=2,
+                        n_heads=4, n_experts=None, capacity_factor=1.25,
+                        batch=8, seq=16, lr=1e-3, aux_weight=1e-2):
+    """Returns (params, opt_state, train_step, data_sharding) for an MoE LM
+    over a ("dp","ep","tp") mesh. n_experts defaults to the ep axis size
+    (one expert shard per device row)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    if n_experts is None:
+        n_experts = max(2, mesh.shape.get("ep", 1))
+    tokens_total = batch * (seq - 1)
+    capacity = int(np.ceil(tokens_total / n_experts * capacity_factor))
+
+    def constrain(v, spec):
+        return jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh, P(*spec)))
+
+    params = _init_moe_params(jax.random.PRNGKey(0), vocab, d_model, d_ff,
+                              n_layers, n_experts)
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, _moe_specs(P, n_layers))
+    tx = optax.adamw(lr)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, tokens):
+        logits, aux = _moe_forward(p, tokens[:, :-1], n_heads, capacity,
+                                   constrain)
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return jnp.mean(nll) + aux_weight * aux
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(p, opt, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(p, tokens)
+        updates, opt = tx.update(grads, opt, p)
+        p = optax.apply_updates(p, updates)
+        return p, opt, loss
+
+    return params, opt_state, train_step, NamedSharding(mesh, P("dp", None))
+
+
+def dryrun_moe_step(n_devices: int, batch=8, seq=16) -> None:
+    """Build a ("dp","ep","tp") mesh, jit the MoE train step, run ONE step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from client_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(n_devices, axes=("dp", "ep", "tp"))
+    params, opt, step, data_sharding = make_moe_train_step(
+        mesh, batch=batch, seq=seq)
+    tokens = jax.device_put(
+        jnp.asarray(np.random.default_rng(0).integers(
+            0, 256, size=(batch, seq)), dtype=jnp.int32),
+        data_sharding)
+    params, opt, loss = step(params, opt, tokens)
+    jax.block_until_ready(loss)
+    assert np.isfinite(float(loss)), "MoE step produced non-finite loss"

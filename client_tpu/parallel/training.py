@@ -67,29 +67,43 @@ def _rms_norm(x):
     return x * (1.0 / jnp.sqrt(jnp.mean(x * x, axis=-1, keepdims=True) + 1e-6))
 
 
+def _attention(lp, x, n_heads, mask, constrain=None, qkv_spec=None):
+    """Causal multi-head attention sublayer (pre-norm, residual applied by
+    the caller): returns attn(x_normed) @ wo. Shared by the dp/sp/tp
+    training step, the pp pipeline blocks, and the ep MoE forward; the
+    tp-sharded caller passes constrain + qkv_spec to pin the head split."""
+    import jax
+    import jax.numpy as jnp
+
+    B, S, D = x.shape
+    head_dim = D // n_heads
+    h = _rms_norm(x)
+    q = (h @ lp["wq"]).reshape(B, S, n_heads, head_dim)
+    k = (h @ lp["wk"]).reshape(B, S, n_heads, head_dim)
+    v = (h @ lp["wv"]).reshape(B, S, n_heads, head_dim)
+    if constrain is not None and qkv_spec is not None:
+        q = constrain(q, qkv_spec)
+        k = constrain(k, qkv_spec)
+        v = constrain(v, qkv_spec)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+    scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, D)
+    return attn @ lp["wo"]
+
+
 def _forward(params, tokens, n_heads, constrain):
     import jax
     import jax.numpy as jnp
 
     x = params["embed"][tokens]                     # [B, S, D]
     x = constrain(x, ("dp", "sp", None))
-    B, S, D = x.shape
-    head_dim = D // n_heads
+    S = x.shape[1]
     mask = jnp.tril(jnp.ones((S, S), dtype=bool))
     for lp in params["layers"]:
         # --- attention (tp over heads) ---
-        h = _rms_norm(x)
-        q = (h @ lp["wq"]).reshape(B, S, n_heads, head_dim)
-        k = (h @ lp["wk"]).reshape(B, S, n_heads, head_dim)
-        v = (h @ lp["wv"]).reshape(B, S, n_heads, head_dim)
-        q = constrain(q, ("dp", None, "tp", None))
-        k = constrain(k, ("dp", None, "tp", None))
-        v = constrain(v, ("dp", None, "tp", None))
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
-        scores = jnp.where(mask[None, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, D)
-        x = x + attn @ lp["wo"]
+        x = x + _attention(lp, x, n_heads, mask, constrain,
+                           ("dp", None, "tp", None))
         x = constrain(x, ("dp", "sp", None))
         # --- FFN (tp over hidden) ---
         h = _rms_norm(x)

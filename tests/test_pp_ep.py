@@ -1,0 +1,110 @@
+"""Pipeline (pp) and expert (ep) parallelism on the 8-device CPU mesh.
+
+Exactness is the contract: the pipelined schedule and the expert-sharded
+dispatch are *layouts*, not approximations — both must reproduce the
+single-device oracle to float tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from client_tpu.parallel.mesh import make_mesh
+from client_tpu.parallel.moe import dryrun_moe_step, moe_ffn
+from client_tpu.parallel.pipeline import (
+    _init_stacked_params,
+    dryrun_pipeline_step,
+    pipeline_apply,
+    reference_forward,
+)
+
+
+class TestPipeline:
+    def test_matches_sequential_oracle(self):
+        """GPipe microbatch schedule == applying all blocks in order."""
+        mesh = make_mesh(8, axes=("dp", "pp"))
+        n_stages = mesh.shape["pp"]
+        n_layers, n_heads, d_model = 2 * n_stages, 4, 32
+        params = _init_stacked_params(
+            jax.random.PRNGKey(1), vocab=64, d_model=d_model, d_ff=64,
+            n_layers=n_layers)
+        blocks = {k: params[k] for k in ("wq", "wk", "wv", "wo", "w1", "w2")}
+        M, mb, seq = 3, 4, 8
+        x = jax.random.normal(jax.random.PRNGKey(2), (M, mb, seq, d_model))
+        mask = jnp.tril(jnp.ones((seq, seq), dtype=bool))
+
+        got = pipeline_apply(mesh, blocks, x, n_heads, mask)
+        want = jnp.stack([
+            reference_forward(blocks, x[m], n_heads, mask) for m in range(M)
+        ])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_train_step_runs(self):
+        dryrun_pipeline_step(8)
+
+    def test_train_step_learns(self):
+        """Loss drops over a few steps on a fixed batch (grads flow through
+        ppermute/scan/all_gather)."""
+        from client_tpu.parallel.pipeline import make_pipeline_train_step
+
+        mesh = make_mesh(8, axes=("dp", "pp"))
+        params, opt, step, shard_fn = make_pipeline_train_step(
+            mesh, n_layers=mesh.shape["pp"], lr=1e-2)
+        tokens = shard_fn(np.random.default_rng(0).integers(
+            0, 256, size=(4, 2, 17)))
+        losses = []
+        for _ in range(4):
+            params, opt, loss = step(params, opt, tokens)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+
+
+class TestMoe:
+    def _oracle_and_sharded(self, T=64, D=16, E=4, F=32, capacity=24):
+        key = jax.random.PRNGKey(3)
+        ks = jax.random.split(key, 4)
+        x = jax.random.normal(ks[0], (2, T // 2, D))
+        router = jax.random.normal(ks[1], (D, E)) * 0.5
+        w1 = jax.random.normal(ks[2], (E, D, F)) * 0.1
+        w2 = jax.random.normal(ks[3], (E, F, D)) * 0.1
+        return x, router, w1, w2, capacity
+
+    def test_matches_dense_oracle(self):
+        """ep/tp-sharded dispatch == unsharded single-device computation."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        x, router, w1, w2, capacity = self._oracle_and_sharded()
+        want_y, want_aux = moe_ffn(x, router, w1, w2, capacity)
+
+        mesh = make_mesh(8, axes=("dp", "ep", "tp"))
+
+        def constrain(v, spec):
+            return jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, P(*spec)))
+
+        w1s = jax.device_put(w1, NamedSharding(mesh, P("ep", None, "tp")))
+        w2s = jax.device_put(w2, NamedSharding(mesh, P("ep", "tp", None)))
+        got_y, got_aux = jax.jit(
+            lambda *a: moe_ffn(*a, capacity, constrain))(x, router, w1s, w2s)
+        np.testing.assert_allclose(np.asarray(got_y), np.asarray(want_y),
+                                   atol=1e-5, rtol=1e-5)
+        assert abs(float(got_aux) - float(want_aux)) < 1e-5
+
+    def test_capacity_overflow_drops_tokens(self):
+        """Tokens past an expert's capacity produce zero output (they ride
+        the residual path), matching Switch semantics."""
+        D, E = 8, 2
+        T = 16
+        x = jnp.ones((1, T, D))  # every token routes identically
+        router = jnp.zeros((D, E)).at[:, 0].set(1.0)  # all to expert 0
+        w1 = jnp.ones((E, D, 2 * D)) * 0.1
+        w2 = jnp.ones((E, 2 * D, D)) * 0.1
+        y, _ = moe_ffn(x, router, w1, w2, capacity=4)
+        y = np.asarray(y).reshape(T, D)
+        assert np.all(np.abs(y[:4]) > 0)     # within capacity: processed
+        assert np.all(y[4:] == 0.0)          # overflow: dropped
+
+    def test_train_step_runs(self):
+        dryrun_moe_step(8)
