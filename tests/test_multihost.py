@@ -70,6 +70,32 @@ train_mesh = multihost.global_mesh(axes=("dp", "sp", "tp"),
 dryrun_training_step(8, mesh=train_mesh)
 print(f"proc {pid}: train step OK", flush=True)
 
+# -- pipeline stages split ACROSS the processes (ppermute over DCN) -----
+from client_tpu.parallel.pipeline import make_pipeline_train_step
+
+pp_mesh = multihost.global_mesh(axes=("pp", "dp"), shape={"pp": 2})
+assert pp_mesh.shape == {"pp": 2, "dp": 4}
+pparams, popt, pstep, pshard = make_pipeline_train_step(pp_mesh, n_layers=2)
+ptokens = pshard(np.random.default_rng(0).integers(0, 256, size=(3, 4, 17)))
+pparams, popt, ploss = pstep(pparams, popt, ptokens)
+assert np.isfinite(float(ploss))
+print(f"proc {pid}: cross-host pipeline step OK", flush=True)
+
+# -- experts split ACROSS the processes (dispatch all-to-all over DCN) --
+from client_tpu.parallel.moe import make_moe_train_step
+
+ep_mesh = multihost.global_mesh(axes=("ep", "dp", "tp"),
+                                shape={"ep": 2, "dp": 2})
+assert ep_mesh.shape["ep"] == 2
+mparams, mopt, mstep, msharding = make_moe_train_step(
+    ep_mesh, batch=8, seq=16)
+mtokens = jax.device_put(
+    jnp.asarray(np.random.default_rng(1).integers(0, 256, size=(8, 16)),
+                jnp.int32), msharding)
+mparams, mopt, mloss = mstep(mparams, mopt, mtokens)
+assert np.isfinite(float(mloss))
+print(f"proc {pid}: cross-host MoE step OK", flush=True)
+
 # -- served inference through the engine on the global mesh -------------
 from client_tpu.engine import InferRequest, TpuEngine
 from client_tpu.engine.repository import ModelRepository
@@ -129,4 +155,6 @@ def test_two_process_cluster_mesh_train_and_serve(tmp_path):
         assert f"proc {pid}: ALL OK" in out, out
         assert f"proc {pid}: reduction OK" in out
         assert f"proc {pid}: train step OK" in out
+        assert f"proc {pid}: cross-host pipeline step OK" in out
+        assert f"proc {pid}: cross-host MoE step OK" in out
         assert f"proc {pid}: served inference OK" in out
