@@ -67,6 +67,11 @@ def moe_ffn(x, router_w, w1, w2, capacity, constrain=None):
     return y.reshape(B, S, D), aux
 
 
+def default_n_experts(mesh) -> int:
+    """Shared train/serve policy: one expert shard per ep row (min 2)."""
+    return max(2, int(mesh.shape.get("ep", 1)))
+
+
 def _init_moe_params(rng, vocab, d_model, d_ff, n_layers, n_experts):
     import jax
 
@@ -145,7 +150,7 @@ def make_moe_train_step(mesh, vocab=256, d_model=64, d_ff=128, n_layers=2,
     from jax.sharding import PartitionSpec as P
 
     if n_experts is None:
-        n_experts = max(2, mesh.shape.get("ep", 1))
+        n_experts = default_n_experts(mesh)
     tokens_total = batch * (seq - 1)
     capacity = int(np.ceil(tokens_total / n_experts * capacity_factor))
 

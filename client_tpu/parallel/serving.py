@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from client_tpu.engine.model import ModelBackend
 from client_tpu.models.bert import BertBackend
 from client_tpu.models.generate import TinyGptBackend
 
@@ -35,6 +36,46 @@ def dp_batch_buckets(dp: int, max_batch_size: int) -> tuple[int, list[int]]:
         buckets.append(b)
         b *= 2
     return top, sorted(set(buckets))
+
+
+def _drop_absent(mesh, axis):
+    """Null out spec entries naming axes this mesh doesn't carry."""
+    if axis is None:
+        return None
+    if isinstance(axis, (tuple, list)):
+        kept = tuple(a for a in axis if a in mesh.shape)
+        return kept if kept else None
+    return axis if axis in mesh.shape else None
+
+
+def make_constrain(mesh):
+    """Sharding-constraint closure for ``mesh`` that ignores absent axes
+    (a dp-only mesh silently drops tp/ep hints). Shared by the sharded
+    serving backends' apply functions."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    def constrain(x, spec):
+        spec = tuple(_drop_absent(mesh, a) for a in spec)
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*spec)))
+
+    return constrain
+
+
+def place_with_specs(mesh, params, specs):
+    """device_put a param tree with per-leaf PartitionSpecs, nulling
+    mesh-absent axes the same way make_constrain does."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    def place(x, s):
+        s = P(*(_drop_absent(mesh, a) for a in s))
+        return jax.device_put(x, NamedSharding(mesh, s))
+
+    return jax.tree.map(place, params, specs)
 
 
 def bert_param_specs(P, n_layers: int):
@@ -106,13 +147,10 @@ class ShardedBertBackend(BertBackend):
                                 "attention_mask": batch_spec}
 
     def place_params(self, params):
-        import jax
         import numpy as np
-        from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
         specs = bert_param_specs(P, self.n_layers)
-        mesh = self.mesh
 
         # Canonical wqkv storage is qkv-major ([q | k | v] column blocks,
         # the fast single-device layout); the sharded apply reads the fused
@@ -129,28 +167,10 @@ class ShardedBertBackend(BertBackend):
             lp["wqkv"]["w"] = np.asarray(lp["wqkv"]["w"])[:, perm]
             lp["wqkv"]["b"] = np.asarray(lp["wqkv"]["b"])[perm]
 
-        def place(x, s):
-            # Drop tp from specs when the mesh doesn't carry it (dp-only).
-            if "tp" not in mesh.shape:
-                s = P(*(a if a != "tp" else None for a in s))
-            return jax.device_put(x, NamedSharding(mesh, s))
-
-        return jax.tree.map(place, params, specs)
+        return place_with_specs(self.mesh, params, specs)
 
     def make_apply_params(self):
-        import jax
-        from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as P
-
-        mesh = self.mesh
-
-        def constrain(x, spec):
-            # Drop axes the mesh doesn't carry (a dp-only mesh ignores tp).
-            spec = tuple(a if (a is None or a in mesh.shape) else None
-                         for a in spec)
-            return jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, P(*spec)))
-
+        constrain = make_constrain(self.mesh)
         return (self._build_apply(constrain=constrain, head_major=True),
                 self.place_params(self.load_or_init_params(self._init_params)))
 
@@ -308,3 +328,115 @@ class ShardedTinyGptBackend(TinyGptBackend):
 
 
 register_model("tiny_gpt_mc", default=False)(ShardedTinyGptBackend)
+
+
+class MoeLmBackend(ModelBackend):
+    """Switch-MoE language model served over a ("dp","ep","tp") mesh.
+
+    Per-token next-token logits from the MoE transformer forward
+    (client_tpu.parallel.moe): expert FFN stacks sharded over ``ep``
+    (hidden over ``tp``), batch over ``dp``; the one-hot dispatch/combine
+    einsums reshard token-major -> expert-major, which XLA lowers to
+    all-to-all-style collectives on ICI. Expert capacity is derived from
+    the compiled bucket's token count (ceil(tokens / E * capacity_factor)),
+    so overflow drops are per-batch — standard Switch semantics: a token
+    past its expert's queue rides the residual path.
+    """
+
+    def __init__(self, mesh=None, name: str = "moe_lm_mc", seq_len: int = 32,
+                 d_model: int = 64, d_ff: int = 128, n_layers: int = 2,
+                 n_heads: int = 4, n_experts: int | None = None,
+                 capacity_factor: float = 1.25, vocab: int = 256,
+                 max_batch_size: int = 8,
+                 weights_path: str | None = None):
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from client_tpu.engine.config import (
+            DynamicBatchingConfig,
+            ModelConfig,
+            TensorConfig,
+        )
+        from client_tpu.parallel.mesh import make_mesh
+
+        if mesh is None:
+            mesh = make_mesh(axes=("dp", "ep", "tp"))
+        self.mesh = mesh
+        self.weights_path = weights_path
+        self.seq_len = seq_len
+        self.d_model = d_model
+        self.d_ff = d_ff
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        from client_tpu.parallel.moe import default_n_experts
+
+        self.n_experts = n_experts or default_n_experts(mesh)
+        ep = int(mesh.shape.get("ep", 1))
+        if self.n_experts % ep:
+            raise ValueError(
+                f"n_experts ({self.n_experts}) must divide by ep ({ep})")
+        tp = int(mesh.shape.get("tp", 1))
+        if d_ff % tp:
+            raise ValueError(f"d_ff ({d_ff}) must divide by tp ({tp})")
+        if d_model % n_heads:
+            raise ValueError(
+                f"d_model ({d_model}) must divide by n_heads ({n_heads})")
+        self.capacity_factor = capacity_factor
+        self.vocab = vocab
+        top, buckets = dp_batch_buckets(int(mesh.shape["dp"]),
+                                        max_batch_size)
+        self.config = ModelConfig(
+            name=name,
+            platform="jax",
+            max_batch_size=top,
+            input=[TensorConfig("INPUT_IDS", "INT32", [seq_len])],
+            output=[TensorConfig("LOGITS", "FP32", [seq_len, vocab])],
+            dynamic_batching=DynamicBatchingConfig(
+                preferred_batch_size=[max(1, top // 2), top],
+                max_queue_delay_microseconds=500,
+            ),
+            instance_count=1,
+        )
+        self.config.batch_buckets = buckets
+        self.input_shardings = {
+            "INPUT_IDS": NamedSharding(mesh, P("dp", None))}
+
+    def _init_params(self):
+        import jax
+
+        from client_tpu.parallel.moe import _init_moe_params
+
+        return _init_moe_params(jax.random.PRNGKey(0), self.vocab,
+                                self.d_model, self.d_ff, self.n_layers,
+                                self.n_experts)
+
+    def place_params(self, params):
+        from jax.sharding import PartitionSpec as P
+
+        from client_tpu.parallel.moe import _moe_specs
+
+        return place_with_specs(self.mesh, params,
+                                _moe_specs(P, self.n_layers))
+
+    def make_apply_params(self):
+        import numpy as np
+
+        from client_tpu.parallel.moe import _moe_forward
+
+        n_heads, n_experts = self.n_heads, self.n_experts
+        cf = self.capacity_factor
+        constrain = make_constrain(self.mesh)
+
+        def apply(params, inputs):
+            tokens = inputs["INPUT_IDS"]
+            B, S = tokens.shape  # static per compiled bucket
+            capacity = int(np.ceil(B * S / n_experts * cf))
+            logits, _aux = _moe_forward(params, tokens, n_heads, capacity,
+                                        constrain)
+            return {"LOGITS": logits.astype("float32")}
+
+        return apply, self.place_params(
+            self.load_or_init_params(self._init_params))
+
+
+register_model("moe_lm_mc", default=False)(MoeLmBackend)
