@@ -125,6 +125,16 @@ class Model:
 
         self.backend = backend
         self.config = backend.config
+        # Per-input validation metadata, built once — the config is immutable
+        # after load, and per-request dict/dtype rebuilds showed up at
+        # ~15us/request in the host-path profile.
+        self._input_meta = {
+            t.name: (t,
+                     np.dtype(wire_to_np_dtype(t.data_type))
+                     if t.data_type != "BYTES" else None,
+                     tuple(t.dims))
+            for t in self.config.input
+        }
         self._lock = threading.Lock()
         self._apply = None
         self._jitted = False
@@ -182,24 +192,21 @@ class Model:
         the model is unbatched)."""
         cfg = self.config
         batch = 1
-        declared = {t.name: t for t in cfg.input}
-        for t in cfg.input:
-            if t.name not in inputs:
-                if t.optional:
-                    continue
+        declared = self._input_meta
+        for name, (t, _, _) in declared.items():
+            if name not in inputs and not t.optional:
                 raise EngineError(
-                    f"missing input '{t.name}' for model '{cfg.name}'")
+                    f"missing input '{name}' for model '{cfg.name}'")
         for name, arr in inputs.items():
-            tc = declared.get(name)
-            if tc is None:
+            entry = declared.get(name)
+            if entry is None:
                 raise EngineError(
                     f"unexpected input '{name}' for model '{cfg.name}'")
-            want = wire_to_np_dtype(tc.data_type)
-            if tc.data_type != "BYTES" and np.dtype(want) != arr.dtype:
+            tc, np_dt, dims = entry
+            if np_dt is not None and np_dt != arr.dtype:
                 raise EngineError(
                     f"input '{name}': dtype {arr.dtype} != declared "
                     f"{tc.data_type}")
-            dims = list(tc.dims)
             shape = list(arr.shape)
             if cfg.max_batch_size > 0 and batched:
                 if len(shape) != len(dims) + 1:
