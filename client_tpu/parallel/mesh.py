@@ -39,6 +39,22 @@ def mesh_axes(n_devices: int,
     return dict(zip(axes, dims))
 
 
+def constrain_to(mesh):
+    """``with_sharding_constraint`` closure over this mesh's named axes —
+    the shared constrain hook the training/MoE steps pass into their
+    forwards. (The serving variant that drops mesh-absent axes lives in
+    ``parallel.serving.make_constrain``.)"""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    def constrain(x, spec):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*spec)))
+
+    return constrain
+
+
 def make_mesh(n_devices: int | None = None,
               axes: tuple[str, ...] = ("dp", "sp", "tp"),
               axis_sizes: dict[str, int] | None = None):

@@ -154,10 +154,9 @@ def make_moe_train_step(mesh, vocab=256, d_model=64, d_ff=128, n_layers=2,
     tokens_total = batch * (seq - 1)
     capacity = int(np.ceil(tokens_total / n_experts * capacity_factor))
 
-    def constrain(v, spec):
-        return jax.lax.with_sharding_constraint(
-            v, NamedSharding(mesh, P(*spec)))
+    from client_tpu.parallel.mesh import constrain_to
 
+    constrain = constrain_to(mesh)
     params = _init_moe_params(jax.random.PRNGKey(0), vocab, d_model, d_ff,
                               n_layers, n_experts)
     params = jax.tree.map(

@@ -341,6 +341,15 @@ class MoeLmBackend(ModelBackend):
     the compiled bucket's token count (ceil(tokens / E * capacity_factor)),
     so overflow drops are per-batch — standard Switch semantics: a token
     past its expert's queue rides the residual path.
+
+    NOT batch-invariant, unlike every other served family: which tokens
+    overflow depends on the co-batched tokens ahead of them in the
+    dispatch queue and on the bucket the dynamic batcher picks, so a
+    request's logits can differ between solo and co-batched service.
+    This is inherent to capacity-based MoE routing (the reference point
+    is Switch/GShard, not this framework); serve with
+    ``dynamic_batching=None`` if per-request determinism matters more
+    than throughput.
     """
 
     def __init__(self, mesh=None, name: str = "moe_lm_mc", seq_len: int = 32,

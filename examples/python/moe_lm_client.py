@@ -1,0 +1,40 @@
+#!/usr/bin/env python3
+"""Next-token query against the served Switch-MoE LM (`moe_lm_mc`).
+
+No reference counterpart (the reference serves no models, SURVEY.md §2.8);
+this demonstrates the expert-parallel model family: experts are sharded
+over the server mesh's ``ep`` axis, invisible to the client — the wire
+contract is plain KServe v2.
+
+Serve with: python -m client_tpu.server --zoo moe_lm_mc
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+from client_tpu.http import InferenceServerClient, InferInput
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8000")
+parser.add_argument("-v", "--verbose", action="store_true")
+args = parser.parse_args()
+
+with InferenceServerClient(args.url, verbose=args.verbose) as client:
+    # The model declares a fixed sequence length — read it from metadata
+    # rather than guessing (control-plane round trip, KServe v2).
+    md = client.get_model_metadata("moe_lm_mc")
+    seq_len = int(md["inputs"][0]["shape"][-1])
+    ids = (np.arange(seq_len, dtype=np.int32) % 256).reshape(1, -1)
+    inp = InferInput("INPUT_IDS", list(ids.shape), "INT32")
+    inp.set_data_from_numpy(ids)
+    result = client.infer("moe_lm_mc", [inp])
+    logits = result.as_numpy("LOGITS")
+    if logits.shape[:2] != (1, seq_len) or not np.isfinite(
+            logits).all():
+        sys.exit(f"error: bad logits {logits.shape}")
+    next_tok = int(np.argmax(logits[0, -1]))
+    print(f"next-token argmax: {next_tok} "
+          f"(logits {logits.shape}, vocab {logits.shape[-1]})")
+    print("PASS: moe_lm")

@@ -123,3 +123,21 @@ def test_memory_growth(servers):
          "-u", http_srv.url, "-n", "200"],
         capture_output=True, text=True, timeout=300, env=env)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_moe_lm_example():
+    """The expert-parallel model family through the example client — own
+    server (the shared fixture doesn't pay the mesh-model load)."""
+    eng = TpuEngine(build_repository(["moe_lm_mc"]))
+    srv = HttpInferenceServer(eng, port=0).start()
+    try:
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(EXAMPLES_DIR, "moe_lm_client.py"),
+             "-u", srv.url],
+            capture_output=True, text=True, timeout=300, env=env)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "PASS" in proc.stdout, proc.stdout
+    finally:
+        srv.stop()
+        eng.shutdown()

@@ -75,15 +75,13 @@ class TestMoe:
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
+        from client_tpu.parallel.mesh import constrain_to
+
         x, router, w1, w2, capacity = self._oracle_and_sharded()
         want_y, want_aux = moe_ffn(x, router, w1, w2, capacity)
 
         mesh = make_mesh(8, axes=("dp", "ep", "tp"))
-
-        def constrain(v, spec):
-            return jax.lax.with_sharding_constraint(
-                v, NamedSharding(mesh, P(*spec)))
-
+        constrain = constrain_to(mesh)
         w1s = jax.device_put(w1, NamedSharding(mesh, P("ep", None, "tp")))
         w2s = jax.device_put(w2, NamedSharding(mesh, P("ep", "tp", None)))
         got_y, got_aux = jax.jit(
