@@ -39,16 +39,27 @@ def mesh_axes(n_devices: int,
     return dict(zip(axes, dims))
 
 
-def constrain_to(mesh):
-    """``with_sharding_constraint`` closure over this mesh's named axes —
-    the shared constrain hook the training/MoE steps pass into their
-    forwards. (The serving variant that drops mesh-absent axes lives in
-    ``parallel.serving.make_constrain``.)"""
+def drop_absent(mesh, axis):
+    """Null out spec entries naming axes this mesh doesn't carry."""
+    if axis is None:
+        return None
+    if isinstance(axis, (tuple, list)):
+        kept = tuple(a for a in axis if a in mesh.shape)
+        return kept if kept else None
+    return axis if axis in mesh.shape else None
+
+
+def make_constrain(mesh):
+    """``with_sharding_constraint`` closure over this mesh that ignores
+    mesh-absent axes (a dp-only mesh silently drops tp/ep hints) — the one
+    constrain hook shared by the training steps, the MoE forward, and the
+    sharded serving backends."""
     import jax
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
     def constrain(x, spec):
+        spec = tuple(drop_absent(mesh, a) for a in spec)
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(mesh, P(*spec)))
 

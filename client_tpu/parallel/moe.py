@@ -154,9 +154,9 @@ def make_moe_train_step(mesh, vocab=256, d_model=64, d_ff=128, n_layers=2,
     tokens_total = batch * (seq - 1)
     capacity = int(np.ceil(tokens_total / n_experts * capacity_factor))
 
-    from client_tpu.parallel.mesh import constrain_to
+    from client_tpu.parallel.mesh import make_constrain
 
-    constrain = constrain_to(mesh)
+    constrain = make_constrain(mesh)
     params = _init_moe_params(jax.random.PRNGKey(0), vocab, d_model, d_ff,
                               n_layers, n_experts)
     params = jax.tree.map(

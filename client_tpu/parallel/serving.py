@@ -38,30 +38,8 @@ def dp_batch_buckets(dp: int, max_batch_size: int) -> tuple[int, list[int]]:
     return top, sorted(set(buckets))
 
 
-def _drop_absent(mesh, axis):
-    """Null out spec entries naming axes this mesh doesn't carry."""
-    if axis is None:
-        return None
-    if isinstance(axis, (tuple, list)):
-        kept = tuple(a for a in axis if a in mesh.shape)
-        return kept if kept else None
-    return axis if axis in mesh.shape else None
-
-
-def make_constrain(mesh):
-    """Sharding-constraint closure for ``mesh`` that ignores absent axes
-    (a dp-only mesh silently drops tp/ep hints). Shared by the sharded
-    serving backends' apply functions."""
-    import jax
-    from jax.sharding import NamedSharding
-    from jax.sharding import PartitionSpec as P
-
-    def constrain(x, spec):
-        spec = tuple(_drop_absent(mesh, a) for a in spec)
-        return jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh, P(*spec)))
-
-    return constrain
+from client_tpu.parallel.mesh import drop_absent, make_constrain  # noqa: F401
+# (make_constrain is re-exported: the sharded backends' public helper.)
 
 
 def place_with_specs(mesh, params, specs):
@@ -72,7 +50,7 @@ def place_with_specs(mesh, params, specs):
     from jax.sharding import PartitionSpec as P
 
     def place(x, s):
-        s = P(*(_drop_absent(mesh, a) for a in s))
+        s = P(*(drop_absent(mesh, a) for a in s))
         return jax.device_put(x, NamedSharding(mesh, s))
 
     return jax.tree.map(place, params, specs)
@@ -449,3 +427,113 @@ class MoeLmBackend(ModelBackend):
 
 
 register_model("moe_lm_mc", default=False)(MoeLmBackend)
+
+
+class PipelinedLmBackend(ModelBackend):
+    """Transformer LM served with its blocks pipeline-parallel over ``pp``.
+
+    Each device row holds a contiguous slice of layers (a pipeline stage);
+    a served batch flows through the stages as one microbatch via the same
+    shard_map + ppermute schedule the training step uses
+    (client_tpu.parallel.pipeline.pipeline_apply with M=1 — handoffs ride
+    ICI). Embed/unembed replicate. The per-request latency is the sum of
+    stage times (a pipeline helps model *capacity*, not solo latency);
+    dynamic batching rides inside the single microbatch.
+    """
+
+    def __init__(self, mesh=None, name: str = "pipelined_lm_mc",
+                 seq_len: int = 32, d_model: int = 64, d_ff: int = 128,
+                 n_layers: int | None = None, n_heads: int = 4,
+                 vocab: int = 256, max_batch_size: int = 8,
+                 weights_path: str | None = None):
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from client_tpu.engine.config import (
+            DynamicBatchingConfig,
+            ModelConfig,
+            TensorConfig,
+        )
+        from client_tpu.parallel.mesh import make_mesh
+
+        if mesh is None:
+            mesh = make_mesh(axes=("dp", "pp"))
+        if "pp" not in mesh.shape:
+            raise ValueError(
+                "PipelinedLmBackend requires a mesh with a 'pp' axis; got "
+                f"axes {tuple(mesh.shape)}")
+        self.mesh = mesh
+        self.weights_path = weights_path
+        pp = int(mesh.shape["pp"])
+        if n_layers is None:
+            n_layers = pp * max(1, 4 // pp)
+        if n_layers % pp:
+            raise ValueError(
+                f"n_layers ({n_layers}) must divide by pp ({pp})")
+        if d_model % n_heads:
+            raise ValueError(
+                f"d_model ({d_model}) must divide by n_heads ({n_heads})")
+        self.seq_len = seq_len
+        self.d_model = d_model
+        self.d_ff = d_ff
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        self.vocab = vocab
+        top, buckets = dp_batch_buckets(int(mesh.shape["dp"]),
+                                        max_batch_size)
+        self.config = ModelConfig(
+            name=name,
+            platform="jax",
+            max_batch_size=top,
+            input=[TensorConfig("INPUT_IDS", "INT32", [seq_len])],
+            output=[TensorConfig("LOGITS", "FP32", [seq_len, vocab])],
+            dynamic_batching=DynamicBatchingConfig(
+                preferred_batch_size=[max(1, top // 2), top],
+                max_queue_delay_microseconds=500,
+            ),
+            instance_count=1,
+        )
+        self.config.batch_buckets = buckets
+        self.input_shardings = {
+            "INPUT_IDS": NamedSharding(mesh, P("dp", None))}
+
+    def _init_params(self):
+        import jax
+
+        from client_tpu.parallel.pipeline import _init_stacked_params
+
+        return _init_stacked_params(jax.random.PRNGKey(0), self.vocab,
+                                    self.d_model, self.d_ff, self.n_layers)
+
+    def place_params(self, params):
+        from jax.sharding import PartitionSpec as P
+
+        from client_tpu.parallel.pipeline import _stacked_specs
+
+        return place_with_specs(self.mesh, params, _stacked_specs(P))
+
+    def make_apply_params(self):
+        import jax.numpy as jnp
+
+        from client_tpu.parallel.pipeline import pipeline_apply
+        from client_tpu.parallel.training import _rms_norm
+
+        mesh = self.mesh
+        n_heads = self.n_heads
+        block_keys = ("wq", "wk", "wv", "wo", "w1", "w2")
+
+        def apply(params, inputs):
+            tokens = inputs["INPUT_IDS"]
+            seq = tokens.shape[-1]
+            mask = jnp.tril(jnp.ones((seq, seq), dtype=bool))
+            x = params["embed"][tokens][None]        # [M=1, B, S, D]
+            x = pipeline_apply(mesh, {k: params[k] for k in block_keys},
+                               x, n_heads, mask)[0]
+            logits = _rms_norm(x) @ params["unembed"]
+            return {"LOGITS": logits.astype("float32")}
+
+        return apply, self.place_params(
+            self.load_or_init_params(self._init_params))
+
+
+register_model("pipelined_lm_mc", default=False)(PipelinedLmBackend)

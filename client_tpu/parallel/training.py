@@ -125,9 +125,9 @@ def make_train_step(mesh, vocab=256, d_model=128, d_ff=256, n_layers=2,
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
-    from client_tpu.parallel.mesh import constrain_to
+    from client_tpu.parallel.mesh import make_constrain
 
-    constrain = constrain_to(mesh)
+    constrain = make_constrain(mesh)
     params = _init_params(jax.random.PRNGKey(0), vocab, d_model, d_ff,
                           n_layers)
     specs = _param_specs(P, n_layers)

@@ -75,13 +75,13 @@ class TestMoe:
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
-        from client_tpu.parallel.mesh import constrain_to
+        from client_tpu.parallel.mesh import make_constrain
 
         x, router, w1, w2, capacity = self._oracle_and_sharded()
         want_y, want_aux = moe_ffn(x, router, w1, w2, capacity)
 
         mesh = make_mesh(8, axes=("dp", "ep", "tp"))
-        constrain = constrain_to(mesh)
+        constrain = make_constrain(mesh)
         w1s = jax.device_put(w1, NamedSharding(mesh, P("ep", None, "tp")))
         w2s = jax.device_put(w2, NamedSharding(mesh, P("ep", "tp", None)))
         got_y, got_aux = jax.jit(
@@ -122,6 +122,39 @@ class TestServedMoe:
         out = apply_fn(params, {"INPUT_IDS": ids})
         assert out["LOGITS"].shape == (2, 32, 256)
 
+    def test_engine_serves_pipelined_lm(self):
+        """pipelined_lm_mc through the engine: stages pp-sharded, output
+        matches the sequential single-device oracle."""
+        import jax.numpy as jnp
+
+        from client_tpu.engine import InferRequest, TpuEngine
+        from client_tpu.models import build_repository
+        from client_tpu.parallel.pipeline import reference_forward
+        from client_tpu.parallel.serving import PipelinedLmBackend
+        from client_tpu.parallel.training import _rms_norm
+
+        engine = TpuEngine(build_repository(["pipelined_lm_mc"]))
+        try:
+            ids = np.arange(2 * 32, dtype=np.int32).reshape(2, 32) % 256
+            got = engine.infer(
+                InferRequest(model_name="pipelined_lm_mc",
+                             inputs={"INPUT_IDS": ids}),
+                timeout_s=300).outputs["LOGITS"]
+            assert got.shape == (2, 32, 256), got.shape
+        finally:
+            engine.shutdown()
+
+        # Oracle: the same params applied sequentially on one device.
+        backend = PipelinedLmBackend()
+        params = backend._init_params()
+        mask = jnp.tril(jnp.ones((32, 32), dtype=bool))
+        x = params["embed"][jnp.asarray(ids)]
+        blocks = {k: params[k] for k in ("wq", "wk", "wv", "wo", "w1", "w2")}
+        want = _rms_norm(reference_forward(blocks, x, 4, mask)) \
+            @ params["unembed"]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-4, rtol=2e-4)
+
     def test_rejects_mismatched_experts(self):
         import pytest
 
@@ -130,6 +163,15 @@ class TestServedMoe:
         with pytest.raises(ValueError, match="n_experts"):
             MoeLmBackend(mesh=make_mesh(8, axes=("dp", "ep", "tp")),
                          n_experts=3)
+
+    def test_rejects_pp_less_mesh(self):
+        import pytest
+
+        from client_tpu.parallel.serving import PipelinedLmBackend
+
+        with pytest.raises(ValueError, match="pp"):
+            PipelinedLmBackend(mesh=make_mesh(8, axes=("dp", "tp")))
+
     def test_engine_serves_moe_lm(self):
         """moe_lm_mc through the full engine path (scheduler, dynamic
         batching) on a dp x ep x tp mesh; repeat calls are deterministic."""
