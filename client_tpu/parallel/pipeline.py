@@ -185,6 +185,10 @@ def make_pipeline_train_step(mesh, vocab=256, d_model=64, d_ff=128,
     data_sharding = NamedSharding(mesh, P(None, "dp", None))
 
     def shard_fn(tokens):
+        dp = mesh.shape["dp"]
+        if tokens.shape[1] % dp:
+            raise ValueError(
+                f"microbatch size {tokens.shape[1]} must divide by dp ({dp})")
         return jax.device_put(jnp.asarray(tokens, jnp.int32), data_sharding)
 
     return params, opt_state, train_step, shard_fn

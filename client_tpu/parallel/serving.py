@@ -42,6 +42,37 @@ from client_tpu.parallel.mesh import drop_absent, make_constrain  # noqa: F401
 # (make_constrain is re-exported: the sharded backends' public helper.)
 
 
+def _served_lm_config(mesh, name, seq_len, vocab, max_batch_size):
+    """(ModelConfig, input_shardings) for the token-in/logits-out served LM
+    families (MoE, pipelined): INPUT_IDS INT32 [seq] -> LOGITS FP32
+    [seq, vocab], dp-multiple batch buckets, batch rows sharded on dp."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from client_tpu.engine.config import (
+        DynamicBatchingConfig,
+        ModelConfig,
+        TensorConfig,
+    )
+
+    top, buckets = dp_batch_buckets(int(mesh.shape["dp"]), max_batch_size)
+    config = ModelConfig(
+        name=name,
+        platform="jax",
+        max_batch_size=top,
+        input=[TensorConfig("INPUT_IDS", "INT32", [seq_len])],
+        output=[TensorConfig("LOGITS", "FP32", [seq_len, vocab])],
+        dynamic_batching=DynamicBatchingConfig(
+            preferred_batch_size=[max(1, top // 2), top],
+            max_queue_delay_microseconds=500,
+        ),
+        instance_count=1,
+    )
+    config.batch_buckets = buckets
+    shardings = {"INPUT_IDS": NamedSharding(mesh, P("dp", None))}
+    return config, shardings
+
+
 def place_with_specs(mesh, params, specs):
     """device_put a param tree with per-leaf PartitionSpecs, nulling
     mesh-absent axes the same way make_constrain does."""
@@ -336,14 +367,6 @@ class MoeLmBackend(ModelBackend):
                  capacity_factor: float = 1.25, vocab: int = 256,
                  max_batch_size: int = 8,
                  weights_path: str | None = None):
-        from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as P
-
-        from client_tpu.engine.config import (
-            DynamicBatchingConfig,
-            ModelConfig,
-            TensorConfig,
-        )
         from client_tpu.parallel.mesh import make_mesh
 
         if mesh is None:
@@ -370,23 +393,8 @@ class MoeLmBackend(ModelBackend):
                 f"d_model ({d_model}) must divide by n_heads ({n_heads})")
         self.capacity_factor = capacity_factor
         self.vocab = vocab
-        top, buckets = dp_batch_buckets(int(mesh.shape["dp"]),
-                                        max_batch_size)
-        self.config = ModelConfig(
-            name=name,
-            platform="jax",
-            max_batch_size=top,
-            input=[TensorConfig("INPUT_IDS", "INT32", [seq_len])],
-            output=[TensorConfig("LOGITS", "FP32", [seq_len, vocab])],
-            dynamic_batching=DynamicBatchingConfig(
-                preferred_batch_size=[max(1, top // 2), top],
-                max_queue_delay_microseconds=500,
-            ),
-            instance_count=1,
-        )
-        self.config.batch_buckets = buckets
-        self.input_shardings = {
-            "INPUT_IDS": NamedSharding(mesh, P("dp", None))}
+        self.config, self.input_shardings = _served_lm_config(
+            mesh, name, seq_len, vocab, max_batch_size)
 
     def _init_params(self):
         import jax
@@ -446,14 +454,6 @@ class PipelinedLmBackend(ModelBackend):
                  n_layers: int | None = None, n_heads: int = 4,
                  vocab: int = 256, max_batch_size: int = 8,
                  weights_path: str | None = None):
-        from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as P
-
-        from client_tpu.engine.config import (
-            DynamicBatchingConfig,
-            ModelConfig,
-            TensorConfig,
-        )
         from client_tpu.parallel.mesh import make_mesh
 
         if mesh is None:
@@ -479,23 +479,8 @@ class PipelinedLmBackend(ModelBackend):
         self.n_layers = n_layers
         self.n_heads = n_heads
         self.vocab = vocab
-        top, buckets = dp_batch_buckets(int(mesh.shape["dp"]),
-                                        max_batch_size)
-        self.config = ModelConfig(
-            name=name,
-            platform="jax",
-            max_batch_size=top,
-            input=[TensorConfig("INPUT_IDS", "INT32", [seq_len])],
-            output=[TensorConfig("LOGITS", "FP32", [seq_len, vocab])],
-            dynamic_batching=DynamicBatchingConfig(
-                preferred_batch_size=[max(1, top // 2), top],
-                max_queue_delay_microseconds=500,
-            ),
-            instance_count=1,
-        )
-        self.config.batch_buckets = buckets
-        self.input_shardings = {
-            "INPUT_IDS": NamedSharding(mesh, P("dp", None))}
+        self.config, self.input_shardings = _served_lm_config(
+            mesh, name, seq_len, vocab, max_batch_size)
 
     def _init_params(self):
         import jax
