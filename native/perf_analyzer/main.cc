@@ -74,6 +74,8 @@ Options:
                          percentiles through the gRPC stream (implies
                          --streaming; streams = --concurrency-range start)
   --generative-max-tokens <n>  tokens per generation stream (default 32)
+  --generative-no-coalesce     disable server-side token coalescing
+                         (per-message tax A/B; default requests coalescing)
   --service-kind <tpu_http|tpu_grpc|tpu_capi|tfserving|torchserve>
                          endpoint kind (default
                          tpu_http; -i grpc implies tpu_grpc);
@@ -132,6 +134,10 @@ struct Args {
   bool streaming = false;
   bool generative = false;
   uint64_t gen_max_tokens = 32;
+  // Server-side token coalescing (one message may carry k tokens). On by
+  // default: it is the production posture; --generative-no-coalesce
+  // measures the per-message tax A/B.
+  bool gen_coalesce = true;
 };
 
 bool ParseRange(const char* s, double* a, double* b, double* c) {
@@ -303,7 +309,7 @@ int RunGenerativeProfile(const ClientBackendFactory& factory,
   std::mutex mu;
   std::condition_variable cv;
   std::vector<uint64_t> ttft_ns, itl_ns;
-  uint64_t tokens = 0, completed = 0, errors = 0;
+  uint64_t tokens = 0, messages = 0, completed = 0, errors = 0;
   std::string first_error;
 
   err = backend->StartStream([&](tpuclient::InferResult* result) {
@@ -312,7 +318,19 @@ int RunGenerativeProfile(const ClientBackendFactory& factory,
                                      : Error("null stream response");
     bool final = IsFinalStreamResponse(result);
     std::string id;
-    if (result != nullptr) result->Id(&id);
+    uint64_t n_tok = 1;
+    if (result != nullptr) {
+      result->Id(&id);
+      // Coalesced responses carry k tokens in one message (the server
+      // merges a backlogged stream's rows); count by payload element
+      // count, not by message count.
+      const uint8_t* buf = nullptr;
+      size_t nbytes = 0;
+      if (result->RawData("TOKEN", &buf, &nbytes).IsOk() &&
+          nbytes >= sizeof(int32_t)) {
+        n_tok = nbytes / sizeof(int32_t);
+      }
+    }
     delete result;
     if (!status.IsOk()) {
       // Error results may carry no request id (the stream-level failure
@@ -337,12 +355,19 @@ int RunGenerativeProfile(const ClientBackendFactory& factory,
       cv.notify_all();
       return;
     }
-    ++tokens;
+    tokens += n_tok;
+    ++messages;
     if (!sl.first_seen) {
       sl.first_seen = true;
       ttft_ns.push_back(now - sl.start_ns);
+      // tokens beyond the first in the same message have no observable
+      // intra-message spacing; they contribute no TTFT/ITL samples
     } else {
-      itl_ns.push_back(now - sl.last_ns);
+      // Per-token ITL: a k-token message closes k token intervals spanning
+      // one observed gap; record gap/k once per token so percentiles stay
+      // token-weighted under coalescing.
+      uint64_t per = (now - sl.last_ns) / n_tok;
+      for (uint64_t i = 0; i < n_tok; ++i) itl_ns.push_back(per);
     }
     sl.last_ns = now;
   });
@@ -383,6 +408,11 @@ int RunGenerativeProfile(const ClientBackendFactory& factory,
     options.model_version = args.version;
     options.request_id = std::to_string(idx);
     options.int_parameters["max_tokens"] = int64_t(args.gen_max_tokens);
+    // Let the server merge backlogged tokens for this stream into one
+    // message ([k]-shaped TOKEN); the callback above counts by element.
+    if (args.gen_coalesce) {
+      options.bool_parameters["response_coalesce"] = true;
+    }
     return backend->AsyncStreamInfer(options, {input.get()}, {});
   };
 
@@ -420,6 +450,7 @@ int RunGenerativeProfile(const ClientBackendFactory& factory,
     ttft_ns.clear();
     itl_ns.clear();
     tokens = 0;
+    messages = 0;
     completed = 0;
   }
   uint64_t t0 = NowNs();
@@ -433,12 +464,13 @@ int RunGenerativeProfile(const ClientBackendFactory& factory,
   backend->StopStream();
 
   std::vector<uint64_t> ttft, itl;
-  uint64_t n_tokens, n_completed;
+  uint64_t n_tokens, n_messages, n_completed;
   {
     std::lock_guard<std::mutex> lk(mu);
     ttft = ttft_ns;
     itl = itl_ns;
     n_tokens = tokens;
+    n_messages = messages;
     n_completed = completed;
   }
   double secs = double(elapsed_ns) / 1e9;
@@ -447,9 +479,11 @@ int RunGenerativeProfile(const ClientBackendFactory& factory,
          "max_tokens=%lu, window %.1fs\n",
          args.model.c_str(), streams,
          static_cast<unsigned long>(args.gen_max_tokens), secs);
-  printf("  Completed streams: %lu, tokens: %lu, tok/s: %.1f\n",
+  printf("  Completed streams: %lu, tokens: %lu, tok/s: %.1f, "
+         "tokens/message: %.2f\n",
          static_cast<unsigned long>(n_completed),
-         static_cast<unsigned long>(n_tokens), tok_s);
+         static_cast<unsigned long>(n_tokens), tok_s,
+         n_messages > 0 ? double(n_tokens) / double(n_messages) : 0.0);
   printf("  TTFT usec: p50 %lu, p90 %lu, p99 %lu\n",
          static_cast<unsigned long>(Pct(ttft, 0.50) / 1000),
          static_cast<unsigned long>(Pct(ttft, 0.90) / 1000),
@@ -496,6 +530,7 @@ int main(int argc, char** argv) {
       {"streaming", no_argument, nullptr, 1022},
       {"generative", no_argument, nullptr, 1023},
       {"generative-max-tokens", required_argument, nullptr, 1024},
+      {"generative-no-coalesce", no_argument, nullptr, 1025},
       {"capi-library-path", required_argument, nullptr, 1018},
       {"capi-models", required_argument, nullptr, 1019},
       {"capi-repo-root", required_argument, nullptr, 1020},
@@ -599,6 +634,7 @@ int main(int argc, char** argv) {
       case 1021: args.warmup_requests = strtoull(optarg, nullptr, 10); break;
       case 1022: args.streaming = true; break;
       case 1023: args.generative = true; args.streaming = true; break;
+      case 1025: args.gen_coalesce = false; break;
       case 1024:
         args.gen_max_tokens = strtoull(optarg, nullptr, 10);
         break;

@@ -384,6 +384,64 @@ class TestGenerativeGrpcStream:
             srv.stop()
             eng.shutdown()
 
+    def test_coalesced_stream_identical_tokens(self, monkeypatch):
+        """`response_coalesce` lets the writer merge backlogged tokens into
+        [k]-shaped messages; the delivered token sequence (flattened, with
+        INDEX continuity) must be identical to the uncoalesced stream, with
+        final still terminating the request.  The writer-delay knob forces
+        a backlog so the multi-response merge path actually runs (without
+        it a fast reader drains token-by-token and the merge is never
+        exercised)."""
+        import client_tpu.grpc as grpcclient
+        from client_tpu.server import GrpcInferenceServer
+
+        monkeypatch.setenv("CLIENT_TPU_STREAM_WRITER_DELAY_MS", "40")
+        eng = TpuEngine(build_repository(["tiny_gpt"]))
+        srv = GrpcInferenceServer(eng, port=0).start()
+        try:
+            n_tok = 24
+            expected = generate(eng, [7, 8, 9], n_tok)
+
+            c = grpcclient.InferenceServerClient(f"127.0.0.1:{srv.port}")
+            tokens: list[int] = []
+            indices: list[int] = []
+            shapes: list[int] = []
+            done = threading.Event()
+
+            def cb(result, error):
+                assert error is None, error
+                params = result.get_response().parameters
+                final = ("triton_final_response" in params
+                         and params["triton_final_response"].bool_param)
+                if result.get_response().outputs:
+                    toks = result.as_numpy("TOKEN")
+                    idx = result.as_numpy("INDEX")
+                    assert len(toks) == len(idx)  # rows stay aligned
+                    shapes.append(len(toks))
+                    tokens.extend(int(t) for t in toks)
+                    indices.extend(int(i) for i in idx)
+                if final:
+                    done.set()
+
+            c.start_stream(cb)
+            inp = grpcclient.InferInput("INPUT_IDS", [3], "INT32")
+            inp.set_data_from_numpy(np.array([7, 8, 9], dtype=np.int32))
+            c.async_stream_infer(
+                "tiny_gpt", [inp], request_id="gc1",
+                parameters={"max_tokens": n_tok, "response_coalesce": True})
+            assert done.wait(timeout=120)
+            c.stop_stream()
+            c.close()
+            assert tokens == expected
+            assert indices == list(range(n_tok))
+            # the throttled writer must actually have merged: fewer
+            # messages than tokens, at least one multi-token message
+            assert max(shapes) > 1
+            assert len(shapes) < n_tok
+        finally:
+            srv.stop()
+            eng.shutdown()
+
 
 class TestCancellation:
     def test_cancel_mid_generation_frees_the_slot(self):
