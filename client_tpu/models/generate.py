@@ -153,6 +153,15 @@ class TinyGptBackend(ModelBackend):
 
     # -- shared blocks --------------------------------------------------------
 
+    def _ffn(self, lp, h):
+        """Position-wise FFN on [T, d] rows; the MoE generative family
+        (parallel/serving.py MoeGptBackend) overrides this with routed
+        experts — attention, KV arena, and the prefill/decode programs are
+        shared unchanged."""
+        import jax
+
+        return jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+
     def _embed_positions(self, p, ids, start):
         import jax.numpy as jnp
 
@@ -185,7 +194,7 @@ class TinyGptBackend(ModelBackend):
             o = jnp.einsum("hqk,khd->qhd", jax.nn.softmax(s), v)
             x = x + o.reshape(n, self.d_model) @ lp["wo"]
             h2 = _ln(x, lp["ln2g"], lp["ln2b"])
-            x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+            x = x + self._ffn(lp, h2)
         return x
 
     # -- generative interface (used by GenerativeScheduler) -------------------
@@ -301,7 +310,7 @@ class TinyGptBackend(ModelBackend):
                 o = jnp.einsum("bhs,bshd->bhd", jax.nn.softmax(s), cv)
                 x = x + o.reshape(b, self.d_model) @ lp["wo"]
                 h2 = _ln(x, lp["ln2g"], lp["ln2b"])
-                x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+                x = x + self._ffn(lp, h2)
             xf = _ln(x, p["lnfg"], p["lnfb"])
             logits = xf @ p["head"]                          # [B, vocab]
             # ctx at sampling = lens + 1 (the token just written occupies

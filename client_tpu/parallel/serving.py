@@ -321,22 +321,160 @@ class ShardedTinyGptBackend(TinyGptBackend):
             params, self._param_specs(P))
 
     def init_arena(self, capacity: int):
-        import jax
-        from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as P
+        return _place_arena_heads_sharded(self.mesh,
+                                          super().init_arena(capacity))
 
-        arena = super().init_arena(capacity)
-        # k/v [L, cap+1, S, H, D]: shard the heads axis with the weights;
-        # the per-row token slots replicate (tiny, read by every shard).
-        kv = NamedSharding(self.mesh, P(None, None, None, "tp", None))
-        rep = NamedSharding(self.mesh, P())
-        return {
-            name: jax.device_put(a, kv if a.ndim == 5 else rep)
-            for name, a in arena.items()
-        }
+
+def _place_arena_heads_sharded(mesh, arena):
+    """KV-arena placement shared by the sharded generative families:
+    k/v [L, cap+1, S, H, D] shard their heads axis with the tp weight
+    splits (dropped when the mesh has no tp); the per-row token slots and
+    any other small plane replicate (tiny, read by every shard)."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    kv = NamedSharding(mesh, P(None, None, None, drop_absent(mesh, "tp"),
+                               None))
+    rep = NamedSharding(mesh, P())
+    return {name: jax.device_put(a, kv if a.ndim == 5 else rep)
+            for name, a in arena.items()}
 
 
 register_model("tiny_gpt_mc", default=False)(ShardedTinyGptBackend)
+
+
+class MoeGptBackend(TinyGptBackend):
+    """Expert-parallel generative decode: a Switch-MoE decoder LM in the
+    continuous-batching arena (GenerativeScheduler) over an ("ep","tp")
+    mesh.
+
+    Every decode wave routes its B tokens top-1 through an expert FFN stack
+    sharded over ``ep`` (attention heads and expert hidden over ``tp``);
+    the KV arena, prefill/decode programs, pipelined dispatch, and the
+    decoupled token-stream protocol are inherited from TinyGptBackend
+    unchanged — only the position-wise FFN hook differs.  The dispatch/
+    combine one-hot einsums reshard token-major -> expert-major, which
+    GSPMD lowers to all-to-all-style collectives on ICI (no explicit
+    constraints needed: propagation from the [E,...] weight shardings pins
+    the expert-major intermediates to ep).
+
+    Routing is **dropless**: per-expert queue capacity equals the token
+    count (worst case every token picks one expert), so no token ever
+    overflows onto the residual path.  That keeps each token's output a
+    pure function of its own features — decode stays batch-invariant and
+    bit-identical to solo decode, the arena contract every served
+    generative family must honor (unlike the capacity-dropping `moe_lm_mc`
+    forward family, which documents its variance).  The cost is the dense
+    [T, E, T] dispatch tensor — the exact one-hot Switch formulation,
+    fine at decode-wave sizes (T <= max_streams); a ragged/sorted Pallas
+    dispatch is the scale-up path, not a semantic change.
+
+    Reference anchor: the decoupled streaming contract this family serves
+    through (/root/reference/src/python/examples/
+    simple_grpc_custom_repeat.py); the reference has no parallelism or
+    generative scheduler (SURVEY.md §2.9).
+    """
+
+    def __init__(self, mesh=None, name: str = "moe_gpt_mc",
+                 n_layers: int = 2, d_model: int = 128, n_heads: int = 4,
+                 d_ff: int = 256, vocab: int = 256, max_seq_len: int = 64,
+                 max_streams: int = 32, n_experts: int | None = None,
+                 weights_path: str | None = None, **kw):
+        from client_tpu.parallel.mesh import make_mesh
+        from client_tpu.parallel.moe import default_n_experts
+
+        if mesh is None:
+            mesh = make_mesh(axes=("ep", "tp"))
+        self.mesh = mesh
+        self.n_experts = n_experts or default_n_experts(mesh)
+        ep = int(mesh.shape.get("ep", 1))
+        tp = int(mesh.shape.get("tp", 1))
+        if self.n_experts % ep:
+            raise ValueError(
+                f"n_experts ({self.n_experts}) must divide by ep ({ep})")
+        if n_heads % tp:
+            raise ValueError(
+                f"n_heads ({n_heads}) must divide by tp ({tp})")
+        if d_ff % tp:
+            raise ValueError(f"d_ff ({d_ff}) must divide by tp ({tp})")
+        super().__init__(name=name, n_layers=n_layers, d_model=d_model,
+                         n_heads=n_heads, d_ff=d_ff, vocab=vocab,
+                         max_seq_len=max_seq_len, max_streams=max_streams,
+                         **kw)
+        self.weights_path = weights_path
+
+    def _init_params(self):
+        import math as _math
+
+        rng = np.random.default_rng(self._seed)
+        d, f, v, E = self.d_model, self.d_ff, self.vocab, self.n_experts
+
+        def w(*shape, scale):
+            return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+        s_d, s_f = 1.0 / _math.sqrt(d), 1.0 / _math.sqrt(f)
+        layers = []
+        for _ in range(self.n_layers):
+            layers.append({
+                "ln1g": np.ones(d, np.float32),
+                "ln1b": np.zeros(d, np.float32),
+                "wq": w(d, d, scale=s_d), "wk": w(d, d, scale=s_d),
+                "wv": w(d, d, scale=s_d), "wo": w(d, d, scale=s_d),
+                "ln2g": np.ones(d, np.float32),
+                "ln2b": np.zeros(d, np.float32),
+                "router": w(d, E, scale=0.02),
+                "w1e": w(E, d, f, scale=s_d),
+                "w2e": w(E, f, d, scale=s_f),
+            })
+        return {
+            "embed": w(v, d, scale=0.02),
+            "pos": w(self.max_seq_len, d, scale=0.02),
+            "layers": layers,
+            "lnfg": np.ones(d, np.float32),
+            "lnfb": np.zeros(d, np.float32),
+            "head": w(d, v, scale=s_d),
+        }
+
+    def _ffn(self, lp, h):
+        """Dropless top-1 Switch FFN on [T, d] rows (both prefill's
+        per-row stack under vmap and the decode wave's [B, d] call):
+        ``moe_ffn`` with capacity == T — every token's queue position is
+        < T, so ``keep == onehot`` and nothing ever drops; one shared
+        routing implementation for training, forward serving, and decode."""
+        from client_tpu.parallel.moe import moe_ffn
+
+        y, _aux = moe_ffn(h[None], lp["router"], lp["w1e"], lp["w2e"],
+                          capacity=h.shape[0])
+        return y[0]
+
+    def _param_specs(self, P):
+        layer = {
+            "ln1g": P(), "ln1b": P(),
+            "wq": P(None, "tp"), "wk": P(None, "tp"), "wv": P(None, "tp"),
+            "wo": P("tp", None),
+            "ln2g": P(), "ln2b": P(),
+            "router": P(),
+            "w1e": P("ep", None, "tp"),
+            "w2e": P("ep", "tp", None),
+        }
+        return {
+            "embed": P(), "pos": P(),
+            "layers": [dict(layer) for _ in range(self.n_layers)],
+            "lnfg": P(), "lnfb": P(), "head": P(),
+        }
+
+    def place_params(self, params):
+        from jax.sharding import PartitionSpec as P
+
+        return place_with_specs(self.mesh, params, self._param_specs(P))
+
+    def init_arena(self, capacity: int):
+        return _place_arena_heads_sharded(self.mesh,
+                                          super().init_arena(capacity))
+
+
+register_model("moe_gpt_mc", default=False)(MoeGptBackend)
 
 
 class MoeLmBackend(ModelBackend):
