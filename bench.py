@@ -1166,5 +1166,80 @@ def _main():
     _emit(_RESULT)
 
 
+def mfu_study(n_runs: int = 5, trace_dir: str | None = None):
+    """Flagship MFU variance study (VERDICT r4 #4): N repeated BERT-base
+    b8 probes on identical code, reported as a distribution — separating
+    shared-chip contention from code drift — plus one jax.profiler trace
+    naming the top ops, saved as an artifact.
+
+    Run: ``python bench.py --mfu-study [n_runs]``.  Appends each probe to
+    BENCH_HISTORY (probe="mfu_study") and prints a summary JSON line.
+    """
+    devices = preflight()
+    _HIST_CTX.update({"platform": devices[0].platform,
+                      "config": "bert-b8-mfu-study"})
+    steps_ms: list[float] = []
+    mfus: list[float] = []
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    for i in range(n_runs):
+        _, mfu, step_s, e2e_s = (bench_bert_mfu(iters=3, pipeline_n=5)
+                                 if smoke else bench_bert_mfu())
+        steps_ms.append(round(step_s * 1e3, 3))
+        if mfu is not None:
+            mfus.append(round(mfu, 4))
+        _append_history({"probe": "mfu_study", "run": i,
+                         "step_ms": step_s * 1e3, "mfu": mfu,
+                         "e2e_ms": e2e_s * 1e3})
+        log(f"mfu-study run {i + 1}/{n_runs}: step {step_s * 1e3:.2f}ms"
+            + (f", MFU {mfu * 100:.1f}%" if mfu is not None else ""))
+    trace_note = None
+    if trace_dir:
+        # One profiled pass on the same workload: the trace artifact names
+        # the top device ops behind the measured step.
+        import jax
+        import numpy as np
+
+        from client_tpu.engine.model import Model
+        from client_tpu.models.bert import BertBackend
+
+        backend = BertBackend(max_batch_size=8)
+        backend.config.batch_buckets = [8]
+        model = Model(backend)
+        ids = np.random.randint(0, 30522, size=(8, 128), dtype=np.int32)
+        inputs = {"input_ids": ids,
+                  "attention_mask": np.ones((8, 128), np.int32)}
+        model.execute(inputs, batch_size=8)  # compile outside the trace
+        apply_j = model.raw_apply()
+        staged = {k: jax.device_put(v) for k, v in inputs.items()}
+        np.asarray(apply_j(staged)["logits"])  # warm
+        with jax.profiler.trace(trace_dir):
+            r = None
+            for _ in range(5 if smoke else 30):
+                r = apply_j(staged)
+            np.asarray(r["logits"])
+        trace_note = trace_dir
+        log(f"mfu-study: profiler trace written to {trace_dir}")
+    summary = {
+        "metric": "bert_b8_mfu_study", "n_runs": n_runs,
+        "step_ms": steps_ms,
+        "step_ms_min": min(steps_ms), "step_ms_max": max(steps_ms),
+        "mfu": mfus,
+        "mfu_min": min(mfus) if mfus else None,
+        "mfu_max": max(mfus) if mfus else None,
+        "trace": trace_note,
+    }
+    _append_history({"probe": "mfu_study_summary", **summary})
+    print(json.dumps(summary), flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    if "--mfu-study" in sys.argv:
+        idx = sys.argv.index("--mfu-study")
+        n = (int(sys.argv[idx + 1])
+             if len(sys.argv) > idx + 1 and sys.argv[idx + 1].isdigit()
+             else 5)
+        trace = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "artifacts", "mfu_trace")
+        mfu_study(n, trace_dir=trace)
+    else:
+        main()
