@@ -892,7 +892,8 @@ def bert_flops_per_example(seq_len=128, hidden=768, n_layers=12, ffn=3072):
     return n_layers * per_layer
 
 
-def bench_bert_mfu(batch: int = 8, iters: int = 30, pipeline_n: int = 100):
+def bench_bert_mfu(batch: int = 8, iters: int = 30, pipeline_n: int = 100,
+                   trace_dir: str | None = None):
     """Flagship BERT-base batch-8 at the Model level (no scheduler).
 
     Two numbers with different denominators:
@@ -956,6 +957,16 @@ def bench_bert_mfu(batch: int = 8, iters: int = 30, pipeline_n: int = 100):
         cand = max(t_total - t_one, 1e-9) / max(pipeline_n - 1, 1)
         step = cand if step is None else min(step, cand)
 
+    if trace_dir:
+        # Same staged workload, one profiled pipelined pass: the trace
+        # artifact names the top device ops behind the measured step.
+        with jax.profiler.trace(trace_dir):
+            r = None
+            for _ in range(min(pipeline_n, 30)):
+                r = apply_j(staged)
+            np.asarray(r["logits"])
+        log(f"bert: profiler trace written to {trace_dir}")
+
     flops = bert_flops_per_example() * batch
     achieved = flops / step
     peak = peak_flops()
@@ -968,11 +979,16 @@ def bench_bert_mfu(batch: int = 8, iters: int = 30, pipeline_n: int = 100):
 
 
 def main():
+    _run_with_watchdog(_main)
+
+
+def _run_with_watchdog(target):
     # Watchdog: the dev tunnel can go DOWN mid-run, hanging device calls
     # indefinitely (observed round 4: jax.devices() blocked for >30 min).
     # Device waits release the GIL, so a timer thread can still emit the
     # sections that completed and exit — the driver then records a partial
-    # (but honest) BENCH json instead of a timeout with no output.
+    # (but honest) BENCH json instead of a timeout with no output.  Every
+    # bench entry point (the driver run AND --mfu-study) runs under this.
     deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "1500"))
     finished = threading.Event()
 
@@ -1006,7 +1022,7 @@ def main():
 
     threading.Thread(target=watchdog, daemon=True).start()
     try:
-        _main()
+        target()
     finally:
         finished.set()
 
@@ -1181,9 +1197,13 @@ def mfu_study(n_runs: int = 5, trace_dir: str | None = None):
     steps_ms: list[float] = []
     mfus: list[float] = []
     smoke = bool(os.environ.get("BENCH_SMOKE"))
+    n_runs = max(1, n_runs)
     for i in range(n_runs):
-        _, mfu, step_s, e2e_s = (bench_bert_mfu(iters=3, pipeline_n=5)
-                                 if smoke else bench_bert_mfu())
+        # The last run also captures the profiler trace (same staged
+        # workload, no extra compile).
+        td = trace_dir if i == n_runs - 1 else None
+        kw = {"iters": 3, "pipeline_n": 5} if smoke else {}
+        _, mfu, step_s, e2e_s = bench_bert_mfu(trace_dir=td, **kw)
         steps_ms.append(round(step_s * 1e3, 3))
         if mfu is not None:
             mfus.append(round(mfu, 4))
@@ -1192,33 +1212,7 @@ def mfu_study(n_runs: int = 5, trace_dir: str | None = None):
                          "e2e_ms": e2e_s * 1e3})
         log(f"mfu-study run {i + 1}/{n_runs}: step {step_s * 1e3:.2f}ms"
             + (f", MFU {mfu * 100:.1f}%" if mfu is not None else ""))
-    trace_note = None
-    if trace_dir:
-        # One profiled pass on the same workload: the trace artifact names
-        # the top device ops behind the measured step.
-        import jax
-        import numpy as np
-
-        from client_tpu.engine.model import Model
-        from client_tpu.models.bert import BertBackend
-
-        backend = BertBackend(max_batch_size=8)
-        backend.config.batch_buckets = [8]
-        model = Model(backend)
-        ids = np.random.randint(0, 30522, size=(8, 128), dtype=np.int32)
-        inputs = {"input_ids": ids,
-                  "attention_mask": np.ones((8, 128), np.int32)}
-        model.execute(inputs, batch_size=8)  # compile outside the trace
-        apply_j = model.raw_apply()
-        staged = {k: jax.device_put(v) for k, v in inputs.items()}
-        np.asarray(apply_j(staged)["logits"])  # warm
-        with jax.profiler.trace(trace_dir):
-            r = None
-            for _ in range(5 if smoke else 30):
-                r = apply_j(staged)
-            np.asarray(r["logits"])
-        trace_note = trace_dir
-        log(f"mfu-study: profiler trace written to {trace_dir}")
+    trace_note = trace_dir
     summary = {
         "metric": "bert_b8_mfu_study", "n_runs": n_runs,
         "step_ms": steps_ms,
@@ -1240,6 +1234,6 @@ if __name__ == "__main__":
              else 5)
         trace = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "artifacts", "mfu_trace")
-        mfu_study(n, trace_dir=trace)
+        _run_with_watchdog(lambda: mfu_study(n, trace_dir=trace))
     else:
         main()

@@ -1,0 +1,57 @@
+"""Decoupled-response coalescing shared by the stream frontends.
+
+Per-message framing cost (protobuf + HTTP/2 write on gRPC, JSON + chunked
+write on SSE) is the served token path's ceiling once decode waves outrun
+the writer.  Requests that opt in via the ``response_coalesce`` parameter
+let a frontend merge a stream's *backlogged* non-final responses into one
+message whose outputs are the rows concatenated along axis 0 (a generation
+stream's k ``[1]``-shaped TOKEN/INDEX rows become one ``[k]`` tensor).
+
+Contract preserved: per-request response order (merging only ever combines
+already-ordered consecutive rows of one request), finals/errors never merge,
+and a dtype or trailing-shape drift starts a new message instead of blowing
+up the concat.  Off backlog every response still ships alone, so latency is
+unchanged; throughput rises exactly when the writer is behind.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from client_tpu.engine.types import InferRequest, InferResponse
+
+
+def mergeable(req: InferRequest, resp: InferResponse) -> bool:
+    """May this response join a coalesce run at all?"""
+    return (resp.error is None and not resp.final
+            and bool(req.parameters.get("response_coalesce"))
+            and all(getattr(a, "ndim", 0) >= 1
+                    for a in resp.outputs.values()))
+
+
+def run_compatible(prev: InferResponse, resp: InferResponse) -> bool:
+    """Do consecutive responses concatenate cleanly (same names, dtypes,
+    trailing dims — axis 0 is the merge axis)?"""
+    if set(prev.outputs) != set(resp.outputs):
+        return False
+    return all(prev.outputs[n].dtype == a.dtype
+               and prev.outputs[n].shape[1:] == a.shape[1:]
+               for n, a in resp.outputs.items())
+
+
+def merge(resps: list[InferResponse]) -> InferResponse:
+    """One response for a run: every output concatenated along axis 0."""
+    if len(resps) == 1:
+        return resps[0]
+    last = resps[-1]
+    return InferResponse(
+        model_name=last.model_name,
+        model_version=last.model_version,
+        request_id=last.request_id,
+        outputs={name: np.concatenate([r.outputs[name] for r in resps],
+                                      axis=0)
+                 for name in last.outputs},
+        parameters=last.parameters,
+        final=False,
+        times=last.times,
+    )

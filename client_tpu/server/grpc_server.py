@@ -23,7 +23,6 @@ from client_tpu.engine.engine import TpuEngine
 from client_tpu.engine.types import (
     EngineError,
     InferRequest,
-    InferResponse,
     OutputRequest,
 )
 from client_tpu.protocol import grpc_codec, grpc_service_pb2 as pb
@@ -34,6 +33,7 @@ from client_tpu.protocol.grpc_stub import (
 )
 from client_tpu.protocol.model_config import config_dict_to_proto
 from client_tpu.server.classification import classify_output
+from client_tpu.server.coalesce import merge, mergeable, run_compatible
 
 import logging
 
@@ -518,43 +518,6 @@ class _Servicer(GRPCInferenceServiceServicer):
                                      "triton_final_response", True)
             return pb.ModelStreamInferResponse(infer_response=proto)
 
-        def encode_group(req, resps) -> pb.ModelStreamInferResponse:
-            """One message for a run of coalesced responses: every output
-            concatenated along axis 0 (a generation stream's k backlogged
-            [1]-shaped TOKEN/INDEX rows become one [k] tensor)."""
-            if len(resps) == 1:
-                return encode(("resp", req, resps[0]))
-            last = resps[-1]
-            merged = InferResponse(
-                model_name=last.model_name,
-                model_version=last.model_version,
-                request_id=last.request_id,
-                outputs={name: np.concatenate(
-                    [r.outputs[name] for r in resps], axis=0)
-                    for name in last.outputs},
-                parameters=last.parameters,
-                final=False,
-                times=last.times,
-            )
-            return encode(("resp", req, merged))
-
-        def mergeable(req, resp) -> bool:
-            return (resp.error is None and not resp.final
-                    and bool(req.parameters.get("response_coalesce"))
-                    and all(getattr(a, "ndim", 0) >= 1
-                            for a in resp.outputs.values()))
-
-        def run_compatible(prev, resp) -> bool:
-            """Responses merge only when every output concatenates cleanly:
-            same names, dtypes, and trailing dims (axis 0 is the merge
-            axis) — a shape drift must start a new message, not blow up
-            np.concatenate mid-encode."""
-            if set(prev.outputs) != set(resp.outputs):
-                return False
-            return all(prev.outputs[n].dtype == a.dtype
-                       and prev.outputs[n].shape[1:] == a.shape[1:]
-                       for n, a in resp.outputs.items())
-
         # Writer: drain everything already queued, coalesce per request,
         # encode, yield.  Per-message protobuf+HTTP/2 cost is the networked
         # stream's dominant tax (VERDICT r4 weak #3): at 10k tok/s the
@@ -615,7 +578,7 @@ class _Servicer(GRPCInferenceServiceServicer):
             for item in plan:
                 try:
                     if item[0] == "resp":
-                        msg = encode_group(item[1], item[2])
+                        msg = encode(("resp", item[1], merge(item[2])))
                     else:
                         msg = encode(item)
                 except Exception as exc:  # noqa: BLE001 — encode failure

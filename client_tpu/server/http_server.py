@@ -21,6 +21,7 @@ import logging
 import os
 import re
 import threading
+import time
 import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -371,13 +372,46 @@ class _Handler(BaseHTTPRequestHandler):
                 req.cancel()
 
         self.engine.async_infer(req, enqueue)
+        # Same coalescing contract as the gRPC stream writer (an SSE event
+        # also pays per-message framing): with `response_coalesce` set,
+        # rows already backlogged behind a slow chunk write merge into one
+        # [k]-row event; off backlog every response ships alone.
+        from client_tpu.server.coalesce import (
+            merge,
+            mergeable,
+            run_compatible,
+        )
+
+        delay_s = float(os.environ.get(
+            "CLIENT_TPU_STREAM_WRITER_DELAY_MS", "0")) / 1e3
         while True:
             try:
                 resp = out_q.get(timeout=self.GENERATE_STALL_TIMEOUT_S)
             except q.Empty:
                 req.cancel()
                 raise EngineError("generation stalled", 504) from None
+            run = [resp]
+            # 512-row cap mirrors the gRPC writer's COALESCE_MAX: bounds
+            # one event's concat memory and chunk size even when the
+            # pending limit is raised via env.
+            while len(run) < 512 and mergeable(req, run[-1]):
+                try:
+                    nxt = out_q.get_nowait()
+                except q.Empty:
+                    break
+                if (mergeable(req, nxt)
+                        and run_compatible(run[-1], nxt)):
+                    run.append(nxt)
+                    continue
+                # non-mergeable tail (final/error/shape drift): flush the
+                # run, then the tail
+                yield merge(run)
+                run = [nxt]
+                break
+            resp = merge(run) if len(run) > 1 else run[-1]
             yield resp
+            if delay_s:
+                time.sleep(delay_s)
             if resp.error is not None or resp.final:
                 return
 

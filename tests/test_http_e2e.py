@@ -357,6 +357,52 @@ class TestGenerateEndpoints:
             collected.append(outs["TOKEN"][0])
         assert collected == tokens
 
+    def test_generate_stream_coalesced(self, gen_server, monkeypatch):
+        """`response_coalesce` + a throttled writer: backlogged tokens
+        arrive as [k]-row SSE events; the flattened sequence matches the
+        uncoalesced stream."""
+        import http.client as hc
+        import json as j
+
+        host, port = gen_server.url.split(":")
+        n = 16
+        conn = hc.HTTPConnection(host, int(port), timeout=120)
+        conn.request("POST", "/v2/models/tiny_gpt/generate_stream",
+                     body=self._body([4, 5, 6], n))
+        plain = []
+        raw = conn.getresponse().read().decode()
+        conn.close()
+        for ev in raw.split("\n\n"):
+            if ev.startswith("data: "):
+                d = j.loads(ev[len("data: "):])
+                outs = {o["name"]: o["data"] for o in d["outputs"]}
+                plain.extend(outs["TOKEN"])
+
+        monkeypatch.setenv("CLIENT_TPU_STREAM_WRITER_DELAY_MS", "40")
+        body = j.dumps({
+            "inputs": [{"name": "INPUT_IDS", "datatype": "INT32",
+                        "shape": [3], "data": [4, 5, 6]}],
+            "parameters": {"max_tokens": n, "response_coalesce": True},
+        }).encode()
+        conn = hc.HTTPConnection(host, int(port), timeout=120)
+        conn.request("POST", "/v2/models/tiny_gpt/generate_stream",
+                     body=body)
+        raw = conn.getresponse().read().decode()
+        conn.close()
+        tokens, idxs, widths = [], [], []
+        for ev in raw.split("\n\n"):
+            if ev.startswith("data: "):
+                d = j.loads(ev[len("data: "):])
+                outs = {o["name"]: o["data"] for o in d["outputs"]}
+                assert len(outs["TOKEN"]) == len(outs["INDEX"])
+                widths.append(len(outs["TOKEN"]))
+                tokens.extend(outs["TOKEN"])
+                idxs.extend(outs["INDEX"])
+        assert tokens == plain
+        assert idxs == list(range(n))
+        assert max(widths) > 1  # the throttled writer actually merged
+        assert len(widths) < n
+
     def test_generate_works_for_single_response_models(self, gen_server):
         import http.client as hc
         import json as j
