@@ -982,7 +982,8 @@ def main():
     _run_with_watchdog(_main)
 
 
-def _run_with_watchdog(target):
+def _run_with_watchdog(target, metric: str = "inproc_simple_ips",
+                       unit: str = "infer/sec"):
     # Watchdog: the dev tunnel can go DOWN mid-run, hanging device calls
     # indefinitely (observed round 4: jax.devices() blocked for >30 min).
     # Device waits release the GIL, so a timer thread can still emit the
@@ -998,8 +999,8 @@ def _run_with_watchdog(target):
         log(f"WATCHDOG: bench exceeded {deadline_s:.0f}s (device hang?); "
             "emitting partial results")
         partial = dict(_RESULT)
-        partial.setdefault("metric", "inproc_simple_ips")
-        partial.setdefault("unit", "infer/sec")
+        partial.setdefault("metric", metric)
+        partial.setdefault("unit", unit)
         # A hang before the first section completes leaves _RESULT empty;
         # the driver schema still needs a numeric value field.
         partial.setdefault("value", 0.0)
@@ -1234,6 +1235,7 @@ if __name__ == "__main__":
              else 5)
         trace = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "artifacts", "mfu_trace")
-        _run_with_watchdog(lambda: mfu_study(n, trace_dir=trace))
+        _run_with_watchdog(lambda: mfu_study(n, trace_dir=trace),
+                           metric="bert_b8_mfu_study", unit="ms")
     else:
         main()

@@ -20,6 +20,10 @@ import numpy as np
 
 from client_tpu.engine.types import InferRequest, InferResponse
 
+# Per-flush merge bound shared by every stream writer: caps one message's
+# concat memory and wire size even when the pending limit is raised.
+COALESCE_MAX = 512
+
 
 def mergeable(req: InferRequest, resp: InferResponse) -> bool:
     """May this response join a coalesce run at all?"""

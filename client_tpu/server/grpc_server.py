@@ -33,7 +33,12 @@ from client_tpu.protocol.grpc_stub import (
 )
 from client_tpu.protocol.model_config import config_dict_to_proto
 from client_tpu.server.classification import classify_output
-from client_tpu.server.coalesce import merge, mergeable, run_compatible
+from client_tpu.server.coalesce import (
+    COALESCE_MAX,
+    merge,
+    mergeable,
+    run_compatible,
+)
 
 import logging
 
@@ -528,7 +533,6 @@ class _Servicer(GRPCInferenceServiceServicer):
         # queued, so throughput rises exactly when it is needed.  Only
         # per-request ordering is contractual on a multi-request stream, and
         # merging preserves it (the queue is FIFO per request).
-        COALESCE_MAX = 512  # items per drain: bounds message size + memory
         # Test knob: per-message writer delay forces a backlog so the merge
         # path is exercisable deterministically (tests/test_generative.py).
         delay_s = float(os.environ.get(

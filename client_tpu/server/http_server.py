@@ -377,6 +377,7 @@ class _Handler(BaseHTTPRequestHandler):
         # rows already backlogged behind a slow chunk write merge into one
         # [k]-row event; off backlog every response ships alone.
         from client_tpu.server.coalesce import (
+            COALESCE_MAX,
             merge,
             mergeable,
             run_compatible,
@@ -391,10 +392,7 @@ class _Handler(BaseHTTPRequestHandler):
                 req.cancel()
                 raise EngineError("generation stalled", 504) from None
             run = [resp]
-            # 512-row cap mirrors the gRPC writer's COALESCE_MAX: bounds
-            # one event's concat memory and chunk size even when the
-            # pending limit is raised via env.
-            while len(run) < 512 and mergeable(req, run[-1]):
+            while len(run) < COALESCE_MAX and mergeable(req, run[-1]):
                 try:
                     nxt = out_q.get_nowait()
                 except q.Empty:

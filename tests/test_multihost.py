@@ -118,6 +118,44 @@ try:
 finally:
     engine.shutdown()
 print(f"proc {pid}: served inference OK", flush=True)
+
+# -- expert-parallel generative decode ACROSS the processes -------------
+# Experts split over the two hosts: every decode wave's dispatch/combine
+# all-to-all crosses DCN. One stream, fixed budget, no sampling: the
+# dispatch sequence (1 prefill + N waves, bucket 1) is deterministic, so
+# both processes issue identical jit executions in lockstep — the SPMD
+# requirement — while each engine's scheduler runs on its own host.
+import threading
+
+from client_tpu.parallel.serving import MoeGptBackend
+
+gen_mesh = multihost.global_mesh(axes=("ep", "tp"), shape={"ep": 2})
+assert gen_mesh.shape["ep"] == 2
+gbackend = MoeGptBackend(gen_mesh, name="moe_gpt_mh", n_layers=2,
+                         d_model=64, n_heads=4, d_ff=128, vocab=256,
+                         max_seq_len=32, max_streams=1)
+grepo = ModelRepository()
+grepo.register_backend(gbackend)
+gengine = TpuEngine(grepo)
+try:
+    tokens, done = [], threading.Event()
+
+    def gcb(resp):
+        if resp.error is not None or resp.final:
+            done.set()
+        else:
+            tokens.append(int(resp.outputs["TOKEN"][0]))
+
+    gengine.async_infer(InferRequest(
+        model_name="moe_gpt_mh",
+        inputs={"INPUT_IDS": np.asarray([1, 2, 3], np.int32)},
+        parameters={"max_tokens": 4}), gcb)
+    assert done.wait(300), "cross-host generation stalled"
+    assert len(tokens) == 4, tokens
+finally:
+    gengine.shutdown()
+print(f"proc {pid}: cross-host expert decode OK tokens={tokens}",
+      flush=True)
 print(f"proc {pid}: ALL OK", flush=True)
 """
 
@@ -158,3 +196,10 @@ def test_two_process_cluster_mesh_train_and_serve(tmp_path):
         assert f"proc {pid}: cross-host pipeline step OK" in out
         assert f"proc {pid}: cross-host MoE step OK" in out
         assert f"proc {pid}: served inference OK" in out
+        assert f"proc {pid}: cross-host expert decode OK" in out
+    # both hosts decoded the same token stream (SPMD lockstep)
+    tok_lines = [next(ln for ln in out.splitlines()
+                      if "cross-host expert decode OK" in ln)
+                 for out in outs]
+    assert tok_lines[0].split("tokens=")[1] == \
+        tok_lines[1].split("tokens=")[1]
