@@ -187,6 +187,20 @@ class GenerativeScheduler(Scheduler):
                                 static_argnums=(9,))
         self._decode = jax.jit(backend.decode_fn(), donate_argnums=(1,),
                                static_argnums=(8,))
+        # Chunked decode (CLIENT_TPU_GEN_CHUNK > 1): K waves fused into one
+        # scanned execution — one dispatch advances every stream K tokens,
+        # dividing per-wave Python + transport-command overhead by K.
+        # Token emission still happens per wave at fetch time; streams that
+        # stop/retire mid-chunk have their surplus lanes discarded exactly
+        # like any retired lane.  Admits join at chunk boundaries (<= K-1
+        # waves of extra TTFT, ~K*step_ms).
+        self._chunk = max(1, int(os.environ.get("CLIENT_TPU_GEN_CHUNK",
+                                                "1")))
+        self._decode_chunk = None
+        if self._chunk > 1:
+            self._decode_chunk = jax.jit(
+                backend.decode_chunk_fn(), donate_argnums=(1,),
+                static_argnums=(8, 9))
         self._prompt_buckets = power_buckets(self._max_seq)
         self._wave_buckets = power_buckets(self._cap)
         # ONE admit lane bucket: every prefill chunk pads to this, so there
@@ -242,6 +256,15 @@ class GenerativeScheduler(Scheduler):
                 np.zeros(wb, np.int32), np.zeros(wb, np.int32),
                 np.zeros(wb, np.float32), np.zeros(wb, np.int32),
                 np.ones(wb, np.float32), False)
+            if self._decode_chunk is not None:
+                self.model._set_state(
+                    f"warmup: chunked decode bucket={wb} k={self._chunk}")
+                self._arena, tokens = self._decode_chunk(
+                    self.model._params, self._arena, rows,
+                    np.zeros(wb, np.int32), np.zeros(wb, np.int32),
+                    np.zeros(wb, np.float32), np.zeros(wb, np.int32),
+                    np.ones(wb, np.float32), False, self._chunk)
+                tokens = tokens[-1]
         self._jax.block_until_ready(tokens)
         self.model._clear_state()
 
@@ -452,20 +475,39 @@ class GenerativeScheduler(Scheduler):
         top_ks = np.asarray([s.top_k for s in live] + [0] * pad, np.int32)
         top_ps = np.asarray([s.top_p for s in live] + [1.0] * pad,
                             np.float32)
+        # Chunk only when every live lane has K steps of sequence headroom:
+        # a scanned step past max_seq would CLIP its k/v scatter onto the
+        # last position (jax .at[] semantics) and corrupt it.  Budget
+        # overshoot is safe (surplus tokens discard at fetch) but wasteful,
+        # so chunking also waits until every lane wants >= K more tokens.
+        k = self._chunk
+        if k > 1 and not all(
+                s.disp_len + k < self._max_seq
+                and s.max_new - s.disp_tokens >= k for s in live):
+            k = 1
         self.model._set_state(
-            f"generative decode wave ({len(live)} streams, bucket={bucket})")
+            f"generative decode wave ({len(live)} streams, bucket={bucket}"
+            + (f", chunk={k}" if k > 1 else "") + ")")
         try:
-            self._arena, nxt = self._decode(
-                self.model._params, self._arena, rows, lens,
-                seeds, temps, top_ks, top_ps, bool((temps > 0.0).any()))
+            sample = bool((temps > 0.0).any())
+            if k > 1:
+                self._arena, nxt = self._decode_chunk(
+                    self.model._params, self._arena, rows, lens,
+                    seeds, temps, top_ks, top_ps, sample, k)
+            else:
+                self._arena, nxt = self._decode(
+                    self.model._params, self._arena, rows, lens,
+                    seeds, temps, top_ks, top_ps, sample)
             nxt.copy_to_host_async()
         finally:
             self.model._clear_state()
         for s in live:
-            s.disp_len += 1
-            s.disp_tokens += 1
-        self.stats.record_execution(len(live))
-        self._inflight.append(_Inflight("wave", live, nxt))
+            s.disp_len += k
+            s.disp_tokens += k
+        for _ in range(k):  # one logical wave per scanned step
+            self.stats.record_execution(len(live))
+        self._inflight.append(_Inflight("chunk" if k > 1 else "wave",
+                                        live, nxt))
 
     def _drain_fetches(self, force_one: bool = False) -> None:
         """Consume completed token fetches in dispatch order; emission,
@@ -483,19 +525,27 @@ class GenerativeScheduler(Scheduler):
             except Exception as exc:  # noqa: BLE001 — execution failed
                 self._reset_arena(exc)
                 return
-            for i, s in enumerate(head.streams):
-                if s.dead:
-                    continue  # retired/cancelled lanes: discard junk
-                tok = int(toks[i])
-                if head.kind == "wave":
-                    s.f_len += 1
-                if tok in s.stop:
-                    # Stop tokens terminate without being emitted.
-                    self._retire(s)
-                    continue
-                self._emit_token(s, tok)
-                if s.emitted >= s.max_new or s.f_len + 1 >= self._max_seq:
-                    self._retire(s)
+            # A chunked fetch is K stacked waves [K, B]; emit them in wave
+            # order so stop/budget retirement lands mid-chunk exactly
+            # where a per-wave dispatch would have retired (surplus lanes
+            # past a retirement are junk and are discarded like any dead
+            # lane).
+            waves = toks if head.kind == "chunk" else toks[None]
+            for kk in range(waves.shape[0]):
+                for i, s in enumerate(head.streams):
+                    if s.dead:
+                        continue  # retired/cancelled lanes: discard junk
+                    tok = int(waves[kk, i])
+                    if head.kind != "prefill":
+                        s.f_len += 1
+                    if tok in s.stop:
+                        # Stop tokens terminate without being emitted.
+                        self._retire(s)
+                        continue
+                    self._emit_token(s, tok)
+                    if (s.emitted >= s.max_new
+                            or s.f_len + 1 >= self._max_seq):
+                        self._retire(s)
 
     # -- stream lifecycle ------------------------------------------------------
 

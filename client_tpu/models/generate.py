@@ -270,6 +270,38 @@ class TinyGptBackend(ModelBackend):
 
         return prefill
 
+    def decode_chunk_fn(self):
+        """(params, arena, rows[B], lens[B], seeds[B], temps[B], top_ks[B],
+        top_ps[B], sample, k) -> (arena, tokens[k, B]).
+
+        K decode waves in ONE device execution via ``lax.scan`` over the
+        single-wave body: each scanned step gathers its inputs from the
+        arena token slots the previous step wrote, so the whole chunk
+        chains on device.  One dispatch (and one transport command round)
+        then advances every live stream K tokens — on a high-latency
+        transport this divides the scheduler's dispatch-side overhead by
+        K.  ``k`` is static (one executable per (wave bucket, K)); the
+        per-step math is the decode_fn body unchanged, so sampling's
+        fold_in(seed, ctx_len) sequence is identical to K separate waves.
+        """
+        import jax
+
+        decode = self.decode_fn()
+
+        def decode_chunk(p, arena, rows, lens, seeds, temps, top_ks,
+                         top_ps, sample=True, k=2):
+            def body(carry, _):
+                arena_c, lens_c = carry
+                arena_c, nxt = decode(p, arena_c, rows, lens_c, seeds,
+                                      temps, top_ks, top_ps, sample)
+                return (arena_c, lens_c + 1), nxt
+
+            (arena, _), toks = jax.lax.scan(body, (arena, lens), None,
+                                            length=k)
+            return arena, toks  # [k, B]
+
+        return decode_chunk
+
     def decode_fn(self):
         """(params, arena, rows[B], lens[B], seeds[B], temps[B],
         top_ks[B], top_ps[B]) -> (arena, next[B]).

@@ -405,36 +405,23 @@ class MoeGptBackend(TinyGptBackend):
         self.weights_path = weights_path
 
     def _init_params(self):
+        """Base init with each layer's dense FFN pair swapped for the
+        routed expert stacks (same scale conventions: 1/sqrt(fan_in))."""
         import math as _math
 
-        rng = np.random.default_rng(self._seed)
-        d, f, v, E = self.d_model, self.d_ff, self.vocab, self.n_experts
+        params = super()._init_params()
+        rng = np.random.default_rng(self._seed + 1)
+        d, f, E = self.d_model, self.d_ff, self.n_experts
 
         def w(*shape, scale):
             return (rng.standard_normal(shape) * scale).astype(np.float32)
 
-        s_d, s_f = 1.0 / _math.sqrt(d), 1.0 / _math.sqrt(f)
-        layers = []
-        for _ in range(self.n_layers):
-            layers.append({
-                "ln1g": np.ones(d, np.float32),
-                "ln1b": np.zeros(d, np.float32),
-                "wq": w(d, d, scale=s_d), "wk": w(d, d, scale=s_d),
-                "wv": w(d, d, scale=s_d), "wo": w(d, d, scale=s_d),
-                "ln2g": np.ones(d, np.float32),
-                "ln2b": np.zeros(d, np.float32),
-                "router": w(d, E, scale=0.02),
-                "w1e": w(E, d, f, scale=s_d),
-                "w2e": w(E, f, d, scale=s_f),
-            })
-        return {
-            "embed": w(v, d, scale=0.02),
-            "pos": w(self.max_seq_len, d, scale=0.02),
-            "layers": layers,
-            "lnfg": np.ones(d, np.float32),
-            "lnfb": np.zeros(d, np.float32),
-            "head": w(d, v, scale=s_d),
-        }
+        for lp in params["layers"]:
+            del lp["w1"], lp["w2"]
+            lp["router"] = w(d, E, scale=0.02)
+            lp["w1e"] = w(E, d, f, scale=1.0 / _math.sqrt(d))
+            lp["w2e"] = w(E, f, d, scale=1.0 / _math.sqrt(f))
+        return params
 
     def _ffn(self, lp, h):
         """Dropless top-1 Switch FFN on [T, d] rows (both prefill's

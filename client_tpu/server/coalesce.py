@@ -43,6 +43,31 @@ def run_compatible(prev: InferResponse, resp: InferResponse) -> bool:
                for n, a in resp.outputs.items())
 
 
+def drain_run(first: InferResponse, get_nowait, req: InferRequest,
+              cap: int = COALESCE_MAX):
+    """Single-request run builder (the SSE writer's shape: one stream, one
+    request): starting at ``first``, pull already-queued responses while
+    they merge cleanly.  ``get_nowait()`` returns the next queued response
+    or None when the queue is empty.  Returns ``(merged, leftover)`` where
+    ``leftover`` is the first non-merging response pulled (caller emits it
+    after ``merged``) or None.
+
+    The gRPC stream writer keeps its own run builder: it interleaves many
+    requests per RPC and must also thread error items and backlog
+    accounting through the drain — the multi-request variant lives there.
+    """
+    run = [first]
+    while len(run) < cap and mergeable(req, run[-1]):
+        nxt = get_nowait()
+        if nxt is None:
+            break
+        if mergeable(req, nxt) and run_compatible(run[-1], nxt):
+            run.append(nxt)
+            continue
+        return merge(run), nxt
+    return merge(run), None
+
+
 def merge(resps: list[InferResponse]) -> InferResponse:
     """One response for a run: every output concatenated along axis 0."""
     if len(resps) == 1:

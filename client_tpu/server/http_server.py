@@ -376,12 +376,13 @@ class _Handler(BaseHTTPRequestHandler):
         # also pays per-message framing): with `response_coalesce` set,
         # rows already backlogged behind a slow chunk write merge into one
         # [k]-row event; off backlog every response ships alone.
-        from client_tpu.server.coalesce import (
-            COALESCE_MAX,
-            merge,
-            mergeable,
-            run_compatible,
-        )
+        from client_tpu.server.coalesce import drain_run
+
+        def get_nowait():
+            try:
+                return out_q.get_nowait()
+            except q.Empty:
+                return None
 
         delay_s = float(os.environ.get(
             "CLIENT_TPU_STREAM_WRITER_DELAY_MS", "0")) / 1e3
@@ -391,27 +392,14 @@ class _Handler(BaseHTTPRequestHandler):
             except q.Empty:
                 req.cancel()
                 raise EngineError("generation stalled", 504) from None
-            run = [resp]
-            while len(run) < COALESCE_MAX and mergeable(req, run[-1]):
-                try:
-                    nxt = out_q.get_nowait()
-                except q.Empty:
-                    break
-                if (mergeable(req, nxt)
-                        and run_compatible(run[-1], nxt)):
-                    run.append(nxt)
-                    continue
-                # non-mergeable tail (final/error/shape drift): flush the
-                # run, then the tail
-                yield merge(run)
-                run = [nxt]
-                break
-            resp = merge(run) if len(run) > 1 else run[-1]
-            yield resp
-            if delay_s:
-                time.sleep(delay_s)
-            if resp.error is not None or resp.final:
-                return
+            merged, leftover = drain_run(resp, get_nowait, req)
+            for resp in ((merged,) if leftover is None
+                         else (merged, leftover)):
+                yield resp
+                if delay_s:
+                    time.sleep(delay_s)
+                if resp.error is not None or resp.final:
+                    return
 
     def _json_response_dict(self, resp) -> dict:
         """v2 response head with all tensors as JSON data (no binary tails

@@ -754,3 +754,43 @@ class TestPerRequestShedding:
             assert any("cancel" in e for e in errors), errors
         finally:
             eng.shutdown()
+
+
+class TestChunkedDecode:
+    """CLIENT_TPU_GEN_CHUNK > 1 fuses K decode waves into one scanned
+    dispatch; it must be invisible — token streams identical to per-wave
+    decode, under greedy, sampling, and mid-chunk stop tokens."""
+
+    @pytest.fixture()
+    def chunk_engine(self, monkeypatch):
+        monkeypatch.setenv("CLIENT_TPU_GEN_CHUNK", "4")
+        eng = TpuEngine(build_repository(["tiny_gpt"]))
+        yield eng
+        eng.shutdown()
+
+    def test_greedy_identical(self, engine, chunk_engine):
+        # n=13: prefill + exactly three 4-chunks; n=4: remaining budget
+        # < K so the scheduler falls back to single waves; n=32: long run
+        for prompt, n in (([7, 8, 9], 13), ([1], 4), ([2, 3], 32)):
+            assert generate(chunk_engine, prompt, n) == \
+                generate(engine, prompt, n)
+
+    def test_sampling_identical(self, engine, chunk_engine):
+        kw = {"temperature": 0.9, "seed": 1234, "top_k": 24, "top_p": 0.9}
+        want = generate(engine, [5, 9], 17, **kw)
+        got = generate(chunk_engine, [5, 9], 17, **kw)
+        assert got == want
+
+    def test_stop_token_mid_chunk(self, engine, chunk_engine):
+        free = generate(engine, [11, 12], 16)
+        stop = free[5]  # lands inside a 4-chunk, not on its boundary
+        want = generate(engine, [11, 12], 16, stop_token_ids=stop)
+        got = generate(chunk_engine, [11, 12], 16, stop_token_ids=stop)
+        assert got == want
+        assert len(got) <= 16
+
+    def test_batch_invariance_chunked(self, chunk_engine):
+        prompts = [[3 + i, 50 + i] for i in range(8)]
+        solo = [generate(chunk_engine, p, 12) for p in prompts]
+        joins = [generate_async(chunk_engine, p, 12) for p in prompts]
+        assert [j() for j in joins] == solo
