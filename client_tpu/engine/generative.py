@@ -90,12 +90,13 @@ class _Stream:
 class _Inflight:
     """One dispatched execution whose token fetch is pending."""
 
-    __slots__ = ("kind", "streams", "tokens")
+    __slots__ = ("kind", "streams", "tokens", "waves")
 
-    def __init__(self, kind, streams, tokens):
-        self.kind = kind          # 'prefill' | 'wave'
+    def __init__(self, kind, streams, tokens, waves=1):
+        self.kind = kind          # 'prefill' | 'wave' | 'chunk'
         self.streams = streams    # lane order, real lanes only
         self.tokens = tokens      # jax.Array future (copy_to_host_async'd)
+        self.waves = waves        # logical waves this dispatch advances
 
 
 class _WarmupReq:
@@ -217,6 +218,10 @@ class GenerativeScheduler(Scheduler):
             "CLIENT_TPU_GEN_PIPELINE", "32")))
         self._streams: list[_Stream] = []
         self._inflight: collections.deque[_Inflight] = collections.deque()
+        # Depth accounting is in WAVES, not dispatches: a K-chunk counts K,
+        # so CLIENT_TPU_GEN_PIPELINE bounds the same amount of dispatched-
+        # ahead device work (and cancellation junk) in either mode.
+        self._inflight_waves = 0
         self._free = list(range(self._cap))
         super().__init__(model, stats)
 
@@ -460,6 +465,7 @@ class GenerativeScheduler(Scheduler):
         # fetch, and everything discarded by an arena reset.
         self.stats.record_execution(n)
         self._inflight.append(_Inflight("prefill", streams, tokens))
+        self._inflight_waves += 1
 
     def _dispatch_wave(self, live: list) -> None:
         """Dispatch one decode wave; input tokens come from the arena's
@@ -504,10 +510,13 @@ class GenerativeScheduler(Scheduler):
         for s in live:
             s.disp_len += k
             s.disp_tokens += k
-        for _ in range(k):  # one logical wave per scanned step
-            self.stats.record_execution(len(live))
+        # One device dispatch = one execution in the public stats, chunked
+        # or not — execution_count means device executions, and fewer
+        # executions per token IS the chunking win the stat should show.
+        self.stats.record_execution(len(live))
         self._inflight.append(_Inflight("chunk" if k > 1 else "wave",
-                                        live, nxt))
+                                        live, nxt, waves=k))
+        self._inflight_waves += k
 
     def _drain_fetches(self, force_one: bool = False) -> None:
         """Consume completed token fetches in dispatch order; emission,
@@ -515,11 +524,12 @@ class GenerativeScheduler(Scheduler):
         dispatch)."""
         while self._inflight:
             head = self._inflight[0]
-            if not (force_one or len(self._inflight) > self._depth
+            if not (force_one or self._inflight_waves > self._depth
                     or head.tokens.is_ready()):
                 return
             force_one = False
             self._inflight.popleft()
+            self._inflight_waves -= head.waves
             try:
                 toks = np.asarray(head.tokens)
             except Exception as exc:  # noqa: BLE001 — execution failed
@@ -606,6 +616,7 @@ class GenerativeScheduler(Scheduler):
             self._fail(s.req, EngineError(why, 503))
         self._streams.clear()
         self._inflight.clear()
+        self._inflight_waves = 0
         self._free = list(range(self._cap))
         self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)  # other sentinels may wait
 
@@ -625,5 +636,6 @@ class GenerativeScheduler(Scheduler):
                 f"generation aborted: {exc}", 500))
         self._streams.clear()
         self._inflight.clear()
+        self._inflight_waves = 0
         self._free = list(range(self._cap))
         self._arena = self.model.backend.init_arena(self._cap)
