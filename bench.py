@@ -609,31 +609,41 @@ def bench_sequence_oldest(n_seq: int = 128, duration_s: float = 3.0):
     return rate
 
 
-def bench_generative(n_streams: int = 64, tokens: int = 32):
-    """Continuous-batching generation (tiny_gpt) measured at BOTH decode
-    dispatch modes — per-wave (chunk 1) and scanned 4-wave chunks — in one
-    probe, so the chunking A/B is self-documenting (a dispatch-mode change
-    can never masquerade as a perf delta).  The headline ``gen`` result is
-    the better mode, labeled.  Reports tok/s plus TTFT and inter-token
-    latency percentiles, the streaming vocabulary the reference's profiler
-    lacks (VERDICT r2 #4; schema extends
-    /root/reference/src/c++/perf_analyzer/inference_profiler.h:71-118)."""
-    out = {}
+import contextlib
+
+
+@contextlib.contextmanager
+def _gen_chunk_env(k: int):
+    """Scope CLIENT_TPU_GEN_CHUNK around an engine build (the scheduler
+    reads it at construction)."""
     saved = os.environ.get("CLIENT_TPU_GEN_CHUNK")
+    os.environ["CLIENT_TPU_GEN_CHUNK"] = str(k)
     try:
-        for chunk in (1, 4):
-            os.environ["CLIENT_TPU_GEN_CHUNK"] = str(chunk)
-            res = _bench_generative_once(n_streams, tokens)
-            res["chunk"] = chunk
-            out[f"chunk{chunk}"] = res
+        yield
     finally:
         if saved is None:
             os.environ.pop("CLIENT_TPU_GEN_CHUNK", None)
         else:
             os.environ["CLIENT_TPU_GEN_CHUNK"] = saved
-    # Headline = the chunked (production-posture) mode, FIXED — not
-    # max-of-modes (best-of headlines were formally retired, BASELINE.md
-    # round-4 footnote).  Both modes ride along labeled.
+
+
+def bench_generative(n_streams: int = 64, tokens: int = 32):
+    """Continuous-batching generation (tiny_gpt) measured at BOTH decode
+    dispatch modes — per-wave (chunk 1) and scanned 4-wave chunks — in one
+    probe, so the chunking A/B is self-documenting (a dispatch-mode change
+    can never masquerade as a perf delta).  The headline ``gen`` result is
+    the FIXED chunked (production-posture) mode, labeled — not
+    max-of-modes (best-of headlines were formally retired, BASELINE.md
+    round-4 footnote).  Reports tok/s plus TTFT and inter-token latency
+    percentiles, the streaming vocabulary the reference's profiler lacks
+    (VERDICT r2 #4; schema extends
+    /root/reference/src/c++/perf_analyzer/inference_profiler.h:71-118)."""
+    out = {}
+    for chunk in (1, 4):
+        with _gen_chunk_env(chunk):
+            res = _bench_generative_once(n_streams, tokens)
+        res["chunk"] = chunk
+        out[f"chunk{chunk}"] = res
     return {**out["chunk4"], **out}
 
 
@@ -748,9 +758,12 @@ def bench_gen_net(n_streams: int = 64, tokens: int = 32):
     from client_tpu.models import build_repository
     from client_tpu.server.grpc_server import GrpcInferenceServer
 
-    engine = TpuEngine(build_repository(["tiny_gpt"]), warmup=True)
+    # Served engine runs the chunked production posture (matches the
+    # in-process probe's headline mode; labeled in the result).
+    with _gen_chunk_env(4):
+        engine = TpuEngine(build_repository(["tiny_gpt"]), warmup=True)
     srv = GrpcInferenceServer(engine, port=0).start()
-    out: dict = {}
+    out: dict = {"chunk": 4}
     try:
         for label, extra in (("coalesced", []),
                              ("per_token", ["--generative-no-coalesce"])):

@@ -497,6 +497,12 @@ class _Servicer(GRPCInferenceServiceServicer):
                         with lock:
                             inflight[0] -= 1
                             live_reqs.pop(id(req), None)
+            except grpc.RpcError:
+                # Client cancelled / stream torn down while the reader was
+                # blocked in the request iterator: a normal end of the
+                # request side (the termination callback cancels in-flight
+                # work) — not a reader-thread crash.
+                pass
             finally:
                 done_reading.set()
                 out_q.put(None)  # wake the writer to re-check state
