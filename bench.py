@@ -20,6 +20,7 @@ full per-window series is emitted so the spread is auditable.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sys
@@ -607,9 +608,6 @@ def bench_sequence_oldest(n_seq: int = 128, duration_s: float = 3.0):
         f"{elapsed:.2f}s (post-warmup window) = {rate:.0f} steps/s, "
         f"avg wave {steps / waves:.1f}")
     return rate
-
-
-import contextlib
 
 
 @contextlib.contextmanager

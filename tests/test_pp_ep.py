@@ -322,6 +322,24 @@ class TestMoeGptDecode:
         finally:
             engine.shutdown()
 
+    def test_chunked_decode_identical(self, monkeypatch):
+        """CLIENT_TPU_GEN_CHUNK with the MoE family: lax.scan over the
+        expert-routed decode body (dispatch/combine einsums inside the
+        scan) must stream the same tokens as per-wave decode."""
+        monkeypatch.delenv("CLIENT_TPU_GEN_CHUNK", raising=False)
+        engine, _ = self._engine()
+        try:
+            want = self._stream(engine, "moe_gpt_mc", [8, 1, 6], 11)()
+        finally:
+            engine.shutdown()
+        monkeypatch.setenv("CLIENT_TPU_GEN_CHUNK", "4")
+        engine, _ = self._engine()
+        try:
+            got = self._stream(engine, "moe_gpt_mc", [8, 1, 6], 11)()
+        finally:
+            engine.shutdown()
+        assert got == want
+
     def test_batch_invariance(self):
         """Dropless routing: tokens generated while sharing decode waves
         (and expert queues) with other streams are bit-identical to solo
