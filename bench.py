@@ -804,7 +804,10 @@ def bench_seq_streaming(concurrencies=(16, 32, 64, 128)):
     """Sequence stepping through the harness's --streaming mode, swept over
     concurrency to find the knee (VERDICT r4 #6): per point, stable
     steps/s plus wave batching efficiency (steps/execution) from the
-    server-side statistics delta.  Reference driving loop:
+    server-side statistics delta.  Serves the OLDEST-strategy variant —
+    the arena wave batcher the in-process seq_oldest headline measures —
+    so the networked-vs-in-process comparison is one variable (the wire),
+    not two.  Reference driving loop:
     /root/reference/src/c++/perf_analyzer/main.cc:610-748."""
     import re
     import subprocess
@@ -814,21 +817,31 @@ def bench_seq_streaming(concurrencies=(16, 32, 64, 128)):
         raise RuntimeError("native tpu_perf_analyzer not built")
 
     from client_tpu.engine import TpuEngine
-    from client_tpu.models import build_repository
     from client_tpu.server.grpc_server import GrpcInferenceServer
 
-    engine = TpuEngine(build_repository(["simple_sequence"]))
+    from client_tpu.engine.repository import ModelRepository
+    from client_tpu.models.simple import SequenceAccumulateBackend
+
+    # Same arena capacity as the in-process seq_oldest headline (128), and
+    # >= the sweep's top concurrency — the registry default of 64 would
+    # 429 the upper sweep points and change two variables at once.
+    model = "simple_sequence_oldest"
+    backend = SequenceAccumulateBackend(
+        name=model, strategy="oldest",
+        max_candidate_sequences=max(max(concurrencies), 128))
+    repo = ModelRepository()
+    repo.register_backend(backend)
+    engine = TpuEngine(repo)
     srv = GrpcInferenceServer(engine, port=0).start()
     out: dict = {}
     try:
         for conc in concurrencies:
             def stats():
-                s = engine.model_statistics(
-                    "simple_sequence")["model_stats"][0]
+                s = engine.model_statistics(model)["model_stats"][0]
                 return s["inference_count"], s["execution_count"]
 
             s0, w0 = stats()
-            cmd = [pa, "-m", "simple_sequence",
+            cmd = [pa, "-m", model,
                    "-u", f"127.0.0.1:{srv.port}",
                    "--service-kind", "tpu_grpc", "--streaming",
                    "-p", "4000", "-r", "8", "-s", "70",

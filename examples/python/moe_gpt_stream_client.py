@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""Token streaming from the expert-parallel generative family
+(`moe_gpt_mc`) over the gRPC bidi stream, with response coalescing.
+
+Two framework features in one client, both invisible at the wire level
+beyond what this script shows:
+
+- the server decodes through the continuous-batching arena with a
+  Switch-MoE FFN inside every wave (experts sharded over the mesh's
+  ``ep`` axis — dropless routing, so this stream is bit-identical no
+  matter what else is co-batched);
+- ``response_coalesce`` lets a backlogged server merge several tokens
+  into one ``[k]``-shaped message — the client below handles 1- and
+  k-token messages identically by iterating the TOKEN tensor.
+
+Extends the reference's decoupled-stream contract
+(/root/reference/src/python/examples/simple_grpc_custom_repeat.py):
+``triton_final_response`` terminates the request.
+
+Serve with: python -m client_tpu.server --zoo moe_gpt_mc
+"""
+
+import argparse
+import sys
+import threading
+
+import numpy as np
+
+from client_tpu.grpc import InferenceServerClient, InferInput
+
+parser = argparse.ArgumentParser()
+parser.add_argument("-u", "--url", default="localhost:8001")
+parser.add_argument("-n", "--max-tokens", type=int, default=12)
+parser.add_argument("-v", "--verbose", action="store_true")
+args = parser.parse_args()
+
+tokens: list[int] = []
+errors: list[str] = []
+done = threading.Event()
+
+
+def callback(result, error):
+    if error is not None:
+        errors.append(str(error))
+        done.set()
+        return
+    response = result.get_response()
+    if response.outputs:
+        toks = result.as_numpy("TOKEN")
+        idx = result.as_numpy("INDEX")
+        for i, t in zip(idx, toks):
+            # report (not assert): the stream reader swallows callback
+            # exceptions, so a violation must land in errors[]
+            if int(i) != len(tokens):
+                errors.append(f"out-of-order INDEX {i} at {len(tokens)}")
+                done.set()
+                return
+            tokens.append(int(t))
+        if len(toks) > 1:
+            print(f"  (coalesced message: {len(toks)} tokens)")
+    params = response.parameters
+    if ("triton_final_response" in params
+            and params["triton_final_response"].bool_param):
+        done.set()
+
+
+client = InferenceServerClient(args.url, verbose=args.verbose)
+client.start_stream(callback)
+prompt = np.array([5, 6, 7], dtype=np.int32)
+inp = InferInput("INPUT_IDS", [len(prompt)], "INT32")
+inp.set_data_from_numpy(prompt)
+client.async_stream_infer(
+    "moe_gpt_mc", [inp], request_id="gen-1",
+    parameters={"max_tokens": args.max_tokens, "response_coalesce": True})
+if not done.wait(300):
+    sys.exit("error: stream did not finish")
+client.stop_stream()
+client.close()
+if errors:
+    sys.exit(f"error: {errors[0]}")
+if len(tokens) != args.max_tokens:
+    sys.exit(f"error: expected {args.max_tokens} tokens, got {len(tokens)}")
+print(f"streamed {len(tokens)} tokens: {tokens}")
+print("PASS: moe_gpt_stream")

@@ -125,19 +125,36 @@ def test_memory_growth(servers):
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
-def test_moe_lm_example():
-    """The expert-parallel model family through the example client — own
-    server (the shared fixture doesn't pay the mesh-model load)."""
-    eng = TpuEngine(build_repository(["moe_lm_mc"]))
-    srv = HttpInferenceServer(eng, port=0).start()
+def _run_example_own_server(model: str, script: str, grpc: bool = False):
+    """Own-server harness for mesh-model examples (the shared fixture
+    doesn't pay their load): serve `model`, run `script` against it,
+    assert returncode 0 and a PASS line."""
+    eng = TpuEngine(build_repository([model]))
+    if grpc:
+        srv = GrpcInferenceServer(eng, port=0).start()
+        url = f"127.0.0.1:{srv.port}"
+    else:
+        srv = HttpInferenceServer(eng, port=0).start()
+        url = srv.url
     try:
         env = dict(os.environ, PYTHONPATH=REPO_ROOT)
         proc = subprocess.run(
-            [sys.executable, os.path.join(EXAMPLES_DIR, "moe_lm_client.py"),
-             "-u", srv.url],
+            [sys.executable, os.path.join(EXAMPLES_DIR, script), "-u", url],
             capture_output=True, text=True, timeout=300, env=env)
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "PASS" in proc.stdout, proc.stdout
     finally:
         srv.stop()
         eng.shutdown()
+
+
+def test_moe_gpt_stream_example():
+    """Expert-parallel generative decode + coalescing through the example
+    stream client."""
+    _run_example_own_server("moe_gpt_mc", "moe_gpt_stream_client.py",
+                            grpc=True)
+
+
+def test_moe_lm_example():
+    """The expert-parallel model family through the example client."""
+    _run_example_own_server("moe_lm_mc", "moe_lm_client.py")
