@@ -84,7 +84,24 @@ class TinyGptBackend(ModelBackend):
     def __init__(self, name: str = "tiny_gpt", n_layers: int = 4,
                  d_model: int = 256, n_heads: int = 4, d_ff: int = 1024,
                  vocab: int = 512, max_seq_len: int = 128,
-                 max_streams: int = 64, seed: int = 0):
+                 max_streams: int = 64, seed: int = 0,
+                 attention_impl: str = "einsum"):
+        # "einsum": XLA-scheduled O(S^2) prefill scores — right for short
+        # prompts.  "flash": the Pallas kernel (causal) for prefill and
+        # the full-context forward — the long-context generation path
+        # (`tiny_gpt_long`: max_seq 2048); decode waves are single-query
+        # and always use the masked dense read over the KV arena.
+        if attention_impl not in ("einsum", "flash"):
+            # Silent fallback would serve the quadratic path at 2048+ —
+            # the exact cliff the option exists to avoid.
+            raise ValueError(
+                f"attention_impl must be 'einsum' or 'flash', got "
+                f"{attention_impl!r}")
+        self.attention_impl = attention_impl
+        # Flash tile caps (block_q, block_k): 512/1024 measured fastest at
+        # s=2048 on v5e (bert.py's sweep); tests shrink them to drive the
+        # multi-block grid at short sequence.
+        self.flash_blocks = (512, 1024)
         self.n_layers, self.d_model = n_layers, d_model
         self.n_heads, self.d_ff = n_heads, d_ff
         self.head_dim = d_model // n_heads
@@ -181,6 +198,30 @@ class TinyGptBackend(ModelBackend):
         h_, d_ = self.n_heads, self.head_dim
         pos = jnp.arange(n)
         mask = pos[None, :] <= pos[:, None] if causal else None
+        use_flash = self.attention_impl == "flash" and causal
+
+        def attend(q, k, v):
+            if use_flash:
+                from client_tpu.ops.flash_attention import flash_attention
+
+                def pick_block(s_len, cap):
+                    best = None
+                    for cand in range(8, min(cap, s_len) + 1, 8):
+                        if s_len % cand == 0:
+                            best = cand
+                    return best if best is not None else s_len
+
+                cap_q, cap_k = self.flash_blocks
+                return flash_attention(
+                    q[None], k[None], v[None], causal=True,
+                    block_q=pick_block(n, cap_q),
+                    block_k=pick_block(n, cap_k),
+                    interpret=jax.default_backend() != "tpu")[0]
+            s = jnp.einsum("qhd,khd->hqk", q, k) / math.sqrt(d_)
+            if mask is not None:
+                s = jnp.where(mask[None], s, -1e30)
+            return jnp.einsum("hqk,khd->qhd", jax.nn.softmax(s), v)
+
         for li, lp in enumerate(p["layers"]):
             h = _ln(x, lp["ln1g"], lp["ln1b"])
             q = (h @ lp["wq"]).reshape(n, h_, d_)
@@ -188,10 +229,7 @@ class TinyGptBackend(ModelBackend):
             v = (h @ lp["wv"]).reshape(n, h_, d_)
             if on_kv is not None:
                 on_kv(li, k, v)
-            s = jnp.einsum("qhd,khd->hqk", q, k) / math.sqrt(d_)
-            if mask is not None:
-                s = jnp.where(mask[None], s, -1e30)
-            o = jnp.einsum("hqk,khd->qhd", jax.nn.softmax(s), v)
+            o = attend(q, k, v)
             x = x + o.reshape(n, self.d_model) @ lp["wo"]
             h2 = _ln(x, lp["ln2g"], lp["ln2b"])
             x = x + self._ffn(lp, h2)
@@ -362,3 +400,9 @@ class TinyGptBackend(ModelBackend):
 
 
 register_model("tiny_gpt")(TinyGptBackend)
+# Long-context generation: seq 2048 with flash-attention prefill (the
+# O(S^2) einsum scores would dominate prompt admission at this length);
+# opt-in — a default load-all server shouldn't pay the 2048-wide arena.
+register_model("tiny_gpt_long", default=False)(
+    lambda: TinyGptBackend(name="tiny_gpt_long", max_seq_len=2048,
+                           max_streams=16, attention_impl="flash"))
