@@ -1165,6 +1165,17 @@ def _main():
     except Exception as exc:  # noqa: BLE001
         log(f"generative bench failed: {exc!r}")
         gen = None
+    # Section order = re-capture priority (VERDICT r4 #1c): the round-4
+    # rows missing artifacts come before this round's new probes, so a
+    # mid-run outage costs the least-established evidence first.
+    try:
+        _maybe_hang("device_steady")
+        steady = bench_device_steady()
+        _RESULT["device_steady"] = steady
+        _append_history({"probe": "device_steady", "device_steady": steady})
+    except Exception as exc:  # noqa: BLE001
+        log(f"device-steady bench failed: {exc!r}")
+        steady = None
     try:
         _maybe_hang("gen_net")
         gen_net = bench_gen_net()
@@ -1181,14 +1192,6 @@ def _main():
     except Exception as exc:  # noqa: BLE001
         log(f"sequence streaming sweep failed: {exc!r}")
         seq_net = None
-    try:
-        _maybe_hang("device_steady")
-        steady = bench_device_steady()
-        _RESULT["device_steady"] = steady
-        _append_history({"probe": "device_steady", "device_steady": steady})
-    except Exception as exc:  # noqa: BLE001
-        log(f"device-steady bench failed: {exc!r}")
-        steady = None
 
     # vs_baseline compares only same-platform runs — a CPU dev-box number is
     # not a baseline for the TPU chip or vice versa. Entries without a
