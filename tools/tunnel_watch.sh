@@ -1,0 +1,16 @@
+#!/bin/bash
+# Round-5 capture watcher: probe the TPU tunnel; the moment it answers,
+# run the full bench + the MFU study, logging everything. One-shot.
+cd /root/repo
+while true; do
+  if timeout 90 python -c "import jax; d = jax.devices(); assert d[0].platform != 'cpu', d" 2>/dev/null; then
+    echo "TUNNEL UP $(date -u +%FT%TZ)" >> tunnel_watch.log
+    python bench.py > bench_r5_manual.json 2> bench_stderr_r5.log
+    echo "BENCH DONE rc=$? $(date -u +%FT%TZ)" >> tunnel_watch.log
+    python bench.py --mfu-study 5 > mfu_study_r5.json 2>> bench_stderr_r5.log
+    echo "MFU DONE rc=$? $(date -u +%FT%TZ)" >> tunnel_watch.log
+    exit 0
+  fi
+  echo "down $(date -u +%FT%TZ)" >> tunnel_watch.log
+  sleep 240
+done
