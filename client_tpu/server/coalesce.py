@@ -12,6 +12,13 @@ already-ordered consecutive rows of one request), finals/errors never merge,
 and a dtype or trailing-shape drift starts a new message instead of blowing
 up the concat.  Off backlog every response still ships alone, so latency is
 unchanged; throughput rises exactly when the writer is behind.
+
+Reference anchor: the decoupled bidi-stream contract this optimizes within
+— many responses per request, ``triton_final_response`` terminating
+(/root/reference/src/c++/library/grpc_client.h:99-312, consumed by
+/root/reference/src/python/examples/simple_grpc_custom_repeat.py).  The
+reference has no counterpart optimization (its servers are opaque); the
+opt-in parameter keeps the wire behavior reference-compatible by default.
 """
 
 from __future__ import annotations
