@@ -1,0 +1,63 @@
+#!/usr/bin/env python3
+"""Summarize BENCH_HISTORY.json: per-run probe records, newest run last.
+
+The round-5 history schema appends one record per PROBE as it completes
+(plus a run-status record), grouped by ``run_ts`` — this prints each run's
+probes on one screen so BASELINE.md reconciliation is mechanical.
+
+Usage: python tools/bench_summary.py [path] [--runs N]
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    path = args[0] if args else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_HISTORY.json")
+    n_runs = 3
+    if "--runs" in sys.argv:
+        n_runs = int(sys.argv[sys.argv.index("--runs") + 1])
+    with open(path) as f:
+        hist = json.load(f)
+
+    runs: dict = {}
+    legacy = []
+    for rec in hist:
+        if not isinstance(rec, dict):
+            continue
+        ts = rec.get("run_ts")
+        if ts is None:
+            legacy.append(rec)  # pre-r5 end-of-run aggregate
+        else:
+            runs.setdefault(ts, []).append(rec)
+
+    if legacy:
+        print(f"{len(legacy)} legacy run aggregate(s) (pre-r5 schema); "
+              f"latest:")
+        last = legacy[-1]
+        print(f"  ts={time.strftime('%F %T', time.localtime(last.get('ts', 0)))}"
+              f" platform={last.get('platform')} config={last.get('config')}"
+              f" ips={last.get('value')}")
+
+    for ts in sorted(runs)[-n_runs:]:
+        recs = runs[ts]
+        first = recs[0]
+        print(f"\n== run {time.strftime('%F %T', time.localtime(ts))} "
+              f"platform={first.get('platform')} "
+              f"config={first.get('config')} ({len(recs)} records)")
+        for rec in recs:
+            probe = rec.get("probe", "?")
+            view = {k: v for k, v in rec.items()
+                    if k not in ("probe", "ts", "run_ts", "platform",
+                                 "config", "windows")}
+            print(f"  {probe}: {json.dumps(view, default=str)[:300]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
