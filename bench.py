@@ -77,6 +77,43 @@ def _append_history(entry: dict) -> None:
             pass
 
 
+_SECTION_NAMES = ("simple", "bert", "shm_ab", "shm_ab_large", "seq", "gen",
+                  "device_steady", "gen_net", "seq_streaming")
+
+
+def _sections_filter() -> set | None:
+    """Parsed BENCH_SECTIONS (None = no filter).  Unknown names are a hard
+    error: a typo must not silently spend a scarce tunnel window running
+    nothing and exiting 0."""
+    only = os.environ.get("BENCH_SECTIONS", "").strip()
+    if not only:
+        return None
+    names = {s.strip() for s in only.split(",") if s.strip()}
+    unknown = names - set(_SECTION_NAMES)
+    if unknown or not names:
+        what = (f"unknown section(s) {sorted(unknown)}" if unknown
+                else "no section names parsed")
+        raise SystemExit(f"BENCH_SECTIONS: {what}; "
+                         f"valid: {', '.join(_SECTION_NAMES)}")
+    return names
+
+
+def _sections_tag() -> str:
+    """Canonical string form of the filter for emits/history — one spelling
+    regardless of the whitespace in the raw env value."""
+    names = _sections_filter()
+    return ",".join(n for n in _SECTION_NAMES if n in names) if names else ""
+
+
+def _want(section: str) -> bool:
+    """Section filter for targeted re-captures: BENCH_SECTIONS=gen_net,seq
+    runs only the named sections (all run when unset).  Exists because the
+    dev TPU tunnel comes and goes — a short window should be spendable on
+    exactly the sections that still lack artifacts rather than a full run."""
+    names = _sections_filter()
+    return names is None or section in names
+
+
 def _maybe_hang(section: str) -> None:
     """Test knob: BENCH_SIMULATE_HANG=<section> blocks forever at that
     section's entry, standing in for a tunnel outage mid-run so the
@@ -1057,11 +1094,18 @@ def _run_with_watchdog(target, metric: str = "inproc_simple_ips",
         # persisted to BENCH_HISTORY), so the partial carries probe-level
         # detail; `status` names the failure mode.
         partial["status"] = "partial-outage"
+        # A filtered run that hangs must not read as a full-run outage:
+        # carry the filter so "sections_completed is short" has its cause.
+        sections_env = _sections_tag()
+        if sections_env:
+            partial["sections"] = sections_env
         partial["sections_completed"] = sorted(
             k for k in partial
             if k not in ("metric", "unit", "value", "partial", "status",
-                         "sections_completed"))
+                         "sections", "sections_completed"))
         _append_history({"probe": "run-status", "status": "partial-outage",
+                         **({"sections": sections_env} if sections_env
+                            else {}),
                          "sections_completed":
                              partial["sections_completed"]})
         _emit(partial)
@@ -1094,6 +1138,7 @@ def _emit(d: dict) -> None:
 
 
 def _main():
+    _sections_filter()  # validate BENCH_SECTIONS before spending backend init
     devices = preflight()
     platform = devices[0].platform
     config = f"mb{BENCH_MAX_BATCH}-c{BENCH_CONCURRENCY}-i{BENCH_INSTANCES}"
@@ -1101,97 +1146,105 @@ def _main():
     # filtering works on probe records as well as run aggregates.
     _HIST_CTX.update({"platform": platform, "config": config})
 
-    _maybe_hang("simple")
-    simple = bench_inproc_simple()
-    ips, p99_us = simple["ips"], simple["p99_us"]
-    _RESULT.update({"metric": "inproc_simple_ips",
-                    "value": round(ips, 2), "unit": "infer/sec",
-                    "p99_us": round(p99_us, 1),
-                    "stable": simple["stable"],
-                    "windows": simple["windows"]})
-    _append_history({"probe": "simple", "metric": "inproc_simple_ips",
-                     "value": ips, "p99_us": p99_us,
-                     "stable": simple["stable"],
-                     "windows": simple["windows"]})
-    try:
-        _maybe_hang("bert")
-        bert_ips, mfu, bert_step_s, bert_e2e_s = bench_bert_mfu()
-        _RESULT["bert_b8_ips"] = round(bert_ips, 2)
-        _RESULT["bert_b8_step_ms"] = round(bert_step_s * 1e3, 3)
-        _RESULT["bert_b8_e2e_ms"] = round(bert_e2e_s * 1e3, 3)
-        if mfu is not None:
-            _RESULT["bert_b8_mfu"] = round(mfu, 4)
-        _append_history({"probe": "bert", "bert_ips": bert_ips, "mfu": mfu,
-                         "step_ms": bert_step_s * 1e3,
-                         "e2e_ms": bert_e2e_s * 1e3})
-    except Exception as exc:  # noqa: BLE001 — headline metric still reports
-        log(f"bert mfu measurement failed: {exc!r}")
-        bert_ips, mfu = None, None
-    try:
-        _maybe_hang("shm_ab")
-        shm_ab = bench_shm_ab()
-        _RESULT["shm_ab"] = shm_ab
-        tpushm_ips = (shm_ab.get("tpu") or {}).get("ips")
-        if tpushm_ips is not None:
-            _RESULT["tpushm_ips"] = round(tpushm_ips, 2)
-        _append_history({"probe": "shm_ab", "shm_ab": shm_ab})
-    except Exception as exc:  # noqa: BLE001
-        log(f"shm A/B bench failed: {exc!r}")
-        shm_ab = None
-    try:
-        _maybe_hang("shm_ab_large")
-        shm_ab_large = bench_shm_ab_large()
-        _RESULT["shm_ab_large"] = shm_ab_large
-        _append_history({"probe": "shm_ab_large",
-                         "shm_ab_large": shm_ab_large})
-    except Exception as exc:  # noqa: BLE001
-        log(f"large-tensor shm A/B bench failed: {exc!r}")
-        shm_ab_large = None
-    try:
-        _maybe_hang("seq")
-        seq_steps_s = bench_sequence_oldest()
-        _RESULT["seq_oldest_steps_s"] = round(seq_steps_s, 1)
-        _append_history({"probe": "seq_oldest",
-                         "seq_oldest_steps_s": seq_steps_s})
-    except Exception as exc:  # noqa: BLE001
-        log(f"sequence-oldest bench failed: {exc!r}")
-        seq_steps_s = None
-    try:
-        _maybe_hang("gen")
-        gen = bench_generative()
-        _RESULT["gen"] = gen
-        _RESULT["gen_tok_s"] = gen["tok_s"]
-        _append_history({"probe": "gen", "gen": gen})
-    except Exception as exc:  # noqa: BLE001
-        log(f"generative bench failed: {exc!r}")
-        gen = None
+    simple, ips, p99_us = None, None, None
+    bert_ips, mfu = None, None
+    seq_steps_s, gen = None, None
+    if _want("simple"):
+        _maybe_hang("simple")
+        simple = bench_inproc_simple()
+        ips, p99_us = simple["ips"], simple["p99_us"]
+        _RESULT.update({"metric": "inproc_simple_ips",
+                        "value": round(ips, 2), "unit": "infer/sec",
+                        "p99_us": round(p99_us, 1),
+                        "stable": simple["stable"],
+                        "windows": simple["windows"]})
+        _append_history({"probe": "simple", "metric": "inproc_simple_ips",
+                         "value": ips, "p99_us": p99_us,
+                         "stable": simple["stable"],
+                         "windows": simple["windows"]})
+    if _want("bert"):
+        try:
+            _maybe_hang("bert")
+            bert_ips, mfu, bert_step_s, bert_e2e_s = bench_bert_mfu()
+            _RESULT["bert_b8_ips"] = round(bert_ips, 2)
+            _RESULT["bert_b8_step_ms"] = round(bert_step_s * 1e3, 3)
+            _RESULT["bert_b8_e2e_ms"] = round(bert_e2e_s * 1e3, 3)
+            if mfu is not None:
+                _RESULT["bert_b8_mfu"] = round(mfu, 4)
+            _append_history({"probe": "bert", "bert_ips": bert_ips,
+                             "mfu": mfu,
+                             "step_ms": bert_step_s * 1e3,
+                             "e2e_ms": bert_e2e_s * 1e3})
+        except Exception as exc:  # noqa: BLE001 — headline still reports
+            log(f"bert mfu measurement failed: {exc!r}")
+            bert_ips, mfu = None, None
+    if _want("shm_ab"):
+        try:
+            _maybe_hang("shm_ab")
+            shm_ab = bench_shm_ab()
+            _RESULT["shm_ab"] = shm_ab
+            tpushm_ips = (shm_ab.get("tpu") or {}).get("ips")
+            if tpushm_ips is not None:
+                _RESULT["tpushm_ips"] = round(tpushm_ips, 2)
+            _append_history({"probe": "shm_ab", "shm_ab": shm_ab})
+        except Exception as exc:  # noqa: BLE001
+            log(f"shm A/B bench failed: {exc!r}")
+    if _want("shm_ab_large"):
+        try:
+            _maybe_hang("shm_ab_large")
+            shm_ab_large = bench_shm_ab_large()
+            _RESULT["shm_ab_large"] = shm_ab_large
+            _append_history({"probe": "shm_ab_large",
+                             "shm_ab_large": shm_ab_large})
+        except Exception as exc:  # noqa: BLE001
+            log(f"large-tensor shm A/B bench failed: {exc!r}")
+    if _want("seq"):
+        try:
+            _maybe_hang("seq")
+            seq_steps_s = bench_sequence_oldest()
+            _RESULT["seq_oldest_steps_s"] = round(seq_steps_s, 1)
+            _append_history({"probe": "seq_oldest",
+                             "seq_oldest_steps_s": seq_steps_s})
+        except Exception as exc:  # noqa: BLE001
+            log(f"sequence-oldest bench failed: {exc!r}")
+    if _want("gen"):
+        try:
+            _maybe_hang("gen")
+            gen = bench_generative()
+            _RESULT["gen"] = gen
+            _RESULT["gen_tok_s"] = gen["tok_s"]
+            _append_history({"probe": "gen", "gen": gen})
+        except Exception as exc:  # noqa: BLE001
+            log(f"generative bench failed: {exc!r}")
     # Section order = re-capture priority (VERDICT r4 #1c): the round-4
     # rows missing artifacts come before this round's new probes, so a
     # mid-run outage costs the least-established evidence first.
-    try:
-        _maybe_hang("device_steady")
-        steady = bench_device_steady()
-        _RESULT["device_steady"] = steady
-        _append_history({"probe": "device_steady", "device_steady": steady})
-    except Exception as exc:  # noqa: BLE001
-        log(f"device-steady bench failed: {exc!r}")
-        steady = None
-    try:
-        _maybe_hang("gen_net")
-        gen_net = bench_gen_net()
-        _RESULT["gen_net"] = gen_net
-        _append_history({"probe": "gen_net", "gen_net": gen_net})
-    except Exception as exc:  # noqa: BLE001
-        log(f"networked generative bench failed: {exc!r}")
-        gen_net = None
-    try:
-        _maybe_hang("seq_streaming")
-        seq_net = bench_seq_streaming()
-        _RESULT["seq_streaming"] = seq_net
-        _append_history({"probe": "seq_streaming", "seq_streaming": seq_net})
-    except Exception as exc:  # noqa: BLE001
-        log(f"sequence streaming sweep failed: {exc!r}")
-        seq_net = None
+    if _want("device_steady"):
+        try:
+            _maybe_hang("device_steady")
+            steady = bench_device_steady()
+            _RESULT["device_steady"] = steady
+            _append_history({"probe": "device_steady",
+                             "device_steady": steady})
+        except Exception as exc:  # noqa: BLE001
+            log(f"device-steady bench failed: {exc!r}")
+    if _want("gen_net"):
+        try:
+            _maybe_hang("gen_net")
+            gen_net = bench_gen_net()
+            _RESULT["gen_net"] = gen_net
+            _append_history({"probe": "gen_net", "gen_net": gen_net})
+        except Exception as exc:  # noqa: BLE001
+            log(f"networked generative bench failed: {exc!r}")
+    if _want("seq_streaming"):
+        try:
+            _maybe_hang("seq_streaming")
+            seq_net = bench_seq_streaming()
+            _RESULT["seq_streaming"] = seq_net
+            _append_history({"probe": "seq_streaming",
+                             "seq_streaming": seq_net})
+        except Exception as exc:  # noqa: BLE001
+            log(f"sequence streaming sweep failed: {exc!r}")
 
     # vs_baseline compares only same-platform runs — a CPU dev-box number is
     # not a baseline for the TPU chip or vice versa. Entries without a
@@ -1202,6 +1255,21 @@ def _main():
     # records (probe == "simple") and legacy run aggregates both carry the
     # metric/value keys, so both populate the baseline.  Records from THIS
     # run are excluded by run_ts: a run must not baseline itself.
+    if simple is None:
+        # Filtered run (BENCH_SECTIONS without "simple"): no headline probe,
+        # so emit an explicitly-labeled partial rather than a fake headline.
+        _RESULT.setdefault("metric", "inproc_simple_ips")
+        # 0.0 (not null): the driver schema wants a numeric value; the
+        # distinct status is what says "no headline was measured".
+        _RESULT.setdefault("value", 0.0)
+        _RESULT.setdefault("unit", "infer/sec")
+        _RESULT["status"] = "sections-filtered"
+        _RESULT["sections"] = _sections_tag()
+        _append_history({"probe": "run-status",
+                         "status": "sections-filtered",
+                         "sections": _RESULT["sections"]})
+        _emit(_RESULT)
+        return
     hist_path = _hist_path()
     try:
         with open(hist_path) as f:
@@ -1220,15 +1288,23 @@ def _main():
                default=None)
     vs = ips / best if best else 1.0
     _RESULT["vs_baseline"] = round(vs, 4)
-    _RESULT["status"] = "ok"
-    _append_history({"probe": "run-status", "status": "ok",
+    # A filtered run that did include the headline still must not pass for a
+    # complete capture: carry the filter on both the emit and the record.
+    filtered = _sections_filter() is not None
+    status = "ok-sections-filtered" if filtered else "ok"
+    _RESULT["status"] = status
+    if filtered:
+        _RESULT["sections"] = _sections_tag()
+    _append_history({"probe": "run-status", "status": status,
                      "metric": "inproc_simple_ips", "value": ips,
                      "p99_us": p99_us, "stable": simple["stable"],
                      "bert_ips": bert_ips, "mfu": mfu,
                      "seq_oldest_steps_s": seq_steps_s,
                      "gen_tok_s": gen["tok_s"] if gen else None,
                      "gen_chunk": gen.get("chunk") if gen else None,
-                     "vs_baseline": round(vs, 4)})
+                     "vs_baseline": round(vs, 4),
+                     **({"sections": _sections_tag()}
+                        if filtered else {})})
 
     _emit(_RESULT)
 

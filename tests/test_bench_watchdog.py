@@ -80,3 +80,21 @@ def test_midrun_hang_emits_partial_with_completed_sections(tmp_path):
     assert simple_records[0]["platform"] == "cpu"
     assert any(h.get("probe") == "run-status"
                and h.get("status") == "partial-outage" for h in history)
+
+
+def test_sections_filter_runs_only_named_sections(tmp_path):
+    # Targeted re-capture knob (round 5): a short tunnel window must be
+    # spendable on exactly the sections that lack artifacts.
+    out, history = run_bench(tmp_path, {
+        "BENCH_SECTIONS": "seq",
+        "BENCH_SMOKE": "1",
+    }, timeout=400)
+    assert out["status"] == "sections-filtered"
+    assert out["sections"] == "seq"
+    assert out["value"] == 0.0  # numeric for the driver schema; the
+    # distinct status is what marks "no headline measured"
+    assert "windows" not in out  # simple probe really did not run
+    assert "seq_oldest_steps_s" in out
+    probes = {h.get("probe") for h in history}
+    assert "seq_oldest" in probes
+    assert "simple" not in probes
