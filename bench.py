@@ -212,6 +212,25 @@ if os.environ.get("BENCH_SMOKE"):
     BENCH_MAX_BATCH, BENCH_CONCURRENCY, BENCH_INSTANCES = 8, 8, 2
 
 
+def _tail_is_stable(history: list, keys: tuple, stability_pct: float,
+                    stable_needed: int) -> bool:
+    """The reference's stability criterion, shared by every windowed probe:
+    the last `stable_needed` windows each sit within ±`stability_pct` of
+    the tail mean on EVERY key (inference_profiler.cc:503-547).  One
+    implementation so a criterion tweak cannot silently fork the contract
+    between probes (which is exactly how the seq probe drifted out of the
+    round-3 stability adoption)."""
+    if len(history) < stable_needed:
+        return False
+    tail = history[-stable_needed:]
+    for k in keys:
+        avg = sum(w[k] for w in tail) / stable_needed
+        if avg <= 0 or any(abs(w[k] - avg) > stability_pct * avg
+                           for w in tail):
+            return False
+    return True
+
+
 def run_stable_load(infer_fn, concurrency: int, window_s: float = 3.0,
                     ramp_s: float = 1.5, stability_pct: float = 0.10,
                     stable_needed: int = 3, max_windows: int = 12,
@@ -279,17 +298,10 @@ def run_stable_load(infer_fn, concurrency: int, window_s: float = 3.0,
             history.append({"ips": round(ips, 1), "p99_us": round(p99, 1)})
             log(f"{tag} window {len(history)}: {len(lat)} completions in "
                 f"{elapsed:.2f}s = {ips:.1f} ips, p99 {p99 / 1e3:.1f}ms")
-            if len(history) >= stable_needed:
-                tail = history[-stable_needed:]
-                avg_ips = sum(w["ips"] for w in tail) / stable_needed
-                avg_p99 = sum(w["p99_us"] for w in tail) / stable_needed
-                if avg_ips > 0 and avg_p99 > 0 and all(
-                        abs(w["ips"] - avg_ips) <= stability_pct * avg_ips
-                        and abs(w["p99_us"] - avg_p99)
-                        <= stability_pct * avg_p99
-                        for w in tail):
-                    stable = True
-                    break
+            if _tail_is_stable(history, ("ips", "p99_us"),
+                               stability_pct, stable_needed):
+                stable = True
+                break
     finally:
         stop_evt.set()
         for t in threads:
@@ -580,12 +592,25 @@ def bench_shm_ab_large(concurrency: int = 16, dim: int = 150528):
         engine.shutdown()
 
 
-def bench_sequence_oldest(n_seq: int = 128, duration_s: float = 3.0):
+def bench_sequence_oldest(n_seq: int = 128, window_s: float = 3.0,
+                          stability_pct: float = 0.10,
+                          stable_needed: int = 3, max_windows: int = 10):
     """Stateful sequence stepping through the oldest-sequence arena batcher:
     steps of distinct live sequences share one XLA execution (state arena in
     HBM, gather->vmap(step)->scatter). Direct strategy measured 14 steps/s
     on the same workload; the wave batcher is the TPU answer to Triton's
-    OLDEST strategy."""
+    OLDEST strategy.
+
+    Round-5 rework: this probe used to report a SINGLE post-warmup window,
+    which is why its round-over-round record swung 372-1123 steps/s on
+    unchanged code — the one probe still exempt from the stability
+    criterion the rest of the bench adopted in round 3.  It now measures
+    consecutive windows (statistics-delta per window) until `stable_needed`
+    in a row agree within ±`stability_pct` on steps/s, same reference
+    anchor as run_stable_load (inference_profiler.cc:503-547).
+
+    Returns {steps_s, stable, avg_wave, windows: [...]}.
+    """
     import numpy as np
 
     from client_tpu.engine import InferRequest, TpuEngine
@@ -607,44 +632,72 @@ def bench_sequence_oldest(n_seq: int = 128, duration_s: float = 3.0):
 
     step(999_999, 0, sequence_start=True, sequence_end=True)  # compile b=1
     warm_s = 1.5  # ramping sequences compile the larger wave buckets here
-    stop = time.monotonic() + warm_s + duration_s
+    stop_evt = threading.Event()
     errs: list = []
 
     def worker(i):
         sid = 1 + i
         started = False
         try:
-            while time.monotonic() < stop:
+            while not stop_evt.is_set():
                 step(sid, 1, sequence_start=not started)
                 started = True
         except Exception as exc:  # noqa: BLE001
             errs.append(repr(exc))
+            stop_evt.set()
 
     def snapshot():
         s = engine.model_statistics("seq_oldest")["model_stats"][0]
         return s["inference_count"], s["execution_count"]
 
-    threads = [threading.Thread(target=worker, args=(i,))
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
                for i in range(n_seq)]
     for t in threads:
         t.start()
     time.sleep(warm_s)
-    steps0, waves0 = snapshot()
-    t0 = time.monotonic()
-    for t in threads:
-        t.join()
-    elapsed = time.monotonic() - t0
-    steps1, waves1 = snapshot()
-    engine.shutdown()
+    windows: list[dict] = []
+    stable = False
+    steps_prev, waves_prev = snapshot()
+    t_mark = time.monotonic()
+    try:
+        while len(windows) < max_windows and not stop_evt.is_set():
+            time.sleep(window_s)
+            now = time.monotonic()
+            steps_now, waves_now = snapshot()
+            elapsed = now - t_mark
+            t_mark = now
+            steps = steps_now - steps_prev
+            waves = max(waves_now - waves_prev, 1)
+            steps_prev, waves_prev = steps_now, waves_now
+            rate = steps / elapsed
+            windows.append({"steps_s": round(rate, 1),
+                            "avg_wave": round(steps / waves, 1)})
+            log(f"seq-oldest window {len(windows)}: {steps} steps in "
+                f"{elapsed:.2f}s = {rate:.0f} steps/s, "
+                f"avg wave {steps / waves:.1f}")
+            if _tail_is_stable(windows, ("steps_s",),
+                               stability_pct, stable_needed):
+                stable = True
+                break
+    finally:
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=120)
+        engine.shutdown()
     if errs:
         raise RuntimeError(f"{len(errs)} sequence errors: {errs[:2]}")
-    steps = steps1 - steps0
-    waves = max(waves1 - waves0, 1)
-    rate = steps / elapsed
-    log(f"sequence-oldest: {steps} steps over {n_seq} live sequences in "
-        f"{elapsed:.2f}s (post-warmup window) = {rate:.0f} steps/s, "
-        f"avg wave {steps / waves:.1f}")
-    return rate
+    if not windows:
+        raise RuntimeError("seq-oldest: no measurement windows completed")
+    tail = windows[-min(stable_needed, len(windows)):]
+    rate = sum(w["steps_s"] for w in tail) / len(tail)
+    avg_wave = sum(w["avg_wave"] for w in tail) / len(tail)
+    if not stable:
+        log(f"seq-oldest: NOT stable after {len(windows)} windows "
+            f"(reporting mean of final {len(tail)})")
+    log(f"sequence-oldest: {rate:.0f} steps/s stable={stable} over "
+        f"{n_seq} live sequences, avg wave {avg_wave:.1f}")
+    return {"steps_s": rate, "stable": stable,
+            "avg_wave": round(avg_wave, 1), "windows": windows}
 
 
 @contextlib.contextmanager
@@ -1201,10 +1254,15 @@ def _main():
     if _want("seq"):
         try:
             _maybe_hang("seq")
-            seq_steps_s = bench_sequence_oldest()
+            seq_res = bench_sequence_oldest()
+            seq_steps_s = seq_res["steps_s"]
             _RESULT["seq_oldest_steps_s"] = round(seq_steps_s, 1)
+            _RESULT["seq_oldest"] = seq_res
             _append_history({"probe": "seq_oldest",
-                             "seq_oldest_steps_s": seq_steps_s})
+                             "seq_oldest_steps_s": seq_steps_s,
+                             "stable": seq_res["stable"],
+                             "avg_wave": seq_res["avg_wave"],
+                             "windows": seq_res["windows"]})
         except Exception as exc:  # noqa: BLE001
             log(f"sequence-oldest bench failed: {exc!r}")
     if _want("gen"):
