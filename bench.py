@@ -922,7 +922,11 @@ def bench_seq_streaming(concurrencies=(16, 32, 64, 128)):
     repo = ModelRepository()
     repo.register_backend(backend)
     engine = TpuEngine(repo)
-    srv = GrpcInferenceServer(engine, port=0).start()
+    # Every streaming RPC holds a grpcio handler-pool thread for its
+    # lifetime; the default pool (64) deadlocks the c64/c128 sweep points
+    # (observed round 5: the c64 point hung its full 300 s timeout).
+    srv = GrpcInferenceServer(engine, port=0,
+                              max_workers=max(concurrencies) + 32).start()
     out: dict = {}
     try:
         for conc in concurrencies:
@@ -938,12 +942,24 @@ def bench_seq_streaming(concurrencies=(16, 32, 64, 128)):
                    "--sequence-length", "16",
                    "--max-threads", str(max(conc, 16)),
                    "--concurrency-range", f"{conc}:{conc}"]
-            proc = subprocess.run(cmd, capture_output=True, text=True,
-                                  timeout=300)
+            # Per-point fault isolation: one hung/failed sweep point must
+            # not erase the points already measured (round-5: the c64
+            # point hit a pool deadlock and took the whole sweep's
+            # evidence with it).  The failure is recorded in-row instead.
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=300)
+            except subprocess.TimeoutExpired:
+                out[f"c{conc}"] = {"error": "timeout (300s)"}
+                log(f"seq-streaming c{conc}: TIMEOUT — point recorded as "
+                    "failed, sweep continues")
+                continue
             if proc.returncode != 0:
-                raise RuntimeError(
-                    f"--streaming conc {conc} rc={proc.returncode}: "
-                    f"{proc.stderr[-400:]}")
+                out[f"c{conc}"] = {
+                    "error": f"rc={proc.returncode}: {proc.stderr[-200:]}"}
+                log(f"seq-streaming c{conc}: rc={proc.returncode} — point "
+                    "recorded as failed, sweep continues")
+                continue
             s1, w1 = stats()
             m = re.findall(r"Throughput:\s*([\d.]+)", proc.stdout)
             ips = float(m[-1]) if m else None
@@ -1028,37 +1044,99 @@ def bert_flops_per_example(seq_len=128, hidden=768, n_layers=12, ffn=3072):
     return n_layers * per_layer
 
 
+# bench_bert_mfu probe state, keyed by batch size (see the cache note in
+# its body).
+_BERT_PROBE_CACHE: dict = {}
+
+
+def make_bert_feedback_scan(apply_fn, mask_dev, vocab: int = 30522,
+                            length: int = 100):
+    """THE dependent-feedback scan construction (single source — bench's
+    MFU probe and tools/mfu_diag.py's validator import this same builder,
+    so the construction the diag validates is the construction the
+    headline trusts).
+
+    The next step's ids derive from a full-tensor reduction of this
+    step's logits: iterations serialize on a real data dependence, and
+    XLA can neither pipeline them apart nor slice/DCE any of the forward
+    pass.  The per-step overhead added by the feedback itself is one
+    reduce + one broadcast-add over int32 ids — nanoseconds against a
+    ms-scale step.  Returns (jitted_fn(ids0), length).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def feed(ids0):
+        def body(ids_c, _):
+            o = apply_fn({"input_ids": ids_c, "attention_mask": mask_dev})
+            sig = jnp.sum(o["logits"].astype(jnp.float32))
+            bump = jnp.clip(sig, 0.0, 1.0).astype(jnp.int32)
+            return (ids_c + bump) % vocab, None
+
+        out, _ = lax.scan(body, ids0, None, length=length)
+        return out
+
+    return feed, length
+
+
 def bench_bert_mfu(batch: int = 8, iters: int = 30, pipeline_n: int = 100,
                    trace_dir: str | None = None):
     """Flagship BERT-base batch-8 at the Model level (no scheduler).
 
     Two numbers with different denominators:
 
-    - **device step** (the MFU numerator): N jitted executions dispatched
-      back-to-back with one final host fetch, total/N.  Back-to-back dispatch
-      keeps the device pipeline full, so this converges on the executable's
-      true step time — what a TPU-VM-local server would see — instead of
-      charging the transport round trip (tens of ms through the dev tunnel)
-      to every step.
+    - **device step** (the MFU numerator): a dependent-feedback
+      ``lax.scan`` inside ONE jitted executable — the next step's ids
+      derive from a full-tensor reduction of this step's logits, so
+      iterations serialize on a real data dependence and XLA can neither
+      pipeline them apart nor slice/DCE any of the forward pass.  The
+      construction is validated by the matmul-chain control in
+      ``tools/mfu_diag.py`` (167 TFLOP/s sustained, 85% of the v5e peak,
+      on an op whose cost is independently known); the
+      optimization-barrier scan variant FAILED that control (5x peak —
+      XLA slices the probe signal) and is not used anywhere.
+    - **dispatch step**: N jitted executions dispatched back-to-back with
+      one final host fetch, total/N.  Through the dev tunnel each dispatch
+      pays a command round trip (0.8-1.5 ms measured), so this is the
+      transport-inclusive upper bound — what THIS host can drive, not what
+      the chip can do.  Round-5 diag decomposition: dispatch 1.9-2.8 ms =
+      feedback step 1.38 ms + per-dispatch overhead.
     - **e2e step**: one stage+execute+fetch round trip per call, the
       per-request serving latency on this transport.
+
+    Returns a dict; ``step_s`` (and the MFU derived from it) is the
+    feedback-scan step when measured, else the dispatch step (smoke mode
+    skips the scan compile), with ``step_method`` naming which.
     """
     import numpy as np
 
-    from client_tpu.engine.model import Model
-    from client_tpu.models.bert import BertBackend
+    # Probe state (model, staged inputs, jitted fns) is cached per batch
+    # size: mfu_study calls this 5+ times and every rebuild re-traces (and
+    # on an unwarmed XLA cache recompiles) both the forward and the
+    # 100-step scan — minutes of a scarce tunnel window for zero
+    # measurement value.
+    cached = _BERT_PROBE_CACHE.get(batch)
+    if cached is None:
+        from client_tpu.engine.model import Model
+        from client_tpu.models.bert import BertBackend
 
-    log("building BERT-base (random weights, bf16)...")
-    backend = BertBackend(max_batch_size=batch)
-    backend.config.batch_buckets = [batch]  # only compile the bucket we time
-    model = Model(backend)
-    ids = np.random.randint(0, 30522, size=(batch, 128), dtype=np.int32)
-    mask = np.ones((batch, 128), dtype=np.int32)
-    inputs = {"input_ids": ids, "attention_mask": mask}
-
-    t0 = time.monotonic()
-    model.execute(inputs, batch_size=batch)  # compile
-    log(f"bert: bucket={batch} compiled+run in {time.monotonic() - t0:.1f}s")
+        log("building BERT-base (random weights, bf16)...")
+        backend = BertBackend(max_batch_size=batch)
+        backend.config.batch_buckets = [batch]  # compile only this bucket
+        model = Model(backend)
+        ids = np.random.randint(0, 30522, size=(batch, 128), dtype=np.int32)
+        mask = np.ones((batch, 128), dtype=np.int32)
+        inputs = {"input_ids": ids, "attention_mask": mask}
+        cached = _BERT_PROBE_CACHE[batch] = {
+            "model": model, "inputs": inputs, "feed": None}
+        t0 = time.monotonic()
+        model.execute(inputs, batch_size=batch)  # compile
+        log(f"bert: bucket={batch} compiled+run in "
+            f"{time.monotonic() - t0:.1f}s")
+    model = cached["model"]
+    inputs = cached["inputs"]
 
     times = []
     for _ in range(iters):
@@ -1093,6 +1171,25 @@ def bench_bert_mfu(batch: int = 8, iters: int = 30, pipeline_n: int = 100,
         cand = max(t_total - t_one, 1e-9) / max(pipeline_n - 1, 1)
         step = cand if step is None else min(step, cand)
 
+    # Dependent-feedback scan (the trusted device step — see docstring).
+    # Smoke/CI runs skip it: the scan compile is the dominant cost on CPU
+    # and the smoke config can never enter a baseline pool anyway.
+    feedback_step = None
+    if not os.environ.get("BENCH_SMOKE"):
+        feed, scan_len = cached.get("feed") or (None, 0)
+        if feed is None:
+            feed, scan_len = make_bert_feedback_scan(
+                apply_j, staged["attention_mask"])
+            feed(staged["input_ids"]).block_until_ready()  # compile
+            cached["feed"] = (feed, scan_len)
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            feed(staged["input_ids"]).block_until_ready()
+            t = (time.perf_counter() - t0) / scan_len
+            best = t if best is None else min(best, t)
+        feedback_step = best
+
     if trace_dir:
         # Same staged workload, one profiled pipelined pass: the trace
         # artifact names the top device ops behind the measured step.
@@ -1104,14 +1201,19 @@ def bench_bert_mfu(batch: int = 8, iters: int = 30, pipeline_n: int = 100,
         log(f"bert: profiler trace written to {trace_dir}")
 
     flops = bert_flops_per_example() * batch
-    achieved = flops / step
+    mfu_step = feedback_step if feedback_step is not None else step
+    achieved = flops / mfu_step
     peak = peak_flops()
     mfu = achieved / peak if peak else None
-    log(f"bert: device step {step * 1e3:.2f}ms ({achieved / 1e12:.2f} "
-        f"TFLOP/s pipelined), e2e step {e2e_step * 1e3:.2f}ms"
+    method = "feedback-scan" if feedback_step is not None else "dispatch-loop"
+    log(f"bert: device step {mfu_step * 1e3:.2f}ms [{method}] "
+        f"({achieved / 1e12:.2f} TFLOP/s), dispatch step "
+        f"{step * 1e3:.2f}ms, e2e step {e2e_step * 1e3:.2f}ms"
         + (f", MFU {mfu * 100:.1f}% of {peak / 1e12:.0f} TFLOP/s peak"
            if peak else " (no peak known for platform; MFU omitted)"))
-    return batch / e2e_step, mfu, step, e2e_step
+    return {"ips": batch / e2e_step, "mfu": mfu, "step_s": mfu_step,
+            "e2e_s": e2e_step, "dispatch_step_s": step,
+            "step_method": method}
 
 
 def main():
@@ -1218,16 +1320,23 @@ def _main():
     if _want("bert"):
         try:
             _maybe_hang("bert")
-            bert_ips, mfu, bert_step_s, bert_e2e_s = bench_bert_mfu()
+            bres = bench_bert_mfu()
+            bert_ips, mfu = bres["ips"], bres["mfu"]
             _RESULT["bert_b8_ips"] = round(bert_ips, 2)
-            _RESULT["bert_b8_step_ms"] = round(bert_step_s * 1e3, 3)
-            _RESULT["bert_b8_e2e_ms"] = round(bert_e2e_s * 1e3, 3)
+            _RESULT["bert_b8_step_ms"] = round(bres["step_s"] * 1e3, 3)
+            _RESULT["bert_b8_step_method"] = bres["step_method"]
+            _RESULT["bert_b8_dispatch_step_ms"] = round(
+                bres["dispatch_step_s"] * 1e3, 3)
+            _RESULT["bert_b8_e2e_ms"] = round(bres["e2e_s"] * 1e3, 3)
             if mfu is not None:
                 _RESULT["bert_b8_mfu"] = round(mfu, 4)
             _append_history({"probe": "bert", "bert_ips": bert_ips,
                              "mfu": mfu,
-                             "step_ms": bert_step_s * 1e3,
-                             "e2e_ms": bert_e2e_s * 1e3})
+                             "step_ms": bres["step_s"] * 1e3,
+                             "step_method": bres["step_method"],
+                             "dispatch_step_ms":
+                                 bres["dispatch_step_s"] * 1e3,
+                             "e2e_ms": bres["e2e_s"] * 1e3})
         except Exception as exc:  # noqa: BLE001 — headline still reports
             log(f"bert mfu measurement failed: {exc!r}")
             bert_ips, mfu = None, None
@@ -1388,18 +1497,22 @@ def mfu_study(n_runs: int = 5, trace_dir: str | None = None):
         # workload, no extra compile).
         td = trace_dir if i == n_runs - 1 else None
         kw = {"iters": 3, "pipeline_n": 5} if smoke else {}
-        _, mfu, step_s, e2e_s = bench_bert_mfu(trace_dir=td, **kw)
+        bres = bench_bert_mfu(trace_dir=td, **kw)
+        mfu, step_s = bres["mfu"], bres["step_s"]
         steps_ms.append(round(step_s * 1e3, 3))
         if mfu is not None:
             mfus.append(round(mfu, 4))
         _append_history({"probe": "mfu_study", "run": i,
                          "step_ms": step_s * 1e3, "mfu": mfu,
-                         "e2e_ms": e2e_s * 1e3})
+                         "step_method": bres["step_method"],
+                         "dispatch_step_ms": bres["dispatch_step_s"] * 1e3,
+                         "e2e_ms": bres["e2e_s"] * 1e3})
         log(f"mfu-study run {i + 1}/{n_runs}: step {step_s * 1e3:.2f}ms"
             + (f", MFU {mfu * 100:.1f}%" if mfu is not None else ""))
     trace_note = trace_dir
     summary = {
         "metric": "bert_b8_mfu_study", "n_runs": n_runs,
+        "step_method": bres["step_method"],
         "step_ms": steps_ms,
         "step_ms_min": min(steps_ms), "step_ms_max": max(steps_ms),
         "mfu": mfus,

@@ -46,6 +46,7 @@ import math
 import os
 import queue as _queue
 import threading
+import time
 
 import numpy as np
 
@@ -53,6 +54,7 @@ from client_tpu.engine.scheduler import (
     Scheduler,
     _SHUTDOWN,
     _SHUTDOWN_LEVEL,
+    _backpressured,
     power_buckets,
 )
 from client_tpu.engine.types import (
@@ -68,7 +70,7 @@ _log = logging.getLogger("client_tpu")
 class _Stream:
     __slots__ = ("req", "row", "disp_len", "disp_tokens", "f_len",
                  "emitted", "max_new", "seed", "temp", "top_k", "top_p",
-                 "stop", "dead")
+                 "stop", "dead", "throttled_since")
 
     def __init__(self, req, row, plen, max_new,
                  seed=0, temp=0.0, top_k=0, top_p=1.0, stop=frozenset()):
@@ -85,6 +87,7 @@ class _Stream:
         self.top_p = top_p        # 1.0 = off
         self.stop = stop          # token ids terminating the stream
         self.dead = False         # retired/cancelled (skip pending lanes)
+        self.throttled_since = None  # monotonic mark while backpressured
 
 
 class _Inflight:
@@ -173,6 +176,9 @@ class GenerativeScheduler(Scheduler):
     """Arena-owned single worker; batching provides the parallelism."""
 
     single_instance = True
+    # How long a stream may stay CONTINUOUSLY transport-throttled before
+    # its arena slot is reclaimed (see the worker-loop flow control).
+    BACKPRESSURE_TIMEOUT_S = 60.0
 
     def __init__(self, model, stats):
         import jax
@@ -318,7 +324,32 @@ class GenerativeScheduler(Scheduler):
                 if s.req.cancelled:
                     self._drop(s)
                     self._fail(s.req, EngineError("request cancelled", 499))
-            live = [s for s in self._streams if self._has_budget(s)]
+            # Transport flow control: streams whose frontend reports a
+            # backlogged response path sit out this wave (production is
+            # writer-paced) instead of flooding the stream queue until the
+            # slow-consumer shed kills them.  They stay live and rejoin
+            # the moment the writer drains — but a stream CONTINUOUSLY
+            # throttled past the timeout is holding an arena slot for a
+            # consumer that stopped reading; drop it (bounds slot
+            # occupancy the way the shed bounds queue memory).
+            live = []
+            now_mono = time.monotonic()
+            for s in list(self._streams):
+                if not self._has_budget(s):
+                    continue
+                if _backpressured(s.req):
+                    if s.throttled_since is None:
+                        s.throttled_since = now_mono
+                    elif (now_mono - s.throttled_since
+                          > self.BACKPRESSURE_TIMEOUT_S):
+                        self._drop(s)
+                        self._fail(s.req, EngineError(
+                            "request cancelled (stream backpressured "
+                            f"beyond {self.BACKPRESSURE_TIMEOUT_S:.0f}s)",
+                            499))
+                    continue
+                s.throttled_since = None
+                live.append(s)
             if live:
                 try:
                     self._dispatch_wave(live)
@@ -329,6 +360,31 @@ class GenerativeScheduler(Scheduler):
             # nothing was dispatched — every budget-exhausted stream has
             # its final wave in flight, so this always makes progress.
             self._drain_fetches(force_one=not live and not pending)
+            if (not live and not pending and not self._inflight
+                    and self._streams):
+                # Every stream is throttled by transport backpressure:
+                # nothing to dispatch, nothing to fetch.  Park briefly so
+                # the writer can drain (it advances ~10 rows/ms) — via a
+                # timed queue poll, not a bare sleep: _SHUTDOWN must not
+                # be starved for the whole backpressure timeout
+                # (engine.shutdown joins this thread), and a warmup
+                # sentinel must not rot behind throttled streams.
+                try:
+                    item = self.queue.get(timeout=0.001)
+                except _queue.Empty:
+                    continue
+                if item is _SHUTDOWN:
+                    self._abort_streams("server shutting down")
+                    return
+                if isinstance(item, _WarmupReq):
+                    self._run_warmup(item)
+                else:
+                    # A new admit while the arena is throttle-parked: put
+                    # it back at the FRONT (no reordering) and yield the
+                    # core — the loop-top opportunistic admit takes it the
+                    # moment a slot frees.
+                    self.queue.put_front(item)
+                    time.sleep(0.001)
 
     def _run_warmup(self, req: _WarmupReq) -> None:
         try:

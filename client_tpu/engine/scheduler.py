@@ -33,6 +33,34 @@ _SHUTDOWN_LEVEL = 1 << 30
 _log = logging.getLogger("client_tpu")
 
 
+def _backpressured(req: InferRequest) -> bool:
+    """True while the request's frontend reports a backlogged response
+    path (InferRequest.backpressure).  Fail-open: a frontend probe that
+    raises must throttle nothing — the slow-consumer shed remains the
+    backstop."""
+    bp = req.backpressure
+    if bp is None:
+        return False
+    try:
+        return bool(bp())
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _wait_while_backpressured(req: InferRequest,
+                              poll_s: float = 0.001,
+                              max_wait_s: float = 60.0) -> None:
+    """Writer-paced production for decoupled emit loops: park until the
+    frontend drains (or the request is cancelled).  Bounded — after
+    max_wait_s production resumes and the shed policy owns the outcome."""
+    import time as _time
+
+    deadline = _time.monotonic() + max_wait_s
+    while (_backpressured(req) and not req.cancelled
+           and _time.monotonic() < deadline):
+        _time.sleep(poll_s)
+
+
 def power_buckets(n: int) -> list[int]:
     """Power-of-two sizes up to and including ``n`` — the shared bucket
     ladder for wave/batch compiles (one XLA executable per bucket)."""
@@ -491,6 +519,17 @@ class DecoupledScheduler(Scheduler):
     responses terminate in the reference's streaming examples).
     """
 
+    # Writer-paced emit bound: how long one emit may stay parked on
+    # transport backpressure before production resumes anyway and the
+    # slow-consumer shed owns the outcome.  Deliberately much shorter
+    # than GenerativeScheduler's: that scheduler skips throttled streams
+    # NON-blockingly, while this park holds one of the model's few worker
+    # threads — other requests on the instance wait behind it (head-of-
+    # line).  5 s paces any healthy consumer pause; past it, the flood
+    # resumes and a stalled consumer is shed by the choke within its
+    # grace window, freeing the worker.
+    BACKPRESSURE_TIMEOUT_S = 5.0
+
     def _worker_loop(self) -> None:
         while True:
             item = self.queue.get()
@@ -512,6 +551,10 @@ class DecoupledScheduler(Scheduler):
         gen = self.model.backend.generate(req.inputs, req.parameters)
         count = 0
         for outputs in gen:
+            # Writer-paced emit: a backlogged frontend pauses production
+            # here instead of flooding its queue into the shed policy.
+            _wait_while_backpressured(
+                req, max_wait_s=self.BACKPRESSURE_TIMEOUT_S)
             if req.cancelled:
                 # Client abandoned (disconnect) or server-side shedding
                 # (slow-consumer policy): stop producing mid-stream.

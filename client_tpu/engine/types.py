@@ -99,6 +99,13 @@ class InferRequest:
     # D2H fetch entirely and responses carry HBM-resident jax.Arrays (the
     # shm write stores them as-is — zero host bytes end to end).
     keep_outputs_on_device: bool = False
+    # Streaming flow control (round 5): frontends with a bounded response
+    # path (the gRPC stream writer) set this to a zero-arg callable that
+    # returns True while the transport is backlogged.  Decoupled producers
+    # (generative decode waves, repeat emit loops) then PAUSE production
+    # for this request instead of flooding the queue — the slow-consumer
+    # shed becomes the stalled-consumer last resort, not the first line.
+    backpressure: Callable[[], bool] | None = None
 
     def cancel(self) -> None:
         self.cancelled = True

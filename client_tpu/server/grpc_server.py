@@ -387,6 +387,12 @@ class _Servicer(GRPCInferenceServiceServicer):
     # so a stalled reader bounds memory instead of growing it token by
     # token.
     STREAM_PENDING_LIMIT = 1024
+    # Soft-shed grace: how long the writer/consumer must make NO progress
+    # (with the backlog over the mark) before the choke fires.  An active
+    # consumer advances the progress counter every yielded message, so ms
+    # of true stall is already anomalous; 0.25 s is far past any healthy
+    # pause yet sheds a stalled consumer promptly.
+    STREAM_STALL_GRACE_S = 0.25
 
     def ModelStreamInfer(self, request_iterator, context):  # noqa: N802
         """Bidi stream: requests in, responses out; decoupled models emit
@@ -413,6 +419,11 @@ class _Servicer(GRPCInferenceServiceServicer):
             lambda: [r.cancel() for r in list(live_reqs.values())])
 
         choke_at = [self.STREAM_PENDING_LIMIT]
+        # Writer progress signal: advances on every batch pop AND every
+        # yielded message (a long coalesce batch yields for tens of ms
+        # between pops; a consumer taking messages IS progress).
+        progress = [0]
+        armed_at: list = [None]  # (progress, monotonic) at backlog crossing
 
         def choke_if_backlogged():
             """Per-request shedding with escalation hysteresis: when the
@@ -422,11 +433,32 @@ class _Servicer(GRPCInferenceServiceServicer):
             limit (a cancelled hog stops producing at its next wave, so a
             merely-slow reader sheds one offender and the siblings keep
             streaming; total memory stays bounded by limit x live
-            requests)."""
+            requests).
+
+            The soft mark is progress-gated (round-5 fix): a chunked decode
+            wave legitimately bursts streams x chunk rows into the queue at
+            once (64 generative warmup streams crossed 1024 and got a
+            well-behaved request shed mid-burst), so crossing the mark only
+            ARMS the choke; it fires when a later crossing finds the writer
+            made NO drain progress for a grace window — a consumer that
+            stopped reading, not a writer mid-burst (an active writer
+            drains a 512-row batch in tens of ms). A hard mark (8x limit)
+            sheds regardless of progress so a producer that persistently
+            outruns a slow-but-moving reader still has bounded memory."""
             size = out_q.qsize()
             if size < self.STREAM_PENDING_LIMIT:
                 choke_at[0] = self.STREAM_PENDING_LIMIT  # re-arm on drain
+                armed_at[0] = None
                 return
+            if size < 8 * self.STREAM_PENDING_LIMIT:
+                p = progress[0]
+                now = time.monotonic()
+                armed = armed_at[0]
+                if armed is None or armed[0] != p:
+                    armed_at[0] = (p, now)  # arm / re-arm on progress
+                    return
+                if now - armed[1] < self.STREAM_STALL_GRACE_S:
+                    return
             if size < choke_at[0]:
                 return
             with lock:
@@ -454,6 +486,13 @@ class _Servicer(GRPCInferenceServiceServicer):
                 "(slow consumer)", size, self.STREAM_PENDING_LIMIT, worst)
             victim.cancel()
 
+        # One probe per RPC, shared by every request on it: producers
+        # (decode waves, decoupled emit loops) pause while this stream's
+        # write queue is over the mark — flow control first; the choke
+        # below sheds only a consumer that then stays stalled.
+        def rpc_backlogged() -> bool:
+            return out_q.qsize() >= self.STREAM_PENDING_LIMIT
+
         def pump_requests():
             try:
                 for request in request_iterator:
@@ -463,6 +502,7 @@ class _Servicer(GRPCInferenceServiceServicer):
                         out_q.put(("err", str(exc), ""))
                         continue
 
+                    req.backpressure = rpc_backlogged
                     with lock:
                         inflight[0] += 1
                         live_reqs[id(req)] = req
@@ -550,6 +590,7 @@ class _Servicer(GRPCInferenceServiceServicer):
                     batch.append(out_q.get_nowait())
                 except queue.Empty:
                     break
+            progress[0] += 1  # batch popped
             saw_sentinel = False
             # plan: list of ("resp", req, [resps...]) / ("err", ...) items;
             # open_runs[id(req)] is a still-growing coalesce run
@@ -598,6 +639,7 @@ class _Servicer(GRPCInferenceServiceServicer):
                     if item[0] == "resp" and item[1].request_id:
                         msg.infer_response.id = item[1].request_id
                 yield msg
+                progress[0] += 1  # consumer took a message
                 if delay_s:
                     time.sleep(delay_s)
             # sentinel: exit once the request side is done and no responses

@@ -360,16 +360,48 @@ class _Handler(BaseHTTPRequestHandler):
         out_q: q.Queue = q.Queue()
         choked = [False]
         limit = self._stream_pending_limit()
+        # Progress-gated cancel, mirroring the gRPC servicer's choke: the
+        # pipelined decoder legitimately delivers depth x chunk rows that
+        # were already in flight when backpressure paused it, so crossing
+        # the mark only ARMS the cancel; it fires when a later enqueue
+        # finds the writer advanced NOTHING for the grace window (a
+        # reader that stopped draining), or at the 8x hard mark (memory
+        # bound if a producer ignores the probe).
+        progress = [0]   # rows yielded to the SSE writer
+        armed = [None]   # (progress, monotonic) at backlog crossing
 
         def enqueue(resp):
             out_q.put(resp)
-            if not choked[0] and out_q.qsize() >= limit:
-                choked[0] = True
-                _log.warning(
-                    "generate stream backlog exceeded %d pending "
-                    "responses; cancelling request (slow consumer)",
-                    limit)
-                req.cancel()
+            if choked[0]:
+                return
+            size = out_q.qsize()
+            if size < limit:
+                armed[0] = None
+                return
+            if size < 8 * limit:
+                p = time.monotonic()
+                if armed[0] is None or armed[0][0] != progress[0]:
+                    armed[0] = (progress[0], p)
+                    return
+                if p - armed[0][1] < 0.25:
+                    return
+            choked[0] = True
+            _log.warning(
+                "generate stream backlog at %d pending responses "
+                "(mark %d) with a stalled reader; cancelling request "
+                "(slow consumer)", size, limit)
+            req.cancel()
+
+        # Transport flow control (same contract as the gRPC stream
+        # writer): decode waves pause for this stream at HALF the cancel
+        # mark, so a slow-but-alive SSE reader is writer-paced (TCP
+        # backpressure propagates here through the blocking chunk write)
+        # and never reaches the cancel; the choke above remains the
+        # backstop for a stalled reader, and the generative scheduler's
+        # BACKPRESSURE_TIMEOUT_S reclaims the arena slot of a stream
+        # throttled past its bound.
+        bp_mark = max(1, limit // 2)
+        req.backpressure = lambda: out_q.qsize() >= bp_mark
 
         self.engine.async_infer(req, enqueue)
         # Same coalescing contract as the gRPC stream writer (an SSE event
@@ -396,6 +428,7 @@ class _Handler(BaseHTTPRequestHandler):
             for resp in ((merged,) if leftover is None
                          else (merged, leftover)):
                 yield resp
+                progress[0] += 1  # reader took an event (choke gate)
                 if delay_s:
                     time.sleep(delay_s)
                 if resp.error is not None or resp.final:

@@ -375,6 +375,17 @@ int RunGenerativeProfile(const ClientBackendFactory& factory,
     fprintf(stderr, "StartStream: %s\n", err.Message().c_str());
     return 1;
   }
+  // Every exit below this point must stop the stream BEFORE the locals the
+  // reader callback captures by reference (slots/mu/cv/counters) are
+  // destroyed: an early `return 1` (e.g. warmup failure after a server-side
+  // cancel) used to leave the reader thread delivering into freed stack
+  // frames — observed as a SIGSEGV in the round-5 gen_net capture.
+  struct StreamGuard {
+    ClientBackend* b;
+    ~StreamGuard() {
+      if (b != nullptr) b->StopStream();
+    }
+  } stream_guard{backend.get()};
 
   // Prompt length honors --shape <input>:N (the same CLI surface the
   // load-manager path consumes); default 4 tokens.
@@ -462,6 +473,7 @@ int RunGenerativeProfile(const ClientBackendFactory& factory,
     return 1;
   }
   backend->StopStream();
+  stream_guard.b = nullptr;  // stopped explicitly; guard must not re-stop
 
   std::vector<uint64_t> ttft, itl;
   uint64_t n_tokens, n_messages, n_completed;

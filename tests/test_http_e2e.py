@@ -403,6 +403,38 @@ class TestGenerateEndpoints:
         assert max(widths) > 1  # the throttled writer actually merged
         assert len(widths) < n
 
+    def test_generate_stream_flow_control_paces_slow_reader(
+            self, gen_server, monkeypatch):
+        """Round-5 flow control: a tiny pending limit plus a slow writer
+        must NOT cancel the stream — decode pauses at the backpressure
+        mark (half the limit) and every token arrives writer-paced.
+        Under the pre-flow-control policy this config cancelled the
+        request the moment the backlog crossed the limit."""
+        import http.client as hc
+        import json as j
+
+        monkeypatch.setenv("CLIENT_TPU_STREAM_PENDING_LIMIT", "4")
+        monkeypatch.setenv("CLIENT_TPU_STREAM_WRITER_DELAY_MS", "30")
+        n = 16
+        host, port = gen_server.url.split(":")
+        conn = hc.HTTPConnection(host, int(port), timeout=120)
+        conn.request("POST", "/v2/models/tiny_gpt/generate_stream",
+                     body=self._body([7, 8, 9], n))
+        raw = conn.getresponse().read().decode()
+        conn.close()
+        tokens, errors = [], []
+        for ev in raw.split("\n\n"):
+            if not ev.startswith("data: "):
+                continue
+            d = j.loads(ev[len("data: "):])
+            if "error" in d:
+                errors.append(d["error"])
+                continue
+            outs = {o["name"]: o["data"] for o in d["outputs"]}
+            tokens.extend(outs["TOKEN"])
+        assert not errors, errors
+        assert len(tokens) == n, (len(tokens), raw[-300:])
+
     def test_generate_works_for_single_response_models(self, gen_server):
         import http.client as hc
         import json as j

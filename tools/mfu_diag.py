@@ -15,6 +15,16 @@ separates the three confounded quantities on live hardware:
    known (~6.5 ms at ~167 TFLOP/s measured via a 256-long dependent
    chain).  If the scan control disagrees with the known matmul time,
    the scan method is broken and its BERT number is discarded.
+   (Round-5 live run: the control FAILED — 1053 "TFLOP/s", above the
+   197 peak, because XLA slices the ``o[:1,:1]`` signal down to a dot
+   product.  Hence stage 4b below.)
+4b. the **dependent-feedback scan**: next step's ids derive from a
+   reduction over the FULL logits (ids' = (ids + clip(sum(logits),0,1))
+   mod vocab), so no slicing/DCE escape exists and iterations
+   serialize on a true data dependence — the same construction the
+   matmul chain control validates.  This is the trusted in-jit device
+   step; the dispatch loop bounds it from above (step + per-dispatch
+   tunnel overhead that back-to-back dispatch fails to hide).
 
 Emits one JSON line per completed stage (flushed immediately, so a
 tunnel drop + timeout kill preserves every finished stage), then a final
@@ -156,9 +166,25 @@ def main():
     stage(bert_scanbar_ms=(
         timeit(lambda: bertscan(staged).block_until_ready()) / 100 * 1e3))
 
+    # 4b. dependent-feedback scan: ids for step i+1 are a function of a
+    # full-tensor reduction of step i's logits, so the whole forward pass
+    # is on the serial critical path and nothing can be sliced away.
+    # SAME builder the bench headline uses (bench.make_bert_feedback_scan)
+    # — this diag validates exactly the construction the headline trusts.
+    from bench import make_bert_feedback_scan
+
+    bertfeed, scan_len = make_bert_feedback_scan(
+        fn, staged["attention_mask"])
+    ids0 = staged["input_ids"]
+    bertfeed(ids0).block_until_ready()
+    stage(bert_feedback_ms=(
+        timeit(lambda: bertfeed(ids0).block_until_ready())
+        / scan_len * 1e3))
+
     flops = bert_flops_per_example() * 8
     stage(bert_dispatch_tflops=flops / (OUT["bert_dispatch_ms"] / 1e3) / 1e12,
-          bert_scanbar_tflops=flops / (OUT["bert_scanbar_ms"] / 1e3) / 1e12)
+          bert_scanbar_tflops=flops / (OUT["bert_scanbar_ms"] / 1e3) / 1e12,
+          bert_feedback_tflops=flops / (OUT["bert_feedback_ms"] / 1e3) / 1e12)
     print(json.dumps(OUT), flush=True)
 
 

@@ -1,13 +1,20 @@
 #!/bin/bash
 # Round-5 capture watcher: probe the TPU tunnel; the moment it answers,
 # run whatever evidence is still missing, logging everything.  One-shot.
-# Round-5 state: the full main bench was captured 2026-07-30 22:13-22:27Z
-# (artifacts/r05/bench_tpu_capture.json).  Still missing on hardware:
-#   - networked sections (the native binary was rebuilt after the capture)
-#   - the MFU variance study + the step-time denominator diagnostic
-# Section order = re-capture priority (bench.py's own rule): the missing
-# bench sections run first; the diagnostics run last and under `timeout`
-# so a mid-run tunnel drop cannot wedge the watcher.
+#
+# Round-5 state (2026-07-31, after the second tunnel window):
+#   CAPTURED with committed artifacts —
+#     - full main bench (artifacts/r05/bench_tpu_capture.json)
+#     - mfu_diag, twice, incl. the dependent-feedback method
+#       (artifacts/r05/mfu_diag.json): BERT-b8 step 1.377 ms = 65.9% MFU
+#     - seq_oldest re-run under the stability criterion: 1613 steps/s
+#       stable (BENCH_HISTORY probe record, snapshot in artifacts/r05)
+#   STILL MISSING on hardware —
+#     - gen_net (first attempt hit the warmup shed + a client segfault,
+#       both fixed; second attempt lost to a tunnel drop mid-warmup)
+#     - seq_streaming full sweep (c64 hung on the grpcio pool deadlock,
+#       fixed via max_workers; c16=195.5 / c32=333.3 were measured)
+#     - --mfu-study distribution with the feedback-scan method + trace
 cd /root/repo
 while true; do
   if timeout 90 python -c "import jax; d = jax.devices(); assert d[0].platform != 'cpu', d" 2>/dev/null; then
@@ -16,15 +23,12 @@ while true; do
     BENCH_SECTIONS=gen_net,seq_streaming timeout 1800 python bench.py \
       > artifacts/r05/bench_net_sections.json 2> bench_stderr_r5_net.log
     echo "NET DONE rc=$? $(date -u +%FT%TZ)" >> tunnel_watch.log
-    timeout 1800 python bench.py --mfu-study 5 \
+    timeout 2400 python bench.py --mfu-study 5 \
       > artifacts/r05/mfu_study.json 2> bench_stderr_r5_mfu.log
     echo "MFU DONE rc=$? $(date -u +%FT%TZ)" >> tunnel_watch.log
-    timeout 900 python tools/mfu_diag.py \
-      > artifacts/r05/mfu_diag.json 2> bench_stderr_r5_diag.log
-    echo "DIAG DONE rc=$? $(date -u +%FT%TZ)" >> tunnel_watch.log
     cp BENCH_HISTORY.json artifacts/r05/BENCH_HISTORY_snapshot.json
     cp bench_stderr_r5_net.log bench_stderr_r5_mfu.log \
-       bench_stderr_r5_diag.log artifacts/r05/ 2>/dev/null
+       artifacts/r05/ 2>/dev/null
     echo "ALL DONE $(date -u +%FT%TZ)" >> tunnel_watch.log
     exit 0
   fi
