@@ -912,13 +912,17 @@ def bench_seq_streaming(concurrencies=(16, 32, 64, 128)):
     from client_tpu.engine.repository import ModelRepository
     from client_tpu.models.simple import SequenceAccumulateBackend
 
-    # Same arena capacity as the in-process seq_oldest headline (128), and
-    # >= the sweep's top concurrency — the registry default of 64 would
-    # 429 the upper sweep points and change two variables at once.
+    # Arena capacity: 2x the sweep's top concurrency.  At cap == conc the
+    # top point fails on sequence ROLLOVER — the harness ends a sequence
+    # (16 steps) and immediately starts its replacement id, so for a
+    # moment conc+1 candidates are live and the oldest gets evicted
+    # mid-flight ("request without start flag for an inactive sequence").
+    # The registry default of 64 would 429 the upper points outright and
+    # change two variables at once.
     model = "simple_sequence_oldest"
     backend = SequenceAccumulateBackend(
         name=model, strategy="oldest",
-        max_candidate_sequences=max(max(concurrencies), 128))
+        max_candidate_sequences=max(2 * max(concurrencies), 128))
     repo = ModelRepository()
     repo.register_backend(backend)
     engine = TpuEngine(repo)
