@@ -78,7 +78,7 @@ def _append_history(entry: dict) -> None:
 
 
 _SECTION_NAMES = ("simple", "bert", "shm_ab", "shm_ab_large", "seq", "gen",
-                  "device_steady", "gen_net", "seq_streaming")
+                  "device_steady", "gen_net", "seq_streaming", "ssd_net")
 
 
 def _sections_filter() -> set | None:
@@ -979,6 +979,80 @@ def bench_seq_streaming(concurrencies=(16, 32, 64, 128)):
         engine.shutdown()
 
 
+def bench_ssd_net(concurrency: int = 64, window_ms: int = 5000):
+    """THE north-star measurement (BASELINE.json, driver-provided):
+    perf_analyzer inferences/sec + p99 latency on ssd_mobilenet_v2 with
+    tpu-shm tensor I/O, against the networked gRPC endpoint — the exact
+    config the reference measures with cudashm on H100
+    (load_manager.cc:287-446).  Until round 5 this existed only as an
+    in-process capi A/B (shm_ab) and a raw device step (device_steady);
+    this probe runs the reference's own harness shape: native client,
+    real wire, shm regions registered over the control plane, pa's
+    3-window stability criterion doing the stabilizing (-s, p99-gated).
+
+    Two points, one variable (the data plane): ``--shared-memory tpu``
+    vs inline ``none`` — same model, same concurrency, same windows.
+    """
+    import csv as _csv
+    import subprocess
+    import tempfile
+
+    pa = _native_pa()
+    if pa is None:
+        raise RuntimeError("native tpu_perf_analyzer not built")
+
+    from client_tpu.engine import TpuEngine
+    from client_tpu.models import build_repository
+    from client_tpu.server.grpc_server import GrpcInferenceServer
+
+    engine = TpuEngine(build_repository(["ssd_mobilenet_v2_tpu"]),
+                       warmup=True)
+    srv = GrpcInferenceServer(engine, port=0,
+                              max_workers=concurrency + 32).start()
+    out: dict = {}
+    try:
+        for plane in ("tpu", "none"):
+            with tempfile.NamedTemporaryFile(
+                    mode="r", suffix=".csv", delete=False) as tf:
+                csv_path = tf.name
+            cmd = [pa, "-m", "ssd_mobilenet_v2_tpu",
+                   "-u", f"127.0.0.1:{srv.port}", "-i", "grpc",
+                   "-p", str(window_ms), "-r", "10", "-s", "25",
+                   "--percentile", "99",
+                   "--concurrency-range", f"{concurrency}:{concurrency}",
+                   "-f", csv_path]
+            if plane != "none":
+                cmd += ["--shared-memory", plane]
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=600)
+            except subprocess.TimeoutExpired:
+                out[plane] = {"error": "timeout (600s)"}
+                log(f"ssd-net [{plane}]: TIMEOUT — point recorded as "
+                    "failed, probe continues")
+                continue
+            if proc.returncode != 0:
+                out[plane] = {
+                    "error": f"rc={proc.returncode}: {proc.stderr[-200:]}"}
+                log(f"ssd-net [{plane}]: rc={proc.returncode} — point "
+                    "recorded as failed, probe continues")
+                continue
+            with open(csv_path) as f:
+                rows = list(_csv.reader(f))
+            header, row = rows[0], rows[1]
+            ips = float(row[header.index("Inferences/Second")])
+            p99_us = float(row[header.index("p99 latency")])
+            out[plane] = {"ips": round(ips, 1), "p99_us": round(p99_us, 1)}
+            os.unlink(csv_path)
+            log(f"ssd-net [{plane}]: {ips:.1f} infer/s, p99 "
+                f"{p99_us / 1e3:.0f} ms (conc {concurrency}, b16 dynamic "
+                "batching, native grpc client)")
+        return out
+    finally:
+        srv.stop()
+        engine.shutdown()
+
+
 def bench_device_steady():
     """Steady-state device throughput for the flagship vision models
     (BASELINE.md configs 1/3/4) — pipelined device step via back-to-back
@@ -1416,6 +1490,14 @@ def _main():
                              "seq_streaming": seq_net})
         except Exception as exc:  # noqa: BLE001
             log(f"sequence streaming sweep failed: {exc!r}")
+    if _want("ssd_net"):
+        try:
+            _maybe_hang("ssd_net")
+            ssd_net = bench_ssd_net()
+            _RESULT["ssd_net"] = ssd_net
+            _append_history({"probe": "ssd_net", "ssd_net": ssd_net})
+        except Exception as exc:  # noqa: BLE001
+            log(f"ssd north-star bench failed: {exc!r}")
 
     # vs_baseline compares only same-platform runs — a CPU dev-box number is
     # not a baseline for the TPU chip or vice versa. Entries without a

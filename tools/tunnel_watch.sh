@@ -14,13 +14,15 @@
 #       both fixed; second attempt lost to a tunnel drop mid-warmup)
 #     - seq_streaming full sweep (c64 hung on the grpcio pool deadlock,
 #       fixed via max_workers; c16=195.5 / c32=333.3 were measured)
+#     - ssd_net, the new north-star probe (pa + tpu-shm + gRPC wire on
+#       ssd_mobilenet_v2_tpu; plumbing validated on CPU)
 #     - --mfu-study distribution with the feedback-scan method + trace
 cd /root/repo
 while true; do
   if timeout 90 python -c "import jax; d = jax.devices(); assert d[0].platform != 'cpu', d" 2>/dev/null; then
     echo "TUNNEL UP $(date -u +%FT%TZ)" >> tunnel_watch.log
     mkdir -p artifacts/r05
-    BENCH_SECTIONS=gen_net,seq_streaming timeout 1800 python bench.py \
+    BENCH_SECTIONS=gen_net,seq_streaming,ssd_net timeout 2400 python bench.py \
       > artifacts/r05/bench_net_sections.json 2> bench_stderr_r5_net.log
     echo "NET DONE rc=$? $(date -u +%FT%TZ)" >> tunnel_watch.log
     timeout 2400 python bench.py --mfu-study 5 \
