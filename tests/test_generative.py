@@ -774,6 +774,65 @@ class TestPerRequestShedding:
             DecoupledScheduler.BACKPRESSURE_TIMEOUT_S = saved_timeout
             eng.shutdown()
 
+    def test_stalled_stream_pauses_without_blocking_sibling_decode(self):
+        """Round-5 flow control, the generative arena case: a stalled
+        consumer's stream is PAUSED (skipped at wave formation), not
+        shed — and a sibling stream on the same model keeps decoding at
+        full speed.  Two separate stream RPCs on one engine: A stalls
+        after the first message; B drains fully.  B must complete all
+        its tokens with no error; A must still be live (throttled, not
+        cancelled) afterwards."""
+        import time as _time
+
+        from client_tpu.protocol import grpc_codec
+        from client_tpu.protocol import grpc_service_pb2 as pb
+        from client_tpu.server.grpc_server import _Servicer
+
+        eng = TpuEngine(build_repository(["tiny_gpt"]))
+        try:
+            servicer = _Servicer(eng, stream_pending_limit=8)
+
+            class FakeContext:
+                def add_callback(self, cb):
+                    return True
+
+                def is_active(self):
+                    return True
+
+            def gen_req(rid, prompt, n):
+                req = pb.ModelInferRequest(model_name="tiny_gpt", id=rid)
+                t = req.inputs.add()
+                t.name, t.datatype = "INPUT_IDS", "INT32"
+                t.shape.extend([len(prompt)])
+                t.contents.int_contents.extend(prompt)
+                grpc_codec.set_param(req.parameters, "max_tokens", n)
+                return req
+
+            stream_a = servicer.ModelStreamInfer(
+                iter([gen_req("a", [1, 2], 60)]), FakeContext())
+            first = next(stream_a)  # starts A's pump; then stall
+            assert not first.error_message
+            _time.sleep(0.3)  # A floods to its mark and gets throttled
+
+            stream_b = servicer.ModelStreamInfer(
+                iter([gen_req("b", [3, 4], 12)]), FakeContext())
+            msgs_b = list(stream_b)  # actively draining sibling
+            errors_b = [m.error_message for m in msgs_b
+                        if m.error_message]
+            assert not errors_b, errors_b
+            tokens_b = sum(
+                1 for m in msgs_b
+                if not m.error_message and m.infer_response.outputs)
+            assert tokens_b == 12, tokens_b
+
+            # A is parked, not shed: its stream still holds an arena row
+            # (the reclaim timeout is 60s, far beyond this test).
+            sched = eng._schedulers["tiny_gpt"]
+            assert any(s.req.request_id == "a" for s in sched._streams), \
+                "stalled stream was dropped instead of paused"
+        finally:
+            eng.shutdown()
+
     def test_burst_with_draining_reader_not_shed(self):
         """Round-5 regression (gen_net warmup failure on TPU): a producer
         that BURSTS past the soft mark while the consumer is actively
