@@ -172,6 +172,10 @@ tpuclient::Error CreateCApiBackend(const std::string& lib_path,
 tpuclient::Error CreateTfServeBackend(
     const std::string& url, bool verbose,
     std::unique_ptr<ClientBackend>* backend);
+// Override the TFS PredictionService signature ("serving_default" by
+// default; reference --model-signature-name).  Process-wide: the CLI sets
+// it once, before any backend exists.  Defined in tfserve_backend.cc.
+void SetTfServeSignatureName(const std::string& name);
 tpuclient::Error CreateTorchServeBackend(
     const std::string& url, bool verbose,
     std::unique_ptr<ClientBackend>* backend);

@@ -188,6 +188,7 @@ Error LoadManager::MakeContext(ThreadConfig* config, InferContext** out) {
   ctx->options = std::make_unique<InferOptions>(parser_->Name());
   ctx->options->model_version = parser_->Version();
   ctx->options->client_timeout_us = options_.request_timeout_us;
+  ctx->options->compression_algorithm = options_.compression;
   ctx->stream = config->index % std::max<size_t>(1, data_loader_->StreamCount());
 
   bool batched = parser_->MaxBatchSize() > 0;

@@ -36,6 +36,13 @@ struct LoadOptions {
   // sequence load (reference load_manager.cc:676-719)
   uint64_t start_sequence_id = 1;
   uint64_t sequence_length = 20;
+  // Distinct concurrent sequences under request-rate/custom load
+  // (reference --num-of-sequences, default 4; concurrency mode sizes the
+  // sequence pool by the concurrency level instead).
+  size_t num_of_sequences = 4;
+  // Per-call gRPC message compression for every generated request
+  // (reference --grpc-compression-algorithm).
+  tpuclient::GrpcCompression compression = tpuclient::GrpcCompression::NONE;
   uint64_t request_timeout_us = 0;
 };
 
@@ -104,6 +111,10 @@ class LoadManager {
 
   struct ThreadConfig {
     size_t index = 0;
+    // Context-pool cap for this worker: bounds the number of distinct
+    // live sequences it drives (set from LoadOptions.num_of_sequences by
+    // the rate manager for sequence models; unbounded otherwise).
+    size_t max_ctxs = SIZE_MAX;
     // Written by StartWorkers while a previously-started worker may still be
     // mid-iteration (PauseWorkers does not quiesce), read in the schedule
     // walk — atomic to keep that benign overlap defined.

@@ -60,6 +60,13 @@ Options:
   --string-data <s>      fixed BYTES element value
   --sequence-length <n>  requests per sequence (default 20)
   --start-sequence-id <n>
+  --num-of-sequences <n> distinct concurrent sequences under request-rate
+                         or custom-interval load (default 4; concurrency
+                         mode sizes the pool by the concurrency level)
+  --grpc-compression-algorithm <none|gzip|deflate>  per-call message
+                         compression on gRPC requests (default none)
+  --model-signature-name <s>  TFS PredictionService signature
+                         (tfserving kind; default serving_default)
   --shared-memory <none|system|tpu>   tensor transport (default none)
   --output-shared-memory-size <bytes>
   --max-threads <n>      worker thread cap (default 16)
@@ -117,6 +124,9 @@ struct Args {
   DataLoader::Options data_opts;
   uint64_t sequence_length = 20;
   uint64_t start_sequence_id = 1;
+  size_t num_of_sequences = 4;
+  tpuclient::GrpcCompression compression = tpuclient::GrpcCompression::NONE;
+  std::string signature_name;  // --model-signature-name (TFS kind)
   SharedMemoryType shm = SharedMemoryType::NONE;
   size_t output_shm_size = 100 * 1024;
   size_t max_threads = 16;
@@ -546,6 +556,18 @@ int main(int argc, char** argv) {
       {"capi-library-path", required_argument, nullptr, 1018},
       {"capi-models", required_argument, nullptr, 1019},
       {"capi-repo-root", required_argument, nullptr, 1020},
+      // Reference long spellings of the short options (main.cc:708-740):
+      // both forms accepted, same semantics.
+      {"async", no_argument, nullptr, 'a'},
+      {"sync", no_argument, nullptr, 1026},
+      {"measurement-interval", required_argument, nullptr, 'p'},
+      {"stability-percentage", required_argument, nullptr, 's'},
+      {"max-trials", required_argument, nullptr, 'r'},
+      {"latency-threshold", required_argument, nullptr, 'l'},
+      {"data-directory", required_argument, nullptr, 1008},
+      {"grpc-compression-algorithm", required_argument, nullptr, 1027},
+      {"model-signature-name", required_argument, nullptr, 1028},
+      {"num-of-sequences", required_argument, nullptr, 1029},
       {"help", no_argument, nullptr, 'h'},
       {nullptr, 0, nullptr, 0}};
 
@@ -650,6 +672,20 @@ int main(int argc, char** argv) {
       case 1024:
         args.gen_max_tokens = strtoull(optarg, nullptr, 10);
         break;
+      case 1026: args.async = false; break;
+      case 1027:
+        if (strcmp(optarg, "gzip") == 0)
+          args.compression = tpuclient::GrpcCompression::GZIP;
+        else if (strcmp(optarg, "deflate") == 0)
+          args.compression = tpuclient::GrpcCompression::DEFLATE;
+        else if (strcmp(optarg, "none") != 0)
+          Usage("--grpc-compression-algorithm must be none|gzip|deflate");
+        break;
+      case 1028: args.signature_name = optarg; break;
+      case 1029:
+        args.num_of_sequences =
+            std::max<size_t>(1, strtoull(optarg, nullptr, 10));
+        break;
       default: Usage("unknown option");
     }
   }
@@ -700,6 +736,9 @@ int main(int argc, char** argv) {
   }
 
   // --- backend + parser -----------------------------------------------------
+  if (!args.signature_name.empty()) {
+    SetTfServeSignatureName(args.signature_name);
+  }
   ClientBackendFactory factory(args.kind, args.url, args.verbose,
                                /*max_async_concurrency=*/32);
   factory.SetCApiOptions(args.capi_lib, args.capi_models,
@@ -762,6 +801,8 @@ int main(int argc, char** argv) {
   load_opts.output_shm_size = args.output_shm_size;
   load_opts.sequence_length = args.sequence_length;
   load_opts.start_sequence_id = args.start_sequence_id;
+  load_opts.num_of_sequences = args.num_of_sequences;
+  load_opts.compression = args.compression;
 
   std::unique_ptr<LoadManager> manager;
   enum class Mode { CONCURRENCY, RATE, CUSTOM } mode = Mode::CONCURRENCY;

@@ -49,6 +49,12 @@ Error RequestRateManager::ChangeRequestRate(double request_rate) {
       std::min<size_t>(options_.max_threads,
                        std::max<size_t>(1, static_cast<size_t>(
                                                request_rate / 100) + 1));
+  if (is_sequence_) {
+    // Each context is one live sequence; --num-of-sequences bounds the
+    // total, so never spin up more workers than sequences.
+    n_threads = std::min<size_t>(
+        n_threads, std::max<size_t>(1, options_.num_of_sequences));
+  }
   StartWorkers(n_threads);
   return Error::Success();
 }
@@ -83,6 +89,17 @@ void RequestRateManager::StartWorkers(size_t n_threads) {
   }
   for (auto& config : thread_configs_) {
     config->stride = threads_.size();
+    if (is_sequence_) {
+      // Distribute --num-of-sequences across the workers: context = one
+      // live sequence, so the per-thread context cap bounds the total
+      // number of distinct concurrent sequences (reference
+      // --num-of-sequences semantics under request-rate load).
+      size_t n = std::max<size_t>(1, options_.num_of_sequences);
+      size_t per = n / threads_.size();
+      size_t extra = n % threads_.size();
+      config->max_ctxs = std::max<size_t>(
+          1, per + (config->index < extra ? 1 : 0));
+    }
   }
   delayed_.store(false);
   epoch_ns_.store(NowNs());
@@ -139,6 +156,23 @@ void RequestRateManager::WorkerLoop(std::shared_ptr<ThreadStat> stat,
         ctx = c.get();
         break;
       }
+    }
+    if (ctx == nullptr && config->ctxs.size() >= config->max_ctxs) {
+      // Sequence-pool cap (--num-of-sequences): all of this worker's
+      // sequences are mid-request; wait for one to go idle instead of
+      // opening a new sequence beyond the requested pool.
+      while (!exit_.load() && running_.load() && ctx == nullptr) {
+        for (auto& c : config->ctxs) {
+          if (!c->inflight) {
+            ctx = c.get();
+            break;
+          }
+        }
+        if (ctx == nullptr) {
+          std::this_thread::sleep_for(std::chrono::milliseconds(1));
+        }
+      }
+      if (ctx == nullptr) continue;  // paused or exiting
     }
     if (ctx == nullptr) {
       Error err = MakeContext(config.get(), &ctx);

@@ -30,7 +30,9 @@ namespace {
 
 namespace tfs = tensorflow::serving;
 
-constexpr const char* kSignatureName = "serving_default";
+// --model-signature-name override (process-wide; set by the CLI before
+// any backend exists, so no synchronization is needed).
+std::string g_signature_name = "serving_default";
 
 struct DtypePair { const char* v2; tfs::DataType tf; };
 constexpr DtypePair kDtypes[] = {
@@ -231,7 +233,7 @@ class TfServeClientBackend : public ClientBackend {
                       const std::string& version) override {
     tfs::GetModelMetadataRequest req;
     req.mutable_model_spec()->set_name(model_name);
-    req.mutable_model_spec()->set_signature_name(kSignatureName);
+    req.mutable_model_spec()->set_signature_name(g_signature_name);
     if (!version.empty())
       req.mutable_model_spec()->mutable_version()->set_value(
           atoll(version.c_str()));
@@ -248,9 +250,9 @@ class TfServeClientBackend : public ClientBackend {
     tfs::SignatureDefMap sigmap;
     if (!it->second.UnpackTo(&sigmap))
       return Error("failed to unpack SignatureDefMap", 400);
-    auto sit = sigmap.signature_def().find(kSignatureName);
+    auto sit = sigmap.signature_def().find(g_signature_name);
     if (sit == sigmap.signature_def().end())
-      return Error("signature '" + std::string(kSignatureName) +
+      return Error("signature '" + g_signature_name +
                        "' not found in TFS metadata",
                    400);
 
@@ -303,7 +305,7 @@ class TfServeClientBackend : public ClientBackend {
                   outputs) override {
     tfs::PredictRequest req;
     req.mutable_model_spec()->set_name(options.model_name);
-    req.mutable_model_spec()->set_signature_name(kSignatureName);
+    req.mutable_model_spec()->set_signature_name(g_signature_name);
     if (!options.model_version.empty())
       req.mutable_model_spec()->mutable_version()->set_value(
           atoll(options.model_version.c_str()));
@@ -391,6 +393,10 @@ class TfServeClientBackend : public ClientBackend {
 Error CreateTfServeBackend(const std::string& url, bool verbose,
                            std::unique_ptr<ClientBackend>* backend) {
   return TfServeClientBackend::Create(url, verbose, backend);
+}
+
+void SetTfServeSignatureName(const std::string& name) {
+  g_signature_name = name;
 }
 
 }  // namespace tpuperf

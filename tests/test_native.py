@@ -155,6 +155,54 @@ def test_perf_analyzer_smoke(native_build, server, tmp_path):
     assert ips > 0
 
 
+def test_perf_analyzer_long_flag_aliases(native_build, server, tmp_path):
+    """Reference long spellings of the short options (--measurement-interval,
+    --stability-percentage, --max-trials, --sync; reference main.cc option
+    table): both forms accepted, same semantics."""
+    csv = tmp_path / "alias.csv"
+    proc = subprocess.run(
+        [os.path.join(native_build, "tpu_perf_analyzer"),
+         "-m", "simple", "-u", server.url,
+         "--measurement-interval", "600", "--max-trials", "6",
+         "--stability-percentage", "70", "--sync",
+         "--concurrency-range", "2:2", "-f", str(csv)],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = csv.read_text().strip().splitlines()
+    header, row = lines[0].split(","), lines[1].split(",")
+    assert float(row[header.index("Inferences/Second")]) > 0
+
+
+def test_perf_analyzer_grpc_compression_flag(native_build, grpc_server):
+    """--grpc-compression-algorithm gzip: every generated request rides the
+    native client's per-call message compression (reference flag; the
+    grpcio server transparently decompresses)."""
+    proc = subprocess.run(
+        [os.path.join(native_build, "tpu_perf_analyzer"),
+         "-m", "simple", "-u", f"127.0.0.1:{grpc_server.port}",
+         "-i", "grpc", "--grpc-compression-algorithm", "gzip",
+         "-p", "600", "-r", "6", "-s", "70",
+         "--concurrency-range", "2:2"],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Throughput" in proc.stdout
+
+
+def test_perf_analyzer_num_of_sequences_rate_mode(native_build, server):
+    """--num-of-sequences under request-rate load: the sequence pool is
+    bounded to N distinct concurrent sequences (reference semantics; in
+    concurrency mode the pool is sized by the concurrency level)."""
+    proc = subprocess.run(
+        [os.path.join(native_build, "tpu_perf_analyzer"),
+         "-m", "simple_sequence", "-u", server.url, "-a",
+         "--request-rate-range", "50:50", "--num-of-sequences", "2",
+         "--sequence-length", "4",
+         "-p", "800", "-r", "6", "-s", "70"],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Throughput" in proc.stdout
+
+
 def test_client_timeout_binary(native_build, server, grpc_server):
     """Reference test parity: client_timeout_test drives sync/async/stream
     over both protocols with microsecond and generous deadlines
@@ -560,6 +608,24 @@ def test_perf_analyzer_tfserving(native_build, fake_tfs_server, tmp_path):
     lines = csv.read_text().strip().splitlines()
     header, row = lines[0].split(","), lines[1].split(",")
     assert float(row[header.index("Inferences/Second")]) > 0
+
+
+def test_perf_analyzer_tfs_signature_flag(native_build, fake_tfs_server):
+    """--model-signature-name (reference flag, TFS kind): an explicit
+    signature reaches GetModelMetadata/Predict; naming the served default
+    works, naming a missing one fails with the signature in the error."""
+    base = [os.path.join(native_build, "tpu_perf_analyzer"),
+            "-m", "toy", "--service-kind", "tfserving",
+            "-u", f"127.0.0.1:{fake_tfs_server}",
+            "-p", "300", "-r", "4", "-s", "70",
+            "--concurrency-range", "1:1"]
+    ok = subprocess.run(base + ["--model-signature-name", "serving_default"],
+                        capture_output=True, text=True, timeout=120)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = subprocess.run(base + ["--model-signature-name", "nope"],
+                         capture_output=True, text=True, timeout=120)
+    assert bad.returncode != 0
+    assert "nope" in (bad.stdout + bad.stderr)
 
 
 @pytest.fixture(scope="module")
