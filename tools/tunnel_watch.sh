@@ -28,9 +28,12 @@ while true; do
     timeout 2400 python bench.py --mfu-study 5 \
       > artifacts/r05/mfu_study.json 2> bench_stderr_r5_mfu.log
     echo "MFU DONE rc=$? $(date -u +%FT%TZ)" >> tunnel_watch.log
+    timeout 1800 python tools/gen_chunk_sweep.py \
+      > artifacts/r05/gen_chunk_sweep.json 2> bench_stderr_r5_sweep.log
+    echo "SWEEP DONE rc=$? $(date -u +%FT%TZ)" >> tunnel_watch.log
     cp BENCH_HISTORY.json artifacts/r05/BENCH_HISTORY_snapshot.json
     cp bench_stderr_r5_net.log bench_stderr_r5_mfu.log \
-       artifacts/r05/ 2>/dev/null
+       bench_stderr_r5_sweep.log artifacts/r05/ 2>/dev/null
     echo "ALL DONE $(date -u +%FT%TZ)" >> tunnel_watch.log
     exit 0
   fi
