@@ -13,6 +13,7 @@ import heapq
 import logging
 import queue
 import threading
+import time
 from typing import Callable
 
 import numpy as np
@@ -53,12 +54,10 @@ def _wait_while_backpressured(req: InferRequest,
     """Writer-paced production for decoupled emit loops: park until the
     frontend drains (or the request is cancelled).  Bounded — after
     max_wait_s production resumes and the shed policy owns the outcome."""
-    import time as _time
-
-    deadline = _time.monotonic() + max_wait_s
+    deadline = time.monotonic() + max_wait_s
     while (_backpressured(req) and not req.cancelled
-           and _time.monotonic() < deadline):
-        _time.sleep(poll_s)
+           and time.monotonic() < deadline):
+        time.sleep(poll_s)
 
 
 def power_buckets(n: int) -> list[int]:
