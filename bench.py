@@ -22,7 +22,9 @@ from __future__ import annotations
 
 import contextlib
 import json
+import math
 import os
+import signal
 import sys
 import threading
 import time
@@ -122,6 +124,114 @@ def _maybe_hang(section: str) -> None:
         log(f"SIMULATING device hang at section {section!r} "
             "(BENCH_SIMULATE_HANG)")
         threading.Event().wait()
+
+
+class _SectionTimeout(BaseException):
+    """A bench section exceeded BENCH_SECTION_DEADLINE_S (device hang).
+
+    BaseException, not Exception: probes have their own internal
+    `except Exception` fault isolation (per-model, per-sweep-point), and
+    the deadline must cut through those — observed otherwise the alarm
+    gets swallowed by an inner handler and the section runs on unbounded
+    with no alarm armed."""
+
+
+# Sections whose probe raised (timeout or error) this run — carried on the
+# final emit as `sections_failed` so a capture with a dead probe can never
+# pass for a complete one.
+_FAILED: list = []
+
+
+def _note_failure(section: str, exc: BaseException) -> None:
+    _FAILED.append(section)
+    log(f"section {section!r} failed: {exc!r}")
+
+
+@contextlib.contextmanager
+def _section_guard(section: str):
+    """Per-section deadline: a tunnel stall inside ONE probe must cost that
+    probe, not the rest of the window (observed round 5: a drop during
+    gen_net's engine warmup hung a 40-minute capture window that
+    seq_streaming/ssd_net could have used once the tunnel returned —
+    device waits raise no exception, so the per-section try/except alone
+    cannot catch them).  SIGALRM aborts the section with _SectionTimeout,
+    which the section's existing failure handling records, and the run
+    moves on.  Sections run on the main thread; elsewhere (or with the
+    knob set to 0) the guard is just the hang-simulation entry hook.
+    Default 600s: above every section's honest worst case on the dev
+    tunnel, far under the run watchdog (BENCH_DEADLINE_S, 1500s).
+
+    Boundary condition, stated plainly: the handler can only raise when
+    the main thread re-enters the bytecode eval loop (PEP 475), so the
+    guard covers waits that poll or retry through Python — which is the
+    observed shape of an axon tunnel stall (main thread in a nanosleep
+    poll loop; verified via /proc wchan during the round-5 hang) and of
+    every subprocess/sleep/lock wait in the sections.  A wait pinned
+    inside a C call that never yields would ride through the alarm; the
+    run-level watchdog (BENCH_DEADLINE_S) remains the backstop for that
+    shape, exactly as before this guard existed."""
+    secs = float(os.environ.get("BENCH_SECTION_DEADLINE_S", "600"))
+    if secs <= 0 or threading.current_thread() is not threading.main_thread():
+        _maybe_hang(section)
+        yield
+        return
+
+    def _on_alarm(signum, frame):
+        # Re-arm a grace alarm BEFORE raising: the timeout unwinds through
+        # the probe's own cleanup (`finally: engine.shutdown()` etc.), and
+        # on a dead tunnel that cleanup can block in a Python-level wait
+        # too — each grace firing cuts through it again until the guard's
+        # finally disarms for good.
+        signal.alarm(60)
+        raise _SectionTimeout(
+            f"section {section!r} exceeded {secs:.0f}s (device hang?)")
+
+    old = signal.signal(signal.SIGALRM, _on_alarm)
+    # ceil, not int(): a sub-second knob value must not truncate to
+    # alarm(0) == "no alarm armed".
+    signal.alarm(max(1, math.ceil(secs)))
+    try:
+        # Inside the armed window: simulated hangs must be bounded the same
+        # way real ones are (the CI test for this guard relies on it).
+        _maybe_hang(section)
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _run_section(section: str, probe, record):
+    """Run one bench section.  ``probe`` (no-arg) executes under the
+    per-section deadline; ``record`` (result -> None) runs after the
+    alarm is disarmed, so a deadline firing at a section's tail can
+    never split a measured result from its _RESULT/history record — the
+    two land together or the section counts as failed.  Failures
+    (timeout or error) are noted centrally and the run continues.
+    Returns the probe result, or None if filtered out or failed."""
+    if not _want(section):
+        return None
+    t0 = time.monotonic()
+    try:
+        with _section_guard(section):
+            res = probe()
+    except (Exception, _SectionTimeout) as exc:  # noqa: BLE001 — later
+        # sections still run
+        _note_failure(section, exc)
+        return None
+    finally:
+        # Per-section wall time rides every emit (including partials — the
+        # watchdog copies _RESULT) so full-run duration budgeting against
+        # the watchdog window is data, not guesswork.
+        _RESULT.setdefault("section_s", {})[section] = round(
+            time.monotonic() - t0, 1)
+    try:
+        record(res)
+    except Exception as exc:  # noqa: BLE001 — a recorder bug (bad key,
+        # unserializable value) costs this section, not the rest of the
+        # run's tunnel window
+        _note_failure(section, exc)
+        return None
+    return res
 
 
 # bf16 peak FLOP/s per chip by TPU generation (public spec sheets).
@@ -1309,44 +1419,66 @@ def _run_with_watchdog(target, metric: str = "inproc_simple_ips",
     deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "1500"))
     finished = threading.Event()
 
+    def emit_partial(status: str, reason: str | None = None):
+        # ONE constructor for every failure-path emit (watchdog partial and
+        # crash) so the two schemas cannot diverge by hand-synchronization.
+        # Self-describing (VERDICT r4 #7): consumers must never have to
+        # infer "0.0 means outage" — completed sections are already in
+        # _RESULT (each probe merges in as it finishes and has persisted to
+        # BENCH_HISTORY independently), the filter tag says why a short run
+        # is short, and `status` names the failure mode.
+        partial = dict(_RESULT)
+        partial.setdefault("metric", metric)
+        partial.setdefault("unit", unit)
+        # A failure before the first section completes leaves _RESULT
+        # empty; the driver schema still needs a numeric value field.
+        partial.setdefault("value", 0.0)
+        partial["partial"] = True
+        partial["status"] = status
+        if reason is not None:
+            partial["reason"] = reason
+        try:
+            sections_env = _sections_tag()
+        except BaseException:  # noqa: BLE001 — when the crash being
+            # reported IS the filter validation error, re-validating here
+            # would re-raise it and kill the emit; fall back to the raw env
+            sections_env = os.environ.get("BENCH_SECTIONS", "").strip()
+        if sections_env:
+            partial["sections"] = sections_env
+        if _FAILED:
+            partial["sections_failed"] = sorted(set(_FAILED))
+        partial["sections_completed"] = sorted(
+            k for k in partial
+            if k not in ("metric", "unit", "value", "partial", "status",
+                         "reason", "sections", "sections_completed",
+                         "sections_failed", "section_s"))
+        _append_history({"probe": "run-status", "status": status,
+                         **({"reason": reason} if reason else {}),
+                         **({"sections": sections_env} if sections_env
+                            else {}),
+                         **({"sections_failed": partial["sections_failed"]}
+                            if _FAILED else {}),
+                         "sections_completed":
+                             partial["sections_completed"]})
+        _emit(partial)
+
     def watchdog():
         if finished.wait(deadline_s):
             return
         log(f"WATCHDOG: bench exceeded {deadline_s:.0f}s (device hang?); "
             "emitting partial results")
-        partial = dict(_RESULT)
-        partial.setdefault("metric", metric)
-        partial.setdefault("unit", unit)
-        # A hang before the first section completes leaves _RESULT empty;
-        # the driver schema still needs a numeric value field.
-        partial.setdefault("value", 0.0)
-        partial["partial"] = True
-        # Self-describing partial (VERDICT r4 #7): consumers must never have
-        # to infer "0.0 means outage".  Completed sections are already in
-        # _RESULT (each probe merges in as it finishes and has independently
-        # persisted to BENCH_HISTORY), so the partial carries probe-level
-        # detail; `status` names the failure mode.
-        partial["status"] = "partial-outage"
-        # A filtered run that hangs must not read as a full-run outage:
-        # carry the filter so "sections_completed is short" has its cause.
-        sections_env = _sections_tag()
-        if sections_env:
-            partial["sections"] = sections_env
-        partial["sections_completed"] = sorted(
-            k for k in partial
-            if k not in ("metric", "unit", "value", "partial", "status",
-                         "sections", "sections_completed"))
-        _append_history({"probe": "run-status", "status": "partial-outage",
-                         **({"sections": sections_env} if sections_env
-                            else {}),
-                         "sections_completed":
-                             partial["sections_completed"]})
-        _emit(partial)
+        emit_partial("partial-outage")
         os._exit(0)
 
     threading.Thread(target=watchdog, daemon=True).start()
     try:
         target()
+    except BaseException as exc:  # noqa: BLE001 — emit before propagating
+        # A crash (not a hang) still owes the driver its one JSON line:
+        # completed sections plus the crash reason instead of leaving
+        # stdout empty with a nonzero rc.
+        emit_partial("error", reason=repr(exc)[:300])
+        raise
     finally:
         finished.set()
 
@@ -1379,125 +1511,90 @@ def _main():
     # filtering works on probe records as well as run aggregates.
     _HIST_CTX.update({"platform": platform, "config": config})
 
-    simple, ips, p99_us = None, None, None
-    bert_ips, mfu = None, None
-    seq_steps_s, gen = None, None
-    if _want("simple"):
-        _maybe_hang("simple")
-        simple = bench_inproc_simple()
-        ips, p99_us = simple["ips"], simple["p99_us"]
+    def _rec_simple(s):
         _RESULT.update({"metric": "inproc_simple_ips",
-                        "value": round(ips, 2), "unit": "infer/sec",
-                        "p99_us": round(p99_us, 1),
-                        "stable": simple["stable"],
-                        "windows": simple["windows"]})
+                        "value": round(s["ips"], 2), "unit": "infer/sec",
+                        "p99_us": round(s["p99_us"], 1),
+                        "stable": s["stable"],
+                        "windows": s["windows"]})
         _append_history({"probe": "simple", "metric": "inproc_simple_ips",
-                         "value": ips, "p99_us": p99_us,
-                         "stable": simple["stable"],
-                         "windows": simple["windows"]})
-    if _want("bert"):
-        try:
-            _maybe_hang("bert")
-            bres = bench_bert_mfu()
-            bert_ips, mfu = bres["ips"], bres["mfu"]
-            _RESULT["bert_b8_ips"] = round(bert_ips, 2)
-            _RESULT["bert_b8_step_ms"] = round(bres["step_s"] * 1e3, 3)
-            _RESULT["bert_b8_step_method"] = bres["step_method"]
-            _RESULT["bert_b8_dispatch_step_ms"] = round(
-                bres["dispatch_step_s"] * 1e3, 3)
-            _RESULT["bert_b8_e2e_ms"] = round(bres["e2e_s"] * 1e3, 3)
-            if mfu is not None:
-                _RESULT["bert_b8_mfu"] = round(mfu, 4)
-            _append_history({"probe": "bert", "bert_ips": bert_ips,
-                             "mfu": mfu,
-                             "step_ms": bres["step_s"] * 1e3,
-                             "step_method": bres["step_method"],
-                             "dispatch_step_ms":
-                                 bres["dispatch_step_s"] * 1e3,
-                             "e2e_ms": bres["e2e_s"] * 1e3})
-        except Exception as exc:  # noqa: BLE001 — headline still reports
-            log(f"bert mfu measurement failed: {exc!r}")
-            bert_ips, mfu = None, None
-    if _want("shm_ab"):
-        try:
-            _maybe_hang("shm_ab")
-            shm_ab = bench_shm_ab()
-            _RESULT["shm_ab"] = shm_ab
-            tpushm_ips = (shm_ab.get("tpu") or {}).get("ips")
-            if tpushm_ips is not None:
-                _RESULT["tpushm_ips"] = round(tpushm_ips, 2)
-            _append_history({"probe": "shm_ab", "shm_ab": shm_ab})
-        except Exception as exc:  # noqa: BLE001
-            log(f"shm A/B bench failed: {exc!r}")
-    if _want("shm_ab_large"):
-        try:
-            _maybe_hang("shm_ab_large")
-            shm_ab_large = bench_shm_ab_large()
-            _RESULT["shm_ab_large"] = shm_ab_large
-            _append_history({"probe": "shm_ab_large",
-                             "shm_ab_large": shm_ab_large})
-        except Exception as exc:  # noqa: BLE001
-            log(f"large-tensor shm A/B bench failed: {exc!r}")
-    if _want("seq"):
-        try:
-            _maybe_hang("seq")
-            seq_res = bench_sequence_oldest()
-            seq_steps_s = seq_res["steps_s"]
-            _RESULT["seq_oldest_steps_s"] = round(seq_steps_s, 1)
-            _RESULT["seq_oldest"] = seq_res
-            _append_history({"probe": "seq_oldest",
-                             "seq_oldest_steps_s": seq_steps_s,
-                             "stable": seq_res["stable"],
-                             "avg_wave": seq_res["avg_wave"],
-                             "windows": seq_res["windows"]})
-        except Exception as exc:  # noqa: BLE001
-            log(f"sequence-oldest bench failed: {exc!r}")
-    if _want("gen"):
-        try:
-            _maybe_hang("gen")
-            gen = bench_generative()
-            _RESULT["gen"] = gen
-            _RESULT["gen_tok_s"] = gen["tok_s"]
-            _append_history({"probe": "gen", "gen": gen})
-        except Exception as exc:  # noqa: BLE001
-            log(f"generative bench failed: {exc!r}")
-    # Section order = re-capture priority (VERDICT r4 #1c): the round-4
-    # rows missing artifacts come before this round's new probes, so a
-    # mid-run outage costs the least-established evidence first.
-    if _want("device_steady"):
-        try:
-            _maybe_hang("device_steady")
-            steady = bench_device_steady()
-            _RESULT["device_steady"] = steady
-            _append_history({"probe": "device_steady",
-                             "device_steady": steady})
-        except Exception as exc:  # noqa: BLE001
-            log(f"device-steady bench failed: {exc!r}")
-    if _want("gen_net"):
-        try:
-            _maybe_hang("gen_net")
-            gen_net = bench_gen_net()
-            _RESULT["gen_net"] = gen_net
-            _append_history({"probe": "gen_net", "gen_net": gen_net})
-        except Exception as exc:  # noqa: BLE001
-            log(f"networked generative bench failed: {exc!r}")
-    if _want("seq_streaming"):
-        try:
-            _maybe_hang("seq_streaming")
-            seq_net = bench_seq_streaming()
-            _RESULT["seq_streaming"] = seq_net
-            _append_history({"probe": "seq_streaming",
-                             "seq_streaming": seq_net})
-        except Exception as exc:  # noqa: BLE001
-            log(f"sequence streaming sweep failed: {exc!r}")
-    if _want("ssd_net"):
-        try:
-            _maybe_hang("ssd_net")
-            ssd_net = bench_ssd_net()
-            _RESULT["ssd_net"] = ssd_net
-            _append_history({"probe": "ssd_net", "ssd_net": ssd_net})
-        except Exception as exc:  # noqa: BLE001
-            log(f"ssd north-star bench failed: {exc!r}")
+                         "value": s["ips"], "p99_us": s["p99_us"],
+                         "stable": s["stable"], "windows": s["windows"]})
+
+    def _rec_bert(b):
+        _RESULT["bert_b8_ips"] = round(b["ips"], 2)
+        _RESULT["bert_b8_step_ms"] = round(b["step_s"] * 1e3, 3)
+        _RESULT["bert_b8_step_method"] = b["step_method"]
+        _RESULT["bert_b8_dispatch_step_ms"] = round(
+            b["dispatch_step_s"] * 1e3, 3)
+        _RESULT["bert_b8_e2e_ms"] = round(b["e2e_s"] * 1e3, 3)
+        if b["mfu"] is not None:
+            _RESULT["bert_b8_mfu"] = round(b["mfu"], 4)
+        _append_history({"probe": "bert", "bert_ips": b["ips"],
+                         "mfu": b["mfu"], "step_ms": b["step_s"] * 1e3,
+                         "step_method": b["step_method"],
+                         "dispatch_step_ms": b["dispatch_step_s"] * 1e3,
+                         "e2e_ms": b["e2e_s"] * 1e3})
+
+    def _rec_shm_ab(shm_ab):
+        _RESULT["shm_ab"] = shm_ab
+        tpushm_ips = (shm_ab.get("tpu") or {}).get("ips")
+        if tpushm_ips is not None:
+            _RESULT["tpushm_ips"] = round(tpushm_ips, 2)
+        _append_history({"probe": "shm_ab", "shm_ab": shm_ab})
+
+    def _rec_shm_ab_large(r):
+        _RESULT["shm_ab_large"] = r
+        _append_history({"probe": "shm_ab_large", "shm_ab_large": r})
+
+    def _rec_seq(s):
+        _RESULT["seq_oldest_steps_s"] = round(s["steps_s"], 1)
+        _RESULT["seq_oldest"] = s
+        _append_history({"probe": "seq_oldest",
+                         "seq_oldest_steps_s": s["steps_s"],
+                         "stable": s["stable"], "avg_wave": s["avg_wave"],
+                         "windows": s["windows"]})
+
+    def _rec_gen(g):
+        _RESULT["gen"] = g
+        _RESULT["gen_tok_s"] = g["tok_s"]
+        _append_history({"probe": "gen", "gen": g})
+
+    def _rec_device_steady(r):
+        _RESULT["device_steady"] = r
+        _append_history({"probe": "device_steady", "device_steady": r})
+
+    def _rec_gen_net(r):
+        _RESULT["gen_net"] = r
+        _append_history({"probe": "gen_net", "gen_net": r})
+
+    def _rec_seq_streaming(r):
+        _RESULT["seq_streaming"] = r
+        _append_history({"probe": "seq_streaming", "seq_streaming": r})
+
+    def _rec_ssd_net(r):
+        _RESULT["ssd_net"] = r
+        _append_history({"probe": "ssd_net", "ssd_net": r})
+
+    # Section order = re-capture priority (VERDICT r4 #1c): the rows whose
+    # evidence is least established run first, so a mid-run outage costs
+    # the least.  _run_section handles filter / deadline / failure
+    # bookkeeping uniformly; record closures run outside the armed window.
+    simple = _run_section("simple", bench_inproc_simple, _rec_simple)
+    ips = simple["ips"] if simple else None
+    p99_us = simple["p99_us"] if simple else None
+    bres = _run_section("bert", bench_bert_mfu, _rec_bert)
+    bert_ips = bres["ips"] if bres else None
+    mfu = bres["mfu"] if bres else None
+    _run_section("shm_ab", bench_shm_ab, _rec_shm_ab)
+    _run_section("shm_ab_large", bench_shm_ab_large, _rec_shm_ab_large)
+    seq_res = _run_section("seq", bench_sequence_oldest, _rec_seq)
+    seq_steps_s = seq_res["steps_s"] if seq_res else None
+    gen = _run_section("gen", bench_generative, _rec_gen)
+    _run_section("device_steady", bench_device_steady, _rec_device_steady)
+    _run_section("gen_net", bench_gen_net, _rec_gen_net)
+    _run_section("seq_streaming", bench_seq_streaming, _rec_seq_streaming)
+    _run_section("ssd_net", bench_ssd_net, _rec_ssd_net)
 
     # vs_baseline compares only same-platform runs — a CPU dev-box number is
     # not a baseline for the TPU chip or vice versa. Entries without a
@@ -1508,19 +1605,28 @@ def _main():
     # records (probe == "simple") and legacy run aggregates both carry the
     # metric/value keys, so both populate the baseline.  Records from THIS
     # run are excluded by run_ts: a run must not baseline itself.
+    if _FAILED:
+        _RESULT["sections_failed"] = sorted(set(_FAILED))
     if simple is None:
-        # Filtered run (BENCH_SECTIONS without "simple"): no headline probe,
-        # so emit an explicitly-labeled partial rather than a fake headline.
+        # No headline probe — either filtered out (BENCH_SECTIONS without
+        # "simple") or the probe itself failed.  Emit an explicitly-labeled
+        # partial rather than a fake headline, with the status naming which
+        # of the two happened.
         _RESULT.setdefault("metric", "inproc_simple_ips")
         # 0.0 (not null): the driver schema wants a numeric value; the
         # distinct status is what says "no headline was measured".
         _RESULT.setdefault("value", 0.0)
         _RESULT.setdefault("unit", "infer/sec")
-        _RESULT["status"] = "sections-filtered"
-        _RESULT["sections"] = _sections_tag()
-        _append_history({"probe": "run-status",
-                         "status": "sections-filtered",
-                         "sections": _RESULT["sections"]})
+        status = ("sections-filtered" if not _want("simple")
+                  else "headline-failed")
+        _RESULT["status"] = status
+        if _sections_filter() is not None:
+            _RESULT["sections"] = _sections_tag()
+        _append_history({"probe": "run-status", "status": status,
+                         **({"sections": _RESULT["sections"]}
+                            if "sections" in _RESULT else {}),
+                         **({"sections_failed": _RESULT["sections_failed"]}
+                            if _FAILED else {})})
         _emit(_RESULT)
         return
     hist_path = _hist_path()
@@ -1557,7 +1663,9 @@ def _main():
                      "gen_chunk": gen.get("chunk") if gen else None,
                      "vs_baseline": round(vs, 4),
                      **({"sections": _sections_tag()}
-                        if filtered else {})})
+                        if filtered else {}),
+                     **({"sections_failed": _RESULT["sections_failed"]}
+                        if _FAILED else {})})
 
     _emit(_RESULT)
 

@@ -98,3 +98,66 @@ def test_sections_filter_runs_only_named_sections(tmp_path):
     probes = {h.get("probe") for h in history}
     assert "seq_oldest" in probes
     assert "simple" not in probes
+
+
+def test_section_deadline_bounds_one_hung_probe(tmp_path):
+    # Round-5 failure mode: a tunnel drop during ONE section's engine
+    # warmup hung the whole capture window.  The per-section deadline
+    # (BENCH_SECTION_DEADLINE_S) must abort just that section and let the
+    # rest of the run proceed to a normal emit that names the casualty.
+    out, history = run_bench(tmp_path, {
+        "BENCH_SECTIONS": "simple,bert",
+        "BENCH_SIMULATE_HANG": "bert",
+        # Well above the smoke simple section's honest runtime (~31s on an
+        # idle CI host — keep ~5x headroom for a contended one), far below
+        # the run watchdog and the subprocess timeout.
+        "BENCH_SECTION_DEADLINE_S": "150",
+        "BENCH_SMOKE": "1",
+    }, timeout=400)
+    assert out["status"] == "ok-sections-filtered"
+    assert out["value"] > 0  # the headline section before the hang is intact
+    assert out["sections_failed"] == ["bert"]
+    assert "bert_b8_ips" not in out  # the hung probe contributed nothing
+    run_status = [h for h in history if h.get("probe") == "run-status"]
+    assert run_status[-1]["sections_failed"] == ["bert"]
+
+
+def test_headline_failure_is_not_mistaken_for_filtering(tmp_path):
+    # A failed simple probe must read "headline-failed", not the
+    # sections-filtered status that means "deliberately not measured".
+    out, history = run_bench(tmp_path, {
+        "BENCH_SECTIONS": "simple",
+        "BENCH_SIMULATE_HANG": "simple",
+        "BENCH_SECTION_DEADLINE_S": "3",
+        "BENCH_SMOKE": "1",
+    }, timeout=400)
+    assert out["status"] == "headline-failed"
+    assert out["value"] == 0.0
+    assert out["sections_failed"] == ["simple"]
+    assert any(h.get("probe") == "run-status"
+               and h.get("status") == "headline-failed" for h in history)
+
+
+def test_crash_emits_error_partial(tmp_path):
+    # A crash (here: the BENCH_SECTIONS validation error itself) must still
+    # produce the single self-describing JSON line, not an empty stdout
+    # with rc=1 — including when the crash IS the filter validation, which
+    # the emit path re-consults for its `sections` tag.
+    hist = tmp_path / "hist.json"
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "BENCH_HISTORY_PATH": str(hist),
+                "BENCH_SECTIONS": "bogus"})
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        timeout=120, env=env, cwd=REPO)
+    assert proc.returncode != 0
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    out = json.loads(lines[0])
+    assert out["status"] == "error"
+    assert out["partial"] is True
+    assert "bogus" in out["reason"]
+    assert out["sections"] == "bogus"  # raw env preserved for the record
+    history = json.loads(hist.read_text())
+    assert any(h.get("probe") == "run-status" and h.get("status") == "error"
+               for h in history)

@@ -2,7 +2,7 @@
 # Round-5 capture watcher: probe the TPU tunnel; the moment it answers,
 # run whatever evidence is still missing, logging everything.  One-shot.
 #
-# Round-5 state (2026-07-31, after the second tunnel window):
+# Round-5 state (2026-07-31, third session):
 #   CAPTURED with committed artifacts —
 #     - full main bench (artifacts/r05/bench_tpu_capture.json)
 #     - mfu_diag, twice, incl. the dependent-feedback method
@@ -10,19 +10,19 @@
 #     - seq_oldest re-run under the stability criterion: 1613 steps/s
 #       stable (BENCH_HISTORY probe record, snapshot in artifacts/r05)
 #   STILL MISSING on hardware —
-#     - gen_net (first attempt hit the warmup shed + a client segfault,
-#       both fixed; second attempt lost to a tunnel drop mid-warmup)
-#     - seq_streaming full sweep (c64 hung on the grpcio pool deadlock,
-#       fixed via max_workers; c16=195.5 / c32=333.3 were measured)
-#     - ssd_net, the new north-star probe (pa + tpu-shm + gRPC wire on
-#       ssd_mobilenet_v2_tpu; plumbing validated on CPU)
+#     - gen_net / seq_streaming / ssd_net: the 03:35Z window died mid-
+#       gen_net-warmup (tunnel drop; old code had no per-section deadline,
+#       the whole 2400 s window hung).  bench.py now aborts a hung section
+#       via BENCH_SECTION_DEADLINE_S and moves on.
 #     - --mfu-study distribution with the feedback-scan method + trace
+#     - gen_chunk_sweep on hardware
 cd /root/repo
 while true; do
   if timeout 90 python -c "import jax; d = jax.devices(); assert d[0].platform != 'cpu', d" 2>/dev/null; then
     echo "TUNNEL UP $(date -u +%FT%TZ)" >> tunnel_watch.log
     mkdir -p artifacts/r05
-    BENCH_SECTIONS=gen_net,seq_streaming,ssd_net timeout 2400 python bench.py \
+    BENCH_SECTIONS=gen_net,seq_streaming,ssd_net BENCH_SECTION_DEADLINE_S=900 \
+      BENCH_DEADLINE_S=3000 timeout 3100 python bench.py \
       > artifacts/r05/bench_net_sections.json 2> bench_stderr_r5_net.log
     echo "NET DONE rc=$? $(date -u +%FT%TZ)" >> tunnel_watch.log
     timeout 2400 python bench.py --mfu-study 5 \
