@@ -200,6 +200,17 @@ def _section_guard(section: str):
         signal.signal(signal.SIGALRM, old)
 
 
+# Rough worst-case section durations on the TPU dev tunnel (seconds) —
+# feeds ONLY the time-budget skip in _run_section.  Calibrated from the
+# round-5 captures (in-process sections: artifacts/r05; net sections: the
+# CPU verify drive, padded for tunnel warmup); refine as captures land.
+_SECTION_EST = {"simple": 150, "bert": 180, "shm_ab": 200,
+                "shm_ab_large": 150, "seq": 90, "gen": 180,
+                "device_steady": 200, "gen_net": 400,
+                "seq_streaming": 350, "ssd_net": 450}
+_RUN_T0 = time.monotonic()
+
+
 def _run_section(section: str, probe, record):
     """Run one bench section.  ``probe`` (no-arg) executes under the
     per-section deadline; ``record`` (result -> None) runs after the
@@ -207,9 +218,31 @@ def _run_section(section: str, probe, record):
     never split a measured result from its _RESULT/history record — the
     two land together or the section counts as failed.  Failures
     (timeout or error) are noted centrally and the run continues.
-    Returns the probe result, or None if filtered out or failed."""
+    Returns the probe result, or None if filtered out, skipped, or
+    failed.
+
+    Time-budget skip (full runs only): with all ten sections live, a full
+    TPU run can honestly outlast the watchdog (BENCH_DEADLINE_S), which
+    would convert a healthy run into a partial-outage emit at the finish
+    line.  If starting a section would plausibly cross the watchdog, the
+    section is skipped and listed in `sections_skipped` — a clean,
+    self-describing truncation instead of a partial.  Filtered runs
+    (BENCH_SECTIONS) always attempt exactly what was asked."""
     if not _want(section):
         return None
+    # The headline is never budget-skipped: it runs first (elapsed ~0), and
+    # a deadline too short even for it means the run cannot exist at all —
+    # better to attempt it and let the watchdog adjudicate.
+    if section != "simple" and _sections_filter() is None:
+        deadline = float(os.environ.get("BENCH_DEADLINE_S", "1500"))
+        elapsed = time.monotonic() - _RUN_T0
+        est = _SECTION_EST.get(section, 300)
+        if elapsed + est > deadline - 90:
+            _RESULT.setdefault("sections_skipped", []).append(section)
+            log(f"section {section!r} skipped: time budget ({elapsed:.0f}s "
+                f"elapsed + ~{est}s estimate would cross the "
+                f"{deadline:.0f}s watchdog)")
+            return None
     t0 = time.monotonic()
     try:
         with _section_guard(section):
@@ -1451,7 +1484,8 @@ def _run_with_watchdog(target, metric: str = "inproc_simple_ips",
             k for k in partial
             if k not in ("metric", "unit", "value", "partial", "status",
                          "reason", "sections", "sections_completed",
-                         "sections_failed", "section_s"))
+                         "sections_failed", "sections_skipped",
+                         "section_s"))
         _append_history({"probe": "run-status", "status": status,
                          **({"reason": reason} if reason else {}),
                          **({"sections": sections_env} if sections_env
@@ -1665,7 +1699,9 @@ def _main():
                      **({"sections": _sections_tag()}
                         if filtered else {}),
                      **({"sections_failed": _RESULT["sections_failed"]}
-                        if _FAILED else {})})
+                        if _FAILED else {}),
+                     **({"sections_skipped": _RESULT["sections_skipped"]}
+                        if "sections_skipped" in _RESULT else {})})
 
     _emit(_RESULT)
 
