@@ -79,8 +79,8 @@ def _append_history(entry: dict) -> None:
             pass
 
 
-_SECTION_NAMES = ("simple", "bert", "shm_ab", "shm_ab_large", "seq", "gen",
-                  "device_steady", "gen_net", "seq_streaming", "ssd_net")
+_SECTION_NAMES = ("simple", "gen_net", "seq_streaming", "ssd_net", "bert",
+                  "shm_ab", "shm_ab_large", "seq", "gen", "device_steady")
 
 
 def _sections_filter() -> set | None:
@@ -1610,13 +1610,20 @@ def _main():
         _RESULT["ssd_net"] = r
         _append_history({"probe": "ssd_net", "ssd_net": r})
 
-    # Section order = re-capture priority (VERDICT r4 #1c): the rows whose
-    # evidence is least established run first, so a mid-run outage costs
-    # the least.  _run_section handles filter / deadline / failure
-    # bookkeeping uniformly; record closures run outside the armed window.
+    # Section order = re-capture priority (VERDICT r4 #1c): after the
+    # headline, the rows whose evidence is least established run first, so
+    # a mid-run outage (or the time-budget skip) costs the least.  As of
+    # round 5 the in-process sections have committed driver artifacts
+    # (artifacts/r05) while the networked sections do not — so the
+    # networked ones run right after the headline.  _run_section handles
+    # filter / budget / deadline / failure bookkeeping uniformly; record
+    # closures run outside the armed window.
     simple = _run_section("simple", bench_inproc_simple, _rec_simple)
     ips = simple["ips"] if simple else None
     p99_us = simple["p99_us"] if simple else None
+    _run_section("gen_net", bench_gen_net, _rec_gen_net)
+    _run_section("seq_streaming", bench_seq_streaming, _rec_seq_streaming)
+    _run_section("ssd_net", bench_ssd_net, _rec_ssd_net)
     bres = _run_section("bert", bench_bert_mfu, _rec_bert)
     bert_ips = bres["ips"] if bres else None
     mfu = bres["mfu"] if bres else None
@@ -1626,9 +1633,6 @@ def _main():
     seq_steps_s = seq_res["steps_s"] if seq_res else None
     gen = _run_section("gen", bench_generative, _rec_gen)
     _run_section("device_steady", bench_device_steady, _rec_device_steady)
-    _run_section("gen_net", bench_gen_net, _rec_gen_net)
-    _run_section("seq_streaming", bench_seq_streaming, _rec_seq_streaming)
-    _run_section("ssd_net", bench_ssd_net, _rec_ssd_net)
 
     # vs_baseline compares only same-platform runs — a CPU dev-box number is
     # not a baseline for the TPU chip or vice versa. Entries without a
