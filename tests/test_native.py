@@ -915,3 +915,75 @@ def test_h2_settings_ack_precedes_frames_sized_under_new_limits(native_build):
     assert big, order[:8]
     # ...and the ACK must have reached the wire before the first big frame.
     assert acks and acks[0] < big[0], order[:8]
+
+
+def _h2_frame(typ, flags, sid, payload=b""):
+    return (len(payload).to_bytes(3, "big") + bytes([typ, flags]) +
+            sid.to_bytes(4, "big") + payload)
+
+
+@pytest.mark.parametrize("attack", ["rst_stream", "goaway"])
+def test_h2_client_survives_server_abort(native_build, attack):
+    """A server that kills the RPC (RST_STREAM, RFC 7540 §6.4) or the whole
+    connection (GOAWAY, §6.8) mid-request must produce a prompt client-side
+    error — not a hang, not a crash.  The reference client inherits this
+    from grpc-core (/root/reference/src/c++/library/grpc_client.cc links
+    grpc++); here the contract lives in native/src/h2.cc HandleFrame, so it
+    gets its own scripted-peer test."""
+    import socket
+    import threading as th
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def fake_server():
+        conn, _ = srv.accept()
+        conn.settimeout(30)
+        buf = b""
+
+        def read(n):
+            nonlocal buf
+            while len(buf) < n:
+                d = conn.recv(65536)
+                if not d:
+                    raise EOFError
+                buf += d
+            out, buf = buf[:n], buf[n:]
+            return out
+
+        try:
+            read(24)  # client preface
+            conn.sendall(_h2_frame(4, 0, 0))  # empty server SETTINGS
+            while True:
+                hdr = read(9)
+                length = int.from_bytes(hdr[:3], "big")
+                typ = hdr[3]
+                read(length)
+                if typ == 1:  # client HEADERS: strike
+                    sid = int.from_bytes(hdr[5:9], "big") & 0x7FFFFFFF
+                    if attack == "rst_stream":
+                        conn.sendall(_h2_frame(
+                            3, 0, sid, (8).to_bytes(4, "big")))  # CANCEL
+                    else:
+                        conn.sendall(_h2_frame(
+                            7, 0, 0, (0).to_bytes(4, "big") +
+                            (2).to_bytes(4, "big") + b"test-goaway"))
+                    # keep draining until the client hangs up
+        except (EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    t = th.Thread(target=fake_server, daemon=True)
+    t.start()
+    proc = subprocess.run(
+        [os.path.join(native_build, "simple_grpc_health_metadata"),
+         "-u", f"127.0.0.1:{port}"],
+        capture_output=True, text=True, timeout=30)
+    srv.close()
+    t.join(timeout=10)
+    assert proc.returncode != 0
+    assert "error" in proc.stderr.lower(), proc.stderr
