@@ -53,6 +53,11 @@ Error Deflate(const std::string& in, bool gzip, std::string* out);
 Error Inflate(const std::string& in, std::string* out);  // auto-detects
 }  // namespace zutil
 
+// Peer-supplied bytes never enter error/log text raw: non-printables are
+// masked with '.' and the length capped, so a hostile server cannot plant
+// terminal escapes or unbounded noise in client-side diagnostics.
+std::string SanitizeForLog(const std::string& s, size_t cap = 64);
+
 // Per-request options (reference InferOptions, common.h:156-208).
 struct InferOptions {
   explicit InferOptions(const std::string& model_name_)

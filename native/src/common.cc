@@ -246,4 +246,15 @@ Error InferResult::StringData(const std::string& output_name,
   return DeserializeStringTensor(buf, byte_size, string_result);
 }
 
+std::string SanitizeForLog(const std::string& s, size_t cap) {
+  std::string out;
+  out.reserve(s.size() < cap ? s.size() : cap);
+  for (size_t i = 0; i < s.size() && i < cap; ++i) {
+    unsigned char c = static_cast<unsigned char>(s[i]);
+    out.push_back((c >= 0x20 && c < 0x7f) ? static_cast<char>(c) : '.');
+  }
+  if (s.size() > cap) out += "...";
+  return out;
+}
+
 }  // namespace tpuclient

@@ -6,6 +6,8 @@
 
 #include "h2.h"
 
+#include "tpuclient/common.h"
+
 #include <arpa/inet.h>
 #include <fcntl.h>
 #include <netdb.h>
@@ -967,7 +969,8 @@ void Connection::HandleFrame(uint8_t type, uint8_t flags, int32_t sid,
         debug.assign(reinterpret_cast<const char*>(payload + 8), len - 8);
       }
       FailConnection("GOAWAY from peer" +
-                     (debug.empty() ? std::string() : ": " + debug));
+                     (debug.empty() ? std::string()
+                                    : ": " + SanitizeForLog(debug)));
       break;
     }
     default:

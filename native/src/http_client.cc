@@ -219,7 +219,8 @@ class HttpConnection {
         line_end == std::string::npos ? head : head.substr(0, line_end);
     if (status_line.size() < 12 || status_line.compare(0, 5, "HTTP/") != 0) {
       Close();
-      return Error("malformed HTTP status line: " + status_line, 400);
+      return Error(
+          "malformed HTTP status line: " + SanitizeForLog(status_line), 400);
     }
     *status = atoi(status_line.c_str() + 9);
 

@@ -158,6 +158,15 @@ static void TestDtypes() {
   CHECK(ElementCount({2, -1}) == -1);
 }
 
+static void TestSanitizeForLog() {
+  // Peer bytes in diagnostics: non-printables masked, length capped.
+  CHECK(SanitizeForLog("plain ascii") == "plain ascii");
+  CHECK(SanitizeForLog(std::string("\x00\xff ok\x1b[31m", 10)) == ".. ok.[31m");
+  std::string longs(100, 'a');
+  std::string out = SanitizeForLog(longs, 8);
+  CHECK(out == "aaaaaaaa...");
+}
+
 static void TestHuffman() {
   // Round-trip through the RFC 7541 Appendix B codes (table generated and
   // verified against libnghttp2 by tools/gen_hpack_table.py).
@@ -270,6 +279,7 @@ int main() {
   TestInferInput();
   TestShmUtils();
   TestDtypes();
+  TestSanitizeForLog();
   TestHuffman();
   TestHpack();
   if (failures == 0) {

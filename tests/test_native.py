@@ -987,3 +987,69 @@ def test_h2_client_survives_server_abort(native_build, attack):
     t.join(timeout=10)
     assert proc.returncode != 0
     assert "error" in proc.stderr.lower(), proc.stderr
+
+
+@pytest.mark.parametrize("attack", ["garbage", "truncated_body", "early_close"])
+def test_http_client_survives_malformed_responses(native_build, attack):
+    """The raw-socket HTTP/1.1 client against a hostile peer: a non-HTTP
+    byte stream, a Content-Length promising more than is sent, or a
+    connection closed mid-response must each yield a prompt client-side
+    error — not a hang or crash.  The reference delegates these to
+    libcurl (/root/reference/src/c++/library/http_client.cc); our client
+    owns the parsing, so the contract is pinned against a scripted peer."""
+    import socket
+    import threading as th
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(2)
+    port = srv.getsockname()[1]
+    stop = th.Event()
+
+    def fake_server():
+        while not stop.is_set():
+            try:
+                srv.settimeout(20)
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            conn.settimeout(20)
+            try:
+                # read the request head (ignore its content)
+                buf = b""
+                while b"\r\n\r\n" not in buf:
+                    d = conn.recv(65536)
+                    if not d:
+                        break
+                    buf += d
+                if attack == "garbage":
+                    conn.sendall(b"\x00\xff NOT HTTP AT ALL \r\n\r\n")
+                elif attack == "truncated_body":
+                    conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                                 b"Content-Length: 100000\r\n\r\n"
+                                 b"only this much")
+                # early_close: say nothing at all
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    t = th.Thread(target=fake_server, daemon=True)
+    t.start()
+    # Binary-safe capture: the client's diagnostics are sanitized, but the
+    # contract under test must hold even if they were not.
+    proc = subprocess.run(
+        [os.path.join(native_build, "simple_http_health_metadata"),
+         "-u", f"127.0.0.1:{port}"],
+        capture_output=True, timeout=30)
+    stop.set()
+    srv.close()
+    t.join(timeout=10)
+    stderr = proc.stderr.decode("utf-8", errors="replace")
+    assert proc.returncode != 0
+    assert "error" in stderr.lower(), stderr
+    if attack == "garbage":
+        # Sanitization contract: raw control bytes from the wire must not
+        # reach the client's error output.
+        assert b"\xff" not in proc.stderr and b"\x00" not in proc.stderr
