@@ -998,18 +998,29 @@ def bench_gen_net(n_streams: int = 64, tokens: int = 32):
     try:
         for label, extra in (("coalesced", []),
                              ("per_token", ["--generative-no-coalesce"])):
+            # Per-point fault isolation (same contract as the seq_streaming
+            # and ssd_net sweeps): one failed/hung point is recorded in-row
+            # and must not erase the sibling point's evidence.
             cmd = [pa, "-m", "tiny_gpt", "-u", f"127.0.0.1:{srv.port}",
                    "-i", "grpc", "--generative",
                    "--generative-max-tokens", str(tokens),
                    "--shape", "INPUT_IDS:4",
                    "--concurrency-range", f"{n_streams}:{n_streams}",
                    "-p", "10000"]
-            proc = subprocess.run(cmd + extra, capture_output=True,
-                                  text=True, timeout=180)
+            try:
+                proc = subprocess.run(cmd + extra, capture_output=True,
+                                      text=True, timeout=180)
+            except subprocess.TimeoutExpired:
+                out[label] = {"error": "timeout (180s)"}
+                log(f"gen-net [{label}]: TIMEOUT — point recorded as "
+                    "failed, probe continues")
+                continue
             if proc.returncode != 0:
-                raise RuntimeError(
-                    f"perf_analyzer --generative [{label}] rc="
-                    f"{proc.returncode}: {proc.stderr[-500:]}")
+                out[label] = {
+                    "error": f"rc={proc.returncode}: {proc.stderr[-200:]}"}
+                log(f"gen-net [{label}]: rc={proc.returncode} — point "
+                    "recorded as failed, probe continues")
+                continue
             parsed = None
             for ln in proc.stdout.splitlines():
                 ln = ln.strip()
@@ -1019,14 +1030,19 @@ def bench_gen_net(n_streams: int = 64, tokens: int = 32):
                     except json.JSONDecodeError:
                         continue  # brace-prefixed diagnostic, not the result
             if parsed is None:
-                raise RuntimeError(
-                    f"no JSON line in perf_analyzer output: "
-                    f"{proc.stdout[-500:]}")
+                out[label] = {
+                    "error": f"no JSON line in output: {proc.stdout[-200:]}"}
+                log(f"gen-net [{label}]: no JSON result — point recorded "
+                    "as failed, probe continues")
+                continue
             out[label] = parsed
             log(f"gen-net [{label}]: {parsed['tok_s']} tok/s, TTFT p50 "
                 f"{parsed['ttft_us_p50'] / 1e3:.0f}ms, ITL p50 "
                 f"{parsed['itl_us_p50'] / 1e3:.2f}ms "
                 f"({n_streams} streams x {tokens} tokens, native client)")
+        if all(isinstance(v, dict) and "error" in v
+               for k, v in out.items() if k != "chunk"):
+            raise RuntimeError(f"every gen-net point failed: {out}")
         return out
     finally:
         srv.stop()
