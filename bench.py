@@ -1774,6 +1774,33 @@ def mfu_study(n_runs: int = 5, trace_dir: str | None = None):
     print(json.dumps(summary), flush=True)
 
 
+def sweep_concurrency(concs):
+    """Reproduce the headline's saturation-knee sweep in one command:
+    ``python bench.py --sweep-concurrency 256,512,768,1024``.  The round-4
+    sweep that picked c768 (BENCH_CONCURRENCY's comment block) was run by
+    hand; this makes the knee re-derivable and appends every point to
+    BENCH_HISTORY as it completes (tunnel-drop safe).  Same stable-window
+    probe as the headline — only the concurrency varies; per-point fault
+    isolation so one collapsed point (c1024 is expected to) does not cost
+    the sweep."""
+    devices = preflight()
+    _HIST_CTX.update({
+        "platform": devices[0].platform,
+        "config": f"mb{BENCH_MAX_BATCH}-sweep-i{BENCH_INSTANCES}"})
+    out = {}
+    for c in concs:
+        try:
+            res = bench_inproc_simple(concurrency=c)
+            row = {k: res[k] for k in ("ips", "p99_us", "stable")}
+        except Exception as exc:  # noqa: BLE001 — per-point isolation
+            row = {"error": repr(exc)[:200]}
+        out[f"c{c}"] = row
+        _append_history({"probe": "simple_sweep", "concurrency": c, **row})
+        log(f"sweep c{c}: {json.dumps(row)}")
+    print(json.dumps({"metric": "simple_concurrency_sweep", **out}),
+          flush=True)
+
+
 if __name__ == "__main__":
     if "--mfu-study" in sys.argv:
         idx = sys.argv.index("--mfu-study")
@@ -1784,5 +1811,13 @@ if __name__ == "__main__":
                              "artifacts", "mfu_trace")
         _run_with_watchdog(lambda: mfu_study(n, trace_dir=trace),
                            metric="bert_b8_mfu_study", unit="ms")
+    elif "--sweep-concurrency" in sys.argv:
+        idx = sys.argv.index("--sweep-concurrency")
+        arg = (sys.argv[idx + 1] if len(sys.argv) > idx + 1
+               else "256,384,512,768,1024")
+        concs = [int(x) for x in arg.split(",") if x.strip()]
+        _run_with_watchdog(lambda: sweep_concurrency(concs),
+                           metric="simple_concurrency_sweep",
+                           unit="infer/sec")
     else:
         main()

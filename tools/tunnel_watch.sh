@@ -31,6 +31,10 @@ while true; do
     timeout 1800 python tools/gen_chunk_sweep.py \
       > artifacts/r05/gen_chunk_sweep.json 2> bench_stderr_r5_sweep.log
     echo "SWEEP DONE rc=$? $(date -u +%FT%TZ)" >> tunnel_watch.log
+    BENCH_DEADLINE_S=2300 timeout 2400 python bench.py \
+      --sweep-concurrency 256,384,512,768,1024 \
+      > artifacts/r05/simple_sweep.json 2> bench_stderr_r5_csweep.log
+    echo "CSWEEP DONE rc=$? $(date -u +%FT%TZ)" >> tunnel_watch.log
     cp BENCH_HISTORY.json artifacts/r05/BENCH_HISTORY_snapshot.json
     cp bench_stderr_r5_net.log bench_stderr_r5_mfu.log \
        bench_stderr_r5_sweep.log artifacts/r05/ 2>/dev/null
