@@ -189,3 +189,25 @@ def test_time_budget_skips_trailing_sections_cleanly(tmp_path):
     assert "simple" not in out["sections_skipped"]
     # the skip is a budget decision, not a failure
     assert "sections_failed" not in out
+
+
+def test_sweep_concurrency_entry_point(tmp_path):
+    # The headline knee sweep: per-point records append to history as each
+    # point completes, and the emit is one JSON line keyed by concurrency.
+    hist = tmp_path / "hist.json"
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "BENCH_HISTORY_PATH": str(hist),
+                "BENCH_SMOKE": "1"})
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--sweep-concurrency", "4,8"],
+        capture_output=True, text=True, timeout=400, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert out["metric"] == "simple_concurrency_sweep"
+    assert out["c4"]["ips"] > 0 and out["c8"]["ips"] > 0
+    history = json.loads(hist.read_text())
+    sweeps = [h for h in history if h.get("probe") == "simple_sweep"]
+    assert [h["concurrency"] for h in sweeps] == [4, 8]
+    assert all("sweep" in h.get("config", "") for h in sweeps)
