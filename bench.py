@@ -201,12 +201,15 @@ def _section_guard(section: str):
 
 
 # Rough worst-case section durations on the TPU dev tunnel (seconds) —
-# feeds ONLY the time-budget skip in _run_section.  Calibrated from the
-# round-5 captures (in-process sections: artifacts/r05; net sections: the
-# CPU verify drive, padded for tunnel warmup); refine as captures land.
-_SECTION_EST = {"simple": 150, "bert": 180, "shm_ab": 200,
-                "shm_ab_large": 150, "seq": 90, "gen": 180,
-                "device_steady": 200, "gen_net": 400,
+# feeds ONLY the time-budget skip in _run_section.  In-process sections
+# calibrated from the r05 TPU capture's per-probe history timestamps
+# (artifacts/r05/BENCH_HISTORY_snapshot.json: simple+preflight ~106s,
+# bert 32s pre-feedback-scan, shm_ab 99s, shm_ab_large 125s, seq 7s, gen
+# 92s, device_steady 379s) plus ~50% margin; net sections from the CPU
+# verify drive padded for tunnel warmup.
+_SECTION_EST = {"simple": 150, "bert": 180, "shm_ab": 150,
+                "shm_ab_large": 180, "seq": 90, "gen": 150,
+                "device_steady": 550, "gen_net": 400,
                 "seq_streaming": 350, "ssd_net": 450}
 _RUN_T0 = time.monotonic()
 
