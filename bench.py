@@ -500,8 +500,33 @@ def bench_inproc_simple(concurrency: int = BENCH_CONCURRENCY):
     log(f"warmup done ({time.monotonic() - t0:.1f}s); stability search "
         f"at concurrency {concurrency}")
 
+    # Snapshot the engine's request-duration histogram around the load run:
+    # the windowed delta yields server-side p50/p99 that cross-check the
+    # client-measured tail (a client-side timer also measures its own
+    # thread-scheduling jitter; the histogram doesn't).
+    def _hist_snapshot():
+        try:
+            from client_tpu.observability import scrape
+
+            return scrape.histogram_state(engine.prometheus_metrics(),
+                                          "tpu_request_duration_us")
+        except Exception as exc:  # noqa: BLE001 — metrics must not sink bench
+            log(f"metrics snapshot failed: {exc}")
+            return None
+
+    before = _hist_snapshot()
     res = run_stable_load(lambda: engine.infer(make_req(), timeout_s=60),
                           concurrency, tag="simple")
+    after = _hist_snapshot()
+    if before is not None and after is not None:
+        from client_tpu.observability import scrape
+
+        d = scrape.delta(after, before)
+        if d["count"] > 0:
+            res["hist_p50_us"] = round(scrape.quantile(d, 0.50), 1)
+            res["hist_p99_us"] = round(scrape.quantile(d, 0.99), 1)
+            log(f"simple: histogram-derived p50 {res['hist_p50_us']}us, "
+                f"p99 {res['hist_p99_us']}us over {int(d['count'])} requests")
     engine.shutdown()
     return res
 
@@ -1570,9 +1595,15 @@ def _main():
                         "p99_us": round(s["p99_us"], 1),
                         "stable": s["stable"],
                         "windows": s["windows"]})
+        hist = {}
+        for k in ("hist_p50_us", "hist_p99_us"):
+            if k in s:
+                _RESULT[k] = s[k]
+                hist[k] = s[k]
         _append_history({"probe": "simple", "metric": "inproc_simple_ips",
                          "value": s["ips"], "p99_us": s["p99_us"],
-                         "stable": s["stable"], "windows": s["windows"]})
+                         "stable": s["stable"], "windows": s["windows"],
+                         **hist})
 
     def _rec_bert(b):
         _RESULT["bert_b8_ips"] = round(b["ips"], 2)

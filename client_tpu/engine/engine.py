@@ -12,6 +12,7 @@ C-API mode, SURVEY.md §3.5).
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Callable
 
@@ -43,7 +44,8 @@ SERVER_EXTENSIONS = [
 class TpuEngine:
     def __init__(self, repository: ModelRepository | None = None, *,
                  jit: bool = True, warmup: bool = False,
-                 load_all: bool = True, eager_init: bool = True):
+                 load_all: bool = True, eager_init: bool = True,
+                 metrics_registry=None):
         if eager_init and jit:
             # Pay PjRt client creation here, on the constructing thread, with
             # progress logged — never lazily inside a scheduler worker where
@@ -62,10 +64,18 @@ class TpuEngine:
         # uniformly through these attributes.
         from client_tpu.engine.shm import SystemShmManager, TpuShmManager
         from client_tpu.engine.trace import TraceManager
+        from client_tpu.observability.metrics import EngineMetrics
+        from client_tpu.observability.tracing import TraceStore
 
         self.system_shm = SystemShmManager()
         self.tpu_shm = TpuShmManager()
         self.trace = TraceManager()
+        # Histogram/gauge layer; a private registry per engine by default so
+        # two engines in one process (tests) don't cross-pollute. Pass
+        # observability.REGISTRY for a process-wide one.
+        self.metrics = EngineMetrics(metrics_registry)
+        self.request_traces = TraceStore(
+            capacity=int(os.environ.get("CLIENT_TPU_TRACE_BUFFER", "512")))
         if load_all:
             for name in self.repository.names():
                 try:
@@ -167,7 +177,10 @@ class TpuEngine:
                     retired.append(sched)
                 stats = self._stats.get(key)
                 if stats is None:
-                    stats = ModelStats(name, str(v))
+                    stats = ModelStats(
+                        name, str(v),
+                        instruments=self.metrics.model_instruments(
+                            name, str(v)))
                     self._stats[key] = stats
                 self._schedulers[key] = make_scheduler(
                     model, stats,
@@ -271,7 +284,35 @@ class TpuEngine:
         except EngineError as exc:
             req.response_callback(InferResponse.make_error(req, exc))
             return
+        if req.trace is not None:
+            self._attach_trace_recorder(req)
         sched.submit(req)
+
+    def _attach_trace_recorder(self, req: InferRequest) -> None:
+        """Wrap the response callback so the final response snapshots the
+        request's span timeline into the trace ring buffer. Only requests
+        that carry a TraceContext pay for this — in-process/bench callers
+        with ``trace=None`` go through untouched."""
+        from client_tpu.observability.tracing import (
+            MAX_CHUNK_EVENTS,
+            build_request_trace,
+        )
+
+        inner = req.response_callback
+        chunks: list[int] = []
+
+        def _traced(resp: InferResponse) -> None:
+            if not resp.final:
+                if len(chunks) < MAX_CHUNK_EVENTS:
+                    chunks.append(now_ns())
+            else:
+                self.request_traces.add(build_request_trace(
+                    req.trace, req.model_name, req.request_id, req.times,
+                    ok=resp.error is None, chunks=chunks,
+                    error=str(resp.error) if resp.error is not None else ""))
+            inner(resp)
+
+        req.response_callback = _traced
 
     def infer(self, req: InferRequest, timeout_s: float | None = None) -> InferResponse:
         """Blocking inference; raises EngineError on failure.
@@ -400,7 +441,22 @@ class TpuEngine:
             metric(name, "counter", help_text + " (microseconds)",
                    rows(lambda s, p=phase:
                         s["inference_stats"][p]["ns"] // 1000))
-        return "\n".join(lines) + "\n"
+        # Histogram/gauge layer: gauges are sampled at scrape time (queue
+        # depth and in-flight batches are point-in-time; HBM via the JAX
+        # device API), histograms accumulated on the hot path via
+        # ModelStats.instruments.
+        with self._lock:
+            scheds = [(k, s) for k, s in self._schedulers.items()
+                      if ":" in k]
+        for key, sched in scheds:
+            model_name, version = key.rsplit(":", 1)
+            self.metrics.queue_depth.set(
+                sched.queue.qsize(), model=model_name, version=version)
+            self.metrics.inflight_batches.set(
+                getattr(sched, "active_batches", 0),
+                model=model_name, version=version)
+        self.metrics.update_device_gauges()
+        return "\n".join(lines) + "\n" + self.metrics.render()
 
     # -- trace (device profiling) --------------------------------------------
 
@@ -409,6 +465,13 @@ class TpuEngine:
 
     def update_trace_setting(self, d: dict) -> dict:
         return self.trace.update(d or {})
+
+    # -- trace (per-request spans) -------------------------------------------
+
+    def request_trace_export(self, trace_id: str | None = None) -> dict:
+        """Chrome trace-event JSON of recently completed traced requests
+        (``GET /v2/trace/requests``); optionally filtered to one trace id."""
+        return self.request_traces.to_chrome_trace(trace_id)
 
     # -- lifecycle -----------------------------------------------------------
 

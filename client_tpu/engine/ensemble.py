@@ -85,7 +85,8 @@ class EnsembleScheduler(Scheduler):
         req.times.compute_input_end = req.times.compute_start
         req.times.compute_infer_end = now_ns()
         req.times.compute_output_end = req.times.compute_infer_end
-        self.stats.record_execution(1)
+        self.stats.record_execution(
+            1, compute_ns=req.times.compute_infer_end - req.times.compute_start)
         self.stats.record_request(req.times, success=True)
         self._respond(req, InferResponse(
             model_name=req.model_name,
@@ -106,6 +107,7 @@ class EnsembleScheduler(Scheduler):
             sequence_start=req.sequence_start,
             sequence_end=req.sequence_end,
             timeout_us=req.timeout_us,
+            trace=req.trace.child() if req.trace is not None else None,
         )
         resp = self.engine.infer(sub)
         for model_out, ensemble_name in step.output_map.items():

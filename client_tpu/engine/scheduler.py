@@ -157,6 +157,10 @@ class Scheduler:
         self.queue = _ReqQueue()
         self.workers: list[threading.Thread] = []
         self._stopping = False
+        # Approximate in-flight batch count for the tpu_inflight_batches
+        # gauge; worker threads inc/dec around device execution (races lose
+        # at most a transient +-1 — acceptable for a sampled gauge).
+        self.active_batches = 0
         # preserve_ordering (Triton ModelDynamicBatching): responses release
         # in arrival order even when instances complete out of order.
         dyn = model.config.dynamic_batching
@@ -209,6 +213,7 @@ class Scheduler:
                 req.arrival_seq = self._arrival_seq
                 self._arrival_seq += 1
         if not self.queue.put(req, level, max_level_size=max_size):
+            self.stats.record_rejection()
             if self._preserve_ordering:
                 # The rejected request's arrival slot must not dam the
                 # release sequence: mark it done with a hole sentinel.
@@ -438,6 +443,13 @@ class DefaultScheduler(Scheduler):
         return batch
 
     def _execute_batch(self, batch: list[InferRequest]) -> None:
+        self.active_batches += 1
+        try:
+            self._execute_batch_inner(batch)
+        finally:
+            self.active_batches -= 1
+
+    def _execute_batch_inner(self, batch: list[InferRequest]) -> None:
         cfg = self.model.config
         start = now_ns()
         for r in batch:
@@ -461,7 +473,8 @@ class DefaultScheduler(Scheduler):
             fetch = not all(r.keep_outputs_on_device for r in batch)
             outputs, phases = self.model.execute_timed(
                 merged, batch_size=total, fetch_outputs=fetch)
-            self.stats.record_execution(total)
+            self.stats.record_execution(
+                total, compute_ns=phases.infer_end - phases.input_end)
             if fetch:
                 offset = 0
                 for r, sz in zip(batch, sizes):
@@ -481,7 +494,8 @@ class DefaultScheduler(Scheduler):
         else:
             outputs, phases = self.model.execute_timed(
                 batch[0].inputs, batch_size=None)
-            self.stats.record_execution(1)
+            self.stats.record_execution(
+                1, compute_ns=phases.infer_end - phases.input_end)
             self._finish(batch[0], outputs, phases)
 
     def _finish(self, req: InferRequest, outputs: dict, phases) -> None:
@@ -538,10 +552,13 @@ class DecoupledScheduler(Scheduler):
             if self._check_timeout(req) or self._check_cancelled(req):
                 continue
             req.times.compute_start = now_ns()
+            self.active_batches += 1
             try:
                 self._stream(req)
             except Exception as exc:  # noqa: BLE001
                 self._fail(req, exc)
+            finally:
+                self.active_batches -= 1
 
     def _stream(self, req: InferRequest) -> None:
         # Each yielded response is emitted immediately (no lookahead
@@ -564,7 +581,8 @@ class DecoupledScheduler(Scheduler):
         req.times.compute_input_end = req.times.compute_start
         req.times.compute_infer_end = now_ns()
         req.times.compute_output_end = req.times.compute_infer_end
-        self.stats.record_execution(max(1, count))
+        self.stats.record_execution(max(1, count),
+                                    compute_ns=req.times.compute_infer_ns)
         self.stats.record_request(req.times, success=True)
         self._emit(req, {}, final=True)
 

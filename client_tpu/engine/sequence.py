@@ -193,7 +193,8 @@ class SequenceScheduler(_PendingGuard, Scheduler):
         req.times.compute_input_end = start
         req.times.compute_infer_end = now_ns()
         req.times.compute_output_end = req.times.compute_infer_end
-        self.stats.record_execution(1)
+        self.stats.record_execution(
+            1, compute_ns=req.times.compute_infer_end - start)
         if req.outputs:
             requested = {o.name for o in req.outputs}
             outputs = {k: v for k, v in outputs.items() if k in requested}
@@ -522,6 +523,9 @@ class OldestSequenceScheduler(_PendingGuard, Scheduler):
                 self._inflight_waves.clear()
                 return
             t_done = now_ns()
+            # Compute ns for this wave was unknown at dispatch (counted in
+            # _dispatch_wave); attribute it now that the device is done.
+            self.stats.add_execution_ns(len(live), t_done - t_stacked)
             # Response delivery IS liveness: with pipelined waves a
             # server-side stall (compile, slow fetch) can push delivery
             # >idle-window past the row acquire; judging idleness from the

@@ -4,11 +4,16 @@ Exposes the same phase breakdown perf_analyzer differences per measurement
 window in the reference (queue / compute_input / compute_infer /
 compute_output; /root/reference/src/c++/perf_analyzer/inference_profiler.cc:
 836-908), in the v2 statistics JSON shape.
+
+When the engine attaches :class:`ModelInstruments` (observability layer),
+every recorded request/execution also feeds the corresponding histogram
+series — cumulative sums here, distributions there, from one call site.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 
 from client_tpu.engine.types import RequestTimes
@@ -41,7 +46,17 @@ class ModelStats:
     cache_miss: _DurationStat = field(default_factory=_DurationStat)
     inference_count: int = 0
     execution_count: int = 0
-    batch_hist: dict[int, int] = field(default_factory=dict)
+    # Wall-clock ms of the most recent successful inference (v2 stats
+    # schema `last_inference`; 0 until the first success).
+    last_inference_ms: int = 0
+    # Admission rejections (queue-full 429s) — exported as
+    # tpu_queue_rejections_total when instruments are attached.
+    rejection_count: int = 0
+    # batch_size -> [execution count, cumulative compute-infer ns]
+    batch_hist: dict[int, list[int]] = field(default_factory=dict)
+    # Optional observability hook (metrics.ModelInstruments); None for
+    # stats objects created outside an engine (unit tests, tools).
+    instruments: object | None = field(default=None, repr=False)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def record_request(self, times: RequestTimes, success: bool,
@@ -56,13 +71,36 @@ class ModelStats:
                 self.compute_infer.add(times.compute_infer_ns)
                 self.compute_output.add(times.compute_output_ns)
                 self.inference_count += 1
+                self.last_inference_ms = int(time.time() * 1000)
             else:
                 self.fail.add(max(0, total))
+        if success and self.instruments is not None:
+            self.instruments.observe_request(max(0, total), times)
 
-    def record_execution(self, batch_size: int) -> None:
+    def record_execution(self, batch_size: int, compute_ns: int = 0) -> None:
+        """One device execution of ``batch_size`` requests taking
+        ``compute_ns`` in the executable (0 when the scheduler can't
+        attribute per-batch compute, e.g. pipelined dispatch)."""
         with self._lock:
             self.execution_count += 1
-            self.batch_hist[batch_size] = self.batch_hist.get(batch_size, 0) + 1
+            entry = self.batch_hist.setdefault(batch_size, [0, 0])
+            entry[0] += 1
+            entry[1] += max(0, compute_ns)
+        if self.instruments is not None:
+            self.instruments.observe_execution(batch_size)
+
+    def add_execution_ns(self, batch_size: int, compute_ns: int) -> None:
+        """Attribute compute ns to an execution counted earlier (wave
+        schedulers count at dispatch, learn the duration at drain)."""
+        with self._lock:
+            entry = self.batch_hist.setdefault(batch_size, [0, 0])
+            entry[1] += max(0, compute_ns)
+
+    def record_rejection(self) -> None:
+        with self._lock:
+            self.rejection_count += 1
+        if self.instruments is not None:
+            self.instruments.record_rejection()
 
     def to_dict(self) -> dict:
         """v2 `GET /v2/models/<m>/stats` entry."""
@@ -70,7 +108,7 @@ class ModelStats:
             return {
                 "name": self.model_name,
                 "version": self.model_version,
-                "last_inference": 0,
+                "last_inference": self.last_inference_ms,
                 "inference_count": self.inference_count,
                 "execution_count": self.execution_count,
                 "inference_stats": {
@@ -86,8 +124,8 @@ class ModelStats:
                 "batch_stats": [
                     {
                         "batch_size": bs,
-                        "compute_infer": {"count": n, "ns": 0},
+                        "compute_infer": {"count": n, "ns": ns},
                     }
-                    for bs, n in sorted(self.batch_hist.items())
+                    for bs, (n, ns) in sorted(self.batch_hist.items())
                 ],
             }

@@ -99,6 +99,11 @@ class InferRequest:
     # D2H fetch entirely and responses carry HBM-resident jax.Arrays (the
     # shm write stores them as-is — zero host bytes end to end).
     keep_outputs_on_device: bool = False
+    # Distributed-trace context (observability.tracing.TraceContext), set
+    # by frontends from the W3C `traceparent` header / gRPC metadata, or
+    # left None for untraced in-process callers (bench fast path).  Typed
+    # Any to keep engine types free of observability imports.
+    trace: Any = None
     # Streaming flow control (round 5): frontends with a bounded response
     # path (the gRPC stream writer) set this to a zero-arg callable that
     # returns True while the transport is backlogged.  Decoupled producers

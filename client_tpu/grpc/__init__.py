@@ -15,10 +15,13 @@ from __future__ import annotations
 import base64
 import queue
 import threading
+import time
 
 import grpc
 import numpy as np
 
+from client_tpu.observability.client_stats import InferStat
+from client_tpu.observability.tracing import TraceContext
 from client_tpu.protocol import grpc_codec, grpc_service_pb2 as pb
 from client_tpu.protocol.codec import serialize_tensor
 from client_tpu.protocol.dtypes import np_to_wire_dtype
@@ -154,6 +157,30 @@ class InferResult:
 
     def __init__(self, result: "pb.ModelInferResponse"):
         self._result = result
+
+    def _response_params(self) -> dict:
+        return grpc_codec.params_to_dict(self._result.parameters)
+
+    def trace_id(self):
+        """The W3C trace id this request ran under (32 hex chars), echoed
+        as the ``traceparent`` response parameter when the request sent
+        one; None otherwise."""
+        tp = self._response_params().get("traceparent") or ""
+        parts = tp.split("-")
+        return parts[1] if len(parts) >= 3 else None
+
+    def server_timing(self):
+        """Server-side phase durations in microseconds
+        ({queue, compute_input, compute_infer, compute_output}), from the
+        ``server_*_us`` response parameters; empty if absent."""
+        params = self._response_params()
+        out = {}
+        for phase in ("queue", "compute_input", "compute_infer",
+                      "compute_output"):
+            v = params.get(f"server_{phase}_us")
+            if v is not None:
+                out[phase] = float(v)
+        return out
 
     def as_numpy(self, name):
         raw_idx = 0
@@ -305,6 +332,13 @@ class InferenceServerClient:
         self._client_stub = stub
         self._verbose = verbose
         self._stream: _InferStream | None = None
+        self._stats = InferStat()
+
+    def get_infer_stat(self):
+        """Cumulative client-side inference statistics (round-trip time
+        plus the server-reported phase breakdown) — the InferStat
+        equivalent of the reference client."""
+        return self._stats.get()
 
     def __enter__(self):
         return self
@@ -497,17 +531,29 @@ class InferenceServerClient:
               sequence_end=False, priority=0, timeout=None,
               client_timeout=None, headers=None, compression_algorithm=None,
               parameters=None):
+        # Distributed tracing: propagate the caller's traceparent (parameter
+        # wins, then RPC metadata), or start a new trace per request so the
+        # server echoes the id and phase timings back as response
+        # parameters.
+        params = dict(parameters or {})
+        params.setdefault("traceparent",
+                          (headers or {}).get("traceparent")
+                          or TraceContext.new().to_traceparent())
         request = self._make_request(
             model_name, inputs, model_version, outputs, request_id,
             sequence_id, sequence_start, sequence_end, priority, timeout,
-            parameters)
+            params)
+        t0 = time.monotonic_ns()
         try:
             response = self._client_stub.ModelInfer(
                 request, metadata=self._md(headers), timeout=client_timeout,
                 compression=_compression(compression_algorithm))
         except grpc.RpcError as exc:
             raise _grpc_error(exc) from None
-        return InferResult(response)
+        result = InferResult(response)
+        self._stats.record((time.monotonic_ns() - t0) / 1e3,
+                           result.server_timing())
+        return result
 
     def async_infer(self, model_name, inputs, callback, model_version="",
                     outputs=None, request_id="", sequence_id=0,

@@ -16,6 +16,7 @@ import base64
 import json
 import queue
 import threading
+import time
 import zlib
 from concurrent.futures import ThreadPoolExecutor
 from http.client import HTTPConnection
@@ -23,6 +24,11 @@ from urllib.parse import quote, urlencode
 
 import numpy as np
 
+from client_tpu.observability.client_stats import InferStat
+from client_tpu.observability.tracing import (
+    TraceContext,
+    parse_server_timing,
+)
 from client_tpu.protocol import rest
 from client_tpu.protocol.codec import serialize_tensor
 from client_tpu.protocol.dtypes import np_to_wire_dtype, wire_to_np_dtype
@@ -161,6 +167,10 @@ class InferResult:
             t.name: t
             for t in rest.parse_tensors(self._head.get("outputs", []), tail)
         }
+        # Populated by the client transport from the response headers
+        # (traceparent round-trip + Server-Timing phase breakdown).
+        self._trace_id = None
+        self._server_timing: dict = {}
 
     @classmethod
     def from_response_body(cls, response_body, verbose=False,
@@ -192,6 +202,17 @@ class InferResult:
 
     def get_response(self):
         return self._head
+
+    def trace_id(self):
+        """The W3C trace id this request ran under (32 hex chars), echoed
+        by the server; correlate against ``GET /v2/trace/requests``."""
+        return self._trace_id
+
+    def server_timing(self):
+        """Server-side phase durations in microseconds
+        ({queue, compute_input, compute_infer, compute_output}), parsed
+        from the Server-Timing response header; empty if absent."""
+        return dict(self._server_timing)
 
 
 class InferAsyncRequest:
@@ -265,6 +286,13 @@ class InferenceServerClient:
         self._pool = _ConnectionPool(self._host, self._port, concurrency,
                                      max(connection_timeout, network_timeout))
         self._executor = ThreadPoolExecutor(max_workers=max(concurrency, 1))
+        self._stats = InferStat()
+
+    def get_infer_stat(self):
+        """Cumulative client-side inference statistics (round-trip time
+        plus the server-reported phase breakdown) — the InferStat
+        equivalent of the reference client."""
+        return self._stats.get()
 
     def __enter__(self):
         return self
@@ -522,14 +550,21 @@ class InferenceServerClient:
             req_headers["Content-Encoding"] = "deflate"
         if response_compression_algorithm in ("gzip", "deflate"):
             req_headers["Accept-Encoding"] = response_compression_algorithm
+        # Distributed tracing: propagate the caller's traceparent, or start
+        # a new trace per request so every inference is correlatable with
+        # the server's span timeline.
+        req_headers.setdefault("traceparent",
+                               TraceContext.new().to_traceparent())
 
         path = f"/v2/models/{quote(model_name)}"
         if model_version:
             path += f"/versions/{model_version}"
         path += "/infer"
+        t0 = time.monotonic_ns()
         resp, data = self._request("POST", path, body=body,
                                    headers=req_headers,
                                    query_params=query_params)
+        round_trip_us = (time.monotonic_ns() - t0) / 1e3
         encoding = resp.getheader("Content-Encoding")
         if encoding == "gzip":
             data = gzip.decompress(data)
@@ -537,8 +572,15 @@ class InferenceServerClient:
             data = zlib.decompress(data)
         self._raise_if_error(resp, data)
         hdr = resp.getheader(rest.HEADER_INFERENCE_CONTENT_LENGTH)
-        return InferResult(data, int(hdr) if hdr is not None else None,
-                           self._verbose)
+        result = InferResult(data, int(hdr) if hdr is not None else None,
+                             self._verbose)
+        tp = resp.getheader("traceparent") or ""
+        if tp.count("-") >= 2:
+            result._trace_id = tp.split("-")[1]
+        result._server_timing = parse_server_timing(
+            resp.getheader("Server-Timing"))
+        self._stats.record(round_trip_us, result._server_timing)
+        return result
 
     def infer(self, model_name, inputs, model_version="", outputs=None,
               request_id="", sequence_id=0, sequence_start=False,

@@ -1,0 +1,39 @@
+"""Observability layer: metric primitives, per-request tracing, scrape
+helpers.
+
+Three pieces, threaded through every layer of the stack:
+
+- :mod:`client_tpu.observability.metrics` — Prometheus-style Counter /
+  Gauge / Histogram behind a :class:`MetricRegistry`;
+  ``TpuEngine.prometheus_metrics()`` renders them alongside the legacy
+  cumulative counters.
+- :mod:`client_tpu.observability.tracing` — W3C ``traceparent``
+  propagation, per-request phase spans in a bounded :class:`TraceStore`,
+  Chrome trace-event export (``GET /v2/trace/requests``).
+- :mod:`client_tpu.observability.client_stats` /
+  :mod:`client_tpu.observability.scrape` — the client-side InferStat
+  equivalent and /metrics parsing (bench's histogram-derived p50/p99).
+
+See docs/OBSERVABILITY.md for the metric vocabulary and wire formats.
+"""
+
+from client_tpu.observability.client_stats import InferStat  # noqa: F401
+from client_tpu.observability.metrics import (  # noqa: F401
+    BATCH_SIZE_BUCKETS,
+    DURATION_US_BUCKETS,
+    Counter,
+    EngineMetrics,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    REGISTRY,
+)
+from client_tpu.observability.tracing import (  # noqa: F401
+    RequestTrace,
+    Span,
+    TraceContext,
+    TraceStore,
+    build_request_trace,
+    parse_server_timing,
+    server_timing_header,
+)

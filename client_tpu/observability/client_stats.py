@@ -1,0 +1,64 @@
+"""Client-side per-request statistics (the InferStat equivalent).
+
+The reference's C++ client keeps an ``InferStat`` (completed request count
+and cumulative request/send/receive time) that perf_analyzer differences
+per window. Our clients accumulate the same shape, extended with the
+server-side phase breakdown surfaced by trace propagation: the HTTP client
+reads it from the ``Server-Timing`` response header, the gRPC client from
+``server_*_us`` response parameters.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class InferStat:
+    """Thread-safe cumulative client-side stats; snapshot via get()."""
+
+    _PHASES = ("queue", "compute_input", "compute_infer", "compute_output")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.completed_request_count = 0
+        self.cumulative_total_request_time_us = 0.0
+        # Server-phase cumulative sums; requests whose response carried no
+        # phase timings contribute to the round-trip sum only.
+        self.reported_request_count = 0
+        self.cumulative_server_queue_us = 0.0
+        self.cumulative_server_compute_input_us = 0.0
+        self.cumulative_server_compute_infer_us = 0.0
+        self.cumulative_server_compute_output_us = 0.0
+
+    def record(self, round_trip_us: float,
+               server_timing: dict | None = None) -> None:
+        with self._lock:
+            self.completed_request_count += 1
+            self.cumulative_total_request_time_us += round_trip_us
+            if server_timing:
+                self.reported_request_count += 1
+                self.cumulative_server_queue_us += \
+                    server_timing.get("queue", 0.0)
+                self.cumulative_server_compute_input_us += \
+                    server_timing.get("compute_input", 0.0)
+                self.cumulative_server_compute_infer_us += \
+                    server_timing.get("compute_infer", 0.0)
+                self.cumulative_server_compute_output_us += \
+                    server_timing.get("compute_output", 0.0)
+
+    def get(self) -> dict:
+        with self._lock:
+            return {
+                "completed_request_count": self.completed_request_count,
+                "cumulative_total_request_time_us":
+                    round(self.cumulative_total_request_time_us, 1),
+                "reported_request_count": self.reported_request_count,
+                "cumulative_server_queue_us":
+                    round(self.cumulative_server_queue_us, 1),
+                "cumulative_server_compute_input_us":
+                    round(self.cumulative_server_compute_input_us, 1),
+                "cumulative_server_compute_infer_us":
+                    round(self.cumulative_server_compute_infer_us, 1),
+                "cumulative_server_compute_output_us":
+                    round(self.cumulative_server_compute_output_us, 1),
+            }

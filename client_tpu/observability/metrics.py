@@ -1,0 +1,359 @@
+"""Prometheus-style metric primitives: Counter, Gauge, Histogram.
+
+The engine's original ``/metrics`` endpoint exported only cumulative sums
+(Triton nv_inference_* vocabulary) — enough for rates, useless for tails.
+These primitives add explicit-bucket histograms (the p50/p99 the ROADMAP
+north-star is judged by) and point-in-time gauges (queue depth, in-flight
+batches, device HBM), rendered in text exposition format 0.0.4 alongside
+the legacy counters by ``TpuEngine.prometheus_metrics``.
+
+Design notes:
+- ``MetricRegistry.histogram/gauge/counter`` are get-or-create (idempotent
+  per name); re-declaring a name with a different type/labels raises.
+- Child series (one per label combination) are created lazily via
+  ``labels(...)`` and cached; hot-path ``observe``/``inc`` is a bisect plus
+  a few adds under a per-family lock.
+- Rendering emits HELP then TYPE then samples per family, label values
+  escaped per the exposition spec, histogram buckets cumulative with a
+  terminal ``+Inf`` equal to ``_count``.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+
+# Microsecond latency ladder: sub-ms queue hops through multi-second
+# first-compile requests (16 finite buckets keeps series count modest).
+DURATION_US_BUCKETS = (
+    50, 100, 250, 500, 1000, 2500, 5000, 10_000, 25_000, 50_000,
+    100_000, 250_000, 500_000, 1_000_000, 5_000_000, 30_000_000,
+)
+# Batch-size ladder matches power_buckets() padding (scheduler.py).
+BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+
+def escape_label_value(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def escape_help(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def format_value(v) -> str:
+    """Render a sample value: integral floats print as integers."""
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def _label_str(labelnames, labelvalues) -> str:
+    return ",".join(
+        f'{k}="{escape_label_value(v)}"'
+        for k, v in zip(labelnames, labelvalues))
+
+
+class _Metric:
+    """One metric family: name, help, a child per label-value combination."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str,
+                 labelnames: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_text
+        self.labelnames = tuple(labelnames)
+        self._children: dict[tuple, object] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values, **kw):
+        if kw:
+            values = tuple(kw[k] for k in self.labelnames)
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"metric '{self.name}' takes labels {self.labelnames}, "
+                f"got {values}")
+        values = tuple(str(v) for v in values)
+        child = self._children.get(values)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(
+                    values, self._make_child())
+        return child
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def collect(self) -> list[str]:
+        lines = [f"# HELP {self.name} {escape_help(self.help)}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            items = sorted(self._children.items())
+        for values, child in items:
+            lines.extend(self._render_child(values, child))
+        return lines
+
+    def _render_child(self, values, child) -> list[str]:
+        raise NotImplementedError
+
+
+class _Value:
+    __slots__ = ("v", "lock")
+
+    def __init__(self):
+        self.v = 0.0
+        self.lock = threading.Lock()
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _make_child(self):
+        return _Value()
+
+    def inc(self, amount: float = 1.0, **labels):
+        child = self.labels(**labels) if self.labelnames else self.labels()
+        with child.lock:
+            child.v += amount
+
+    def _render_child(self, values, child) -> list[str]:
+        ls = _label_str(self.labelnames, values)
+        body = f"{{{ls}}}" if ls else ""
+        return [f"{self.name}{body} {format_value(child.v)}"]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def _make_child(self):
+        return _Value()
+
+    def set(self, value: float, **labels):
+        child = self.labels(**labels) if self.labelnames else self.labels()
+        with child.lock:
+            child.v = float(value)
+
+    def inc(self, amount: float = 1.0, **labels):
+        child = self.labels(**labels) if self.labelnames else self.labels()
+        with child.lock:
+            child.v += amount
+
+    def dec(self, amount: float = 1.0, **labels):
+        self.inc(-amount, **labels)
+
+    def _render_child(self, values, child) -> list[str]:
+        ls = _label_str(self.labelnames, values)
+        body = f"{{{ls}}}" if ls else ""
+        return [f"{self.name}{body} {format_value(child.v)}"]
+
+
+class _HistValue:
+    __slots__ = ("counts", "sum", "lock")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * (n_buckets + 1)  # +1 for the +Inf bucket
+        self.sum = 0.0
+        self.lock = threading.Lock()
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_text: str,
+                 labelnames: tuple[str, ...] = (),
+                 buckets=DURATION_US_BUCKETS):
+        super().__init__(name, help_text, labelnames)
+        bs = sorted(float(b) for b in buckets)
+        if not bs:
+            raise ValueError(f"histogram '{name}' needs >= 1 finite bucket")
+        self.buckets = tuple(bs)
+
+    def _make_child(self):
+        return _HistValue(len(self.buckets))
+
+    def observe(self, value: float, **labels):
+        child = self.labels(**labels) if self.labelnames else self.labels()
+        idx = bisect_left(self.buckets, value)
+        with child.lock:
+            child.counts[idx] += 1
+            child.sum += value
+
+    def _render_child(self, values, child) -> list[str]:
+        ls = _label_str(self.labelnames, values)
+        with child.lock:
+            counts = list(child.counts)
+            total_sum = child.sum
+        lines = []
+        cum = 0
+        for le, n in zip(self.buckets, counts):
+            cum += n
+            sep = "," if ls else ""
+            lines.append(
+                f'{self.name}_bucket{{{ls}{sep}le="{format_value(le)}"}} '
+                f"{cum}")
+        cum += counts[-1]
+        sep = "," if ls else ""
+        lines.append(f'{self.name}_bucket{{{ls}{sep}le="+Inf"}} {cum}')
+        body = f"{{{ls}}}" if ls else ""
+        lines.append(f"{self.name}_sum{body} {format_value(total_sum)}")
+        lines.append(f"{self.name}_count{body} {cum}")
+        return lines
+
+
+class MetricRegistry:
+    """Ordered collection of metric families with get-or-create semantics."""
+
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name, help_text, labelnames, **kw):
+        labelnames = tuple(labelnames or ())
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if (type(existing) is not cls
+                        or existing.labelnames != labelnames):
+                    raise ValueError(
+                        f"metric '{name}' already registered as "
+                        f"{type(existing).__name__}{existing.labelnames}")
+                return existing
+            m = cls(name, help_text, labelnames, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help_text, labelnames=()) -> Counter:
+        return self._get_or_create(Counter, name, help_text, labelnames)
+
+    def gauge(self, name, help_text, labelnames=()) -> Gauge:
+        return self._get_or_create(Gauge, name, help_text, labelnames)
+
+    def histogram(self, name, help_text, labelnames=(),
+                  buckets=DURATION_US_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help_text, labelnames,
+                                   buckets=buckets)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m.collect())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+# Process-wide default registry for library users that want one aggregation
+# point across engines; TpuEngine defaults to a private registry per
+# instance (so concurrent engines in one process don't cross-pollute their
+# /metrics) but accepts this via TpuEngine(metrics_registry=REGISTRY).
+REGISTRY = MetricRegistry()
+
+
+class ModelInstruments:
+    """Per-model:version bound handles for the hot-path observations."""
+
+    __slots__ = ("_em", "_labels")
+
+    def __init__(self, em: "EngineMetrics", model: str, version: str):
+        self._em = em
+        self._labels = {"model": model, "version": version}
+
+    def observe_request(self, total_ns: int, times) -> None:
+        em = self._em
+        lab = self._labels
+        em.request_duration_us.observe(max(0, total_ns) / 1e3, **lab)
+        em.phase_duration_us.observe(times.queue_ns / 1e3,
+                                     phase="queue", **lab)
+        em.phase_duration_us.observe(times.compute_input_ns / 1e3,
+                                     phase="compute_input", **lab)
+        em.phase_duration_us.observe(times.compute_infer_ns / 1e3,
+                                     phase="compute_infer", **lab)
+        em.phase_duration_us.observe(times.compute_output_ns / 1e3,
+                                     phase="compute_output", **lab)
+
+    def observe_execution(self, batch_size: int) -> None:
+        self._em.batch_size.observe(batch_size, **self._labels)
+
+    def record_rejection(self) -> None:
+        self._em.queue_rejections.inc(**self._labels)
+
+
+class EngineMetrics:
+    """The engine's standard metric vocabulary on one registry.
+
+    Histograms: tpu_request_duration_us, tpu_phase_duration_us{phase},
+    tpu_batch_size. Gauges: tpu_queue_depth, tpu_inflight_batches,
+    tpu_device_hbm_bytes_in_use. Counter: tpu_queue_rejections_total.
+    """
+
+    def __init__(self, registry: MetricRegistry | None = None):
+        self.registry = registry or MetricRegistry()
+        r = self.registry
+        self.request_duration_us = r.histogram(
+            "tpu_request_duration_us",
+            "End-to-end successful request duration (microseconds)",
+            ("model", "version"))
+        self.phase_duration_us = r.histogram(
+            "tpu_phase_duration_us",
+            "Per-phase request duration (microseconds)",
+            ("model", "version", "phase"))
+        self.batch_size = r.histogram(
+            "tpu_batch_size",
+            "Requests per model execution (batch size)",
+            ("model", "version"), buckets=BATCH_SIZE_BUCKETS)
+        self.queue_depth = r.gauge(
+            "tpu_queue_depth",
+            "Requests waiting in the scheduler queue",
+            ("model", "version"))
+        self.inflight_batches = r.gauge(
+            "tpu_inflight_batches",
+            "Batches currently executing on device",
+            ("model", "version"))
+        self.hbm_bytes = r.gauge(
+            "tpu_device_hbm_bytes_in_use",
+            "Device HBM bytes in use (0 when the platform does not report "
+            "memory stats, e.g. CPU)",
+            ("device",))
+        self.queue_rejections = r.counter(
+            "tpu_queue_rejections_total",
+            "Requests rejected at admission (backpressure, HTTP 429)",
+            ("model", "version"))
+        self._instruments: dict[tuple[str, str], ModelInstruments] = {}
+        self._lock = threading.Lock()
+
+    def model_instruments(self, model: str, version: str) -> ModelInstruments:
+        key = (model, str(version))
+        inst = self._instruments.get(key)
+        if inst is None:
+            with self._lock:
+                inst = self._instruments.setdefault(
+                    key, ModelInstruments(self, key[0], key[1]))
+        return inst
+
+    def update_device_gauges(self) -> None:
+        """Sample per-device HBM usage; on platforms without memory stats
+        (JAX_PLATFORMS=cpu) the gauge still renders, pinned to 0."""
+        sampled = False
+        try:
+            import jax
+
+            for d in jax.local_devices():
+                try:
+                    ms = d.memory_stats()
+                except Exception:  # noqa: BLE001 — per-device probe
+                    ms = None
+                self.hbm_bytes.set(
+                    int((ms or {}).get("bytes_in_use", 0)),
+                    device=str(d.id))
+                sampled = True
+        except Exception:  # noqa: BLE001 — no backend at all
+            pass
+        if not sampled:
+            self.hbm_bytes.set(0, device="0")
+
+    def render(self) -> str:
+        return self.registry.render()

@@ -25,6 +25,7 @@ from client_tpu.engine.types import (
     InferRequest,
     OutputRequest,
 )
+from client_tpu.observability.tracing import TraceContext
 from client_tpu.protocol import grpc_codec, grpc_service_pb2 as pb
 from client_tpu.protocol.dtypes import np_to_wire_dtype
 from client_tpu.protocol.grpc_stub import (
@@ -156,6 +157,22 @@ def _response_to_proto(engine: TpuEngine, req: InferRequest, resp,
                                  written)
             continue
         out.raw_output_contents.append(grpc_codec.ndarray_to_raw(arr, dt))
+
+    # Trace round-trip: only for callers that SENT a traceparent (the
+    # response parameter set must stay unchanged for everyone else), and
+    # only on the final response of a stream.
+    if (req.trace is not None and resp.final
+            and req.parameters.get("traceparent")):
+        grpc_codec.set_param(out.parameters, "traceparent",
+                             req.trace.to_traceparent())
+        if resp.times is not None:
+            t = resp.times
+            for phase, ns in (("queue", t.queue_ns),
+                              ("compute_input", t.compute_input_ns),
+                              ("compute_infer", t.compute_infer_ns),
+                              ("compute_output", t.compute_output_ns)):
+                grpc_codec.set_param(out.parameters,
+                                     f"server_{phase}_us", ns // 1000)
     return out
 
 
@@ -173,6 +190,21 @@ class _Servicer(GRPCInferenceServiceServicer):
                 "CLIENT_TPU_STREAM_PENDING_LIMIT",
                 str(self.STREAM_PENDING_LIMIT)))
         self.STREAM_PENDING_LIMIT = max(1, stream_pending_limit)
+
+    @staticmethod
+    def _adopt_trace(req: InferRequest, context=None) -> None:
+        """Adopt the caller's W3C trace context. gRPC carries it either as
+        a request parameter (works on streams, where per-message metadata
+        does not exist) or as RPC metadata (the OpenTelemetry convention);
+        the parameter wins. Metadata-sourced ids are copied into
+        ``req.parameters`` so the response round-trip gate sees them."""
+        tp = req.parameters.get("traceparent")
+        if not tp and context is not None:
+            md = {k: v for k, v in (context.invocation_metadata() or [])}
+            tp = md.get("traceparent")
+            if tp:
+                req.parameters["traceparent"] = tp
+        req.trace = TraceContext.from_traceparent(tp)
 
     # -- health / metadata ---------------------------------------------------
 
@@ -238,6 +270,7 @@ class _Servicer(GRPCInferenceServiceServicer):
             for b in s.get("batch_stats", []):
                 be = entry.batch_stats.add(batch_size=b["batch_size"])
                 be.compute_infer.count = b["compute_infer"]["count"]
+                be.compute_infer.ns = b["compute_infer"]["ns"]
         return resp
 
     # -- repository ----------------------------------------------------------
@@ -366,6 +399,7 @@ class _Servicer(GRPCInferenceServiceServicer):
     def ModelInfer(self, request, context):  # noqa: N802
         try:
             req = _proto_to_request(self.engine, request)
+            self._adopt_trace(req, context)
             # Client disconnect/cancel marks the request so the scheduler
             # skips it instead of spending device time on a dead caller.
             # add_callback returns False when the RPC already terminated —
@@ -502,6 +536,7 @@ class _Servicer(GRPCInferenceServiceServicer):
                         out_q.put(("err", str(exc), ""))
                         continue
 
+                    self._adopt_trace(req)
                     req.backpressure = rpc_backlogged
                     with lock:
                         inflight[0] += 1

@@ -31,6 +31,10 @@ _log = logging.getLogger("client_tpu")
 
 from client_tpu.engine.engine import TpuEngine
 from client_tpu.engine.types import EngineError, InferRequest, OutputRequest
+from client_tpu.observability.tracing import (
+    TraceContext,
+    server_timing_header,
+)
 from client_tpu.protocol import rest
 from client_tpu.server.classification import classify_output
 
@@ -59,6 +63,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
                         r"(?:/region/([^/]+))?/unregister$"), "shm_unregister"),
     ("GET", re.compile(r"^/v2/trace/setting$"), "trace_setting"),
     ("POST", re.compile(r"^/v2/trace/setting$"), "trace_update"),
+    ("GET", re.compile(r"^/v2/trace/requests$"), "trace_requests"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
 ]
 
@@ -245,6 +250,16 @@ class _Handler(BaseHTTPRequestHandler):
     def h_trace_update(self):
         body = json.loads(self._read_body() or b"{}")
         self._send_json(self.engine.update_trace_setting(body))
+
+    def h_trace_requests(self):
+        """Chrome trace-event JSON of recently traced requests; open the
+        result in chrome://tracing or Perfetto. ``?trace_id=<32hex>``
+        filters to one request's timeline."""
+        from urllib.parse import parse_qs, urlparse
+
+        q = parse_qs(urlparse(self.path).query)
+        trace_id = (q.get("trace_id") or [None])[0]
+        self._send_json(self.engine.request_trace_export(trace_id))
 
     def h_shm_status(self, kind, region=None):
         self._send_json(self._shm_manager(kind).status(region))
@@ -496,6 +511,10 @@ class _Handler(BaseHTTPRequestHandler):
             sequence_end=bool(params.get("sequence_end", False)),
             priority=int(params.get("priority", 0)),
             timeout_us=int(params.get("timeout", 0)),
+            # Adopt the caller's W3C trace context (or start a new trace);
+            # every HTTP inference is traced into the engine's ring buffer.
+            trace=TraceContext.from_traceparent(
+                self.headers.get("traceparent")),
         )
         return req
 
@@ -566,6 +585,13 @@ class _Handler(BaseHTTPRequestHandler):
             ctype = "application/octet-stream"
         else:
             ctype = "application/json"
+        # Round-trip the trace id (clients correlate against
+        # /v2/trace/requests) and surface the server-side phase breakdown
+        # as a standard Server-Timing header.
+        if req.trace is not None:
+            headers["traceparent"] = req.trace.to_traceparent()
+        if resp.times is not None:
+            headers["Server-Timing"] = server_timing_header(resp.times)
         self._send(200, body, content_type=ctype, extra_headers=headers)
 
     def _write_shm_output(self, o: OutputRequest, arr: np.ndarray) -> int:

@@ -270,3 +270,55 @@ class TestStreaming:
         assert "unknown model" in str(errors[0])
         c.stop_stream()
         c.close()
+
+
+class TestObservability:
+    """Trace propagation over gRPC: request parameter (explicit) and RPC
+    metadata both adopt the caller's trace id; the final response echoes
+    it plus the server_*_us phase parameters."""
+
+    TRACEPARENT = ("00-" + "ef" * 16 + "-" + "12" * 8 + "-01")
+
+    def test_traceparent_parameter_round_trip(self, client):
+        a, b, inputs = _simple_inputs()
+        result = client.infer(
+            "simple", inputs,
+            parameters={"traceparent": self.TRACEPARENT})
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+        assert result.trace_id() == "ef" * 16
+        timing = result.server_timing()
+        assert set(timing) == {"queue", "compute_input", "compute_infer",
+                               "compute_output"}
+        assert all(v >= 0 for v in timing.values())
+
+    def test_traceparent_metadata_adopted(self, client):
+        _, _, inputs = _simple_inputs()
+        result = client.infer(
+            "simple", inputs,
+            headers={"traceparent": self.TRACEPARENT})
+        # Metadata-sourced ids round-trip exactly like parameter-sourced
+        # ones (the servicer copies them into the parameter set).
+        assert result.trace_id() == "ef" * 16
+
+    def test_client_auto_trace_and_stats(self, server):
+        c = grpcclient.InferenceServerClient(server.url)
+        _, _, inputs = _simple_inputs()
+        r1 = c.infer("simple", inputs)
+        r2 = c.infer("simple", inputs)
+        tid1, tid2 = r1.trace_id(), r2.trace_id()
+        assert tid1 and tid2 and tid1 != tid2  # fresh trace per request
+        stat = c.get_infer_stat()
+        assert stat["completed_request_count"] == 2
+        assert stat["reported_request_count"] == 2
+        assert stat["cumulative_server_compute_infer_us"] >= 0
+
+    def test_batch_stats_ns_exported(self, client):
+        _, _, inputs = _simple_inputs()
+        client.infer("simple", inputs)
+        stats = client.get_inference_statistics("simple", as_json=True)
+        entry = stats["model_stats"][0]
+        batches = entry.get("batch_stats", [])
+        assert batches
+        total_ns = sum(int(b["compute_infer"].get("ns", 0))
+                       for b in batches)
+        assert total_ns > 0

@@ -474,3 +474,127 @@ class TestGenerateEndpoints:
         resp.read()
         conn.close()
         assert resp.status == 400
+
+
+class TestObservability:
+    """Trace propagation + histogram/gauge layer over the real wire."""
+
+    TRACEPARENT = ("00-" + "ab" * 16 + "-" + "cd" * 8 + "-01")
+
+    def _raw_get(self, server, path):
+        import http.client as hc
+
+        host, port = server.url.split(":")
+        conn = hc.HTTPConnection(host, int(port), timeout=60)
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        conn.close()
+        return resp, body
+
+    def test_traceparent_round_trip(self, server, client):
+        a, b, inputs = _simple_inputs()
+        result = client.infer(
+            "simple", inputs,
+            headers={"traceparent": self.TRACEPARENT})
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+        # Same trace id comes back; the server minted a fresh span id.
+        assert result.trace_id() == "ab" * 16
+        timing = result.server_timing()
+        assert set(timing) == {"queue", "compute_input", "compute_infer",
+                               "compute_output"}
+        assert all(v >= 0 for v in timing.values())
+
+    def test_trace_generated_when_absent(self, client):
+        _, _, inputs = _simple_inputs()
+        result = client.infer("simple", inputs)
+        tid = result.trace_id()
+        assert tid is not None and len(tid) == 32 and tid != "00" * 16
+
+    def test_trace_requests_export(self, server, client):
+        import json as j
+
+        # A trace id unique to this test: the export filter must return
+        # exactly this request's timeline, not earlier tests' spans.
+        tid = "5e" * 16
+        _, _, inputs = _simple_inputs()
+        client.infer("simple", inputs,
+                     headers={"traceparent": f"00-{tid}-{'cd' * 8}-01"})
+        resp, body = self._raw_get(
+            server, f"/v2/trace/requests?trace_id={tid}")
+        assert resp.status == 200
+        doc = j.loads(body)
+        events = doc["traceEvents"]
+        assert events, "no trace events for the propagated trace id"
+        names = {e["name"] for e in events}
+        assert "simple:request" in names
+        assert {"queue", "compute_input", "compute_infer",
+                "compute_output"} <= names
+        req_ev = next(e for e in events if e["name"] == "simple:request")
+        assert req_ev["ph"] == "X"
+        assert req_ev["args"]["trace_id"] == tid
+        assert req_ev["args"]["parent_span_id"] == "cd" * 8
+        assert req_ev["dur"] >= sum(
+            e["dur"] for e in events
+            if e["name"] in ("compute_input", "compute_infer",
+                             "compute_output")) * 0.99
+
+    def test_metrics_pass_promlint_and_expose_families(self, server, client):
+        import importlib.util
+        import os
+
+        _, _, inputs = _simple_inputs()
+        client.infer("simple", inputs)
+        resp, body = self._raw_get(server, "/metrics")
+        text = body.decode()
+        spec = importlib.util.spec_from_file_location(
+            "promlint", os.path.join(os.path.dirname(__file__), "..",
+                                     "tools", "promlint.py"))
+        promlint = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(promlint)
+        errors = promlint.lint(text)
+        assert not errors, errors
+        assert "# TYPE tpu_request_duration_us histogram" in text
+        assert "# TYPE tpu_queue_depth gauge" in text
+        assert "# TYPE tpu_device_hbm_bytes_in_use gauge" in text
+        assert 'tpu_request_duration_us_bucket{model="simple"' in text
+        # The scrape helpers agree with what the server rendered.
+        from client_tpu.observability import scrape
+
+        state = scrape.histogram_state(text, "tpu_request_duration_us")
+        assert state["count"] >= 1
+        q = scrape.quantile(state, 0.5)
+        assert q == q and q > 0  # not NaN
+
+    def test_client_infer_stat(self, server):
+        c = httpclient.InferenceServerClient(server.url)
+        try:
+            _, _, inputs = _simple_inputs()
+            c.infer("simple", inputs)
+            c.infer("simple", inputs)
+            stat = c.get_infer_stat()
+        finally:
+            c.close()
+        assert stat["completed_request_count"] == 2
+        assert stat["reported_request_count"] == 2
+        assert stat["cumulative_total_request_time_us"] > 0
+        # Server-side phases are a subset of the measured round trip.
+        server_sum = (stat["cumulative_server_queue_us"]
+                      + stat["cumulative_server_compute_input_us"]
+                      + stat["cumulative_server_compute_infer_us"]
+                      + stat["cumulative_server_compute_output_us"])
+        assert server_sum <= stat["cumulative_total_request_time_us"]
+
+    def test_stats_last_inference_and_batch_ns(self, server, client):
+        import time as _time
+
+        _, _, inputs = _simple_inputs()
+        before_ms = int(_time.time() * 1000)
+        client.infer("simple", inputs)
+        stats = client.get_inference_statistics("simple")
+        entry = stats["model_stats"][0]
+        assert entry["last_inference"] >= before_ms - 1
+        batch = entry["batch_stats"]
+        assert batch, "batch_stats empty"
+        assert sum(b["compute_infer"]["count"] for b in batch) >= 1
+        assert sum(b["compute_infer"]["ns"] for b in batch) > 0

@@ -1,0 +1,431 @@
+"""Unit tests for the observability layer: metric primitives and their
+exposition rendering, the promlint checker itself, W3C trace-context
+handling, the span ring buffer + Chrome export, the scrape/quantile
+helpers, and the ModelStats fixes (last_inference wall-clock, per-batch
+compute ns) — plus one engine-level integration pass."""
+
+import importlib.util
+import json
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+
+from client_tpu.engine.stats import ModelStats
+from client_tpu.engine.types import RequestTimes
+from client_tpu.observability import scrape
+from client_tpu.observability.metrics import (
+    BATCH_SIZE_BUCKETS,
+    Counter,
+    EngineMetrics,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    escape_label_value,
+)
+from client_tpu.observability.tracing import (
+    MAX_CHUNK_EVENTS,
+    RequestTrace,
+    Span,
+    TraceContext,
+    TraceStore,
+    build_request_trace,
+    parse_server_timing,
+    server_timing_header,
+)
+
+
+def _load_promlint():
+    spec = importlib.util.spec_from_file_location(
+        "promlint", os.path.join(os.path.dirname(__file__), "..",
+                                 "tools", "promlint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+promlint = _load_promlint()
+
+
+class TestMetricPrimitives:
+    def test_counter_renders_help_type_samples_in_order(self):
+        c = Counter("x_total", "help text", ("model",))
+        c.inc(model="m1")
+        c.inc(2, model="m1")
+        lines = c.collect()
+        assert lines[0] == "# HELP x_total help text"
+        assert lines[1] == "# TYPE x_total counter"
+        assert lines[2] == 'x_total{model="m1"} 3'
+
+    def test_gauge_set_inc_dec(self):
+        g = Gauge("depth", "d", ("q",))
+        g.set(5, q="a")
+        g.inc(2, q="a")
+        g.dec(q="a")
+        assert 'depth{q="a"} 6' in g.collect()
+
+    def test_label_escaping(self):
+        assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+        c = Counter("esc", "h", ("l",))
+        c.inc(l='quote " slash \\ nl \n')
+        line = c.collect()[2]
+        assert '\\"' in line and "\\\\" in line and "\\n" in line
+        # and the scrape parser round-trips it
+        (name, labels, value), = scrape.parse_samples(line)
+        assert labels["l"] == 'quote " slash \\ nl \n'
+
+    def test_histogram_buckets_cumulative_with_inf(self):
+        h = Histogram("lat", "h", buckets=(10, 100, 1000))
+        for v in (5, 5, 50, 5000):
+            h.observe(v)
+        lines = h.collect()
+        assert 'lat_bucket{le="10"} 2' in lines
+        assert 'lat_bucket{le="100"} 3' in lines
+        assert 'lat_bucket{le="1000"} 3' in lines
+        assert 'lat_bucket{le="+Inf"} 4' in lines
+        assert "lat_sum 5060" in lines
+        assert "lat_count 4" in lines
+
+    def test_histogram_boundary_is_inclusive(self):
+        # Prometheus le is <=: an observation exactly on a bound lands in
+        # that bucket.
+        h = Histogram("b", "h", buckets=(10,))
+        h.observe(10)
+        assert 'b_bucket{le="10"} 1' in h.collect()
+
+    def test_registry_get_or_create_and_conflict(self):
+        r = MetricRegistry()
+        c1 = r.counter("n", "h", ("a",))
+        assert r.counter("n", "h", ("a",)) is c1
+        with pytest.raises(ValueError):
+            r.gauge("n", "h", ("a",))
+        with pytest.raises(ValueError):
+            r.counter("n", "h", ("b",))
+
+    def test_registry_render_passes_promlint(self):
+        r = MetricRegistry()
+        r.counter("a_total", "c", ("m",)).inc(m="x")
+        r.gauge("g", "g help").set(1.5)
+        h = r.histogram("h_us", "h", ("m",), buckets=(1, 10))
+        h.observe(3, m="x")
+        h.observe(30, m="y")
+        errors = promlint.lint(r.render())
+        assert not errors, errors
+
+    def test_engine_metrics_vocabulary(self):
+        em = EngineMetrics()
+        inst = em.model_instruments("m", "1")
+        assert em.model_instruments("m", "1") is inst
+        t = RequestTimes(received=0, queue_start=1000, compute_start=2000,
+                         compute_input_end=3000, compute_infer_end=9000,
+                         compute_output_end=10_000)
+        inst.observe_request(9000, t)
+        inst.observe_execution(4)
+        inst.record_rejection()
+        em.update_device_gauges()
+        text = em.render()
+        assert 'tpu_request_duration_us_bucket{model="m",version="1"' in text
+        assert 'phase="compute_infer"' in text
+        assert "tpu_batch_size_bucket" in text
+        assert "tpu_device_hbm_bytes_in_use" in text
+        assert 'tpu_queue_rejections_total{model="m",version="1"} 1' in text
+        assert not promlint.lint(text)
+
+    def test_batch_buckets_cover_powers_of_two(self):
+        assert BATCH_SIZE_BUCKETS[0] == 1
+        assert all(b == 2 ** i for i, b in enumerate(BATCH_SIZE_BUCKETS))
+
+
+class TestPromlint:
+    def test_clean_text_passes(self):
+        text = (
+            "# HELP a_total things\n"
+            "# TYPE a_total counter\n"
+            'a_total{m="x"} 3\n'
+            "# HELP h_us lat\n"
+            "# TYPE h_us histogram\n"
+            'h_us_bucket{le="1"} 1\n'
+            'h_us_bucket{le="+Inf"} 2\n'
+            "h_us_sum 3.5\n"
+            "h_us_count 2\n")
+        assert promlint.lint(text) == []
+
+    def test_type_after_samples_flagged(self):
+        text = ("# HELP a h\na 1\n# TYPE a counter\n")
+        errors = promlint.lint(text)
+        assert any("TYPE" in e for e in errors)
+
+    def test_reopened_family_flagged(self):
+        text = ("# HELP a h\n# TYPE a counter\na 1\n"
+                "# HELP b h\n# TYPE b counter\nb 1\n"
+                "a 2\n")
+        errors = promlint.lint(text)
+        assert any("outside its family" in e or "re-opened" in e
+                   for e in errors)
+
+    def test_histogram_invariants_flagged(self):
+        base = ("# HELP h x\n# TYPE h histogram\n")
+        # non-cumulative buckets
+        errors = promlint.lint(
+            base + 'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 3\n'
+                   "h_sum 1\nh_count 3\n")
+        assert any("not cumulative" in e for e in errors)
+        # missing +Inf
+        errors = promlint.lint(
+            base + 'h_bucket{le="1"} 1\nh_sum 1\nh_count 1\n')
+        assert any("+Inf" in e for e in errors)
+        # +Inf != count
+        errors = promlint.lint(
+            base + 'h_bucket{le="+Inf"} 2\nh_sum 1\nh_count 3\n')
+        assert any("_count" in e for e in errors)
+        # missing _sum
+        errors = promlint.lint(
+            base + 'h_bucket{le="+Inf"} 1\nh_count 1\n')
+        assert any("_sum" in e for e in errors)
+
+    def test_bad_names_and_values_flagged(self):
+        errors = promlint.lint("# HELP 9bad h\n# TYPE 9bad counter\n")
+        assert any("invalid metric name" in e for e in errors)
+        errors = promlint.lint(
+            "# HELP a h\n# TYPE a counter\na notanumber\n")
+        assert any("invalid sample value" in e for e in errors)
+        errors = promlint.lint(
+            "# HELP a h\n# TYPE a counter\na{bad-label=\"x\"} 1\n")
+        assert errors
+
+    def test_orphan_sample_flagged(self):
+        errors = promlint.lint("loose_metric 1\n")
+        assert any("no preceding TYPE" in e for e in errors)
+
+
+class TestTraceContext:
+    def test_parse_valid_traceparent(self):
+        tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        ctx = TraceContext.from_traceparent(tp)
+        assert ctx.trace_id == "ab" * 16
+        assert ctx.parent_span_id == "cd" * 8
+        assert ctx.span_id != "cd" * 8 and len(ctx.span_id) == 16
+        assert ctx.flags == 1
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-short-cdcdcdcdcdcdcdcd-01",
+        "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",       # all-zero trace id
+        "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",      # all-zero span id
+        "00-" + "AB" * 16,                                # truncated
+    ])
+    def test_invalid_headers_restart(self, bad):
+        ctx = TraceContext.from_traceparent(bad)
+        assert len(ctx.trace_id) == 32 and ctx.trace_id != "0" * 32
+        assert ctx.parent_span_id == ""
+
+    def test_uppercase_header_normalised(self):
+        tp = "00-" + "AB" * 16 + "-" + "CD" * 8 + "-01"
+        assert TraceContext.from_traceparent(tp).trace_id == "ab" * 16
+
+    def test_to_traceparent_format(self):
+        ctx = TraceContext.new()
+        tp = ctx.to_traceparent()
+        assert TraceContext.from_traceparent(tp).trace_id == ctx.trace_id
+        assert tp.startswith("00-") and tp.endswith("-01")
+
+    def test_child_keeps_trace_id(self):
+        ctx = TraceContext.new()
+        kid = ctx.child()
+        assert kid.trace_id == ctx.trace_id
+        assert kid.parent_span_id == ctx.span_id
+        assert kid.span_id != ctx.span_id
+
+    def test_server_timing_round_trip(self):
+        t = RequestTimes(queue_start=0, compute_start=1_500_000,
+                         compute_input_end=2_000_000,
+                         compute_infer_end=10_000_000,
+                         compute_output_end=10_250_000)
+        hdr = server_timing_header(t)
+        parsed = parse_server_timing(hdr)
+        assert parsed["queue"] == pytest.approx(1500, abs=1)
+        assert parsed["compute_infer"] == pytest.approx(8000, abs=1)
+        assert parse_server_timing(None) == {}
+        assert parse_server_timing("weird;;junk=,") == {}
+
+
+class TestTraceStore:
+    def _trace(self, trace_id="t" * 32, n_spans=1):
+        return RequestTrace(
+            trace_id=trace_id, span_id="s" * 16, parent_span_id="",
+            model_name="m", request_id="r", ok=True,
+            spans=[Span(f"sp{i}", 1000, 2000) for i in range(n_spans)])
+
+    def test_ring_buffer_bounded(self):
+        store = TraceStore(capacity=3)
+        for i in range(10):
+            store.add(self._trace(trace_id=f"{i:032x}"))
+        assert len(store) == 3
+        ids = [t.trace_id for t in store.snapshot()]
+        assert ids == [f"{i:032x}" for i in (7, 8, 9)]
+
+    def test_snapshot_filter(self):
+        store = TraceStore()
+        store.add(self._trace(trace_id="a" * 32))
+        store.add(self._trace(trace_id="b" * 32))
+        assert len(store.snapshot("a" * 32)) == 1
+        assert store.snapshot("c" * 32) == []
+
+    def test_chrome_export_shape(self):
+        store = TraceStore()
+        t = self._trace(n_spans=2)
+        t.chunk_ts_ns = [1500]
+        store.add(t)
+        doc = json.loads(store.to_json())
+        assert doc["displayTimeUnit"] == "ms"
+        events = doc["traceEvents"]
+        assert len(events) == 3  # 2 spans + 1 chunk instant
+        span_ev = events[0]
+        assert span_ev["ph"] == "X" and span_ev["pid"] == 1
+        assert span_ev["ts"] == 1.0 and span_ev["dur"] == 1.0  # ns -> us
+        chunk_ev = events[-1]
+        assert chunk_ev["ph"] == "i" and chunk_ev["s"] == "t"
+
+    def test_build_request_trace_spans_and_chunk_cap(self):
+        ctx = TraceContext.new()
+        t = RequestTimes(received=100, queue_start=200, compute_start=1000,
+                         compute_input_end=1200, compute_infer_end=5000,
+                         compute_output_end=5600)
+        trace = build_request_trace(
+            ctx, "m", "rid", t, ok=True,
+            chunks=list(range(MAX_CHUNK_EVENTS + 50)))
+        names = {s.name for s in trace.spans}
+        assert names == {"request", "queue", "compute_input",
+                         "compute_infer", "compute_output"}
+        req = next(s for s in trace.spans if s.name == "request")
+        assert (req.start_ns, req.end_ns) == (100, 5600)
+        assert len(trace.chunk_ts_ns) == MAX_CHUNK_EVENTS
+        assert trace.wall_time_ms > 0
+
+    def test_build_request_trace_omits_unstamped_phases(self):
+        ctx = TraceContext.new()
+        t = RequestTimes(received=100, queue_start=200)  # rejected in queue
+        trace = build_request_trace(ctx, "m", "", t, ok=False, error="full")
+        names = {s.name for s in trace.spans}
+        assert "compute_infer" not in names
+        assert trace.error == "full"
+
+
+class TestScrape:
+    TEXT = (
+        "# HELP h_us lat\n# TYPE h_us histogram\n"
+        'h_us_bucket{m="a",le="100"} 10\n'
+        'h_us_bucket{m="a",le="1000"} 19\n'
+        'h_us_bucket{m="a",le="+Inf"} 20\n'
+        'h_us_sum{m="a"} 9000\n'
+        'h_us_count{m="a"} 20\n')
+
+    def test_histogram_state_and_quantile(self):
+        state = scrape.histogram_state(self.TEXT, "h_us")
+        assert state["count"] == 20 and state["sum"] == 9000
+        # p50: rank 10 -> exactly the 100-bucket boundary
+        assert scrape.quantile(state, 0.5) == pytest.approx(100.0)
+        # p95: rank 19 of 20 -> upper edge of the 1000 bucket
+        assert scrape.quantile(state, 0.95) == pytest.approx(1000.0)
+        # p99 lands in +Inf -> highest finite bound
+        assert scrape.quantile(state, 0.99) == pytest.approx(1000.0)
+
+    def test_delta_and_empty_window(self):
+        before = scrape.histogram_state(self.TEXT, "h_us")
+        d = scrape.delta(before, before)
+        assert d["count"] == 0
+        assert math.isnan(scrape.quantile(d, 0.5))
+
+    def test_aggregates_across_label_sets(self):
+        text = self.TEXT + (
+            'h_us_bucket{m="b",le="100"} 5\n'
+            'h_us_bucket{m="b",le="1000"} 5\n'
+            'h_us_bucket{m="b",le="+Inf"} 5\n'
+            'h_us_sum{m="b"} 100\nh_us_count{m="b"} 5\n')
+        state = scrape.histogram_state(text, "h_us")
+        assert state["count"] == 25
+        assert state["buckets"][100.0] == 15
+
+
+class TestModelStatsFixes:
+    def _times(self):
+        return RequestTimes(received=0, queue_start=100, compute_start=200,
+                            compute_input_end=300, compute_infer_end=700,
+                            compute_output_end=800)
+
+    def test_last_inference_wall_clock(self):
+        s = ModelStats("m")
+        assert s.to_dict()["last_inference"] == 0
+        before = int(time.time() * 1000)
+        s.record_request(self._times(), success=True)
+        after = int(time.time() * 1000)
+        assert before <= s.to_dict()["last_inference"] <= after
+        # failures don't advance it
+        mark = s.to_dict()["last_inference"]
+        s.record_request(self._times(), success=False)
+        assert s.to_dict()["last_inference"] == mark
+
+    def test_batch_stats_carry_compute_ns(self):
+        s = ModelStats("m")
+        s.record_execution(4, compute_ns=1000)
+        s.record_execution(4, compute_ns=500)
+        s.record_execution(1)
+        s.add_execution_ns(1, 250)
+        d = s.to_dict()
+        by_size = {b["batch_size"]: b["compute_infer"]
+                   for b in d["batch_stats"]}
+        assert by_size[4] == {"count": 2, "ns": 1500}
+        assert by_size[1] == {"count": 1, "ns": 250}
+        assert d["execution_count"] == 3
+
+    def test_instruments_hook(self):
+        em = EngineMetrics()
+        s = ModelStats("m", "1", instruments=em.model_instruments("m", "1"))
+        s.record_request(self._times(), success=True)
+        s.record_execution(2, compute_ns=400)
+        s.record_rejection()
+        text = em.render()
+        assert "tpu_request_duration_us_count" in text
+        assert 'tpu_queue_rejections_total{model="m",version="1"} 1' in text
+        state = scrape.histogram_state(text, "tpu_batch_size")
+        assert state["count"] == 1
+
+
+class TestEngineIntegration:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        from client_tpu.engine import TpuEngine
+        from client_tpu.models import build_repository
+
+        eng = TpuEngine(build_repository(["simple"]))
+        yield eng
+        eng.shutdown()
+
+    def _infer(self, engine, trace=None):
+        from client_tpu.engine.types import InferRequest
+
+        return engine.infer(InferRequest(
+            model_name="simple",
+            inputs={"INPUT0": np.zeros((1, 16), np.int32),
+                    "INPUT1": np.ones((1, 16), np.int32)},
+            trace=trace), timeout_s=120)
+
+    def test_untraced_requests_skip_the_trace_store(self, engine):
+        n0 = len(engine.request_traces)
+        self._infer(engine)
+        assert len(engine.request_traces) == n0
+
+    def test_traced_request_lands_in_store_and_metrics(self, engine):
+        ctx = TraceContext.from_traceparent(
+            "00-" + "12" * 16 + "-" + "34" * 8 + "-01")
+        self._infer(engine, trace=ctx)
+        doc = engine.request_trace_export("12" * 16)
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "simple:request" in names and "compute_infer" in names
+        text = engine.prometheus_metrics()
+        assert not promlint.lint(text), promlint.lint(text)
+        assert 'tpu_queue_depth{model="simple"' in text
+        assert "tpu_inflight_batches" in text
+        state = scrape.histogram_state(text, "tpu_request_duration_us")
+        assert state["count"] >= 1

@@ -1,0 +1,253 @@
+#!/usr/bin/env python3
+"""Standalone Prometheus text-exposition (0.0.4) linter.
+
+Validates what scrapers actually trip over: HELP/TYPE/sample ordering per
+family, re-opened families, metric/label name syntax, label-string escaping,
+and histogram invariants (cumulative le-buckets, terminal +Inf == _count,
+_sum present). Stdlib only, so it runs inside tier-1 tests and against any
+live endpoint:
+
+    python tools/promlint.py metrics.txt
+    curl -s localhost:8000/metrics | python tools/promlint.py
+
+Exit status 0 when clean, 1 with one "line N: message" per finding.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import sys
+
+METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# One label pair: name="value" with \\ \" \n escapes only.
+_PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\[\\"n])*)"')
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)(?:\s+(-?\d+))?$")
+
+VALID_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+_SUFFIXES = ("_bucket", "_sum", "_count", "_total")
+
+
+def _family_of(sample_name: str, families: set[str]) -> str:
+    """Map a sample name to its family: histogram/summary series names
+    carry _bucket/_sum/_count suffixes; counters may end in _total."""
+    if sample_name in families:
+        return sample_name
+    for suffix in _SUFFIXES:
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            if base in families:
+                return base
+    return sample_name
+
+
+def _parse_labels(labelstr: str):
+    """Return (labels dict, error) — error when the brace body is not a
+    well-formed comma-separated list of escaped pairs."""
+    body = labelstr[1:-1].strip()
+    if not body:
+        return {}, None
+    labels = {}
+    pos = 0
+    while pos < len(body):
+        m = _PAIR_RE.match(body, pos)
+        if not m:
+            return None, f"malformed label pair at offset {pos}: {body[pos:]!r}"
+        labels[m.group(1)] = m.group(2)
+        pos = m.end()
+        if pos < len(body):
+            if body[pos] != ",":
+                return None, f"expected ',' between labels at offset {pos}"
+            pos += 1
+    return labels, None
+
+
+class _Family:
+    def __init__(self):
+        self.help_line = None
+        self.type_line = None
+        self.kind = None
+        self.samples = []          # (lineno, name, labels, value)
+        self.closed = False
+
+
+def lint(text: str) -> list[str]:
+    """Lint exposition text; returns ["line N: message", ...] (empty when
+    clean)."""
+    errors: list[str] = []
+    families: dict[str, _Family] = {}
+    current: str | None = None
+
+    def fam(name: str) -> _Family:
+        return families.setdefault(name, _Family())
+
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            kind_of_comment = line[2:6]
+            rest = line[7:]
+            parts = rest.split(None, 1)
+            name = parts[0] if parts else ""
+            if not METRIC_NAME_RE.match(name):
+                errors.append(
+                    f"line {lineno}: invalid metric name {name!r} in "
+                    f"{kind_of_comment}")
+                continue
+            f = fam(name)
+            if f.closed or (current not in (None, name) and f.samples):
+                errors.append(
+                    f"line {lineno}: family '{name}' re-opened (all of a "
+                    "family's lines must be consecutive)")
+            if kind_of_comment == "HELP":
+                if f.help_line is not None:
+                    errors.append(
+                        f"line {lineno}: duplicate HELP for '{name}'")
+                if f.type_line is not None or f.samples:
+                    errors.append(
+                        f"line {lineno}: HELP for '{name}' must precede its "
+                        "TYPE and samples")
+                f.help_line = lineno
+            else:
+                if f.type_line is not None:
+                    errors.append(
+                        f"line {lineno}: duplicate TYPE for '{name}'")
+                if f.samples:
+                    errors.append(
+                        f"line {lineno}: TYPE for '{name}' must precede its "
+                        "samples")
+                kind = (parts[1].strip() if len(parts) > 1 else "")
+                if kind not in VALID_TYPES:
+                    errors.append(
+                        f"line {lineno}: unknown TYPE '{kind}' for '{name}'")
+                f.type_line = lineno
+                f.kind = kind
+            if current is not None and current != name:
+                fam(current).closed = True
+            current = name
+            continue
+        if line.startswith("#"):
+            continue  # free-form comment
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            errors.append(f"line {lineno}: unparseable sample: {line!r}")
+            continue
+        sname, labelstr, raw_value = m.group(1), m.group(2), m.group(3)
+        labels = {}
+        if labelstr:
+            labels, err = _parse_labels(labelstr)
+            if err:
+                errors.append(f"line {lineno}: {err}")
+                continue
+            for lname in labels:
+                if not LABEL_NAME_RE.match(lname) or lname.startswith("__"):
+                    errors.append(
+                        f"line {lineno}: invalid label name {lname!r}")
+        try:
+            value = float(raw_value)
+        except ValueError:
+            errors.append(
+                f"line {lineno}: invalid sample value {raw_value!r}")
+            continue
+        family_name = _family_of(sname, set(families))
+        f = families.get(family_name)
+        if f is None or f.type_line is None:
+            errors.append(
+                f"line {lineno}: sample '{sname}' has no preceding TYPE")
+            f = fam(family_name)
+        elif f.closed or current != family_name:
+            errors.append(
+                f"line {lineno}: sample '{sname}' outside its family block "
+                f"('{family_name}')")
+        if f.kind in ("counter", "gauge", "untyped", None) \
+                and sname != family_name \
+                and not (f.kind == "counter" and sname == f"{family_name}_total"):
+            errors.append(
+                f"line {lineno}: sample '{sname}' does not match family "
+                f"'{family_name}' of type '{f.kind}'")
+        f.samples.append((lineno, sname, labels, value))
+        if current is not None and current != family_name:
+            fam(current).closed = True
+        current = family_name
+
+    for name, f in families.items():
+        if f.kind == "histogram":
+            errors.extend(_check_histogram(name, f))
+    return errors
+
+
+def _check_histogram(name: str, f: _Family) -> list[str]:
+    """Per-labelset histogram invariants (grouped by the non-le labels)."""
+    errors: list[str] = []
+    groups: dict[tuple, dict] = {}
+    for lineno, sname, labels, value in f.samples:
+        key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+        g = groups.setdefault(
+            key, {"buckets": [], "sum": None, "count": None, "line": lineno})
+        if sname == f"{name}_bucket":
+            le = labels.get("le")
+            if le is None:
+                errors.append(
+                    f"line {lineno}: {name}_bucket sample without 'le'")
+                continue
+            try:
+                le_f = math.inf if le == "+Inf" else float(le)
+            except ValueError:
+                errors.append(f"line {lineno}: invalid le value {le!r}")
+                continue
+            g["buckets"].append((le_f, value, lineno))
+        elif sname == f"{name}_sum":
+            g["sum"] = value
+        elif sname == f"{name}_count":
+            g["count"] = value
+        elif sname == name:
+            errors.append(
+                f"line {lineno}: histogram '{name}' has a bare sample "
+                "(expected _bucket/_sum/_count series)")
+    for key, g in groups.items():
+        where = f"histogram '{name}'" + (
+            f" {{{', '.join(f'{k}={v}' for k, v in key)}}}" if key else "")
+        buckets = sorted(g["buckets"])
+        if not buckets:
+            errors.append(f"line {g['line']}: {where} has no buckets")
+            continue
+        prev = None
+        for le_f, value, lineno in buckets:
+            if prev is not None and value < prev:
+                errors.append(
+                    f"line {lineno}: {where} buckets not cumulative at "
+                    f"le={le_f}")
+            prev = value
+        if not math.isinf(buckets[-1][0]):
+            errors.append(f"line {g['line']}: {where} missing +Inf bucket")
+        elif g["count"] is not None and buckets[-1][1] != g["count"]:
+            errors.append(
+                f"line {buckets[-1][2]}: {where} +Inf bucket "
+                f"({buckets[-1][1]}) != _count ({g['count']})")
+        if g["sum"] is None:
+            errors.append(f"line {g['line']}: {where} missing _sum")
+        if g["count"] is None:
+            errors.append(f"line {g['line']}: {where} missing _count")
+    return errors
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) > 1 and argv[1] not in ("-", "--"):
+        with open(argv[1], encoding="utf-8") as fh:
+            text = fh.read()
+    else:
+        text = sys.stdin.read()
+    errors = lint(text)
+    for e in errors:
+        print(e, file=sys.stderr)
+    if errors:
+        print(f"promlint: {len(errors)} problem(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
