@@ -465,6 +465,29 @@ def run_stable_load(infer_fn, concurrency: int, window_s: float = 3.0,
     return {"ips": ips, "p99_us": p99, "stable": stable, "windows": history}
 
 
+def _fault_profile():
+    """Parsed BENCH_FAULT_PROFILE (None = chaos bench disabled).
+
+    Same JSON shape as CLIENT_TPU_FAULTS, e.g.
+    ``{"model.execute": {"probability": 0.05, "seed": 7,
+    "error_status": 503}}``.  When set, bench_inproc_simple runs its load
+    through a RetryPolicy + CircuitBreaker so latency percentiles are
+    measured *including* the resilience layer's recovery cost, and the run
+    records ``retries`` / ``breaker_open_s`` next to them.
+    """
+    raw = os.environ.get("BENCH_FAULT_PROFILE", "").strip()
+    if not raw:
+        return None
+    try:
+        profile = json.loads(raw)
+    except ValueError as exc:
+        raise SystemExit(f"BENCH_FAULT_PROFILE: invalid JSON: {exc}")
+    if not isinstance(profile, dict) or not profile:
+        raise SystemExit("BENCH_FAULT_PROFILE: expected a non-empty JSON "
+                         "object keyed by fault site")
+    return profile
+
+
 def bench_inproc_simple(concurrency: int = BENCH_CONCURRENCY):
     import numpy as np
 
@@ -514,10 +537,50 @@ def bench_inproc_simple(concurrency: int = BENCH_CONCURRENCY):
             log(f"metrics snapshot failed: {exc}")
             return None
 
+    profile = _fault_profile()
+    infer_fn = lambda: engine.infer(make_req(), timeout_s=60)  # noqa: E731
+    retry_count = [0]
+    breaker = None
+    if profile is not None:
+        from client_tpu import faults
+        from client_tpu.resilience import (CircuitBreaker, RetryPolicy,
+                                           run_with_resilience)
+
+        faults.configure(profile)
+        faults.registry().bind_metrics(engine.metrics.registry)
+        policy = RetryPolicy(max_attempts=4, initial_backoff_s=0.002, seed=7)
+        breaker = CircuitBreaker(failure_threshold=16, cooldown_s=0.25)
+        retry_lock = threading.Lock()
+
+        def _on_retry(n, exc, delay):
+            with retry_lock:
+                retry_count[0] += 1
+
+        plain_fn = infer_fn
+
+        def infer_fn():  # noqa: F811 — deliberate chaos-mode shadow
+            run_with_resilience(lambda remaining_s: plain_fn(),
+                                policy=policy, breaker=breaker,
+                                host="inproc", on_retry=_on_retry)
+
+        log(f"chaos profile active (BENCH_FAULT_PROFILE): "
+            f"{sorted(profile)} — load runs through RetryPolicy"
+            f"(max_attempts=4) + CircuitBreaker")
+
     before = _hist_snapshot()
-    res = run_stable_load(lambda: engine.infer(make_req(), timeout_s=60),
-                          concurrency, tag="simple")
+    try:
+        res = run_stable_load(infer_fn, concurrency, tag="simple")
+    finally:
+        if profile is not None:
+            from client_tpu import faults
+
+            faults.reset()
     after = _hist_snapshot()
+    if profile is not None:
+        res["retries"] = retry_count[0]
+        res["breaker_open_s"] = round(breaker.open_seconds_total(), 3)
+        log(f"simple: {res['retries']} retries, breaker open "
+            f"{res['breaker_open_s']}s under fault profile")
     if before is not None and after is not None:
         from client_tpu.observability import scrape
 

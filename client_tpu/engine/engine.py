@@ -74,6 +74,13 @@ class TpuEngine:
         # two engines in one process (tests) don't cross-pollute. Pass
         # observability.REGISTRY for a process-wide one.
         self.metrics = EngineMetrics(metrics_registry)
+        # Chaos subsystem: the process-global fault registry, with this
+        # engine's metric registry bound so injection counts render in
+        # prometheus_metrics() as tpu_fault_injections_total{site,kind}.
+        from client_tpu import faults as _faults
+
+        self.faults = _faults.registry()
+        self.faults.bind_metrics(self.metrics.registry)
         self.request_traces = TraceStore(
             capacity=int(os.environ.get("CLIENT_TPU_TRACE_BUFFER", "512")))
         if load_all:

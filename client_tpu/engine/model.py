@@ -25,6 +25,7 @@ from typing import Any, Callable, Iterator
 
 import numpy as np
 
+from client_tpu import faults
 from client_tpu.engine.backend_init import log as _log
 from client_tpu.engine.config import ModelConfig
 from client_tpu.engine.types import EngineError, now_ns
@@ -264,6 +265,13 @@ class Model:
             raise EngineError(
                 f"model '{self.config.name}' is an ensemble; "
                 "execute composing models instead", 500)
+        # Chaos site: model execution — the deepest injection point,
+        # exercising the scheduler's batch-failure fan-out and the
+        # frontends' 5xx translation from a device-level fault.
+        try:
+            faults.fire("model.execute")
+        except faults.FaultInjected as exc:
+            raise EngineError(str(exc), exc.status or 503) from None
         cfg = self.config
         phases = ExecPhases(start=now_ns())
         pad_to = None

@@ -18,6 +18,7 @@ from typing import Callable
 
 import numpy as np
 
+from client_tpu import faults
 from client_tpu.engine.model import Model
 from client_tpu.engine.stats import ModelStats
 from client_tpu.engine.types import (
@@ -203,6 +204,13 @@ class Scheduler:
         return max(1, min(level, dyn.priority_levels))
 
     def submit(self, req: InferRequest) -> None:
+        # Chaos site: scheduler admission — an injected error here proves
+        # the frontend error paths and client retry classification against
+        # queue-level failures without needing a real overload.
+        try:
+            faults.fire("scheduler.enqueue")
+        except faults.FaultInjected as exc:
+            raise EngineError(str(exc), exc.status or 503) from None
         level = self._priority_level(req)
         dyn = self.model.config.dynamic_batching
         policy = dyn.policy_for(level) if dyn is not None else None
@@ -355,6 +363,10 @@ class Scheduler:
             if waited_us > timeout_us:
                 if policy is not None and policy.timeout_action == "DELAY":
                     return False  # execute anyway (Triton DELAY action)
+                # A timed-out REJECT is an admission failure like a full
+                # queue: count it on the same rejection counter so the
+                # tpu_queue_rejections_total series covers both causes.
+                self.stats.record_rejection()
                 self._fail(req, EngineError("request timed out in queue", 504))
                 return True
         return False

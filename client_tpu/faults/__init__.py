@@ -1,0 +1,238 @@
+"""Deterministic server-side fault injection ("chaos") subsystem.
+
+A process-global registry of named injection sites, each threaded through
+one chokepoint of the serving stack:
+
+* ``http.pre_read``      — HTTP frontend, before the request body is read
+* ``grpc.pre_infer``     — gRPC frontend, on ModelInfer entry
+* ``scheduler.enqueue``  — scheduler admission, before the queue put
+* ``model.execute``      — model execution, before device dispatch
+
+Each site can inject added latency, a protocol error with a chosen
+status, or a dropped connection, gated by a *seeded* Bernoulli draw —
+``random.Random(seed)`` per site, so a given (seed, probability) produces
+the same injection pattern on every run and chaos tests are tier-1
+deterministic, not flaky.
+
+Configuration is programmatic (``faults.configure({...})``) or via the
+``CLIENT_TPU_FAULTS`` environment variable holding either inline JSON or
+``@/path/to/profile.json``::
+
+    CLIENT_TPU_FAULTS='{"http.pre_read":
+        {"probability": 0.2, "seed": 42, "latency_ms": 50,
+         "error_status": 503}}'
+
+Injection counts are exported through the PR-1 metrics registry as
+``tpu_fault_injections_total{site,kind}`` — the engine binds its registry
+at construction, so counts show up in ``prometheus_metrics()``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+
+__all__ = [
+    "SITES",
+    "FaultSpec",
+    "FaultInjected",
+    "FaultRegistry",
+    "registry",
+    "configure",
+    "fire",
+    "reset",
+]
+
+SITES = ("http.pre_read", "grpc.pre_infer", "scheduler.enqueue",
+         "model.execute")
+
+ENV_VAR = "CLIENT_TPU_FAULTS"
+
+
+class FaultInjected(Exception):
+    """Raised at an injection site whose draw triggered an error or
+    connection-drop action; the hosting layer translates it into its own
+    protocol error (HTTP status / gRPC abort / EngineError)."""
+
+    def __init__(self, site: str, kind: str, status: int | None = None):
+        super().__init__(f"injected fault at {site} ({kind}"
+                         + (f", status {status}" if status else "") + ")")
+        self.site = site
+        self.kind = kind  # "error" | "drop"
+        self.status = status
+
+
+class FaultSpec:
+    """One site's injection behavior. Any combination of latency + one
+    terminal action (error XOR drop); latency applies first so an injected
+    503 still pays the injected delay, like a struggling real server."""
+
+    def __init__(self, site: str, probability: float = 1.0, seed: int = 0,
+                 latency_ms: float = 0.0, error_status: int | None = None,
+                 drop: bool = False, max_injections: int | None = None):
+        if site not in SITES:
+            raise ValueError(
+                f"unknown fault site '{site}' (valid: {', '.join(SITES)})")
+        if not 0.0 <= probability <= 1.0:
+            raise ValueError("probability must be within [0, 1]")
+        if drop and error_status is not None:
+            raise ValueError("a fault is either an error or a drop, not both")
+        self.site = site
+        self.probability = float(probability)
+        self.seed = int(seed)
+        self.latency_ms = float(latency_ms)
+        self.error_status = (int(error_status)
+                             if error_status is not None else None)
+        self.drop = bool(drop)
+        self.max_injections = (int(max_injections)
+                               if max_injections is not None else None)
+
+    @classmethod
+    def from_dict(cls, site: str, d: dict) -> "FaultSpec":
+        unknown = set(d) - {"probability", "seed", "latency_ms",
+                            "error_status", "drop", "max_injections"}
+        if unknown:
+            raise ValueError(
+                f"unknown fault spec keys for '{site}': {sorted(unknown)}")
+        return cls(site, **d)
+
+
+class _ActiveFault:
+    """A spec armed with its own seeded RNG and injection budget."""
+
+    def __init__(self, spec: FaultSpec):
+        self.spec = spec
+        self.rng = random.Random(spec.seed)
+        self.remaining = spec.max_injections
+        self.lock = threading.Lock()
+
+    def draw(self) -> bool:
+        with self.lock:
+            if self.remaining == 0:
+                return False
+            if self.rng.random() >= self.spec.probability:
+                return False
+            if self.remaining is not None:
+                self.remaining -= 1
+            return True
+
+
+class FaultRegistry:
+    """Named injection sites + deterministic draws + injection counters."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active: dict[str, _ActiveFault] = {}
+        self._counts: dict[tuple[str, str], int] = {}
+        self._metric_counters: list = []
+
+    # -- configuration -------------------------------------------------------
+
+    def configure(self, config: dict) -> None:
+        """Replace all armed sites: {site: spec-dict} (env/JSON shape)."""
+        active = {site: _ActiveFault(FaultSpec.from_dict(site, dict(d)))
+                  for site, d in (config or {}).items()}
+        with self._lock:
+            self._active = active
+
+    def install(self, spec: FaultSpec) -> None:
+        with self._lock:
+            self._active[spec.site] = _ActiveFault(spec)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._active = {}
+
+    def configure_from_env(self, environ=os.environ) -> None:
+        raw = (environ.get(ENV_VAR) or "").strip()
+        if not raw:
+            return
+        if raw.startswith("@"):
+            with open(raw[1:], "r", encoding="utf-8") as f:
+                raw = f.read()
+        self.configure(json.loads(raw))
+
+    # -- metrics -------------------------------------------------------------
+
+    def bind_metrics(self, metric_registry) -> None:
+        """Export injection counts as tpu_fault_injections_total{site,kind}
+        on the given PR-1 MetricRegistry (the engine binds its own at
+        construction). Idempotent per registry; multiple engines may bind."""
+        counter = metric_registry.counter(
+            "tpu_fault_injections_total",
+            "Injected faults by site and kind (chaos subsystem)",
+            ("site", "kind"))
+        with self._lock:
+            if all(c is not counter for c in self._metric_counters):
+                self._metric_counters.append(counter)
+
+    def _count(self, site: str, kind: str) -> None:
+        with self._lock:
+            key = (site, kind)
+            self._counts[key] = self._counts.get(key, 0) + 1
+            counters = list(self._metric_counters)
+        for c in counters:
+            c.inc(site=site, kind=kind)
+
+    def counts(self) -> dict:
+        with self._lock:
+            return {f"{site}:{kind}": n
+                    for (site, kind), n in sorted(self._counts.items())}
+
+    # -- the hot call --------------------------------------------------------
+
+    def fire(self, site: str, sleep=time.sleep) -> None:
+        """Evaluate the site; no-op when unarmed or the draw misses.
+        Applies injected latency inline, then raises FaultInjected for
+        error/drop actions (the caller translates)."""
+        active = self._active.get(site)
+        if active is None or not active.draw():
+            return
+        spec = active.spec
+        if spec.latency_ms > 0:
+            self._count(site, "latency")
+            sleep(spec.latency_ms / 1000.0)
+        if spec.drop:
+            self._count(site, "drop")
+            raise FaultInjected(site, "drop")
+        if spec.error_status is not None:
+            self._count(site, "error")
+            raise FaultInjected(site, "error", spec.error_status)
+
+
+# -- process-global default registry ----------------------------------------
+#
+# Sites live at chokepoints that have no constructor path from user code
+# (scheduler workers, model execution), so like observability.REGISTRY the
+# default registry is process-global; the env profile is applied once on
+# first access.
+
+_default: FaultRegistry | None = None
+_default_lock = threading.Lock()
+
+
+def registry() -> FaultRegistry:
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                r = FaultRegistry()
+                r.configure_from_env()
+                _default = r
+    return _default
+
+
+def configure(config: dict) -> None:
+    registry().configure(config)
+
+
+def fire(site: str) -> None:
+    registry().fire(site)
+
+
+def reset() -> None:
+    """Disarm every site (counters and metric bindings are kept)."""
+    registry().clear()

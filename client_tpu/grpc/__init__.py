@@ -20,15 +20,20 @@ import time
 import grpc
 import numpy as np
 
+import logging
+
 from client_tpu.observability.client_stats import InferStat
 from client_tpu.observability.tracing import TraceContext
 from client_tpu.protocol import grpc_codec, grpc_service_pb2 as pb
+from client_tpu.resilience import run_with_resilience
 from client_tpu.protocol.codec import serialize_tensor
 from client_tpu.protocol.dtypes import np_to_wire_dtype
 from client_tpu.protocol.grpc_stub import GRPCInferenceServiceStub
 from client_tpu.utils import InferenceServerException, raise_error
 
 service_pb2 = pb  # re-export, as the reference re-exports its generated pb2
+
+_log = logging.getLogger("client_tpu")
 
 _channel_cache: dict[tuple, tuple[grpc.Channel, GRPCInferenceServiceStub]] = {}
 _channel_cache_lock = threading.Lock()
@@ -292,12 +297,26 @@ class _InferStream:
             self._call.cancel()
         self._q.put(None)
         self._reader.join(timeout=10)
+        if not self._reader.is_alive():
+            return
+        # The reader is wedged (server stopped sending without closing the
+        # stream, or a response is stuck in flow control). Cancelling the
+        # call unblocks the response iterator; a silent return here would
+        # leak the thread AND the RPC.
+        _log.warning("stream reader did not terminate within 10s; "
+                     "cancelling the call")
+        self._call.cancel()
+        self._reader.join(timeout=2)
+        if self._reader.is_alive():
+            raise_error("stream reader did not terminate within 10s "
+                        "(call cancelled; reader thread leaked)")
 
 
 class InferenceServerClient:
     def __init__(self, url, verbose=False, ssl=False, root_certificates=None,
                  private_key=None, certificate_chain=None, creds=None,
-                 keepalive_options=None, channel_args=None):
+                 keepalive_options=None, channel_args=None,
+                 retry_policy=None, circuit_breaker=None):
         if ssl:
             raise InferenceServerException(
                 "ssl is not supported by this transport yet")
@@ -333,6 +352,15 @@ class InferenceServerClient:
         self._verbose = verbose
         self._stream: _InferStream | None = None
         self._stats = InferStat()
+        # Opt-in resilience: when a RetryPolicy is set, a call's
+        # `client_timeout` becomes the end-to-end deadline budget across
+        # all attempts (each attempt's RPC deadline shrinks to what
+        # remains). Streaming retries connection establishment only.
+        self._retry_policy = retry_policy
+        self._breaker = circuit_breaker
+        self._breaker_host = url
+        self._async_executor = None
+        self._async_executor_lock = threading.Lock()
 
     def get_infer_stat(self):
         """Cumulative client-side inference statistics (round-trip time
@@ -348,6 +376,8 @@ class InferenceServerClient:
 
     def close(self):
         self.stop_stream()
+        if self._async_executor is not None:
+            self._async_executor.shutdown(wait=False)
         # channel stays cached for other clients of the same URL
 
     # -- health / metadata ---------------------------------------------------
@@ -356,13 +386,36 @@ class InferenceServerClient:
     def _md(headers):
         return list(headers.items()) if headers else None
 
+    def _unary(self, rpc, request, metadata, client_timeout, **rpc_kwargs):
+        """One unary RPC under the configured retry/breaker/deadline.
+        With a retry policy, ``client_timeout`` is the total budget across
+        attempts and each attempt's RPC deadline is the remaining slice."""
+
+        def attempt(remaining_s):
+            try:
+                return rpc(request, metadata=metadata,
+                           timeout=(remaining_s if remaining_s is not None
+                                    else client_timeout),
+                           **rpc_kwargs)
+            except grpc.RpcError as exc:
+                raise _grpc_error(exc) from None
+
+        if self._retry_policy is None and self._breaker is None:
+            return attempt(None)
+        return run_with_resilience(
+            attempt,
+            policy=self._retry_policy,
+            breaker=self._breaker,
+            deadline_s=(client_timeout
+                        if self._retry_policy is not None else None),
+            host=self._breaker_host,
+            on_retry=lambda n, exc, delay: self._stats.record_retry(),
+            on_breaker_reject=self._stats.record_breaker_rejection)
+
     def _call(self, method, request, headers=None, as_json=False,
               client_timeout=None):
-        try:
-            response = method(request, metadata=self._md(headers),
-                              timeout=client_timeout)
-        except grpc.RpcError as exc:
-            raise _grpc_error(exc) from None
+        response = self._unary(method, request, self._md(headers),
+                               client_timeout)
         if as_json:
             from google.protobuf import json_format
 
@@ -544,12 +597,10 @@ class InferenceServerClient:
             sequence_id, sequence_start, sequence_end, priority, timeout,
             params)
         t0 = time.monotonic_ns()
-        try:
-            response = self._client_stub.ModelInfer(
-                request, metadata=self._md(headers), timeout=client_timeout,
-                compression=_compression(compression_algorithm))
-        except grpc.RpcError as exc:
-            raise _grpc_error(exc) from None
+        response = self._unary(
+            self._client_stub.ModelInfer, request, self._md(headers),
+            client_timeout,
+            compression=_compression(compression_algorithm))
         result = InferResult(response)
         self._stats.record((time.monotonic_ns() - t0) / 1e3,
                            result.server_timing())
@@ -564,6 +615,32 @@ class InferenceServerClient:
             model_name, inputs, model_version, outputs, request_id,
             sequence_id, sequence_start, sequence_end, priority, timeout,
             parameters)
+        if self._retry_policy is not None or self._breaker is not None:
+            # gRPC's call-future cannot replay itself, so the resilient
+            # async path runs the retrying unary call on a worker thread.
+            with self._async_executor_lock:
+                if self._async_executor is None:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    self._async_executor = ThreadPoolExecutor(max_workers=4)
+            task = self._async_executor.submit(
+                self._unary, self._client_stub.ModelInfer, request,
+                self._md(headers), client_timeout,
+                compression=_compression(compression_algorithm))
+
+            def _task_done(f):
+                try:
+                    result = InferResult(f.result())
+                except InferenceServerException as exc:
+                    callback(None, exc)
+                    return
+                except Exception as exc:  # noqa: BLE001
+                    callback(None, InferenceServerException(str(exc)))
+                    return
+                callback(result, None)
+
+            task.add_done_callback(_task_done)
+            return CallContext(task)
         future = self._client_stub.ModelInfer.future(
             request, metadata=self._md(headers), timeout=client_timeout,
             compression=_compression(compression_algorithm))
@@ -589,6 +666,29 @@ class InferenceServerClient:
     def start_stream(self, callback, stream_timeout=None, headers=None):
         if self._stream is not None:
             raise_error("stream already started")
+        if self._retry_policy is not None:
+            # Streaming retries CONNECTION ESTABLISHMENT only: once a
+            # stream is up, replaying in-flight stream requests would
+            # reorder sequences, so mid-stream errors still surface to the
+            # user callback. Each readiness probe waits up to 1s.
+            def attempt(remaining_s):
+                wait = 1.0 if remaining_s is None else min(1.0, remaining_s)
+                try:
+                    grpc.channel_ready_future(self._channel).result(
+                        timeout=wait)
+                except grpc.FutureTimeoutError:
+                    raise ConnectionError(
+                        "gRPC channel not ready (connection "
+                        "establishment timed out)") from None
+
+            run_with_resilience(
+                attempt,
+                policy=self._retry_policy,
+                breaker=self._breaker,
+                deadline_s=stream_timeout,
+                host=self._breaker_host,
+                on_retry=lambda n, exc, delay: self._stats.record_retry(),
+                on_breaker_reject=self._stats.record_breaker_rejection)
         self._stream = _InferStream(self._client_stub, callback,
                                     stream_timeout, headers)
 

@@ -19,12 +19,13 @@ import threading
 import time
 import zlib
 from concurrent.futures import ThreadPoolExecutor
-from http.client import HTTPConnection
+from http.client import BadStatusLine, HTTPConnection
 from urllib.parse import quote, urlencode
 
 import numpy as np
 
 from client_tpu.observability.client_stats import InferStat
+from client_tpu.resilience import run_with_resilience
 from client_tpu.observability.tracing import (
     TraceContext,
     parse_server_timing,
@@ -231,7 +232,36 @@ class InferAsyncRequest:
             raise InferenceServerException(str(exc)) from exc
 
 
+# Connection died before any response bytes: safe to replay regardless of
+# method, since the server cannot have begun processing a request it never
+# acknowledged. BadStatusLine covers http.client.RemoteDisconnected.
+_STALE_SOCKET_ERRORS = (BadStatusLine, ConnectionResetError,
+                        BrokenPipeError, ConnectionAbortedError)
+
+
+class _RetryableStatus(Exception):
+    """Internal: a response with a retryable HTTP status (502/503),
+    re-raised through the resilience loop; carries the response so retry
+    exhaustion degrades to returning it (original _request contract)."""
+
+    def __init__(self, resp, data):
+        super().__init__(f"HTTP {resp.status}")
+        self.resp = resp
+        self.data = data
+        self.status = resp.status
+
+
 class _ConnectionPool:
+    """LIFO keep-alive pool with symmetric accounting.
+
+    ``live`` counts connections in existence (pooled + checked out): +1
+    exactly once when a connection is constructed, -1 exactly once when it
+    is destroyed (``_discard``, guarded against double-close so an errant
+    double release can never drift the counter negative — the pre-PR-2
+    accounting decremented on every broken release and never on pool
+    drain, so the counter wandered under churn).
+    """
+
     def __init__(self, host, port, size, timeout):
         self._host, self._port, self._timeout = host, port, timeout
         self._pool: queue.LifoQueue = queue.LifoQueue()
@@ -239,30 +269,47 @@ class _ConnectionPool:
         self._created = 0
         self._size = size
 
-    def acquire(self) -> HTTPConnection:
+    @property
+    def live(self) -> int:
+        with self._lock:
+            return self._created
+
+    def acquire(self) -> tuple[HTTPConnection, bool]:
+        """Returns (conn, reused): reused connections came out of the pool
+        and may be stale keep-alive sockets — the transport replays once on
+        a fresh connection if one dies before response bytes arrive."""
         try:
-            return self._pool.get_nowait()
+            return self._pool.get_nowait(), True
         except queue.Empty:
             pass
+        conn = HTTPConnection(self._host, self._port, timeout=self._timeout)
+        # Count only after successful construction, so a failing
+        # constructor cannot leak a phantom entry.
         with self._lock:
             self._created += 1
-        return HTTPConnection(self._host, self._port, timeout=self._timeout)
+        return conn, False
+
+    def _discard(self, conn: HTTPConnection) -> None:
+        if getattr(conn, "_pool_discarded", False):
+            return
+        conn._pool_discarded = True
+        try:
+            conn.close()
+        finally:
+            with self._lock:
+                self._created -= 1
 
     def release(self, conn: HTTPConnection, broken=False):
         if broken or self._pool.qsize() >= self._size:
             # enforce the pool bound: excess/broken connections are closed
-            try:
-                conn.close()
-            finally:
-                with self._lock:
-                    self._created -= 1
+            self._discard(conn)
             return
         self._pool.put(conn)
 
     def close(self):
         while True:
             try:
-                self._pool.get_nowait().close()
+                self._discard(self._pool.get_nowait())
             except queue.Empty:
                 return
 
@@ -273,7 +320,8 @@ class InferenceServerClient:
     def __init__(self, url, verbose=False, concurrency=1,
                  connection_timeout=60.0, network_timeout=60.0,
                  max_greenlets=None, ssl=False, ssl_options=None,
-                 ssl_context_factory=None, insecure=False):
+                 ssl_context_factory=None, insecure=False,
+                 retry_policy=None, circuit_breaker=None):
         if ssl:
             raise InferenceServerException(
                 "ssl is not supported by this transport yet")
@@ -287,6 +335,14 @@ class InferenceServerClient:
                                      max(connection_timeout, network_timeout))
         self._executor = ThreadPoolExecutor(max_workers=max(concurrency, 1))
         self._stats = InferStat()
+        # Opt-in resilience (client_tpu.resilience): when a RetryPolicy is
+        # set, `network_timeout` becomes the end-to-end deadline budget —
+        # it bounds the TOTAL wall time across all attempts and backoffs,
+        # and each attempt's socket timeout shrinks to what remains.
+        self._retry_policy = retry_policy
+        self._breaker = circuit_breaker
+        self._breaker_host = f"{self._host}:{self._port}"
+        self._network_timeout = network_timeout
 
     def get_infer_stat(self):
         """Cumulative client-side inference statistics (round-trip time
@@ -311,18 +367,71 @@ class InferenceServerClient:
         headers = dict(headers or {})
         if query_params:
             path = path + "?" + urlencode(query_params)
-        conn = self._pool.acquire()
+        if self._retry_policy is None and self._breaker is None:
+            return self._request_once(method, path, body, headers, None)
+
+        def attempt(remaining_s):
+            resp, data = self._request_once(method, path, body, headers,
+                                            remaining_s)
+            retryable = (self._retry_policy is not None
+                         and resp.status
+                         in self._retry_policy.retryable_statuses)
+            # A breaker-only client still needs 5xx surfaced as failures so
+            # consecutive server faults trip it (4xx stays a plain return:
+            # the caller's fault, not the host's).
+            trips_breaker = self._breaker is not None and resp.status >= 500
+            if retryable or trips_breaker:
+                # Surface retryable statuses as failures so the resilience
+                # loop replays them; _RetryableStatus keeps (resp, data) so
+                # exhaustion falls back to the plain return-the-response
+                # contract every call site already handles.
+                raise _RetryableStatus(resp, data)
+            return resp, data
+
         try:
-            conn.request(method, path, body=body, headers=headers)
-            resp = conn.getresponse()
-            data = resp.read()
-            self._pool.release(conn)
-        except Exception:
-            self._pool.release(conn, broken=True)
-            raise
-        if self._verbose:
-            print(f"{method} {path}, status {resp.status}")
-        return resp, data
+            return run_with_resilience(
+                attempt,
+                policy=self._retry_policy,
+                breaker=self._breaker,
+                deadline_s=(self._network_timeout
+                            if self._retry_policy is not None else None),
+                host=self._breaker_host,
+                on_retry=lambda n, exc, delay: self._stats.record_retry(),
+                on_breaker_reject=self._stats.record_breaker_rejection)
+        except _RetryableStatus as exc:
+            return exc.resp, exc.data
+
+    def _request_once(self, method, path, body, headers, remaining_s):
+        """One wire attempt, with the urllib3-style stale-socket replay: a
+        pooled keep-alive connection that dies before ANY response bytes
+        are read is discarded and the request replayed exactly once on a
+        fresh connection (server-side idle timeouts routinely race the
+        client's next use; independent of RetryPolicy)."""
+        for replay in (False, True):
+            conn, reused = self._pool.acquire()
+            if remaining_s is not None:
+                # Per-attempt socket timeout shrinks to the remaining
+                # deadline budget so one attempt cannot overrun the total.
+                conn.timeout = remaining_s
+                if conn.sock is not None:
+                    conn.sock.settimeout(remaining_s)
+            got_response = False
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                got_response = True
+                data = resp.read()
+                self._pool.release(conn)
+            except Exception as exc:
+                self._pool.release(conn, broken=True)
+                if (reused and not replay and not got_response
+                        and isinstance(exc, _STALE_SOCKET_ERRORS)):
+                    self._stats.record_stale_socket_retry()
+                    continue
+                raise
+            if self._verbose:
+                print(f"{method} {path}, status {resp.status}")
+            return resp, data
 
     def _get_json(self, path, query_params=None, headers=None):
         resp, data = self._request("GET", path, headers=headers,

@@ -29,6 +29,12 @@ class InferStat:
         self.cumulative_server_compute_input_us = 0.0
         self.cumulative_server_compute_infer_us = 0.0
         self.cumulative_server_compute_output_us = 0.0
+        # Resilience events (PR-2): how often the client retried, replayed
+        # a stale pooled socket, or was rejected locally by an open
+        # circuit breaker. Zero unless the corresponding feature is on.
+        self.retry_count = 0
+        self.stale_socket_retry_count = 0
+        self.breaker_rejected_count = 0
 
     def record(self, round_trip_us: float,
                server_timing: dict | None = None) -> None:
@@ -46,6 +52,18 @@ class InferStat:
                 self.cumulative_server_compute_output_us += \
                     server_timing.get("compute_output", 0.0)
 
+    def record_retry(self) -> None:
+        with self._lock:
+            self.retry_count += 1
+
+    def record_stale_socket_retry(self) -> None:
+        with self._lock:
+            self.stale_socket_retry_count += 1
+
+    def record_breaker_rejection(self) -> None:
+        with self._lock:
+            self.breaker_rejected_count += 1
+
     def get(self) -> dict:
         with self._lock:
             return {
@@ -61,4 +79,7 @@ class InferStat:
                     round(self.cumulative_server_compute_infer_us, 1),
                 "cumulative_server_compute_output_us":
                     round(self.cumulative_server_compute_output_us, 1),
+                "retry_count": self.retry_count,
+                "stale_socket_retry_count": self.stale_socket_retry_count,
+                "breaker_rejected_count": self.breaker_rejected_count,
             }

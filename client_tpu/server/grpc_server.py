@@ -25,6 +25,7 @@ from client_tpu.engine.types import (
     InferRequest,
     OutputRequest,
 )
+from client_tpu.faults import FaultInjected
 from client_tpu.observability.tracing import TraceContext
 from client_tpu.protocol import grpc_codec, grpc_service_pb2 as pb
 from client_tpu.protocol.dtypes import np_to_wire_dtype
@@ -49,7 +50,13 @@ _STATUS_BY_HTTP = {
     400: grpc.StatusCode.INVALID_ARGUMENT,
     404: grpc.StatusCode.NOT_FOUND,
     415: grpc.StatusCode.INVALID_ARGUMENT,
+    429: grpc.StatusCode.RESOURCE_EXHAUSTED,
+    499: grpc.StatusCode.CANCELLED,
     500: grpc.StatusCode.INTERNAL,
+    # 503 maps to UNAVAILABLE so transient overload/injected faults are
+    # retryable under the client RetryPolicy classification, matching the
+    # HTTP transport's semantics for the same engine error.
+    503: grpc.StatusCode.UNAVAILABLE,
     504: grpc.StatusCode.DEADLINE_EXCEEDED,
 }
 
@@ -397,6 +404,15 @@ class _Servicer(GRPCInferenceServiceServicer):
     # -- inference -----------------------------------------------------------
 
     def ModelInfer(self, request, context):  # noqa: N802
+        # Chaos site: on RPC entry, before the proto is decoded. A "drop"
+        # surfaces as UNAVAILABLE — the code a severed HTTP/2 connection
+        # produces — so retrying clients classify it identically.
+        try:
+            self.engine.faults.fire("grpc.pre_infer")
+        except FaultInjected as exc:
+            code = _STATUS_BY_HTTP.get(exc.status,
+                                       grpc.StatusCode.UNAVAILABLE)
+            context.abort(code, str(exc))
         try:
             req = _proto_to_request(self.engine, request)
             self._adopt_trace(req, context)

@@ -31,6 +31,7 @@ _log = logging.getLogger("client_tpu")
 
 from client_tpu.engine.engine import TpuEngine
 from client_tpu.engine.types import EngineError, InferRequest, OutputRequest
+from client_tpu.faults import FaultInjected
 from client_tpu.observability.tracing import (
     TraceContext,
     server_timing_header,
@@ -94,6 +95,18 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _dispatch(self, method: str) -> None:
         try:
+            # Chaos site: before any request byte past the headers is
+            # consumed. A "drop" action closes the keep-alive socket with
+            # no response — exactly the stale-socket/idle-timeout shape
+            # the client-side replay and RetryPolicy must absorb.
+            try:
+                self.engine.faults.fire("http.pre_read")
+            except FaultInjected as exc:
+                if exc.kind == "drop":
+                    self.close_connection = True
+                    return
+                self._send_error(exc.status or 503, str(exc))
+                return
             # Drain the request body up front: handlers that ignore it (e.g.
             # repository index with an empty JSON body) must not leave bytes
             # in the keep-alive stream, or they would prefix the next

@@ -1,0 +1,343 @@
+"""Chaos end-to-end: real HTTP+gRPC servers under seeded fault injection.
+
+The acceptance scenario of the robustness PR: with a seeded 20% injected
+503 + 50 ms latency at ``http.pre_read``/``grpc.pre_infer``, a client with
+``RetryPolicy(max_attempts=4)`` completes 100% of requests over both
+transports, the no-retry client surfaces ``InferenceServerException``,
+injected-fault and retry counts appear in ``prometheus_metrics()`` /
+``InferStat``, and the deadline budget is never exceeded across attempts.
+
+Every fault profile pins its seed, so the injection pattern — and thus the
+whole suite — is deterministic run to run (tier-1 safe, no flake budget).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+from client_tpu import faults
+from client_tpu.engine import TpuEngine
+from client_tpu.models import build_repository
+from client_tpu.resilience import (
+    CircuitBreaker,
+    CircuitBreakerOpenError,
+    RetryPolicy,
+)
+from client_tpu.server import GrpcInferenceServer, HttpInferenceServer
+from client_tpu.utils import InferenceServerException
+
+pytestmark = pytest.mark.chaos
+
+# The acceptance fault profile: seeded 20% probability, 50 ms added
+# latency, protocol error 503.
+ACCEPT_PROFILE = {"probability": 0.2, "seed": 42, "latency_ms": 50,
+                  "error_status": 503}
+N_REQUESTS = 25
+
+
+@pytest.fixture(scope="module")
+def stack():
+    eng = TpuEngine(build_repository(["simple"]))
+    http_srv = HttpInferenceServer(eng, port=0).start()
+    grpc_srv = GrpcInferenceServer(eng, port=0).start()
+    yield {"engine": eng, "http": http_srv,
+           "grpc_url": f"127.0.0.1:{grpc_srv.port}"}
+    faults.reset()
+    http_srv.stop()
+    grpc_srv.stop()
+    eng.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _http_inputs():
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    i0 = httpclient.InferInput("INPUT0", a.shape, "INT32")
+    i0.set_data_from_numpy(a)
+    i1 = httpclient.InferInput("INPUT1", b.shape, "INT32")
+    i1.set_data_from_numpy(b)
+    return a, b, [i0, i1]
+
+
+def _grpc_inputs():
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    i0 = grpcclient.InferInput("INPUT0", a.shape, "INT32")
+    i0.set_data_from_numpy(a)
+    i1 = grpcclient.InferInput("INPUT1", b.shape, "INT32")
+    i1.set_data_from_numpy(b)
+    return a, b, [i0, i1]
+
+
+class TestHttpChaos:
+    def test_retrying_client_converges(self, stack):
+        faults.configure({"http.pre_read": dict(ACCEPT_PROFILE)})
+        c = httpclient.InferenceServerClient(
+            stack["http"].url,
+            retry_policy=RetryPolicy(max_attempts=4, initial_backoff_s=0.002,
+                                     seed=3))
+        try:
+            a, b, inputs = _http_inputs()
+            for _ in range(N_REQUESTS):
+                r = c.infer("simple", inputs)
+                assert np.array_equal(r.as_numpy("OUTPUT0"), a + b)
+            stat = c.get_infer_stat()
+        finally:
+            c.close()
+        # 100% completion, and the retries + injections are observable.
+        assert stat["completed_request_count"] == N_REQUESTS
+        assert stat["retry_count"] > 0
+        metrics = stack["engine"].prometheus_metrics()
+        assert ('tpu_fault_injections_total{site="http.pre_read",'
+                'kind="error"}') in metrics
+
+    def test_no_retry_client_surfaces_error(self, stack):
+        faults.configure({"http.pre_read": {
+            "probability": 1.0, "seed": 1, "error_status": 503}})
+        c = httpclient.InferenceServerClient(stack["http"].url)
+        try:
+            _, _, inputs = _http_inputs()
+            with pytest.raises(InferenceServerException) as ei:
+                c.infer("simple", inputs)
+            assert ei.value.status() == 503
+        finally:
+            c.close()
+
+    def test_deadline_budget_never_exceeded(self, stack):
+        """100% failure + eager policy: network_timeout is the end-to-end
+        budget, so the client gives up within ~1s, not max_attempts *
+        per-attempt time."""
+        faults.configure({"http.pre_read": {
+            "probability": 1.0, "seed": 1, "error_status": 503}})
+        c = httpclient.InferenceServerClient(
+            stack["http"].url, network_timeout=1.0,
+            retry_policy=RetryPolicy(max_attempts=100,
+                                     initial_backoff_s=0.05, seed=5))
+        try:
+            _, _, inputs = _http_inputs()
+            t0 = time.monotonic()
+            with pytest.raises(InferenceServerException):
+                c.infer("simple", inputs)
+            elapsed = time.monotonic() - t0
+            assert elapsed < 1.0 + 0.6  # budget + one attempt of slack
+            assert c.get_infer_stat()["retry_count"] > 0
+        finally:
+            c.close()
+
+    def test_dropped_connection_replayed_on_fresh_socket(self, stack):
+        """A keep-alive connection the server drops before responding is
+        replayed once on a fresh socket — no RetryPolicy needed."""
+        c = httpclient.InferenceServerClient(stack["http"].url)
+        try:
+            a, b, inputs = _http_inputs()
+            c.infer("simple", inputs)  # pools the connection
+            faults.configure({"http.pre_read": {
+                "probability": 1.0, "seed": 1, "drop": True,
+                "max_injections": 1}})
+            r = c.infer("simple", inputs)
+            assert np.array_equal(r.as_numpy("OUTPUT0"), a + b)
+            assert c.get_infer_stat()["stale_socket_retry_count"] == 1
+        finally:
+            c.close()
+
+    def test_circuit_breaker_opens_and_recovers(self, stack):
+        faults.configure({"http.pre_read": {
+            "probability": 1.0, "seed": 1, "error_status": 503}})
+        breaker = CircuitBreaker(failure_threshold=3, cooldown_s=0.3)
+        c = httpclient.InferenceServerClient(stack["http"].url,
+                                             circuit_breaker=breaker)
+        try:
+            _, _, inputs = _http_inputs()
+            for _ in range(3):
+                with pytest.raises(InferenceServerException):
+                    c.infer("simple", inputs)
+            assert breaker.state(c._breaker_host) == "open"
+            # While open, calls are rejected locally: the injection count
+            # must NOT advance.
+            before = faults.registry().counts()
+            with pytest.raises(CircuitBreakerOpenError):
+                c.infer("simple", inputs)
+            assert faults.registry().counts() == before
+            assert c.get_infer_stat()["breaker_rejected_count"] == 1
+            # Server heals; after the cooldown the half-open probe closes
+            # the breaker again.
+            faults.reset()
+            time.sleep(0.35)
+            c.infer("simple", inputs)
+            assert breaker.state(c._breaker_host) == "closed"
+            assert breaker.open_seconds_total() > 0.3
+        finally:
+            c.close()
+
+    def test_async_infer_retries(self, stack):
+        faults.configure({"http.pre_read": {
+            "probability": 0.5, "seed": 11, "error_status": 503}})
+        c = httpclient.InferenceServerClient(
+            stack["http"].url, concurrency=2,
+            retry_policy=RetryPolicy(max_attempts=6, initial_backoff_s=0.002,
+                                     seed=2))
+        try:
+            a, b, inputs = _http_inputs()
+            reqs = [c.async_infer("simple", inputs) for _ in range(6)]
+            for req in reqs:
+                r = req.get_result(timeout=30)
+                assert np.array_equal(r.as_numpy("OUTPUT0"), a + b)
+        finally:
+            c.close()
+
+
+class TestGrpcChaos:
+    def test_retrying_client_converges(self, stack):
+        faults.configure({"grpc.pre_infer": dict(ACCEPT_PROFILE)})
+        c = grpcclient.InferenceServerClient(
+            stack["grpc_url"],
+            retry_policy=RetryPolicy(max_attempts=4, initial_backoff_s=0.002,
+                                     seed=3))
+        try:
+            a, b, inputs = _grpc_inputs()
+            for _ in range(N_REQUESTS):
+                r = c.infer("simple", inputs)
+                assert np.array_equal(r.as_numpy("OUTPUT0"), a + b)
+            stat = c.get_infer_stat()
+        finally:
+            c.close()
+        assert stat["completed_request_count"] == N_REQUESTS
+        assert stat["retry_count"] > 0
+        metrics = stack["engine"].prometheus_metrics()
+        assert ('tpu_fault_injections_total{site="grpc.pre_infer",'
+                'kind="error"}') in metrics
+
+    def test_no_retry_client_surfaces_unavailable(self, stack):
+        faults.configure({"grpc.pre_infer": {
+            "probability": 1.0, "seed": 1, "error_status": 503}})
+        c = grpcclient.InferenceServerClient(stack["grpc_url"])
+        try:
+            _, _, inputs = _grpc_inputs()
+            with pytest.raises(InferenceServerException) as ei:
+                c.infer("simple", inputs)
+            # 503 travels as UNAVAILABLE over gRPC (retryable class).
+            assert "UNAVAILABLE" in str(ei.value.status())
+        finally:
+            c.close()
+
+    def test_client_timeout_is_total_budget(self, stack):
+        faults.configure({"grpc.pre_infer": {
+            "probability": 1.0, "seed": 1, "error_status": 503}})
+        c = grpcclient.InferenceServerClient(
+            stack["grpc_url"],
+            retry_policy=RetryPolicy(max_attempts=100,
+                                     initial_backoff_s=0.05, seed=5))
+        try:
+            _, _, inputs = _grpc_inputs()
+            t0 = time.monotonic()
+            with pytest.raises(InferenceServerException):
+                c.infer("simple", inputs, client_timeout=1.0)
+            elapsed = time.monotonic() - t0
+            assert elapsed < 1.0 + 0.6
+            assert c.get_infer_stat()["retry_count"] > 0
+        finally:
+            c.close()
+
+    def test_async_infer_retries(self, stack):
+        faults.configure({"grpc.pre_infer": {
+            "probability": 0.5, "seed": 11, "error_status": 503}})
+        c = grpcclient.InferenceServerClient(
+            stack["grpc_url"],
+            retry_policy=RetryPolicy(max_attempts=6, initial_backoff_s=0.002,
+                                     seed=2))
+        try:
+            a, b, inputs = _grpc_inputs()
+            done = threading.Event()
+            results = []
+
+            def cb(result, error):
+                results.append((result, error))
+                if len(results) == 4:
+                    done.set()
+
+            for _ in range(4):
+                c.async_infer("simple", inputs, cb)
+            assert done.wait(30)
+            for result, error in results:
+                assert error is None
+                assert np.array_equal(result.as_numpy("OUTPUT0"), a + b)
+        finally:
+            c.close()
+
+    def test_streaming_unaffected_midstream(self, stack):
+        """Streaming retries connection establishment only; an armed unary
+        fault site must not perturb an established stream."""
+        faults.configure({"grpc.pre_infer": {
+            "probability": 1.0, "seed": 1, "error_status": 503}})
+        c = grpcclient.InferenceServerClient(
+            stack["grpc_url"],
+            retry_policy=RetryPolicy(max_attempts=4, initial_backoff_s=0.002,
+                                     seed=3))
+        try:
+            a, b, inputs = _grpc_inputs()
+            done = threading.Event()
+            got = []
+
+            def cb(result, error):
+                got.append((result, error))
+                done.set()
+
+            c.start_stream(cb)
+            c.async_stream_infer("simple", inputs)
+            assert done.wait(30)
+            result, error = got[0]
+            assert error is None
+            assert np.array_equal(result.as_numpy("OUTPUT0"), a + b)
+        finally:
+            c.close()
+
+
+class TestDeepSites:
+    """scheduler.enqueue and model.execute inject below the frontends:
+    both transports translate them to their protocol's retryable error."""
+
+    def test_scheduler_enqueue_fault_retried_http(self, stack):
+        faults.configure({"scheduler.enqueue": {
+            "probability": 0.3, "seed": 21, "error_status": 503}})
+        c = httpclient.InferenceServerClient(
+            stack["http"].url,
+            retry_policy=RetryPolicy(max_attempts=5, initial_backoff_s=0.002,
+                                     seed=4))
+        try:
+            a, b, inputs = _http_inputs()
+            for _ in range(10):
+                r = c.infer("simple", inputs)
+                assert np.array_equal(r.as_numpy("OUTPUT0"), a + b)
+        finally:
+            c.close()
+        metrics = stack["engine"].prometheus_metrics()
+        assert ('tpu_fault_injections_total{site="scheduler.enqueue",'
+                'kind="error"}') in metrics
+
+    def test_model_execute_fault_retried_grpc(self, stack):
+        faults.configure({"model.execute": {
+            "probability": 0.3, "seed": 33, "error_status": 503}})
+        c = grpcclient.InferenceServerClient(
+            stack["grpc_url"],
+            retry_policy=RetryPolicy(max_attempts=5, initial_backoff_s=0.002,
+                                     seed=4))
+        try:
+            a, b, inputs = _grpc_inputs()
+            for _ in range(10):
+                r = c.infer("simple", inputs)
+                assert np.array_equal(r.as_numpy("OUTPUT0"), a + b)
+        finally:
+            c.close()
+        metrics = stack["engine"].prometheus_metrics()
+        assert ('tpu_fault_injections_total{site="model.execute",'
+                'kind="error"}') in metrics

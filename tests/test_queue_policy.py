@@ -1,0 +1,155 @@
+"""QueuePolicy timeout-path coverage (Triton ModelQueuePolicy semantics).
+
+Complements test_engine.py's TestSchedulePolicy with the three paths the
+robustness PR pinned down: DELAY executes-anyway under a request-level
+timeout, ``allow_timeout_override: false`` ignoring the request's
+``timeout_us``, and an expired REJECT counting on the PR-1
+``tpu_queue_rejections_total`` counter (a timed-out reject is an admission
+failure exactly like a full queue).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from client_tpu.engine import InferRequest, TpuEngine
+from client_tpu.engine.config import DynamicBatchingConfig, QueuePolicy
+from client_tpu.engine.repository import ModelRepository
+from client_tpu.engine.types import EngineError
+from client_tpu.models.simple import AddSubBackend
+
+
+def _blocking_backend(block, running, policy):
+    """AddSub with one worker whose FIRST request parks on `block` after
+    signalling `running` — a deterministic head-of-line blocker so the
+    second request demonstrably times out while queued."""
+    backend = AddSubBackend(name="qp", max_batch_size=4)
+    backend.config.dynamic_batching = DynamicBatchingConfig(
+        preferred_batch_size=[4], max_queue_delay_microseconds=0,
+        priority_levels=2, default_priority_level=1,
+        priority_queue_policy={2: policy})
+    backend.config.instance_count = 1
+    backend.config.batch_buckets = [1, 4]
+    backend.jittable = False
+    first = {"seen": False}
+
+    def make_apply():
+        def apply(inputs):
+            if not first["seen"]:
+                first["seen"] = True
+                running.set()
+                assert block.wait(60)
+            a, b = inputs["INPUT0"], inputs["INPUT1"]
+            return {"OUTPUT0": a + b, "OUTPUT1": a - b}
+        return apply
+
+    backend.make_apply = make_apply
+    return backend
+
+
+def _run_behind_blocker(policy, timeout_us):
+    """Submit a level-1 blocker, then a level-2 request with the given
+    request timeout; release the blocker after 0.2s (far past any
+    microsecond-scale queue timeout). Returns (engine_metrics_text,
+    result-or-EngineError)."""
+    block = threading.Event()
+    running = threading.Event()
+    repo = ModelRepository()
+    repo.register_backend(_blocking_backend(block, running, policy))
+    engine = TpuEngine(repo)
+    try:
+        a = np.zeros((1, 16), np.int32)
+        engine.async_infer(
+            InferRequest(model_name="qp",
+                         inputs={"INPUT0": a, "INPUT1": a}),
+            lambda resp: None)
+        assert running.wait(30)
+        threading.Timer(0.2, block.set).start()
+        req = InferRequest(model_name="qp",
+                           inputs={"INPUT0": a, "INPUT1": a},
+                           priority=2, timeout_us=timeout_us)
+        try:
+            outcome = engine.infer(req, timeout_s=30)
+        except EngineError as exc:
+            outcome = exc
+        return engine.prometheus_metrics(), outcome
+    finally:
+        block.set()
+        engine.shutdown()
+
+
+def _rejections(metrics_text):
+    for line in metrics_text.splitlines():
+        if line.startswith("tpu_queue_rejections_total{") and '"qp"' in line:
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+class TestQueuePolicyTimeouts:
+    def test_delay_executes_anyway(self):
+        """timeout_action DELAY: the queue timeout expires while the
+        request waits, but it still executes (Triton DELAY action)."""
+        _, outcome = _run_behind_blocker(
+            QueuePolicy(timeout_action="DELAY", allow_timeout_override=True),
+            timeout_us=1)
+        assert not isinstance(outcome, EngineError)
+        assert np.array_equal(outcome.outputs["OUTPUT0"],
+                              np.zeros((1, 16), np.int32))
+
+    def test_allow_timeout_override_false_ignores_request_timeout(self):
+        """With allow_timeout_override=False and no policy default timeout,
+        a request-level timeout_us that would expire instantly is ignored
+        and the request completes."""
+        _, outcome = _run_behind_blocker(
+            QueuePolicy(timeout_action="REJECT",
+                        default_timeout_microseconds=0,
+                        allow_timeout_override=False),
+            timeout_us=1)
+        assert not isinstance(outcome, EngineError)
+
+    def test_expired_reject_increments_rejection_counter(self):
+        """An expired REJECT surfaces 504 AND counts on the PR-1
+        tpu_queue_rejections_total counter."""
+        metrics, outcome = _run_behind_blocker(
+            QueuePolicy(timeout_action="REJECT",
+                        default_timeout_microseconds=1,
+                        allow_timeout_override=False),
+            timeout_us=0)
+        assert isinstance(outcome, EngineError)
+        assert outcome.status == 504
+        assert "timed out in queue" in str(outcome)
+        assert _rejections(metrics) >= 1.0
+
+    def test_full_queue_and_timeout_share_the_counter(self):
+        """Sanity: the admission counter is one series for both causes —
+        a max_queue_size rejection lands on the same metric the timeout
+        path increments."""
+        block = threading.Event()
+        running = threading.Event()
+        repo = ModelRepository()
+        repo.register_backend(_blocking_backend(
+            block, running,
+            QueuePolicy(max_queue_size=1)))
+        engine = TpuEngine(repo)
+        try:
+            a = np.zeros((1, 16), np.int32)
+
+            def submit():
+                engine.async_infer(
+                    InferRequest(model_name="qp", priority=2,
+                                 inputs={"INPUT0": a, "INPUT1": a}),
+                    lambda resp: None)
+
+            engine.async_infer(
+                InferRequest(model_name="qp",
+                             inputs={"INPUT0": a, "INPUT1": a}),
+                lambda resp: None)
+            assert running.wait(30)
+            submit()  # fills the single level-2 slot
+            with pytest.raises(EngineError, match="maximum queue size"):
+                submit()
+            assert _rejections(engine.prometheus_metrics()) >= 1.0
+        finally:
+            block.set()
+            engine.shutdown()

@@ -1,0 +1,352 @@
+"""Unit tests for client_tpu.resilience, client_tpu.faults, and the HTTP
+connection-pool accounting — no servers, no sockets, deterministic."""
+
+import queue
+import threading
+
+import pytest
+
+from client_tpu import faults
+from client_tpu.http import _ConnectionPool
+from client_tpu.resilience import (
+    CircuitBreaker,
+    CircuitBreakerOpenError,
+    DeadlineExceededError,
+    RetryPolicy,
+    run_with_resilience,
+)
+from client_tpu.utils import InferenceServerException
+
+pytestmark = pytest.mark.chaos
+
+
+class TestRetryPolicy:
+    def test_classification(self):
+        p = RetryPolicy()
+        # transient server trouble and connection-level failures retry
+        assert p.retryable(InferenceServerException("x", status=502))
+        assert p.retryable(InferenceServerException("x", status=503))
+        assert p.retryable(
+            InferenceServerException("x", status="StatusCode.UNAVAILABLE"))
+        assert p.retryable(ConnectionResetError())
+        assert p.retryable(ConnectionRefusedError())
+        assert p.retryable(TimeoutError())
+        # the request's own fault never retries
+        assert not p.retryable(InferenceServerException("x", status=400))
+        assert not p.retryable(InferenceServerException("x", status=404))
+        assert not p.retryable(InferenceServerException("x", status=429))
+        assert not p.retryable(InferenceServerException(
+            "x", status="StatusCode.INVALID_ARGUMENT"))
+        assert not p.retryable(InferenceServerException("x"))  # no status
+        assert not p.retryable(ValueError("x"))
+
+    def test_backoff_full_jitter_capped(self):
+        p = RetryPolicy(initial_backoff_s=0.1, max_backoff_s=0.5,
+                        backoff_multiplier=2.0, seed=0)
+        for retry in range(1, 12):
+            cap = min(0.5, 0.1 * 2.0 ** (retry - 1))
+            for _ in range(20):
+                d = p.backoff_s(retry)
+                assert 0.0 <= d <= cap
+        # never exceeds the remaining deadline budget
+        assert p.backoff_s(8, remaining_s=0.01) <= 0.01
+
+    def test_backoff_deterministic_with_seed(self):
+        a = RetryPolicy(seed=7)
+        b = RetryPolicy(seed=7)
+        assert [a.backoff_s(i) for i in range(1, 6)] == \
+               [b.backoff_s(i) for i in range(1, 6)]
+
+    def test_max_attempts_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+
+
+class TestRunWithResilience:
+    def test_retries_until_success(self):
+        calls = []
+
+        def attempt(remaining):
+            calls.append(remaining)
+            if len(calls) < 3:
+                raise InferenceServerException("boom", status=503)
+            return "ok"
+
+        retries = []
+        out = run_with_resilience(
+            attempt, policy=RetryPolicy(max_attempts=4, seed=1),
+            sleep=lambda s: None,
+            on_retry=lambda n, exc, d: retries.append(n))
+        assert out == "ok"
+        assert len(calls) == 3
+        assert retries == [1, 2]
+
+    def test_non_retryable_raises_immediately(self):
+        calls = []
+
+        def attempt(remaining):
+            calls.append(1)
+            raise InferenceServerException("bad", status=400)
+
+        with pytest.raises(InferenceServerException):
+            run_with_resilience(attempt,
+                                policy=RetryPolicy(max_attempts=5, seed=1),
+                                sleep=lambda s: None)
+        assert len(calls) == 1
+
+    def test_attempts_exhausted_reraises_last(self):
+        def attempt(remaining):
+            raise InferenceServerException("still down", status=503)
+
+        with pytest.raises(InferenceServerException, match="still down"):
+            run_with_resilience(attempt,
+                                policy=RetryPolicy(max_attempts=3, seed=1),
+                                sleep=lambda s: None)
+
+    def test_deadline_bounds_total_time(self):
+        """Fake clock: each attempt costs 0.4s against a 1.0s budget —
+        only 3 attempts fit even though the policy allows 100, sleeps are
+        clipped to the remaining budget, and the per-attempt remaining
+        shrinks monotonically."""
+        now = [0.0]
+        seen_remaining = []
+        slept = []
+
+        def clock():
+            return now[0]
+
+        def sleep(s):
+            assert s <= 1.0 - now[0] + 1e-9
+            slept.append(s)
+            now[0] += s
+
+        def attempt(remaining):
+            seen_remaining.append(remaining)
+            now[0] += 0.4
+            raise InferenceServerException("down", status=503)
+
+        with pytest.raises(InferenceServerException):
+            run_with_resilience(
+                attempt,
+                policy=RetryPolicy(max_attempts=100, initial_backoff_s=0.0,
+                                   jitter=False),
+                deadline_s=1.0, clock=clock, sleep=sleep)
+        assert len(seen_remaining) == 3
+        assert seen_remaining == sorted(seen_remaining, reverse=True)
+        assert all(r <= 1.0 for r in seen_remaining)
+
+    def test_deadline_exhausted_before_first_attempt(self):
+        def attempt(remaining):  # pragma: no cover - must not run
+            raise AssertionError("attempt ran past the deadline")
+
+        now = [5.0]
+        with pytest.raises(DeadlineExceededError):
+            run_with_resilience(attempt, policy=RetryPolicy(),
+                                deadline_s=-1.0, clock=lambda: now[0])
+
+
+class TestCircuitBreaker:
+    def test_open_after_consecutive_failures_and_halfopen_probe(self):
+        now = [0.0]
+        br = CircuitBreaker(failure_threshold=3, cooldown_s=10.0,
+                            clock=lambda: now[0])
+        assert br.state("h") == "closed"
+        for _ in range(2):
+            br.check("h")
+            br.record_failure("h")
+        assert br.state("h") == "closed"  # not yet at threshold
+        br.record_failure("h")
+        assert br.state("h") == "open"
+        with pytest.raises(CircuitBreakerOpenError):
+            br.check("h")
+        # cooldown elapses: exactly one half-open probe admitted
+        now[0] = 10.5
+        br.check("h")
+        with pytest.raises(CircuitBreakerOpenError):
+            br.check("h")  # concurrent caller while probe in flight
+        br.record_success("h")
+        assert br.state("h") == "closed"
+        br.check("h")
+
+    def test_failed_probe_reopens(self):
+        now = [0.0]
+        br = CircuitBreaker(failure_threshold=1, cooldown_s=5.0,
+                            clock=lambda: now[0])
+        br.record_failure("h")
+        assert br.state("h") == "open"
+        now[0] = 5.1
+        br.check("h")  # half-open probe
+        br.record_failure("h")
+        assert br.state("h") == "open"
+        with pytest.raises(CircuitBreakerOpenError):
+            br.check("h")  # fresh cooldown
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker(failure_threshold=2)
+        br.record_failure("h")
+        br.record_success("h")
+        br.record_failure("h")
+        assert br.state("h") == "closed"  # never 2 consecutive
+
+    def test_per_host_isolation_and_open_seconds(self):
+        now = [0.0]
+        br = CircuitBreaker(failure_threshold=1, cooldown_s=100.0,
+                            clock=lambda: now[0])
+        br.record_failure("a")
+        assert br.state("a") == "open"
+        assert br.state("b") == "closed"
+        br.check("b")
+        now[0] = 2.0
+        assert br.open_seconds_total() == pytest.approx(2.0)
+
+    def test_breaker_counts_only_server_faults(self):
+        """A flood of 4xx (the caller's fault) must not open the breaker."""
+        br = CircuitBreaker(failure_threshold=2, cooldown_s=10.0)
+
+        def attempt(remaining):
+            raise InferenceServerException("bad request", status=400)
+
+        for _ in range(5):
+            with pytest.raises(InferenceServerException):
+                run_with_resilience(attempt, breaker=br, host="h")
+        assert br.state("h") == "closed"
+
+
+class TestFaultRegistry:
+    def setup_method(self):
+        self.reg = faults.FaultRegistry()
+
+    def test_deterministic_injection_pattern(self):
+        spec = {"probability": 0.3, "seed": 9, "error_status": 503}
+
+        def pattern():
+            self.reg.configure({"scheduler.enqueue": dict(spec)})
+            hits = []
+            for _ in range(50):
+                try:
+                    self.reg.fire("scheduler.enqueue")
+                    hits.append(0)
+                except faults.FaultInjected:
+                    hits.append(1)
+            return hits
+
+        first, second = pattern(), pattern()
+        assert first == second
+        assert 0 < sum(first) < 50
+
+    def test_latency_then_error_and_counts(self):
+        slept = []
+        self.reg.configure({"model.execute": {
+            "probability": 1.0, "latency_ms": 25, "error_status": 503}})
+        with pytest.raises(faults.FaultInjected) as ei:
+            self.reg.fire("model.execute", sleep=slept.append)
+        assert slept == [0.025]
+        assert ei.value.status == 503
+        assert self.reg.counts() == {"model.execute:error": 1,
+                                     "model.execute:latency": 1}
+
+    def test_max_injections_budget(self):
+        self.reg.configure({"http.pre_read": {
+            "probability": 1.0, "drop": True, "max_injections": 2}})
+        for _ in range(2):
+            with pytest.raises(faults.FaultInjected):
+                self.reg.fire("http.pre_read")
+        self.reg.fire("http.pre_read")  # budget spent: no-op
+
+    def test_metrics_binding(self):
+        from client_tpu.observability.metrics import MetricRegistry
+
+        mr = MetricRegistry()
+        self.reg.bind_metrics(mr)
+        self.reg.bind_metrics(mr)  # idempotent
+        self.reg.configure({"grpc.pre_infer": {
+            "probability": 1.0, "error_status": 503}})
+        with pytest.raises(faults.FaultInjected):
+            self.reg.fire("grpc.pre_infer")
+        text = mr.render()
+        assert ('tpu_fault_injections_total{site="grpc.pre_infer",'
+                'kind="error"} 1') in text
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            self.reg.configure({"nope.where": {"probability": 1.0}})
+        with pytest.raises(ValueError, match="unknown fault spec keys"):
+            self.reg.configure({"http.pre_read": {"latency": 5}})
+        with pytest.raises(ValueError, match="error or a drop"):
+            faults.FaultSpec("http.pre_read", error_status=503, drop=True)
+        with pytest.raises(ValueError, match="probability"):
+            faults.FaultSpec("http.pre_read", probability=1.5)
+
+    def test_env_config(self, tmp_path):
+        self.reg.configure_from_env(
+            {"CLIENT_TPU_FAULTS":
+             '{"http.pre_read": {"probability": 1.0, "error_status": 503}}'})
+        with pytest.raises(faults.FaultInjected):
+            self.reg.fire("http.pre_read")
+        profile = tmp_path / "profile.json"
+        profile.write_text(
+            '{"grpc.pre_infer": {"probability": 1.0, "drop": true}}')
+        self.reg.configure_from_env({"CLIENT_TPU_FAULTS": f"@{profile}"})
+        with pytest.raises(faults.FaultInjected):
+            self.reg.fire("grpc.pre_infer")
+        self.reg.fire("http.pre_read")  # configure replaces, not merges
+
+
+class TestConnectionPoolAccounting:
+    def test_symmetric_churn_never_drifts(self):
+        pool = _ConnectionPool("localhost", 1, size=2, timeout=1)
+        assert pool.live == 0
+        for _ in range(10):
+            conn, reused = pool.acquire()
+            assert pool.live >= 1
+            pool.release(conn, broken=True)
+        assert pool.live == 0
+
+    def test_reused_connection_broken_release(self):
+        pool = _ConnectionPool("localhost", 1, size=2, timeout=1)
+        conn, reused = pool.acquire()
+        assert not reused and pool.live == 1
+        pool.release(conn)
+        conn2, reused2 = pool.acquire()
+        assert reused2 and conn2 is conn and pool.live == 1
+        pool.release(conn2, broken=True)
+        assert pool.live == 0
+
+    def test_double_broken_release_is_safe(self):
+        pool = _ConnectionPool("localhost", 1, size=2, timeout=1)
+        conn, _ = pool.acquire()
+        pool.release(conn, broken=True)
+        pool.release(conn, broken=True)  # pre-fix: drove the counter to -1
+        assert pool.live == 0
+
+    def test_overflow_release_closes_and_counts_down(self):
+        pool = _ConnectionPool("localhost", 1, size=1, timeout=1)
+        c1, _ = pool.acquire()
+        c2, _ = pool.acquire()
+        assert pool.live == 2
+        pool.release(c1)            # fills the one slot
+        pool.release(c2)            # over the bound: closed + decremented
+        assert pool.live == 1
+        pool.close()                # pre-fix: drained without decrementing
+        assert pool.live == 0
+
+    def test_concurrent_churn(self):
+        pool = _ConnectionPool("localhost", 1, size=4, timeout=1)
+        errs = []
+
+        def churn():
+            try:
+                for i in range(200):
+                    conn, _ = pool.acquire()
+                    pool.release(conn, broken=(i % 3 == 0))
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        threads = [threading.Thread(target=churn) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        pool.close()
+        assert pool.live == 0
