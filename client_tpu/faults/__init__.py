@@ -34,6 +34,7 @@ import os
 import random
 import threading
 import time
+import weakref
 
 __all__ = [
     "SITES",
@@ -127,7 +128,11 @@ class FaultRegistry:
         self._lock = threading.Lock()
         self._active: dict[str, _ActiveFault] = {}
         self._counts: dict[tuple[str, str], int] = {}
-        self._metric_counters: list = []
+        # id(MetricRegistry) -> weakref to its bound counter. Keyed by
+        # registry identity so rebinding replaces rather than appends, and
+        # held weakly so counters of dead registries (engines long gone)
+        # are pruned instead of incremented forever on the hot fire() path.
+        self._metric_counters: dict[int, weakref.ref] = {}
 
     # -- configuration -------------------------------------------------------
 
@@ -166,14 +171,19 @@ class FaultRegistry:
             "Injected faults by site and kind (chaos subsystem)",
             ("site", "kind"))
         with self._lock:
-            if all(c is not counter for c in self._metric_counters):
-                self._metric_counters.append(counter)
+            self._metric_counters[id(metric_registry)] = weakref.ref(counter)
 
     def _count(self, site: str, kind: str) -> None:
         with self._lock:
             key = (site, kind)
             self._counts[key] = self._counts.get(key, 0) + 1
-            counters = list(self._metric_counters)
+            counters = []
+            for rid, ref in list(self._metric_counters.items()):
+                c = ref()
+                if c is None:
+                    del self._metric_counters[rid]
+                else:
+                    counters.append(c)
         for c in counters:
             c.inc(site=site, kind=kind)
 

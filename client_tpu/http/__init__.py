@@ -407,14 +407,21 @@ class InferenceServerClient:
         are read is discarded and the request replayed exactly once on a
         fresh connection (server-side idle timeouts routinely race the
         client's next use; independent of RetryPolicy)."""
+        deadline = (time.monotonic() + remaining_s
+                    if remaining_s is not None else None)
         for replay in (False, True):
             conn, reused = self._pool.acquire()
-            if remaining_s is not None:
+            if deadline is not None:
                 # Per-attempt socket timeout shrinks to the remaining
                 # deadline budget so one attempt cannot overrun the total.
-                conn.timeout = remaining_s
+                # Recomputed per iteration: the replay must not reuse the
+                # pre-attempt budget, or it would overrun by whatever the
+                # stale first attempt consumed. Floor at 1ms — settimeout(0)
+                # would flip the socket into non-blocking mode.
+                attempt_remaining = max(deadline - time.monotonic(), 0.001)
+                conn.timeout = attempt_remaining
                 if conn.sock is not None:
-                    conn.sock.settimeout(remaining_s)
+                    conn.sock.settimeout(attempt_remaining)
             got_response = False
             try:
                 conn.request(method, path, body=body, headers=headers)
@@ -425,7 +432,9 @@ class InferenceServerClient:
             except Exception as exc:
                 self._pool.release(conn, broken=True)
                 if (reused and not replay and not got_response
-                        and isinstance(exc, _STALE_SOCKET_ERRORS)):
+                        and isinstance(exc, _STALE_SOCKET_ERRORS)
+                        and (deadline is None
+                             or deadline - time.monotonic() > 0)):
                     self._stats.record_stale_socket_retry()
                     continue
                 raise

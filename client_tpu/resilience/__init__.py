@@ -158,13 +158,14 @@ class CircuitBreaker:
 
     class _HostState:
         __slots__ = ("state", "consecutive_failures", "opened_at",
-                     "probe_in_flight", "open_accum_s")
+                     "probe_in_flight", "probe_started_at", "open_accum_s")
 
         def __init__(self):
             self.state = CircuitBreaker.CLOSED
             self.consecutive_failures = 0
             self.opened_at = 0.0
             self.probe_in_flight = False
+            self.probe_started_at = 0.0
             self.open_accum_s = 0.0
 
     def __init__(self, failure_threshold=5, cooldown_s=5.0,
@@ -203,10 +204,17 @@ class CircuitBreaker:
                 st.state = self.HALF_OPEN
                 st.probe_in_flight = False
             # HALF_OPEN: exactly one probe at a time; concurrent callers
-            # are rejected until the probe resolves.
+            # are rejected until the probe resolves. A probe older than
+            # cooldown_s is treated as abandoned (its attempt died without
+            # reporting success OR failure) and a fresh probe is admitted,
+            # so the breaker can never wedge permanently in HALF_OPEN.
             if st.probe_in_flight:
-                raise CircuitBreakerOpenError(host, 0.0)
+                probe_age = now - st.probe_started_at
+                if probe_age < self.cooldown_s:
+                    raise CircuitBreakerOpenError(
+                        host, self.cooldown_s - probe_age)
             st.probe_in_flight = True
+            st.probe_started_at = now
 
     def record_success(self, host: str = "") -> None:
         with self._lock:
@@ -301,8 +309,15 @@ def run_with_resilience(attempt, *, policy=None, breaker=None,
         try:
             result = attempt(remaining)
         except Exception as exc:  # noqa: BLE001 — classified below
-            if breaker is not None and counts_as_server_fault(exc):
-                breaker.record_failure(host)
+            if breaker is not None:
+                if counts_as_server_fault(exc):
+                    breaker.record_failure(host)
+                else:
+                    # The host answered (4xx, RESOURCE_EXHAUSTED, a wrapped
+                    # error with no status): the breaker must resolve any
+                    # half-open probe as a SUCCESS — leaving it unresolved
+                    # would reject every future call to this host forever.
+                    breaker.record_success(host)
             if (policy is None or attempt_no >= max_attempts
                     or not policy.retryable(exc)):
                 raise

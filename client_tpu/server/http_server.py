@@ -105,6 +105,16 @@ class _Handler(BaseHTTPRequestHandler):
                 if exc.kind == "drop":
                     self.close_connection = True
                     return
+                # The injected error must still drain the request body —
+                # the same keep-alive hazard the normal path documents
+                # below: unread POST bytes would prefix the next request
+                # line on this socket and desync the connection.
+                try:
+                    if method == "POST":
+                        self.rfile.read(
+                            int(self.headers.get("Content-Length", 0) or 0))
+                except (OSError, ValueError):
+                    self.close_connection = True
                 self._send_error(exc.status or 503, str(exc))
                 return
             # Drain the request body up front: handlers that ignore it (e.g.

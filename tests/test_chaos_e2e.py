@@ -113,6 +113,28 @@ class TestHttpChaos:
         finally:
             c.close()
 
+    def test_injected_error_keeps_keepalive_connection_usable(self, stack):
+        """Regression: the http.pre_read injected-error response must
+        drain the POST body first — unread bytes would prefix the next
+        request line on the same keep-alive socket and desync it."""
+        faults.configure({"http.pre_read": {
+            "probability": 1.0, "seed": 1, "error_status": 503,
+            "max_injections": 1}})
+        c = httpclient.InferenceServerClient(stack["http"].url)
+        try:
+            a, b, inputs = _http_inputs()
+            with pytest.raises(InferenceServerException) as ei:
+                c.infer("simple", inputs)
+            assert ei.value.status() == 503
+            # Fault budget spent; the same pooled connection must serve
+            # the next infer cleanly, with no stale-socket replay masking
+            # a desynced stream.
+            r = c.infer("simple", inputs)
+            assert np.array_equal(r.as_numpy("OUTPUT0"), a + b)
+            assert c.get_infer_stat()["stale_socket_retry_count"] == 0
+        finally:
+            c.close()
+
     def test_deadline_budget_never_exceeded(self, stack):
         """100% failure + eager policy: network_timeout is the end-to-end
         budget, so the client gives up within ~1s, not max_attempts *

@@ -1,8 +1,10 @@
 """Unit tests for client_tpu.resilience, client_tpu.faults, and the HTTP
 connection-pool accounting — no servers, no sockets, deterministic."""
 
+import gc
 import queue
 import threading
+import time
 
 import pytest
 
@@ -211,6 +213,49 @@ class TestCircuitBreaker:
                 run_with_resilience(attempt, breaker=br, host="h")
         assert br.state("h") == "closed"
 
+    def test_half_open_probe_resolved_by_non_server_fault(self):
+        """Regression: a half-open probe that fails with a NON-server
+        fault (e.g. 429/RESOURCE_EXHAUSTED — the host answered) must
+        resolve the probe instead of leaving it in flight forever, which
+        used to reject every later call with CircuitBreakerOpenError."""
+        now = [0.0]
+        br = CircuitBreaker(failure_threshold=1, cooldown_s=5.0,
+                            clock=lambda: now[0])
+
+        def fail_unavailable(remaining):
+            raise InferenceServerException("down", status=503)
+
+        def fail_throttled(remaining):
+            raise InferenceServerException("throttled", status=429)
+
+        with pytest.raises(InferenceServerException):
+            run_with_resilience(fail_unavailable, breaker=br, host="h")
+        assert br.state("h") == "open"
+        now[0] = 5.1  # cooldown elapses; next call is the probe
+        with pytest.raises(InferenceServerException, match="throttled"):
+            run_with_resilience(fail_throttled, breaker=br, host="h")
+        # The 429 probe proved the host is alive: breaker closed, and the
+        # very next call goes straight through (no wedge).
+        assert br.state("h") == "closed"
+        assert run_with_resilience(lambda r: "ok", breaker=br,
+                                   host="h") == "ok"
+
+    def test_stale_half_open_probe_is_reclaimed(self):
+        """A probe whose attempt died without reporting either way stops
+        blocking the host after cooldown_s: a fresh probe is admitted."""
+        now = [0.0]
+        br = CircuitBreaker(failure_threshold=1, cooldown_s=5.0,
+                            clock=lambda: now[0])
+        br.record_failure("h")
+        now[0] = 5.1
+        br.check("h")  # probe taken, never resolved (caller died)
+        with pytest.raises(CircuitBreakerOpenError):
+            br.check("h")  # fresh probe still rejected...
+        now[0] = 10.3  # ...until the stale probe ages past cooldown_s
+        br.check("h")
+        br.record_success("h")
+        assert br.state("h") == "closed"
+
 
 class TestFaultRegistry:
     def setup_method(self):
@@ -266,6 +311,28 @@ class TestFaultRegistry:
         text = mr.render()
         assert ('tpu_fault_injections_total{site="grpc.pre_infer",'
                 'kind="error"} 1') in text
+
+    def test_metrics_rebind_replaces_and_dead_registries_pruned(self):
+        """Regression: bindings are keyed by registry identity and held
+        weakly — rebinding never appends, and counters of
+        garbage-collected registries (dead engines) stop being updated."""
+        from client_tpu.observability.metrics import MetricRegistry
+
+        live = MetricRegistry()
+        dead = MetricRegistry()
+        self.reg.bind_metrics(live)
+        self.reg.bind_metrics(live)  # rebind: replaces, never appends
+        self.reg.bind_metrics(dead)
+        assert len(self.reg._metric_counters) == 2
+        del dead
+        gc.collect()
+        self.reg.configure({"model.execute": {
+            "probability": 1.0, "error_status": 503}})
+        with pytest.raises(faults.FaultInjected):
+            self.reg.fire("model.execute")
+        assert len(self.reg._metric_counters) == 1  # dead binding pruned
+        assert ('tpu_fault_injections_total{site="model.execute",'
+                'kind="error"} 1') in live.render()
 
     def test_validation(self):
         with pytest.raises(ValueError, match="unknown fault site"):
@@ -329,6 +396,61 @@ class TestConnectionPoolAccounting:
         assert pool.live == 1
         pool.close()                # pre-fix: drained without decrementing
         assert pool.live == 0
+
+    def test_stale_replay_recomputes_deadline(self):
+        """Regression: the stale-socket replay's per-attempt socket
+        timeout must reflect the budget actually remaining, not the
+        remaining_s captured before the first (stale) attempt ran."""
+        from client_tpu.http import InferenceServerClient
+
+        class _FakeResp:
+            status = 200
+
+            def read(self):
+                return b""
+
+        class _FakeConn:
+            def __init__(self, fail_after_s=None):
+                self.fail_after_s = fail_after_s
+                self.timeout = None
+                self.sock = None
+
+            def request(self, *a, **kw):
+                if self.fail_after_s is not None:
+                    time.sleep(self.fail_after_s)
+                    raise ConnectionResetError("stale keep-alive")
+
+            def getresponse(self):
+                return _FakeResp()
+
+            def close(self):
+                pass
+
+        stale, fresh = _FakeConn(fail_after_s=0.08), _FakeConn()
+        handed = [(stale, True), (fresh, False)]
+
+        class _FakePool:
+            def acquire(self):
+                return handed.pop(0)
+
+            def release(self, conn, broken=False):
+                pass
+
+            def close(self):
+                pass
+
+        c = InferenceServerClient("localhost:9")
+        c._pool = _FakePool()
+        try:
+            resp, _ = c._request_once("GET", "/x", None, {}, 0.5)
+            assert resp.status == 200
+            assert stale.timeout == pytest.approx(0.5, abs=0.02)
+            # The stale attempt burned ~80ms; pre-fix the replay got the
+            # full 0.5s again and could overrun the end-to-end budget.
+            assert fresh.timeout <= 0.5 - 0.07
+            assert c.get_infer_stat()["stale_socket_retry_count"] == 1
+        finally:
+            c.close()
 
     def test_concurrent_churn(self):
         pool = _ConnectionPool("localhost", 1, size=4, timeout=1)
