@@ -590,7 +590,30 @@ def bench_inproc_simple(concurrency: int = BENCH_CONCURRENCY):
             res["hist_p99_us"] = round(scrape.quantile(d, 0.99), 1)
             log(f"simple: histogram-derived p50 {res['hist_p50_us']}us, "
                 f"p99 {res['hist_p99_us']}us over {int(d['count'])} requests")
-    engine.shutdown()
+    if profile is not None:
+        # Overload-protection counters + a real graceful drain instead of
+        # the abrupt shutdown: chaos runs report what the admission layer
+        # shed, what expired, and how long the drain took.
+        from client_tpu.admission.drain import drain
+        from client_tpu.observability import scrape
+
+        try:
+            samples = scrape.parse_samples(engine.prometheus_metrics())
+            res["shed_total"] = int(sum(
+                v for name, _labels, v in samples
+                if name == "tpu_admission_rejections_total"))
+            res["deadline_expired_total"] = int(sum(
+                v for name, _labels, v in samples
+                if name == "tpu_deadline_expirations_total"))
+        except Exception as exc:  # noqa: BLE001
+            log(f"overload counters unavailable: {exc}")
+        report = drain(engine, deadline_s=10.0)
+        res["drain_s"] = round(report["drain_s"], 3)
+        log(f"simple: shed={res.get('shed_total')} "
+            f"deadline_expired={res.get('deadline_expired_total')} "
+            f"drain_s={res['drain_s']} (clean={report['clean']})")
+    else:
+        engine.shutdown()
     return res
 
 

@@ -1,0 +1,353 @@
+"""Server-side admission control: load shedding, throttling, pushback.
+
+PR 2 made the *client* resilient; this module is the server-side
+complement. Under sustained overload an unprotected queue grows until
+every request times out — the classic metastable failure. The standard
+SRE remedy is to shed early and tell the client when to come back:
+
+* **Load shedder** — reject when the scheduler queue is deeper than
+  ``max_queue_depth``, or when the *estimated wait* (queue depth x EWMA
+  service time / instances) exceeds ``max_estimated_wait_s``. A request
+  that would wait longer than its caller will is dead on arrival; failing
+  it in microseconds preserves capacity for requests that can still
+  succeed.
+* **Per-model token buckets** — ``tokens_per_s`` + ``burst`` rate caps
+  and a ``max_inflight`` concurrency cap, so one model cannot starve the
+  rest of the repository.
+* **Retry-After pushback** — every rejection is an
+  :class:`AdmissionError` (HTTP 429 / gRPC RESOURCE_EXHAUSTED) carrying
+  ``retry_after_s``: the frontends surface it as a ``Retry-After`` header
+  / retry-pushback trailing metadata, and the client ``RetryPolicy``
+  honors it instead of guessing with blind exponential backoff.
+* **DEGRADED health** — while the controller is actively shedding,
+  ``TpuEngine.health_state()`` reports DEGRADED (surfaced via
+  ``/v2/health/ready``) so load balancers can steer traffic away before
+  the instance falls over.
+
+Configuration is programmatic (``AdmissionController(AdmissionConfig(...))``)
+or via the ``CLIENT_TPU_ADMISSION`` environment variable holding JSON::
+
+    CLIENT_TPU_ADMISSION='{"max_queue_depth": 256,
+        "max_estimated_wait_s": 2.0,
+        "models": {"bert_base": {"tokens_per_s": 100, "burst": 20,
+                                 "max_inflight": 64}}}'
+
+Every limit defaults to off (0), so an unconfigured engine admits
+everything — the controller then only provides in-flight accounting for
+the drain coordinator (:mod:`client_tpu.admission.drain`).
+
+Rejections are exported as ``tpu_admission_rejections_total{model,
+version,reason}`` on the engine's metric registry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from client_tpu.engine.types import EngineError
+
+__all__ = [
+    "ENV_VAR",
+    "AdmissionConfig",
+    "AdmissionError",
+    "AdmissionController",
+    "TokenBucket",
+]
+
+ENV_VAR = "CLIENT_TPU_ADMISSION"
+
+# Pushback floor: never tell a client to come back in less than this
+# (a 0-second Retry-After degenerates into a tight retry loop).
+MIN_RETRY_AFTER_S = 0.01
+# Pushback ceiling: under pathological estimates, cap the advertised wait
+# so clients re-probe within a bounded window.
+MAX_RETRY_AFTER_S = 30.0
+
+# EWMA smoothing for the per-model service-time estimate: ~86% of the
+# weight within the last 12 observations — reactive enough to follow a
+# load shift, smooth enough to ignore one slow compile.
+_EWMA_ALPHA = 0.15
+
+
+class AdmissionError(EngineError):
+    """A request shed at admission. ``retry_after_s`` is the server's
+    pushback: how long the client should wait before retrying (surfaced
+    as the HTTP ``Retry-After`` header / gRPC retry-pushback trailing
+    metadata). ``reason`` matches the metric label."""
+
+    def __init__(self, message: str, retry_after_s: float,
+                 reason: str = "shed", status: int = 429):
+        super().__init__(message, status)
+        self.retry_after_s = max(MIN_RETRY_AFTER_S,
+                                 min(float(retry_after_s), MAX_RETRY_AFTER_S))
+        self.reason = reason
+
+
+def _clip_retry_after(s: float) -> float:
+    return max(MIN_RETRY_AFTER_S, min(float(s), MAX_RETRY_AFTER_S))
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/s refill up to ``burst``
+    capacity. ``try_acquire`` never blocks; a failed acquire pairs with
+    :meth:`retry_after_s` — the refill time until the request would fit —
+    which becomes the rejection's pushback."""
+
+    def __init__(self, rate: float, burst: float,
+                 clock=time.monotonic):
+        if rate <= 0:
+            raise ValueError("TokenBucket rate must be > 0")
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self._clock = clock
+        self._tokens = self.burst
+        self._stamp = clock()
+        self._lock = threading.Lock()
+
+    def _refill_locked(self) -> None:
+        now = self._clock()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._stamp) * self.rate)
+        self._stamp = now
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        with self._lock:
+            self._refill_locked()
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def retry_after_s(self, n: float = 1.0) -> float:
+        with self._lock:
+            self._refill_locked()
+            deficit = n - self._tokens
+        if deficit <= 0:
+            return MIN_RETRY_AFTER_S
+        return deficit / self.rate
+
+    def available(self) -> float:
+        with self._lock:
+            self._refill_locked()
+            return self._tokens
+
+
+@dataclass
+class AdmissionConfig:
+    """Per-model (or default) admission limits; 0 disables each check."""
+
+    # Shed when the model's scheduler queue is at/over this depth.
+    max_queue_depth: int = 0
+    # Shed when queue_depth x EWMA service time / instances exceeds this.
+    max_estimated_wait_s: float = 0.0
+    # Token-bucket rate cap (requests/s); burst defaults to the rate.
+    tokens_per_s: float = 0.0
+    burst: float = 0.0
+    # Concurrency cap: requests admitted but not yet finally responded.
+    max_inflight: int = 0
+    # How long after the last shed the engine stays DEGRADED.
+    degraded_hold_s: float = 5.0
+    # Per-model overrides, keyed by model name (dicts of the fields above).
+    models: dict[str, dict] = field(default_factory=dict)
+
+    _FIELDS = ("max_queue_depth", "max_estimated_wait_s", "tokens_per_s",
+               "burst", "max_inflight", "degraded_hold_s")
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AdmissionConfig":
+        d = dict(d or {})
+        models = d.pop("models", {}) or {}
+        unknown = set(d) - set(cls._FIELDS)
+        if unknown:
+            raise ValueError(
+                f"unknown admission config keys: {sorted(unknown)}")
+        for name, override in models.items():
+            bad = set(override) - set(cls._FIELDS)
+            if bad:
+                raise ValueError(
+                    f"unknown admission config keys for model "
+                    f"'{name}': {sorted(bad)}")
+        return cls(models=models, **d)
+
+    @classmethod
+    def from_env(cls, environ=os.environ) -> "AdmissionConfig":
+        raw = (environ.get(ENV_VAR) or "").strip()
+        if not raw:
+            return cls()
+        if raw.startswith("@"):
+            with open(raw[1:], "r", encoding="utf-8") as f:
+                raw = f.read()
+        return cls.from_dict(json.loads(raw))
+
+    def for_model(self, name: str) -> "AdmissionConfig":
+        """Effective limits for one model: defaults + per-model override."""
+        override = self.models.get(name)
+        if not override:
+            return self
+        merged = {f: getattr(self, f) for f in self._FIELDS}
+        merged.update(override)
+        return AdmissionConfig(**merged)
+
+
+class _ModelGate:
+    """Per-model admission state: bucket, in-flight count, service EWMA."""
+
+    __slots__ = ("cfg", "bucket", "inflight", "ewma_service_s")
+
+    def __init__(self, cfg: AdmissionConfig):
+        self.cfg = cfg
+        self.bucket = None
+        if cfg.tokens_per_s > 0:
+            self.bucket = TokenBucket(
+                cfg.tokens_per_s, cfg.burst or cfg.tokens_per_s)
+        self.inflight = 0
+        self.ewma_service_s = 0.0
+
+
+class AdmissionController:
+    """Admission decisions + in-flight accounting for one engine.
+
+    The engine calls :meth:`admit` before every scheduler submit and the
+    start/end hooks around each request's lifetime; the drain coordinator
+    reads :meth:`total_inflight` to know when the server is empty.
+    Thread-safe; the hot path is one lock acquisition.
+    """
+
+    def __init__(self, config: AdmissionConfig | None = None,
+                 metrics=None, clock=time.monotonic):
+        self.config = config or AdmissionConfig()
+        self._metrics = metrics  # EngineMetrics | None
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._gates: dict[str, _ModelGate] = {}
+        self._last_shed = 0.0
+        self.rejection_count = 0
+
+    @classmethod
+    def from_env(cls, metrics=None, environ=os.environ
+                 ) -> "AdmissionController":
+        return cls(AdmissionConfig.from_env(environ), metrics=metrics)
+
+    def _gate(self, model: str) -> _ModelGate:
+        gate = self._gates.get(model)
+        if gate is None:
+            with self._lock:
+                gate = self._gates.setdefault(
+                    model, _ModelGate(self.config.for_model(model)))
+        return gate
+
+    # -- the admission decision ---------------------------------------------
+
+    def admit(self, model: str, version: str = "",
+              queue_depth: int = 0, instances: int = 1) -> None:
+        """Admit or shed one request; raises :class:`AdmissionError` on
+        shed. ``queue_depth`` is the model's current scheduler backlog and
+        ``instances`` its worker count (for the estimated-wait check)."""
+        gate = self._gate(model)
+        cfg = gate.cfg
+        if cfg.max_inflight > 0 and gate.inflight >= cfg.max_inflight:
+            # Pushback ~ one service interval: a slot frees when the
+            # oldest in-flight request completes.
+            self._reject(model, version, "concurrency", AdmissionError(
+                f"model '{model}' is at its concurrency cap "
+                f"({gate.inflight}/{cfg.max_inflight} in flight)",
+                retry_after_s=gate.ewma_service_s or MIN_RETRY_AFTER_S,
+                reason="concurrency"))
+        if gate.bucket is not None and not gate.bucket.try_acquire():
+            self._reject(model, version, "throttled", AdmissionError(
+                f"model '{model}' request rate exceeds "
+                f"{cfg.tokens_per_s:g}/s (burst {gate.bucket.burst:g})",
+                retry_after_s=gate.bucket.retry_after_s(),
+                reason="throttled"))
+        if cfg.max_queue_depth > 0 and queue_depth >= cfg.max_queue_depth:
+            est = self._estimated_wait_s(gate, queue_depth, instances)
+            self._reject(model, version, "queue_depth", AdmissionError(
+                f"model '{model}' queue depth {queue_depth} is at the "
+                f"shed limit ({cfg.max_queue_depth}); estimated wait "
+                f"{est:.3f}s", retry_after_s=est, reason="queue_depth"))
+        if cfg.max_estimated_wait_s > 0:
+            est = self._estimated_wait_s(gate, queue_depth, instances)
+            if est > cfg.max_estimated_wait_s:
+                self._reject(model, version, "estimated_wait",
+                             AdmissionError(
+                                 f"model '{model}' estimated queue wait "
+                                 f"{est:.3f}s exceeds the shed limit "
+                                 f"({cfg.max_estimated_wait_s:g}s)",
+                                 retry_after_s=est - cfg.max_estimated_wait_s
+                                 + MIN_RETRY_AFTER_S,
+                                 reason="estimated_wait"))
+
+    @staticmethod
+    def _estimated_wait_s(gate: _ModelGate, queue_depth: int,
+                          instances: int) -> float:
+        service = gate.ewma_service_s or MIN_RETRY_AFTER_S
+        return queue_depth * service / max(1, instances)
+
+    def _reject(self, model: str, version: str, reason: str,
+                exc: AdmissionError):
+        with self._lock:
+            self.rejection_count += 1
+            self._last_shed = self._clock()
+        if self._metrics is not None:
+            self._metrics.admission_rejections.inc(
+                model=model, version=str(version or "latest"),
+                reason=reason)
+        raise exc
+
+    def record_rejection(self, model: str, version: str = "",
+                         reason: str = "draining") -> None:
+        """Count a shed decided outside :meth:`admit` (e.g. the engine's
+        drain gate) on the same counter and DEGRADED clock."""
+        with self._lock:
+            self.rejection_count += 1
+            self._last_shed = self._clock()
+        if self._metrics is not None:
+            self._metrics.admission_rejections.inc(
+                model=model, version=str(version or "latest"),
+                reason=reason)
+
+    # -- lifetime accounting -------------------------------------------------
+
+    def on_request_start(self, model: str) -> None:
+        gate = self._gate(model)
+        with self._lock:
+            gate.inflight += 1
+
+    def on_request_end(self, model: str, service_s: float | None = None
+                       ) -> None:
+        gate = self._gate(model)
+        with self._lock:
+            gate.inflight = max(0, gate.inflight - 1)
+            if service_s is not None and service_s > 0:
+                if gate.ewma_service_s <= 0:
+                    gate.ewma_service_s = service_s
+                else:
+                    gate.ewma_service_s += _EWMA_ALPHA * (
+                        service_s - gate.ewma_service_s)
+
+    def inflight(self, model: str) -> int:
+        gate = self._gates.get(model)
+        return gate.inflight if gate is not None else 0
+
+    def total_inflight(self) -> int:
+        with self._lock:
+            return sum(g.inflight for g in self._gates.values())
+
+    def estimated_service_s(self, model: str) -> float:
+        gate = self._gates.get(model)
+        return gate.ewma_service_s if gate is not None else 0.0
+
+    # -- health --------------------------------------------------------------
+
+    def degraded(self) -> bool:
+        """True while the controller shed recently (within
+        ``degraded_hold_s``): the engine reports DEGRADED so balancers
+        deprioritize the instance while it is actively overloaded."""
+        with self._lock:
+            last = self._last_shed
+        return bool(last) and (self._clock() - last
+                               < self.config.degraded_hold_s)

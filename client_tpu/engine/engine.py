@@ -21,6 +21,7 @@ from client_tpu.engine.repository import ModelRepository
 from client_tpu.engine.scheduler import Scheduler, make_scheduler
 from client_tpu.engine.stats import ModelStats
 from client_tpu.engine.types import (
+    DeadlineExpired,
     EngineError,
     InferRequest,
     InferResponse,
@@ -45,7 +46,7 @@ class TpuEngine:
     def __init__(self, repository: ModelRepository | None = None, *,
                  jit: bool = True, warmup: bool = False,
                  load_all: bool = True, eager_init: bool = True,
-                 metrics_registry=None):
+                 metrics_registry=None, admission=None):
         if eager_init and jit:
             # Pay PjRt client creation here, on the constructing thread, with
             # progress logged — never lazily inside a scheduler worker where
@@ -60,6 +61,7 @@ class TpuEngine:
         self._lock = threading.RLock()
         self._warmup = warmup
         self._live = True
+        self._draining = False
         # Shared-memory data planes (SURVEY.md §5.8); frontends reach them
         # uniformly through these attributes.
         from client_tpu.engine.shm import SystemShmManager, TpuShmManager
@@ -81,6 +83,18 @@ class TpuEngine:
 
         self.faults = _faults.registry()
         self.faults.bind_metrics(self.metrics.registry)
+        # Admission controller: load shedding + in-flight accounting. The
+        # default (CLIENT_TPU_ADMISSION unset) admits everything but still
+        # counts in-flight requests — the drain coordinator depends on
+        # that. (Imported here: client_tpu.admission imports engine.types,
+        # whose package __init__ imports this module — top-level would be
+        # circular.)
+        from client_tpu.admission import AdmissionController
+
+        self.admission = admission or AdmissionController.from_env(
+            metrics=self.metrics)
+        if self.admission._metrics is None:
+            self.admission._metrics = self.metrics
         self.request_traces = TraceStore(
             capacity=int(os.environ.get("CLIENT_TPU_TRACE_BUFFER", "512")))
         if load_all:
@@ -96,7 +110,26 @@ class TpuEngine:
         return self._live
 
     def is_ready(self) -> bool:
-        return self._live
+        # A draining server is still LIVE (don't kill the pod early) but
+        # not READY (stop routing new work here).
+        return self._live and not self._draining
+
+    def health_state(self) -> str:
+        """Readiness with nuance (surfaced via ``/v2/health/ready``):
+        READY — serving normally; DEGRADED — serving, but the admission
+        controller shed recently (balancers should deprioritize);
+        DRAINING — refusing new work while in-flight requests finish."""
+        if self._draining or not self._live:
+            return "DRAINING"
+        if self.admission.degraded():
+            return "DEGRADED"
+        return "READY"
+
+    def begin_drain(self) -> None:
+        """Flip readiness off and start rejecting new submissions with
+        503 + Retry-After pushback. In-flight and queued work continues;
+        :func:`client_tpu.admission.drain.drain` owns the full sequence."""
+        self._draining = True
 
     def server_metadata(self) -> dict:
         # shm extensions are advertised only when a manager is attached.
@@ -253,6 +286,18 @@ class TpuEngine:
     def repository_index(self) -> list[dict]:
         return self.repository.index()
 
+    def schedulers(self) -> list[Scheduler]:
+        """Distinct live schedulers (the bare-name alias shares the latest
+        version's object); the drain coordinator polls their queues."""
+        with self._lock:
+            seen: set[int] = set()
+            out: list[Scheduler] = []
+            for s in self._schedulers.values():
+                if id(s) not in seen:
+                    seen.add(id(s))
+                    out.append(s)
+            return out
+
     # -- inference -----------------------------------------------------------
 
     def async_infer(self, req: InferRequest,
@@ -293,7 +338,57 @@ class TpuEngine:
             return
         if req.trace is not None:
             self._attach_trace_recorder(req)
-        sched.submit(req)
+        # -- overload protection gates (raise like submit's queue-full 429,
+        # so sync and async frontends translate them on one path) ----------
+        from client_tpu.admission import AdmissionError
+
+        if self._draining or not self._live:
+            self.admission.record_rejection(
+                req.model_name, req.model_version, reason="draining")
+            raise AdmissionError(
+                "server is draining; retry against another replica",
+                retry_after_s=1.0, reason="draining", status=503)
+        if req.deadline_expired():
+            # The client's end-to-end budget lapsed in transit/parse:
+            # reject before it costs a queue slot.
+            sched.stats.record_deadline_expired("admission")
+            raise DeadlineExpired(
+                "end-to-end deadline expired before admission")
+        self.admission.admit(
+            req.model_name, req.model_version,
+            queue_depth=sched.queue.qsize(), instances=len(sched.workers))
+        self._submit_accounted(sched, req)
+
+    def _submit_accounted(self, sched: Scheduler, req: InferRequest) -> None:
+        """Submit with exactly-once in-flight accounting: the admitted
+        count increments before submit and decrements on the FINAL response
+        (feeding the service-time EWMA) — or immediately on the unwind path
+        when submit itself rejects (queue full / injected fault), since a
+        rejected request never gets a callback-delivered response."""
+        model_name = req.model_name
+        self.admission.on_request_start(model_name)
+        inner = req.response_callback
+        ended = [False]
+
+        def _accounted(resp: InferResponse) -> None:
+            if resp.final and not ended[0]:
+                ended[0] = True
+                service_s = None
+                t = req.times
+                if resp.error is None and t.compute_start:
+                    service_s = max(
+                        0.0, (t.compute_output_end - t.compute_start) / 1e9)
+                self.admission.on_request_end(model_name, service_s)
+            inner(resp)
+
+        req.response_callback = _accounted
+        try:
+            sched.submit(req)
+        except BaseException:
+            if not ended[0]:
+                ended[0] = True
+                self.admission.on_request_end(model_name)
+            raise
 
     def _attach_trace_recorder(self, req: InferRequest) -> None:
         """Wrap the response callback so the final response snapshots the

@@ -28,7 +28,7 @@ import numpy as np
 from client_tpu import faults
 from client_tpu.engine.backend_init import log as _log
 from client_tpu.engine.config import ModelConfig
-from client_tpu.engine.types import EngineError, now_ns
+from client_tpu.engine.types import DeadlineExpired, EngineError, now_ns
 from client_tpu.protocol.dtypes import wire_to_np_dtype
 
 
@@ -246,7 +246,7 @@ class Model:
 
     def execute_timed(
         self, inputs: dict[str, np.ndarray], batch_size: int | None = None,
-        fetch_outputs: bool = True,
+        fetch_outputs: bool = True, deadline_ns: int = 0,
     ) -> tuple[dict[str, np.ndarray], ExecPhases]:
         """Run one (possibly padded) batch through the jitted executable.
 
@@ -256,6 +256,9 @@ class Model:
         the caller is directing every output into a device region, so
         pulling the batch to host only to ``device_put`` it straight back
         would be pure staging waste.
+        ``deadline_ns`` (absolute ``now_ns()``; 0 = none): raise
+        :class:`DeadlineExpired` instead of dispatching when the batch's
+        end-to-end budget has already lapsed.
         Returns the outputs plus measured :class:`ExecPhases` — each phase is
         bounded by a real device sync (device_put committed / executable
         done / D2H complete), so the statistics the scheduler records are
@@ -265,6 +268,15 @@ class Model:
             raise EngineError(
                 f"model '{self.config.name}' is an ensemble; "
                 "execute composing models instead", 500)
+        # Deadline backstop: the scheduler filters expired requests at
+        # dequeue and pre-dispatch, but batch assembly takes time — this
+        # closes the race so device dispatch never runs for a batch whose
+        # every member has given up (deadline_ns is the LATEST member
+        # deadline; 0 means at least one member has no deadline).
+        if deadline_ns > 0 and now_ns() >= deadline_ns:
+            raise DeadlineExpired(
+                f"end-to-end deadline expired before execution of model "
+                f"'{self.config.name}'")
         # Chaos site: model execution — the deepest injection point,
         # exercising the scheduler's batch-failure fan-out and the
         # frontends' 5xx translation from a device-level fault.

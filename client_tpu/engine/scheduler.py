@@ -22,6 +22,7 @@ from client_tpu import faults
 from client_tpu.engine.model import Model
 from client_tpu.engine.stats import ModelStats
 from client_tpu.engine.types import (
+    DeadlineExpired,
     EngineError,
     InferRequest,
     InferResponse,
@@ -139,6 +140,10 @@ class _ReqQueue:
         with self._cv:
             return len(self._h)
 
+    def level_qsize(self, level: int) -> int:
+        with self._cv:
+            return self._level_counts.get(level, 0)
+
 
 class Scheduler:
     """Base scheduler: owns the request queue and worker threads."""
@@ -226,9 +231,12 @@ class Scheduler:
                 # The rejected request's arrival slot must not dam the
                 # release sequence: mark it done with a hole sentinel.
                 self._release_in_order(req.arrival_seq, (None, None))
+            depth = self.queue.level_qsize(level)
             raise EngineError(
-                f"exceeds maximum queue size ({max_size}) for priority "
-                f"level {level} of model '{self.model.config.name}'", 429)
+                f"model '{self.model.config.name}' rejected request at "
+                f"priority level {level}: current queue depth {depth} "
+                f"exceeds maximum queue size ({max_size}) for that level",
+                429)
         if self._stopping and not any(t.is_alive() for t in self.workers):
             # Submit raced stop() and the workers are already gone: nothing
             # will ever pop this request. Fail whatever is queued
@@ -238,12 +246,17 @@ class Scheduler:
             self._fail_queued("model unloaded before the request was "
                               "processed", 503)
 
-    def stop(self) -> None:
+    def stop(self, timeout_s: float = 5.0) -> None:
+        """Drain and stop the workers. ``timeout_s`` bounds the TOTAL wait
+        across all workers (the drain coordinator budgets one overall
+        deadline, not 5s-per-thread); workers still mid-request past it are
+        abandoned and their queued work failed below."""
         self._stopping = True
+        deadline = time.monotonic() + max(0.0, timeout_s)
         for _ in self.workers:
             self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)
         for t in self.workers:
-            t.join(timeout=5.0)
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
         # Workers drain real requests ahead of the shutdown sentinels (heap
         # order), but anything enqueued after the workers exited — or left
         # behind by a worker that timed out — must still get a response.
@@ -347,6 +360,34 @@ class Scheduler:
             return True
         return False
 
+    def _check_deadline(self, req: InferRequest, stage: str = "queue") -> bool:
+        """End-to-end deadline propagation: the client's budget
+        (``timeout-ms`` header / gRPC deadline) landed on
+        ``req.deadline_ns``; past it the caller has given up, so fail
+        fast with 504/DEADLINE_EXCEEDED instead of spending device time
+        on a dead request. ``stage`` labels where the expiry was caught
+        on tpu_deadline_expirations_total (queue | execute)."""
+        if req.deadline_expired():
+            waited_ms = (now_ns() - req.times.queue_start) / 1e6
+            self.stats.record_deadline_expired(stage)
+            self._fail(req, DeadlineExpired(
+                f"end-to-end deadline expired before {stage} "
+                f"(waited {waited_ms:.1f}ms in queue)"))
+            return True
+        return False
+
+    def _check_dequeue_fault(self, req: InferRequest) -> bool:
+        """Chaos site: scheduler dequeue — a popped request that fails
+        before any batching/execution. Proves the expiry-at-dequeue and
+        shed error paths (frontend translation, client classification)
+        with seeded determinism."""
+        try:
+            faults.fire("scheduler.dequeue")
+        except faults.FaultInjected as exc:
+            self._fail(req, EngineError(str(exc), exc.status or 503))
+            return True
+        return False
+
     def _check_timeout(self, req: InferRequest) -> bool:
         """Server-side request timeout while queued (InferOptions
         server_timeout, reference common.h:199-204, composed with the
@@ -391,13 +432,30 @@ class DefaultScheduler(Scheduler):
             if item is _SHUTDOWN:
                 return
             req: InferRequest = item
-            if self._check_timeout(req) or self._check_cancelled(req):
+            if self._check_timeout(req) or self._check_cancelled(req) \
+                    or self._check_deadline(req) \
+                    or self._check_dequeue_fault(req):
                 continue
             batch = [req]
             if dyn is not None and cfg.max_batch_size > 0:
                 batch = self._gather(req, dyn)
+            # Deadline backstop at dispatch: gathering may have consumed the
+            # delay window, and a request popped with time left can expire
+            # while the batch assembles. Expired members fail here (stage
+            # "execute"); the survivors still run.
+            batch = [r for r in batch
+                     if not self._check_deadline(r, stage="execute")]
+            if not batch:
+                continue
             try:
                 self._execute_batch(batch)
+            except DeadlineExpired as exc:
+                # model.execute_timed's pre-dispatch check fired: the whole
+                # batch's budget lapsed between the filter above and device
+                # dispatch (the race window the model-level check closes).
+                for r in batch:
+                    self.stats.record_deadline_expired("execute")
+                    self._fail(r, exc)
             except Exception as exc:  # noqa: BLE001 — isolate worker
                 for r in batch:
                     self._fail(r, exc)
@@ -430,7 +488,9 @@ class DefaultScheduler(Scheduler):
                     stop = True
                     break
                 nxt: InferRequest = item
-                if self._check_timeout(nxt) or self._check_cancelled(nxt):
+                if self._check_timeout(nxt) or self._check_cancelled(nxt) \
+                        or self._check_deadline(nxt) \
+                        or self._check_dequeue_fault(nxt):
                     continue
                 if total >= prefer \
                         or total + _request_batch(nxt) > max_batch \
@@ -466,6 +526,12 @@ class DefaultScheduler(Scheduler):
         start = now_ns()
         for r in batch:
             r.times.compute_start = start
+        # Whole-batch deadline for the model's pre-dispatch check: 0 (none)
+        # if ANY member is deadline-free — the batch must run for that
+        # member's sake — else the latest member deadline (failing the batch
+        # any earlier would expire requests that still had budget).
+        deadline_ns = 0 if any(r.deadline_ns == 0 for r in batch) \
+            else max(r.deadline_ns for r in batch)
 
         if cfg.max_batch_size > 0:
             sizes = [_request_batch(r) for r in batch]
@@ -484,7 +550,8 @@ class DefaultScheduler(Scheduler):
             # pathology.
             fetch = not all(r.keep_outputs_on_device for r in batch)
             outputs, phases = self.model.execute_timed(
-                merged, batch_size=total, fetch_outputs=fetch)
+                merged, batch_size=total, fetch_outputs=fetch,
+                deadline_ns=deadline_ns)
             self.stats.record_execution(
                 total, compute_ns=phases.infer_end - phases.input_end)
             if fetch:
@@ -505,7 +572,7 @@ class DefaultScheduler(Scheduler):
                     self._finish(r, per, phases)
         else:
             outputs, phases = self.model.execute_timed(
-                batch[0].inputs, batch_size=None)
+                batch[0].inputs, batch_size=None, deadline_ns=deadline_ns)
             self.stats.record_execution(
                 1, compute_ns=phases.infer_end - phases.input_end)
             self._finish(batch[0], outputs, phases)
@@ -561,7 +628,9 @@ class DecoupledScheduler(Scheduler):
             if item is _SHUTDOWN:
                 return
             req: InferRequest = item
-            if self._check_timeout(req) or self._check_cancelled(req):
+            if self._check_timeout(req) or self._check_cancelled(req) \
+                    or self._check_deadline(req) \
+                    or self._check_dequeue_fault(req):
                 continue
             req.times.compute_start = now_ns()
             self.active_batches += 1

@@ -52,6 +52,8 @@ class ModelStats:
     # Admission rejections (queue-full 429s) — exported as
     # tpu_queue_rejections_total when instruments are attached.
     rejection_count: int = 0
+    # End-to-end deadline expirations caught before device dispatch.
+    deadline_expired_count: int = 0
     # batch_size -> [execution count, cumulative compute-infer ns]
     batch_hist: dict[int, list[int]] = field(default_factory=dict)
     # Optional observability hook (metrics.ModelInstruments); None for
@@ -101,6 +103,15 @@ class ModelStats:
             self.rejection_count += 1
         if self.instruments is not None:
             self.instruments.record_rejection()
+
+    def record_deadline_expired(self, stage: str = "queue") -> None:
+        """An end-to-end deadline passed before `stage` ran (exported as
+        tpu_deadline_expirations_total{stage} when instruments are
+        attached)."""
+        with self._lock:
+            self.deadline_expired_count += 1
+        if self.instruments is not None:
+            self.instruments.record_deadline_expired(stage)
 
     def to_dict(self) -> dict:
         """v2 `GET /v2/models/<m>/stats` entry."""

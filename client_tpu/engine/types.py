@@ -23,6 +23,18 @@ class EngineError(Exception):
         self.status = status
 
 
+class DeadlineExpired(EngineError):
+    """The request's end-to-end deadline passed before the work ran.
+
+    Carried as 504 so the HTTP frontend answers Gateway Timeout and the
+    gRPC frontend maps to DEADLINE_EXCEEDED. A distinct type (rather than
+    a bare 504 EngineError) lets the scheduler attribute the expiry stage
+    on tpu_deadline_expirations_total without string matching."""
+
+    def __init__(self, message: str):
+        super().__init__(message, 504)
+
+
 def now_ns() -> int:
     return time.monotonic_ns()
 
@@ -84,6 +96,14 @@ class InferRequest:
     # Assigned by the scheduler under preserve_ordering (arrival index).
     arrival_seq: int | None = None
     timeout_us: int = 0
+    # End-to-end deadline (absolute time.monotonic_ns(); 0 = none).
+    # Frontends derive it from the client's budget — the `timeout-ms` HTTP
+    # header / `timeout_ms` request parameter, or the gRPC RPC deadline —
+    # and the scheduler dequeue path plus the model-execute pre-check fail
+    # expired requests fast (504/DEADLINE_EXCEEDED) instead of burning
+    # device time on work whose caller already gave up. Distinct from
+    # `timeout_us`, which is the queue policy's queue-WAIT bound.
+    deadline_ns: int = 0
     times: RequestTimes = field(default_factory=RequestTimes)
     # Decoupled models invoke this once per streamed response; the final
     # response (or the only one, for non-decoupled) resolves the future too.
@@ -114,6 +134,22 @@ class InferRequest:
 
     def cancel(self) -> None:
         self.cancelled = True
+
+    def set_deadline_from_timeout_ms(self, timeout_ms: float) -> None:
+        """Arm the end-to-end deadline from a client budget in ms
+        (non-positive budgets leave the request deadline-free)."""
+        if timeout_ms > 0:
+            self.deadline_ns = now_ns() + int(timeout_ms * 1_000_000)
+
+    def deadline_expired(self, now: int | None = None) -> bool:
+        return self.deadline_ns > 0 and \
+            (now if now is not None else now_ns()) >= self.deadline_ns
+
+    def deadline_remaining_s(self) -> float | None:
+        """Seconds until the deadline (None when no deadline is set)."""
+        if self.deadline_ns <= 0:
+            return None
+        return (self.deadline_ns - now_ns()) / 1e9
 
     def requested_output_names(self) -> list[str]:
         return [o.name for o in self.outputs]

@@ -6,6 +6,8 @@ one chokepoint of the serving stack:
 * ``http.pre_read``      — HTTP frontend, before the request body is read
 * ``grpc.pre_infer``     — gRPC frontend, on ModelInfer entry
 * ``scheduler.enqueue``  — scheduler admission, before the queue put
+* ``scheduler.dequeue``  — scheduler worker, after a request is popped
+  (exercises the expiry-at-dequeue / shed paths with seeded determinism)
 * ``model.execute``      — model execution, before device dispatch
 
 Each site can inject added latency, a protocol error with a chosen
@@ -48,7 +50,7 @@ __all__ = [
 ]
 
 SITES = ("http.pre_read", "grpc.pre_infer", "scheduler.enqueue",
-         "model.execute")
+         "scheduler.dequeue", "model.execute")
 
 ENV_VAR = "CLIENT_TPU_FAULTS"
 

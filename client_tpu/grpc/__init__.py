@@ -53,10 +53,24 @@ class KeepAliveOptions:
 
 def _grpc_error(exc: grpc.RpcError) -> InferenceServerException:
     try:
-        return InferenceServerException(
+        err = InferenceServerException(
             msg=exc.details(), status=str(exc.code()))
     except Exception:  # noqa: BLE001
         return InferenceServerException(msg=str(exc))
+    # Server pushback rides in trailing metadata (admission sheds / drain:
+    # `retry-after` in fractional seconds, `retry-pushback-ms` integral) —
+    # surfaced as retry_after_s so resilience.retry_after_of finds it and
+    # RetryPolicy waits exactly as long as the server asked.
+    try:
+        trailing = exc.trailing_metadata() or ()
+        meta = {k.lower(): v for k, v in trailing}
+        if "retry-after" in meta:
+            err.retry_after_s = float(meta["retry-after"])
+        elif "retry-pushback-ms" in meta:
+            err.retry_after_s = float(meta["retry-pushback-ms"]) / 1000.0
+    except Exception:  # noqa: BLE001 — pushback is best-effort
+        pass
+    return err
 
 
 class InferInput:

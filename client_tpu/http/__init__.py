@@ -239,16 +239,34 @@ _STALE_SOCKET_ERRORS = (BadStatusLine, ConnectionResetError,
                         BrokenPipeError, ConnectionAbortedError)
 
 
+def _parse_retry_after(resp) -> float | None:
+    """Server pushback from a Retry-After header (seconds form only —
+    this ecosystem's servers send fractional seconds; HTTP-date is not
+    used here). None when absent or unparsable."""
+    raw = resp.getheader("Retry-After") if resp is not None else None
+    if raw is None:
+        return None
+    try:
+        value = float(raw)
+    except (TypeError, ValueError):
+        return None
+    return value if value >= 0 else None
+
+
 class _RetryableStatus(Exception):
-    """Internal: a response with a retryable HTTP status (502/503),
-    re-raised through the resilience loop; carries the response so retry
-    exhaustion degrades to returning it (original _request contract)."""
+    """Internal: a response with a retryable HTTP status (502/503, or an
+    admission-shed 429 carrying Retry-After pushback), re-raised through
+    the resilience loop; carries the response so retry exhaustion degrades
+    to returning it (original _request contract). ``retry_after_s`` feeds
+    RetryPolicy.backoff_s so the client waits exactly as long as the
+    server asked."""
 
     def __init__(self, resp, data):
         super().__init__(f"HTTP {resp.status}")
         self.resp = resp
         self.data = data
         self.status = resp.status
+        self.retry_after_s = _parse_retry_after(resp)
 
 
 class _ConnectionPool:
@@ -374,8 +392,11 @@ class InferenceServerClient:
             resp, data = self._request_once(method, path, body, headers,
                                             remaining_s)
             retryable = (self._retry_policy is not None
-                         and resp.status
-                         in self._retry_policy.retryable_statuses)
+                         and (resp.status
+                              in self._retry_policy.retryable_statuses
+                              or (resp.status in (429, 503)
+                                  and _parse_retry_after(resp)
+                                  is not None)))
             # A breaker-only client still needs 5xx surfaced as failures so
             # consecutive server faults trip it (4xx stays a plain return:
             # the caller's fault, not the host's).
@@ -466,8 +487,14 @@ class InferenceServerClient:
                 msg = json.loads(data).get("error", "")
             except Exception:  # noqa: BLE001
                 msg = data.decode("utf-8", errors="replace")
-            raise InferenceServerException(msg or f"HTTP {resp.status}",
+            exc = InferenceServerException(msg or f"HTTP {resp.status}",
                                            status=resp.status)
+            # Surface server pushback (admission sheds, drain) so callers
+            # and resilience.retry_after_of can honor it.
+            retry_after = _parse_retry_after(resp)
+            if retry_after is not None:
+                exc.retry_after_s = retry_after
+            raise exc
 
     # -- health / metadata ---------------------------------------------------
 
@@ -656,8 +683,13 @@ class InferenceServerClient:
 
     def _infer_request(self, model_name, model_version, body, header_length,
                        headers, query_params, request_compression_algorithm,
-                       response_compression_algorithm):
+                       response_compression_algorithm, timeout_ms=None):
         req_headers = dict(headers or {})
+        if timeout_ms is not None:
+            # End-to-end deadline propagation: the server's scheduler and
+            # model skip this request once the budget lapses (504 instead
+            # of wasted device time).
+            req_headers["timeout-ms"] = f"{float(timeout_ms):g}"
         if header_length is not None:
             req_headers[rest.HEADER_INFERENCE_CONTENT_LENGTH] = str(header_length)
         if request_compression_algorithm == "gzip":
@@ -704,26 +736,29 @@ class InferenceServerClient:
               request_id="", sequence_id=0, sequence_start=False,
               sequence_end=False, priority=0, timeout=None, headers=None,
               query_params=None, request_compression_algorithm=None,
-              response_compression_algorithm=None, parameters=None):
+              response_compression_algorithm=None, parameters=None,
+              timeout_ms=None):
         body, header_length = self.generate_request_body(
             inputs, outputs, request_id, sequence_id, sequence_start,
             sequence_end, priority, timeout, parameters)
         return self._infer_request(
             model_name, model_version, body, header_length, headers,
             query_params, request_compression_algorithm,
-            response_compression_algorithm)
+            response_compression_algorithm, timeout_ms=timeout_ms)
 
     def async_infer(self, model_name, inputs, model_version="", outputs=None,
                     request_id="", sequence_id=0, sequence_start=False,
                     sequence_end=False, priority=0, timeout=None,
                     headers=None, query_params=None,
                     request_compression_algorithm=None,
-                    response_compression_algorithm=None, parameters=None):
+                    response_compression_algorithm=None, parameters=None,
+                    timeout_ms=None):
         body, header_length = self.generate_request_body(
             inputs, outputs, request_id, sequence_id, sequence_start,
             sequence_end, priority, timeout, parameters)
         future = self._executor.submit(
             self._infer_request, model_name, model_version, body,
             header_length, headers, query_params,
-            request_compression_algorithm, response_compression_algorithm)
+            request_compression_algorithm, response_compression_algorithm,
+            timeout_ms)
         return InferAsyncRequest(future, self._verbose)

@@ -281,13 +281,21 @@ class ModelInstruments:
     def record_rejection(self) -> None:
         self._em.queue_rejections.inc(**self._labels)
 
+    def record_deadline_expired(self, stage: str) -> None:
+        self._em.deadline_expirations.inc(stage=stage, **self._labels)
+
+    def record_admission_rejection(self, reason: str) -> None:
+        self._em.admission_rejections.inc(reason=reason, **self._labels)
+
 
 class EngineMetrics:
     """The engine's standard metric vocabulary on one registry.
 
     Histograms: tpu_request_duration_us, tpu_phase_duration_us{phase},
     tpu_batch_size. Gauges: tpu_queue_depth, tpu_inflight_batches,
-    tpu_device_hbm_bytes_in_use. Counter: tpu_queue_rejections_total.
+    tpu_device_hbm_bytes_in_use, tpu_drain_duration_seconds. Counters:
+    tpu_queue_rejections_total, tpu_admission_rejections_total{reason},
+    tpu_deadline_expirations_total{stage}.
     """
 
     def __init__(self, registry: MetricRegistry | None = None):
@@ -322,6 +330,21 @@ class EngineMetrics:
             "tpu_queue_rejections_total",
             "Requests rejected at admission (backpressure, HTTP 429)",
             ("model", "version"))
+        self.admission_rejections = r.counter(
+            "tpu_admission_rejections_total",
+            "Requests shed by the admission controller, by reason "
+            "(queue_depth, estimated_wait, concurrency, throttled, "
+            "draining)",
+            ("model", "version", "reason"))
+        self.deadline_expirations = r.counter(
+            "tpu_deadline_expirations_total",
+            "Requests whose end-to-end deadline expired before the given "
+            "stage ran (admission, queue, execute)",
+            ("model", "version", "stage"))
+        self.drain_duration = r.gauge(
+            "tpu_drain_duration_seconds",
+            "Wall time of the last graceful drain (0 until one runs)")
+        self.drain_duration.set(0.0)
         self._instruments: dict[tuple[str, str], ModelInstruments] = {}
         self._lock = threading.Lock()
 

@@ -34,6 +34,7 @@ __all__ = [
     "CircuitBreaker",
     "CircuitBreakerOpenError",
     "DeadlineExceededError",
+    "retry_after_of",
     "run_with_resilience",
 ]
 
@@ -82,6 +83,21 @@ def status_of(exc) -> int | str | None:
     return status
 
 
+def retry_after_of(exc) -> float | None:
+    """Server pushback attached to an error by the transports: the HTTP
+    client parses a ``Retry-After`` header, the gRPC client the
+    ``retry-after``/``retry-pushback-ms`` trailing metadata — both land
+    on ``exc.retry_after_s``. None when the server sent no pushback."""
+    value = getattr(exc, "retry_after_s", None)
+    if value is None:
+        return None
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        return None
+    return value if value >= 0 else None
+
+
 class RetryPolicy:
     """Retry schedule + retryable-status classification.
 
@@ -112,6 +128,11 @@ class RetryPolicy:
     def retryable(self, exc) -> bool:
         if isinstance(exc, CONNECTION_ERRORS):
             return True
+        if retry_after_of(exc) is not None:
+            # Explicit server pushback (429 + Retry-After / gRPC
+            # retry-pushback): the server ASKED for a retry later —
+            # retryable by definition, whatever the status code.
+            return True
         status = status_of(exc)
         if status is None:
             # A wrapped connection failure (e.g. gRPC future timeout or an
@@ -125,17 +146,26 @@ class RetryPolicy:
             return True
         return False
 
-    def backoff_s(self, retry_index: int, remaining_s: float | None = None):
+    def backoff_s(self, retry_index: int, remaining_s: float | None = None,
+                  retry_after_s: float | None = None):
         """Delay before retry number ``retry_index`` (1-based). Never
-        exceeds the remaining deadline budget when one is given."""
-        cap = min(self.max_backoff_s,
-                  self.initial_backoff_s
-                  * self.backoff_multiplier ** max(0, retry_index - 1))
-        if self.jitter:
-            with self._rng_lock:
-                delay = self._rng.uniform(0.0, cap)
+        exceeds the remaining deadline budget when one is given.
+
+        ``retry_after_s`` is server pushback (Retry-After / gRPC
+        retry-pushback metadata): when present it REPLACES the jittered
+        exponential draw — the server knows when capacity frees up;
+        guessing earlier hammers it, guessing later wastes budget."""
+        if retry_after_s is not None:
+            delay = max(0.0, float(retry_after_s))
         else:
-            delay = cap
+            cap = min(self.max_backoff_s,
+                      self.initial_backoff_s
+                      * self.backoff_multiplier ** max(0, retry_index - 1))
+            if self.jitter:
+                with self._rng_lock:
+                    delay = self._rng.uniform(0.0, cap)
+            else:
+                delay = cap
         if remaining_s is not None:
             delay = min(delay, max(0.0, remaining_s))
         return delay
@@ -325,7 +355,8 @@ def run_with_resilience(attempt, *, policy=None, breaker=None,
                 remaining = deadline_s - (clock() - start)
                 if remaining <= 0:
                     raise
-            delay = policy.backoff_s(attempt_no, remaining)
+            delay = policy.backoff_s(attempt_no, remaining,
+                                     retry_after_s=retry_after_of(exc))
             if on_retry is not None:
                 on_retry(attempt_no, exc, delay)
             if delay > 0:

@@ -34,6 +34,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="pre-compile every model's batch buckets at load")
     ap.add_argument("--no-jit", action="store_true",
                     help="skip XLA jit (host execution; for debugging)")
+    ap.add_argument("--drain-deadline", type=float, default=30.0,
+                    metavar="SECONDS",
+                    help="max seconds to drain in-flight requests on "
+                         "SIGTERM before forcing shutdown (default 30)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -69,27 +73,40 @@ def main(argv: list[str] | None = None) -> int:
         print(line, file=sys.stderr, flush=True)
 
     servers = []
+    http_servers = []
+    grpc_servers = []
     if not args.no_http:
         from client_tpu.server import HttpInferenceServer
 
         http_srv = HttpInferenceServer(engine, host=args.host,
                                        port=args.http_port,
                                        verbose=args.verbose).start()
+        http_servers.append(http_srv)
         servers.append(("http", http_srv.url))
     if not args.no_grpc:
         from client_tpu.server import GrpcInferenceServer
 
         grpc_srv = GrpcInferenceServer(engine, host=args.host,
                                        port=args.grpc_port).start()
+        grpc_servers.append(grpc_srv)
         servers.append(("grpc", grpc_srv.url))
     for kind, url in servers:
         print(f"serving {kind} at {url}", file=sys.stderr, flush=True)
     if not servers:
         print("nothing to serve (--no-http and --no-grpc)", file=sys.stderr)
         return 2
+    # Graceful drain on SIGTERM (the orchestrator's stop signal): flip
+    # readiness, refuse new work, let in-flight requests finish inside
+    # --drain-deadline, then exit 0.
+    from client_tpu.admission.drain import install_sigterm_handler
+
+    drained = install_sigterm_handler(
+        engine, http_servers=http_servers, grpc_servers=grpc_servers,
+        deadline_s=args.drain_deadline)
     try:
-        while True:
-            time.sleep(3600)
+        while not drained.wait(timeout=3600):
+            pass
+        print("drained; exiting", file=sys.stderr, flush=True)
     except KeyboardInterrupt:
         print("shutting down", file=sys.stderr)
         engine.shutdown()

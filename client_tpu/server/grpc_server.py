@@ -62,6 +62,17 @@ _STATUS_BY_HTTP = {
 
 
 def _abort(context, exc: Exception):
+    # Admission/drain sheds carry pushback in trailing metadata (keys must
+    # not use the reserved `grpc-` prefix): `retry-after` in fractional
+    # seconds plus `retry-pushback-ms` for integral-ms consumers — the
+    # client RetryPolicy reads either and waits that long instead of its
+    # blind exponential backoff.
+    retry_after_s = getattr(exc, "retry_after_s", None)
+    if retry_after_s is not None:
+        context.set_trailing_metadata((
+            ("retry-after", f"{retry_after_s:.3f}"),
+            ("retry-pushback-ms", str(max(1, int(retry_after_s * 1000)))),
+        ))
     if isinstance(exc, EngineError):
         code = _STATUS_BY_HTTP.get(exc.status, grpc.StatusCode.UNKNOWN)
         context.abort(code, str(exc))
@@ -69,7 +80,8 @@ def _abort(context, exc: Exception):
 
 
 def _proto_to_request(engine: TpuEngine,
-                     request: "pb.ModelInferRequest") -> InferRequest:
+                     request: "pb.ModelInferRequest",
+                     context=None) -> InferRequest:
     inputs: dict[str, np.ndarray] = {}
     raw = list(request.raw_input_contents)
     raw_idx = 0
@@ -98,7 +110,7 @@ def _proto_to_request(engine: TpuEngine,
         ))
 
     params = grpc_codec.params_to_dict(request.parameters)
-    return InferRequest(
+    req = InferRequest(
         model_name=request.model_name,
         model_version=request.model_version,
         request_id=request.id,
@@ -111,6 +123,19 @@ def _proto_to_request(engine: TpuEngine,
         priority=int(params.get("priority", 0)),
         timeout_us=int(params.get("timeout", 0)),
     )
+    # End-to-end deadline: the RPC's own deadline (context.time_remaining()
+    # is the budget the CLIENT set, already net of transit) or a
+    # `timeout_ms` request parameter (usable mid-stream, where per-RPC
+    # deadlines cover the whole stream, not one exchange). Parameter wins
+    # when both are present — it is the more specific statement.
+    timeout_ms = params.get("timeout_ms")
+    if timeout_ms is not None:
+        req.set_deadline_from_timeout_ms(float(timeout_ms))
+    elif context is not None:
+        remaining = context.time_remaining()
+        if remaining is not None and remaining >= 0:
+            req.set_deadline_from_timeout_ms(remaining * 1000.0)
+    return req
 
 
 def _read_shm_input(engine, tensor, params) -> np.ndarray:
@@ -414,7 +439,7 @@ class _Servicer(GRPCInferenceServiceServicer):
                                        grpc.StatusCode.UNAVAILABLE)
             context.abort(code, str(exc))
         try:
-            req = _proto_to_request(self.engine, request)
+            req = _proto_to_request(self.engine, request, context)
             self._adopt_trace(req, context)
             # Client disconnect/cancel marks the request so the scheduler
             # skips it instead of spending device time on a dead caller.

@@ -133,7 +133,9 @@ class _Handler(BaseHTTPRequestHandler):
                     return
             self._send_error(404, f"no route for {method} {self.path}")
         except EngineError as exc:
-            self._send_error(exc.status, str(exc))
+            self._send_error(exc.status, str(exc),
+                             retry_after_s=getattr(exc, "retry_after_s",
+                                                   None))
         except (json.JSONDecodeError, ValueError, KeyError, zlib.error,
                 gzip.BadGzipFile) as exc:
             self._send_error(400, f"malformed request: {exc!r}")
@@ -182,9 +184,16 @@ class _Handler(BaseHTTPRequestHandler):
     def _send_json(self, obj, status: int = 200) -> None:
         self._send(status, json.dumps(obj).encode("utf-8"))
 
-    def _send_error(self, status: int, msg: str) -> None:
+    def _send_error(self, status: int, msg: str,
+                    retry_after_s: float | None = None) -> None:
+        # Admission/drain sheds carry server pushback: Retry-After in
+        # fractional seconds (our RetryPolicy parses floats; proxies that
+        # only read integral seconds round down harmlessly).
+        headers = ({"Retry-After": f"{retry_after_s:.3f}"}
+                   if retry_after_s is not None else None)
         try:
-            self._send(status, json.dumps({"error": msg}).encode("utf-8"))
+            self._send(status, json.dumps({"error": msg}).encode("utf-8"),
+                       extra_headers=headers)
         except Exception:  # noqa: BLE001 — peer may have gone away
             pass
 
@@ -194,7 +203,17 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(200 if self.engine.is_live() else 400, b"")
 
     def h_health_ready(self):
-        self._send(200 if self.engine.is_ready() else 400, b"")
+        # Readiness with nuance: 200 while serving (READY or DEGRADED —
+        # degraded still accepts work), 503 while DRAINING/down. The state
+        # rides in both the JSON body and a header so HEAD-style probes
+        # that ignore bodies can still read it.
+        state = (self.engine.health_state()
+                 if hasattr(self.engine, "health_state")
+                 else ("READY" if self.engine.is_ready() else "DRAINING"))
+        ready = self.engine.is_ready()
+        self._send(200 if ready else 503,
+                   json.dumps({"state": state}).encode("utf-8"),
+                   extra_headers={"X-Health-State": state})
 
     def h_server_metadata(self):
         md = self.engine.server_metadata()
@@ -539,6 +558,19 @@ class _Handler(BaseHTTPRequestHandler):
             trace=TraceContext.from_traceparent(
                 self.headers.get("traceparent")),
         )
+        # End-to-end deadline: the `timeout-ms` header (transport-level,
+        # set by our HTTP client from its request budget) or the
+        # `timeout_ms` request parameter (protocol-level, works through
+        # proxies that strip unknown headers). Header wins — it reflects
+        # the budget *remaining* at send time.
+        timeout_ms = self.headers.get("timeout-ms") \
+            or params.get("timeout_ms")
+        if timeout_ms is not None:
+            try:
+                req.set_deadline_from_timeout_ms(float(timeout_ms))
+            except (TypeError, ValueError):
+                raise EngineError(
+                    f"invalid timeout-ms value {timeout_ms!r}", 400) from None
         return req
 
     def _read_shm_input(self, wire) -> np.ndarray:

@@ -1,0 +1,566 @@
+"""Admission control, deadline propagation, and drain — unit coverage.
+
+The server-side overload-protection layer: token buckets and load
+shedding (client_tpu.admission), end-to-end deadlines on InferRequest,
+RetryPolicy honoring server pushback, the scheduler.dequeue fault site,
+and Scheduler.stop() draining queued work across priority levels.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from client_tpu import faults
+from client_tpu.admission import (
+    ENV_VAR,
+    MAX_RETRY_AFTER_S,
+    MIN_RETRY_AFTER_S,
+    AdmissionConfig,
+    AdmissionController,
+    AdmissionError,
+    TokenBucket,
+)
+from client_tpu.admission.drain import drain
+from client_tpu.engine import InferRequest, TpuEngine
+from client_tpu.engine.config import DynamicBatchingConfig, QueuePolicy
+from client_tpu.engine.repository import ModelRepository
+from client_tpu.engine.types import DeadlineExpired, EngineError, now_ns
+from client_tpu.models import build_repository
+from client_tpu.models.simple import AddSubBackend
+from client_tpu.resilience import RetryPolicy, retry_after_of
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        clk = FakeClock()
+        b = TokenBucket(rate=10.0, burst=3.0, clock=clk)
+        assert all(b.try_acquire() for _ in range(3))
+        assert not b.try_acquire()
+        # Deficit of 1 token at 10/s -> 0.1s pushback.
+        assert b.retry_after_s() == pytest.approx(0.1)
+        clk.advance(0.1)
+        assert b.try_acquire()
+
+    def test_refill_caps_at_burst(self):
+        clk = FakeClock()
+        b = TokenBucket(rate=100.0, burst=2.0, clock=clk)
+        clk.advance(60)
+        assert b.available() == pytest.approx(2.0)
+
+    def test_rate_must_be_positive(self):
+        with pytest.raises(ValueError):
+            TokenBucket(rate=0, burst=1)
+
+
+class TestAdmissionConfig:
+    def test_from_dict_rejects_unknown_keys(self):
+        with pytest.raises(ValueError, match="unknown admission config"):
+            AdmissionConfig.from_dict({"max_queue_dept": 5})
+        with pytest.raises(ValueError, match="for model 'm'"):
+            AdmissionConfig.from_dict({"models": {"m": {"bogus": 1}}})
+
+    def test_for_model_merges_overrides(self):
+        cfg = AdmissionConfig.from_dict({
+            "max_queue_depth": 100,
+            "models": {"bert": {"max_queue_depth": 8, "tokens_per_s": 5}}})
+        eff = cfg.for_model("bert")
+        assert eff.max_queue_depth == 8
+        assert eff.tokens_per_s == 5
+        assert cfg.for_model("other").max_queue_depth == 100
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv(ENV_VAR, '{"max_inflight": 7}')
+        assert AdmissionConfig.from_env().max_inflight == 7
+        monkeypatch.delenv(ENV_VAR)
+        assert AdmissionConfig.from_env().max_inflight == 0
+
+
+class TestAdmissionController:
+    def test_unconfigured_admits_everything(self):
+        c = AdmissionController()
+        for _ in range(1000):
+            c.admit("m", queue_depth=10_000)
+        assert c.rejection_count == 0
+
+    def test_concurrency_cap_and_accounting(self):
+        c = AdmissionController(AdmissionConfig(max_inflight=2))
+        c.on_request_start("m")
+        c.on_request_start("m")
+        with pytest.raises(AdmissionError) as ei:
+            c.admit("m")
+        assert ei.value.status == 429
+        assert ei.value.reason == "concurrency"
+        c.on_request_end("m")
+        c.admit("m")  # slot freed
+        assert c.inflight("m") == 1
+        assert c.total_inflight() == 1
+
+    def test_token_bucket_pushback(self):
+        clk = FakeClock()
+        c = AdmissionController(
+            AdmissionConfig(tokens_per_s=10.0, burst=1.0), clock=clk)
+        # The controller's gates build their own bucket from config; the
+        # bucket uses time.monotonic, so only check the shape here.
+        c.admit("m")
+        with pytest.raises(AdmissionError) as ei:
+            c.admit("m")
+        assert ei.value.reason == "throttled"
+        assert MIN_RETRY_AFTER_S <= ei.value.retry_after_s \
+            <= MAX_RETRY_AFTER_S
+
+    def test_queue_depth_shed_uses_estimated_wait(self):
+        clk = FakeClock()
+        c = AdmissionController(
+            AdmissionConfig(max_queue_depth=4), clock=clk)
+        # Teach the EWMA a 0.5s service time.
+        c.on_request_start("m")
+        c.on_request_end("m", service_s=0.5)
+        with pytest.raises(AdmissionError) as ei:
+            c.admit("m", queue_depth=4, instances=1)
+        assert ei.value.reason == "queue_depth"
+        assert ei.value.retry_after_s == pytest.approx(2.0)  # 4 * 0.5 / 1
+
+    def test_estimated_wait_shed(self):
+        c = AdmissionController(AdmissionConfig(max_estimated_wait_s=1.0))
+        c.on_request_start("m")
+        c.on_request_end("m", service_s=1.0)
+        c.admit("m", queue_depth=1, instances=1)  # 1s wait: at the limit
+        with pytest.raises(AdmissionError) as ei:
+            c.admit("m", queue_depth=5, instances=1)
+        assert ei.value.reason == "estimated_wait"
+
+    def test_degraded_hold_window(self):
+        clk = FakeClock()
+        c = AdmissionController(
+            AdmissionConfig(max_inflight=1, degraded_hold_s=5.0), clock=clk)
+        assert not c.degraded()
+        c.on_request_start("m")
+        with pytest.raises(AdmissionError):
+            c.admit("m")
+        assert c.degraded()
+        clk.advance(4.9)
+        assert c.degraded()
+        clk.advance(0.2)
+        assert not c.degraded()
+
+    def test_record_rejection_feeds_degraded(self):
+        clk = FakeClock()
+        c = AdmissionController(clock=clk)
+        c.record_rejection("m", reason="draining")
+        assert c.rejection_count == 1
+        assert c.degraded()
+
+    def test_ewma_smooths_service_time(self):
+        c = AdmissionController()
+        c.on_request_start("m")
+        c.on_request_end("m", service_s=1.0)
+        assert c.estimated_service_s("m") == pytest.approx(1.0)
+        c.on_request_start("m")
+        c.on_request_end("m", service_s=2.0)
+        # alpha=0.15: 1.0 + 0.15*(2.0-1.0)
+        assert c.estimated_service_s("m") == pytest.approx(1.15)
+
+    def test_retry_after_clipped(self):
+        err = AdmissionError("x", retry_after_s=10_000.0)
+        assert err.retry_after_s == MAX_RETRY_AFTER_S
+        err = AdmissionError("x", retry_after_s=0.0)
+        assert err.retry_after_s == MIN_RETRY_AFTER_S
+
+
+class TestDeadlineHelpers:
+    def test_set_and_expire(self):
+        req = InferRequest(model_name="m", inputs={})
+        assert req.deadline_ns == 0
+        assert not req.deadline_expired()
+        assert req.deadline_remaining_s() is None
+        req.set_deadline_from_timeout_ms(10_000)
+        assert not req.deadline_expired()
+        assert 9.0 < req.deadline_remaining_s() <= 10.0
+        req.deadline_ns = now_ns() - 1
+        assert req.deadline_expired()
+        assert req.deadline_remaining_s() <= 0
+
+    def test_non_positive_timeout_sets_nothing(self):
+        req = InferRequest(model_name="m", inputs={})
+        req.set_deadline_from_timeout_ms(0)
+        req.set_deadline_from_timeout_ms(-5)
+        assert req.deadline_ns == 0
+
+    def test_deadline_expired_is_status_504(self):
+        exc = DeadlineExpired("late")
+        assert isinstance(exc, EngineError)
+        assert exc.status == 504
+
+
+class TestRetryPolicyPushback:
+    def test_pushback_overrides_backoff(self):
+        p = RetryPolicy(max_attempts=3, initial_backoff_s=0.001,
+                        max_backoff_s=0.002, seed=1)
+        assert p.backoff_s(1, retry_after_s=0.7) == pytest.approx(0.7)
+
+    def test_pushback_clipped_to_remaining_budget(self):
+        p = RetryPolicy(seed=1)
+        assert p.backoff_s(1, remaining_s=0.2,
+                           retry_after_s=5.0) == pytest.approx(0.2)
+
+    def test_pushback_makes_any_status_retryable(self):
+        p = RetryPolicy()  # default retryable set: 502/503 only
+        exc = EngineError("shed", 429)
+        assert not p.retryable(exc)
+        exc.retry_after_s = 0.25
+        assert p.retryable(exc)
+
+    def test_retry_after_of_validation(self):
+        exc = EngineError("x", 429)
+        assert retry_after_of(exc) is None
+        exc.retry_after_s = "0.5"
+        assert retry_after_of(exc) == pytest.approx(0.5)
+        exc.retry_after_s = "soon"
+        assert retry_after_of(exc) is None
+        exc.retry_after_s = -1
+        assert retry_after_of(exc) is None
+
+
+def _addsub_inputs(n=1):
+    a = np.zeros((n, 16), np.int32)
+    return {"INPUT0": a, "INPUT1": a}
+
+
+def _blocking_backend(block, running, name="ovl", priority_levels=0):
+    """AddSub whose FIRST apply parks on `block` after signalling
+    `running` — deterministic overload for queue/drain tests."""
+    backend = AddSubBackend(name=name, max_batch_size=4)
+    if priority_levels:
+        backend.config.dynamic_batching = DynamicBatchingConfig(
+            preferred_batch_size=[1], max_queue_delay_microseconds=0,
+            priority_levels=priority_levels, default_priority_level=1,
+            priority_queue_policy={
+                lvl: QueuePolicy() for lvl in range(1, priority_levels + 1)})
+    backend.config.instance_count = 1
+    backend.config.batch_buckets = [1, 4]
+    backend.jittable = False
+    first = {"seen": False}
+
+    def make_apply():
+        def apply(inputs):
+            if not first["seen"]:
+                first["seen"] = True
+                running.set()
+                assert block.wait(60)
+            a, b = inputs["INPUT0"], inputs["INPUT1"]
+            return {"OUTPUT0": a + b, "OUTPUT1": a - b}
+        return apply
+
+    backend.make_apply = make_apply
+    return backend
+
+
+def _blocked_engine(name="ovl", priority_levels=0, **engine_kw):
+    block, running = threading.Event(), threading.Event()
+    repo = ModelRepository()
+    repo.register_backend(_blocking_backend(
+        block, running, name=name, priority_levels=priority_levels))
+    engine = TpuEngine(repo, **engine_kw)
+    return engine, block, running
+
+
+class TestEngineAdmission:
+    def test_engine_shed_surfaces_429_with_pushback(self):
+        engine, block, running = _blocked_engine(
+            admission=AdmissionController(AdmissionConfig(max_inflight=1)))
+        try:
+            engine.async_infer(
+                InferRequest(model_name="ovl", inputs=_addsub_inputs()),
+                lambda resp: None)
+            assert running.wait(30)
+            with pytest.raises(AdmissionError) as ei:
+                engine.infer(InferRequest(model_name="ovl",
+                                          inputs=_addsub_inputs()),
+                             timeout_s=10)
+            assert ei.value.status == 429
+            assert ei.value.retry_after_s >= MIN_RETRY_AFTER_S
+            assert engine.health_state() == "DEGRADED"
+            metrics = engine.prometheus_metrics()
+            assert 'tpu_admission_rejections_total{model="ovl"' in metrics
+            assert 'reason="concurrency"' in metrics
+        finally:
+            block.set()
+            engine.shutdown()
+
+    def test_inflight_accounting_balances(self):
+        engine = TpuEngine(build_repository(["simple"]))
+        try:
+            for _ in range(3):
+                engine.infer(InferRequest(model_name="simple",
+                                          inputs=_addsub_inputs()),
+                             timeout_s=60)
+            assert engine.admission.total_inflight() == 0
+            # A submit-time rejection must unwind its in-flight slot too.
+            faults.configure({"scheduler.enqueue": {
+                "probability": 1.0, "seed": 1, "error_status": 503}})
+            with pytest.raises(EngineError):
+                engine.infer(InferRequest(model_name="simple",
+                                          inputs=_addsub_inputs()),
+                             timeout_s=10)
+            assert engine.admission.total_inflight() == 0
+        finally:
+            engine.shutdown()
+
+    def test_expired_deadline_rejected_at_admission(self):
+        engine = TpuEngine(build_repository(["simple"]))
+        try:
+            req = InferRequest(model_name="simple",
+                               inputs=_addsub_inputs())
+            req.deadline_ns = 1  # long past
+            with pytest.raises(DeadlineExpired) as ei:
+                engine.infer(req, timeout_s=10)
+            assert ei.value.status == 504
+            metrics = engine.prometheus_metrics()
+            assert ('tpu_deadline_expirations_total{model="simple",'
+                    'version="1",stage="admission"}') in metrics
+        finally:
+            engine.shutdown()
+
+    def test_deadline_expires_in_queue_behind_blocker(self):
+        engine, block, running = _blocked_engine()
+        try:
+            engine.async_infer(
+                InferRequest(model_name="ovl", inputs=_addsub_inputs()),
+                lambda resp: None)
+            assert running.wait(30)
+            req = InferRequest(model_name="ovl", inputs=_addsub_inputs())
+            req.set_deadline_from_timeout_ms(50)  # expires while queued
+            threading.Timer(0.3, block.set).start()
+            with pytest.raises(DeadlineExpired) as ei:
+                engine.infer(req, timeout_s=30)
+            assert ei.value.status == 504
+            metrics = engine.prometheus_metrics()
+            assert 'tpu_deadline_expirations_total{model="ovl"' in metrics
+        finally:
+            block.set()
+            engine.shutdown()
+
+
+class TestDequeueFaultSite:
+    def test_site_registered(self):
+        assert "scheduler.dequeue" in faults.SITES
+
+    def test_dequeue_fault_fails_request(self):
+        faults.configure({"scheduler.dequeue": {
+            "probability": 1.0, "seed": 1, "error_status": 503,
+            "max_injections": 1}})
+        engine = TpuEngine(build_repository(["simple"]))
+        try:
+            with pytest.raises(EngineError) as ei:
+                engine.infer(InferRequest(model_name="simple",
+                                          inputs=_addsub_inputs()),
+                             timeout_s=60)
+            assert ei.value.status == 503
+            # Budget spent: the next request executes normally.
+            resp = engine.infer(InferRequest(model_name="simple",
+                                             inputs=_addsub_inputs()),
+                                timeout_s=60)
+            assert resp.error is None
+            metrics = engine.prometheus_metrics()
+            assert ('tpu_fault_injections_total{site="scheduler.dequeue",'
+                    'kind="error"}') in metrics
+        finally:
+            engine.shutdown()
+
+
+class TestSubmitRejectDetail:
+    def test_queue_full_error_reports_depth_and_level(self):
+        engine, block, running = _blocked_engine(priority_levels=2)
+        sched = engine.schedulers()[0]
+        sched.model.config.dynamic_batching.priority_queue_policy[2] = \
+            QueuePolicy(max_queue_size=1)
+        try:
+            engine.async_infer(
+                InferRequest(model_name="ovl", inputs=_addsub_inputs()),
+                lambda resp: None)
+            assert running.wait(30)
+            engine.async_infer(
+                InferRequest(model_name="ovl", priority=2,
+                             inputs=_addsub_inputs()),
+                lambda resp: None)  # fills the single level-2 slot
+            with pytest.raises(EngineError, match="maximum queue size") as ei:
+                engine.infer(InferRequest(model_name="ovl", priority=2,
+                                          inputs=_addsub_inputs()),
+                             timeout_s=10)
+            msg = str(ei.value)
+            assert "priority level 2" in msg
+            assert "queue depth 1" in msg
+            assert ei.value.status == 429
+        finally:
+            block.set()
+            engine.shutdown()
+
+
+class TestSchedulerStopDrain:
+    """Scheduler.stop() under load: heap order pops queued real requests
+    ahead of the shutdown sentinels, so every admitted request resolves
+    deterministically — completed when the worker drains them, failed
+    with 503 when stop()'s bounded wait expires first."""
+
+    def test_stop_drains_queued_multi_priority(self):
+        engine, block, running = _blocked_engine(priority_levels=3)
+        responses = []
+        done = threading.Event()
+        total = 7  # 1 blocker + 6 queued across levels
+
+        def cb(resp):
+            responses.append(resp)
+            if len(responses) == total and resp.final:
+                done.set()
+
+        try:
+            engine.async_infer(
+                InferRequest(model_name="ovl", inputs=_addsub_inputs()),
+                cb)
+            assert running.wait(30)
+            for i in range(6):
+                engine.async_infer(
+                    InferRequest(model_name="ovl",
+                                 priority=(i % 3) + 1,
+                                 inputs=_addsub_inputs()),
+                    cb)
+            block.set()
+            # stop() drains: the worker pops all six real requests (all
+            # levels) before any sentinel, so every one completes.
+            engine.schedulers()[0].stop(timeout_s=30)
+            assert done.wait(30)
+            assert len(responses) == total
+            assert all(r.error is None for r in responses)
+        finally:
+            block.set()
+            engine.shutdown()
+
+    def test_stop_timeout_fails_queued_with_503(self):
+        engine, block, running = _blocked_engine()
+        responses = []
+        try:
+            engine.async_infer(
+                InferRequest(model_name="ovl", inputs=_addsub_inputs()),
+                responses.append)
+            assert running.wait(30)
+            for _ in range(3):
+                engine.async_infer(
+                    InferRequest(model_name="ovl",
+                                 inputs=_addsub_inputs()),
+                    responses.append)
+            # The worker is parked on the blocker: stop's bounded wait
+            # expires and the queued requests are failed, not dropped.
+            engine.schedulers()[0].stop(timeout_s=0.2)
+            failed = [r for r in responses if r.error is not None]
+            assert len(failed) == 3
+            assert all(r.error.status == 503 for r in failed)
+        finally:
+            block.set()
+            engine.shutdown()
+
+
+class TestDrainCoordinator:
+    def test_drain_empty_engine_is_clean_and_fast(self):
+        engine = TpuEngine(build_repository(["simple"]))
+        report = drain(engine, deadline_s=5.0)
+        assert report["clean"]
+        assert report["pending"] == 0
+        assert report["drain_s"] < 5.0
+
+    def test_begin_drain_rejects_new_work_with_503(self):
+        engine = TpuEngine(build_repository(["simple"]))
+        try:
+            engine.begin_drain()
+            assert engine.health_state() == "DRAINING"
+            assert not engine.is_ready()
+            assert engine.is_live()
+            with pytest.raises(AdmissionError) as ei:
+                engine.infer(InferRequest(model_name="simple",
+                                          inputs=_addsub_inputs()),
+                             timeout_s=10)
+            assert ei.value.status == 503
+            assert ei.value.retry_after_s > 0
+            metrics = engine.prometheus_metrics()
+            assert 'reason="draining"' in metrics
+        finally:
+            engine.shutdown()
+
+    def test_drain_waits_for_inflight_work(self):
+        engine, block, running = _blocked_engine()
+        got = []
+        engine.async_infer(
+            InferRequest(model_name="ovl", inputs=_addsub_inputs()),
+            got.append)
+        assert running.wait(30)
+        threading.Timer(0.3, block.set).start()
+        t0 = time.monotonic()
+        report = drain(engine, deadline_s=30.0)
+        assert report["clean"]
+        assert time.monotonic() - t0 >= 0.25
+        assert len(got) == 1 and got[0].error is None
+        # Drain wall time lands on the gauge.
+        assert "tpu_drain_duration_seconds" in engine.metrics.render()
+
+    def test_drain_rearms_grpc_stop_past_idle_connections(self):
+        # Real grpc servers hold their termination event open while IDLE
+        # client connections exist (the client channel cache keeps them
+        # alive), firing it only when a stop grace expires. Without the
+        # short-grace re-arm after engine shutdown, any ever-connected
+        # gRPC client stretches every drain to the full deadline.
+        class _StickyGrpcServer:
+            def __init__(self):
+                self.graces = []
+
+            def stop(self, grace):
+                self.graces.append(grace)
+                evt = threading.Event()
+                if grace <= 0.5:  # idle connections outlive long graces
+                    evt.set()
+                return evt
+
+        class _Frontend:
+            server = _StickyGrpcServer()
+
+        engine = TpuEngine(build_repository(["simple"]))
+        t0 = time.monotonic()
+        report = drain(engine, grpc_servers=[_Frontend()], deadline_s=10.0)
+        assert report["clean"]
+        assert time.monotonic() - t0 < 5.0
+        graces = _Frontend.server.graces
+        assert len(graces) == 2 and graces[0] > 1.0 and graces[1] <= 0.5
+
+    def test_drain_deadline_bounds_stuck_work(self):
+        engine, block, running = _blocked_engine()
+        got = []
+        engine.async_infer(
+            InferRequest(model_name="ovl", inputs=_addsub_inputs()),
+            got.append)
+        assert running.wait(30)
+        try:
+            # Never release the blocker: the drain must give up at its
+            # deadline and report the stuck request.
+            report = drain(engine, deadline_s=0.3)
+            assert not report["clean"]
+            assert report["pending"] >= 1
+        finally:
+            block.set()

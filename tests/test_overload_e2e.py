@@ -1,0 +1,409 @@
+"""Overload-protection end-to-end: deadlines, admission, drain.
+
+The acceptance scenario of the overload PR, over real HTTP and gRPC
+servers: under a seeded overload the shed responses carry ``Retry-After``
+pushback that the client's ``RetryPolicy`` honors; requests whose
+end-to-end deadline expires in the queue are failed with 504 /
+DEADLINE_EXCEEDED and provably never reach ``model.execute``; and an
+in-process SIGTERM drains a busy server with zero dropped in-flight
+requests inside the drain deadline.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+from urllib.error import HTTPError
+from urllib.request import urlopen
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+from client_tpu import faults
+from client_tpu.admission import (
+    AdmissionConfig,
+    AdmissionController,
+)
+from client_tpu.admission.drain import install_sigterm_handler
+from client_tpu.engine import InferRequest, TpuEngine
+from client_tpu.models import build_repository
+from client_tpu.models.simple import AddSubBackend
+from client_tpu.observability import scrape
+from client_tpu.resilience import RetryPolicy
+from client_tpu.server import GrpcInferenceServer, HttpInferenceServer
+from client_tpu.utils import InferenceServerException
+
+pytestmark = pytest.mark.chaos
+
+
+class _Gate:
+    """Parks ``slow``-model executions while enabled — deterministic
+    queue buildup for deadline and drain tests."""
+
+    def __init__(self):
+        self.enabled = False
+        self.release = threading.Event()
+        self.running = threading.Event()
+
+    def reset(self):
+        self.enabled = False
+        self.release.set()  # free anything parked on the old event
+        self.release = threading.Event()
+        self.running = threading.Event()
+
+
+def _gated_backend(gate, name="slow"):
+    backend = AddSubBackend(name=name, max_batch_size=4)
+    backend.config.instance_count = 1
+    backend.config.batch_buckets = [1, 4]
+    backend.jittable = False
+
+    def make_apply():
+        def apply(inputs):
+            if gate.enabled:
+                rel = gate.release  # grab before signalling: reset() races
+                gate.running.set()
+                rel.wait(60)
+            a, b = inputs["INPUT0"], inputs["INPUT1"]
+            return {"OUTPUT0": a + b, "OUTPUT1": a - b}
+        return apply
+
+    backend.make_apply = make_apply
+    return backend
+
+
+GATE = _Gate()
+
+
+@pytest.fixture(scope="module")
+def stack():
+    repo = build_repository(["simple"])
+    repo.register_backend(_gated_backend(GATE))
+    eng = TpuEngine(repo)
+    http_srv = HttpInferenceServer(eng, port=0).start()
+    grpc_srv = GrpcInferenceServer(eng, port=0).start()
+    yield {"engine": eng, "http": http_srv,
+           "grpc_url": f"127.0.0.1:{grpc_srv.port}"}
+    faults.reset()
+    http_srv.stop()
+    grpc_srv.stop()
+    eng.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def clean_slate(stack):
+    faults.reset()
+    GATE.reset()
+    yield
+    faults.reset()
+    GATE.reset()
+
+
+@pytest.fixture
+def shed_admission(stack):
+    """Swap in a configured AdmissionController for one test; restore the
+    module default (admit-everything) afterwards."""
+    eng = stack["engine"]
+    orig = eng.admission
+
+    def _install(cfg_dict):
+        eng.admission = AdmissionController(
+            AdmissionConfig.from_dict(cfg_dict), metrics=eng.metrics)
+        return eng.admission
+
+    yield _install
+    eng.admission = orig
+
+
+def _inputs(mod):
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    i0 = mod.InferInput("INPUT0", a.shape, "INT32")
+    i0.set_data_from_numpy(a)
+    i1 = mod.InferInput("INPUT1", b.shape, "INT32")
+    i1.set_data_from_numpy(b)
+    return a, b, [i0, i1]
+
+
+# Token rate 5/s with burst 1: the first request drains the bucket; each
+# subsequent one is shed with Retry-After ~0.2s until the bucket refills.
+THROTTLE_CFG = {"models": {"simple": {"tokens_per_s": 5.0, "burst": 1.0}}}
+
+
+class TestRetryAfterHttp:
+    def test_shed_response_carries_retry_after(self, stack, shed_admission):
+        shed_admission(THROTTLE_CFG)
+        c = httpclient.InferenceServerClient(stack["http"].url)
+        try:
+            _, _, inputs = _inputs(httpclient)
+            c.infer("simple", inputs)  # drains the burst
+            with pytest.raises(InferenceServerException) as ei:
+                c.infer("simple", inputs)
+            assert ei.value.status() == 429
+            pushback = getattr(ei.value, "retry_after_s", None)
+            assert pushback is not None
+            assert 0 < pushback <= 0.25
+        finally:
+            c.close()
+
+    def test_retry_policy_honors_pushback(self, stack, shed_admission):
+        """Backoff floor is ~1ms: convergence within the bucket's ~200ms
+        refill proves the client slept on the server's Retry-After, not
+        its own (exhausted-in-6ms) exponential schedule."""
+        shed_admission(THROTTLE_CFG)
+        c = httpclient.InferenceServerClient(
+            stack["http"].url,
+            retry_policy=RetryPolicy(max_attempts=4, initial_backoff_s=0.001,
+                                     max_backoff_s=0.002, seed=7))
+        try:
+            a, b, inputs = _inputs(httpclient)
+            t0 = time.monotonic()
+            for _ in range(3):
+                r = c.infer("simple", inputs)
+                assert np.array_equal(r.as_numpy("OUTPUT0"), a + b)
+            elapsed = time.monotonic() - t0
+            stat = c.get_infer_stat()
+        finally:
+            c.close()
+        assert stat["completed_request_count"] == 3
+        assert stat["retry_count"] >= 2
+        # Two pushback waits of ~0.2s each; far beyond the jitter budget.
+        assert elapsed >= 0.3
+        metrics = stack["engine"].prometheus_metrics()
+        assert ('tpu_admission_rejections_total{model="simple",'
+                'version="latest",reason="throttled"}') in metrics
+
+    def test_ready_endpoint_reports_degraded_after_shed(
+            self, stack, shed_admission):
+        adm = shed_admission(THROTTLE_CFG)
+        adm.record_rejection("simple", reason="shed")
+        resp = urlopen(f"http://{stack['http'].url}/v2/health/ready",
+                       timeout=10)
+        assert resp.status == 200  # degraded still serves
+        assert resp.headers["X-Health-State"] == "DEGRADED"
+        assert json.loads(resp.read())["state"] == "DEGRADED"
+
+
+class TestRetryAfterGrpc:
+    def test_shed_response_carries_retry_after(self, stack, shed_admission):
+        shed_admission(THROTTLE_CFG)
+        c = grpcclient.InferenceServerClient(stack["grpc_url"])
+        try:
+            _, _, inputs = _inputs(grpcclient)
+            c.infer("simple", inputs)
+            with pytest.raises(InferenceServerException) as ei:
+                c.infer("simple", inputs)
+            # 429 travels as RESOURCE_EXHAUSTED with retry-after trailing
+            # metadata, surfaced on the exception.
+            assert "RESOURCE_EXHAUSTED" in str(ei.value.status())
+            pushback = getattr(ei.value, "retry_after_s", None)
+            assert pushback is not None
+            assert 0 < pushback <= 0.25
+        finally:
+            c.close()
+
+    def test_retry_policy_honors_pushback(self, stack, shed_admission):
+        shed_admission(THROTTLE_CFG)
+        c = grpcclient.InferenceServerClient(
+            stack["grpc_url"],
+            retry_policy=RetryPolicy(max_attempts=4, initial_backoff_s=0.001,
+                                     max_backoff_s=0.002, seed=7))
+        try:
+            a, b, inputs = _inputs(grpcclient)
+            t0 = time.monotonic()
+            for _ in range(3):
+                r = c.infer("simple", inputs)
+                assert np.array_equal(r.as_numpy("OUTPUT0"), a + b)
+            elapsed = time.monotonic() - t0
+            stat = c.get_infer_stat()
+        finally:
+            c.close()
+        assert stat["completed_request_count"] == 3
+        assert stat["retry_count"] >= 2
+        assert elapsed >= 0.3
+
+
+class TestDeadlineE2e:
+    """A request whose budget expires while queued behind a blocker must
+    fail 504 / DEADLINE_EXCEEDED without ever reaching model.execute.
+    Proof: while it is queued, the model.execute fault site is armed at
+    probability 1.0 — had the request reached execution it would have
+    come back 503 FaultInjected, not 504."""
+
+    def test_http_expired_in_queue_never_executes(self, stack):
+        eng = stack["engine"]
+        GATE.enabled = True
+        blocker_done = []
+        eng.async_infer(
+            InferRequest(model_name="slow", inputs={
+                "INPUT0": np.zeros((1, 16), np.int32),
+                "INPUT1": np.zeros((1, 16), np.int32)}),
+            blocker_done.append)
+        assert GATE.running.wait(30)
+        # Blocker is inside apply (past the fault site); arm the tripwire.
+        faults.configure({"model.execute": {
+            "probability": 1.0, "seed": 1, "error_status": 503}})
+        c = httpclient.InferenceServerClient(stack["http"].url)
+        try:
+            _, _, inputs = _inputs(httpclient)
+            threading.Timer(0.5, GATE.release.set).start()
+            with pytest.raises(InferenceServerException) as ei:
+                c.infer("slow", inputs, timeout_ms=100)
+            assert ei.value.status() == 504
+            assert "deadline" in str(ei.value).lower()
+        finally:
+            c.close()
+        metrics = eng.prometheus_metrics()
+        assert ('tpu_deadline_expirations_total{model="slow",version="1",'
+                'stage="queue"}') in metrics
+        # The tripwire never fired: the expired request was cut at dequeue.
+        assert 'site="model.execute"' not in metrics
+        assert len(blocker_done) == 1 and blocker_done[0].error is None
+
+    def _slow_expirations(self, eng):
+        return sum(
+            v for n, labels, v in
+            scrape.parse_samples(eng.prometheus_metrics())
+            if n == "tpu_deadline_expirations_total"
+            and labels.get("model") == "slow")
+
+    def test_grpc_timeout_ms_param_expires_in_queue(self, stack):
+        """The `timeout_ms` request parameter carries the budget (the
+        mid-stream form, where per-RPC deadlines can't); the client keeps
+        waiting, so the server's own dequeue check must cut the request."""
+        eng = stack["engine"]
+        queue_before = self._slow_expirations(eng)
+        GATE.enabled = True
+        eng.async_infer(
+            InferRequest(model_name="slow", inputs={
+                "INPUT0": np.zeros((1, 16), np.int32),
+                "INPUT1": np.zeros((1, 16), np.int32)}),
+            lambda resp: None)
+        assert GATE.running.wait(30)
+        faults.configure({"model.execute": {
+            "probability": 1.0, "seed": 1, "error_status": 503}})
+        c = grpcclient.InferenceServerClient(stack["grpc_url"])
+        try:
+            _, _, inputs = _inputs(grpcclient)
+            threading.Timer(0.5, GATE.release.set).start()
+            with pytest.raises(InferenceServerException) as ei:
+                c.infer("slow", inputs, parameters={"timeout_ms": 100})
+            assert "DEADLINE_EXCEEDED" in str(ei.value.status())
+        finally:
+            c.close()
+        assert self._slow_expirations(eng) > queue_before
+        assert 'site="model.execute"' not in eng.prometheus_metrics()
+
+    def test_grpc_rpc_deadline_cancels_queued_work(self, stack):
+        """A true per-RPC deadline: the client cuts at 0.3s and the RPC
+        termination callback cancels the queued request, so it is skipped
+        at dequeue — either way it must never reach model.execute."""
+        eng = stack["engine"]
+        GATE.enabled = True
+        eng.async_infer(
+            InferRequest(model_name="slow", inputs={
+                "INPUT0": np.zeros((1, 16), np.int32),
+                "INPUT1": np.zeros((1, 16), np.int32)}),
+            lambda resp: None)
+        assert GATE.running.wait(30)
+        faults.configure({"model.execute": {
+            "probability": 1.0, "seed": 1, "error_status": 503}})
+        c = grpcclient.InferenceServerClient(stack["grpc_url"])
+        try:
+            _, _, inputs = _inputs(grpcclient)
+            threading.Timer(0.6, GATE.release.set).start()
+            with pytest.raises(InferenceServerException) as ei:
+                c.infer("slow", inputs, client_timeout=0.3)
+            assert "DEADLINE_EXCEEDED" in str(ei.value.status())
+        finally:
+            c.close()
+        # Wait for the scheduler to work through the abandoned request,
+        # then confirm the execute tripwire never fired.
+        deadline = time.monotonic() + 10
+        while (eng.admission.total_inflight() > 0
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert eng.admission.total_inflight() == 0
+        assert 'site="model.execute"' not in eng.prometheus_metrics()
+
+
+class TestHealthDraining:
+    def test_ready_endpoint_flips_503_when_draining(self, stack):
+        eng = stack["engine"]
+        url = f"http://{stack['http'].url}/v2/health/ready"
+        resp = urlopen(url, timeout=10)
+        assert resp.status == 200
+        assert resp.headers["X-Health-State"] == "READY"
+        eng.begin_drain()
+        try:
+            with pytest.raises(HTTPError) as ei:
+                urlopen(url, timeout=10)
+            assert ei.value.code == 503
+            assert ei.value.headers["X-Health-State"] == "DRAINING"
+            assert json.loads(ei.value.read())["state"] == "DRAINING"
+        finally:
+            eng._draining = False  # restore the shared module stack
+
+
+class TestSigtermDrain:
+    """In-process SIGTERM against a busy server: readiness flips, new work
+    is refused, and every admitted request completes inside the drain
+    deadline — zero dropped."""
+
+    def test_sigterm_drains_busy_server_zero_dropped(self):
+        gate = _Gate()
+        gate.enabled = True
+        repo = build_repository(["simple"])
+        repo.register_backend(_gated_backend(gate))
+        eng = TpuEngine(repo)
+        http_srv = HttpInferenceServer(eng, port=0).start()
+        grpc_srv = GrpcInferenceServer(eng, port=0).start()
+        prev_handler = signal.getsignal(signal.SIGTERM)
+        c = httpclient.InferenceServerClient(http_srv.url, concurrency=4)
+        try:
+            a, b, inputs = _inputs(httpclient)
+            pending = [c.async_infer("slow", inputs) for _ in range(4)]
+            assert gate.running.wait(30)
+            # All four admitted (1 executing + 3 queued) before the signal.
+            deadline = time.monotonic() + 10
+            while (eng.admission.total_inflight() < 4
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert eng.admission.total_inflight() == 4
+
+            drained = install_sigterm_handler(
+                eng, http_servers=[http_srv], grpc_servers=[grpc_srv],
+                deadline_s=20.0)
+
+            def _unblock():
+                gate.enabled = False
+                gate.release.set()
+
+            threading.Timer(0.4, _unblock).start()
+            t0 = time.monotonic()
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert drained.wait(30), "drain never completed"
+            drain_wall_s = time.monotonic() - t0
+
+            # Zero dropped: every in-flight request completed normally.
+            for req in pending:
+                r = req.get_result(timeout=30)
+                assert np.array_equal(r.as_numpy("OUTPUT0"), a + b)
+            assert drain_wall_s < 20.0
+            assert not eng.is_ready()
+            assert eng.health_state() == "DRAINING"
+            assert eng.admission.total_inflight() == 0
+            samples = scrape.parse_samples(eng.prometheus_metrics())
+            gauge = [v for n, labels, v in samples
+                     if n == "tpu_drain_duration_seconds"]
+            assert gauge and gauge[0] > 0
+        finally:
+            signal.signal(signal.SIGTERM, prev_handler)
+            gate.reset()
+            c.close()
+            http_srv.stop()
+            grpc_srv.stop()
+            eng.shutdown()
