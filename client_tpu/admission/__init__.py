@@ -225,6 +225,9 @@ class AdmissionController:
         self._lock = threading.Lock()
         self._gates: dict[str, _ModelGate] = {}
         self._last_shed = 0.0
+        # True between the first shed and the hold-window expiry observed
+        # by degraded(); drives degraded_enter/degraded_exit events.
+        self._degraded_state = False
         self.rejection_count = 0
 
     @classmethod
@@ -243,10 +246,13 @@ class AdmissionController:
     # -- the admission decision ---------------------------------------------
 
     def admit(self, model: str, version: str = "",
-              queue_depth: int = 0, instances: int = 1) -> None:
+              queue_depth: int = 0, instances: int = 1,
+              trace_id: str | None = None) -> None:
         """Admit or shed one request; raises :class:`AdmissionError` on
         shed. ``queue_depth`` is the model's current scheduler backlog and
-        ``instances`` its worker count (for the estimated-wait check)."""
+        ``instances`` its worker count (for the estimated-wait check).
+        ``trace_id`` correlates a shed with the rejected request's trace
+        in the event journal."""
         gate = self._gate(model)
         cfg = gate.cfg
         if cfg.max_inflight > 0 and gate.inflight >= cfg.max_inflight:
@@ -256,19 +262,20 @@ class AdmissionController:
                 f"model '{model}' is at its concurrency cap "
                 f"({gate.inflight}/{cfg.max_inflight} in flight)",
                 retry_after_s=gate.ewma_service_s or MIN_RETRY_AFTER_S,
-                reason="concurrency"))
+                reason="concurrency"), trace_id=trace_id)
         if gate.bucket is not None and not gate.bucket.try_acquire():
             self._reject(model, version, "throttled", AdmissionError(
                 f"model '{model}' request rate exceeds "
                 f"{cfg.tokens_per_s:g}/s (burst {gate.bucket.burst:g})",
                 retry_after_s=gate.bucket.retry_after_s(),
-                reason="throttled"))
+                reason="throttled"), trace_id=trace_id)
         if cfg.max_queue_depth > 0 and queue_depth >= cfg.max_queue_depth:
             est = self._estimated_wait_s(gate, queue_depth, instances)
             self._reject(model, version, "queue_depth", AdmissionError(
                 f"model '{model}' queue depth {queue_depth} is at the "
                 f"shed limit ({cfg.max_queue_depth}); estimated wait "
-                f"{est:.3f}s", retry_after_s=est, reason="queue_depth"))
+                f"{est:.3f}s", retry_after_s=est, reason="queue_depth"),
+                trace_id=trace_id)
         if cfg.max_estimated_wait_s > 0:
             est = self._estimated_wait_s(gate, queue_depth, instances)
             if est > cfg.max_estimated_wait_s:
@@ -279,7 +286,8 @@ class AdmissionController:
                                  f"({cfg.max_estimated_wait_s:g}s)",
                                  retry_after_s=est - cfg.max_estimated_wait_s
                                  + MIN_RETRY_AFTER_S,
-                                 reason="estimated_wait"))
+                                 reason="estimated_wait"),
+                             trace_id=trace_id)
 
     @staticmethod
     def _estimated_wait_s(gate: _ModelGate, queue_depth: int,
@@ -288,27 +296,51 @@ class AdmissionController:
         return queue_depth * service / max(1, instances)
 
     def _reject(self, model: str, version: str, reason: str,
-                exc: AdmissionError):
-        with self._lock:
-            self.rejection_count += 1
-            self._last_shed = self._clock()
-        if self._metrics is not None:
-            self._metrics.admission_rejections.inc(
-                model=model, version=str(version or "latest"),
-                reason=reason)
+                exc: AdmissionError, trace_id: str | None = None):
+        self._count_shed(model, version, reason,
+                         retry_after_s=exc.retry_after_s,
+                         trace_id=trace_id)
         raise exc
 
     def record_rejection(self, model: str, version: str = "",
-                         reason: str = "draining") -> None:
+                         reason: str = "draining",
+                         trace_id: str | None = None) -> None:
         """Count a shed decided outside :meth:`admit` (e.g. the engine's
         drain gate) on the same counter and DEGRADED clock."""
+        self._count_shed(model, version, reason, trace_id=trace_id)
+
+    def _count_shed(self, model: str, version: str, reason: str,
+                    retry_after_s: float | None = None,
+                    trace_id: str | None = None) -> None:
         with self._lock:
             self.rejection_count += 1
             self._last_shed = self._clock()
+            entered = not self._degraded_state
+            self._degraded_state = True
         if self._metrics is not None:
             self._metrics.admission_rejections.inc(
                 model=model, version=str(version or "latest"),
                 reason=reason)
+        jour = self._journal()
+        if jour is not None:
+            detail = {"reason": reason}
+            if retry_after_s is not None:
+                detail["retry_after_s"] = round(retry_after_s, 4)
+            jour.emit("admission", "shed", severity="WARNING",
+                      model=model, version=version or None,
+                      trace_id=trace_id, **detail)
+            if entered:
+                jour.emit("admission", "degraded_enter",
+                          severity="WARNING", model=model,
+                          version=version or None, trace_id=trace_id,
+                          hold_s=self.config.degraded_hold_s)
+
+    def _journal(self):
+        """The process-global event journal (lazy: admission is imported
+        by engine.types consumers that never serve)."""
+        from client_tpu.observability.events import journal
+
+        return journal()
 
     # -- lifetime accounting -------------------------------------------------
 
@@ -346,8 +378,18 @@ class AdmissionController:
     def degraded(self) -> bool:
         """True while the controller shed recently (within
         ``degraded_hold_s``): the engine reports DEGRADED so balancers
-        deprioritize the instance while it is actively overloaded."""
+        deprioritize the instance while it is actively overloaded. The
+        enter/exit edges land in the event journal as
+        ``admission.degraded_enter`` / ``admission.degraded_exit``."""
         with self._lock:
             last = self._last_shed
-        return bool(last) and (self._clock() - last
-                               < self.config.degraded_hold_s)
+            now_degraded = bool(last) and (
+                self._clock() - last < self.config.degraded_hold_s)
+            exited = self._degraded_state and not now_degraded
+            self._degraded_state = now_degraded
+        if exited:
+            jour = self._journal()
+            if jour is not None:
+                jour.emit("admission", "degraded_exit",
+                          hold_s=self.config.degraded_hold_s)
+        return now_degraded

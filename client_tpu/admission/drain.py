@@ -57,9 +57,15 @@ def drain(engine, http_servers=(), grpc_servers=(),
     admitted request finished inside the deadline (``pending`` is what
     remained when the deadline forced shutdown — those requests get 503
     responses from ``Scheduler.stop()``, not severed connections)."""
+    from client_tpu.observability.events import journal
+
+    jour = journal()
     start = time.monotonic()
     deadline = start + max(0.0, deadline_s)
     engine.begin_drain()
+    jour.emit("drain", "begin", deadline_s=deadline_s,
+              http_frontends=len(http_servers),
+              grpc_frontends=len(grpc_servers))
     # Stop accepting new work. HTTP: the accept loop ends (threads serving
     # accepted connections run on; their new requests hit the drain gate).
     # gRPC: new RPCs are rejected immediately; in-flight ones get the
@@ -114,6 +120,10 @@ def drain(engine, http_servers=(), grpc_servers=(),
         metrics.drain_duration.set(drain_s)
     _log.info("drain complete in %.3fs (clean=%s, pending=%d)",
               drain_s, pending == 0, pending)
+    jour.emit("drain", "end",
+              severity="INFO" if pending == 0 else "WARNING",
+              drain_s=round(drain_s, 4), clean=pending == 0,
+              pending=pending)
     return {"drain_s": drain_s, "clean": pending == 0, "pending": pending}
 
 

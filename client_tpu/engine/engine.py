@@ -83,6 +83,15 @@ class TpuEngine:
 
         self.faults = _faults.registry()
         self.faults.bind_metrics(self.metrics.registry)
+        # Operational event journal (process-global, like the fault
+        # registry) and the per-model SLO tracker (CLIENT_TPU_SLO; off by
+        # default). SLO burn gauges live on this engine's registry.
+        from client_tpu.observability.events import journal
+        from client_tpu.observability.slo import SloTracker
+
+        self.events = journal()
+        self.slo = SloTracker.from_env(registry=self.metrics.registry)
+        self._last_health: str | None = None
         # Admission controller: load shedding + in-flight accounting. The
         # default (CLIENT_TPU_ADMISSION unset) admits everything but still
         # counts in-flight requests — the drain coordinator depends on
@@ -97,6 +106,10 @@ class TpuEngine:
             self.admission._metrics = self.metrics
         self.request_traces = TraceStore(
             capacity=int(os.environ.get("CLIENT_TPU_TRACE_BUFFER", "512")))
+        self.events.emit(
+            "lifecycle", "server_start",
+            models=len(self.repository.names()),
+            slo_enabled=self.slo.enabled)
         if load_all:
             for name in self.repository.names():
                 try:
@@ -117,13 +130,30 @@ class TpuEngine:
     def health_state(self) -> str:
         """Readiness with nuance (surfaced via ``/v2/health/ready``):
         READY — serving normally; DEGRADED — serving, but the admission
-        controller shed recently (balancers should deprioritize);
-        DRAINING — refusing new work while in-flight requests finish."""
+        controller shed recently (balancers should deprioritize) or a
+        model is fast-burning its SLO error budget; DRAINING — refusing
+        new work while in-flight requests finish."""
+        fast_burn: list[str] = []
         if self._draining or not self._live:
-            return "DRAINING"
-        if self.admission.degraded():
-            return "DEGRADED"
-        return "READY"
+            state = "DRAINING"
+        elif self.admission.degraded():
+            state = "DEGRADED"
+        else:
+            fast_burn = self.slo.fast_burn()
+            state = "DEGRADED" if fast_burn else "READY"
+        prev = self._last_health
+        if state != prev:
+            self._last_health = state
+            detail = {"state": state}
+            if prev is not None:
+                detail["previous"] = prev
+            if fast_burn:
+                detail["slo_fast_burn"] = fast_burn
+            self.events.emit(
+                "lifecycle", "health",
+                severity="INFO" if state == "READY" else "WARNING",
+                **detail)
+        return state
 
     def begin_drain(self) -> None:
         """Flip readiness off and start rejecting new submissions with
@@ -220,7 +250,8 @@ class TpuEngine:
                     stats = ModelStats(
                         name, str(v),
                         instruments=self.metrics.model_instruments(
-                            name, str(v)))
+                            name, str(v)),
+                        slo=self.slo, events=self.events)
                     self._stats[key] = stats
                 self._schedulers[key] = make_scheduler(
                     model, stats,
@@ -244,6 +275,9 @@ class TpuEngine:
         for sched in retired:
             if id(sched) not in still_referenced:
                 sched.stop()
+        for model in new_models:
+            self.events.emit("model", "load", model=name,
+                             version=model.config.version)
         if self._warmup:
             for model in new_models:
                 model.warmup()
@@ -266,6 +300,10 @@ class TpuEngine:
             if id(sched) not in seen:
                 seen.add(id(sched))
                 sched.stop()
+        versions = sorted(k.rsplit(":", 1)[1] for k in keys if ":" in k)
+        if popped:
+            self.events.emit("model", "unload", model=name,
+                             versions=versions)
         self.repository.unload(name)
         for dep in dependents:
             if dep != name and not self._referenced_by_loaded_ensemble(dep):
@@ -342,21 +380,25 @@ class TpuEngine:
         # so sync and async frontends translate them on one path) ----------
         from client_tpu.admission import AdmissionError
 
+        trace_id = req.trace.trace_id if req.trace is not None else None
         if self._draining or not self._live:
             self.admission.record_rejection(
-                req.model_name, req.model_version, reason="draining")
+                req.model_name, req.model_version, reason="draining",
+                trace_id=trace_id)
             raise AdmissionError(
                 "server is draining; retry against another replica",
                 retry_after_s=1.0, reason="draining", status=503)
         if req.deadline_expired():
             # The client's end-to-end budget lapsed in transit/parse:
             # reject before it costs a queue slot.
-            sched.stats.record_deadline_expired("admission")
+            sched.stats.record_deadline_expired("admission",
+                                                trace_id=trace_id)
             raise DeadlineExpired(
                 "end-to-end deadline expired before admission")
         self.admission.admit(
             req.model_name, req.model_version,
-            queue_depth=sched.queue.qsize(), instances=len(sched.workers))
+            queue_depth=sched.queue.qsize(), instances=len(sched.workers),
+            trace_id=trace_id)
         self._submit_accounted(sched, req)
 
     def _submit_accounted(self, sched: Scheduler, req: InferRequest) -> None:
@@ -493,13 +535,20 @@ class TpuEngine:
         raise EngineError(
             f"shared memory region '{region}' not registered", 400)
 
-    def prometheus_metrics(self) -> str:
+    def prometheus_metrics(self, openmetrics: bool = False) -> str:
         """Prometheus text exposition of the per-model statistics — the
         equivalent of the metrics endpoint the Triton *server* exposes
         (the reference client stack consumes server stats; here the engine
         IS the server, so it exports both the statistics RPC and this).
         Metric names mirror Triton's nv_inference_* vocabulary with a
-        tpu_ prefix."""
+        tpu_ prefix.
+
+        ``openmetrics=True`` (``Accept: application/openmetrics-text``)
+        emits OpenMetrics 1.0 from the histogram/gauge registry only —
+        counter ``_total`` naming, bucket exemplars linking to
+        ``/v2/trace/requests``, terminal ``# EOF``. The legacy cumulative
+        tpu_inference_* block is 0.0.4-only (its counter names don't meet
+        OpenMetrics naming rules; the registry carries the same signal)."""
         stats = self.model_statistics()["model_stats"]
         lines: list[str] = []
 
@@ -558,7 +607,27 @@ class TpuEngine:
                 getattr(sched, "active_batches", 0),
                 model=model_name, version=version)
         self.metrics.update_device_gauges()
+        # Refresh SLO burn gauges at scrape time so a quiet period still
+        # reads current windows.
+        if self.slo.enabled:
+            self.slo.snapshot()
+        if openmetrics:
+            return self.metrics.render(openmetrics=True)
         return "\n".join(lines) + "\n" + self.metrics.render()
+
+    # -- events / SLO ---------------------------------------------------------
+
+    def events_export(self, *, model=None, severity=None, since_seq=None,
+                      since_ts=None, category=None, limit=None) -> dict:
+        """``GET /v2/events`` body: the journal filtered by model /
+        minimum severity / exclusive since cursors / category."""
+        return self.events.export(
+            model=model, severity=severity, since_seq=since_seq,
+            since_ts=since_ts, category=category, limit=limit)
+
+    def slo_snapshot(self) -> dict:
+        """``GET /v2/slo`` body: per-model window counts and burn rates."""
+        return self.slo.snapshot()
 
     # -- trace (device profiling) --------------------------------------------
 
@@ -578,6 +647,9 @@ class TpuEngine:
     # -- lifecycle -----------------------------------------------------------
 
     def shutdown(self) -> None:
+        if self._live:
+            self.events.emit("lifecycle", "server_shutdown",
+                             draining=self._draining)
         self._live = False
         if getattr(self, "trace", None) is not None:
             self.trace.shutdown()

@@ -347,9 +347,14 @@ class Scheduler:
                     "response callback raised (model '%s')",
                     self.model.config.name)
 
+    @staticmethod
+    def _trace_id(req: InferRequest):
+        return req.trace.trace_id if req.trace is not None else None
+
     def _fail(self, req: InferRequest, exc: Exception) -> None:
         req.times.compute_output_end = now_ns()
-        self.stats.record_request(req.times, success=False)
+        self.stats.record_request(req.times, success=False,
+                                  trace_id=self._trace_id(req))
         self._respond(req, InferResponse.make_error(req, exc))
 
     def _check_cancelled(self, req: InferRequest) -> bool:
@@ -369,7 +374,8 @@ class Scheduler:
         on tpu_deadline_expirations_total (queue | execute)."""
         if req.deadline_expired():
             waited_ms = (now_ns() - req.times.queue_start) / 1e6
-            self.stats.record_deadline_expired(stage)
+            self.stats.record_deadline_expired(
+                stage, trace_id=self._trace_id(req))
             self._fail(req, DeadlineExpired(
                 f"end-to-end deadline expired before {stage} "
                 f"(waited {waited_ms:.1f}ms in queue)"))
@@ -454,7 +460,8 @@ class DefaultScheduler(Scheduler):
                 # batch's budget lapsed between the filter above and device
                 # dispatch (the race window the model-level check closes).
                 for r in batch:
-                    self.stats.record_deadline_expired("execute")
+                    self.stats.record_deadline_expired(
+                        "execute", trace_id=self._trace_id(r))
                     self._fail(r, exc)
             except Exception as exc:  # noqa: BLE001 — isolate worker
                 for r in batch:
@@ -589,7 +596,8 @@ class DefaultScheduler(Scheduler):
         if req.outputs:
             requested = {o.name for o in req.outputs}
             outputs = {k: v for k, v in outputs.items() if k in requested}
-        self.stats.record_request(req.times, success=True)
+        self.stats.record_request(req.times, success=True,
+                                  trace_id=self._trace_id(req))
         self._respond(
             req,
             InferResponse(
@@ -664,7 +672,8 @@ class DecoupledScheduler(Scheduler):
         req.times.compute_output_end = req.times.compute_infer_end
         self.stats.record_execution(max(1, count),
                                     compute_ns=req.times.compute_infer_ns)
-        self.stats.record_request(req.times, success=True)
+        self.stats.record_request(req.times, success=True,
+                                  trace_id=self._trace_id(req))
         self._emit(req, {}, final=True)
 
     def _emit(self, req: InferRequest, outputs: dict, final: bool) -> None:

@@ -59,10 +59,17 @@ class ModelStats:
     # Optional observability hook (metrics.ModelInstruments); None for
     # stats objects created outside an engine (unit tests, tools).
     instruments: object | None = field(default=None, repr=False)
+    # Optional SLO hook (slo.SloTracker); record_request feeds it so
+    # every finally-responded request scores the availability/latency
+    # objectives from one funnel.
+    slo: object | None = field(default=None, repr=False)
+    # Optional event journal (events.EventJournal) for deadline.expired.
+    events: object | None = field(default=None, repr=False)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def record_request(self, times: RequestTimes, success: bool,
-                       total_ns: int | None = None) -> None:
+                       total_ns: int | None = None,
+                       trace_id: str | None = None) -> None:
         with self._lock:
             total = total_ns if total_ns is not None else (
                 times.compute_output_end - times.queue_start)
@@ -77,7 +84,11 @@ class ModelStats:
             else:
                 self.fail.add(max(0, total))
         if success and self.instruments is not None:
-            self.instruments.observe_request(max(0, total), times)
+            self.instruments.observe_request(max(0, total), times,
+                                             trace_id=trace_id)
+        if self.slo is not None:
+            self.slo.record(self.model_name, success,
+                            duration_us=max(0, total) / 1e3)
 
     def record_execution(self, batch_size: int, compute_ns: int = 0) -> None:
         """One device execution of ``batch_size`` requests taking
@@ -104,14 +115,20 @@ class ModelStats:
         if self.instruments is not None:
             self.instruments.record_rejection()
 
-    def record_deadline_expired(self, stage: str = "queue") -> None:
+    def record_deadline_expired(self, stage: str = "queue",
+                                trace_id: str | None = None) -> None:
         """An end-to-end deadline passed before `stage` ran (exported as
         tpu_deadline_expirations_total{stage} when instruments are
-        attached)."""
+        attached; journalled as deadline.expired when events are)."""
         with self._lock:
             self.deadline_expired_count += 1
         if self.instruments is not None:
             self.instruments.record_deadline_expired(stage)
+        if self.events is not None:
+            self.events.emit(
+                "deadline", "expired", severity="WARNING",
+                model=self.model_name, version=self.model_version,
+                trace_id=trace_id, stage=stage)
 
     def to_dict(self) -> dict:
         """v2 `GET /v2/models/<m>/stats` entry."""

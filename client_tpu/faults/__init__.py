@@ -188,6 +188,12 @@ class FaultRegistry:
                     counters.append(c)
         for c in counters:
             c.inc(site=site, kind=kind)
+        # Journal the injection so chaos timelines interleave faults with
+        # the breaker/admission/drain transitions they cause. Imported
+        # lazily: observability must stay importable without faults.
+        from client_tpu.observability.events import journal
+
+        journal().emit("fault", "injected", site=site, kind=kind)
 
     def counts(self) -> dict:
         with self._lock:

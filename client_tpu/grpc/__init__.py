@@ -13,6 +13,7 @@ by URL (grpc_client.cc:48-123) and request-proto reuse across calls
 from __future__ import annotations
 
 import base64
+import json
 import queue
 import threading
 import time
@@ -400,7 +401,8 @@ class InferenceServerClient:
     def _md(headers):
         return list(headers.items()) if headers else None
 
-    def _unary(self, rpc, request, metadata, client_timeout, **rpc_kwargs):
+    def _unary(self, rpc, request, metadata, client_timeout, trace_id=None,
+               **rpc_kwargs):
         """One unary RPC under the configured retry/breaker/deadline.
         With a retry policy, ``client_timeout`` is the total budget across
         attempts and each attempt's RPC deadline is the remaining slice."""
@@ -424,7 +426,8 @@ class InferenceServerClient:
                         if self._retry_policy is not None else None),
             host=self._breaker_host,
             on_retry=lambda n, exc, delay: self._stats.record_retry(),
-            on_breaker_reject=self._stats.record_breaker_rejection)
+            on_breaker_reject=self._stats.record_breaker_rejection,
+            trace_id=trace_id)
 
     def _call(self, method, request, headers=None, as_json=False,
               client_timeout=None):
@@ -507,6 +510,48 @@ class InferenceServerClient:
             pb.ModelStatisticsRequest(name=model_name,
                                       version=model_version),
             headers, as_json, client_timeout)
+
+    def get_events(self, model_name="", severity="", category="",
+                   since_seq=None, limit=None, headers=None,
+                   client_timeout=None):
+        """Structured event journal (gRPC mirror of ``GET /v2/events``).
+        Returns the same dict shape as the HTTP endpoint: ``events`` (each
+        with its ``detail`` decoded from JSON), ``next_seq``, ``dropped``."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        request = ops.EventsRequest(
+            model=model_name, severity=severity, category=category,
+            since_seq=int(since_seq) if since_seq else 0,
+            limit=int(limit) if limit else 0)
+        response = self._unary(self._client_stub.Events, request,
+                               self._md(headers), client_timeout)
+        events = []
+        for e in response.events:
+            ev = {"seq": e.seq, "ts_wall": e.ts_wall,
+                  "ts_mono_ns": e.ts_mono_ns, "category": e.category,
+                  "name": e.name, "severity": e.severity}
+            if e.model:
+                ev["model"] = e.model
+            if e.version:
+                ev["version"] = e.version
+            if e.trace_id:
+                ev["trace_id"] = e.trace_id
+            if e.detail_json:
+                ev["detail"] = json.loads(e.detail_json)
+            events.append(ev)
+        return {"events": events, "next_seq": response.next_seq,
+                "dropped": response.dropped}
+
+    def get_slo_status(self, model_name="", headers=None,
+                       client_timeout=None):
+        """SLO burn-rate snapshot (gRPC mirror of ``GET /v2/slo``)."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        response = self._unary(
+            self._client_stub.SloStatus,
+            ops.SloStatusRequest(model=model_name),
+            self._md(headers), client_timeout)
+        return json.loads(response.slo_json)
 
     # -- shared memory -------------------------------------------------------
 
@@ -610,14 +655,17 @@ class InferenceServerClient:
             model_name, inputs, model_version, outputs, request_id,
             sequence_id, sequence_start, sequence_end, priority, timeout,
             params)
+        tp_parts = params["traceparent"].split("-")
+        trace_id = tp_parts[1] if len(tp_parts) >= 3 else None
         t0 = time.monotonic_ns()
         response = self._unary(
             self._client_stub.ModelInfer, request, self._md(headers),
-            client_timeout,
+            client_timeout, trace_id=trace_id,
             compression=_compression(compression_algorithm))
         result = InferResult(response)
         self._stats.record((time.monotonic_ns() - t0) / 1e3,
-                           result.server_timing())
+                           result.server_timing(),
+                           trace_id=result.trace_id() or trace_id)
         return result
 
     def async_infer(self, model_name, inputs, callback, model_version="",

@@ -387,6 +387,15 @@ class InferenceServerClient:
             path = path + "?" + urlencode(query_params)
         if self._retry_policy is None and self._breaker is None:
             return self._request_once(method, path, body, headers, None)
+        # Correlate breaker transitions this request causes with its
+        # distributed trace: infer() stamps a W3C traceparent header
+        # (version-traceid-spanid-flags) before reaching here.
+        trace_id = None
+        tp = headers.get("traceparent")
+        if tp:
+            parts = tp.split("-")
+            if len(parts) == 4:
+                trace_id = parts[1]
 
         def attempt(remaining_s):
             resp, data = self._request_once(method, path, body, headers,
@@ -418,7 +427,8 @@ class InferenceServerClient:
                             if self._retry_policy is not None else None),
                 host=self._breaker_host,
                 on_retry=lambda n, exc, delay: self._stats.record_retry(),
-                on_breaker_reject=self._stats.record_breaker_rejection)
+                on_breaker_reject=self._stats.record_breaker_rejection,
+                trace_id=trace_id)
         except _RetryableStatus as exc:
             return exc.resp, exc.data
 
@@ -644,6 +654,33 @@ class InferenceServerClient:
         return self._post_json("/v2/trace/setting", settings or {},
                                query_params, headers)
 
+    # -- operational control plane -------------------------------------------
+
+    def get_events(self, model_name="", severity="", category="",
+                   since_seq=None, limit=None, headers=None,
+                   query_params=None):
+        """Server operational event timeline (``GET /v2/events``):
+        breaker/admission/drain/model/fault/deadline transitions with
+        trace correlation. ``severity`` is a minimum (e.g. ``WARNING``);
+        ``since_seq`` the exclusive cursor from the previous response's
+        ``next_seq``."""
+        qp = dict(query_params or {})
+        if model_name:
+            qp["model"] = model_name
+        if severity:
+            qp["severity"] = severity
+        if category:
+            qp["category"] = category
+        if since_seq is not None:
+            qp["since"] = int(since_seq)
+        if limit is not None:
+            qp["limit"] = int(limit)
+        return self._get_json("/v2/events", qp or None, headers)
+
+    def get_slo_status(self, headers=None, query_params=None):
+        """Per-model SLO burn-rate report (``GET /v2/slo``)."""
+        return self._get_json("/v2/slo", query_params, headers)
+
     # -- inference -----------------------------------------------------------
 
     @staticmethod
@@ -729,7 +766,8 @@ class InferenceServerClient:
             result._trace_id = tp.split("-")[1]
         result._server_timing = parse_server_timing(
             resp.getheader("Server-Timing"))
-        self._stats.record(round_trip_us, result._server_timing)
+        self._stats.record(round_trip_us, result._server_timing,
+                           trace_id=result._trace_id)
         return result
 
     def infer(self, model_name, inputs, model_version="", outputs=None,

@@ -13,11 +13,23 @@ Three pieces, threaded through every layer of the stack:
 - :mod:`client_tpu.observability.client_stats` /
   :mod:`client_tpu.observability.scrape` — the client-side InferStat
   equivalent and /metrics parsing (bench's histogram-derived p50/p99).
+- :mod:`client_tpu.observability.events` — bounded structured event
+  journal (``GET /v2/events``) plus the CLIENT_TPU_LOG=json sink.
+- :mod:`client_tpu.observability.slo` — per-model multi-window SLO
+  burn-rate tracking (``GET /v2/slo``, ``tpu_slo_*`` gauges).
 
 See docs/OBSERVABILITY.md for the metric vocabulary and wire formats.
 """
 
 from client_tpu.observability.client_stats import InferStat  # noqa: F401
+from client_tpu.observability.events import (  # noqa: F401
+    Event,
+    EventJournal,
+    configure_logging,
+    journal,
+    reset_journal,
+)
+from client_tpu.observability.slo import SloConfig, SloTracker  # noqa: F401
 from client_tpu.observability.metrics import (  # noqa: F401
     BATCH_SIZE_BUCKETS,
     DURATION_US_BUCKETS,

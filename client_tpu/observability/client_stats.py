@@ -35,12 +35,19 @@ class InferStat:
         self.retry_count = 0
         self.stale_socket_retry_count = 0
         self.breaker_rejected_count = 0
+        # trace_id of the most recent completed request (empty until one
+        # carries a trace) — the handle for jumping from client stats to
+        # the server's /v2/events and /v2/trace/requests timelines.
+        self.last_trace_id = ""
 
     def record(self, round_trip_us: float,
-               server_timing: dict | None = None) -> None:
+               server_timing: dict | None = None,
+               trace_id: str | None = None) -> None:
         with self._lock:
             self.completed_request_count += 1
             self.cumulative_total_request_time_us += round_trip_us
+            if trace_id:
+                self.last_trace_id = trace_id
             if server_timing:
                 self.reported_request_count += 1
                 self.cumulative_server_queue_us += \
@@ -82,4 +89,5 @@ class InferStat:
                 "retry_count": self.retry_count,
                 "stale_socket_retry_count": self.stale_socket_retry_count,
                 "breaker_rejected_count": self.breaker_rejected_count,
+                "last_trace_id": self.last_trace_id,
             }

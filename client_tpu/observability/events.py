@@ -1,0 +1,324 @@
+"""Structured operational event journal + opt-in JSON log sink.
+
+PRs 2–3 added the state machines that matter in production — circuit
+breakers, admission DEGRADED holds, graceful drains, deadline expiry —
+but their transitions were visible only as bare counters. This module is
+the causally-ordered timeline behind them: every state transition lands
+here as one :class:`Event` carrying monotonic + wall-clock timestamps,
+the model/version it concerns, a severity, and — when the transition was
+caused by a specific request — that request's ``trace_id``, so an
+operator can jump from ``GET /v2/events`` straight to the request's span
+timeline in ``GET /v2/trace/requests``.
+
+Emit points (category.name):
+
+* ``lifecycle.server_start`` / ``lifecycle.server_shutdown``
+* ``lifecycle.health`` — health_state() transition (READY/DEGRADED/DRAINING)
+* ``model.load`` / ``model.unload``
+* ``breaker.open`` / ``breaker.half_open`` / ``breaker.closed``
+* ``admission.shed`` / ``admission.degraded_enter`` /
+  ``admission.degraded_exit``
+* ``drain.begin`` / ``drain.end``
+* ``fault.injected``
+* ``deadline.expired``
+
+Like :func:`client_tpu.faults.registry`, the default journal is
+process-global: breaker transitions happen inside client objects with no
+engine handle, and chaos tests run client + server in one process — a
+single journal gives them one correlated timeline. The buffer is a
+bounded deque (``CLIENT_TPU_EVENT_BUFFER``, default 1024); old events
+fall off the head and ``dropped`` counts them so ``since``-cursor readers
+can detect gaps.
+
+``CLIENT_TPU_LOG=json`` additionally mirrors every event (and every
+``client_tpu`` logger record) to stderr as one JSON object per line —
+the structured replacement for the bare ``logging.getLogger("client_tpu")``
+stream handler, with ``trace_id`` correlation preserved.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+__all__ = [
+    "SEVERITIES",
+    "Event",
+    "EventJournal",
+    "journal",
+    "reset_journal",
+    "configure_logging",
+]
+
+ENV_BUFFER = "CLIENT_TPU_EVENT_BUFFER"
+ENV_LOG = "CLIENT_TPU_LOG"
+DEFAULT_CAPACITY = 1024
+
+SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR")
+_SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+
+class Event:
+    """One journal entry. ``seq`` is a process-monotonic cursor (gap-free
+    per journal); ``ts_wall`` is epoch seconds for humans, ``ts_mono_ns``
+    the monotonic stamp for ordering against trace spans."""
+
+    __slots__ = ("seq", "ts_wall", "ts_mono_ns", "category", "name",
+                 "severity", "model", "version", "trace_id", "detail")
+
+    def __init__(self, seq, ts_wall, ts_mono_ns, category, name, severity,
+                 model=None, version=None, trace_id=None, detail=None):
+        self.seq = seq
+        self.ts_wall = ts_wall
+        self.ts_mono_ns = ts_mono_ns
+        self.category = category
+        self.name = name
+        self.severity = severity
+        self.model = model
+        self.version = version
+        self.trace_id = trace_id
+        self.detail = detail or {}
+
+    def to_dict(self) -> dict:
+        d = {
+            "seq": self.seq,
+            "ts_wall": self.ts_wall,
+            "ts_mono_ns": self.ts_mono_ns,
+            "category": self.category,
+            "name": self.name,
+            "severity": self.severity,
+        }
+        if self.model is not None:
+            d["model"] = self.model
+        if self.version:
+            d["version"] = str(self.version)
+        if self.trace_id:
+            d["trace_id"] = self.trace_id
+        if self.detail:
+            d["detail"] = self.detail
+        return d
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"Event(seq={self.seq}, name={self.name!r}, "
+                f"severity={self.severity!r}, model={self.model!r}, "
+                f"trace_id={self.trace_id!r})")
+
+
+class EventJournal:
+    """Bounded, thread-safe event ring. ``emit`` is the hot write path
+    (one lock acquisition + deque append); sinks run outside the lock so
+    a slow stderr cannot stall the serving path's lock."""
+
+    def __init__(self, capacity: int | None = None, clock=time.time,
+                 mono_ns=time.monotonic_ns):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get(ENV_BUFFER,
+                                              str(DEFAULT_CAPACITY)))
+            except ValueError:
+                capacity = DEFAULT_CAPACITY
+        self.capacity = max(1, int(capacity))
+        self._clock = clock
+        self._mono_ns = mono_ns
+        self._events: deque[Event] = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._dropped = 0
+        self._sinks: list = []
+
+    # -- write path ----------------------------------------------------------
+
+    def emit(self, category: str, name: str, *, severity: str = "INFO",
+             model: str | None = None, version=None,
+             trace_id: str | None = None, **detail) -> Event:
+        if severity not in _SEV_RANK:
+            raise ValueError(f"unknown severity {severity!r} "
+                             f"(valid: {', '.join(SEVERITIES)})")
+        with self._lock:
+            self._seq += 1
+            evt = Event(self._seq, self._clock(), self._mono_ns(),
+                        category, name, severity, model=model,
+                        version=str(version) if version is not None else None,
+                        trace_id=trace_id, detail=detail or None)
+            if len(self._events) == self._events.maxlen:
+                self._dropped += 1
+            self._events.append(evt)
+            sinks = list(self._sinks)
+        for sink in sinks:
+            try:
+                sink(evt)
+            except Exception:  # noqa: BLE001 — a broken sink must not
+                pass           # take down the serving path
+        return evt
+
+    # -- read path -----------------------------------------------------------
+
+    def snapshot(self, *, model: str | None = None,
+                 severity: str | None = None, since_seq: int | None = None,
+                 since_ts: float | None = None, category: str | None = None,
+                 limit: int | None = None) -> list[Event]:
+        """Filtered copy, oldest first. ``severity`` is a minimum (WARNING
+        returns WARNING + ERROR); ``since_seq``/``since_ts`` are exclusive
+        cursors for incremental polls."""
+        min_rank = None
+        if severity is not None:
+            sev = str(severity).upper()
+            if sev not in _SEV_RANK:
+                raise ValueError(f"unknown severity {severity!r} "
+                                 f"(valid: {', '.join(SEVERITIES)})")
+            min_rank = _SEV_RANK[sev]
+        with self._lock:
+            events = list(self._events)
+        out = []
+        for e in events:
+            if model is not None and e.model != model:
+                continue
+            if category is not None and e.category != category:
+                continue
+            if min_rank is not None and _SEV_RANK[e.severity] < min_rank:
+                continue
+            if since_seq is not None and e.seq <= since_seq:
+                continue
+            if since_ts is not None and e.ts_wall <= since_ts:
+                continue
+            out.append(e)
+        if limit is not None and limit >= 0:
+            out = out[-limit:]
+        return out
+
+    def export(self, **filters) -> dict:
+        """The ``GET /v2/events`` response shape."""
+        events = self.snapshot(**filters)
+        with self._lock:
+            next_seq = self._seq
+            dropped = self._dropped
+        return {
+            "events": [e.to_dict() for e in events],
+            "next_seq": next_seq,
+            "dropped": dropped,
+            "capacity": self.capacity,
+        }
+
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def clear(self) -> None:
+        """Empty the ring (tests); seq keeps counting so cursors held
+        across a clear stay valid."""
+        with self._lock:
+            self._events.clear()
+            self._dropped = 0
+
+    # -- sinks ---------------------------------------------------------------
+
+    def add_sink(self, fn) -> None:
+        """Subscribe ``fn(event)``; called after every emit, outside the
+        journal lock. Idempotent per callable identity."""
+        with self._lock:
+            if fn not in self._sinks:
+                self._sinks.append(fn)
+
+    def remove_sink(self, fn) -> None:
+        with self._lock:
+            if fn in self._sinks:
+                self._sinks.remove(fn)
+
+
+# -- process-global default journal ------------------------------------------
+
+_default: EventJournal | None = None
+_default_lock = threading.Lock()
+
+
+def journal() -> EventJournal:
+    """The process-global journal (double-checked, like
+    :func:`client_tpu.faults.registry`); ``CLIENT_TPU_LOG=json`` wires
+    the stderr sink on first access."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                j = EventJournal()
+                _default = j
+                configure_logging()
+    return _default
+
+
+def reset_journal() -> None:
+    """Drop the global journal (tests); the next journal() recreates it
+    with current env settings."""
+    global _default
+    with _default_lock:
+        _default = None
+
+
+# -- structured JSON log sink (CLIENT_TPU_LOG=json) ---------------------------
+
+
+class _JsonLogFormatter(logging.Formatter):
+    """One JSON object per log record; ``trace_id`` rides along when the
+    caller attached one via ``extra={"trace_id": ...}``."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        d = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        trace_id = getattr(record, "trace_id", None)
+        if trace_id:
+            d["trace_id"] = trace_id
+        if record.exc_info and record.exc_info[0] is not None:
+            d["exc"] = self.formatException(record.exc_info)
+        return json.dumps(d, default=str)
+
+
+def _event_sink(stream):
+    def sink(evt: Event) -> None:
+        d = evt.to_dict()
+        d["kind"] = "event"
+        try:
+            stream.write(json.dumps(d, default=str) + "\n")
+            stream.flush()
+        except (OSError, ValueError):
+            pass
+
+    return sink
+
+
+def configure_logging(environ=os.environ, stream=None,
+                      jour: EventJournal | None = None) -> bool:
+    """When ``CLIENT_TPU_LOG=json``: attach a JSON-lines handler to the
+    ``client_tpu`` logger (replacing logging's default plain-text
+    propagation for it) and mirror every journal event to the same
+    stream. Returns True when the sink was installed. Idempotent."""
+    mode = (environ.get(ENV_LOG) or "").strip().lower()
+    if mode != "json":
+        return False
+    out = stream or sys.stderr
+    logger = logging.getLogger("client_tpu")
+    already = any(getattr(h, "_client_tpu_json", False)
+                  for h in logger.handlers)
+    if not already:
+        handler = logging.StreamHandler(out)
+        handler.setFormatter(_JsonLogFormatter())
+        handler._client_tpu_json = True
+        logger.addHandler(handler)
+        logger.propagate = False
+        if logger.level == logging.NOTSET:
+            logger.setLevel(logging.INFO)
+    target = jour if jour is not None else _default
+    if target is not None:
+        target.add_sink(_event_sink(out))
+    return True

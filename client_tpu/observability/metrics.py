@@ -16,6 +16,11 @@ Design notes:
 - Rendering emits HELP then TYPE then samples per family, label values
   escaped per the exposition spec, histogram buckets cumulative with a
   terminal ``+Inf`` equal to ``_count``.
+- ``render(openmetrics=True)`` switches to OpenMetrics 1.0 exposition:
+  counter samples take the ``_total`` suffix (family advertised by its
+  base name), histogram buckets carry ``# {trace_id="..."} value``
+  exemplars (the last observation that landed in each bucket, when the
+  caller supplied one), and the body ends with the mandatory ``# EOF``.
 """
 
 from __future__ import annotations
@@ -88,16 +93,21 @@ class _Metric:
     def _make_child(self):
         raise NotImplementedError
 
-    def collect(self) -> list[str]:
-        lines = [f"# HELP {self.name} {escape_help(self.help)}",
-                 f"# TYPE {self.name} {self.kind}"]
+    def _family_name(self, openmetrics: bool) -> str:
+        return self.name
+
+    def collect(self, openmetrics: bool = False) -> list[str]:
+        fam = self._family_name(openmetrics)
+        lines = [f"# HELP {fam} {escape_help(self.help)}",
+                 f"# TYPE {fam} {self.kind}"]
         with self._lock:
             items = sorted(self._children.items())
         for values, child in items:
-            lines.extend(self._render_child(values, child))
+            lines.extend(self._render_child(values, child, openmetrics))
         return lines
 
-    def _render_child(self, values, child) -> list[str]:
+    def _render_child(self, values, child,
+                      openmetrics: bool = False) -> list[str]:
         raise NotImplementedError
 
 
@@ -120,10 +130,21 @@ class Counter(_Metric):
         with child.lock:
             child.v += amount
 
-    def _render_child(self, values, child) -> list[str]:
+    def _family_name(self, openmetrics: bool) -> str:
+        # OpenMetrics advertises the counter by its base name and
+        # suffixes every sample with `_total`.
+        if openmetrics and self.name.endswith("_total"):
+            return self.name[:-len("_total")]
+        return self.name
+
+    def _render_child(self, values, child,
+                      openmetrics: bool = False) -> list[str]:
         ls = _label_str(self.labelnames, values)
         body = f"{{{ls}}}" if ls else ""
-        return [f"{self.name}{body} {format_value(child.v)}"]
+        name = self.name
+        if openmetrics:
+            name = self._family_name(True) + "_total"
+        return [f"{name}{body} {format_value(child.v)}"]
 
 
 class Gauge(_Metric):
@@ -145,17 +166,22 @@ class Gauge(_Metric):
     def dec(self, amount: float = 1.0, **labels):
         self.inc(-amount, **labels)
 
-    def _render_child(self, values, child) -> list[str]:
+    def _render_child(self, values, child,
+                      openmetrics: bool = False) -> list[str]:
         ls = _label_str(self.labelnames, values)
         body = f"{{{ls}}}" if ls else ""
         return [f"{self.name}{body} {format_value(child.v)}"]
 
 
 class _HistValue:
-    __slots__ = ("counts", "sum", "lock")
+    __slots__ = ("counts", "sum", "exemplars", "lock")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * (n_buckets + 1)  # +1 for the +Inf bucket
+        # Last (value, trace_id) that landed in each bucket; OpenMetrics
+        # exemplars linking a bucket straight to /v2/trace/requests.
+        self.exemplars: list[tuple[float, str] | None] = \
+            [None] * (n_buckets + 1)
         self.sum = 0.0
         self.lock = threading.Lock()
 
@@ -175,29 +201,43 @@ class Histogram(_Metric):
     def _make_child(self):
         return _HistValue(len(self.buckets))
 
-    def observe(self, value: float, **labels):
+    def observe(self, value: float, exemplar: str | None = None, **labels):
+        """Record ``value``; ``exemplar`` (a trace_id) is retained as the
+        bucket's last exemplar for OpenMetrics rendering."""
         child = self.labels(**labels) if self.labelnames else self.labels()
         idx = bisect_left(self.buckets, value)
         with child.lock:
             child.counts[idx] += 1
             child.sum += value
+            if exemplar:
+                child.exemplars[idx] = (float(value), str(exemplar))
 
-    def _render_child(self, values, child) -> list[str]:
+    def _render_child(self, values, child,
+                      openmetrics: bool = False) -> list[str]:
         ls = _label_str(self.labelnames, values)
         with child.lock:
             counts = list(child.counts)
+            exemplars = list(child.exemplars)
             total_sum = child.sum
         lines = []
         cum = 0
-        for le, n in zip(self.buckets, counts):
+        sep = "," if ls else ""
+
+        def _ex(i: int) -> str:
+            if not openmetrics or exemplars[i] is None:
+                return ""
+            v, trace_id = exemplars[i]
+            return (f' # {{trace_id="{escape_label_value(trace_id)}"}} '
+                    f"{format_value(v)}")
+
+        for i, (le, n) in enumerate(zip(self.buckets, counts)):
             cum += n
-            sep = "," if ls else ""
             lines.append(
                 f'{self.name}_bucket{{{ls}{sep}le="{format_value(le)}"}} '
-                f"{cum}")
+                f"{cum}{_ex(i)}")
         cum += counts[-1]
-        sep = "," if ls else ""
-        lines.append(f'{self.name}_bucket{{{ls}{sep}le="+Inf"}} {cum}')
+        lines.append(f'{self.name}_bucket{{{ls}{sep}le="+Inf"}} {cum}'
+                     f"{_ex(len(counts) - 1)}")
         body = f"{{{ls}}}" if ls else ""
         lines.append(f"{self.name}_sum{body} {format_value(total_sum)}")
         lines.append(f"{self.name}_count{body} {cum}")
@@ -237,12 +277,14 @@ class MetricRegistry:
         return self._get_or_create(Histogram, name, help_text, labelnames,
                                    buckets=buckets)
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
         with self._lock:
             metrics = list(self._metrics.values())
         lines: list[str] = []
         for m in metrics:
-            lines.extend(m.collect())
+            lines.extend(m.collect(openmetrics))
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -262,10 +304,12 @@ class ModelInstruments:
         self._em = em
         self._labels = {"model": model, "version": version}
 
-    def observe_request(self, total_ns: int, times) -> None:
+    def observe_request(self, total_ns: int, times,
+                        trace_id: str | None = None) -> None:
         em = self._em
         lab = self._labels
-        em.request_duration_us.observe(max(0, total_ns) / 1e3, **lab)
+        em.request_duration_us.observe(max(0, total_ns) / 1e3,
+                                       exemplar=trace_id, **lab)
         em.phase_duration_us.observe(times.queue_ns / 1e3,
                                      phase="queue", **lab)
         em.phase_duration_us.observe(times.compute_input_ns / 1e3,
@@ -293,8 +337,9 @@ class EngineMetrics:
 
     Histograms: tpu_request_duration_us, tpu_phase_duration_us{phase},
     tpu_batch_size. Gauges: tpu_queue_depth, tpu_inflight_batches,
-    tpu_device_hbm_bytes_in_use, tpu_drain_duration_seconds. Counters:
-    tpu_queue_rejections_total, tpu_admission_rejections_total{reason},
+    tpu_device_hbm_bytes_in_use, tpu_hbm_limit_bytes, tpu_hbm_peak_bytes,
+    tpu_drain_duration_seconds. Counters: tpu_queue_rejections_total,
+    tpu_admission_rejections_total{reason},
     tpu_deadline_expirations_total{stage}.
     """
 
@@ -326,6 +371,16 @@ class EngineMetrics:
             "Device HBM bytes in use (0 when the platform does not report "
             "memory stats, e.g. CPU)",
             ("device",))
+        self.hbm_limit_bytes = r.gauge(
+            "tpu_hbm_limit_bytes",
+            "Device HBM capacity limit (0 when the platform does not "
+            "report memory stats, e.g. CPU)",
+            ("device",))
+        self.hbm_peak_bytes = r.gauge(
+            "tpu_hbm_peak_bytes",
+            "Peak device HBM bytes in use since process start (0 when the "
+            "platform does not report memory stats, e.g. CPU)",
+            ("device",))
         self.queue_rejections = r.counter(
             "tpu_queue_rejections_total",
             "Requests rejected at admission (backpressure, HTTP 429)",
@@ -349,17 +404,24 @@ class EngineMetrics:
         self._lock = threading.Lock()
 
     def model_instruments(self, model: str, version: str) -> ModelInstruments:
-        key = (model, str(version))
+        key = (str(model), str(version))
         inst = self._instruments.get(key)
         if inst is None:
             with self._lock:
-                inst = self._instruments.setdefault(
-                    key, ModelInstruments(self, key[0], key[1]))
+                inst = self._instruments.get(key)
+                if inst is None:
+                    inst = ModelInstruments(self, key[0], key[1])
+                    # Copy-on-write: lock-free fast-path readers only ever
+                    # see a fully-built dict, never one mid-mutation.
+                    updated = dict(self._instruments)
+                    updated[key] = inst
+                    self._instruments = updated
         return inst
 
     def update_device_gauges(self) -> None:
-        """Sample per-device HBM usage; on platforms without memory stats
-        (JAX_PLATFORMS=cpu) the gauge still renders, pinned to 0."""
+        """Sample per-device HBM usage, capacity and peak; on platforms
+        without memory stats (JAX_PLATFORMS=cpu) the gauges still render,
+        pinned to 0."""
         sampled = False
         try:
             import jax
@@ -369,14 +431,21 @@ class EngineMetrics:
                     ms = d.memory_stats()
                 except Exception:  # noqa: BLE001 — per-device probe
                     ms = None
-                self.hbm_bytes.set(
-                    int((ms or {}).get("bytes_in_use", 0)),
-                    device=str(d.id))
+                ms = ms or {}
+                dev = str(d.id)
+                self.hbm_bytes.set(int(ms.get("bytes_in_use", 0)),
+                                   device=dev)
+                self.hbm_limit_bytes.set(int(ms.get("bytes_limit", 0)),
+                                         device=dev)
+                self.hbm_peak_bytes.set(
+                    int(ms.get("peak_bytes_in_use", 0)), device=dev)
                 sampled = True
         except Exception:  # noqa: BLE001 — no backend at all
             pass
         if not sampled:
             self.hbm_bytes.set(0, device="0")
+            self.hbm_limit_bytes.set(0, device="0")
+            self.hbm_peak_bytes.set(0, device="0")
 
-    def render(self) -> str:
-        return self.registry.render()
+    def render(self, openmetrics: bool = False) -> str:
+        return self.registry.render(openmetrics)

@@ -29,6 +29,12 @@ def parse_samples(text: str) -> list[tuple[str, dict, float]]:
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        # OpenMetrics exposition suffixes bucket/counter samples with an
+        # exemplar ("... # {trace_id=...} value"); drop it, or the greedy
+        # label match would read the exemplar value as the sample value.
+        # (Our label values never contain " # ", so the split is safe.)
+        if " # " in line:
+            line = line.split(" # ", 1)[0].rstrip()
         m = _SAMPLE_RE.match(line)
         if not m:
             continue

@@ -12,6 +12,7 @@ from __future__ import annotations
 import grpc
 
 from client_tpu.protocol import grpc_service_pb2 as pb
+from client_tpu.protocol import ops_pb2 as ops
 
 _SERVICE = "inference.GRPCInferenceService"
 
@@ -49,6 +50,10 @@ _METHODS = [
      pb.TpuSharedMemoryRegisterResponse, False),
     ("TpuSharedMemoryUnregister", pb.TpuSharedMemoryUnregisterRequest,
      pb.TpuSharedMemoryUnregisterResponse, False),
+    # Operational control plane (gRPC mirrors of /v2/events and /v2/slo;
+    # messages hand-built in ops_pb2 — the image carries no protoc).
+    ("Events", ops.EventsRequest, ops.EventsResponse, False),
+    ("SloStatus", ops.SloStatusRequest, ops.SloStatusResponse, False),
 ]
 
 

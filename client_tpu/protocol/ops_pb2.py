@@ -1,0 +1,112 @@
+"""Protobuf messages for the operational control-plane RPCs.
+
+Companion to grpc_service_pb2 for the Events / SloStatus accessors
+(``/v2/events`` and ``/v2/slo`` over gRPC). The runtime image has no
+protoc/grpc_tools, and appending to grpc_service_pb2's serialized blob
+by hand would be unmaintainable — so this module builds its
+FileDescriptorProto programmatically, registers it in the default
+descriptor pool, and lets the same generated-code builder materialise
+the message classes. Wire-compatible with the equivalent .proto:
+
+    syntax = "proto3"; package inference;
+    message EventsRequest  { string model = 1; string severity = 2;
+                             uint64 since_seq = 3; string category = 4;
+                             uint32 limit = 5; }
+    message Event          { uint64 seq = 1; double ts_wall = 2;
+                             uint64 ts_mono_ns = 3; string category = 4;
+                             string name = 5; string severity = 6;
+                             string model = 7; string version = 8;
+                             string trace_id = 9; string detail_json = 10; }
+    message EventsResponse { repeated Event events = 1;
+                             uint64 next_seq = 2; uint64 dropped = 3; }
+    message SloStatusRequest  { string model = 1; }
+    message SloStatusResponse { string slo_json = 1; }
+
+Event.detail_json / SloStatusResponse.slo_json carry the open-ended
+detail/report dicts as JSON strings — same pattern the HTTP frontend
+uses, without freezing their schema into the proto.
+"""
+
+from __future__ import annotations
+
+from google.protobuf import descriptor_pb2 as _descriptor_pb2
+from google.protobuf import descriptor_pool as _descriptor_pool
+from google.protobuf.internal import builder as _builder
+
+_F = _descriptor_pb2.FieldDescriptorProto
+
+_FILE_NAME = "client_tpu_ops_service.proto"
+
+
+def _file_proto() -> _descriptor_pb2.FileDescriptorProto:
+    fdp = _descriptor_pb2.FileDescriptorProto()
+    fdp.name = _FILE_NAME
+    fdp.package = "inference"
+    fdp.syntax = "proto3"
+
+    def message(name: str):
+        m = fdp.message_type.add()
+        m.name = name
+        return m
+
+    def field(msg, name: str, number: int, ftype,
+              label=_F.LABEL_OPTIONAL, type_name: str = ""):
+        f = msg.field.add()
+        f.name = name
+        f.number = number
+        f.type = ftype
+        f.label = label
+        if type_name:
+            f.type_name = type_name
+
+    m = message("EventsRequest")
+    field(m, "model", 1, _F.TYPE_STRING)
+    field(m, "severity", 2, _F.TYPE_STRING)
+    field(m, "since_seq", 3, _F.TYPE_UINT64)
+    field(m, "category", 4, _F.TYPE_STRING)
+    field(m, "limit", 5, _F.TYPE_UINT32)
+
+    m = message("Event")
+    field(m, "seq", 1, _F.TYPE_UINT64)
+    field(m, "ts_wall", 2, _F.TYPE_DOUBLE)
+    field(m, "ts_mono_ns", 3, _F.TYPE_UINT64)
+    field(m, "category", 4, _F.TYPE_STRING)
+    field(m, "name", 5, _F.TYPE_STRING)
+    field(m, "severity", 6, _F.TYPE_STRING)
+    field(m, "model", 7, _F.TYPE_STRING)
+    field(m, "version", 8, _F.TYPE_STRING)
+    field(m, "trace_id", 9, _F.TYPE_STRING)
+    field(m, "detail_json", 10, _F.TYPE_STRING)
+
+    m = message("EventsResponse")
+    field(m, "events", 1, _F.TYPE_MESSAGE, label=_F.LABEL_REPEATED,
+          type_name=".inference.Event")
+    field(m, "next_seq", 2, _F.TYPE_UINT64)
+    field(m, "dropped", 3, _F.TYPE_UINT64)
+
+    m = message("SloStatusRequest")
+    field(m, "model", 1, _F.TYPE_STRING)
+
+    m = message("SloStatusResponse")
+    field(m, "slo_json", 1, _F.TYPE_STRING)
+
+    return fdp
+
+
+_pool = _descriptor_pool.Default()
+try:
+    DESCRIPTOR = _pool.Add(_file_proto())
+except Exception:  # noqa: BLE001 — already registered (re-import/reload)
+    DESCRIPTOR = _pool.FindFileByName(_FILE_NAME)
+
+_builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
+_builder.BuildTopDescriptorsAndMessages(
+    DESCRIPTOR, "client_tpu.protocol.ops_pb2", globals())
+
+__all__ = [
+    "EventsRequest",
+    "Event",
+    "EventsResponse",
+    "SloStatusRequest",
+    "SloStatusResponse",
+]

@@ -218,9 +218,19 @@ class CircuitBreaker:
         with self._lock:
             return self._host(host).state
 
-    def check(self, host: str = "") -> None:
+    def _emit(self, name: str, host: str, trace_id: str | None,
+              severity: str = "INFO", **detail) -> None:
+        """Journal a state transition (outside the breaker lock — the
+        journal has its own lock and runs sinks)."""
+        from client_tpu.observability.events import journal
+
+        journal().emit("breaker", name, severity=severity,
+                       trace_id=trace_id, host=host, **detail)
+
+    def check(self, host: str = "", trace_id: str | None = None) -> None:
         """Gate one call attempt; raises CircuitBreakerOpenError when the
         host is open (or half-open with the single probe already taken)."""
+        probing = False
         with self._lock:
             st = self._host(host)
             if st.state == self.CLOSED:
@@ -233,6 +243,7 @@ class CircuitBreaker:
                         host, self.cooldown_s - elapsed)
                 st.state = self.HALF_OPEN
                 st.probe_in_flight = False
+                probing = True
             # HALF_OPEN: exactly one probe at a time; concurrent callers
             # are rejected until the probe resolves. A probe older than
             # cooldown_s is treated as abandoned (its attempt died without
@@ -245,17 +256,25 @@ class CircuitBreaker:
                         host, self.cooldown_s - probe_age)
             st.probe_in_flight = True
             st.probe_started_at = now
+        if probing:
+            self._emit("half_open", host, trace_id)
 
-    def record_success(self, host: str = "") -> None:
+    def record_success(self, host: str = "",
+                       trace_id: str | None = None) -> None:
         with self._lock:
             st = self._host(host)
-            if st.state != self.CLOSED:
+            closed = st.state != self.CLOSED
+            if closed:
                 st.open_accum_s += self._clock() - st.opened_at
             st.state = self.CLOSED
             st.consecutive_failures = 0
             st.probe_in_flight = False
+        if closed:
+            self._emit("closed", host, trace_id)
 
-    def record_failure(self, host: str = "") -> None:
+    def record_failure(self, host: str = "",
+                       trace_id: str | None = None) -> None:
+        opened = None
         with self._lock:
             st = self._host(host)
             now = self._clock()
@@ -266,12 +285,18 @@ class CircuitBreaker:
                 st.state = self.OPEN
                 st.opened_at = now
                 st.probe_in_flight = False
-                return
-            st.consecutive_failures += 1
-            if (st.state == self.CLOSED
-                    and st.consecutive_failures >= self.failure_threshold):
-                st.state = self.OPEN
-                st.opened_at = now
+                opened = {"probe_failed": True}
+            else:
+                st.consecutive_failures += 1
+                if (st.state == self.CLOSED
+                        and st.consecutive_failures
+                        >= self.failure_threshold):
+                    st.state = self.OPEN
+                    st.opened_at = now
+                    opened = {"failures": st.consecutive_failures}
+        if opened is not None:
+            self._emit("open", host, trace_id, severity="ERROR",
+                       cooldown_s=self.cooldown_s, **opened)
 
     def open_seconds_total(self) -> float:
         with self._lock:
@@ -304,7 +329,7 @@ def counts_as_server_fault(exc) -> bool:
 def run_with_resilience(attempt, *, policy=None, breaker=None,
                         deadline_s=None, host="", on_retry=None,
                         on_breaker_reject=None, sleep=time.sleep,
-                        clock=time.monotonic):
+                        clock=time.monotonic, trace_id=None):
     """Run ``attempt(remaining_s)`` under retry/breaker/deadline control.
 
     ``attempt`` receives the remaining deadline budget in seconds (None
@@ -331,7 +356,7 @@ def run_with_resilience(attempt, *, policy=None, breaker=None,
                     f"before attempt {attempt_no}")
         if breaker is not None:
             try:
-                breaker.check(host)
+                breaker.check(host, trace_id=trace_id)
             except CircuitBreakerOpenError:
                 if on_breaker_reject is not None:
                     on_breaker_reject()
@@ -341,13 +366,13 @@ def run_with_resilience(attempt, *, policy=None, breaker=None,
         except Exception as exc:  # noqa: BLE001 — classified below
             if breaker is not None:
                 if counts_as_server_fault(exc):
-                    breaker.record_failure(host)
+                    breaker.record_failure(host, trace_id=trace_id)
                 else:
                     # The host answered (4xx, RESOURCE_EXHAUSTED, a wrapped
                     # error with no status): the breaker must resolve any
                     # half-open probe as a SUCCESS — leaving it unresolved
                     # would reject every future call to this host forever.
-                    breaker.record_success(host)
+                    breaker.record_success(host, trace_id=trace_id)
             if (policy is None or attempt_no >= max_attempts
                     or not policy.retryable(exc)):
                 raise
@@ -363,5 +388,5 @@ def run_with_resilience(attempt, *, policy=None, breaker=None,
                 sleep(delay)
             continue
         if breaker is not None:
-            breaker.record_success(host)
+            breaker.record_success(host, trace_id=trace_id)
         return result

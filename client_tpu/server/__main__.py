@@ -41,6 +41,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
 
+    # Opt-in structured logging (CLIENT_TPU_LOG=json): JSON lines on
+    # stderr, with the event journal mirrored alongside normal log records.
+    from client_tpu.observability.events import configure_logging
+
+    configure_logging()
+
     from client_tpu.engine import TpuEngine
     from client_tpu.engine.repository import ModelRepository
     from client_tpu.models import build_repository

@@ -10,6 +10,7 @@ with correlation by request id, terminated per-request by the
 
 from __future__ import annotations
 
+import json
 import os
 import queue
 import threading
@@ -304,6 +305,47 @@ class _Servicer(GRPCInferenceServiceServicer):
                 be.compute_infer.count = b["compute_infer"]["count"]
                 be.compute_infer.ns = b["compute_infer"]["ns"]
         return resp
+
+    # -- operational control plane -------------------------------------------
+
+    def Events(self, request, context):  # noqa: N802
+        """gRPC mirror of ``GET /v2/events``. Empty string/zero fields
+        mean unfiltered (proto3 default semantics); ``since_seq`` is the
+        exclusive cursor from the previous response's ``next_seq``."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        try:
+            out = self.engine.events_export(
+                model=request.model or None,
+                severity=request.severity or None,
+                category=request.category or None,
+                since_seq=request.since_seq or None,
+                limit=request.limit or None)
+        except ValueError as exc:  # unknown severity name
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        resp = ops.EventsResponse(next_seq=out["next_seq"],
+                                  dropped=out["dropped"])
+        for e in out["events"]:
+            resp.events.add(
+                seq=e["seq"], ts_wall=e["ts_wall"],
+                ts_mono_ns=e["ts_mono_ns"], category=e["category"],
+                name=e["name"], severity=e["severity"],
+                model=e.get("model", ""), version=e.get("version", ""),
+                trace_id=e.get("trace_id", ""),
+                detail_json=(json.dumps(e["detail"])
+                             if e.get("detail") else ""))
+        return resp
+
+    def SloStatus(self, request, context):  # noqa: N802
+        """gRPC mirror of ``GET /v2/slo``; the report rides as JSON
+        (open-ended schema, same body the HTTP endpoint serves)."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        snap = self.engine.slo_snapshot()
+        if request.model:
+            snap["models"] = {k: v for k, v in snap["models"].items()
+                              if k == request.model}
+        return ops.SloStatusResponse(slo_json=json.dumps(snap))
 
     # -- repository ----------------------------------------------------------
 

@@ -65,6 +65,8 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/v2/trace/setting$"), "trace_setting"),
     ("POST", re.compile(r"^/v2/trace/setting$"), "trace_update"),
     ("GET", re.compile(r"^/v2/trace/requests$"), "trace_requests"),
+    ("GET", re.compile(r"^/v2/events$"), "events"),
+    ("GET", re.compile(r"^/v2/slo$"), "slo"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
 ]
 
@@ -282,9 +284,52 @@ class _Handler(BaseHTTPRequestHandler):
             raise EngineError(f"{kind} is not enabled on this server", 400)
         return mgr
 
+    OPENMETRICS_CT = "application/openmetrics-text; version=1.0.0; " \
+                     "charset=utf-8"
+
     def h_metrics(self):
-        self._send(200, self.engine.prometheus_metrics().encode("utf-8"),
-                   content_type="text/plain; version=0.0.4")
+        # Content negotiation mirrors prometheus/client_python: a scraper
+        # that Accepts application/openmetrics-text gets OpenMetrics 1.0
+        # (exemplars, # EOF); everyone else the classic 0.0.4 text format.
+        accept = self.headers.get("Accept", "") or ""
+        om = "application/openmetrics-text" in accept
+        body = self.engine.prometheus_metrics(openmetrics=om)
+        self._send(200, body.encode("utf-8"),
+                   content_type=(self.OPENMETRICS_CT if om
+                                 else "text/plain; version=0.0.4"))
+
+    def h_events(self):
+        """Operational event timeline (``/v2/events``). Filters:
+        ``?model=`` exact, ``?severity=`` minimum (DEBUG..ERROR),
+        ``?category=``, ``?since=<seq>`` exclusive cursor (use the
+        previous response's ``next_seq``), ``?limit=<n>`` newest n."""
+        from urllib.parse import parse_qs, urlparse
+
+        q = parse_qs(urlparse(self.path).query)
+
+        def one(key):
+            return (q.get(key) or [None])[0]
+
+        def num(key, cast):
+            raw = one(key)
+            if raw is None:
+                return None
+            try:
+                return cast(raw)
+            except ValueError:
+                raise EngineError(f"malformed {key!r} parameter", 400)
+
+        try:
+            self._send_json(self.engine.events_export(
+                model=one("model"), severity=one("severity"),
+                category=one("category"), since_seq=num("since", int),
+                since_ts=num("since_ts", float), limit=num("limit", int)))
+        except ValueError as exc:  # unknown severity name
+            raise EngineError(str(exc), 400)
+
+    def h_slo(self):
+        """Per-model SLO burn-rate report (``/v2/slo``)."""
+        self._send_json(self.engine.slo_snapshot())
 
     def h_trace_setting(self):
         self._send_json(self.engine.trace_setting())

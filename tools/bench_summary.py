@@ -5,7 +5,14 @@ The round-5 history schema appends one record per PROBE as it completes
 (plus a run-status record), grouped by ``run_ts`` — this prints each run's
 probes on one screen so BASELINE.md reconciliation is mechanical.
 
+``--check`` turns the tool into a regression gate: the newest run's
+per-probe p99 latency is compared against the median of the prior runs
+(same probe), and the process exits 1 when any probe regressed by more
+than ``--threshold`` (default 25%). Fewer than two runs of a probe is a
+pass — there is nothing to compare against.
+
 Usage: python tools/bench_summary.py [path] [--runs N]
+       python tools/bench_summary.py --check [path] [--threshold 0.25]
 """
 
 import json
@@ -14,16 +21,77 @@ import sys
 import time
 
 
+def _probe_runs(hist: list) -> dict:
+    """{run_ts: {probe: record}} for probe records (run-status excluded)."""
+    runs: dict = {}
+    for rec in hist:
+        if not isinstance(rec, dict) or rec.get("run_ts") is None:
+            continue
+        if rec.get("probe") in (None, "run-status"):
+            continue
+        runs.setdefault(rec["run_ts"], {})[rec["probe"]] = rec
+    return runs
+
+
+def _median(values: list) -> float:
+    ordered = sorted(values)
+    mid = len(ordered) // 2
+    if len(ordered) % 2:
+        return ordered[mid]
+    return (ordered[mid - 1] + ordered[mid]) / 2
+
+
+def check(hist: list, threshold: float = 0.25) -> int:
+    """Gate the newest run against the median of prior runs per probe.
+    Returns the exit status (1 on any >threshold p99 regression)."""
+    runs = _probe_runs(hist)
+    if len(runs) < 2:
+        print(f"bench-check: {len(runs)} run(s) with probe records — "
+              "nothing to compare, pass")
+        return 0
+    latest_ts = max(runs)
+    failures = 0
+    for probe, rec in sorted(runs[latest_ts].items()):
+        p99 = rec.get("p99_us")
+        prior = [runs[ts][probe].get("p99_us")
+                 for ts in runs if ts != latest_ts and probe in runs[ts]]
+        prior = [v for v in prior if v is not None]
+        if p99 is None or not prior:
+            print(f"bench-check: {probe}: no prior p99 to compare, skip")
+            continue
+        base = _median(prior)
+        ratio = (p99 / base - 1.0) if base > 0 else 0.0
+        verdict = "FAIL" if ratio > threshold else "ok"
+        print(f"bench-check: {probe}: p99 {p99:.1f}us vs median "
+              f"{base:.1f}us over {len(prior)} prior run(s) "
+              f"({ratio:+.1%}) {verdict}")
+        if ratio > threshold:
+            failures += 1
+    if failures:
+        print(f"bench-check: {failures} probe(s) regressed more than "
+              f"{threshold:.0%} on p99", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main() -> int:
-    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    argv = sys.argv[1:]
+    args = [a for i, a in enumerate(argv) if not a.startswith("--")
+            and (i == 0 or argv[i - 1] not in ("--runs", "--threshold"))]
     path = args[0] if args else os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "BENCH_HISTORY.json")
     n_runs = 3
     if "--runs" in sys.argv:
         n_runs = int(sys.argv[sys.argv.index("--runs") + 1])
+    threshold = 0.25
+    if "--threshold" in sys.argv:
+        threshold = float(sys.argv[sys.argv.index("--threshold") + 1])
     with open(path) as f:
         hist = json.load(f)
+
+    if "--check" in sys.argv:
+        return check(hist, threshold)
 
     runs: dict = {}
     legacy = []

@@ -1,19 +1,23 @@
 #!/usr/bin/env bash
-# CI gate: tier-1 tests + chaos suite + metrics-endpoint lint.
+# CI gate: tier-1 tests + chaos suite + live endpoint lint + bench gate.
 #
 #   tools/ci_check.sh            # everything (tier-1 already includes chaos)
-#   tools/ci_check.sh --fast     # chaos suite + promlint only
+#   tools/ci_check.sh --fast     # chaos suite + live lint + bench gate only
 #
-# Three stages:
+# Four stages:
 #   1. tier-1: the full fast suite (ROADMAP.md contract; excludes `slow`).
 #   2. chaos: the deterministic fault-injection suite alone (`-m chaos`) —
 #      redundant with tier-1 when stage 1 runs, but the -m filter proves
 #      the marker set stays collectible on its own (a broken marker would
 #      silently drop these tests from any filtered CI job).
-#   3. promlint: boot a real HTTP server, scrape /metrics live, and lint
-#      the exposition with tools/promlint.py — catching malformed metric
-#      renderings (bad escapes, re-opened families, histogram invariants)
-#      that unit tests of individual counters never exercise.
+#   3. live scrape: boot a real HTTP server, lint /metrics in both the
+#      classic and OpenMetrics expositions with tools/promlint.py (the
+#      OpenMetrics pass also requires an exemplar on tpu_request_duration),
+#      and smoke-scrape /v2/events and /v2/slo — catching malformed
+#      renderings and broken ops endpoints that unit tests of individual
+#      counters never exercise.
+#   4. bench gate: tools/bench_summary.py --check fails the build when the
+#      newest BENCH_HISTORY.json run regressed any probe's p99 by >25%.
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -23,7 +27,7 @@ FAST=0
 rc=0
 
 if [ "$FAST" -eq 0 ]; then
-    echo "=== stage 1/3: tier-1 test suite ==="
+    echo "=== stage 1/4: tier-1 test suite ==="
     rm -f /tmp/_t1.log
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -33,27 +37,32 @@ if [ "$FAST" -eq 0 ]; then
         | tr -cd . | wc -c)"
     [ "$t1" -ne 0 ] && { echo "tier-1 FAILED (exit $t1)"; rc=1; }
 else
-    echo "=== stage 1/3: tier-1 skipped (--fast) ==="
+    echo "=== stage 1/4: tier-1 skipped (--fast) ==="
 fi
 
-echo "=== stage 2/3: chaos (fault-injection) suite ==="
+echo "=== stage 2/4: chaos (fault-injection) suite ==="
 timeout -k 10 300 python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 [ $? -ne 0 ] && { echo "chaos suite FAILED"; rc=1; }
 
-echo "=== stage 3/3: promlint against a live /metrics scrape ==="
-python - <<'EOF' | python tools/promlint.py
+echo "=== stage 3/4: live scrape (promlint + ops endpoints) ==="
+SCRAPE_DIR=$(mktemp -d)
+python - "$SCRAPE_DIR" <<'EOF'
+import json
 import sys
-from urllib.request import urlopen
+from urllib.request import Request, urlopen
 
 from client_tpu.models import build_repository
 from client_tpu.engine import TpuEngine
+from client_tpu.observability.tracing import TraceContext
 from client_tpu.server import HttpInferenceServer
 
+out_dir = sys.argv[1]
 engine = TpuEngine(build_repository(["simple"]), warmup=False)
 srv = HttpInferenceServer(engine, host="127.0.0.1", port=0).start()
 try:
-    # One inference so per-model counters/histograms render non-trivially.
+    # One traced inference so per-model counters/histograms render
+    # non-trivially and the duration histogram carries an exemplar.
     import numpy as np
     from client_tpu.engine.types import InferRequest
 
@@ -61,15 +70,46 @@ try:
         model_name="simple",
         inputs={"INPUT0": np.zeros((1, 16), dtype=np.int32),
                 "INPUT1": np.zeros((1, 16), dtype=np.int32)},
+        trace=TraceContext.new(),
     ), timeout_s=120)
-    text = urlopen(f"http://{srv.url}/metrics", timeout=10).read()
-    sys.stdout.write(text.decode("utf-8"))
+    base = f"http://{srv.url}"
+    classic = urlopen(f"{base}/metrics", timeout=10).read().decode()
+    om = urlopen(Request(f"{base}/metrics", headers={
+        "Accept": "application/openmetrics-text"}), timeout=10).read().decode()
+    with open(f"{out_dir}/metrics.txt", "w") as f:
+        f.write(classic)
+    with open(f"{out_dir}/metrics.om.txt", "w") as f:
+        f.write(om)
+    if not any("tpu_request_duration" in ln and " # {" in ln
+               for ln in om.splitlines()):
+        sys.exit("no exemplar on tpu_request_duration in OpenMetrics scrape")
+    events = json.load(urlopen(f"{base}/v2/events", timeout=10))
+    if "events" not in events or not any(
+            e["category"] == "lifecycle" for e in events["events"]):
+        sys.exit(f"/v2/events smoke failed: {str(events)[:200]}")
+    slo = json.load(urlopen(f"{base}/v2/slo", timeout=10))
+    if "enabled" not in slo or "windows" not in slo:
+        sys.exit(f"/v2/slo smoke failed: {str(slo)[:200]}")
+    print(f"ops endpoints ok: {len(events['events'])} event(s), "
+          f"slo enabled={slo['enabled']}")
 finally:
     srv.stop()
     engine.shutdown()
 EOF
-pl=$?
-[ "$pl" -ne 0 ] && { echo "promlint FAILED"; rc=1; }
+[ $? -ne 0 ] && { echo "live scrape FAILED"; rc=1; }
+python tools/promlint.py "$SCRAPE_DIR/metrics.txt" \
+    || { echo "promlint (classic) FAILED"; rc=1; }
+python tools/promlint.py --openmetrics "$SCRAPE_DIR/metrics.om.txt" \
+    || { echo "promlint (openmetrics) FAILED"; rc=1; }
+rm -rf "$SCRAPE_DIR"
+
+echo "=== stage 4/4: bench p99 regression gate ==="
+if [ -f BENCH_HISTORY.json ]; then
+    python tools/bench_summary.py --check \
+        || { echo "bench gate FAILED"; rc=1; }
+else
+    echo "no BENCH_HISTORY.json — skipping"
+fi
 
 if [ "$rc" -eq 0 ]; then
     echo "ci_check: ALL STAGES PASSED"

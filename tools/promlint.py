@@ -1,14 +1,21 @@
 #!/usr/bin/env python3
-"""Standalone Prometheus text-exposition (0.0.4) linter.
+"""Standalone Prometheus text-exposition linter (0.0.4 + OpenMetrics 1.0).
 
 Validates what scrapers actually trip over: HELP/TYPE/sample ordering per
 family, re-opened families, metric/label name syntax, label-string escaping,
 and histogram invariants (cumulative le-buckets, terminal +Inf == _count,
-_sum present). Stdlib only, so it runs inside tier-1 tests and against any
-live endpoint:
+_sum present). OpenMetrics mode — auto-detected from a ``# EOF`` line, or
+forced with ``--openmetrics`` — additionally checks exemplar syntax
+(``... # {trace_id="..."} <value>``, only on _bucket/_total samples, label
+payload within the 128-rune budget), requires the ``# EOF`` terminator to
+be the final content, and requires counter samples to carry the ``_total``
+suffix. Stdlib only, so it runs inside tier-1 tests and against any live
+endpoint:
 
     python tools/promlint.py metrics.txt
     curl -s localhost:8000/metrics | python tools/promlint.py
+    curl -s -H 'Accept: application/openmetrics-text' \
+        localhost:8000/metrics | python tools/promlint.py --openmetrics
 
 Exit status 0 when clean, 1 with one "line N: message" per finding.
 """
@@ -25,6 +32,8 @@ LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 _PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\[\\"n])*)"')
 _SAMPLE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)(?:\s+(-?\d+))?$")
+# OpenMetrics exemplar payload: "{labels} value [timestamp]" after " # ".
+_EXEMPLAR_RE = re.compile(r"^(\{.*\})\s+(\S+)(?:\s+(\S+))?$")
 
 VALID_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
 
@@ -74,18 +83,31 @@ class _Family:
         self.closed = False
 
 
-def lint(text: str) -> list[str]:
+def lint(text: str, openmetrics: bool | None = None) -> list[str]:
     """Lint exposition text; returns ["line N: message", ...] (empty when
-    clean)."""
+    clean). ``openmetrics`` forces the exposition dialect; None
+    auto-detects it from the presence of a ``# EOF`` line."""
+    lines = text.splitlines()
+    if openmetrics is None:
+        openmetrics = any(ln.rstrip() == "# EOF" for ln in lines)
     errors: list[str] = []
     families: dict[str, _Family] = {}
     current: str | None = None
+    eof_line: int | None = None
 
     def fam(name: str) -> _Family:
         return families.setdefault(name, _Family())
 
-    for lineno, line in enumerate(text.splitlines(), start=1):
+    for lineno, line in enumerate(lines, start=1):
         if not line.strip():
+            continue
+        if eof_line is not None:
+            errors.append(
+                f"line {lineno}: content after '# EOF' terminator "
+                f"(line {eof_line})")
+            continue
+        if openmetrics and line.rstrip() == "# EOF":
+            eof_line = lineno
             continue
         if line.startswith("# HELP ") or line.startswith("# TYPE "):
             kind_of_comment = line[2:6]
@@ -131,6 +153,10 @@ def lint(text: str) -> list[str]:
             continue
         if line.startswith("#"):
             continue  # free-form comment
+        exemplar = None
+        if openmetrics and " # " in line:
+            line, _, ex_text = line.partition(" # ")
+            exemplar = (ex_text, lineno)
         m = _SAMPLE_RE.match(line)
         if not m:
             errors.append(f"line {lineno}: unparseable sample: {line!r}")
@@ -168,6 +194,12 @@ def lint(text: str) -> list[str]:
             errors.append(
                 f"line {lineno}: sample '{sname}' does not match family "
                 f"'{family_name}' of type '{f.kind}'")
+        if openmetrics and f.kind == "counter" and sname == family_name:
+            errors.append(
+                f"line {lineno}: OpenMetrics counter sample '{sname}' must "
+                "carry the '_total' suffix")
+        if exemplar is not None:
+            errors.extend(_check_exemplar(exemplar[0], exemplar[1], sname))
         f.samples.append((lineno, sname, labels, value))
         if current is not None and current != family_name:
             fam(current).closed = True
@@ -176,6 +208,51 @@ def lint(text: str) -> list[str]:
     for name, f in families.items():
         if f.kind == "histogram":
             errors.extend(_check_histogram(name, f))
+    if openmetrics and eof_line is None:
+        errors.append(
+            f"line {len(lines) or 1}: OpenMetrics exposition missing the "
+            "'# EOF' terminator")
+    return errors
+
+
+def _check_exemplar(ex_text: str, lineno: int, sname: str) -> list[str]:
+    """Validate one exemplar payload (the part after ``sample # ``).
+    Exemplars are only legal on histogram buckets and counter samples."""
+    errors: list[str] = []
+    if not (sname.endswith("_bucket") or sname.endswith("_total")):
+        errors.append(
+            f"line {lineno}: exemplar on '{sname}' (only _bucket and "
+            "_total samples may carry exemplars)")
+    m = _EXEMPLAR_RE.match(ex_text)
+    if not m:
+        errors.append(
+            f"line {lineno}: malformed exemplar {ex_text!r} (expected "
+            "'{{labels}} value [timestamp]')")
+        return errors
+    labels, err = _parse_labels(m.group(1))
+    if err:
+        errors.append(f"line {lineno}: exemplar {err}")
+    else:
+        for lname in labels:
+            if not LABEL_NAME_RE.match(lname):
+                errors.append(
+                    f"line {lineno}: invalid exemplar label name {lname!r}")
+        runes = sum(len(k) + len(v) for k, v in labels.items())
+        if runes > 128:
+            errors.append(
+                f"line {lineno}: exemplar label set is {runes} runes "
+                "(OpenMetrics caps it at 128)")
+    try:
+        float(m.group(2))
+    except ValueError:
+        errors.append(
+            f"line {lineno}: invalid exemplar value {m.group(2)!r}")
+    if m.group(3) is not None:
+        try:
+            float(m.group(3))
+        except ValueError:
+            errors.append(
+                f"line {lineno}: invalid exemplar timestamp {m.group(3)!r}")
     return errors
 
 
@@ -235,12 +312,17 @@ def _check_histogram(name: str, f: _Family) -> list[str]:
 
 
 def main(argv: list[str]) -> int:
-    if len(argv) > 1 and argv[1] not in ("-", "--"):
-        with open(argv[1], encoding="utf-8") as fh:
+    openmetrics = None
+    args = [a for a in argv[1:] if a not in ("-", "--")]
+    if "--openmetrics" in args:
+        openmetrics = True
+        args.remove("--openmetrics")
+    if args:
+        with open(args[0], encoding="utf-8") as fh:
             text = fh.read()
     else:
         text = sys.stdin.read()
-    errors = lint(text)
+    errors = lint(text, openmetrics=openmetrics)
     for e in errors:
         print(e, file=sys.stderr)
     if errors:
