@@ -590,6 +590,26 @@ def bench_inproc_simple(concurrency: int = BENCH_CONCURRENCY):
             res["hist_p99_us"] = round(scrape.quantile(d, 0.99), 1)
             log(f"simple: histogram-derived p50 {res['hist_p50_us']}us, "
                 f"p99 {res['hist_p99_us']}us over {int(d['count'])} requests")
+    # Efficiency counters from the always-on profiler: how full the padded
+    # batches ran, how much device time padding wasted, and what compiling
+    # cost — the context a throughput number needs to be actionable.
+    try:
+        psnap = engine.profile_snapshot(model="simple")
+        pm = next(iter(psnap["models"].values()), None)
+        if pm is not None:
+            rows = sum(b["rows"] for b in pm["buckets"])
+            padded = sum(b["padded_rows"] for b in pm["buckets"])
+            res["fill_ratio"] = (round(rows / (rows + padded), 4)
+                                 if rows + padded else 1.0)
+            res["duty_cycle"] = psnap["duty_cycle"]
+            res["xla_compiles"] = pm["compilations"]
+            res["pad_waste_device_s"] = round(
+                pm["padding_waste_device_s"], 4)
+            log(f"simple: fill_ratio {res['fill_ratio']}, duty_cycle "
+                f"{res['duty_cycle']}, {res['xla_compiles']} XLA compiles, "
+                f"padding waste {res['pad_waste_device_s']}s device")
+    except Exception as exc:  # noqa: BLE001 — profiler must not sink bench
+        log(f"profiler snapshot unavailable: {exc}")
     if profile is not None:
         # Overload-protection counters + a real graceful drain instead of
         # the abrupt shutdown: chaos runs report what the admission layer
@@ -1681,15 +1701,16 @@ def _main():
                         "p99_us": round(s["p99_us"], 1),
                         "stable": s["stable"],
                         "windows": s["windows"]})
-        hist = {}
-        for k in ("hist_p50_us", "hist_p99_us"):
+        extra = {}
+        for k in ("hist_p50_us", "hist_p99_us", "fill_ratio", "duty_cycle",
+                  "xla_compiles", "pad_waste_device_s"):
             if k in s:
                 _RESULT[k] = s[k]
-                hist[k] = s[k]
+                extra[k] = s[k]
         _append_history({"probe": "simple", "metric": "inproc_simple_ips",
                          "value": s["ips"], "p99_us": s["p99_us"],
                          "stable": s["stable"], "windows": s["windows"],
-                         **hist})
+                         **extra})
 
     def _rec_bert(b):
         _RESULT["bert_b8_ips"] = round(b["ips"], 2)

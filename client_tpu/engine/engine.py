@@ -91,6 +91,15 @@ class TpuEngine:
 
         self.events = journal()
         self.slo = SloTracker.from_env(registry=self.metrics.registry)
+        # Efficiency profiler (process-global, like the fault registry:
+        # models record into it from below the engine). Binding exports
+        # tpu_batch_fill_ratio / tpu_padded_rows_total /
+        # tpu_xla_compilations_total / tpu_xla_compile_seconds /
+        # tpu_device_seconds_total / tpu_device_duty_cycle here.
+        from client_tpu.observability.profiler import profiler as _profiler
+
+        self.profiler = _profiler()
+        self.profiler.bind_metrics(self.metrics.registry)
         self._last_health: str | None = None
         # Admission controller: load shedding + in-flight accounting. The
         # default (CLIENT_TPU_ADMISSION unset) admits everything but still
@@ -607,8 +616,9 @@ class TpuEngine:
                 getattr(sched, "active_batches", 0),
                 model=model_name, version=version)
         self.metrics.update_device_gauges()
-        # Refresh SLO burn gauges at scrape time so a quiet period still
-        # reads current windows.
+        # Duty-cycle and SLO burn gauges refresh at scrape time so a
+        # quiet period still reads current windows.
+        self.profiler.update_gauges()
         if self.slo.enabled:
             self.slo.snapshot()
         if openmetrics:
@@ -628,6 +638,12 @@ class TpuEngine:
     def slo_snapshot(self) -> dict:
         """``GET /v2/slo`` body: per-model window counts and burn rates."""
         return self.slo.snapshot()
+
+    def profile_snapshot(self, model: str | None = None) -> dict:
+        """``GET /v2/profile`` body: per-model/per-bucket efficiency cost
+        table (fill ratios, padding-waste device-seconds, compile counts,
+        duty cycle) with a suggested bucket-ladder tweak."""
+        return self.profiler.snapshot(model=model)
 
     # -- trace (device profiling) --------------------------------------------
 

@@ -29,6 +29,7 @@ from client_tpu import faults
 from client_tpu.engine.backend_init import log as _log
 from client_tpu.engine.config import ModelConfig
 from client_tpu.engine.types import DeadlineExpired, EngineError, now_ns
+from client_tpu.observability.profiler import profiler as _profiler
 from client_tpu.protocol.dtypes import wire_to_np_dtype
 
 
@@ -47,6 +48,11 @@ class ExecPhases:
     input_end: int = 0    # inputs resident in HBM
     infer_end: int = 0    # XLA executable complete
     output_end: int = 0   # outputs on host (or staged to shm)
+    # First call for this input signature: the infer interval includes the
+    # XLA trace+compile, measured here so schedulers/frontends can flag
+    # the request cold (Server-Timing `compile`, trace span args) and the
+    # profiler can keep compile time out of the duty-cycle window.
+    compile_ns: int = 0
 
 
 class ModelBackend:
@@ -351,9 +357,11 @@ class Model:
                 self._jax.block_until_ready(device_outs)
             if first:
                 self._compiled.add(sig)
+                phases.compile_ns = now_ns() - phases.input_end
                 _log.info("model '%s': compiled bucket=%s in %.1fs",
-                          cfg.name, pad_to,
-                          (now_ns() - phases.input_end) / 1e9)
+                          cfg.name, pad_to, phases.compile_ns / 1e9)
+                _profiler().record_compile(
+                    cfg.name, cfg.version, pad_to, phases.compile_ns)
             phases.infer_end = now_ns()
             self._set_state("fetching outputs")
             host: dict[str, np.ndarray] = {}
@@ -371,6 +379,15 @@ class Model:
                     arr = arr[:batch_size]
                 host[name] = arr
             phases.output_end = now_ns()
+            # Efficiency attribution: one profiler record per batch (not
+            # per request) keeps the always-on cost under a microsecond.
+            _profiler().record_execution(
+                cfg.name, cfg.version, pad_to,
+                rows=batch_size if batch_size is not None else 1,
+                device_ns=phases.infer_end - phases.input_end,
+                host_ns=(phases.input_end - phases.start)
+                + (phases.output_end - phases.infer_end),
+                cold=bool(phases.compile_ns))
             return host, phases
         finally:
             # Always clear: a raise mid-compile must not leave a stale

@@ -593,6 +593,9 @@ class DefaultScheduler(Scheduler):
         req.times.compute_input_end = phases.input_end
         req.times.compute_infer_end = phases.infer_end
         req.times.compute_output_end = now_ns()
+        # Cold-start attribution: every member of a batch that paid the
+        # XLA compile carries it (the whole batch waited on the trace).
+        req.times.compile_ns = getattr(phases, "compile_ns", 0)
         if req.outputs:
             requested = {o.name for o in req.outputs}
             outputs = {k: v for k, v in outputs.items() if k in requested}

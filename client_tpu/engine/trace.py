@@ -48,10 +48,19 @@ class TraceManager:
         with self._lock:
             # Deactivation first: {"trace_level": ["OFF"], "log_dir": new}
             # is the natural stop-and-redirect call and must succeed.
+            # Deactivating when no trace is active is a no-op, and a jax
+            # error on stop (jax never actually started one — e.g. an
+            # earlier start failed halfway, or something else stopped the
+            # process-wide profiler) must not wedge this manager active:
+            # either way the trace is not running, which is what the
+            # caller asked for.
             if want_active is False and self._active:
                 import jax
 
-                jax.profiler.stop_trace()
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001 — already stopped
+                    pass
                 self._active = False
             if log_dir:
                 if self._active:
@@ -64,7 +73,20 @@ class TraceManager:
                         "trace activation requires a log_dir", 400)
                 import jax
 
-                jax.profiler.start_trace(self._log_dir)
+                try:
+                    jax.profiler.start_trace(self._log_dir)
+                except Exception as exc:
+                    # A failed start must not leave _active=True (the
+                    # next OFF would then call stop_trace on a profiler
+                    # that never started). Best-effort stop clears any
+                    # half-initialised jax profiler state so a later
+                    # start can succeed.
+                    try:
+                        jax.profiler.stop_trace()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    raise EngineError(
+                        f"failed to start device trace: {exc}", 500)
                 self._active = True
         return self.setting()
 

@@ -49,6 +49,11 @@ class RequestTimes:
     compute_input_end: int = 0    # inputs on device
     compute_infer_end: int = 0    # executable done
     compute_output_end: int = 0   # outputs staged for the frontend
+    # XLA compile time paid inside compute_infer (first call of this
+    # request's bucket signature; 0 on warm requests). Lets frontends mark
+    # the response cold (Server-Timing `compile` entry / server_compile_us
+    # parameter) so clients can tell compile-hit outliers from queueing.
+    compile_ns: int = 0
 
     @property
     def queue_ns(self) -> int:

@@ -191,12 +191,13 @@ class InferResult:
 
     def server_timing(self):
         """Server-side phase durations in microseconds
-        ({queue, compute_input, compute_infer, compute_output}), from the
+        ({queue, compute_input, compute_infer, compute_output}, plus
+        ``compile`` when this request paid an XLA compile), from the
         ``server_*_us`` response parameters; empty if absent."""
         params = self._response_params()
         out = {}
         for phase in ("queue", "compute_input", "compute_infer",
-                      "compute_output"):
+                      "compute_output", "compile"):
             v = params.get(f"server_{phase}_us")
             if v is not None:
                 out[phase] = float(v)
@@ -552,6 +553,18 @@ class InferenceServerClient:
             ops.SloStatusRequest(model=model_name),
             self._md(headers), client_timeout)
         return json.loads(response.slo_json)
+
+    def get_profile(self, model_name="", headers=None, client_timeout=None):
+        """Efficiency profiler cost table (gRPC mirror of
+        ``GET /v2/profile``): per-model/per-bucket fill ratios,
+        padding-waste device-seconds, compile counts, duty cycle."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        response = self._unary(
+            self._client_stub.Profile,
+            ops.ProfileRequest(model=model_name),
+            self._md(headers), client_timeout)
+        return json.loads(response.profile_json)
 
     # -- shared memory -------------------------------------------------------
 

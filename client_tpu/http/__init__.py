@@ -681,6 +681,15 @@ class InferenceServerClient:
         """Per-model SLO burn-rate report (``GET /v2/slo``)."""
         return self._get_json("/v2/slo", query_params, headers)
 
+    def get_profile(self, model_name="", headers=None, query_params=None):
+        """Efficiency profiler cost table (``GET /v2/profile``): per-model
+        per-bucket fill ratios, padding-waste device-seconds, compile
+        counts, device duty cycle, and a suggested bucket-ladder tweak."""
+        qp = dict(query_params or {})
+        if model_name:
+            qp["model"] = model_name
+        return self._get_json("/v2/profile", qp or None, headers)
+
     # -- inference -----------------------------------------------------------
 
     @staticmethod

@@ -17,6 +17,10 @@ Three pieces, threaded through every layer of the stack:
   journal (``GET /v2/events``) plus the CLIENT_TPU_LOG=json sink.
 - :mod:`client_tpu.observability.slo` — per-model multi-window SLO
   burn-rate tracking (``GET /v2/slo``, ``tpu_slo_*`` gauges).
+- :mod:`client_tpu.observability.profiler` — always-on efficiency
+  profiler: batch-fill cost attribution, XLA compile telemetry, device
+  duty-cycle (``GET /v2/profile``, ``tpu_batch_fill_ratio`` /
+  ``tpu_xla_*`` / ``tpu_device_*`` families).
 
 See docs/OBSERVABILITY.md for the metric vocabulary and wire formats.
 """
@@ -28,6 +32,11 @@ from client_tpu.observability.events import (  # noqa: F401
     configure_logging,
     journal,
     reset_journal,
+)
+from client_tpu.observability.profiler import (  # noqa: F401
+    EfficiencyProfiler,
+    profiler,
+    reset_profiler,
 )
 from client_tpu.observability.slo import SloConfig, SloTracker  # noqa: F401
 from client_tpu.observability.metrics import (  # noqa: F401

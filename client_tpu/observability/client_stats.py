@@ -39,6 +39,11 @@ class InferStat:
         # carries a trace) — the handle for jumping from client stats to
         # the server's /v2/events and /v2/trace/requests timelines.
         self.last_trace_id = ""
+        # Cold-start attribution: requests whose Server-Timing carried a
+        # `compile` entry (server_compile_us over gRPC) paid an XLA
+        # compile — their latency outlier is compile, not queueing.
+        self.cold_start_count = 0
+        self.last_compile_s = 0.0
 
     def record(self, round_trip_us: float,
                server_timing: dict | None = None,
@@ -58,6 +63,10 @@ class InferStat:
                     server_timing.get("compute_infer", 0.0)
                 self.cumulative_server_compute_output_us += \
                     server_timing.get("compute_output", 0.0)
+                compile_us = server_timing.get("compile", 0.0)
+                if compile_us > 0:
+                    self.cold_start_count += 1
+                    self.last_compile_s = compile_us / 1e6
 
     def record_retry(self) -> None:
         with self._lock:
@@ -90,4 +99,6 @@ class InferStat:
                 "stale_socket_retry_count": self.stale_socket_retry_count,
                 "breaker_rejected_count": self.breaker_rejected_count,
                 "last_trace_id": self.last_trace_id,
+                "cold_start_count": self.cold_start_count,
+                "last_compile_s": round(self.last_compile_s, 6),
             }

@@ -1,0 +1,392 @@
+"""Continuous efficiency profiler: where does the device time go?
+
+PR-1 tracing answers "where did THIS request spend its time" and PR-4
+events/SLO answer "is the server healthy"; this module answers the cost
+question the ROADMAP north-star ("as fast as the hardware allows") is
+ultimately judged by: which model/bucket pairs burn device seconds, how
+much of every padded batch is real work, and how often XLA recompiles.
+
+Three always-on signals, recorded from ``Model.execute_timed`` at a cost
+of a few dict operations per *batch* (not per request):
+
+- **Batch-fill cost attribution** — per (model, version, bucket): call
+  counts, real vs padded rows, device/host time totals + per-call EWMA.
+  Rendered as the ``tpu_batch_fill_ratio`` histogram and the
+  ``tpu_padded_rows_total`` counter; the padding-waste estimate in
+  :meth:`EfficiencyProfiler.snapshot` is ``device_s * padded/(real+padded)``
+  — the device seconds spent multiplying zeros.
+- **Compile telemetry** — every first-call XLA trace of a bucket counts on
+  ``tpu_xla_compilations_total{model,version,bucket}``, observes
+  ``tpu_xla_compile_seconds``, and emits a ``compile.finished`` event into
+  the PR-4 journal. Cold executions are excluded from device-time
+  accumulation so one 30 s compile doesn't masquerade as load.
+- **Device duty-cycle** — a sliding window (default 60 s,
+  ``CLIENT_TPU_PROFILE_WINDOW_S``) of executable-busy intervals, sampled
+  at scrape time into the ``tpu_device_duty_cycle`` gauge (busy device
+  time / wall time; can exceed 1.0 when model instances execute
+  concurrently on multiple devices) plus the per-model
+  ``tpu_device_seconds_total`` counter.
+
+Like the fault registry and the event journal, the profiler is
+process-global (:func:`profiler`) because models execute below the engine
+and must not hold engine references; each engine binds its own
+``MetricRegistry`` via :meth:`EfficiencyProfiler.bind_metrics` (per-registry
+weakrefs — dead engines are pruned, rebinding replaces). The JSON cost
+table behind ``GET /v2/profile`` / the ``Profile`` RPC comes from
+:meth:`EfficiencyProfiler.snapshot`; ``tools/profile_report.py``
+pretty-prints it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from dataclasses import dataclass, field
+
+# Fill ratio lives in (0, 1]; power-of-two ladders can't go below 0.5 but
+# custom ladders (and max_batch_size overflow buckets) can.
+FILL_RATIO_BUCKETS = (0.25, 0.5, 0.625, 0.75, 0.875, 1.0)
+# First compiles run 20-40 s on TPU, sub-second on CPU tests.
+COMPILE_SECONDS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                           20.0, 40.0, 80.0, 160.0)
+
+# EWMA smoothing for per-call device/host time (~last 10 calls dominate).
+_EWMA_ALPHA = 0.2
+
+# A bucket ladder tweak is only suggested once a bucket has enough calls
+# to make its fill ratio meaningful, and only when it wastes real time.
+_SUGGEST_MIN_CALLS = 8
+_SUGGEST_MAX_FILL = 0.85
+
+
+@dataclass
+class _BucketCost:
+    """Accumulated cost of one (model, version, bucket) execution shape."""
+
+    calls: int = 0
+    cold_calls: int = 0
+    rows: int = 0            # real rows executed
+    padded_rows: int = 0     # zero rows added to reach the bucket
+    device_ns: int = 0       # executable time, warm calls only
+    host_ns: int = 0         # staging + fetch host time, warm calls only
+    device_ns_ewma: float = 0.0
+    host_ns_ewma: float = 0.0
+    compile_count: int = 0
+    compile_ns: int = 0
+    max_rows: int = 0
+
+    def fill_ratio(self) -> float:
+        total = self.rows + self.padded_rows
+        return (self.rows / total) if total else 1.0
+
+    def padding_waste_device_s(self) -> float:
+        """Device seconds spent on padding rows: the executable runs the
+        full bucket, so the padded fraction of its time is pure waste."""
+        total = self.rows + self.padded_rows
+        if not total or not self.padded_rows:
+            return 0.0
+        return (self.device_ns / 1e9) * (self.padded_rows / total)
+
+
+class _Bound:
+    """One engine registry's instrument handles (see bind_metrics)."""
+
+    __slots__ = ("registry_ref", "fill_ratio", "padded_rows",
+                 "compilations", "compile_seconds", "device_seconds",
+                 "duty_cycle")
+
+    def __init__(self, registry):
+        self.registry_ref = weakref.ref(registry)
+        self.fill_ratio = registry.histogram(
+            "tpu_batch_fill_ratio",
+            "Real rows / padded bucket rows per device execution",
+            ("model", "version"), buckets=FILL_RATIO_BUCKETS)
+        self.padded_rows = registry.counter(
+            "tpu_padded_rows_total",
+            "Zero rows added to reach the batch bucket (pure device waste)",
+            ("model", "version", "bucket"))
+        self.compilations = registry.counter(
+            "tpu_xla_compilations_total",
+            "XLA compilations (first call per model/bucket signature)",
+            ("model", "version", "bucket"))
+        self.compile_seconds = registry.histogram(
+            "tpu_xla_compile_seconds",
+            "XLA compile duration per first-call bucket trace (seconds)",
+            ("model", "version"), buckets=COMPILE_SECONDS_BUCKETS)
+        self.device_seconds = registry.counter(
+            "tpu_device_seconds_total",
+            "Cumulative executable-busy device time (warm executions)",
+            ("model", "version"))
+        self.duty_cycle = registry.gauge(
+            "tpu_device_duty_cycle",
+            "Busy device time / wall time over the profiler window "
+            "(sampled at scrape; >1.0 means concurrent instances)")
+        self.duty_cycle.set(0.0)
+
+
+class EfficiencyProfiler:
+    """Low-overhead always-on cost attribution; see module docstring."""
+
+    def __init__(self, window_s: float | None = None, now=time.monotonic_ns):
+        if window_s is None:
+            window_s = float(os.environ.get(
+                "CLIENT_TPU_PROFILE_WINDOW_S", "60"))
+        self.window_s = max(1.0, window_s)
+        self._now = now
+        self._t0 = now()
+        self._lock = threading.Lock()
+        self._costs: dict[tuple[str, str, int], _BucketCost] = {}
+        # (end_mono_ns, device_ns) of warm executions inside the window.
+        self._busy: deque[tuple[int, int]] = deque()
+        self._bound: dict[int, _Bound] = {}
+
+    # -- metric binding ------------------------------------------------------
+
+    def bind_metrics(self, registry) -> None:
+        """Declare the profiler's metric families on an engine's
+        MetricRegistry and mirror every later observation into it.
+        Idempotent per registry; multiple engines may bind; dead
+        registries are pruned on the next record."""
+        b = _Bound(registry)
+        with self._lock:
+            self._bound[id(registry)] = b
+
+    def _bindings(self) -> list[_Bound]:
+        with self._lock:
+            out = []
+            for rid, b in list(self._bound.items()):
+                if b.registry_ref() is None:
+                    del self._bound[rid]
+                else:
+                    out.append(b)
+            return out
+
+    # -- recording (the hot path) -------------------------------------------
+
+    def record_execution(self, model: str, version, bucket: int | None,
+                         rows: int, device_ns: int, host_ns: int = 0,
+                         cold: bool = False) -> None:
+        """One device execution: ``rows`` real rows padded up to
+        ``bucket`` (None/0 = unbatched model, no padding), taking
+        ``device_ns`` in the executable and ``host_ns`` in staging+fetch.
+        ``cold=True`` (first call, XLA traced) keeps the call/row counts
+        but excludes the interval from device-time accumulation — it is
+        compile, not load, and is accounted by :meth:`record_compile`."""
+        key = (str(model), str(version), int(bucket or 0))
+        rows = max(0, int(rows))
+        padded = max(0, key[2] - rows) if key[2] else 0
+        end = self._now()
+        with self._lock:
+            c = self._costs.get(key)
+            if c is None:
+                c = self._costs[key] = _BucketCost()
+            c.calls += 1
+            c.rows += rows
+            c.padded_rows += padded
+            c.max_rows = max(c.max_rows, rows)
+            if cold:
+                c.cold_calls += 1
+            else:
+                c.device_ns += max(0, device_ns)
+                c.host_ns += max(0, host_ns)
+                c.device_ns_ewma = (
+                    device_ns if c.device_ns_ewma == 0.0
+                    else _EWMA_ALPHA * device_ns
+                    + (1 - _EWMA_ALPHA) * c.device_ns_ewma)
+                c.host_ns_ewma = (
+                    host_ns if c.host_ns_ewma == 0.0
+                    else _EWMA_ALPHA * host_ns
+                    + (1 - _EWMA_ALPHA) * c.host_ns_ewma)
+                self._busy.append((end, max(0, device_ns)))
+                self._prune_locked(end)
+        fill = (rows / key[2]) if key[2] else 1.0
+        for b in self._bindings():
+            b.fill_ratio.observe(fill, model=key[0], version=key[1])
+            if padded:
+                b.padded_rows.inc(padded, model=key[0], version=key[1],
+                                  bucket=str(key[2]))
+            if not cold and device_ns > 0:
+                b.device_seconds.inc(device_ns / 1e9,
+                                     model=key[0], version=key[1])
+
+    def record_compile(self, model: str, version, bucket: int | None,
+                       compile_ns: int, trace_id: str | None = None) -> None:
+        """A first-call XLA trace finished: count it, observe its
+        duration, and journal ``compile.finished``."""
+        key = (str(model), str(version), int(bucket or 0))
+        with self._lock:
+            c = self._costs.get(key)
+            if c is None:
+                c = self._costs[key] = _BucketCost()
+            c.compile_count += 1
+            c.compile_ns += max(0, compile_ns)
+        for b in self._bindings():
+            b.compilations.inc(model=key[0], version=key[1],
+                               bucket=str(key[2]))
+            b.compile_seconds.observe(compile_ns / 1e9,
+                                      model=key[0], version=key[1])
+        # Lazy import: observability.metrics users must not pull in the
+        # journal (and its env wiring) just by importing this module.
+        from client_tpu.observability.events import journal
+
+        journal().emit("compile", "finished", model=key[0],
+                       version=key[1], trace_id=trace_id,
+                       bucket=key[2], compile_s=round(compile_ns / 1e9, 3))
+
+    # -- duty cycle ----------------------------------------------------------
+
+    def _prune_locked(self, now: int) -> None:
+        horizon = now - int(self.window_s * 1e9)
+        while self._busy and self._busy[0][0] < horizon:
+            self._busy.popleft()
+
+    def duty_cycle(self) -> float:
+        """Busy device time / wall time over the sliding window. Intervals
+        straddling the window edge contribute their overlap only."""
+        now = self._now()
+        window_ns = int(self.window_s * 1e9)
+        start = now - window_ns
+        with self._lock:
+            self._prune_locked(now)
+            busy = 0
+            for end, dur in self._busy:
+                busy += min(end, now) - max(end - dur, start)
+        wall = min(window_ns, max(1, now - self._t0))
+        return busy / wall
+
+    def update_gauges(self) -> None:
+        """Refresh ``tpu_device_duty_cycle`` on every bound registry;
+        called at scrape time so a quiet period still reads current."""
+        duty = self.duty_cycle()
+        for b in self._bindings():
+            b.duty_cycle.set(round(duty, 6))
+
+    # -- report ---------------------------------------------------------------
+
+    def snapshot(self, model: str | None = None) -> dict:
+        """The ``GET /v2/profile`` body: per-model/per-bucket cost table
+        with padding-waste estimates and a bucket-ladder suggestion."""
+        with self._lock:
+            items = sorted(self._costs.items())
+        models: dict[str, dict] = {}
+        for (mname, version, bucket), c in items:
+            if model and mname != model:
+                continue
+            mkey = f"{mname}:{version}"
+            entry = models.get(mkey)
+            if entry is None:
+                entry = models[mkey] = {
+                    "model": mname, "version": version,
+                    "device_s": 0.0, "host_s": 0.0,
+                    "padding_waste_device_s": 0.0,
+                    "compilations": 0, "compile_s": 0.0,
+                    "buckets": [], "suggestion": None,
+                }
+            waste = c.padding_waste_device_s()
+            entry["device_s"] += c.device_ns / 1e9
+            entry["host_s"] += c.host_ns / 1e9
+            entry["padding_waste_device_s"] += waste
+            entry["compilations"] += c.compile_count
+            entry["compile_s"] += c.compile_ns / 1e9
+            entry["buckets"].append({
+                "bucket": bucket,
+                "executions": c.calls,
+                "cold_executions": c.cold_calls,
+                "rows": c.rows,
+                "padded_rows": c.padded_rows,
+                "max_rows": c.max_rows,
+                "fill_ratio": round(c.fill_ratio(), 4),
+                "device_s": round(c.device_ns / 1e9, 6),
+                "host_s": round(c.host_ns / 1e9, 6),
+                "device_s_per_call_ewma": round(c.device_ns_ewma / 1e9, 6),
+                "host_s_per_call_ewma": round(c.host_ns_ewma / 1e9, 6),
+                "padding_waste_device_s": round(waste, 6),
+                "compilations": c.compile_count,
+                "compile_s": round(c.compile_ns / 1e9, 6),
+            })
+        for entry in models.values():
+            entry["device_s"] = round(entry["device_s"], 6)
+            entry["host_s"] = round(entry["host_s"], 6)
+            entry["compile_s"] = round(entry["compile_s"], 6)
+            entry["padding_waste_device_s"] = round(
+                entry["padding_waste_device_s"], 6)
+            entry["suggestion"] = _suggest_bucket_tweak(entry["buckets"])
+        return {
+            "window_s": self.window_s,
+            "duty_cycle": round(self.duty_cycle(), 6),
+            "models": models,
+        }
+
+    def reset(self) -> None:
+        """Drop accumulated costs (tests); metric bindings survive."""
+        with self._lock:
+            self._costs.clear()
+            self._busy.clear()
+            self._t0 = self._now()
+
+
+def _suggest_bucket_tweak(buckets: list[dict]) -> dict | None:
+    """Greedy ladder tweak: the bucket wasting the most device time on
+    padding, with enough calls to trust its fill ratio and headroom below
+    it (max observed rows < bucket), suggests inserting a bucket at the
+    observed row high-water mark. Returns None when the ladder looks
+    right-sized."""
+    worst = None
+    for b in buckets:
+        if b["bucket"] <= 1 or b["executions"] < _SUGGEST_MIN_CALLS:
+            continue
+        if b["fill_ratio"] >= _SUGGEST_MAX_FILL:
+            continue
+        if b["max_rows"] >= b["bucket"]:
+            continue
+        if worst is None or (b["padding_waste_device_s"]
+                             > worst["padding_waste_device_s"]):
+            worst = b
+    if worst is None:
+        return None
+    suggested = max(1, worst["max_rows"])
+    # Executable time scales ~linearly with bucket rows on TPU, so
+    # re-landing these executions on the smaller bucket saves the row
+    # fraction of their device time.
+    saving = worst["device_s"] * (1 - suggested / worst["bucket"])
+    return {
+        "action": "add_bucket",
+        "bucket": suggested,
+        "below": worst["bucket"],
+        "fill_ratio": worst["fill_ratio"],
+        "est_saving_device_s": round(saving, 6),
+        "reason": (f"bucket {worst['bucket']} ran {worst['executions']} "
+                   f"executions at {worst['fill_ratio']:.0%} fill "
+                   f"(max {worst['max_rows']} real rows); a "
+                   f"{suggested}-row bucket would absorb them"),
+    }
+
+
+# -- process-global default profiler ------------------------------------------
+
+_default: EfficiencyProfiler | None = None
+_default_lock = threading.Lock()
+
+
+def profiler() -> EfficiencyProfiler:
+    """The process-global profiler (double-checked, like
+    :func:`client_tpu.observability.events.journal`): models record into
+    it from below the engine; engines bind their metric registries to it
+    from above."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = EfficiencyProfiler()
+    return _default
+
+
+def reset_profiler() -> None:
+    """Drop the global profiler (tests); the next profiler() recreates it
+    with current env settings."""
+    global _default
+    with _default_lock:
+        _default = None

@@ -96,6 +96,9 @@ class RequestTrace:
     chunk_ts_ns: list[int] = field(default_factory=list)
     error: str = ""
     wall_time_ms: int = 0
+    # XLA compile ns paid inside compute_infer (0 on warm requests); the
+    # cold/warm flag rides the request span's args in the Chrome export.
+    compile_ns: int = 0
 
 
 def build_request_trace(ctx: TraceContext, model_name: str, request_id: str,
@@ -128,7 +131,8 @@ def build_request_trace(ctx: TraceContext, model_name: str, request_id: str,
         parent_span_id=ctx.parent_span_id, model_name=model_name,
         request_id=request_id, ok=ok, spans=spans,
         chunk_ts_ns=list(chunks)[:MAX_CHUNK_EVENTS], error=error,
-        wall_time_ms=int(time.time() * 1000))
+        wall_time_ms=int(time.time() * 1000),
+        compile_ns=getattr(times, "compile_ns", 0))
 
 
 class TraceStore:
@@ -160,7 +164,9 @@ class TraceStore:
         for tid, t in enumerate(self.snapshot(trace_id), start=1):
             args = {"trace_id": t.trace_id, "span_id": t.span_id,
                     "model": t.model_name, "request_id": t.request_id,
-                    "ok": t.ok}
+                    "ok": t.ok, "cold_start": t.compile_ns > 0}
+            if t.compile_ns:
+                args["compile_ms"] = round(t.compile_ns / 1e6, 3)
             if t.parent_span_id:
                 args["parent_span_id"] = t.parent_span_id
             if t.error:
@@ -190,13 +196,18 @@ class TraceStore:
 
 
 def server_timing_header(times) -> str:
-    """``Server-Timing`` response header (durations in ms per the spec)."""
+    """``Server-Timing`` response header (durations in ms per the spec).
+    Requests that paid an XLA compile carry an extra ``compile`` entry so
+    clients can attribute the latency outlier (InferStat cold-start)."""
     parts = []
     for phase, ns in (("queue", times.queue_ns),
                       ("compute_input", times.compute_input_ns),
                       ("compute_infer", times.compute_infer_ns),
                       ("compute_output", times.compute_output_ns)):
         parts.append(f"{phase};dur={ns / 1e6:.3f}")
+    compile_ns = getattr(times, "compile_ns", 0)
+    if compile_ns > 0:
+        parts.append(f"compile;dur={compile_ns / 1e6:.3f}")
     return ", ".join(parts)
 
 

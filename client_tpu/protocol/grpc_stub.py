@@ -54,6 +54,7 @@ _METHODS = [
     # messages hand-built in ops_pb2 — the image carries no protoc).
     ("Events", ops.EventsRequest, ops.EventsResponse, False),
     ("SloStatus", ops.SloStatusRequest, ops.SloStatusResponse, False),
+    ("Profile", ops.ProfileRequest, ops.ProfileResponse, False),
 ]
 
 

@@ -21,10 +21,13 @@ the message classes. Wire-compatible with the equivalent .proto:
                              uint64 next_seq = 2; uint64 dropped = 3; }
     message SloStatusRequest  { string model = 1; }
     message SloStatusResponse { string slo_json = 1; }
+    message ProfileRequest    { string model = 1; }
+    message ProfileResponse   { string profile_json = 1; }
 
-Event.detail_json / SloStatusResponse.slo_json carry the open-ended
-detail/report dicts as JSON strings — same pattern the HTTP frontend
-uses, without freezing their schema into the proto.
+Event.detail_json / SloStatusResponse.slo_json /
+ProfileResponse.profile_json carry the open-ended detail/report dicts as
+JSON strings — same pattern the HTTP frontend uses, without freezing
+their schema into the proto.
 """
 
 from __future__ import annotations
@@ -90,6 +93,12 @@ def _file_proto() -> _descriptor_pb2.FileDescriptorProto:
     m = message("SloStatusResponse")
     field(m, "slo_json", 1, _F.TYPE_STRING)
 
+    m = message("ProfileRequest")
+    field(m, "model", 1, _F.TYPE_STRING)
+
+    m = message("ProfileResponse")
+    field(m, "profile_json", 1, _F.TYPE_STRING)
+
     return fdp
 
 
@@ -109,4 +118,6 @@ __all__ = [
     "EventsResponse",
     "SloStatusRequest",
     "SloStatusResponse",
+    "ProfileRequest",
+    "ProfileResponse",
 ]

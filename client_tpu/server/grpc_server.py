@@ -206,6 +206,11 @@ def _response_to_proto(engine: TpuEngine, req: InferRequest, resp,
                               ("compute_output", t.compute_output_ns)):
                 grpc_codec.set_param(out.parameters,
                                      f"server_{phase}_us", ns // 1000)
+            if getattr(t, "compile_ns", 0) > 0:
+                # Cold-start marker: this request paid the bucket's XLA
+                # compile (InferStat separates it from queueing).
+                grpc_codec.set_param(out.parameters, "server_compile_us",
+                                     t.compile_ns // 1000)
     return out
 
 
@@ -346,6 +351,14 @@ class _Servicer(GRPCInferenceServiceServicer):
             snap["models"] = {k: v for k, v in snap["models"].items()
                               if k == request.model}
         return ops.SloStatusResponse(slo_json=json.dumps(snap))
+
+    def Profile(self, request, context):  # noqa: N802
+        """gRPC mirror of ``GET /v2/profile``: the efficiency profiler's
+        per-model/per-bucket cost table as JSON (open-ended schema)."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        snap = self.engine.profile_snapshot(model=request.model or None)
+        return ops.ProfileResponse(profile_json=json.dumps(snap))
 
     # -- repository ----------------------------------------------------------
 

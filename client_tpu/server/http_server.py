@@ -67,6 +67,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/v2/trace/requests$"), "trace_requests"),
     ("GET", re.compile(r"^/v2/events$"), "events"),
     ("GET", re.compile(r"^/v2/slo$"), "slo"),
+    ("GET", re.compile(r"^/v2/profile$"), "profile"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
 ]
 
@@ -330,6 +331,16 @@ class _Handler(BaseHTTPRequestHandler):
     def h_slo(self):
         """Per-model SLO burn-rate report (``/v2/slo``)."""
         self._send_json(self.engine.slo_snapshot())
+
+    def h_profile(self):
+        """Efficiency profiler cost table (``/v2/profile``): per-model/
+        per-bucket fill ratios, padding-waste device-seconds, compile
+        counts, duty cycle. ``?model=`` filters to one model."""
+        from urllib.parse import parse_qs, urlparse
+
+        q = parse_qs(urlparse(self.path).query)
+        model = (q.get("model") or [None])[0]
+        self._send_json(self.engine.profile_snapshot(model=model))
 
     def h_trace_setting(self):
         self._send_json(self.engine.trace_setting())

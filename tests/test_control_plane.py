@@ -399,7 +399,11 @@ class TestPromlintOpenMetrics:
         assert any("128" in e for e in errs)
 
     def test_classic_mode_unaffected_by_om_rules(self):
-        classic = "# HELP c Total.\n# TYPE c counter\nc 5\n"
+        # A classic counter family carries _total on the family name
+        # itself — the exact shape the OM dialect forbids (families there
+        # advertise the base name). Clean here proves OM-only rules
+        # (family naming, EOF, exemplar placement) don't leak.
+        classic = "# HELP c_total Total.\n# TYPE c_total counter\nc_total 5\n"
         assert promlint.lint(classic) == []
 
 

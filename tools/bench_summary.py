@@ -120,10 +120,15 @@ def main() -> int:
               f"config={first.get('config')} ({len(recs)} records)")
         for rec in recs:
             probe = rec.get("probe", "?")
+            eff_keys = ("fill_ratio", "duty_cycle", "xla_compiles",
+                        "pad_waste_device_s")
             view = {k: v for k, v in rec.items()
                     if k not in ("probe", "ts", "run_ts", "platform",
-                                 "config", "windows")}
+                                 "config", "windows") + eff_keys}
             print(f"  {probe}: {json.dumps(view, default=str)[:300]}")
+            eff = {k: rec[k] for k in eff_keys if k in rec}
+            if eff:
+                print(f"    efficiency: {json.dumps(eff)}")
     return 0
 
 

@@ -90,8 +90,14 @@ try:
     slo = json.load(urlopen(f"{base}/v2/slo", timeout=10))
     if "enabled" not in slo or "windows" not in slo:
         sys.exit(f"/v2/slo smoke failed: {str(slo)[:200]}")
+    prof = json.load(urlopen(f"{base}/v2/profile", timeout=10))
+    if "models" not in prof or "duty_cycle" not in prof:
+        sys.exit(f"/v2/profile smoke failed: {str(prof)[:200]}")
+    if "tpu_batch_fill_ratio" not in classic:
+        sys.exit("tpu_batch_fill_ratio missing from /metrics scrape")
     print(f"ops endpoints ok: {len(events['events'])} event(s), "
-          f"slo enabled={slo['enabled']}")
+          f"slo enabled={slo['enabled']}, "
+          f"profile models={len(prof['models'])}")
 finally:
     srv.stop()
     engine.shutdown()

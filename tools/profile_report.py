@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Render a ``/v2/profile`` snapshot as a per-bucket cost table.
+
+Input is either a live server base URL (``http://host:port``) or a path to
+a saved JSON snapshot (e.g. ``curl $base/v2/profile > prof.json``). For
+each model the report shows, per bucket: execution and row counts, fill
+ratio, cumulative and per-call-EWMA device time, the padding-waste
+device-seconds estimate, and compile cost — followed by the profiler's
+bucket-ladder suggestion when one fires.
+
+    python tools/profile_report.py http://127.0.0.1:8000
+    python tools/profile_report.py http://127.0.0.1:8000 --model simple
+    python tools/profile_report.py prof.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from urllib.parse import quote, urlparse
+from urllib.request import urlopen
+
+_COLS = ("bucket", "execs", "cold", "rows", "padded", "fill",
+         "device_s", "ewma_ms", "waste_s", "compiles", "compile_s")
+
+
+def load_snapshot(source: str, model: str = "",
+                  timeout_s: float = 10.0) -> dict:
+    """Fetch from a server base URL or read a saved JSON file."""
+    if urlparse(source).scheme in ("http", "https"):
+        url = source.rstrip("/") + "/v2/profile"
+        if model:
+            url += f"?model={quote(model)}"
+        with urlopen(url, timeout=timeout_s) as resp:
+            return json.load(resp)
+    with open(source) as f:
+        snap = json.load(f)
+    if model:
+        snap = dict(snap, models={k: v for k, v in snap["models"].items()
+                                  if v.get("model") == model})
+    return snap
+
+
+def _bucket_row(b: dict) -> tuple:
+    return (b["bucket"], b["executions"], b["cold_executions"], b["rows"],
+            b["padded_rows"], f"{b['fill_ratio']:.3f}",
+            f"{b['device_s']:.4f}",
+            f"{b['device_s_per_call_ewma'] * 1e3:.3f}",
+            f"{b['padding_waste_device_s']:.4f}",
+            b["compilations"], f"{b['compile_s']:.3f}")
+
+
+def render(snap: dict, out=None) -> None:
+    w = (out or sys.stdout).write
+    w(f"window_s={snap.get('window_s')} "
+      f"duty_cycle={snap.get('duty_cycle')}\n")
+    models = snap.get("models", {})
+    if not models:
+        w("no recorded executions yet\n")
+        return
+    for mkey in sorted(models):
+        m = models[mkey]
+        w(f"\nmodel {m['model']} (version {m['version']}): "
+          f"device {m['device_s']:.4f}s, host {m['host_s']:.4f}s, "
+          f"padding waste {m['padding_waste_device_s']:.4f}s, "
+          f"{m['compilations']} compile(s) totalling "
+          f"{m['compile_s']:.3f}s\n")
+        rows = [_COLS] + [_bucket_row(b) for b in m["buckets"]]
+        widths = [max(len(str(r[i])) for r in rows)
+                  for i in range(len(_COLS))]
+        for r in rows:
+            w("  " + "  ".join(str(v).rjust(widths[i])
+                               for i, v in enumerate(r)) + "\n")
+        sug = m.get("suggestion")
+        if sug:
+            w(f"  suggestion: add bucket {sug['bucket']} below "
+              f"{sug['below']} (fill {sug['fill_ratio']:.3f}, est. saving "
+              f"{sug['est_saving_device_s']:.4f} device-s) — "
+              f"{sug['reason']}\n")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("source", help="server base URL or saved snapshot path")
+    p.add_argument("--model", default="", help="restrict to one model")
+    p.add_argument("--json", action="store_true",
+                   help="dump the (filtered) snapshot as JSON instead")
+    args = p.parse_args(argv)
+    try:
+        snap = load_snapshot(args.source, model=args.model)
+    except Exception as exc:  # noqa: BLE001 — CLI surface
+        print(f"profile_report: cannot load {args.source}: {exc}",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        json.dump(snap, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        render(snap)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,8 +3,10 @@
 
 Validates what scrapers actually trip over: HELP/TYPE/sample ordering per
 family, re-opened families, metric/label name syntax, label-string escaping,
-and histogram invariants (cumulative le-buckets, terminal +Inf == _count,
-_sum present). OpenMetrics mode — auto-detected from a ``# EOF`` line, or
+histogram invariants (cumulative le-buckets, terminal +Inf == _count,
+_sum present), and unit-suffix conventions (counters end ``_total``;
+``_seconds``/``_bytes``/``_ratio`` names are gauges or histograms — a
+small legacy allowlist grandfathers the pre-rule tpu_inference_* block). OpenMetrics mode — auto-detected from a ``# EOF`` line, or
 forced with ``--openmetrics`` — additionally checks exemplar syntax
 (``... # {trace_id="..."} <value>``, only on _bucket/_total samples, label
 payload within the 128-rune budget), requires the ``# EOF`` terminator to
@@ -38,6 +40,26 @@ _EXEMPLAR_RE = re.compile(r"^(\{.*\})\s+(\S+)(?:\s+(\S+))?$")
 VALID_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
 
 _SUFFIXES = ("_bucket", "_sum", "_count", "_total")
+
+# Unit-suffix rule: counters end `_total`; names ending in a base unit
+# (`_seconds`/`_bytes`/`_ratio`) are gauges or histograms, never bare
+# counters (a counter of seconds is `..._seconds_total`).
+_UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio")
+# Legacy families grandfathered in before the rule existed (the Triton
+# nv_inference_* vocabulary mirrored with a tpu_ prefix; classic-dialect
+# only — the OpenMetrics rendering already excludes them). New metric
+# families must NOT be added here; name them correctly instead.
+_UNIT_SUFFIX_ALLOWLIST = frozenset({
+    "tpu_inference_request_success",
+    "tpu_inference_request_failure",
+    "tpu_inference_count",
+    "tpu_inference_exec_count",
+    "tpu_inference_request_duration_us",
+    "tpu_inference_queue_duration_us",
+    "tpu_inference_compute_input_duration_us",
+    "tpu_inference_compute_infer_duration_us",
+    "tpu_inference_compute_output_duration_us",
+})
 
 
 def _family_of(sample_name: str, families: set[str]) -> str:
@@ -208,11 +230,49 @@ def lint(text: str, openmetrics: bool | None = None) -> list[str]:
     for name, f in families.items():
         if f.kind == "histogram":
             errors.extend(_check_histogram(name, f))
+        errors.extend(_check_unit_suffix(name, f, openmetrics))
     if openmetrics and eof_line is None:
         errors.append(
             f"line {len(lines) or 1}: OpenMetrics exposition missing the "
             "'# EOF' terminator")
     return errors
+
+
+def _check_unit_suffix(name: str, f: _Family,
+                       openmetrics: bool) -> list[str]:
+    """Unit-suffix conventions per family (see _UNIT_SUFFIXES above).
+    Families without a TYPE line are reported elsewhere; allowlisted
+    legacy names are exempt. Counter naming is dialect-dependent: classic
+    families carry ``_total`` on the family name itself; OpenMetrics
+    advertises the base name (the per-sample ``_total`` requirement is
+    enforced separately in :func:`lint`)."""
+    if f.kind is None or name in _UNIT_SUFFIX_ALLOWLIST:
+        return []
+    where = f.type_line if f.type_line is not None else (
+        f.samples[0][0] if f.samples else 1)
+    if f.kind == "counter":
+        if openmetrics:
+            # OM spec: the MetricFamily name must not include the suffix.
+            if name.endswith("_total"):
+                return [f"line {where}: OpenMetrics counter family "
+                        f"'{name}' must be advertised without the "
+                        "'_total' suffix (samples carry it)"]
+            return []
+        if name.endswith("_total"):
+            return []
+        for unit in _UNIT_SUFFIXES:
+            if name.endswith(unit):
+                return [f"line {where}: counter '{name}' ends in a bare "
+                        f"unit suffix — cumulative units are "
+                        f"'{name}_total'"]
+        return [f"line {where}: counter '{name}' should end in '_total'"]
+    # Gauges/histograms/summaries carry the observation itself: a unit
+    # suffix (_seconds/_bytes/_ratio) terminates the name; '_total' is
+    # reserved for counters.
+    if name.endswith("_total"):
+        return [f"line {where}: '{name}' is a {f.kind} but ends in "
+                "'_total' (reserved for counters)"]
+    return []
 
 
 def _check_exemplar(ex_text: str, lineno: int, sname: str) -> list[str]:
