@@ -79,8 +79,9 @@ def _append_history(entry: dict) -> None:
             pass
 
 
-_SECTION_NAMES = ("simple", "gen_net", "seq_streaming", "ssd_net", "bert",
-                  "shm_ab", "shm_ab_large", "seq", "gen", "device_steady")
+_SECTION_NAMES = ("simple", "gen_net", "seq_streaming", "ssd_net",
+                  "autotune", "bert", "shm_ab", "shm_ab_large", "seq",
+                  "gen", "device_steady")
 
 
 def _sections_filter() -> set | None:
@@ -210,7 +211,10 @@ def _section_guard(section: str):
 _SECTION_EST = {"simple": 150, "bert": 180, "shm_ab": 150,
                 "shm_ab_large": 180, "seq": 90, "gen": 150,
                 "device_steady": 550, "gen_net": 400,
-                "seq_streaming": 350, "ssd_net": 450}
+                "seq_streaming": 350, "ssd_net": 450,
+                # two engine builds + two short load phases + promotion
+                # wait; TPU pays two warmup compiles of the max bucket
+                "autotune": 120}
 _RUN_T0 = time.monotonic()
 
 
@@ -635,6 +639,125 @@ def bench_inproc_simple(concurrency: int = BENCH_CONCURRENCY):
     else:
         engine.shutdown()
     return res
+
+
+def bench_autotune(duration_s: float = 2.0):
+    """Before/after proof for the CLIENT_TPU_AUTOTUNE bucket tuner.
+
+    The simple model is loaded with a deliberately MISFIT ladder — only
+    the max bucket — and driven with batch-1 traffic, once with the
+    tuner off and once with it on.  Off: every execution pads 1 row up
+    to ``BENCH_MAX_BATCH`` (fill 1/max, maximal padding waste).  On: the
+    background tuner should observe the waste, compile a 1-row bucket
+    off the hot path, and promote it, after which the same traffic runs
+    at fill 1.0.  The record carries both phases' ``fill_ratio``,
+    ``pad_waste_device_s``, and ips plus the promotion count —
+    ``bench_summary`` prints the delta."""
+    import numpy as np
+
+    from client_tpu.engine import InferRequest, TpuEngine
+    from client_tpu.engine.repository import ModelRepository
+    from client_tpu.models.simple import AddSubBackend
+    from client_tpu.observability.profiler import profiler, reset_profiler
+
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+
+    def phase(tuned: bool) -> dict:
+        backend = AddSubBackend(name="autotune_probe",
+                                max_batch_size=BENCH_MAX_BATCH)
+        backend.config.batch_buckets = [BENCH_MAX_BATCH]  # misfit on purpose
+        backend.config.instance_count = 1  # serial: every batch is 1 row
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        prev = os.environ.get("CLIENT_TPU_AUTOTUNE")
+        if tuned:
+            os.environ["CLIENT_TPU_AUTOTUNE"] = json.dumps(
+                {"interval_s": 0.2, "cooldown_s": 0.5})
+        else:
+            os.environ.pop("CLIENT_TPU_AUTOTUNE", None)
+        reset_profiler()
+        try:
+            engine = TpuEngine(repo, warmup=True)
+        finally:
+            if prev is None:
+                os.environ.pop("CLIENT_TPU_AUTOTUNE", None)
+            else:
+                os.environ["CLIENT_TPU_AUTOTUNE"] = prev
+        try:
+            def infer():
+                engine.infer(InferRequest(
+                    model_name="autotune_probe",
+                    inputs={"INPUT0": a, "INPUT1": b}), timeout_s=60)
+
+            # Evidence traffic: enough misfit batches for the tuner's
+            # min_calls hysteresis, then (tuned phase) wait for the
+            # background thread to journal an applied promotion.
+            for _ in range(16):
+                infer()
+            promotions = 0
+            if tuned:
+                deadline = time.monotonic() + 15.0
+                while time.monotonic() < deadline:
+                    snap = engine.profile_snapshot()
+                    promotions = sum(
+                        1 for d in snap.get("autotune", {}).get(
+                            "decisions", [])
+                        if d["action"] == "add_bucket" and d["applied"])
+                    if promotions:
+                        break
+                    time.sleep(0.1)
+                log(f"autotune phase(on): {promotions} promotion(s) "
+                    "observed" if promotions else
+                    "autotune phase(on): no promotion within 15s")
+            # Measurement epoch: a fresh profiler so warmup/evidence
+            # traffic doesn't dilute the measured fill ratio.
+            reset_profiler()
+            t0 = time.monotonic()
+            n = 0
+            while time.monotonic() - t0 < duration_s:
+                infer()
+                n += 1
+            elapsed = time.monotonic() - t0
+            snap = profiler().snapshot(model="autotune_probe")
+            pm = next(iter(snap["models"].values()), None)
+            rows = sum(bk["rows"] for bk in pm["buckets"]) if pm else 0
+            padded = sum(bk["padded_rows"]
+                         for bk in pm["buckets"]) if pm else 0
+            sched = engine.scheduler_for("autotune_probe")
+            out = {
+                "ips": round(n / elapsed, 2),
+                "fill_ratio": (round(rows / (rows + padded), 4)
+                               if rows + padded else 1.0),
+                "pad_waste_device_s": round(
+                    pm["padding_waste_device_s"], 6) if pm else 0.0,
+                "ladder": sched.bucket_ladder() if sched else [],
+            }
+            if tuned:
+                out["promotions"] = promotions
+            return out
+        finally:
+            engine.shutdown()
+            reset_profiler()
+
+    log("autotune probe: tuner OFF phase (misfit ladder "
+        f"[{BENCH_MAX_BATCH}], batch-1 traffic)...")
+    off = phase(tuned=False)
+    log(f"autotune off: {off}")
+    log("autotune probe: tuner ON phase (CLIENT_TPU_AUTOTUNE, "
+        "interval 0.2s)...")
+    on = phase(tuned=True)
+    log(f"autotune on: {on}")
+    return {
+        "off": off, "on": on,
+        "promotions": on.get("promotions", 0),
+        "delta": {
+            "fill_ratio": round(on["fill_ratio"] - off["fill_ratio"], 4),
+            "pad_waste_device_s": round(
+                on["pad_waste_device_s"] - off["pad_waste_device_s"], 6),
+            "ips": round(on["ips"] - off["ips"], 2),
+        },
+    }
 
 
 def _shm_ab_modes(engine, model_name: str, inputs: dict, output_specs: dict,
@@ -1767,6 +1890,10 @@ def _main():
         _RESULT["ssd_net"] = r
         _append_history({"probe": "ssd_net", "ssd_net": r})
 
+    def _rec_autotune(r):
+        _RESULT["autotune"] = r
+        _append_history({"probe": "autotune", **r})
+
     # Section order = re-capture priority (VERDICT r4 #1c): after the
     # headline, the rows whose evidence is least established run first, so
     # a mid-run outage (or the time-budget skip) costs the least.  As of
@@ -1781,6 +1908,7 @@ def _main():
     _run_section("gen_net", bench_gen_net, _rec_gen_net)
     _run_section("seq_streaming", bench_seq_streaming, _rec_seq_streaming)
     _run_section("ssd_net", bench_ssd_net, _rec_ssd_net)
+    _run_section("autotune", bench_autotune, _rec_autotune)
     bres = _run_section("bert", bench_bert_mfu, _rec_bert)
     bert_ips = bres["ips"] if bres else None
     mfu = bres["mfu"] if bres else None
@@ -1838,6 +1966,10 @@ def _main():
                 and isinstance(h.get("value"), (int, float))
                 and h.get("platform") == platform
                 and h.get("config") == config
+                # Outage placeholders carry value 0.0 with
+                # status=unavailable; they are not baselines (and must
+                # not be, should the placeholder value ever change).
+                and h.get("status") != "unavailable"
                 and h.get("run_ts") != _RUN_TS),
                default=None)
     vs = ips / best if best else 1.0

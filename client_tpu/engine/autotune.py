@@ -1,0 +1,487 @@
+"""Background bucket autotuner: act on ``/v2/profile`` instead of just
+reporting it.
+
+PR-5's profiler computes, per (model, version, bucket), the fill ratio,
+padding-waste device-seconds, and ladder suggestions; until now a human
+had to read ``/v2/profile`` and edit ``batch_buckets`` by hand. This
+module closes the loop (ROADMAP Open item 1):
+
+- a daemon thread wakes every ``interval_s``, reads
+  ``EfficiencyProfiler.snapshot()``, and walks each model's
+  ``suggestions`` list;
+- **promotion** (``add_bucket``): under hysteresis (≥ ``min_calls``
+  executions at < ``max_fill`` fill, per-bucket cooldown), the candidate
+  is first *reserved* against the HBM arena budget
+  (:class:`client_tpu.engine.arena.ArenaAllocator`) — a promotion that
+  doesn't fit is rejected with an ``autotune.rejected_budget`` journal
+  event instead of a device OOM — then *compiled off the hot path* (a
+  warm-up execution on dummy rows via ``Model.warm_bucket`` on the tuner
+  thread, never a scheduler worker), and only then atomically swapped
+  into the scheduler's ladder (``Scheduler.swap_ladder``);
+- **retirement** (``retire_bucket``): a bucket whose call rate stayed
+  below ``retire_rate_per_min`` for a full profile window is dropped
+  from the ladder. In-flight batches that already picked it still finish
+  (the executable stays in XLA's jit cache; only the *planning*
+  reservation is released), and the ladder always keeps
+  ``max_batch_size`` plus at least one bucket;
+- every decision lands in the PR-4 event journal with the triggering
+  snapshot stats and counts on ``tpu_autotune_*`` metrics; ``/v2/profile``
+  gains an ``autotune`` section and per-suggestion ``state``
+  (``applied`` vs ``suggested``).
+
+Opt-in via ``CLIENT_TPU_AUTOTUNE`` — inline JSON or ``@file``, like
+``CLIENT_TPU_ADMISSION`` (``"1"``/``"true"`` enables the defaults). With
+the env unset nothing here is constructed: no tuner thread, no arena, a
+byte-identical engine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, fields
+
+import numpy as np
+
+from client_tpu.engine.arena import (
+    ArenaAllocator,
+    ArenaExhausted,
+    device_hbm_budget,
+)
+from client_tpu.engine.backend_init import log as _log
+from client_tpu.engine.types import EngineError
+from client_tpu.protocol.dtypes import wire_to_np_dtype
+
+ENV_VAR = "CLIENT_TPU_AUTOTUNE"
+
+# Arena budget fallback when the device reports no bytes_limit (CPU tests
+# and CI): large enough that packing, not the budget, is what tests of
+# normal promotion exercise; override with ``budget_bytes`` to test
+# rejection.
+_DEFAULT_CPU_BUDGET = 1 << 30  # 1 GiB
+
+
+@dataclass
+class AutotuneConfig:
+    """``CLIENT_TPU_AUTOTUNE`` knobs (all optional; see docs/AUTOTUNE.md).
+
+    Hysteresis: ``min_calls``/``max_fill`` gate promotions (both must
+    hold *in the profiler snapshot* — the profiler applies its own
+    identical defaults when building the suggestion list), and
+    ``cooldown_s`` spaces repeated decisions on the same (model, bucket)
+    so a noisy window can't flap the ladder. Retirement additionally
+    requires the profiler to have observed the bucket for a full window
+    (absence of calls on a just-added bucket is not evidence).
+    """
+
+    interval_s: float = 5.0          # tuner wake period
+    min_calls: int = 8               # executions before fill is trusted
+    max_fill: float = 0.85           # promote only below this fill
+    retire_rate_per_min: float = 0.5  # retire below this call rate
+    cooldown_s: float = 60.0         # per-(model,bucket,action) spacing
+    max_ladder: int = 12             # never grow a ladder past this
+    hbm_fraction: float = 0.9        # share of bytes_limit the arena owns
+    budget_bytes: int = 0            # explicit budget (0 = from device)
+    activation_factor: float = 2.0   # io-bytes -> activation estimate
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "AutotuneConfig":
+        known = {f.name: f.type for f in fields(cls)}
+        unknown = set(data) - set(known)
+        if unknown:
+            raise EngineError(
+                f"{ENV_VAR}: unknown key(s) {sorted(unknown)}; "
+                f"valid: {sorted(known)}", 400)
+        cfg = cls()
+        for f in fields(cls):
+            if f.name not in data:
+                continue
+            raw = data[f.name]
+            try:
+                coerce = int if f.name in (
+                    "min_calls", "max_ladder", "budget_bytes") else float
+                setattr(cfg, f.name, coerce(raw))
+            except (TypeError, ValueError):
+                raise EngineError(
+                    f"{ENV_VAR}: key '{f.name}' expects a number, "
+                    f"got {raw!r}", 400) from None
+        if cfg.interval_s <= 0:
+            raise EngineError(f"{ENV_VAR}: interval_s must be > 0", 400)
+        if not 0 < cfg.hbm_fraction <= 1:
+            raise EngineError(
+                f"{ENV_VAR}: hbm_fraction must be in (0, 1]", 400)
+        return cfg
+
+    @classmethod
+    def from_env(cls, env_var: str = ENV_VAR) -> "AutotuneConfig | None":
+        """None when unset/disabled (the engine then builds no tuner at
+        all); ``"1"``/``"true"``/``"on"`` → defaults; otherwise inline
+        JSON or ``@/path/to/file.json``."""
+        raw = os.environ.get(env_var, "").strip()
+        if not raw or raw.lower() in ("0", "false", "off"):
+            return None
+        if raw.lower() in ("1", "true", "on"):
+            return cls()
+        if raw.startswith("@"):
+            try:
+                with open(raw[1:]) as f:
+                    raw = f.read()
+            except OSError as exc:
+                raise EngineError(
+                    f"{env_var}: cannot read '{raw[1:]}': {exc}", 400) \
+                    from None
+        try:
+            data = json.loads(raw)
+        except json.JSONDecodeError as exc:
+            raise EngineError(
+                f"{env_var}: invalid JSON ({exc})", 400) from None
+        if not isinstance(data, dict):
+            raise EngineError(
+                f"{env_var}: expected a JSON object", 400)
+        return cls.from_dict(data)
+
+    def summary(self) -> dict:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+
+class Autotuner:
+    """The background ladder tuner; one per engine (see module doc)."""
+
+    def __init__(self, engine, config: AutotuneConfig, registry=None):
+        self.engine = engine
+        self.config = config
+        budget = config.budget_bytes or device_hbm_budget(
+            config.hbm_fraction, fallback_bytes=_DEFAULT_CPU_BUDGET)
+        self.arena = ArenaAllocator(budget, label="hbm:0")
+        self._lock = threading.Lock()
+        # (model, version, action, bucket) -> monotonic deadline before
+        # which the same decision is not retried (hysteresis spacing).
+        self._cooldown: dict[tuple, float] = {}
+        # (model, version, action, bucket) of applied decisions — drives
+        # the applied-vs-suggested annotation in /v2/profile.
+        self._applied: set[tuple] = set()
+        self._decisions: deque[dict] = deque(maxlen=64)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._metrics = None
+        if registry is not None:
+            self.bind_metrics(registry)
+
+    # -- metrics --------------------------------------------------------------
+
+    def bind_metrics(self, registry) -> None:
+        self._metrics = {
+            "decisions": registry.counter(
+                "tpu_autotune_decisions_total",
+                "Autotuner ladder decisions "
+                "(add_bucket / retire_bucket / rejected_budget)",
+                ("model", "version", "action")),
+            "ticks": registry.counter(
+                "tpu_autotune_ticks_total",
+                "Autotuner evaluation passes over the profiler snapshot"),
+            "compile_seconds": registry.counter(
+                "tpu_autotune_compile_seconds_total",
+                "Off-hot-path XLA compile time paid by the tuner thread"),
+            "ladder": registry.gauge(
+                "tpu_autotune_ladder_size",
+                "Batch-bucket ladder length under autotuning",
+                ("model", "version")),
+            "budget": registry.gauge(
+                "tpu_autotune_hbm_budget_bytes",
+                "HBM arena budget the tuner plans against"),
+            "reserved": registry.gauge(
+                "tpu_autotune_hbm_reserved_bytes",
+                "HBM arena bytes reserved for buckets and KV arenas"),
+        }
+        self._metrics["budget"].set(float(self.arena.budget))
+        self._metrics["reserved"].set(0.0)
+
+    def _count(self, action: str, model: str, version: str) -> None:
+        if self._metrics is not None:
+            self._metrics["decisions"].inc(
+                model=model, version=version, action=action)
+
+    def _refresh_gauges(self) -> None:
+        if self._metrics is not None:
+            self._metrics["reserved"].set(float(self.arena.reserved_bytes()))
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="autotuner", daemon=True)
+        self._thread.start()
+        self._journal("enabled", severity="INFO",
+                      interval_s=self.config.interval_s,
+                      budget_bytes=self.arena.budget)
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout_s)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                # The tuner must never take the serving path down with it.
+                _log.exception("autotune: tick failed")
+
+    # -- journal --------------------------------------------------------------
+
+    def _journal(self, name: str, model: str | None = None,
+                 version=None, severity: str = "INFO", **detail) -> None:
+        from client_tpu.observability.events import journal
+
+        journal().emit("autotune", name, model=model,
+                       version=str(version) if version is not None else None,
+                       severity=severity, **detail)
+
+    # -- reservations (load/unload surface) -----------------------------------
+
+    def _bucket_nbytes(self, model, bucket: int) -> int:
+        """Planning estimate for one bucket's executable working set:
+        bucket rows × per-row I/O bytes × ``activation_factor`` (inputs,
+        outputs, and an allowance for intermediates; BYTES tensors stay
+        host-side and cost no HBM)."""
+        per_row = 0
+        for tc in list(model.config.input) + list(model.config.output):
+            if tc.data_type == "BYTES":
+                continue
+            n = 1
+            for d in tc.dims:
+                n *= d if d and d > 0 else 1
+            per_row += n * np.dtype(wire_to_np_dtype(tc.data_type)).itemsize
+        return max(1, int(bucket * per_row * self.config.activation_factor))
+
+    def on_model_loaded(self, model, sched) -> None:
+        """Reserve the loaded ladder's buckets (and a generative KV arena)
+        in the planning arena. Loads must succeed even over budget — an
+        overcommit journals a WARNING instead of failing the load; only
+        *tuner promotions* are hard-rejected."""
+        name = model.config.name
+        version = model.config.version
+        prefix = f"bucket:{name}:{version}:"
+        self.arena.release_prefix(prefix)  # re-load replaces, idempotent
+        self.arena.release(f"kv:{name}:{version}")
+        if model.config.max_batch_size > 0:
+            for b in model.config.effective_buckets():
+                self._reserve_advisory(f"{prefix}{b}",
+                                       self._bucket_nbytes(model, b),
+                                       name, version)
+        arena_nbytes = getattr(sched, "arena_nbytes", None)
+        if callable(arena_nbytes):
+            self._reserve_advisory(f"kv:{name}:{version}",
+                                   int(arena_nbytes()), name, version)
+        if self._metrics is not None and model.config.max_batch_size > 0:
+            self._metrics["ladder"].set(
+                float(len(model.config.effective_buckets())),
+                model=name, version=str(version))
+        self._refresh_gauges()
+
+    def _reserve_advisory(self, rname: str, nbytes: int,
+                          model: str, version) -> None:
+        try:
+            self.arena.reserve(rname, nbytes)
+        except ArenaExhausted as exc:
+            self._journal("budget_overcommit", model=model, version=version,
+                          severity="WARNING", reservation=rname,
+                          nbytes=nbytes, error=str(exc))
+
+    def on_model_unloaded(self, name: str) -> None:
+        self.arena.release_prefix(f"bucket:{name}:")
+        self.arena.release_prefix(f"kv:{name}:")
+        with self._lock:
+            for key in [k for k in self._cooldown if k[0] == name]:
+                del self._cooldown[key]
+            self._applied = {k for k in self._applied if k[0] != name}
+        self._refresh_gauges()
+
+    # -- the decision pass ----------------------------------------------------
+
+    def tick(self) -> list[dict]:
+        """One evaluation pass (the loop calls this every ``interval_s``;
+        tests call it directly for determinism). Returns the decisions
+        applied or rejected this pass."""
+        if self._metrics is not None:
+            self._metrics["ticks"].inc()
+        snap = self.engine.profiler.snapshot()
+        out: list[dict] = []
+        for entry in snap.get("models", {}).values():
+            name, version = entry["model"], entry["version"]
+            sched = self.engine.scheduler_for(name, version)
+            if sched is None or sched.model.config.max_batch_size <= 0:
+                continue
+            for sug in entry.get("suggestions") or []:
+                action = sug.get("action")
+                if action == "add_bucket":
+                    d = self._try_add(sched, entry, sug)
+                elif action == "retire_bucket":
+                    d = self._try_retire(sched, entry, sug)
+                else:
+                    d = None
+                if d is not None:
+                    out.append(d)
+        self._refresh_gauges()
+        return out
+
+    def _cooling(self, key: tuple) -> bool:
+        with self._lock:
+            return time.monotonic() < self._cooldown.get(key, 0.0)
+
+    def _set_cooldown(self, *keys: tuple) -> None:
+        deadline = time.monotonic() + self.config.cooldown_s
+        with self._lock:
+            for key in keys:
+                self._cooldown[key] = deadline
+
+    def _record(self, action: str, name: str, version, bucket: int,
+                applied: bool, **detail) -> dict:
+        d = {"action": action, "model": name, "version": str(version),
+             "bucket": bucket, "applied": applied,
+             "ts": round(time.time(), 3), **detail}
+        with self._lock:
+            self._decisions.append(d)
+            if applied:
+                self._applied.add((name, str(version), action, bucket))
+        return d
+
+    def _try_add(self, sched, entry: dict, sug: dict) -> dict | None:
+        name, version = entry["model"], entry["version"]
+        model = sched.model
+        candidate = int(sug["bucket"])
+        ladder = sched.bucket_ladder()
+        if candidate in ladder or not 1 <= candidate <= \
+                model.config.max_batch_size:
+            return None
+        if len(ladder) >= self.config.max_ladder:
+            return None
+        # Re-validate the profiler's evidence against OUR thresholds (the
+        # profiler's suggestion constants may be looser than this config).
+        src = next((b for b in entry["buckets"]
+                    if b["bucket"] == sug.get("below")), None)
+        if src is None or src["executions"] < self.config.min_calls \
+                or src["fill_ratio"] >= self.config.max_fill:
+            return None
+        key = (name, str(version), "add_bucket", candidate)
+        if self._cooling(key):
+            return None
+        self._set_cooldown(key)
+        # 1. Budget first: never pay a compile for a bucket we can't keep.
+        rname = f"bucket:{name}:{version}:{candidate}"
+        nbytes = self._bucket_nbytes(model, candidate)
+        try:
+            self.arena.reserve(rname, nbytes)
+        except ArenaExhausted as exc:
+            self._count("rejected_budget", name, str(version))
+            self._journal("rejected_budget", model=name, version=version,
+                          severity="WARNING", bucket=candidate,
+                          nbytes=nbytes, fill_ratio=sug.get("fill_ratio"),
+                          below=sug.get("below"), error=str(exc))
+            return self._record("rejected_budget", name, version,
+                                candidate, applied=False, nbytes=nbytes)
+        # 2. Compile off the hot path: a warm-up execution at exactly the
+        # candidate shape on THIS thread. Scheduler workers keep serving
+        # the old ladder meanwhile.
+        try:
+            compile_s = model.warm_bucket(candidate)
+        except Exception as exc:
+            self.arena.release(rname)
+            self._journal("compile_failed", model=name, version=version,
+                          severity="ERROR", bucket=candidate,
+                          error=str(exc))
+            return self._record("compile_failed", name, version,
+                                candidate, applied=False, error=str(exc))
+        if self._metrics is not None and compile_s:
+            self._metrics["compile_seconds"].inc(compile_s)
+        # 3. Atomic promotion: future batches may now land on the
+        # candidate; in-flight ones are untouched.
+        new_ladder = sched.swap_ladder(ladder + [candidate])
+        self._count("add_bucket", name, str(version))
+        if self._metrics is not None:
+            self._metrics["ladder"].set(
+                float(len(new_ladder)), model=name, version=str(version))
+        self._journal("add_bucket", model=name, version=version,
+                      bucket=candidate, below=sug.get("below"),
+                      fill_ratio=sug.get("fill_ratio"),
+                      est_saving_device_s=sug.get("est_saving_device_s"),
+                      compile_s=round(compile_s, 3), ladder=new_ladder,
+                      reserved_bytes=nbytes)
+        _log.info("autotune: model '%s' v%s: promoted bucket %d "
+                  "(ladder %s, compile %.3fs)", name, version, candidate,
+                  new_ladder, compile_s)
+        return self._record("add_bucket", name, version, candidate,
+                            applied=True, below=sug.get("below"),
+                            compile_s=round(compile_s, 3),
+                            ladder=new_ladder)
+
+    def _try_retire(self, sched, entry: dict, sug: dict) -> dict | None:
+        name, version = entry["model"], entry["version"]
+        bucket = int(sug["bucket"])
+        ladder = sched.bucket_ladder()
+        # Ladder invariants: the bucket must actually be configured, must
+        # not be the max (pick_bucket's coverage of max_batch_size), and
+        # the ladder never shrinks below one bucket.
+        if bucket not in ladder or bucket == max(ladder) or len(ladder) <= 1:
+            return None
+        if sug.get("calls_per_min", 0.0) >= self.config.retire_rate_per_min:
+            return None
+        key = (name, str(version), "retire_bucket", bucket)
+        if self._cooling(key):
+            return None
+        # Re-adding what we just retired must also wait out the cooldown.
+        self._set_cooldown(key, (name, str(version), "add_bucket", bucket))
+        new_ladder = sched.swap_ladder([b for b in ladder if b != bucket])
+        self.arena.release(f"bucket:{name}:{version}:{bucket}")
+        self._count("retire_bucket", name, str(version))
+        if self._metrics is not None:
+            self._metrics["ladder"].set(
+                float(len(new_ladder)), model=name, version=str(version))
+        self._journal("retire_bucket", model=name, version=version,
+                      bucket=bucket,
+                      calls_per_min=sug.get("calls_per_min"),
+                      ladder=new_ladder)
+        _log.info("autotune: model '%s' v%s: retired bucket %d "
+                  "(ladder %s)", name, version, bucket, new_ladder)
+        return self._record("retire_bucket", name, version, bucket,
+                            applied=True, ladder=new_ladder)
+
+    # -- /v2/profile annotation -----------------------------------------------
+
+    def annotate(self, snap: dict) -> dict:
+        """Fold tuner state into a profiler snapshot: a top-level
+        ``autotune`` section (config, arena layout, recent decisions) and
+        a ``state`` on every suggestion — ``applied`` when the tuner has
+        already acted on it, ``suggested`` otherwise."""
+        with self._lock:
+            applied = set(self._applied)
+            decisions = list(self._decisions)
+        for entry in snap.get("models", {}).values():
+            name, version = entry["model"], str(entry["version"])
+            sugs = list(entry.get("suggestions") or [])
+            single = entry.get("suggestion")
+            if single is not None:
+                sugs.append(single)
+            for sug in sugs:
+                key = (name, version, sug.get("action"),
+                       int(sug.get("bucket", -1)))
+                sug["state"] = "applied" if key in applied else "suggested"
+            sched = self.engine.scheduler_for(name, entry["version"])
+            if sched is not None:
+                entry["autotune"] = {"ladder": sched.bucket_ladder()}
+        snap["autotune"] = {
+            "enabled": True,
+            "config": self.config.summary(),
+            "arena": self.arena.snapshot(),
+            "decisions": decisions,
+        }
+        return snap

@@ -115,16 +115,30 @@ class TpuEngine:
             self.admission._metrics = self.metrics
         self.request_traces = TraceStore(
             capacity=int(os.environ.get("CLIENT_TPU_TRACE_BUFFER", "512")))
+        # Opt-in bucket autotuner + HBM planning arena (CLIENT_TPU_AUTOTUNE;
+        # see client_tpu.engine.autotune). With the env unset this stays
+        # None and the engine is byte-identical to an untuned one: no
+        # thread, no arena, ladders fixed at load.
+        from client_tpu.engine.autotune import Autotuner, AutotuneConfig
+
+        self.autotuner: Autotuner | None = None
+        _tune_cfg = AutotuneConfig.from_env()
+        if _tune_cfg is not None:
+            self.autotuner = Autotuner(self, _tune_cfg,
+                                       registry=self.metrics.registry)
         self.events.emit(
             "lifecycle", "server_start",
             models=len(self.repository.names()),
-            slo_enabled=self.slo.enabled)
+            slo_enabled=self.slo.enabled,
+            autotune=self.autotuner is not None)
         if load_all:
             for name in self.repository.names():
                 try:
                     self.load_model(name)
                 except Exception:
                     pass  # surfaced via repository index state
+        if self.autotuner is not None:
+            self.autotuner.start()
 
     # -- health / metadata ---------------------------------------------------
 
@@ -287,6 +301,9 @@ class TpuEngine:
         for model in new_models:
             self.events.emit("model", "load", model=name,
                              version=model.config.version)
+        if self.autotuner is not None:
+            for model, sched in zip(new_models, new_scheds):
+                self.autotuner.on_model_loaded(model, sched)
         if self._warmup:
             for model in new_models:
                 model.warmup()
@@ -313,6 +330,8 @@ class TpuEngine:
         if popped:
             self.events.emit("model", "unload", model=name,
                              versions=versions)
+        if self.autotuner is not None:
+            self.autotuner.on_model_unloaded(name)
         self.repository.unload(name)
         for dep in dependents:
             if dep != name and not self._referenced_by_loaded_ensemble(dep):
@@ -332,6 +351,16 @@ class TpuEngine:
 
     def repository_index(self) -> list[dict]:
         return self.repository.index()
+
+    def scheduler_for(self, name: str, version: str | int = "") -> Scheduler | None:
+        """The live scheduler for one model version (bare version =
+        latest alias); None when not loaded. The autotuner resolves
+        profiler snapshot keys through this."""
+        with self._lock:
+            try:
+                return self._schedulers.get(self._vkey(name, version))
+            except ValueError:
+                return None
 
     def schedulers(self) -> list[Scheduler]:
         """Distinct live schedulers (the bare-name alias shares the latest
@@ -642,8 +671,14 @@ class TpuEngine:
     def profile_snapshot(self, model: str | None = None) -> dict:
         """``GET /v2/profile`` body: per-model/per-bucket efficiency cost
         table (fill ratios, padding-waste device-seconds, compile counts,
-        duty cycle) with a suggested bucket-ladder tweak."""
-        return self.profiler.snapshot(model=model)
+        duty cycle) with suggested bucket-ladder tweaks. When the
+        autotuner is enabled, suggestions carry ``state``
+        (``applied``/``suggested``) and the snapshot gains an
+        ``autotune`` section (config, arena layout, recent decisions)."""
+        snap = self.profiler.snapshot(model=model)
+        if self.autotuner is not None:
+            self.autotuner.annotate(snap)
+        return snap
 
     # -- trace (device profiling) --------------------------------------------
 
@@ -667,6 +702,8 @@ class TpuEngine:
             self.events.emit("lifecycle", "server_shutdown",
                              draining=self._draining)
         self._live = False
+        if getattr(self, "autotuner", None) is not None:
+            self.autotuner.stop()
         if getattr(self, "trace", None) is not None:
             self.trace.shutdown()
         with self._lock:

@@ -231,6 +231,23 @@ class GenerativeScheduler(Scheduler):
         self._free = list(range(self._cap))
         super().__init__(model, stats)
 
+    def arena_nbytes(self) -> int:
+        """Total bytes of the KV arena pytree — the engine's HBM planner
+        (``client_tpu.engine.arena``) reserves this against the device
+        budget when the autotuner is enabled, so co-resident models see
+        the generative arena as committed memory, not free space."""
+        leaves = self._jax.tree_util.tree_leaves(self._arena)
+        total = 0
+        for leaf in leaves:
+            nbytes = getattr(leaf, "nbytes", None)
+            if nbytes is None:
+                size = getattr(leaf, "size", 0)
+                itemsize = getattr(getattr(leaf, "dtype", None),
+                                   "itemsize", 0)
+                nbytes = size * itemsize
+            total += int(nbytes)
+        return total
+
     # -- warmup ---------------------------------------------------------------
 
     def warmup(self) -> None:

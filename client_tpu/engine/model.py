@@ -253,6 +253,7 @@ class Model:
     def execute_timed(
         self, inputs: dict[str, np.ndarray], batch_size: int | None = None,
         fetch_outputs: bool = True, deadline_ns: int = 0,
+        pad_to: int | None = None, synthetic: bool = False,
     ) -> tuple[dict[str, np.ndarray], ExecPhases]:
         """Run one (possibly padded) batch through the jitted executable.
 
@@ -265,6 +266,16 @@ class Model:
         ``deadline_ns`` (absolute ``now_ns()``; 0 = none): raise
         :class:`DeadlineExpired` instead of dispatching when the batch's
         end-to-end budget has already lapsed.
+        ``pad_to`` overrides bucket selection (normally
+        ``pick_bucket(batch_size)``): the autotuner uses it to compile a
+        candidate bucket that is not yet in the ladder — without the
+        override the rows would pad up to the next *existing* bucket and
+        XLA would cache the wrong shape.
+        ``synthetic=True`` (warmup / tuner compile probes): the execution
+        is excluded from the profiler's traffic statistics — a full-fill
+        dummy batch would otherwise poison the bucket's ``max_rows`` and
+        fill evidence, suppressing ladder suggestions for real traffic.
+        Compile telemetry is still recorded (a compile is a compile).
         Returns the outputs plus measured :class:`ExecPhases` — each phase is
         bounded by a real device sync (device_put committed / executable
         done / D2H complete), so the statistics the scheduler records are
@@ -292,8 +303,8 @@ class Model:
             raise EngineError(str(exc), exc.status or 503) from None
         cfg = self.config
         phases = ExecPhases(start=now_ns())
-        pad_to = None
-        if cfg.max_batch_size > 0 and batch_size is not None:
+        if pad_to is None and cfg.max_batch_size > 0 \
+                and batch_size is not None:
             pad_to = self.pick_bucket(batch_size)
 
         try:
@@ -379,6 +390,8 @@ class Model:
                     arr = arr[:batch_size]
                 host[name] = arr
             phases.output_end = now_ns()
+            if synthetic:
+                return host, phases  # dummy rows are not traffic
             # Efficiency attribution: one profiler record per batch (not
             # per request) keeps the always-on cost under a microsecond.
             _profiler().record_execution(
@@ -436,6 +449,51 @@ class Model:
         finally:
             self._clear_state()
 
+    def warm_bucket(self, bucket: int) -> float:
+        """Compile the executable for one batch bucket by executing zero
+        inputs at exactly ``bucket`` rows (``pad_to`` override — the
+        bucket need not be in the ladder yet). Runs on the *caller's*
+        thread: the autotuner pays the XLA compile here, off the
+        scheduler hot path, before promoting the bucket. Returns the
+        measured compile seconds (0.0 when the shape was already cached
+        or the model can't take dummy zeros, e.g. BYTES inputs)."""
+        cfg = self.config
+        if self._apply is None or cfg.max_batch_size <= 0:
+            return 0.0
+        bucket = int(bucket)
+        if not 1 <= bucket <= cfg.max_batch_size:
+            raise EngineError(
+                f"bucket {bucket} out of range 1..{cfg.max_batch_size} "
+                f"for model '{cfg.name}'")
+        inputs = {}
+        for tc in cfg.input:
+            if tc.data_type == "BYTES":
+                return 0.0  # zeros can't stand in for string inputs
+            dims = [d if d != -1 else 1 for d in tc.dims]
+            inputs[tc.name] = np.zeros(
+                [bucket] + dims, dtype=wire_to_np_dtype(tc.data_type))
+        _, phases = self.execute_timed(
+            inputs, batch_size=bucket, pad_to=bucket, synthetic=True)
+        return phases.compile_ns / 1e9
+
+    def swap_buckets(self, buckets: list[int]) -> list[int]:
+        """Atomically replace the batch-bucket ladder. The new ladder is
+        deduplicated, clamped to ``1..max_batch_size``, and always keeps
+        ``max_batch_size`` itself so ``pick_bucket`` covers every legal
+        batch. Safe concurrent with in-flight executions: readers see
+        either the old or the new list (reference assignment), and a
+        batch that already picked a retired bucket still runs — its
+        executable stays in the jit cache. Returns the ladder applied."""
+        cfg = self.config
+        if cfg.max_batch_size <= 0:
+            raise EngineError(
+                f"model '{cfg.name}' is unbatched; no bucket ladder")
+        new = sorted({int(b) for b in buckets
+                      if 1 <= int(b) <= cfg.max_batch_size}
+                     | {cfg.max_batch_size})
+        cfg.batch_buckets = new
+        return new
+
     def warmup(self) -> None:
         """Pre-compile every bucket with zero inputs so first real requests
         don't pay XLA compile latency (first compile ~20-40s on TPU)."""
@@ -456,8 +514,10 @@ class Model:
             if len(inputs) < len([t for t in cfg.input if t.data_type != "BYTES"]):
                 continue
             try:
-                self.execute(inputs,
-                             batch_size=bucket if cfg.max_batch_size > 0 else None)
+                self.execute_timed(
+                    inputs,
+                    batch_size=bucket if cfg.max_batch_size > 0 else None,
+                    synthetic=True)
             except EngineError:
                 raise
             except Exception:

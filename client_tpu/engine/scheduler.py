@@ -208,6 +208,24 @@ class Scheduler:
                 (dyn.priority_levels + 1) // 2
         return max(1, min(level, dyn.priority_levels))
 
+    # -- bucket ladder (autotuner surface) ------------------------------------
+
+    def bucket_ladder(self) -> list[int]:
+        """The model's current batch-bucket ladder ([] for unbatched)."""
+        if self.model.config.max_batch_size <= 0:
+            return []
+        return self.model.config.effective_buckets()
+
+    def swap_ladder(self, buckets: list[int]) -> list[int]:
+        """Atomically replace the bucket ladder (the autotuner's
+        promotion/retire path). Safe concurrent with enqueue/dequeue:
+        queueing is bucket-independent and padding happens only inside
+        ``execute_timed``, so queued requests simply land on the new
+        ladder while in-flight batches finish on the bucket they already
+        picked (its executable stays in the jit cache). Returns the
+        ladder actually applied (validated/clamped)."""
+        return self.model.swap_buckets(buckets)
+
     def submit(self, req: InferRequest) -> None:
         # Chaos site: scheduler admission — an injected error here proves
         # the frontend error paths and client retry classification against

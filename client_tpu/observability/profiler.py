@@ -60,6 +60,13 @@ _EWMA_ALPHA = 0.2
 # to make its fill ratio meaningful, and only when it wastes real time.
 _SUGGEST_MIN_CALLS = 8
 _SUGGEST_MAX_FILL = 0.85
+# A bucket is "cold" (retire candidate) when its call rate over the
+# profile window drops below this floor — warmup gives every ladder
+# bucket one execution, so a bucket nobody uses decays to ~0 calls/min
+# once the window slides past it. The autotuner applies its own floor on
+# top (AutotuneConfig.retire_rate_per_min); this default keeps
+# /v2/profile's suggestions aligned with what the tuner can do.
+_SUGGEST_RETIRE_RATE_PER_MIN = 0.5
 
 
 @dataclass
@@ -77,6 +84,18 @@ class _BucketCost:
     compile_count: int = 0
     compile_ns: int = 0
     max_rows: int = 0
+    # Recency tracking for retire suggestions: a two-window rotation gives
+    # an O(1)-per-call sliding call rate (a timestamp deque would cost
+    # memory proportional to call rate — thousands/s under load). The
+    # current window accumulates calls since ``win_start``; when it
+    # exceeds the profiler window it rotates into ``prev_*``. The rate at
+    # snapshot time is (prev + current calls) / (prev + current span) —
+    # a bucket that goes quiet decays toward zero as the span grows.
+    first_seen: int = 0      # mono ns of first record (0 = never)
+    win_start: int = 0       # current rate-window start, mono ns
+    win_calls: int = 0
+    prev_win_s: float = 0.0  # span of the rotated-out window, seconds
+    prev_win_calls: int = 0
 
     def fill_ratio(self) -> float:
         total = self.rows + self.padded_rows
@@ -89,6 +108,29 @@ class _BucketCost:
         if not total or not self.padded_rows:
             return 0.0
         return (self.device_ns / 1e9) * (self.padded_rows / total)
+
+    def touch(self, now: int, window_ns: int) -> None:
+        """Count one call into the sliding rate window (rotate first when
+        the current window has outlived the profiler window)."""
+        if self.first_seen == 0:
+            self.first_seen = now
+        if self.win_start == 0:
+            self.win_start = now
+        elif now - self.win_start >= window_ns:
+            self.prev_win_calls = self.win_calls
+            self.prev_win_s = (now - self.win_start) / 1e9
+            self.win_calls = 0
+            self.win_start = now
+        self.win_calls += 1
+
+    def calls_per_min(self, now: int) -> float:
+        """Sliding call rate: counted calls over the covered span (clamped
+        to ≥1 s so a just-created bucket doesn't read as infinite)."""
+        if self.win_start == 0:
+            return 0.0
+        span_s = (now - self.win_start) / 1e9 + self.prev_win_s
+        return 60.0 * (self.win_calls + self.prev_win_calls) \
+            / max(span_s, 1.0)
 
 
 class _Bound:
@@ -187,6 +229,7 @@ class EfficiencyProfiler:
             c.rows += rows
             c.padded_rows += padded
             c.max_rows = max(c.max_rows, rows)
+            c.touch(end, int(self.window_s * 1e9))
             if cold:
                 c.cold_calls += 1
             else:
@@ -269,6 +312,7 @@ class EfficiencyProfiler:
     def snapshot(self, model: str | None = None) -> dict:
         """The ``GET /v2/profile`` body: per-model/per-bucket cost table
         with padding-waste estimates and a bucket-ladder suggestion."""
+        now = self._now()
         with self._lock:
             items = sorted(self._costs.items())
         models: dict[str, dict] = {}
@@ -284,6 +328,7 @@ class EfficiencyProfiler:
                     "padding_waste_device_s": 0.0,
                     "compilations": 0, "compile_s": 0.0,
                     "buckets": [], "suggestion": None,
+                    "suggestions": [],
                 }
             waste = c.padding_waste_device_s()
             entry["device_s"] += c.device_ns / 1e9
@@ -306,6 +351,9 @@ class EfficiencyProfiler:
                 "padding_waste_device_s": round(waste, 6),
                 "compilations": c.compile_count,
                 "compile_s": round(c.compile_ns / 1e9, 6),
+                "calls_per_min": round(c.calls_per_min(now), 3),
+                "observed_s": round(
+                    (now - c.first_seen) / 1e9 if c.first_seen else 0.0, 3),
             })
         for entry in models.values():
             entry["device_s"] = round(entry["device_s"], 6)
@@ -314,6 +362,8 @@ class EfficiencyProfiler:
             entry["padding_waste_device_s"] = round(
                 entry["padding_waste_device_s"], 6)
             entry["suggestion"] = _suggest_bucket_tweak(entry["buckets"])
+            entry["suggestions"] = _suggest_ladder_tweaks(
+                entry["buckets"], self.window_s)
         return {
             "window_s": self.window_s,
             "duty_cycle": round(self.duty_cycle(), 6),
@@ -363,6 +413,40 @@ def _suggest_bucket_tweak(buckets: list[dict]) -> dict | None:
                    f"(max {worst['max_rows']} real rows); a "
                    f"{suggested}-row bucket would absorb them"),
     }
+
+
+def _suggest_ladder_tweaks(buckets: list[dict],
+                           window_s: float) -> list[dict]:
+    """The full suggestion list the autotuner acts on: the greedy
+    ``add_bucket`` (same semantics as :func:`_suggest_bucket_tweak`) plus
+    one ``retire_bucket`` per cold bucket — tracked for at least a full
+    profile window yet called below :data:`_SUGGEST_RETIRE_RATE_PER_MIN`.
+    The largest tracked bucket is never suggested for retirement (the
+    ladder must keep covering max_batch_size); the tuner re-validates
+    against the actual configured ladder before acting."""
+    out: list[dict] = []
+    add = _suggest_bucket_tweak(buckets)
+    if add is not None:
+        out.append(add)
+    largest = max((b["bucket"] for b in buckets), default=0)
+    for b in buckets:
+        if b["bucket"] < 1 or b["bucket"] >= largest:
+            continue
+        if b.get("observed_s", 0.0) < window_s:
+            continue  # too young: absence of calls is not yet evidence
+        rate = b.get("calls_per_min", 0.0)
+        if rate >= _SUGGEST_RETIRE_RATE_PER_MIN:
+            continue
+        out.append({
+            "action": "retire_bucket",
+            "bucket": b["bucket"],
+            "calls_per_min": rate,
+            "reason": (f"bucket {b['bucket']} saw "
+                       f"{rate:.2f} calls/min over the last "
+                       f"{window_s:.0f}s window (floor "
+                       f"{_SUGGEST_RETIRE_RATE_PER_MIN})"),
+        })
+    return out
 
 
 # -- process-global default profiler ------------------------------------------

@@ -22,12 +22,19 @@ import time
 
 
 def _probe_runs(hist: list) -> dict:
-    """{run_ts: {probe: record}} for probe records (run-status excluded)."""
+    """{run_ts: {probe: record}} for probe records (run-status excluded).
+
+    Records with ``status: "unavailable"`` (backend init timed out, the
+    probe emitted a 0.0 placeholder — see BENCH_r05.json) are dropped:
+    an outage run carries no performance signal, and letting its zeros
+    into the p99/ips medians would mask real regressions."""
     runs: dict = {}
     for rec in hist:
         if not isinstance(rec, dict) or rec.get("run_ts") is None:
             continue
         if rec.get("probe") in (None, "run-status"):
+            continue
+        if rec.get("status") == "unavailable":
             continue
         runs.setdefault(rec["run_ts"], {})[rec["probe"]] = rec
     return runs
@@ -120,6 +127,11 @@ def main() -> int:
               f"config={first.get('config')} ({len(recs)} records)")
         for rec in recs:
             probe = rec.get("probe", "?")
+            if rec.get("status") == "unavailable":
+                print(f"  {probe}: UNAVAILABLE "
+                      f"({rec.get('reason', 'no reason recorded')}) "
+                      "— excluded from medians")
+                continue
             eff_keys = ("fill_ratio", "duty_cycle", "xla_compiles",
                         "pad_waste_device_s")
             view = {k: v for k, v in rec.items()
@@ -129,7 +141,30 @@ def main() -> int:
             eff = {k: rec[k] for k in eff_keys if k in rec}
             if eff:
                 print(f"    efficiency: {json.dumps(eff)}")
+            if probe == "autotune":
+                _print_autotune_delta(rec)
     return 0
+
+
+def _print_autotune_delta(rec: dict) -> None:
+    """The tuner-off vs tuner-on delta of the bench autotune probe: the
+    before/after that proves (or disproves) the promotion paid off."""
+    off, on = rec.get("off") or {}, rec.get("on") or {}
+    if not off or not on:
+        return
+    def fmt(key, scale=1.0, unit=""):
+        a, b = off.get(key), on.get(key)
+        if a is None or b is None:
+            return f"{key}: n/a"
+        return (f"{key}: {a * scale:.4g}{unit} -> {b * scale:.4g}{unit} "
+                f"({(b - a) * scale:+.4g}{unit})")
+    print("    autotune delta (off -> on): "
+          + "; ".join((fmt("fill_ratio"),
+                       fmt("pad_waste_device_s", unit="s"),
+                       fmt("ips"))))
+    if rec.get("promotions") is not None:
+        print(f"    promotions applied: {rec['promotions']} "
+              f"(ladder {off.get('ladder')} -> {on.get('ladder')})")
 
 
 if __name__ == "__main__":

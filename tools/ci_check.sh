@@ -1,10 +1,11 @@
 #!/usr/bin/env bash
-# CI gate: tier-1 tests + chaos suite + live endpoint lint + bench gate.
+# CI gate: tier-1 tests + chaos suite + live endpoint lint + autotune
+# e2e + bench gate.
 #
 #   tools/ci_check.sh            # everything (tier-1 already includes chaos)
-#   tools/ci_check.sh --fast     # chaos suite + live lint + bench gate only
+#   tools/ci_check.sh --fast     # all stages except tier-1
 #
-# Four stages:
+# Five stages:
 #   1. tier-1: the full fast suite (ROADMAP.md contract; excludes `slow`).
 #   2. chaos: the deterministic fault-injection suite alone (`-m chaos`) —
 #      redundant with tier-1 when stage 1 runs, but the -m filter proves
@@ -16,7 +17,12 @@
 #      and smoke-scrape /v2/events and /v2/slo — catching malformed
 #      renderings and broken ops endpoints that unit tests of individual
 #      counters never exercise.
-#   4. bench gate: tools/bench_summary.py --check fails the build when the
+#   4. autotune e2e: boot the server with CLIENT_TPU_AUTOTUNE enabled and
+#      a deliberately misfit bucket ladder, drive skewed batch-1 traffic,
+#      and assert the tuner promotes a bucket (journaled, applied state in
+#      /v2/profile) and tpu_autotune_* counters render promlint-clean in
+#      both exposition dialects.
+#   5. bench gate: tools/bench_summary.py --check fails the build when the
 #      newest BENCH_HISTORY.json run regressed any probe's p99 by >25%.
 set -u -o pipefail
 
@@ -27,7 +33,7 @@ FAST=0
 rc=0
 
 if [ "$FAST" -eq 0 ]; then
-    echo "=== stage 1/4: tier-1 test suite ==="
+    echo "=== stage 1/5: tier-1 test suite ==="
     rm -f /tmp/_t1.log
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -37,15 +43,15 @@ if [ "$FAST" -eq 0 ]; then
         | tr -cd . | wc -c)"
     [ "$t1" -ne 0 ] && { echo "tier-1 FAILED (exit $t1)"; rc=1; }
 else
-    echo "=== stage 1/4: tier-1 skipped (--fast) ==="
+    echo "=== stage 1/5: tier-1 skipped (--fast) ==="
 fi
 
-echo "=== stage 2/4: chaos (fault-injection) suite ==="
+echo "=== stage 2/5: chaos (fault-injection) suite ==="
 timeout -k 10 300 python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 [ $? -ne 0 ] && { echo "chaos suite FAILED"; rc=1; }
 
-echo "=== stage 3/4: live scrape (promlint + ops endpoints) ==="
+echo "=== stage 3/5: live scrape (promlint + ops endpoints) ==="
 SCRAPE_DIR=$(mktemp -d)
 python - "$SCRAPE_DIR" <<'EOF'
 import json
@@ -109,7 +115,83 @@ python tools/promlint.py --openmetrics "$SCRAPE_DIR/metrics.om.txt" \
     || { echo "promlint (openmetrics) FAILED"; rc=1; }
 rm -rf "$SCRAPE_DIR"
 
-echo "=== stage 4/4: bench p99 regression gate ==="
+echo "=== stage 4/5: autotune e2e (promotion + metrics) ==="
+TUNE_DIR=$(mktemp -d)
+CLIENT_TPU_AUTOTUNE='{"interval_s": 0.2, "cooldown_s": 0.5}' \
+timeout -k 10 300 python - "$TUNE_DIR" <<'EOF'
+import json
+import sys
+import time
+from urllib.request import Request, urlopen
+
+import numpy as np
+
+from client_tpu.engine import TpuEngine
+from client_tpu.engine.repository import ModelRepository
+from client_tpu.engine.types import InferRequest
+from client_tpu.models.simple import AddSubBackend
+from client_tpu.server import HttpInferenceServer
+
+out_dir = sys.argv[1]
+# Misfit ladder on purpose: only the max bucket exists, so batch-1
+# traffic runs at 1/32 fill until the tuner promotes a 1-row bucket.
+backend = AddSubBackend(name="simple", max_batch_size=32)
+backend.config.batch_buckets = [32]
+repo = ModelRepository()
+repo.register_backend(backend)
+engine = TpuEngine(repo, warmup=True)
+if engine.autotuner is None:
+    sys.exit("CLIENT_TPU_AUTOTUNE set but engine built no autotuner")
+srv = HttpInferenceServer(engine, host="127.0.0.1", port=0).start()
+try:
+    base = f"http://{srv.url}"
+    ins = {"INPUT0": np.zeros((1, 16), dtype=np.int32),
+           "INPUT1": np.zeros((1, 16), dtype=np.int32)}
+    for _ in range(16):  # skewed traffic: all batch-1
+        engine.infer(InferRequest(model_name="simple", inputs=ins),
+                     timeout_s=120)
+    applied = []
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not applied:
+        prof = json.load(urlopen(f"{base}/v2/profile", timeout=10))
+        applied = [d for d in prof.get("autotune", {}).get("decisions", [])
+                   if d["action"] == "add_bucket" and d["applied"]]
+        if not applied:
+            time.sleep(0.25)
+    if not applied:
+        sys.exit(f"no applied promotion within 30s: "
+                 f"{json.dumps(prof.get('autotune'))[:400]}")
+    states = [s.get("state") for m in prof["models"].values()
+              for s in (m.get("suggestions") or [])]
+    if "applied" not in states:
+        sys.exit(f"/v2/profile has no suggestion in state=applied: {states}")
+    events = json.load(urlopen(
+        f"{base}/v2/events?category=autotune", timeout=10))
+    if not any(e["name"] == "add_bucket" for e in events["events"]):
+        sys.exit("journal has no autotune.add_bucket event")
+    classic = urlopen(f"{base}/metrics", timeout=10).read().decode()
+    om = urlopen(Request(f"{base}/metrics", headers={
+        "Accept": "application/openmetrics-text"}), timeout=10).read().decode()
+    if "tpu_autotune_decisions_total" not in classic:
+        sys.exit("tpu_autotune_decisions_total missing from /metrics")
+    with open(f"{out_dir}/metrics.txt", "w") as f:
+        f.write(classic)
+    with open(f"{out_dir}/metrics.om.txt", "w") as f:
+        f.write(om)
+    print(f"autotune e2e ok: promotion {applied[0]['bucket']} applied, "
+          f"{len(events['events'])} journal event(s)")
+finally:
+    srv.stop()
+    engine.shutdown()
+EOF
+[ $? -ne 0 ] && { echo "autotune e2e FAILED"; rc=1; }
+python tools/promlint.py "$TUNE_DIR/metrics.txt" \
+    || { echo "promlint (autotune classic) FAILED"; rc=1; }
+python tools/promlint.py --openmetrics "$TUNE_DIR/metrics.om.txt" \
+    || { echo "promlint (autotune openmetrics) FAILED"; rc=1; }
+rm -rf "$TUNE_DIR"
+
+echo "=== stage 5/5: bench p99 regression gate ==="
 if [ -f BENCH_HISTORY.json ]; then
     python tools/bench_summary.py --check \
         || { echo "bench gate FAILED"; rc=1; }
