@@ -373,6 +373,16 @@ class AdmissionController:
         gate = self._gates.get(model)
         return gate.ewma_service_s if gate is not None else 0.0
 
+    def load_snapshot(self) -> dict[str, dict]:
+        """Per-model load inputs for the replica load report
+        (:meth:`TpuEngine.load_report`): the in-flight count and the
+        service EWMA that the estimated-wait shed check already uses —
+        one lock acquisition for the whole table."""
+        with self._lock:
+            return {m: {"inflight": g.inflight,
+                        "ewma_service_s": g.ewma_service_s}
+                    for m, g in self._gates.items()}
+
     # -- health --------------------------------------------------------------
 
     def degraded(self) -> bool:

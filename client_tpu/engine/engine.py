@@ -101,6 +101,10 @@ class TpuEngine:
         self.profiler = _profiler()
         self.profiler.bind_metrics(self.metrics.registry)
         self._last_health: str | None = None
+        # (mono_timestamp, LoadReport) pair behind load_report(): the
+        # report piggybacks on every inference response, so it is cached
+        # for a routing-irrelevant 50ms rather than recomputed per call.
+        self._load_report_cache: tuple[float, object] | None = None
         # Admission controller: load shedding + in-flight accounting. The
         # default (CLIENT_TPU_ADMISSION unset) admits everything but still
         # counts in-flight requests — the drain coordinator depends on
@@ -667,6 +671,54 @@ class TpuEngine:
     def slo_snapshot(self) -> dict:
         """``GET /v2/slo`` body: per-model window counts and burn rates."""
         return self.slo.snapshot()
+
+    # Staleness bound on the cached load report: piggybacked on every
+    # inference response, so it must be cheaper than a response — 50ms is
+    # far below any routing-relevant signal change at serving timescales.
+    LOAD_REPORT_TTL_S = 0.05
+
+    def load_report(self, max_age_s: float | None = None):
+        """The replica load report (``GET /v2/load`` + the ``X-Tpu-Load``
+        response piggyback): health state, in-flight, queue depth, active
+        batches, the admission EWMA wait estimate, and SLO fast-burn —
+        everything :class:`client_tpu.router.Router` scores replicas by.
+        Cached for :data:`LOAD_REPORT_TTL_S` (pass ``max_age_s=0`` to
+        force recomputation)."""
+        import time as _time
+
+        from client_tpu.protocol.loadreport import LoadReport
+
+        ttl = self.LOAD_REPORT_TTL_S if max_age_s is None else max_age_s
+        now = _time.monotonic()
+        cached = self._load_report_cache
+        if cached is not None and now - cached[0] <= ttl:
+            return cached[1]
+        snap = self.admission.load_snapshot()
+        inflight = sum(g["inflight"] for g in snap.values())
+        queue_depth = 0
+        active_batches = 0
+        wait_s = 0.0
+        models: list[str] = []
+        for sched in self.schedulers():
+            cfg = sched.model.config
+            models.append(cfg.name)
+            depth = sched.queue.qsize()
+            queue_depth += depth
+            active_batches += sched.active_batches
+            service = snap.get(cfg.name, {}).get("ewma_service_s", 0.0)
+            if depth and service > 0:
+                wait_s += depth * service / max(1, cfg.instance_count)
+        report = LoadReport(
+            state=self.health_state(),
+            inflight=inflight,
+            queue_depth=queue_depth,
+            active_batches=active_batches,
+            wait_s=wait_s,
+            slo_fast_burn=bool(self.slo.fast_burn()),
+            models=tuple(sorted(models)),
+        )
+        self._load_report_cache = (now, report)
+        return report
 
     def profile_snapshot(self, model: str | None = None) -> dict:
         """``GET /v2/profile`` body: per-model/per-bucket efficiency cost

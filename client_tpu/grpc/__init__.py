@@ -13,6 +13,7 @@ by URL (grpc_client.cc:48-123) and request-proto reuse across calls
 from __future__ import annotations
 
 import base64
+import itertools
 import json
 import queue
 import threading
@@ -26,6 +27,7 @@ import logging
 from client_tpu.observability.client_stats import InferStat
 from client_tpu.observability.tracing import TraceContext
 from client_tpu.protocol import grpc_codec, grpc_service_pb2 as pb
+from client_tpu.protocol.pushback import parse_pushback_metadata
 from client_tpu.resilience import run_with_resilience
 from client_tpu.protocol.codec import serialize_tensor
 from client_tpu.protocol.dtypes import np_to_wire_dtype
@@ -61,14 +63,13 @@ def _grpc_error(exc: grpc.RpcError) -> InferenceServerException:
     # Server pushback rides in trailing metadata (admission sheds / drain:
     # `retry-after` in fractional seconds, `retry-pushback-ms` integral) —
     # surfaced as retry_after_s so resilience.retry_after_of finds it and
-    # RetryPolicy waits exactly as long as the server asked.
+    # RetryPolicy waits exactly as long as the server asked. Parsing is
+    # shared with the HTTP Retry-After path (client_tpu.protocol.pushback)
+    # so both transports agree on sub-second handling.
     try:
-        trailing = exc.trailing_metadata() or ()
-        meta = {k.lower(): v for k, v in trailing}
-        if "retry-after" in meta:
-            err.retry_after_s = float(meta["retry-after"])
-        elif "retry-pushback-ms" in meta:
-            err.retry_after_s = float(meta["retry-pushback-ms"]) / 1000.0
+        retry_after_s = parse_pushback_metadata(exc.trailing_metadata())
+        if retry_after_s is not None:
+            err.retry_after_s = retry_after_s
     except Exception:  # noqa: BLE001 — pushback is best-effort
         pass
     return err
@@ -352,19 +353,33 @@ class InferenceServerClient:
                 ("grpc.http2.max_pings_without_data",
                  keepalive_options.http2_max_pings_without_data),
             ]
-        key = (url, tuple(sorted(options)))
-        # Process-global channel/stub reuse keyed by URL+options, the same
-        # allocation hygiene as the reference's channel cache.
-        with _channel_cache_lock:
-            cached = _channel_cache.get(key)
-            if cached is None:
-                channel = grpc.insecure_channel(url, options=options)
-                stub = GRPCInferenceServiceStub(channel)
-                _channel_cache[key] = (channel, stub)
-            else:
-                channel, stub = cached
-        self._channel = channel
-        self._client_stub = stub
+        # Router-aware URL handling: a comma-separated string (or list) of
+        # URLs round-robins calls across N replicas, each on its own
+        # cached channel, with the per-call breaker host tracking the
+        # replica actually dialed. A single URL behaves exactly as before.
+        urls = ([u.strip() for u in url.split(",") if u.strip()]
+                if isinstance(url, str) else [str(u) for u in url])
+        if not urls:
+            raise InferenceServerException("no server url given")
+        self._endpoints: list[tuple[str, grpc.Channel,
+                                    GRPCInferenceServiceStub]] = []
+        for u in urls:
+            key = (u, tuple(sorted(options)))
+            # Process-global channel/stub reuse keyed by URL+options, the
+            # same allocation hygiene as the reference's channel cache.
+            with _channel_cache_lock:
+                cached = _channel_cache.get(key)
+                if cached is None:
+                    channel = grpc.insecure_channel(u, options=options)
+                    stub = GRPCInferenceServiceStub(channel)
+                    _channel_cache[key] = (channel, stub)
+                else:
+                    channel, stub = cached
+            self._endpoints.append((u, channel, stub))
+        url = self._endpoints[0][0]
+        self._channel = self._endpoints[0][1]
+        self._rr = itertools.count()
+        self._local = threading.local()
         self._verbose = verbose
         self._stream: _InferStream | None = None
         self._stats = InferStat()
@@ -374,9 +389,27 @@ class InferenceServerClient:
         # remains). Streaming retries connection establishment only.
         self._retry_policy = retry_policy
         self._breaker = circuit_breaker
-        self._breaker_host = url
         self._async_executor = None
         self._async_executor_lock = threading.Lock()
+
+    @property
+    def _client_stub(self):
+        """The stub for the next call. Multi-URL clients rotate here — the
+        stub is bound at the call site (``self._client_stub.ModelInfer``),
+        so one rotation covers all of that call's retry attempts — and the
+        thread records which endpoint it dialed for breaker attribution."""
+        if len(self._endpoints) == 1:
+            return self._endpoints[0][2]
+        url, _, stub = self._endpoints[next(self._rr)
+                                      % len(self._endpoints)]
+        self._local.host = url
+        return stub
+
+    @property
+    def _breaker_host(self):
+        if len(self._endpoints) == 1:
+            return self._endpoints[0][0]
+        return getattr(self._local, "host", self._endpoints[0][0])
 
     def get_infer_stat(self):
         """Cumulative client-side inference statistics (round-trip time

@@ -15,6 +15,7 @@ import gzip
 import base64
 import json
 import queue
+import random
 import threading
 import time
 import zlib
@@ -25,7 +26,12 @@ from urllib.parse import quote, urlencode
 import numpy as np
 
 from client_tpu.observability.client_stats import InferStat
-from client_tpu.resilience import run_with_resilience
+from client_tpu.resilience import (
+    CircuitBreaker,
+    CircuitBreakerOpenError,
+    run_with_resilience,
+)
+from client_tpu.router.core import rendezvous_pick
 from client_tpu.observability.tracing import (
     TraceContext,
     parse_server_timing,
@@ -33,6 +39,11 @@ from client_tpu.observability.tracing import (
 from client_tpu.protocol import rest
 from client_tpu.protocol.codec import serialize_tensor
 from client_tpu.protocol.dtypes import np_to_wire_dtype, wire_to_np_dtype
+from client_tpu.protocol.loadreport import LOAD_HEADER, decode_header
+from client_tpu.protocol.pushback import (
+    RETRY_AFTER_HEADER,
+    parse_retry_after,
+)
 from client_tpu.utils import InferenceServerException, raise_error
 
 
@@ -242,15 +253,12 @@ _STALE_SOCKET_ERRORS = (BadStatusLine, ConnectionResetError,
 def _parse_retry_after(resp) -> float | None:
     """Server pushback from a Retry-After header (seconds form only —
     this ecosystem's servers send fractional seconds; HTTP-date is not
-    used here). None when absent or unparsable."""
-    raw = resp.getheader("Retry-After") if resp is not None else None
-    if raw is None:
+    used here). None when absent or unparsable. Parsing is shared with
+    the gRPC metadata path (client_tpu.protocol.pushback) so both
+    transports agree on sub-second handling."""
+    if resp is None:
         return None
-    try:
-        value = float(raw)
-    except (TypeError, ValueError):
-        return None
-    return value if value >= 0 else None
+    return parse_retry_after(resp.getheader(RETRY_AFTER_HEADER))
 
 
 class _RetryableStatus(Exception):
@@ -267,6 +275,44 @@ class _RetryableStatus(Exception):
         self.data = data
         self.status = resp.status
         self.retry_after_s = _parse_retry_after(resp)
+
+
+class _Target:
+    """One server endpoint of a multi-URL client: its connection pool,
+    its last piggybacked load report, and the client-local outstanding
+    count. Single-URL clients never build these (zero overhead on the
+    common path)."""
+
+    def __init__(self, url, concurrency, timeout):
+        if "://" in url:
+            url = url.split("://", 1)[1]
+        host, _, port = url.rstrip("/").partition(":")
+        self.host = host
+        self.port = int(port or 80)
+        self.id = f"{self.host}:{self.port}"
+        self.pool = _ConnectionPool(self.host, self.port, concurrency,
+                                    timeout)
+        self.load = None
+        self.outstanding = 0
+        self._lock = threading.Lock()
+
+    def observe(self, resp) -> None:
+        """Learn the endpoint's load from a response's X-Tpu-Load
+        piggyback header — the zero-extra-RPC load view."""
+        report = decode_header(resp.getheader(LOAD_HEADER))
+        if report is not None:
+            with self._lock:
+                self.load = report
+
+    def score(self) -> float:
+        with self._lock:
+            return self.outstanding + (self.load.score() if self.load
+                                       else 0.0)
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self.load is not None and self.load.draining
 
 
 class _ConnectionPool:
@@ -343,14 +389,23 @@ class InferenceServerClient:
         if ssl:
             raise InferenceServerException(
                 "ssl is not supported by this transport yet")
-        if "://" in url:
-            url = url.split("://", 1)[1]
-        host, _, port = url.partition(":")
-        self._host = host
-        self._port = int(port or 80)
+        # Router-aware URL handling: a list (or comma-separated string) of
+        # URLs makes the client balance across N replicas itself — P2C on
+        # load score learned from X-Tpu-Load piggyback headers, per-target
+        # circuit breaking, and transparent failover. A single URL (which
+        # may be a standalone `client_tpu.router` frontend) keeps the
+        # original single-pool transport untouched.
+        urls = ([u.strip() for u in url.split(",") if u.strip()]
+                if isinstance(url, str) else [str(u) for u in url])
+        if not urls:
+            raise InferenceServerException("no server url given")
+        timeout = max(connection_timeout, network_timeout)
+        self._targets = [_Target(u, concurrency, timeout) for u in urls]
+        self._host = self._targets[0].host
+        self._port = self._targets[0].port
         self._verbose = verbose
-        self._pool = _ConnectionPool(self._host, self._port, concurrency,
-                                     max(connection_timeout, network_timeout))
+        self._pool = self._targets[0].pool
+        self._rng = random.Random()
         self._executor = ThreadPoolExecutor(max_workers=max(concurrency, 1))
         self._stats = InferStat()
         # Opt-in resilience (client_tpu.resilience): when a RetryPolicy is
@@ -359,6 +414,11 @@ class InferenceServerClient:
         # and each attempt's socket timeout shrinks to what remains.
         self._retry_policy = retry_policy
         self._breaker = circuit_breaker
+        if len(self._targets) > 1 and self._breaker is None:
+            # Multi-URL mode implies per-target circuit breaking: failover
+            # without breaker memory would re-probe a dead replica on
+            # every request.
+            self._breaker = CircuitBreaker()
         self._breaker_host = f"{self._host}:{self._port}"
         self._network_timeout = network_timeout
 
@@ -376,7 +436,8 @@ class InferenceServerClient:
 
     def close(self):
         self._executor.shutdown(wait=False)
-        self._pool.close()
+        for target in self._targets:
+            target.pool.close()
 
     # -- low-level ----------------------------------------------------------
 
@@ -385,8 +446,13 @@ class InferenceServerClient:
         headers = dict(headers or {})
         if query_params:
             path = path + "?" + urlencode(query_params)
-        if self._retry_policy is None and self._breaker is None:
-            return self._request_once(method, path, body, headers, None)
+        multi = len(self._targets) > 1
+        send = self._request_multi if multi else self._request_once
+        # Multi-target clients do per-target breaking inside the failover
+        # loop; the resilience wrapper only adds value when a RetryPolicy
+        # asks for cross-sweep retries.
+        if self._retry_policy is None and (multi or self._breaker is None):
+            return send(method, path, body, headers, None)
         # Correlate breaker transitions this request causes with its
         # distributed trace: infer() stamps a W3C traceparent header
         # (version-traceid-spanid-flags) before reaching here.
@@ -398,8 +464,7 @@ class InferenceServerClient:
                 trace_id = parts[1]
 
         def attempt(remaining_s):
-            resp, data = self._request_once(method, path, body, headers,
-                                            remaining_s)
+            resp, data = send(method, path, body, headers, remaining_s)
             retryable = (self._retry_policy is not None
                          and (resp.status
                               in self._retry_policy.retryable_statuses
@@ -408,8 +473,10 @@ class InferenceServerClient:
                                   is not None)))
             # A breaker-only client still needs 5xx surfaced as failures so
             # consecutive server faults trip it (4xx stays a plain return:
-            # the caller's fault, not the host's).
-            trips_breaker = self._breaker is not None and resp.status >= 500
+            # the caller's fault, not the host's). Multi-target mode
+            # already recorded per-target outcomes inside the sweep.
+            trips_breaker = (not multi and self._breaker is not None
+                             and resp.status >= 500)
             if retryable or trips_breaker:
                 # Surface retryable statuses as failures so the resilience
                 # loop replays them; _RetryableStatus keeps (resp, data) so
@@ -422,7 +489,7 @@ class InferenceServerClient:
             return run_with_resilience(
                 attempt,
                 policy=self._retry_policy,
-                breaker=self._breaker,
+                breaker=None if multi else self._breaker,
                 deadline_s=(self._network_timeout
                             if self._retry_policy is not None else None),
                 host=self._breaker_host,
@@ -432,7 +499,87 @@ class InferenceServerClient:
         except _RetryableStatus as exc:
             return exc.resp, exc.data
 
+    # -- multi-target (router-aware) transport -------------------------------
+
+    def _order_targets(self, headers):
+        """Sweep order: known-DRAINING targets last-resort only; affinity
+        pin for an X-Sequence-Id header, else power-of-two-choices on load
+        score; remaining targets by ascending score (failover order)."""
+        pool = [t for t in self._targets if not t.draining]
+        if not pool:
+            pool = list(self._targets)
+        if len(pool) == 1:
+            return pool
+        rest = sorted(pool, key=lambda t: t.score())
+        seq = headers.get("X-Sequence-Id")
+        if seq:
+            by_id = {t.id: t for t in pool}
+            primary = by_id[rendezvous_pick(sorted(by_id), seq)]
+        else:
+            a, b = self._rng.sample(pool, 2)
+            primary = a if a.score() <= b.score() else b
+        rest.remove(primary)
+        return [primary] + rest
+
+    def _request_multi(self, method, path, body, headers, remaining_s):
+        """One sweep across the targets with the router's classification:
+        transport failure trips that target's breaker and fails over;
+        pushback (429/503 + Retry-After) is breaker-neutral-positive and
+        fails over; a 5xx counts against the target and fails over. The
+        sweep returns a pushback response only when EVERY reachable
+        target pushed back (honest aggregation, client edition)."""
+        last_exc = None
+        pushback = None
+        last_5xx = None
+        for target in self._order_targets(headers):
+            if self._breaker is not None:
+                try:
+                    self._breaker.check(target.id, None)
+                except CircuitBreakerOpenError as exc:
+                    self._stats.record_breaker_rejection()
+                    last_exc = exc
+                    continue
+            with target._lock:
+                target.outstanding += 1
+            try:
+                resp, data = self._request_on(target.pool, method, path,
+                                              body, headers, remaining_s)
+            except Exception as exc:  # noqa: BLE001 — transport failure
+                if self._breaker is not None:
+                    self._breaker.record_failure(target.id, None)
+                last_exc = exc
+                continue
+            finally:
+                with target._lock:
+                    target.outstanding -= 1
+            target.observe(resp)
+            if (resp.status in (429, 503)
+                    and _parse_retry_after(resp) is not None):
+                # Alive and shedding — the opposite of down.
+                if self._breaker is not None:
+                    self._breaker.record_success(target.id, None)
+                pushback = (resp, data)
+                continue
+            if resp.status >= 500:
+                if self._breaker is not None:
+                    self._breaker.record_failure(target.id, None)
+                last_5xx = (resp, data)
+                continue
+            if self._breaker is not None:
+                self._breaker.record_success(target.id, None)
+            return resp, data
+        if pushback is not None:
+            return pushback
+        if last_5xx is not None:
+            return last_5xx
+        raise last_exc if last_exc is not None else InferenceServerException(
+            "no reachable server")
+
     def _request_once(self, method, path, body, headers, remaining_s):
+        return self._request_on(self._pool, method, path, body, headers,
+                                remaining_s)
+
+    def _request_on(self, pool, method, path, body, headers, remaining_s):
         """One wire attempt, with the urllib3-style stale-socket replay: a
         pooled keep-alive connection that dies before ANY response bytes
         are read is discarded and the request replayed exactly once on a
@@ -441,7 +588,7 @@ class InferenceServerClient:
         deadline = (time.monotonic() + remaining_s
                     if remaining_s is not None else None)
         for replay in (False, True):
-            conn, reused = self._pool.acquire()
+            conn, reused = pool.acquire()
             if deadline is not None:
                 # Per-attempt socket timeout shrinks to the remaining
                 # deadline budget so one attempt cannot overrun the total.
@@ -459,9 +606,9 @@ class InferenceServerClient:
                 resp = conn.getresponse()
                 got_response = True
                 data = resp.read()
-                self._pool.release(conn)
+                pool.release(conn)
             except Exception as exc:
-                self._pool.release(conn, broken=True)
+                pool.release(conn, broken=True)
                 if (reused and not replay and not got_response
                         and isinstance(exc, _STALE_SOCKET_ERRORS)
                         and (deadline is None
@@ -729,8 +876,14 @@ class InferenceServerClient:
 
     def _infer_request(self, model_name, model_version, body, header_length,
                        headers, query_params, request_compression_algorithm,
-                       response_compression_algorithm, timeout_ms=None):
+                       response_compression_algorithm, timeout_ms=None,
+                       sequence_id=0):
         req_headers = dict(headers or {})
+        if sequence_id:
+            # Affinity signal for L7 routing (this client's own multi-URL
+            # sweep and the standalone router both rendezvous-hash on it)
+            # — a header, so no intermediary ever parses the body.
+            req_headers.setdefault("X-Sequence-Id", str(sequence_id))
         if timeout_ms is not None:
             # End-to-end deadline propagation: the server's scheduler and
             # model skip this request once the budget lapses (504 instead
@@ -791,7 +944,8 @@ class InferenceServerClient:
         return self._infer_request(
             model_name, model_version, body, header_length, headers,
             query_params, request_compression_algorithm,
-            response_compression_algorithm, timeout_ms=timeout_ms)
+            response_compression_algorithm, timeout_ms=timeout_ms,
+            sequence_id=sequence_id)
 
     def async_infer(self, model_name, inputs, model_version="", outputs=None,
                     request_id="", sequence_id=0, sequence_start=False,
@@ -807,5 +961,5 @@ class InferenceServerClient:
             self._infer_request, model_name, model_version, body,
             header_length, headers, query_params,
             request_compression_algorithm, response_compression_algorithm,
-            timeout_ms)
+            timeout_ms, sequence_id)
         return InferAsyncRequest(future, self._verbose)

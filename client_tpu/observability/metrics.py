@@ -449,3 +449,64 @@ class EngineMetrics:
 
     def render(self, openmetrics: bool = False) -> str:
         return self.registry.render(openmetrics)
+
+
+class RouterMetrics:
+    """The L7 router's metric vocabulary (``tpu_router_*``).
+
+    Lives on its own registry by default so a router co-located with
+    engine replicas in one process scrapes only routing metrics from its
+    ``/metrics`` (the engines keep their private registries). Balancing
+    quality is read off ``tpu_router_requests_total{replica}`` — under
+    uniform load the per-replica spread is the P2C acceptance check.
+    """
+
+    def __init__(self, registry: MetricRegistry | None = None):
+        self.registry = registry or MetricRegistry()
+        r = self.registry
+        self.requests = r.counter(
+            "tpu_router_requests_total",
+            "Requests forwarded, by replica and outcome (ok, error, "
+            "pushback, unreachable)",
+            ("replica", "outcome"))
+        self.failovers = r.counter(
+            "tpu_router_failovers_total",
+            "Requests re-routed to another replica after the first "
+            "candidate failed or pushed back, by failed replica",
+            ("replica",))
+        self.sheds = r.counter(
+            "tpu_router_sheds_total",
+            "Requests shed by the router itself: every candidate pushed "
+            "back (all_pushback) or no replica was reachable (no_replica)",
+            ("reason",))
+        self.breaker_open = r.gauge(
+            "tpu_router_breaker_open",
+            "1 while the per-replica circuit breaker is open",
+            ("replica",))
+        self.replica_states = r.gauge(
+            "tpu_router_replicas",
+            "Replicas known to the router, by last observed health state",
+            ("state",))
+        self.request_duration_us = r.histogram(
+            "tpu_router_request_duration_us",
+            "Router-observed request duration including failovers "
+            "(microseconds)",
+            ("replica",))
+        self.load_report_age = r.gauge(
+            "tpu_router_load_report_age_seconds",
+            "Seconds since each replica's load report was refreshed "
+            "(piggyback or /v2/load poll)",
+            ("replica",))
+        self.affinity_routed = r.counter(
+            "tpu_router_affinity_routed_total",
+            "Requests pinned to a replica by sequence-id rendezvous "
+            "affinity rather than P2C",
+            ("replica",))
+        self.drain_steps = r.counter(
+            "tpu_router_drain_steps_total",
+            "Rolling-drain steps executed, by replica and outcome "
+            "(clean, dirty, timeout, skipped)",
+            ("replica", "outcome"))
+
+    def render(self, openmetrics: bool = False) -> str:
+        return self.registry.render(openmetrics)

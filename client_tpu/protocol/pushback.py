@@ -1,0 +1,99 @@
+"""Retry-After pushback: ONE parse/format pair for every transport.
+
+Before this module each surface had its own formatter/parser and they
+disagreed on sub-second handling: the HTTP server printed ``"%.3f"``
+(so a 0.4ms clip floor became ``"0.000"``, which the HTTP client parsed
+back as an *immediate* retry), while the gRPC server's integral
+``retry-pushback-ms`` mirror truncated (``int(s * 1000)``) instead of
+rounding, so 9.9999s read back as 9.999s on one channel and 10.000s on
+the other. Both servers and both clients now route through here:
+
+* :func:`format_retry_after_s` — fractional-seconds text for the HTTP
+  ``Retry-After`` header and the gRPC ``retry-after`` trailing metadata.
+  3-decimal fixed point, rounded half-up; positive values floor at
+  0.001 so pushback can never collapse to "retry now".
+* :func:`format_retry_pushback_ms` — integral milliseconds for the gRPC
+  ``retry-pushback-ms`` mirror (some proxies strip fractional values).
+  Rounded, floored at 1ms for positive input — always within 0.5ms of
+  the seconds form.
+* :func:`parse_retry_after` — text -> seconds. Fractional or integral
+  seconds; None on absent/unparsable/negative (callers treat None as
+  "no pushback", never as "retry immediately").
+* :func:`parse_pushback_metadata` — the gRPC client's trailing-metadata
+  view (``retry-after`` preferred, ``retry-pushback-ms`` fallback).
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "RETRY_AFTER_HEADER",
+    "RETRY_AFTER_METADATA_KEY",
+    "RETRY_PUSHBACK_MS_METADATA_KEY",
+    "format_retry_after_s",
+    "format_retry_pushback_ms",
+    "parse_retry_after",
+    "parse_pushback_metadata",
+]
+
+RETRY_AFTER_HEADER = "Retry-After"
+RETRY_AFTER_METADATA_KEY = "retry-after"
+RETRY_PUSHBACK_MS_METADATA_KEY = "retry-pushback-ms"
+
+
+def format_retry_after_s(seconds: float) -> str:
+    """Canonical wire text for a pushback interval in seconds.
+
+    Negative input clamps to 0 ("retry now" is only ever deliberate);
+    any positive interval renders as at least ``"0.001"`` so rounding
+    cannot silently erase the server's request to back off.
+    """
+    s = float(seconds)
+    if s <= 0.0:
+        return "0.000"
+    # round() half-even at the 3rd decimal, then re-floor: 0.0004 must
+    # not round down to zero.
+    return f"{max(round(s, 3), 0.001):.3f}"
+
+
+def format_retry_pushback_ms(seconds: float) -> str:
+    """Integral-millisecond mirror of :func:`format_retry_after_s`.
+
+    Rounds (the old formatter truncated, so the two encodings of one
+    interval disagreed by up to 1ms); positive input floors at 1ms.
+    """
+    s = float(seconds)
+    if s <= 0.0:
+        return "0"
+    return str(max(1, round(s * 1000)))
+
+
+def parse_retry_after(raw) -> float | None:
+    """Text (or None) -> pushback seconds, or None when the value is
+    absent, unparsable, or negative. Accepts the integral-seconds form
+    plain proxies rewrite to; HTTP-date is not used in this ecosystem."""
+    if raw is None:
+        return None
+    try:
+        value = float(raw)
+    except (TypeError, ValueError):
+        return None
+    # Non-finite values ("inf", "nan") must read as "no pushback", not
+    # "wait forever".
+    return value if 0 <= value < float("inf") else None
+
+
+def parse_pushback_metadata(meta) -> float | None:
+    """gRPC trailing metadata (any mapping with lowercase keys, or an
+    iterable of (key, value)) -> pushback seconds, or None.
+
+    ``retry-after`` (fractional seconds) wins over ``retry-pushback-ms``
+    — the ms mirror exists for consumers that drop fractional text."""
+    if meta is None:
+        return None
+    if not hasattr(meta, "get"):
+        meta = {str(k).lower(): v for k, v in meta}
+    value = parse_retry_after(meta.get(RETRY_AFTER_METADATA_KEY))
+    if value is not None:
+        return value
+    ms = parse_retry_after(meta.get(RETRY_PUSHBACK_MS_METADATA_KEY))
+    return ms / 1000.0 if ms is not None else None

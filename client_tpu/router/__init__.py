@@ -1,0 +1,62 @@
+"""Shard-aware multi-replica router: load-aware L7 balancing in front
+of N engines.
+
+One engine process owns one accelerator slice; scaling a model past a
+slice means running N replicas and deciding, per request, which one
+takes it. This package is that decision layer — deliberately thin
+(stdlib HTTP, no event loop) and driven by signals the engines already
+produce:
+
+* every response carries an ``X-Tpu-Load`` piggyback header, so the
+  steady-state load view costs zero extra RPCs (``GET /v2/load`` covers
+  bootstrap and idle gaps);
+* selection is rendezvous affinity for sequences, then
+  power-of-two-choices on load score, then score-ordered failover;
+* per-replica circuit breaking reuses :mod:`client_tpu.resilience`;
+* pushback aggregation is honest: shed only when ALL candidates pushed
+  back, propagating the fleet's minimum ``Retry-After``;
+* :func:`rolling_drain` walks replicas through their existing SIGTERM
+  drain one at a time, readiness-gated;
+* :mod:`placement <client_tpu.router.placement>` turns ``/v2/profile``
+  device-seconds into a contention-aware model→replica plan.
+
+Use it in-process (``Router([...]).start()`` + ``forward``), or
+standalone::
+
+    python -m client_tpu.router --replica http://h1:8000 \
+        --replica http://h2:8000 --port 8080
+
+See ``docs/ROUTER.md`` for the operational story.
+"""
+
+from client_tpu.router.core import (
+    ProxyResponse,
+    Replica,
+    Router,
+    normalize_replica_url,
+    rendezvous_pick,
+    replicas_from_hostlist,
+)
+from client_tpu.router.drain import rolling_drain
+from client_tpu.router.placement import (
+    apply_placement,
+    model_costs,
+    placement_moves,
+    plan_placement,
+)
+from client_tpu.router.server import RouterHttpServer
+
+__all__ = [
+    "ProxyResponse",
+    "Replica",
+    "Router",
+    "RouterHttpServer",
+    "apply_placement",
+    "model_costs",
+    "normalize_replica_url",
+    "placement_moves",
+    "plan_placement",
+    "rendezvous_pick",
+    "replicas_from_hostlist",
+    "rolling_drain",
+]

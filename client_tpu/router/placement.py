@@ -1,0 +1,115 @@
+"""Contention-aware model→replica placement from ``/v2/profile``.
+
+Co-locating two hot models on one replica makes them fight for the same
+device (the shared-resource contention result of "Shared Memory-
+contention-aware Concurrent DNN Execution", arXiv 2308.05869, applied at
+replica granularity): each model's measured device-seconds from the
+replicas' efficiency profilers is the contention cost, and placement is
+the classic longest-processing-time greedy — heaviest model first onto
+the replica with the least accumulated cost. LPT is within 4/3 of the
+optimal makespan, deterministic, and explainable in a runbook, which a
+serving control plane values over the last few percent.
+
+The plan is a *control-plane* action (``Router.plan_placement`` /
+``POST /v2/router/placement``), never something the data path does
+implicitly: moving a model means load/unload churn and cold compiles, so
+an operator (or an orchestrator cron) applies it deliberately.
+"""
+
+from __future__ import annotations
+
+import json
+
+__all__ = ["model_costs", "plan_placement", "placement_moves",
+           "apply_placement"]
+
+
+def model_costs(profiles: dict[str, dict]) -> dict[str, float]:
+    """Fleet-wide per-model contention cost from ``/v2/profile`` bodies:
+    device-seconds summed across replicas and versions (device time is
+    the resource replicas contend on). Models that have never executed
+    cost a nominal epsilon so they still get spread out."""
+    costs: dict[str, float] = {}
+    for prof in profiles.values():
+        for entry in (prof.get("models") or {}).values():
+            name = entry.get("model")
+            if not name:
+                continue
+            costs[name] = costs.get(name, 0.0) + float(
+                entry.get("device_s", 0.0) or 0.0)
+    return {m: (c if c > 0 else 1e-6) for m, c in costs.items()}
+
+
+def plan_placement(costs: dict[str, float], replica_ids: list[str],
+                   current: dict[str, set] | None = None,
+                   min_replicas_per_model: int = 1) -> dict[str, list[str]]:
+    """LPT greedy: heaviest model first onto the least-loaded replica.
+
+    ``current`` (replica id -> models hosted now) breaks ties toward the
+    replica already hosting the model, so a balanced fleet replans to
+    itself and nothing churns. Returns replica id -> sorted model list;
+    every model lands on at least ``min_replicas_per_model`` replicas
+    (capped at the fleet size).
+    """
+    if not replica_ids:
+        raise ValueError("no replicas to place onto")
+    current = current or {}
+    copies = min(max(1, min_replicas_per_model), len(replica_ids))
+    accumulated = {rid: 0.0 for rid in replica_ids}
+    plan: dict[str, list[str]] = {rid: [] for rid in replica_ids}
+    for model, cost in sorted(costs.items(),
+                              key=lambda kv: (-kv[1], kv[0])):
+        placed: set[str] = set()
+        for _ in range(copies):
+            rid = min(
+                (r for r in replica_ids if r not in placed),
+                key=lambda r: (accumulated[r],
+                               model not in current.get(r, ()), r))
+            plan[rid].append(model)
+            accumulated[rid] += cost / copies
+            placed.add(rid)
+    return {rid: sorted(models) for rid, models in plan.items()}
+
+
+def placement_moves(plan: dict[str, list[str]],
+                    current: dict[str, set]) -> list[dict]:
+    """Diff a plan against current hosting into explicit load/unload
+    steps. Loads come first across the whole fleet so capacity is added
+    before it is removed (no model ever has zero live copies mid-apply)."""
+    loads, unloads = [], []
+    for rid, models in plan.items():
+        have = set(current.get(rid, ()))
+        want = set(models)
+        loads += [{"replica": rid, "action": "load", "model": m}
+                  for m in sorted(want - have)]
+        unloads += [{"replica": rid, "action": "unload", "model": m}
+                    for m in sorted(have - want)]
+    return loads + unloads
+
+
+def apply_placement(router, plan: dict[str, list[str]]) -> list[dict]:
+    """Issue the load/unload steps against the replicas through their
+    repository control plane. Returns the step list with per-step
+    ``ok``/``error`` annotations; a failed load aborts before any unload
+    runs (capacity is never removed after an add failed)."""
+    current = {r.id: set(r.load.models) for r in router.replicas}
+    steps = placement_moves(plan, current)
+    results = []
+    for step in steps:
+        replica = router.replica(step["replica"])
+        path = f"/v2/repository/models/{step['model']}/{step['action']}"
+        try:
+            status, _, data = replica.send(
+                "POST", path, headers={"Content-Type": "application/json"},
+                body=b"{}", timeout_s=120.0)
+            ok = status == 200
+            err = None if ok else json.loads(data or b"{}").get(
+                "error", f"HTTP {status}")
+        except Exception as exc:  # noqa: BLE001
+            ok, err = False, repr(exc)
+        results.append({**step, "ok": ok, **({"error": err} if err else {})})
+        router.events.emit("router", "placement_step",
+                           severity="INFO" if ok else "ERROR", **results[-1])
+        if not ok and step["action"] == "load":
+            break
+    return results

@@ -1,0 +1,269 @@
+"""The standalone router frontend: a thin HTTP/1.1 reverse proxy over
+:class:`client_tpu.router.core.Router`.
+
+Router-owned endpoints (never proxied):
+
+* ``GET /v2/health/live`` — router process liveness.
+* ``GET /v2/health/ready`` — fleet readiness: 200 while ≥1 replica is
+  eligible, 503 (+ ``X-Health-State: DRAINING``) when none is.
+* ``GET /v2/load`` — the fleet view: every replica's last load report
+  with age, breaker state, and outstanding counts, plus routing config.
+* ``GET /metrics`` — the router's OWN ``tpu_router_*`` registry (classic
+  or OpenMetrics by Accept), not an aggregation of replica metrics.
+* ``GET /v2/router/status`` — replica table (same body as /v2/load).
+* ``GET /v2/router/placement`` — contention-aware placement *plan* from
+  the replicas' ``/v2/profile`` duty/device-seconds; ``POST`` applies it.
+* ``POST /v2/router/drain`` — rolling drain walk (body:
+  ``{"replicas": [...], "deadline_s": ...}``; replicas need pids or the
+  walk is driven in-process through :mod:`client_tpu.router.drain`).
+
+Everything else under ``/v2`` is forwarded through the selection policy.
+The sequence id for affinity comes from the ``X-Sequence-Id`` request
+header (our clients set it) or, failing that, the JSON request head —
+header first, so the hot path never parses a body it does not need to.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from client_tpu.router.core import Router
+from client_tpu.router.drain import rolling_drain
+from client_tpu.router import placement as _placement
+
+_log = logging.getLogger("client_tpu")
+
+SEQUENCE_ID_HEADER = "X-Sequence-Id"
+
+_STREAM_PATH = re.compile(
+    r"^/v2/models/[^/]+(?:/versions/[^/]+)?/generate_stream$")
+_INFER_PATH = re.compile(
+    r"^/v2/models/[^/]+(?:/versions/[^/]+)?/(?:infer|generate|"
+    r"generate_stream)$")
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    disable_nagle_algorithm = True
+    wbufsize = 64 * 1024
+    router: Router = None  # patched on by RouterHttpServer
+    verbose = False
+
+    def log_message(self, fmt, *args):  # noqa: A003
+        if self.verbose:
+            super().log_message(fmt, *args)
+
+    # -- plumbing -----------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802
+        self._dispatch("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._dispatch("POST")
+
+    def _dispatch(self, method: str) -> None:
+        try:
+            body = self.rfile.read(
+                int(self.headers.get("Content-Length", 0) or 0)
+            ) if method == "POST" else b""
+            path = self.path.split("?")[0]
+            own = getattr(self, f"h_{method.lower()}_" +
+                          path.strip("/").replace("/", "_").replace(".", "_"),
+                          None)
+            if own is not None:
+                own(body)
+                return
+            if path.startswith("/v2"):
+                self._proxy(method, body)
+                return
+            self._send_json({"error": f"no route for {method} {path}"}, 404)
+        except BrokenPipeError:
+            pass
+        except Exception as exc:  # noqa: BLE001
+            _log.exception("router handler error")
+            try:
+                self._send_json({"error": f"router error: {exc}"}, 500)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _send(self, status: int, body: bytes, headers=None) -> None:
+        self.send_response(status)
+        sent = set()
+        for k, v in (headers or []):
+            self.send_header(k, v)
+            sent.add(k.lower())
+        if "content-type" not in sent:
+            self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _send_json(self, obj, status: int = 200, headers=None) -> None:
+        self._send(status, json.dumps(obj).encode("utf-8"), headers)
+
+    # -- router-owned endpoints ---------------------------------------------
+
+    def h_get_v2_health_live(self, body):
+        self._send(200, b"")
+
+    def h_get_v2_health_ready(self, body):
+        eligible = self.router.eligible()
+        state = "READY" if eligible else "DRAINING"
+        self._send_json({"state": state,
+                         "eligible": [r.id for r in eligible]},
+                        200 if eligible else 503,
+                        headers=[("X-Health-State", state)])
+
+    def h_get_v2_load(self, body):
+        self._send_json(self.router.status())
+
+    def h_get_v2_router_status(self, body):
+        self._send_json(self.router.status())
+
+    def h_get_metrics(self, body):
+        accept = self.headers.get("Accept", "") or ""
+        om = "application/openmetrics-text" in accept
+        text = self.router.metrics.render(openmetrics=om)
+        ctype = ("application/openmetrics-text; version=1.0.0; charset=utf-8"
+                 if om else "text/plain; version=0.0.4")
+        self._send(200, text.encode("utf-8"),
+                   headers=[("Content-Type", ctype)])
+
+    def _placement_plan(self):
+        profiles, current = {}, {}
+        for r in self.router.eligible():
+            try:
+                status, _, data = r.send("GET", "/v2/profile", timeout_s=10)
+                if status == 200:
+                    profiles[r.id] = json.loads(data)
+            except Exception:  # noqa: BLE001 — plan over who answers
+                continue
+            current[r.id] = set(r.load.models)
+        costs = _placement.model_costs(profiles)
+        if not costs:
+            # Nothing has executed yet: place whatever the fleet hosts.
+            for models in current.values():
+                for m in models:
+                    costs.setdefault(m, 1e-6)
+        plan = _placement.plan_placement(
+            costs, sorted(profiles) or sorted(current))
+        return costs, current, plan
+
+    def h_get_v2_router_placement(self, body):
+        costs, current, plan = self._placement_plan()
+        self._send_json({
+            "costs_device_s": {m: round(c, 6) for m, c in costs.items()},
+            "current": {rid: sorted(ms) for rid, ms in current.items()},
+            "plan": plan,
+            "moves": _placement.placement_moves(plan, current),
+        })
+
+    def h_post_v2_router_placement(self, body):
+        _, current, plan = self._placement_plan()
+        results = _placement.apply_placement(self.router, plan)
+        self._send_json({"plan": plan, "applied": results})
+
+    def h_post_v2_router_drain(self, body):
+        opts = json.loads(body or b"{}")
+        reports = rolling_drain(
+            self.router, opts.get("replicas"),
+            deadline_s=float(opts.get("deadline_s", 30.0)))
+        ok = all(r["outcome"] in ("clean", "gone") for r in reports)
+        self._send_json({"reports": reports}, 200 if ok else 500)
+
+    # -- the proxy path ------------------------------------------------------
+
+    def _sequence_id(self, path: str, body: bytes) -> int:
+        raw = self.headers.get(SEQUENCE_ID_HEADER)
+        if raw is not None:
+            try:
+                return int(raw)
+            except ValueError:
+                return 0
+        # Fall back to the JSON head only when it plausibly names one and
+        # arrived uncompressed (compressed callers use the header).
+        if (not _INFER_PATH.match(path) or b'"sequence_id"' not in body
+                or self.headers.get("Content-Encoding")):
+            return 0
+        header_len = self.headers.get("Inference-Header-Content-Length")
+        head = body[:int(header_len)] if header_len else body
+        try:
+            params = json.loads(head).get("parameters") or {}
+            return int(params.get("sequence_id", 0))
+        except (ValueError, TypeError, AttributeError):
+            return 0
+
+    def _proxy(self, method: str, body: bytes) -> None:
+        path = self.path.split("?")[0]
+        stream = bool(_STREAM_PATH.match(path))
+        trace_id = None
+        tp = self.headers.get("traceparent")
+        if tp and len(tp.split("-")) == 4:
+            trace_id = tp.split("-")[1]
+        out = self.router.forward(
+            method, self.path, headers=dict(self.headers.items()),
+            body=body, sequence_id=self._sequence_id(path, body),
+            stream=stream, trace_id=trace_id)
+        if out.stream is None:
+            self._send(out.status, out.body, headers=out.headers)
+            return
+        # Streaming (SSE) pass-through: chunked transfer toward the
+        # client, re-framed from the upstream read loop.
+        self.send_response(out.status)
+        for k, v in out.headers:
+            self.send_header(k, v)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        self.wfile.flush()
+        try:
+            for piece in out.stream:
+                self.wfile.write(f"{len(piece):X}\r\n".encode()
+                                 + piece + b"\r\n")
+                self.wfile.flush()
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            out.stream.close()  # dead client: stop pulling upstream
+
+
+class RouterHttpServer:
+    """Threaded standalone router frontend over a :class:`Router`."""
+
+    def __init__(self, router: Router, host: str = "127.0.0.1",
+                 port: int = 8080, verbose: bool = False):
+        handler = type("BoundRouterHandler", (_RouterHandler,),
+                       {"router": router, "verbose": verbose})
+        self.router = router
+        server_cls = type("_RouterHttpd", (ThreadingHTTPServer,),
+                          {"request_queue_size": 128})
+        self.httpd = server_cls((host, port), handler)
+        self.httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"{self.httpd.server_address[0]}:{self.port}"
+
+    def start(self) -> "RouterHttpServer":
+        self.router.start()
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="router-http",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self.router.stop()

@@ -34,6 +34,13 @@ from client_tpu.protocol.grpc_stub import (
     GRPCInferenceServiceServicer,
     add_GRPCInferenceServiceServicer_to_server,
 )
+from client_tpu.protocol.loadreport import LOAD_METADATA_KEY, encode_header
+from client_tpu.protocol.pushback import (
+    RETRY_AFTER_METADATA_KEY,
+    RETRY_PUSHBACK_MS_METADATA_KEY,
+    format_retry_after_s,
+    format_retry_pushback_ms,
+)
 from client_tpu.protocol.model_config import config_dict_to_proto
 from client_tpu.server.classification import classify_output
 from client_tpu.server.coalesce import (
@@ -71,8 +78,9 @@ def _abort(context, exc: Exception):
     retry_after_s = getattr(exc, "retry_after_s", None)
     if retry_after_s is not None:
         context.set_trailing_metadata((
-            ("retry-after", f"{retry_after_s:.3f}"),
-            ("retry-pushback-ms", str(max(1, int(retry_after_s * 1000)))),
+            (RETRY_AFTER_METADATA_KEY, format_retry_after_s(retry_after_s)),
+            (RETRY_PUSHBACK_MS_METADATA_KEY,
+             format_retry_pushback_ms(retry_after_s)),
         ))
     if isinstance(exc, EngineError):
         code = _STATUS_BY_HTTP.get(exc.status, grpc.StatusCode.UNKNOWN)
@@ -250,6 +258,14 @@ class _Servicer(GRPCInferenceServiceServicer):
         return pb.ServerLiveResponse(live=self.engine.is_live())
 
     def ServerReady(self, request, context):  # noqa: N802
+        # Mirror of the HTTP frontend's X-Health-State header: the nuanced
+        # state (READY/DEGRADED/DRAINING) rides in trailing metadata so a
+        # router can tell a draining replica from a dead one over gRPC too.
+        try:
+            context.set_trailing_metadata(
+                (("x-health-state", self.engine.health_state()),))
+        except Exception:  # noqa: BLE001 — telemetry must not fail health
+            pass
         return pb.ServerReadyResponse(ready=self.engine.is_ready())
 
     def ModelReady(self, request, context):  # noqa: N802
@@ -503,6 +519,15 @@ class _Servicer(GRPCInferenceServiceServicer):
             if not context.add_callback(req.cancel):
                 req.cancel()
             resp = self.engine.infer(req)
+            # Load-report piggyback (mirror of the HTTP X-Tpu-Load
+            # header): every unary response refreshes the caller's view
+            # of this replica's load at zero extra RPCs.
+            try:
+                context.set_trailing_metadata((
+                    (LOAD_METADATA_KEY,
+                     encode_header(self.engine.load_report())),))
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
             return _response_to_proto(self.engine, req, resp)
         except Exception as exc:  # noqa: BLE001
             _abort(context, exc)

@@ -37,6 +37,11 @@ from client_tpu.observability.tracing import (
     server_timing_header,
 )
 from client_tpu.protocol import rest
+from client_tpu.protocol.loadreport import LOAD_HEADER, encode_header
+from client_tpu.protocol.pushback import (
+    RETRY_AFTER_HEADER,
+    format_retry_after_s,
+)
 from client_tpu.server.classification import classify_output
 
 _ROUTES: list[tuple[str, re.Pattern, str]] = [
@@ -68,6 +73,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/v2/events$"), "events"),
     ("GET", re.compile(r"^/v2/slo$"), "slo"),
     ("GET", re.compile(r"^/v2/profile$"), "profile"),
+    ("GET", re.compile(r"^/v2/load$"), "load"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
 ]
 
@@ -191,12 +197,22 @@ class _Handler(BaseHTTPRequestHandler):
                     retry_after_s: float | None = None) -> None:
         # Admission/drain sheds carry server pushback: Retry-After in
         # fractional seconds (our RetryPolicy parses floats; proxies that
-        # only read integral seconds round down harmlessly).
-        headers = ({"Retry-After": f"{retry_after_s:.3f}"}
-                   if retry_after_s is not None else None)
+        # only read integral seconds round down harmlessly). The shared
+        # formatter keeps the text identical to the gRPC metadata form.
+        headers = {}
+        if retry_after_s is not None:
+            headers[RETRY_AFTER_HEADER] = format_retry_after_s(retry_after_s)
+        if status in (429, 503):
+            # A shed/drain rejection names the health state it came from,
+            # so an L7 router can tell a DRAINING replica (stop routing,
+            # don't breaker it) from an overloaded or dead one.
+            try:
+                headers["X-Health-State"] = self.engine.health_state()
+            except Exception:  # noqa: BLE001 — telemetry must not mask
+                pass           # the error being reported
         try:
             self._send(status, json.dumps({"error": msg}).encode("utf-8"),
-                       extra_headers=headers)
+                       extra_headers=headers or None)
         except Exception:  # noqa: BLE001 — peer may have gone away
             pass
 
@@ -341,6 +357,15 @@ class _Handler(BaseHTTPRequestHandler):
         q = parse_qs(urlparse(self.path).query)
         model = (q.get("model") or [None])[0]
         self._send_json(self.engine.profile_snapshot(model=model))
+
+    def h_load(self):
+        """Replica load report (``/v2/load``): the pull form of the
+        ``X-Tpu-Load`` response piggyback — health state, in-flight,
+        queue depth, EWMA wait estimate, SLO fast-burn, loaded models.
+        Routers bootstrap from this and refresh via piggyback."""
+        report = self.engine.load_report()
+        self._send(200, json.dumps(report.to_json_dict()).encode("utf-8"),
+                   extra_headers={LOAD_HEADER: encode_header(report)})
 
     def h_trace_setting(self):
         self._send_json(self.engine.trace_setting())
@@ -703,6 +728,13 @@ class _Handler(BaseHTTPRequestHandler):
             headers["traceparent"] = req.trace.to_traceparent()
         if resp.times is not None:
             headers["Server-Timing"] = server_timing_header(resp.times)
+        # Load-report piggyback: every response refreshes the caller's
+        # view of this replica's load, so steady-state L7 routing costs
+        # zero extra RPCs (the report itself is cached engine-side).
+        try:
+            headers[LOAD_HEADER] = encode_header(self.engine.load_report())
+        except Exception:  # noqa: BLE001 — telemetry must not fail a
+            pass           # successful inference
         self._send(200, body, content_type=ctype, extra_headers=headers)
 
     def _write_shm_output(self, o: OutputRequest, arr: np.ndarray) -> int:

@@ -1,0 +1,135 @@
+"""Property tests for the shared pushback + load-report wire helpers
+(`client_tpu.protocol.pushback`, `client_tpu.protocol.loadreport`) —
+the ONE place both servers and both clients agree on Retry-After /
+retry-pushback-ms formatting and on the X-Tpu-Load piggyback form.
+"""
+
+import random
+
+import pytest
+
+from client_tpu.protocol.loadreport import (
+    LoadReport,
+    decode_header,
+    encode_header,
+)
+from client_tpu.protocol.pushback import (
+    format_retry_after_s,
+    format_retry_pushback_ms,
+    parse_pushback_metadata,
+    parse_retry_after,
+)
+
+
+class TestRetryAfterRoundTrip:
+    def test_format_parse_round_trip_preserves_order(self):
+        rng = random.Random(7)
+        values = [rng.uniform(0.0005, 120.0) for _ in range(200)]
+        for s in values:
+            parsed = parse_retry_after(format_retry_after_s(s))
+            assert parsed is not None
+            assert abs(parsed - s) <= 0.0005 + 1e-9, (s, parsed)
+
+    def test_positive_never_formats_to_zero(self):
+        # The old per-server "%.3f" truncated 0.0004 -> "0.000", telling
+        # clients to hammer back immediately; the shared helper floors at
+        # 1 ms instead.
+        for s in (1e-6, 0.0004, 0.00049, 0.0005):
+            parsed = parse_retry_after(format_retry_after_s(s))
+            assert parsed is not None and parsed >= 0.001, (s, parsed)
+
+    def test_zero_and_negative(self):
+        assert format_retry_after_s(0.0) == "0.000"
+        assert format_retry_after_s(-5.0) == "0.000"
+        assert parse_retry_after("0.000") == 0.0
+
+    @pytest.mark.parametrize("raw", [None, "", "soon", "-1", "-0.5", "nan",
+                                     "inf"])
+    def test_parse_garbage_is_none(self, raw):
+        assert parse_retry_after(raw) is None
+
+    def test_parse_integer_seconds(self):
+        # RFC form is integral seconds; both must parse.
+        assert parse_retry_after("3") == 3.0
+        assert parse_retry_after("0.25") == 0.25
+
+
+class TestPushbackMs:
+    def test_positive_never_zero_ms(self):
+        rng = random.Random(11)
+        for _ in range(200):
+            s = rng.uniform(1e-7, 10.0)
+            ms = int(format_retry_pushback_ms(s))
+            assert ms >= 1, s
+            assert abs(ms - s * 1000) <= 1.0
+
+    def test_zero_is_zero(self):
+        assert format_retry_pushback_ms(0.0) == "0"
+        assert format_retry_pushback_ms(-1.0) == "0"
+
+
+class TestMetadataParsing:
+    def test_retry_after_wins_over_ms(self):
+        got = parse_pushback_metadata(
+            [("retry-after", "0.500"), ("retry-pushback-ms", "900")])
+        assert got == 0.5
+
+    def test_ms_fallback(self):
+        assert parse_pushback_metadata(
+            [("retry-pushback-ms", "250")]) == pytest.approx(0.25)
+
+    def test_mapping_form(self):
+        assert parse_pushback_metadata({"retry-after": "1.250"}) == 1.25
+
+    def test_absent_and_garbage(self):
+        assert parse_pushback_metadata([]) is None
+        assert parse_pushback_metadata(None) is None
+        assert parse_pushback_metadata([("retry-after", "soon")]) is None
+
+    def test_server_formats_parse_back(self):
+        # The exact pair the gRPC server attaches must round-trip.
+        rng = random.Random(3)
+        for _ in range(100):
+            s = rng.uniform(0.001, 30.0)
+            meta = [("retry-after", format_retry_after_s(s)),
+                    ("retry-pushback-ms", format_retry_pushback_ms(s))]
+            got = parse_pushback_metadata(meta)
+            assert got is not None and abs(got - s) <= 0.0005 + 1e-9
+
+
+class TestLoadReportHeader:
+    def test_round_trip(self):
+        rng = random.Random(5)
+        for _ in range(100):
+            rep = LoadReport(
+                state=rng.choice(("READY", "DEGRADED", "DRAINING")),
+                inflight=rng.randrange(0, 500),
+                queue_depth=rng.randrange(0, 500),
+                active_batches=rng.randrange(0, 16),
+                wait_s=round(rng.uniform(0, 20), 4),
+                slo_fast_burn=rng.random() < 0.5)
+            got = decode_header(encode_header(rep))
+            assert got is not None
+            assert got.state == rep.state
+            assert got.inflight == rep.inflight
+            assert got.queue_depth == rep.queue_depth
+            assert got.active_batches == rep.active_batches
+            assert got.wait_s == pytest.approx(rep.wait_s, abs=1e-4)
+            assert got.slo_fast_burn == rep.slo_fast_burn
+
+    @pytest.mark.parametrize("raw", [None, "", "garbage", "s=BOGUS;i=1",
+                                     "i=notanint;s=READY", "s=READY;i="])
+    def test_decode_garbage_is_none(self, raw):
+        assert decode_header(raw) is None
+
+    def test_score_monotone_in_load(self):
+        lo = LoadReport(inflight=1, queue_depth=0, wait_s=0.0)
+        hi = LoadReport(inflight=5, queue_depth=3, wait_s=1.0)
+        assert lo.score() < hi.score()
+
+    def test_json_round_trip(self):
+        rep = LoadReport(state="DEGRADED", inflight=3, queue_depth=2,
+                         active_batches=1, wait_s=0.5, slo_fast_burn=True,
+                         models=("a", "b"), ts=12.0)
+        got = LoadReport.from_json_dict(rep.to_json_dict())
+        assert got == rep

@@ -1,0 +1,452 @@
+"""Unit tests for the L7 router: selection (rendezvous affinity, P2C,
+score ordering), honest pushback aggregation, per-replica breaker
+failover, placement planning, the load-report surface on the engine and
+both frontends, and the rolling-drain coordinator (with fake triggers —
+no subprocesses here; the process-level walk lives in test_router_e2e).
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from client_tpu.protocol.loadreport import LOAD_HEADER, LoadReport
+from client_tpu.resilience import CircuitBreaker
+from client_tpu.router import (
+    Replica,
+    Router,
+    RouterHttpServer,
+    placement_moves,
+    plan_placement,
+    rendezvous_pick,
+    replicas_from_hostlist,
+    rolling_drain,
+)
+from client_tpu.router.core import normalize_replica_url
+from client_tpu.router.placement import model_costs
+
+
+# ---------------------------------------------------------------------------
+# A scriptable fake replica server: per-path handlers set by each test.
+
+
+class _FakeReplica:
+    """Minimal HTTP server whose behaviour is a mutable function of
+    (method, path) -> (status, headers, body)."""
+
+    def __init__(self):
+        self.requests = []
+        self.behavior = self.default_behavior
+        self.conns = set()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+
+            def setup(self):
+                super().setup()
+                outer.conns.add(self.connection)
+
+            def log_message(self, *a):
+                pass
+
+            def _serve(self, method):
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                body = self.rfile.read(length) if length else b""
+                outer.requests.append((method, self.path, body))
+                status, headers, payload = outer.behavior(method, self.path)
+                self.send_response(status)
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._serve("GET")
+
+            def do_POST(self):
+                self._serve("POST")
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+        self.url = f"127.0.0.1:{self.httpd.server_address[1]}"
+
+    def default_behavior(self, method, path):
+        if path == "/v2/load":
+            return 200, [(LOAD_HEADER, "s=READY;i=0;q=0;b=0;w=0.0;f=0")], \
+                json.dumps(LoadReport().to_json_dict()).encode()
+        if path == "/v2/health/ready":
+            return 200, [("X-Health-State", "READY")], b""
+        return 200, [(LOAD_HEADER, "s=READY;i=0;q=0;b=0;w=0.0;f=0")], \
+            b'{"ok": true}'
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        # Kill live keep-alive sockets too, like a dying process would —
+        # shutdown() alone leaves handler threads serving pooled
+        # connections forever.
+        for conn in list(self.conns):
+            try:
+                conn.shutdown(2)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+@pytest.fixture
+def fakes():
+    servers = [_FakeReplica(), _FakeReplica()]
+    yield servers
+    for s in servers:
+        s.stop()
+
+
+def _router(fakes, **kw):
+    kw.setdefault("seed", 1234)
+    kw.setdefault("poll_interval_s", 3600.0)  # tests drive refresh manually
+    r = Router([Replica(f.url) for f in fakes], **kw)
+    r.refresh()
+    return r
+
+
+# ---------------------------------------------------------------------------
+# Selection
+
+
+class TestSelection:
+    def test_normalize(self):
+        assert normalize_replica_url("http://h:8000/") == "h:8000"
+        assert normalize_replica_url("h:8000") == "h:8000"
+
+    def test_hostlist(self):
+        assert replicas_from_hostlist(["a", "b"], 9) == ["a:9", "b:9"]
+
+    def test_rendezvous_stable_and_minimal_disruption(self):
+        ids = [f"replica-{i}" for i in range(5)]
+        picks = {t: rendezvous_pick(ids, t) for t in range(200)}
+        # Deterministic.
+        assert picks == {t: rendezvous_pick(ids, t) for t in range(200)}
+        # Removing one replica only remaps tokens that lived on it.
+        removed = picks[0]
+        survivors = [i for i in ids if i != removed]
+        for t, old in picks.items():
+            new = rendezvous_pick(survivors, t)
+            if old != removed:
+                assert new == old, (t, old, new)
+
+    def test_p2c_spreads_under_uniform_load(self, fakes):
+        router = _router(fakes)
+        counts = {}
+        for _ in range(300):
+            out = router.forward("POST", "/v2/models/m/infer", body=b"{}")
+            assert out.status == 200
+            counts[out.replica_id] = counts.get(out.replica_id, 0) + 1
+        assert len(counts) == 2
+        # Acceptance bound: spread no worse than 70/30.
+        assert min(counts.values()) >= 300 * 0.3, counts
+
+    def test_affinity_pins_sequence(self, fakes):
+        router = _router(fakes)
+        picked = {router.forward("POST", "/v2/models/m/infer", body=b"{}",
+                                 sequence_id=99).replica_id
+                  for _ in range(20)}
+        assert len(picked) == 1
+        # And a different sequence may land elsewhere, but is also stable.
+        other = {router.forward("POST", "/v2/models/m/infer", body=b"{}",
+                                sequence_id=7).replica_id
+                 for _ in range(20)}
+        assert len(other) == 1
+
+    def test_candidates_prefer_lower_score(self, fakes):
+        router = _router(fakes)
+        a, b = router.replicas
+        a.observe_report(LoadReport(inflight=50, queue_depth=50))
+        b.observe_report(LoadReport(inflight=0))
+        # P2C must always pick b (both sampled, b's score lower).
+        for _ in range(20):
+            assert router.candidates()[0] is b
+
+    def test_quiesced_replica_not_selected(self, fakes):
+        router = _router(fakes)
+        rid = router.replicas[0].id
+        router.quiesce(rid)
+        for _ in range(20):
+            out = router.forward("POST", "/v2/models/m/infer", body=b"{}")
+            assert out.replica_id == router.replicas[1].id
+        router.unquiesce(rid)
+        assert len({router.forward("POST", "/v2/models/m/infer",
+                                   body=b"{}").replica_id
+                    for _ in range(50)}) == 2
+
+
+# ---------------------------------------------------------------------------
+# Failover / pushback aggregation
+
+
+class TestFailover:
+    def test_transport_failure_fails_over_and_breaks(self, fakes):
+        router = _router(fakes)
+        dead, alive = fakes
+        dead_id = Replica(dead.url).id
+        dead.stop()
+        for _ in range(10):
+            out = router.forward("POST", "/v2/models/m/infer", body=b"{}")
+            assert out.status == 200
+            assert out.replica_id != dead_id
+        # Default router breaker: 3 consecutive failures open it.
+        assert router.breaker.state(dead_id) == CircuitBreaker.OPEN
+
+    def test_all_pushback_sheds_with_min_retry_after(self, fakes):
+        router = _router(fakes)
+        fakes[0].behavior = lambda m, p: (
+            429, [("Retry-After", "0.750")], b'{"error": "shed"}')
+        fakes[1].behavior = lambda m, p: (
+            503, [("Retry-After", "0.250")], b'{"error": "draining"}')
+        out = router.forward("POST", "/v2/models/m/infer", body=b"{}")
+        assert out.status == 429  # any 429 -> 429
+        assert out.header("Retry-After") == "0.250"  # the minimum
+        assert out.header("X-Router-Shed") == "all_pushback"
+        # Pushback is breaker-neutral-positive: nothing opened.
+        for r in router.replicas:
+            assert router.breaker.state(r.id) == CircuitBreaker.CLOSED
+
+    def test_one_pushback_fails_over_not_sheds(self, fakes):
+        router = _router(fakes)
+        fakes[0].behavior = lambda m, p: (
+            429, [("Retry-After", "1.000")], b'{"error": "shed"}')
+        for _ in range(10):
+            out = router.forward("POST", "/v2/models/m/infer", body=b"{}")
+            assert out.status == 200
+            assert out.replica_id == Replica(fakes[1].url).id
+
+    def test_draining_503_marks_replica(self, fakes):
+        router = _router(fakes)
+        fakes[0].behavior = lambda m, p: (
+            503, [("Retry-After", "1.000"),
+                  ("X-Health-State", "DRAINING")], b'{"error": "draining"}')
+        draining = router.replica(Replica(fakes[0].url).id)
+        # Keep forwarding until P2C lands on the draining replica once.
+        for _ in range(30):
+            out = router.forward("POST", "/v2/models/m/infer", body=b"{}")
+            assert out.status == 200
+            if draining.draining:
+                break
+        assert draining.draining
+        # Subsequent selection skips it entirely.
+        assert draining not in router.eligible()
+
+    def test_all_down_is_502(self, fakes):
+        router = _router(fakes)
+        for f in fakes:
+            f.stop()
+        out = router.forward("POST", "/v2/models/m/infer", body=b"{}")
+        assert out.status == 502
+        assert out.header("X-Router-Shed") == "no_replica"
+
+    def test_5xx_passthrough_when_everyone_errors(self, fakes):
+        router = _router(fakes)
+        for f in fakes:
+            f.behavior = lambda m, p: (500, [], b'{"error": "boom"}')
+        out = router.forward("POST", "/v2/models/m/infer", body=b"{}")
+        assert out.status == 500
+        assert json.loads(out.body)["error"] == "boom"
+
+
+# ---------------------------------------------------------------------------
+# Placement
+
+
+class TestPlacement:
+    def test_model_costs_sums_across_replicas(self):
+        profiles = {
+            "r1": {"models": {"a:1": {"model": "a", "device_s": 3.0},
+                              "b:1": {"model": "b", "device_s": 1.0}}},
+            "r2": {"models": {"a:1": {"model": "a", "device_s": 2.0}}},
+        }
+        costs = model_costs(profiles)
+        assert costs["a"] == pytest.approx(5.0)
+        assert costs["b"] == pytest.approx(1.0)
+
+    def test_lpt_separates_hot_models(self):
+        plan = plan_placement({"hot1": 10.0, "hot2": 9.0, "cold": 0.1},
+                              ["r1", "r2"])
+        homes = {m: rid for rid, models in plan.items() for m in models}
+        assert homes["hot1"] != homes["hot2"]
+
+    def test_plan_is_deterministic_and_total(self):
+        costs = {f"m{i}": float(i + 1) for i in range(7)}
+        p1 = plan_placement(costs, ["r1", "r2", "r3"])
+        p2 = plan_placement(costs, ["r1", "r2", "r3"])
+        assert p1 == p2
+        assert sorted(m for ms in p1.values() for m in ms) == sorted(costs)
+
+    def test_stable_fleet_replans_to_itself(self):
+        costs = {"a": 5.0, "b": 5.0}
+        current = {"r1": {"b"}, "r2": {"a"}}
+        plan = plan_placement(costs, ["r1", "r2"], current=current)
+        assert plan == {"r1": ["b"], "r2": ["a"]}
+        assert placement_moves(plan, current) == []
+
+    def test_replication_floor(self):
+        plan = plan_placement({"a": 1.0}, ["r1", "r2"],
+                              min_replicas_per_model=2)
+        assert plan == {"r1": ["a"], "r2": ["a"]}
+
+    def test_moves_load_before_unload(self):
+        plan = {"r1": ["a"], "r2": ["b"]}
+        current = {"r1": {"b"}, "r2": {"a"}}
+        moves = placement_moves(plan, current)
+        actions = [m["action"] for m in moves]
+        assert actions == ["load", "load", "unload", "unload"]
+
+    def test_empty_replicas_raises(self):
+        with pytest.raises(ValueError):
+            plan_placement({"a": 1.0}, [])
+
+
+# ---------------------------------------------------------------------------
+# Rolling drain (fake triggers)
+
+
+class TestRollingDrain:
+    def test_walk_is_sequential_and_clean(self, fakes):
+        router = _router(fakes)
+        state = {f.url: "READY" for f in fakes}
+
+        def make_behavior(url):
+            def behavior(method, path):
+                if path == "/v2/health/ready":
+                    if state[url] == "DRAINING":
+                        return 503, [("X-Health-State", "DRAINING")], b""
+                    if state[url] == "GONE":
+                        raise ConnectionResetError  # simulate death
+                    return 200, [("X-Health-State", "READY")], b""
+                return 200, [], b"{}"
+            return behavior
+
+        order = []
+
+        def make_trigger(url, rid):
+            def trigger():
+                order.append(rid)
+                state[url] = "DRAINING"
+                # After a short observation window the process "exits".
+                def die():
+                    state[url] = "GONE"
+                threading.Timer(0.15, die).start()
+            return trigger
+
+        for f in fakes:
+            f.behavior = make_behavior(f.url)
+        triggers = {Replica(f.url).id: make_trigger(f.url, Replica(f.url).id)
+                    for f in fakes[:1]}
+        reports = rolling_drain(router, [Replica(fakes[0].url).id],
+                                triggers=triggers, deadline_s=5.0)
+        assert [r["outcome"] for r in reports] == ["clean"]
+        assert reports[0]["saw_draining"] is True
+
+    def test_gate_refuses_last_replica(self, fakes):
+        router = _router(fakes)
+        # Other replica is not ready -> gate must refuse and stop the walk.
+        fakes[1].behavior = lambda m, p: (
+            503, [("X-Health-State", "DRAINING")], b"")
+        fired = []
+        reports = rolling_drain(
+            router, [Replica(fakes[0].url).id],
+            triggers={Replica(fakes[0].url).id: lambda: fired.append(1)},
+            deadline_s=2.0, gate_timeout_s=0.3)
+        assert reports[0]["outcome"] == "skipped"
+        assert not fired  # never triggered a drain without a standby
+
+    def test_trigger_failure_unquiesces(self, fakes):
+        router = _router(fakes)
+        rid = Replica(fakes[0].url).id
+
+        def boom():
+            raise RuntimeError("no such pid")
+
+        reports = rolling_drain(router, [rid], triggers={rid: boom},
+                                deadline_s=2.0)
+        assert reports[0]["outcome"] == "skipped"
+        assert not router.replica(rid).quiesced  # restored to service
+
+    def test_no_pid_no_trigger_skips(self, fakes):
+        router = _router(fakes)
+        rid = Replica(fakes[0].url).id
+        reports = rolling_drain(router, [rid], deadline_s=2.0)
+        assert reports[0]["outcome"] == "skipped"
+        assert "pid" in reports[0]["error"]
+
+
+# ---------------------------------------------------------------------------
+# Standalone frontend basics (fake replicas; real-engine paths live in
+# test_router_e2e)
+
+
+class TestRouterFrontend:
+    def test_health_and_metrics_endpoints(self, fakes):
+        router = _router(fakes)
+        srv = RouterHttpServer(router, port=0)
+        srv._thread = threading.Thread(
+            target=srv.httpd.serve_forever, daemon=True)
+        srv._thread.start()
+        base = f"http://{srv.url}"
+        try:
+            r = urllib.request.urlopen(base + "/v2/health/live", timeout=5)
+            assert r.status == 200
+            r = urllib.request.urlopen(base + "/v2/health/ready", timeout=5)
+            assert r.status == 200
+            assert r.headers.get("X-Health-State") == "READY"
+            # drive some traffic through the proxy
+            req = urllib.request.Request(
+                base + "/v2/models/m/infer", data=b"{}")
+            r = urllib.request.urlopen(req, timeout=5)
+            assert r.status == 200
+            assert r.headers.get("X-Tpu-Replica")
+            text = urllib.request.urlopen(
+                base + "/metrics", timeout=5).read().decode()
+            assert "tpu_router_requests_total" in text
+            om_req = urllib.request.Request(
+                base + "/metrics",
+                headers={"Accept": "application/openmetrics-text"})
+            om = urllib.request.urlopen(om_req, timeout=5).read().decode()
+            assert om.rstrip().endswith("# EOF")
+            status = json.loads(urllib.request.urlopen(
+                base + "/v2/load", timeout=5).read())
+            assert set(status["replicas"]) == {r_.id
+                                              for r_ in router.replicas}
+        finally:
+            srv.httpd.shutdown()
+            srv.httpd.server_close()
+            router.stop()
+
+    def test_ready_503_when_fleet_draining(self, fakes):
+        router = _router(fakes)
+        for r in router.replicas:
+            router.quiesce(r.id)
+        srv = RouterHttpServer(router, port=0)
+        srv._thread = threading.Thread(
+            target=srv.httpd.serve_forever, daemon=True)
+        srv._thread.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://{srv.url}/v2/health/ready", timeout=5)
+            assert err.value.code == 503
+            assert err.value.headers.get("X-Health-State") == "DRAINING"
+        finally:
+            srv.httpd.shutdown()
+            srv.httpd.server_close()
+            router.stop()

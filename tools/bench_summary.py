@@ -143,6 +143,8 @@ def main() -> int:
                 print(f"    efficiency: {json.dumps(eff)}")
             if probe == "autotune":
                 _print_autotune_delta(rec)
+            if probe == "router":
+                _print_router_delta(rec)
     return 0
 
 
@@ -165,6 +167,25 @@ def _print_autotune_delta(rec: dict) -> None:
     if rec.get("promotions") is not None:
         print(f"    promotions applied: {rec['promotions']} "
               f"(ladder {off.get('ladder')} -> {on.get('ladder')})")
+
+
+def _print_router_delta(rec: dict) -> None:
+    """The router probe's scale-out story: aggregate ips/p99 at replica
+    count 1 vs 2 (both through the router) and the 2v1 ratio the
+    acceptance bar (>=1.6x, p99 no worse) reads off."""
+    x1, x2 = rec.get("x1") or {}, rec.get("x2") or {}
+    if not x1 or not x2:
+        return
+    scale = rec.get("scale_2v1")
+    cpus = rec.get("host_cpus")
+    print(f"    router scale-out: {x1.get('ips')} ips / "
+          f"p99 {x1.get('p99_us')}us (x1) -> {x2.get('ips')} ips / "
+          f"p99 {x2.get('p99_us')}us (x2)"
+          + (f" = {scale}x" if scale is not None else "")
+          + (f" [host_cpus={cpus}: contention-bound, not scale-out]"
+             if cpus is not None and cpus < 4 else ""))
+    if x2.get("spread"):
+        print(f"    replica spread (ok): {json.dumps(x2['spread'])}")
 
 
 if __name__ == "__main__":

@@ -1,11 +1,11 @@
 #!/usr/bin/env bash
 # CI gate: tier-1 tests + chaos suite + live endpoint lint + autotune
-# e2e + bench gate.
+# e2e + router e2e + bench gate.
 #
 #   tools/ci_check.sh            # everything (tier-1 already includes chaos)
 #   tools/ci_check.sh --fast     # all stages except tier-1
 #
-# Five stages:
+# Six stages:
 #   1. tier-1: the full fast suite (ROADMAP.md contract; excludes `slow`).
 #   2. chaos: the deterministic fault-injection suite alone (`-m chaos`) —
 #      redundant with tier-1 when stage 1 runs, but the -m filter proves
@@ -22,7 +22,12 @@
 #      and assert the tuner promotes a bucket (journaled, applied state in
 #      /v2/profile) and tpu_autotune_* counters render promlint-clean in
 #      both exposition dialects.
-#   5. bench gate: tools/bench_summary.py --check fails the build when the
+#   5. router e2e: two in-process replicas behind the standalone L7
+#      router — drive traffic through the proxy (both replicas must
+#      receive some), smoke /v2/load, roll-drain one replica with live
+#      in-process drain (survivor keeps serving), and lint tpu_router_*
+#      in both exposition dialects.
+#   6. bench gate: tools/bench_summary.py --check fails the build when the
 #      newest BENCH_HISTORY.json run regressed any probe's p99 by >25%.
 set -u -o pipefail
 
@@ -33,7 +38,7 @@ FAST=0
 rc=0
 
 if [ "$FAST" -eq 0 ]; then
-    echo "=== stage 1/5: tier-1 test suite ==="
+    echo "=== stage 1/6: tier-1 test suite ==="
     rm -f /tmp/_t1.log
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -43,15 +48,15 @@ if [ "$FAST" -eq 0 ]; then
         | tr -cd . | wc -c)"
     [ "$t1" -ne 0 ] && { echo "tier-1 FAILED (exit $t1)"; rc=1; }
 else
-    echo "=== stage 1/5: tier-1 skipped (--fast) ==="
+    echo "=== stage 1/6: tier-1 skipped (--fast) ==="
 fi
 
-echo "=== stage 2/5: chaos (fault-injection) suite ==="
+echo "=== stage 2/6: chaos (fault-injection) suite ==="
 timeout -k 10 300 python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 [ $? -ne 0 ] && { echo "chaos suite FAILED"; rc=1; }
 
-echo "=== stage 3/5: live scrape (promlint + ops endpoints) ==="
+echo "=== stage 3/6: live scrape (promlint + ops endpoints) ==="
 SCRAPE_DIR=$(mktemp -d)
 python - "$SCRAPE_DIR" <<'EOF'
 import json
@@ -115,7 +120,7 @@ python tools/promlint.py --openmetrics "$SCRAPE_DIR/metrics.om.txt" \
     || { echo "promlint (openmetrics) FAILED"; rc=1; }
 rm -rf "$SCRAPE_DIR"
 
-echo "=== stage 4/5: autotune e2e (promotion + metrics) ==="
+echo "=== stage 4/6: autotune e2e (promotion + metrics) ==="
 TUNE_DIR=$(mktemp -d)
 CLIENT_TPU_AUTOTUNE='{"interval_s": 0.2, "cooldown_s": 0.5}' \
 timeout -k 10 300 python - "$TUNE_DIR" <<'EOF'
@@ -191,7 +196,114 @@ python tools/promlint.py --openmetrics "$TUNE_DIR/metrics.om.txt" \
     || { echo "promlint (autotune openmetrics) FAILED"; rc=1; }
 rm -rf "$TUNE_DIR"
 
-echo "=== stage 5/5: bench p99 regression gate ==="
+echo "=== stage 5/6: router e2e (balance + roll-drain + metrics) ==="
+ROUTER_DIR=$(mktemp -d)
+timeout -k 10 300 python - "$ROUTER_DIR" <<'EOF'
+import json
+import sys
+import threading
+from urllib.request import Request, urlopen
+
+import numpy as np
+
+import client_tpu.http as httpclient
+from client_tpu.admission.drain import drain as engine_drain
+from client_tpu.engine import TpuEngine
+from client_tpu.models import build_repository
+from client_tpu.router import Replica, Router, RouterHttpServer, rolling_drain
+from client_tpu.server import HttpInferenceServer
+
+out_dir = sys.argv[1]
+engines = [TpuEngine(build_repository(["simple"]), warmup=False)
+           for _ in range(2)]
+replicas = [HttpInferenceServer(e, host="127.0.0.1", port=0).start()
+            for e in engines]
+router = Router([Replica(f"http://{r.url}") for r in replicas], seed=7)
+srv = RouterHttpServer(router, port=0).start()
+try:
+    base = f"http://{srv.url}"
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    i0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+    i0.set_data_from_numpy(a)
+    i1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+    i1.set_data_from_numpy(b)
+    client = httpclient.InferenceServerClient(base)
+    for _ in range(40):
+        result = client.infer("simple", [i0, i1])
+        if not (result.as_numpy("OUTPUT0") == a + b).all():
+            sys.exit("router proxy returned wrong OUTPUT0")
+
+    # /v2/load smoke: every replica reporting, all READY.
+    load = json.load(urlopen(f"{base}/v2/load", timeout=10))
+    if set(load["replicas"]) != {r.id for r in router.replicas}:
+        sys.exit(f"/v2/load replica set mismatch: {str(load)[:300]}")
+    if any(rep["load"].get("state") != "READY"
+           for rep in load["replicas"].values()):
+        sys.exit(f"/v2/load has non-READY replica: {str(load)[:300]}")
+
+    # Uniform load must reach both replicas (P2C, no affinity key).
+    ok_children = router.metrics.requests._children
+    counts = {rid: (ch.v if (ch := ok_children.get((rid, "ok"))) else 0.0)
+              for rid in load["replicas"]}
+    if any(v <= 0 for v in counts.values()):
+        sys.exit(f"one replica got no traffic: {counts}")
+
+    # Roll-drain replica 0 via the real in-process drain sequence (the
+    # same code SIGTERM runs), then prove the survivor keeps serving.
+    victim_id = router.replicas[0].id
+
+    def trigger():
+        threading.Thread(
+            target=engine_drain, args=(engines[0],),
+            kwargs={"http_servers": [replicas[0]], "deadline_s": 10.0},
+            daemon=True).start()
+
+    reports = rolling_drain(router, [victim_id],
+                            triggers={victim_id: trigger}, deadline_s=30.0)
+    if reports[0]["outcome"] not in ("clean", "gone"):
+        sys.exit(f"rolling drain not clean: {reports}")
+    for _ in range(10):
+        result = client.infer("simple", [i0, i1])
+        if not (result.as_numpy("OUTPUT0") == a + b).all():
+            sys.exit("survivor returned wrong OUTPUT0 after drain")
+    status = json.load(urlopen(f"{base}/v2/router/status", timeout=10))
+    if victim_id in status["eligible"]:
+        sys.exit("drained replica still eligible")
+    client.close()
+
+    classic = urlopen(f"{base}/metrics", timeout=10).read().decode()
+    om = urlopen(Request(f"{base}/metrics", headers={
+        "Accept": "application/openmetrics-text"}), timeout=10).read().decode()
+    if "tpu_router_requests_total" not in classic:
+        sys.exit("tpu_router_requests_total missing from router /metrics")
+    with open(f"{out_dir}/metrics.txt", "w") as f:
+        f.write(classic)
+    with open(f"{out_dir}/metrics.om.txt", "w") as f:
+        f.write(om)
+    print(f"router e2e ok: spread {counts}, drain "
+          f"{reports[0]['outcome']}, survivor serving")
+finally:
+    srv.stop()
+    for r in replicas:
+        try:
+            r.stop()
+        except Exception:  # noqa: BLE001 — drained frontend already closed
+            pass
+    for e in engines:
+        try:
+            e.shutdown()
+        except Exception:  # noqa: BLE001 — drained engine already down
+            pass
+EOF
+[ $? -ne 0 ] && { echo "router e2e FAILED"; rc=1; }
+python tools/promlint.py "$ROUTER_DIR/metrics.txt" \
+    || { echo "promlint (router classic) FAILED"; rc=1; }
+python tools/promlint.py --openmetrics "$ROUTER_DIR/metrics.om.txt" \
+    || { echo "promlint (router openmetrics) FAILED"; rc=1; }
+rm -rf "$ROUTER_DIR"
+
+echo "=== stage 6/6: bench p99 regression gate ==="
 if [ -f BENCH_HISTORY.json ]; then
     python tools/bench_summary.py --check \
         || { echo "bench gate FAILED"; rc=1; }
