@@ -1143,7 +1143,13 @@ def _bench_generative_once(n_streams: int, tokens: int):
 
     from client_tpu.engine import InferRequest, TpuEngine
     from client_tpu.models import build_repository
+    from client_tpu.observability.profiler import profiler, reset_profiler
 
+    # Fresh profiler epoch per dispatch mode BEFORE the engine builds (the
+    # engine caches the instance at construction): the wave stats below
+    # must describe THIS mode's decode waves, not the previous chunk
+    # setting's.
+    reset_profiler()
     # warmup=True: the generative scheduler precompiles every (prompt
     # bucket, wave bucket) executable up front — round 3 measured ~1-1.5s
     # XLA compiles landing mid-burst as the TTFT p99.
@@ -1204,8 +1210,11 @@ def _bench_generative_once(n_streams: int, tokens: int):
                                int(len(sorted_vals) * q))]
 
     burst(n_streams, 8)  # warmup: compiles prefill + wave buckets
+    reset_profiler()  # measurement epoch: drop warmup-burst waves
+    # (record_wave resolves the global dynamically, so post-reset waves
+    # land in the fresh instance even though the engine cached the old
+    # one at construction — snapshot below reads the fresh global too.)
     rate, ttft, itl = burst(n_streams, tokens)
-    engine.shutdown()
     out = {
         "tok_s": round(rate, 1),
         "ttft_ms_p50": round(pct(ttft, 0.50), 1) if ttft else None,
@@ -1213,6 +1222,25 @@ def _bench_generative_once(n_streams: int, tokens: int):
         "itl_ms_p50": round(pct(itl, 0.50), 2) if itl else None,
         "itl_ms_p99": round(pct(itl, 0.99), 2) if itl else None,
     }
+    # Device-side decode-wave stats from the always-on profiler
+    # (record_wave in engine/generative.py): duty cycle answers "was the
+    # chip busy", wave_step_ms answers "what did one decode step cost" —
+    # the pair that turns a tok/s delta into a diagnosis.  The p50 is
+    # taken from the busiest (bucket, chunk) cell so a handful of ragged
+    # tail waves can't speak for the steady state.
+    try:
+        psnap = profiler().snapshot(model="tiny_gpt")
+        pm = next(iter(psnap["models"].values()), None)
+        waves = (pm or {}).get("decode_waves") or []
+        if waves:
+            top = max(waves, key=lambda w: w["waves"])
+            out["wave_step_ms_p50"] = top["wave_ms_p50"]
+            out["wave_step_ms_p99"] = top["wave_ms_p99"]
+            out["wave_bucket"] = top["bucket"]
+        out["duty_cycle"] = psnap["duty_cycle"]
+    except Exception as exc:  # noqa: BLE001 — profiler must not sink bench
+        log(f"generative wave stats unavailable: {exc}")
+    engine.shutdown()
     log(f"generative: {n_streams} concurrent streams x {tokens} tokens = "
         f"{rate:.0f} tok/s, TTFT p50/p99 {out['ttft_ms_p50']}/"
         f"{out['ttft_ms_p99']}ms, ITL p50/p99 {out['itl_ms_p50']}/"
@@ -1980,7 +2008,17 @@ def _main():
     def _rec_gen(g):
         _RESULT["gen"] = g
         _RESULT["gen_tok_s"] = g["tok_s"]
-        _append_history({"probe": "gen", "gen": g})
+        # Top-level p99 (inter-token latency, us) so bench_summary --check
+        # gates the generative path's tail like every other probe.
+        itl_p99 = g.get("itl_ms_p99")
+        _append_history({"probe": "gen", "gen": g,
+                         "p99_us": (round(itl_p99 * 1000, 1)
+                                    if itl_p99 else None),
+                         # hoisted so the summary's efficiency line (and
+                         # eye-balling the raw JSON) sees them per run
+                         **{k: g[k] for k in ("duty_cycle",
+                                              "wave_step_ms_p50")
+                            if k in g}})
 
     def _rec_device_steady(r):
         _RESULT["device_steady"] = r

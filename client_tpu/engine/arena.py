@@ -113,6 +113,19 @@ class ArenaAllocator:
             return cursor
         return None
 
+    def reserve_sharded(self, name: str, nbytes: int,
+                        shards: int = 1) -> Reservation:
+        """Reserve the PER-DEVICE share of a globally sharded buffer.
+
+        The planning arena models one device's HBM (its budget comes from
+        device 0's ``bytes_limit``), while a ``NamedSharding``-sharded
+        buffer — e.g. the cross-chip KV arena (parallel/kv_shard.py) —
+        reports its *global* pytree bytes.  Charging the global size
+        against one device's budget would spuriously exhaust the planner;
+        an N-way shard commits ``ceil(nbytes / N)`` per device."""
+        shards = max(1, int(shards))
+        return self.reserve(name, (int(nbytes) + shards - 1) // shards)
+
     def release(self, name: str) -> bool:
         """Free one reservation; returns False when the name is unknown
         (idempotent — unload paths call this unconditionally)."""

@@ -280,8 +280,13 @@ class Autotuner:
                                        name, version)
         arena_nbytes = getattr(sched, "arena_nbytes", None)
         if callable(arena_nbytes):
+            # Sharded KV arenas report global bytes; charge the planner
+            # (which models ONE device's HBM) the per-shard share.
+            shards_of = getattr(sched, "arena_shards", None)
+            shards = int(shards_of()) if callable(shards_of) else 1
             self._reserve_advisory(f"kv:{name}:{version}",
-                                   int(arena_nbytes()), name, version)
+                                   int(arena_nbytes()), name, version,
+                                   shards=shards)
         if self._metrics is not None and model.config.max_batch_size > 0:
             self._metrics["ladder"].set(
                 float(len(model.config.effective_buckets())),
@@ -289,9 +294,9 @@ class Autotuner:
         self._refresh_gauges()
 
     def _reserve_advisory(self, rname: str, nbytes: int,
-                          model: str, version) -> None:
+                          model: str, version, shards: int = 1) -> None:
         try:
-            self.arena.reserve(rname, nbytes)
+            self.arena.reserve_sharded(rname, nbytes, shards)
         except ArenaExhausted as exc:
             self._journal("budget_overcommit", model=model, version=version,
                           severity="WARNING", reservation=rname,

@@ -93,13 +93,15 @@ class _Stream:
 class _Inflight:
     """One dispatched execution whose token fetch is pending."""
 
-    __slots__ = ("kind", "streams", "tokens", "waves")
+    __slots__ = ("kind", "streams", "tokens", "waves", "t_disp", "bucket")
 
-    def __init__(self, kind, streams, tokens, waves=1):
+    def __init__(self, kind, streams, tokens, waves=1, t_disp=0, bucket=0):
         self.kind = kind          # 'prefill' | 'wave' | 'chunk'
         self.streams = streams    # lane order, real lanes only
         self.tokens = tokens      # jax.Array future (copy_to_host_async'd)
         self.waves = waves        # logical waves this dispatch advances
+        self.t_disp = t_disp      # monotonic ns at dispatch (wave timing)
+        self.bucket = bucket      # wave bucket (0 for prefill)
 
 
 class _WarmupReq:
@@ -187,6 +189,19 @@ class GenerativeScheduler(Scheduler):
         backend = model.backend
         self._cap = int(backend.max_streams)
         self._max_seq = int(backend.max_seq_len)
+        # Row layout comes from the backend when it can say (sharded KV
+        # arenas carry one junk row per shard, so free rows are not
+        # 0..cap-1 and the dummy row is not `cap` — see
+        # parallel/kv_shard.py); the legacy +1-dummy layout is the
+        # fallback for backends without the hook.
+        rows_of = getattr(backend, "arena_rows", None)
+        if callable(rows_of):
+            free_rows, dummy = rows_of(self._cap)
+            self._rows_init = [int(r) for r in free_rows]
+            self._dummy = int(dummy)
+        else:
+            self._rows_init = list(range(self._cap))
+            self._dummy = self._cap
         self._arena = backend.init_arena(self._cap)
         # `sample` is static: all-greedy calls get an executable with no
         # sampling pipeline in it (prefill arg 9, decode arg 8).
@@ -228,8 +243,18 @@ class GenerativeScheduler(Scheduler):
         # so CLIENT_TPU_GEN_PIPELINE bounds the same amount of dispatched-
         # ahead device work (and cancellation junk) in either mode.
         self._inflight_waves = 0
-        self._free = list(range(self._cap))
+        self._free = list(self._rows_init)
+        # Fetch-side low-water mark for wave timing: the device is busy
+        # from max(dispatch, previous fetch) to this fetch, so pipelined
+        # waves are not double-counted (see _drain_fetches).
+        self._last_fetch_ns = 0
         super().__init__(model, stats)
+
+    def arena_shards(self) -> int:
+        """KV arena shard count (1 = single-chip): the autotuner divides
+        the arena reservation by this so the planning arena charges the
+        PER-DEVICE share, not the global pytree bytes."""
+        return int(getattr(self.model.backend, "kv_shards", 1) or 1)
 
     def arena_nbytes(self) -> int:
         """Total bytes of the KV arena pytree — the engine's HBM planner
@@ -266,7 +291,7 @@ class GenerativeScheduler(Scheduler):
 
     def _precompile(self) -> None:
         lane = self._admit_lane
-        dummy = np.full(lane, self._cap, np.int32)  # all lanes padded
+        dummy = np.full(lane, self._dummy, np.int32)  # all lanes padded
         z_i = np.zeros(lane, np.int32)
         z_f = np.zeros(lane, np.float32)
         ones_f = np.ones(lane, np.float32)
@@ -278,7 +303,7 @@ class GenerativeScheduler(Scheduler):
                 z_i, z_f, z_i, ones_f, False)
         for wb in self._wave_buckets:
             self.model._set_state(f"warmup: decode wave bucket={wb}")
-            rows = np.full(wb, self._cap, np.int32)
+            rows = np.full(wb, self._dummy, np.int32)
             self._arena, tokens = self._decode(
                 self.model._params, self._arena, rows,
                 np.zeros(wb, np.int32), np.zeros(wb, np.int32),
@@ -510,7 +535,7 @@ class GenerativeScheduler(Scheduler):
                 top_ps[i] = top_p
             seeds = seeds.astype(np.int32)
             rows_arr = np.asarray(
-                rows + [self._cap] * pad, np.int32)  # dummy row pads
+                rows + [self._dummy] * pad, np.int32)  # dummy row pads
             self.model._set_state(
                 f"generative prefill ({n} streams, prompt "
                 f"bucket={prompt_bucket})")
@@ -537,15 +562,28 @@ class GenerativeScheduler(Scheduler):
         # counting would drop waves whose lanes all retired before the
         # fetch, and everything discarded by an arena reset.
         self.stats.record_execution(n)
-        self._inflight.append(_Inflight("prefill", streams, tokens))
+        self._inflight.append(_Inflight("prefill", streams, tokens,
+                                        t_disp=time.monotonic_ns()))
         self._inflight_waves += 1
 
     def _dispatch_wave(self, live: list) -> None:
+        """Dispatch decode wave(s) for the live lanes.  Live lanes can
+        exceed the largest wave bucket (a ladder edit, a tuner-retired
+        bucket, or a subclass shrinking the ladder): clamp to the max
+        bucket and split into several dispatches instead of letting the
+        bucket pick in :meth:`_dispatch_one_wave` raise StopIteration and
+        reset the arena under full load."""
+        max_bucket = self._wave_buckets[-1] if self._wave_buckets \
+            else len(live)
+        for i in range(0, len(live), max_bucket):
+            self._dispatch_one_wave(live[i:i + max_bucket])
+
+    def _dispatch_one_wave(self, live: list) -> None:
         """Dispatch one decode wave; input tokens come from the arena's
         device-side slots, so no host value is needed."""
         bucket = next(b for b in self._wave_buckets if b >= len(live))
         pad = bucket - len(live)
-        rows = np.asarray([s.row for s in live] + [self._cap] * pad,
+        rows = np.asarray([s.row for s in live] + [self._dummy] * pad,
                           np.int32)
         lens = np.asarray([s.disp_len for s in live] + [0] * pad, np.int32)
         seeds = np.asarray([s.seed & 0xFFFFFFFF for s in live] + [0] * pad,
@@ -588,7 +626,9 @@ class GenerativeScheduler(Scheduler):
         # executions per token IS the chunking win the stat should show.
         self.stats.record_execution(len(live))
         self._inflight.append(_Inflight("chunk" if k > 1 else "wave",
-                                        live, nxt, waves=k))
+                                        live, nxt, waves=k,
+                                        t_disp=time.monotonic_ns(),
+                                        bucket=bucket))
         self._inflight_waves += k
 
     def _drain_fetches(self, force_one: bool = False) -> None:
@@ -608,6 +648,23 @@ class GenerativeScheduler(Scheduler):
             except Exception as exc:  # noqa: BLE001 — execution failed
                 self._reset_arena(exc)
                 return
+            # Wave timing: the device ran this dispatch from
+            # max(its dispatch, the previous fetch) until now — pipelined
+            # waves complete back to back, so the deltas between
+            # consecutive fetches ARE the per-dispatch device occupancy
+            # (the first fetch after an idle gap also carries host
+            # staging; steady-state waves dominate the histogram).
+            t_done = time.monotonic_ns()
+            if head.kind != "prefill" and head.bucket:
+                from client_tpu.observability.profiler import profiler
+
+                busy_ns = max(
+                    0, t_done - max(head.t_disp, self._last_fetch_ns))
+                profiler().record_wave(
+                    self.model.config.name, self.model.config.version,
+                    bucket=head.bucket, chunk=head.waves,
+                    duration_ns=busy_ns, waves=head.waves)
+            self._last_fetch_ns = t_done
             # A chunked fetch is K stacked waves [K, B]; emit them in wave
             # order so stop/budget retirement lands mid-chunk exactly
             # where a per-wave dispatch would have retired (surplus lanes
@@ -690,7 +747,7 @@ class GenerativeScheduler(Scheduler):
         self._streams.clear()
         self._inflight.clear()
         self._inflight_waves = 0
-        self._free = list(range(self._cap))
+        self._free = list(self._rows_init)
         self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)  # other sentinels may wait
 
     def _reset_arena(self, exc: Exception, failing=None) -> None:
@@ -710,5 +767,5 @@ class GenerativeScheduler(Scheduler):
         self._streams.clear()
         self._inflight.clear()
         self._inflight_waves = 0
-        self._free = list(range(self._cap))
+        self._free = list(self._rows_init)
         self._arena = self.model.backend.init_arena(self._cap)

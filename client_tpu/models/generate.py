@@ -23,6 +23,7 @@ bit-identical token streams to solo decode.
 from __future__ import annotations
 
 import math
+import os
 
 import numpy as np
 
@@ -85,7 +86,8 @@ class TinyGptBackend(ModelBackend):
                  d_model: int = 256, n_heads: int = 4, d_ff: int = 1024,
                  vocab: int = 512, max_seq_len: int = 128,
                  max_streams: int = 64, seed: int = 0,
-                 attention_impl: str = "einsum"):
+                 attention_impl: str = "einsum",
+                 attn_impl: str | None = None, kv_shards: int = 1):
         # "einsum": XLA-scheduled O(S^2) prefill scores — right for short
         # prompts.  "flash": the Pallas kernel (causal) for prefill and
         # the full-context forward — the long-context generation path
@@ -102,6 +104,40 @@ class TinyGptBackend(ModelBackend):
         # s=2048 on v5e (bert.py's sweep); tests shrink them to drive the
         # multi-block grid at short sequence.
         self.flash_blocks = (512, 1024)
+        # Decode-wave implementation: "reference" is the stacked-XLA path
+        # above; "fused" runs the one-pass Pallas kernel
+        # (ops/decode_kernel.py) — same math, same `_sample_token`
+        # sequence, so streams are token-identical either way. The env
+        # flips the fleet without touching model registration.
+        if attn_impl is None:
+            attn_impl = os.environ.get("CLIENT_TPU_ATTN_IMPL", "reference")
+        if attn_impl not in ("reference", "fused"):
+            raise ValueError(
+                f"attn_impl must be 'reference' or 'fused', got "
+                f"{attn_impl!r}")
+        self.attn_impl = attn_impl
+        # KV arena shards over a "kv" mesh axis (parallel/kv_shard.py);
+        # 1 = single-chip arena (the +1-dummy-row layout). >1 requires the
+        # fused decode path — the row-sharded layout and the shard_map'd
+        # kernel go together.
+        self.kv_shards = int(kv_shards)
+        if self.kv_shards < 1:
+            raise ValueError(f"kv_shards must be >= 1, got {kv_shards}")
+        if self.kv_shards > 1:
+            if self.attn_impl != "fused":
+                raise ValueError(
+                    "kv_shards > 1 requires attn_impl='fused' (the "
+                    "sharded arena is served by the shard_map'd kernel)")
+            if max_streams % self.kv_shards:
+                raise ValueError(
+                    f"max_streams ({max_streams}) must be divisible by "
+                    f"kv_shards ({self.kv_shards})")
+        # Fused-kernel knobs: key-block tile (None = auto divisor of
+        # max_seq_len) and the cross-shard combine ("ring" remote-DMA
+        # kernel | "psum" XLA collective).
+        self.decode_block_s: int | None = None
+        self.kv_combine = "ring"
+        self._kv_mesh = None
         self.n_layers, self.d_model = n_layers, d_model
         self.n_heads, self.d_ff = n_heads, d_ff
         self.head_dim = d_model // n_heads
@@ -237,19 +273,49 @@ class TinyGptBackend(ModelBackend):
 
     # -- generative interface (used by GenerativeScheduler) -------------------
 
+    def arena_rows(self, capacity: int | None = None):
+        """(free_rows, dummy_row) of the arena this backend builds: which
+        rows the scheduler may hand to streams, and the junk row padded
+        lanes point at.  Single-chip: rows 0..cap-1 plus the trailing
+        dummy; sharded: one junk row per shard (parallel/kv_shard.py), so
+        the free list is non-contiguous and the scheduler must not assume
+        ``row == lane`` arithmetic."""
+        cap = self.max_streams if capacity is None else int(capacity)
+        from client_tpu.parallel.kv_shard import arena_row_layout
+
+        _total, free, dummy = arena_row_layout(cap, self.kv_shards)
+        return free, dummy
+
+    def _mesh(self):
+        if self._kv_mesh is None:
+            from client_tpu.parallel.kv_shard import kv_mesh
+
+            self._kv_mesh = kv_mesh(self.kv_shards)
+        return self._kv_mesh
+
     def init_arena(self, capacity: int):
-        """KV arena pytree: k/v of shape [L, capacity+1, S, H, D] (the +1
-        dummy row absorbs padded decode lanes) plus ``tok`` [capacity+1] —
+        """KV arena pytree: k/v of shape [L, R, S, H, D] plus ``tok`` [R] —
         each row's latest token, kept ON DEVICE so decode waves chain
         without a host round trip per step (the scheduler pipelines waves
-        and fetches emitted tokens asynchronously)."""
+        and fetches emitted tokens asynchronously).  Unsharded, R is
+        ``capacity + 1`` (the +1 dummy row absorbs padded decode lanes);
+        with ``kv_shards > 1`` the rows carry a junk row per shard and the
+        k/v leaves are placed row-sharded over the "kv" mesh
+        (``NamedSharding``) — capacity beyond one chip's HBM."""
         import jax.numpy as jnp
 
-        shape = (self.n_layers, capacity + 1, self.max_seq_len,
+        from client_tpu.parallel.kv_shard import (arena_row_layout,
+                                                  shard_arena)
+
+        total, _free, _dummy = arena_row_layout(capacity, self.kv_shards)
+        shape = (self.n_layers, total, self.max_seq_len,
                  self.n_heads, self.head_dim)
-        return {"k": jnp.zeros(shape, jnp.float32),
-                "v": jnp.zeros(shape, jnp.float32),
-                "tok": jnp.zeros(capacity + 1, jnp.int32)}
+        arena = {"k": jnp.zeros(shape, jnp.float32),
+                 "v": jnp.zeros(shape, jnp.float32),
+                 "tok": jnp.zeros(total, jnp.int32)}
+        if self.kv_shards > 1:
+            arena = shard_arena(arena, self._mesh())
+        return arena
 
     def prefill_fn(self):
         """(params, arena, rows[B], ids[B, S_pad], lens[B], seeds[B],
@@ -351,7 +417,13 @@ class TinyGptBackend(ModelBackend):
         fetches emitted tokens asynchronously. Scatter each stream's new
         K/V at its current position, masked attention over the static
         max_seq_len axis, per-stream sampled (or greedy) next token.
+
+        ``attn_impl="fused"`` swaps the per-layer scatter/gather/attend
+        stack for the one-pass Pallas kernel (``_fused_decode_fn``); this
+        body stays as the reference path and the parity oracle.
         """
+        if self.attn_impl == "fused":
+            return self._fused_decode_fn()
         import jax
         import jax.numpy as jnp
 
@@ -397,6 +469,69 @@ class TinyGptBackend(ModelBackend):
 
         return decode
 
+    def _fused_decode_fn(self):
+        """The ``attn_impl="fused"`` decode step: same signature, same
+        sampling sequence, but each layer's scatter + masked attention is
+        ONE Pallas grid (ops/decode_kernel.py) — the arena row streams
+        through VMEM once instead of materializing a [B, S, H, D] gather
+        per layer.  With ``kv_shards > 1`` the per-layer call is the
+        shard_map-wrapped variant over the row-sharded arena
+        (parallel/kv_shard.py).  ``decode_chunk_fn`` scans this body
+        unchanged, so chunked decode inherits the kernel for free."""
+        import jax
+        import jax.numpy as jnp
+
+        h_, d_ = self.n_heads, self.head_dim
+        interpret = jax.default_backend() != "tpu"
+        block_s = self.decode_block_s
+
+        if self.kv_shards > 1:
+            from client_tpu.parallel.kv_shard import \
+                sharded_decode_attention
+
+            mesh, combine = self._mesh(), self.kv_combine
+
+            def attend(k_a, v_a, q, k, v, rows, lens, layer):
+                return sharded_decode_attention(
+                    mesh, k_a, v_a, q, k, v, rows, lens, layer=layer,
+                    block_s=block_s, interpret=interpret, combine=combine)
+        else:
+            from client_tpu.ops.decode_kernel import decode_wave_attention
+
+            def attend(k_a, v_a, q, k, v, rows, lens, layer):
+                return decode_wave_attention(
+                    k_a, v_a, q, k, v, rows, lens, layer=layer,
+                    block_s=block_s, interpret=interpret)
+
+        def decode(p, arena, rows, lens, seeds, temps, top_ks,
+                   top_ps, sample=True):
+            b = rows.shape[0]
+            tokens = arena["tok"][rows]                      # [B]
+            x = p["embed"][tokens] + p["pos"][lens]          # [B, d]
+            k_a, v_a = arena["k"], arena["v"]
+            for li, lp in enumerate(p["layers"]):
+                h = _ln(x, lp["ln1g"], lp["ln1b"])
+                q = (h @ lp["wq"]).reshape(b, h_, d_)
+                k = (h @ lp["wk"]).reshape(b, h_, d_)
+                v = (h @ lp["wv"]).reshape(b, h_, d_)
+                k_a, v_a, o = attend(k_a, v_a, q, k, v, rows, lens, li)
+                x = x + o.reshape(b, self.d_model) @ lp["wo"]
+                h2 = _ln(x, lp["ln2g"], lp["ln2b"])
+                x = x + self._ffn(lp, h2)
+            xf = _ln(x, p["lnfg"], p["lnfb"])
+            logits = xf @ p["head"]                          # [B, vocab]
+            # Same ctx/sample semantics as the reference body — sampling
+            # is bit-identical across impls by construction.
+            if sample:
+                nxt = jax.vmap(_sample_token)(
+                    logits, seeds, lens + 1, temps, top_ks, top_ps)
+            else:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            arena = {**arena, "k": k_a, "v": v_a,
+                     "tok": arena["tok"].at[rows].set(nxt)}
+            return arena, nxt
+
+        return decode
 
 
 register_model("tiny_gpt")(TinyGptBackend)

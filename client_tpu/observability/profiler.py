@@ -52,6 +52,9 @@ FILL_RATIO_BUCKETS = (0.25, 0.5, 0.625, 0.75, 0.875, 1.0)
 # First compiles run 20-40 s on TPU, sub-second on CPU tests.
 COMPILE_SECONDS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
                            20.0, 40.0, 80.0, 160.0)
+# Decode wave steps: ~1-3 ms on TPU, tens of ms on the CPU test backend.
+WAVE_SECONDS_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                        0.05, 0.1, 0.25, 1.0)
 
 # EWMA smoothing for per-call device/host time (~last 10 calls dominate).
 _EWMA_ALPHA = 0.2
@@ -133,12 +136,28 @@ class _BucketCost:
             / max(span_s, 1.0)
 
 
+@dataclass
+class _WaveCost:
+    """Accumulated decode-wave timing for one (model, version, bucket,
+    chunk) shape — fed by the generative scheduler at fetch time (waves
+    don't pass through ``Model.execute_timed``; they are dispatched
+    pipelined and their occupancy is only known when the token fetch
+    lands)."""
+
+    waves: int = 0
+    device_ns: int = 0
+    wave_ns_ewma: float = 0.0
+    # Per-dispatch per-wave samples for snapshot percentiles; bounded so
+    # a long-running engine can't grow it.
+    recent: deque = field(default_factory=lambda: deque(maxlen=512))
+
+
 class _Bound:
     """One engine registry's instrument handles (see bind_metrics)."""
 
     __slots__ = ("registry_ref", "fill_ratio", "padded_rows",
                  "compilations", "compile_seconds", "device_seconds",
-                 "duty_cycle")
+                 "duty_cycle", "wave_seconds")
 
     def __init__(self, registry):
         self.registry_ref = weakref.ref(registry)
@@ -167,6 +186,12 @@ class _Bound:
             "Busy device time / wall time over the profiler window "
             "(sampled at scrape; >1.0 means concurrent instances)")
         self.duty_cycle.set(0.0)
+        self.wave_seconds = registry.histogram(
+            "tpu_decode_wave_seconds",
+            "Per-wave decode step time of the generative engine "
+            "(bucket = wave lane count, chunk = waves per dispatch)",
+            ("model", "version", "bucket", "chunk"),
+            buckets=WAVE_SECONDS_BUCKETS)
 
 
 class EfficiencyProfiler:
@@ -181,6 +206,8 @@ class EfficiencyProfiler:
         self._t0 = now()
         self._lock = threading.Lock()
         self._costs: dict[tuple[str, str, int], _BucketCost] = {}
+        # (model, version, wave bucket, chunk) -> _WaveCost.
+        self._waves: dict[tuple[str, str, int, int], _WaveCost] = {}
         # (end_mono_ns, device_ns) of warm executions inside the window.
         self._busy: deque[tuple[int, int]] = deque()
         self._bound: dict[int, _Bound] = {}
@@ -279,6 +306,41 @@ class EfficiencyProfiler:
                        version=key[1], trace_id=trace_id,
                        bucket=key[2], compile_s=round(compile_ns / 1e9, 3))
 
+    def record_wave(self, model: str, version, bucket: int, chunk: int,
+                    duration_ns: int, waves: int = 1) -> None:
+        """One generative decode dispatch completed: ``waves`` logical
+        wave steps (``chunk`` > 1 when a scanned K-chunk) over a
+        ``bucket``-lane executable took ``duration_ns`` of device
+        occupancy.  Feeds ``tpu_decode_wave_seconds`` (one observation per
+        logical wave, at the per-wave time), the snapshot's decode-wave
+        table, and the duty-cycle window — generative waves never pass
+        through ``Model.execute_timed``, so without this the busiest
+        engine in the fleet read as idle."""
+        key = (str(model), str(version), int(bucket), max(1, int(chunk)))
+        waves = max(1, int(waves))
+        duration_ns = max(0, int(duration_ns))
+        per_wave_ns = duration_ns / waves
+        end = self._now()
+        with self._lock:
+            w = self._waves.get(key)
+            if w is None:
+                w = self._waves[key] = _WaveCost()
+            w.waves += waves
+            w.device_ns += duration_ns
+            w.wave_ns_ewma = (
+                per_wave_ns if w.wave_ns_ewma == 0.0
+                else _EWMA_ALPHA * per_wave_ns
+                + (1 - _EWMA_ALPHA) * w.wave_ns_ewma)
+            w.recent.append(per_wave_ns)
+            self._busy.append((end, duration_ns))
+            self._prune_locked(end)
+        per_wave_s = per_wave_ns / 1e9
+        for b in self._bindings():
+            for _ in range(waves):
+                b.wave_seconds.observe(per_wave_s, model=key[0],
+                                       version=key[1], bucket=str(key[2]),
+                                       chunk=str(key[3]))
+
     # -- duty cycle ----------------------------------------------------------
 
     def _prune_locked(self, now: int) -> None:
@@ -315,10 +377,13 @@ class EfficiencyProfiler:
         now = self._now()
         with self._lock:
             items = sorted(self._costs.items())
+            wave_items = sorted(
+                (k, (w.waves, w.device_ns, w.wave_ns_ewma,
+                     sorted(w.recent)))
+                for k, w in self._waves.items())
         models: dict[str, dict] = {}
-        for (mname, version, bucket), c in items:
-            if model and mname != model:
-                continue
+
+        def model_entry(mname: str, version: str) -> dict:
             mkey = f"{mname}:{version}"
             entry = models.get(mkey)
             if entry is None:
@@ -330,6 +395,12 @@ class EfficiencyProfiler:
                     "buckets": [], "suggestion": None,
                     "suggestions": [],
                 }
+            return entry
+
+        for (mname, version, bucket), c in items:
+            if model and mname != model:
+                continue
+            entry = model_entry(mname, version)
             waste = c.padding_waste_device_s()
             entry["device_s"] += c.device_ns / 1e9
             entry["host_s"] += c.host_ns / 1e9
@@ -355,6 +426,31 @@ class EfficiencyProfiler:
                 "observed_s": round(
                     (now - c.first_seen) / 1e9 if c.first_seen else 0.0, 3),
             })
+        # Generative decode waves (record_wave): per (bucket, chunk) wave
+        # step times.  Wave device time also counts into the model's
+        # device_s total — generative engines never pass execute_timed,
+        # so without this their models profile as idle.
+        for (mname, version, bucket, chunk), (wv, dns, ewma, recent) \
+                in wave_items:
+            if model and mname != model:
+                continue
+            entry = model_entry(mname, version)
+            entry["device_s"] += dns / 1e9
+
+            def pct(q: float) -> float:
+                if not recent:
+                    return 0.0
+                return recent[min(len(recent) - 1, int(q * len(recent)))]
+
+            entry.setdefault("decode_waves", []).append({
+                "bucket": bucket,
+                "chunk": chunk,
+                "waves": wv,
+                "device_s": round(dns / 1e9, 6),
+                "wave_ms_ewma": round(ewma / 1e6, 3),
+                "wave_ms_p50": round(pct(0.5) / 1e6, 3),
+                "wave_ms_p99": round(pct(0.99) / 1e6, 3),
+            })
         for entry in models.values():
             entry["device_s"] = round(entry["device_s"], 6)
             entry["host_s"] = round(entry["host_s"], 6)
@@ -374,6 +470,7 @@ class EfficiencyProfiler:
         """Drop accumulated costs (tests); metric bindings survive."""
         with self._lock:
             self._costs.clear()
+            self._waves.clear()
             self._busy.clear()
             self._t0 = self._now()
 

@@ -6,4 +6,8 @@ layer is where hand-written TPU kernels live: memory-bound or
 fusion-resistant pieces XLA doesn't schedule optimally on its own.
 """
 
+from client_tpu.ops.decode_kernel import (  # noqa: F401
+    decode_wave_attention,
+    reference_decode_attention,
+)
 from client_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,220 @@
+"""Fused decode-wave kernel: KV scatter + masked single-query attention.
+
+The generative engine's hot loop is the decode wave
+(engine/generative.py): for every live stream, write the new token's K/V
+row into the KV arena at ``(row, len)`` and attend the stream's query over
+its valid prefix.  The reference path (models/generate.py ``decode_fn``)
+does this as stacked XLA ops — ``arena.at[li, rows, lens].set`` followed by
+``arena[li, rows]``, which materializes a fresh ``[B, S, H, D]`` gather of
+every lane's row in HBM per layer per wave, then runs a dense masked
+softmax over the static ``max_seq_len`` axis.
+
+This kernel fuses the scatter and the attention into one Pallas grid so
+the arena row is streamed through VMEM exactly once (arXiv 2308.15152's
+shared-memory-footprint discipline): grid ``(B, S // block_s)`` with the
+key-block index innermost, the lane's ``(row, len)`` pair arriving via
+scalar prefetch (``PrefetchScalarGridSpec``) so the BlockSpec index maps
+gather each lane's row directly out of the arena — no ``[B, S, H, D]``
+intermediate exists anywhere.  The arena update is in place via
+``input_output_aliases``: each visited block is copied through VMEM
+unchanged except the scatter block, where the new K/V row is inserted at
+``len % block_s`` with an iota mask (TPU vector stores want static
+offsets).  Attention follows ``_fa_kernel``'s online-softmax carry
+(ops/flash_attention.py:31) with a *strict* ``pos < len`` mask over the
+old arena content; the new token's contribution (position ``len``, whose
+value is exactly the k/v being scattered) is folded in at the finalize
+step from registers — so the kernel never depends on reading back its own
+scatter, and block write-back order cannot matter.
+
+``interpret=True`` runs the same kernel on CPU; the tier-1 suite and
+ci_check drive it that way (tests/test_ops.py parity suite).  The sharded
+cross-chip variant wraps this kernel per shard — see
+client_tpu/parallel/kv_shard.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG_INF = -1e30
+
+
+def pick_block_s(seq_len: int, cap: int = 128) -> int:
+    """Largest multiple-of-8 divisor of ``seq_len`` up to ``cap`` (falls
+    back to ``seq_len`` itself when no aligned divisor exists) — the same
+    rule the flash prefill path uses to keep TPU tiles (8, 128)-friendly
+    while still exercising a multi-block grid at test sizes."""
+    best = None
+    for cand in range(8, min(cap, seq_len) + 1, 8):
+        if seq_len % cand == 0:
+            best = cand
+    return best if best is not None else seq_len
+
+
+def _decode_kernel(rows_ref, lens_ref,           # scalar prefetch
+                   k_ref, v_ref, q_ref, kn_ref, vn_ref,   # inputs
+                   ko_ref, vo_ref, o_ref,                 # outputs
+                   m_ref, l_ref, acc_ref,                 # VMEM scratch
+                   *, block_s: int, sm_scale: float):
+    """One (lane, key-block) grid step; key blocks iterate innermost so the
+    scratch carries the online-softmax state across one lane's row."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    ik = pl.program_id(1)
+    nk = pl.num_programs(1)
+    length = lens_ref[b]                 # valid prefix length (strict)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    k_blk = k_ref[0, 0]                  # [block_s, H, D] (old content)
+    v_blk = v_ref[0, 0]
+    q = q_ref[0]                         # [H, D]
+    kn = kn_ref[0]                       # [H, D]
+    vn = vn_ref[0]
+
+    # Copy-through scatter: every block writes back what it read, except
+    # the scatter block inserts the new K/V row at position `length`.
+    # Writing every block (out index map == in index map) keeps the
+    # aliased arena well-defined under any block write-back schedule; a
+    # write-once-at-the-scatter-block design would depend on unwritten
+    # output windows preserving their aliased input, which Pallas does not
+    # promise.
+    off = length - (length // block_s) * block_s
+    ins = (ik == length // block_s) & (jax.lax.broadcasted_iota(
+        jnp.int32, (block_s, 1, 1), 0) == off)
+    ko_ref[0, 0] = jnp.where(ins, kn[None], k_blk)
+    vo_ref[0, 0] = jnp.where(ins, vn[None], v_blk)
+
+    # Masked single-query scores over the OLD prefix content: strictly
+    # pos < length (position `length` is the new token, folded below).
+    s = jnp.sum(q[None] * k_blk, axis=-1) * sm_scale      # [block_s, H]
+    pos = ik * block_s + jax.lax.broadcasted_iota(
+        jnp.int32, (block_s, 1), 0)
+    s = jnp.where(pos < length, s, _NEG_INF)
+
+    m_prev = m_ref[:]                                     # [1, H]
+    m_cur = jnp.max(s, axis=0, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    safe_m = jnp.where(m_new <= _NEG_INF, 0.0, m_new)
+    p = jnp.exp(jnp.where(s <= _NEG_INF, -jnp.inf, s) - safe_m)
+    corr = jnp.where(m_prev <= _NEG_INF, 0.0, jnp.exp(m_prev - safe_m))
+    m_ref[:] = m_new
+    l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=0, keepdims=True)
+    h = acc_ref.shape[0]
+    acc_ref[:] = (acc_ref[:] * corr.reshape(h, 1)
+                  + jnp.sum(p[:, :, None] * v_blk, axis=0))  # [H, D]
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        # Fold in the new token (position `length`, value kn/vn) from
+        # registers — it is always valid, so the denominator is > 0 and
+        # fully-masked-prefix lanes (length == 0, i.e. padded lanes on the
+        # dummy row) come out as exactly vn instead of NaN.
+        s_new = jnp.sum(q * kn, axis=-1)[None] * sm_scale  # [1, H]
+        m_fin = jnp.maximum(m_ref[:], s_new)
+        p_new = jnp.exp(s_new - m_fin)
+        corr_f = jnp.where(m_ref[:] <= _NEG_INF, 0.0,
+                           jnp.exp(m_ref[:] - m_fin))
+        l_fin = l_ref[:] * corr_f + p_new
+        acc_f = (acc_ref[:] * corr_f.reshape(h, 1)
+                 + p_new.reshape(h, 1) * vn)
+        o_ref[0] = (acc_f / l_fin.reshape(h, 1)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("layer", "block_s",
+                                             "interpret"))
+def decode_wave_attention(k_arena, v_arena, q, k_new, v_new, rows, lens, *,
+                          layer: int, block_s: int | None = None,
+                          interpret: bool = False):
+    """One layer's fused decode wave over the KV arena.
+
+    k_arena/v_arena: ``[L, R, S, H, D]``; q/k_new/v_new: ``[B, H, D]``;
+    rows/lens: ``[B]`` int32 (lane → arena row, valid prefix length).
+    Returns ``(k_arena, v_arena, o)`` with the new K/V scattered at
+    ``(layer, rows[b], lens[b])`` in place (donation-friendly: the arena
+    operands are aliased to the outputs) and ``o: [B, H, D]`` the
+    attention read over positions ``0 .. lens[b]`` inclusive.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _, _, s, h, d = k_arena.shape
+    bsz = q.shape[0]
+    if block_s is None:
+        block_s = pick_block_s(s)
+    if s % block_s:
+        raise ValueError(f"block_s ({block_s}) must divide max_seq_len "
+                         f"({s})")
+    sm_scale = 1.0 / np.sqrt(d)
+    grid = (bsz, s // block_s)
+
+    def arena_map(b, ik, rows, lens):
+        return (layer, rows[b], ik, 0, 0)
+
+    def lane_map(b, ik, rows, lens):
+        return (b, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_s, h, d), arena_map),   # k arena
+            pl.BlockSpec((1, 1, block_s, h, d), arena_map),   # v arena
+            pl.BlockSpec((1, h, d), lane_map),                # q
+            pl.BlockSpec((1, h, d), lane_map),                # k_new
+            pl.BlockSpec((1, h, d), lane_map),                # v_new
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_s, h, d), arena_map),   # k arena out
+            pl.BlockSpec((1, 1, block_s, h, d), arena_map),   # v arena out
+            pl.BlockSpec((1, h, d), lane_map),                # o
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, h), jnp.float32),    # running max
+            pltpu.VMEM((1, h), jnp.float32),    # running denominator
+            pltpu.VMEM((h, d), jnp.float32),    # weighted accumulator
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, block_s=block_s,
+                               sm_scale=sm_scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(k_arena.shape, k_arena.dtype),
+            jax.ShapeDtypeStruct(v_arena.shape, v_arena.dtype),
+            jax.ShapeDtypeStruct((bsz, h, d), q.dtype),
+        ],
+        # Operand indices count the scalar-prefetch args: rows=0, lens=1,
+        # k_arena=2, v_arena=3.
+        input_output_aliases={2: 0, 3: 1},
+        interpret=interpret,
+    )(rows, lens, k_arena, v_arena, q, k_new, v_new)
+
+
+def reference_decode_attention(k_arena, v_arena, q, k_new, v_new, rows,
+                               lens, *, layer: int):
+    """XLA oracle with the reference path's exact semantics (scatter the
+    new K/V, gather the rows, dense masked softmax over ``pos <= len``) —
+    the parity target for the fused kernel, kept next to it like
+    ``reference_attention`` is for flash."""
+    d = q.shape[-1]
+    s = k_arena.shape[2]
+    k_arena = k_arena.at[layer, rows, lens].set(k_new)
+    v_arena = v_arena.at[layer, rows, lens].set(v_new)
+    ck = k_arena[layer, rows]                       # [B, S, H, D]
+    cv = v_arena[layer, rows]
+    scores = jnp.einsum("bhd,bshd->bhs", q, ck) / np.sqrt(d)
+    mask = jnp.arange(s)[None, :] <= lens[:, None]
+    scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
+    o = jnp.einsum("bhs,bshd->bhd", jax.nn.softmax(scores), cv)
+    return k_arena, v_arena, o

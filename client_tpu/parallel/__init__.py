@@ -7,6 +7,13 @@ client↔server transports for the *device-side* data plane (SURVEY.md §2.9:
 the reference has no NCCL/MPI; its transports map per §5.8).
 """
 
+from client_tpu.parallel.kv_shard import (  # noqa: F401
+    arena_row_layout,
+    kv_mesh,
+    ring_all_reduce,
+    shard_arena,
+    sharded_decode_attention,
+)
 from client_tpu.parallel.mesh import make_mesh, mesh_axes  # noqa: F401
 from client_tpu.parallel.moe import (  # noqa: F401
     make_moe_train_step,

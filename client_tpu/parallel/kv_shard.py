@@ -1,0 +1,190 @@
+"""Cross-chip KV arena: row-sharded cache + shard_map'd fused decode.
+
+One chip's HBM caps the generative engine at ``max_streams × max_seq_len``
+KV rows; this module lifts that ceiling by sharding the arena's *row* axis
+over a ``"kv"`` mesh axis with ``NamedSharding`` — each stream's whole
+context lives on exactly one chip, so a decode wave needs no cross-chip
+softmax (contrast ring_attention.py, which shards the *sequence* axis and
+must rotate K/V): the owning shard computes the lane's full attention
+locally with the fused kernel (ops/decode_kernel.py) and the per-lane
+outputs are combined across the mesh, unowned shards contributing zeros.
+
+Row layout (``arena_row_layout``): the global arena carries one junk row
+*per shard* — the last local row of each shard — instead of the
+single-chip layout's one trailing dummy row, so every shard has a local
+row that absorbs scatters from lanes it does not own (the kernel always
+scatters somewhere; pointing unowned lanes at their local junk row keeps
+the grid shape static and the real rows untouched).  Shard 0's junk row
+doubles as the engine-visible dummy row for padded lanes.
+
+The combine is the cross-chip data plane and comes in two flavors:
+``psum`` (XLA's collective) and the default ``ring`` — a Pallas kernel
+moving the partial outputs neighbor-to-neighbor with
+``make_async_remote_copy`` remote DMA (SNIPPETS.md [3] / pallas_guide.md),
+double-buffered with per-slot DMA semaphores.  Both run under
+``interpret=True`` on CPU, which is how the tier-1 suite exercises ≥2
+shards on 8 virtual devices (tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def kv_mesh(n_shards: int):
+    """A 1-D ``("kv",)`` mesh over the first ``n_shards`` devices."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_shards > len(devices):
+        raise ValueError(
+            f"kv_shards={n_shards} but runtime has {len(devices)} "
+            f"device(s)")
+    return Mesh(np.asarray(devices[:n_shards]), ("kv",))
+
+
+def arena_row_layout(capacity: int, n_shards: int):
+    """(total_rows, free_rows, dummy_row) for a ``capacity``-stream arena
+    over ``n_shards``.  Unsharded: ``capacity`` real rows plus the one
+    trailing dummy.  Sharded: ``capacity`` real rows plus one junk row per
+    shard (each shard's last local row), so ``capacity`` must divide
+    evenly — every shard then holds ``capacity/n + 1`` rows."""
+    if n_shards <= 1:
+        return capacity + 1, list(range(capacity)), capacity
+    if capacity % n_shards:
+        raise ValueError(
+            f"max_streams ({capacity}) must be divisible by kv_shards "
+            f"({n_shards}) for an even row partition")
+    total = capacity + n_shards
+    r_loc = total // n_shards
+    free = [r for r in range(total) if (r + 1) % r_loc != 0]
+    return total, free, r_loc - 1
+
+
+def shard_arena(arena: dict, mesh):
+    """Place an arena pytree on the mesh: k/v rows sharded over ``kv``,
+    token slots replicated (they are tiny and every shard gathers them)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    rows = NamedSharding(mesh, P(None, "kv"))
+    rep = NamedSharding(mesh, P())
+    return {"k": jax.device_put(arena["k"], rows),
+            "v": jax.device_put(arena["v"], rows),
+            "tok": jax.device_put(arena["tok"], rep)}
+
+
+# -- ring all-reduce over remote DMA ------------------------------------------
+
+
+def _ring_kernel(x_ref, o_ref, buf_ref, send_sem, recv_sem,
+                 *, n_dev: int, axis_name: str):
+    """All-reduce-sum by rotating the chunk around the ring n-1 times:
+    each step remote-copies the current buffer slot to the right
+    neighbor's other slot and accumulates what arrived from the left.
+    Double-buffered so a step never sends the slot it is receiving into;
+    start()+wait() per hop keeps the schedule a simple barrier ring."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    my = jax.lax.axis_index(axis_name)
+    right = jax.lax.rem(my + 1, n_dev)
+    o_ref[...] = x_ref[...]
+    buf_ref[0] = x_ref[...]
+    for step in range(n_dev - 1):
+        src, dst = step % 2, (step + 1) % 2
+        copy = pltpu.make_async_remote_copy(
+            src_ref=buf_ref.at[src],
+            dst_ref=buf_ref.at[dst],
+            send_sem=send_sem.at[src],
+            recv_sem=recv_sem.at[dst],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        copy.start()
+        copy.wait()
+        o_ref[...] += buf_ref[dst]
+
+
+def ring_all_reduce(x, axis_name: str, n_dev: int, *,
+                    interpret: bool = False):
+    """Sum ``x`` across ``axis_name`` (size ``n_dev``, static) with a
+    Pallas remote-DMA ring.  Call under ``shard_map``; the result is
+    replicated.  ``n_dev`` must be passed statically — Pallas needs the
+    hop count at trace time."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if n_dev == 1:
+        return x
+    kernel = functools.partial(_ring_kernel, n_dev=n_dev,
+                               axis_name=axis_name)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2,) + x.shape, x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(x)
+
+
+# -- sharded fused decode ------------------------------------------------------
+
+
+def sharded_decode_attention(mesh, k_arena, v_arena, q, k_new, v_new,
+                             rows, lens, *, layer: int,
+                             block_s: int | None = None,
+                             interpret: bool = False,
+                             combine: str = "ring"):
+    """The fused decode wave over a row-sharded arena: every shard runs
+    ops/decode_kernel.py on its local rows (lanes it does not own scatter
+    into its junk row with a zero-length prefix), masks unowned lanes'
+    outputs to zero, and the combine sums the partials so each lane's
+    answer — computed entirely on its owning shard — lands everywhere.
+    Same signature/returns as ``decode_wave_attention`` plus the mesh."""
+    from client_tpu.ops.decode_kernel import decode_wave_attention
+    from jax.sharding import PartitionSpec as P
+
+    if combine not in ("ring", "psum"):
+        raise ValueError(f"combine must be 'ring' or 'psum', "
+                         f"got {combine!r}")
+    n = mesh.shape["kv"]
+    r_loc = k_arena.shape[1] // n
+
+    def body(k_sh, v_sh, q, kn, vn, rows, lens):
+        idx = jax.lax.axis_index("kv")
+        lo = idx * r_loc
+        owned = (rows >= lo) & (rows < lo + r_loc)
+        loc_rows = jnp.where(owned, rows - lo, r_loc - 1).astype(jnp.int32)
+        loc_lens = jnp.where(owned, lens, 0).astype(jnp.int32)
+        k_sh, v_sh, o = decode_wave_attention(
+            k_sh, v_sh, q, kn, vn, loc_rows, loc_lens, layer=layer,
+            block_s=block_s, interpret=interpret)
+        o = jnp.where(owned[:, None, None], o, 0.0).astype(o.dtype)
+        if combine == "ring":
+            o = ring_all_reduce(o, "kv", n, interpret=interpret)
+        else:
+            o = jax.lax.psum(o, "kv")
+        return k_sh, v_sh, o
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    arena_spec = P(None, "kv")
+    rep = P()
+    kwargs = dict(mesh=mesh,
+                  in_specs=(arena_spec, arena_spec, rep, rep, rep, rep,
+                            rep),
+                  out_specs=(arena_spec, arena_spec, rep))
+    try:
+        fn = shard_map(body, check_vma=False, **kwargs)
+    except TypeError:  # pre-0.8 jax spells it check_rep
+        fn = shard_map(body, check_rep=False, **kwargs)
+    return fn(k_arena, v_arena, q, k_new, v_new, rows, lens)

@@ -111,6 +111,18 @@ class TestArena:
         assert snap["reservations"] == [
             {"name": "kv:m:1", "offset": 0, "nbytes": 2 * ALIGN}]
 
+    def test_reserve_sharded_commits_per_device_share(self):
+        a = ArenaAllocator(64 * ALIGN)
+        # A 4-way shard of a global buffer charges a quarter per device.
+        assert a.reserve_sharded("kv", 8 * ALIGN, shards=4).nbytes == \
+            2 * ALIGN
+        # shards=1 is exactly a plain reserve.
+        assert a.reserve_sharded("solo", 3 * ALIGN).nbytes == \
+            a.reserve("plain", 3 * ALIGN).nbytes
+        # Uneven splits ceil-divide, then align like any reservation.
+        assert a.reserve_sharded("odd", 3 * ALIGN + 1, shards=2).nbytes == \
+            2 * ALIGN
+
     def test_cpu_fallback_budget(self):
         # On the CPU test platform memory_stats reports no bytes_limit.
         assert device_hbm_budget(0.9, fallback_bytes=123) in (123,) or \

@@ -989,3 +989,233 @@ class TestFlashPrefill:
         b = _REGISTRY["tiny_gpt_long"]()
         assert b.max_seq_len == 2048
         assert b.attention_impl == "flash"
+
+
+class TestFusedDecode:
+    """attn_impl='fused' (ops/decode_kernel.py) and the row-sharded arena
+    (parallel/kv_shard.py) must be invisible: token streams bit-identical
+    to the reference decode path, greedy and sampled, solo and batched."""
+
+    KW = dict(n_layers=2, d_model=64, n_heads=2, d_ff=128, vocab=128,
+              max_seq_len=32, max_streams=4)
+
+    def _engine(self, **overrides):
+        from client_tpu.engine.repository import ModelRepository
+        from client_tpu.models.generate import TinyGptBackend
+
+        repo = ModelRepository()
+        repo.register_backend(TinyGptBackend(name="tg",
+                                             **{**self.KW, **overrides}))
+        return TpuEngine(repo)
+
+    def _gen(self, eng, prompt, n, **params):
+        toks: list[int] = []
+        errs: list = []
+        done = threading.Event()
+
+        def cb(resp):
+            if resp.error is not None:
+                errs.append(resp.error)
+                done.set()
+            elif resp.final:
+                done.set()
+            else:
+                toks.append(int(resp.outputs["TOKEN"][0]))
+
+        eng.async_infer(InferRequest(
+            model_name="tg",
+            inputs={"INPUT_IDS": np.asarray(prompt, np.int32)},
+            parameters={"max_tokens": n, **params}), cb)
+        assert done.wait(240), "stream stalled"
+        assert not errs, errs
+        return toks
+
+    def _stream_suite(self, eng):
+        """Greedy + sampled streams across prompt lengths; returns the
+        token lists so impls can be compared token for token."""
+        out = [self._gen(eng, p, 6) for p in ([1, 2, 3], [7] * 9, [5])]
+        out.append(self._gen(eng, [4, 4], 8, temperature=1.0, seed=42))
+        out.append(self._gen(eng, [4, 4], 8, temperature=0.8, seed=7,
+                             top_k=24, top_p=0.9))
+        return out
+
+    def test_fused_matches_reference_token_for_token(self):
+        ref_eng = self._engine(attn_impl="reference")
+        try:
+            want = self._stream_suite(ref_eng)
+        finally:
+            ref_eng.shutdown()
+        fus_eng = self._engine(attn_impl="fused")
+        try:
+            assert self._stream_suite(fus_eng) == want
+        finally:
+            fus_eng.shutdown()
+
+    def test_sharded_arena_matches_and_serves_two_shards(self):
+        ref_eng = self._engine(attn_impl="reference")
+        try:
+            want = self._stream_suite(ref_eng)
+        finally:
+            ref_eng.shutdown()
+        shd_eng = self._engine(attn_impl="fused", kv_shards=2)
+        try:
+            sched = shd_eng._schedulers["tg"]
+            assert sched.arena_shards() == 2
+            mesh = sched.model.backend._mesh()
+            assert mesh.shape["kv"] == 2
+            assert self._stream_suite(shd_eng) == want
+        finally:
+            shd_eng.shutdown()
+
+    def test_sharded_batched_streams_match_solo(self):
+        eng = self._engine(attn_impl="fused", kv_shards=2)
+        try:
+            prompts = [[i + 1, i + 2] for i in range(6)]
+            solo = [self._gen(eng, p, 6) for p in prompts]
+            results: list = [None] * len(prompts)
+            errs: list = []
+
+            def run(i):
+                try:
+                    results[i] = self._gen(eng, prompts[i], 6)
+                except Exception as exc:  # noqa: BLE001
+                    errs.append((i, repr(exc)))
+
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(len(prompts))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs, errs
+            assert results == solo
+        finally:
+            eng.shutdown()
+
+    def test_chunked_decode_identical_on_fused_path(self, monkeypatch):
+        want_eng = self._engine(attn_impl="fused")
+        try:
+            want = self._gen(want_eng, [7, 8], 13)
+        finally:
+            want_eng.shutdown()
+        monkeypatch.setenv("CLIENT_TPU_GEN_CHUNK", "4")
+        chunk_eng = self._engine(attn_impl="fused")
+        try:
+            assert self._gen(chunk_eng, [7, 8], 13) == want
+        finally:
+            chunk_eng.shutdown()
+
+    def test_env_var_selects_impl(self, monkeypatch):
+        from client_tpu.models.generate import TinyGptBackend
+
+        monkeypatch.setenv("CLIENT_TPU_ATTN_IMPL", "fused")
+        assert TinyGptBackend(name="e1", **self.KW).attn_impl == "fused"
+        monkeypatch.delenv("CLIENT_TPU_ATTN_IMPL")
+        assert TinyGptBackend(name="e2", **self.KW).attn_impl == "reference"
+        # Explicit ctor arg wins over the env default.
+        monkeypatch.setenv("CLIENT_TPU_ATTN_IMPL", "reference")
+        assert TinyGptBackend(name="e3", attn_impl="fused",
+                              **self.KW).attn_impl == "fused"
+
+    def test_invalid_configs_rejected(self):
+        from client_tpu.models.generate import TinyGptBackend
+
+        with pytest.raises(ValueError, match="attn_impl"):
+            TinyGptBackend(name="bad1", attn_impl="nope", **self.KW)
+        with pytest.raises(ValueError, match="fused"):
+            TinyGptBackend(name="bad2", attn_impl="reference",
+                           kv_shards=2, **self.KW)
+        with pytest.raises(ValueError, match="divisible"):
+            TinyGptBackend(name="bad3", attn_impl="fused", kv_shards=3,
+                           **self.KW)
+
+    def test_wave_stats_recorded(self):
+        from client_tpu.observability.profiler import profiler, \
+            reset_profiler
+
+        reset_profiler()
+        eng = self._engine(attn_impl="fused")
+        try:
+            self._gen(eng, [1, 2], 6)
+            snap = profiler().snapshot(model="tg")
+            entry = snap["models"].get("tg:1") or {}
+            waves = entry.get("decode_waves") or []
+            assert waves, snap["models"].keys()
+            w = waves[0]
+            assert w["bucket"] >= 1 and w["waves"] >= 1
+            assert w["wave_ms_p50"] >= 0
+        finally:
+            eng.shutdown()
+            reset_profiler()
+
+
+class TestWaveBucketOverflow:
+    def test_live_set_larger_than_max_bucket_splits(self):
+        """Regression: a live set larger than the largest wave bucket used
+        to raise StopIteration inside the bucket pick (killing the decode
+        loop); it must clamp to the max bucket and split the wave."""
+        from client_tpu.engine.repository import ModelRepository
+        from client_tpu.models.generate import TinyGptBackend
+
+        backend = TinyGptBackend(name="tg_of", n_layers=2, d_model=64,
+                                 n_heads=2, d_ff=128, vocab=128,
+                                 max_seq_len=32, max_streams=8)
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        eng = TpuEngine(repo)
+        try:
+            sched = eng._schedulers["tg_of"]
+            solo: list = []
+            for i in range(5):
+                toks, done = [], threading.Event()
+
+                def cb(resp, toks=toks, done=done):
+                    if resp.error is not None or resp.final:
+                        done.set()
+                    else:
+                        toks.append(int(resp.outputs["TOKEN"][0]))
+
+                eng.async_infer(InferRequest(
+                    model_name="tg_of",
+                    inputs={"INPUT_IDS": np.asarray([i + 1], np.int32)},
+                    parameters={"max_tokens": 5}), cb)
+                assert done.wait(120)
+                solo.append(toks)
+            # Force the overflow: largest wave bucket (2) < live set (5).
+            sched._wave_buckets = [1, 2]
+            results: list = [None] * 5
+            errs: list = []
+
+            def run(i):
+                try:
+                    toks, done = [], threading.Event()
+
+                    def cb(resp):
+                        if resp.error is not None:
+                            errs.append((i, str(resp.error)))
+                            done.set()
+                        elif resp.final:
+                            done.set()
+                        else:
+                            toks.append(int(resp.outputs["TOKEN"][0]))
+
+                    eng.async_infer(InferRequest(
+                        model_name="tg_of",
+                        inputs={"INPUT_IDS": np.asarray([i + 1], np.int32)},
+                        parameters={"max_tokens": 5}), cb)
+                    assert done.wait(120), "stream stalled"
+                    results[i] = toks
+                except Exception as exc:  # noqa: BLE001
+                    errs.append((i, repr(exc)))
+
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(5)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs, errs[:3]
+            # Split waves are still batch-invariant.
+            assert results == solo
+        finally:
+            eng.shutdown()

@@ -138,3 +138,179 @@ def test_long_context_bert_through_engine():
         assert float(np.max(np.abs(out_sp - out_ref))) < 2e-2  # bf16
     finally:
         engine.shutdown()
+
+
+# -- fused decode-wave kernel (ops/decode_kernel.py) ---------------------------
+
+
+from client_tpu.ops.decode_kernel import (  # noqa: E402
+    decode_wave_attention,
+    pick_block_s,
+    reference_decode_attention,
+)
+from client_tpu.parallel.kv_shard import (  # noqa: E402
+    arena_row_layout,
+    kv_mesh,
+    ring_all_reduce,
+    sharded_decode_attention,
+)
+
+
+def _decode_case(layers=2, rows=5, s=32, h=2, d=16, bsz=4, seed=0):
+    """A populated arena + one wave of lane inputs. Lane 3 is a padded
+    lane parked on the dummy row (len 0) like the scheduler pads waves."""
+    ks = jax.random.split(jax.random.PRNGKey(seed), 5)
+    k_arena = jax.random.normal(ks[0], (layers, rows, s, h, d))
+    v_arena = jax.random.normal(ks[1], (layers, rows, s, h, d))
+    q = jax.random.normal(ks[2], (bsz, h, d))
+    kn = jax.random.normal(ks[3], (bsz, h, d))
+    vn = jax.random.normal(ks[4], (bsz, h, d))
+    rows_ix = jnp.asarray([0, 2, 1, rows - 1], jnp.int32)[:bsz]
+    lens = jnp.asarray([7, 0, s - 1, 0], jnp.int32)[:bsz]
+    return k_arena, v_arena, q, kn, vn, rows_ix, lens
+
+
+class TestFusedDecodeKernel:
+    @pytest.mark.parametrize("block_s", [8, 16, 32])
+    def test_matches_reference_across_blocks(self, block_s):
+        k_a, v_a, q, kn, vn, rows, lens = _decode_case()
+        for layer in (0, 1):
+            fk, fv, fo = decode_wave_attention(
+                k_a, v_a, q, kn, vn, rows, lens, layer=layer,
+                block_s=block_s, interpret=True)
+            rk, rv, ro = reference_decode_attention(
+                k_a, v_a, q, kn, vn, rows, lens, layer=layer)
+            # Real lanes' outputs agree; padded lanes (dummy row, len 0)
+            # are junk in both impls and are discarded by the scheduler.
+            live = np.asarray(lens) > 0
+            live[0] = True  # len 7 lane
+            assert float(jnp.max(jnp.abs(fo[live] - ro[live]))) < 2e-5
+            # The scatter itself is exact on every real row the wave
+            # touched (the arena IS the model state; bitwise matters).
+            for b in (0, 2):
+                r, ln = int(rows[b]), int(lens[b])
+                np.testing.assert_array_equal(
+                    np.asarray(fk[layer, r, ln]), np.asarray(rk[layer, r, ln]))
+                np.testing.assert_array_equal(
+                    np.asarray(fv[layer, r, ln]), np.asarray(rv[layer, r, ln]))
+
+    @pytest.mark.parametrize("length", [0, 1, 7, 8, 15, 31])
+    def test_every_prefix_length(self, length):
+        """Scatter offset and strict mask at block boundaries (8/16) and
+        the edges (empty prefix, full arena row)."""
+        k_a, v_a, q, kn, vn, _, _ = _decode_case(bsz=1)
+        rows = jnp.asarray([1], jnp.int32)
+        lens = jnp.asarray([length], jnp.int32)
+        fk, fv, fo = decode_wave_attention(
+            k_a, v_a, q, kn, vn, rows, lens, layer=0, block_s=8,
+            interpret=True)
+        rk, rv, ro = reference_decode_attention(
+            k_a, v_a, q, kn, vn, rows, lens, layer=0)
+        assert float(jnp.max(jnp.abs(fo - ro))) < 2e-5
+        np.testing.assert_array_equal(np.asarray(fk[0, 1]),
+                                      np.asarray(rk[0, 1]))
+        np.testing.assert_array_equal(np.asarray(fv[0, 1]),
+                                      np.asarray(rv[0, 1]))
+
+    def test_untouched_rows_survive_aliasing(self):
+        """input_output_aliases updates in place: rows no lane points at
+        must come through bit-identical."""
+        k_a, v_a, q, kn, vn, rows, lens = _decode_case(rows=6)
+        before = np.asarray(k_a[0, 3]).copy()
+        fk, _, _ = decode_wave_attention(
+            k_a, v_a, q, kn, vn, rows, lens, layer=0, block_s=8,
+            interpret=True)
+        np.testing.assert_array_equal(np.asarray(fk[0, 3]), before)
+
+    def test_outputs_finite_for_padded_lanes(self):
+        """len==0 lanes (dummy row) must produce finite output (the new
+        token is always a valid attention target), never NaN."""
+        k_a, v_a, q, kn, vn, _, _ = _decode_case(bsz=2)
+        rows = jnp.asarray([4, 4], jnp.int32)
+        lens = jnp.asarray([0, 0], jnp.int32)
+        _, _, o = decode_wave_attention(
+            k_a, v_a, q, kn, vn, rows, lens, layer=0, interpret=True)
+        assert bool(jnp.all(jnp.isfinite(o)))
+
+    def test_pick_block_s(self):
+        assert pick_block_s(32) == 32
+        assert pick_block_s(256) == 128
+        assert pick_block_s(256, cap=64) == 64
+        assert pick_block_s(24) == 24
+        assert pick_block_s(7) == 7  # no aligned divisor: whole row
+
+    def test_block_s_must_divide(self):
+        k_a, v_a, q, kn, vn, rows, lens = _decode_case()
+        with pytest.raises(ValueError, match="divide"):
+            decode_wave_attention(k_a, v_a, q, kn, vn, rows, lens,
+                                  layer=0, block_s=24, interpret=True)
+
+
+class TestShardedKvArena:
+    def test_arena_row_layout(self):
+        assert arena_row_layout(4, 1) == (5, [0, 1, 2, 3], 4)
+        total, free, dummy = arena_row_layout(4, 2)
+        assert (total, dummy) == (6, 2)
+        assert free == [0, 1, 3, 4]  # rows 2 and 5 are the junk rows
+        with pytest.raises(ValueError, match="divisible"):
+            arena_row_layout(5, 2)
+
+    def test_ring_all_reduce_sums(self):
+        try:
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = kv_mesh(4)
+        x = jnp.arange(4 * 8, dtype=jnp.float32).reshape(4, 8)
+
+        def body(x_sh):
+            return ring_all_reduce(x_sh[0], "kv", 4, interpret=True)[None]
+
+        kwargs = dict(mesh=mesh, in_specs=(P("kv"),), out_specs=P("kv"))
+        try:
+            fn = shard_map(body, check_vma=False, **kwargs)
+        except TypeError:
+            fn = shard_map(body, check_rep=False, **kwargs)
+        out = np.asarray(fn(x))
+        want = np.tile(np.asarray(x).sum(0), (4, 1))
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+    @pytest.mark.parametrize("combine", ["ring", "psum"])
+    def test_sharded_matches_single_chip(self, combine):
+        """2 mesh shards over the row-sharded arena == the single-chip
+        fused kernel on the free rows, and == the XLA reference."""
+        cap, n = 4, 2
+        total, free, _dummy = arena_row_layout(cap, n)
+        layers, s, h, d, bsz = 2, 16, 2, 8, 3
+        ks = jax.random.split(jax.random.PRNGKey(3), 5)
+        k_a = jax.random.normal(ks[0], (layers, total, s, h, d))
+        v_a = jax.random.normal(ks[1], (layers, total, s, h, d))
+        q = jax.random.normal(ks[2], (bsz, h, d))
+        kn = jax.random.normal(ks[3], (bsz, h, d))
+        vn = jax.random.normal(ks[4], (bsz, h, d))
+        # Lanes on both shards: global rows 0 (shard 0), 3 and 4 (shard 1).
+        rows = jnp.asarray([free[0], free[2], free[3]], jnp.int32)
+        lens = jnp.asarray([5, 0, s - 1], jnp.int32)
+
+        mesh = kv_mesh(n)
+        sk, sv, so = sharded_decode_attention(
+            mesh, k_a, v_a, q, kn, vn, rows, lens, layer=1,
+            interpret=True, combine=combine)
+        fk, fv, fo = decode_wave_attention(
+            k_a, v_a, q, kn, vn, rows, lens, layer=1, interpret=True)
+        rk, rv, ro = reference_decode_attention(
+            k_a, v_a, q, kn, vn, rows, lens, layer=1)
+        assert float(jnp.max(jnp.abs(so - fo))) < 2e-5
+        assert float(jnp.max(jnp.abs(so - ro))) < 2e-5
+        # Free-row arena content identical across all three paths (junk
+        # rows absorb unowned scatters and are never read).
+        np.testing.assert_allclose(np.asarray(sk[:, free]),
+                                   np.asarray(fk[:, free]), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(sv[:, free]),
+                                   np.asarray(rv[:, free]), rtol=1e-6)
+
+    def test_kv_mesh_rejects_oversubscription(self):
+        with pytest.raises(ValueError, match="device"):
+            kv_mesh(1024)

@@ -514,3 +514,52 @@ class TestProfileE2e:
         assert profile_report.main([str(path), "--model", "simple"]) == 0
         out = capsys.readouterr().out
         assert "duty_cycle" in out
+
+
+# -- decode wave stats (generative fused path) --------------------------------
+
+
+class TestDecodeWaves:
+    def test_record_wave_snapshot_and_duty(self):
+        p, clk = _prof(window_s=10.0)
+        clk.advance_s(20.0)
+        p.record_wave("m", 1, bucket=8, chunk=4,
+                      duration_ns=2_000_000_000, waves=4)
+        snap = p.snapshot()
+        m = snap["models"]["m:1"]
+        waves = m["decode_waves"]
+        assert len(waves) == 1
+        w = waves[0]
+        assert w["bucket"] == 8 and w["chunk"] == 4 and w["waves"] == 4
+        assert w["device_s"] == pytest.approx(2.0)
+        # 2s chunk of 4 waves -> 500ms per wave
+        assert w["wave_ms_p50"] == pytest.approx(500.0)
+        # wave time rolls into the model's device time and the duty cycle
+        assert m["device_s"] == pytest.approx(2.0)
+        assert p.duty_cycle() == pytest.approx(0.2, abs=1e-6)
+
+    def test_wave_histogram_on_bound_registry(self):
+        p, _ = _prof()
+        reg = MetricRegistry()
+        p.bind_metrics(reg)
+        p.record_wave("m", 1, bucket=8, chunk=1, duration_ns=3_000_000)
+        text = reg.render()
+        assert "tpu_decode_wave_seconds" in text
+        assert 'bucket="8"' in text and 'chunk="1"' in text
+        assert promlint.lint(text) == []
+
+    def test_percentiles_over_many_waves(self):
+        p, _ = _prof()
+        for i in range(100):
+            p.record_wave("m", 1, bucket=4, chunk=1,
+                          duration_ns=(i + 1) * 1_000_000)
+        w = p.snapshot()["models"]["m:1"]["decode_waves"][0]
+        assert w["waves"] == 100
+        assert 45 <= w["wave_ms_p50"] <= 55
+        assert w["wave_ms_p99"] >= 95
+
+    def test_reset_drops_waves(self):
+        p, _ = _prof()
+        p.record_wave("m", 1, bucket=4, chunk=1, duration_ns=1_000_000)
+        p.reset()
+        assert p.snapshot()["models"] == {}

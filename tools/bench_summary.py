@@ -133,7 +133,7 @@ def main() -> int:
                       "— excluded from medians")
                 continue
             eff_keys = ("fill_ratio", "duty_cycle", "xla_compiles",
-                        "pad_waste_device_s")
+                        "pad_waste_device_s", "wave_step_ms_p50")
             view = {k: v for k, v in rec.items()
                     if k not in ("probe", "ts", "run_ts", "platform",
                                  "config", "windows") + eff_keys}

@@ -1,11 +1,11 @@
 #!/usr/bin/env bash
 # CI gate: tier-1 tests + chaos suite + live endpoint lint + autotune
-# e2e + router e2e + bench gate.
+# e2e + router e2e + fused kernel parity + bench gate.
 #
 #   tools/ci_check.sh            # everything (tier-1 already includes chaos)
 #   tools/ci_check.sh --fast     # all stages except tier-1
 #
-# Six stages:
+# Seven stages:
 #   1. tier-1: the full fast suite (ROADMAP.md contract; excludes `slow`).
 #   2. chaos: the deterministic fault-injection suite alone (`-m chaos`) —
 #      redundant with tier-1 when stage 1 runs, but the -m filter proves
@@ -27,7 +27,11 @@
 #      receive some), smoke /v2/load, roll-drain one replica with live
 #      in-process drain (survivor keeps serving), and lint tpu_router_*
 #      in both exposition dialects.
-#   6. bench gate: tools/bench_summary.py --check fails the build when the
+#   6. fused kernel parity: the Pallas decode-kernel suite
+#      (tests/test_ops.py) in interpret mode, then a fused-path engine
+#      driven end to end so tpu_decode_wave_seconds renders and lints
+#      clean in both exposition dialects.
+#   7. bench gate: tools/bench_summary.py --check fails the build when the
 #      newest BENCH_HISTORY.json run regressed any probe's p99 by >25%.
 set -u -o pipefail
 
@@ -38,7 +42,7 @@ FAST=0
 rc=0
 
 if [ "$FAST" -eq 0 ]; then
-    echo "=== stage 1/6: tier-1 test suite ==="
+    echo "=== stage 1/7: tier-1 test suite ==="
     rm -f /tmp/_t1.log
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -48,15 +52,15 @@ if [ "$FAST" -eq 0 ]; then
         | tr -cd . | wc -c)"
     [ "$t1" -ne 0 ] && { echo "tier-1 FAILED (exit $t1)"; rc=1; }
 else
-    echo "=== stage 1/6: tier-1 skipped (--fast) ==="
+    echo "=== stage 1/7: tier-1 skipped (--fast) ==="
 fi
 
-echo "=== stage 2/6: chaos (fault-injection) suite ==="
+echo "=== stage 2/7: chaos (fault-injection) suite ==="
 timeout -k 10 300 python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 [ $? -ne 0 ] && { echo "chaos suite FAILED"; rc=1; }
 
-echo "=== stage 3/6: live scrape (promlint + ops endpoints) ==="
+echo "=== stage 3/7: live scrape (promlint + ops endpoints) ==="
 SCRAPE_DIR=$(mktemp -d)
 python - "$SCRAPE_DIR" <<'EOF'
 import json
@@ -120,7 +124,7 @@ python tools/promlint.py --openmetrics "$SCRAPE_DIR/metrics.om.txt" \
     || { echo "promlint (openmetrics) FAILED"; rc=1; }
 rm -rf "$SCRAPE_DIR"
 
-echo "=== stage 4/6: autotune e2e (promotion + metrics) ==="
+echo "=== stage 4/7: autotune e2e (promotion + metrics) ==="
 TUNE_DIR=$(mktemp -d)
 CLIENT_TPU_AUTOTUNE='{"interval_s": 0.2, "cooldown_s": 0.5}' \
 timeout -k 10 300 python - "$TUNE_DIR" <<'EOF'
@@ -196,7 +200,7 @@ python tools/promlint.py --openmetrics "$TUNE_DIR/metrics.om.txt" \
     || { echo "promlint (autotune openmetrics) FAILED"; rc=1; }
 rm -rf "$TUNE_DIR"
 
-echo "=== stage 5/6: router e2e (balance + roll-drain + metrics) ==="
+echo "=== stage 5/7: router e2e (balance + roll-drain + metrics) ==="
 ROUTER_DIR=$(mktemp -d)
 timeout -k 10 300 python - "$ROUTER_DIR" <<'EOF'
 import json
@@ -303,7 +307,78 @@ python tools/promlint.py --openmetrics "$ROUTER_DIR/metrics.om.txt" \
     || { echo "promlint (router openmetrics) FAILED"; rc=1; }
 rm -rf "$ROUTER_DIR"
 
-echo "=== stage 6/6: bench p99 regression gate ==="
+echo "=== stage 6/7: fused decode kernel parity (interpret) + wave metrics ==="
+# The Pallas decode kernel and the sharded KV arena run in interpret mode
+# on CPU (docs/KERNELS.md): this stage proves (a) fused == reference on
+# the fast parity subset, (b) an engine on the fused path emits
+# tpu_decode_wave_seconds, and (c) that histogram renders promlint-clean
+# in both exposition dialects.
+timeout -k 10 300 python -m pytest tests/test_ops.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+[ $? -ne 0 ] && { echo "kernel parity suite FAILED"; rc=1; }
+KERNEL_DIR=$(mktemp -d)
+timeout -k 10 300 python - "$KERNEL_DIR" <<'EOF'
+import sys
+import threading
+from urllib.request import Request, urlopen
+
+import numpy as np
+
+from client_tpu.engine import TpuEngine
+from client_tpu.engine.repository import ModelRepository
+from client_tpu.engine.types import InferRequest
+from client_tpu.models.generate import TinyGptBackend
+from client_tpu.server import HttpInferenceServer
+
+out_dir = sys.argv[1]
+repo = ModelRepository()
+repo.register_backend(TinyGptBackend(
+    name="tiny_gpt", n_layers=2, d_model=64, n_heads=2, d_ff=128,
+    vocab=128, max_seq_len=32, max_streams=4, attn_impl="fused"))
+engine = TpuEngine(repo)
+srv = HttpInferenceServer(engine, host="127.0.0.1", port=0).start()
+try:
+    done = threading.Event()
+    errs = []
+
+    def cb(resp):
+        if resp.error is not None:
+            errs.append(resp.error)
+            done.set()
+        elif resp.final:
+            done.set()
+
+    engine.async_infer(InferRequest(
+        model_name="tiny_gpt",
+        inputs={"INPUT_IDS": np.asarray([1, 2, 3], np.int32)},
+        parameters={"max_tokens": 6}), cb)
+    if not done.wait(120):
+        sys.exit("fused generation stalled")
+    if errs:
+        sys.exit(f"fused generation failed: {errs[0]}")
+    base = f"http://{srv.url}"
+    classic = urlopen(f"{base}/metrics", timeout=10).read().decode()
+    om = urlopen(Request(f"{base}/metrics", headers={
+        "Accept": "application/openmetrics-text"}), timeout=10).read().decode()
+    if "tpu_decode_wave_seconds" not in classic:
+        sys.exit("tpu_decode_wave_seconds missing from /metrics")
+    with open(f"{out_dir}/metrics.txt", "w") as f:
+        f.write(classic)
+    with open(f"{out_dir}/metrics.om.txt", "w") as f:
+        f.write(om)
+    print("fused engine e2e ok: tpu_decode_wave_seconds rendered")
+finally:
+    srv.stop()
+    engine.shutdown()
+EOF
+[ $? -ne 0 ] && { echo "fused wave metrics e2e FAILED"; rc=1; }
+python tools/promlint.py "$KERNEL_DIR/metrics.txt" \
+    || { echo "promlint (kernel classic) FAILED"; rc=1; }
+python tools/promlint.py --openmetrics "$KERNEL_DIR/metrics.om.txt" \
+    || { echo "promlint (kernel openmetrics) FAILED"; rc=1; }
+rm -rf "$KERNEL_DIR"
+
+echo "=== stage 7/7: bench p99 regression gate ==="
 if [ -f BENCH_HISTORY.json ]; then
     python tools/bench_summary.py --check \
         || { echo "bench gate FAILED"; rc=1; }
