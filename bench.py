@@ -80,8 +80,8 @@ def _append_history(entry: dict) -> None:
 
 
 _SECTION_NAMES = ("simple", "gen_net", "seq_streaming", "ssd_net",
-                  "router", "autotune", "bert", "shm_ab", "shm_ab_large",
-                  "seq", "gen", "device_steady")
+                  "router", "autotune", "dlrm", "bert", "shm_ab",
+                  "shm_ab_large", "seq", "gen", "device_steady")
 
 
 def _sections_filter() -> set | None:
@@ -760,6 +760,121 @@ def bench_autotune(duration_s: float = 2.0):
                 on["pad_waste_device_s"] - off["pad_waste_device_s"], 6),
             "ips": round(on["ips"] - off["ips"], 2),
         },
+    }
+
+
+def bench_dlrm(window_s: float = 2.0):
+    """DLRM ragged-lookup probe: Zipf-skewed CSR bags through the
+    lookups-axis scheduler, three configurations of one fixed-seed model:
+
+    - ``device`` — device-resident tables (uncached): the ips/p99
+      headline, plus the lookup-bucket fill ratio (nnz / padded bucket);
+    - ``cached`` — host tables behind the hot-row LRU
+      (``engine/rowcache.py``): Zipf traffic concentrates on a small hot
+      set, so the recorded ``cache_hit_rate`` should be well above zero;
+    - ``sharded`` — 4-way row-sharded tables, recorded as a
+      bit-identical parity bit against the device oracle rather than
+      timed (off-TPU the shard_map runs interpreted; timing it measures
+      the interpreter, not the serving path).
+    """
+    import numpy as np
+
+    from client_tpu.engine import InferRequest, TpuEngine
+    from client_tpu.engine.repository import ModelRepository
+    from client_tpu.models.dlrm import DlrmBackend
+    from client_tpu.observability.profiler import reset_profiler
+
+    TABLE_ROWS, TABLES, SEED = 256, 4, 13
+    rng = np.random.default_rng(SEED)
+
+    def zipf_csr():
+        counts = rng.integers(1, 9, size=TABLES)
+        nnz = int(counts.sum())
+        # Zipf-skewed row ids: a few hot rows absorb most lookups, the
+        # DLRM serving traffic shape the hot-row cache exists for.
+        idx = ((rng.zipf(1.3, size=nnz) - 1) % TABLE_ROWS).astype(np.int32)
+        off = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+        dense = rng.standard_normal((1, 8)).astype(np.float32)
+        return {"DENSE": dense, "INDICES": idx, "OFFSETS": off}
+
+    pool = [zipf_csr() for _ in range(64)]
+
+    def phase(tag: str, **backend_kw) -> dict:
+        backend = DlrmBackend(name="dlrm_bench", table_rows=TABLE_ROWS,
+                              seed=SEED, max_lookups=256, **backend_kw)
+        repo = ModelRepository()
+        repo.register_backend(backend)
+        reset_profiler()
+        engine = TpuEngine(repo, warmup=True)
+        try:
+            cursor = [0]
+            lock = threading.Lock()
+
+            def infer():
+                with lock:
+                    i = cursor[0]
+                    cursor[0] += 1
+                engine.infer(InferRequest(
+                    model_name="dlrm_bench",
+                    inputs=dict(pool[i % len(pool)])), timeout_s=60)
+
+            res = run_stable_load(infer, concurrency=4,
+                                  window_s=window_s, tag=f"dlrm-{tag}")
+            psnap = engine.profile_snapshot(model="dlrm_bench")
+            pm = next(iter(psnap["models"].values()), None)
+            if pm is not None:
+                # "rows" on a lookups-axis model counts lookups; fill is
+                # real nnz over padded bucket slots.
+                nnz = sum(b["rows"] for b in pm["buckets"])
+                padded = sum(b["padded_rows"] for b in pm["buckets"])
+                res["fill_ratio"] = (round(nnz / (nnz + padded), 4)
+                                     if nnz + padded else 1.0)
+                res["lookup_buckets"] = [b["bucket"] for b in pm["buckets"]
+                                         if b["executions"]]
+            if backend.row_cache is not None:
+                res["cache_hit_rate"] = round(
+                    backend.row_cache.hit_rate(), 4)
+                res["cache"] = backend.row_cache.snapshot()
+            return res
+        finally:
+            engine.shutdown()
+            reset_profiler()
+
+    def sharded_parity():
+        import jax
+
+        if len(jax.devices()) < 4:
+            return None
+        from client_tpu.engine.model import Model
+
+        kw = dict(table_rows=TABLE_ROWS, seed=SEED, max_lookups=256)
+        oracle = Model(DlrmBackend(name="dlrm_oracle", **kw), jit=True)
+        shard = Model(DlrmBackend(name="dlrm_shard", emb_shards=4, **kw),
+                      jit=True)
+        inputs = pool[0]
+        nnz = int(inputs["INDICES"].shape[0])
+        o0, _ = oracle.execute_timed(dict(inputs), batch_size=nnz)
+        o1, _ = shard.execute_timed(dict(inputs), batch_size=nnz)
+        return bool(np.array_equal(o0["OUTPUT0"], o1["OUTPUT0"]))
+
+    log("dlrm probe: device-table phase (Zipf CSR, uncached)...")
+    device = phase("device")
+    log(f"dlrm device: {device['ips']} infer/s, p99 {device['p99_us']}us, "
+        f"lookup fill {device.get('fill_ratio')}")
+    log("dlrm probe: host-table + hot-row cache phase...")
+    cached = phase("cached", host_tables=True, cache_budget_bytes=1 << 13)
+    log(f"dlrm cached: {cached['ips']} infer/s, cache hit rate "
+        f"{cached.get('cache_hit_rate')}")
+    parity = sharded_parity()
+    log(f"dlrm sharded-vs-oracle bit-identical: {parity}")
+    return {
+        "ips": device["ips"],
+        "p99_us": device["p99_us"],
+        "fill_ratio": device.get("fill_ratio"),
+        "cache_hit_rate": cached.get("cache_hit_rate"),
+        "sharded_parity": parity,
+        "device": device,
+        "cached": cached,
     }
 
 
@@ -2040,6 +2155,19 @@ def _main():
         _RESULT["autotune"] = r
         _append_history({"probe": "autotune", **r})
 
+    def _rec_dlrm(r):
+        _RESULT["dlrm"] = r
+        _RESULT["dlrm_ips"] = r["ips"]
+        if r.get("cache_hit_rate") is not None:
+            # hoisted so the summary's efficiency line sees it per run
+            _RESULT["cache_hit_rate"] = r["cache_hit_rate"]
+        _append_history({"probe": "dlrm", "dlrm_ips": r["ips"],
+                         "p99_us": r["p99_us"],
+                         "fill_ratio": r.get("fill_ratio"),
+                         "cache_hit_rate": r.get("cache_hit_rate"),
+                         "sharded_parity": r.get("sharded_parity"),
+                         "dlrm": r})
+
     def _rec_router(r):
         _RESULT["router"] = r
         # Top-level p99 of the 2-replica point so bench_summary --check
@@ -2064,6 +2192,7 @@ def _main():
     _run_section("ssd_net", bench_ssd_net, _rec_ssd_net)
     _run_section("router", bench_router, _rec_router)
     _run_section("autotune", bench_autotune, _rec_autotune)
+    _run_section("dlrm", bench_dlrm, _rec_dlrm)
     bres = _run_section("bert", bench_bert_mfu, _rec_bert)
     bert_ips = bres["ips"] if bres else None
     mfu = bres["mfu"] if bres else None

@@ -273,7 +273,8 @@ class Autotuner:
         prefix = f"bucket:{name}:{version}:"
         self.arena.release_prefix(prefix)  # re-load replaces, idempotent
         self.arena.release(f"kv:{name}:{version}")
-        if model.config.max_batch_size > 0:
+        self.arena.release(f"rowcache:{name}:{version}")
+        if model.config.axis_capacity() > 0:
             for b in model.config.effective_buckets():
                 self._reserve_advisory(f"{prefix}{b}",
                                        self._bucket_nbytes(model, b),
@@ -287,7 +288,13 @@ class Autotuner:
             self._reserve_advisory(f"kv:{name}:{version}",
                                    int(arena_nbytes()), name, version,
                                    shards=shards)
-        if self._metrics is not None and model.config.max_batch_size > 0:
+        # A host-table embedding cache is HBM-adjacent working set the
+        # planner should see next to buckets and KV arenas.
+        cache = getattr(model.backend, "row_cache", None)
+        if cache is not None and cache.budget_bytes > 0:
+            self._reserve_advisory(f"rowcache:{name}:{version}",
+                                   int(cache.budget_bytes), name, version)
+        if self._metrics is not None and model.config.axis_capacity() > 0:
             self._metrics["ladder"].set(
                 float(len(model.config.effective_buckets())),
                 model=name, version=str(version))
@@ -305,6 +312,7 @@ class Autotuner:
     def on_model_unloaded(self, name: str) -> None:
         self.arena.release_prefix(f"bucket:{name}:")
         self.arena.release_prefix(f"kv:{name}:")
+        self.arena.release_prefix(f"rowcache:{name}:")
         with self._lock:
             for key in [k for k in self._cooldown if k[0] == name]:
                 del self._cooldown[key]
@@ -324,7 +332,7 @@ class Autotuner:
         for entry in snap.get("models", {}).values():
             name, version = entry["model"], entry["version"]
             sched = self.engine.scheduler_for(name, version)
-            if sched is None or sched.model.config.max_batch_size <= 0:
+            if sched is None or sched.model.config.axis_capacity() <= 0:
                 continue
             for sug in entry.get("suggestions") or []:
                 action = sug.get("action")
@@ -366,7 +374,7 @@ class Autotuner:
         candidate = int(sug["bucket"])
         ladder = sched.bucket_ladder()
         if candidate in ladder or not 1 <= candidate <= \
-                model.config.max_batch_size:
+                model.config.axis_capacity():
             return None
         if len(ladder) >= self.config.max_ladder:
             return None

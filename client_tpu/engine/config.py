@@ -35,6 +35,10 @@ class TensorConfig:
     reshape: list[int] | None = None
     is_shape_tensor: bool = False
     optional: bool = False
+    # Ragged tensors (DLRM CSR indices/offsets) carry their own variable
+    # leading dim instead of the implicit [-1] batch dim: the wire shape is
+    # exactly `dims` and per-request lengths differ even within one batch.
+    ragged: bool = False
 
     @classmethod
     def from_dict(cls, d: dict) -> "TensorConfig":
@@ -45,6 +49,7 @@ class TensorConfig:
             reshape=[int(x) for x in d["reshape"]["shape"]] if "reshape" in d else None,
             is_shape_tensor=bool(d.get("is_shape_tensor", False)),
             optional=bool(d.get("optional", False)),
+            ragged=bool(d.get("ragged", False)),
         )
 
 
@@ -135,6 +140,13 @@ class ModelConfig:
     # Batch buckets the engine pre-compiles; default = powers of two up to
     # max_batch_size. XLA needs static shapes, so off-bucket batches pad up.
     batch_buckets: list[int] | None = None
+    # Which quantity the bucket ladder pads: "rows" (default — batch rows,
+    # the Triton-style axis) or "lookups" (summed embedding-lookup nnz for
+    # ragged DLRM batches; rows still cap at max_batch_size but the ladder,
+    # profiler fill, and autotuner all count lookups).
+    padding_axis: str = "rows"
+    # Ladder ceiling along the lookups axis (ignored for "rows").
+    max_lookups: int = 0
     parameters: dict[str, Any] = field(default_factory=dict)
 
     def scheduler_kind(self) -> str:
@@ -150,16 +162,24 @@ class ModelConfig:
             return "DYNAMIC"
         return "NONE"
 
+    def axis_capacity(self) -> int:
+        """Ladder ceiling along the declared padding axis: max_lookups for
+        lookup-bucketed models, max_batch_size otherwise."""
+        if self.padding_axis == "lookups":
+            return self.max_lookups
+        return self.max_batch_size
+
     def effective_buckets(self) -> list[int]:
-        if self.max_batch_size <= 0:
+        cap = self.axis_capacity()
+        if cap <= 0:
             return [0]
         if self.batch_buckets:
             return sorted(set(int(b) for b in self.batch_buckets))
         buckets, b = [], 1
-        while b < self.max_batch_size:
+        while b < cap:
             buckets.append(b)
             b *= 2
-        buckets.append(self.max_batch_size)
+        buckets.append(cap)
         return buckets
 
     @classmethod
@@ -226,13 +246,18 @@ class ModelConfig:
             decoupled=decoupled,
             version=int(d.get("version", 1)),
             batch_buckets=[int(b) for b in d["batch_buckets"]] if d.get("batch_buckets") else None,
+            padding_axis=str(d.get("padding_axis", "rows")),
+            max_lookups=int(d.get("max_lookups", 0)),
             parameters=dict(d.get("parameters", {})),
         )
 
     def metadata_dict(self, versions: list[str] | None = None) -> dict:
         """v2 model-metadata JSON (GET /v2/models/<name>)."""
         def io_md(tc: TensorConfig) -> dict:
-            dims = ([-1] if self.max_batch_size > 0 else []) + list(tc.dims)
+            # Ragged tensors own their variable leading dim — no implicit
+            # batch dim is prepended.
+            dims = (([-1] if self.max_batch_size > 0 and not tc.ragged
+                     else []) + list(tc.dims))
             return {"name": tc.name, "datatype": tc.data_type, "shape": dims}
 
         return {
@@ -251,7 +276,9 @@ class ModelConfig:
             "backend": self.platform,
             "max_batch_size": self.max_batch_size,
             "input": [
-                {"name": t.name, "data_type": f"TYPE_{t.data_type}", "dims": t.dims}
+                {"name": t.name, "data_type": f"TYPE_{t.data_type}",
+                 "dims": t.dims,
+                 **({"ragged": True} if t.ragged else {})}
                 for t in self.input
             ],
             "output": [
@@ -259,6 +286,9 @@ class ModelConfig:
                 for t in self.output
             ],
         }
+        if self.padding_axis != "rows":
+            out["padding_axis"] = self.padding_axis
+            out["max_lookups"] = self.max_lookups
         if self.dynamic_batching is not None:
             db = self.dynamic_batching
             out["dynamic_batching"] = {

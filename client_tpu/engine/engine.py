@@ -302,6 +302,18 @@ class TpuEngine:
         for sched in retired:
             if id(sched) not in still_referenced:
                 sched.stop()
+        # Host-table backends carry a hot-row cache: every explicit load
+        # invalidates it (the repository was re-polled — weights may have
+        # changed, and stale vectors are a correctness bug, not a perf
+        # one); newly built backends additionally bind their tpu_emb_*
+        # metrics to this engine's registry.
+        for _v, model in sorted(versions.items()):
+            cache = getattr(model.backend, "row_cache", None)
+            if cache is not None:
+                if model in new_models:
+                    cache.bind_metrics(self.metrics.registry, name,
+                                       model.config.version)
+                cache.clear()
         for model in new_models:
             self.events.emit("model", "load", model=name,
                              version=model.config.version)
@@ -330,6 +342,9 @@ class TpuEngine:
             if id(sched) not in seen:
                 seen.add(id(sched))
                 sched.stop()
+                cache = getattr(sched.model.backend, "row_cache", None)
+                if cache is not None:
+                    cache.clear()
         versions = sorted(k.rsplit(":", 1)[1] for k in keys if ":" in k)
         if popped:
             self.events.emit("model", "unload", model=name,
@@ -728,6 +743,19 @@ class TpuEngine:
         (``applied``/``suggested``) and the snapshot gains an
         ``autotune`` section (config, arena layout, recent decisions)."""
         snap = self.profiler.snapshot(model=model)
+        # Per-model memory + cache annotations: placement and capacity
+        # tooling read reservations from here without loading backends.
+        for entry in snap.get("models", {}).values():
+            sched = self.scheduler_for(entry["model"], entry["version"])
+            if sched is None:
+                continue
+            backend = sched.model.backend
+            hbm = getattr(backend, "hbm_reservation_bytes", None)
+            if callable(hbm):
+                entry["hbm_bytes"] = int(hbm())
+            cache = getattr(backend, "row_cache", None)
+            if cache is not None:
+                entry["row_cache"] = cache.snapshot()
         if self.autotuner is not None:
             self.autotuner.annotate(snap)
         return snap

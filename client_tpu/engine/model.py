@@ -215,7 +215,7 @@ class Model:
                     f"input '{name}': dtype {arr.dtype} != declared "
                     f"{tc.data_type}")
             shape = list(arr.shape)
-            if cfg.max_batch_size > 0 and batched:
+            if cfg.max_batch_size > 0 and batched and not tc.ragged:
                 if len(shape) != len(dims) + 1:
                     raise EngineError(
                         f"input '{name}': expected batched rank {len(dims)+1}, "
@@ -234,13 +234,21 @@ class Model:
             raise EngineError(
                 f"batch size {batch} exceeds max_batch_size "
                 f"{cfg.max_batch_size} for '{cfg.name}'")
+        # Ragged backends (DLRM CSR) check cross-tensor structure the
+        # per-tensor loop can't see: offsets monotonicity, nnz ceilings,
+        # offsets/indices length agreement.
+        check = getattr(self.backend, "validate_ragged", None)
+        if check is not None and batched:
+            check(inputs, batch)
         return batch
 
     def pick_bucket(self, batch: int) -> int:
+        """Smallest ladder bucket covering ``batch`` units along the
+        model's padding axis (rows, or summed lookups for ragged DLRM)."""
         for b in self.config.effective_buckets():
             if b >= batch:
                 return b
-        return self.config.max_batch_size
+        return self.config.axis_capacity()
 
     # -- execution ----------------------------------------------------------
 
@@ -303,12 +311,21 @@ class Model:
             raise EngineError(str(exc), exc.status or 503) from None
         cfg = self.config
         phases = ExecPhases(start=now_ns())
-        if pad_to is None and cfg.max_batch_size > 0 \
+        if pad_to is None and cfg.axis_capacity() > 0 \
                 and batch_size is not None:
             pad_to = self.pick_bucket(batch_size)
 
         try:
             self._set_state(f"staging inputs (bucket={pad_to})")
+            # Ragged backends own their padding: the generic row-pad below
+            # would stretch every tensor's leading dim to the *lookup*
+            # bucket, which is only right for the indices tensor. The hook
+            # converts CSR {indices, offsets} into the model's static-shape
+            # device layout (padded indices + segment ids, rows padded to
+            # max_batch_size) and nothing downstream pads again.
+            pre_stage = getattr(self.backend, "pre_stage", None)
+            if pre_stage is not None:
+                inputs = pre_stage(inputs, pad_to)
             # Multi-chip backends declare per-input shardings (e.g. batch
             # over "dp"); device_put then scatters straight onto the mesh
             # and GSPMD propagates layouts from there (parallel/serving.py).
@@ -318,7 +335,8 @@ class Model:
                 if arr.dtype == np.object_ or not self._jitted:
                     staged[name] = arr  # BYTES / host models stay host-side
                     continue
-                if pad_to is not None and arr.shape[0] < pad_to:
+                if pre_stage is None and pad_to is not None \
+                        and arr.shape[0] < pad_to:
                     pad_width = [(0, pad_to - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
                     if isinstance(arr, self._jax.Array):
                         # device-resident (tpu-shm region): pad on device,
@@ -372,7 +390,8 @@ class Model:
                 _log.info("model '%s': compiled bucket=%s in %.1fs",
                           cfg.name, pad_to, phases.compile_ns / 1e9)
                 _profiler().record_compile(
-                    cfg.name, cfg.version, pad_to, phases.compile_ns)
+                    cfg.name, cfg.version, pad_to, phases.compile_ns,
+                    axis=cfg.padding_axis)
             phases.infer_end = now_ns()
             self._set_state("fetching outputs")
             host: dict[str, np.ndarray] = {}
@@ -385,7 +404,12 @@ class Model:
                     host[name] = val
                     continue
                 arr = self._fetch_host(val)
+                # Lookup-bucketed models pad the *lookup* axis; output rows
+                # are already exact (the backend padded rows statically), so
+                # slicing to batch_size==nnz here would corrupt them whenever
+                # a row count collides with a lookup bucket.
                 if pad_to is not None and batch_size is not None \
+                        and cfg.padding_axis == "rows" \
                         and arr.ndim >= 1 and arr.shape[0] == pad_to:
                     arr = arr[:batch_size]
                 host[name] = arr
@@ -400,7 +424,8 @@ class Model:
                 device_ns=phases.infer_end - phases.input_end,
                 host_ns=(phases.input_end - phases.start)
                 + (phases.output_end - phases.infer_end),
-                cold=bool(phases.compile_ns))
+                cold=bool(phases.compile_ns),
+                axis=cfg.padding_axis)
             return host, phases
         finally:
             # Always clear: a raise mid-compile must not leave a stale
@@ -458,39 +483,48 @@ class Model:
         measured compile seconds (0.0 when the shape was already cached
         or the model can't take dummy zeros, e.g. BYTES inputs)."""
         cfg = self.config
-        if self._apply is None or cfg.max_batch_size <= 0:
+        cap = cfg.axis_capacity()
+        if self._apply is None or cap <= 0:
             return 0.0
         bucket = int(bucket)
-        if not 1 <= bucket <= cfg.max_batch_size:
+        if not 1 <= bucket <= cap:
             raise EngineError(
-                f"bucket {bucket} out of range 1..{cfg.max_batch_size} "
+                f"bucket {bucket} out of range 1..{cap} "
                 f"for model '{cfg.name}'")
-        inputs = {}
-        for tc in cfg.input:
-            if tc.data_type == "BYTES":
-                return 0.0  # zeros can't stand in for string inputs
-            dims = [d if d != -1 else 1 for d in tc.dims]
-            inputs[tc.name] = np.zeros(
-                [bucket] + dims, dtype=wire_to_np_dtype(tc.data_type))
+        synth = getattr(self.backend, "synthetic_inputs", None)
+        if synth is not None:
+            # Ragged backends build their own dummy batch for a lookup
+            # bucket — generic [bucket]+dims zeros have the wrong axis.
+            inputs = synth(bucket)
+        else:
+            inputs = {}
+            for tc in cfg.input:
+                if tc.data_type == "BYTES":
+                    return 0.0  # zeros can't stand in for string inputs
+                dims = [d if d != -1 else 1 for d in tc.dims]
+                inputs[tc.name] = np.zeros(
+                    [bucket] + dims, dtype=wire_to_np_dtype(tc.data_type))
         _, phases = self.execute_timed(
             inputs, batch_size=bucket, pad_to=bucket, synthetic=True)
         return phases.compile_ns / 1e9
 
     def swap_buckets(self, buckets: list[int]) -> list[int]:
-        """Atomically replace the batch-bucket ladder. The new ladder is
-        deduplicated, clamped to ``1..max_batch_size``, and always keeps
-        ``max_batch_size`` itself so ``pick_bucket`` covers every legal
-        batch. Safe concurrent with in-flight executions: readers see
-        either the old or the new list (reference assignment), and a
-        batch that already picked a retired bucket still runs — its
-        executable stays in the jit cache. Returns the ladder applied."""
+        """Atomically replace the bucket ladder. The new ladder is
+        deduplicated, clamped to ``1..axis_capacity()`` (max_batch_size,
+        or max_lookups for lookup-bucketed models), and always keeps the
+        capacity itself so ``pick_bucket`` covers every legal batch. Safe
+        concurrent with in-flight executions: readers see either the old
+        or the new list (reference assignment), and a batch that already
+        picked a retired bucket still runs — its executable stays in the
+        jit cache. Returns the ladder applied."""
         cfg = self.config
-        if cfg.max_batch_size <= 0:
+        cap = cfg.axis_capacity()
+        if cap <= 0:
             raise EngineError(
                 f"model '{cfg.name}' is unbatched; no bucket ladder")
         new = sorted({int(b) for b in buckets
-                      if 1 <= int(b) <= cfg.max_batch_size}
-                     | {cfg.max_batch_size})
+                      if 1 <= int(b) <= cap}
+                     | {cap})
         cfg.batch_buckets = new
         return new
 
@@ -502,21 +536,26 @@ class Model:
             return
         _log.info("model '%s': warmup over buckets %s",
                   cfg.name, cfg.effective_buckets())
+        synth = getattr(self.backend, "synthetic_inputs", None)
         for bucket in cfg.effective_buckets():
-            inputs = {}
-            for tc in cfg.input:
-                if tc.data_type == "BYTES":
+            if synth is not None:
+                inputs = synth(max(bucket, 1))
+            else:
+                inputs = {}
+                for tc in cfg.input:
+                    if tc.data_type == "BYTES":
+                        continue
+                    dims = [d if d != -1 else 1 for d in tc.dims]
+                    shape = ([bucket] if cfg.max_batch_size > 0 else []) + dims
+                    inputs[tc.name] = np.zeros(
+                        shape, dtype=wire_to_np_dtype(tc.data_type))
+                if len(inputs) < len([t for t in cfg.input
+                                      if t.data_type != "BYTES"]):
                     continue
-                dims = [d if d != -1 else 1 for d in tc.dims]
-                shape = ([bucket] if cfg.max_batch_size > 0 else []) + dims
-                inputs[tc.name] = np.zeros(
-                    shape, dtype=wire_to_np_dtype(tc.data_type))
-            if len(inputs) < len([t for t in cfg.input if t.data_type != "BYTES"]):
-                continue
             try:
                 self.execute_timed(
                     inputs,
-                    batch_size=bucket if cfg.max_batch_size > 0 else None,
+                    batch_size=bucket if cfg.axis_capacity() > 0 else None,
                     synthetic=True)
             except EngineError:
                 raise

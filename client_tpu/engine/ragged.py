@@ -1,0 +1,157 @@
+"""Ragged-batch scheduling: micro-batch by summed lookup count.
+
+DLRM embedding requests are CSR bags (``indices``/``offsets``): their
+device cost scales with the total number of embedding lookups (nnz), not
+the number of batch rows — a 4-row request with 200 lookups costs more
+than a 64-row request with 64.  The :class:`RaggedScheduler` therefore
+gathers requests until the *summed nnz* reaches the preferred lookup
+bucket (``ModelConfig.max_lookups``, ``padding_axis="lookups"``), while
+still capping rows at ``max_batch_size`` (the dense features and outputs
+are row-shaped).  A request that would overflow either ceiling is pushed
+back to the head of its queue level and starts the next batch — the same
+split-don't-drop guard the generative wave scheduler applies when a
+decode wave overflows its largest bucket.
+
+Everything downstream is the ordinary bucket machinery, re-read along
+the lookups axis: ``Model.pick_bucket`` snaps the summed nnz to the
+ladder, the backend's ``pre_stage`` hook pads indices/segment-ids up to
+the bucket (rows pad statically to ``max_batch_size`` so lookups stay
+the only variable device axis), and the profiler's fill-ratio /
+padded-rows / autotune suggestions work unchanged because "rows" in its
+accounting simply means lookups here (tagged ``axis="lookups"`` so
+renderers don't misread a 512-lookup bucket as a 512-row batch).
+"""
+
+from __future__ import annotations
+
+import queue
+
+import numpy as np
+
+from client_tpu.engine.model import Model
+from client_tpu.engine.scheduler import (
+    _SHUTDOWN,
+    _SHUTDOWN_LEVEL,
+    DefaultScheduler,
+    _request_batch,
+)
+from client_tpu.engine.stats import ModelStats
+from client_tpu.engine.types import InferRequest, now_ns
+
+
+def request_nnz(req: InferRequest, indices_name: str) -> int:
+    """Total lookups a request contributes: the length of its indices."""
+    arr = req.inputs.get(indices_name)
+    return int(arr.shape[0]) if arr is not None else 0
+
+
+class RaggedScheduler(DefaultScheduler):
+    """Dynamic batching over summed lookup count (see module docstring).
+
+    The backend names its CSR tensors via ``indices_name`` /
+    ``offsets_name`` attributes (defaults ``INDICES`` / ``OFFSETS``);
+    every other input is row-shaped and concatenates along axis 0 as
+    usual.
+    """
+
+    def __init__(self, model: Model, stats: ModelStats):
+        self._indices = getattr(model.backend, "indices_name", "INDICES")
+        self._offsets = getattr(model.backend, "offsets_name", "OFFSETS")
+        super().__init__(model, stats)
+
+    def _gather(self, first: InferRequest, dyn) -> list[InferRequest]:
+        cfg = self.model.config
+        max_lookups = cfg.max_lookups
+        max_rows = cfg.max_batch_size
+        prefer = (max(dyn.preferred_batch_size)
+                  if dyn.preferred_batch_size else max_lookups)
+        prefer = min(prefer, max_lookups)
+        deadline_ns = now_ns() + dyn.max_queue_delay_microseconds * 1000
+        batch = [first]
+        nnz = request_nnz(first, self._indices)
+        rows = _request_batch(first)
+        while nnz < prefer:
+            timeout = max((deadline_ns - now_ns()) / 1e9, 0.0)
+            try:
+                # Lookups per request vary wildly (Zipf traffic), so the
+                # slab size is row-bounded: at most the rows still legal.
+                items = self.queue.get_many(max(1, max_rows - rows),
+                                            timeout=timeout)
+            except queue.Empty:
+                break
+            stop = False
+            for idx, item in enumerate(items):
+                if item is _SHUTDOWN:
+                    for _ in items[idx:]:
+                        self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)
+                    stop = True
+                    break
+                nxt: InferRequest = item
+                if self._check_timeout(nxt) or self._check_cancelled(nxt) \
+                        or self._check_deadline(nxt) \
+                        or self._check_dequeue_fault(nxt):
+                    continue
+                if nnz >= prefer \
+                        or nnz + request_nnz(nxt, self._indices) > max_lookups \
+                        or rows + _request_batch(nxt) > max_rows:
+                    # Either ceiling would overflow: this request (and
+                    # everything behind it) starts the NEXT batch — pushed
+                    # back to the head of its level in reverse so FIFO
+                    # order survives, exactly like the row gatherer.
+                    for later in reversed(items[idx:]):
+                        if later is _SHUTDOWN:
+                            self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)
+                        else:
+                            self.queue.put_front(
+                                later, self._priority_level(later))
+                    stop = True
+                    break
+                batch.append(nxt)
+                nnz += request_nnz(nxt, self._indices)
+                rows += _request_batch(nxt)
+            if stop:
+                break
+        return batch
+
+    def _execute_batch_inner(self, batch: list[InferRequest]) -> None:
+        start = now_ns()
+        for r in batch:
+            r.times.compute_start = start
+        deadline_ns = 0 if any(r.deadline_ns == 0 for r in batch) \
+            else max(r.deadline_ns for r in batch)
+
+        row_sizes = [_request_batch(r) for r in batch]
+        total_rows = sum(row_sizes)
+        total_nnz = sum(request_nnz(r, self._indices) for r in batch)
+        merged: dict[str, np.ndarray] = {}
+        for name in batch[0].inputs:
+            if name == self._offsets:
+                # CSR offsets rebase under concatenation: each request's
+                # offsets restart at 0, so the merged array is the cumsum
+                # of the per-bag counts with one shared leading zero.
+                counts = [np.diff(np.asarray(r.inputs[name], np.int64))
+                          for r in batch]
+                merged[name] = np.concatenate(
+                    [np.zeros(1, np.int64)] + counts).cumsum().astype(
+                        batch[0].inputs[name].dtype)
+            else:
+                merged[name] = (batch[0].inputs[name] if len(batch) == 1
+                                else np.concatenate(
+                                    [np.asarray(r.inputs[name])
+                                     for r in batch], axis=0))
+        # batch_size counts LOOKUPS here: pick_bucket snaps it to the
+        # lookup ladder and the profiler's fill evidence is nnz/bucket.
+        outputs, phases = self.model.execute_timed(
+            merged, batch_size=total_nnz, deadline_ns=deadline_ns)
+        # Engine-facing stats keep ROW semantics (inference_count is
+        # requests' rows, same as every other scheduler).
+        self.stats.record_execution(
+            total_rows, compute_ns=phases.infer_end - phases.input_end)
+        # Outputs are row-shaped (the backend pads rows statically to
+        # max_batch_size; rows past total_rows are padding junk): window
+        # each request's rows by ROW offset, not lookup offset.
+        offset = 0
+        for r, sz in zip(batch, row_sizes):
+            per = {k: v[offset:offset + sz] for k, v in outputs.items()}
+            offset += sz
+            self._finish(r, per, phases)

@@ -1,0 +1,176 @@
+"""Hot-row embedding cache: host-side LRU over a table's hot rows.
+
+DLRM serving traffic is heavily Zipf-skewed — a few percent of each
+embedding table's rows absorb most lookups ("Dissecting Embedding Bag
+Performance in DLRM Inference", PAPERS.md).  When a :class:`DlrmBackend`
+keeps its tables host-resident (``host_tables=True`` — tables too large
+for HBM, or HBM reserved for other models), every batch's lookups resolve
+through this cache before staging: hot rows come from the cache's packed
+store, cold rows fault in from the backing table and evict
+least-recently-used entries.  The device then receives dense, already-
+gathered vectors — the gather never burns device time or HBM capacity.
+
+The cache is **arena-budgeted**: its byte budget is a named reservation
+in the engine's :class:`~client_tpu.engine.arena.ArenaAllocator`
+(``rowcache:{model}:{version}``) so capacity planning sees it next to
+bucket I/O and KV reservations, and **invalidated on model load/unload**
+— a reloaded version may carry new weights, so serving stale vectors
+across a reload is a correctness bug, not a performance one.
+
+Metrics (bound per engine registry, see OBSERVABILITY.md):
+
+- ``tpu_emb_lookups_total{model,version}`` — rows resolved through the
+  cache (one count per lookup, hit or miss);
+- ``tpu_emb_cache_hits_total{model,version}`` — lookups served from the
+  cache without touching the backing table;
+- ``tpu_emb_cache_size_bytes{model,version}`` — current resident bytes
+  (rows held × row bytes), sampled on every lookup batch.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+
+class RowCache:
+    """Per-table LRU of hot embedding rows (see module docstring).
+
+    ``table`` is the host-resident backing store (``[rows, dim]``,
+    typically the stacked multi-table matrix of a DLRM backend);
+    ``budget_bytes`` bounds the resident vector bytes (0 disables
+    caching — every lookup faults through to the table).
+    """
+
+    def __init__(self, table: np.ndarray, budget_bytes: int = 0):
+        if table.ndim != 2:
+            raise ValueError(f"backing table must be 2-D, got {table.shape}")
+        self._table = table
+        self.row_bytes = int(table.shape[1]) * int(table.itemsize)
+        self.capacity_rows = (max(1, int(budget_bytes) // self.row_bytes)
+                              if budget_bytes > 0 else 0)
+        self.budget_bytes = int(budget_bytes)
+        self._lock = threading.Lock()
+        # row id -> vector copy; OrderedDict recency order (LRU at head).
+        self._rows: OrderedDict[int, np.ndarray] = OrderedDict()
+        # Cumulative counters (monotonic — the bound Prometheus counters
+        # must never go backwards, so clear() leaves these alone).
+        self.lookups = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self._bound: list = []  # (registry_id, counters...) bindings
+
+    # -- metrics --------------------------------------------------------------
+
+    def bind_metrics(self, registry, model: str, version) -> None:
+        """Declare/bind the ``tpu_emb_*`` families on an engine registry;
+        every later lookup batch mirrors its deltas into them."""
+        labels = {"model": str(model), "version": str(version)}
+        self._bound.append((
+            registry.counter(
+                "tpu_emb_lookups_total",
+                "Embedding rows resolved through the hot-row cache",
+                ("model", "version")),
+            registry.counter(
+                "tpu_emb_cache_hits_total",
+                "Embedding lookups served from the hot-row cache",
+                ("model", "version")),
+            registry.gauge(
+                "tpu_emb_cache_size_bytes",
+                "Resident bytes of the hot-row embedding cache",
+                ("model", "version")),
+            labels,
+        ))
+        for _lk, _h, size_g, lab in self._bound:
+            size_g.set(self.size_bytes(), **lab)
+
+    def _record(self, lookups: int, hits: int) -> None:
+        size = self.size_bytes()
+        for lk, h, size_g, lab in self._bound:
+            if lookups:
+                lk.inc(lookups, **lab)
+            if hits:
+                h.inc(hits, **lab)
+            size_g.set(size, **lab)
+
+    # -- cache ops ------------------------------------------------------------
+
+    def size_bytes(self) -> int:
+        return len(self._rows) * self.row_bytes
+
+    def hit_rate(self) -> float:
+        return self.hits / self.lookups if self.lookups else 0.0
+
+    def lookup(self, rows: np.ndarray) -> np.ndarray:
+        """Resolve ``rows`` (int array) to their vectors ``[len(rows),
+        dim]``; see :meth:`lookup_counted` for the accounting."""
+        out, _ = self.lookup_counted(rows)
+        return out
+
+    def lookup_counted(self, rows: np.ndarray) -> tuple[np.ndarray, int]:
+        """Resolve ``rows`` to their vectors ``[len(rows), dim]`` and
+        return this batch's hit count.  Hot rows come from the cache;
+        cold rows read the backing table, are inserted, and evict LRU
+        entries past capacity.  Duplicate rows in one batch count one
+        lookup each (hit/miss is per LOOKUP — the serving cost — so 64
+        lookups of one hot row are 64 hits) but fault at most once."""
+        rows = np.asarray(rows)
+        n = int(rows.shape[0])
+        out = np.empty((n, self._table.shape[1]), dtype=self._table.dtype)
+        if n == 0:
+            return out, 0
+        uniq, inverse = np.unique(rows, return_inverse=True)
+        counts = np.bincount(inverse, minlength=len(uniq))
+        gathered = np.empty((len(uniq), self._table.shape[1]),
+                            dtype=self._table.dtype)
+        hits = 0
+        with self._lock:
+            for i, r in enumerate(uniq):
+                r = int(r)
+                vec = self._rows.get(r)
+                if vec is not None:
+                    self._rows.move_to_end(r)
+                    gathered[i] = vec
+                    hits += int(counts[i])
+                    continue
+                vec = np.array(self._table[r])
+                gathered[i] = vec
+                if self.capacity_rows > 0:
+                    self._rows[r] = vec
+                    while len(self._rows) > self.capacity_rows:
+                        self._rows.popitem(last=False)
+                        self.evictions += 1
+            self.lookups += n
+            self.hits += hits
+            self.misses += n - hits
+        out[:] = gathered[inverse]
+        self._record(n, hits)
+        return out, hits
+
+    def clear(self) -> None:
+        """Invalidate every resident row (model load/unload: the backing
+        weights may have changed).  Counters stay monotonic; the size
+        gauge drops to zero."""
+        with self._lock:
+            self._rows.clear()
+            self.invalidations += 1
+        self._record(0, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "capacity_rows": self.capacity_rows,
+                "resident_rows": len(self._rows),
+                "size_bytes": self.size_bytes(),
+                "budget_bytes": self.budget_bytes,
+                "lookups": self.lookups,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+                "hit_rate": round(self.hit_rate(), 4),
+            }

@@ -211,8 +211,9 @@ class Scheduler:
     # -- bucket ladder (autotuner surface) ------------------------------------
 
     def bucket_ladder(self) -> list[int]:
-        """The model's current batch-bucket ladder ([] for unbatched)."""
-        if self.model.config.max_batch_size <= 0:
+        """The model's current bucket ladder along its padding axis
+        (rows, or lookups for ragged models; [] for unbatched)."""
+        if self.model.config.axis_capacity() <= 0:
             return []
         return self.model.config.effective_buckets()
 
@@ -779,4 +780,9 @@ def make_scheduler(model: Model, stats: ModelStats,
 
             return GenerativeScheduler(model, stats)
         return DecoupledScheduler(model, stats)
+    if model.config.padding_axis == "lookups":
+        # Ragged DLRM batching: gather by summed lookup count, not rows.
+        from client_tpu.engine.ragged import RaggedScheduler
+
+        return RaggedScheduler(model, stats)
     return DefaultScheduler(model, stats)

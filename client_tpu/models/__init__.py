@@ -61,7 +61,7 @@ def build_repository(names: list[str] | None = None,
 def _import_all() -> None:
     from client_tpu.models import simple  # noqa: F401
 
-    for mod in ("vision", "bert", "ssd", "ensembles", "generate"):
+    for mod in ("vision", "bert", "ssd", "ensembles", "generate", "dlrm"):
         try:
             __import__(f"client_tpu.models.{mod}")
         except ImportError:

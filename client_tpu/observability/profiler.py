@@ -87,6 +87,11 @@ class _BucketCost:
     compile_count: int = 0
     compile_ns: int = 0
     max_rows: int = 0
+    # Which quantity the bucket pads: "rows" (default) or "lookups"
+    # (ragged DLRM — ``rows`` above then counts summed lookups, and the
+    # fill/suggestion math is identical; only renderers need the tag so a
+    # 512-lookup bucket isn't misread as a 512-row batch).
+    axis: str = "rows"
     # Recency tracking for retire suggestions: a two-window rotation gives
     # an O(1)-per-call sliding call rate (a timestamp deque would cost
     # memory proportional to call rate — thousands/s under load). The
@@ -237,13 +242,16 @@ class EfficiencyProfiler:
 
     def record_execution(self, model: str, version, bucket: int | None,
                          rows: int, device_ns: int, host_ns: int = 0,
-                         cold: bool = False) -> None:
-        """One device execution: ``rows`` real rows padded up to
+                         cold: bool = False, axis: str = "rows") -> None:
+        """One device execution: ``rows`` real units padded up to
         ``bucket`` (None/0 = unbatched model, no padding), taking
         ``device_ns`` in the executable and ``host_ns`` in staging+fetch.
-        ``cold=True`` (first call, XLA traced) keeps the call/row counts
-        but excludes the interval from device-time accumulation — it is
-        compile, not load, and is accounted by :meth:`record_compile`."""
+        ``axis`` names the padded unit — batch "rows" (default) or summed
+        embedding "lookups" for ragged models; the accounting is the same,
+        renderers use the tag. ``cold=True`` (first call, XLA traced)
+        keeps the call/row counts but excludes the interval from
+        device-time accumulation — it is compile, not load, and is
+        accounted by :meth:`record_compile`."""
         key = (str(model), str(version), int(bucket or 0))
         rows = max(0, int(rows))
         padded = max(0, key[2] - rows) if key[2] else 0
@@ -252,6 +260,7 @@ class EfficiencyProfiler:
             c = self._costs.get(key)
             if c is None:
                 c = self._costs[key] = _BucketCost()
+            c.axis = axis
             c.calls += 1
             c.rows += rows
             c.padded_rows += padded
@@ -283,14 +292,20 @@ class EfficiencyProfiler:
                                      model=key[0], version=key[1])
 
     def record_compile(self, model: str, version, bucket: int | None,
-                       compile_ns: int, trace_id: str | None = None) -> None:
+                       compile_ns: int, trace_id: str | None = None,
+                       axis: str = "rows") -> None:
         """A first-call XLA trace finished: count it, observe its
-        duration, and journal ``compile.finished``."""
+        duration, and journal ``compile.finished``. ``axis`` tags the
+        bucket's padded unit up front — warmup/tuner compiles are
+        synthetic (no ``record_execution`` follows), so without it a
+        warm-compiled lookup bucket would sit mislabelled "rows" until
+        real traffic landed on it."""
         key = (str(model), str(version), int(bucket or 0))
         with self._lock:
             c = self._costs.get(key)
             if c is None:
                 c = self._costs[key] = _BucketCost()
+            c.axis = axis
             c.compile_count += 1
             c.compile_ns += max(0, compile_ns)
         for b in self._bindings():
@@ -409,6 +424,7 @@ class EfficiencyProfiler:
             entry["compile_s"] += c.compile_ns / 1e9
             entry["buckets"].append({
                 "bucket": bucket,
+                "axis": c.axis,
                 "executions": c.calls,
                 "cold_executions": c.cold_calls,
                 "rows": c.rows,

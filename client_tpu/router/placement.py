@@ -24,20 +24,36 @@ __all__ = ["model_costs", "plan_placement", "placement_moves",
            "apply_placement"]
 
 
-def model_costs(profiles: dict[str, dict]) -> dict[str, float]:
+HBM_WEIGHT_S_PER_GB = 10.0
+
+
+def model_costs(profiles: dict[str, dict],
+                hbm_weight_s_per_gb: float = HBM_WEIGHT_S_PER_GB,
+                ) -> dict[str, float]:
     """Fleet-wide per-model contention cost from ``/v2/profile`` bodies:
     device-seconds summed across replicas and versions (device time is
-    the resource replicas contend on). Models that have never executed
-    cost a nominal epsilon so they still get spread out."""
-    costs: dict[str, float] = {}
+    the resource replicas contend on), plus an HBM term — each model's
+    reported ``hbm_bytes`` reservation (embedding tables, KV arenas)
+    weighted at ``hbm_weight_s_per_gb`` device-seconds per GiB. Memory is
+    a *capacity*, not a rate: one copy's reservation is taken (max across
+    replicas, not summed), so LPT spreads two table-heavy models onto
+    different replicas even when both are idle. Models that have never
+    executed and reserve nothing cost a nominal epsilon so they still
+    get spread out."""
+    device_s: dict[str, float] = {}
+    hbm_bytes: dict[str, float] = {}
     for prof in profiles.values():
         for entry in (prof.get("models") or {}).values():
             name = entry.get("model")
             if not name:
                 continue
-            costs[name] = costs.get(name, 0.0) + float(
+            device_s[name] = device_s.get(name, 0.0) + float(
                 entry.get("device_s", 0.0) or 0.0)
-    return {m: (c if c > 0 else 1e-6) for m, c in costs.items()}
+            hbm_bytes[name] = max(hbm_bytes.get(name, 0.0), float(
+                entry.get("hbm_bytes", 0) or 0))
+    return {m: (c + hbm_bytes[m] / (1 << 30) * hbm_weight_s_per_gb
+                if c + hbm_bytes[m] > 0 else 1e-6)
+            for m, c in device_s.items()}
 
 
 def plan_placement(costs: dict[str, float], replica_ids: list[str],

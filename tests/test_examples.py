@@ -26,7 +26,7 @@ def servers():
         "simple", "simple_string", "simple_identity", "simple_sequence",
         "simple_int8", "simple_repeat", "resnet50", "image_preprocess",
         "ensemble_image",
-        "ssd_mobilenet_v2_coco_quantized", "tiny_gpt",
+        "ssd_mobilenet_v2_coco_quantized", "tiny_gpt", "dlrm",
     ]))
     http_srv = HttpInferenceServer(eng, port=0).start()
     grpc_srv = GrpcInferenceServer(eng, port=0).start()
@@ -113,6 +113,25 @@ def test_reuse_infer_objects(servers):
          "-n", "5"],
         capture_output=True, text=True, timeout=300, env=env)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_dlrm_client_both_transports(servers):
+    """The ragged CSR client runs over HTTP and gRPC and the printed
+    scores (deterministic weights, static buckets) match exactly."""
+    http_srv, grpc_srv = servers
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    scores = {}
+    for proto, url in (("http", http_srv.url),
+                       ("grpc", f"127.0.0.1:{grpc_srv.port}")):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(EXAMPLES_DIR, "dlrm_client.py"),
+             "-u", url, "-i", proto],
+            capture_output=True, text=True, timeout=300, env=env)
+        assert proc.returncode == 0, f"{proto}: {proc.stdout}{proc.stderr}"
+        assert f"PASS: dlrm ({proto})" in proc.stdout, proc.stdout
+        scores[proto] = [line for line in proc.stdout.splitlines()
+                         if line.startswith("scores[")]
+    assert scores["http"] and scores["http"] == scores["grpc"]
 
 
 def test_memory_growth(servers):

@@ -133,7 +133,8 @@ def main() -> int:
                       "— excluded from medians")
                 continue
             eff_keys = ("fill_ratio", "duty_cycle", "xla_compiles",
-                        "pad_waste_device_s", "wave_step_ms_p50")
+                        "pad_waste_device_s", "wave_step_ms_p50",
+                        "cache_hit_rate")
             view = {k: v for k, v in rec.items()
                     if k not in ("probe", "ts", "run_ts", "platform",
                                  "config", "windows") + eff_keys}
@@ -145,6 +146,8 @@ def main() -> int:
                 _print_autotune_delta(rec)
             if probe == "router":
                 _print_router_delta(rec)
+            if probe == "dlrm":
+                _print_dlrm_delta(rec)
     return 0
 
 
@@ -167,6 +170,23 @@ def _print_autotune_delta(rec: dict) -> None:
     if rec.get("promotions") is not None:
         print(f"    promotions applied: {rec['promotions']} "
               f"(ladder {off.get('ladder')} -> {on.get('ladder')})")
+
+
+def _print_dlrm_delta(rec: dict) -> None:
+    """The DLRM probe's cached-vs-uncached story plus the sharded-parity
+    bit: hot-row cache hit rate under Zipf traffic next to both phases'
+    ips/p99, and whether 4-way sharded tables matched the oracle."""
+    d = rec.get("dlrm") or rec
+    device, cached = d.get("device") or {}, d.get("cached") or {}
+    if not device or not cached:
+        return
+    print(f"    dlrm device -> cached: {device.get('ips')} ips / "
+          f"p99 {device.get('p99_us')}us -> {cached.get('ips')} ips / "
+          f"p99 {cached.get('p99_us')}us "
+          f"(hit rate {cached.get('cache_hit_rate')})")
+    if d.get("sharded_parity") is not None:
+        print(f"    sharded-vs-oracle bit-identical: "
+              f"{d['sharded_parity']}")
 
 
 def _print_router_delta(rec: dict) -> None:

@@ -1,11 +1,11 @@
 #!/usr/bin/env bash
 # CI gate: tier-1 tests + chaos suite + live endpoint lint + autotune
-# e2e + router e2e + fused kernel parity + bench gate.
+# e2e + router e2e + fused kernel parity + DLRM e2e + bench gate.
 #
 #   tools/ci_check.sh            # everything (tier-1 already includes chaos)
 #   tools/ci_check.sh --fast     # all stages except tier-1
 #
-# Seven stages:
+# Eight stages:
 #   1. tier-1: the full fast suite (ROADMAP.md contract; excludes `slow`).
 #   2. chaos: the deterministic fault-injection suite alone (`-m chaos`) —
 #      redundant with tier-1 when stage 1 runs, but the -m filter proves
@@ -31,7 +31,13 @@
 #      (tests/test_ops.py) in interpret mode, then a fused-path engine
 #      driven end to end so tpu_decode_wave_seconds renders and lints
 #      clean in both exposition dialects.
-#   7. bench gate: tools/bench_summary.py --check fails the build when the
+#   7. dlrm e2e: serve the ragged-CSR DLRM model (host tables + hot-row
+#      cache) under CLIENT_TPU_AUTOTUNE with a deliberately misfit lookup
+#      ladder, drive small-nnz traffic, and assert the tuner promotes a
+#      LOOKUP-axis bucket (applied in /v2/profile, buckets tagged
+#      axis=lookups) and the tpu_emb_* cache metrics render
+#      promlint-clean in both exposition dialects.
+#   8. bench gate: tools/bench_summary.py --check fails the build when the
 #      newest BENCH_HISTORY.json run regressed any probe's p99 by >25%.
 set -u -o pipefail
 
@@ -42,7 +48,7 @@ FAST=0
 rc=0
 
 if [ "$FAST" -eq 0 ]; then
-    echo "=== stage 1/7: tier-1 test suite ==="
+    echo "=== stage 1/8: tier-1 test suite ==="
     rm -f /tmp/_t1.log
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -52,15 +58,15 @@ if [ "$FAST" -eq 0 ]; then
         | tr -cd . | wc -c)"
     [ "$t1" -ne 0 ] && { echo "tier-1 FAILED (exit $t1)"; rc=1; }
 else
-    echo "=== stage 1/7: tier-1 skipped (--fast) ==="
+    echo "=== stage 1/8: tier-1 skipped (--fast) ==="
 fi
 
-echo "=== stage 2/7: chaos (fault-injection) suite ==="
+echo "=== stage 2/8: chaos (fault-injection) suite ==="
 timeout -k 10 300 python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 [ $? -ne 0 ] && { echo "chaos suite FAILED"; rc=1; }
 
-echo "=== stage 3/7: live scrape (promlint + ops endpoints) ==="
+echo "=== stage 3/8: live scrape (promlint + ops endpoints) ==="
 SCRAPE_DIR=$(mktemp -d)
 python - "$SCRAPE_DIR" <<'EOF'
 import json
@@ -124,7 +130,7 @@ python tools/promlint.py --openmetrics "$SCRAPE_DIR/metrics.om.txt" \
     || { echo "promlint (openmetrics) FAILED"; rc=1; }
 rm -rf "$SCRAPE_DIR"
 
-echo "=== stage 4/7: autotune e2e (promotion + metrics) ==="
+echo "=== stage 4/8: autotune e2e (promotion + metrics) ==="
 TUNE_DIR=$(mktemp -d)
 CLIENT_TPU_AUTOTUNE='{"interval_s": 0.2, "cooldown_s": 0.5}' \
 timeout -k 10 300 python - "$TUNE_DIR" <<'EOF'
@@ -200,7 +206,7 @@ python tools/promlint.py --openmetrics "$TUNE_DIR/metrics.om.txt" \
     || { echo "promlint (autotune openmetrics) FAILED"; rc=1; }
 rm -rf "$TUNE_DIR"
 
-echo "=== stage 5/7: router e2e (balance + roll-drain + metrics) ==="
+echo "=== stage 5/8: router e2e (balance + roll-drain + metrics) ==="
 ROUTER_DIR=$(mktemp -d)
 timeout -k 10 300 python - "$ROUTER_DIR" <<'EOF'
 import json
@@ -307,7 +313,7 @@ python tools/promlint.py --openmetrics "$ROUTER_DIR/metrics.om.txt" \
     || { echo "promlint (router openmetrics) FAILED"; rc=1; }
 rm -rf "$ROUTER_DIR"
 
-echo "=== stage 6/7: fused decode kernel parity (interpret) + wave metrics ==="
+echo "=== stage 6/8: fused decode kernel parity (interpret) + wave metrics ==="
 # The Pallas decode kernel and the sharded KV arena run in interpret mode
 # on CPU (docs/KERNELS.md): this stage proves (a) fused == reference on
 # the fast parity subset, (b) an engine on the fused path emits
@@ -378,7 +384,85 @@ python tools/promlint.py --openmetrics "$KERNEL_DIR/metrics.om.txt" \
     || { echo "promlint (kernel openmetrics) FAILED"; rc=1; }
 rm -rf "$KERNEL_DIR"
 
-echo "=== stage 7/7: bench p99 regression gate ==="
+echo "=== stage 7/8: dlrm e2e (lookup-bucket promotion + emb metrics) ==="
+DLRM_DIR=$(mktemp -d)
+CLIENT_TPU_AUTOTUNE='{"interval_s": 0.2, "cooldown_s": 0.5}' \
+timeout -k 10 300 python - "$DLRM_DIR" <<'EOF'
+import json
+import sys
+import time
+from urllib.request import Request, urlopen
+
+import numpy as np
+
+from client_tpu.engine import TpuEngine
+from client_tpu.engine.repository import ModelRepository
+from client_tpu.engine.types import InferRequest
+from client_tpu.models.dlrm import DlrmBackend
+from client_tpu.server import HttpInferenceServer
+
+out_dir = sys.argv[1]
+# Misfit LOOKUP ladder on purpose: only the 128-lookup bucket exists, so
+# ~8-nnz CSR traffic runs at 8/128 fill until the tuner promotes a small
+# lookup bucket. Host tables + hot-row cache so tpu_emb_* render.
+backend = DlrmBackend(name="dlrm", host_tables=True,
+                      cache_budget_bytes=4096, lookup_buckets=[128])
+repo = ModelRepository()
+repo.register_backend(backend)
+engine = TpuEngine(repo, warmup=True)
+if engine.autotuner is None:
+    sys.exit("CLIENT_TPU_AUTOTUNE set but engine built no autotuner")
+srv = HttpInferenceServer(engine, host="127.0.0.1", port=0).start()
+try:
+    base = f"http://{srv.url}"
+    rng = np.random.default_rng(11)
+    for _ in range(16):  # skewed traffic: ~8 lookups per request
+        counts = rng.integers(1, 3, size=4)  # 1 row x 4 tables
+        idx = rng.integers(0, 64, size=int(counts.sum())).astype(np.int32)
+        off = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+        engine.infer(InferRequest(model_name="dlrm", inputs={
+            "DENSE": rng.standard_normal((1, 8)).astype(np.float32),
+            "INDICES": idx, "OFFSETS": off}), timeout_s=120)
+    applied = []
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not applied:
+        prof = json.load(urlopen(f"{base}/v2/profile", timeout=10))
+        applied = [d for d in prof.get("autotune", {}).get("decisions", [])
+                   if d["action"] == "add_bucket" and d["applied"]]
+        if not applied:
+            time.sleep(0.25)
+    if not applied:
+        sys.exit(f"no applied lookup-bucket promotion within 30s: "
+                 f"{json.dumps(prof.get('autotune'))[:400]}")
+    axes = {b.get("axis") for m in prof["models"].values()
+            for b in (m.get("buckets") or [])}
+    if axes != {"lookups"}:
+        sys.exit(f"profile buckets not tagged axis=lookups: {axes}")
+    classic = urlopen(f"{base}/metrics", timeout=10).read().decode()
+    om = urlopen(Request(f"{base}/metrics", headers={
+        "Accept": "application/openmetrics-text"}), timeout=10).read().decode()
+    for fam in ("tpu_emb_lookups_total", "tpu_emb_cache_hits_total",
+                "tpu_emb_cache_size_bytes"):
+        if fam not in classic:
+            sys.exit(f"{fam} missing from /metrics")
+    with open(f"{out_dir}/metrics.txt", "w") as f:
+        f.write(classic)
+    with open(f"{out_dir}/metrics.om.txt", "w") as f:
+        f.write(om)
+    print(f"dlrm e2e ok: lookup bucket {applied[0]['bucket']} applied, "
+          f"tpu_emb_* rendered")
+finally:
+    srv.stop()
+    engine.shutdown()
+EOF
+[ $? -ne 0 ] && { echo "dlrm e2e FAILED"; rc=1; }
+python tools/promlint.py "$DLRM_DIR/metrics.txt" \
+    || { echo "promlint (dlrm classic) FAILED"; rc=1; }
+python tools/promlint.py --openmetrics "$DLRM_DIR/metrics.om.txt" \
+    || { echo "promlint (dlrm openmetrics) FAILED"; rc=1; }
+rm -rf "$DLRM_DIR"
+
+echo "=== stage 8/8: bench p99 regression gate ==="
 if [ -f BENCH_HISTORY.json ]; then
     python tools/bench_summary.py --check \
         || { echo "bench gate FAILED"; rc=1; }

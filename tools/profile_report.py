@@ -21,7 +21,7 @@ import sys
 from urllib.parse import quote, urlparse
 from urllib.request import urlopen
 
-_COLS = ("bucket", "execs", "cold", "rows", "padded", "fill",
+_COLS = ("bucket", "axis", "execs", "cold", "rows", "padded", "fill",
          "device_s", "ewma_ms", "waste_s", "compiles", "compile_s")
 
 
@@ -43,7 +43,10 @@ def load_snapshot(source: str, model: str = "",
 
 
 def _bucket_row(b: dict) -> tuple:
-    return (b["bucket"], b["executions"], b["cold_executions"], b["rows"],
+    # "rows" vs "lookups": a 512-lookup ragged bucket is not a 512-row
+    # batch — the axis column keeps the two ladders readable side by side.
+    return (b["bucket"], b.get("axis", "rows"),
+            b["executions"], b["cold_executions"], b["rows"],
             b["padded_rows"], f"{b['fill_ratio']:.3f}",
             f"{b['device_s']:.4f}",
             f"{b['device_s_per_call_ewma'] * 1e3:.3f}",
