@@ -81,7 +81,7 @@ def _append_history(entry: dict) -> None:
 
 _SECTION_NAMES = ("simple", "gen_net", "seq_streaming", "ssd_net",
                   "router", "autotune", "dlrm", "bert", "shm_ab",
-                  "shm_ab_large", "seq", "gen", "device_steady")
+                  "shm_ab_large", "shm_ring", "seq", "gen", "device_steady")
 
 
 def _sections_filter() -> set | None:
@@ -209,7 +209,7 @@ def _section_guard(section: str):
 # 92s, device_steady 379s) plus ~50% margin; net sections from the CPU
 # verify drive padded for tunnel warmup.
 _SECTION_EST = {"simple": 150, "bert": 180, "shm_ab": 150,
-                "shm_ab_large": 180, "seq": 90, "gen": 150,
+                "shm_ab_large": 180, "shm_ring": 200, "seq": 90, "gen": 150,
                 "device_steady": 550, "gen_net": 400,
                 "seq_streaming": 350, "ssd_net": 450,
                 # two engine builds + two short load phases + promotion
@@ -1107,6 +1107,226 @@ def bench_shm_ab_large(concurrency: int = 16, dim: int = 150528):
             output_specs={"OUTPUT": arr.nbytes},
             concurrency=concurrency, tag="shmL")
     finally:
+        engine.shutdown()
+
+
+def bench_shm_ring(lanes: int = 4, span: int = 8, dim: int = 150528):
+    """Zero-copy shm ring vs binary HTTP on a vision-sized payload
+    (~602 KB FP32 per request): one co-located server, one passthrough
+    model, varying ONLY the data plane.  The HTTP side pays one POST with
+    the tensor inline per request; the ring side stages `span` requests
+    into /dev/shm slots, rings ONE doorbell for the whole span, and polls
+    the slot state words for completions — no response round trip at all.
+    `lanes` SPSC rings run concurrently (slot order is per-ring, so
+    parallelism comes from lanes, like independent co-located clients);
+    both planes run the same max in-flight (lanes * span).
+
+    Returns {http: {ips, p99_us, stable}, ring: {ips, p99_us, stable,
+    occupancy_mean, windows}, ring_vs_http_ips, fill_ratio, duty_cycle,
+    ring_rows}.
+    """
+    import numpy as np
+
+    import client_tpu.http as httpclient
+    from client_tpu.engine import TpuEngine
+    from client_tpu.engine.config import (
+        DynamicBatchingConfig,
+        ModelConfig,
+        TensorConfig,
+    )
+    from client_tpu.engine.model import ModelBackend
+    from client_tpu.engine.repository import ModelRepository
+    from client_tpu.engine.scheduler import power_buckets
+    from client_tpu.server import HttpInferenceServer
+    from client_tpu.utils.shm_ring import RingProducer
+
+    if os.environ.get("BENCH_SMOKE"):
+        lanes, span, dim = 2, 4, 4096
+    conc = lanes * span  # equal max in-flight on both planes
+
+    class RingIdentity(ModelBackend):
+        def __init__(self):
+            self.config = ModelConfig(
+                name="ring_identity", platform="jax",
+                max_batch_size=conc,
+                input=[TensorConfig("INPUT", "FP32", [dim])],
+                output=[TensorConfig("OUTPUT", "FP32", [dim])],
+                dynamic_batching=DynamicBatchingConfig(
+                    preferred_batch_size=[conc],
+                    max_queue_delay_microseconds=200),
+                batch_buckets=power_buckets(conc),
+                instance_count=4,
+            )
+
+        def make_apply(self):
+            def apply(inputs):
+                return {"OUTPUT": inputs["INPUT"] + 1.0}
+            return apply
+
+    repo = ModelRepository()
+    repo.register_backend(RingIdentity())
+    engine = TpuEngine(repo, warmup=True)
+    srv = HttpInferenceServer(engine, port=0).start()
+    rng = np.random.default_rng(0)
+    arr = rng.random((1, dim), dtype=np.float32)
+    out: dict = {}
+    try:
+        # -- binary HTTP: tensor bytes inline on the wire, one POST per
+        # request — what a co-located client pays without the ring.
+        client = httpclient.InferenceServerClient(srv.url, concurrency=conc)
+        inp = httpclient.InferInput("INPUT", [1, dim], "FP32")
+        inp.set_data_from_numpy(arr)
+
+        def infer_http():
+            client.infer("ring_identity", [inp])
+
+        try:
+            # Bursts of every power-of-two size up to the measured
+            # concurrency so no wave-bucket XLA compile lands inside a
+            # measurement window (same rationale as _shm_ab_modes).
+            k = 1
+            while True:
+                ts = [threading.Thread(target=infer_http) for _ in range(k)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                if k >= conc:
+                    break
+                k = min(k * 2, conc)
+            res = run_stable_load(infer_http, conc, window_s=2.5,
+                                  max_windows=10, tag="ring-http")
+        finally:
+            client.close()
+        out["http"] = {"ips": round(res["ips"], 1),
+                       "p99_us": round(res["p99_us"], 1),
+                       "stable": res["stable"]}
+
+        # -- shm ring: each lane fills a span of slots, rings one doorbell,
+        # then reaps completions straight out of shm.  Per-request latency
+        # is fill-to-reap (reap order == fill order on an SPSC ring).
+        stop_evt = threading.Event()
+        locks = [threading.Lock() for _ in range(lanes)]
+        lat_buckets: list[list[int]] = [[] for _ in range(lanes)]
+        occ_sum = [0] * lanes
+        occ_n = [0] * lanes
+        errs: list[str] = []
+
+        def lane(i):
+            # slot_count = 2*span keeps a span cooking server-side while
+            # this thread reaps the previous one — fill/doorbell/reap
+            # overlap instead of draining the ring to empty each cycle.
+            lane_client = httpclient.InferenceServerClient(srv.url)
+            try:
+                with RingProducer(lane_client, f"bench_ring{i}",
+                                  f"/bench_ring{i}", slot_count=2 * span,
+                                  slot_bytes=arr.nbytes) as prod:
+                    import collections
+                    fill_ts: collections.deque = collections.deque()
+                    while not stop_evt.is_set():
+                        while prod.fill({"INPUT": arr}) is not None:
+                            fill_ts.append(time.monotonic_ns())
+                        prod.doorbell("ring_identity")
+                        occ_sum[i] += prod.outstanding
+                        occ_n[i] += 1
+                        for _ in range(span):
+                            slot, _outs, err = prod.reap(timeout_s=120,
+                                                         copy=False)
+                            if err is not None:
+                                raise RuntimeError(
+                                    f"lane {i} slot {slot}: {err}")
+                            dt = time.monotonic_ns() - fill_ts.popleft()
+                            with locks[i]:
+                                lat_buckets[i].append(dt)
+                    # Drain what is still in flight so __exit__ never
+                    # detaches a ring the server is mid-write on.
+                    while prod.outstanding > prod.pending:
+                        prod.reap(timeout_s=120, copy=False)
+            except Exception as exc:  # noqa: BLE001 — surfaced after join
+                errs.append(repr(exc))
+                stop_evt.set()
+            finally:
+                lane_client.close()
+
+        def swap() -> list[int]:
+            taken: list[int] = []
+            for i in range(lanes):
+                with locks[i]:
+                    if lat_buckets[i]:
+                        taken.extend(lat_buckets[i])
+                        lat_buckets[i] = []
+            return taken
+
+        threads = [threading.Thread(target=lane, args=(i,), daemon=True)
+                   for i in range(lanes)]
+        for t in threads:
+            t.start()
+        time.sleep(1.5)
+        swap()  # discard everything completed during ramp
+        history: list[dict] = []
+        stable = False
+        t_mark = time.monotonic()
+        try:
+            while len(history) < 10 and not stop_evt.is_set():
+                time.sleep(2.5)
+                now = time.monotonic()
+                lat = swap()
+                elapsed = now - t_mark
+                t_mark = now
+                lat.sort()
+                ring_ips = len(lat) / elapsed
+                p99 = lat[int(len(lat) * 0.99) - 1] / 1e3 if lat else 0.0
+                history.append({"ips": round(ring_ips, 1),
+                                "p99_us": round(p99, 1)})
+                log(f"ring-shm window {len(history)}: {len(lat)} "
+                    f"completions in {elapsed:.2f}s = {ring_ips:.1f} ips, "
+                    f"p99 {p99 / 1e3:.1f}ms")
+                if "ring_rows" not in out:
+                    # Per-ring occupancy/backpressure rows while the rings
+                    # are still attached (they detach at lane exit).
+                    out["ring_rows"] = engine.ring_shm.status()
+                if _tail_is_stable(history, ("ips", "p99_us"), 0.10, 3):
+                    stable = True
+                    break
+        finally:
+            stop_evt.set()
+            for t in threads:
+                t.join(timeout=120)
+        if errs:
+            raise RuntimeError(f"shm_ring: lane errors: {errs[:3]}")
+        if not history:
+            raise RuntimeError("shm_ring: no measurement windows completed")
+        tail = history[-min(3, len(history)):]
+        ring_ips = sum(w["ips"] for w in tail) / len(tail)
+        ring_p99 = sum(w["p99_us"] for w in tail) / len(tail)
+        occ_samples = sum(occ_n)
+        out["ring"] = {"ips": round(ring_ips, 1),
+                       "p99_us": round(ring_p99, 1), "stable": stable,
+                       "occupancy_mean": (round(sum(occ_sum) / occ_samples,
+                                                2)
+                                          if occ_samples else None),
+                       "windows": history}
+        out["lanes"], out["span"], out["dim"] = lanes, span, dim
+        out["ring_vs_http_ips"] = (round(ring_ips / out["http"]["ips"], 3)
+                                   if out["http"]["ips"] else None)
+        try:
+            psnap = engine.profile_snapshot(model="ring_identity")
+            pm = next(iter(psnap["models"].values()), None)
+            if pm is not None:
+                rows = sum(b["rows"] for b in pm["buckets"])
+                padded = sum(b["padded_rows"] for b in pm["buckets"])
+                out["fill_ratio"] = (round(rows / (rows + padded), 4)
+                                     if rows + padded else 1.0)
+                out["duty_cycle"] = psnap["duty_cycle"]
+        except Exception as exc:  # noqa: BLE001 — profiler must not sink
+            log(f"profiler snapshot unavailable: {exc}")
+        log(f"shm_ring: ring {ring_ips:.1f} ips (p99 "
+            f"{ring_p99 / 1e3:.1f}ms) vs http {out['http']['ips']:.1f} ips "
+            f"(p99 {out['http']['p99_us'] / 1e3:.1f}ms) = "
+            f"{out['ring_vs_http_ips']}x")
+        return out
+    finally:
+        srv.stop()
         engine.shutdown()
 
 
@@ -2112,6 +2332,16 @@ def _main():
         _RESULT["shm_ab_large"] = r
         _append_history({"probe": "shm_ab_large", "shm_ab_large": r})
 
+    def _rec_shm_ring(r):
+        _RESULT["shm_ring"] = r
+        # Top-level p99 = the ring path's tail so bench_summary --check
+        # gates the new data plane like every other probe.
+        _append_history({"probe": "shm_ring",
+                         "p99_us": (r.get("ring") or {}).get("p99_us"),
+                         "fill_ratio": r.get("fill_ratio"),
+                         "duty_cycle": r.get("duty_cycle"),
+                         "shm_ring": r})
+
     def _rec_seq(s):
         _RESULT["seq_oldest_steps_s"] = round(s["steps_s"], 1)
         _RESULT["seq_oldest"] = s
@@ -2198,6 +2428,7 @@ def _main():
     mfu = bres["mfu"] if bres else None
     _run_section("shm_ab", bench_shm_ab, _rec_shm_ab)
     _run_section("shm_ab_large", bench_shm_ab_large, _rec_shm_ab_large)
+    _run_section("shm_ring", bench_shm_ring, _rec_shm_ring)
     seq_res = _run_section("seq", bench_sequence_oldest, _rec_seq)
     seq_steps_s = seq_res["steps_s"] if seq_res else None
     gen = _run_section("gen", bench_generative, _rec_gen)

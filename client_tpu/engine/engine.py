@@ -91,6 +91,13 @@ class TpuEngine:
 
         self.events = journal()
         self.slo = SloTracker.from_env(registry=self.metrics.registry)
+        # Third shm data plane: the zero-copy slot ring (engine.shmring).
+        # Constructed after metrics/events so tpu_shm_ring_* and the
+        # attach/detach/overflow journal events bind to this engine.
+        from client_tpu.engine.shmring import RingShmManager
+
+        self.ring_shm = RingShmManager(registry=self.metrics.registry,
+                                       events=self.events)
         # Efficiency profiler (process-global, like the fault registry:
         # models record into it from below the engine). Binding exports
         # tpu_batch_fill_ratio / tpu_padded_rows_total /
@@ -196,6 +203,8 @@ class TpuEngine:
         if self.tpu_shm is not None:
             extensions.append("tpu_shared_memory")
             extensions.append("cuda_shared_memory")  # wire-parity alias
+        if self.ring_shm is not None:
+            extensions.append("shm_ring")
         return {
             "name": SERVER_NAME,
             "version": client_tpu.__version__,
@@ -592,6 +601,12 @@ class TpuEngine:
         raise EngineError(
             f"shared memory region '{region}' not registered", 400)
 
+    def ring_doorbell(self, name: str, spec: dict) -> dict:
+        """Admit a span of FILLED ring slots (``engine.shmring``); each
+        slot becomes an ordinary async_infer submission whose outputs are
+        written back into the slot's shm response region."""
+        return self.ring_shm.doorbell(name, spec, self.async_infer)
+
     def prometheus_metrics(self, openmetrics: bool = False) -> str:
         """Prometheus text exposition of the per-model statistics — the
         equivalent of the metrics endpoint the Triton *server* exposes
@@ -667,6 +682,7 @@ class TpuEngine:
         # Duty-cycle and SLO burn gauges refresh at scrape time so a
         # quiet period still reads current windows.
         self.profiler.update_gauges()
+        self.ring_shm.update_gauges()
         if self.slo.enabled:
             self.slo.snapshot()
         if openmetrics:
@@ -758,6 +774,9 @@ class TpuEngine:
                 entry["row_cache"] = cache.snapshot()
         if self.autotuner is not None:
             self.autotuner.annotate(snap)
+        rings = self.ring_shm.profile_table()
+        if rings:
+            snap["shm_rings"] = rings
         return snap
 
     # -- trace (device profiling) --------------------------------------------
@@ -797,3 +816,5 @@ class TpuEngine:
             self.system_shm.unregister(None)
         if self.tpu_shm is not None:
             self.tpu_shm.unregister(None)
+        if getattr(self, "ring_shm", None) is not None:
+            self.ring_shm.unregister(None)

@@ -75,14 +75,20 @@ class _SysRegion:
                 f"({len(self.map)})", 400)
 
     def close(self):
-        try:
-            self.map.close()
-        except BufferError:
-            # zero-copy tensor views still reference the mapping; drop our
-            # reference and let GC unmap once the last view dies
-            self.map = None
-        finally:
-            os.close(self.fd)
+        # Idempotent: a second close() (e.g. unregister-all racing a single
+        # unregister, or re-close after the BufferError path below already
+        # dropped the mapping) must be a no-op, not an EBADF/AttributeError.
+        if self.map is not None:
+            try:
+                self.map.close()
+                self.map = None
+            except BufferError:
+                # zero-copy tensor views still reference the mapping; drop
+                # our reference and let GC unmap once the last view dies
+                self.map = None
+        if self.fd >= 0:
+            fd, self.fd = self.fd, -1
+            os.close(fd)
 
     def read_view(self, offset: int, byte_size: int) -> memoryview:
         offset = int(offset)
@@ -93,7 +99,11 @@ class _SysRegion:
         start = self.offset + offset
         if byte_size <= 0:
             byte_size = self.byte_size - offset
-        if byte_size <= 0 or start + byte_size > self.offset + self.byte_size:
+        if byte_size == 0:
+            # Explicit zero-length read (offset == byte_size with default
+            # size): a valid empty window, not an error.
+            return memoryview(b"")
+        if byte_size < 0 or start + byte_size > self.offset + self.byte_size:
             raise EngineError(
                 f"read of {byte_size}B at {offset} exceeds region "
                 f"'{self.name}' ({self.byte_size}B)", 400)
@@ -313,12 +323,27 @@ class TpuShmManager:
         except Exception:
             raise EngineError(
                 f"region '{name}': malformed TPU buffer handle", 400) from None
+        # Fuzz contract: any malformed/truncated handle is a client error
+        # (400), never a 500 — a JSON scalar/list, a missing or non-string
+        # key, and a non-numeric byte_size all land here.
+        if not isinstance(desc, dict):
+            raise EngineError(
+                f"region '{name}': malformed TPU buffer handle", 400)
         if desc.get("kind") != "host_staged":
             raise EngineError(
                 f"region '{name}': unsupported handle kind "
                 f"'{desc.get('kind')}'", 400)
-        staging = _SysRegion(name, desc["key"], 0,
-                             int(desc.get("byte_size", byte_size)))
+        key = desc.get("key")
+        if not isinstance(key, str) or not key:
+            raise EngineError(
+                f"region '{name}': handle missing shm key", 400)
+        try:
+            staged_size = int(desc.get("byte_size", byte_size))
+        except (TypeError, ValueError):
+            raise EngineError(
+                f"region '{name}': malformed handle byte_size", 400) \
+                from None
+        staging = _SysRegion(name, key, 0, staged_size)
         with self._lock:
             if name in self._regions:
                 staging.close()

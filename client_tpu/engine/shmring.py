@@ -1,0 +1,455 @@
+"""Server side of the zero-copy shm slot ring (TensorSocket-style).
+
+:class:`RingShmManager` sits alongside ``SystemShmManager`` /
+``TpuShmManager`` as the third shared-memory data plane (docs/SHM.md):
+a co-located producer creates the segment with
+``client_tpu.utils.shm_ring`` and registers it by key; each **batched
+doorbell** (``POST /v2/shm/ring/<name>/doorbell`` or the ``RingDoorbell``
+RPC) names a contiguous span of FILLED slots plus the span's shared
+tensor metadata, and every slot is admitted as a normal
+:class:`InferRequest` whose input tensors are zero-copy
+``np.frombuffer`` views into the slot (via ``_SysRegion.read_view``) —
+the engine's per-batch ``device_put`` stays the single host->HBM DMA.
+Outputs are written back into the slot's response region and completion
+is flagged through the slot state word, so the producer polls shm for
+results instead of holding N HTTP responses open.
+
+Ownership split (see ``client_tpu.utils.shm_ring`` for the layout): the
+producer owns head/tail and the FREE->FILLED and DONE->FREE state
+transitions; this manager owns FILLED->IN_FLIGHT->DONE. Response bytes
+land before the DONE store, and slot payloads are only read after the
+FILLED observation — program order under the GIL gives the
+release/acquire pairing on the aligned uint64 words.
+
+Slot response region wire format::
+
+    [uint64 header_len][JSON header][raw tensor bytes back-to-back]
+    header = {"outputs": [{"name","datatype","shape","byte_size"}, ...],
+              "error": null | "message"}
+
+Raw tensor bytes use the same ``serialize_tensor`` codec as the binary
+HTTP path, which is what makes ring-path outputs byte-identical to it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import numpy as np
+
+from client_tpu.engine.shm import _SysRegion, shm_path
+from client_tpu.engine.types import EngineError, InferRequest, OutputRequest
+from client_tpu.protocol.codec import serialize_tensor
+from client_tpu.protocol.dtypes import np_to_wire_dtype
+from client_tpu.utils.shm_ring import (
+    HEADER_BYTES,
+    OFF_HEAD,
+    OFF_MAGIC,
+    OFF_RESP_BYTES,
+    OFF_SLOT_BYTES,
+    OFF_SLOT_COUNT,
+    OFF_TAIL,
+    OFF_VERSION,
+    RING_MAGIC,
+    RING_VERSION,
+    SLOT_DONE,
+    SLOT_FILLED,
+    SLOT_IN_FLIGHT,
+    STATE_STRIDE,
+    ring_total_bytes,
+)
+
+# Span-size histogram buckets: the doorbell's whole point is amortizing
+# the control-channel round trip, so the interesting range is 1..slots.
+_SPAN_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+class _Ring:
+    """One attached ring: the mapped region plus word accessors and
+    per-ring accounting (doorbells, slot outcomes)."""
+
+    def __init__(self, name: str, key: str):
+        path = shm_path(key)
+        if not os.path.exists(path):
+            raise EngineError(
+                f"ring '{name}': shm key '{key}' does not exist", 400)
+        total = os.path.getsize(path)
+        if total < HEADER_BYTES:
+            raise EngineError(
+                f"ring '{name}': segment smaller than the ring header "
+                f"({total} < {HEADER_BYTES})", 400)
+        self.name = name
+        self.key = key
+        self.region = _SysRegion(name, key, 0, total)
+        words = np.frombuffer(self.region.map, dtype="<u8",
+                              count=HEADER_BYTES // 8)
+        if int(words[OFF_MAGIC // 8]) != RING_MAGIC:
+            self.region.close()
+            raise EngineError(
+                f"ring '{name}': '{key}' is not a ring segment "
+                "(bad magic)", 400)
+        if int(words[OFF_VERSION // 8]) != RING_VERSION:
+            self.region.close()
+            raise EngineError(
+                f"ring '{name}': unsupported ring version "
+                f"{int(words[OFF_VERSION // 8])}", 400)
+        self.slot_count = int(words[OFF_SLOT_COUNT // 8])
+        self.slot_bytes = int(words[OFF_SLOT_BYTES // 8])
+        self.resp_bytes = int(words[OFF_RESP_BYTES // 8])
+        if (self.slot_count < 1
+                or total < ring_total_bytes(self.slot_count,
+                                            self.slot_bytes,
+                                            self.resp_bytes)):
+            self.region.close()
+            raise EngineError(
+                f"ring '{name}': geometry exceeds segment size", 400)
+        self._words = np.frombuffer(
+            self.region.map, dtype="<u8",
+            count=(HEADER_BYTES + self.slot_count * STATE_STRIDE) // 8)
+        # Serializes completion writes against detach; slot payloads are
+        # disjoint, so concurrent completions need no ordering among
+        # themselves.
+        self.lock = threading.Lock()
+        self.closed = False
+        self.doorbells = 0
+        self.slots_ok = 0
+        self.slots_error = 0
+        self.slots_backpressured = 0
+        self.slots_skipped = 0
+
+    # -- ring words ----------------------------------------------------------
+
+    @property
+    def head(self) -> int:
+        return int(self._words[OFF_HEAD // 8])
+
+    @property
+    def tail(self) -> int:
+        return int(self._words[OFF_TAIL // 8])
+
+    @property
+    def occupancy(self) -> int:
+        return self.head - self.tail
+
+    def state(self, slot: int) -> int:
+        return int(self._words[(HEADER_BYTES
+                                + slot * STATE_STRIDE) // 8])
+
+    def set_state(self, slot: int, value: int) -> None:
+        self._words[(HEADER_BYTES + slot * STATE_STRIDE) // 8] = value
+
+    # -- slot I/O ------------------------------------------------------------
+
+    def request_offset(self, slot: int) -> int:
+        return (HEADER_BYTES + self.slot_count * STATE_STRIDE
+                + slot * (self.slot_bytes + self.resp_bytes))
+
+    def read_inputs(self, slot: int, metas: list[dict]) -> dict:
+        """Zero-copy input views for one slot (``_SysRegion.read_view``
+        under ``read_ndarray``; BYTES tensors decode, fixed dtypes are
+        frombuffer views — the batch device_put is the only copy)."""
+        base = self.request_offset(slot)
+        inputs = {}
+        for m in metas:
+            off = int(m.get("offset", 0))
+            size = int(m["byte_size"])
+            if off < 0 or off + size > self.slot_bytes:
+                raise EngineError(
+                    f"ring '{self.name}': input '{m.get('name')}' "
+                    f"({off}+{size}B) exceeds slot_bytes "
+                    f"({self.slot_bytes})", 400)
+            inputs[m["name"]] = self.region.read_ndarray(
+                base + off, size, m["datatype"], m["shape"])
+        return inputs
+
+    def write_response(self, slot: int, outputs: dict | None,
+                       error: str | None) -> bool:
+        """Serialize a completion into the slot's response region and
+        store DONE. Returns False when the payload overflows resp_bytes
+        (the slot then carries an overflow *error* response instead)."""
+        fit = True
+        raws: list[tuple[dict, bytes]] = []
+        if error is None:
+            for out_name, arr in (outputs or {}).items():
+                arr = np.asarray(arr)
+                raw = serialize_tensor(arr, np_to_wire_dtype(arr.dtype))
+                raws.append(({"name": out_name,
+                              "datatype": np_to_wire_dtype(arr.dtype),
+                              "shape": list(arr.shape),
+                              "byte_size": len(raw)}, raw))
+            header = json.dumps({"outputs": [m for m, _ in raws],
+                                 "error": None}).encode("utf-8")
+            total = 8 + len(header) + sum(len(r) for _, r in raws)
+            if total > self.resp_bytes:
+                error = (f"response ({total}B) exceeds ring resp_bytes "
+                         f"({self.resp_bytes})")
+                fit = False
+        if error is not None:
+            raws = []
+            header = json.dumps({"outputs": [],
+                                 "error": str(error)}).encode("utf-8")
+            if 8 + len(header) > self.resp_bytes:
+                header = json.dumps(
+                    {"outputs": [], "error": "response overflow"}
+                ).encode("utf-8")
+        with self.lock:
+            if self.closed:
+                return fit
+            base = self.region.offset + self.request_offset(slot) \
+                + self.slot_bytes
+            m = self.region.map
+            m[base:base + 8] = np.uint64(len(header)).tobytes()
+            pos = base + 8
+            m[pos:pos + len(header)] = header
+            pos += len(header)
+            for _, raw in raws:
+                m[pos:pos + len(raw)] = raw
+                pos += len(raw)
+            self.set_state(slot, SLOT_DONE)   # bytes first, then DONE
+        return fit
+
+    def close(self) -> None:
+        with self.lock:
+            self.closed = True
+            self.region.close()
+
+
+class RingShmManager:
+    """Registry + doorbell executor for shm slot rings.
+
+    ``registry``/``events`` bind the ``tpu_shm_ring_*`` metric family and
+    the journal; both optional so the manager stays usable standalone in
+    tests.
+    """
+
+    def __init__(self, registry=None, events=None):
+        self._rings: dict[str, _Ring] = {}
+        self._lock = threading.Lock()
+        self._events = events
+        self._m_doorbells = self._m_slots = None
+        self._m_occupancy = self._m_span = None
+        if registry is not None:
+            self._m_doorbells = registry.counter(
+                "tpu_shm_ring_doorbells_total",
+                "Batched ring doorbells received", ("ring",))
+            self._m_slots = registry.counter(
+                "tpu_shm_ring_slots_total",
+                "Ring slots processed by outcome "
+                "(ok|error|backpressured|skipped)", ("ring", "outcome"))
+            self._m_occupancy = registry.gauge(
+                "tpu_shm_ring_occupancy",
+                "Slots published but not yet released (head - tail)",
+                ("ring",))
+            self._m_span = registry.histogram(
+                "tpu_shm_ring_doorbell_span",
+                "Slots named per doorbell", ("ring",),
+                buckets=_SPAN_BUCKETS)
+
+    # -- registration (mirrors the other shm managers) ----------------------
+
+    def register(self, name: str, key: str) -> None:
+        ring = _Ring(name, key)
+        with self._lock:
+            if name in self._rings:
+                ring.close()
+                raise EngineError(
+                    f"ring '{name}' already registered", 400)
+            self._rings[name] = ring
+        if self._events is not None:
+            self._events.emit(
+                "shm_ring", "attach", ring=name, key=key,
+                slot_count=ring.slot_count, slot_bytes=ring.slot_bytes,
+                resp_bytes=ring.resp_bytes)
+
+    def register_from_json(self, name: str, body: dict) -> None:
+        key = body.get("key") if isinstance(body, dict) else None
+        if not isinstance(key, str) or not key:
+            raise EngineError(
+                f"ring '{name}': register body requires a string 'key'",
+                400)
+        self.register(name, key)
+
+    def unregister(self, name: str | None) -> None:
+        with self._lock:
+            if name is None:
+                rings = list(self._rings.items())
+                self._rings.clear()
+            else:
+                ring = self._rings.pop(name, None)
+                rings = [(name, ring)] if ring is not None else []
+        for ring_name, ring in rings:
+            ring.close()
+            if self._m_occupancy is not None:
+                # A detached ring's last-scraped occupancy must not render
+                # stale forever.
+                self._m_occupancy.remove(ring=ring_name)
+            if self._events is not None:
+                self._events.emit("shm_ring", "detach", ring=ring_name,
+                                  doorbells=ring.doorbells,
+                                  slots_ok=ring.slots_ok,
+                                  slots_error=ring.slots_error)
+
+    def has_region(self, name: str) -> bool:
+        with self._lock:
+            return name in self._rings
+
+    def status(self, name: str | None = None) -> dict:
+        with self._lock:
+            items = (
+                self._rings.items() if name is None
+                else [(name, self._rings[name])] if name in self._rings
+                else [])
+            return {n: self._ring_row(r) for n, r in items}
+
+    @staticmethod
+    def _ring_row(r: _Ring) -> dict:
+        occ = r.occupancy
+        return {
+            "name": r.name, "key": r.key,
+            "slot_count": r.slot_count, "slot_bytes": r.slot_bytes,
+            "resp_bytes": r.resp_bytes,
+            "head": r.head, "tail": r.tail, "occupancy": occ,
+            "fill": round(occ / r.slot_count, 4) if r.slot_count else 0.0,
+            "doorbells": r.doorbells,
+            "slots_ok": r.slots_ok, "slots_error": r.slots_error,
+            "slots_backpressured": r.slots_backpressured,
+            "slots_skipped": r.slots_skipped,
+        }
+
+    def profile_table(self) -> dict:
+        """The ``/v2/profile`` per-ring occupancy/backpressure table."""
+        return self.status()
+
+    def update_gauges(self) -> None:
+        """Refresh occupancy gauges (called at metrics scrape time)."""
+        if self._m_occupancy is None:
+            return
+        with self._lock:
+            rings = list(self._rings.values())
+        for r in rings:
+            self._m_occupancy.set(r.occupancy, ring=r.name)
+
+    def _get(self, name: str) -> _Ring:
+        with self._lock:
+            ring = self._rings.get(name)
+        if ring is None:
+            raise EngineError(f"ring '{name}' not registered", 400)
+        return ring
+
+    # -- the doorbell --------------------------------------------------------
+
+    def doorbell(self, name: str, spec: dict, submit) -> dict:
+        """Admit a contiguous span of FILLED slots as InferRequests.
+
+        ``submit`` is ``engine.async_infer``. Per-slot failures (admission
+        shed, validation, model errors) are written into that slot's
+        response region and flagged DONE — the doorbell call itself only
+        fails on malformed specs, so one bad slot never voids the span.
+        Returns ``{"admitted", "rejected", "skipped"}``.
+        """
+        from client_tpu.admission import AdmissionError
+
+        ring = self._get(name)
+        try:
+            start = int(spec["start"])
+            count = int(spec["count"])
+            metas = list(spec["inputs"])
+            model_name = spec["model_name"]
+        except (KeyError, TypeError, ValueError):
+            raise EngineError(
+                "doorbell requires start, count, model_name and "
+                "inputs metadata", 400) from None
+        if count < 1 or count > ring.slot_count:
+            raise EngineError(
+                f"doorbell span {count} outside 1..{ring.slot_count}", 400)
+        if start < 0 or start >= ring.slot_count:
+            raise EngineError(
+                f"doorbell start {start} outside ring "
+                f"(slot_count {ring.slot_count})", 400)
+        if not metas:
+            raise EngineError("doorbell names no input tensors", 400)
+        ring.doorbells += 1
+        if self._m_doorbells is not None:
+            self._m_doorbells.inc(ring=name)
+            self._m_span.observe(count, ring=name)
+        out_names = spec.get("outputs") or []
+        timeout_ms = float(spec.get("timeout_ms", 0) or 0)
+        priority = int(spec.get("priority", 0) or 0)
+        admitted = rejected = skipped = 0
+        backpressured = 0
+        for k in range(count):
+            slot = (start + k) % ring.slot_count
+            if ring.state(slot) != SLOT_FILLED:
+                # Producer protocol violation (or a replayed doorbell):
+                # never touch a slot the producer hasn't published.
+                ring.slots_skipped += 1
+                skipped += 1
+                if self._m_slots is not None:
+                    self._m_slots.inc(ring=name, outcome="skipped")
+                continue
+            ring.set_state(slot, SLOT_IN_FLIGHT)
+            try:
+                req = InferRequest(
+                    model_name=model_name,
+                    model_version=spec.get("model_version", "") or "",
+                    request_id=f"{name}/{slot}",
+                    inputs=ring.read_inputs(slot, metas),
+                    outputs=[OutputRequest(n) for n in out_names],
+                    priority=priority,
+                )
+                if timeout_ms:
+                    req.set_deadline_from_timeout_ms(timeout_ms)
+                submit(req, self._completion(ring, slot))
+            except AdmissionError as exc:
+                self._finish_slot(ring, slot, None, str(exc),
+                                  outcome="backpressured")
+                rejected += 1
+                backpressured += 1
+            except Exception as exc:  # noqa: BLE001 — per-slot isolation
+                self._finish_slot(ring, slot, None, str(exc),
+                                  outcome="error")
+                rejected += 1
+            else:
+                admitted += 1
+        if backpressured and self._events is not None:
+            self._events.emit(
+                "shm_ring", "overflow", severity="WARNING", ring=name,
+                model=model_name, backpressured=backpressured,
+                span=count, occupancy=ring.occupancy)
+        if self._m_occupancy is not None:
+            self._m_occupancy.set(ring.occupancy, ring=name)
+        return {"admitted": admitted, "rejected": rejected,
+                "skipped": skipped}
+
+    def _completion(self, ring: _Ring, slot: int):
+        def _cb(resp) -> None:
+            if not resp.final:
+                return
+            if resp.error is not None:
+                self._finish_slot(ring, slot, None, str(resp.error),
+                                  outcome="error")
+            else:
+                self._finish_slot(ring, slot, resp.outputs, None,
+                                  outcome="ok")
+        return _cb
+
+    def _finish_slot(self, ring: _Ring, slot: int, outputs, error,
+                     outcome: str) -> None:
+        try:
+            fit = ring.write_response(slot, outputs, error)
+        except Exception:
+            # Detached/unmapped mid-flight: drop the completion; the
+            # producer side is gone with the mapping.
+            fit = True
+        if not fit:
+            outcome = "error"
+        if outcome == "ok":
+            ring.slots_ok += 1
+        elif outcome == "backpressured":
+            ring.slots_backpressured += 1
+        else:
+            ring.slots_error += 1
+        if self._m_slots is not None:
+            self._m_slots.inc(ring=ring.name, outcome=outcome)

@@ -33,6 +33,7 @@ from client_tpu.protocol.codec import serialize_tensor
 from client_tpu.protocol.dtypes import np_to_wire_dtype
 from client_tpu.protocol.grpc_stub import GRPCInferenceServiceStub
 from client_tpu.utils import InferenceServerException, raise_error
+from client_tpu.utils.shm_ring import RingProducer  # noqa: F401 — re-export
 
 service_pb2 = pb  # re-export, as the reference re-exports its generated pb2
 
@@ -653,6 +654,49 @@ class InferenceServerClient:
     get_cuda_shared_memory_status = get_tpu_shared_memory_status
     register_cuda_shared_memory = register_tpu_shared_memory
     unregister_cuda_shared_memory = unregister_tpu_shared_memory
+
+    # -- shm slot ring (zero-copy data plane) -------------------------------
+
+    def register_shm_ring(self, name, key, headers=None,
+                          client_timeout=None):
+        """Attach a slot-ring segment (created with
+        ``client_tpu.utils.shm_ring``) by POSIX shm key."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        self._call(self._client_stub.RingRegister,
+                   ops.RingRegisterRequest(name=name, key=key), headers,
+                   client_timeout=client_timeout)
+
+    def unregister_shm_ring(self, name="", headers=None,
+                            client_timeout=None):
+        from client_tpu.protocol import ops_pb2 as ops
+
+        self._call(self._client_stub.RingUnregister,
+                   ops.RingUnregisterRequest(name=name), headers,
+                   client_timeout=client_timeout)
+
+    def get_shm_ring_status(self, name="", headers=None,
+                            client_timeout=None):
+        from client_tpu.protocol import ops_pb2 as ops
+
+        response = self._unary(
+            self._client_stub.RingStatus,
+            ops.RingStatusRequest(name=name),
+            self._md(headers), client_timeout)
+        return json.loads(response.status_json)
+
+    def ring_doorbell(self, name, spec, headers=None, client_timeout=None):
+        """Submit a span of FILLED ring slots in one RPC; the span spec
+        rides as JSON (same body as the HTTP doorbell) and completions
+        are polled from shm."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        response = self._unary(
+            self._client_stub.RingDoorbell,
+            ops.RingDoorbellRequest(name=name,
+                                    doorbell_json=json.dumps(spec)),
+            self._md(headers), client_timeout)
+        return json.loads(response.result_json)
 
     # -- inference -----------------------------------------------------------
 

@@ -45,6 +45,7 @@ from client_tpu.protocol.pushback import (
     parse_retry_after,
 )
 from client_tpu.utils import InferenceServerException, raise_error
+from client_tpu.utils.shm_ring import RingProducer  # noqa: F401 — re-export
 
 
 class InferInput:
@@ -785,6 +786,35 @@ class InferenceServerClient:
     get_cuda_shared_memory_status = get_tpu_shared_memory_status
     register_cuda_shared_memory = register_tpu_shared_memory
     unregister_cuda_shared_memory = unregister_tpu_shared_memory
+
+    # -- shm slot ring (zero-copy data plane) -------------------------------
+
+    def register_shm_ring(self, name, key, headers=None, query_params=None):
+        """Attach a slot-ring segment (created with
+        ``client_tpu.utils.shm_ring``) by POSIX shm key; geometry is read
+        from the ring header."""
+        self._post_json(f"/v2/shm/ring/{quote(name)}/register",
+                        {"key": key}, query_params, headers)
+
+    def unregister_shm_ring(self, name="", headers=None, query_params=None):
+        path = "/v2/shm/ring"
+        if name:
+            path += f"/{quote(name)}"
+        self._post_json(path + "/unregister", {}, query_params, headers)
+
+    def get_shm_ring_status(self, name="", headers=None, query_params=None):
+        path = "/v2/shm/ring"
+        if name:
+            path += f"/{quote(name)}"
+        return self._get_json(path + "/status", query_params, headers)
+
+    def ring_doorbell(self, name, spec, headers=None, query_params=None):
+        """Submit a span of FILLED slots in one round trip. ``spec`` is the
+        doorbell span description (see ``RingProducer.doorbell``); returns
+        ``{"admitted", "rejected", "skipped"}`` — completions are polled
+        from shm, not from this response."""
+        return self._post_json(f"/v2/shm/ring/{quote(name)}/doorbell",
+                               spec, query_params, headers)
 
     # -- trace (device profiling) --------------------------------------------
 

@@ -93,6 +93,14 @@ class _Metric:
     def _make_child(self):
         raise NotImplementedError
 
+    def remove(self, **labels):
+        """Drop one child series — for gauges tracking a resource that no
+        longer exists (a detached shm ring, an unloaded model), where the
+        last-set value would otherwise render stale forever."""
+        values = tuple(str(labels[k]) for k in self.labelnames)
+        with self._lock:
+            self._children.pop(values, None)
+
     def _family_name(self, openmetrics: bool) -> str:
         return self.name
 

@@ -55,6 +55,15 @@ _METHODS = [
     ("Events", ops.EventsRequest, ops.EventsResponse, False),
     ("SloStatus", ops.SloStatusRequest, ops.SloStatusResponse, False),
     ("Profile", ops.ProfileRequest, ops.ProfileResponse, False),
+    # shm slot-ring data plane (engine.shmring): register-by-key,
+    # status, and the batched doorbell.
+    ("RingRegister", ops.RingRegisterRequest, ops.RingRegisterResponse,
+     False),
+    ("RingStatus", ops.RingStatusRequest, ops.RingStatusResponse, False),
+    ("RingUnregister", ops.RingUnregisterRequest,
+     ops.RingUnregisterResponse, False),
+    ("RingDoorbell", ops.RingDoorbellRequest, ops.RingDoorbellResponse,
+     False),
 ]
 
 

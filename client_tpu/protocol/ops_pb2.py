@@ -23,6 +23,15 @@ the message classes. Wire-compatible with the equivalent .proto:
     message SloStatusResponse { string slo_json = 1; }
     message ProfileRequest    { string model = 1; }
     message ProfileResponse   { string profile_json = 1; }
+    message RingRegisterRequest    { string name = 1; string key = 2; }
+    message RingRegisterResponse   {}
+    message RingStatusRequest      { string name = 1; }
+    message RingStatusResponse     { string status_json = 1; }
+    message RingUnregisterRequest  { string name = 1; }
+    message RingUnregisterResponse {}
+    message RingDoorbellRequest    { string name = 1;
+                                     string doorbell_json = 2; }
+    message RingDoorbellResponse   { string result_json = 1; }
 
 Event.detail_json / SloStatusResponse.slo_json /
 ProfileResponse.profile_json carry the open-ended detail/report dicts as
@@ -99,6 +108,33 @@ def _file_proto() -> _descriptor_pb2.FileDescriptorProto:
     m = message("ProfileResponse")
     field(m, "profile_json", 1, _F.TYPE_STRING)
 
+    # shm slot-ring control plane (register-by-key + batched doorbell;
+    # the doorbell span spec and status tables ride as JSON, matching
+    # the HTTP bodies byte for byte).
+    m = message("RingRegisterRequest")
+    field(m, "name", 1, _F.TYPE_STRING)
+    field(m, "key", 2, _F.TYPE_STRING)
+
+    message("RingRegisterResponse")
+
+    m = message("RingStatusRequest")
+    field(m, "name", 1, _F.TYPE_STRING)
+
+    m = message("RingStatusResponse")
+    field(m, "status_json", 1, _F.TYPE_STRING)
+
+    m = message("RingUnregisterRequest")
+    field(m, "name", 1, _F.TYPE_STRING)
+
+    message("RingUnregisterResponse")
+
+    m = message("RingDoorbellRequest")
+    field(m, "name", 1, _F.TYPE_STRING)
+    field(m, "doorbell_json", 2, _F.TYPE_STRING)
+
+    m = message("RingDoorbellResponse")
+    field(m, "result_json", 1, _F.TYPE_STRING)
+
     return fdp
 
 
@@ -120,4 +156,12 @@ __all__ = [
     "SloStatusResponse",
     "ProfileRequest",
     "ProfileResponse",
+    "RingRegisterRequest",
+    "RingRegisterResponse",
+    "RingStatusRequest",
+    "RingStatusResponse",
+    "RingUnregisterRequest",
+    "RingUnregisterResponse",
+    "RingDoorbellRequest",
+    "RingDoorbellResponse",
 ]

@@ -376,6 +376,44 @@ class _Servicer(GRPCInferenceServiceServicer):
         snap = self.engine.profile_snapshot(model=request.model or None)
         return ops.ProfileResponse(profile_json=json.dumps(snap))
 
+    # -- shm slot ring (zero-copy data plane; engine.shmring) ---------------
+
+    def RingRegister(self, request, context):  # noqa: N802
+        from client_tpu.protocol import ops_pb2 as ops
+
+        try:
+            self.engine.ring_shm.register(request.name, request.key)
+        except Exception as exc:  # noqa: BLE001
+            _abort(context, exc)
+        return ops.RingRegisterResponse()
+
+    def RingStatus(self, request, context):  # noqa: N802
+        from client_tpu.protocol import ops_pb2 as ops
+
+        status = self.engine.ring_shm.status(request.name or None)
+        return ops.RingStatusResponse(status_json=json.dumps(status))
+
+    def RingUnregister(self, request, context):  # noqa: N802
+        from client_tpu.protocol import ops_pb2 as ops
+
+        try:
+            self.engine.ring_shm.unregister(request.name or None)
+        except Exception as exc:  # noqa: BLE001
+            _abort(context, exc)
+        return ops.RingUnregisterResponse()
+
+    def RingDoorbell(self, request, context):  # noqa: N802
+        """Batched doorbell over gRPC: the span spec rides as JSON (same
+        body as the HTTP doorbell); completions land in shm."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        try:
+            spec = json.loads(request.doorbell_json or "{}")
+            result = self.engine.ring_doorbell(request.name, spec)
+        except Exception as exc:  # noqa: BLE001
+            _abort(context, exc)
+        return ops.RingDoorbellResponse(result_json=json.dumps(result))
+
     # -- repository ----------------------------------------------------------
 
     def RepositoryIndex(self, request, context):  # noqa: N802

@@ -67,6 +67,11 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
                         r"/region/([^/]+)/register$"), "shm_register"),
     ("POST", re.compile(r"^/v2/(systemsharedmemory|cudasharedmemory|tpusharedmemory)"
                         r"(?:/region/([^/]+))?/unregister$"), "shm_unregister"),
+    ("GET", re.compile(r"^/v2/shm/ring(?:/([^/]+))?/status$"), "ring_status"),
+    ("POST", re.compile(r"^/v2/shm/ring/([^/]+)/register$"), "ring_register"),
+    ("POST", re.compile(r"^/v2/shm/ring(?:/([^/]+))?/unregister$"),
+     "ring_unregister"),
+    ("POST", re.compile(r"^/v2/shm/ring/([^/]+)/doorbell$"), "ring_doorbell"),
     ("GET", re.compile(r"^/v2/trace/setting$"), "trace_setting"),
     ("POST", re.compile(r"^/v2/trace/setting$"), "trace_update"),
     ("GET", re.compile(r"^/v2/trace/requests$"), "trace_requests"),
@@ -396,6 +401,27 @@ class _Handler(BaseHTTPRequestHandler):
         self._read_body()
         self._shm_manager(kind).unregister(region)
         self._send_json({})
+
+    # -- shm slot ring (zero-copy data plane; engine.shmring) ---------------
+
+    def h_ring_status(self, name=None):
+        self._send_json(self.engine.ring_shm.status(name))
+
+    def h_ring_register(self, name):
+        body = json.loads(self._read_body() or b"{}")
+        self.engine.ring_shm.register_from_json(name, body)
+        self._send_json({})
+
+    def h_ring_unregister(self, name=None):
+        self._read_body()
+        self.engine.ring_shm.unregister(name)
+        self._send_json({})
+
+    def h_ring_doorbell(self, name):
+        """The batched doorbell: one POST admits a whole span of FILLED
+        slots; completions land in shm, not in this response."""
+        spec = json.loads(self._read_body() or b"{}")
+        self._send_json(self.engine.ring_doorbell(name, spec))
 
     # -- inference ----------------------------------------------------------
 

@@ -1,0 +1,75 @@
+#!/usr/bin/env python3
+"""Zero-copy shm ring example: stage requests into a slot ring, ring ONE
+batched doorbell for the whole span, and poll shm for completions — no
+per-request HTTP round trip and no tensor bytes on the wire.
+
+Run against a co-located server (the ring is POSIX shm, so client and
+server must share /dev/shm):
+
+    python simple_shm_ring_client.py -u localhost:8000
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+from client_tpu.http import InferenceServerClient, RingProducer
+
+SPAN = 8
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000",
+                        help="server URL host:port")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    with InferenceServerClient(args.url, verbose=args.verbose) as client:
+        extensions = client.get_server_metadata()["extensions"]
+        if "shm_ring" not in extensions:
+            print("FAIL: server does not advertise the shm_ring extension")
+            sys.exit(1)
+
+        b = np.ones((1, 16), dtype=np.int32)
+        with RingProducer(client, "example_ring", "/example_shm_ring",
+                          slot_count=16, slot_bytes=4096) as producer:
+            # Stage a whole span of requests into ring slots (zero-copy:
+            # the server reads them straight out of /dev/shm)...
+            for i in range(SPAN):
+                a = np.arange(16, dtype=np.int32).reshape(1, 16) + i
+                slot = producer.fill({"INPUT0": a, "INPUT1": b})
+                assert slot is not None, "ring unexpectedly full"
+            # ...then submit all of them with ONE control-channel call.
+            result = producer.doorbell("simple")
+            print(f"doorbell: {result['admitted']} slot(s) admitted in "
+                  "one round trip")
+            if result["admitted"] != SPAN:
+                print(f"FAIL: expected {SPAN} admitted, got {result}")
+                sys.exit(1)
+            # Completions land in shm; poll the slot state words.
+            for i in range(SPAN):
+                a = np.arange(16, dtype=np.int32).reshape(1, 16) + i
+                slot, outputs, error = producer.reap(timeout_s=120)
+                if error is not None:
+                    print(f"FAIL: slot {slot}: {error}")
+                    sys.exit(1)
+                if not np.array_equal(outputs["OUTPUT0"], a + b) or \
+                        not np.array_equal(outputs["OUTPUT1"], a - b):
+                    print(f"FAIL: slot {slot} returned wrong results")
+                    sys.exit(1)
+                if args.verbose:
+                    print(f"slot {slot}: OUTPUT0={outputs['OUTPUT0'][0][:4]}"
+                          f"... OUTPUT1={outputs['OUTPUT1'][0][:4]}...")
+            status = client.get_shm_ring_status("example_ring")
+            ring = status["example_ring"]
+            print(f"ring status: {ring['slots_ok']} ok / "
+                  f"{ring['doorbells']} doorbell(s), occupancy "
+                  f"{ring['occupancy']}/{ring['slot_count']}")
+
+    print("PASS: shm_ring")
+
+
+if __name__ == "__main__":
+    main()

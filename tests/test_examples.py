@@ -63,6 +63,7 @@ def run_example(script, servers, extra=None):
     "simple_grpc_shm_string_client.py",
     "simple_grpc_tpushm_client.py",
     "simple_http_tpushm_client.py",
+    "simple_shm_ring_client.py",
     "grpc_client.py",
     "grpc_explicit_int_content_client.py",
     "grpc_explicit_int8_content_client.py",

@@ -1,0 +1,495 @@
+"""Zero-copy shm slot ring (engine.shmring + utils.shm_ring) and the
+satellite hardening of the existing shm managers.
+
+Ring coverage: layout/protocol unit tests on RingBuffer, end-to-end
+doorbell spans over HTTP and gRPC with byte-identical parity against the
+binary HTTP path, per-slot error isolation, backpressure, and the
+observability surface (tpu_shm_ring_* metrics, /v2/profile table,
+attach/detach journal events).
+
+Manager hardening: _SysRegion.close() idempotency, explicit zero-length
+read_view, BYTES round trips through system shm, concurrent
+register/unregister races, the TpuShmManager stale-view store-back
+guard, and handle-decode fuzz (malformed handles must 400, never 500).
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+from client_tpu.engine import TpuEngine
+from client_tpu.engine.shm import (
+    DeviceTensorView,
+    SystemShmManager,
+    TpuShmManager,
+    _SysRegion,
+    make_tpu_handle,
+)
+from client_tpu.engine.shmring import RingShmManager
+from client_tpu.engine.types import EngineError
+from client_tpu.models import build_repository
+from client_tpu.server import GrpcInferenceServer, HttpInferenceServer
+from client_tpu.utils.shm_ring import (
+    SLOT_DONE,
+    SLOT_FILLED,
+    SLOT_FREE,
+    RingBuffer,
+    RingProducer,
+    ShmRingError,
+)
+
+
+@pytest.fixture(scope="module")
+def servers():
+    eng = TpuEngine(build_repository(["simple"]))
+    http_srv = HttpInferenceServer(eng, port=0).start()
+    grpc_srv = GrpcInferenceServer(eng, port=0).start()
+    yield eng, http_srv, grpc_srv
+    grpc_srv.stop()
+    http_srv.stop()
+    eng.shutdown()
+
+
+def _mk_shm(key: str, size: int) -> str:
+    path = "/dev/shm/" + key.lstrip("/")
+    with open(path, "wb") as f:
+        f.write(b"\0" * size)
+    return path
+
+
+def _inputs(i: int = 0):
+    a = (np.arange(16, dtype=np.int32) + i).reshape(1, 16)
+    b = np.full((1, 16), 3, dtype=np.int32)
+    return a, b
+
+
+# ---------------------------------------------------------------------------
+# satellite: _SysRegion close()/read_view hardening
+# ---------------------------------------------------------------------------
+
+
+class TestSysRegionHardening:
+    def test_close_idempotent(self):
+        _mk_shm("/ct_ring_close", 256)
+        try:
+            region = _SysRegion("r", "/ct_ring_close", 0, 256)
+            region.close()
+            region.close()  # regression: second close() must be a no-op
+        finally:
+            os.unlink("/dev/shm/ct_ring_close")
+
+    def test_close_idempotent_after_buffererror(self):
+        """The BufferError path (live zero-copy view) drops the mapping;
+        a later close() must not die on map=None or the closed fd."""
+        _mk_shm("/ct_ring_close2", 256)
+        try:
+            region = _SysRegion("r", "/ct_ring_close2", 0, 256)
+            view = region.read_view(0, 64)  # keeps the mmap referenced
+            arr = np.frombuffer(view, dtype=np.uint8)
+            region.close()
+            assert region.map is None
+            region.close()
+            assert arr[0] == 0  # the view stays readable until GC
+            del arr, view
+        finally:
+            os.unlink("/dev/shm/ct_ring_close2")
+
+    def test_zero_length_read_view(self):
+        _mk_shm("/ct_ring_zlen", 128)
+        try:
+            region = _SysRegion("r", "/ct_ring_zlen", 0, 128)
+            # offset == byte_size with default size: a valid empty window,
+            # not a "read of 0B" error
+            view = region.read_view(128, 0)
+            assert len(view) == 0
+            assert len(region.read_view(128, -1)) == 0
+            # out-of-range offsets and oversized reads still reject
+            with pytest.raises(EngineError):
+                region.read_view(129, 0)
+            with pytest.raises(EngineError):
+                region.read_view(0, 129)
+            region.close()
+        finally:
+            os.unlink("/dev/shm/ct_ring_zlen")
+
+    def test_bytes_roundtrip_through_shm(self):
+        """BYTES tensors survive a write_tensor/read_tensor round trip
+        through a system shm region (length-prefixed codec)."""
+        mgr = SystemShmManager()
+        _mk_shm("/ct_ring_bytes", 1024)
+        try:
+            mgr.register("strs", "/ct_ring_bytes", 0, 1024)
+            arr = np.array([[b"alpha", b"", b"\x00binary\xff"]],
+                           dtype=np.object_)
+            written = mgr.write_tensor("strs", 0, 0, arr)
+            assert written > 0
+            back = mgr.read_tensor("strs", 0, written, "BYTES", [1, 3])
+            assert [bytes(x) for x in back.flatten()] == \
+                [b"alpha", b"", b"\x00binary\xff"]
+        finally:
+            mgr.unregister(None)
+            os.unlink("/dev/shm/ct_ring_bytes")
+
+
+# ---------------------------------------------------------------------------
+# satellite: manager races + handle fuzz
+# ---------------------------------------------------------------------------
+
+
+class TestManagerConcurrency:
+    def test_concurrent_register_unregister(self):
+        """register/unregister hammered from threads: duplicate-name 400s
+        are fine, crashes and double-close errors are not."""
+        mgr = SystemShmManager()
+        _mk_shm("/ct_ring_race", 4096)
+        errors: list = []
+
+        def worker(n):
+            for i in range(40):
+                name = f"r{(n + i) % 4}"
+                try:
+                    mgr.register(name, "/ct_ring_race", 0, 64)
+                except EngineError:
+                    pass  # duplicate registration — expected under race
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+                try:
+                    mgr.unregister(name if i % 3 else None)
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(n,))
+                   for n in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        try:
+            assert errors == []
+            mgr.unregister(None)
+            assert mgr.status() == {}
+        finally:
+            os.unlink("/dev/shm/ct_ring_race")
+
+    def test_stale_view_store_back_race(self):
+        """A read that materializes a stored DeviceTensorView while a
+        concurrent write_tensor lands a newer output must NOT store its
+        stale materialization back over the new array (shm.py
+        _resolve_device_array's identity guard)."""
+        import jax
+
+        release = threading.Event()
+        started = threading.Event()
+
+        class BlockingParent:
+            shape = (4, 8)
+            ndim = 2
+            dtype = np.dtype(np.float32)
+
+            def __getitem__(self, sl):
+                started.set()
+                assert release.wait(timeout=10)
+                return np.ones((2, 8), dtype=np.float32)
+
+        mgr = TpuShmManager(devices=jax.devices())
+        view = DeviceTensorView(BlockingParent(), 0, 2)
+        mgr.register_device_array("out", view)
+        region = mgr._get("out")
+
+        got: list = []
+        reader = threading.Thread(
+            target=lambda: got.append(mgr._resolve_device_array(region)))
+        reader.start()
+        assert started.wait(timeout=10)
+        # concurrent write of the NEXT batch's output
+        newer = np.zeros((2, 8), dtype=np.float32)
+        mgr.write_tensor("out", 0, 0, newer)
+        replacement = region.device_array
+        release.set()
+        reader.join(timeout=10)
+        # the reader saw its (stale) materialization...
+        np.testing.assert_array_equal(np.asarray(got[0]),
+                                      np.ones((2, 8), dtype=np.float32))
+        # ...but the region still holds the newer write
+        assert region.device_array is replacement
+        np.testing.assert_array_equal(np.asarray(region.device_array),
+                                      newer)
+        mgr.unregister(None)
+
+    @pytest.mark.parametrize("raw", [
+        b"",
+        b"garbage not json",
+        b"{\"kind\": \"host_staged\", \"key\":",   # truncated
+        b"[]",
+        b"42",
+        b"\"host_staged\"",
+        b"\xff\xfe\x00",                           # invalid utf-8
+        b"{\"kind\": \"cuda_ipc\", \"key\": \"/x\"}",
+        b"{\"kind\": \"host_staged\"}",            # missing key
+        b"{\"kind\": \"host_staged\", \"key\": 7}",
+        b"{\"kind\": \"host_staged\", \"key\": \"/x\", "
+        b"\"byte_size\": \"lots\"}",
+    ])
+    def test_handle_decode_fuzz_is_400(self, raw):
+        """Malformed/truncated handles are client errors: EngineError with
+        status 400 — never an uncaught exception the frontends turn into
+        a 500."""
+        mgr = TpuShmManager()
+        with pytest.raises(EngineError) as exc_info:
+            mgr.register_handle("fuzz", raw, 0, 64)
+        assert exc_info.value.status == 400
+
+    def test_wellformed_handle_still_registers(self):
+        _mk_shm("/ct_ring_handle_ok", 256)
+        try:
+            mgr = TpuShmManager()
+            mgr.register_handle(
+                "ok", make_tpu_handle("/ct_ring_handle_ok", 256), 0, 256)
+            assert mgr.has_region("ok")
+            mgr.unregister(None)
+        finally:
+            os.unlink("/dev/shm/ct_ring_handle_ok")
+
+
+# ---------------------------------------------------------------------------
+# ring: layout + SPSC protocol unit tests
+# ---------------------------------------------------------------------------
+
+
+class TestRingBuffer:
+    def test_create_attach_geometry(self):
+        ring = RingBuffer.create("/ct_ring_geom", 4, 100, 200)
+        try:
+            # sizes round up to cache lines
+            assert ring.slot_bytes == 128 and ring.resp_bytes == 256
+            peer = RingBuffer.attach("/ct_ring_geom")
+            assert (peer.slot_count, peer.slot_bytes, peer.resp_bytes) == \
+                (4, 128, 256)
+            peer.close()
+        finally:
+            ring.close(unlink=True)
+
+    def test_attach_rejects_non_ring(self):
+        _mk_shm("/ct_ring_notring", 8192)
+        try:
+            with pytest.raises(ShmRingError):
+                RingBuffer.attach("/ct_ring_notring")
+        finally:
+            os.unlink("/dev/shm/ct_ring_notring")
+
+    def test_fill_poll_release_cycle(self):
+        ring = RingBuffer.create("/ct_ring_cycle", 2, 256, 256)
+        try:
+            a, b = _inputs()
+            s0, meta = ring.fill({"INPUT0": a, "INPUT1": b})
+            s1, _ = ring.fill({"INPUT0": a, "INPUT1": b})
+            assert ring.fill({"INPUT0": a, "INPUT1": b}) is None  # full
+            assert ring.occupancy == 2
+            assert ring.state(s0) == SLOT_FILLED
+            assert meta[0]["byte_size"] == 64 and meta[1]["offset"] == 64
+            # emulate the server: complete slot 0
+            ring.set_state(s0, SLOT_DONE)
+            view = ring.response_view(s0)
+            header = json.dumps({"outputs": [], "error": "boom"}).encode()
+            view[0:8] = np.uint64(len(header)).tobytes()
+            view[8:8 + len(header)] = header
+            slot = ring.poll(timeout_s=5)
+            assert slot == s0
+            outs, err = ring.read_response(slot)
+            assert outs == {} and err == "boom"
+            with pytest.raises(ShmRingError):
+                ring.release(s1)  # out of ring order
+            ring.release(s0)
+            assert ring.state(s0) == SLOT_FREE
+            assert ring.occupancy == 1
+            assert ring.fill({"INPUT0": a, "INPUT1": b}) is not None
+        finally:
+            ring.close(unlink=True)
+
+    def test_oversized_fill_rejected(self):
+        ring = RingBuffer.create("/ct_ring_big", 2, 64, 64)
+        try:
+            with pytest.raises(ShmRingError):
+                ring.fill({"X": np.zeros(1024, dtype=np.float32)})
+        finally:
+            ring.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# ring: manager-level registration
+# ---------------------------------------------------------------------------
+
+
+class TestRingManager:
+    def test_register_validates_magic_and_duplicates(self):
+        mgr = RingShmManager()
+        _mk_shm("/ct_ring_mgr_bad", 8192)
+        ring = RingBuffer.create("/ct_ring_mgr_ok", 4, 128, 128)
+        try:
+            with pytest.raises(EngineError) as exc_info:
+                mgr.register("bad", "/ct_ring_mgr_bad")
+            assert exc_info.value.status == 400
+            with pytest.raises(EngineError):
+                mgr.register("gone", "/ct_ring_does_not_exist")
+            mgr.register("ok", "/ct_ring_mgr_ok")
+            with pytest.raises(EngineError):
+                mgr.register("ok", "/ct_ring_mgr_ok")
+            assert mgr.status("ok")["ok"]["slot_count"] == 4
+            mgr.unregister(None)
+            assert mgr.status() == {}
+        finally:
+            ring.close(unlink=True)
+            os.unlink("/dev/shm/ct_ring_mgr_bad")
+
+    def test_doorbell_spec_validation(self):
+        mgr = RingShmManager()
+        ring = RingBuffer.create("/ct_ring_mgr_spec", 4, 128, 128)
+        try:
+            mgr.register("r", "/ct_ring_mgr_spec")
+            for spec in ({},
+                         {"start": 0, "count": 0, "model_name": "m",
+                          "inputs": [{}]},
+                         {"start": 9, "count": 1, "model_name": "m",
+                          "inputs": [{}]},
+                         {"start": 0, "count": 1, "model_name": "m",
+                          "inputs": []}):
+                with pytest.raises(EngineError):
+                    mgr.doorbell("r", spec, lambda req, cb: None)
+            with pytest.raises(EngineError):
+                mgr.doorbell("nope", {"start": 0, "count": 1,
+                                      "model_name": "m", "inputs": [{}]},
+                             lambda req, cb: None)
+            mgr.unregister(None)
+        finally:
+            ring.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# ring: end-to-end over HTTP/gRPC
+# ---------------------------------------------------------------------------
+
+
+class TestRingE2E:
+    def test_http_ring_byte_identical_to_http_path(self, servers):
+        """The acceptance bar: ring-path outputs must be byte-identical
+        to the plain (binary) HTTP path for the same inputs."""
+        eng, http_srv, _ = servers
+        with httpclient.InferenceServerClient(http_srv.url) as c:
+            assert "shm_ring" in c.get_server_metadata()["extensions"]
+            # reference results over the ordinary binary HTTP path
+            reference = {}
+            for i in range(8):
+                a, b = _inputs(i)
+                i0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+                i0.set_data_from_numpy(a)
+                i1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+                i1.set_data_from_numpy(b)
+                res = c.infer("simple", [i0, i1])
+                reference[i] = (res.as_numpy("OUTPUT0"),
+                                res.as_numpy("OUTPUT1"))
+            with RingProducer(c, "e2e", "/ct_ring_e2e", slot_count=8,
+                              slot_bytes=4096) as prod:
+                for i in range(8):
+                    a, b = _inputs(i)
+                    assert prod.fill({"INPUT0": a, "INPUT1": b}) is not None
+                result = prod.doorbell("simple")
+                assert result == {"admitted": 8, "rejected": 0,
+                                  "skipped": 0}
+                for i in range(8):
+                    _, outs, err = prod.reap(timeout_s=60)
+                    assert err is None
+                    for name, ref in zip(("OUTPUT0", "OUTPUT1"),
+                                         reference[i]):
+                        assert outs[name].dtype == ref.dtype
+                        assert outs[name].tobytes() == ref.tobytes()
+                status = c.get_shm_ring_status("e2e")["e2e"]
+                assert status["slots_ok"] == 8
+                assert status["doorbells"] == 1
+
+    def test_http_ring_per_slot_errors_and_skips(self, servers):
+        """One bad slot never voids the span: unknown models land as
+        per-slot errors in shm; unfilled slots are skipped."""
+        eng, http_srv, _ = servers
+        with httpclient.InferenceServerClient(http_srv.url) as c:
+            with RingProducer(c, "errs", "/ct_ring_errs", slot_count=4,
+                              slot_bytes=2048) as prod:
+                a, b = _inputs()
+                prod.fill({"INPUT0": a, "INPUT1": b})
+                prod.fill({"INPUT0": a, "INPUT1": b})
+                spec = {"start": 0, "count": 3, "model_name": "no_such",
+                        "inputs": prod._meta}
+                prod._pending, prod._meta = [], None
+                result = c.ring_doorbell("errs", spec)
+                assert result["skipped"] == 1  # slot 2 was never FILLED
+                for _ in range(2):
+                    _, outs, err = prod.reap(timeout_s=60)
+                    assert err is not None and "no_such" in err
+                status = c.get_shm_ring_status("errs")["errs"]
+                assert status["slots_error"] == 2
+                assert status["slots_skipped"] == 1
+
+    def test_ring_observability_surface(self, servers):
+        """tpu_shm_ring_* metrics render in both exposition dialects, the
+        profile snapshot carries the per-ring table, and the journal logs
+        attach/detach."""
+        eng, http_srv, _ = servers
+        with httpclient.InferenceServerClient(http_srv.url) as c:
+            with RingProducer(c, "obs", "/ct_ring_obs", slot_count=4,
+                              slot_bytes=2048) as prod:
+                a, b = _inputs()
+                prod.fill({"INPUT0": a, "INPUT1": b})
+                c_resp = prod.doorbell("simple")
+                assert c_resp["admitted"] == 1
+                _, outs, err = prod.reap(timeout_s=60)
+                assert err is None
+                classic = eng.prometheus_metrics()
+                assert 'tpu_shm_ring_doorbells_total{ring="obs"} 1' \
+                    in classic
+                assert 'tpu_shm_ring_slots_total{ring="obs",' \
+                    'outcome="ok"} 1' in classic
+                assert "tpu_shm_ring_occupancy" in classic
+                om = eng.prometheus_metrics(openmetrics=True)
+                assert "tpu_shm_ring_doorbells_total" in om
+                assert om.rstrip().endswith("# EOF")
+                prof = c.get_profile()
+                assert prof["shm_rings"]["obs"]["doorbells"] == 1
+                assert "occupancy" in prof["shm_rings"]["obs"]
+            names = [e["name"] for e in
+                     eng.events_export(category="shm_ring")["events"]]
+            assert "attach" in names and "detach" in names
+            # The gauge child scraped while attached must not render a
+            # stale occupancy forever after detach.
+            assert 'tpu_shm_ring_occupancy{ring="obs"}' \
+                not in eng.prometheus_metrics()
+
+    def test_grpc_ring_parity(self, servers):
+        eng, _, grpc_srv = servers
+        c = grpcclient.InferenceServerClient(f"127.0.0.1:{grpc_srv.port}")
+        try:
+            with RingProducer(c, "gr", "/ct_ring_grpc_t", slot_count=4,
+                              slot_bytes=2048) as prod:
+                a, b = _inputs(5)
+                prod.fill({"INPUT0": a, "INPUT1": b})
+                assert prod.doorbell("simple")["admitted"] == 1
+                _, outs, err = prod.reap(timeout_s=60)
+                assert err is None
+                np.testing.assert_array_equal(outs["OUTPUT0"], a + b)
+                np.testing.assert_array_equal(outs["OUTPUT1"], a - b)
+                assert c.get_shm_ring_status("gr")["gr"]["slots_ok"] == 1
+            assert c.get_shm_ring_status() == {}
+        finally:
+            c.close()
+
+    def test_http_register_bad_body_is_400(self, servers):
+        eng, http_srv, _ = servers
+        from client_tpu.utils import InferenceServerException
+
+        with httpclient.InferenceServerClient(http_srv.url) as c:
+            with pytest.raises(InferenceServerException) as exc_info:
+                c.register_shm_ring("nokey", key=None)
+            assert exc_info.value.status() == 400

@@ -148,6 +148,8 @@ def main() -> int:
                 _print_router_delta(rec)
             if probe == "dlrm":
                 _print_dlrm_delta(rec)
+            if probe == "shm_ring":
+                _print_shm_ring_delta(rec)
     return 0
 
 
@@ -187,6 +189,24 @@ def _print_dlrm_delta(rec: dict) -> None:
     if d.get("sharded_parity") is not None:
         print(f"    sharded-vs-oracle bit-identical: "
               f"{d['sharded_parity']}")
+
+
+def _print_shm_ring_delta(rec: dict) -> None:
+    """The shm-ring probe's data-plane story: batched-doorbell ring vs
+    binary HTTP on the same model/payload, plus mean ring occupancy — the
+    acceptance bar (ring strictly higher ips) reads off the ratio."""
+    r = rec.get("shm_ring") or rec
+    http, ring = r.get("http") or {}, r.get("ring") or {}
+    if not http or not ring:
+        return
+    ratio = r.get("ring_vs_http_ips")
+    print(f"    shm_ring http -> ring: {http.get('ips')} ips / "
+          f"p99 {http.get('p99_us')}us -> {ring.get('ips')} ips / "
+          f"p99 {ring.get('p99_us')}us"
+          + (f" = {ratio}x" if ratio is not None else "")
+          + (f" (occupancy {ring.get('occupancy_mean')}, "
+             f"{r.get('lanes')} lanes x span {r.get('span')})"
+             if ring.get("occupancy_mean") is not None else ""))
 
 
 def _print_router_delta(rec: dict) -> None:

@@ -1,11 +1,12 @@
 #!/usr/bin/env bash
 # CI gate: tier-1 tests + chaos suite + live endpoint lint + autotune
-# e2e + router e2e + fused kernel parity + DLRM e2e + bench gate.
+# e2e + router e2e + fused kernel parity + DLRM e2e + shm ring e2e +
+# bench gate.
 #
 #   tools/ci_check.sh            # everything (tier-1 already includes chaos)
 #   tools/ci_check.sh --fast     # all stages except tier-1
 #
-# Eight stages:
+# Nine stages:
 #   1. tier-1: the full fast suite (ROADMAP.md contract; excludes `slow`).
 #   2. chaos: the deterministic fault-injection suite alone (`-m chaos`) —
 #      redundant with tier-1 when stage 1 runs, but the -m filter proves
@@ -37,7 +38,13 @@
 #      LOOKUP-axis bucket (applied in /v2/profile, buckets tagged
 #      axis=lookups) and the tpu_emb_* cache metrics render
 #      promlint-clean in both exposition dialects.
-#   8. bench gate: tools/bench_summary.py --check fails the build when the
+#   8. shm ring e2e: a REAL producer process creates a slot ring in
+#      /dev/shm, registers it over HTTP, stages a span of requests, rings
+#      ONE batched doorbell, and polls the slot state words for
+#      completions — asserting the reaped outputs are byte-identical to
+#      the binary-HTTP path for the same inputs, and that tpu_shm_ring_*
+#      render promlint-clean in both exposition dialects.
+#   9. bench gate: tools/bench_summary.py --check fails the build when the
 #      newest BENCH_HISTORY.json run regressed any probe's p99 by >25%.
 set -u -o pipefail
 
@@ -48,7 +55,7 @@ FAST=0
 rc=0
 
 if [ "$FAST" -eq 0 ]; then
-    echo "=== stage 1/8: tier-1 test suite ==="
+    echo "=== stage 1/9: tier-1 test suite ==="
     rm -f /tmp/_t1.log
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -58,15 +65,15 @@ if [ "$FAST" -eq 0 ]; then
         | tr -cd . | wc -c)"
     [ "$t1" -ne 0 ] && { echo "tier-1 FAILED (exit $t1)"; rc=1; }
 else
-    echo "=== stage 1/8: tier-1 skipped (--fast) ==="
+    echo "=== stage 1/9: tier-1 skipped (--fast) ==="
 fi
 
-echo "=== stage 2/8: chaos (fault-injection) suite ==="
+echo "=== stage 2/9: chaos (fault-injection) suite ==="
 timeout -k 10 300 python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 [ $? -ne 0 ] && { echo "chaos suite FAILED"; rc=1; }
 
-echo "=== stage 3/8: live scrape (promlint + ops endpoints) ==="
+echo "=== stage 3/9: live scrape (promlint + ops endpoints) ==="
 SCRAPE_DIR=$(mktemp -d)
 python - "$SCRAPE_DIR" <<'EOF'
 import json
@@ -130,7 +137,7 @@ python tools/promlint.py --openmetrics "$SCRAPE_DIR/metrics.om.txt" \
     || { echo "promlint (openmetrics) FAILED"; rc=1; }
 rm -rf "$SCRAPE_DIR"
 
-echo "=== stage 4/8: autotune e2e (promotion + metrics) ==="
+echo "=== stage 4/9: autotune e2e (promotion + metrics) ==="
 TUNE_DIR=$(mktemp -d)
 CLIENT_TPU_AUTOTUNE='{"interval_s": 0.2, "cooldown_s": 0.5}' \
 timeout -k 10 300 python - "$TUNE_DIR" <<'EOF'
@@ -206,7 +213,7 @@ python tools/promlint.py --openmetrics "$TUNE_DIR/metrics.om.txt" \
     || { echo "promlint (autotune openmetrics) FAILED"; rc=1; }
 rm -rf "$TUNE_DIR"
 
-echo "=== stage 5/8: router e2e (balance + roll-drain + metrics) ==="
+echo "=== stage 5/9: router e2e (balance + roll-drain + metrics) ==="
 ROUTER_DIR=$(mktemp -d)
 timeout -k 10 300 python - "$ROUTER_DIR" <<'EOF'
 import json
@@ -313,7 +320,7 @@ python tools/promlint.py --openmetrics "$ROUTER_DIR/metrics.om.txt" \
     || { echo "promlint (router openmetrics) FAILED"; rc=1; }
 rm -rf "$ROUTER_DIR"
 
-echo "=== stage 6/8: fused decode kernel parity (interpret) + wave metrics ==="
+echo "=== stage 6/9: fused decode kernel parity (interpret) + wave metrics ==="
 # The Pallas decode kernel and the sharded KV arena run in interpret mode
 # on CPU (docs/KERNELS.md): this stage proves (a) fused == reference on
 # the fast parity subset, (b) an engine on the fused path emits
@@ -384,7 +391,7 @@ python tools/promlint.py --openmetrics "$KERNEL_DIR/metrics.om.txt" \
     || { echo "promlint (kernel openmetrics) FAILED"; rc=1; }
 rm -rf "$KERNEL_DIR"
 
-echo "=== stage 7/8: dlrm e2e (lookup-bucket promotion + emb metrics) ==="
+echo "=== stage 7/9: dlrm e2e (lookup-bucket promotion + emb metrics) ==="
 DLRM_DIR=$(mktemp -d)
 CLIENT_TPU_AUTOTUNE='{"interval_s": 0.2, "cooldown_s": 0.5}' \
 timeout -k 10 300 python - "$DLRM_DIR" <<'EOF'
@@ -462,7 +469,121 @@ python tools/promlint.py --openmetrics "$DLRM_DIR/metrics.om.txt" \
     || { echo "promlint (dlrm openmetrics) FAILED"; rc=1; }
 rm -rf "$DLRM_DIR"
 
-echo "=== stage 8/8: bench p99 regression gate ==="
+echo "=== stage 8/9: shm ring e2e (producer process + doorbell + metrics) ==="
+RING_DIR=$(mktemp -d)
+timeout -k 10 300 python - "$RING_DIR" <<'EOF'
+import json
+import os
+import subprocess
+import sys
+from urllib.request import Request, urlopen
+
+import numpy as np
+
+import client_tpu.http as httpclient
+from client_tpu.engine import TpuEngine
+from client_tpu.models import build_repository
+from client_tpu.server import HttpInferenceServer
+
+out_dir = sys.argv[1]
+
+# The producer runs as a SEPARATE process: the whole point of the ring is
+# the cross-process /dev/shm contract, so CI must not fake it in-process.
+PRODUCER = r'''
+import sys
+
+import numpy as np
+
+import client_tpu.http as httpclient
+from client_tpu.utils.shm_ring import RingProducer
+
+url, out_npz, n = sys.argv[1], sys.argv[2], int(sys.argv[3])
+client = httpclient.InferenceServerClient(url)
+outs = {}
+with RingProducer(client, "ci_ring", "/ci_ring_e2e", slot_count=8,
+                  slot_bytes=4096) as prod:
+    b = np.ones((1, 16), dtype=np.int32)
+    for i in range(n):
+        a = np.arange(16, dtype=np.int32).reshape(1, 16) + i
+        assert prod.fill({"INPUT0": a, "INPUT1": b}) is not None
+    res = prod.doorbell("simple")
+    assert res["admitted"] == n, res
+    for _ in range(n):
+        slot, o, err = prod.reap(timeout_s=120)
+        assert err is None, err
+        outs[f"o0_{slot}"] = o["OUTPUT0"]
+        outs[f"o1_{slot}"] = o["OUTPUT1"]
+client.close()
+np.savez(out_npz, **outs)
+'''
+
+engine = TpuEngine(build_repository(["simple"]), warmup=False)
+srv = HttpInferenceServer(engine, host="127.0.0.1", port=0).start()
+try:
+    n = 6
+    # Reference outputs via the binary-HTTP data plane, same inputs.
+    client = httpclient.InferenceServerClient(srv.url)
+    b = np.ones((1, 16), dtype=np.int32)
+    i1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+    i1.set_data_from_numpy(b)
+    ref = []
+    for i in range(n):
+        a = np.arange(16, dtype=np.int32).reshape(1, 16) + i
+        i0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+        i0.set_data_from_numpy(a)
+        r = client.infer("simple", [i0, i1])
+        ref.append((r.as_numpy("OUTPUT0"), r.as_numpy("OUTPUT1")))
+    client.close()
+
+    prod_py = os.path.join(out_dir, "producer.py")
+    with open(prod_py, "w") as f:
+        f.write(PRODUCER)
+    out_npz = os.path.join(out_dir, "ring_outputs.npz")
+    proc = subprocess.run(
+        [sys.executable, prod_py, srv.url, out_npz, str(n)],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, PYTHONPATH=os.getcwd()))
+    if proc.returncode != 0:
+        sys.exit("ring producer process failed:\n"
+                 f"{proc.stdout}{proc.stderr}")
+
+    got = np.load(out_npz)
+    for i in range(n):  # fresh ring: request i landed in slot i
+        r0, r1 = ref[i]
+        if got[f"o0_{i}"].tobytes() != r0.tobytes() or \
+                got[f"o1_{i}"].tobytes() != r1.tobytes():
+            sys.exit(f"slot {i}: ring outputs not byte-identical to HTTP")
+
+    events = json.load(urlopen(
+        f"http://{srv.url}/v2/events?category=shm_ring", timeout=10))
+    names = {e["name"] for e in events["events"]}
+    if not {"attach", "detach"} <= names:
+        sys.exit(f"journal missing shm_ring attach/detach: {names}")
+    classic = urlopen(f"http://{srv.url}/metrics", timeout=10).read().decode()
+    om = urlopen(Request(f"http://{srv.url}/metrics", headers={
+        "Accept": "application/openmetrics-text"}), timeout=10).read().decode()
+    for fam in ("tpu_shm_ring_doorbells_total", "tpu_shm_ring_slots_total",
+                "tpu_shm_ring_doorbell_span"):
+        if fam not in classic:
+            sys.exit(f"{fam} missing from /metrics")
+    with open(f"{out_dir}/metrics.txt", "w") as f:
+        f.write(classic)
+    with open(f"{out_dir}/metrics.om.txt", "w") as f:
+        f.write(om)
+    print(f"shm ring e2e ok: {n} slots byte-identical to HTTP, "
+          f"one doorbell, tpu_shm_ring_* rendered")
+finally:
+    srv.stop()
+    engine.shutdown()
+EOF
+[ $? -ne 0 ] && { echo "shm ring e2e FAILED"; rc=1; }
+python tools/promlint.py "$RING_DIR/metrics.txt" \
+    || { echo "promlint (shm ring classic) FAILED"; rc=1; }
+python tools/promlint.py --openmetrics "$RING_DIR/metrics.om.txt" \
+    || { echo "promlint (shm ring openmetrics) FAILED"; rc=1; }
+rm -rf "$RING_DIR"
+
+echo "=== stage 9/9: bench p99 regression gate ==="
 if [ -f BENCH_HISTORY.json ]; then
     python tools/bench_summary.py --check \
         || { echo "bench gate FAILED"; rc=1; }
