@@ -290,15 +290,33 @@ def peak_flops() -> float | None:
     return _PEAK_FLOPS.get(gen)
 
 
+def _backend_init_abort(reason: str) -> None:
+    """Fail FAST and LOUD on a backend-init outage (round-6 fix: rounds 4
+    and 5 each recorded a hollow ``status:"unavailable"`` run that then
+    sat in the baseline history looking like data). The emitted record
+    says ``backend_init_error`` — unambiguous: no measurement happened —
+    and the process exits nonzero so a driver cannot file the run as a
+    green result. bench_summary skips these records entirely."""
+    log(f"preflight: {reason} — emitting status=backend_init_error "
+        "(no measurement happened; this is an outage, not a perf result)")
+    _RESULT.update({
+        "metric": "inproc_simple_ips", "value": 0.0, "unit": "infer/sec",
+        "status": "backend_init_error", "reason": reason})
+    _append_history({"probe": "run-status", "status": "backend_init_error",
+                     "reason": reason})
+    _emit(_RESULT)
+    os._exit(3)
+
+
 def preflight():
     """Bounded, logged backend init (round-5 fix: round 4's driver capture
     spent its entire 1500s watchdog window in "JAX backend still
     initializing" during a tunnel outage and reported value 0.0 — which
-    reads as a perf collapse, not an outage).  Init now runs on a helper
+    reads as a perf collapse, not an outage).  Init runs on a helper
     thread with a hard deadline (BENCH_INIT_DEADLINE_S, default 120s); on
-    expiry the bench emits ``status: "unavailable"`` immediately so an
-    outage is distinguishable from a collapse and the driver's watchdog
-    window is not consumed waiting on a dead tunnel."""
+    expiry OR an init exception the bench aborts through
+    :func:`_backend_init_abort` — a clear diagnostic and a nonzero exit,
+    never a hollow run recorded as if it were a measurement."""
     deadline_s = float(os.environ.get("BENCH_INIT_DEADLINE_S", "120"))
     log(f"preflight: initializing JAX backend "
         f"(JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', 'auto')}, "
@@ -317,26 +335,20 @@ def preflight():
 
             box["devices"] = ensure_backend()
             box["secs"] = init_seconds()
-        except BaseException as exc:  # noqa: BLE001 — re-raised on caller
+        except BaseException as exc:  # noqa: BLE001 — reported on caller
             box["error"] = exc
 
     t = threading.Thread(target=_init, name="bench-init", daemon=True)
     t.start()
     t.join(deadline_s)
     if t.is_alive():
-        log(f"preflight: backend init exceeded {deadline_s:.0f}s — "
-            "emitting status=unavailable (tunnel outage, not a perf result)")
-        _RESULT.update({
-            "metric": "inproc_simple_ips", "value": 0.0, "unit": "infer/sec",
-            "status": "unavailable",
-            "reason": f"JAX backend init exceeded {deadline_s:.0f}s "
-                      "(device tunnel outage?)"})
-        _append_history({"probe": "run-status", "status": "unavailable",
-                         "reason": _RESULT["reason"]})
-        _emit(_RESULT)
-        os._exit(0)
+        _backend_init_abort(
+            f"JAX backend init exceeded {deadline_s:.0f}s "
+            "(device tunnel outage?)")
     if "error" in box:
-        raise box["error"]
+        exc = box["error"]
+        _backend_init_abort(
+            f"JAX backend init failed: {type(exc).__name__}: {exc}")
     devices = box["devices"]
     log(f"preflight: backend up in {box['secs']:.1f}s — "
         f"{len(devices)}x {devices[0].platform}")

@@ -546,19 +546,16 @@ class InferenceServerClient:
                                       version=model_version),
             headers, as_json, client_timeout)
 
-    def get_events(self, model_name="", severity="", category="",
-                   since_seq=None, limit=None, headers=None,
-                   client_timeout=None):
-        """Structured event journal (gRPC mirror of ``GET /v2/events``).
-        Returns the same dict shape as the HTTP endpoint: ``events`` (each
-        with its ``detail`` decoded from JSON), ``next_seq``, ``dropped``."""
+    def _events_via(self, stub, model_name="", severity="", category="",
+                    since_seq=None, limit=None, headers=None,
+                    client_timeout=None):
         from client_tpu.protocol import ops_pb2 as ops
 
         request = ops.EventsRequest(
             model=model_name, severity=severity, category=category,
             since_seq=int(since_seq) if since_seq else 0,
             limit=int(limit) if limit else 0)
-        response = self._unary(self._client_stub.Events, request,
+        response = self._unary(stub.Events, request,
                                self._md(headers), client_timeout)
         events = []
         for e in response.events:
@@ -576,6 +573,16 @@ class InferenceServerClient:
             events.append(ev)
         return {"events": events, "next_seq": response.next_seq,
                 "dropped": response.dropped}
+
+    def get_events(self, model_name="", severity="", category="",
+                   since_seq=None, limit=None, headers=None,
+                   client_timeout=None):
+        """Structured event journal (gRPC mirror of ``GET /v2/events``).
+        Returns the same dict shape as the HTTP endpoint: ``events`` (each
+        with its ``detail`` decoded from JSON), ``next_seq``, ``dropped``."""
+        return self._events_via(self._client_stub, model_name, severity,
+                                category, since_seq, limit, headers,
+                                client_timeout)
 
     def get_slo_status(self, model_name="", headers=None,
                        client_timeout=None):
@@ -599,6 +606,57 @@ class InferenceServerClient:
             ops.ProfileRequest(model=model_name),
             self._md(headers), client_timeout)
         return json.loads(response.profile_json)
+
+    # -- fleet observability (client-side federation) -------------------------
+    # gRPC has no fronting router, so the multi-URL client federates the
+    # per-endpoint surfaces itself with the same merge semantics the
+    # router's /v2/fleet/* endpoints use (observability.fleet): the
+    # aggregate never fails on a dead endpoint — its error rides inline.
+
+    def _fleet_fan_out(self, fetch):
+        results: dict[str, dict] = {}
+        errors: dict[str, str] = {}
+        for url, _channel, stub in self._endpoints:
+            try:
+                results[url] = fetch(stub)
+            except Exception as exc:  # noqa: BLE001 — inline reporting
+                errors[url] = f"{type(exc).__name__}: {exc}"
+        return results, errors
+
+    def get_fleet_events(self, model_name="", severity="", category="",
+                         limit=None, headers=None, client_timeout=None):
+        """Every endpoint's event journal merged by wall stamp, each
+        event tagged with its endpoint url; ``cursors`` carries each
+        endpoint's ``next_seq`` (seq spaces are per-process)."""
+        from client_tpu.observability.fleet import merge_events
+
+        exports, errors = self._fleet_fan_out(
+            lambda stub: self._events_via(
+                stub, model_name, severity, category, None, limit,
+                headers, client_timeout))
+        return merge_events(exports, errors, limit=limit)
+
+    def get_fleet_profile(self, headers=None, client_timeout=None):
+        """Per-endpoint profiler snapshots plus fleet drift signals."""
+        from client_tpu.observability.fleet import merge_profiles
+        from client_tpu.protocol import ops_pb2 as ops
+
+        profiles, errors = self._fleet_fan_out(
+            lambda stub: json.loads(self._unary(
+                stub.Profile, ops.ProfileRequest(model=""),
+                self._md(headers), client_timeout).profile_json))
+        return merge_profiles(profiles, errors)
+
+    def get_fleet_slo(self, headers=None, client_timeout=None):
+        """Per-endpoint SLO reports plus the fleet's worst fast burn."""
+        from client_tpu.observability.fleet import merge_slo
+        from client_tpu.protocol import ops_pb2 as ops
+
+        exports, errors = self._fleet_fan_out(
+            lambda stub: json.loads(self._unary(
+                stub.SloStatus, ops.SloStatusRequest(model=""),
+                self._md(headers), client_timeout).slo_json))
+        return merge_slo(exports, errors)
 
     # -- shared memory -------------------------------------------------------
 

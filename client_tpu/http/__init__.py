@@ -867,6 +867,47 @@ class InferenceServerClient:
             qp["model"] = model_name
         return self._get_json("/v2/profile", qp or None, headers)
 
+    # -- fleet observability (router endpoints) ------------------------------
+
+    def get_fleet_events(self, limit=None, headers=None, query_params=None):
+        """Federated fleet event timeline (router ``GET
+        /v2/fleet/events``): every replica's journal merged by wall
+        stamp, each event tagged ``replica``, with per-replica
+        ``cursors`` and inline fetch ``errors``."""
+        qp = dict(query_params or {})
+        if limit is not None:
+            qp["limit"] = int(limit)
+        return self._get_json("/v2/fleet/events", qp or None, headers)
+
+    def get_fleet_profile(self, headers=None, query_params=None):
+        """Federated profiler view (router ``GET /v2/fleet/profile``):
+        per-replica snapshots plus fleet drift signals/scores."""
+        return self._get_json("/v2/fleet/profile", query_params, headers)
+
+    def get_fleet_slo(self, headers=None, query_params=None):
+        """Federated SLO view (router ``GET /v2/fleet/slo``)."""
+        return self._get_json("/v2/fleet/slo", query_params, headers)
+
+    def get_fleet_metrics(self, headers=None, query_params=None):
+        """Merged fleet exposition text (router ``GET
+        /v2/fleet/metrics``) — counters summed, level gauges maxed."""
+        resp, data = self._request("GET", "/v2/fleet/metrics",
+                                   headers=headers,
+                                   query_params=query_params)
+        self._raise_if_error(resp, data)
+        return data.decode("utf-8", "replace")
+
+    def get_stitched_trace(self, trace_id="", headers=None,
+                           query_params=None):
+        """Stitched fleet Chrome trace (router ``GET
+        /v2/trace/requests``): router spans + replica phase spans on
+        distinct tracks; pass the ``X-Tpu-Trace-Id`` echoed on any
+        routed response to narrow to one request."""
+        qp = dict(query_params or {})
+        if trace_id:
+            qp["trace_id"] = trace_id
+        return self._get_json("/v2/trace/requests", qp or None, headers)
+
     # -- inference -----------------------------------------------------------
 
     @staticmethod

@@ -21,6 +21,10 @@ Three pieces, threaded through every layer of the stack:
   profiler: batch-fill cost attribution, XLA compile telemetry, device
   duty-cycle (``GET /v2/profile``, ``tpu_batch_fill_ratio`` /
   ``tpu_xla_*`` / ``tpu_device_*`` families).
+- :mod:`client_tpu.observability.fleet` — fleet-level merges of the
+  per-replica surfaces (events/metrics/profile/slo) plus the drift
+  math behind ``tpu_fleet_drift_score`` (see
+  :mod:`client_tpu.router.fleet` for the router-side half).
 
 See docs/OBSERVABILITY.md for the metric vocabulary and wire formats.
 """
@@ -38,6 +42,16 @@ from client_tpu.observability.profiler import (  # noqa: F401
     profiler,
     reset_profiler,
 )
+from client_tpu.observability.fleet import (  # noqa: F401
+    FleetMonitorConfig,
+    drift_scores,
+    merge_events,
+    merge_expositions,
+    merge_profiles,
+    merge_slo,
+    parse_exposition,
+    profile_signals,
+)
 from client_tpu.observability.slo import SloConfig, SloTracker  # noqa: F401
 from client_tpu.observability.metrics import (  # noqa: F401
     BATCH_SIZE_BUCKETS,
@@ -50,8 +64,10 @@ from client_tpu.observability.metrics import (  # noqa: F401
     REGISTRY,
 )
 from client_tpu.observability.tracing import (  # noqa: F401
+    NamedSpan,
     RequestTrace,
     Span,
+    SpanStore,
     TraceContext,
     TraceStore,
     build_request_trace,

@@ -515,6 +515,17 @@ class RouterMetrics:
             "Rolling-drain steps executed, by replica and outcome "
             "(clean, dirty, timeout, skipped)",
             ("replica", "outcome"))
+        self.fleet_drift_score = r.gauge(
+            "tpu_fleet_drift_score",
+            "Per-replica drift from the fleet median, by signal "
+            "(duty_cycle, fill_ratio, wave_ms_p50, wait_s); unitless "
+            "|v-median|/max(|median|,floor) skew",
+            ("replica", "signal"))
+        self.fleet_fetch_failures = r.counter(
+            "tpu_fleet_fetch_failures_total",
+            "Per-replica fetch failures while federating a fleet "
+            "surface (events, profile, metrics, slo, trace)",
+            ("replica", "surface"))
 
     def render(self, openmetrics: bool = False) -> str:
         return self.registry.render(openmetrics)

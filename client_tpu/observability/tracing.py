@@ -195,6 +195,87 @@ class TraceStore:
         return json.dumps(self.to_chrome_trace(trace_id))
 
 
+@dataclass
+class NamedSpan:
+    """One free-form span: an interval with a name, optional span
+    identity, and Chrome-trace ``args``. Unlike the engine's phase
+    :class:`Span` (whose names are the fixed request phases), these are
+    recorded by intermediaries — the router's select/proxy/shed spans —
+    where the vocabulary is open."""
+
+    name: str
+    start_ns: int
+    end_ns: int
+    span_id: str = ""
+    parent_span_id: str = ""
+    args: dict = field(default_factory=dict)
+
+
+@dataclass
+class SpanGroup:
+    """All spans one component recorded for one trace id (one request's
+    router-side timeline)."""
+
+    trace_id: str
+    spans: list[NamedSpan]
+    wall_time_ms: int = 0
+
+
+class SpanStore:
+    """Bounded ring buffer of :class:`SpanGroup`s — the intermediary
+    (router) counterpart of :class:`TraceStore`. One ``add`` per routed
+    request; export is Chrome trace events the fleet stitcher merges
+    with the replicas' own ``/v2/trace/requests`` payloads."""
+
+    def __init__(self, capacity: int = 512):
+        self._buf: deque[SpanGroup] = deque(maxlen=max(1, capacity))
+        self._lock = threading.Lock()
+
+    def add(self, trace_id: str, spans: list[NamedSpan]) -> None:
+        if not spans:
+            return
+        with self._lock:
+            self._buf.append(SpanGroup(
+                trace_id=trace_id, spans=list(spans),
+                wall_time_ms=int(time.time() * 1000)))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def snapshot(self, trace_id: str | None = None) -> list[SpanGroup]:
+        with self._lock:
+            groups = list(self._buf)
+        if trace_id:
+            groups = [g for g in groups if g.trace_id == trace_id]
+        return groups
+
+    def to_chrome_events(self, trace_id: str | None = None,
+                         pid: int = 1) -> list[dict]:
+        """Chrome ``ph:"X"`` events; one tid per group so concurrent
+        requests stack as lanes on the component's track."""
+        events = []
+        for tid, g in enumerate(self.snapshot(trace_id), start=1):
+            for span in g.spans:
+                args = {"trace_id": g.trace_id}
+                if span.span_id:
+                    args["span_id"] = span.span_id
+                if span.parent_span_id:
+                    args["parent_span_id"] = span.parent_span_id
+                args.update(span.args)
+                events.append({
+                    "name": span.name,
+                    "cat": "router",
+                    "ph": "X",
+                    "ts": span.start_ns / 1e3,
+                    "dur": max(0.0, (span.end_ns - span.start_ns) / 1e3),
+                    "pid": pid,
+                    "tid": tid,
+                    "args": args,
+                })
+        return events
+
+
 def server_timing_header(times) -> str:
     """``Server-Timing`` response header (durations in ms per the spec).
     Requests that paid an XLA compile carry an extra ``compile`` entry so

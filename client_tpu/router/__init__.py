@@ -18,7 +18,11 @@ produce:
 * :func:`rolling_drain` walks replicas through their existing SIGTERM
   drain one at a time, readiness-gated;
 * :mod:`placement <client_tpu.router.placement>` turns ``/v2/profile``
-  device-seconds into a contention-aware model→replica plan.
+  device-seconds into a contention-aware model→replica plan;
+* :mod:`fleet <client_tpu.router.fleet>` is the fleet observability
+  plane: stitched cross-process traces (``GET /v2/trace/requests``),
+  federated ``/v2/fleet/*`` surfaces, and the background drift monitor
+  (``CLIENT_TPU_FLEET_MONITOR``).
 
 Use it in-process (``Router([...]).start()`` + ``forward``), or
 standalone::
@@ -38,6 +42,11 @@ from client_tpu.router.core import (
     replicas_from_hostlist,
 )
 from client_tpu.router.drain import rolling_drain
+from client_tpu.router.fleet import (
+    FleetFederator,
+    FleetMonitor,
+    stitched_trace,
+)
 from client_tpu.router.placement import (
     apply_placement,
     model_costs,
@@ -47,6 +56,8 @@ from client_tpu.router.placement import (
 from client_tpu.router.server import RouterHttpServer
 
 __all__ = [
+    "FleetFederator",
+    "FleetMonitor",
     "ProxyResponse",
     "Replica",
     "Router",
@@ -59,4 +70,5 @@ __all__ = [
     "rendezvous_pick",
     "replicas_from_hostlist",
     "rolling_drain",
+    "stitched_trace",
 ]

@@ -71,6 +71,9 @@ def main(argv: list[str] | None = None) -> int:
                     request_timeout_s=args.request_timeout)
     srv = RouterHttpServer(router, host=args.host, port=args.port,
                            verbose=args.verbose).start()
+    if srv.monitor is not None:
+        print("fleet monitor: "
+              f"{srv.monitor.config.summary()}", file=sys.stderr, flush=True)
     for r in router.replicas:
         state = r.load.state if r.load_age_s() != float("inf") else "UNKNOWN"
         print(f"replica {r.id}: {state}"

@@ -35,6 +35,7 @@ from __future__ import annotations
 import hashlib
 import json
 import logging
+import os
 import queue
 import random
 import threading
@@ -43,6 +44,12 @@ from http.client import BadStatusLine, HTTPConnection
 
 from client_tpu.observability.events import journal
 from client_tpu.observability.metrics import RouterMetrics
+from client_tpu.observability.tracing import (
+    NamedSpan,
+    SpanStore,
+    TraceContext,
+    new_span_id,
+)
 from client_tpu.protocol.loadreport import (
     LOAD_HEADER,
     LoadReport,
@@ -75,6 +82,10 @@ _HOP_HEADERS = frozenset((
 # aggregated minimum can never tell clients "retry immediately".
 _DEFAULT_PUSHBACK_S = 0.05
 
+# Router-side span ring capacity (one entry per routed request).
+ENV_TRACE_BUFFER = "CLIENT_TPU_ROUTER_TRACE_BUFFER"
+DEFAULT_TRACE_BUFFER = 512
+
 
 def normalize_replica_url(url: str) -> str:
     """``http://host:port/`` -> ``host:port`` (the replica id)."""
@@ -103,14 +114,17 @@ class ProxyResponse:
     header list, the body, and — for streaming proxying — an optional
     chunk iterator that replaces the body."""
 
-    __slots__ = ("status", "headers", "body", "stream", "replica_id")
+    __slots__ = ("status", "headers", "body", "stream", "replica_id",
+                 "trace_id")
 
-    def __init__(self, status, headers, body, stream=None, replica_id=None):
+    def __init__(self, status, headers, body, stream=None, replica_id=None,
+                 trace_id=None):
         self.status = status
         self.headers = headers  # list[(name, value)]
         self.body = body
         self.stream = stream
         self.replica_id = replica_id
+        self.trace_id = trace_id
 
     def header(self, name: str):
         lname = name.lower()
@@ -309,6 +323,12 @@ class Router:
         self.affinity = affinity
         self.request_timeout_s = request_timeout_s
         self.events = journal()
+        try:
+            trace_cap = int(os.environ.get(ENV_TRACE_BUFFER,
+                                           str(DEFAULT_TRACE_BUFFER)))
+        except ValueError:
+            trace_cap = DEFAULT_TRACE_BUFFER
+        self.spans = SpanStore(capacity=trace_cap)
         self._rng = random.Random(seed)
         self._poll_interval_s = poll_interval_s
         self._poll_thread: threading.Thread | None = None
@@ -422,35 +442,102 @@ class Router:
 
     def forward(self, method: str, path: str, headers=None, body=None,
                 sequence_id: int = 0, stream: bool = False,
-                trace_id: str | None = None) -> ProxyResponse:
+                trace_ctx: TraceContext | None = None) -> ProxyResponse:
         """Route one request. Tries candidates in selection order;
         transport failures trip the per-replica breaker and fail over;
         pushback (429/503 + Retry-After, or a DRAINING 503) marks the
         replica and fails over breaker-neutrally. Sheds only when every
         candidate pushed back — with the fleet's minimum Retry-After —
-        and answers 502 only when no replica was reachable at all."""
+        and answers 502 only when no replica was reachable at all.
+
+        Every call records the router's own spans (select, one proxy
+        span per attempt, the request root) into ``self.spans`` under
+        the request's trace id — adopted from the caller's
+        ``traceparent`` or generated here — and forwards a child
+        context downstream so replica phase spans parent onto the
+        attempt that carried them. The trace id is echoed on every
+        response (success, shed, or 502) as ``X-Tpu-Trace-Id``."""
         t0 = time.monotonic()
+        t0_ns = time.monotonic_ns()
+        ctx = trace_ctx
+        if ctx is None:
+            tp = next((v for k, v in (headers or {}).items()
+                       if k.lower() == "traceparent"), None)
+            ctx = TraceContext.from_traceparent(tp)
+        trace_id = ctx.trace_id
+        # The downstream header set; traceparent is re-stamped per
+        # attempt so each replica's spans hang off the attempt span.
+        fwd_headers = {k: v for k, v in (headers or {}).items()
+                       if k.lower() != "traceparent"}
+        spans: list[NamedSpan] = []
+
+        def finish(resp: ProxyResponse, outcome: str) -> ProxyResponse:
+            spans.append(NamedSpan(
+                "router:request", t0_ns, time.monotonic_ns(),
+                span_id=ctx.span_id, parent_span_id=ctx.parent_span_id,
+                args={"method": method, "path": path, "outcome": outcome,
+                      "status": resp.status,
+                      **({"replica": resp.replica_id}
+                         if resp.replica_id else {}),
+                      **({"sequence_id": sequence_id}
+                         if sequence_id else {})}))
+            self.spans.add(trace_id, spans)
+            resp.headers.append(("X-Tpu-Trace-Id", trace_id))
+            resp.trace_id = trace_id
+            return resp
+
         cands = self.candidates(sequence_id)
         pinned = bool(self.affinity and sequence_id and len(cands) > 1)
+        policy = ("none" if not cands else "single" if len(cands) == 1
+                  else "affinity" if pinned else "p2c")
+        spans.append(NamedSpan(
+            "router:select", t0_ns, time.monotonic_ns(),
+            span_id=new_span_id(), parent_span_id=ctx.span_id,
+            args={"policy": policy,
+                  "candidates": [r.id for r in cands]}))
         pushbacks: list[tuple[int, float]] = []
         last_5xx: ProxyResponse | None = None
         open_cooldowns: list[float] = []
-        for replica in cands:
+        for attempt, replica in enumerate(cands, start=1):
             try:
                 self.breaker.check(replica.id, trace_id)
             except CircuitBreakerOpenError as exc:
                 open_cooldowns.append(exc.cooldown_remaining_s)
+                now_ns = time.monotonic_ns()
+                spans.append(NamedSpan(
+                    "router:proxy", now_ns, now_ns,
+                    span_id=new_span_id(), parent_span_id=ctx.span_id,
+                    args={"replica": replica.id, "attempt": attempt,
+                          "outcome": "breaker_open"}))
                 continue
+            attempt_ctx = ctx.child()
+            fwd_headers["traceparent"] = attempt_ctx.to_traceparent()
+            a0_ns = time.monotonic_ns()
+
+            def attempt_span(outcome, status=None, *, replica=replica,
+                             attempt=attempt, attempt_ctx=attempt_ctx,
+                             a0_ns=a0_ns):
+                args = {"replica": replica.id, "attempt": attempt,
+                        "outcome": outcome}
+                if status is not None:
+                    args["status"] = status
+                spans.append(NamedSpan(
+                    "router:proxy", a0_ns, time.monotonic_ns(),
+                    span_id=attempt_ctx.span_id,
+                    parent_span_id=ctx.span_id, args=args))
+
             with replica._lock:
                 replica.outstanding += 1
             try:
                 if stream:
                     status, rhdrs, chunks = replica.send_stream(
-                        method, path, headers, body, self.request_timeout_s)
+                        method, path, fwd_headers, body,
+                        self.request_timeout_s)
                     data = b""
                 else:
                     status, rhdrs, data = replica.send(
-                        method, path, headers, body, self.request_timeout_s)
+                        method, path, fwd_headers, body,
+                        self.request_timeout_s)
                     chunks = None
             except Exception as exc:  # noqa: BLE001 — transport failure
                 with replica._lock:
@@ -459,6 +546,7 @@ class Router:
                 self.metrics.requests.inc(replica=replica.id,
                                           outcome="unreachable")
                 self.metrics.failovers.inc(replica=replica.id)
+                attempt_span("unreachable")
                 _log.debug("router: replica %s unreachable: %r",
                            replica.id, exc)
                 continue
@@ -501,6 +589,7 @@ class Router:
                 self.metrics.requests.inc(replica=replica.id,
                                           outcome="pushback")
                 self.metrics.failovers.inc(replica=replica.id)
+                attempt_span("pushback", status)
                 if stream:
                     for _ in chunks:  # release the connection
                         pass
@@ -514,6 +603,7 @@ class Router:
                 self.metrics.requests.inc(replica=replica.id,
                                           outcome="error")
                 self.metrics.failovers.inc(replica=replica.id)
+                attempt_span("error", status)
                 last_5xx = ProxyResponse(status, self._resp_headers(
                     rhdrs, replica), data, replica_id=replica.id)
                 if stream:
@@ -526,9 +616,21 @@ class Router:
                 self.metrics.affinity_routed.inc(replica=replica.id)
             self.metrics.request_duration_us.observe(
                 (time.monotonic() - t0) * 1e6, replica=replica.id)
-            return ProxyResponse(status, self._resp_headers(rhdrs, replica),
-                                 data, stream=chunks, replica_id=replica.id)
-        return self._exhausted(pushbacks, last_5xx, open_cooldowns, cands)
+            attempt_span("ok", status)
+            return finish(ProxyResponse(
+                status, self._resp_headers(rhdrs, replica), data,
+                stream=chunks, replica_id=replica.id), "ok")
+        resp = self._exhausted(pushbacks, last_5xx, open_cooldowns, cands)
+        outcome = ("shed" if resp.header("X-Router-Shed")
+                   else "error")
+        if outcome == "shed":
+            now_ns = time.monotonic_ns()
+            spans.append(NamedSpan(
+                "router:shed", now_ns, now_ns,
+                span_id=new_span_id(), parent_span_id=ctx.span_id,
+                args={"reason": resp.header("X-Router-Shed"),
+                      "status": resp.status}))
+        return finish(resp, outcome)
 
     @staticmethod
     def _resp_headers(rhdrs, replica) -> list:

@@ -16,6 +16,12 @@ Router-owned endpoints (never proxied):
 * ``POST /v2/router/drain`` — rolling drain walk (body:
   ``{"replicas": [...], "deadline_s": ...}``; replicas need pids or the
   walk is driven in-process through :mod:`client_tpu.router.drain`).
+* ``GET /v2/trace/requests`` — the *stitched* fleet trace: router spans
+  + every replica's request traces on distinct tracks
+  (``?trace_id=...`` narrows to one request end-to-end).
+* ``GET /v2/fleet/{events,profile,metrics,slo}`` — federated replica
+  surfaces (see :mod:`client_tpu.router.fleet`); per-replica fetch
+  failures are reported inline, never failing the aggregate.
 
 Everything else under ``/v2`` is forwarded through the selection policy.
 The sequence id for affinity comes from the ``X-Sequence-Id`` request
@@ -30,9 +36,16 @@ import logging
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
+from client_tpu.observability.fleet import FleetMonitorConfig
 from client_tpu.router.core import Router
 from client_tpu.router.drain import rolling_drain
+from client_tpu.router.fleet import (
+    FleetFederator,
+    FleetMonitor,
+    stitched_trace,
+)
 from client_tpu.router import placement as _placement
 
 _log = logging.getLogger("client_tpu")
@@ -51,6 +64,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
     disable_nagle_algorithm = True
     wbufsize = 64 * 1024
     router: Router = None  # patched on by RouterHttpServer
+    federator: FleetFederator = None
+    monitor: FleetMonitor | None = None
     verbose = False
 
     def log_message(self, fmt, *args):  # noqa: A003
@@ -156,11 +171,16 @@ class _RouterHandler(BaseHTTPRequestHandler):
 
     def h_get_v2_router_placement(self, body):
         costs, current, plan = self._placement_plan()
+        # Placement plans carry the fleet's observed drift so continuous
+        # re-placement (ROADMAP item 2) has evidence, not just costs.
+        drift = (self.monitor.drift_report() if self.monitor is not None
+                 else None)
         self._send_json({
             "costs_device_s": {m: round(c, 6) for m, c in costs.items()},
             "current": {rid: sorted(ms) for rid, ms in current.items()},
             "plan": plan,
             "moves": _placement.placement_moves(plan, current),
+            "drift": drift,
         })
 
     def h_post_v2_router_placement(self, body):
@@ -175,6 +195,44 @@ class _RouterHandler(BaseHTTPRequestHandler):
             deadline_s=float(opts.get("deadline_s", 30.0)))
         ok = all(r["outcome"] in ("clean", "gone") for r in reports)
         self._send_json({"reports": reports}, 200 if ok else 500)
+
+    # -- fleet observability -------------------------------------------------
+
+    def _query(self) -> dict[str, str]:
+        return {k: v[-1] for k, v in
+                parse_qs(urlsplit(self.path).query).items()}
+
+    def h_get_v2_trace_requests(self, body):
+        # Router-owned (never proxied): the stitched fleet trace.
+        # Per-replica raw traces stay reachable on the replicas directly.
+        q = self._query()
+        self._send_json(stitched_trace(self.router, self.federator,
+                                       trace_id=q.get("trace_id")))
+
+    def h_get_v2_fleet_events(self, body):
+        q = self._query()
+        limit = None
+        if "limit" in q:
+            try:
+                limit = int(q.pop("limit"))
+            except ValueError:
+                self._send_json({"error": "limit must be an integer"}, 400)
+                return
+        query = "&".join(f"{k}={v}" for k, v in q.items())
+        self._send_json(self.federator.events(query, limit=limit))
+
+    def h_get_v2_fleet_profile(self, body):
+        drift = (self.monitor.drift_report() if self.monitor is not None
+                 else None)
+        self._send_json(self.federator.profile(drift=drift))
+
+    def h_get_v2_fleet_slo(self, body):
+        self._send_json(self.federator.slo())
+
+    def h_get_v2_fleet_metrics(self, body):
+        text = self.federator.metrics_text()
+        self._send(200, text.encode("utf-8"),
+                   headers=[("Content-Type", "text/plain; version=0.0.4")])
 
     # -- the proxy path ------------------------------------------------------
 
@@ -201,14 +259,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
     def _proxy(self, method: str, body: bytes) -> None:
         path = self.path.split("?")[0]
         stream = bool(_STREAM_PATH.match(path))
-        trace_id = None
-        tp = self.headers.get("traceparent")
-        if tp and len(tp.split("-")) == 4:
-            trace_id = tp.split("-")[1]
+        # forward() adopts the caller's traceparent (or mints one),
+        # stamps a child context downstream per attempt, and echoes
+        # X-Tpu-Trace-Id on every response.
         out = self.router.forward(
             method, self.path, headers=dict(self.headers.items()),
             body=body, sequence_id=self._sequence_id(path, body),
-            stream=stream, trace_id=trace_id)
+            stream=stream)
         if out.stream is None:
             self._send(out.status, out.body, headers=out.headers)
             return
@@ -235,10 +292,18 @@ class RouterHttpServer:
     """Threaded standalone router frontend over a :class:`Router`."""
 
     def __init__(self, router: Router, host: str = "127.0.0.1",
-                 port: int = 8080, verbose: bool = False):
-        handler = type("BoundRouterHandler", (_RouterHandler,),
-                       {"router": router, "verbose": verbose})
+                 port: int = 8080, verbose: bool = False,
+                 monitor_config: FleetMonitorConfig | None = None):
         self.router = router
+        self.federator = FleetFederator(router)
+        if monitor_config is None:
+            monitor_config = FleetMonitorConfig.from_env()
+        self.monitor = (FleetMonitor(router, monitor_config,
+                                     self.federator)
+                        if monitor_config is not None else None)
+        handler = type("BoundRouterHandler", (_RouterHandler,),
+                       {"router": router, "federator": self.federator,
+                        "monitor": self.monitor, "verbose": verbose})
         server_cls = type("_RouterHttpd", (ThreadingHTTPServer,),
                           {"request_queue_size": 128})
         self.httpd = server_cls((host, port), handler)
@@ -255,6 +320,8 @@ class RouterHttpServer:
 
     def start(self) -> "RouterHttpServer":
         self.router.start()
+        if self.monitor is not None:
+            self.monitor.start()
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, name="router-http",
             daemon=True)
@@ -262,6 +329,8 @@ class RouterHttpServer:
         return self
 
     def stop(self) -> None:
+        if self.monitor is not None:
+            self.monitor.stop()
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:

@@ -157,6 +157,18 @@ class _Handler(BaseHTTPRequestHandler):
             pass
         except Exception as exc:  # noqa: BLE001
             self._send_error(500, f"internal error: {exc}")
+        finally:
+            # A draining server must shed its keep-alive sockets: the
+            # accept loop is already stopped, so a pooled client (the L7
+            # router, a probe loop) holding a live connection would keep
+            # this "drained" frontend answering indefinitely. Closing
+            # after the in-flight response is what lets the fleet
+            # observe the replica as gone.
+            try:
+                if not self.engine.is_ready():
+                    self.close_connection = True
+            except Exception:  # noqa: BLE001 — health probe must not
+                pass           # break the response already sent
 
     def do_GET(self):  # noqa: N802
         self._dispatch("GET")

@@ -3,8 +3,9 @@
 Runs the real bench entry point as a subprocess with the simulated-hang
 knob and asserts the three failure-mode contracts:
 
-- backend-init hang -> ``status: "unavailable"`` within the init deadline
-  (an outage must be distinguishable from a perf collapse);
+- backend-init hang -> ``status: "backend_init_error"`` within the init
+  deadline and a NONZERO exit (an outage must be distinguishable from a
+  perf collapse, and a driver must not file it as a green run);
 - mid-run hang -> watchdog emits ``status: "partial-outage"`` carrying the
   sections that DID complete, and those sections' evidence has already been
   persisted to BENCH_HISTORY incrementally;
@@ -26,7 +27,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 
 
-def run_bench(tmp_path, extra_env, timeout=240):
+def run_bench(tmp_path, extra_env, timeout=240, expect_rc=None):
     hist = tmp_path / "hist.json"
     env = dict(os.environ)
     env.update({
@@ -38,6 +39,10 @@ def run_bench(tmp_path, extra_env, timeout=240):
     proc = subprocess.run(
         [sys.executable, BENCH], capture_output=True, text=True,
         timeout=timeout, env=env, cwd=REPO)
+    if expect_rc is not None:
+        assert proc.returncode == expect_rc, (
+            f"expected rc={expect_rc}, got {proc.returncode}\n"
+            f"stderr tail: {proc.stderr[-2000:]}")
     lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
     assert len(lines) == 1, (
         f"expected exactly one stdout JSON line, got {lines!r}\n"
@@ -47,17 +52,20 @@ def run_bench(tmp_path, extra_env, timeout=240):
     return out, history
 
 
-def test_init_hang_reports_unavailable(tmp_path):
+def test_init_hang_aborts_with_backend_init_error(tmp_path):
+    # Round-6 contract: an init outage fails FAST with an unambiguous
+    # diagnostic and a nonzero exit — rounds 4/5 each recorded a hollow
+    # "unavailable" run (rc=0) that sat in the baseline looking like data.
     out, history = run_bench(tmp_path, {
         "BENCH_SIMULATE_HANG": "init",
         "BENCH_INIT_DEADLINE_S": "3",
-    })
-    assert out["status"] == "unavailable"
+    }, expect_rc=3)
+    assert out["status"] == "backend_init_error"
     assert out["value"] == 0.0  # numeric for the driver schema
     assert "init exceeded" in out["reason"]
     # the outage itself is on the record
     assert any(h.get("probe") == "run-status"
-               and h.get("status") == "unavailable" for h in history)
+               and h.get("status") == "backend_init_error" for h in history)
 
 
 def test_midrun_hang_emits_partial_with_completed_sections(tmp_path):

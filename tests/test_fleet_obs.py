@@ -1,0 +1,605 @@
+"""Fleet observability plane: cross-process trace stitching through the
+router, federated /v2/fleet/* surfaces, and drift detection.
+
+Units cover the pure merge/drift math (observability.fleet), the
+router-side span ring (SpanStore), monitor config parsing, and the
+FleetMonitor's edge-triggered flagging with injected signals. The e2e
+half runs two real in-process engines behind a real RouterHttpServer
+and asserts the acceptance path: an infer with NO client traceparent
+resolves — via the echoed ``X-Tpu-Trace-Id`` and the router's stitched
+``GET /v2/trace/requests`` — to one tree holding the router's
+select/proxy spans plus the serving replica's phase spans (and only
+that replica's), including the failover case where the attempt-1 span
+survives on the dead replica's track.
+"""
+
+import importlib.util
+import json
+import os
+import urllib.request
+
+import pytest
+
+import client_tpu.http as httpclient
+from client_tpu.engine import TpuEngine
+from client_tpu.models import build_repository
+from client_tpu.observability import scrape
+from client_tpu.observability.events import journal
+from client_tpu.observability.fleet import (
+    FleetMonitorConfig,
+    drift_scores,
+    fleet_median,
+    merge_events,
+    merge_expositions,
+    merge_profiles,
+    merge_slo,
+    parse_exposition,
+    profile_signals,
+)
+from client_tpu.observability.tracing import NamedSpan, SpanStore
+from client_tpu.protocol.loadreport import LoadReport
+from client_tpu.resilience import CircuitBreaker
+from client_tpu.router import (
+    FleetFederator,
+    FleetMonitor,
+    Replica,
+    Router,
+    RouterHttpServer,
+)
+from client_tpu.server import HttpInferenceServer
+
+
+def _load_promlint():
+    spec = importlib.util.spec_from_file_location(
+        "promlint", os.path.join(os.path.dirname(__file__), "..",
+                                 "tools", "promlint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+promlint = _load_promlint()
+
+
+# ---------------------------------------------------------------------------
+# SpanStore
+
+
+class TestSpanStore:
+    def test_ring_bound_and_filter(self):
+        store = SpanStore(capacity=3)
+        for i in range(5):
+            store.add(f"t{i}", [NamedSpan("router:request", 10, 20)])
+        assert len(store) == 3
+        assert store.snapshot("t0") == []
+        assert len(store.snapshot("t4")) == 1
+
+    def test_empty_add_ignored(self):
+        store = SpanStore()
+        store.add("t", [])
+        assert len(store) == 0
+
+    def test_chrome_events_carry_identity(self):
+        store = SpanStore()
+        store.add("t1", [NamedSpan("router:proxy", 1000, 3000,
+                                   span_id="ab" * 8,
+                                   parent_span_id="cd" * 8,
+                                   args={"replica": "h:1"})])
+        (evt,) = store.to_chrome_events("t1")
+        assert evt["ph"] == "X" and evt["dur"] == 2.0
+        assert evt["args"]["span_id"] == "ab" * 8
+        assert evt["args"]["parent_span_id"] == "cd" * 8
+        assert evt["args"]["replica"] == "h:1"
+        assert evt["args"]["trace_id"] == "t1"
+
+
+# ---------------------------------------------------------------------------
+# Exposition parse + merge
+
+
+_EXPO = """\
+# HELP tpu_reqs_total requests
+# TYPE tpu_reqs_total counter
+tpu_reqs_total{replica="a"} 3
+# TYPE tpu_device_duty_cycle gauge
+tpu_device_duty_cycle 0.2
+# TYPE tpu_inflight gauge
+tpu_inflight 4
+# TYPE tpu_lat_us histogram
+tpu_lat_us_bucket{le="10"} 1
+tpu_lat_us_bucket{le="+Inf"} 2
+tpu_lat_us_sum 15
+tpu_lat_us_count 2
+"""
+
+
+class TestExpositionMerge:
+    def test_parse_families_and_samples(self):
+        fams = parse_exposition(_EXPO)
+        assert fams["tpu_reqs_total"]["type"] == "counter"
+        assert fams["tpu_reqs_total"]["help"] == "requests"
+        # _bucket/_sum/_count attach to the histogram family.
+        assert len(fams["tpu_lat_us"]["samples"]) == 4
+
+    def test_counters_and_histograms_sum(self):
+        other = _EXPO.replace(" 3", " 5").replace("0.2", "0.6")
+        merged = merge_expositions({"r1": _EXPO, "r2": other})
+        fams = parse_exposition(merged)
+        by_name = {s[0]: s[2] for s in fams["tpu_reqs_total"]["samples"]}
+        assert by_name["tpu_reqs_total"] == 8
+        by_name = {(s[0], s[1].get("le")): s[2]
+                   for s in fams["tpu_lat_us"]["samples"]}
+        assert by_name[("tpu_lat_us_bucket", "+Inf")] == 4
+        assert by_name[("tpu_lat_us_count", None)] == 4
+
+    def test_level_gauges_max_plain_gauges_sum(self):
+        other = _EXPO.replace("0.2", "0.6").replace(
+            "tpu_inflight 4", "tpu_inflight 6")
+        merged = parse_exposition(
+            merge_expositions({"r1": _EXPO, "r2": other}))
+        duty = merged["tpu_device_duty_cycle"]["samples"][0][2]
+        assert duty == 0.6  # worst replica, not the sum
+        assert merged["tpu_inflight"]["samples"][0][2] == 10  # a total
+
+    def test_merged_text_passes_promlint(self):
+        assert promlint.lint(
+            merge_expositions({"r1": _EXPO}), openmetrics=False) == []
+
+
+# ---------------------------------------------------------------------------
+# Events merge
+
+
+class TestMergeEvents:
+    def _export(self, replica_ts):
+        return {"events": [{"seq": i + 1, "ts_wall": ts, "category": "x",
+                            "name": "e", "severity": "INFO"}
+                           for i, ts in enumerate(replica_ts)],
+                "next_seq": len(replica_ts), "dropped": 0}
+
+    def test_tagged_sorted_with_cursors(self):
+        out = merge_events({"b": self._export([2.0, 4.0]),
+                            "a": self._export([1.0, 3.0])})
+        assert [e["ts_wall"] for e in out["events"]] == [1.0, 2.0, 3.0, 4.0]
+        assert [e["replica"] for e in out["events"]] == ["a", "b", "a", "b"]
+        assert out["cursors"] == {"a": 2, "b": 2}
+        assert out["errors"] == {}
+
+    def test_errors_inline_and_limit(self):
+        out = merge_events({"a": self._export([1.0, 2.0, 3.0])},
+                           errors={"b": "ConnectionRefusedError: x"},
+                           limit=2)
+        assert len(out["events"]) == 2
+        assert out["events"][0]["ts_wall"] == 2.0  # newest kept
+        assert "b" in out["errors"]
+
+
+# ---------------------------------------------------------------------------
+# Drift math
+
+
+class TestDriftMath:
+    def test_profile_signals_extraction(self):
+        profile = {
+            "duty_cycle": 0.4,
+            "models": {"m": {
+                "buckets": [{"rows": 60, "padded_rows": 100},
+                            {"rows": 30, "padded_rows": 40}],
+                "decode_waves": [{"waves": 10, "wave_ms_p50": 4.0},
+                                 {"waves": 30, "wave_ms_p50": 8.0}],
+            }},
+        }
+        s = profile_signals(profile, {"wait_s": 0.25})
+        assert s["duty_cycle"] == 0.4
+        assert s["fill_ratio"] == pytest.approx(90 / 140)
+        assert s["wave_ms_p50"] == pytest.approx(7.0)
+        assert s["wait_s"] == 0.25
+
+    def test_signals_without_evidence_omitted(self):
+        assert profile_signals({"models": {}}) == {}
+        assert profile_signals(None, None) == {}
+
+    def test_median(self):
+        assert fleet_median([]) == 0.0
+        assert fleet_median([3.0]) == 3.0
+        assert fleet_median([1.0, 2.0, 10.0]) == 2.0
+        assert fleet_median([1.0, 3.0]) == 2.0
+
+    def test_scores_normalized_by_median(self):
+        scores, medians = drift_scores({
+            "a": {"duty_cycle": 0.2}, "b": {"duty_cycle": 0.2},
+            "c": {"duty_cycle": 0.8}})
+        assert medians["duty_cycle"] == 0.2
+        assert scores["a"]["duty_cycle"] == 0.0
+        assert scores["c"]["duty_cycle"] == pytest.approx(3.0)
+
+    def test_floor_damps_idle_noise(self):
+        # Median 0: the floor keeps tiny absolute jitter from scoring
+        # as huge relative drift.
+        scores, _ = drift_scores({"a": {"wait_s": 0.0},
+                                  "b": {"wait_s": 0.0},
+                                  "c": {"wait_s": 0.01}})
+        assert scores["c"]["wait_s"] == pytest.approx(0.01 / 0.05)
+
+    def test_single_reporter_skipped(self):
+        scores, medians = drift_scores({"a": {"duty_cycle": 0.9},
+                                        "b": {}})
+        assert scores["a"] == {} and medians == {}
+
+
+# ---------------------------------------------------------------------------
+# Monitor config
+
+
+class TestFleetMonitorConfig:
+    def test_disabled_and_defaults(self):
+        assert FleetMonitorConfig.from_env(environ={}) is None
+        assert FleetMonitorConfig.from_env(
+            environ={"CLIENT_TPU_FLEET_MONITOR": "off"}) is None
+        cfg = FleetMonitorConfig.from_env(
+            environ={"CLIENT_TPU_FLEET_MONITOR": "1"})
+        assert cfg.interval_s == 5.0 and cfg.threshold == 0.5
+
+    def test_inline_json(self):
+        cfg = FleetMonitorConfig.from_env(environ={
+            "CLIENT_TPU_FLEET_MONITOR":
+                '{"interval_s": 0.2, "threshold": 2.5}'})
+        assert cfg.interval_s == 0.2 and cfg.threshold == 2.5
+
+    def test_unknown_key_and_bad_values_fail_fast(self):
+        with pytest.raises(ValueError, match="unknown key"):
+            FleetMonitorConfig.from_dict({"intervall_s": 1})
+        with pytest.raises(ValueError, match="expects a number"):
+            FleetMonitorConfig.from_dict({"threshold": "hot"})
+        with pytest.raises(ValueError, match="threshold"):
+            FleetMonitorConfig.from_dict({"threshold": 0})
+        with pytest.raises(ValueError, match="invalid JSON"):
+            FleetMonitorConfig.from_env(
+                environ={"CLIENT_TPU_FLEET_MONITOR": "{nope"})
+
+
+# ---------------------------------------------------------------------------
+# FleetMonitor (injected signals — profiler is process-global, so true
+# cross-replica skew needs either injection or subprocess replicas)
+
+
+class TestFleetMonitor:
+    # Three replicas on purpose: with two, the median sits midway and
+    # any skew flags BOTH sides; a 3-fleet isolates the one outlier.
+    def _monitor(self, threshold=0.5):
+        router = Router([Replica("127.0.0.1:1"), Replica("127.0.0.1:2"),
+                         Replica("127.0.0.1:3")],
+                        seed=7, poll_interval_s=3600.0)
+        cfg = FleetMonitorConfig(interval_s=3600.0, threshold=threshold)
+        return router, FleetMonitor(router, cfg)
+
+    def _drift_events(self, since):
+        return [e for e in journal().snapshot(category="fleet",
+                                              since_seq=since)
+                if e.name in ("drift", "drift_cleared")]
+
+    def test_skew_flags_gauge_and_event_edge_triggered(self):
+        router, monitor = self._monitor()
+        mark = journal().export()["next_seq"]
+        skewed = {"127.0.0.1:1": {"wait_s": 0.1},
+                  "127.0.0.1:2": {"wait_s": 0.1},
+                  "127.0.0.1:3": {"wait_s": 2.0}}
+        report = monitor.tick(signals=skewed)
+        assert list(report["flagged"]) == ["127.0.0.1:3"]
+        assert report["flagged"]["127.0.0.1:3"]["wait_s"] > 0.5
+        samples = scrape.parse_samples(router.metrics.render())
+        drift = {s[1]["replica"]: s[2] for s in samples
+                 if s[0] == "tpu_fleet_drift_score"}
+        assert drift["127.0.0.1:3"] > 0.5
+        assert drift["127.0.0.1:1"] == 0.0
+        evts = self._drift_events(mark)
+        assert [e.name for e in evts] == ["drift"]
+        assert evts[0].severity == "WARNING"
+        assert evts[0].detail["replica"] == "127.0.0.1:3"
+        # Same skew again: still flagged, but no duplicate event.
+        monitor.tick(signals=skewed)
+        assert [e.name for e in self._drift_events(mark)] == ["drift"]
+
+    def test_recovery_emits_cleared(self):
+        router, monitor = self._monitor()
+        mark = journal().export()["next_seq"]
+        monitor.tick(signals={"127.0.0.1:1": {"wait_s": 0.1},
+                              "127.0.0.1:2": {"wait_s": 0.1},
+                              "127.0.0.1:3": {"wait_s": 2.0}})
+        report = monitor.tick(signals={"127.0.0.1:1": {"wait_s": 0.1},
+                                       "127.0.0.1:2": {"wait_s": 0.1},
+                                       "127.0.0.1:3": {"wait_s": 0.1}})
+        assert report["flagged"] == {}
+        assert [e.name for e in self._drift_events(mark)] == \
+            ["drift", "drift_cleared"]
+
+    def test_small_fleet_skipped(self):
+        router = Router([Replica("127.0.0.1:1")], poll_interval_s=3600.0)
+        monitor = FleetMonitor(router, FleetMonitorConfig(
+            interval_s=3600.0))
+        assert monitor.tick()["skipped"] == "fleet too small"
+
+    def test_router_metrics_pass_promlint_both_dialects(self):
+        router, monitor = self._monitor()
+        monitor.tick(signals={"127.0.0.1:1": {"wait_s": 0.0},
+                              "127.0.0.1:2": {"wait_s": 0.0},
+                              "127.0.0.1:3": {"wait_s": 1.0}})
+        for om in (False, True):
+            text = router.metrics.render(openmetrics=om)
+            assert "tpu_fleet_drift_score" in text
+            assert promlint.lint(text, openmetrics=om) == []
+
+
+# ---------------------------------------------------------------------------
+# E2E: two in-process engines behind a real router frontend
+
+pytestmark_e2e = pytest.mark.chaos
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    engines, servers = [], []
+    for _ in range(2):
+        eng = TpuEngine(build_repository(["simple"]))
+        engines.append(eng)
+        servers.append(HttpInferenceServer(eng, port=0).start())
+    router = Router([Replica(s.url) for s in servers], seed=42,
+                    poll_interval_s=3600.0)
+    front = RouterHttpServer(router, port=0).start()
+    yield {"engines": engines, "servers": servers, "router": router,
+           "front": front}
+    front.stop()
+    for s in servers:
+        s.stop()
+    for e in engines:
+        e.shutdown()
+
+
+def _infer_body():
+    data = list(range(16))
+    return json.dumps({
+        "inputs": [
+            {"name": "INPUT0", "shape": [1, 16], "datatype": "INT32",
+             "data": data},
+            {"name": "INPUT1", "shape": [1, 16], "datatype": "INT32",
+             "data": [1] * 16},
+        ]}).encode()
+
+
+def _post(url, path, body, headers=None):
+    req = urllib.request.Request(f"http://{url}{path}", data=body,
+                                 headers=headers or {}, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+def _get_json(url, path):
+    with urllib.request.urlopen(f"http://{url}{path}", timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.mark.chaos
+class TestStitchedTraceE2E:
+    def test_no_client_traceparent_resolves_to_stitched_tree(self, fleet):
+        front = fleet["front"]
+        # Raw urllib on purpose: the library client would stamp its own
+        # traceparent; the acceptance path is a client that sends none.
+        status, headers, _ = _post(front.url, "/v2/models/simple/infer",
+                                   _infer_body())
+        assert status == 200
+        trace_id = headers.get("X-Tpu-Trace-Id")
+        serving = headers.get("X-Tpu-Replica")
+        assert trace_id and len(trace_id) == 32
+        assert serving in [r.id for r in fleet["router"].replicas]
+
+        doc = _get_json(front.url, f"/v2/trace/requests?trace_id={trace_id}")
+        events = doc["traceEvents"]
+        assert doc["errors"] == {}
+        names = {e["name"] for e in events}
+        assert {"router:request", "router:select",
+                "router:proxy"} <= names
+
+        pid_of = {e["args"]["name"]: e["pid"] for e in events
+                  if e.get("ph") == "M"}
+        serving_pid = pid_of[f"replica {serving}"]
+        other = next(r.id for r in fleet["router"].replicas
+                     if r.id != serving)
+        other_pid = pid_of[f"replica {other}"]
+
+        # Router spans carry the chosen replica id; the proxy span is
+        # drawn on the serving replica's track.
+        root = next(e for e in events if e["name"] == "router:request")
+        assert root["args"]["replica"] == serving
+        assert root["args"]["outcome"] == "ok"
+        proxy = next(e for e in events if e["name"] == "router:proxy")
+        assert proxy["pid"] == serving_pid
+        # The serving replica contributed its phase spans; the idle
+        # replica's track holds nothing for this trace id.
+        serving_phases = {e["name"] for e in events
+                          if e["pid"] == serving_pid and e.get("ph") == "X"}
+        assert {"queue", "compute_infer", "simple:request"} <= \
+            serving_phases
+        assert not any(e["pid"] == other_pid and e.get("ph") == "X"
+                       for e in events)
+
+    def test_replica_spans_parent_onto_router_attempt(self, fleet):
+        front = fleet["front"]
+        _, headers, _ = _post(front.url, "/v2/models/simple/infer",
+                              _infer_body())
+        trace_id = headers["X-Tpu-Trace-Id"]
+        doc = _get_json(front.url, f"/v2/trace/requests?trace_id={trace_id}")
+        events = doc["traceEvents"]
+        proxy = next(e for e in events if e["name"] == "router:proxy")
+        replica_root = next(e for e in events
+                            if e["name"] == "simple:request")
+        # The replica adopted the per-attempt child context: its root
+        # span's parent is the router's proxy span.
+        assert replica_root["args"]["parent_span_id"] == \
+            proxy["args"]["span_id"]
+        root = next(e for e in events if e["name"] == "router:request")
+        assert proxy["args"]["parent_span_id"] == root["args"]["span_id"]
+
+    def test_client_traceparent_adopted_and_echoed(self, fleet):
+        front = fleet["front"]
+        tid = "f1" * 16
+        _, headers, _ = _post(
+            front.url, "/v2/models/simple/infer", _infer_body(),
+            headers={"traceparent": f"00-{tid}-{'0a' * 8}-01"})
+        assert headers["X-Tpu-Trace-Id"] == tid
+
+    def test_shed_response_carries_trace_id(self, fleet):
+        router = fleet["router"]
+        for r in router.replicas:
+            r.quiesced = True
+        try:
+            out = router.forward("POST", "/v2/models/simple/infer",
+                                 body=_infer_body())
+            assert out.status == 502
+            assert out.trace_id and out.header("X-Tpu-Trace-Id")
+        finally:
+            for r in router.replicas:
+                r.quiesced = False
+
+
+@pytest.mark.chaos
+class TestFailoverStitchE2E:
+    def test_attempt1_span_survives_on_dead_replica_track(self, fleet):
+        live = fleet["servers"][0].url
+        # A dead address (bind+close to find a free port nothing owns)
+        # plus a lenient breaker so the dead replica keeps being tried.
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        router = Router([Replica(live), Replica(dead)], seed=3,
+                        poll_interval_s=3600.0,
+                        breaker=CircuitBreaker(failure_threshold=100,
+                                               cooldown_s=0.01))
+        front = RouterHttpServer(router, port=0).start()
+        try:
+            stitched = None
+            for _ in range(20):
+                status, headers, _ = _post(
+                    front.url, "/v2/models/simple/infer", _infer_body())
+                assert status == 200
+                doc = _get_json(front.url, "/v2/trace/requests?trace_id="
+                                + headers["X-Tpu-Trace-Id"])
+                outcomes = {e["args"]["outcome"]
+                            for e in doc["traceEvents"]
+                            if e["name"] == "router:proxy"}
+                if outcomes == {"unreachable", "ok"}:
+                    stitched = doc
+                    break
+            assert stitched, "no request ever tried the dead replica first"
+            pid_of = {e["args"]["name"]: e["pid"]
+                      for e in stitched["traceEvents"] if e.get("ph") == "M"}
+            dead_pid, live_pid = pid_of[f"replica {dead}"], \
+                pid_of[f"replica {live}"]
+            failed = next(e for e in stitched["traceEvents"]
+                          if e["name"] == "router:proxy"
+                          and e["args"]["outcome"] == "unreachable")
+            ok = next(e for e in stitched["traceEvents"]
+                      if e["name"] == "router:proxy"
+                      and e["args"]["outcome"] == "ok")
+            assert failed["pid"] == dead_pid  # survives on the dead track
+            assert failed["args"]["attempt"] == 1
+            assert ok["pid"] == live_pid
+            assert ok["args"]["attempt"] == 2
+            # The dead replica's trace fetch failed inline, not fatally.
+            assert dead in stitched["errors"]
+        finally:
+            front.stop()
+
+
+@pytest.mark.chaos
+class TestFleetEndpointsE2E:
+    def test_fleet_profile_reports_per_replica_rows(self, fleet):
+        front, router = fleet["front"], fleet["router"]
+        _post(front.url, "/v2/models/simple/infer", _infer_body())
+        doc = _get_json(front.url, "/v2/fleet/profile")
+        assert set(doc["replicas"]) == {r.id for r in router.replicas}
+        assert doc["fleet"]["replica_count"] == 2
+        assert set(doc["fleet"]["signals"]) == set(doc["replicas"])
+        assert doc["errors"] == {}
+
+    def test_fleet_events_tagged_and_cursored(self, fleet):
+        front, router = fleet["front"], fleet["router"]
+        doc = _get_json(front.url, "/v2/fleet/events?limit=50")
+        assert set(doc["cursors"]) == {r.id for r in router.replicas}
+        assert doc["events"], "fleet journal empty"
+        assert all(e["replica"] in doc["cursors"] for e in doc["events"])
+        stamps = [e["ts_wall"] for e in doc["events"]]
+        assert stamps == sorted(stamps)
+
+    def test_fleet_metrics_merged_and_linted(self, fleet):
+        front = fleet["front"]
+        with urllib.request.urlopen(
+                f"http://{front.url}/v2/fleet/metrics", timeout=30) as resp:
+            text = resp.read().decode()
+        assert "# fleet replicas=2 merged=2 errors=0" in text
+        assert "tpu_inference_request_success" in text
+        body = "\n".join(line for line in text.splitlines()
+                         if not line.startswith("# fleet"))
+        assert promlint.lint(body, openmetrics=False) == []
+
+    def test_fleet_slo_reports_worst_burn(self, fleet):
+        doc = _get_json(fleet["front"].url, "/v2/fleet/slo")
+        assert set(doc["replicas"]) == \
+            {r.id for r in fleet["router"].replicas}
+        assert "worst" in doc
+
+    def test_dead_replica_degrades_not_fails(self, fleet):
+        live = fleet["servers"][0].url
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        router = Router([Replica(live), Replica(dead)], seed=5,
+                        poll_interval_s=3600.0)
+        front = RouterHttpServer(router, port=0).start()
+        try:
+            for path in ("/v2/fleet/profile", "/v2/fleet/events",
+                         "/v2/fleet/slo"):
+                doc = _get_json(front.url, path)
+                assert dead in doc["errors"], path
+            samples = scrape.parse_samples(router.metrics.render())
+            fails = [s for s in samples
+                     if s[0] == "tpu_fleet_fetch_failures_total"
+                     and s[1].get("replica") == dead]
+            assert fails and sum(v for _, _, v in fails) >= 3
+        finally:
+            front.stop()
+
+    def test_placement_plan_carries_drift(self, fleet):
+        router = fleet["router"]
+        cfg = FleetMonitorConfig(interval_s=3600.0, threshold=0.5)
+        front = RouterHttpServer(router, port=0, monitor_config=cfg)
+        front.start()
+        try:
+            front.monitor.tick(signals={
+                router.replicas[0].id: {"wait_s": 0.1},
+                router.replicas[1].id: {"wait_s": 3.0}})
+            doc = _get_json(front.url, "/v2/router/placement")
+            assert doc["drift"]["flagged"], "placement plan missing drift"
+            prof = _get_json(front.url, "/v2/fleet/profile")
+            assert prof["drift"]["flagged"]
+        finally:
+            front.stop()
+
+    def test_monitor_collects_wait_signal_from_load_reports(self, fleet):
+        # End-to-end signal path minus injection: the monitor reads the
+        # router's per-replica load view (wait_s is per-engine even when
+        # the profiler singleton is shared in-process).
+        router = fleet["router"]
+        monitor = FleetMonitor(
+            router, FleetMonitorConfig(interval_s=3600.0, threshold=0.5),
+            FleetFederator(router))
+        router.replicas[0].observe_report(LoadReport(wait_s=0.01))
+        router.replicas[1].observe_report(LoadReport(wait_s=4.0))
+        report = monitor.tick()
+        assert router.replicas[1].id in report["flagged"]
+        assert "wait_s" in report["flagged"][router.replicas[1].id]

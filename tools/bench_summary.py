@@ -24,17 +24,18 @@ import time
 def _probe_runs(hist: list) -> dict:
     """{run_ts: {probe: record}} for probe records (run-status excluded).
 
-    Records with ``status: "unavailable"`` (backend init timed out, the
-    probe emitted a 0.0 placeholder — see BENCH_r05.json) are dropped:
-    an outage run carries no performance signal, and letting its zeros
-    into the p99/ips medians would mask real regressions."""
+    Records with ``status: "unavailable"`` (the pre-r06 placeholder for
+    backend-init outages — see BENCH_r05.json) or
+    ``status: "backend_init_error"`` (the r06+ fail-fast diagnostic) are
+    dropped: an outage run carries no performance signal, and letting
+    its zeros into the p99/ips medians would mask real regressions."""
     runs: dict = {}
     for rec in hist:
         if not isinstance(rec, dict) or rec.get("run_ts") is None:
             continue
         if rec.get("probe") in (None, "run-status"):
             continue
-        if rec.get("status") == "unavailable":
+        if rec.get("status") in ("unavailable", "backend_init_error"):
             continue
         runs.setdefault(rec["run_ts"], {})[rec["probe"]] = rec
     return runs
@@ -127,8 +128,8 @@ def main() -> int:
               f"config={first.get('config')} ({len(recs)} records)")
         for rec in recs:
             probe = rec.get("probe", "?")
-            if rec.get("status") == "unavailable":
-                print(f"  {probe}: UNAVAILABLE "
+            if rec.get("status") in ("unavailable", "backend_init_error"):
+                print(f"  {probe}: {rec['status'].upper()} "
                       f"({rec.get('reason', 'no reason recorded')}) "
                       "— excluded from medians")
                 continue

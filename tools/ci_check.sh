@@ -25,9 +25,13 @@
 #      both exposition dialects.
 #   5. router e2e: two in-process replicas behind the standalone L7
 #      router — drive traffic through the proxy (both replicas must
-#      receive some), smoke /v2/load, roll-drain one replica with live
-#      in-process drain (survivor keeps serving), and lint tpu_router_*
-#      in both exposition dialects.
+#      receive some), smoke /v2/load + /v2/fleet/profile +
+#      /v2/fleet/events, round-trip one stitched trace (router spans +
+#      the serving replica's phase spans under one trace id), induce
+#      load-report skew and assert tpu_fleet_drift_score crosses the
+#      monitor threshold, roll-drain one replica with live in-process
+#      drain (survivor keeps serving), and lint tpu_router_* and the
+#      fleet drift gauge in both exposition dialects.
 #   6. fused kernel parity: the Pallas decode-kernel suite
 #      (tests/test_ops.py) in interpret mode, then a fused-path engine
 #      driven end to end so tpu_decode_wave_seconds renders and lints
@@ -213,7 +217,7 @@ python tools/promlint.py --openmetrics "$TUNE_DIR/metrics.om.txt" \
     || { echo "promlint (autotune openmetrics) FAILED"; rc=1; }
 rm -rf "$TUNE_DIR"
 
-echo "=== stage 5/9: router e2e (balance + roll-drain + metrics) ==="
+echo "=== stage 5/9: router e2e (balance + roll-drain + fleet + metrics) ==="
 ROUTER_DIR=$(mktemp -d)
 timeout -k 10 300 python - "$ROUTER_DIR" <<'EOF'
 import json
@@ -227,6 +231,7 @@ import client_tpu.http as httpclient
 from client_tpu.admission.drain import drain as engine_drain
 from client_tpu.engine import TpuEngine
 from client_tpu.models import build_repository
+from client_tpu.observability import FleetMonitorConfig
 from client_tpu.router import Replica, Router, RouterHttpServer, rolling_drain
 from client_tpu.server import HttpInferenceServer
 
@@ -236,7 +241,8 @@ engines = [TpuEngine(build_repository(["simple"]), warmup=False)
 replicas = [HttpInferenceServer(e, host="127.0.0.1", port=0).start()
             for e in engines]
 router = Router([Replica(f"http://{r.url}") for r in replicas], seed=7)
-srv = RouterHttpServer(router, port=0).start()
+srv = RouterHttpServer(router, port=0, monitor_config=FleetMonitorConfig(
+    interval_s=3600.0, threshold=0.5)).start()
 try:
     base = f"http://{srv.url}"
     a = np.arange(16, dtype=np.int32).reshape(1, 16)
@@ -265,6 +271,60 @@ try:
               for rid in load["replicas"]}
     if any(v <= 0 for v in counts.values()):
         sys.exit(f"one replica got no traffic: {counts}")
+
+    # Fleet federation smoke against the 2 live replicas: per-replica
+    # profile rows, cursor-merged events, and a stitched trace tree.
+    fleet_prof = json.load(urlopen(f"{base}/v2/fleet/profile", timeout=10))
+    if set(fleet_prof["replicas"]) != {r.id for r in router.replicas}:
+        sys.exit(f"/v2/fleet/profile replica rows wrong: "
+                 f"{str(fleet_prof)[:300]}")
+    if fleet_prof["errors"]:
+        sys.exit(f"/v2/fleet/profile fetch errors: {fleet_prof['errors']}")
+    fleet_evts = json.load(urlopen(f"{base}/v2/fleet/events?limit=50",
+                                   timeout=10))
+    if set(fleet_evts["cursors"]) != {r.id for r in router.replicas}:
+        sys.exit(f"/v2/fleet/events cursors wrong: {str(fleet_evts)[:300]}")
+    if not fleet_evts["events"]:
+        sys.exit("/v2/fleet/events merged to an empty journal")
+
+    # Stitched trace round-trip: one more infer (raw urlopen, no client
+    # traceparent), then resolve the echoed trace id on the router into
+    # router spans + replica phase spans.
+    infer_body = json.dumps({"inputs": [
+        {"name": "INPUT0", "shape": [1, 16], "datatype": "INT32",
+         "data": a.flatten().tolist()},
+        {"name": "INPUT1", "shape": [1, 16], "datatype": "INT32",
+         "data": b.flatten().tolist()}]}).encode()
+    resp = urlopen(Request(f"{base}/v2/models/simple/infer",
+                           data=infer_body, method="POST"), timeout=10)
+    resp.read()
+    trace_id = resp.headers.get("X-Tpu-Trace-Id")
+    if not trace_id:
+        sys.exit("router response missing X-Tpu-Trace-Id")
+    stitched = json.load(urlopen(
+        f"{base}/v2/trace/requests?trace_id={trace_id}", timeout=10))
+    names = {e["name"] for e in stitched["traceEvents"]}
+    for need in ("router:request", "router:select", "router:proxy",
+                 "simple:request"):
+        if need not in names:
+            sys.exit(f"stitched trace missing span {need}: {sorted(names)}")
+
+    # Induce skew (divergent queue-wait reports) and tick the drift
+    # monitor: the flagged replica must cross the gauge threshold.
+    from client_tpu.protocol.loadreport import LoadReport
+    router.replicas[0].observe_report(LoadReport(wait_s=0.01))
+    router.replicas[1].observe_report(LoadReport(wait_s=5.0))
+    report = srv.monitor.tick()
+    if router.replicas[1].id not in report["flagged"]:
+        sys.exit(f"induced skew not flagged: {str(report)[:300]}")
+    from client_tpu.observability import scrape
+    drift_samples = [s for s in scrape.parse_samples(router.metrics.render())
+                     if s[0] == "tpu_fleet_drift_score"]
+    if not any(v > 0.5 for _, _, v in drift_samples):
+        sys.exit(f"tpu_fleet_drift_score never crossed 0.5: {drift_samples}")
+    print(f"fleet ok: {len(fleet_prof['replicas'])} profile rows, "
+          f"{len(fleet_evts['events'])} merged events, stitched trace "
+          f"{trace_id[:8]}…, drift flagged {sorted(report['flagged'])}")
 
     # Roll-drain replica 0 via the real in-process drain sequence (the
     # same code SIGTERM runs), then prove the survivor keeps serving.
@@ -318,6 +378,10 @@ python tools/promlint.py "$ROUTER_DIR/metrics.txt" \
     || { echo "promlint (router classic) FAILED"; rc=1; }
 python tools/promlint.py --openmetrics "$ROUTER_DIR/metrics.om.txt" \
     || { echo "promlint (router openmetrics) FAILED"; rc=1; }
+grep -q "^tpu_fleet_drift_score{" "$ROUTER_DIR/metrics.txt" \
+    || { echo "tpu_fleet_drift_score missing from classic dialect"; rc=1; }
+grep -q "^tpu_fleet_drift_score{" "$ROUTER_DIR/metrics.om.txt" \
+    || { echo "tpu_fleet_drift_score missing from openmetrics dialect"; rc=1; }
 rm -rf "$ROUTER_DIR"
 
 echo "=== stage 6/9: fused decode kernel parity (interpret) + wave metrics ==="

@@ -11,6 +11,13 @@ bucket-ladder suggestion when one fires.
     python tools/profile_report.py http://127.0.0.1:8000
     python tools/profile_report.py http://127.0.0.1:8000 --model simple
     python tools/profile_report.py prof.json
+
+``--fleet`` points the same tool at a *router* and renders the
+federated ``/v2/fleet/profile``: a fleet summary table (one row per
+replica with its drift scores) followed by each replica's per-bucket
+cost table.
+
+    python tools/profile_report.py http://127.0.0.1:8080 --fleet
 """
 
 from __future__ import annotations
@@ -25,18 +32,19 @@ _COLS = ("bucket", "axis", "execs", "cold", "rows", "padded", "fill",
          "device_s", "ewma_ms", "waste_s", "compiles", "compile_s")
 
 
-def load_snapshot(source: str, model: str = "",
+def load_snapshot(source: str, model: str = "", fleet: bool = False,
                   timeout_s: float = 10.0) -> dict:
     """Fetch from a server base URL or read a saved JSON file."""
     if urlparse(source).scheme in ("http", "https"):
-        url = source.rstrip("/") + "/v2/profile"
-        if model:
+        url = source.rstrip("/") + (
+            "/v2/fleet/profile" if fleet else "/v2/profile")
+        if model and not fleet:
             url += f"?model={quote(model)}"
         with urlopen(url, timeout=timeout_s) as resp:
             return json.load(resp)
     with open(source) as f:
         snap = json.load(f)
-    if model:
+    if model and not fleet:
         snap = dict(snap, models={k: v for k, v in snap["models"].items()
                                   if v.get("model") == model})
     return snap
@@ -83,15 +91,59 @@ def render(snap: dict, out=None) -> None:
               f"{sug['reason']}\n")
 
 
+def render_fleet(fleet_snap: dict, out=None) -> None:
+    """The federated view: replica summary rows (with drift scores from
+    the fleet section, flagged ``!`` above the monitor threshold when a
+    drift report is present) followed by per-replica bucket tables."""
+    w = (out or sys.stdout).write
+    fleet = fleet_snap.get("fleet", {})
+    replicas = fleet_snap.get("replicas", {})
+    signals = fleet.get("signals", {})
+    scores = fleet.get("drift_scores", {})
+    drift = fleet_snap.get("drift") or {}
+    threshold = drift.get("threshold")
+    flagged = drift.get("flagged", {})
+    names = sorted({s for per in signals.values() for s in per})
+    w(f"fleet: {fleet.get('replica_count', len(replicas))} replica(s), "
+      f"medians {fleet.get('medians', {})}"
+      + (f", drift threshold {threshold}" if threshold is not None else "")
+      + "\n")
+    header = ("replica", "duty") + tuple(
+        f"drift:{s}" for s in names) + ("flagged",)
+    rows = [header]
+    for rid in sorted(replicas):
+        duty = replicas[rid].get("duty_cycle")
+        row = [rid, f"{duty:.3f}" if duty is not None else "-"]
+        for s in names:
+            score = scores.get(rid, {}).get(s)
+            mark = "!" if rid in flagged and s in flagged[rid] else ""
+            row.append(f"{score:.3f}{mark}" if score is not None else "-")
+        row.append(",".join(sorted(flagged.get(rid, {}))) or "-")
+        rows.append(tuple(row))
+    widths = [max(len(str(r[i])) for r in rows) for i in range(len(header))]
+    for r in rows:
+        w("  " + "  ".join(str(v).ljust(widths[i])
+                           for i, v in enumerate(r)).rstrip() + "\n")
+    for rid, err in sorted(fleet_snap.get("errors", {}).items()):
+        w(f"  replica {rid}: FETCH FAILED ({err})\n")
+    for rid in sorted(replicas):
+        w(f"\n=== replica {rid} ===\n")
+        render(replicas[rid], out=out)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("source", help="server base URL or saved snapshot path")
     p.add_argument("--model", default="", help="restrict to one model")
+    p.add_argument("--fleet", action="store_true",
+                   help="source is a router: render the federated "
+                        "/v2/fleet/profile with per-replica drift")
     p.add_argument("--json", action="store_true",
                    help="dump the (filtered) snapshot as JSON instead")
     args = p.parse_args(argv)
     try:
-        snap = load_snapshot(args.source, model=args.model)
+        snap = load_snapshot(args.source, model=args.model,
+                             fleet=args.fleet)
     except Exception as exc:  # noqa: BLE001 — CLI surface
         print(f"profile_report: cannot load {args.source}: {exc}",
               file=sys.stderr)
@@ -99,6 +151,8 @@ def main(argv=None) -> int:
     if args.json:
         json.dump(snap, sys.stdout, indent=2)
         sys.stdout.write("\n")
+    elif args.fleet:
+        render_fleet(snap)
     else:
         render(snap)
     return 0
