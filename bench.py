@@ -629,6 +629,17 @@ def bench_inproc_simple(concurrency: int = BENCH_CONCURRENCY):
                 f"padding waste {res['pad_waste_device_s']}s device")
     except Exception as exc:  # noqa: BLE001 — profiler must not sink bench
         log(f"profiler snapshot unavailable: {exc}")
+    # Flight-recorder and HBM-census availability: the run is only
+    # observable in production if both surfaces were live during it.
+    try:
+        res["timeseries_samples"] = len(
+            engine.timeseries_export().get("samples", []))
+        res["census_attr_fraction"] = engine.memory_census().get(
+            "attributed_fraction")
+        log(f"simple: {res['timeseries_samples']} flight-recorder samples, "
+            f"census attribution {res['census_attr_fraction']}")
+    except Exception as exc:  # noqa: BLE001 — observability must not sink bench
+        log(f"flight recorder / census unavailable: {exc}")
     if profile is not None:
         # Overload-protection counters + a real graceful drain instead of
         # the abrupt shutdown: chaos runs report what the admission layer
@@ -2309,7 +2320,8 @@ def _main():
                         "windows": s["windows"]})
         extra = {}
         for k in ("hist_p50_us", "hist_p99_us", "fill_ratio", "duty_cycle",
-                  "xla_compiles", "pad_waste_device_s"):
+                  "xla_compiles", "pad_waste_device_s",
+                  "timeseries_samples", "census_attr_fraction"):
             if k in s:
                 _RESULT[k] = s[k]
                 extra[k] = s[k]

@@ -156,6 +156,11 @@ class Autotuner:
         budget = config.budget_bytes or device_hbm_budget(
             config.hbm_fraction, fallback_bytes=_DEFAULT_CPU_BUDGET)
         self.arena = ArenaAllocator(budget, label="hbm:0")
+        # The census reconciles these reservations against live tagged
+        # bytes (tpu_hbm_plan_drift_bytes); held weakly on its side.
+        from client_tpu.observability.memory import hbm_census
+
+        hbm_census().register_arena(self.arena)
         self._lock = threading.Lock()
         # (model, version, action, bucket) -> monotonic deadline before
         # which the same decision is not retried (hysteresis spacing).
@@ -227,6 +232,9 @@ class Autotuner:
         if t is not None:
             t.join(timeout=timeout_s)
             self._thread = None
+        from client_tpu.observability.memory import hbm_census
+
+        hbm_census().unregister_arena(self.arena)
 
     def _loop(self) -> None:
         while not self._stop.wait(self.config.interval_s):

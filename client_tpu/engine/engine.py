@@ -107,6 +107,20 @@ class TpuEngine:
 
         self.profiler = _profiler()
         self.profiler.bind_metrics(self.metrics.registry)
+        # HBM census (process-global: load paths tag buffers from below
+        # the engine) + the flight recorder. The recorder holds this
+        # engine weakly and samples timeseries_sample() at 1 Hz; with
+        # CLIENT_TPU_TIMESERIES=0 attach() is a no-op and the engine is
+        # byte-identical to a recorder-less one.
+        from client_tpu.observability.memory import hbm_census
+        from client_tpu.observability.timeseries import recorder as _recorder
+
+        self.hbm_census = hbm_census()
+        self.recorder = _recorder()
+        # Per-signal sampler state (fill EWMA, shed-counter deltas);
+        # touched only from the recorder thread.
+        self._ts_state: dict = {"fill": {}, "shed": {}, "mono": None}
+        self.recorder.attach(self)
         self._last_health: str | None = None
         # (mono_timestamp, LoadReport) pair behind load_report(): the
         # report piggybacks on every inference response, so it is cached
@@ -678,7 +692,8 @@ class TpuEngine:
             self.metrics.inflight_batches.set(
                 getattr(sched, "active_batches", 0),
                 model=model_name, version=version)
-        self.metrics.update_device_gauges()
+        self.metrics.update_device_gauges(census=self.hbm_census)
+        self.metrics.update_census_gauges(self.memory_census())
         # Duty-cycle and SLO burn gauges refresh at scrape time so a
         # quiet period still reads current windows.
         self.profiler.update_gauges()
@@ -702,6 +717,133 @@ class TpuEngine:
     def slo_snapshot(self) -> dict:
         """``GET /v2/slo`` body: per-model window counts and burn rates."""
         return self.slo.snapshot()
+
+    # -- flight recorder / HBM census -----------------------------------------
+
+    def timeseries_sample(self) -> dict:
+        """One flight-recorder sample (called by the recorder thread at
+        1 Hz; see :mod:`client_tpu.observability.timeseries` for the
+        signal vocabulary). Scalars are engine-wide; per-model signals
+        ride as {model: value} maps."""
+        import time as _time
+
+        state = self._ts_state
+        now = _time.monotonic()
+        elapsed = (now - state["mono"]) if state["mono"] else None
+        state["mono"] = now
+        sample: dict = {"duty_cycle": round(self.profiler.duty_cycle(), 6)}
+        queue_depth: dict[str, int] = {}
+        in_flight: dict[str, int] = {}
+        for sched in self.schedulers():
+            name = sched.model.config.name
+            queue_depth[name] = (queue_depth.get(name, 0)
+                                 + sched.queue.qsize())
+            in_flight[name] = (in_flight.get(name, 0)
+                               + getattr(sched, "active_batches", 0))
+        sample["queue_depth"] = queue_depth
+        sample["in_flight"] = in_flight
+        # Batch-fill EWMA per model: the cumulative fill ratio smoothed
+        # across ticks (alpha 0.3 ~ a 3-sample memory at 1 Hz).
+        psnap = self.profiler.snapshot()
+        fill: dict[str, float] = {}
+        wave: dict[str, float] = {}
+        for entry in psnap.get("models", {}).values():
+            name = entry["model"]
+            rows = sum(b["rows"] for b in entry.get("buckets", ()))
+            padded = sum(b["padded_rows"] for b in entry.get("buckets", ()))
+            if rows + padded:
+                current = rows / (rows + padded)
+                prev = state["fill"].get(name)
+                fill[name] = round(
+                    current if prev is None
+                    else 0.3 * current + 0.7 * prev, 6)
+            waves_total = wave_weighted = 0.0
+            for w in entry.get("decode_waves", ()):
+                n = float(w.get("waves", 0) or 0)
+                p50 = w.get("wave_ms_p50")
+                if n > 0 and p50 is not None:
+                    waves_total += n
+                    wave_weighted += n * float(p50)
+            if waves_total > 0:
+                wave[name] = round(wave_weighted / waves_total, 3)
+        state["fill"].update(fill)
+        if fill:
+            sample["batch_fill"] = fill
+        if wave:
+            sample["wave_p50_ms"] = wave
+        # Admission shed rate: per-model counter delta over the tick gap
+        # (the counter sums versions and reasons).
+        shed_totals: dict[str, float] = {}
+        children = self.metrics.admission_rejections._children
+        for values in list(children):
+            shed_totals[values[0]] = (shed_totals.get(values[0], 0.0)
+                                      + children[values].v)
+        shed_rate: dict[str, float] = {}
+        for name, total in shed_totals.items():
+            prev = state["shed"].get(name, 0.0)
+            if elapsed and elapsed > 0:
+                shed_rate[name] = round(max(0.0, total - prev) / elapsed, 4)
+        state["shed"] = shed_totals
+        if shed_rate:
+            sample["shed_rate"] = shed_rate
+        # HBM: census actuals (live-array bytes stand in on platforms
+        # without memory stats) vs the planner arena's reservations.
+        devices = self.hbm_census.device_stats()
+        used = sum(d["bytes_in_use"] for d in devices)
+        if used == 0:
+            try:
+                import jax
+
+                from client_tpu.observability.memory import _buffer_nbytes
+
+                used = sum(_buffer_nbytes(a) for a in jax.live_arrays())
+            except Exception:  # noqa: BLE001 — no backend
+                used = 0
+        sample["hbm_used"] = used
+        if self.autotuner is not None:
+            sample["hbm_reserved"] = int(
+                self.autotuner.arena.reserved_bytes())
+        if self.slo.enabled:
+            burn: dict[str, float] = {}
+            for name, report in self.slo.snapshot()["models"].items():
+                w = report.get("windows", {}).get("5m")
+                if w is not None:
+                    burn[name] = float(w.get("availability_burn_rate",
+                                             0.0))
+            if burn:
+                sample["slo_burn"] = burn
+        return sample
+
+    def timeseries_export(self, *, signal=None, model=None,
+                          since_seq=None, limit=None) -> dict:
+        """``GET /v2/timeseries`` body: the flight-recorder ring,
+        optionally narrowed by signal / model / exclusive seq cursor."""
+        return self.recorder.export(signal=signal, model=model,
+                                    since_seq=since_seq, limit=limit)
+
+    def memory_census(self) -> dict:
+        """``GET /v2/memory`` body: per-owner live device-buffer bytes,
+        plan-vs-actual drift against the planner arenas, per-device
+        memory stats, and the unattributed remainder."""
+        extra_plans: dict = {}
+        for sched in self.schedulers():
+            backend = sched.model.backend
+            hbm = getattr(backend, "hbm_reservation_bytes", None)
+            if callable(hbm):
+                host_mode = bool(getattr(backend, "host_tables", False))
+                if host_mode and self.autotuner is not None:
+                    # The tuner arena already carries a rowcache:{name}
+                    # reservation for host-mode tables; adding the
+                    # backend figure again would double the plan.
+                    continue
+                component = "rowcache" if host_mode else "embedding"
+                try:
+                    extra_plans[(sched.model.config.name, component)] = \
+                        int(hbm())
+                except Exception:  # noqa: BLE001 — backend mid-unload
+                    pass
+        return self.hbm_census.report(extra_plans=extra_plans,
+                                      events=self.events)
 
     # Staleness bound on the cached load report: piggybacked on every
     # inference response, so it must be cheaper than a response — 50ms is
@@ -777,6 +919,17 @@ class TpuEngine:
         rings = self.ring_shm.profile_table()
         if rings:
             snap["shm_rings"] = rings
+        # Census summary: the capacity headline without the full
+        # per-device walk detail (that's /v2/memory's job).
+        census = self.memory_census()
+        snap["memory"] = {
+            "committed_bytes": census["totals"]["committed_bytes"],
+            "attributed_bytes": census["attributed_bytes"],
+            "unattributed_bytes": census["unattributed_bytes"],
+            "attributed_fraction": census["attributed_fraction"],
+            "watermark_bytes": census["watermark_bytes"],
+            "owners": census["owners"],
+        }
         return snap
 
     # -- trace (device profiling) --------------------------------------------
@@ -801,6 +954,8 @@ class TpuEngine:
             self.events.emit("lifecycle", "server_shutdown",
                              draining=self._draining)
         self._live = False
+        if getattr(self, "recorder", None) is not None:
+            self.recorder.detach(self)
         if getattr(self, "autotuner", None) is not None:
             self.autotuner.stop()
         if getattr(self, "trace", None) is not None:

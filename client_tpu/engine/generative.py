@@ -174,6 +174,21 @@ def _parse_sampling(req: InferRequest, vocab: int):
     return seed, temp, top_k, top_p, frozenset(stop)
 
 
+def _census_arena(sched) -> tuple[int, int]:
+    """HbmCensus dynamic-provider hook. The KV arena is donated into
+    every jit call, so its buffers are replaced wave-to-wave — static
+    tags would die within one step; the census instead reads the live
+    pytree through this at walk time. Must stay a plain function (the
+    census holds the scheduler weakly; a closure would pin it)."""
+    from client_tpu.observability.memory import _buffer_nbytes
+
+    leaves = sched._jax.tree_util.tree_leaves(sched._arena)
+    total = 0
+    for leaf in leaves:
+        total += _buffer_nbytes(leaf)
+    return total, len(leaves)
+
+
 class GenerativeScheduler(Scheduler):
     """Arena-owned single worker; batching provides the parallelism."""
 
@@ -203,6 +218,10 @@ class GenerativeScheduler(Scheduler):
             self._rows_init = list(range(self._cap))
             self._dummy = self._cap
         self._arena = backend.init_arena(self._cap)
+        from client_tpu.observability.memory import hbm_census
+
+        hbm_census().register_provider(
+            model.config.name, "kv_arena", self, _census_arena)
         # `sample` is static: all-greedy calls get an executable with no
         # sampling pipeline in it (prefill arg 9, decode arg 8).
         self._prefill = jax.jit(backend.prefill_fn(), donate_argnums=(1,),

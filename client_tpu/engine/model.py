@@ -155,6 +155,14 @@ class Model:
                 # ModelBackend.make_apply_params.
                 apply_fn, self._params = pair
                 self._takes_params = True
+                # HBM census attribution: the placed pytree is the
+                # model's device-resident weight set. overwrite=False so
+                # leaves the backend already tagged with a more specific
+                # component (DLRM embedding tables) keep that owner.
+                from client_tpu.observability.memory import hbm_census
+
+                hbm_census().tag(self.config.name, "weights", self._params,
+                                 overwrite=False)
             else:
                 apply_fn = backend.make_apply()
             jittable = getattr(backend, "jittable", True)

@@ -607,6 +607,31 @@ class InferenceServerClient:
             self._md(headers), client_timeout)
         return json.loads(response.profile_json)
 
+    def get_timeseries(self, signal="", model_name="", since_seq=None,
+                       limit=None, headers=None, client_timeout=None):
+        """Flight-recorder signal ring (gRPC mirror of
+        ``GET /v2/timeseries``): the 1 Hz duty-cycle / queue-depth /
+        HBM sample history; ``since_seq`` is the exclusive cursor from
+        the previous response's ``next_seq``."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        response = self._unary(
+            self._client_stub.Timeseries,
+            ops.TimeseriesRequest(signal=signal, model=model_name,
+                                  since_seq=since_seq or 0,
+                                  limit=limit or 0),
+            self._md(headers), client_timeout)
+        return json.loads(response.timeseries_json)
+
+    def get_memory(self, headers=None, client_timeout=None):
+        """HBM census report (gRPC mirror of ``GET /v2/memory``)."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        response = self._unary(
+            self._client_stub.MemoryCensus, ops.MemoryRequest(),
+            self._md(headers), client_timeout)
+        return json.loads(response.memory_json)
+
     # -- fleet observability (client-side federation) -------------------------
     # gRPC has no fronting router, so the multi-URL client federates the
     # per-endpoint surfaces itself with the same merge semantics the
@@ -657,6 +682,22 @@ class InferenceServerClient:
                 stub.SloStatus, ops.SloStatusRequest(model=""),
                 self._md(headers), client_timeout).slo_json))
         return merge_slo(exports, errors)
+
+    def get_fleet_timeseries(self, signal="", model_name="", limit=None,
+                             headers=None, client_timeout=None):
+        """Every endpoint's flight-recorder ring merged by wall stamp,
+        each sample tagged with its endpoint url; ``cursors`` carries
+        each endpoint's ``next_seq`` (seq spaces are per-process)."""
+        from client_tpu.observability.fleet import merge_timeseries
+        from client_tpu.protocol import ops_pb2 as ops
+
+        exports, errors = self._fleet_fan_out(
+            lambda stub: json.loads(self._unary(
+                stub.Timeseries,
+                ops.TimeseriesRequest(signal=signal, model=model_name,
+                                      since_seq=0, limit=limit or 0),
+                self._md(headers), client_timeout).timeseries_json))
+        return merge_timeseries(exports, errors, limit=limit)
 
     # -- shared memory -------------------------------------------------------
 

@@ -867,6 +867,28 @@ class InferenceServerClient:
             qp["model"] = model_name
         return self._get_json("/v2/profile", qp or None, headers)
 
+    def get_timeseries(self, signal="", model_name="", since_seq=None,
+                       limit=None, headers=None, query_params=None):
+        """Flight-recorder signal ring (``GET /v2/timeseries``): ~15 min
+        of 1 Hz duty-cycle / queue-depth / batch-fill / shed-rate /
+        wave-p50 / HBM / SLO-burn samples. ``since_seq`` is the
+        exclusive cursor from the previous response's ``next_seq``."""
+        qp = dict(query_params or {})
+        if signal:
+            qp["signal"] = signal
+        if model_name:
+            qp["model"] = model_name
+        if since_seq is not None:
+            qp["since"] = int(since_seq)
+        if limit is not None:
+            qp["limit"] = int(limit)
+        return self._get_json("/v2/timeseries", qp or None, headers)
+
+    def get_memory(self, headers=None, query_params=None):
+        """HBM census report (``GET /v2/memory``): live device bytes per
+        ``(model, component)`` owner, plan-vs-actual drift, watermark."""
+        return self._get_json("/v2/memory", query_params, headers)
+
     # -- fleet observability (router endpoints) ------------------------------
 
     def get_fleet_events(self, limit=None, headers=None, query_params=None):
@@ -887,6 +909,21 @@ class InferenceServerClient:
     def get_fleet_slo(self, headers=None, query_params=None):
         """Federated SLO view (router ``GET /v2/fleet/slo``)."""
         return self._get_json("/v2/fleet/slo", query_params, headers)
+
+    def get_fleet_timeseries(self, signal="", model_name="", limit=None,
+                             headers=None, query_params=None):
+        """Federated flight-recorder view (router ``GET
+        /v2/fleet/timeseries``): every replica's signal ring merged by
+        wall stamp, each sample tagged ``replica``, with per-replica
+        ``cursors`` and inline fetch ``errors``."""
+        qp = dict(query_params or {})
+        if signal:
+            qp["signal"] = signal
+        if model_name:
+            qp["model"] = model_name
+        if limit is not None:
+            qp["limit"] = int(limit)
+        return self._get_json("/v2/fleet/timeseries", qp or None, headers)
 
     def get_fleet_metrics(self, headers=None, query_params=None):
         """Merged fleet exposition text (router ``GET

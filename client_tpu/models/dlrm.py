@@ -267,6 +267,10 @@ class DlrmBackend(ModelBackend):
             params["table"] = (shard_table(self.table_host, mesh)
                                if mesh is not None
                                else jax.device_put(self.table_host))
+            from client_tpu.observability.memory import hbm_census
+
+            hbm_census().tag(self.config.name, "embedding",
+                             params["table"])
 
         def mlp(layers, x):
             for i, (w, b) in enumerate(layers):

@@ -22,9 +22,18 @@ Three pieces, threaded through every layer of the stack:
   duty-cycle (``GET /v2/profile``, ``tpu_batch_fill_ratio`` /
   ``tpu_xla_*`` / ``tpu_device_*`` families).
 - :mod:`client_tpu.observability.fleet` — fleet-level merges of the
-  per-replica surfaces (events/metrics/profile/slo) plus the drift
-  math behind ``tpu_fleet_drift_score`` (see
+  per-replica surfaces (events/metrics/profile/slo/timeseries) plus
+  the drift math behind ``tpu_fleet_drift_score`` (see
   :mod:`client_tpu.router.fleet` for the router-side half).
+- :mod:`client_tpu.observability.timeseries` — the flight recorder: a
+  process-global 1 Hz sampler recording duty cycle, queue depth, batch
+  fill, shed rate, wave p50, HBM use and SLO burn into a bounded ring
+  (``GET /v2/timeseries``, federated as ``/v2/fleet/timeseries``).
+- :mod:`client_tpu.observability.memory` — the HBM census:
+  byte-accurate device-memory attribution to ``(model, component)``
+  owners, reconciled against planner arena reservations
+  (``GET /v2/memory``, ``tpu_hbm_census_bytes`` /
+  ``tpu_hbm_plan_drift_bytes``).
 
 See docs/OBSERVABILITY.md for the metric vocabulary and wire formats.
 """
@@ -51,6 +60,18 @@ from client_tpu.observability.fleet import (  # noqa: F401
     merge_slo,
     parse_exposition,
     profile_signals,
+)
+from client_tpu.observability.timeseries import (  # noqa: F401
+    FlightRecorder,
+    TimeseriesConfig,
+    recorder,
+    reset_recorder,
+)
+from client_tpu.observability.memory import (  # noqa: F401
+    HbmCensus,
+    MemoryConfig,
+    hbm_census,
+    reset_hbm_census,
 )
 from client_tpu.observability.slo import SloConfig, SloTracker  # noqa: F401
 from client_tpu.observability.metrics import (  # noqa: F401

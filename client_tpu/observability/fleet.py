@@ -46,8 +46,10 @@ __all__ = [
     "merge_expositions",
     "merge_profiles",
     "merge_slo",
+    "merge_timeseries",
     "parse_exposition",
     "profile_signals",
+    "timeseries_signals",
 ]
 
 ENV_VAR = "CLIENT_TPU_FLEET_MONITOR"
@@ -221,6 +223,42 @@ def merge_events(exports: dict[str, dict],
     }
 
 
+def merge_timeseries(exports: dict[str, dict],
+                     errors: dict[str, str] | None = None,
+                     limit: int | None = None) -> dict:
+    """Merge per-replica ``/v2/timeseries`` exports into one fleet
+    stream. Same contract as :func:`merge_events`: every sample gains a
+    ``replica`` tag, ordering is by wall stamp (seq spaces are
+    per-process), ``cursors`` carries each replica's ``next_seq`` so an
+    incremental poller resumes per replica."""
+    samples: list[dict] = []
+    cursors: dict[str, int] = {}
+    dropped = 0
+    interval_s = None
+    for replica in sorted(exports):
+        exp = exports[replica] or {}
+        cursors[replica] = int(exp.get("next_seq", 0))
+        dropped += int(exp.get("dropped", 0))
+        if interval_s is None and exp.get("interval_s") is not None:
+            interval_s = exp["interval_s"]
+        for s in exp.get("samples", ()):
+            tagged = dict(s)
+            tagged["replica"] = replica
+            samples.append(tagged)
+    samples.sort(key=lambda s: (s.get("ts_wall", 0),
+                                s.get("replica", ""), s.get("seq", 0)))
+    if limit is not None and limit >= 0:
+        samples = samples[-limit:]
+    return {
+        "samples": samples,
+        "cursors": cursors,
+        "dropped": dropped,
+        "interval_s": interval_s,
+        "replicas": sorted(exports),
+        "errors": dict(errors or {}),
+    }
+
+
 # -- profile / slo merge ------------------------------------------------------
 
 
@@ -315,6 +353,47 @@ def profile_signals(profile: dict | None,
     return signals
 
 
+def timeseries_signals(export: dict | None, window_s: float = 60.0,
+                       now: float | None = None) -> dict[str, float]:
+    """Extract the drift signals from one replica's ``/v2/timeseries``
+    export as *windowed medians* — the flight-recorder upgrade over
+    :func:`profile_signals`' single-scrape instantaneous values. A
+    replica mid-GC or mid-compile no longer reads as drifted: one
+    outlier second cannot move a 60-sample median. Keys match
+    ``profile_signals`` (duty_cycle / fill_ratio / wave_ms_p50) so
+    :func:`drift_scores` and SIGNAL_FLOORS apply unchanged; signals
+    without evidence in the window are omitted, not zeroed."""
+    if not export:
+        return {}
+    samples = export.get("samples") or []
+    if not samples:
+        return {}
+    if now is None:
+        now = max(float(s.get("ts_wall", 0) or 0) for s in samples)
+    duty: list[float] = []
+    fill: list[float] = []
+    wave: list[float] = []
+    for s in samples:
+        if float(s.get("ts_wall", 0) or 0) < now - window_s:
+            continue
+        sig = s.get("signals") or {}
+        if sig.get("duty_cycle") is not None:
+            duty.append(float(sig["duty_cycle"]))
+        for source, dest in (("batch_fill", fill), ("wave_p50_ms", wave)):
+            per_model = sig.get(source)
+            if isinstance(per_model, dict) and per_model:
+                vals = [float(v) for v in per_model.values()]
+                dest.append(sum(vals) / len(vals))
+    signals: dict[str, float] = {}
+    if duty:
+        signals["duty_cycle"] = fleet_median(duty)
+    if fill:
+        signals["fill_ratio"] = fleet_median(fill)
+    if wave:
+        signals["wave_ms_p50"] = fleet_median(wave)
+    return signals
+
+
 def fleet_median(values: list[float]) -> float:
     s = sorted(values)
     n = len(s)
@@ -367,6 +446,7 @@ class FleetMonitorConfig:
     interval_s: float = 5.0    # monitor wake period
     threshold: float = 0.5     # drift score above this flags the replica
     min_replicas: int = 2      # no drift math below this fleet size
+    window_s: float = 60.0     # flight-recorder median window per scrape
 
     @classmethod
     def from_dict(cls, data: dict) -> "FleetMonitorConfig":
@@ -394,6 +474,8 @@ class FleetMonitorConfig:
             raise ValueError(f"{ENV_VAR}: threshold must be > 0")
         if cfg.min_replicas < 2:
             raise ValueError(f"{ENV_VAR}: min_replicas must be >= 2")
+        if cfg.window_s <= 0:
+            raise ValueError(f"{ENV_VAR}: window_s must be > 0")
         return cfg
 
     @classmethod

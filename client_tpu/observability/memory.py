@@ -1,0 +1,463 @@
+"""HBM census: byte-accurate attribution of live device memory.
+
+The planning arena (:mod:`client_tpu.engine.arena`) *reserves* HBM but
+never places buffers, and the per-device gauges only report raw
+``memory_stats()`` totals — so nothing could say which model owns which
+live bytes, or whether the planner's reservations match reality. The
+census closes both gaps:
+
+- **Owner tagging** — load paths register the device buffers they
+  create (model weights via :class:`~client_tpu.engine.model.Model`,
+  generative KV arenas, DLRM embedding tables, autotune warm buffers)
+  against an ``(model, component)`` owner. Registration is weak: a
+  freed buffer drops out of the census on the next walk, never pinned.
+- **The walk** — :meth:`HbmCensus.report` sums live tagged bytes per
+  owner, reads ``device.memory_stats()`` per device (zeros on CPU,
+  matching the long-standing gauge behavior), totals
+  ``jax.live_arrays()`` as the platform-independent committed-bytes
+  figure, and buckets the remainder as ``unattributed``.
+- **Plan reconciliation** — planner arenas registered by the autotuner
+  are reconciled reservation-by-reservation against the census actuals:
+  ``drift_bytes = plan - actual`` per owner (positive = the planner
+  reserved more than is live; negative = live memory the plan never
+  charged).
+
+Rendered as ``tpu_hbm_census_bytes{model,component}`` /
+``tpu_hbm_plan_drift_bytes{model,component}`` plus watermark gauges,
+served at ``GET /v2/memory`` and summarized in ``/v2/profile``.
+Crossing the pressure threshold (``CLIENT_TPU_MEMORY``, default 90% of
+``bytes_limit``) emits an edge-triggered ``memory.pressure`` journal
+event.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import weakref
+from dataclasses import dataclass, fields
+
+__all__ = [
+    "COMPONENTS",
+    "MemoryConfig",
+    "HbmCensus",
+    "hbm_census",
+    "reset_hbm_census",
+]
+
+ENV_VAR = "CLIENT_TPU_MEMORY"
+
+# The owner vocabulary load paths tag with. Free-form strings are
+# accepted (future components shouldn't need a census edit), but these
+# are the wired ones.
+COMPONENTS = ("weights", "kv_arena", "embedding", "rowcache",
+              "autotune_warm")
+
+# Arena reservation-name prefixes -> census component, for plan
+# reconciliation (see Autotuner._reserve_ladder for the name grammar:
+# "bucket:{model}:{version}:{b}", "kv:{model}:{version}", ...).
+_PLAN_COMPONENTS = {
+    "bucket": "autotune_warm",
+    "kv": "kv_arena",
+    "rowcache": "rowcache",
+}
+
+
+@dataclass
+class MemoryConfig:
+    """``CLIENT_TPU_MEMORY`` knobs (grammar matches CLIENT_TPU_AUTOTUNE
+    except unset means defaults — the census is always on; ``0``/``off``
+    only silences pressure events)."""
+
+    pressure_fraction: float = 0.9   # bytes_in_use/bytes_limit threshold
+    pressure_events: bool = True
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "MemoryConfig":
+        known = {f.name for f in fields(cls) if f.name != "pressure_events"}
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(
+                f"{ENV_VAR}: unknown key(s) {sorted(unknown)}; "
+                f"valid: {sorted(known)}")
+        cfg = cls()
+        if "pressure_fraction" in data:
+            try:
+                cfg.pressure_fraction = float(data["pressure_fraction"])
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"{ENV_VAR}: key 'pressure_fraction' expects a "
+                    f"number, got {data['pressure_fraction']!r}") from None
+        if not 0 < cfg.pressure_fraction <= 1:
+            raise ValueError(
+                f"{ENV_VAR}: pressure_fraction must be in (0, 1]")
+        return cfg
+
+    @classmethod
+    def from_env(cls, environ=os.environ) -> "MemoryConfig":
+        raw = (environ.get(ENV_VAR) or "").strip()
+        if raw.lower() in ("0", "false", "off"):
+            return cls(pressure_events=False)
+        if not raw or raw.lower() in ("1", "true", "on"):
+            return cls()
+        if raw.startswith("@"):
+            try:
+                with open(raw[1:]) as f:
+                    raw = f.read()
+            except OSError as exc:
+                raise ValueError(
+                    f"{ENV_VAR}: cannot read '{raw[1:]}': {exc}") from None
+        try:
+            data = json.loads(raw)
+        except json.JSONDecodeError as exc:
+            raise ValueError(f"{ENV_VAR}: invalid JSON ({exc})") from None
+        if not isinstance(data, dict):
+            raise ValueError(f"{ENV_VAR}: expected a JSON object")
+        return cls.from_dict(data)
+
+
+def _buffer_nbytes(buf) -> int:
+    """Committed bytes of one device array: per-device shard size times
+    addressable device count (a replicated array really holds one copy
+    per device), falling back to the logical nbytes when sharding
+    introspection is unavailable. Computed from sharding *metadata* on
+    purpose: materializing ``shard.data`` would mint a new jax.Array per
+    shard per walk — the census must never allocate what it counts."""
+    try:
+        sharding = buf.sharding
+        shard_shape = sharding.shard_shape(buf.shape)
+        n_dev = len(sharding.addressable_devices)
+        per_shard = int(buf.dtype.itemsize)
+        for dim in shard_shape:
+            per_shard *= int(dim)
+        return per_shard * n_dev
+    except Exception:  # noqa: BLE001 — non-jax leaves, odd shardings
+        pass
+    try:
+        return int(buf.nbytes)
+    except Exception:  # noqa: BLE001
+        return 0
+
+
+class HbmCensus:
+    """Registration-tag map + the census walk. Process-global (load
+    paths run below the engine and must find it without plumbing);
+    :func:`reset_hbm_census` drops it between tests."""
+
+    def __init__(self, config: MemoryConfig | None = None):
+        self.config = config or MemoryConfig()
+        self._lock = threading.Lock()
+        # id(buffer) -> (weakref, model, component). Keyed by id because
+        # jax.Arrays are unhashable; the weakref both detects death and
+        # guards against id reuse (a dead ref's entry is pruned before a
+        # recycled id could collide).
+        self._tags: dict[int, tuple[weakref.ref, str, str]] = {}
+        # Dynamic owners whose buffers are continuously replaced (donated
+        # KV arenas): id(owner) -> (weakref, model, component, fn) where
+        # fn(owner) -> (bytes, buffers). fn must be a plain function (no
+        # closure over the owner — the census must not keep it alive).
+        self._providers: dict[int, tuple] = {}
+        # Planner arenas (ArenaAllocator) registered by the autotuner,
+        # weakly so a stopped tuner's arena ages out.
+        self._arenas: list[weakref.ref] = []
+        self._watermark = 0          # high-water bytes_in_use (or live)
+        self._pressured = False      # edge-trigger latch
+
+    # -- registration ---------------------------------------------------------
+
+    def tag(self, model: str, component: str, tree, *,
+            overwrite: bool = True) -> int:
+        """Attribute every weakref-able leaf of ``tree`` (a pytree /
+        list / single array) to ``(model, component)``. Re-tagging a
+        buffer overwrites its owner unless ``overwrite=False`` — the
+        generic weights pass in ``Model.__init__`` passes False so a
+        more specific tag placed during ``make_apply_params`` (DLRM's
+        ``embedding`` tables) survives it. Returns the number of
+        buffers registered."""
+        try:
+            import jax
+
+            leaves = jax.tree_util.tree_leaves(tree)
+        except Exception:  # noqa: BLE001 — jax-less callers pass lists
+            leaves = tree if isinstance(tree, (list, tuple)) else [tree]
+        count = 0
+        with self._lock:
+            for leaf in leaves:
+                try:
+                    ref = weakref.ref(leaf)
+                except TypeError:
+                    continue  # ints, numpy scalars: not device buffers
+                prior = self._tags.get(id(leaf))
+                if (not overwrite and prior is not None
+                        and prior[0]() is leaf):
+                    continue
+                self._tags[id(leaf)] = (ref, str(model), str(component))
+                count += 1
+        return count
+
+    def untag(self, model: str | None = None,
+              component: str | None = None) -> int:
+        """Drop tags by owner (unload paths); None matches everything."""
+        with self._lock:
+            victims = [
+                key for key, (_, m, c) in self._tags.items()
+                if (model is None or m == model)
+                and (component is None or c == component)]
+            for key in victims:
+                del self._tags[key]
+        return len(victims)
+
+    def register_provider(self, model: str, component: str, owner,
+                          fn) -> None:
+        """Dynamic attribution for owners whose buffers are replaced on
+        every step (donated KV arenas outlive no two waves, so static
+        tags would die instantly). ``fn(owner) -> (bytes, buffers)`` is
+        called at walk time while ``owner`` is alive; it must be a plain
+        function taking the owner, never a closure over it (the census
+        holds the owner weakly and must not pin it). Idempotent per
+        owner identity."""
+        with self._lock:
+            self._providers[id(owner)] = (
+                weakref.ref(owner), str(model), str(component), fn)
+
+    def unregister_provider(self, owner) -> None:
+        with self._lock:
+            self._providers.pop(id(owner), None)
+
+    def register_arena(self, arena) -> None:
+        """Register a planner :class:`ArenaAllocator` for plan-vs-actual
+        reconciliation (idempotent per arena identity)."""
+        with self._lock:
+            self._arenas = [r for r in self._arenas
+                            if r() is not None and r() is not arena]
+            self._arenas.append(weakref.ref(arena))
+
+    def unregister_arena(self, arena) -> None:
+        with self._lock:
+            self._arenas = [r for r in self._arenas
+                            if r() is not None and r() is not arena]
+
+    # -- the walk -------------------------------------------------------------
+
+    def device_stats(self) -> list[dict]:
+        """Per-device memory stats, one entry per local device; zeros
+        where the platform reports none (CPU) — the single source of
+        truth behind the ``tpu_device_hbm_bytes_in_use`` /
+        ``tpu_hbm_limit_bytes`` / ``tpu_hbm_peak_bytes`` gauges. Empty
+        when no backend is reachable at all."""
+        out: list[dict] = []
+        try:
+            import jax
+
+            for d in jax.local_devices():
+                try:
+                    ms = d.memory_stats()
+                except Exception:  # noqa: BLE001 — per-device probe
+                    ms = None
+                ms = ms or {}
+                out.append({
+                    "device": str(d.id),
+                    "platform": getattr(d, "platform", "unknown"),
+                    "bytes_in_use": int(ms.get("bytes_in_use", 0)),
+                    "bytes_limit": int(ms.get("bytes_limit", 0)),
+                    "peak_bytes_in_use": int(ms.get("peak_bytes_in_use",
+                                                    0)),
+                })
+        except Exception:  # noqa: BLE001 — no backend at all
+            return []
+        return out
+
+    def _attributed(self) -> dict[tuple[str, str], dict]:
+        """{(model, component): {"bytes": n, "buffers": k}} over live
+        tagged buffers; dead tags pruned as a side effect."""
+        with self._lock:
+            items = list(self._tags.items())
+        owners: dict[tuple[str, str], dict] = {}
+        dead = []
+        for key, (ref, model, component) in items:
+            buf = ref()
+            if buf is None:
+                dead.append(key)
+                continue
+            nbytes = _buffer_nbytes(buf)
+            row = owners.setdefault((model, component),
+                                    {"bytes": 0, "buffers": 0})
+            row["bytes"] += nbytes
+            row["buffers"] += 1
+        if dead:
+            with self._lock:
+                for key in dead:
+                    self._tags.pop(key, None)
+        with self._lock:
+            providers = list(self._providers.items())
+        for key, (ref, model, component, fn) in providers:
+            obj = ref()
+            if obj is None:
+                with self._lock:
+                    self._providers.pop(key, None)
+                continue
+            try:
+                nbytes, buffers = fn(obj)
+            except Exception:  # noqa: BLE001 — owner mid-teardown
+                continue
+            row = owners.setdefault((model, component),
+                                    {"bytes": 0, "buffers": 0})
+            row["bytes"] += int(nbytes)
+            row["buffers"] += int(buffers)
+        return owners
+
+    def _plans(self) -> dict[tuple[str, str], int]:
+        """{(model, component): reserved bytes} from every registered
+        planner arena, component mapped by reservation-name prefix."""
+        with self._lock:
+            arenas = [r() for r in self._arenas]
+        plans: dict[tuple[str, str], int] = {}
+        for arena in arenas:
+            if arena is None:
+                continue
+            try:
+                snap = arena.snapshot()
+            except Exception:  # noqa: BLE001 — arena mid-teardown
+                continue
+            for res in snap.get("reservations", ()):
+                parts = str(res.get("name", "")).split(":")
+                if len(parts) < 2:
+                    continue
+                component = _PLAN_COMPONENTS.get(parts[0])
+                if component is None:
+                    continue
+                owner = (parts[1], component)
+                plans[owner] = plans.get(owner, 0) + int(
+                    res.get("nbytes", 0))
+        return plans
+
+    def report(self, extra_plans: dict | None = None,
+               events=None) -> dict:
+        """The ``GET /v2/memory`` body. ``extra_plans`` maps
+        ``(model, component)`` to planned bytes from sources outside the
+        arenas (e.g. DLRM's ``hbm_reservation_bytes``); ``events`` is an
+        EventJournal for pressure emission (None = no events)."""
+        devices = self.device_stats()
+        total_in_use = sum(d["bytes_in_use"] for d in devices)
+        total_limit = sum(d["bytes_limit"] for d in devices)
+        total_peak = sum(d["peak_bytes_in_use"] for d in devices)
+        live_bytes = 0
+        live_count = 0
+        try:
+            import jax
+
+            for arr in jax.live_arrays():
+                live_bytes += _buffer_nbytes(arr)
+                live_count += 1
+        except Exception:  # noqa: BLE001 — no backend
+            pass
+        # On platforms without memory stats (CPU) the live-array total is
+        # the honest committed-bytes figure; on TPU bytes_in_use also
+        # covers allocator overhead the census attributes as slack.
+        committed = total_in_use if total_in_use > 0 else live_bytes
+
+        attributed = self._attributed()
+        plans = self._plans()
+        for owner, nbytes in (extra_plans or {}).items():
+            plans[owner] = plans.get(owner, 0) + int(nbytes)
+
+        owners = []
+        attributed_bytes = 0
+        for owner in sorted(set(attributed) | set(plans)):
+            actual = attributed.get(owner, {"bytes": 0, "buffers": 0})
+            plan = plans.get(owner)
+            attributed_bytes += actual["bytes"]
+            row = {
+                "model": owner[0],
+                "component": owner[1],
+                "bytes": actual["bytes"],
+                "buffers": actual["buffers"],
+            }
+            if plan is not None:
+                row["plan_bytes"] = plan
+                row["drift_bytes"] = plan - actual["bytes"]
+            owners.append(row)
+        unattributed = max(0, committed - attributed_bytes)
+        fraction = (attributed_bytes / committed) if committed else 1.0
+
+        watermark_src = committed
+        with self._lock:
+            if watermark_src > self._watermark:
+                self._watermark = watermark_src
+            watermark = self._watermark
+
+        pressure = None
+        if total_limit > 0:
+            used_fraction = total_in_use / total_limit
+            pressure = {
+                "fraction": round(used_fraction, 6),
+                "threshold": self.config.pressure_fraction,
+                "over": used_fraction >= self.config.pressure_fraction,
+            }
+            self._pressure_edge(pressure, total_in_use, total_limit,
+                                events)
+        return {
+            "devices": devices,
+            "totals": {
+                "bytes_in_use": total_in_use,
+                "bytes_limit": total_limit,
+                "peak_bytes_in_use": total_peak,
+                "live_array_bytes": live_bytes,
+                "live_arrays": live_count,
+                "committed_bytes": committed,
+            },
+            "owners": owners,
+            "attributed_bytes": attributed_bytes,
+            "unattributed_bytes": unattributed,
+            "attributed_fraction": round(fraction, 6),
+            "watermark_bytes": watermark,
+            "pressure": pressure,
+        }
+
+    def _pressure_edge(self, pressure: dict, in_use: int, limit: int,
+                       events) -> None:
+        """Edge-triggered ``memory.pressure`` journal events: one on
+        crossing the threshold upward, one ``pressure_cleared`` on the
+        way back down — never one per scrape."""
+        if not self.config.pressure_events or events is None:
+            return
+        over = pressure["over"]
+        with self._lock:
+            was = self._pressured
+            self._pressured = over
+        if over and not was:
+            events.emit("memory", "pressure", severity="WARNING",
+                        bytes_in_use=in_use, bytes_limit=limit,
+                        fraction=pressure["fraction"],
+                        threshold=pressure["threshold"])
+        elif was and not over:
+            events.emit("memory", "pressure_cleared",
+                        bytes_in_use=in_use, bytes_limit=limit,
+                        fraction=pressure["fraction"])
+
+
+# -- process-global census -----------------------------------------------------
+
+_default: HbmCensus | None = None
+_default_lock = threading.Lock()
+
+
+def hbm_census() -> HbmCensus:
+    """The process-global census (double-checked, like
+    :func:`client_tpu.observability.events.journal`): load paths tag
+    into it from below the engine, the engine reads reports out of it."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = HbmCensus(MemoryConfig.from_env())
+    return _default
+
+
+def reset_hbm_census() -> None:
+    """Drop the global census (tests); the next :func:`hbm_census` call
+    recreates it with current env settings."""
+    global _default
+    with _default_lock:
+        _default = None

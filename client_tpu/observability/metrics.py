@@ -389,6 +389,24 @@ class EngineMetrics:
             "Peak device HBM bytes in use since process start (0 when the "
             "platform does not report memory stats, e.g. CPU)",
             ("device",))
+        self.hbm_census_bytes = r.gauge(
+            "tpu_hbm_census_bytes",
+            "Live device-buffer bytes attributed to an owner by the HBM "
+            "census (component: weights, kv_arena, embedding, rowcache, "
+            "autotune_warm; the unattributed remainder rides with "
+            "model=\"\", component=\"unattributed\")",
+            ("model", "component"))
+        self.hbm_plan_drift_bytes = r.gauge(
+            "tpu_hbm_plan_drift_bytes",
+            "Planner-reservation bytes minus census-actual bytes per "
+            "owner (positive: the arena reserved more than is live; "
+            "negative: live memory the plan never charged)",
+            ("model", "component"))
+        self.hbm_census_watermark_bytes = r.gauge(
+            "tpu_hbm_census_watermark_bytes",
+            "High-water committed device bytes observed by the census "
+            "since process start")
+        self.hbm_census_watermark_bytes.set(0)
         self.queue_rejections = r.counter(
             "tpu_queue_rejections_total",
             "Requests rejected at admission (backpressure, HTTP 429)",
@@ -426,34 +444,44 @@ class EngineMetrics:
                     self._instruments = updated
         return inst
 
-    def update_device_gauges(self) -> None:
-        """Sample per-device HBM usage, capacity and peak; on platforms
-        without memory stats (JAX_PLATFORMS=cpu) the gauges still render,
-        pinned to 0."""
-        sampled = False
-        try:
-            import jax
+    def update_device_gauges(self, census=None) -> None:
+        """Refresh per-device HBM usage, capacity and peak from the HBM
+        census's device walk (:meth:`HbmCensus.device_stats` — the one
+        device-memory source of truth); a private census is used when
+        the caller doesn't pass one (standalone EngineMetrics). On
+        platforms without memory stats (JAX_PLATFORMS=cpu) the gauges
+        still render, pinned to 0 — byte-compatible with the pre-census
+        ad-hoc ``memory_stats()`` scrape."""
+        if census is None:
+            from client_tpu.observability.memory import hbm_census
 
-            for d in jax.local_devices():
-                try:
-                    ms = d.memory_stats()
-                except Exception:  # noqa: BLE001 — per-device probe
-                    ms = None
-                ms = ms or {}
-                dev = str(d.id)
-                self.hbm_bytes.set(int(ms.get("bytes_in_use", 0)),
-                                   device=dev)
-                self.hbm_limit_bytes.set(int(ms.get("bytes_limit", 0)),
-                                         device=dev)
-                self.hbm_peak_bytes.set(
-                    int(ms.get("peak_bytes_in_use", 0)), device=dev)
-                sampled = True
-        except Exception:  # noqa: BLE001 — no backend at all
-            pass
-        if not sampled:
+            census = hbm_census()
+        devices = census.device_stats()
+        for d in devices:
+            self.hbm_bytes.set(d["bytes_in_use"], device=d["device"])
+            self.hbm_limit_bytes.set(d["bytes_limit"], device=d["device"])
+            self.hbm_peak_bytes.set(d["peak_bytes_in_use"],
+                                    device=d["device"])
+        if not devices:
             self.hbm_bytes.set(0, device="0")
             self.hbm_limit_bytes.set(0, device="0")
             self.hbm_peak_bytes.set(0, device="0")
+
+    def update_census_gauges(self, report: dict) -> None:
+        """Refresh the attribution gauges from one census report
+        (:meth:`TpuEngine.memory_census`), called at scrape time like
+        the device gauges above."""
+        for row in report.get("owners", ()):
+            self.hbm_census_bytes.set(row["bytes"], model=row["model"],
+                                      component=row["component"])
+            if "drift_bytes" in row:
+                self.hbm_plan_drift_bytes.set(
+                    row["drift_bytes"], model=row["model"],
+                    component=row["component"])
+        self.hbm_census_bytes.set(report.get("unattributed_bytes", 0),
+                                  model="", component="unattributed")
+        self.hbm_census_watermark_bytes.set(
+            report.get("watermark_bytes", 0))
 
     def render(self, openmetrics: bool = False) -> str:
         return self.registry.render(openmetrics)

@@ -64,6 +64,9 @@ _METHODS = [
      ops.RingUnregisterResponse, False),
     ("RingDoorbell", ops.RingDoorbellRequest, ops.RingDoorbellResponse,
      False),
+    # Flight recorder ring + HBM census report.
+    ("Timeseries", ops.TimeseriesRequest, ops.TimeseriesResponse, False),
+    ("MemoryCensus", ops.MemoryRequest, ops.MemoryResponse, False),
 ]
 
 

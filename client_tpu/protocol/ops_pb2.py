@@ -32,6 +32,11 @@ the message classes. Wire-compatible with the equivalent .proto:
     message RingDoorbellRequest    { string name = 1;
                                      string doorbell_json = 2; }
     message RingDoorbellResponse   { string result_json = 1; }
+    message TimeseriesRequest  { string signal = 1; string model = 2;
+                                 uint64 since_seq = 3; uint32 limit = 4; }
+    message TimeseriesResponse { string timeseries_json = 1; }
+    message MemoryRequest      {}
+    message MemoryResponse     { string memory_json = 1; }
 
 Event.detail_json / SloStatusResponse.slo_json /
 ProfileResponse.profile_json carry the open-ended detail/report dicts as
@@ -135,6 +140,22 @@ def _file_proto() -> _descriptor_pb2.FileDescriptorProto:
     m = message("RingDoorbellResponse")
     field(m, "result_json", 1, _F.TYPE_STRING)
 
+    # Flight recorder + HBM census (the /v2/timeseries and /v2/memory
+    # bodies ride as JSON strings, same pattern as slo/profile).
+    m = message("TimeseriesRequest")
+    field(m, "signal", 1, _F.TYPE_STRING)
+    field(m, "model", 2, _F.TYPE_STRING)
+    field(m, "since_seq", 3, _F.TYPE_UINT64)
+    field(m, "limit", 4, _F.TYPE_UINT32)
+
+    m = message("TimeseriesResponse")
+    field(m, "timeseries_json", 1, _F.TYPE_STRING)
+
+    message("MemoryRequest")
+
+    m = message("MemoryResponse")
+    field(m, "memory_json", 1, _F.TYPE_STRING)
+
     return fdp
 
 
@@ -164,4 +185,8 @@ __all__ = [
     "RingUnregisterResponse",
     "RingDoorbellRequest",
     "RingDoorbellResponse",
+    "TimeseriesRequest",
+    "TimeseriesResponse",
+    "MemoryRequest",
+    "MemoryResponse",
 ]

@@ -41,7 +41,9 @@ from client_tpu.observability.fleet import (
     merge_expositions,
     merge_profiles,
     merge_slo,
+    merge_timeseries,
     profile_signals,
+    timeseries_signals,
 )
 
 _log = logging.getLogger("client_tpu")
@@ -115,6 +117,15 @@ class FleetFederator:
     def slo(self) -> dict:
         exports, errors = self._fan_out("/v2/slo", "slo")
         return merge_slo(exports, errors)
+
+    def timeseries_raw(self, query: str = ""):
+        path = "/v2/timeseries" + (f"?{query}" if query else "")
+        return self._fan_out(path, "timeseries")
+
+    def timeseries(self, query: str = "",
+                   limit: int | None = None) -> dict:
+        exports, errors = self.timeseries_raw(query)
+        return merge_timeseries(exports, errors, limit=limit)
 
     def metrics_text(self) -> str:
         """One classic-dialect exposition for the whole fleet; fetch
@@ -249,13 +260,33 @@ class FleetMonitor:
     # -- the tick ------------------------------------------------------------
 
     def collect_signals(self) -> tuple[dict, dict]:
-        """-> ({replica: {signal: value}}, {replica: fetch error})."""
-        profiles, errors = self.federator.profiles()
+        """-> ({replica: {signal: value}}, {replica: fetch error}).
+
+        Prefers the flight recorder: duty/fill/wave come as medians over
+        the last ``config.window_s`` of each replica's 1 Hz ring, so one
+        GC pause or compile stall no longer flags a replica the way a
+        single ``/v2/profile`` scrape did. Replicas without a usable
+        ring (older build, recorder disabled) fall back per replica to
+        the instantaneous profile signals; queue wait always comes from
+        the router's own load view."""
+        exports, ts_errors = self.federator.timeseries_raw()
         loads = self.federator.loads()
+        profiles: dict = {}
+        prof_errors: dict = {}
         signals = {}
         for r in self.router.replicas:
-            signals[r.id] = profile_signals(
-                profiles.get(r.id), loads.get(r.id))
+            sig = timeseries_signals(exports.get(r.id),
+                                     window_s=self.config.window_s)
+            if not sig:
+                if not profiles and not prof_errors:
+                    profiles, prof_errors = self.federator.profiles()
+                sig = profile_signals(profiles.get(r.id))
+            wait = (loads.get(r.id) or {}).get("wait_s")
+            if wait is not None:
+                sig["wait_s"] = float(wait)
+            signals[r.id] = sig
+        errors = dict(ts_errors)
+        errors.update(prof_errors)
         return signals, errors
 
     def tick(self, signals: dict | None = None,

@@ -19,7 +19,7 @@ Router-owned endpoints (never proxied):
 * ``GET /v2/trace/requests`` — the *stitched* fleet trace: router spans
   + every replica's request traces on distinct tracks
   (``?trace_id=...`` narrows to one request end-to-end).
-* ``GET /v2/fleet/{events,profile,metrics,slo}`` — federated replica
+* ``GET /v2/fleet/{events,profile,metrics,slo,timeseries}`` — federated replica
   surfaces (see :mod:`client_tpu.router.fleet`); per-replica fetch
   failures are reported inline, never failing the aggregate.
 
@@ -228,6 +228,18 @@ class _RouterHandler(BaseHTTPRequestHandler):
 
     def h_get_v2_fleet_slo(self, body):
         self._send_json(self.federator.slo())
+
+    def h_get_v2_fleet_timeseries(self, body):
+        q = self._query()
+        limit = None
+        if "limit" in q:
+            try:
+                limit = int(q.pop("limit"))
+            except ValueError:
+                self._send_json({"error": "limit must be an integer"}, 400)
+                return
+        query = "&".join(f"{k}={v}" for k, v in q.items())
+        self._send_json(self.federator.timeseries(query, limit=limit))
 
     def h_get_v2_fleet_metrics(self, body):
         text = self.federator.metrics_text()

@@ -376,6 +376,29 @@ class _Servicer(GRPCInferenceServiceServicer):
         snap = self.engine.profile_snapshot(model=request.model or None)
         return ops.ProfileResponse(profile_json=json.dumps(snap))
 
+    def Timeseries(self, request, context):  # noqa: N802
+        """gRPC mirror of ``GET /v2/timeseries``: the flight recorder's
+        1 Hz signal ring as JSON (open-ended schema)."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        try:
+            out = self.engine.timeseries_export(
+                signal=request.signal or None,
+                model=request.model or None,
+                since_seq=request.since_seq or None,
+                limit=request.limit or None)
+        except ValueError as exc:  # unknown signal name
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        return ops.TimeseriesResponse(timeseries_json=json.dumps(out))
+
+    def MemoryCensus(self, request, context):  # noqa: N802
+        """gRPC mirror of ``GET /v2/memory``: the HBM census report as
+        JSON (open-ended schema)."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        return ops.MemoryResponse(
+            memory_json=json.dumps(self.engine.memory_census()))
+
     # -- shm slot ring (zero-copy data plane; engine.shmring) ---------------
 
     def RingRegister(self, request, context):  # noqa: N802

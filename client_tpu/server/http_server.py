@@ -78,6 +78,8 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/v2/events$"), "events"),
     ("GET", re.compile(r"^/v2/slo$"), "slo"),
     ("GET", re.compile(r"^/v2/profile$"), "profile"),
+    ("GET", re.compile(r"^/v2/timeseries$"), "timeseries"),
+    ("GET", re.compile(r"^/v2/memory$"), "memory"),
     ("GET", re.compile(r"^/v2/load$"), "load"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
 ]
@@ -374,6 +376,39 @@ class _Handler(BaseHTTPRequestHandler):
         q = parse_qs(urlparse(self.path).query)
         model = (q.get("model") or [None])[0]
         self._send_json(self.engine.profile_snapshot(model=model))
+
+    def h_timeseries(self):
+        """Flight-recorder export (``/v2/timeseries``): the 1 Hz signal
+        ring. Filters: ``?signal=`` one signal family, ``?model=``
+        narrows per-model maps, ``?since=<seq>`` exclusive cursor (use
+        the previous response's ``next_seq``), ``?limit=<n>`` newest n."""
+        from urllib.parse import parse_qs, urlparse
+
+        q = parse_qs(urlparse(self.path).query)
+
+        def one(key):
+            return (q.get(key) or [None])[0]
+
+        def num(key, cast):
+            raw = one(key)
+            if raw is None:
+                return None
+            try:
+                return cast(raw)
+            except ValueError:
+                raise EngineError(f"malformed {key!r} parameter", 400)
+
+        try:
+            self._send_json(self.engine.timeseries_export(
+                signal=one("signal"), model=one("model"),
+                since_seq=num("since", int), limit=num("limit", int)))
+        except ValueError as exc:  # unknown signal name
+            raise EngineError(str(exc), 400)
+
+    def h_memory(self):
+        """HBM census report (``/v2/memory``): per-(model, component)
+        live device bytes, plan-vs-actual drift, watermark, pressure."""
+        self._send_json(self.engine.memory_census())
 
     def h_load(self):
         """Replica load report (``/v2/load``): the pull form of the

@@ -1,0 +1,617 @@
+"""Flight recorder + HBM census: the continuous-telemetry tentpole.
+
+Units cover config parsing for both env vars (defaults-on-unset, off,
+inline JSON, unknown-key fail-fast), the recorder ring (manual ticks,
+wraparound with the dropped counter, the exclusive since-cursor, signal
+and model narrowing, scalar-max vs model-map merge across co-resident
+providers, weakref pruning, thread start/stop idempotence), and the
+census (tagging with overwrite semantics, weakref death, dynamic
+providers for donated arenas, plan-vs-actual drift sign, and the
+no-allocation guarantee of the metadata byte walk). The e2e half runs a
+real engine behind HttpInferenceServer with a fast sampling interval
+and asserts the acceptance surfaces: >= 60 samples of duty_cycle /
+queue_depth / hbm_used over /v2/timeseries, a /v2/memory owner table,
+promlint-clean tpu_hbm_census_bytes in both dialects, and the router's
+/v2/fleet/timeseries merging two replicas with per-replica tags.
+"""
+
+import gc
+import importlib.util
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from client_tpu.engine import InferRequest, TpuEngine
+from client_tpu.models import build_repository
+from client_tpu.observability.memory import (
+    HbmCensus,
+    MemoryConfig,
+    _buffer_nbytes,
+    reset_hbm_census,
+)
+from client_tpu.observability.timeseries import (
+    SCALAR_SIGNALS,
+    SIGNALS,
+    FlightRecorder,
+    TimeseriesConfig,
+    recorder,
+    reset_recorder,
+)
+from client_tpu.router import Replica, Router, RouterHttpServer
+from client_tpu.server import HttpInferenceServer
+
+
+def _load_promlint():
+    spec = importlib.util.spec_from_file_location(
+        "promlint", os.path.join(os.path.dirname(__file__), "..",
+                                 "tools", "promlint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+promlint = _load_promlint()
+
+
+def _get_json(url, path):
+    with urllib.request.urlopen(f"http://{url}{path}", timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+# ---------------------------------------------------------------------------
+# Config parsing
+
+
+class TestTimeseriesConfig:
+    def test_unset_means_enabled_defaults(self):
+        cfg = TimeseriesConfig.from_env(environ={})
+        assert cfg.enabled and cfg.interval_s == 1.0 and cfg.capacity == 900
+
+    def test_off_disables(self):
+        for raw in ("0", "off", "false"):
+            cfg = TimeseriesConfig.from_env(
+                environ={"CLIENT_TPU_TIMESERIES": raw})
+            assert not cfg.enabled
+
+    def test_inline_json(self):
+        cfg = TimeseriesConfig.from_env(environ={
+            "CLIENT_TPU_TIMESERIES":
+                '{"interval_s": 0.25, "capacity": 40}'})
+        assert cfg.enabled and cfg.interval_s == 0.25 and cfg.capacity == 40
+
+    def test_unknown_key_and_bad_values_fail_fast(self):
+        with pytest.raises(ValueError, match="unknown key"):
+            TimeseriesConfig.from_dict({"intervall_s": 1})
+        with pytest.raises(ValueError, match="expects a number"):
+            TimeseriesConfig.from_dict({"interval_s": "fast"})
+        with pytest.raises(ValueError, match="capacity"):
+            TimeseriesConfig.from_dict({"capacity": 0})
+        with pytest.raises(ValueError, match="interval_s"):
+            TimeseriesConfig.from_dict({"interval_s": -1})
+        with pytest.raises(ValueError, match="invalid JSON"):
+            TimeseriesConfig.from_env(
+                environ={"CLIENT_TPU_TIMESERIES": "{nope"})
+
+    def test_at_file_missing_fails(self):
+        with pytest.raises(ValueError, match="cannot read"):
+            TimeseriesConfig.from_env(environ={
+                "CLIENT_TPU_TIMESERIES": "@/nonexistent/ts.json"})
+
+
+class TestMemoryConfig:
+    def test_unset_means_defaults(self):
+        cfg = MemoryConfig.from_env(environ={})
+        assert cfg.pressure_events and cfg.pressure_fraction == 0.9
+
+    def test_off_silences_pressure_events_only(self):
+        cfg = MemoryConfig.from_env(environ={"CLIENT_TPU_MEMORY": "off"})
+        assert not cfg.pressure_events
+
+    def test_inline_json_and_validation(self):
+        cfg = MemoryConfig.from_env(environ={
+            "CLIENT_TPU_MEMORY": '{"pressure_fraction": 0.5}'})
+        assert cfg.pressure_fraction == 0.5
+        with pytest.raises(ValueError, match="unknown key"):
+            MemoryConfig.from_dict({"pressure": 0.5})
+        with pytest.raises(ValueError, match="pressure_fraction"):
+            MemoryConfig.from_dict({"pressure_fraction": 2})
+
+
+# ---------------------------------------------------------------------------
+# FlightRecorder ring mechanics (manual ticks, no thread)
+
+
+class _Provider:
+    """A fake engine: returns whatever sample dict it's told to."""
+
+    def __init__(self, sample):
+        self.sample = sample
+        self.calls = 0
+
+    def timeseries_sample(self):
+        self.calls += 1
+        if isinstance(self.sample, Exception):
+            raise self.sample
+        return self.sample
+
+
+class TestFlightRecorder:
+    def test_tick_records_and_export_reads(self):
+        rec = FlightRecorder(TimeseriesConfig(capacity=10))
+        p = _Provider({"duty_cycle": 0.5, "queue_depth": {"m": 3}})
+        rec.attach(p)  # recorder holds p weakly: the local keeps it alive
+        rec.stop()  # manual ticks only; attach started the thread
+        sample = rec.tick()
+        assert sample["seq"] == 1
+        out = rec.export()
+        assert out["enabled"] and len(out["samples"]) == 1
+        assert out["samples"][0]["signals"]["duty_cycle"] == 0.5
+        assert out["samples"][0]["signals"]["queue_depth"] == {"m": 3}
+        assert out["next_seq"] == 1 and out["dropped"] == 0
+        assert out["signals"] == list(SIGNALS)
+
+    def test_wraparound_counts_dropped_and_seq_monotonic(self):
+        rec = FlightRecorder(TimeseriesConfig(capacity=3))
+        p = _Provider({"duty_cycle": 0.1})
+        rec.attach(p)
+        rec.stop()
+        for _ in range(5):
+            rec.tick()
+        out = rec.export()
+        assert len(out["samples"]) == 3
+        assert [s["seq"] for s in out["samples"]] == [3, 4, 5]
+        assert out["dropped"] == 2 and out["next_seq"] == 5
+
+    def test_since_cursor_exclusive_and_limit(self):
+        rec = FlightRecorder(TimeseriesConfig(capacity=10))
+        p = _Provider({"duty_cycle": 0.1})
+        rec.attach(p)
+        rec.stop()
+        for _ in range(5):
+            rec.tick()
+        out = rec.export(since_seq=3)
+        assert [s["seq"] for s in out["samples"]] == [4, 5]
+        # Resume from next_seq: nothing new yet.
+        assert rec.export(since_seq=out["next_seq"])["samples"] == []
+        assert [s["seq"] for s in rec.export(limit=2)["samples"]] == [4, 5]
+
+    def test_signal_and_model_filters(self):
+        rec = FlightRecorder(TimeseriesConfig(capacity=10))
+        p = _Provider({"duty_cycle": 0.2,
+                       "queue_depth": {"a": 1, "b": 2},
+                       "in_flight": {"a": 0}})
+        rec.attach(p)
+        rec.stop()
+        rec.tick()
+        only = rec.export(signal="queue_depth")["samples"][0]["signals"]
+        assert set(only) == {"queue_depth"}
+        narrowed = rec.export(model="b")["samples"][0]["signals"]
+        assert narrowed["queue_depth"] == {"b": 2}
+        assert "in_flight" not in narrowed  # model b has no entry
+        assert narrowed["duty_cycle"] == 0.2  # scalars survive model filter
+        with pytest.raises(ValueError, match="unknown signal"):
+            rec.export(signal="jitter")
+
+    def test_scalar_max_and_model_map_merge_across_providers(self):
+        # Two co-resident engines share one device: scalar signals take
+        # the max (same HBM counted once), model maps union.
+        rec = FlightRecorder(TimeseriesConfig(capacity=4))
+        p1 = _Provider({"duty_cycle": 0.3, "hbm_used": 100,
+                        "queue_depth": {"a": 1}})
+        p2 = _Provider({"duty_cycle": 0.7, "hbm_used": 90,
+                        "queue_depth": {"b": 5}})
+        rec.attach(p1)
+        rec.attach(p2)
+        rec.stop()
+        sig = rec.tick()["signals"]
+        assert sig["duty_cycle"] == 0.7 and sig["hbm_used"] == 100
+        assert sig["queue_depth"] == {"a": 1, "b": 5}
+        assert "duty_cycle" in SCALAR_SIGNALS
+
+    def test_sick_provider_skipped_not_fatal(self):
+        rec = FlightRecorder(TimeseriesConfig(capacity=4))
+        sick = _Provider(RuntimeError("mid-shutdown"))
+        ok = _Provider({"duty_cycle": 0.4})
+        rec.attach(sick)
+        rec.attach(ok)
+        rec.stop()
+        assert rec.tick()["signals"]["duty_cycle"] == 0.4
+
+    def test_detach_and_weakref_prune_stop_contribution(self):
+        rec = FlightRecorder(TimeseriesConfig(capacity=8))
+        keep = _Provider({"duty_cycle": 0.1})
+        gone = _Provider({"duty_cycle": 0.9})
+        rec.attach(keep)
+        rec.attach(gone)
+        rec.stop()
+        rec.detach(gone)
+        assert rec.tick()["signals"]["duty_cycle"] == 0.1
+        dead = _Provider({"duty_cycle": 0.8})
+        rec.attach(dead)
+        rec.stop()
+        del dead
+        gc.collect()
+        assert rec.tick()["signals"]["duty_cycle"] == 0.1
+        assert len(rec.providers()) == 1
+
+    def test_thread_start_stop_idempotent(self):
+        rec = FlightRecorder(TimeseriesConfig(interval_s=0.01, capacity=64))
+        p = _Provider({"duty_cycle": 0.1})
+        rec.attach(p)
+        assert rec.running()
+        first = rec._thread
+        rec.start()
+        rec.start()
+        assert rec._thread is first  # no second thread spawned
+        deadline = time.time() + 5
+        while p.calls == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert p.calls > 0, "sampler thread never ticked"
+        rec.stop()
+        rec.stop()
+        assert not rec.running()
+        assert len(rec.export()["samples"]) > 0  # ring kept after stop
+
+    def test_disabled_recorder_is_inert(self):
+        rec = FlightRecorder(TimeseriesConfig(enabled=False))
+        rec.attach(_Provider({"duty_cycle": 0.5}))
+        assert not rec.running()
+        assert rec.providers() == []  # attach was a no-op
+        assert rec.tick() is None
+        out = rec.export()
+        assert out["enabled"] is False and out["samples"] == []
+
+
+class TestGlobalRecorder:
+    def test_reset_recreates_from_env(self, monkeypatch):
+        monkeypatch.setenv("CLIENT_TPU_TIMESERIES",
+                           '{"interval_s": 2.5, "capacity": 7}')
+        reset_recorder()
+        try:
+            rec = recorder()
+            assert rec.config.interval_s == 2.5
+            assert rec.config.capacity == 7
+            assert recorder() is rec  # singleton
+        finally:
+            reset_recorder()
+
+
+# ---------------------------------------------------------------------------
+# HBM census
+
+
+class _Buf:
+    """A weakref-able stand-in for a device buffer: no .sharding, so
+    the census byte walk takes the .nbytes fallback."""
+
+    def __init__(self, nbytes):
+        self.nbytes = nbytes
+
+
+class _Arena:
+    """A planner-arena stand-in with the reservation-name grammar."""
+
+    def __init__(self, reservations):
+        self._res = reservations
+
+    def snapshot(self):
+        return {"reservations": [{"name": n, "nbytes": b}
+                                 for n, b in self._res]}
+
+
+def _census_rows(report):
+    return {(o["model"], o["component"]): o for o in report["owners"]}
+
+
+class TestHbmCensus:
+    def test_tag_attributes_bytes_and_buffers(self):
+        census = HbmCensus()
+        b1, b2 = _Buf(100), _Buf(200)
+        assert census.tag("m", "weights", [b1, b2]) == 2
+        row = census._attributed()[("m", "weights")]
+        assert row == {"bytes": 300, "buffers": 2}
+
+    def test_dead_buffer_pruned_on_walk(self):
+        census = HbmCensus()
+        b1, b2 = _Buf(100), _Buf(200)
+        census.tag("m", "weights", [b1, b2])
+        del b1
+        gc.collect()
+        row = census._attributed()[("m", "weights")]
+        assert row == {"bytes": 200, "buffers": 1}
+
+    def test_untag_by_owner(self):
+        census = HbmCensus()
+        b1, b2 = _Buf(1), _Buf(2)
+        census.tag("m", "weights", b1)
+        census.tag("m", "embedding", b2)
+        assert census.untag("m", "weights") == 1
+        assert list(census._attributed()) == [("m", "embedding")]
+
+    def test_specific_tag_survives_generic_pass(self):
+        # DLRM tags its table "embedding" during make_apply_params; the
+        # generic weights pass in Model.__init__ must not clobber it.
+        census = HbmCensus()
+        table, dense = _Buf(500), _Buf(50)
+        census.tag("dlrm", "embedding", table)
+        census.tag("dlrm", "weights", [table, dense], overwrite=False)
+        rows = census._attributed()
+        assert rows[("dlrm", "embedding")]["bytes"] == 500
+        assert rows[("dlrm", "weights")]["buffers"] == 1
+        # Default overwrite=True does re-own.
+        census.tag("dlrm", "weights", table)
+        assert ("dlrm", "embedding") not in census._attributed()
+
+    def test_unweakrefable_leaves_skipped(self):
+        census = HbmCensus()
+        assert census.tag("m", "weights", [5, "x", _Buf(10)]) == 1
+
+    def test_dynamic_provider_register_unregister_and_death(self):
+        census = HbmCensus()
+
+        class Owner:
+            nbytes = 4096
+
+        def walk(owner):
+            return owner.nbytes, 3
+
+        owner = Owner()
+        census.register_provider("g", "kv_arena", owner, walk)
+        row = census._attributed()[("g", "kv_arena")]
+        assert row == {"bytes": 4096, "buffers": 3}
+        census.unregister_provider(owner)
+        assert ("g", "kv_arena") not in census._attributed()
+        census.register_provider("g", "kv_arena", owner, walk)
+        del owner
+        gc.collect()
+        assert ("g", "kv_arena") not in census._attributed()
+
+    def test_drift_sign_plan_minus_actual(self):
+        census = HbmCensus()
+        arena = _Arena([("kv:m:1", 1000), ("bucket:m:1:8", 50),
+                        ("unrelated", 7)])
+        census.register_arena(arena)  # held weakly: local keeps it alive
+        kv = _Buf(400)
+        census.tag("m", "kv_arena", kv)
+        rows = _census_rows(census.report())
+        kv = rows[("m", "kv_arena")]
+        assert kv["plan_bytes"] == 1000
+        assert kv["drift_bytes"] == 600  # planner over-reserved
+        warm = rows[("m", "autotune_warm")]
+        assert warm["bytes"] == 0 and warm["drift_bytes"] == 50
+        assert ("unrelated", None) not in rows  # unknown prefix ignored
+
+    def test_negative_drift_when_live_exceeds_plan(self):
+        census = HbmCensus()
+        arena = _Arena([("kv:m:1", 100)])
+        census.register_arena(arena)
+        big = _Buf(900)
+        census.tag("m", "kv_arena", big)
+        assert _census_rows(census.report())[
+            ("m", "kv_arena")]["drift_bytes"] == -800
+
+    def test_unregister_arena_drops_plan_rows(self):
+        census = HbmCensus()
+        arena = _Arena([("kv:m:1", 100)])
+        census.register_arena(arena)
+        census.register_arena(arena)  # idempotent
+        assert ("m", "kv_arena") in _census_rows(census.report())
+        census.unregister_arena(arena)
+        assert ("m", "kv_arena") not in _census_rows(census.report())
+
+    def test_extra_plans_merge(self):
+        census = HbmCensus()
+        rows = _census_rows(census.report(
+            extra_plans={("d", "rowcache"): 640}))
+        assert rows[("d", "rowcache")]["plan_bytes"] == 640
+        assert rows[("d", "rowcache")]["drift_bytes"] == 640
+
+    def test_report_shape_and_watermark_monotonic(self):
+        census = HbmCensus()
+        rep = census.report()
+        assert {"devices", "totals", "owners", "attributed_bytes",
+                "unattributed_bytes", "attributed_fraction",
+                "watermark_bytes", "pressure"} <= set(rep)
+        assert rep["totals"]["committed_bytes"] >= 0
+        assert census.report()["watermark_bytes"] >= rep["watermark_bytes"]
+
+    def test_global_reset(self, monkeypatch):
+        from client_tpu.observability.memory import hbm_census
+
+        monkeypatch.setenv("CLIENT_TPU_MEMORY",
+                           '{"pressure_fraction": 0.42}')
+        reset_hbm_census()
+        try:
+            assert hbm_census().config.pressure_fraction == 0.42
+        finally:
+            reset_hbm_census()
+
+
+class TestBufferNbytes:
+    def test_numpy_fallback(self):
+        a = np.zeros((4, 8), np.float32)
+        assert _buffer_nbytes(a) == a.nbytes
+
+    def test_jax_metadata_path_matches_nbytes(self):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        arr = jnp.zeros((16, 16), jnp.float32)
+        assert _buffer_nbytes(arr) == 16 * 16 * 4
+        assert _buffer_nbytes(arr) == int(arr.nbytes)
+        del arr
+
+    def test_walk_does_not_mint_live_arrays(self):
+        # Regression: summing shard.data.nbytes materializes one new
+        # jax.Array per shard per walk, inflating live_arrays and
+        # halving attribution on the next pass. The metadata walk must
+        # leave the live-array population unchanged.
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        arrs = [jnp.zeros((8, 8), jnp.float32) for _ in range(4)]
+        jax.block_until_ready(arrs)
+        gc.collect()
+        before = len(jax.live_arrays())
+        for _ in range(3):
+            for a in arrs:
+                _buffer_nbytes(a)
+        gc.collect()
+        assert len(jax.live_arrays()) == before
+        del arrs
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: disabled env is byte-identical
+
+
+class TestDisabledRecorderEngine:
+    def test_engine_runs_without_recorder(self, monkeypatch):
+        monkeypatch.setenv("CLIENT_TPU_TIMESERIES", "0")
+        reset_recorder()
+        engine = TpuEngine(build_repository(["simple"]))
+        try:
+            assert not engine.recorder.running()
+            assert engine.recorder.providers() == []
+            resp = engine.infer(InferRequest(
+                model_name="simple",
+                inputs={"INPUT0": np.zeros((1, 16), np.int32),
+                        "INPUT1": np.ones((1, 16), np.int32)}),
+                timeout_s=30)
+            assert resp.error is None
+            out = engine.timeseries_export()
+            assert out["enabled"] is False and out["samples"] == []
+        finally:
+            engine.shutdown()
+            reset_recorder()
+
+
+# ---------------------------------------------------------------------------
+# E2E: a real engine + HTTP server with a fast sampling interval
+
+
+@pytest.fixture(scope="class")
+def live():
+    os.environ["CLIENT_TPU_TIMESERIES"] = \
+        '{"interval_s": 0.01, "capacity": 900}'
+    reset_recorder()
+    # tiny_gpt rides along for the census: it has real device weights
+    # and a donated KV arena ("simple" is parameterless — nothing to tag).
+    engine = TpuEngine(build_repository(["simple", "tiny_gpt"]))
+    srv = HttpInferenceServer(engine, port=0).start()
+    try:
+        # A burst, then wait for the sampler to bank >= 60 ticks — the
+        # fast-interval stand-in for "60 s of 1 Hz history".
+        for _ in range(8):
+            engine.infer(InferRequest(
+                model_name="simple",
+                inputs={"INPUT0": np.zeros((1, 16), np.int32),
+                        "INPUT1": np.ones((1, 16), np.int32)}),
+                timeout_s=30)
+        deadline = time.time() + 30
+        while (len(engine.timeseries_export()["samples"]) < 60
+               and time.time() < deadline):
+            time.sleep(0.05)
+        yield {"engine": engine, "srv": srv}
+    finally:
+        srv.stop()
+        engine.shutdown()
+        os.environ.pop("CLIENT_TPU_TIMESERIES", None)
+        reset_recorder()
+
+
+@pytest.mark.chaos
+class TestTimeseriesHttpE2E:
+    def test_sixty_samples_of_core_signals(self, live):
+        doc = _get_json(live["srv"].url, "/v2/timeseries")
+        assert doc["enabled"] and len(doc["samples"]) >= 60
+        latest = doc["samples"][-1]["signals"]
+        assert "duty_cycle" in latest
+        assert "simple" in latest["queue_depth"]
+        assert latest["hbm_used"] > 0
+        seqs = [s["seq"] for s in doc["samples"]]
+        assert seqs == sorted(seqs)
+
+    def test_signal_filter_cursor_and_limit(self, live):
+        doc = _get_json(live["srv"].url,
+                        "/v2/timeseries?signal=duty_cycle&limit=5")
+        assert len(doc["samples"]) == 5
+        assert all(set(s["signals"]) <= {"duty_cycle"}
+                   for s in doc["samples"])
+        nxt = doc["next_seq"]
+        doc2 = _get_json(live["srv"].url, f"/v2/timeseries?since={nxt}")
+        assert all(s["seq"] > nxt for s in doc2["samples"])
+
+    def test_unknown_signal_is_400(self, live):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_json(live["srv"].url, "/v2/timeseries?signal=bogus")
+        assert ei.value.code == 400
+
+    def test_memory_endpoint_attributes_weights(self, live):
+        doc = _get_json(live["srv"].url, "/v2/memory")
+        rows = {(o["model"], o["component"]): o for o in doc["owners"]}
+        assert ("tiny_gpt", "weights") in rows
+        assert rows[("tiny_gpt", "weights")]["bytes"] > 0
+        assert ("tiny_gpt", "kv_arena") in rows
+        assert rows[("tiny_gpt", "kv_arena")]["bytes"] > 0
+        assert doc["totals"]["committed_bytes"] > 0
+        assert 0 < doc["attributed_fraction"] <= 1
+        assert doc["watermark_bytes"] >= doc["totals"]["committed_bytes"]
+
+    def test_profile_carries_memory_summary(self, live):
+        doc = _get_json(live["srv"].url, "/v2/profile")
+        assert doc["memory"]["committed_bytes"] > 0
+        assert "attributed_fraction" in doc["memory"]
+
+    def test_census_gauges_promlint_clean_both_dialects(self, live):
+        for om in (False, True):
+            req = urllib.request.Request(
+                f"http://{live['srv'].url}/metrics",
+                headers={"Accept": "application/openmetrics-text"}
+                if om else {})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                text = resp.read().decode()
+            assert "tpu_hbm_census_bytes" in text
+            assert "tpu_hbm_census_watermark_bytes" in text
+            assert promlint.lint(text, openmetrics=om) == []
+
+    def test_http_client_surface(self, live):
+        import client_tpu.http as httpclient
+
+        with httpclient.InferenceServerClient(live["srv"].url) as cl:
+            doc = cl.get_timeseries(signal="duty_cycle", limit=3)
+            assert len(doc["samples"]) == 3
+            mem = cl.get_memory()
+            assert mem["totals"]["committed_bytes"] > 0
+
+
+@pytest.mark.chaos
+class TestFleetTimeseriesE2E:
+    def test_router_merges_two_replicas_with_tags(self, live):
+        # Second in-process replica: both engines attach to the same
+        # process-global recorder, but each server exports through its
+        # own engine, so the router still sees two distinct feeds.
+        eng2 = TpuEngine(build_repository(["simple"]))
+        srv2 = HttpInferenceServer(eng2, port=0).start()
+        router = Router([Replica(live["srv"].url), Replica(srv2.url)],
+                        poll_interval_s=3600.0)
+        front = RouterHttpServer(router, port=0).start()
+        try:
+            doc = _get_json(front.url, "/v2/fleet/timeseries?limit=40")
+            assert doc["errors"] == {}
+            assert set(doc["replicas"]) == {r.id for r in router.replicas}
+            tags = {s["replica"] for s in doc["samples"]}
+            assert tags == {r.id for r in router.replicas}
+            assert set(doc["cursors"]) == tags
+            stamps = [s["ts_wall"] for s in doc["samples"]]
+            assert stamps == sorted(stamps)
+            assert doc["interval_s"] == 0.01
+        finally:
+            front.stop()
+            srv2.stop()
+            eng2.shutdown()

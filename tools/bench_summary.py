@@ -135,7 +135,8 @@ def main() -> int:
                 continue
             eff_keys = ("fill_ratio", "duty_cycle", "xla_compiles",
                         "pad_waste_device_s", "wave_step_ms_p50",
-                        "cache_hit_rate")
+                        "cache_hit_rate", "timeseries_samples",
+                        "census_attr_fraction")
             view = {k: v for k, v in rec.items()
                     if k not in ("probe", "ts", "run_ts", "platform",
                                  "config", "windows") + eff_keys}

@@ -15,9 +15,11 @@
 #   3. live scrape: boot a real HTTP server, lint /metrics in both the
 #      classic and OpenMetrics expositions with tools/promlint.py (the
 #      OpenMetrics pass also requires an exemplar on tpu_request_duration),
-#      and smoke-scrape /v2/events and /v2/slo — catching malformed
+#      and smoke-scrape /v2/events, /v2/slo, /v2/timeseries (flight
+#      recorder ring) and /v2/memory (HBM census) — catching malformed
 #      renderings and broken ops endpoints that unit tests of individual
-#      counters never exercise.
+#      counters never exercise. The census gauge family
+#      tpu_hbm_census_bytes must render in both dialects.
 #   4. autotune e2e: boot the server with CLIENT_TPU_AUTOTUNE enabled and
 #      a deliberately misfit bucket ladder, drive skewed batch-1 traffic,
 #      and assert the tuner promotes a bucket (journaled, applied state in
@@ -127,9 +129,20 @@ try:
         sys.exit(f"/v2/profile smoke failed: {str(prof)[:200]}")
     if "tpu_batch_fill_ratio" not in classic:
         sys.exit("tpu_batch_fill_ratio missing from /metrics scrape")
+    engine.recorder.tick()  # deterministic sample even on a fast scrape
+    ts = json.load(urlopen(f"{base}/v2/timeseries", timeout=10))
+    if not ts.get("enabled") or not ts.get("samples"):
+        sys.exit(f"/v2/timeseries smoke failed: {str(ts)[:200]}")
+    mem = json.load(urlopen(f"{base}/v2/memory", timeout=10))
+    if "owners" not in mem or "attributed_fraction" not in mem:
+        sys.exit(f"/v2/memory smoke failed: {str(mem)[:200]}")
+    if "tpu_hbm_census_bytes" not in classic:
+        sys.exit("tpu_hbm_census_bytes missing from /metrics scrape")
     print(f"ops endpoints ok: {len(events['events'])} event(s), "
           f"slo enabled={slo['enabled']}, "
-          f"profile models={len(prof['models'])}")
+          f"profile models={len(prof['models'])}, "
+          f"timeseries samples={len(ts['samples'])}, "
+          f"census owners={len(mem['owners'])}")
 finally:
     srv.stop()
     engine.shutdown()
@@ -139,6 +152,10 @@ python tools/promlint.py "$SCRAPE_DIR/metrics.txt" \
     || { echo "promlint (classic) FAILED"; rc=1; }
 python tools/promlint.py --openmetrics "$SCRAPE_DIR/metrics.om.txt" \
     || { echo "promlint (openmetrics) FAILED"; rc=1; }
+grep -q "^tpu_hbm_census_bytes" "$SCRAPE_DIR/metrics.txt" \
+    || { echo "tpu_hbm_census_bytes missing from classic dialect"; rc=1; }
+grep -q "^tpu_hbm_census_bytes" "$SCRAPE_DIR/metrics.om.txt" \
+    || { echo "tpu_hbm_census_bytes missing from openmetrics dialect"; rc=1; }
 rm -rf "$SCRAPE_DIR"
 
 echo "=== stage 4/9: autotune e2e (promotion + metrics) ==="

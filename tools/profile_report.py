@@ -18,6 +18,14 @@ replica with its drift scores) followed by each replica's per-bucket
 cost table.
 
     python tools/profile_report.py http://127.0.0.1:8080 --fleet
+
+``--timeseries`` renders the flight recorder (``/v2/timeseries`` or a
+saved export) as one unicode sparkline per signal (per-model signals
+get one line per model); ``--memory`` renders the HBM census
+(``/v2/memory``) as an owner table with plan-vs-actual drift.
+
+    python tools/profile_report.py http://127.0.0.1:8000 --timeseries
+    python tools/profile_report.py http://127.0.0.1:8000 --memory
 """
 
 from __future__ import annotations
@@ -33,18 +41,22 @@ _COLS = ("bucket", "axis", "execs", "cold", "rows", "padded", "fill",
 
 
 def load_snapshot(source: str, model: str = "", fleet: bool = False,
-                  timeout_s: float = 10.0) -> dict:
-    """Fetch from a server base URL or read a saved JSON file."""
+                  endpoint: str = "", timeout_s: float = 10.0) -> dict:
+    """Fetch from a server base URL or read a saved JSON file.
+    ``endpoint`` overrides the path (``/v2/timeseries``, ``/v2/memory``);
+    the default is the profile surface (fleet-aware)."""
     if urlparse(source).scheme in ("http", "https"):
         url = source.rstrip("/") + (
-            "/v2/fleet/profile" if fleet else "/v2/profile")
-        if model and not fleet:
+            endpoint or ("/v2/fleet/profile" if fleet else "/v2/profile"))
+        if model and endpoint == "/v2/timeseries":
+            url += f"?model={quote(model)}"
+        elif model and not fleet and not endpoint:
             url += f"?model={quote(model)}"
         with urlopen(url, timeout=timeout_s) as resp:
             return json.load(resp)
     with open(source) as f:
         snap = json.load(f)
-    if model and not fleet:
+    if model and not fleet and not endpoint:
         snap = dict(snap, models={k: v for k, v in snap["models"].items()
                                   if v.get("model") == model})
     return snap
@@ -131,6 +143,107 @@ def render_fleet(fleet_snap: dict, out=None) -> None:
         render(replicas[rid], out=out)
 
 
+# -- flight recorder sparklines ------------------------------------------------
+
+_SPARKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list[float], width: int = 60) -> str:
+    """Map a series onto ▁..█ glyphs, newest-right, downsampled to
+    ``width`` by bucket-mean. A flat series renders as all-▁."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        # bucket-mean downsample: len(vals)/width samples per glyph
+        step = len(vals) / width
+        vals = [sum(vals[int(i * step):max(int(i * step) + 1,
+                                           int((i + 1) * step))])
+                / max(1, int((i + 1) * step) - int(i * step))
+                for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARKS[0] * len(vals)
+    return "".join(_SPARKS[min(len(_SPARKS) - 1,
+                               int((v - lo) / span * len(_SPARKS)))]
+                   for v in vals)
+
+
+def render_timeseries(export: dict, out=None, width: int = 60) -> None:
+    """One sparkline per signal; per-model signals one line per model.
+    Each line carries the min/last/max so the glyph scale is readable."""
+    w = (out or sys.stdout).write
+    samples = export.get("samples", [])
+    w(f"flight recorder: {len(samples)} sample(s), "
+      f"interval {export.get('interval_s')}s, capacity "
+      f"{export.get('capacity')}, dropped {export.get('dropped', 0)}, "
+      f"next_seq {export.get('next_seq')}\n")
+    if not samples:
+        w("no samples recorded yet\n")
+        return
+    series: dict[str, list[float]] = {}
+    for s in samples:
+        for name, value in (s.get("signals") or {}).items():
+            if isinstance(value, dict):
+                for mname, v in value.items():
+                    series.setdefault(f"{name}[{mname}]", []).append(
+                        float(v))
+            else:
+                series.setdefault(name, []).append(float(value))
+    if not series:
+        w("no signals in the window\n")
+        return
+    label_w = max(len(k) for k in series)
+    for name in sorted(series):
+        vals = series[name]
+        w(f"  {name.ljust(label_w)}  {sparkline(vals, width)}  "
+          f"min={min(vals):.4g} last={vals[-1]:.4g} "
+          f"max={max(vals):.4g}\n")
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return (f"{n:.0f}{unit}" if unit == "B"
+                    else f"{n:.2f}{unit}")
+        n /= 1024
+    return f"{n:.2f}GiB"
+
+
+def render_memory(report: dict, out=None) -> None:
+    """The HBM census owner table: live bytes and buffer counts per
+    (model, component), plan bytes and drift where the planner holds a
+    reservation, then the unattributed remainder and totals."""
+    w = (out or sys.stdout).write
+    totals = report.get("totals", {})
+    w(f"hbm census: committed {_fmt_bytes(totals.get('committed_bytes', 0))} "
+      f"({totals.get('live_arrays', 0)} live arrays), "
+      f"attributed {report.get('attributed_fraction', 0) * 100:.1f}%, "
+      f"watermark {_fmt_bytes(report.get('watermark_bytes', 0))}\n")
+    header = ("model", "component", "bytes", "buffers", "plan", "drift")
+    rows = [header]
+    for o in report.get("owners", []):
+        rows.append((o["model"], o["component"], _fmt_bytes(o["bytes"]),
+                     str(o["buffers"]),
+                     _fmt_bytes(o["plan_bytes"])
+                     if "plan_bytes" in o else "-",
+                     f"{o['drift_bytes']:+d}"
+                     if "drift_bytes" in o else "-"))
+    unattr = report.get("unattributed_bytes", 0)
+    rows.append(("", "unattributed", _fmt_bytes(unattr), "-", "-", "-"))
+    widths = [max(len(str(r[i])) for r in rows) for i in range(len(header))]
+    for r in rows:
+        w("  " + "  ".join(str(v).ljust(widths[i])
+                           for i, v in enumerate(r)).rstrip() + "\n")
+    pressure = report.get("pressure")
+    if pressure:
+        mark = " OVER THRESHOLD" if pressure.get("over") else ""
+        w(f"  pressure: {pressure['fraction'] * 100:.1f}% of limit "
+          f"(threshold {pressure['threshold'] * 100:.0f}%){mark}\n")
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("source", help="server base URL or saved snapshot path")
@@ -140,10 +253,21 @@ def main(argv=None) -> int:
                         "/v2/fleet/profile with per-replica drift")
     p.add_argument("--json", action="store_true",
                    help="dump the (filtered) snapshot as JSON instead")
+    p.add_argument("--timeseries", action="store_true",
+                   help="render the flight recorder (/v2/timeseries) "
+                        "as per-signal sparklines")
+    p.add_argument("--memory", action="store_true",
+                   help="render the HBM census (/v2/memory) as an "
+                        "owner/drift table")
     args = p.parse_args(argv)
+    endpoint = ""
+    if args.timeseries:
+        endpoint = "/v2/timeseries"
+    elif args.memory:
+        endpoint = "/v2/memory"
     try:
         snap = load_snapshot(args.source, model=args.model,
-                             fleet=args.fleet)
+                             fleet=args.fleet, endpoint=endpoint)
     except Exception as exc:  # noqa: BLE001 — CLI surface
         print(f"profile_report: cannot load {args.source}: {exc}",
               file=sys.stderr)
@@ -151,6 +275,10 @@ def main(argv=None) -> int:
     if args.json:
         json.dump(snap, sys.stdout, indent=2)
         sys.stdout.write("\n")
+    elif args.timeseries:
+        render_timeseries(snap)
+    elif args.memory:
+        render_memory(snap)
     elif args.fleet:
         render_fleet(snap)
     else:
