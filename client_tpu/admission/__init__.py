@@ -44,7 +44,8 @@ from __future__ import annotations
 
 import json
 import os
-import threading
+from client_tpu.utils import lockdep
+from client_tpu import config as envcfg
 import time
 from dataclasses import dataclass, field
 
@@ -106,7 +107,7 @@ class TokenBucket:
         self._clock = clock
         self._tokens = self.burst
         self._stamp = clock()
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("admission.bucket")
 
     def _refill_locked(self) -> None:
         now = self._clock()
@@ -175,7 +176,7 @@ class AdmissionConfig:
 
     @classmethod
     def from_env(cls, environ=os.environ) -> "AdmissionConfig":
-        raw = (environ.get(ENV_VAR) or "").strip()
+        raw = envcfg.env_text(ENV_VAR, environ)
         if not raw:
             return cls()
         if raw.startswith("@"):
@@ -222,7 +223,7 @@ class AdmissionController:
         self.config = config or AdmissionConfig()
         self._metrics = metrics  # EngineMetrics | None
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("admission.controller")
         self._gates: dict[str, _ModelGate] = {}
         self._last_shed = 0.0
         # True between the first shed and the hold-window expiry observed

@@ -112,6 +112,7 @@ def drain(engine, http_servers=(), grpc_servers=(),
     for srv in http_servers:
         try:
             srv.httpd.server_close()
+        # tpulint: allow[swallowed-exception] reviewed fail-open
         except Exception:  # noqa: BLE001
             pass
     drain_s = time.monotonic() - start

@@ -22,7 +22,7 @@ Contract with the C side:
 from __future__ import annotations
 
 import json
-import os
+from client_tpu import config as envcfg
 
 import numpy as np
 
@@ -40,12 +40,13 @@ def create_engine(models_csv: str = "") -> TpuEngine:
     # CLIENT_TPU_PLATFORM=cpu lets the embedded engine run hermetically
     # (tests, machines without a TPU). The image's sitecustomize pins the
     # platform before env vars are seen, so this must go through jax.config.
-    platform = os.environ.get("CLIENT_TPU_PLATFORM")
+    platform = envcfg.env_str("CLIENT_TPU_PLATFORM")
     if platform:
         import jax
 
         try:
             jax.config.update("jax_platforms", platform)
+        # tpulint: allow[swallowed-exception] backend already initialized
         except Exception:  # noqa: BLE001 — backend already initialized
             pass
     names = [n.strip() for n in models_csv.split(",") if n.strip()] or None
@@ -53,7 +54,7 @@ def create_engine(models_csv: str = "") -> TpuEngine:
     # XLA compile ever lands inside a perf-harness measurement window
     # (pair with tpu_perf_analyzer --warmup-request-count for the
     # request-path caches).
-    warmup = os.environ.get("CLIENT_TPU_WARMUP", "") not in ("", "0")
+    warmup = envcfg.env_flag("CLIENT_TPU_WARMUP")
     return TpuEngine(build_repository(names), warmup=warmup)
 
 

@@ -29,7 +29,7 @@ tuner never promotes past the budget.
 
 from __future__ import annotations
 
-import threading
+from client_tpu.utils import lockdep
 from dataclasses import dataclass
 
 from client_tpu.engine.types import EngineError
@@ -71,7 +71,7 @@ class ArenaAllocator:
                 f"got {budget_bytes}", 500)
         self.budget = int(budget_bytes)
         self.label = label
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("engine.arena")
         self._res: dict[str, Reservation] = {}
 
     # -- core ops -------------------------------------------------------------

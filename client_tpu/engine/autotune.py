@@ -38,8 +38,9 @@ byte-identical engine.
 from __future__ import annotations
 
 import json
-import os
+from client_tpu import config as envcfg
 import threading
+from client_tpu.utils import lockdep
 import time
 from collections import deque
 from dataclasses import dataclass, fields
@@ -120,7 +121,7 @@ class AutotuneConfig:
         """None when unset/disabled (the engine then builds no tuner at
         all); ``"1"``/``"true"``/``"on"`` → defaults; otherwise inline
         JSON or ``@/path/to/file.json``."""
-        raw = os.environ.get(env_var, "").strip()
+        raw = envcfg.env_text(env_var)
         if not raw or raw.lower() in ("0", "false", "off"):
             return None
         if raw.lower() in ("1", "true", "on"):
@@ -161,7 +162,7 @@ class Autotuner:
         from client_tpu.observability.memory import hbm_census
 
         hbm_census().register_arena(self.arena)
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("engine.autotune")
         # (model, version, action, bucket) -> monotonic deadline before
         # which the same decision is not retried (hysteresis spacing).
         self._cooldown: dict[tuple, float] = {}
@@ -369,6 +370,7 @@ class Autotuner:
                 applied: bool, **detail) -> dict:
         d = {"action": action, "model": name, "version": str(version),
              "bucket": bucket, "applied": applied,
+             # tpulint: allow[wall-clock] journal entries carry a wall `ts` stamp for operators
              "ts": round(time.time(), 3), **detail}
         with self._lock:
             self._decisions.append(d)

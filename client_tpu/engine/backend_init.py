@@ -19,7 +19,9 @@ from __future__ import annotations
 
 import logging
 import os
+from client_tpu import config as envcfg
 import threading
+from client_tpu.utils import lockdep
 import time
 
 log = logging.getLogger("client_tpu.engine")
@@ -27,9 +29,9 @@ if not log.handlers:  # default to visible stderr progress; apps may override
     _h = logging.StreamHandler()
     _h.setFormatter(logging.Formatter("[client_tpu] %(asctime)s %(message)s"))
     log.addHandler(_h)
-    log.setLevel(os.environ.get("CLIENT_TPU_LOGLEVEL", "INFO"))
+    log.setLevel(envcfg.env_str("CLIENT_TPU_LOGLEVEL"))
 
-_lock = threading.Lock()
+_lock = lockdep.Lock("engine.backend_init")
 _devices: list | None = None
 _init_seconds: float | None = None
 

@@ -12,8 +12,9 @@ C-API mode, SURVEY.md §3.5).
 
 from __future__ import annotations
 
-import os
+from client_tpu import config as envcfg
 import threading
+from client_tpu.utils import lockdep
 from typing import Callable
 
 import client_tpu
@@ -58,7 +59,7 @@ class TpuEngine:
         self.repository = repository or ModelRepository(jit=jit)
         self._schedulers: dict[str, Scheduler] = {}
         self._stats: dict[str, ModelStats] = {}
-        self._lock = threading.RLock()
+        self._lock = lockdep.RLock("engine.engine")
         self._warmup = warmup
         self._live = True
         self._draining = False
@@ -139,7 +140,7 @@ class TpuEngine:
         if self.admission._metrics is None:
             self.admission._metrics = self.metrics
         self.request_traces = TraceStore(
-            capacity=int(os.environ.get("CLIENT_TPU_TRACE_BUFFER", "512")))
+            capacity=envcfg.env_int("CLIENT_TPU_TRACE_BUFFER"))
         # Opt-in bucket autotuner + HBM planning arena (CLIENT_TPU_AUTOTUNE;
         # see client_tpu.engine.autotune). With the env unset this stays
         # None and the engine is byte-identical to an untuned one: no
@@ -160,8 +161,13 @@ class TpuEngine:
             for name in self.repository.names():
                 try:
                     self.load_model(name)
-                except Exception:
-                    pass  # surfaced via repository index state
+                except Exception as exc:  # noqa: BLE001 — load the rest
+                    # Also visible in the repository index state, but a
+                    # model silently absent at startup is the kind of
+                    # failure operators grep the journal for.
+                    self.events.emit(
+                        "lifecycle", "model_load_failed",
+                        severity="ERROR", model=name, error=str(exc))
         if self.autotuner is not None:
             self.autotuner.start()
 
@@ -840,6 +846,7 @@ class TpuEngine:
                 try:
                     extra_plans[(sched.model.config.name, component)] = \
                         int(hbm())
+                # tpulint: allow[swallowed-exception] backend mid-unload
                 except Exception:  # noqa: BLE001 — backend mid-unload
                     pass
         return self.hbm_census.report(extra_plans=extra_plans,

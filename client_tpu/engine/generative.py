@@ -44,6 +44,7 @@ import collections
 import logging
 import math
 import os
+from client_tpu import config as envcfg
 import queue as _queue
 import threading
 import time
@@ -235,8 +236,7 @@ class GenerativeScheduler(Scheduler):
         # stop/retire mid-chunk have their surplus lanes discarded exactly
         # like any retired lane.  Admits join at chunk boundaries (<= K-1
         # waves of extra TTFT, ~K*step_ms).
-        self._chunk = max(1, int(os.environ.get("CLIENT_TPU_GEN_CHUNK",
-                                                "1")))
+        self._chunk = max(1, envcfg.env_int("CLIENT_TPU_GEN_CHUNK"))
         self._decode_chunk = None
         if self._chunk > 1:
             self._decode_chunk = jax.jit(
@@ -254,8 +254,7 @@ class GenerativeScheduler(Scheduler):
         # the oldest fetch. Sized to hide the host↔device round trip
         # (tunnel ~70 ms vs ~2 ms device step); each entry holds only a
         # bucket-sized token vector.
-        self._depth = max(1, int(os.environ.get(
-            "CLIENT_TPU_GEN_PIPELINE", "32")))
+        self._depth = max(1, envcfg.env_int("CLIENT_TPU_GEN_PIPELINE"))
         self._streams: list[_Stream] = []
         self._inflight: collections.deque[_Inflight] = collections.deque()
         # Depth accounting is in WAVES, not dispatches: a K-chunk counts K,

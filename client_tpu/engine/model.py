@@ -20,6 +20,7 @@ Backends implement the small :class:`ModelBackend` protocol; the model zoo in
 from __future__ import annotations
 
 import threading
+from client_tpu.utils import lockdep
 from dataclasses import dataclass
 from typing import Any, Callable, Iterator
 
@@ -142,7 +143,7 @@ class Model:
                      tuple(t.dims))
             for t in self.config.input
         }
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("engine.model")
         self._apply = None
         self._jitted = False
         self._params = None

@@ -19,6 +19,7 @@ import json
 import logging
 import os
 import threading
+from client_tpu.utils import lockdep
 from typing import Callable
 
 from client_tpu.engine.model import Model, ModelBackend
@@ -83,7 +84,7 @@ class ModelRepository:
         # concurrent loads of the same name would both build the new
         # versions and race the _loaded write.
         self._load_locks: dict[str, threading.Lock] = {}
-        self._lock = threading.RLock()
+        self._lock = lockdep.RLock("engine.repository")
         self._jit = jit
 
     def register(self, name: str, builder: Callable[[], ModelBackend],
@@ -124,7 +125,7 @@ class ModelRepository:
         load are materialized, and already-loaded versions are kept as-is
         (no rebuild, no recompile)."""
         with self._lock:
-            load_lock = self._load_locks.setdefault(name, threading.Lock())
+            load_lock = self._load_locks.setdefault(name, lockdep.Lock("engine.repository.load"))
         with load_lock:
             return self._load_serialized(name)
 

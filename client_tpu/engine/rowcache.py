@@ -29,7 +29,7 @@ Metrics (bound per engine registry, see OBSERVABILITY.md):
 
 from __future__ import annotations
 
-import threading
+from client_tpu.utils import lockdep
 from collections import OrderedDict
 
 import numpy as np
@@ -52,7 +52,7 @@ class RowCache:
         self.capacity_rows = (max(1, int(budget_bytes) // self.row_bytes)
                               if budget_bytes > 0 else 0)
         self.budget_bytes = int(budget_bytes)
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("engine.rowcache")
         # row id -> vector copy; OrderedDict recency order (LRU at head).
         self._rows: OrderedDict[int, np.ndarray] = OrderedDict()
         # Cumulative counters (monotonic — the bound Prometheus counters

@@ -13,6 +13,7 @@ import heapq
 import logging
 import queue
 import threading
+from client_tpu.utils import lockdep
 import time
 from typing import Callable
 
@@ -88,7 +89,7 @@ class _ReqQueue:
 
     def __init__(self):
         self._h: list = []  # (level, seq, item)
-        self._cv = threading.Condition()
+        self._cv = lockdep.Condition("scheduler.queue")
         self._seq = 0        # arrival order within a level
         self._front_seq = 0  # decreasing: pushback lands ahead of arrivals
         self._level_counts: dict[int, int] = {}
@@ -181,7 +182,7 @@ class Scheduler:
             raise EngineError(
                 f"model '{model.config.name}': preserve_ordering cannot be "
                 "combined with priority_levels", 400)
-        self._order_lock = threading.Lock()
+        self._order_lock = lockdep.Lock("scheduler.order")
         self._arrival_seq = 0        # assigned at submit
         self._release_seq = 0        # next sequence allowed to respond
         self._held: dict[int, tuple] = {}  # seq -> (req, resp)

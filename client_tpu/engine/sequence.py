@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
+from client_tpu.utils import lockdep
+from client_tpu import config as envcfg
 
 import numpy as np
 
@@ -48,7 +50,7 @@ class _SequenceSlot:
 
     def __init__(self, state):
         self.state = state
-        self.lock = threading.Lock()
+        self.lock = lockdep.Lock("sequence.slot")
         self.last_used_ns = now_ns()
         # Executions holding this slot right now. last_used_ns is only
         # written AFTER a step completes, so idle-GC judging by timestamp
@@ -94,7 +96,7 @@ class SequenceScheduler(_PendingGuard, Scheduler):
 
     def __init__(self, model, stats):
         self._slots: dict[int, _SequenceSlot] = {}
-        self._slots_lock = threading.Lock()
+        self._slots_lock = lockdep.Lock("sequence.slots")
         self._pending: dict[int, int] = {}
         super().__init__(model, stats)
 
@@ -181,7 +183,11 @@ class SequenceScheduler(_PendingGuard, Scheduler):
         start = now_ns()
         req.times.compute_start = start
         try:
-            with slot.lock:  # in-order, one in-flight request per sequence
+            # In-order, one in-flight request per sequence: the device
+            # step IS this lock's critical section (the reference's
+            # 1-context-per-sequence rule), so blocking under it is the
+            # design, not a bug.
+            with slot.lock, lockdep.allow_blocking():
                 new_state, outputs = self.model.execute_stateful(
                     slot.state, req.inputs)
                 slot.state = new_state
@@ -265,7 +271,7 @@ class OldestSequenceScheduler(_PendingGuard, Scheduler):
         # (`protect` only covers the wave being assembled, not
         # continuations queued behind it) — see _PendingGuard.
         self._pending: dict[int, int] = {}
-        self._arena_lock = threading.Lock()
+        self._arena_lock = lockdep.Lock("sequence.arena")
         self._compiled_buckets: set[int] = set()
         # Pipelined waves (round 4, mirroring the generative scheduler):
         # a wave is DISPATCHED without waiting for its outputs; responses
@@ -274,7 +280,6 @@ class OldestSequenceScheduler(_PendingGuard, Scheduler):
         # wave k's responses, so consecutive waves carry disjoint
         # sequences and the donated-arena chain keeps device-side order.
         import collections
-        import os as _os
 
         # Depth 2 = double buffering: one wave executing/fetching while
         # the next assembles. Deeper pipelines fragment the waves (the
@@ -282,8 +287,7 @@ class OldestSequenceScheduler(_PendingGuard, Scheduler):
         # queue fill during the fetch) — measured 354 steps/s at depth 4
         # with avg wave 36 vs ~1500 at depth 2 with avg wave ~100.
         self._inflight_waves: "collections.deque" = collections.deque()
-        self._depth = max(1, int(_os.environ.get(
-            "CLIENT_TPU_SEQ_PIPELINE", "2")))
+        self._depth = max(1, envcfg.env_int("CLIENT_TPU_SEQ_PIPELINE"))
         super().__init__(model, stats)
 
     # -- slot management -----------------------------------------------------
@@ -484,6 +488,7 @@ class OldestSequenceScheduler(_PendingGuard, Scheduler):
             # survived, then rebuild the arena.
             try:
                 self._drain_waves(flush=True)
+            # tpulint: allow[swallowed-exception] flush is best-effort here
             except Exception:  # noqa: BLE001 — flush is best-effort here
                 pass
             self._reset_arena_state()

@@ -36,7 +36,7 @@ from __future__ import annotations
 import json
 import mmap
 import os
-import threading
+from client_tpu.utils import lockdep
 
 import numpy as np
 
@@ -147,7 +147,7 @@ def shm_path(key: str) -> str:
 class SystemShmManager:
     def __init__(self):
         self._regions: dict[str, _SysRegion] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("shm.system")
 
     def register(self, name, key, offset, byte_size) -> None:
         with self._lock:
@@ -300,7 +300,7 @@ class _TpuRegion:
 class TpuShmManager:
     def __init__(self, devices=None):
         self._regions: dict[str, _TpuRegion] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("shm.device")
         self._devices = devices
 
     def _device(self, device_id: int):

@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import json
 import os
-import threading
+from client_tpu.utils import lockdep
 
 import numpy as np
 
@@ -111,7 +111,7 @@ class _Ring:
         # Serializes completion writes against detach; slot payloads are
         # disjoint, so concurrent completions need no ordering among
         # themselves.
-        self.lock = threading.Lock()
+        self.lock = lockdep.Lock("shmring.ring")
         self.closed = False
         self.doorbells = 0
         self.slots_ok = 0
@@ -226,7 +226,7 @@ class RingShmManager:
 
     def __init__(self, registry=None, events=None):
         self._rings: dict[str, _Ring] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("shmring.manager")
         self._events = events
         self._m_doorbells = self._m_slots = None
         self._m_occupancy = self._m_span = None

@@ -12,11 +12,11 @@ series — cumulative sums here, distributions there, from one call site.
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass, field
 
 from client_tpu.engine.types import RequestTimes
+from client_tpu.utils import lockdep
 
 
 @dataclass
@@ -65,7 +65,8 @@ class ModelStats:
     slo: object | None = field(default=None, repr=False)
     # Optional event journal (events.EventJournal) for deadline.expired.
     events: object | None = field(default=None, repr=False)
-    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _lock: object = field(
+        default_factory=lambda: lockdep.Lock("engine.stats"), repr=False)
 
     def record_request(self, times: RequestTimes, success: bool,
                        total_ns: int | None = None,
@@ -80,6 +81,7 @@ class ModelStats:
                 self.compute_infer.add(times.compute_infer_ns)
                 self.compute_output.add(times.compute_output_ns)
                 self.inference_count += 1
+                # tpulint: allow[wall-clock] v2 stats `last_inference` is a wall-epoch ms stamp
                 self.last_inference_ms = int(time.time() * 1000)
             else:
                 self.fail.add(max(0, total))

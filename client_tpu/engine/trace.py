@@ -17,7 +17,7 @@ alias on update).
 
 from __future__ import annotations
 
-import threading
+from client_tpu.utils import lockdep
 
 from client_tpu.engine.types import EngineError
 
@@ -26,7 +26,7 @@ class TraceManager:
     """Engine-wide device trace control (jax.profiler start/stop)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("engine.trace")
         self._log_dir = ""
         self._active = False
 
@@ -59,6 +59,7 @@ class TraceManager:
 
                 try:
                     jax.profiler.stop_trace()
+                # tpulint: allow[swallowed-exception] already stopped
                 except Exception:  # noqa: BLE001 — already stopped
                     pass
                 self._active = False
@@ -83,6 +84,7 @@ class TraceManager:
                     # start can succeed.
                     try:
                         jax.profiler.stop_trace()
+                    # tpulint: allow[swallowed-exception] reviewed fail-open
                     except Exception:  # noqa: BLE001
                         pass
                     raise EngineError(
@@ -97,6 +99,7 @@ class TraceManager:
 
                 try:
                     jax.profiler.stop_trace()
+                # tpulint: allow[swallowed-exception] best-effort on teardown
                 except Exception:  # noqa: BLE001 — best-effort on teardown
                     pass
                 self._active = False

@@ -33,8 +33,9 @@ from __future__ import annotations
 
 import json
 import os
+from client_tpu import config as envcfg
 import random
-import threading
+from client_tpu.utils import lockdep
 import time
 import weakref
 
@@ -110,7 +111,7 @@ class _ActiveFault:
         self.spec = spec
         self.rng = random.Random(spec.seed)
         self.remaining = spec.max_injections
-        self.lock = threading.Lock()
+        self.lock = lockdep.Lock("faults.active")
 
     def draw(self) -> bool:
         with self.lock:
@@ -127,7 +128,7 @@ class FaultRegistry:
     """Named injection sites + deterministic draws + injection counters."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("faults.registry")
         self._active: dict[str, _ActiveFault] = {}
         self._counts: dict[tuple[str, str], int] = {}
         # id(MetricRegistry) -> weakref to its bound counter. Keyed by
@@ -154,7 +155,7 @@ class FaultRegistry:
             self._active = {}
 
     def configure_from_env(self, environ=os.environ) -> None:
-        raw = (environ.get(ENV_VAR) or "").strip()
+        raw = envcfg.env_text(ENV_VAR, environ)
         if not raw:
             return
         if raw.startswith("@"):
@@ -229,7 +230,7 @@ class FaultRegistry:
 # first access.
 
 _default: FaultRegistry | None = None
-_default_lock = threading.Lock()
+_default_lock = lockdep.Lock("faults.default")
 
 
 def registry() -> FaultRegistry:

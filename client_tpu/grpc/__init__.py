@@ -17,6 +17,7 @@ import itertools
 import json
 import queue
 import threading
+from client_tpu.utils import lockdep
 import time
 
 import grpc
@@ -40,7 +41,7 @@ service_pb2 = pb  # re-export, as the reference re-exports its generated pb2
 _log = logging.getLogger("client_tpu")
 
 _channel_cache: dict[tuple, tuple[grpc.Channel, GRPCInferenceServiceStub]] = {}
-_channel_cache_lock = threading.Lock()
+_channel_cache_lock = lockdep.Lock("grpcclient.channel_cache")
 
 
 class KeepAliveOptions:
@@ -71,6 +72,7 @@ def _grpc_error(exc: grpc.RpcError) -> InferenceServerException:
         retry_after_s = parse_pushback_metadata(exc.trailing_metadata())
         if retry_after_s is not None:
             err.retry_after_s = retry_after_s
+    # tpulint: allow[swallowed-exception] pushback is best-effort
     except Exception:  # noqa: BLE001 — pushback is best-effort
         pass
     return err
@@ -293,12 +295,14 @@ class _InferStream:
                     else:
                         self._callback(
                             InferResult(response.infer_response), None)
+                # tpulint: allow[swallowed-exception] user callback fault
                 except Exception:  # noqa: BLE001 — user callback fault
                     pass
         except grpc.RpcError as exc:
             if not self._closed:
                 try:
                     self._callback(None, _grpc_error(exc))
+                # tpulint: allow[swallowed-exception] reviewed fail-open
                 except Exception:  # noqa: BLE001
                     pass
 
@@ -391,7 +395,7 @@ class InferenceServerClient:
         self._retry_policy = retry_policy
         self._breaker = circuit_breaker
         self._async_executor = None
-        self._async_executor_lock = threading.Lock()
+        self._async_executor_lock = lockdep.Lock("grpcclient.async_executor")
 
     @property
     def _client_stub(self):

@@ -16,7 +16,7 @@ import base64
 import json
 import queue
 import random
-import threading
+from client_tpu.utils import lockdep
 import time
 import zlib
 from concurrent.futures import ThreadPoolExecutor
@@ -295,7 +295,7 @@ class _Target:
                                     timeout)
         self.load = None
         self.outstanding = 0
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("httpclient.endpoint")
 
     def observe(self, resp) -> None:
         """Learn the endpoint's load from a response's X-Tpu-Load
@@ -330,7 +330,7 @@ class _ConnectionPool:
     def __init__(self, host, port, size, timeout):
         self._host, self._port, self._timeout = host, port, timeout
         self._pool: queue.LifoQueue = queue.LifoQueue()
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("httpclient.pool")
         self._created = 0
         self._size = size
 

@@ -23,7 +23,7 @@ bit-identical token streams to solo decode.
 from __future__ import annotations
 
 import math
-import os
+from client_tpu import config as envcfg
 
 import numpy as np
 
@@ -110,7 +110,7 @@ class TinyGptBackend(ModelBackend):
         # sequence, so streams are token-identical either way. The env
         # flips the fleet without touching model registration.
         if attn_impl is None:
-            attn_impl = os.environ.get("CLIENT_TPU_ATTN_IMPL", "reference")
+            attn_impl = envcfg.env_str("CLIENT_TPU_ATTN_IMPL")
         if attn_impl not in ("reference", "fused"):
             raise ValueError(
                 f"attn_impl must be 'reference' or 'fused', got "

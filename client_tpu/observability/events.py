@@ -41,8 +41,9 @@ from __future__ import annotations
 import json
 import logging
 import os
+from client_tpu import config as envcfg
 import sys
-import threading
+from client_tpu.utils import lockdep
 import time
 from collections import deque
 
@@ -118,15 +119,14 @@ class EventJournal:
                  mono_ns=time.monotonic_ns):
         if capacity is None:
             try:
-                capacity = int(os.environ.get(ENV_BUFFER,
-                                              str(DEFAULT_CAPACITY)))
+                capacity = envcfg.env_int(ENV_BUFFER)
             except ValueError:
                 capacity = DEFAULT_CAPACITY
         self.capacity = max(1, int(capacity))
         self._clock = clock
         self._mono_ns = mono_ns
         self._events: deque[Event] = deque(maxlen=self.capacity)
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("observability.events")
         self._seq = 0
         self._dropped = 0
         self._sinks: list = []
@@ -152,6 +152,7 @@ class EventJournal:
         for sink in sinks:
             try:
                 sink(evt)
+            # tpulint: allow[swallowed-exception] a broken sink must not take down the serving path
             except Exception:  # noqa: BLE001 — a broken sink must not
                 pass           # take down the serving path
         return evt
@@ -237,7 +238,7 @@ class EventJournal:
 # -- process-global default journal ------------------------------------------
 
 _default: EventJournal | None = None
-_default_lock = threading.Lock()
+_default_lock = lockdep.Lock("observability.events.default")
 
 
 def journal() -> EventJournal:
@@ -303,7 +304,7 @@ def configure_logging(environ=os.environ, stream=None,
     ``client_tpu`` logger (replacing logging's default plain-text
     propagation for it) and mirror every journal event to the same
     stream. Returns True when the sink was installed. Idempotent."""
-    mode = (environ.get(ENV_LOG) or "").strip().lower()
+    mode = envcfg.env_text(ENV_LOG, environ).lower()
     if mode != "json":
         return False
     out = stream or sys.stderr

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import json
 import os
+from client_tpu import config as envcfg
 import re
 from dataclasses import dataclass, fields
 
@@ -481,7 +482,7 @@ class FleetMonitorConfig:
     @classmethod
     def from_env(cls, env_var: str = ENV_VAR,
                  environ=os.environ) -> "FleetMonitorConfig | None":
-        raw = (environ.get(env_var) or "").strip()
+        raw = envcfg.env_text(env_var, environ)
         if not raw or raw.lower() in ("0", "false", "off"):
             return None
         if raw.lower() in ("1", "true", "on"):

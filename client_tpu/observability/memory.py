@@ -34,7 +34,8 @@ from __future__ import annotations
 
 import json
 import os
-import threading
+from client_tpu import config as envcfg
+from client_tpu.utils import lockdep
 import weakref
 from dataclasses import dataclass, fields
 
@@ -96,7 +97,7 @@ class MemoryConfig:
 
     @classmethod
     def from_env(cls, environ=os.environ) -> "MemoryConfig":
-        raw = (environ.get(ENV_VAR) or "").strip()
+        raw = envcfg.env_text(ENV_VAR, environ)
         if raw.lower() in ("0", "false", "off"):
             return cls(pressure_events=False)
         if not raw or raw.lower() in ("1", "true", "on"):
@@ -132,6 +133,7 @@ def _buffer_nbytes(buf) -> int:
         for dim in shard_shape:
             per_shard *= int(dim)
         return per_shard * n_dev
+    # tpulint: allow[swallowed-exception] non-jax leaves, odd shardings
     except Exception:  # noqa: BLE001 — non-jax leaves, odd shardings
         pass
     try:
@@ -147,7 +149,7 @@ class HbmCensus:
 
     def __init__(self, config: MemoryConfig | None = None):
         self.config = config or MemoryConfig()
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("observability.memory")
         # id(buffer) -> (weakref, model, component). Keyed by id because
         # jax.Arrays are unhashable; the weakref both detects death and
         # guards against id reuse (a dead ref's entry is pruned before a
@@ -299,6 +301,7 @@ class HbmCensus:
                 continue
             try:
                 nbytes, buffers = fn(obj)
+            # tpulint: allow[swallowed-exception] owner mid-teardown
             except Exception:  # noqa: BLE001 — owner mid-teardown
                 continue
             row = owners.setdefault((model, component),
@@ -318,6 +321,7 @@ class HbmCensus:
                 continue
             try:
                 snap = arena.snapshot()
+            # tpulint: allow[swallowed-exception] arena mid-teardown
             except Exception:  # noqa: BLE001 — arena mid-teardown
                 continue
             for res in snap.get("reservations", ()):
@@ -350,6 +354,7 @@ class HbmCensus:
             for arr in jax.live_arrays():
                 live_bytes += _buffer_nbytes(arr)
                 live_count += 1
+        # tpulint: allow[swallowed-exception] no backend
         except Exception:  # noqa: BLE001 — no backend
             pass
         # On platforms without memory stats (CPU) the live-array total is
@@ -440,7 +445,7 @@ class HbmCensus:
 # -- process-global census -----------------------------------------------------
 
 _default: HbmCensus | None = None
-_default_lock = threading.Lock()
+_default_lock = lockdep.Lock("observability.memory.default")
 
 
 def hbm_census() -> HbmCensus:

@@ -25,7 +25,7 @@ Design notes:
 
 from __future__ import annotations
 
-import threading
+from client_tpu.utils import lockdep
 from bisect import bisect_left
 
 # Microsecond latency ladder: sub-ms queue hops through multi-second
@@ -71,7 +71,7 @@ class _Metric:
         self.help = help_text
         self.labelnames = tuple(labelnames)
         self._children: dict[tuple, object] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("metrics.family")
 
     def labels(self, *values, **kw):
         if kw:
@@ -124,7 +124,7 @@ class _Value:
 
     def __init__(self):
         self.v = 0.0
-        self.lock = threading.Lock()
+        self.lock = lockdep.Lock("metrics.value")
 
 
 class Counter(_Metric):
@@ -191,7 +191,7 @@ class _HistValue:
         self.exemplars: list[tuple[float, str] | None] = \
             [None] * (n_buckets + 1)
         self.sum = 0.0
-        self.lock = threading.Lock()
+        self.lock = lockdep.Lock("metrics.value")
 
 
 class Histogram(_Metric):
@@ -257,7 +257,7 @@ class MetricRegistry:
 
     def __init__(self):
         self._metrics: dict[str, _Metric] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("metrics.registry")
 
     def _get_or_create(self, cls, name, help_text, labelnames, **kw):
         labelnames = tuple(labelnames or ())
@@ -427,7 +427,7 @@ class EngineMetrics:
             "Wall time of the last graceful drain (0 until one runs)")
         self.drain_duration.set(0.0)
         self._instruments: dict[tuple[str, str], ModelInstruments] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("metrics.instruments")
 
     def model_instruments(self, model: str, version: str) -> ModelInstruments:
         key = (str(model), str(version))

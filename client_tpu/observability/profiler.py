@@ -40,7 +40,8 @@ pretty-prints it.
 from __future__ import annotations
 
 import os
-import threading
+from client_tpu import config as envcfg
+from client_tpu.utils import lockdep
 import time
 import weakref
 from collections import deque
@@ -204,12 +205,11 @@ class EfficiencyProfiler:
 
     def __init__(self, window_s: float | None = None, now=time.monotonic_ns):
         if window_s is None:
-            window_s = float(os.environ.get(
-                "CLIENT_TPU_PROFILE_WINDOW_S", "60"))
+            window_s = envcfg.env_float("CLIENT_TPU_PROFILE_WINDOW_S")
         self.window_s = max(1.0, window_s)
         self._now = now
         self._t0 = now()
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("observability.profiler")
         self._costs: dict[tuple[str, str, int], _BucketCost] = {}
         # (model, version, wave bucket, chunk) -> _WaveCost.
         self._waves: dict[tuple[str, str, int, int], _WaveCost] = {}
@@ -565,7 +565,7 @@ def _suggest_ladder_tweaks(buckets: list[dict],
 # -- process-global default profiler ------------------------------------------
 
 _default: EfficiencyProfiler | None = None
-_default_lock = threading.Lock()
+_default_lock = lockdep.Lock("observability.profiler.default")
 
 
 def profiler() -> EfficiencyProfiler:

@@ -38,7 +38,8 @@ from __future__ import annotations
 
 import json
 import os
-import threading
+from client_tpu import config as envcfg
+from client_tpu.utils import lockdep
 import time
 from dataclasses import dataclass, field
 
@@ -105,7 +106,7 @@ class SloConfig:
 
     @classmethod
     def from_env(cls, environ=os.environ) -> "SloConfig":
-        raw = (environ.get(ENV_VAR) or "").strip()
+        raw = envcfg.env_text(ENV_VAR, environ)
         if not raw:
             return cls(enabled=False)
         if raw.startswith("@"):
@@ -168,7 +169,7 @@ class _ModelSlo:
     def __init__(self, cfg: SloConfig):
         self.cfg = cfg
         self.ring = _SecondRing()
-        self.lock = threading.Lock()
+        self.lock = lockdep.Lock("observability.slo.model")
 
 
 def _burn(bad: int, total: int, target: float) -> float:
@@ -190,7 +191,7 @@ class SloTracker:
                  clock=time.monotonic):
         self.config = config or SloConfig(enabled=False)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("observability.slo")
         self._models: dict[str, _ModelSlo] = {}
         self._burn_gauge = None
         self._fast_gauge = None

@@ -36,8 +36,11 @@ federated by the router as ``/v2/fleet/timeseries``.
 from __future__ import annotations
 
 import json
+import logging
 import os
+from client_tpu import config as envcfg
 import threading
+from client_tpu.utils import lockdep
 import time
 import weakref
 from collections import deque
@@ -101,7 +104,7 @@ class TimeseriesConfig:
 
     @classmethod
     def from_env(cls, environ=os.environ) -> "TimeseriesConfig":
-        raw = (environ.get(ENV_VAR) or "").strip()
+        raw = envcfg.env_text(ENV_VAR, environ)
         if raw.lower() in ("0", "false", "off"):
             return cls(enabled=False)
         if not raw or raw.lower() in ("1", "true", "on"):
@@ -134,12 +137,13 @@ class FlightRecorder:
         self._ring: deque = deque(maxlen=self.config.capacity)
         self._seq = 0
         self._dropped = 0
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("timeseries.recorder")
         # id(provider) -> weakref; id keys survive unhashable providers
         # and give O(1) detach. Dead refs are pruned every tick.
         self._providers: dict[int, weakref.ref] = {}
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
+        self._tick_failures = 0
 
     # -- providers ------------------------------------------------------------
 
@@ -201,7 +205,12 @@ class FlightRecorder:
             try:
                 self.tick()
             except Exception:  # noqa: BLE001 — the recorder must not die
-                pass
+                self._tick_failures += 1
+                if self._tick_failures == 1:
+                    logging.getLogger(
+                        "client_tpu.timeseries").exception(
+                        "flight-recorder tick failed (logged once; "
+                        "further failures only counted)")
 
     # -- sampling -------------------------------------------------------------
 
@@ -215,6 +224,7 @@ class FlightRecorder:
         for provider in self.providers():
             try:
                 contributed = provider.timeseries_sample()
+            # tpulint: allow[swallowed-exception] one sick provider must not stop the others from recording
             except Exception:  # noqa: BLE001 — one sick provider must
                 continue       # not stop the others from recording
             if not contributed:
@@ -293,7 +303,7 @@ class FlightRecorder:
 # -- process-global recorder ---------------------------------------------------
 
 _default: FlightRecorder | None = None
-_default_lock = threading.Lock()
+_default_lock = lockdep.Lock("timeseries.default")
 
 
 def recorder() -> FlightRecorder:

@@ -19,7 +19,7 @@ from __future__ import annotations
 import json
 import re
 import secrets
-import threading
+from client_tpu.utils import lockdep
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -131,6 +131,7 @@ def build_request_trace(ctx: TraceContext, model_name: str, request_id: str,
         parent_span_id=ctx.parent_span_id, model_name=model_name,
         request_id=request_id, ok=ok, spans=spans,
         chunk_ts_ns=list(chunks)[:MAX_CHUNK_EVENTS], error=error,
+        # tpulint: allow[wall-clock] exported span timestamp (wall epoch by contract)
         wall_time_ms=int(time.time() * 1000),
         compile_ns=getattr(times, "compile_ns", 0))
 
@@ -140,7 +141,7 @@ class TraceStore:
 
     def __init__(self, capacity: int = 512):
         self._buf: deque[RequestTrace] = deque(maxlen=max(1, capacity))
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("tracing.store")
 
     def add(self, trace: RequestTrace) -> None:
         with self._lock:
@@ -229,7 +230,7 @@ class SpanStore:
 
     def __init__(self, capacity: int = 512):
         self._buf: deque[SpanGroup] = deque(maxlen=max(1, capacity))
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("tracing.spanstore")
 
     def add(self, trace_id: str, spans: list[NamedSpan]) -> None:
         if not spans:
@@ -237,6 +238,7 @@ class SpanStore:
         with self._lock:
             self._buf.append(SpanGroup(
                 trace_id=trace_id, spans=list(spans),
+                # tpulint: allow[wall-clock] exported span timestamp (wall epoch by contract)
                 wall_time_ms=int(time.time() * 1000)))
 
     def __len__(self) -> int:

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import random
 import socket
-import threading
+from client_tpu.utils import lockdep
 import time
 from http.client import BadStatusLine
 
@@ -123,7 +123,7 @@ class RetryPolicy:
         self.retryable_statuses = frozenset(retryable_statuses)
         self.retryable_grpc_codes = tuple(retryable_grpc_codes)
         self._rng = random.Random(seed)
-        self._rng_lock = threading.Lock()
+        self._rng_lock = lockdep.Lock("resilience.rng")
 
     def retryable(self, exc) -> bool:
         if isinstance(exc, CONNECTION_ERRORS):
@@ -205,7 +205,7 @@ class CircuitBreaker:
         self.failure_threshold = int(failure_threshold)
         self.cooldown_s = float(cooldown_s)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("resilience.breaker")
         self._hosts: dict[str, CircuitBreaker._HostState] = {}
 
     def _host(self, host: str) -> "_HostState":

@@ -35,10 +35,11 @@ from __future__ import annotations
 import hashlib
 import json
 import logging
-import os
 import queue
 import random
 import threading
+from client_tpu.utils import lockdep
+from client_tpu import config as envcfg
 import time
 from http.client import BadStatusLine, HTTPConnection
 
@@ -151,7 +152,7 @@ class Replica:
         self.load_age_ref = 0.0  # monotonic stamp of the last report
         self.outstanding = 0
         self.quiesced = False
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("router.replica")
         self._pool: queue.LifoQueue = queue.LifoQueue()
         self._pool_size = pool_size
 
@@ -200,6 +201,7 @@ class Replica:
         if broken or self._pool.qsize() >= self._pool_size:
             try:
                 conn.close()
+            # tpulint: allow[swallowed-exception] reviewed fail-open
             except Exception:  # noqa: BLE001
                 pass
             return
@@ -292,6 +294,7 @@ class Replica:
                 self._pool.get_nowait().close()
             except queue.Empty:
                 return
+            # tpulint: allow[swallowed-exception] reviewed fail-open
             except Exception:  # noqa: BLE001
                 pass
 
@@ -324,8 +327,7 @@ class Router:
         self.request_timeout_s = request_timeout_s
         self.events = journal()
         try:
-            trace_cap = int(os.environ.get(ENV_TRACE_BUFFER,
-                                           str(DEFAULT_TRACE_BUFFER)))
+            trace_cap = envcfg.env_int(ENV_TRACE_BUFFER)
         except ValueError:
             trace_cap = DEFAULT_TRACE_BUFFER
         self.spans = SpanStore(capacity=trace_cap)
@@ -370,6 +372,7 @@ class Router:
                 continue
             try:
                 r.fetch_load()
+            # tpulint: allow[swallowed-exception] poller is best-effort
             except Exception:  # noqa: BLE001 — poller is best-effort
                 pass
         self._update_state_gauges()

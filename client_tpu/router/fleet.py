@@ -31,6 +31,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+from client_tpu.utils import lockdep
 import time
 
 from client_tpu.observability.events import journal
@@ -228,7 +229,7 @@ class FleetMonitor:
         self.events = journal()
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("router.fleet")
         self._flagged: dict[str, dict[str, float]] = {}
         self._report: dict = {"ticks": 0}
         self._ticks = 0
@@ -327,6 +328,7 @@ class FleetMonitor:
                 self.events.emit("fleet", "drift_cleared", replica=rid)
         report = {
             "ticks": ticks,
+            # tpulint: allow[wall-clock] `ts_wall` drift-event stamp; windows use monotonic
             "ts_wall": time.time(),
             "threshold": threshold,
             "signals": signals,

@@ -11,9 +11,10 @@ with correlation by request id, terminated per-request by the
 from __future__ import annotations
 
 import json
-import os
+from client_tpu import config as envcfg
 import queue
 import threading
+from client_tpu.utils import lockdep
 import time
 from concurrent import futures
 
@@ -232,9 +233,8 @@ class _Servicer(GRPCInferenceServiceServicer):
                  stream_pending_limit: int | None = None):
         self.engine = engine
         if stream_pending_limit is None:
-            stream_pending_limit = int(os.environ.get(
-                "CLIENT_TPU_STREAM_PENDING_LIMIT",
-                str(self.STREAM_PENDING_LIMIT)))
+            stream_pending_limit = envcfg.env_int(
+                "CLIENT_TPU_STREAM_PENDING_LIMIT")
         self.STREAM_PENDING_LIMIT = max(1, stream_pending_limit)
 
     @staticmethod
@@ -264,6 +264,7 @@ class _Servicer(GRPCInferenceServiceServicer):
         try:
             context.set_trailing_metadata(
                 (("x-health-state", self.engine.health_state()),))
+        # tpulint: allow[swallowed-exception] telemetry must not fail health
         except Exception:  # noqa: BLE001 — telemetry must not fail health
             pass
         return pb.ServerReadyResponse(ready=self.engine.is_ready())
@@ -587,6 +588,7 @@ class _Servicer(GRPCInferenceServiceServicer):
                 context.set_trailing_metadata((
                     (LOAD_METADATA_KEY,
                      encode_header(self.engine.load_report())),))
+            # tpulint: allow[swallowed-exception] telemetry only
             except Exception:  # noqa: BLE001 — telemetry only
                 pass
             return _response_to_proto(self.engine, req, resp)
@@ -622,7 +624,7 @@ class _Servicer(GRPCInferenceServiceServicer):
         """
         out_q: queue.Queue = queue.Queue()
         inflight = [0]
-        lock = threading.Lock()
+        lock = lockdep.Lock("grpc_server.stream")
         done_reading = threading.Event()
         live_reqs: dict = {}  # id(req) -> req (InferRequest is unhashable)
         pending_by_req: dict = {}  # id(req) -> responses enqueued, unread
@@ -798,8 +800,8 @@ class _Servicer(GRPCInferenceServiceServicer):
         # merging preserves it (the queue is FIFO per request).
         # Test knob: per-message writer delay forces a backlog so the merge
         # path is exercisable deterministically (tests/test_generative.py).
-        delay_s = float(os.environ.get(
-            "CLIENT_TPU_STREAM_WRITER_DELAY_MS", "0")) / 1e3
+        delay_s = envcfg.env_float(
+            "CLIENT_TPU_STREAM_WRITER_DELAY_MS") / 1e3
         while True:
             batch = [out_q.get()]
             while len(batch) < COALESCE_MAX:

@@ -18,7 +18,7 @@ from __future__ import annotations
 import gzip
 import json
 import logging
-import os
+from client_tpu import config as envcfg
 import re
 import threading
 import time
@@ -169,6 +169,7 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 if not self.engine.is_ready():
                     self.close_connection = True
+            # tpulint: allow[swallowed-exception] health probe must not break the response already sent
             except Exception:  # noqa: BLE001 — health probe must not
                 pass           # break the response already sent
 
@@ -227,11 +228,13 @@ class _Handler(BaseHTTPRequestHandler):
             # don't breaker it) from an overloaded or dead one.
             try:
                 headers["X-Health-State"] = self.engine.health_state()
+            # tpulint: allow[swallowed-exception] telemetry must not mask the error being reported
             except Exception:  # noqa: BLE001 — telemetry must not mask
                 pass           # the error being reported
         try:
             self._send(status, json.dumps({"error": msg}).encode("utf-8"),
                        extra_headers=headers or None)
+        # tpulint: allow[swallowed-exception] peer may have gone away
         except Exception:  # noqa: BLE001 — peer may have gone away
             pass
 
@@ -558,9 +561,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _stream_pending_limit(self) -> int:
         """Read the env knob per stream (not at import) so it matches the
         gRPC servicer's construction-time semantics."""
-        return max(1, int(os.environ.get(
-            "CLIENT_TPU_STREAM_PENDING_LIMIT",
-            str(self.STREAM_PENDING_LIMIT))))
+        return max(1, envcfg.env_int("CLIENT_TPU_STREAM_PENDING_LIMIT"))
 
     def _stream_responses(self, req: InferRequest):
         """Submit and yield responses until the final one; a stall cancels
@@ -627,8 +628,8 @@ class _Handler(BaseHTTPRequestHandler):
             except q.Empty:
                 return None
 
-        delay_s = float(os.environ.get(
-            "CLIENT_TPU_STREAM_WRITER_DELAY_MS", "0")) / 1e3
+        delay_s = envcfg.env_float(
+            "CLIENT_TPU_STREAM_WRITER_DELAY_MS") / 1e3
         while True:
             try:
                 resp = out_q.get(timeout=self.GENERATE_STALL_TIMEOUT_S)
@@ -806,6 +807,7 @@ class _Handler(BaseHTTPRequestHandler):
         # zero extra RPCs (the report itself is cached engine-side).
         try:
             headers[LOAD_HEADER] = encode_header(self.engine.load_report())
+        # tpulint: allow[swallowed-exception] telemetry must not fail a successful inference
         except Exception:  # noqa: BLE001 — telemetry must not fail a
             pass           # successful inference
         self._send(200, body, content_type=ctype, extra_headers=headers)

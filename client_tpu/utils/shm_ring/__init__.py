@@ -392,6 +392,7 @@ class RingProducer:
     def __exit__(self, *exc) -> None:
         try:
             self._client.unregister_shm_ring(self.name)
+        # tpulint: allow[swallowed-exception] reviewed fail-open
         except Exception:
             pass
         if self.ring is not None:

@@ -1,0 +1,11 @@
+"""Seeded violation: time.sleep inside a critical section."""
+
+import threading
+import time
+
+_poll_lock = threading.Lock()
+
+
+def poll_once():
+    with _poll_lock:
+        time.sleep(0.5)

@@ -1,0 +1,14 @@
+"""Seeded violation: a daemon loop nothing can ever shut down."""
+
+import threading
+
+
+def _loop():
+    while True:
+        pass
+
+
+def spawn_worker():
+    thread = threading.Thread(target=_loop, daemon=True)
+    thread.start()
+    return thread

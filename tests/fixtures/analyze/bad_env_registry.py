@@ -1,0 +1,7 @@
+"""Seeded violation: a raw environment read bypassing the registry."""
+
+import os
+
+
+def platform():
+    return os.environ.get("CLIENT_TPU_PLATFORM", "")

@@ -1,0 +1,5 @@
+"""Seeded violation: a counter without the '_total' suffix."""
+
+
+def bind(registry):
+    return registry.counter("tpu_requests", "requests served")

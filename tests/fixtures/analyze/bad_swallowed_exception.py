@@ -1,0 +1,8 @@
+"""Seeded violation: a broad except erasing the failure entirely."""
+
+
+def refresh(cache):
+    try:
+        cache.reload()
+    except Exception:
+        pass

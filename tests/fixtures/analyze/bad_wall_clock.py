@@ -1,0 +1,7 @@
+"""Seeded violation: wall clock in duration math."""
+
+import time
+
+
+def elapsed(start):
+    return time.time() - start

@@ -1,0 +1,18 @@
+"""Clean twin: the sleep moves outside the lock, and the reviewed
+exception uses the runtime escape hatch."""
+
+import threading
+import time
+
+from client_tpu.utils import lockdep
+
+_poll_lock = threading.Lock()
+
+
+def poll_once():
+    with _poll_lock:
+        pending = 1
+    time.sleep(0.5)
+    with _poll_lock, lockdep.allow_blocking():
+        time.sleep(0.5)
+    return pending

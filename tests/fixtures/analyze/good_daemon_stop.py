@@ -1,0 +1,18 @@
+"""Clean twin: the owning class carries a deliberate stop path."""
+
+import threading
+
+
+class Sampler:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(1.0):
+            pass
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2)

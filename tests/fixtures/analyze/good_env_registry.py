@@ -1,0 +1,7 @@
+"""Clean twin: the read goes through the central registry."""
+
+from client_tpu import config as envcfg
+
+
+def platform():
+    return envcfg.env_str("CLIENT_TPU_PLATFORM")

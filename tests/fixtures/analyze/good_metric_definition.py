@@ -1,0 +1,7 @@
+"""Clean twin: suffix discipline and sane labels."""
+
+
+def bind(registry):
+    registry.counter("tpu_requests_total", "requests served",
+                     ("model", "kind"))
+    return registry.gauge("tpu_queue_depth", "requests waiting")

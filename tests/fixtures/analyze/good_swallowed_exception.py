@@ -1,0 +1,14 @@
+"""Clean twin: the broad handler logs; the silent one is narrow."""
+
+import logging
+
+
+def refresh(cache):
+    try:
+        cache.reload()
+    except Exception:
+        logging.getLogger(__name__).exception("cache reload failed")
+    try:
+        cache.prune()
+    except KeyError:
+        pass

@@ -1,0 +1,12 @@
+"""Clean twin: monotonic durations; the one wall stamp is annotated."""
+
+import time
+
+
+def elapsed(start):
+    return time.monotonic() - start
+
+
+def stamp():
+    # tpulint: allow[wall-clock] exported journal timestamp
+    return time.time()

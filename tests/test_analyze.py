@@ -1,0 +1,222 @@
+"""tpulint self-tests: every check must catch its seeded fixture and
+stay quiet on the clean twin, the allow grammar must suppress, the
+baseline must match line-move-stably, and the repo itself must be clean
+against the reviewed baseline (the CI gate, as a unit test)."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tools import promlint
+from tools.analyze import checks as checks_mod
+from tools.analyze import core
+from tools.analyze import surface as surface_mod
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "fixtures", "analyze")
+REPO_ROOT = os.path.dirname(HERE)
+
+
+def _fixture_findings(check_id, fixture):
+    src = core.SourceFile(os.path.join(FIXTURES, fixture), FIXTURES)
+    return src.filter(checks_mod.CHECKS[check_id](src))
+
+
+@pytest.mark.parametrize("check_id", sorted(checks_mod.CHECKS))
+def test_bad_fixture_yields_exactly_one_finding(check_id):
+    slug = check_id.replace("-", "_")
+    found = _fixture_findings(check_id, f"bad_{slug}.py")
+    assert len(found) == 1, [f.render() for f in found]
+    assert found[0].check == check_id
+
+
+@pytest.mark.parametrize("check_id", sorted(checks_mod.CHECKS))
+def test_good_fixture_is_clean(check_id):
+    slug = check_id.replace("-", "_")
+    found = _fixture_findings(check_id, f"good_{slug}.py")
+    assert found == [], [f.render() for f in found]
+
+
+def _parse_snippet(tmp_path, text, name="snippet.py"):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(text))
+    return core.SourceFile(str(path), str(tmp_path))
+
+
+class TestAllowGrammar:
+    def test_marker_on_the_line_itself(self, tmp_path):
+        src = _parse_snippet(tmp_path, """\
+            import time
+            T = time.time()  # tpulint: allow[wall-clock] stamp
+        """)
+        assert src.filter(checks_mod.CHECKS["wall-clock"](src)) == []
+
+    def test_marker_on_the_line_above(self, tmp_path):
+        src = _parse_snippet(tmp_path, """\
+            import time
+            # tpulint: allow[wall-clock] stamp
+            T = time.time()
+        """)
+        assert src.filter(checks_mod.CHECKS["wall-clock"](src)) == []
+
+    def test_marker_two_lines_up_does_not_reach(self, tmp_path):
+        src = _parse_snippet(tmp_path, """\
+            import time
+            # tpulint: allow[wall-clock] too far away
+            x = 1
+            T = time.time()
+        """)
+        assert len(src.filter(checks_mod.CHECKS["wall-clock"](src))) == 1
+
+    def test_wrong_check_id_does_not_suppress(self, tmp_path):
+        src = _parse_snippet(tmp_path, """\
+            import time
+            T = time.time()  # tpulint: allow[daemon-stop] wrong id
+        """)
+        assert len(src.filter(checks_mod.CHECKS["wall-clock"](src))) == 1
+
+    def test_wildcard_and_comma_list(self, tmp_path):
+        src = _parse_snippet(tmp_path, """\
+            import time
+            A = time.time()  # tpulint: allow[*] blanket
+            B = time.time()  # tpulint: allow[daemon-stop, wall-clock] x
+        """)
+        assert src.filter(checks_mod.CHECKS["wall-clock"](src)) == []
+
+
+class TestBaseline:
+    def test_round_trip_and_line_move_stability(self, tmp_path):
+        f = core.Finding("wall-clock", "a.py", 10, "read at line 10")
+        path = tmp_path / "baseline.json"
+        core.write_baseline(str(path), [f], {f.key(): "reviewed stamp"})
+        baseline = core.load_baseline(str(path))
+        # Same finding, different line and different digits in the
+        # message: still baselined (digits normalize, line is excluded).
+        moved = core.Finding("wall-clock", "a.py", 99, "read at line 99")
+        new, stale = core.apply_baseline([moved], baseline)
+        assert new == [] and stale == []
+
+    def test_new_finding_and_stale_entry_split(self, tmp_path):
+        old = core.Finding("wall-clock", "a.py", 1, "gone")
+        path = tmp_path / "baseline.json"
+        core.write_baseline(str(path), [old], {old.key(): "was reviewed"})
+        fresh = core.Finding("daemon-stop", "b.py", 2, "brand new")
+        new, stale = core.apply_baseline(
+            [fresh], core.load_baseline(str(path)))
+        assert new == [fresh]
+        assert stale == [old.key()]
+
+    def test_empty_justification_rejected(self, tmp_path):
+        path = tmp_path / "baseline.json"
+        path.write_text(json.dumps([{
+            "check": "wall-clock", "path": "a.py",
+            "message": "m", "justification": "  "}]))
+        with pytest.raises(ValueError, match="justification"):
+            core.load_baseline(str(path))
+
+
+class TestSurfaceParity:
+    def _tree(self, tmp_path):
+        files = {
+            surface_mod.HTTP_SERVER: """\
+                _ROUTES = [
+                    ("GET", "/v2/health/live", "health_live"),
+                    ("GET", "/metrics", "metrics"),
+                ]
+            """,
+            surface_mod.GRPC_SERVER: """\
+                class _Servicer:
+                    def ServerLive(self, request, context):
+                        return None
+            """,
+            surface_mod.HTTP_CLIENT: """\
+                class InferenceServerClient:
+                    def is_server_live(self):
+                        return True
+
+                    def bogus_method(self):
+                        return None
+            """,
+            surface_mod.GRPC_CLIENT: """\
+                class InferenceServerClient:
+                    def is_server_live(self):
+                        return True
+            """,
+        }
+        sources = []
+        for rel, text in files.items():
+            path = tmp_path / rel
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(textwrap.dedent(text))
+            sources.append(core.SourceFile(str(path), str(tmp_path)))
+        return sources
+
+    def test_gap_and_unmapped_are_found(self, tmp_path):
+        findings = surface_mod.check_surface_parity(
+            self._tree(tmp_path), str(tmp_path))
+        messages = sorted(f.message for f in findings)
+        assert len(findings) == 2
+        assert "unmapped HTTP client method 'bogus_method'" \
+            in messages[1]
+        assert "'metrics'" in messages[0]
+        assert "missing from" in messages[0]
+
+    def test_partial_scan_is_silent(self, tmp_path):
+        sources = [s for s in self._tree(tmp_path)
+                   if s.path != surface_mod.GRPC_CLIENT]
+        assert surface_mod.check_surface_parity(
+            sources, str(tmp_path)) == []
+
+
+class TestPromlintDefinitions:
+    def test_clean_counter(self):
+        assert promlint.definition_errors(
+            "tpu_requests_total", "counter", ("model",)) == []
+
+    def test_counter_without_total(self):
+        errors = promlint.definition_errors("tpu_requests", "counter")
+        assert errors and "_total" in errors[0]
+
+    def test_counter_with_bare_unit_suffix(self):
+        errors = promlint.definition_errors("tpu_wait_seconds", "counter")
+        assert errors and "bare unit suffix" in errors[0]
+
+    def test_gauge_must_not_end_total(self):
+        errors = promlint.definition_errors("tpu_depth_total", "gauge")
+        assert errors and "reserved for counters" in errors[0]
+
+    def test_reserved_label(self):
+        errors = promlint.definition_errors(
+            "tpu_latency_seconds", "histogram", ("le",))
+        assert errors and "reserved" in errors[0]
+
+    def test_high_cardinality_label(self):
+        errors = promlint.definition_errors(
+            "tpu_requests_total", "counter", ("request_id",))
+        assert errors and "cardinality" in errors[0]
+
+    def test_label_cap(self):
+        labels = tuple(f"l{i}" for i in range(6))
+        errors = promlint.definition_errors(
+            "tpu_requests_total", "counter", labels)
+        assert errors
+
+
+def test_repo_is_clean_against_reviewed_baseline():
+    """The CI gate as a unit test: a full scan of the repo must produce
+    no findings beyond the reviewed baseline, and no baseline entry may
+    be stale."""
+    findings = core.run(REPO_ROOT)
+    baseline = core.load_baseline(
+        os.path.join(REPO_ROOT, "tools", "analyze", "baseline.json"))
+    new, stale = core.apply_baseline(findings, baseline)
+    assert new == [], [f.render() for f in new]
+    assert stale == []
+
+
+def test_fixture_dir_is_excluded_from_the_scan():
+    paths = [s.path for s in core.iter_source_files(REPO_ROOT)]
+    assert not any("fixtures" in p for p in paths)
+    assert "client_tpu/engine/engine.py" in paths

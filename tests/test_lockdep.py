@@ -1,0 +1,217 @@
+"""lockdep unit tests: inversion detection (with both stacks in the
+message), declared-rank enforcement, blocking-under-lock at runtime,
+reentrancy semantics, and the disabled fast path."""
+
+import threading
+import time
+
+import pytest
+
+from client_tpu.utils import lockdep
+
+
+@pytest.fixture
+def dep():
+    """Enable lockdep with a clean graph; restore prior state after."""
+    was_enabled = lockdep.enabled()
+    lockdep.enable()
+    lockdep.reset()
+    yield lockdep
+    lockdep.reset()
+    if not was_enabled:
+        lockdep.disable()
+
+
+def test_disabled_returns_plain_primitives():
+    was_enabled = lockdep.enabled()
+    lockdep.disable()
+    try:
+        assert isinstance(lockdep.Lock("x"), type(threading.Lock()))
+        assert isinstance(lockdep.RLock("x"), type(threading.RLock()))
+        assert isinstance(lockdep.Condition("x"), threading.Condition)
+        assert time.sleep is lockdep._real_sleep
+    finally:
+        if was_enabled:
+            lockdep.enable()
+
+
+def test_enabled_returns_instrumented(dep):
+    lk = dep.Lock("test.a")
+    assert isinstance(lk, dep._DepLock)
+    with lk:
+        assert dep.held_names() == ("test.a",)
+    assert dep.held_names() == ()
+
+
+def test_inversion_raises_with_both_stacks(dep):
+    a = dep.Lock("test.a")
+    b = dep.Lock("test.b")
+    with a:
+        with b:
+            pass
+    with pytest.raises(dep.LockOrderViolation) as excinfo:
+        with b:
+            with a:
+                pass
+    msg = str(excinfo.value)
+    assert "lock-order inversion" in msg
+    # Both sides of the cycle must be in the message: the stack that
+    # recorded the earlier a->b edge AND the acquisition closing it.
+    assert "earlier edge test.a -> test.b" in msg
+    assert "this acquisition" in msg
+    assert "test_lockdep.py" in msg
+
+
+def test_inversion_detected_across_threads(dep):
+    a = dep.Lock("test.outerthread")
+    b = dep.Lock("test.innerthread")
+
+    def worker():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    # The worker's a->b edge is global state: this thread's b->a is an
+    # inversion even though no two threads ever contended.
+    with pytest.raises(dep.LockOrderViolation):
+        with b:
+            with a:
+                pass
+
+
+def test_transitive_cycle_detected(dep):
+    a, b, c = (dep.Lock(f"test.chain{i}") for i in "abc")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with pytest.raises(dep.LockOrderViolation) as excinfo:
+        with c:
+            with a:
+                pass
+    assert "test.chaina -> test.chainb -> test.chainc" in \
+        str(excinfo.value)
+
+
+def test_consistent_order_never_raises(dep):
+    a = dep.Lock("test.first")
+    b = dep.Lock("test.second")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+
+
+def test_declared_rank_violation(dep):
+    outer = dep.Lock("test.declared_outer", order=10)
+    inner = dep.Lock("test.declared_inner", order=20)
+    with outer:
+        with inner:
+            pass  # descending through the layers is fine
+    with pytest.raises(dep.LockOrderViolation, match="declared-order"):
+        with inner:
+            with outer:
+                pass
+
+
+def test_declared_order_table_covers_core_names(dep):
+    assert dep.DECLARED_ORDER["engine.engine"] \
+        < dep.DECLARED_ORDER["scheduler.queue"] \
+        < dep.DECLARED_ORDER["metrics.registry"]
+
+
+def test_self_deadlock_on_nonreentrant_lock(dep):
+    lk = dep.Lock("test.self")
+    with lk:
+        with pytest.raises(dep.LockOrderViolation, match="self-deadlock"):
+            lk.acquire()
+
+
+def test_rlock_is_reentrant(dep):
+    lk = dep.RLock("test.re")
+    with lk:
+        with lk:
+            assert dep.held_names() == ("test.re",)
+    assert dep.held_names() == ()
+
+
+def test_sleep_under_lock_raises(dep):
+    lk = dep.Lock("test.sleepy")
+    with lk:
+        with pytest.raises(dep.BlockingUnderLock) as excinfo:
+            time.sleep(0.001)
+    assert "test.sleepy" in str(excinfo.value)
+
+
+def test_sleep_without_lock_is_fine(dep):
+    time.sleep(0)
+
+
+def test_allow_blocking_escape_hatch(dep):
+    lk = dep.Lock("test.sleepy2")
+    with lk:
+        with dep.allow_blocking():
+            time.sleep(0)
+    # The allowance does not leak past the context manager.
+    with lk:
+        with pytest.raises(dep.BlockingUnderLock):
+            time.sleep(0)
+
+
+def test_condition_participates_in_ordering(dep):
+    lk = dep.Lock("test.condouter")
+    cond = dep.Condition("test.cond")
+    with lk:
+        with cond:
+            cond.notify_all()
+    with pytest.raises(dep.LockOrderViolation):
+        with cond:
+            with lk:
+                pass
+
+
+def test_condition_wait_releases_and_reacquires(dep):
+    cond = dep.Condition("test.condwait")
+    hits = []
+
+    def waiter():
+        with cond:
+            while not hits:
+                if not cond.wait(timeout=2):
+                    return
+        hits.append("woke")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)  # let the waiter park
+    with cond:
+        hits.append("set")
+        cond.notify_all()
+    t.join(timeout=2)
+    assert hits == ["set", "woke"]
+
+
+def test_reset_forgets_edges(dep):
+    a = dep.Lock("test.resa")
+    b = dep.Lock("test.resb")
+    with a:
+        with b:
+            pass
+    dep.reset()
+    with b:
+        with a:
+            pass  # no longer an inversion after reset
+
+
+def test_graph_snapshot(dep):
+    a = dep.Lock("test.snapa")
+    b = dep.Lock("test.snapb")
+    with a:
+        with b:
+            pass
+    assert "test.snapb" in dep.graph().get("test.snapa", [])

@@ -1,0 +1,6 @@
+"""Repo tooling package marker.
+
+Exists so ``python -m tools.analyze`` resolves and so tools.analyze can
+import the shared metric-definition rules from :mod:`tools.promlint`.
+The scripts in here remain directly runnable (``python tools/promlint.py``).
+"""

@@ -1,0 +1,25 @@
+"""tpulint — project-specific AST static analysis for client_tpu.
+
+The engine runs many concurrent daemon loops over ~50 lock sites, and
+generic linters know nothing about this project's invariants: what a
+lock is, which calls block, which clocks are legal in duration math,
+what a metric name must look like at its *definition* site, or that the
+HTTP, gRPC, and client API surfaces are supposed to agree. tpulint
+encodes those invariants as deterministic AST checks so violations are
+found at lint time instead of by flaky e2e timeouts. The runtime
+counterpart is :mod:`client_tpu.utils.lockdep`, which checks the same
+discipline dynamically under ``CLIENT_TPU_LOCKDEP``.
+
+Usage (CI runs this as a ci_check stage)::
+
+    python -m tools.analyze                              # full tree
+    python -m tools.analyze --baseline tools/analyze/baseline.json
+    python -m tools.analyze --update-baseline ... path   # after review
+    python -m tools.analyze --list-checks
+
+Findings are suppressed inline with ``# tpulint: allow[check-id] reason``
+on the flagged line or the line above, or collectively via the reviewed
+baseline file. See docs/ANALYSIS.md for the check catalog.
+"""
+
+from tools.analyze.core import Finding, SourceFile, run  # noqa: F401

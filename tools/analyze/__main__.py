@@ -1,0 +1,87 @@
+"""CLI: ``python -m tools.analyze [--baseline FILE] [paths...]``.
+
+Exit 0 when every finding is allowed inline or baselined; exit 1 with
+one ``path:line: [check] message`` per new finding. ``--update-baseline``
+rewrites the baseline from the current findings, preserving existing
+justifications (new entries get a TODO placeholder that a reviewer must
+replace — the loader rejects empty justifications)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tools.analyze import checks, core
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="tpulint: project-specific static analysis")
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to scan (default: the standard scan set)")
+    parser.add_argument(
+        "--baseline", metavar="FILE",
+        help="reviewed exceptions; only findings NOT in it fail the "
+             "run (default: tools/analyze/baseline.json when present)")
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the default baseline and report every finding")
+    parser.add_argument(
+        "--update-baseline", metavar="FILE",
+        help="rewrite FILE from current findings, keeping existing "
+             "justifications")
+    parser.add_argument(
+        "--list-checks", action="store_true",
+        help="print the check catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_checks:
+        for check_id, fn in sorted(checks.CHECKS.items()):
+            doc = (fn.__doc__ or "").strip().splitlines()
+            print(f"{check_id}: {doc[0] if doc else ''}")
+        print("env-registry (repo): referenced names registered + "
+              "docs/CONFIG.md coverage")
+        print("surface-parity (repo): HTTP routes / gRPC RPCs / client "
+              "accessors agree")
+        return 0
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    targets = tuple(args.paths) if args.paths else core.DEFAULT_TARGETS
+    findings = core.run(root, targets)
+
+    if args.update_baseline:
+        old = {}
+        if os.path.exists(args.update_baseline):
+            old = core.load_baseline(args.update_baseline)
+        core.write_baseline(args.update_baseline, findings, old)
+        print(f"wrote {len(findings)} entries to {args.update_baseline}")
+        return 0
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline:
+        default = os.path.join(root, "tools", "analyze", "baseline.json")
+        if os.path.exists(default):
+            baseline_path = default
+    stale: list = []
+    if baseline_path and not args.no_baseline:
+        baseline = core.load_baseline(baseline_path)
+        findings, stale = core.apply_baseline(findings, baseline)
+
+    for f in findings:
+        print(f.render())
+    for key in stale:
+        print(f"stale baseline entry (no longer matches anything): "
+              f"{key[0]} {key[1]} {key[2]!r}")
+    if findings or stale:
+        print(f"tpulint: {len(findings)} new finding(s), "
+              f"{len(stale)} stale baseline entr(y/ies)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

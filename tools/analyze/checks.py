@@ -1,0 +1,420 @@
+"""Per-file tpulint checks (AST visitors).
+
+Each check takes a :class:`tools.analyze.core.SourceFile` and returns
+raw findings; the caller applies ``# tpulint: allow[...]`` filtering.
+Check ids are kebab-case and stable — they are the vocabulary of the
+allow annotations and the baseline file.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tools.analyze.core import Finding, SourceFile
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> str:
+    """`a.b.c` for Name/Attribute chains, '' otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _terminal(node: ast.AST) -> str:
+    """Final identifier of a Name/Attribute chain ('' otherwise)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _parents(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+# A with-item guards a critical section when its terminal identifier
+# looks lock-ish. "blocking" is excluded because lockdep.allow_blocking
+# (the runtime escape hatch) would otherwise match the 'lock' substring.
+def _is_lockish(expr: ast.AST) -> str | None:
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    name = _terminal(expr).lower()
+    if "blocking" in name:
+        return None
+    if "lock" in name or "cond" in name or "mutex" in name:
+        return _terminal(expr)
+    return None
+
+
+def _is_allow_blocking(expr: ast.AST) -> bool:
+    return (isinstance(expr, ast.Call)
+            and _terminal(expr.func) == "allow_blocking")
+
+
+# Calls that park the thread (or worse, the device) and must not run
+# inside a critical section: the held lock serializes every contending
+# thread behind the wait.
+_BLOCKING_PREFIXES = ("subprocess.", "socket.", "requests.",
+                      "urllib.request.")
+_BLOCKING_DOTTED = {"time.sleep", "jax.block_until_ready",
+                    "jax.device_get", "urlopen"}
+_BLOCKING_METHODS = {"result", "block_until_ready", "device_get",
+                     "getresponse", "urlopen"}
+
+
+def _blocking_reason(call: ast.Call) -> str | None:
+    dotted = _dotted(call.func)
+    if dotted in _BLOCKING_DOTTED:
+        return dotted
+    for prefix in _BLOCKING_PREFIXES:
+        if dotted.startswith(prefix):
+            return dotted
+    if isinstance(call.func, ast.Attribute) \
+            and call.func.attr in _BLOCKING_METHODS:
+        return f".{call.func.attr}()"
+    return None
+
+
+def check_blocking_under_lock(src: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def visit(node: ast.AST, held: list[str], allowed: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # A nested def runs later, on some other stack — its body
+            # starts with no locks held here.
+            for child in ast.iter_child_nodes(node):
+                visit(child, [], allowed)
+            return
+        if isinstance(node, ast.With):
+            names = [n for n in (
+                _is_lockish(item.context_expr) for item in node.items)
+                if n]
+            now_allowed = allowed or any(
+                _is_allow_blocking(item.context_expr)
+                for item in node.items)
+            for item in node.items:
+                visit(item, held, allowed)
+            for stmt in node.body:
+                visit(stmt, held + names, now_allowed)
+            return
+        if isinstance(node, ast.Call) and held and not allowed:
+            reason = _blocking_reason(node)
+            if reason is not None:
+                findings.append(Finding(
+                    "blocking-under-lock", src.path, node.lineno,
+                    f"blocking call {reason} while holding lock(s) "
+                    f"{held} — stalls every thread contending for them; "
+                    "move it outside the critical section"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held, allowed)
+
+    visit(src.tree, [], False)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# wall-clock
+# ---------------------------------------------------------------------------
+
+def check_wall_clock(src: SourceFile) -> list[Finding]:
+    """Every ``time.time()``/``time.time_ns()`` read is flagged: wall
+    clocks step (NTP) and must never enter duration/deadline math.
+    Intentional wall *stamps* (exported timestamps) carry the allow
+    annotation; everything else uses time.monotonic*_ns."""
+    findings = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) \
+                and _dotted(node.func) in ("time.time", "time.time_ns"):
+            findings.append(Finding(
+                "wall-clock", src.path, node.lineno,
+                f"{_dotted(node.func)}() wall-clock read — use "
+                "monotonic time for durations/deadlines, or annotate "
+                "an intentional wall stamp with "
+                "`# tpulint: allow[wall-clock] <why>`"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# daemon-stop
+# ---------------------------------------------------------------------------
+
+# Evidence of a deliberate shutdown path: a stop-ish identifier or a
+# threading.Event the loop waits on.
+_STOP_TOKEN_RE = re.compile(
+    r"stop|shutdown|close|drain|quit|cancel|halt|Event\(", re.IGNORECASE)
+
+
+def check_daemon_stop(src: SourceFile) -> list[Finding]:
+    """A ``threading.Thread(..., daemon=True)`` whose owning scope has
+    no stop mechanism can never be shut down deliberately — tests leak
+    it and drain can't wait for it. Heuristic: the enclosing class (or
+    the module, for free-standing threads) must mention a stop signal
+    (stop/shutdown/close/drain/quit/cancel/halt)."""
+    findings: list[Finding] = []
+    parents = _parents(src.tree)
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call)
+                and _terminal(node.func) == "Thread"):
+            continue
+        if not any(kw.arg == "daemon"
+                   and isinstance(kw.value, ast.Constant)
+                   and kw.value.value is True for kw in node.keywords):
+            continue
+        scope: ast.AST = node
+        while scope in parents and not isinstance(
+                scope, (ast.ClassDef, ast.FunctionDef,
+                        ast.AsyncFunctionDef)):
+            scope = parents[scope]
+        # A thread made inside a method is owned by the class (the stop
+        # flag usually lives on self); a free function owns its own.
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and isinstance(parents.get(scope), ast.ClassDef):
+            scope = parents[scope]
+        if isinstance(scope, (ast.ClassDef, ast.FunctionDef,
+                              ast.AsyncFunctionDef)):
+            start, end = scope.lineno, scope.end_lineno
+            kind = "class" if isinstance(scope, ast.ClassDef) \
+                else "function"
+            where = f"{kind} {scope.name}"
+        else:
+            start, end = 1, len(src.lines)
+            where = "module"
+        segment = "\n".join(src.lines[start - 1:end])
+        if not _STOP_TOKEN_RE.search(segment):
+            findings.append(Finding(
+                "daemon-stop", src.path, node.lineno,
+                f"daemon thread created in {where} with no visible stop "
+                "signal (no stop/shutdown/close/drain in scope) — "
+                "daemon loops need a deliberate shutdown path"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# swallowed-exception
+# ---------------------------------------------------------------------------
+
+def _is_broad(htype: ast.AST | None) -> bool:
+    if htype is None:
+        return True
+    if isinstance(htype, ast.Name):
+        return htype.id in ("Exception", "BaseException")
+    if isinstance(htype, ast.Tuple):
+        return any(_is_broad(elt) for elt in htype.elts)
+    return False
+
+
+def check_swallowed_exception(src: SourceFile) -> list[Finding]:
+    """A broad ``except`` whose body is only ``pass``/``continue``
+    erases the failure entirely — in a background thread that's an
+    invisible wedge. Handlers that log, count, return a fallback, or
+    re-raise are fine; reviewed fail-open handlers carry the allow
+    annotation."""
+    findings = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node.type):
+            continue
+        if all(isinstance(stmt, (ast.Pass, ast.Continue))
+               for stmt in node.body):
+            findings.append(Finding(
+                "swallowed-exception", src.path, node.lineno,
+                "broad except swallows the exception (body is only "
+                "pass/continue) — log it, count it, or narrow the "
+                "exception type"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# metric-definition
+# ---------------------------------------------------------------------------
+
+def check_metric_definition(src: SourceFile) -> list[Finding]:
+    """Definition-site metric lint: ``registry.counter/gauge/histogram``
+    calls with a literal name are checked against the shared promlint
+    rules (name syntax, _total discipline, unit suffixes, label names,
+    label cardinality) — the static complement of scrape-time promlint."""
+    from tools import promlint
+    findings = []
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("counter", "gauge", "histogram")):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        name = node.args[0].value
+        if "_" not in name:
+            continue  # not a metric-style name (e.g. collections use)
+        labels: list[str] = []
+        label_node = None
+        if len(node.args) >= 3:
+            label_node = node.args[2]
+        for kw in node.keywords:
+            if kw.arg == "labelnames":
+                label_node = kw.value
+        if isinstance(label_node, (ast.Tuple, ast.List)):
+            labels = [elt.value for elt in label_node.elts
+                      if isinstance(elt, ast.Constant)
+                      and isinstance(elt.value, str)]
+        for error in promlint.definition_errors(
+                name, node.func.attr, labels):
+            findings.append(Finding(
+                "metric-definition", src.path, node.lineno, error))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# env-registry
+# ---------------------------------------------------------------------------
+
+_ENV_ACCESSORS = ("env_text", "env_str", "env_int", "env_float",
+                  "env_flag")
+
+
+def _env_constants(tree: ast.AST) -> dict[str, str]:
+    """Module-level ``ENV_X = "CLIENT_TPU_..."`` constants."""
+    consts: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str) \
+                and node.value.value.startswith("CLIENT_TPU_"):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    consts[target.id] = node.value.value
+    return consts
+
+
+def _resolve_env_name(node: ast.AST, consts: dict[str, str]) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value.startswith("CLIENT_TPU_"):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def check_env_registry(src: SourceFile) -> list[Finding]:
+    """Raw ``os.environ`` / ``os.getenv`` reads of ``CLIENT_TPU_*``
+    names bypass the central registry (client_tpu/config.py) — the
+    default drifts from the docs and typos fail silently. Only the
+    registry itself may touch the environment for these names."""
+    if src.path == "client_tpu/config.py":
+        return []
+    consts = _env_constants(src.tree)
+    findings = []
+    for node in ast.walk(src.tree):
+        name = None
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func)
+            if (dotted.endswith("environ.get") or dotted == "os.getenv") \
+                    and node.args:
+                name = _resolve_env_name(node.args[0], consts)
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "get" \
+                    and _terminal(node.func.value) == "environ" \
+                    and node.args:
+                name = _resolve_env_name(node.args[0], consts)
+        elif isinstance(node, ast.Subscript) \
+                and _dotted(node.value).endswith("environ"):
+            name = _resolve_env_name(node.slice, consts)
+        if name is not None:
+            findings.append(Finding(
+                "env-registry", src.path, node.lineno,
+                f"raw environment read of {name} — go through "
+                "client_tpu.config (env_text/env_str/env_int/env_float/"
+                "env_flag) so the registry owns the default and docs"))
+    return findings
+
+
+def env_references(src: SourceFile) -> list[tuple[str, int]]:
+    """(name, line) for every registry-accessor call with a resolvable
+    ``CLIENT_TPU_*`` name in this file (repo-level registration check)."""
+    consts = _env_constants(src.tree)
+    refs = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) \
+                and _terminal(node.func) in _ENV_ACCESSORS and node.args:
+            name = _resolve_env_name(node.args[0], consts)
+            if name is not None:
+                refs.append((name, node.lineno))
+    return refs
+
+
+def registered_env_vars(config_src: SourceFile) -> dict[str, int]:
+    """Names registered in client_tpu/config.py, by AST (no import)."""
+    names: dict[str, int] = {}
+    for node in ast.walk(config_src.tree):
+        if isinstance(node, ast.Call) \
+                and _terminal(node.func) == "register" and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            names[node.args[0].value] = node.lineno
+    return names
+
+
+def check_env_registry_docs(files: list[SourceFile],
+                            root: str) -> list[Finding]:
+    """Repo-level closure: every accessor-referenced name must be
+    registered, and every registered name must appear in the generated
+    docs table (docs/CONFIG.md)."""
+    findings: list[Finding] = []
+    config_src = next(
+        (f for f in files if f.path == "client_tpu/config.py"), None)
+    if config_src is None:
+        return findings
+    registry = registered_env_vars(config_src)
+    for src in files:
+        for name, lineno in env_references(src):
+            if name not in registry:
+                findings.append(Finding(
+                    "env-registry", src.path, lineno,
+                    f"{name} read through the config accessors but "
+                    "never registered in client_tpu/config.py"))
+    docs_path = os.path.join(root, "docs", "CONFIG.md")
+    try:
+        with open(docs_path, encoding="utf-8") as fh:
+            docs = fh.read()
+    except FileNotFoundError:
+        docs = ""
+    for name, lineno in sorted(registry.items()):
+        if f"`{name}`" not in docs:
+            findings.append(Finding(
+                "env-registry", "client_tpu/config.py", lineno,
+                f"registered env var {name} missing from docs/CONFIG.md "
+                "— regenerate the table with "
+                "`python -m client_tpu.config`"))
+    return findings
+
+
+CHECKS = {
+    "blocking-under-lock": check_blocking_under_lock,
+    "wall-clock": check_wall_clock,
+    "daemon-stop": check_daemon_stop,
+    "swallowed-exception": check_swallowed_exception,
+    "metric-definition": check_metric_definition,
+    "env-registry": check_env_registry,
+}

@@ -1,0 +1,176 @@
+"""tpulint core: findings, allow annotations, file walking, baseline.
+
+A finding is identified for baseline purposes by ``(check, path,
+normalized message)`` — the line number is deliberately excluded and any
+digits in the message are normalized, so unrelated edits that shift code
+don't invalidate the reviewed baseline. Every baseline entry carries a
+one-line human justification; entries that no longer match any finding
+are reported as stale so the file can't silently rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+
+# `# tpulint: allow[check-id] reason` (comma-separated ids; `*` = all).
+# The annotation suppresses matching findings on its own line and the
+# line directly below it (so it can sit above a long statement).
+ALLOW_RE = re.compile(
+    r"#\s*tpulint:\s*allow\[([a-z0-9*-]+(?:\s*,\s*[a-z0-9*-]+)*)\]")
+
+# Default scan set, relative to the repo root. bench.py is excluded by
+# design: it is a wall-clock-heavy load generator whose time.time()
+# reads are its product, not a bug (documented in docs/ANALYSIS.md).
+DEFAULT_TARGETS = (
+    "client_tpu",
+    "tools",
+    "tpuclientutils.py",
+    "tpuhttpclient.py",
+    "tpugrpcclient.py",
+    "tpushmutils.py",
+)
+EXCLUDE_PARTS = ("__pycache__", "fixtures")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    check: str
+    path: str          # repo-root-relative, posix separators
+    line: int
+    message: str
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.check, self.path, normalize(self.message))
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.check}] {self.message}"
+
+
+def normalize(message: str) -> str:
+    """Baseline-stable form of a message: digits collapse to ``N`` so
+    capacities/line references inside messages don't churn the key."""
+    return re.sub(r"\d+", "N", message)
+
+
+class SourceFile:
+    """One parsed file plus its allow-annotation map."""
+
+    def __init__(self, path: str, root: str):
+        self.abspath = path
+        self.path = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as fh:
+            self.text = fh.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=self.path)
+        self.allows: dict[int, set[str]] = {}
+        for lineno, line in enumerate(self.lines, start=1):
+            m = ALLOW_RE.search(line)
+            if m:
+                self.allows[lineno] = {
+                    part.strip() for part in m.group(1).split(",")}
+
+    def allowed(self, check: str, line: int) -> bool:
+        for lineno in (line, line - 1):
+            ids = self.allows.get(lineno)
+            if ids and (check in ids or "*" in ids):
+                return True
+        return False
+
+    def filter(self, findings: list[Finding]) -> list[Finding]:
+        return [f for f in findings if not self.allowed(f.check, f.line)]
+
+
+def iter_source_files(root: str, targets=DEFAULT_TARGETS):
+    """Yield SourceFile for every .py in the scan set (skipping files
+    that fail to parse is deliberately NOT done — a syntax error in the
+    tree should fail the lint loudly)."""
+    for target in targets:
+        top = os.path.join(root, target)
+        if os.path.isfile(top):
+            yield SourceFile(top, root)
+            continue
+        if not os.path.isdir(top):
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in EXCLUDE_PARTS)
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield SourceFile(os.path.join(dirpath, name), root)
+
+
+def run(root: str, targets=DEFAULT_TARGETS, checks=None,
+        repo_checks=None) -> list[Finding]:
+    """Run per-file checks + repo-level checks over the scan set and
+    return allow-filtered findings sorted by (path, line)."""
+    from tools.analyze import checks as checks_mod
+    from tools.analyze import surface as surface_mod
+    if checks is None:
+        checks = checks_mod.CHECKS
+    if repo_checks is None:
+        repo_checks = [checks_mod.check_env_registry_docs,
+                       surface_mod.check_surface_parity]
+    files = list(iter_source_files(root, targets))
+    findings: list[Finding] = []
+    for src in files:
+        for check in checks.values():
+            findings.extend(src.filter(check(src)))
+    for repo_check in repo_checks:
+        findings.extend(repo_check(files, root))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.check))
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> dict[tuple[str, str, str], str]:
+    """Baseline file → {finding key: justification}. The file is a JSON
+    list of {check, path, message, justification} entries (message
+    stored in normalized form)."""
+    with open(path, encoding="utf-8") as fh:
+        entries = json.load(fh)
+    baseline: dict[tuple[str, str, str], str] = {}
+    for entry in entries:
+        just = entry.get("justification", "").strip()
+        if not just:
+            raise ValueError(
+                f"baseline entry for {entry.get('check')}:"
+                f"{entry.get('path')} has no justification — every "
+                "accepted exception needs a one-line reason")
+        baseline[(entry["check"], entry["path"],
+                  entry["message"])] = just
+    return baseline
+
+
+def write_baseline(path: str, findings: list[Finding],
+                   justifications=None) -> None:
+    """Serialize findings as a fresh baseline; justifications maps
+    finding keys to reasons (default placeholder forces review)."""
+    justifications = justifications or {}
+    entries = []
+    for f in findings:
+        key = f.key()
+        entries.append({
+            "check": f.check,
+            "path": f.path,
+            "message": normalize(f.message),
+            "justification": justifications.get(
+                key, "TODO: reviewed-by justification"),
+        })
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(entries, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+def apply_baseline(findings: list[Finding], baseline) -> tuple[
+        list[Finding], list[tuple[str, str, str]]]:
+    """Split findings into (new, stale-baseline-keys)."""
+    keys = {f.key() for f in findings}
+    new = [f for f in findings if f.key() not in baseline]
+    stale = [k for k in baseline if k not in keys]
+    return new, stale

@@ -1,0 +1,251 @@
+"""Surface-parity check: HTTP routes ↔ gRPC RPCs ↔ client accessors.
+
+The project promises the same serving surface over four faces: the HTTP
+frontend (`_ROUTES` in client_tpu/server/http_server.py), the gRPC
+servicer (CamelCase RPC methods in client_tpu/server/grpc_server.py),
+and the two client libraries (public methods of InferenceServerClient
+in client_tpu/http and client_tpu/grpc). Historically these drifted one
+endpoint at a time — an observability route would land on HTTP and the
+gRPC RPC or a client accessor would follow a PR later, or never.
+
+Every element of each face maps to a *canonical operation* via the
+tables below (all three Tpu/System/Cuda shared-memory variants collapse
+to one op, both sync and async infer accessors are `infer`, …). The
+check then requires every operation to exist on all four faces; an
+element missing from a table is itself a finding, so adding an endpoint
+forces the author to either complete the surface or record the reviewed
+gap in the baseline with a justification (e.g. `/metrics` is
+scrape-only HTTP by design; `/v2/fleet/*` is served by the router
+frontend, not the engine server).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze.core import Finding, SourceFile
+
+HTTP_SERVER = "client_tpu/server/http_server.py"
+GRPC_SERVER = "client_tpu/server/grpc_server.py"
+HTTP_CLIENT = "client_tpu/http/__init__.py"
+GRPC_CLIENT = "client_tpu/grpc/__init__.py"
+
+SURFACES = ("http-route", "grpc-rpc", "http-client", "grpc-client")
+
+HTTP_HANDLER_OPS = {
+    "health_live": "server_live",
+    "health_ready": "server_ready",
+    "server_metadata": "server_metadata",
+    "model_ready": "model_ready",
+    "model_config": "model_config",
+    "model_stats": "model_statistics",
+    "all_stats": "model_statistics",
+    "model_metadata": "model_metadata",
+    "infer": "infer",
+    "generate": "generate",
+    "generate_stream": "generate_stream",
+    "repo_index": "repository_index",
+    "repo_load": "repository_load",
+    "repo_unload": "repository_unload",
+    "shm_status": "shm_status",
+    "shm_register": "shm_register",
+    "shm_unregister": "shm_unregister",
+    "ring_status": "ring_status",
+    "ring_register": "ring_register",
+    "ring_unregister": "ring_unregister",
+    "ring_doorbell": "ring_doorbell",
+    "trace_setting": "trace_settings_get",
+    "trace_update": "trace_settings_update",
+    "trace_requests": "trace_requests",
+    "events": "events",
+    "slo": "slo_status",
+    "profile": "profile",
+    "timeseries": "timeseries",
+    "memory": "memory_census",
+    "load": "load_report",
+    "metrics": "metrics",
+}
+
+GRPC_RPC_OPS = {
+    "ServerLive": "server_live",
+    "ServerReady": "server_ready",
+    "ServerMetadata": "server_metadata",
+    "ModelReady": "model_ready",
+    "ModelMetadata": "model_metadata",
+    "ModelConfig": "model_config",
+    "ModelStatistics": "model_statistics",
+    "ModelInfer": "infer",
+    "ModelStreamInfer": "stream_infer",
+    "Events": "events",
+    "SloStatus": "slo_status",
+    "Profile": "profile",
+    "Timeseries": "timeseries",
+    "MemoryCensus": "memory_census",
+    "RingRegister": "ring_register",
+    "RingStatus": "ring_status",
+    "RingUnregister": "ring_unregister",
+    "RingDoorbell": "ring_doorbell",
+    "RepositoryIndex": "repository_index",
+    "RepositoryModelLoad": "repository_load",
+    "RepositoryModelUnload": "repository_unload",
+    "SystemSharedMemoryStatus": "shm_status",
+    "SystemSharedMemoryRegister": "shm_register",
+    "SystemSharedMemoryUnregister": "shm_unregister",
+    "TpuSharedMemoryStatus": "shm_status",
+    "TpuSharedMemoryRegister": "shm_register",
+    "TpuSharedMemoryUnregister": "shm_unregister",
+    "CudaSharedMemoryStatus": "shm_status",
+    "CudaSharedMemoryRegister": "shm_register",
+    "CudaSharedMemoryUnregister": "shm_unregister",
+}
+
+CLIENT_METHOD_OPS = {
+    "is_server_live": "server_live",
+    "is_server_ready": "server_ready",
+    "is_model_ready": "model_ready",
+    "get_server_metadata": "server_metadata",
+    "get_model_metadata": "model_metadata",
+    "get_model_config": "model_config",
+    "get_model_repository_index": "repository_index",
+    "load_model": "repository_load",
+    "unload_model": "repository_unload",
+    "get_inference_statistics": "model_statistics",
+    "get_system_shared_memory_status": "shm_status",
+    "register_system_shared_memory": "shm_register",
+    "unregister_system_shared_memory": "shm_unregister",
+    "get_tpu_shared_memory_status": "shm_status",
+    "register_tpu_shared_memory": "shm_register",
+    "unregister_tpu_shared_memory": "shm_unregister",
+    "get_cuda_shared_memory_status": "shm_status",
+    "register_cuda_shared_memory": "shm_register",
+    "unregister_cuda_shared_memory": "shm_unregister",
+    "register_shm_ring": "ring_register",
+    "unregister_shm_ring": "ring_unregister",
+    "get_shm_ring_status": "ring_status",
+    "ring_doorbell": "ring_doorbell",
+    "get_trace_settings": "trace_settings_get",
+    "update_trace_settings": "trace_settings_update",
+    "get_stitched_trace": "trace_requests",
+    "get_events": "events",
+    "get_slo_status": "slo_status",
+    "get_profile": "profile",
+    "get_timeseries": "timeseries",
+    "get_memory": "memory_census",
+    "get_fleet_events": "fleet_events",
+    "get_fleet_profile": "fleet_profile",
+    "get_fleet_slo": "fleet_slo",
+    "get_fleet_timeseries": "fleet_timeseries",
+    "get_fleet_metrics": "fleet_metrics",
+    "infer": "infer",
+    "async_infer": "infer",
+    "generate": "generate",
+    "generate_stream": "generate_stream",
+    "stream_infer": "stream_infer",
+    "start_stream": "stream_infer",
+    "stop_stream": "stream_infer",
+    "async_stream_infer": "stream_infer",
+}
+
+# Client-class methods that are plumbing, not serving-surface accessors.
+CLIENT_IGNORE = {
+    "close",
+    "generate_request_body",
+    "parse_response_body",
+    # Client-local accessor over previously fetched statistics — reads
+    # library state, never talks to a server, so it has no server face.
+    "get_infer_stat",
+}
+
+
+def _http_routes(src: SourceFile) -> list[tuple[str, int]]:
+    """(handler_name, line) from the `_ROUTES` table."""
+    routes = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        if any(isinstance(t, ast.Name) and t.id == "_ROUTES"
+               for t in targets):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Tuple) and len(elt.elts) == 3 \
+                        and isinstance(elt.elts[2], ast.Constant):
+                    routes.append((elt.elts[2].value, elt.lineno))
+    return routes
+
+
+def _grpc_rpcs(src: SourceFile) -> list[tuple[str, int]]:
+    """(RpcName, line) — CamelCase methods of *Servicer classes."""
+    rpcs = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef) and "Servicer" in node.name:
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef) \
+                        and item.name[:1].isupper():
+                    rpcs.append((item.name, item.lineno))
+    return rpcs
+
+
+def _client_methods(src: SourceFile) -> list[tuple[str, int]]:
+    """(method, line) — public methods of InferenceServerClient."""
+    methods = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef) \
+                and node.name == "InferenceServerClient":
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef) \
+                        and not item.name.startswith("_") \
+                        and item.name not in CLIENT_IGNORE:
+                    methods.append((item.name, item.lineno))
+    return methods
+
+
+def check_surface_parity(files: list[SourceFile],
+                         root: str) -> list[Finding]:
+    by_path = {f.path: f for f in files}
+    needed = (HTTP_SERVER, GRPC_SERVER, HTTP_CLIENT, GRPC_CLIENT)
+    if any(p not in by_path for p in needed):
+        return []  # partial scan (explicit path args) — nothing to compare
+    findings: list[Finding] = []
+    # op -> surface -> (path, line of first element implementing it)
+    ops: dict[str, dict[str, tuple[str, int]]] = {}
+
+    def ingest(surface, path, elements, table, kind):
+        for name, lineno in elements:
+            op = table.get(name)
+            if op is None:
+                findings.append(Finding(
+                    "surface-parity", path, lineno,
+                    f"unmapped {kind} '{name}' — add it to the "
+                    "canonical-op tables in tools/analyze/surface.py "
+                    "so parity stays checkable"))
+                continue
+            ops.setdefault(op, {}).setdefault(surface, (path, lineno))
+
+    ingest("http-route", HTTP_SERVER,
+           _http_routes(by_path[HTTP_SERVER]), HTTP_HANDLER_OPS,
+           "HTTP route handler")
+    ingest("grpc-rpc", GRPC_SERVER,
+           _grpc_rpcs(by_path[GRPC_SERVER]), GRPC_RPC_OPS, "gRPC RPC")
+    ingest("http-client", HTTP_CLIENT,
+           _client_methods(by_path[HTTP_CLIENT]), CLIENT_METHOD_OPS,
+           "HTTP client method")
+    ingest("grpc-client", GRPC_CLIENT,
+           _client_methods(by_path[GRPC_CLIENT]), CLIENT_METHOD_OPS,
+           "gRPC client method")
+
+    for op in sorted(ops):
+        present = ops[op]
+        missing = [s for s in SURFACES if s not in present]
+        if not missing:
+            continue
+        anchor_surface = next(s for s in SURFACES if s in present)
+        path, lineno = present[anchor_surface]
+        findings.append(Finding(
+            "surface-parity", path, lineno,
+            f"operation '{op}' is on {sorted(present)} but missing "
+            f"from {missing} — complete the surface or record the "
+            "reviewed gap in the baseline"))
+    return findings

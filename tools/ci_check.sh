@@ -1,12 +1,12 @@
 #!/usr/bin/env bash
 # CI gate: tier-1 tests + chaos suite + live endpoint lint + autotune
 # e2e + router e2e + fused kernel parity + DLRM e2e + shm ring e2e +
-# bench gate.
+# bench gate + static analysis / lockdep gate.
 #
 #   tools/ci_check.sh            # everything (tier-1 already includes chaos)
 #   tools/ci_check.sh --fast     # all stages except tier-1
 #
-# Nine stages:
+# Ten stages:
 #   1. tier-1: the full fast suite (ROADMAP.md contract; excludes `slow`).
 #   2. chaos: the deterministic fault-injection suite alone (`-m chaos`) —
 #      redundant with tier-1 when stage 1 runs, but the -m filter proves
@@ -52,6 +52,12 @@
 #      render promlint-clean in both exposition dialects.
 #   9. bench gate: tools/bench_summary.py --check fails the build when the
 #      newest BENCH_HISTORY.json run regressed any probe's p99 by >25%.
+#  10. analysis gate: tpulint (python -m tools.analyze) against the
+#      reviewed baseline, promlint --definitions over every metric
+#      registration site, and the concurrency-heavy tier-1 subset
+#      re-run under CLIENT_TPU_LOCKDEP=1 so the runtime lock-order and
+#      blocking-under-lock checkers ride every lock the suite takes
+#      (docs/ANALYSIS.md).
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -61,7 +67,7 @@ FAST=0
 rc=0
 
 if [ "$FAST" -eq 0 ]; then
-    echo "=== stage 1/9: tier-1 test suite ==="
+    echo "=== stage 1/10: tier-1 test suite ==="
     rm -f /tmp/_t1.log
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -71,15 +77,15 @@ if [ "$FAST" -eq 0 ]; then
         | tr -cd . | wc -c)"
     [ "$t1" -ne 0 ] && { echo "tier-1 FAILED (exit $t1)"; rc=1; }
 else
-    echo "=== stage 1/9: tier-1 skipped (--fast) ==="
+    echo "=== stage 1/10: tier-1 skipped (--fast) ==="
 fi
 
-echo "=== stage 2/9: chaos (fault-injection) suite ==="
+echo "=== stage 2/10: chaos (fault-injection) suite ==="
 timeout -k 10 300 python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 [ $? -ne 0 ] && { echo "chaos suite FAILED"; rc=1; }
 
-echo "=== stage 3/9: live scrape (promlint + ops endpoints) ==="
+echo "=== stage 3/10: live scrape (promlint + ops endpoints) ==="
 SCRAPE_DIR=$(mktemp -d)
 python - "$SCRAPE_DIR" <<'EOF'
 import json
@@ -158,7 +164,7 @@ grep -q "^tpu_hbm_census_bytes" "$SCRAPE_DIR/metrics.om.txt" \
     || { echo "tpu_hbm_census_bytes missing from openmetrics dialect"; rc=1; }
 rm -rf "$SCRAPE_DIR"
 
-echo "=== stage 4/9: autotune e2e (promotion + metrics) ==="
+echo "=== stage 4/10: autotune e2e (promotion + metrics) ==="
 TUNE_DIR=$(mktemp -d)
 CLIENT_TPU_AUTOTUNE='{"interval_s": 0.2, "cooldown_s": 0.5}' \
 timeout -k 10 300 python - "$TUNE_DIR" <<'EOF'
@@ -234,7 +240,7 @@ python tools/promlint.py --openmetrics "$TUNE_DIR/metrics.om.txt" \
     || { echo "promlint (autotune openmetrics) FAILED"; rc=1; }
 rm -rf "$TUNE_DIR"
 
-echo "=== stage 5/9: router e2e (balance + roll-drain + fleet + metrics) ==="
+echo "=== stage 5/10: router e2e (balance + roll-drain + fleet + metrics) ==="
 ROUTER_DIR=$(mktemp -d)
 timeout -k 10 300 python - "$ROUTER_DIR" <<'EOF'
 import json
@@ -401,7 +407,7 @@ grep -q "^tpu_fleet_drift_score{" "$ROUTER_DIR/metrics.om.txt" \
     || { echo "tpu_fleet_drift_score missing from openmetrics dialect"; rc=1; }
 rm -rf "$ROUTER_DIR"
 
-echo "=== stage 6/9: fused decode kernel parity (interpret) + wave metrics ==="
+echo "=== stage 6/10: fused decode kernel parity (interpret) + wave metrics ==="
 # The Pallas decode kernel and the sharded KV arena run in interpret mode
 # on CPU (docs/KERNELS.md): this stage proves (a) fused == reference on
 # the fast parity subset, (b) an engine on the fused path emits
@@ -472,7 +478,7 @@ python tools/promlint.py --openmetrics "$KERNEL_DIR/metrics.om.txt" \
     || { echo "promlint (kernel openmetrics) FAILED"; rc=1; }
 rm -rf "$KERNEL_DIR"
 
-echo "=== stage 7/9: dlrm e2e (lookup-bucket promotion + emb metrics) ==="
+echo "=== stage 7/10: dlrm e2e (lookup-bucket promotion + emb metrics) ==="
 DLRM_DIR=$(mktemp -d)
 CLIENT_TPU_AUTOTUNE='{"interval_s": 0.2, "cooldown_s": 0.5}' \
 timeout -k 10 300 python - "$DLRM_DIR" <<'EOF'
@@ -550,7 +556,7 @@ python tools/promlint.py --openmetrics "$DLRM_DIR/metrics.om.txt" \
     || { echo "promlint (dlrm openmetrics) FAILED"; rc=1; }
 rm -rf "$DLRM_DIR"
 
-echo "=== stage 8/9: shm ring e2e (producer process + doorbell + metrics) ==="
+echo "=== stage 8/10: shm ring e2e (producer process + doorbell + metrics) ==="
 RING_DIR=$(mktemp -d)
 timeout -k 10 300 python - "$RING_DIR" <<'EOF'
 import json
@@ -664,13 +670,24 @@ python tools/promlint.py --openmetrics "$RING_DIR/metrics.om.txt" \
     || { echo "promlint (shm ring openmetrics) FAILED"; rc=1; }
 rm -rf "$RING_DIR"
 
-echo "=== stage 9/9: bench p99 regression gate ==="
+echo "=== stage 9/10: bench p99 regression gate ==="
 if [ -f BENCH_HISTORY.json ]; then
     python tools/bench_summary.py --check \
         || { echo "bench gate FAILED"; rc=1; }
 else
     echo "no BENCH_HISTORY.json — skipping"
 fi
+
+echo "=== stage 10/10: static analysis + lockdep gate ==="
+python -m tools.analyze --baseline tools/analyze/baseline.json \
+    || { echo "tpulint FAILED"; rc=1; }
+python tools/promlint.py --definitions client_tpu \
+    || { echo "promlint --definitions FAILED"; rc=1; }
+CLIENT_TPU_LOCKDEP=1 timeout -k 10 600 python -m pytest -q \
+    tests/test_lockdep.py tests/test_engine.py tests/test_generative.py \
+    tests/test_shm_ring.py tests/test_flight_recorder.py \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+[ $? -ne 0 ] && { echo "lockdep-enabled concurrency subset FAILED"; rc=1; }
 
 if [ "$rc" -eq 0 ]; then
     echo "ci_check: ALL STAGES PASSED"

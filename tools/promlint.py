@@ -62,6 +62,119 @@ _UNIT_SUFFIX_ALLOWLIST = frozenset({
 })
 
 
+# Definition-site label rules (shared with tools/analyze, check
+# `metric-definition`): reserved names collide with series the renderer
+# itself emits; the high-cardinality set is the classic per-request
+# explosion vocabulary — a label that is unique per request turns one
+# family into one series per request and kills the scrape.
+_RESERVED_LABELS = frozenset({"le", "quantile"})
+_HIGH_CARDINALITY_LABELS = frozenset({
+    "id", "request_id", "trace_id", "uuid", "session_id", "url",
+    "path", "timestamp",
+})
+_MAX_LABELS = 5
+
+
+def definition_errors(name: str, kind: str, labelnames=()) -> list[str]:
+    """Lint one metric *definition* (registration-site name/kind/labels).
+
+    The static complement of the exposition checks below: the same
+    ``_total``/unit-suffix discipline applied where the metric is
+    declared (``MetricRegistry.counter/gauge/histogram`` calls), plus
+    label-name syntax, reserved labels, and cardinality rules that the
+    text format can't see until scrape time. Shared by this module's
+    ``--definitions`` mode and tpulint's ``metric-definition`` check."""
+    errors: list[str] = []
+    if not METRIC_NAME_RE.match(name):
+        errors.append(f"invalid metric name {name!r}")
+        return errors
+    if name not in _UNIT_SUFFIX_ALLOWLIST:
+        if kind == "counter":
+            if not name.endswith("_total"):
+                for unit in _UNIT_SUFFIXES:
+                    if name.endswith(unit):
+                        errors.append(
+                            f"counter '{name}' ends in a bare unit "
+                            f"suffix — cumulative units are "
+                            f"'{name}_total'")
+                        break
+                else:
+                    errors.append(
+                        f"counter '{name}' should end in '_total'")
+        elif name.endswith("_total"):
+            errors.append(
+                f"'{name}' is a {kind} but ends in '_total' "
+                "(reserved for counters)")
+    for label in labelnames:
+        if not LABEL_NAME_RE.match(label) or label.startswith("__"):
+            errors.append(
+                f"metric '{name}': invalid label name {label!r}")
+        elif label in _RESERVED_LABELS:
+            errors.append(
+                f"metric '{name}': label {label!r} is reserved for "
+                "histogram/summary series")
+        elif label in _HIGH_CARDINALITY_LABELS:
+            errors.append(
+                f"metric '{name}': label {label!r} is per-request "
+                "cardinality — one series per value will flood the "
+                "scrape; put it in an exemplar or a trace instead")
+    if len(tuple(labelnames)) > _MAX_LABELS:
+        errors.append(
+            f"metric '{name}': {len(tuple(labelnames))} labels "
+            f"(cap {_MAX_LABELS}) — the series count is the *product* "
+            "of the label cardinalities")
+    return errors
+
+
+def lint_definitions(paths) -> list[str]:
+    """``--definitions`` mode: AST-scan .py files for registration calls
+    (``<registry>.counter/gauge/histogram("name", "help", labels)``)
+    and apply :func:`definition_errors` to each. Returns
+    ``path:line: message`` strings."""
+    import ast
+    import os
+
+    def py_files():
+        for path in paths:
+            if os.path.isdir(path):
+                for dirpath, dirnames, filenames in os.walk(path):
+                    dirnames[:] = [d for d in dirnames
+                                   if d != "__pycache__"]
+                    for fname in sorted(filenames):
+                        if fname.endswith(".py"):
+                            yield os.path.join(dirpath, fname)
+            else:
+                yield path
+
+    errors: list[str] = []
+    for path in py_files():
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("counter", "gauge",
+                                           "histogram")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and "_" in node.args[0].value):
+                continue
+            label_node = node.args[2] if len(node.args) >= 3 else None
+            for kw in node.keywords:
+                if kw.arg == "labelnames":
+                    label_node = kw.value
+            labels = []
+            if isinstance(label_node, (ast.Tuple, ast.List)):
+                labels = [elt.value for elt in label_node.elts
+                          if isinstance(elt, ast.Constant)
+                          and isinstance(elt.value, str)]
+            for msg in definition_errors(
+                    node.args[0].value, node.func.attr, labels):
+                errors.append(f"{path}:{node.lineno}: {msg}")
+    return errors
+
+
 def _family_of(sample_name: str, families: set[str]) -> str:
     """Map a sample name to its family: histogram/summary series names
     carry _bucket/_sum/_count suffixes; counters may end in _total."""
@@ -374,6 +487,16 @@ def _check_histogram(name: str, f: _Family) -> list[str]:
 def main(argv: list[str]) -> int:
     openmetrics = None
     args = [a for a in argv[1:] if a not in ("-", "--")]
+    if "--definitions" in args:
+        args.remove("--definitions")
+        errors = lint_definitions(args or ["client_tpu"])
+        for e in errors:
+            print(e, file=sys.stderr)
+        if errors:
+            print(f"promlint: {len(errors)} definition problem(s)",
+                  file=sys.stderr)
+            return 1
+        return 0
     if "--openmetrics" in args:
         openmetrics = True
         args.remove("--openmetrics")
