@@ -81,7 +81,8 @@ def _append_history(entry: dict) -> None:
 
 _SECTION_NAMES = ("simple", "gen_net", "seq_streaming", "ssd_net",
                   "router", "autotune", "dlrm", "bert", "shm_ab",
-                  "shm_ab_large", "shm_ring", "seq", "gen", "device_steady")
+                  "shm_ab_large", "shm_ring", "shm_fanin", "seq", "gen",
+                  "device_steady")
 
 
 def _sections_filter() -> set | None:
@@ -209,7 +210,10 @@ def _section_guard(section: str):
 # 92s, device_steady 379s) plus ~50% margin; net sections from the CPU
 # verify drive padded for tunnel warmup.
 _SECTION_EST = {"simple": 150, "bert": 180, "shm_ab": 150,
-                "shm_ab_large": 180, "shm_ring": 200, "seq": 90, "gen": 150,
+                "shm_ab_large": 180, "shm_ring": 200,
+                # two replay-fleet phases + two stable-load phases, plus
+                # producer-subprocess startup x (1 + 3*producers)
+                "shm_fanin": 220, "seq": 90, "gen": 150,
                 "device_steady": 550, "gen_net": 400,
                 "seq_streaming": 350, "ssd_net": 450,
                 # two engine builds + two short load phases + promotion
@@ -1353,6 +1357,204 @@ def bench_shm_ring(lanes: int = 4, span: int = 8, dim: int = 150528):
         engine.shutdown()
 
 
+def bench_shm_fanin(producers: int = 8, rows: int = 64, dim: int = 16384,
+                    replay_s: float = 8.0, live_conc: int = 16):
+    """Many-producer shm fan-in + shadow-class protection, two stories:
+
+    1. Fan-in scaling: one staged-dataset segment, N REAL producer
+       processes (tools/replay.py workers) each with its own SPSC ring,
+       all multiplexed through the engine-side reaper — aggregate ips at
+       ``producers`` rings vs ONE producer on the same plane.  The
+       acceptance bar (aggregate >= 3x single) reads off
+       ``fanin_vs_single_ips``.
+    2. Shadow protection: closed-loop LIVE http traffic (priority 0)
+       measured with replay off, then again with the producer fleet
+       replaying at the shadow priority under an admission config that
+       caps the shadow class — ``shadow_p99_ratio`` (live p99 on/off)
+       must stay near 1.0 (<= 1.25 is the bar bench_summary gates).
+
+    Returns {single: {ips}, fanin: {ips, producers, per_producer},
+    fanin_vs_single_ips, live_off: {ips, p99_us, stable},
+    live_shadow: {ips, p99_us, stable}, shadow: {completions, errors},
+    shadow_p99_ratio, rows, dim}.
+    """
+    import numpy as np
+
+    import client_tpu.http as httpclient
+    from client_tpu.admission import AdmissionConfig, AdmissionController
+    from client_tpu.engine import TpuEngine
+    from client_tpu.engine.config import (
+        DynamicBatchingConfig,
+        ModelConfig,
+        TensorConfig,
+    )
+    from client_tpu.engine.model import ModelBackend
+    from client_tpu.engine.repository import ModelRepository
+    from client_tpu.engine.scheduler import power_buckets
+    from client_tpu.server import HttpInferenceServer
+    from client_tpu.utils.shm_ring.staged import build_staged_dataset
+    from tools.replay import collect_workers, spawn_workers
+
+    window_s, max_windows = 2.5, 8
+    if os.environ.get("BENCH_SMOKE"):
+        producers, rows, dim, replay_s, live_conc = 4, 8, 1024, 2.0, 4
+        window_s, max_windows = 1.0, 4
+    mb = min(64, max(live_conc, producers * 4))
+
+    class FaninIdentity(ModelBackend):
+        def __init__(self):
+            self.config = ModelConfig(
+                name="fanin_identity", platform="jax",
+                max_batch_size=mb,
+                input=[TensorConfig("INPUT", "FP32", [dim])],
+                output=[TensorConfig("OUTPUT", "FP32", [dim])],
+                dynamic_batching=DynamicBatchingConfig(
+                    preferred_batch_size=[mb],
+                    max_queue_delay_microseconds=200),
+                batch_buckets=power_buckets(mb),
+                instance_count=4,
+            )
+
+        def make_apply(self):
+            def apply(inputs):
+                return {"OUTPUT": inputs["INPUT"] + 1.0}
+            return apply
+
+    repo = ModelRepository()
+    repo.register_backend(FaninIdentity())
+    # Shadow class lives in admission: replay traffic rides priority 8
+    # (>= shadow_priority) and is capped well below the live plane's
+    # concurrency, so shedding hits replay first — the protection this
+    # probe exists to measure.
+    admission = AdmissionController(AdmissionConfig(
+        shadow_priority=8, shadow_max_inflight=max(2, producers // 2),
+        shadow_max_queue_depth=producers * 2))
+    engine = TpuEngine(repo, warmup=True, admission=admission)
+    srv = HttpInferenceServer(engine, port=0).start()
+    rng = np.random.default_rng(0)
+    staged = rng.random((rows, dim), dtype=np.float32)
+    ds = None
+    out: dict = {}
+    try:
+        ds = build_staged_dataset("/bench_fanin_dset", {"INPUT": staged})
+        reg_client = httpclient.InferenceServerClient(srv.url)
+        reg_client.register_staged_dataset("bench_fanin", "/bench_fanin_dset")
+
+        def replay_fleet(n, duration, priority):
+            procs = spawn_workers(
+                srv.url, "fanin_identity", "/bench_fanin_dset",
+                "bench_fanin", n, duration=duration, priority=priority,
+                slot_count=16, slot_bytes=staged[0].nbytes + 4096,
+                key_prefix=f"/bench_fanin_p{priority}n{n}")
+            return collect_workers(procs, timeout_s=duration * 4 + 120)
+
+        def fleet_ips(stats):
+            return round(sum(s.get("ips", 0.0) for s in stats), 1)
+
+        # -- fan-in scaling: 1 producer, then the full fleet, priority 0
+        # (no shadow gate in the way — this phase measures the reaper).
+        single = replay_fleet(1, replay_s, 0)
+        if any("error" in s for s in single):
+            raise RuntimeError(f"shm_fanin: single producer failed: "
+                               f"{single}")
+        out["single"] = {"ips": fleet_ips(single)}
+        fleet = replay_fleet(producers, replay_s, 0)
+        bad = [s for s in fleet if "error" in s]
+        if bad:
+            raise RuntimeError(f"shm_fanin: producer fleet failed: {bad}")
+        if sum(s.get("errors", 0) for s in fleet):
+            raise RuntimeError(f"shm_fanin: fleet completions errored: "
+                               f"{fleet}")
+        out["fanin"] = {"ips": fleet_ips(fleet), "producers": producers,
+                        "per_producer": [s.get("ips") for s in fleet]}
+        out["fanin_vs_single_ips"] = (
+            round(out["fanin"]["ips"] / out["single"]["ips"], 3)
+            if out["single"]["ips"] else None)
+        log(f"shm_fanin: {producers} producers {out['fanin']['ips']:.1f} "
+            f"ips vs single {out['single']['ips']:.1f} ips = "
+            f"{out['fanin_vs_single_ips']}x")
+
+        # -- live plane: closed-loop HTTP inference at priority 0,
+        # measured with replay off, then under a shadow-priority replay
+        # fleet.  Same warm bucket ladder for both phases.
+        client = httpclient.InferenceServerClient(srv.url,
+                                                  concurrency=live_conc)
+        inp = httpclient.InferInput("INPUT", [1, dim], "FP32")
+        inp.set_data_from_numpy(staged[:1])
+
+        def infer_live():
+            client.infer("fanin_identity", [inp])
+
+        try:
+            k = 1
+            while True:  # precompile every wave bucket outside windows
+                ts = [threading.Thread(target=infer_live)
+                      for _ in range(k)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                if k >= live_conc:
+                    break
+                k = min(k * 2, live_conc)
+            res_off = run_stable_load(infer_live, live_conc,
+                                      window_s=window_s,
+                                      max_windows=max_windows,
+                                      tag="fanin-live-off")
+            out["live_off"] = {"ips": round(res_off["ips"], 1),
+                               "p99_us": round(res_off["p99_us"], 1),
+                               "stable": res_off["stable"]}
+            # Shadow replay must outlive the whole measured load phase;
+            # collect_workers joins the fleet afterwards.
+            shadow_s = 1.5 + window_s * max_windows + 6.0
+            # Shallow rings for the shadow fleet: a shed costs a full
+            # submit/reject round through the reaper, so the burst a
+            # producer can land between backoffs is kept small.
+            procs = spawn_workers(
+                srv.url, "fanin_identity", "/bench_fanin_dset",
+                "bench_fanin", producers, duration=shadow_s, priority=8,
+                slot_count=4, slot_bytes=staged[0].nbytes + 4096,
+                key_prefix="/bench_fanin_shadow")
+            try:
+                res_on = run_stable_load(infer_live, live_conc,
+                                         window_s=window_s,
+                                         max_windows=max_windows,
+                                         tag="fanin-live-shadow")
+            finally:
+                shadow_stats = collect_workers(
+                    procs, timeout_s=shadow_s * 4 + 120)
+            out["live_shadow"] = {"ips": round(res_on["ips"], 1),
+                                  "p99_us": round(res_on["p99_us"], 1),
+                                  "stable": res_on["stable"]}
+            # Shed shadow submissions surface as reap errors in the
+            # workers — expected under the cap, recorded, not fatal.
+            out["shadow"] = {
+                "completions": sum(s.get("completions", 0)
+                                   for s in shadow_stats),
+                "errors": sum(s.get("errors", 0) for s in shadow_stats),
+            }
+        finally:
+            client.close()
+        out["shadow_p99_ratio"] = (
+            round(out["live_shadow"]["p99_us"] / out["live_off"]["p99_us"],
+                  3)
+            if out["live_off"]["p99_us"] else None)
+        out["rows"], out["dim"] = rows, dim
+        reg_client.unregister_staged_dataset("bench_fanin")
+        reg_client.close()
+        log(f"shm_fanin: live p99 {out['live_off']['p99_us'] / 1e3:.1f}ms "
+            f"off -> {out['live_shadow']['p99_us'] / 1e3:.1f}ms under "
+            f"shadow replay = {out['shadow_p99_ratio']}x "
+            f"(shadow {out['shadow']['completions']} completions, "
+            f"{out['shadow']['errors']} shed)")
+        return out
+    finally:
+        if ds is not None:
+            ds.close(unlink=True)
+        srv.stop()
+        engine.shutdown()
+
+
 def bench_sequence_oldest(n_seq: int = 128, window_s: float = 3.0,
                           stability_pct: float = 0.10,
                           stable_needed: int = 3, max_windows: int = 10):
@@ -2366,6 +2568,17 @@ def _main():
                          "duty_cycle": r.get("duty_cycle"),
                          "shm_ring": r})
 
+    def _rec_shm_fanin(r):
+        _RESULT["shm_fanin"] = r
+        # Top-level p99 = the LIVE plane's tail while shadow replay runs —
+        # what bench_summary --check gates: shadow traffic regressing the
+        # live p99 is exactly the failure this probe exists to catch.
+        _append_history({"probe": "shm_fanin",
+                         "p99_us": (r.get("live_shadow") or {}).get("p99_us"),
+                         "fanin_vs_single_ips": r.get("fanin_vs_single_ips"),
+                         "shadow_p99_ratio": r.get("shadow_p99_ratio"),
+                         "shm_fanin": r})
+
     def _rec_seq(s):
         _RESULT["seq_oldest_steps_s"] = round(s["steps_s"], 1)
         _RESULT["seq_oldest"] = s
@@ -2453,6 +2666,7 @@ def _main():
     _run_section("shm_ab", bench_shm_ab, _rec_shm_ab)
     _run_section("shm_ab_large", bench_shm_ab_large, _rec_shm_ab_large)
     _run_section("shm_ring", bench_shm_ring, _rec_shm_ring)
+    _run_section("shm_fanin", bench_shm_fanin, _rec_shm_fanin)
     seq_res = _run_section("seq", bench_sequence_oldest, _rec_seq)
     seq_steps_s = seq_res["steps_s"] if seq_res else None
     gen = _run_section("gen", bench_generative, _rec_gen)

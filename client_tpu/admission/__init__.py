@@ -19,6 +19,13 @@ SRE remedy is to shed early and tell the client when to come back:
   ``retry_after_s``: the frontends surface it as a ``Retry-After`` header
   / retry-pushback trailing metadata, and the client ``RetryPolicy``
   honors it instead of guessing with blind exponential backoff.
+* **Shadow admission class** — requests whose priority is at or above
+  ``shadow_priority`` (Triton convention: higher number = less urgent)
+  are classed *shadow* — replayed/offline traffic fed through the shm
+  fan-in plane by ``tools/replay.py``. Shadow traffic gets its own,
+  stricter gates (``shadow_max_inflight``, ``shadow_max_queue_depth``)
+  evaluated *before* the shared ones, so replay sheds first and live
+  p99 stays intact while the engine soaks spare capacity.
 * **DEGRADED health** — while the controller is actively shedding,
   ``TpuEngine.health_state()`` reports DEGRADED (surfaced via
   ``/v2/health/ready``) so load balancers can steer traffic away before
@@ -152,11 +159,18 @@ class AdmissionConfig:
     max_inflight: int = 0
     # How long after the last shed the engine stays DEGRADED.
     degraded_hold_s: float = 5.0
+    # Shadow class: requests with priority >= shadow_priority (0 = no
+    # shadow class) pass these stricter gates before the shared ones.
+    shadow_priority: int = 0
+    shadow_max_inflight: int = 0
+    shadow_max_queue_depth: int = 0
     # Per-model overrides, keyed by model name (dicts of the fields above).
     models: dict[str, dict] = field(default_factory=dict)
 
     _FIELDS = ("max_queue_depth", "max_estimated_wait_s", "tokens_per_s",
-               "burst", "max_inflight", "degraded_hold_s")
+               "burst", "max_inflight", "degraded_hold_s",
+               "shadow_priority", "shadow_max_inflight",
+               "shadow_max_queue_depth")
 
     @classmethod
     def from_dict(cls, d: dict) -> "AdmissionConfig":
@@ -197,7 +211,8 @@ class AdmissionConfig:
 class _ModelGate:
     """Per-model admission state: bucket, in-flight count, service EWMA."""
 
-    __slots__ = ("cfg", "bucket", "inflight", "ewma_service_s")
+    __slots__ = ("cfg", "bucket", "inflight", "shadow_inflight",
+                 "ewma_service_s")
 
     def __init__(self, cfg: AdmissionConfig):
         self.cfg = cfg
@@ -206,6 +221,7 @@ class _ModelGate:
             self.bucket = TokenBucket(
                 cfg.tokens_per_s, cfg.burst or cfg.tokens_per_s)
         self.inflight = 0
+        self.shadow_inflight = 0
         self.ewma_service_s = 0.0
 
 
@@ -246,16 +262,42 @@ class AdmissionController:
 
     # -- the admission decision ---------------------------------------------
 
+    def is_shadow(self, model: str, priority: int = 0) -> bool:
+        """True when ``priority`` puts the request in the model's shadow
+        class (``shadow_priority`` configured and priority at/above it)."""
+        cfg = self._gate(model).cfg
+        return cfg.shadow_priority > 0 and priority >= cfg.shadow_priority
+
     def admit(self, model: str, version: str = "",
               queue_depth: int = 0, instances: int = 1,
-              trace_id: str | None = None) -> None:
+              trace_id: str | None = None, priority: int = 0) -> None:
         """Admit or shed one request; raises :class:`AdmissionError` on
         shed. ``queue_depth`` is the model's current scheduler backlog and
         ``instances`` its worker count (for the estimated-wait check).
         ``trace_id`` correlates a shed with the rejected request's trace
-        in the event journal."""
+        in the event journal. ``priority`` selects the admission class:
+        at/above ``shadow_priority`` the stricter shadow gates apply
+        first, so replay traffic sheds before it can queue behind live."""
         gate = self._gate(model)
         cfg = gate.cfg
+        if cfg.shadow_priority > 0 and priority >= cfg.shadow_priority:
+            if cfg.shadow_max_inflight > 0 \
+                    and gate.shadow_inflight >= cfg.shadow_max_inflight:
+                self._reject(model, version, "shadow", AdmissionError(
+                    f"model '{model}' shadow class is at its concurrency "
+                    f"cap ({gate.shadow_inflight}/"
+                    f"{cfg.shadow_max_inflight} in flight)",
+                    retry_after_s=gate.ewma_service_s or MIN_RETRY_AFTER_S,
+                    reason="shadow"), trace_id=trace_id)
+            if cfg.shadow_max_queue_depth > 0 \
+                    and queue_depth >= cfg.shadow_max_queue_depth:
+                est = self._estimated_wait_s(gate, queue_depth, instances)
+                self._reject(model, version, "shadow", AdmissionError(
+                    f"model '{model}' queue depth {queue_depth} is at "
+                    f"the shadow shed limit "
+                    f"({cfg.shadow_max_queue_depth})",
+                    retry_after_s=est, reason="shadow"),
+                    trace_id=trace_id)
         if cfg.max_inflight > 0 and gate.inflight >= cfg.max_inflight:
             # Pushback ~ one service interval: a slot frees when the
             # oldest in-flight request completes.
@@ -345,16 +387,20 @@ class AdmissionController:
 
     # -- lifetime accounting -------------------------------------------------
 
-    def on_request_start(self, model: str) -> None:
+    def on_request_start(self, model: str, shadow: bool = False) -> None:
         gate = self._gate(model)
         with self._lock:
             gate.inflight += 1
+            if shadow:
+                gate.shadow_inflight += 1
 
-    def on_request_end(self, model: str, service_s: float | None = None
-                       ) -> None:
+    def on_request_end(self, model: str, service_s: float | None = None,
+                       shadow: bool = False) -> None:
         gate = self._gate(model)
         with self._lock:
             gate.inflight = max(0, gate.inflight - 1)
+            if shadow:
+                gate.shadow_inflight = max(0, gate.shadow_inflight - 1)
             if service_s is not None and service_s > 0:
                 if gate.ewma_service_s <= 0:
                     gate.ewma_service_s = service_s
@@ -381,6 +427,7 @@ class AdmissionController:
         one lock acquisition for the whole table."""
         with self._lock:
             return {m: {"inflight": g.inflight,
+                        "shadow_inflight": g.shadow_inflight,
                         "ewma_service_s": g.ewma_service_s}
                     for m, g in self._gates.items()}
 

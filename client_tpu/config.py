@@ -222,6 +222,34 @@ register(
     "disables; unset/`1`/`on` defaults; else inline JSON or `@/path.json`.",
     "observability")
 
+# -- shm data planes ---------------------------------------------------------
+register(
+    "CLIENT_TPU_REPLAY_PRIORITY", "8", "int",
+    "InferRequest priority tools/replay.py stamps on shadow traffic; at "
+    "or above the admission `shadow_priority` threshold the request is "
+    "classed shadow and sheds first.",
+    "shm")
+register(
+    "CLIENT_TPU_SHM_REAPER_INTERVAL_MS", "1.0", "float",
+    "Idle sleep (ms) of the engine-side multi-ring reaper thread between "
+    "sweeps that admitted nothing.",
+    "shm")
+register(
+    "CLIENT_TPU_SHM_REAPER_SPAN", "32", "int",
+    "Per-ring slot cap per reaper sweep — the fairness quantum that "
+    "keeps one hot producer from starving the other reaped rings.",
+    "shm")
+register(
+    "CLIENT_TPU_STAGED_BUDGET", "0", "int",
+    "Total payload bytes of staged datasets the engine will hold "
+    "attached at once; `0` means unlimited.",
+    "shm")
+register(
+    "CLIENT_TPU_STAGED_PATH", "", "str",
+    "Default staged-dataset shm key for tools/replay.py (`--dataset-key` "
+    "overrides).",
+    "shm")
+
 # -- router / fleet ----------------------------------------------------------
 register(
     "CLIENT_TPU_FLEET_MONITOR", "", "json",

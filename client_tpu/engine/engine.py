@@ -92,13 +92,22 @@ class TpuEngine:
 
         self.events = journal()
         self.slo = SloTracker.from_env(registry=self.metrics.registry)
-        # Third shm data plane: the zero-copy slot ring (engine.shmring).
-        # Constructed after metrics/events so tpu_shm_ring_* and the
-        # attach/detach/overflow journal events bind to this engine.
+        # Third + fourth shm data planes: the zero-copy slot ring
+        # (engine.shmring) and the staged-dataset segments it references
+        # (engine.staged). Constructed after metrics/events so
+        # tpu_shm_ring_* / tpu_shm_dataset_* / tpu_shm_reaper_* and the
+        # attach/detach/overflow journal events bind to this engine; the
+        # ring manager gets the dataset manager (staged descriptor
+        # resolution) and async_infer (reaped-mode admission).
         from client_tpu.engine.shmring import RingShmManager
+        from client_tpu.engine.staged import StagedDatasetManager
 
+        self.staged_shm = StagedDatasetManager(
+            registry=self.metrics.registry, events=self.events)
         self.ring_shm = RingShmManager(registry=self.metrics.registry,
-                                       events=self.events)
+                                       events=self.events,
+                                       datasets=self.staged_shm,
+                                       submit=self.async_infer)
         # Efficiency profiler (process-global, like the fault registry:
         # models record into it from below the engine). Binding exports
         # tpu_batch_fill_ratio / tpu_padded_rows_total /
@@ -225,6 +234,8 @@ class TpuEngine:
             extensions.append("cuda_shared_memory")  # wire-parity alias
         if self.ring_shm is not None:
             extensions.append("shm_ring")
+        if self.staged_shm is not None:
+            extensions.append("staged_dataset")
         return {
             "name": SERVER_NAME,
             "version": client_tpu.__version__,
@@ -484,7 +495,7 @@ class TpuEngine:
         self.admission.admit(
             req.model_name, req.model_version,
             queue_depth=sched.queue.qsize(), instances=len(sched.workers),
-            trace_id=trace_id)
+            trace_id=trace_id, priority=req.priority)
         self._submit_accounted(sched, req)
 
     def _submit_accounted(self, sched: Scheduler, req: InferRequest) -> None:
@@ -494,7 +505,8 @@ class TpuEngine:
         when submit itself rejects (queue full / injected fault), since a
         rejected request never gets a callback-delivered response."""
         model_name = req.model_name
-        self.admission.on_request_start(model_name)
+        shadow = self.admission.is_shadow(model_name, req.priority)
+        self.admission.on_request_start(model_name, shadow=shadow)
         inner = req.response_callback
         ended = [False]
 
@@ -506,7 +518,8 @@ class TpuEngine:
                 if resp.error is None and t.compute_start:
                     service_s = max(
                         0.0, (t.compute_output_end - t.compute_start) / 1e9)
-                self.admission.on_request_end(model_name, service_s)
+                self.admission.on_request_end(model_name, service_s,
+                                              shadow=shadow)
             inner(resp)
 
         req.response_callback = _accounted
@@ -515,7 +528,7 @@ class TpuEngine:
         except BaseException:
             if not ended[0]:
                 ended[0] = True
-                self.admission.on_request_end(model_name)
+                self.admission.on_request_end(model_name, shadow=shadow)
             raise
 
     def _attach_trace_recorder(self, req: InferRequest) -> None:
@@ -626,6 +639,13 @@ class TpuEngine:
         slot becomes an ordinary async_infer submission whose outputs are
         written back into the slot's shm response region."""
         return self.ring_shm.doorbell(name, spec, self.async_infer)
+
+    def resolve_staged_input(self, dataset: str, tensor_index: int,
+                             row_start: int, row_count: int) -> "object":
+        """Resolve a 24-byte staged-input descriptor to a zero-copy row
+        slice of a registered staged dataset (``engine.staged``)."""
+        return self.staged_shm.resolve(dataset, tensor_index, row_start,
+                                       row_count)
 
     def prometheus_metrics(self, openmetrics: bool = False) -> str:
         """Prometheus text exposition of the per-model statistics — the
@@ -926,6 +946,9 @@ class TpuEngine:
         rings = self.ring_shm.profile_table()
         if rings:
             snap["shm_rings"] = rings
+        datasets = self.staged_shm.profile_table()
+        if datasets:
+            snap["shm_datasets"] = datasets
         # Census summary: the capacity headline without the full
         # per-device walk detail (that's /v2/memory's job).
         census = self.memory_census()
@@ -979,4 +1002,8 @@ class TpuEngine:
         if self.tpu_shm is not None:
             self.tpu_shm.unregister(None)
         if getattr(self, "ring_shm", None) is not None:
-            self.ring_shm.unregister(None)
+            # shutdown() (not unregister): the reaper thread must stop
+            # before the segments unmap beneath it.
+            self.ring_shm.shutdown()
+        if getattr(self, "staged_shm", None) is not None:
+            self.staged_shm.unregister(None)

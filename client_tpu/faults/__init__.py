@@ -9,6 +9,9 @@ one chokepoint of the serving stack:
 * ``scheduler.dequeue``  — scheduler worker, after a request is popped
   (exercises the expiry-at-dequeue / shed paths with seeded determinism)
 * ``model.execute``      — model execution, before device dispatch
+* ``shmring.doorbell``   — shm ring span admission, on doorbell entry
+  (explicit doorbells) and per reaper sweep of a non-empty reaped ring
+  (exercises reaper error isolation)
 
 Each site can inject added latency, a protocol error with a chosen
 status, or a dropped connection, gated by a *seeded* Bernoulli draw —
@@ -51,7 +54,7 @@ __all__ = [
 ]
 
 SITES = ("http.pre_read", "grpc.pre_infer", "scheduler.enqueue",
-         "scheduler.dequeue", "model.execute")
+         "scheduler.dequeue", "model.execute", "shmring.doorbell")
 
 ENV_VAR = "CLIENT_TPU_FAULTS"
 

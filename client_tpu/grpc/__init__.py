@@ -760,15 +760,20 @@ class InferenceServerClient:
 
     # -- shm slot ring (zero-copy data plane) -------------------------------
 
-    def register_shm_ring(self, name, key, headers=None,
+    def register_shm_ring(self, name, key, spec=None, headers=None,
                           client_timeout=None):
         """Attach a slot-ring segment (created with
-        ``client_tpu.utils.shm_ring``) by POSIX shm key."""
+        ``client_tpu.utils.shm_ring``) by POSIX shm key. A ``spec``
+        (doorbell span spec without start/count) switches the ring to
+        reaped mode: the engine-side reaper sweeps FILLED slots
+        continuously, no doorbells needed."""
         from client_tpu.protocol import ops_pb2 as ops
 
         self._call(self._client_stub.RingRegister,
-                   ops.RingRegisterRequest(name=name, key=key), headers,
-                   client_timeout=client_timeout)
+                   ops.RingRegisterRequest(
+                       name=name, key=key,
+                       spec_json=json.dumps(spec) if spec else ""),
+                   headers, client_timeout=client_timeout)
 
     def unregister_shm_ring(self, name="", headers=None,
                             client_timeout=None):
@@ -800,6 +805,36 @@ class InferenceServerClient:
                                     doorbell_json=json.dumps(spec)),
             self._md(headers), client_timeout)
         return json.loads(response.result_json)
+
+    # -- staged datasets (many-producer fan-in) -----------------------------
+
+    def register_staged_dataset(self, name, key, headers=None,
+                                client_timeout=None):
+        """Attach a staged-dataset segment (built with
+        ``client_tpu.utils.shm_ring.staged``) by POSIX shm key."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        self._call(self._client_stub.DatasetRegister,
+                   ops.DatasetRegisterRequest(name=name, key=key),
+                   headers, client_timeout=client_timeout)
+
+    def unregister_staged_dataset(self, name="", headers=None,
+                                  client_timeout=None):
+        from client_tpu.protocol import ops_pb2 as ops
+
+        self._call(self._client_stub.DatasetUnregister,
+                   ops.DatasetUnregisterRequest(name=name), headers,
+                   client_timeout=client_timeout)
+
+    def get_staged_dataset_status(self, name="", headers=None,
+                                  client_timeout=None):
+        from client_tpu.protocol import ops_pb2 as ops
+
+        response = self._unary(
+            self._client_stub.DatasetStatus,
+            ops.DatasetStatusRequest(name=name),
+            self._md(headers), client_timeout)
+        return json.loads(response.status_json)
 
     # -- inference -----------------------------------------------------------
 

@@ -789,12 +789,18 @@ class InferenceServerClient:
 
     # -- shm slot ring (zero-copy data plane) -------------------------------
 
-    def register_shm_ring(self, name, key, headers=None, query_params=None):
+    def register_shm_ring(self, name, key, spec=None, headers=None,
+                          query_params=None):
         """Attach a slot-ring segment (created with
         ``client_tpu.utils.shm_ring``) by POSIX shm key; geometry is read
-        from the ring header."""
+        from the ring header. A ``spec`` (doorbell span spec without
+        start/count) switches the ring to reaped mode: the engine-side
+        reaper sweeps FILLED slots continuously, no doorbells needed."""
+        body = {"key": key}
+        if spec is not None:
+            body["spec"] = spec
         self._post_json(f"/v2/shm/ring/{quote(name)}/register",
-                        {"key": key}, query_params, headers)
+                        body, query_params, headers)
 
     def unregister_shm_ring(self, name="", headers=None, query_params=None):
         path = "/v2/shm/ring"
@@ -815,6 +821,30 @@ class InferenceServerClient:
         from shm, not from this response."""
         return self._post_json(f"/v2/shm/ring/{quote(name)}/doorbell",
                                spec, query_params, headers)
+
+    # -- staged datasets (many-producer fan-in) -----------------------------
+
+    def register_staged_dataset(self, name, key, headers=None,
+                                query_params=None):
+        """Attach a staged-dataset segment (built with
+        ``client_tpu.utils.shm_ring.staged``) by POSIX shm key; the
+        tensor manifest is read and validated from the segment header."""
+        self._post_json(f"/v2/shm/dataset/{quote(name)}/register",
+                        {"key": key}, query_params, headers)
+
+    def unregister_staged_dataset(self, name="", headers=None,
+                                  query_params=None):
+        path = "/v2/shm/dataset"
+        if name:
+            path += f"/{quote(name)}"
+        self._post_json(path + "/unregister", {}, query_params, headers)
+
+    def get_staged_dataset_status(self, name="", headers=None,
+                                  query_params=None):
+        path = "/v2/shm/dataset"
+        if name:
+            path += f"/{quote(name)}"
+        return self._get_json(path + "/status", query_params, headers)
 
     # -- trace (device profiling) --------------------------------------------
 

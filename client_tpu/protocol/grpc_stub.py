@@ -64,6 +64,14 @@ _METHODS = [
      ops.RingUnregisterResponse, False),
     ("RingDoorbell", ops.RingDoorbellRequest, ops.RingDoorbellResponse,
      False),
+    # Staged-dataset control plane (engine.staged): register-by-key the
+    # shared read-only segment ring descriptors reference.
+    ("DatasetRegister", ops.DatasetRegisterRequest,
+     ops.DatasetRegisterResponse, False),
+    ("DatasetStatus", ops.DatasetStatusRequest,
+     ops.DatasetStatusResponse, False),
+    ("DatasetUnregister", ops.DatasetUnregisterRequest,
+     ops.DatasetUnregisterResponse, False),
     # Flight recorder ring + HBM census report.
     ("Timeseries", ops.TimeseriesRequest, ops.TimeseriesResponse, False),
     ("MemoryCensus", ops.MemoryRequest, ops.MemoryResponse, False),

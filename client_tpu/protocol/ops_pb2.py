@@ -23,7 +23,8 @@ the message classes. Wire-compatible with the equivalent .proto:
     message SloStatusResponse { string slo_json = 1; }
     message ProfileRequest    { string model = 1; }
     message ProfileResponse   { string profile_json = 1; }
-    message RingRegisterRequest    { string name = 1; string key = 2; }
+    message RingRegisterRequest    { string name = 1; string key = 2;
+                                     string spec_json = 3; }
     message RingRegisterResponse   {}
     message RingStatusRequest      { string name = 1; }
     message RingStatusResponse     { string status_json = 1; }
@@ -32,6 +33,12 @@ the message classes. Wire-compatible with the equivalent .proto:
     message RingDoorbellRequest    { string name = 1;
                                      string doorbell_json = 2; }
     message RingDoorbellResponse   { string result_json = 1; }
+    message DatasetRegisterRequest    { string name = 1; string key = 2; }
+    message DatasetRegisterResponse   {}
+    message DatasetStatusRequest      { string name = 1; }
+    message DatasetStatusResponse     { string status_json = 1; }
+    message DatasetUnregisterRequest  { string name = 1; }
+    message DatasetUnregisterResponse {}
     message TimeseriesRequest  { string signal = 1; string model = 2;
                                  uint64 since_seq = 3; uint32 limit = 4; }
     message TimeseriesResponse { string timeseries_json = 1; }
@@ -119,6 +126,7 @@ def _file_proto() -> _descriptor_pb2.FileDescriptorProto:
     m = message("RingRegisterRequest")
     field(m, "name", 1, _F.TYPE_STRING)
     field(m, "key", 2, _F.TYPE_STRING)
+    field(m, "spec_json", 3, _F.TYPE_STRING)
 
     message("RingRegisterResponse")
 
@@ -139,6 +147,25 @@ def _file_proto() -> _descriptor_pb2.FileDescriptorProto:
 
     m = message("RingDoorbellResponse")
     field(m, "result_json", 1, _F.TYPE_STRING)
+
+    # Staged-dataset control plane (many-producer fan-in; the status
+    # table rides as JSON, matching the HTTP body byte for byte).
+    m = message("DatasetRegisterRequest")
+    field(m, "name", 1, _F.TYPE_STRING)
+    field(m, "key", 2, _F.TYPE_STRING)
+
+    message("DatasetRegisterResponse")
+
+    m = message("DatasetStatusRequest")
+    field(m, "name", 1, _F.TYPE_STRING)
+
+    m = message("DatasetStatusResponse")
+    field(m, "status_json", 1, _F.TYPE_STRING)
+
+    m = message("DatasetUnregisterRequest")
+    field(m, "name", 1, _F.TYPE_STRING)
+
+    message("DatasetUnregisterResponse")
 
     # Flight recorder + HBM census (the /v2/timeseries and /v2/memory
     # bodies ride as JSON strings, same pattern as slo/profile).
@@ -185,6 +212,12 @@ __all__ = [
     "RingUnregisterResponse",
     "RingDoorbellRequest",
     "RingDoorbellResponse",
+    "DatasetRegisterRequest",
+    "DatasetRegisterResponse",
+    "DatasetStatusRequest",
+    "DatasetStatusResponse",
+    "DatasetUnregisterRequest",
+    "DatasetUnregisterResponse",
     "TimeseriesRequest",
     "TimeseriesResponse",
     "MemoryRequest",

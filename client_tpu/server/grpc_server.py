@@ -406,7 +406,10 @@ class _Servicer(GRPCInferenceServiceServicer):
         from client_tpu.protocol import ops_pb2 as ops
 
         try:
-            self.engine.ring_shm.register(request.name, request.key)
+            spec = (json.loads(request.spec_json)
+                    if request.spec_json else None)
+            self.engine.ring_shm.register(request.name, request.key,
+                                          spec=spec)
         except Exception as exc:  # noqa: BLE001
             _abort(context, exc)
         return ops.RingRegisterResponse()
@@ -437,6 +440,32 @@ class _Servicer(GRPCInferenceServiceServicer):
         except Exception as exc:  # noqa: BLE001
             _abort(context, exc)
         return ops.RingDoorbellResponse(result_json=json.dumps(result))
+
+    # -- staged datasets (many-producer fan-in; engine.staged) --------------
+
+    def DatasetRegister(self, request, context):  # noqa: N802
+        from client_tpu.protocol import ops_pb2 as ops
+
+        try:
+            self.engine.staged_shm.register(request.name, request.key)
+        except Exception as exc:  # noqa: BLE001
+            _abort(context, exc)
+        return ops.DatasetRegisterResponse()
+
+    def DatasetStatus(self, request, context):  # noqa: N802
+        from client_tpu.protocol import ops_pb2 as ops
+
+        status = self.engine.staged_shm.status(request.name or None)
+        return ops.DatasetStatusResponse(status_json=json.dumps(status))
+
+    def DatasetUnregister(self, request, context):  # noqa: N802
+        from client_tpu.protocol import ops_pb2 as ops
+
+        try:
+            self.engine.staged_shm.unregister(request.name or None)
+        except Exception as exc:  # noqa: BLE001
+            _abort(context, exc)
+        return ops.DatasetUnregisterResponse()
 
     # -- repository ----------------------------------------------------------
 

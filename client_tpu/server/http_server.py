@@ -72,6 +72,12 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/v2/shm/ring(?:/([^/]+))?/unregister$"),
      "ring_unregister"),
     ("POST", re.compile(r"^/v2/shm/ring/([^/]+)/doorbell$"), "ring_doorbell"),
+    ("GET", re.compile(r"^/v2/shm/dataset(?:/([^/]+))?/status$"),
+     "dataset_status"),
+    ("POST", re.compile(r"^/v2/shm/dataset/([^/]+)/register$"),
+     "dataset_register"),
+    ("POST", re.compile(r"^/v2/shm/dataset(?:/([^/]+))?/unregister$"),
+     "dataset_unregister"),
     ("GET", re.compile(r"^/v2/trace/setting$"), "trace_setting"),
     ("POST", re.compile(r"^/v2/trace/setting$"), "trace_update"),
     ("GET", re.compile(r"^/v2/trace/requests$"), "trace_requests"),
@@ -472,6 +478,21 @@ class _Handler(BaseHTTPRequestHandler):
         slots; completions land in shm, not in this response."""
         spec = json.loads(self._read_body() or b"{}")
         self._send_json(self.engine.ring_doorbell(name, spec))
+
+    # -- staged datasets (many-producer fan-in; engine.staged) --------------
+
+    def h_dataset_status(self, name=None):
+        self._send_json(self.engine.staged_shm.status(name))
+
+    def h_dataset_register(self, name):
+        body = json.loads(self._read_body() or b"{}")
+        self.engine.staged_shm.register_from_json(name, body)
+        self._send_json({})
+
+    def h_dataset_unregister(self, name=None):
+        self._read_body()
+        self.engine.staged_shm.unregister(name)
+        self._send_json({})
 
     # -- inference ----------------------------------------------------------
 

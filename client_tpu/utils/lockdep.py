@@ -94,6 +94,10 @@ DECLARED_ORDER: dict[str, int] = {
     "engine.arena": 500,
     "shm.system": 510,
     "shm.device": 510,
+    # Staged datasets sit between the plain shm managers and the ring
+    # plane: ring completion paths may resolve staged descriptors but
+    # never the reverse.
+    "shmstaged.manager": 515,
     "shmring.manager": 520,
     "shmring.ring": 530,
     "engine.rowcache": 540,

@@ -18,8 +18,10 @@ single-word loads/stores are atomic under the GIL)::
       16  slot_count
       24  slot_bytes      request payload capacity per slot
       32  resp_bytes      response capacity per slot
+      40  producer_pid    liveness word (written once at create)
       64  head            producer cursor (cumulative slots published)
       128 tail            producer cursor (cumulative slots released)
+      192 heartbeat       producer-owned activity counter (own cache line)
     [ state area: slot_count words at STATE_STRIDE spacing ]
       per-slot state word: FREE -> FILLED -> IN_FLIGHT -> DONE -> FREE
     [ payload area: slot_count x (slot_bytes + resp_bytes) ]
@@ -55,8 +57,10 @@ OFF_VERSION = 8
 OFF_SLOT_COUNT = 16
 OFF_SLOT_BYTES = 24
 OFF_RESP_BYTES = 32
+OFF_PRODUCER_PID = 40
 OFF_HEAD = 64
 OFF_TAIL = 128
+OFF_HEARTBEAT = 192
 
 SLOT_FREE = 0
 SLOT_FILLED = 1
@@ -153,6 +157,9 @@ class RingBuffer:
             map_, dtype="<u8", offset=HEADER_BYTES,
             count=slot_count * STATE_STRIDE // 8)
         states[:] = 0
+        # Liveness word: the engine-side reaper probes this pid to fail
+        # and detach rings whose producer died mid-fill.
+        header[OFF_PRODUCER_PID // 8] = os.getpid()
         header[OFF_MAGIC // 8] = RING_MAGIC
         return cls(shm_key, fd, map_, created=not existed)
 
@@ -208,6 +215,18 @@ class RingBuffer:
     @property
     def occupancy(self) -> int:
         return self.head - self.tail
+
+    @property
+    def producer_pid(self) -> int:
+        return int(self._words[OFF_PRODUCER_PID // 8])
+
+    @property
+    def heartbeat(self) -> int:
+        return int(self._words[OFF_HEARTBEAT // 8])
+
+    def beat(self) -> None:
+        """Bump the producer activity counter (producer-owned word)."""
+        self._words[OFF_HEARTBEAT // 8] += 1
 
     def _bump(self, word_off: int) -> None:
         self._words[word_off // 8] += 1
@@ -274,6 +293,35 @@ class RingBuffer:
                          "shape": list(arr.shape),
                          "offset": pos, "byte_size": len(raw)})
             pos += len(raw)
+        self.set_state(slot, SLOT_FILLED)   # payload before state: release
+        self._bump(OFF_HEAD)
+        return slot, meta
+
+    def fill_staged(self, dataset, refs: dict) -> tuple[int, list] | None:
+        """Stage one request by *reference*: write a 24-byte
+        ``(tensor, row_start, row_count)`` descriptor per input instead
+        of tensor bytes. ``dataset`` is an attached
+        :class:`~client_tpu.utils.shm_ring.staged.StagedDataset` and
+        ``refs`` maps ``{input_name: (tensor_name, row_start,
+        row_count)}``. Returns ``(slot, meta)`` or None when the ring is
+        full."""
+        from client_tpu.utils.shm_ring.staged import DESCRIPTOR_BYTES
+
+        slot = self.acquire()
+        if slot is None:
+            return None
+        view = self.request_view(slot)
+        meta = []
+        pos = 0
+        for input_name, (tensor, row_start, row_count) in refs.items():
+            desc = dataset.descriptor(tensor, row_start, row_count)
+            if pos + DESCRIPTOR_BYTES > self.slot_bytes:
+                raise ShmRingError(
+                    f"descriptors exceed slot_bytes ({self.slot_bytes})")
+            view[pos:pos + DESCRIPTOR_BYTES] = desc
+            meta.append({"name": input_name, "staged": True,
+                         "offset": pos, "byte_size": DESCRIPTOR_BYTES})
+            pos += DESCRIPTOR_BYTES
         self.set_state(slot, SLOT_FILLED)   # payload before state: release
         self._bump(OFF_HEAD)
         return slot, meta
@@ -360,12 +408,27 @@ class RingProducer:
 
     ``fill`` accumulates a pending span; ``doorbell`` submits it in one
     control-channel round trip; ``reap`` polls shm for the oldest
-    completion. One producer per ring (SPSC).
+    completion. One producer per ring (SPSC) — many producers per host
+    mean many rings, multiplexed server-side by the reaper.
+
+    Fan-in extensions:
+
+    * ``dataset=`` (an attached :class:`staged.StagedDataset`) +
+      ``dataset_name=`` (its server-registered name) arm
+      :meth:`fill_staged`, which stages 24-byte row descriptors instead
+      of tensor bytes;
+    * ``spec=`` registers the ring in **reaped mode**: the span spec
+      (``model_name``, ``inputs`` metadata, optional
+      ``outputs``/``timeout_ms``/``priority``/``dataset``) is fixed at
+      register time, the engine-side reaper sweeps FILLED slots without
+      any doorbell call, and :meth:`doorbell` becomes invalid.
     """
 
     def __init__(self, client, name: str, shm_key: str, *,
                  slot_count: int = 64, slot_bytes: int = 1 << 20,
-                 resp_bytes: int | None = None):
+                 resp_bytes: int | None = None, dataset=None,
+                 dataset_name: str | None = None,
+                 spec: dict | None = None):
         self._client = client
         self.name = name
         self.shm_key = shm_key
@@ -373,16 +436,27 @@ class RingProducer:
         self._slot_bytes = slot_bytes
         self._resp_bytes = (slot_bytes + 4096 if resp_bytes is None
                             else resp_bytes)
+        self._dataset = dataset
+        self._dataset_name = dataset_name
+        self._spec = dict(spec) if spec is not None else None
         self.ring: RingBuffer | None = None
         self._pending: list[int] = []
         self._meta: list | None = None
+
+    @property
+    def reaped(self) -> bool:
+        return self._spec is not None
 
     def __enter__(self) -> "RingProducer":
         self.ring = RingBuffer.create(
             self.shm_key, self._slot_count, self._slot_bytes,
             self._resp_bytes)
         try:
-            self._client.register_shm_ring(self.name, self.shm_key)
+            if self._spec is not None:
+                self._client.register_shm_ring(self.name, self.shm_key,
+                                               spec=self._spec)
+            else:
+                self._client.register_shm_ring(self.name, self.shm_key)
         except Exception:
             self.ring.close(unlink=True)
             self.ring = None
@@ -407,15 +481,41 @@ class RingProducer:
         if filled is None:
             return None
         slot, meta = filled
-        if self._meta is None:
-            self._meta = meta
-        self._pending.append(slot)
+        if self._spec is None:
+            # doorbell mode: accumulate the span (a reaped ring's spans
+            # are swept server-side; nothing to accumulate)
+            if self._meta is None:
+                self._meta = meta
+            self._pending.append(slot)
+        self.ring.beat()
+        return slot
+
+    def fill_staged(self, refs: dict) -> int | None:
+        """Stage one request by staged-dataset reference:
+        ``{input_name: (tensor_name, row_start, row_count)}`` against
+        the producer's ``dataset=``. None = ring full."""
+        if self._dataset is None:
+            raise ShmRingError(
+                "fill_staged needs RingProducer(dataset=...)")
+        filled = self.ring.fill_staged(self._dataset, refs)
+        if filled is None:
+            return None
+        slot, meta = filled
+        if self._spec is None:
+            if self._meta is None:
+                self._meta = meta
+            self._pending.append(slot)
+        self.ring.beat()
         return slot
 
     def doorbell(self, model_name: str, model_version: str = "", *,
                  outputs=None, timeout_ms: float = 0.0,
                  priority: int = 0, headers=None) -> dict:
         """Submit the pending span in one control-channel round trip."""
+        if self._spec is not None:
+            raise ShmRingError(
+                f"ring '{self.name}' is reaped — the engine sweeps "
+                "FILLED slots; no doorbell needed")
         if not self._pending:
             return {"admitted": 0, "rejected": 0}
         spec = {
@@ -425,6 +525,12 @@ class RingProducer:
             "model_version": model_version,
             "inputs": self._meta,
         }
+        if any(m.get("staged") for m in self._meta):
+            if not self._dataset_name:
+                raise ShmRingError(
+                    "staged fills need RingProducer(dataset_name=...) — "
+                    "the server-registered dataset name")
+            spec["dataset"] = self._dataset_name
         if outputs:
             spec["outputs"] = list(outputs)
         if timeout_ms:
@@ -441,6 +547,7 @@ class RingProducer:
         slot = self.ring.poll(timeout_s=timeout_s)
         outputs, error = self.ring.read_response(slot, copy=copy)
         self.ring.release(slot)
+        self.ring.beat()
         return slot, outputs, error
 
     @property
@@ -453,8 +560,22 @@ class RingProducer:
         return self.ring.occupancy if self.ring is not None else 0
 
 
+def staged_inputs_meta(refs: dict) -> list[dict]:
+    """The ``inputs`` metadata a span of :meth:`RingBuffer.fill_staged`
+    fills with the same ``refs`` structure will carry — for building a
+    reaped-mode register ``spec`` before the first fill."""
+    from client_tpu.utils.shm_ring.staged import DESCRIPTOR_BYTES
+
+    return [{"name": input_name, "staged": True,
+             "offset": i * DESCRIPTOR_BYTES,
+             "byte_size": DESCRIPTOR_BYTES}
+            for i, input_name in enumerate(refs)]
+
+
 __all__ = [
     "HEADER_BYTES", "RING_MAGIC", "RING_VERSION", "STATE_STRIDE",
+    "OFF_PRODUCER_PID", "OFF_HEARTBEAT",
     "SLOT_FREE", "SLOT_FILLED", "SLOT_IN_FLIGHT", "SLOT_DONE",
     "RingBuffer", "RingProducer", "ShmRingError", "ring_total_bytes",
+    "staged_inputs_meta",
 ]

@@ -1,0 +1,549 @@
+"""Many-producer shm fan-in: staged-dataset segments, the multi-ring
+reaper, and the shadow admission class.
+
+Coverage: staged-segment build/attach round trips and strict
+manifest validation (corrupt segments must 400 at register, never
+500), the four-face dataset control surface (HTTP + gRPC), reaped
+rings swept by the engine-side reaper with byte-identical parity
+against the binary HTTP path — including 8 REAL producer subprocesses
+through ``tools.replay`` — dead-producer reclamation after SIGKILL,
+the detach-mid-flight fix (IN_FLIGHT slots failed + journaled), the
+seeded ``shmring.doorbell`` fault site, and the shadow admission
+class's shed-shadow-first contract.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+from client_tpu import faults
+from client_tpu.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    AdmissionError,
+)
+from client_tpu.engine import TpuEngine
+from client_tpu.engine.shmring import RingShmManager
+from client_tpu.engine.types import EngineError
+from client_tpu.models import build_repository
+from client_tpu.observability.events import EventJournal
+from client_tpu.server import GrpcInferenceServer, HttpInferenceServer
+from client_tpu.utils import InferenceServerException
+from client_tpu.utils.shm_ring import (
+    SLOT_DONE,
+    RingBuffer,
+    RingProducer,
+    staged_inputs_meta,
+)
+from client_tpu.utils.shm_ring.staged import (
+    DSET_MAGIC,
+    OFF_DSET_MAGIC,
+    OFF_DSET_VERSION,
+    StagedDataset,
+    StagedDatasetError,
+    build_staged_dataset,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def servers():
+    eng = TpuEngine(build_repository(["simple"]))
+    http_srv = HttpInferenceServer(eng, port=0).start()
+    grpc_srv = GrpcInferenceServer(eng, port=0).start()
+    yield eng, http_srv, grpc_srv
+    grpc_srv.stop()
+    http_srv.stop()
+    eng.shutdown()
+
+
+def _simple_tensors(rows: int = 16) -> dict:
+    """Replay tensors for the `simple` model: row r of INPUT0 is
+    arange+r, INPUT1 is all-3s — OUTPUT0 = a+b, OUTPUT1 = a-b."""
+    base = np.arange(16, dtype=np.int32)
+    return {
+        "INPUT0": np.stack([base + r for r in range(rows)]),
+        "INPUT1": np.full((rows, 16), 3, dtype=np.int32),
+    }
+
+
+def _refs(row: int) -> dict:
+    return {"INPUT0": ("INPUT0", row, 1),
+            "INPUT1": ("INPUT1", row, 1)}
+
+
+# ---------------------------------------------------------------------------
+# staged segment: build/attach round trip + client-side validation
+# ---------------------------------------------------------------------------
+
+
+class TestStagedSegment:
+    def test_build_attach_roundtrip(self):
+        ds = build_staged_dataset("/ct_fanin_rt", _simple_tensors(8))
+        try:
+            peer = StagedDataset.attach("/ct_fanin_rt")
+            assert peer.names == ["INPUT0", "INPUT1"]
+            assert peer.rows("INPUT0") == 8
+            np.testing.assert_array_equal(peer.tensor("INPUT0"),
+                                          ds.tensor("INPUT0"))
+            # descriptor packs and bounds-checks
+            assert len(peer.descriptor("INPUT0", 7, 1)) == 24
+            with pytest.raises(StagedDatasetError):
+                peer.descriptor("INPUT0", 7, 2)  # runs off the end
+            with pytest.raises(StagedDatasetError):
+                peer.descriptor("NOPE", 0, 1)
+            peer.close()
+        finally:
+            ds.close(unlink=True)
+
+    def test_build_rejects_unstageable_tensors(self):
+        with pytest.raises(StagedDatasetError):
+            build_staged_dataset("/ct_fanin_obj", {
+                "B": np.array([b"x", b"yy"], dtype=object)})
+        with pytest.raises(StagedDatasetError):
+            build_staged_dataset("/ct_fanin_empty", {})
+
+    def test_attach_rejects_non_dataset(self):
+        with pytest.raises(StagedDatasetError):
+            StagedDataset.attach("/ct_fanin_missing")
+        path = "/dev/shm/ct_fanin_junk"
+        with open(path, "wb") as f:
+            f.write(b"\0" * 8192)
+        try:
+            with pytest.raises(StagedDatasetError):
+                StagedDataset.attach("/ct_fanin_junk")
+        finally:
+            os.unlink(path)
+
+
+# ---------------------------------------------------------------------------
+# dataset control surface (HTTP + gRPC) and strict 400-never-500 validation
+# ---------------------------------------------------------------------------
+
+
+class TestDatasetSurface:
+    def test_register_status_unregister_both_faces(self, servers):
+        eng, http_srv, grpc_srv = servers
+        ds = build_staged_dataset("/ct_fanin_surf", _simple_tensors(4))
+        try:
+            with httpclient.InferenceServerClient(http_srv.url) as hc, \
+                    grpcclient.InferenceServerClient(
+                        f"127.0.0.1:{grpc_srv.port}") as gc:
+                hc.register_staged_dataset("surf", "/ct_fanin_surf")
+                status = gc.get_staged_dataset_status("surf")["surf"]
+                assert status["key"] == "/ct_fanin_surf"
+                assert [t["name"] for t in status["tensors"]] == [
+                    "INPUT0", "INPUT1"]
+                assert status["payload_bytes"] > 0
+                # duplicate name is a client error on either face
+                with pytest.raises(InferenceServerException) as exc_info:
+                    hc.register_staged_dataset("surf", "/ct_fanin_surf")
+                assert exc_info.value.status() == 400
+                gc.unregister_staged_dataset("surf")
+                assert hc.get_staged_dataset_status() == {}
+        finally:
+            ds.close(unlink=True)
+
+    def test_corrupt_segments_register_400_never_500(self, servers):
+        """Every malformed segment shape is a client error: missing
+        key, truncated header, wrong magic, unsupported version,
+        manifest JSON garbage, and a manifest whose byte ranges lie."""
+        eng, http_srv, _ = servers
+
+        def register(key):
+            with httpclient.InferenceServerClient(http_srv.url) as c:
+                with pytest.raises(InferenceServerException) as exc_info:
+                    c.register_staged_dataset("bad", key)
+                assert exc_info.value.status() == 400, key
+
+        register("/ct_fanin_nokey")  # does not exist
+
+        path = "/dev/shm/ct_fanin_tiny"
+        with open(path, "wb") as f:
+            f.write(b"\0" * 32)  # smaller than the header
+        try:
+            register("/ct_fanin_tiny")
+        finally:
+            os.unlink(path)
+
+        ds = build_staged_dataset("/ct_fanin_mut", _simple_tensors(4))
+        ds.close()
+        path = "/dev/shm/ct_fanin_mut"
+        with open(path, "rb") as f:
+            good = f.read()
+
+        def mutated(mutate):
+            raw = bytearray(good)
+            mutate(raw)
+            with open(path, "wb") as f:
+                f.write(raw)
+            register("/ct_fanin_mut")
+
+        try:
+            def bad_magic(raw):
+                raw[OFF_DSET_MAGIC:OFF_DSET_MAGIC + 8] = b"NOTADSET"
+
+            def bad_version(raw):
+                raw[OFF_DSET_VERSION] = 99
+
+            def bad_manifest_json(raw):
+                raw[64] = ord("{")  # manifest starts at byte 64
+
+            def lying_byte_size(raw):
+                # inflate the first tensor's byte_size past the payload
+                # in place (same digit count keeps the JSON valid — this
+                # must hit the range check, not the JSON parser)
+                key = raw.index(b'"byte_size"')
+                j = raw.index(b":", key) + 1
+                while raw[j:j + 1] == b" ":
+                    j += 1
+                k = j
+                while raw[k:k + 1].isdigit():
+                    k += 1
+                raw[j:k] = b"9" * (k - j)
+
+            for mutate in (bad_magic, bad_version, bad_manifest_json,
+                           lying_byte_size):
+                mutated(mutate)
+        finally:
+            os.unlink(path)
+
+    def test_register_bad_body_is_400(self, servers):
+        eng, http_srv, _ = servers
+        url = f"http://{http_srv.url}" \
+            if "://" not in http_srv.url else http_srv.url
+        import urllib.error
+        import urllib.request
+        for body in (b"", b"[]", b"{}", b'{"key": 7}'):
+            req = urllib.request.Request(
+                f"{url}/v2/shm/dataset/bad/register", data=body,
+                method="POST")
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(req)
+            assert exc_info.value.code == 400
+
+
+# ---------------------------------------------------------------------------
+# reaped rings: engine-side sweeping, parity, fairness counters
+# ---------------------------------------------------------------------------
+
+
+class TestReapedRings:
+    def test_reaped_staged_parity_vs_http(self, servers):
+        """One reaped ring replays every dataset row with NO doorbell
+        calls; outputs must be byte-identical to the binary HTTP path
+        on the same rows."""
+        eng, http_srv, _ = servers
+        rows = 12
+        ds = build_staged_dataset("/ct_fanin_par", _simple_tensors(rows))
+        try:
+            with httpclient.InferenceServerClient(http_srv.url) as c:
+                c.register_staged_dataset("par", "/ct_fanin_par")
+                spec = {"model_name": "simple",
+                        "inputs": staged_inputs_meta(_refs(0)),
+                        "dataset": "par"}
+                try:
+                    with RingProducer(c, "par_ring", "/ct_fanin_parring",
+                                      slot_count=8, slot_bytes=4096,
+                                      dataset=ds, dataset_name="par",
+                                      spec=spec) as prod:
+                        status = c.get_shm_ring_status("par_ring")["par_ring"]
+                        assert status["reaped"] is True
+                        got = {}
+                        sent = reaped = 0
+                        while reaped < rows:
+                            if sent < rows and \
+                                    prod.fill_staged(_refs(sent)) is not None:
+                                sent += 1
+                                continue
+                            slot, outputs, err = prod.reap(timeout_s=30)
+                            assert err is None, err
+                            # SPSC reap order == fill order
+                            got[reaped] = {k: v.copy()
+                                           for k, v in outputs.items()}
+                            reaped += 1
+                        # doorbell on a reaped ring double-admits: 400
+                        with pytest.raises(InferenceServerException) as ei:
+                            c.ring_doorbell("par_ring", {
+                                "start": 0, "count": 1,
+                                "model_name": "simple",
+                                "inputs": staged_inputs_meta(_refs(0))})
+                        assert ei.value.status() == 400
+                finally:
+                    c.unregister_staged_dataset("par")
+
+                # HTTP binary-path oracle on the same rows
+                for r in range(rows):
+                    ins = []
+                    for name in ("INPUT0", "INPUT1"):
+                        arr = ds.tensor(name)[r:r + 1]
+                        inp = httpclient.InferInput(name, [1, 16], "INT32")
+                        inp.set_data_from_numpy(np.ascontiguousarray(arr))
+                        ins.append(inp)
+                    resp = c.infer("simple", ins)
+                    for out in ("OUTPUT0", "OUTPUT1"):
+                        expect = resp.as_numpy(out)
+                        assert got[r][out].tobytes() == expect.tobytes(), \
+                            f"row {r} {out} differs from HTTP path"
+        finally:
+            ds.close(unlink=True)
+        # reaper observability: sweeps ran, slots were attributed to the
+        # ring, and the rings gauge fell back to zero after unregister
+        text = eng.prometheus_metrics()
+        assert "tpu_shm_reaper_sweeps_total" in text
+        assert 'tpu_shm_reaper_slots_total{ring="par_ring"}' in text
+        assert "tpu_shm_reaper_rings 0" in text
+
+    def test_eight_producer_subprocess_parity(self, servers):
+        """8 REAL producer processes (tools.replay workers) fan into one
+        staged dataset through 8 reaped rings; the summed per-request
+        output CRCs must equal the HTTP path's on the same rows."""
+        eng, http_srv, _ = servers
+        sys.path.insert(0, REPO_ROOT)
+        try:
+            from tools.replay import collect_workers, spawn_workers
+        finally:
+            sys.path.pop(0)
+        rows, producers, per = 16, 8, 6
+        ds = build_staged_dataset("/ct_fanin_fan", _simple_tensors(rows))
+        try:
+            with httpclient.InferenceServerClient(http_srv.url) as c:
+                c.register_staged_dataset("fan", "/ct_fanin_fan")
+                try:
+                    procs = spawn_workers(
+                        f"http://{http_srv.url}", "simple",
+                        "/ct_fanin_fan", "fan", producers,
+                        duration=0.0, count=per, slot_count=8,
+                        slot_bytes=4096, key_prefix="/ct_fanin_fanr")
+                    stats = collect_workers(procs, timeout_s=120)
+                finally:
+                    c.unregister_staged_dataset("fan")
+                assert [s for s in stats if "error" in s] == []
+                assert sum(s["completions"] for s in stats) \
+                    == producers * per
+                assert sum(s["errors"] for s in stats) == 0
+
+                # Oracle: worker i replays rows i, i+1, ... i+per-1
+                # (mod rows); recompute the identical CRC over HTTP.
+                expect_crc = 0
+                for i in range(producers):
+                    for k in range(per):
+                        r = (i + k) % rows
+                        ins = []
+                        for name in ("INPUT0", "INPUT1"):
+                            arr = ds.tensor(name)[r:r + 1]
+                            inp = httpclient.InferInput(
+                                name, [1, 16], "INT32")
+                            inp.set_data_from_numpy(
+                                np.ascontiguousarray(arr))
+                            ins.append(inp)
+                        resp = c.infer("simple", ins)
+                        for out in sorted(("OUTPUT0", "OUTPUT1")):
+                            expect_crc += zlib.crc32(
+                                resp.as_numpy(out).tobytes())
+                assert sum(s["crc"] for s in stats) == expect_crc
+        finally:
+            ds.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# dead-producer reclamation (real subprocess, SIGKILL)
+# ---------------------------------------------------------------------------
+
+
+_DEAD_PRODUCER_SCRIPT = """
+import sys, time
+import client_tpu.http as httpclient
+from client_tpu.utils.shm_ring import RingBuffer
+
+url, name, key = sys.argv[1:4]
+ring = RingBuffer.create(key, 8, 4096, 8192)
+client = httpclient.InferenceServerClient(url)
+client.register_shm_ring(name, key, spec={
+    "model_name": "simple",
+    "inputs": [
+        {"name": "INPUT0", "datatype": "INT32", "shape": [1, 16],
+         "offset": 0, "byte_size": 64},
+        {"name": "INPUT1", "datatype": "INT32", "shape": [1, 16],
+         "offset": 64, "byte_size": 64}]})
+print("ready", flush=True)
+time.sleep(600)
+"""
+
+
+class TestDeadProducerReclaim:
+    def test_sigkill_reclaims_ring(self, servers):
+        eng, http_srv, _ = servers
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _DEAD_PRODUCER_SCRIPT,
+             f"http://{http_srv.url}", "deadring", "/ct_fanin_dead"],
+            stdout=subprocess.PIPE, cwd=REPO_ROOT)
+        try:
+            assert proc.stdout.readline().strip() == b"ready"
+            status = eng.ring_shm.status("deadring")["deadring"]
+            assert status["reaped"] is True
+            assert status["producer_pid"] == proc.pid
+            proc.kill()
+            proc.wait(timeout=10)
+            # the reaper's liveness probe unregisters the dead
+            # producer's ring within a few sweep intervals
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if "deadring" not in eng.ring_shm.status():
+                    break
+                time.sleep(0.02)
+            assert "deadring" not in eng.ring_shm.status()
+            names = [e["name"] for e in
+                     eng.events_export(category="shm_ring")["events"]]
+            assert "producer_dead" in names
+            assert ('tpu_shm_reaper_dead_producers_total'
+                    '{ring="deadring"}') in eng.prometheus_metrics()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            try:
+                os.unlink("/dev/shm/ct_fanin_dead")
+            except FileNotFoundError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# detach-mid-flight fix: IN_FLIGHT slots failed + journaled
+# ---------------------------------------------------------------------------
+
+
+class TestDetachInflight:
+    def test_unregister_fails_inflight_slots(self):
+        """Detaching a ring with requests still in flight must complete
+        those slots with an error response (producer unblocks) and
+        journal `shm_ring.detach_inflight` — the regression this PR
+        fixes (slots used to stay IN_FLIGHT forever producer-side)."""
+        events = EventJournal()
+        held = []
+        mgr = RingShmManager(events=events)
+        ring = RingBuffer.create("/ct_fanin_dif", 4, 4096, 8192)
+        try:
+            mgr.register("dif", "/ct_fanin_dif")
+            a = np.arange(16, dtype=np.int32).reshape(1, 16)
+            _, meta = ring.fill({"INPUT0": a, "INPUT1": a})
+            ring.fill({"INPUT0": a, "INPUT1": a})
+            res = mgr.doorbell(
+                "dif", {"start": 0, "count": 2, "model_name": "simple",
+                        "inputs": meta},
+                submit=lambda req, cb: held.append((req, cb)))
+            assert res["admitted"] == 2
+            assert len(held) == 2  # in flight, never completed
+            mgr.unregister("dif")
+            # producer side: both slots are DONE with an error payload
+            for slot in (0, 1):
+                assert ring.state(slot) == SLOT_DONE
+                outputs, err = ring.read_response(slot)
+                assert outputs == {}
+                assert "detached" in err
+            ev = [e for e in events.snapshot(category="shm_ring")
+                  if e.name == "detach_inflight"]
+            assert len(ev) == 1
+            assert ev[0].severity == "WARNING"
+            assert ev[0].detail["slots"] == 2
+        finally:
+            mgr.shutdown()
+            ring.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# seeded fault site: shmring.doorbell
+# ---------------------------------------------------------------------------
+
+
+class TestDoorbellFaultSite:
+    def test_site_is_registered(self):
+        assert "shmring.doorbell" in faults.SITES
+
+    def test_doorbell_fault_counted_and_translated(self, servers):
+        """An armed `shmring.doorbell` site fails the doorbell with the
+        configured status (translated, not a 500) and increments
+        tpu_fault_injections_total like every other site."""
+        eng, http_srv, _ = servers
+        ring = RingBuffer.create("/ct_fanin_flt", 4, 4096, 8192)
+        try:
+            with httpclient.InferenceServerClient(http_srv.url) as c:
+                c.register_shm_ring("flt", "/ct_fanin_flt")
+                a = np.arange(16, dtype=np.int32).reshape(1, 16)
+                _, meta = ring.fill({"INPUT0": a, "INPUT1": a})
+                faults.configure({"shmring.doorbell": {
+                    "probability": 1.0, "seed": 3, "error_status": 503}})
+                try:
+                    with pytest.raises(InferenceServerException) as ei:
+                        c.ring_doorbell("flt", {
+                            "start": 0, "count": 1,
+                            "model_name": "simple", "inputs": meta})
+                    assert ei.value.status() == 503
+                finally:
+                    faults.reset()
+                text = eng.prometheus_metrics()
+                assert "tpu_fault_injections_total" in text
+                assert 'site="shmring.doorbell"' in text
+                c.unregister_shm_ring("flt")
+        finally:
+            ring.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# shadow admission class
+# ---------------------------------------------------------------------------
+
+
+class TestShadowAdmission:
+    def _ctrl(self, **kw):
+        return AdmissionController(AdmissionConfig(**kw))
+
+    def test_priority_threshold_classes_shadow(self):
+        ctrl = self._ctrl(shadow_priority=4)
+        assert not ctrl.is_shadow("m", 0)
+        assert not ctrl.is_shadow("m", 3)
+        assert ctrl.is_shadow("m", 4)
+        assert ctrl.is_shadow("m", 8)
+        # disabled (the default): nothing is shadow at any priority
+        assert not self._ctrl().is_shadow("m", 99)
+
+    def test_shadow_sheds_first_live_unaffected(self):
+        ctrl = self._ctrl(shadow_priority=4, shadow_max_inflight=1)
+        ctrl.admit("m", priority=8)
+        ctrl.on_request_start("m", shadow=True)
+        # second shadow request sheds with reason="shadow" ...
+        with pytest.raises(AdmissionError) as exc_info:
+            ctrl.admit("m", priority=8)
+        assert exc_info.value.reason == "shadow"
+        assert exc_info.value.status == 429
+        # ... while live traffic at the same instant admits fine
+        ctrl.admit("m", priority=0)
+        ctrl.on_request_end("m", shadow=True)
+        ctrl.admit("m", priority=8)  # slot freed: shadow admits again
+
+    def test_shadow_queue_depth_gate(self):
+        ctrl = self._ctrl(shadow_priority=4, shadow_max_queue_depth=2)
+        ctrl.admit("m", queue_depth=1, priority=4)
+        with pytest.raises(AdmissionError) as exc_info:
+            ctrl.admit("m", queue_depth=2, priority=4)
+        assert exc_info.value.reason == "shadow"
+        ctrl.admit("m", queue_depth=2, priority=0)  # live gate is higher
+
+    def test_shadow_inflight_in_load_snapshot(self):
+        ctrl = self._ctrl(shadow_priority=4)
+        ctrl.on_request_start("m", shadow=True)
+        ctrl.on_request_start("m", shadow=False)
+        snap = ctrl.load_snapshot()["m"]
+        assert snap["inflight"] == 2
+        assert snap["shadow_inflight"] == 1
+        ctrl.on_request_end("m", shadow=True)
+        assert ctrl.load_snapshot()["m"]["shadow_inflight"] == 0

@@ -54,6 +54,9 @@ HTTP_HANDLER_OPS = {
     "ring_register": "ring_register",
     "ring_unregister": "ring_unregister",
     "ring_doorbell": "ring_doorbell",
+    "dataset_status": "dataset_status",
+    "dataset_register": "dataset_register",
+    "dataset_unregister": "dataset_unregister",
     "trace_setting": "trace_settings_get",
     "trace_update": "trace_settings_update",
     "trace_requests": "trace_requests",
@@ -85,6 +88,9 @@ GRPC_RPC_OPS = {
     "RingStatus": "ring_status",
     "RingUnregister": "ring_unregister",
     "RingDoorbell": "ring_doorbell",
+    "DatasetRegister": "dataset_register",
+    "DatasetStatus": "dataset_status",
+    "DatasetUnregister": "dataset_unregister",
     "RepositoryIndex": "repository_index",
     "RepositoryModelLoad": "repository_load",
     "RepositoryModelUnload": "repository_unload",
@@ -123,6 +129,9 @@ CLIENT_METHOD_OPS = {
     "unregister_shm_ring": "ring_unregister",
     "get_shm_ring_status": "ring_status",
     "ring_doorbell": "ring_doorbell",
+    "register_staged_dataset": "dataset_register",
+    "unregister_staged_dataset": "dataset_unregister",
+    "get_staged_dataset_status": "dataset_status",
     "get_trace_settings": "trace_settings_get",
     "update_trace_settings": "trace_settings_update",
     "get_stitched_trace": "trace_requests",

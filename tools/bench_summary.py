@@ -152,6 +152,8 @@ def main() -> int:
                 _print_dlrm_delta(rec)
             if probe == "shm_ring":
                 _print_shm_ring_delta(rec)
+            if probe == "shm_fanin":
+                _print_shm_fanin_delta(rec)
     return 0
 
 
@@ -209,6 +211,28 @@ def _print_shm_ring_delta(rec: dict) -> None:
           + (f" (occupancy {ring.get('occupancy_mean')}, "
              f"{r.get('lanes')} lanes x span {r.get('span')})"
              if ring.get("occupancy_mean") is not None else ""))
+
+
+def _print_shm_fanin_delta(rec: dict) -> None:
+    """The fan-in probe's two acceptance bars on one line each: N
+    producer processes vs one on the reaper plane (>= 3x aggregate ips),
+    and the live plane's p99 with shadow replay on vs off (<= 1.25x)."""
+    r = rec.get("shm_fanin") or rec
+    single, fanin = r.get("single") or {}, r.get("fanin") or {}
+    if single and fanin:
+        ratio = r.get("fanin_vs_single_ips")
+        print(f"    shm_fanin scaling: {single.get('ips')} ips (1 producer)"
+              f" -> {fanin.get('ips')} ips "
+              f"({fanin.get('producers')} producers)"
+              + (f" = {ratio}x" if ratio is not None else ""))
+    off, on = r.get("live_off") or {}, r.get("live_shadow") or {}
+    if off and on:
+        shed = r.get("shadow") or {}
+        print(f"    live p99 under shadow replay: {off.get('p99_us')}us "
+              f"off -> {on.get('p99_us')}us on = "
+              f"{r.get('shadow_p99_ratio')}x "
+              f"(shadow: {shed.get('completions')} done, "
+              f"{shed.get('errors')} shed)")
 
 
 def _print_router_delta(rec: dict) -> None:

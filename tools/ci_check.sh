@@ -1,12 +1,12 @@
 #!/usr/bin/env bash
 # CI gate: tier-1 tests + chaos suite + live endpoint lint + autotune
 # e2e + router e2e + fused kernel parity + DLRM e2e + shm ring e2e +
-# bench gate + static analysis / lockdep gate.
+# staged fan-in e2e + bench gate + static analysis / lockdep gate.
 #
 #   tools/ci_check.sh            # everything (tier-1 already includes chaos)
 #   tools/ci_check.sh --fast     # all stages except tier-1
 #
-# Ten stages:
+# Eleven stages:
 #   1. tier-1: the full fast suite (ROADMAP.md contract; excludes `slow`).
 #   2. chaos: the deterministic fault-injection suite alone (`-m chaos`) —
 #      redundant with tier-1 when stage 1 runs, but the -m filter proves
@@ -50,9 +50,16 @@
 #      completions — asserting the reaped outputs are byte-identical to
 #      the binary-HTTP path for the same inputs, and that tpu_shm_ring_*
 #      render promlint-clean in both exposition dialects.
-#   9. bench gate: tools/bench_summary.py --check fails the build when the
+#   9. staged fan-in e2e: EIGHT real producer processes (tools/replay.py
+#      workers) share ONE staged-dataset segment and fan into the
+#      engine-side multi-ring reaper via descriptor-only slots — zero
+#      doorbells. Asserts every completion arrives error-free, the
+#      summed per-tensor CRC32s are byte-identical to the binary-HTTP
+#      path for the same rows, and tpu_shm_dataset_* / tpu_shm_reaper_*
+#      render promlint-clean in both exposition dialects.
+#  10. bench gate: tools/bench_summary.py --check fails the build when the
 #      newest BENCH_HISTORY.json run regressed any probe's p99 by >25%.
-#  10. analysis gate: tpulint (python -m tools.analyze) against the
+#  11. analysis gate: tpulint (python -m tools.analyze) against the
 #      reviewed baseline, promlint --definitions over every metric
 #      registration site, and the concurrency-heavy tier-1 subset
 #      re-run under CLIENT_TPU_LOCKDEP=1 so the runtime lock-order and
@@ -67,7 +74,7 @@ FAST=0
 rc=0
 
 if [ "$FAST" -eq 0 ]; then
-    echo "=== stage 1/10: tier-1 test suite ==="
+    echo "=== stage 1/11: tier-1 test suite ==="
     rm -f /tmp/_t1.log
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -77,15 +84,15 @@ if [ "$FAST" -eq 0 ]; then
         | tr -cd . | wc -c)"
     [ "$t1" -ne 0 ] && { echo "tier-1 FAILED (exit $t1)"; rc=1; }
 else
-    echo "=== stage 1/10: tier-1 skipped (--fast) ==="
+    echo "=== stage 1/11: tier-1 skipped (--fast) ==="
 fi
 
-echo "=== stage 2/10: chaos (fault-injection) suite ==="
+echo "=== stage 2/11: chaos (fault-injection) suite ==="
 timeout -k 10 300 python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 [ $? -ne 0 ] && { echo "chaos suite FAILED"; rc=1; }
 
-echo "=== stage 3/10: live scrape (promlint + ops endpoints) ==="
+echo "=== stage 3/11: live scrape (promlint + ops endpoints) ==="
 SCRAPE_DIR=$(mktemp -d)
 python - "$SCRAPE_DIR" <<'EOF'
 import json
@@ -164,7 +171,7 @@ grep -q "^tpu_hbm_census_bytes" "$SCRAPE_DIR/metrics.om.txt" \
     || { echo "tpu_hbm_census_bytes missing from openmetrics dialect"; rc=1; }
 rm -rf "$SCRAPE_DIR"
 
-echo "=== stage 4/10: autotune e2e (promotion + metrics) ==="
+echo "=== stage 4/11: autotune e2e (promotion + metrics) ==="
 TUNE_DIR=$(mktemp -d)
 CLIENT_TPU_AUTOTUNE='{"interval_s": 0.2, "cooldown_s": 0.5}' \
 timeout -k 10 300 python - "$TUNE_DIR" <<'EOF'
@@ -240,7 +247,7 @@ python tools/promlint.py --openmetrics "$TUNE_DIR/metrics.om.txt" \
     || { echo "promlint (autotune openmetrics) FAILED"; rc=1; }
 rm -rf "$TUNE_DIR"
 
-echo "=== stage 5/10: router e2e (balance + roll-drain + fleet + metrics) ==="
+echo "=== stage 5/11: router e2e (balance + roll-drain + fleet + metrics) ==="
 ROUTER_DIR=$(mktemp -d)
 timeout -k 10 300 python - "$ROUTER_DIR" <<'EOF'
 import json
@@ -407,7 +414,7 @@ grep -q "^tpu_fleet_drift_score{" "$ROUTER_DIR/metrics.om.txt" \
     || { echo "tpu_fleet_drift_score missing from openmetrics dialect"; rc=1; }
 rm -rf "$ROUTER_DIR"
 
-echo "=== stage 6/10: fused decode kernel parity (interpret) + wave metrics ==="
+echo "=== stage 6/11: fused decode kernel parity (interpret) + wave metrics ==="
 # The Pallas decode kernel and the sharded KV arena run in interpret mode
 # on CPU (docs/KERNELS.md): this stage proves (a) fused == reference on
 # the fast parity subset, (b) an engine on the fused path emits
@@ -478,7 +485,7 @@ python tools/promlint.py --openmetrics "$KERNEL_DIR/metrics.om.txt" \
     || { echo "promlint (kernel openmetrics) FAILED"; rc=1; }
 rm -rf "$KERNEL_DIR"
 
-echo "=== stage 7/10: dlrm e2e (lookup-bucket promotion + emb metrics) ==="
+echo "=== stage 7/11: dlrm e2e (lookup-bucket promotion + emb metrics) ==="
 DLRM_DIR=$(mktemp -d)
 CLIENT_TPU_AUTOTUNE='{"interval_s": 0.2, "cooldown_s": 0.5}' \
 timeout -k 10 300 python - "$DLRM_DIR" <<'EOF'
@@ -556,7 +563,7 @@ python tools/promlint.py --openmetrics "$DLRM_DIR/metrics.om.txt" \
     || { echo "promlint (dlrm openmetrics) FAILED"; rc=1; }
 rm -rf "$DLRM_DIR"
 
-echo "=== stage 8/10: shm ring e2e (producer process + doorbell + metrics) ==="
+echo "=== stage 8/11: shm ring e2e (producer process + doorbell + metrics) ==="
 RING_DIR=$(mktemp -d)
 timeout -k 10 300 python - "$RING_DIR" <<'EOF'
 import json
@@ -670,7 +677,112 @@ python tools/promlint.py --openmetrics "$RING_DIR/metrics.om.txt" \
     || { echo "promlint (shm ring openmetrics) FAILED"; rc=1; }
 rm -rf "$RING_DIR"
 
-echo "=== stage 9/10: bench p99 regression gate ==="
+echo "=== stage 9/11: staged fan-in e2e (8 producer processes + reaper metrics) ==="
+FANIN_DIR=$(mktemp -d)
+timeout -k 10 300 python - "$FANIN_DIR" <<'EOF'
+import json
+import sys
+import zlib
+from urllib.request import Request, urlopen
+
+import numpy as np
+
+import client_tpu.http as httpclient
+from client_tpu.engine import TpuEngine
+from client_tpu.models import build_repository
+from client_tpu.server import HttpInferenceServer
+from client_tpu.utils.shm_ring.staged import build_staged_dataset
+from tools.replay import collect_workers, spawn_workers
+
+out_dir = sys.argv[1]
+ROWS, PRODUCERS, PER = 16, 8, 6
+
+engine = TpuEngine(build_repository(["simple"]), warmup=False)
+srv = HttpInferenceServer(engine, host="127.0.0.1", port=0).start()
+ds = None
+try:
+    base = np.arange(16, dtype=np.int32).reshape(1, 16)
+    ds = build_staged_dataset("/ci_fanin_dset", {
+        "INPUT0": np.concatenate([base + r for r in range(ROWS)]),
+        "INPUT1": np.full((ROWS, 16), 3, dtype=np.int32),
+    })
+    client = httpclient.InferenceServerClient(srv.url)
+    client.register_staged_dataset("ci_fanin", "/ci_fanin_dset")
+
+    # Oracle: binary-HTTP outputs for the rows each worker replays
+    # (worker i starts at row i, wraps mod ROWS), CRC-folded exactly
+    # like tools/replay._reap_one does on the ring side.
+    expect = 0
+    row_crc = {}
+    for row in range(ROWS):
+        i0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+        i0.set_data_from_numpy((base + row).astype(np.int32))
+        i1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+        i1.set_data_from_numpy(np.full((1, 16), 3, dtype=np.int32))
+        r = client.infer("simple", [i0, i1])
+        row_crc[row] = sum(
+            zlib.crc32(r.as_numpy(n).tobytes())
+            for n in ("OUTPUT0", "OUTPUT1"))
+    for i in range(PRODUCERS):
+        for k in range(PER):
+            expect += row_crc[(i + k) % ROWS]
+
+    procs = spawn_workers(srv.url, "simple", "/ci_fanin_dset", "ci_fanin",
+                          PRODUCERS, duration=0.0, count=PER,
+                          slot_count=8, slot_bytes=4096,
+                          key_prefix="/ci_fanin_ring")
+    stats = collect_workers(procs, timeout_s=240.0)
+    failed = [s for s in stats if "error" in s]
+    if failed:
+        sys.exit(f"fan-in producer processes failed: {failed}")
+    done = sum(s["completions"] for s in stats)
+    errs = sum(s["errors"] for s in stats)
+    if done != PRODUCERS * PER or errs:
+        sys.exit(f"fan-in completions {done}/{PRODUCERS * PER}, "
+                 f"errors {errs}: {stats}")
+    got = sum(s["crc"] for s in stats)
+    if got != expect:
+        sys.exit(f"fan-in outputs not byte-identical to HTTP: "
+                 f"crc {got} != {expect}")
+
+    events = json.load(urlopen(
+        f"http://{srv.url}/v2/events?category=shm_ring", timeout=10))
+    names = {e["name"] for e in events["events"]}
+    if "attach" not in names:
+        sys.exit(f"journal missing shm_ring attach: {names}")
+    # Scrape while the dataset is still registered so the byte gauge
+    # has a live child; reaper counters survive ring detach.
+    classic = urlopen(f"http://{srv.url}/metrics", timeout=10).read().decode()
+    om = urlopen(Request(f"http://{srv.url}/metrics", headers={
+        "Accept": "application/openmetrics-text"}), timeout=10).read().decode()
+    for fam in ("tpu_shm_dataset_bytes", "tpu_shm_dataset_refs_total",
+                "tpu_shm_reaper_sweeps_total", "tpu_shm_reaper_slots_total",
+                "tpu_shm_reaper_rings"):
+        if fam not in classic:
+            sys.exit(f"{fam} missing from /metrics")
+    with open(f"{out_dir}/metrics.txt", "w") as f:
+        f.write(classic)
+    with open(f"{out_dir}/metrics.om.txt", "w") as f:
+        f.write(om)
+    client.unregister_staged_dataset("ci_fanin")
+    client.close()
+    print(f"staged fan-in e2e ok: {PRODUCERS} producer processes, "
+          f"{done} completions byte-identical to HTTP, "
+          f"tpu_shm_dataset_*/tpu_shm_reaper_* rendered")
+finally:
+    if ds is not None:
+        ds.close(unlink=True)
+    srv.stop()
+    engine.shutdown()
+EOF
+[ $? -ne 0 ] && { echo "staged fan-in e2e FAILED"; rc=1; }
+python tools/promlint.py "$FANIN_DIR/metrics.txt" \
+    || { echo "promlint (fan-in classic) FAILED"; rc=1; }
+python tools/promlint.py --openmetrics "$FANIN_DIR/metrics.om.txt" \
+    || { echo "promlint (fan-in openmetrics) FAILED"; rc=1; }
+rm -rf "$FANIN_DIR"
+
+echo "=== stage 10/11: bench p99 regression gate ==="
 if [ -f BENCH_HISTORY.json ]; then
     python tools/bench_summary.py --check \
         || { echo "bench gate FAILED"; rc=1; }
@@ -678,14 +790,15 @@ else
     echo "no BENCH_HISTORY.json — skipping"
 fi
 
-echo "=== stage 10/10: static analysis + lockdep gate ==="
+echo "=== stage 11/11: static analysis + lockdep gate ==="
 python -m tools.analyze --baseline tools/analyze/baseline.json \
     || { echo "tpulint FAILED"; rc=1; }
 python tools/promlint.py --definitions client_tpu \
     || { echo "promlint --definitions FAILED"; rc=1; }
 CLIENT_TPU_LOCKDEP=1 timeout -k 10 600 python -m pytest -q \
     tests/test_lockdep.py tests/test_engine.py tests/test_generative.py \
-    tests/test_shm_ring.py tests/test_flight_recorder.py \
+    tests/test_shm_ring.py tests/test_shm_fanin.py \
+    tests/test_flight_recorder.py \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 [ $? -ne 0 ] && { echo "lockdep-enabled concurrency subset FAILED"; rc=1; }
 

@@ -1,0 +1,315 @@
+#!/usr/bin/env python
+"""Shadow-traffic replay harness over the many-producer shm fan-in plane.
+
+Replays rows of a staged dataset (``client_tpu.utils.shm_ring.staged``)
+against a live server from N **real producer processes**, each with its
+own SPSC ring in reaped mode — the engine-side reaper multiplexes the
+rings; no doorbell round trips.  Traffic is stamped with the shadow
+priority (``CLIENT_TPU_REPLAY_PRIORITY``, default 8) so an engine
+running with an admission ``shadow_priority`` threshold classes it
+shadow: replay sheds first and live p99 stays intact.
+
+Coordinator (the command you run)::
+
+    python -m tools.replay http://127.0.0.1:8000 --model simple \
+        --build --rows 256 --producers 8 --duration 10
+
+builds (or attaches, without ``--build``) the staged segment, registers
+it with the server, spawns the producers by re-invoking this module
+with ``--worker``, and prints ONE aggregate JSON line on stdout::
+
+    {"producers": 8, "completions": ..., "errors": ..., "ips": ...,
+     "duration_s": ..., "priority": 8, "per_producer": [...]}
+
+The dataset's tensor names must match the model's input names — with
+``--build`` the tensors are synthesized from the server's model
+metadata (deterministic, seeded), which guarantees it.  Without
+``--build`` the segment named by ``--dataset-key`` (default
+``CLIENT_TPU_STAGED_PATH``) must already exist, e.g. staged by a
+capture pipeline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+from client_tpu import config as envcfg
+
+
+def _log(msg: str) -> None:
+    print(f"[replay] {msg}", file=sys.stderr, flush=True)
+
+
+def synth_dataset_tensors(metadata: dict, rows: int, seed: int = 0) -> dict:
+    """Deterministic replay tensors from ``/v2/models/<m>`` metadata:
+    one staged tensor per model input, named after it, ``rows`` rows of
+    the input's batch-1 shape stacked on axis 0."""
+    import numpy as np
+
+    from client_tpu.protocol.dtypes import wire_to_np_dtype
+
+    rng = np.random.default_rng(seed)
+    tensors: dict = {}
+    for inp in metadata.get("inputs", []):
+        dtype = np.dtype(wire_to_np_dtype(inp["datatype"]))
+        dims = [int(d) for d in inp["shape"]]
+        # Metadata shape leads with the batch axis; the staged row axis
+        # replaces it, so resolve(row, row_count=1) hands the engine a
+        # batch-1 tensor of the remaining dims.
+        shape = [rows] + dims[1:]
+        if dtype.kind in "iu":
+            arr = rng.integers(0, 100, size=shape).astype(dtype)
+        elif dtype.kind == "b":
+            arr = (rng.integers(0, 2, size=shape) > 0)
+        else:
+            arr = rng.standard_normal(shape).astype(dtype)
+        tensors[inp["name"]] = arr
+    if not tensors:
+        raise SystemExit(f"model '{metadata.get('name')}' reports no "
+                         "inputs — nothing to stage")
+    return tensors
+
+
+def run_worker(args) -> int:
+    """One producer process: attach the staged dataset, create a reaped
+    ring, replay rows until ``--duration`` (or ``--count`` requests),
+    drain, print one JSON stats line."""
+    import client_tpu.http as httpclient
+    from client_tpu.utils.shm_ring import RingProducer, staged_inputs_meta
+    from client_tpu.utils.shm_ring.staged import StagedDataset
+
+    ds = StagedDataset.attach(args.dataset_key)
+    names = [m["name"] for m in ds.manifest]
+    rows = min(ds.rows(n) for n in names)
+    refs = lambda row: {n: (n, row, 1) for n in names}  # noqa: E731
+    spec = {
+        "model_name": args.model,
+        "inputs": staged_inputs_meta(refs(0)),
+        "dataset": args.dataset_name,
+    }
+    if args.priority:
+        spec["priority"] = args.priority
+    client = httpclient.InferenceServerClient(args.url)
+    sent = completions = errors = crc = 0
+    t0 = time.monotonic()
+    deadline = t0 + args.duration if args.duration > 0 else None
+    try:
+        with RingProducer(client, args.ring_name, args.ring_key,
+                          slot_count=args.slot_count,
+                          slot_bytes=args.slot_bytes,
+                          dataset=ds, dataset_name=args.dataset_name,
+                          spec=spec) as prod:
+            row = args.index  # stagger producers across the dataset
+            while True:
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+                if args.count and sent >= args.count:
+                    break
+                if prod.fill_staged(refs(row % rows)) is None:
+                    before = errors
+                    completions, errors, crc = _reap_one(
+                        prod, completions, errors, crc)
+                    if errors > before:
+                        # Shed (admission rejected the slot): back off
+                        # instead of retry-storming — a shadow class only
+                        # protects live traffic if the replayer yields
+                        # when told to.
+                        time.sleep(args.shed_backoff)
+                    continue
+                sent += 1
+                row += 1
+            while prod.outstanding:
+                completions, errors, crc = _reap_one(
+                    prod, completions, errors, crc)
+    finally:
+        client.close()
+        ds.close()
+    elapsed = time.monotonic() - t0
+    print(json.dumps({
+        "ring": args.ring_name, "sent": sent, "completions": completions,
+        "errors": errors, "crc": crc, "elapsed_s": round(elapsed, 3),
+        "ips": round(completions / elapsed, 1) if elapsed > 0 else 0.0,
+    }), flush=True)
+    return 0
+
+
+def _reap_one(prod, completions: int, errors: int, crc: int):
+    """Reap the oldest completion, folding its output bytes into the
+    order-independent parity checksum (sum of per-tensor CRC32s — what
+    the byte-parity tests compare against the HTTP path)."""
+    import zlib
+
+    _, outputs, err = prod.reap(timeout_s=30.0)
+    if err:
+        return completions + 1, errors + 1, crc
+    for name in sorted(outputs or {}):
+        crc += zlib.crc32(outputs[name].tobytes())
+    return completions + 1, errors, crc
+
+
+def spawn_workers(url: str, model: str, dataset_key: str,
+                  dataset_name: str, producers: int, *,
+                  duration: float = 0.0, count: int = 0,
+                  priority: int = 0, slot_count: int = 64,
+                  slot_bytes: int = 1 << 16,
+                  key_prefix: str | None = None) -> list[subprocess.Popen]:
+    """Start the producer subprocesses (importable — bench/ci reuse).
+    Each worker is a REAL process re-invoking this module with
+    ``--worker``; collect them with :func:`collect_workers`."""
+    prefix = key_prefix or f"/replay_{os.getpid()}"
+    procs = []
+    for i in range(producers):
+        cmd = [sys.executable, "-m", "tools.replay", url, "--worker",
+               "--model", model, "--dataset-key", dataset_key,
+               "--dataset-name", dataset_name,
+               "--ring-name", f"{dataset_name}_r{i}",
+               "--ring-key", f"{prefix}_r{i}", "--index", str(i),
+               "--priority", str(priority), "--duration", str(duration),
+               "--count", str(count), "--slot-count", str(slot_count),
+               "--slot-bytes", str(slot_bytes)]
+        procs.append(subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    return procs
+
+
+def collect_workers(procs: list[subprocess.Popen],
+                    timeout_s: float = 120.0) -> list[dict]:
+    """Join the producer subprocesses and parse their JSON stat lines.
+    A worker that died or printed garbage contributes an ``{"error"}``
+    record instead of silently vanishing from the aggregate."""
+    stats = []
+    deadline = time.monotonic() + timeout_s
+    for p in procs:
+        try:
+            out, _ = p.communicate(
+                timeout=max(1.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        line = (out or b"").decode("utf-8", "replace").strip()
+        try:
+            rec = json.loads(line.splitlines()[-1]) if line else {}
+        except ValueError:
+            rec = {}
+        if p.returncode != 0 or not rec:
+            rec = dict(rec, error=f"worker exit {p.returncode}")
+        stats.append(rec)
+    return stats
+
+
+def run_coordinator(args) -> int:
+    import client_tpu.http as httpclient
+    from client_tpu.utils.shm_ring.staged import (StagedDataset,
+                                                  build_staged_dataset)
+
+    dataset_key = args.dataset_key or envcfg.env_str("CLIENT_TPU_STAGED_PATH")
+    if not dataset_key:
+        raise SystemExit("no staged dataset key: pass --dataset-key or set "
+                         "CLIENT_TPU_STAGED_PATH")
+    client = httpclient.InferenceServerClient(args.url)
+    ds = None
+    registered = False
+    try:
+        if args.build:
+            meta = client.get_model_metadata(args.model)
+            ds = build_staged_dataset(
+                dataset_key,
+                synth_dataset_tensors(meta, args.rows, seed=args.seed))
+            _log(f"built staged dataset {dataset_key!r}: "
+                 f"{len(ds.manifest)} tensors x {args.rows} rows")
+        else:
+            ds = StagedDataset.attach(dataset_key)
+            _log(f"attached staged dataset {dataset_key!r}: "
+                 f"{len(ds.manifest)} tensors")
+        client.register_staged_dataset(args.dataset_name, dataset_key)
+        registered = True
+        t0 = time.monotonic()
+        procs = spawn_workers(
+            args.url, args.model, dataset_key, args.dataset_name,
+            args.producers, duration=args.duration, count=args.count,
+            priority=args.priority, slot_count=args.slot_count,
+            slot_bytes=args.slot_bytes)
+        per = (f"{args.duration:.1f}s" if args.duration
+               else f"{args.count} requests")
+        _log(f"{len(procs)} producer processes live "
+             f"(priority {args.priority}, {per} each)")
+        stats = collect_workers(
+            procs, timeout_s=max(120.0, args.duration * 4))
+        elapsed = time.monotonic() - t0
+    finally:
+        if registered:
+            try:
+                client.unregister_staged_dataset(args.dataset_name)
+            # tpulint: allow[swallowed-exception] reviewed fail-open
+            except Exception:
+                pass
+        if ds is not None:
+            ds.close(unlink=args.build)
+        client.close()
+    failed = [s for s in stats if "error" in s]
+    summary = {
+        "producers": args.producers,
+        "completions": sum(s.get("completions", 0) for s in stats),
+        "errors": sum(s.get("errors", 0) for s in stats) + len(failed),
+        "ips": round(sum(s.get("ips", 0.0) for s in stats), 1),
+        "crc": sum(s.get("crc", 0) for s in stats),
+        "duration_s": round(elapsed, 3),
+        "priority": args.priority,
+        "per_producer": stats,
+    }
+    print(json.dumps(summary), flush=True)
+    return 1 if failed else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("url", help="server base URL, e.g. http://127.0.0.1:8000")
+    p.add_argument("--model", required=True)
+    p.add_argument("--dataset-key", default="",
+                   help="staged segment shm key (default: "
+                        "CLIENT_TPU_STAGED_PATH)")
+    p.add_argument("--dataset-name", default="replay",
+                   help="server-side registration name")
+    p.add_argument("--build", action="store_true",
+                   help="synthesize the dataset from model metadata "
+                        "instead of attaching an existing segment")
+    p.add_argument("--rows", type=int, default=256,
+                   help="rows per tensor with --build")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--producers", type=int, default=8)
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="seconds each producer replays (0 = use --count)")
+    p.add_argument("--count", type=int, default=0,
+                   help="requests per producer (with --duration 0)")
+    p.add_argument("--priority", type=int,
+                   default=envcfg.env_int("CLIENT_TPU_REPLAY_PRIORITY"),
+                   help="InferRequest priority stamped on replay traffic "
+                        "(default: CLIENT_TPU_REPLAY_PRIORITY)")
+    p.add_argument("--slot-count", type=int, default=64)
+    p.add_argument("--slot-bytes", type=int, default=1 << 16)
+    p.add_argument("--shed-backoff", type=float, default=0.05,
+                   help="seconds a producer sleeps after a shed "
+                        "completion before refilling")
+    # internal: producer-subprocess mode
+    p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--ring-name", default="", help=argparse.SUPPRESS)
+    p.add_argument("--ring-key", default="", help=argparse.SUPPRESS)
+    p.add_argument("--index", type=int, default=0, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+    if args.worker:
+        if not (args.ring_name and args.ring_key and args.dataset_key):
+            p.error("--worker needs --ring-name/--ring-key/--dataset-key")
+        return run_worker(args)
+    if args.duration <= 0 and args.count <= 0:
+        p.error("one of --duration/--count must be positive")
+    return run_coordinator(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
