@@ -270,14 +270,16 @@ class AdmissionController:
 
     def admit(self, model: str, version: str = "",
               queue_depth: int = 0, instances: int = 1,
-              trace_id: str | None = None, priority: int = 0) -> None:
+              trace_id: str | None = None, priority: int = 0,
+              tenant: str = "") -> None:
         """Admit or shed one request; raises :class:`AdmissionError` on
         shed. ``queue_depth`` is the model's current scheduler backlog and
         ``instances`` its worker count (for the estimated-wait check).
         ``trace_id`` correlates a shed with the rejected request's trace
         in the event journal. ``priority`` selects the admission class:
         at/above ``shadow_priority`` the stricter shadow gates apply
-        first, so replay traffic sheds before it can queue behind live."""
+        first, so replay traffic sheds before it can queue behind live.
+        ``tenant`` attributes a shed on the metrics/ledger side."""
         gate = self._gate(model)
         cfg = gate.cfg
         if cfg.shadow_priority > 0 and priority >= cfg.shadow_priority:
@@ -288,7 +290,7 @@ class AdmissionController:
                     f"cap ({gate.shadow_inflight}/"
                     f"{cfg.shadow_max_inflight} in flight)",
                     retry_after_s=gate.ewma_service_s or MIN_RETRY_AFTER_S,
-                    reason="shadow"), trace_id=trace_id)
+                    reason="shadow"), trace_id=trace_id, tenant=tenant)
             if cfg.shadow_max_queue_depth > 0 \
                     and queue_depth >= cfg.shadow_max_queue_depth:
                 est = self._estimated_wait_s(gate, queue_depth, instances)
@@ -297,7 +299,7 @@ class AdmissionController:
                     f"the shadow shed limit "
                     f"({cfg.shadow_max_queue_depth})",
                     retry_after_s=est, reason="shadow"),
-                    trace_id=trace_id)
+                    trace_id=trace_id, tenant=tenant)
         if cfg.max_inflight > 0 and gate.inflight >= cfg.max_inflight:
             # Pushback ~ one service interval: a slot frees when the
             # oldest in-flight request completes.
@@ -305,20 +307,20 @@ class AdmissionController:
                 f"model '{model}' is at its concurrency cap "
                 f"({gate.inflight}/{cfg.max_inflight} in flight)",
                 retry_after_s=gate.ewma_service_s or MIN_RETRY_AFTER_S,
-                reason="concurrency"), trace_id=trace_id)
+                reason="concurrency"), trace_id=trace_id, tenant=tenant)
         if gate.bucket is not None and not gate.bucket.try_acquire():
             self._reject(model, version, "throttled", AdmissionError(
                 f"model '{model}' request rate exceeds "
                 f"{cfg.tokens_per_s:g}/s (burst {gate.bucket.burst:g})",
                 retry_after_s=gate.bucket.retry_after_s(),
-                reason="throttled"), trace_id=trace_id)
+                reason="throttled"), trace_id=trace_id, tenant=tenant)
         if cfg.max_queue_depth > 0 and queue_depth >= cfg.max_queue_depth:
             est = self._estimated_wait_s(gate, queue_depth, instances)
             self._reject(model, version, "queue_depth", AdmissionError(
                 f"model '{model}' queue depth {queue_depth} is at the "
                 f"shed limit ({cfg.max_queue_depth}); estimated wait "
                 f"{est:.3f}s", retry_after_s=est, reason="queue_depth"),
-                trace_id=trace_id)
+                trace_id=trace_id, tenant=tenant)
         if cfg.max_estimated_wait_s > 0:
             est = self._estimated_wait_s(gate, queue_depth, instances)
             if est > cfg.max_estimated_wait_s:
@@ -330,7 +332,7 @@ class AdmissionController:
                                  retry_after_s=est - cfg.max_estimated_wait_s
                                  + MIN_RETRY_AFTER_S,
                                  reason="estimated_wait"),
-                             trace_id=trace_id)
+                             trace_id=trace_id, tenant=tenant)
 
     @staticmethod
     def _estimated_wait_s(gate: _ModelGate, queue_depth: int,
@@ -339,34 +341,44 @@ class AdmissionController:
         return queue_depth * service / max(1, instances)
 
     def _reject(self, model: str, version: str, reason: str,
-                exc: AdmissionError, trace_id: str | None = None):
+                exc: AdmissionError, trace_id: str | None = None,
+                tenant: str = ""):
         self._count_shed(model, version, reason,
                          retry_after_s=exc.retry_after_s,
-                         trace_id=trace_id)
+                         trace_id=trace_id, tenant=tenant)
         raise exc
 
     def record_rejection(self, model: str, version: str = "",
                          reason: str = "draining",
-                         trace_id: str | None = None) -> None:
+                         trace_id: str | None = None,
+                         tenant: str = "") -> None:
         """Count a shed decided outside :meth:`admit` (e.g. the engine's
         drain gate) on the same counter and DEGRADED clock."""
-        self._count_shed(model, version, reason, trace_id=trace_id)
+        self._count_shed(model, version, reason, trace_id=trace_id,
+                         tenant=tenant)
 
     def _count_shed(self, model: str, version: str, reason: str,
                     retry_after_s: float | None = None,
-                    trace_id: str | None = None) -> None:
+                    trace_id: str | None = None,
+                    tenant: str = "") -> None:
         with self._lock:
             self.rejection_count += 1
             self._last_shed = self._clock()
             entered = not self._degraded_state
             self._degraded_state = True
+        tenant = tenant or "default"
         if self._metrics is not None:
             self._metrics.admission_rejections.inc(
                 model=model, version=str(version or "latest"),
-                reason=reason)
+                reason=reason, tenant=tenant, exemplar=trace_id)
+        # Lazy, like _journal(): count the shed on the cost ledger's
+        # interference taxonomy (the `admission` leg).
+        from client_tpu.observability.costs import ledger
+
+        ledger().note_shed(model, version or "latest", tenant, reason)
         jour = self._journal()
         if jour is not None:
-            detail = {"reason": reason}
+            detail = {"reason": reason, "tenant": tenant}
             if retry_after_s is not None:
                 detail["retry_after_s"] = round(retry_after_s, 4)
             jour.emit("admission", "shed", severity="WARNING",

@@ -194,6 +194,12 @@ register(
 
 # -- observability -----------------------------------------------------------
 register(
+    "CLIENT_TPU_COSTS", "", "json",
+    "Per-tenant cost ledger (GET /v2/costs, tpu_cost_* metrics): `0`/"
+    "`off` disables; unset/`1`/`on` defaults; else inline JSON or "
+    "`@/path.json` (window_s, max_tenants, tenants, top_talker_*).",
+    "observability")
+register(
     "CLIENT_TPU_EVENT_BUFFER", "1024", "int",
     "Capacity of the operational event-journal ring (GET /v2/events).",
     "observability")
@@ -228,6 +234,11 @@ register(
     "InferRequest priority tools/replay.py stamps on shadow traffic; at "
     "or above the admission `shadow_priority` threshold the request is "
     "classed shadow and sheds first.",
+    "shm")
+register(
+    "CLIENT_TPU_REPLAY_TENANT", "shadow", "str",
+    "Cost-ledger tenant tag tools/replay.py stamps on its shm traffic "
+    "(`--tenant` overrides) so shadow device/HBM spend is attributable.",
     "shm")
 register(
     "CLIENT_TPU_SHM_REAPER_INTERVAL_MS", "1.0", "float",

@@ -117,6 +117,15 @@ class TpuEngine:
 
         self.profiler = _profiler()
         self.profiler.bind_metrics(self.metrics.registry)
+        # Cost ledger (process-global, same pattern): schedulers charge
+        # tenant-tagged device/queue/HBM time into it from below; binding
+        # exports tpu_cost_device_seconds_total / tpu_cost_queue_seconds_
+        # total / tpu_cost_hbm_byte_seconds_total /
+        # tpu_cost_interference_seconds_total here.
+        from client_tpu.observability.costs import ledger as _ledger
+
+        self.costs = _ledger()
+        self.costs.bind_metrics(self.metrics.registry)
         # HBM census (process-global: load paths tag buffers from below
         # the engine) + the flight recorder. The recorder holds this
         # engine weakly and samples timeseries_sample() at 1 Hz; with
@@ -129,7 +138,8 @@ class TpuEngine:
         self.recorder = _recorder()
         # Per-signal sampler state (fill EWMA, shed-counter deltas);
         # touched only from the recorder thread.
-        self._ts_state: dict = {"fill": {}, "shed": {}, "mono": None}
+        self._ts_state: dict = {"fill": {}, "shed": {}, "tenant_cost": {},
+                                "mono": None}
         self.recorder.attach(self)
         self._last_health: str | None = None
         # (mono_timestamp, LoadReport) pair behind load_report(): the
@@ -478,10 +488,19 @@ class TpuEngine:
         from client_tpu.admission import AdmissionError
 
         trace_id = req.trace.trace_id if req.trace is not None else None
+        # Resolve the cost-ledger tenant tag before any shed can fire, so
+        # rejections are attributable: untagged requests fold to the
+        # admission shadow class ("shadow") or "default"; tagged ones are
+        # canonicalized into the bounded label space.
+        if not req.tenant:
+            req.tenant = "shadow" if self.admission.is_shadow(
+                req.model_name, req.priority) else "default"
+        else:
+            req.tenant = self.costs.canonical_tenant(req.tenant)
         if self._draining or not self._live:
             self.admission.record_rejection(
                 req.model_name, req.model_version, reason="draining",
-                trace_id=trace_id)
+                trace_id=trace_id, tenant=req.tenant)
             raise AdmissionError(
                 "server is draining; retry against another replica",
                 retry_after_s=1.0, reason="draining", status=503)
@@ -495,7 +514,7 @@ class TpuEngine:
         self.admission.admit(
             req.model_name, req.model_version,
             queue_depth=sched.queue.qsize(), instances=len(sched.workers),
-            trace_id=trace_id, priority=req.priority)
+            trace_id=trace_id, priority=req.priority, tenant=req.tenant)
         self._submit_accounted(sched, req)
 
     def _submit_accounted(self, sched: Scheduler, req: InferRequest) -> None:
@@ -744,6 +763,34 @@ class TpuEngine:
         """``GET /v2/slo`` body: per-model window counts and burn rates."""
         return self.slo.snapshot()
 
+    def costs_snapshot(self, model: str | None = None) -> dict:
+        """``GET /v2/costs`` body: the per-tenant cost ledger plus a
+        ``reconciliation`` section cross-checking the ledger's totals
+        against the efficiency profiler (device-seconds, windowed) and
+        the HBM census (live KV-arena bytes) — the independent meters
+        the conservation invariant is audited against."""
+        snap = self.costs.snapshot(model=model)
+        prof = self.profiler.snapshot(model=model)
+        prof_device = sum(e["device_s"]
+                          for e in prof.get("models", {}).values())
+        census = self.memory_census()
+        kv_bytes = sum(o["bytes"] for o in census.get("owners", ())
+                       if o.get("component") == "kv_arena"
+                       and (model is None or o.get("model") == model))
+        ledger_device = snap.get("totals", {}).get("device_s", 0.0)
+        snap["reconciliation"] = {
+            # Profiler device_s is a sliding window; the ledger is
+            # cumulative — comparable only while uptime < window_s, so
+            # both figures (and the window) ship and the caller decides.
+            "profiler_device_s": round(prof_device, 6),
+            "profiler_window_s": prof.get("window_s"),
+            "ledger_device_s": round(ledger_device, 6),
+            "device_s_ratio": round(ledger_device / prof_device, 4)
+            if prof_device > 0 else None,
+            "census_kv_arena_bytes": int(kv_bytes),
+        }
+        return snap
+
     # -- flight recorder / HBM census -----------------------------------------
 
     def timeseries_sample(self) -> dict:
@@ -812,6 +859,22 @@ class TpuEngine:
         state["shed"] = shed_totals
         if shed_rate:
             sample["shed_rate"] = shed_rate
+        # Per-tenant device spend rate (device-seconds per wall second =
+        # that tenant's share of device occupancy), from cost-ledger
+        # deltas. Keys are TENANTS, not models — the recorder's map
+        # machinery doesn't care, but readers should.
+        cost_rows = self.costs.snapshot().get("tenants", {})
+        cost_totals = {t: row["device_s"] + row["padding_s"]
+                       for t, row in cost_rows.items()}
+        cost_rate: dict[str, float] = {}
+        for tenant, total in cost_totals.items():
+            prev = state["tenant_cost"].get(tenant, 0.0)
+            if elapsed and elapsed > 0:
+                cost_rate[tenant] = round(
+                    max(0.0, total - prev) / elapsed, 6)
+        state["tenant_cost"] = cost_totals
+        if cost_rate:
+            sample["tenant_cost_rate"] = cost_rate
         # HBM: census actuals (live-array bytes stand in on platforms
         # without memory stats) vs the planner arena's reservations.
         devices = self.hbm_census.device_stats()

@@ -64,6 +64,7 @@ from client_tpu.engine.types import (
     InferResponse,
     now_ns,
 )
+from client_tpu.observability.costs import ledger
 
 _log = logging.getLogger("client_tpu")
 
@@ -266,6 +267,9 @@ class GenerativeScheduler(Scheduler):
         # from max(dispatch, previous fetch) to this fetch, so pipelined
         # waves are not double-counted (see _drain_fetches).
         self._last_fetch_ns = 0
+        # Per-row arena bytes for the cost ledger's HBM-byte-second
+        # charges, cached on first use (one pytree walk, static shapes).
+        self._row_bytes = 0.0
         super().__init__(model, stats)
 
     def arena_shards(self) -> int:
@@ -499,6 +503,10 @@ class GenerativeScheduler(Scheduler):
                 self._fail(req, EngineError(f"invalid request: {exc}", 400))
                 continue
             req.times.compute_start = now_ns()
+            ledger().charge_queue(
+                self.model.config.name, str(self.model.config.version),
+                req.tenant, req.times.queue_ns / 1e9,
+                trace_id=self._trace_id(req))
             ready.append((req, ids, max_new, sampling))
         by_bucket: dict[int, list] = {}
         for entry in ready:
@@ -682,6 +690,24 @@ class GenerativeScheduler(Scheduler):
                     self.model.config.name, self.model.config.version,
                     bucket=head.bucket, chunk=head.waves,
                     duration_ns=busy_ns, waves=head.waves)
+                # Cost ledger: the wave's device occupancy splits evenly
+                # across live lanes (every stream advances one token per
+                # wave regardless of context length); padded lanes charge
+                # the wave's dominant tenant as padding waste. A junk
+                # wave (every lane retired while it was in flight) bills
+                # its dispatch-time streams instead — they caused the
+                # speculative dispatch, and conservation against the
+                # profiler requires every recorded wave to be charged.
+                live = [s for s in head.streams if not s.dead] \
+                    or list(head.streams)
+                if live:
+                    ledger().charge_batch(
+                        self.model.config.name,
+                        str(self.model.config.version),
+                        [(s.req.tenant, 1, None) for s in live],
+                        busy_ns / 1e9,
+                        padded=max(0, head.bucket - len(live)),
+                        component="wave")
             self._last_fetch_ns = t_done
             # A chunked fetch is K stacked waves [K, B]; emit them in wave
             # order so stop/budget retirement lands mid-chunk exactly
@@ -730,13 +756,31 @@ class GenerativeScheduler(Scheduler):
         if s in self._streams:
             self._streams.remove(s)
         self._free.append(s.row)
+        # Cost ledger: KV-arena residency — this stream held one arena row
+        # from admission until now, excluding nothing (a row blocked for
+        # the whole generation is the scarce resource being attributed).
+        held_ns = now_ns() - s.req.times.compute_start
+        if held_ns > 0 and s.req.times.compute_start:
+            ledger().charge_hbm(
+                self.model.config.name, str(self.model.config.version),
+                s.req.tenant, held_ns / 1e9 * self._row_nbytes(),
+                trace_id=self._trace_id(s.req))
+
+    def _row_nbytes(self) -> float:
+        """Per-row KV arena bytes, cached (the arena is static-shaped, so
+        one pytree walk amortises over every stream release)."""
+        if not self._row_bytes:
+            rows = len(self._rows_init) + 1  # usable rows + dummy lane
+            self._row_bytes = self.arena_nbytes() / max(1, rows)
+        return self._row_bytes
 
     def _retire(self, s: _Stream) -> None:
         self._drop(s)
         s.req.times.compute_input_end = s.req.times.compute_start
         s.req.times.compute_infer_end = now_ns()
         s.req.times.compute_output_end = s.req.times.compute_infer_end
-        self.stats.record_request(s.req.times, success=True)
+        self.stats.record_request(s.req.times, success=True,
+                                  tenant=s.req.tenant)
         self._respond(s.req, InferResponse(
             model_name=s.req.model_name,
             model_version=s.req.model_version or

@@ -37,6 +37,7 @@ from client_tpu.engine.scheduler import (
 )
 from client_tpu.engine.stats import ModelStats
 from client_tpu.engine.types import InferRequest, now_ns
+from client_tpu.observability.costs import ledger
 
 
 def request_nnz(req: InferRequest, indices_name: str) -> int:
@@ -147,6 +148,21 @@ class RaggedScheduler(DefaultScheduler):
         # requests' rows, same as every other scheduler).
         self.stats.record_execution(
             total_rows, compute_ns=phases.infer_end - phases.input_end)
+        # Cost ledger: split device time by LOOKUP weight (the padded
+        # axis — a 900-lookup bag costs 9x a 100-lookup bag on the same
+        # executable); padding to the lookup bucket charges the dominant
+        # tenant, with the profiler's cold-call exclusion mirrored.
+        if not getattr(phases, "compile_ns", 0):
+            cfg = self.model.config
+            bucket = self.model.pick_bucket(total_nnz)
+            device_ns = max(0, phases.infer_end - phases.input_end)
+            ledger().charge_batch(
+                cfg.name, str(cfg.version),
+                [(r.tenant, request_nnz(r, self._indices),
+                  self._trace_id(r)) for r in batch],
+                device_ns / 1e9,
+                padded=max(0, bucket - total_nnz),
+                host_s=max(0, now_ns() - start - device_ns) / 1e9)
         # Outputs are row-shaped (the backend pads rows statically to
         # max_batch_size; rows past total_rows are padding junk): window
         # each request's rows by ROW offset, not lookup offset.

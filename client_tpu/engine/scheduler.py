@@ -22,6 +22,7 @@ import numpy as np
 from client_tpu import faults
 from client_tpu.engine.model import Model
 from client_tpu.engine.stats import ModelStats
+from client_tpu.observability.costs import ledger
 from client_tpu.engine.types import (
     DeadlineExpired,
     EngineError,
@@ -245,7 +246,12 @@ class Scheduler:
             with self._order_lock:
                 req.arrival_seq = self._arrival_seq
                 self._arrival_seq += 1
-        if not self.queue.put(req, level, max_level_size=max_size):
+        queued = self.queue.put(req, level, max_level_size=max_size)
+        if queued:
+            # Cost ledger: record the arrival into the model's tenant mix
+            # (feeds the queue_wait interference split at dequeue).
+            ledger().note_queued(self.model.config.name, req.tenant)
+        else:
             self.stats.record_rejection()
             if self._preserve_ordering:
                 # The rejected request's arrival slot must not dam the
@@ -597,12 +603,37 @@ class DefaultScheduler(Scheduler):
                            for k, v in outputs.items()}
                     offset += sz
                     self._finish(r, per, phases)
+            # Cost ledger: split the measured device time across members
+            # by real rows; the padded remainder is charged to the
+            # batch's dominant tenant. Same device_ns (and the same
+            # cold-call exclusion) as the profiler accumulates, so
+            # per-tenant sums stay conserved against its totals. Charged
+            # after the response scatter so the host leg — batch wall
+            # net of the device interval: input assembly, dispatch
+            # overhead, response scatter — is complete.
+            if not getattr(phases, "compile_ns", 0):
+                bucket = self.model.pick_bucket(total)
+                device_ns = max(0, phases.infer_end - phases.input_end)
+                ledger().charge_batch(
+                    cfg.name, str(cfg.version),
+                    [(r.tenant, sz, self._trace_id(r))
+                     for r, sz in zip(batch, sizes)],
+                    device_ns / 1e9,
+                    padded=max(0, bucket - total),
+                    host_s=max(0, now_ns() - start - device_ns) / 1e9)
         else:
             outputs, phases = self.model.execute_timed(
                 batch[0].inputs, batch_size=None, deadline_ns=deadline_ns)
             self.stats.record_execution(
                 1, compute_ns=phases.infer_end - phases.input_end)
             self._finish(batch[0], outputs, phases)
+            if not getattr(phases, "compile_ns", 0):
+                device_ns = max(0, phases.infer_end - phases.input_end)
+                ledger().charge_batch(
+                    cfg.name, str(cfg.version),
+                    [(batch[0].tenant, 1, self._trace_id(batch[0]))],
+                    device_ns / 1e9,
+                    host_s=max(0, now_ns() - start - device_ns) / 1e9)
 
     def _finish(self, req: InferRequest, outputs: dict, phases) -> None:
         # Measured phase boundaries from Model.execute_timed: host batch
@@ -619,8 +650,13 @@ class DefaultScheduler(Scheduler):
         if req.outputs:
             requested = {o.name for o in req.outputs}
             outputs = {k: v for k, v in outputs.items() if k in requested}
+        ledger().charge_queue(
+            self.model.config.name, str(self.model.config.version),
+            req.tenant, req.times.queue_ns / 1e9,
+            trace_id=self._trace_id(req))
         self.stats.record_request(req.times, success=True,
-                                  trace_id=self._trace_id(req))
+                                  trace_id=self._trace_id(req),
+                                  tenant=req.tenant)
         self._respond(
             req,
             InferResponse(
@@ -695,8 +731,16 @@ class DecoupledScheduler(Scheduler):
         req.times.compute_output_end = req.times.compute_infer_end
         self.stats.record_execution(max(1, count),
                                     compute_ns=req.times.compute_infer_ns)
+        # Decoupled repeat backends run on host (no device executable),
+        # so only queue wait is charged — inventing device-seconds here
+        # would break conservation against the profiler.
+        ledger().charge_queue(
+            self.model.config.name, str(self.model.config.version),
+            req.tenant, req.times.queue_ns / 1e9,
+            trace_id=self._trace_id(req))
         self.stats.record_request(req.times, success=True,
-                                  trace_id=self._trace_id(req))
+                                  trace_id=self._trace_id(req),
+                                  tenant=req.tenant)
         self._emit(req, {}, final=True)
 
     def _emit(self, req: InferRequest, outputs: dict, final: bool) -> None:

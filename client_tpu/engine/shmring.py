@@ -542,6 +542,7 @@ class RingShmManager:
             "out_names": list(spec.get("outputs") or []),
             "timeout_ms": float(spec.get("timeout_ms", 0) or 0),
             "priority": int(spec.get("priority", 0) or 0),
+            "tenant": str(spec.get("tenant", "") or ""),
             "dataset": dataset,
         }
 
@@ -643,6 +644,7 @@ class RingShmManager:
                     resolve=self._resolver(parsed["dataset"])),
                 outputs=[OutputRequest(n) for n in parsed["out_names"]],
                 priority=parsed["priority"],
+                tenant=parsed["tenant"],
             )
             if parsed["timeout_ms"]:
                 req.set_deadline_from_timeout_ms(parsed["timeout_ms"])

@@ -70,7 +70,8 @@ class ModelStats:
 
     def record_request(self, times: RequestTimes, success: bool,
                        total_ns: int | None = None,
-                       trace_id: str | None = None) -> None:
+                       trace_id: str | None = None,
+                       tenant: str = "") -> None:
         with self._lock:
             total = total_ns if total_ns is not None else (
                 times.compute_output_end - times.queue_start)
@@ -87,7 +88,8 @@ class ModelStats:
                 self.fail.add(max(0, total))
         if success and self.instruments is not None:
             self.instruments.observe_request(max(0, total), times,
-                                             trace_id=trace_id)
+                                             trace_id=trace_id,
+                                             tenant=tenant)
         if self.slo is not None:
             self.slo.record(self.model_name, success,
                             duration_us=max(0, total) / 1e3)

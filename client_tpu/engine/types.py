@@ -98,6 +98,11 @@ class InferRequest:
     sequence_start: bool = False
     sequence_end: bool = False
     priority: int = 0
+    # Cost-ledger tenant tag (observability.costs): set by frontends from
+    # the `X-Tpu-Tenant` HTTP header / `tenant` request parameter / shm
+    # slot header. Empty means untagged — the engine resolves it to
+    # "shadow" (admission shadow class) or "default" at submit.
+    tenant: str = ""
     # Assigned by the scheduler under preserve_ordering (arrival index).
     arrival_seq: int | None = None
     timeout_us: int = 0

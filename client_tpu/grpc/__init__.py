@@ -636,6 +636,19 @@ class InferenceServerClient:
             self._md(headers), client_timeout)
         return json.loads(response.memory_json)
 
+    def get_costs(self, model_name="", headers=None, client_timeout=None):
+        """Per-tenant cost ledger (gRPC mirror of ``GET /v2/costs``):
+        device/HBM/queue seconds and interference attribution per
+        tenant. Tag requests with a ``tenant`` request parameter to
+        attribute their spend."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        response = self._unary(
+            self._client_stub.Costs,
+            ops.CostsRequest(model=model_name),
+            self._md(headers), client_timeout)
+        return json.loads(response.costs_json)
+
     # -- fleet observability (client-side federation) -------------------------
     # gRPC has no fronting router, so the multi-URL client federates the
     # per-endpoint surfaces itself with the same merge semantics the
@@ -686,6 +699,19 @@ class InferenceServerClient:
                 stub.SloStatus, ops.SloStatusRequest(model=""),
                 self._md(headers), client_timeout).slo_json))
         return merge_slo(exports, errors)
+
+    def get_fleet_costs(self, headers=None, client_timeout=None):
+        """Per-endpoint cost-ledger snapshots plus fleet-wide per-tenant
+        totals (the client-side analogue of the router's
+        ``GET /v2/fleet/costs``)."""
+        from client_tpu.observability.fleet import merge_costs
+        from client_tpu.protocol import ops_pb2 as ops
+
+        exports, errors = self._fleet_fan_out(
+            lambda stub: json.loads(self._unary(
+                stub.Costs, ops.CostsRequest(model=""),
+                self._md(headers), client_timeout).costs_json))
+        return merge_costs(exports, errors)
 
     def get_fleet_timeseries(self, signal="", model_name="", limit=None,
                              headers=None, client_timeout=None):

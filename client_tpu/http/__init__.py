@@ -919,6 +919,17 @@ class InferenceServerClient:
         ``(model, component)`` owner, plan-vs-actual drift, watermark."""
         return self._get_json("/v2/memory", query_params, headers)
 
+    def get_costs(self, model_name="", headers=None, query_params=None):
+        """Per-tenant cost ledger (``GET /v2/costs``): device-seconds,
+        HBM-byte-seconds, queue-seconds, and interference attribution
+        per tenant, with profiler/census reconciliation. Tag requests
+        with the ``X-Tpu-Tenant`` header or a ``tenant`` request
+        parameter to attribute their spend."""
+        qp = dict(query_params or {})
+        if model_name:
+            qp["model"] = model_name
+        return self._get_json("/v2/costs", qp or None, headers)
+
     # -- fleet observability (router endpoints) ------------------------------
 
     def get_fleet_events(self, limit=None, headers=None, query_params=None):
@@ -939,6 +950,11 @@ class InferenceServerClient:
     def get_fleet_slo(self, headers=None, query_params=None):
         """Federated SLO view (router ``GET /v2/fleet/slo``)."""
         return self._get_json("/v2/fleet/slo", query_params, headers)
+
+    def get_fleet_costs(self, headers=None, query_params=None):
+        """Federated cost-ledger view (router ``GET /v2/fleet/costs``):
+        per-replica snapshots plus fleet-wide per-tenant totals."""
+        return self._get_json("/v2/fleet/costs", query_params, headers)
 
     def get_fleet_timeseries(self, signal="", model_name="", limit=None,
                              headers=None, query_params=None):

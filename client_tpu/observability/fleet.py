@@ -43,6 +43,7 @@ __all__ = [
     "FleetMonitorConfig",
     "drift_scores",
     "fleet_median",
+    "merge_costs",
     "merge_events",
     "merge_expositions",
     "merge_profiles",
@@ -302,6 +303,44 @@ def merge_slo(exports: dict[str, dict],
     return {
         "replicas": exports,
         "worst": worst,
+        "errors": dict(errors or {}),
+    }
+
+
+def merge_costs(exports: dict[str, dict],
+                errors: dict[str, str] | None = None) -> dict:
+    """Fleet cost ledger: per-replica snapshots keyed by replica, plus
+    fleet-wide per-tenant totals (device/padding/queue/HBM seconds sum
+    across replicas — each replica meters its own device) and the
+    fleet's loudest top-talker."""
+    tenants: dict[str, dict] = {}
+    totals = {"device_s": 0.0, "padding_s": 0.0, "queue_s": 0.0,
+              "hbm_byte_s": 0.0, "requests": 0}
+    worst = {"replica": None, "tenant": None, "share": 0.0}
+    for replica, exp in exports.items():
+        for tenant, row in (exp or {}).get("tenants", {}).items():
+            agg = tenants.setdefault(tenant, {
+                "device_s": 0.0, "padding_s": 0.0, "queue_s": 0.0,
+                "hbm_byte_s": 0.0, "requests": 0,
+                "co_batch_s": 0.0, "queue_wait_s": 0.0,
+                "admission_sheds": 0})
+            for key in ("device_s", "padding_s", "queue_s",
+                        "hbm_byte_s", "requests"):
+                agg[key] += row.get(key, 0)
+            interference = row.get("interference", {})
+            for key in ("co_batch_s", "queue_wait_s", "admission_sheds"):
+                agg[key] += interference.get(key, 0)
+        for key in totals:
+            totals[key] += (exp or {}).get("totals", {}).get(key, 0)
+        top = (exp or {}).get("top_talker")
+        if top and float(top.get("share", 0.0)) > worst["share"]:
+            worst = {"replica": replica, "tenant": top.get("tenant"),
+                     "share": float(top.get("share", 0.0))}
+    return {
+        "replicas": exports,
+        "tenants": tenants,
+        "totals": totals,
+        "top_talker": worst if worst["tenant"] is not None else None,
         "errors": dict(errors or {}),
     }
 

@@ -127,16 +127,33 @@ class _Value:
         self.lock = lockdep.Lock("metrics.value")
 
 
+class _CounterValue:
+    __slots__ = ("v", "exemplar", "lock")
+
+    def __init__(self):
+        self.v = 0.0
+        # Last (increment, trace_id) supplied with an exemplar; rendered
+        # on the OpenMetrics `_total` sample (the spec permits counter
+        # exemplars) linking the series to /v2/trace/requests.
+        self.exemplar: tuple[float, str] | None = None
+        self.lock = lockdep.Lock("metrics.value")
+
+
 class Counter(_Metric):
     kind = "counter"
 
     def _make_child(self):
-        return _Value()
+        return _CounterValue()
 
-    def inc(self, amount: float = 1.0, **labels):
+    def inc(self, amount: float = 1.0, exemplar: str | None = None,
+            **labels):
+        """Add ``amount``; ``exemplar`` (a trace_id) is retained as the
+        series' last exemplar for OpenMetrics rendering."""
         child = self.labels(**labels) if self.labelnames else self.labels()
         with child.lock:
             child.v += amount
+            if exemplar:
+                child.exemplar = (float(amount), str(exemplar))
 
     def _family_name(self, openmetrics: bool) -> str:
         # OpenMetrics advertises the counter by its base name and
@@ -150,9 +167,14 @@ class Counter(_Metric):
         ls = _label_str(self.labelnames, values)
         body = f"{{{ls}}}" if ls else ""
         name = self.name
+        ex = ""
         if openmetrics:
             name = self._family_name(True) + "_total"
-        return [f"{name}{body} {format_value(child.v)}"]
+            if child.exemplar is not None:
+                v, trace_id = child.exemplar
+                ex = (f' # {{trace_id="{escape_label_value(trace_id)}"}} '
+                      f"{format_value(v)}")
+        return [f"{name}{body} {format_value(child.v)}{ex}"]
 
 
 class Gauge(_Metric):
@@ -313,11 +335,13 @@ class ModelInstruments:
         self._labels = {"model": model, "version": version}
 
     def observe_request(self, total_ns: int, times,
-                        trace_id: str | None = None) -> None:
+                        trace_id: str | None = None,
+                        tenant: str = "") -> None:
         em = self._em
         lab = self._labels
         em.request_duration_us.observe(max(0, total_ns) / 1e3,
-                                       exemplar=trace_id, **lab)
+                                       exemplar=trace_id,
+                                       tenant=tenant or "default", **lab)
         em.phase_duration_us.observe(times.queue_ns / 1e3,
                                      phase="queue", **lab)
         em.phase_duration_us.observe(times.compute_input_ns / 1e3,
@@ -336,8 +360,11 @@ class ModelInstruments:
     def record_deadline_expired(self, stage: str) -> None:
         self._em.deadline_expirations.inc(stage=stage, **self._labels)
 
-    def record_admission_rejection(self, reason: str) -> None:
-        self._em.admission_rejections.inc(reason=reason, **self._labels)
+    def record_admission_rejection(self, reason: str,
+                                   tenant: str = "") -> None:
+        self._em.admission_rejections.inc(reason=reason,
+                                          tenant=tenant or "default",
+                                          **self._labels)
 
 
 class EngineMetrics:
@@ -356,8 +383,10 @@ class EngineMetrics:
         r = self.registry
         self.request_duration_us = r.histogram(
             "tpu_request_duration_us",
-            "End-to-end successful request duration (microseconds)",
-            ("model", "version"))
+            "End-to-end successful request duration (microseconds); "
+            "tenant is the cost-ledger tag (bounded: registered tenants "
+            "+ default + shadow, overflow folds to other)",
+            ("model", "version", "tenant"))
         self.phase_duration_us = r.histogram(
             "tpu_phase_duration_us",
             "Per-phase request duration (microseconds)",
@@ -415,8 +444,8 @@ class EngineMetrics:
             "tpu_admission_rejections_total",
             "Requests shed by the admission controller, by reason "
             "(queue_depth, estimated_wait, concurrency, throttled, "
-            "draining)",
-            ("model", "version", "reason"))
+            "draining) and cost-ledger tenant tag",
+            ("model", "version", "reason", "tenant"))
         self.deadline_expirations = r.counter(
             "tpu_deadline_expirations_total",
             "Requests whose end-to-end deadline expired before the given "

@@ -75,6 +75,8 @@ _METHODS = [
     # Flight recorder ring + HBM census report.
     ("Timeseries", ops.TimeseriesRequest, ops.TimeseriesResponse, False),
     ("MemoryCensus", ops.MemoryRequest, ops.MemoryResponse, False),
+    # Per-tenant cost ledger (gRPC mirror of /v2/costs).
+    ("Costs", ops.CostsRequest, ops.CostsResponse, False),
 ]
 
 

@@ -44,6 +44,8 @@ the message classes. Wire-compatible with the equivalent .proto:
     message TimeseriesResponse { string timeseries_json = 1; }
     message MemoryRequest      {}
     message MemoryResponse     { string memory_json = 1; }
+    message CostsRequest       { string model = 1; }
+    message CostsResponse      { string costs_json = 1; }
 
 Event.detail_json / SloStatusResponse.slo_json /
 ProfileResponse.profile_json carry the open-ended detail/report dicts as
@@ -183,6 +185,14 @@ def _file_proto() -> _descriptor_pb2.FileDescriptorProto:
     m = message("MemoryResponse")
     field(m, "memory_json", 1, _F.TYPE_STRING)
 
+    # Per-tenant cost ledger (the /v2/costs body rides as JSON, same
+    # pattern as slo/profile/memory).
+    m = message("CostsRequest")
+    field(m, "model", 1, _F.TYPE_STRING)
+
+    m = message("CostsResponse")
+    field(m, "costs_json", 1, _F.TYPE_STRING)
+
     return fdp
 
 
@@ -222,4 +232,6 @@ __all__ = [
     "TimeseriesResponse",
     "MemoryRequest",
     "MemoryResponse",
+    "CostsRequest",
+    "CostsResponse",
 ]

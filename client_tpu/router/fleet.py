@@ -38,6 +38,7 @@ from client_tpu.observability.events import journal
 from client_tpu.observability.fleet import (
     FleetMonitorConfig,
     drift_scores,
+    merge_costs,
     merge_events,
     merge_expositions,
     merge_profiles,
@@ -118,6 +119,10 @@ class FleetFederator:
     def slo(self) -> dict:
         exports, errors = self._fan_out("/v2/slo", "slo")
         return merge_slo(exports, errors)
+
+    def costs(self) -> dict:
+        exports, errors = self._fan_out("/v2/costs", "costs")
+        return merge_costs(exports, errors)
 
     def timeseries_raw(self, query: str = ""):
         path = "/v2/timeseries" + (f"?{query}" if query else "")

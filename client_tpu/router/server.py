@@ -231,6 +231,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
     def h_get_v2_fleet_slo(self, body):
         self._send_json(self.federator.slo())
 
+    def h_get_v2_fleet_costs(self, body):
+        self._send_json(self.federator.costs())
+
     def h_get_v2_fleet_timeseries(self, body):
         q = self._query()
         limit = None

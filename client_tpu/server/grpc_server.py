@@ -132,6 +132,10 @@ def _proto_to_request(engine: TpuEngine,
         sequence_end=bool(params.get("sequence_end", False)),
         priority=int(params.get("priority", 0)),
         timeout_us=int(params.get("timeout", 0)),
+        # Cost-ledger tenant: the `tenant` request parameter (set by our
+        # client's tenant= kwarg; parameters are the gRPC analogue of
+        # the HTTP X-Tpu-Tenant header).
+        tenant=str(params.get("tenant", "") or ""),
     )
     # End-to-end deadline: the RPC's own deadline (context.time_remaining()
     # is the budget the CLIENT set, already net of transit) or a
@@ -399,6 +403,14 @@ class _Servicer(GRPCInferenceServiceServicer):
 
         return ops.MemoryResponse(
             memory_json=json.dumps(self.engine.memory_census()))
+
+    def Costs(self, request, context):  # noqa: N802
+        """gRPC mirror of ``GET /v2/costs``: the per-tenant cost ledger
+        (device/HBM/queue seconds + interference attribution) as JSON."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        snap = self.engine.costs_snapshot(model=request.model or None)
+        return ops.CostsResponse(costs_json=json.dumps(snap))
 
     # -- shm slot ring (zero-copy data plane; engine.shmring) ---------------
 

@@ -84,6 +84,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/v2/events$"), "events"),
     ("GET", re.compile(r"^/v2/slo$"), "slo"),
     ("GET", re.compile(r"^/v2/profile$"), "profile"),
+    ("GET", re.compile(r"^/v2/costs$"), "costs"),
     ("GET", re.compile(r"^/v2/timeseries$"), "timeseries"),
     ("GET", re.compile(r"^/v2/memory$"), "memory"),
     ("GET", re.compile(r"^/v2/load$"), "load"),
@@ -385,6 +386,17 @@ class _Handler(BaseHTTPRequestHandler):
         q = parse_qs(urlparse(self.path).query)
         model = (q.get("model") or [None])[0]
         self._send_json(self.engine.profile_snapshot(model=model))
+
+    def h_costs(self):
+        """Per-tenant cost ledger (``/v2/costs``): device-seconds,
+        HBM-byte-seconds, queue-seconds, and interference attribution,
+        with reconciliation against the profiler and HBM census.
+        ``?model=`` filters per-model rows to one model."""
+        from urllib.parse import parse_qs, urlparse
+
+        q = parse_qs(urlparse(self.path).query)
+        model = (q.get("model") or [None])[0]
+        self._send_json(self.engine.costs_snapshot(model=model))
 
     def h_timeseries(self):
         """Flight-recorder export (``/v2/timeseries``): the 1 Hz signal
@@ -729,6 +741,12 @@ class _Handler(BaseHTTPRequestHandler):
             sequence_end=bool(params.get("sequence_end", False)),
             priority=int(params.get("priority", 0)),
             timeout_us=int(params.get("timeout", 0)),
+            # Cost-ledger tenant: the `X-Tpu-Tenant` header (transport-
+            # level, set by our client) or the `tenant` request parameter
+            # (protocol-level, survives proxies that strip unknown
+            # headers). Header wins, like timeout-ms below.
+            tenant=str(self.headers.get("x-tpu-tenant")
+                       or params.get("tenant", "") or ""),
             # Adopt the caller's W3C trace context (or start a new trace);
             # every HTTP inference is traced into the engine's ring buffer.
             trace=TraceContext.from_traceparent(

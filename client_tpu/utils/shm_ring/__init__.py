@@ -510,7 +510,8 @@ class RingProducer:
 
     def doorbell(self, model_name: str, model_version: str = "", *,
                  outputs=None, timeout_ms: float = 0.0,
-                 priority: int = 0, headers=None) -> dict:
+                 priority: int = 0, tenant: str = "",
+                 headers=None) -> dict:
         """Submit the pending span in one control-channel round trip."""
         if self._spec is not None:
             raise ShmRingError(
@@ -537,6 +538,10 @@ class RingProducer:
             spec["timeout_ms"] = float(timeout_ms)
         if priority:
             spec["priority"] = int(priority)
+        if tenant:
+            # Cost-ledger tenant tag — the shm analogue of the HTTP
+            # X-Tpu-Tenant header (rides in the span spec slot header).
+            spec["tenant"] = str(tenant)
         self._pending = []
         self._meta = None
         return self._client.ring_doorbell(self.name, spec, headers=headers)

@@ -174,7 +174,8 @@ class TestRetryAfterHttp:
         assert elapsed >= 0.3
         metrics = stack["engine"].prometheus_metrics()
         assert ('tpu_admission_rejections_total{model="simple",'
-                'version="latest",reason="throttled"}') in metrics
+                'version="latest",reason="throttled",tenant="default"}'
+                ) in metrics
 
     def test_ready_endpoint_reports_degraded_after_shed(
             self, stack, shed_admission):

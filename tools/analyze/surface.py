@@ -65,6 +65,7 @@ HTTP_HANDLER_OPS = {
     "profile": "profile",
     "timeseries": "timeseries",
     "memory": "memory_census",
+    "costs": "costs",
     "load": "load_report",
     "metrics": "metrics",
 }
@@ -84,6 +85,7 @@ GRPC_RPC_OPS = {
     "Profile": "profile",
     "Timeseries": "timeseries",
     "MemoryCensus": "memory_census",
+    "Costs": "costs",
     "RingRegister": "ring_register",
     "RingStatus": "ring_status",
     "RingUnregister": "ring_unregister",
@@ -140,11 +142,13 @@ CLIENT_METHOD_OPS = {
     "get_profile": "profile",
     "get_timeseries": "timeseries",
     "get_memory": "memory_census",
+    "get_costs": "costs",
     "get_fleet_events": "fleet_events",
     "get_fleet_profile": "fleet_profile",
     "get_fleet_slo": "fleet_slo",
     "get_fleet_timeseries": "fleet_timeseries",
     "get_fleet_metrics": "fleet_metrics",
+    "get_fleet_costs": "fleet_costs",
     "infer": "infer",
     "async_infer": "infer",
     "generate": "generate",

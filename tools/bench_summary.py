@@ -53,28 +53,50 @@ def check(hist: list, threshold: float = 0.25) -> int:
     """Gate the newest run against the median of prior runs per probe.
     Returns the exit status (1 on any >threshold p99 regression)."""
     runs = _probe_runs(hist)
-    if len(runs) < 2:
-        print(f"bench-check: {len(runs)} run(s) with probe records — "
+    if not runs:
+        print("bench-check: 0 run(s) with probe records — "
               "nothing to compare, pass")
         return 0
     latest_ts = max(runs)
     failures = 0
-    for probe, rec in sorted(runs[latest_ts].items()):
-        p99 = rec.get("p99_us")
-        prior = [runs[ts][probe].get("p99_us")
-                 for ts in runs if ts != latest_ts and probe in runs[ts]]
-        prior = [v for v in prior if v is not None]
-        if p99 is None or not prior:
-            print(f"bench-check: {probe}: no prior p99 to compare, skip")
-            continue
-        base = _median(prior)
-        ratio = (p99 / base - 1.0) if base > 0 else 0.0
-        verdict = "FAIL" if ratio > threshold else "ok"
-        print(f"bench-check: {probe}: p99 {p99:.1f}us vs median "
-              f"{base:.1f}us over {len(prior)} prior run(s) "
-              f"({ratio:+.1%}) {verdict}")
-        if ratio > threshold:
-            failures += 1
+    if len(runs) < 2:
+        print(f"bench-check: {len(runs)} run(s) with probe records — "
+              "no prior runs to compare p99 against")
+    else:
+        for probe, rec in sorted(runs[latest_ts].items()):
+            p99 = rec.get("p99_us")
+            prior = [runs[ts][probe].get("p99_us")
+                     for ts in runs
+                     if ts != latest_ts and probe in runs[ts]]
+            prior = [v for v in prior if v is not None]
+            if p99 is None or not prior:
+                print(f"bench-check: {probe}: no prior p99 to compare, "
+                      "skip")
+                continue
+            base = _median(prior)
+            ratio = (p99 / base - 1.0) if base > 0 else 0.0
+            verdict = "FAIL" if ratio > threshold else "ok"
+            print(f"bench-check: {probe}: p99 {p99:.1f}us vs median "
+                  f"{base:.1f}us over {len(prior)} prior run(s) "
+                  f"({ratio:+.1%}) {verdict}")
+            if ratio > threshold:
+                failures += 1
+    # Interference gate: when the fan-in probe carries the cost ledger's
+    # attribution, it must explain most of the measured p99 inflation —
+    # an unexplained slowdown means the ledger lost track of who paid.
+    # Records predating the ledger skip silently.
+    fanin = runs[latest_ts].get("shm_fanin")
+    if fanin is not None:
+        r = fanin.get("shm_fanin") or fanin
+        inter = r.get("interference") or {}
+        if inter:
+            explained = float(inter.get("explained_fraction") or 0.0)
+            verdict = "FAIL" if explained < 0.8 else "ok"
+            print(f"bench-check: shm_fanin: interference attribution "
+                  f"explains {explained:.0%} of the p99 inflation "
+                  f"(floor 80%) {verdict}")
+            if explained < 0.8:
+                failures += 1
     if failures:
         print(f"bench-check: {failures} probe(s) regressed more than "
               f"{threshold:.0%} on p99", file=sys.stderr)
@@ -233,6 +255,22 @@ def _print_shm_fanin_delta(rec: dict) -> None:
               f"{r.get('shadow_p99_ratio')}x "
               f"(shadow: {shed.get('completions')} done, "
               f"{shed.get('errors')} shed)")
+    inter = r.get("interference") or {}
+    if inter:
+        legs = [("co_batch", inter.get("co_batch_us_per_req")),
+                ("queue_wait", inter.get("queue_wait_us_per_req")),
+                ("queue_growth", inter.get("queue_growth_us_per_req")),
+                ("device_contention",
+                 inter.get("device_contention_us_per_req")),
+                ("occupancy_dilation",
+                 inter.get("occupancy_dilation_us"))]
+        shown = " + ".join(f"{name} {v}us" for name, v in legs
+                           if v is not None)
+        rho = inter.get("foreign_occupancy")
+        print(f"    interference attribution: {shown}"
+              + (f" (foreign occupancy {rho})" if rho is not None else "")
+              + f" explains {inter.get('explained_fraction')} of the "
+              f"{inter.get('p99_inflation_us')}us p99 inflation")
 
 
 def _print_router_delta(rec: dict) -> None:

@@ -16,10 +16,11 @@
 #      classic and OpenMetrics expositions with tools/promlint.py (the
 #      OpenMetrics pass also requires an exemplar on tpu_request_duration),
 #      and smoke-scrape /v2/events, /v2/slo, /v2/timeseries (flight
-#      recorder ring) and /v2/memory (HBM census) — catching malformed
-#      renderings and broken ops endpoints that unit tests of individual
-#      counters never exercise. The census gauge family
-#      tpu_hbm_census_bytes must render in both dialects.
+#      recorder ring), /v2/memory (HBM census) and /v2/costs (tenant
+#      cost ledger) — catching malformed renderings and broken ops
+#      endpoints that unit tests of individual counters never exercise.
+#      The census gauge family tpu_hbm_census_bytes and the tpu_cost_*
+#      counter families must render in both dialects.
 #   4. autotune e2e: boot the server with CLIENT_TPU_AUTOTUNE enabled and
 #      a deliberately misfit bucket ladder, drive skewed batch-1 traffic,
 #      and assert the tuner promotes a bucket (journaled, applied state in
@@ -28,7 +29,8 @@
 #   5. router e2e: two in-process replicas behind the standalone L7
 #      router — drive traffic through the proxy (both replicas must
 #      receive some), smoke /v2/load + /v2/fleet/profile +
-#      /v2/fleet/events, round-trip one stitched trace (router spans +
+#      /v2/fleet/events + /v2/fleet/costs (federated cost ledger),
+#      round-trip one stitched trace (router spans +
 #      the serving replica's phase spans under one trace id), induce
 #      load-report skew and assert tpu_fleet_drift_score crosses the
 #      monitor threshold, roll-drain one replica with live in-process
@@ -119,6 +121,15 @@ try:
                 "INPUT1": np.zeros((1, 16), dtype=np.int32)},
         trace=TraceContext.new(),
     ), timeout_s=120)
+    # A second, tenant-tagged inference: the first is the cold call
+    # (compile time excluded from charging on both meters), so this is
+    # the one the cost ledger bills — /v2/costs must show the tenant.
+    engine.infer(InferRequest(
+        model_name="simple",
+        inputs={"INPUT0": np.zeros((1, 16), dtype=np.int32),
+                "INPUT1": np.zeros((1, 16), dtype=np.int32)},
+        tenant="ci",
+    ), timeout_s=120)
     base = f"http://{srv.url}"
     classic = urlopen(f"{base}/metrics", timeout=10).read().decode()
     om = urlopen(Request(f"{base}/metrics", headers={
@@ -151,11 +162,20 @@ try:
         sys.exit(f"/v2/memory smoke failed: {str(mem)[:200]}")
     if "tpu_hbm_census_bytes" not in classic:
         sys.exit("tpu_hbm_census_bytes missing from /metrics scrape")
+    costs = json.load(urlopen(f"{base}/v2/costs", timeout=10))
+    if "tenants" not in costs or "reconciliation" not in costs:
+        sys.exit(f"/v2/costs smoke failed: {str(costs)[:200]}")
+    if "ci" not in costs["tenants"]:
+        sys.exit(f"/v2/costs missing the tagged tenant: "
+                 f"{sorted(costs['tenants'])}")
+    if "tpu_cost_device_seconds_total" not in classic:
+        sys.exit("tpu_cost_device_seconds_total missing from /metrics")
     print(f"ops endpoints ok: {len(events['events'])} event(s), "
           f"slo enabled={slo['enabled']}, "
           f"profile models={len(prof['models'])}, "
           f"timeseries samples={len(ts['samples'])}, "
-          f"census owners={len(mem['owners'])}")
+          f"census owners={len(mem['owners'])}, "
+          f"cost tenants={sorted(costs['tenants'])}")
 finally:
     srv.stop()
     engine.shutdown()
@@ -169,6 +189,10 @@ grep -q "^tpu_hbm_census_bytes" "$SCRAPE_DIR/metrics.txt" \
     || { echo "tpu_hbm_census_bytes missing from classic dialect"; rc=1; }
 grep -q "^tpu_hbm_census_bytes" "$SCRAPE_DIR/metrics.om.txt" \
     || { echo "tpu_hbm_census_bytes missing from openmetrics dialect"; rc=1; }
+grep -q "^tpu_cost_" "$SCRAPE_DIR/metrics.txt" \
+    || { echo "tpu_cost_* missing from classic dialect"; rc=1; }
+grep -q "^tpu_cost_" "$SCRAPE_DIR/metrics.om.txt" \
+    || { echo "tpu_cost_* missing from openmetrics dialect"; rc=1; }
 rm -rf "$SCRAPE_DIR"
 
 echo "=== stage 4/11: autotune e2e (promotion + metrics) ==="
@@ -316,6 +340,13 @@ try:
         sys.exit(f"/v2/fleet/events cursors wrong: {str(fleet_evts)[:300]}")
     if not fleet_evts["events"]:
         sys.exit("/v2/fleet/events merged to an empty journal")
+    fleet_costs = json.load(urlopen(f"{base}/v2/fleet/costs", timeout=10))
+    if set(fleet_costs["replicas"]) != {r.id for r in router.replicas}:
+        sys.exit(f"/v2/fleet/costs replica rows wrong: "
+                 f"{str(fleet_costs)[:300]}")
+    if "default" not in fleet_costs.get("tenants", {}):
+        sys.exit(f"/v2/fleet/costs has no default-tenant charges: "
+                 f"{str(fleet_costs)[:300]}")
 
     # Stitched trace round-trip: one more infer (raw urlopen, no client
     # traceparent), then resolve the echoed trace id on the router into

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Render a ``/v2/costs`` snapshot as a per-tenant bill.
+
+Input is either a live server base URL (``http://host:port``) or a path
+to a saved JSON snapshot (``curl $base/v2/costs > costs.json``). For
+each tenant the report shows its device-seconds (split into useful and
+padding), host-seconds, queue-seconds, HBM-byte-seconds, request count,
+and the
+interference breakdown (device time spent co-batched with foreign
+tenants, queue wait attributable to foreign arrivals, admission sheds)
+— followed by the reconciliation section auditing the ledger against
+the efficiency profiler and the HBM census.
+
+    python tools/cost_report.py http://127.0.0.1:8000
+    python tools/cost_report.py http://127.0.0.1:8000 --model simple
+    python tools/cost_report.py costs.json
+
+``--fleet`` points the tool at a *router* and renders the federated
+``/v2/fleet/costs``: fleet-wide per-tenant totals first, then each
+replica's own bill.
+
+    python tools/cost_report.py http://127.0.0.1:8080 --fleet
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from urllib.parse import quote, urlparse
+from urllib.request import urlopen
+
+_COLS = ("tenant", "device_s", "padding_s", "host_s", "queue_s",
+         "hbm_byte_s", "requests", "co_batch_s", "queue_wait_s", "sheds")
+
+
+def load_snapshot(source: str, model: str = "",
+                  fleet: bool = False, timeout_s: float = 10.0) -> dict:
+    """Fetch from a server base URL or read a saved JSON file."""
+    if urlparse(source).scheme in ("http", "https"):
+        url = source.rstrip("/") + (
+            "/v2/fleet/costs" if fleet else "/v2/costs")
+        if model and not fleet:
+            url += f"?model={quote(model)}"
+        with urlopen(url, timeout=timeout_s) as resp:
+            return json.load(resp)
+    with open(source) as f:
+        return json.load(f)
+
+
+def _fmt_bytes_s(v: float) -> str:
+    """HBM-byte-seconds, scaled to a readable unit (GiB·s dominates on
+    any real arena)."""
+    for unit, div in (("GiB*s", 1 << 30), ("MiB*s", 1 << 20),
+                      ("KiB*s", 1 << 10)):
+        if v >= div:
+            return f"{v / div:.3f}{unit}"
+    return f"{v:.0f}B*s"
+
+
+def _tenant_row(tenant: str, row: dict) -> tuple:
+    interference = row.get("interference", row)
+    return (tenant,
+            f"{row.get('device_s', 0.0):.4f}",
+            f"{row.get('padding_s', 0.0):.4f}",
+            f"{row.get('host_s', 0.0):.4f}",
+            f"{row.get('queue_s', 0.0):.4f}",
+            _fmt_bytes_s(float(row.get("hbm_byte_s", 0.0))),
+            row.get("requests", 0),
+            f"{interference.get('co_batch_s', 0.0):.4f}",
+            f"{interference.get('queue_wait_s', 0.0):.4f}",
+            interference.get("admission_sheds", 0))
+
+
+def _table(w, rows: list[tuple]) -> None:
+    widths = [max(len(str(c)) for c in col)
+              for col in zip(_COLS, *rows)]
+    w("  " + "  ".join(str(c).rjust(n)
+                       for c, n in zip(_COLS, widths)) + "\n")
+    for r in rows:
+        w("  " + "  ".join(str(c).rjust(n)
+                           for c, n in zip(r, widths)) + "\n")
+
+
+def render(snap: dict, out=None) -> None:
+    w = (out or sys.stdout).write
+    tenants = snap.get("tenants", {})
+    totals = snap.get("totals", {})
+    w(f"tenants={len(tenants)} "
+      f"device={totals.get('device_s', 0.0):.4f}s "
+      f"(padding {totals.get('padding_s', 0.0):.4f}s) "
+      f"host={totals.get('host_s', 0.0):.4f}s "
+      f"queue={totals.get('queue_s', 0.0):.4f}s "
+      f"hbm={_fmt_bytes_s(float(totals.get('hbm_byte_s', 0.0)))} "
+      f"requests={totals.get('requests', 0)}\n")
+    if not tenants:
+        w("no charged requests yet\n")
+        return
+    # Loudest first: the bill is read top-down when hunting a leak.
+    ordered = sorted(tenants.items(),
+                     key=lambda kv: -(kv[1].get("device_s", 0.0)
+                                      + kv[1].get("padding_s", 0.0)))
+    _table(w, [_tenant_row(t, row) for t, row in ordered])
+    top = snap.get("top_talker")
+    if top:
+        w(f"top talker: {top['tenant']} "
+          f"({top['share']:.0%} of the last "
+          f"{snap.get('window_s')}s device window)\n")
+    recon = snap.get("reconciliation")
+    if recon:
+        ratio = recon.get("device_s_ratio")
+        w(f"reconciliation: ledger {recon.get('ledger_device_s')}s vs "
+          f"profiler {recon.get('profiler_device_s')}s "
+          f"(ratio {ratio if ratio is not None else 'n/a'}, "
+          f"window {recon.get('profiler_window_s')}s), "
+          f"census kv_arena {recon.get('census_kv_arena_bytes')} bytes\n")
+
+
+def render_fleet(snap: dict, out=None) -> None:
+    w = (out or sys.stdout).write
+    replicas = snap.get("replicas", {})
+    w(f"fleet: {len(replicas)} replica(s), "
+      f"{len(snap.get('errors', {}))} fetch error(s)\n")
+    tenants = snap.get("tenants", {})
+    if tenants:
+        w("\nfleet-wide per-tenant totals:\n")
+        ordered = sorted(tenants.items(),
+                         key=lambda kv: -(kv[1].get("device_s", 0.0)
+                                          + kv[1].get("padding_s", 0.0)))
+        _table(w, [_tenant_row(t, row) for t, row in ordered])
+    top = snap.get("top_talker")
+    if top:
+        w(f"loudest replica: {top['replica']} "
+          f"(tenant {top['tenant']}, {top['share']:.0%})\n")
+    for rid in sorted(replicas):
+        w(f"\n== replica {rid} ==\n")
+        render(replicas[rid] or {}, out)
+    for rid, err in sorted(snap.get("errors", {}).items()):
+        w(f"\n== replica {rid}: FETCH FAILED: {err} ==\n")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("source", help="server base URL or saved JSON path")
+    p.add_argument("--model", default="",
+                   help="narrow per-model rows to one model")
+    p.add_argument("--fleet", action="store_true",
+                   help="treat source as a router; render /v2/fleet/costs")
+    args = p.parse_args(argv)
+    snap = load_snapshot(args.source, model=args.model, fleet=args.fleet)
+    if args.fleet:
+        render_fleet(snap)
+    else:
+        render(snap)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

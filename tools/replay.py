@@ -94,6 +94,10 @@ def run_worker(args) -> int:
     }
     if args.priority:
         spec["priority"] = args.priority
+    if args.tenant:
+        # Cost-ledger tenant tag: shadow traffic books its own device/
+        # HBM spend instead of hiding in the live tenants' bills.
+        spec["tenant"] = args.tenant
     client = httpclient.InferenceServerClient(args.url)
     sent = completions = errors = crc = 0
     t0 = time.monotonic()
@@ -155,7 +159,8 @@ def _reap_one(prod, completions: int, errors: int, crc: int):
 def spawn_workers(url: str, model: str, dataset_key: str,
                   dataset_name: str, producers: int, *,
                   duration: float = 0.0, count: int = 0,
-                  priority: int = 0, slot_count: int = 64,
+                  priority: int = 0, tenant: str | None = None,
+                  slot_count: int = 64,
                   slot_bytes: int = 1 << 16,
                   key_prefix: str | None = None) -> list[subprocess.Popen]:
     """Start the producer subprocesses (importable — bench/ci reuse).
@@ -172,6 +177,8 @@ def spawn_workers(url: str, model: str, dataset_key: str,
                "--priority", str(priority), "--duration", str(duration),
                "--count", str(count), "--slot-count", str(slot_count),
                "--slot-bytes", str(slot_bytes)]
+        if tenant is not None:
+            cmd += ["--tenant", tenant]
         procs.append(subprocess.Popen(
             cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
@@ -233,8 +240,8 @@ def run_coordinator(args) -> int:
         procs = spawn_workers(
             args.url, args.model, dataset_key, args.dataset_name,
             args.producers, duration=args.duration, count=args.count,
-            priority=args.priority, slot_count=args.slot_count,
-            slot_bytes=args.slot_bytes)
+            priority=args.priority, tenant=args.tenant,
+            slot_count=args.slot_count, slot_bytes=args.slot_bytes)
         per = (f"{args.duration:.1f}s" if args.duration
                else f"{args.count} requests")
         _log(f"{len(procs)} producer processes live "
@@ -291,6 +298,11 @@ def main(argv=None) -> int:
                    default=envcfg.env_int("CLIENT_TPU_REPLAY_PRIORITY"),
                    help="InferRequest priority stamped on replay traffic "
                         "(default: CLIENT_TPU_REPLAY_PRIORITY)")
+    p.add_argument("--tenant",
+                   default=envcfg.env_str("CLIENT_TPU_REPLAY_TENANT"),
+                   help="cost-ledger tenant tag stamped on replay "
+                        "traffic (default: CLIENT_TPU_REPLAY_TENANT, "
+                        "'shadow')")
     p.add_argument("--slot-count", type=int, default=64)
     p.add_argument("--slot-bytes", type=int, default=1 << 16)
     p.add_argument("--shed-backoff", type=float, default=0.05,
