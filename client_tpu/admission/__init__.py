@@ -143,6 +143,14 @@ class TokenBucket:
             self._refill_locked()
             return self._tokens
 
+    def set_rate(self, rate: float) -> None:
+        """Retarget the refill rate (the QoS governor's actuator).
+        Tokens accrued at the old rate are banked first, so a rate cut
+        never claws back credit already earned."""
+        with self._lock:
+            self._refill_locked()
+            self.rate = max(1e-9, float(rate))
+
 
 @dataclass
 class AdmissionConfig:
@@ -241,6 +249,9 @@ class AdmissionController:
         self._clock = clock
         self._lock = lockdep.Lock("admission.controller")
         self._gates: dict[str, _ModelGate] = {}
+        # Optional QoS controller (client_tpu.admission.qos): per-class
+        # gates evaluated ahead of the shared ones when attached.
+        self.qos = None
         self._last_shed = 0.0
         # True between the first shed and the hold-window expiry observed
         # by degraded(); drives degraded_enter/degraded_exit events.
@@ -268,10 +279,18 @@ class AdmissionController:
         cfg = self._gate(model).cfg
         return cfg.shadow_priority > 0 and priority >= cfg.shadow_priority
 
+    def attach_qos(self, qos) -> None:
+        """Bind a :class:`~client_tpu.admission.qos.QosController`; its
+        per-class gates run first in :meth:`admit` and its sheds land on
+        the same rejection counter/journal/ledger as the shared ones."""
+        self.qos = qos if qos is not None and \
+            getattr(qos, "enabled", False) else None
+
     def admit(self, model: str, version: str = "",
               queue_depth: int = 0, instances: int = 1,
               trace_id: str | None = None, priority: int = 0,
-              tenant: str = "") -> None:
+              tenant: str = "", qos_class: str = "",
+              class_queue_depth: int = 0) -> None:
         """Admit or shed one request; raises :class:`AdmissionError` on
         shed. ``queue_depth`` is the model's current scheduler backlog and
         ``instances`` its worker count (for the estimated-wait check).
@@ -279,9 +298,22 @@ class AdmissionController:
         in the event journal. ``priority`` selects the admission class:
         at/above ``shadow_priority`` the stricter shadow gates apply
         first, so replay traffic sheds before it can queue behind live.
-        ``tenant`` attributes a shed on the metrics/ledger side."""
+        ``tenant`` attributes a shed on the metrics/ledger side. With a
+        QoS controller attached, ``qos_class`` / ``class_queue_depth``
+        drive the per-class gates (quota, class inflight/queue caps) —
+        their pushback is the class bucket's refill time, not the shared
+        EWMA estimate."""
         gate = self._gate(model)
         cfg = gate.cfg
+        if self.qos is not None and qos_class:
+            try:
+                self.qos.admit(model, qos_class,
+                               class_queue_depth=class_queue_depth)
+            except AdmissionError as exc:
+                self._count_shed(model, version, exc.reason,
+                                 retry_after_s=exc.retry_after_s,
+                                 trace_id=trace_id, tenant=tenant)
+                raise
         if cfg.shadow_priority > 0 and priority >= cfg.shadow_priority:
             if cfg.shadow_max_inflight > 0 \
                     and gate.shadow_inflight >= cfg.shadow_max_inflight:

@@ -191,6 +191,14 @@ register(
     "SLO objectives (availability/latency burn tracking): inline JSON or "
     "`@/path.json`; unset disables tracking entirely.",
     "admission")
+register(
+    "CLIENT_TPU_QOS", "", "json",
+    "Tenant QoS classes (inline JSON or `@/path.json`): named classes "
+    "with WFQ weights, token-bucket quotas, inflight/queue caps, "
+    "class→priority mapping, preempt/protect flags, plus the "
+    "tenant→class table; unset disables QoS entirely (priority-heap "
+    "scheduling, shared admission gates only). See docs/QOS.md.",
+    "admission")
 
 # -- observability -----------------------------------------------------------
 register(
@@ -239,6 +247,12 @@ register(
     "CLIENT_TPU_REPLAY_TENANT", "shadow", "str",
     "Cost-ledger tenant tag tools/replay.py stamps on its shm traffic "
     "(`--tenant` overrides) so shadow device/HBM spend is attributable.",
+    "shm")
+register(
+    "CLIENT_TPU_REPLAY_SHAPE", "steady", "str",
+    "Default load shape for tools/replay.py `--rate` pacing: `steady`, "
+    "`diurnal` (raised cosine to `--peak-rate`), or `flash_crowd` "
+    "(rectangular peak burst each `--shape-period`).",
     "shm")
 register(
     "CLIENT_TPU_SHM_REAPER_INTERVAL_MS", "1.0", "float",

@@ -47,7 +47,7 @@ class TpuEngine:
     def __init__(self, repository: ModelRepository | None = None, *,
                  jit: bool = True, warmup: bool = False,
                  load_all: bool = True, eager_init: bool = True,
-                 metrics_registry=None, admission=None):
+                 metrics_registry=None, admission=None, qos=None):
         if eager_init and jit:
             # Pay PjRt client creation here, on the constructing thread, with
             # progress logged — never lazily inside a scheduler worker where
@@ -158,6 +158,17 @@ class TpuEngine:
             metrics=self.metrics)
         if self.admission._metrics is None:
             self.admission._metrics = self.metrics
+        # Tenant QoS (CLIENT_TPU_QOS): named classes with WFQ weights,
+        # per-class quotas/caps, preemption, and the SLO-burn governor.
+        # Disabled (env unset, no explicit controller) everything below
+        # is inert: schedulers keep their priority heap and admission
+        # runs only the shared gates.
+        from client_tpu.admission.qos import QosController
+
+        self.qos = qos or QosController.from_env(metrics=self.metrics)
+        if self.qos._metrics is None:
+            self.qos._metrics = self.metrics
+        self.admission.attach_qos(self.qos)
         self.request_traces = TraceStore(
             capacity=envcfg.env_int("CLIENT_TPU_TRACE_BUFFER"))
         # Opt-in bucket autotuner + HBM planning arena (CLIENT_TPU_AUTOTUNE;
@@ -189,6 +200,11 @@ class TpuEngine:
                         severity="ERROR", model=name, error=str(exc))
         if self.autotuner is not None:
             self.autotuner.start()
+        # The QoS governor needs both the alarm (SLO fast burn) and the
+        # actuator (a throttleable class bucket); start_governor no-ops
+        # without the latter.
+        if self.qos.enabled and self.slo.enabled:
+            self.qos.start_governor(self.slo, self.costs)
 
     # -- health / metadata ---------------------------------------------------
 
@@ -334,6 +350,7 @@ class TpuEngine:
                     model, stats,
                     sequence_cls=make_sequence_scheduler,
                     ensemble_cls=EnsembleScheduler,
+                    qos=self.qos if self.qos.enabled else None,
                     engine=self,
                 )
                 new_models.append(model)
@@ -497,6 +514,15 @@ class TpuEngine:
                 req.model_name, req.priority) else "default"
         else:
             req.tenant = self.costs.canonical_tenant(req.tenant)
+        # QoS classification: stamp the class (WFQ lane) from the tenant
+        # table / priority band, and let a class imply a scheduler
+        # priority for requests that arrived without one.
+        if self.qos.enabled:
+            req.qos_class = self.qos.classify(req.tenant, req.priority)
+            if req.priority <= 0:
+                level = self.qos.priority_level(req.qos_class)
+                if level > 0:
+                    req.priority = level
         if self._draining or not self._live:
             self.admission.record_rejection(
                 req.model_name, req.model_version, reason="draining",
@@ -511,10 +537,13 @@ class TpuEngine:
                                                 trace_id=trace_id)
             raise DeadlineExpired(
                 "end-to-end deadline expired before admission")
+        class_depth = sched.queue.class_qsize(req.qos_class) \
+            if req.qos_class and hasattr(sched.queue, "class_qsize") else 0
         self.admission.admit(
             req.model_name, req.model_version,
             queue_depth=sched.queue.qsize(), instances=len(sched.workers),
-            trace_id=trace_id, priority=req.priority, tenant=req.tenant)
+            trace_id=trace_id, priority=req.priority, tenant=req.tenant,
+            qos_class=req.qos_class, class_queue_depth=class_depth)
         self._submit_accounted(sched, req)
 
     def _submit_accounted(self, sched: Scheduler, req: InferRequest) -> None:
@@ -525,7 +554,10 @@ class TpuEngine:
         rejected request never gets a callback-delivered response."""
         model_name = req.model_name
         shadow = self.admission.is_shadow(model_name, req.priority)
+        qos_class = req.qos_class if self.qos.enabled else ""
         self.admission.on_request_start(model_name, shadow=shadow)
+        if qos_class:
+            self.qos.on_request_start(qos_class)
         inner = req.response_callback
         ended = [False]
 
@@ -539,6 +571,8 @@ class TpuEngine:
                         0.0, (t.compute_output_end - t.compute_start) / 1e9)
                 self.admission.on_request_end(model_name, service_s,
                                               shadow=shadow)
+                if qos_class:
+                    self.qos.on_request_end(qos_class)
             inner(resp)
 
         req.response_callback = _accounted
@@ -548,6 +582,8 @@ class TpuEngine:
             if not ended[0]:
                 ended[0] = True
                 self.admission.on_request_end(model_name, shadow=shadow)
+                if qos_class:
+                    self.qos.on_request_end(qos_class)
             raise
 
     def _attach_trace_recorder(self, req: InferRequest) -> None:
@@ -791,6 +827,36 @@ class TpuEngine:
         }
         return snap
 
+    def qos_snapshot(self, model: str | None = None) -> dict:
+        """``GET /v2/qos`` body: the controller's class table (weights,
+        quotas, throttle ratios, inflight, shed/preemption tallies)
+        layered with per-model WFQ lane depths from the live
+        schedulers."""
+        snap = self.qos.snapshot()
+        queues: dict[str, dict[str, int]] = {}
+        if self.qos.enabled:
+            with self._lock:
+                scheds = dict(self._schedulers)
+            seen: set[int] = set()
+            for key, sched in sorted(scheds.items()):
+                name = key.split(":", 1)[0]
+                if model and name != model:
+                    continue
+                q = sched.queue
+                if id(sched) in seen or not hasattr(q, "class_qsize"):
+                    continue
+                seen.add(id(sched))
+                depths = {cls: q.class_qsize(cls)
+                          for cls in self.qos.class_names()}
+                prev = queues.get(name)
+                if prev is None:
+                    queues[name] = depths
+                else:
+                    for cls, d in depths.items():
+                        prev[cls] = prev.get(cls, 0) + d
+        snap["queues"] = queues
+        return snap
+
     # -- flight recorder / HBM census -----------------------------------------
 
     def timeseries_sample(self) -> dict:
@@ -901,6 +967,12 @@ class TpuEngine:
                                              0.0))
             if burn:
                 sample["slo_burn"] = burn
+        # QoS governor actuation: how many classes are currently running
+        # below their configured rate (0 = loop quiescent). A nonzero
+        # plateau in the flight recorder is the visual signature of the
+        # SLO-burn feedback loop holding a tenant down.
+        if self.qos.enabled:
+            sample["qos_throttled"] = len(self.qos.throttled_classes())
         return sample
 
     def timeseries_export(self, *, signal=None, model=None,
@@ -1047,6 +1119,8 @@ class TpuEngine:
             self.events.emit("lifecycle", "server_shutdown",
                              draining=self._draining)
         self._live = False
+        if getattr(self, "qos", None) is not None:
+            self.qos.stop_governor()
         if getattr(self, "recorder", None) is not None:
             self.recorder.detach(self)
         if getattr(self, "autotuner", None) is not None:

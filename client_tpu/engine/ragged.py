@@ -55,10 +55,10 @@ class RaggedScheduler(DefaultScheduler):
     usual.
     """
 
-    def __init__(self, model: Model, stats: ModelStats):
+    def __init__(self, model: Model, stats: ModelStats, qos=None):
         self._indices = getattr(model.backend, "indices_name", "INDICES")
         self._offsets = getattr(model.backend, "offsets_name", "OFFSETS")
-        super().__init__(model, stats)
+        super().__init__(model, stats, qos=qos)
 
     def _gather(self, first: InferRequest, dyn) -> list[InferRequest]:
         cfg = self.model.config
@@ -71,7 +71,16 @@ class RaggedScheduler(DefaultScheduler):
         batch = [first]
         nnz = request_nnz(first, self._indices)
         rows = _request_batch(first)
+        preemptable = (
+            self.qos is not None
+            and not self.qos.is_preempt(getattr(first, "qos_class", ""))
+            and hasattr(self.queue, "preempt_pending"))
         while nnz < prefer:
+            if preemptable:
+                pend = self.queue.preempt_pending()
+                if pend is not None:
+                    self.qos.note_preemption(cfg.name, pend)
+                    break
             timeout = max((deadline_ns - now_ns()) / 1e9, 0.0)
             try:
                 # Lookups per request vary wildly (Zipf traffic), so the
@@ -102,7 +111,10 @@ class RaggedScheduler(DefaultScheduler):
                     for later in reversed(items[idx:]):
                         if later is _SHUTDOWN:
                             self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)
-                        else:
+                        elif not self._check_deadline(later):
+                            # Requeueing a request whose deadline lapsed
+                            # would re-dispatch a dead request next wave;
+                            # fail it here as a stage=queue expiry.
                             self.queue.put_front(
                                 later, self._priority_level(later))
                     stop = True

@@ -147,6 +147,186 @@ class _ReqQueue:
             return self._level_counts.get(level, 0)
 
 
+class _WfqLane:
+    """One QoS class's lane inside :class:`_WfqQueue`: a (level, seq)
+    heap like :class:`_ReqQueue` plus the DRR deficit counter."""
+
+    __slots__ = ("name", "weight", "preempt", "h", "deficit")
+
+    def __init__(self, name: str, weight: float, preempt: bool):
+        self.name = name
+        self.weight = max(1e-6, float(weight))
+        self.preempt = preempt
+        self.h: list = []  # (level, seq, item)
+        self.deficit = 0.0
+
+
+class _WfqQueue:
+    """Weighted fair queue across QoS classes: deficit round-robin over
+    per-class lanes, quantum proportional to the configured weight.
+
+    Drop-in for :class:`_ReqQueue` (same put/put_front/get/get_many/
+    qsize/level_qsize surface) so every scheduler check chain, shutdown
+    sentinel contract, and pushback path is untouched. Differences:
+
+    * **Pop order** — instead of one global priority heap, each class
+      owns a lane (priority/FIFO *within* the lane) and ``get_many``
+      serves lanes by DRR: a lane earns ``quantum x weight`` credit per
+      rotation and pops one request per credit, so under saturation the
+      served mix converges to the weight ratio regardless of which
+      class floods the queue.
+    * **Preemption hint** — an arrival in a ``preempt`` class restarts
+      the rotation at that lane (next wave leads with it) and is
+      visible to in-assembly gathers via :meth:`preempt_pending`, which
+      lets the dynamic batcher split a batch-lane batch instead of
+      making the interactive request wait behind a full wave.
+    * **Shutdown** — sentinels ride a control lane served only when
+      every class lane is empty, preserving the drain-real-work-first
+      contract heap order used to give.
+    """
+
+    def __init__(self, qos):
+        self._qos = qos
+        self._cv = lockdep.Condition("scheduler.queue")
+        self._seq = 0
+        self._front_seq = 0
+        self._level_counts: dict[int, int] = {}
+        self._lanes: dict[str, _WfqLane] = {}
+        for name in qos.class_names():
+            self._lanes[name] = _WfqLane(
+                name, qos.weight(name), qos.is_preempt(name))
+        self._default = qos.config.default_class
+        self._order = list(self._lanes)
+        self._rr = 0
+        self._control: list = []  # shutdown sentinels / control items
+        self._size = 0
+        # One rotation gives the lightest lane >= 1 credit so every
+        # round makes progress (classic DRR quantum >= 1 packet).
+        min_w = min(lane.weight for lane in self._lanes.values())
+        self._quantum = 1.0 / min_w
+
+    def _lane_for(self, item) -> _WfqLane | None:
+        if item is _SHUTDOWN or not isinstance(item, InferRequest):
+            return None  # control lane
+        name = getattr(item, "qos_class", "") or self._default
+        lane = self._lanes.get(name)
+        return lane if lane is not None else self._lanes[self._default]
+
+    def put(self, item, level: int = 0, max_level_size: int = 0) -> bool:
+        with self._cv:
+            if max_level_size > 0 and \
+                    self._level_counts.get(level, 0) >= max_level_size:
+                return False
+            lane = self._lane_for(item)
+            if lane is None:
+                self._control.append((level, item))
+            else:
+                self._seq += 1
+                heapq.heappush(lane.h, (level, self._seq, item))
+                if lane.preempt:
+                    # Next rotation leads with the interactive lane; DRR
+                    # deficits still bound its share, so this shifts
+                    # latency, not throughput fairness.
+                    self._rr = self._order.index(lane.name)
+            self._level_counts[level] = self._level_counts.get(level, 0) + 1
+            self._size += 1
+            self._cv.notify()
+            return True
+
+    def put_front(self, item, level: int = 0) -> None:
+        with self._cv:
+            lane = self._lane_for(item)
+            if lane is None:
+                self._control.append((level, item))
+            else:
+                self._front_seq -= 1
+                heapq.heappush(lane.h, (level, self._front_seq, item))
+            self._level_counts[level] = self._level_counts.get(level, 0) + 1
+            self._size += 1
+            self._cv.notify()
+
+    def get(self, timeout: float | None = None):
+        return self.get_many(1, timeout=timeout)[0]
+
+    def get_many(self, max_items: int, timeout: float | None = None) -> list:
+        with self._cv:
+            if not self._cv.wait_for(lambda: self._size > 0,
+                                     timeout=timeout):
+                raise queue.Empty
+            out: list = []
+            n = len(self._order)
+            while len(out) < max_items and \
+                    self._size > len(self._control):
+                progressed = False
+                for k in range(n):
+                    i = (self._rr + k) % n
+                    lane = self._lanes[self._order[i]]
+                    if not lane.h:
+                        lane.deficit = 0.0
+                        continue
+                    # Credit only at the START of a lane's turn: a turn
+                    # cut short by max_items resumes on leftover deficit
+                    # (crediting per visit would let one lane re-earn
+                    # forever and starve the rotation).
+                    if lane.deficit < 1.0:
+                        lane.deficit += self._quantum * lane.weight
+                    while lane.h and lane.deficit >= 1.0 \
+                            and len(out) < max_items:
+                        self._pop_lane(lane, out)
+                        lane.deficit -= 1.0
+                        progressed = True
+                    if not lane.h:
+                        lane.deficit = 0.0
+                    if len(out) >= max_items:
+                        # Mid-turn cut (credit left): the lane keeps the
+                        # floor; an exhausted turn passes it on.
+                        self._rr = i if lane.h and lane.deficit >= 1.0 \
+                            else (i + 1) % n
+                        break
+                if not progressed:
+                    break  # defensive: every visited lane was empty
+            # Control items (shutdown sentinels) only once every class
+            # lane has drained — real work first, like heap order did.
+            while len(out) < max_items and self._control \
+                    and self._size == len(self._control):
+                level, item = self._control.pop(0)
+                out.append(item)
+                self._size -= 1
+                self._level_counts[level] = \
+                    self._level_counts.get(level, 1) - 1
+            return out
+
+    def _pop_lane(self, lane: _WfqLane, out: list) -> None:
+        level, _seq, item = heapq.heappop(lane.h)
+        self._level_counts[level] = self._level_counts.get(level, 1) - 1
+        self._size -= 1
+        out.append(item)
+
+    def preempt_pending(self) -> str | None:
+        """The name of a preempt-class lane with queued work (None when
+        no interactive request is waiting)."""
+        with self._cv:
+            for lane in self._lanes.values():
+                if lane.preempt and lane.h:
+                    return lane.name
+        return None
+
+    def qsize(self) -> int:
+        with self._cv:
+            return self._size
+
+    def class_qsize(self, name: str) -> int:
+        lane = self._lanes.get(name)
+        if lane is None:
+            return 0
+        with self._cv:
+            return len(lane.h)
+
+    def level_qsize(self, level: int) -> int:
+        with self._cv:
+            return self._level_counts.get(level, 0)
+
+
 class Scheduler:
     """Base scheduler: owns the request queue and worker threads."""
 
@@ -159,10 +339,16 @@ class Scheduler:
     # instance_count — their parallelism comes from batching.
     single_instance = False
 
-    def __init__(self, model: Model, stats: ModelStats):
+    def __init__(self, model: Model, stats: ModelStats, qos=None):
         self.model = model
         self.stats = stats
-        self.queue = _ReqQueue()
+        # With a QoS controller attached (CLIENT_TPU_QOS), batching
+        # schedulers swap the priority heap for the weighted fair queue;
+        # everything else keeps pure priority order.
+        self.qos = qos if qos is not None and \
+            getattr(qos, "enabled", False) else None
+        self.queue = _WfqQueue(self.qos) if self.qos is not None \
+            else _ReqQueue()
         self.workers: list[threading.Thread] = []
         self._stopping = False
         # Approximate in-flight batch count for the tpu_inflight_batches
@@ -500,7 +686,19 @@ class DefaultScheduler(Scheduler):
         deadline_ns = now_ns() + dyn.max_queue_delay_microseconds * 1000
         batch = [first]
         total = _request_batch(first)
+        # Preemption: a batch-lane gather yields to a waiting
+        # interactive (preempt-class) request by splitting here instead
+        # of filling the wave — the partial batch executes now and the
+        # interactive request leads the next pop.
+        preemptable = (
+            self.qos is not None and isinstance(self.queue, _WfqQueue)
+            and not self.qos.is_preempt(getattr(first, "qos_class", "")))
         while total < prefer:
+            if preemptable:
+                pend = self.queue.preempt_pending()
+                if pend is not None:
+                    self.qos.note_preemption(cfg.name, pend)
+                    break
             # Within the delay window this blocks for arrivals; past it
             # (timeout 0) it only drains what is already queued — the delay
             # bounds *waiting*, not backlog draining (Triton max_queue_delay
@@ -532,11 +730,14 @@ class DefaultScheduler(Scheduler):
                     # preferred size mid-slab) or this request doesn't fit:
                     # push it and everything behind it back to the *head* of
                     # their levels (reverse order keeps FIFO) so the next
-                    # gather starts with them.
+                    # gather starts with them. A pushed-back request whose
+                    # deadline already lapsed fails here as a stage=queue
+                    # expiry — requeueing a dead request would only spend
+                    # another pop on it next wave.
                     for later in reversed(items[idx:]):
                         if later is _SHUTDOWN:
                             self.queue.put(_SHUTDOWN, _SHUTDOWN_LEVEL)
-                        else:
+                        elif not self._check_deadline(later):
                             self.queue.put_front(
                                 later, self._priority_level(later))
                     stop = True
@@ -807,7 +1008,8 @@ def _compatible(a: InferRequest, b: InferRequest) -> bool:
 
 def make_scheduler(model: Model, stats: ModelStats,
                    sequence_cls: Callable | None = None,
-                   ensemble_cls: Callable | None = None, **kw) -> Scheduler:
+                   ensemble_cls: Callable | None = None,
+                   qos=None, **kw) -> Scheduler:
     kind = model.config.scheduler_kind()
     if kind in ("ENSEMBLE", "ENSEMBLE_SEQUENCE"):
         if ensemble_cls is None:
@@ -829,5 +1031,5 @@ def make_scheduler(model: Model, stats: ModelStats,
         # Ragged DLRM batching: gather by summed lookup count, not rows.
         from client_tpu.engine.ragged import RaggedScheduler
 
-        return RaggedScheduler(model, stats)
-    return DefaultScheduler(model, stats)
+        return RaggedScheduler(model, stats, qos=qos)
+    return DefaultScheduler(model, stats, qos=qos)

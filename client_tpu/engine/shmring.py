@@ -64,6 +64,7 @@ from client_tpu.engine.shm import _SysRegion, shm_path
 from client_tpu.engine.types import EngineError, InferRequest, OutputRequest
 from client_tpu.protocol.codec import serialize_tensor
 from client_tpu.protocol.dtypes import np_to_wire_dtype
+from client_tpu.protocol.pushback import format_slot_error
 from client_tpu.utils.shm_ring import (
     HEADER_BYTES,
     OFF_HEAD,
@@ -650,8 +651,14 @@ class RingShmManager:
                 req.set_deadline_from_timeout_ms(parsed["timeout_ms"])
             submit(req, self._completion(ring, slot))
         except AdmissionError as exc:
-            self._finish_slot(ring, slot, None, str(exc),
-                              outcome="backpressured")
+            # The slot channel has no header side channel for pushback,
+            # so the Retry-After rides the error string — producers
+            # (tools/replay.py) parse it back out to pace their backoff.
+            self._finish_slot(
+                ring, slot, None,
+                format_slot_error(str(exc),
+                                  getattr(exc, "retry_after_s", None)),
+                outcome="backpressured")
             return "backpressured"
         except Exception as exc:  # noqa: BLE001 — per-slot isolation
             self._finish_slot(ring, slot, None, str(exc),

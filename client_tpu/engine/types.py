@@ -103,6 +103,10 @@ class InferRequest:
     # slot header. Empty means untagged — the engine resolves it to
     # "shadow" (admission shadow class) or "default" at submit.
     tenant: str = ""
+    # QoS class name (client_tpu.admission.qos): stamped by the engine
+    # at admission from the tenant/priority via QosController.classify;
+    # the scheduler's WFQ queue lanes requests by it. Empty = QoS off.
+    qos_class: str = ""
     # Assigned by the scheduler under preserve_ordering (arrival index).
     arrival_seq: int | None = None
     timeout_us: int = 0

@@ -649,6 +649,19 @@ class InferenceServerClient:
             self._md(headers), client_timeout)
         return json.loads(response.costs_json)
 
+    def get_qos_status(self, model_name="", headers=None,
+                       client_timeout=None):
+        """Tenant QoS status (gRPC mirror of ``GET /v2/qos``): class
+        weights, quotas, governor throttle ratios, and per-model WFQ
+        lane depths."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        response = self._unary(
+            self._client_stub.Qos,
+            ops.QosRequest(model=model_name),
+            self._md(headers), client_timeout)
+        return json.loads(response.qos_json)
+
     # -- fleet observability (client-side federation) -------------------------
     # gRPC has no fronting router, so the multi-URL client federates the
     # per-endpoint surfaces itself with the same merge semantics the

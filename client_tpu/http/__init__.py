@@ -930,6 +930,17 @@ class InferenceServerClient:
             qp["model"] = model_name
         return self._get_json("/v2/costs", qp or None, headers)
 
+    def get_qos_status(self, model_name="", headers=None,
+                       query_params=None):
+        """Tenant QoS status (``GET /v2/qos``): the class table (WFQ
+        weights, token-bucket quotas, governor throttle ratios,
+        inflight and shed/preemption tallies) plus per-model WFQ lane
+        depths. ``model_name`` narrows the lane depths to one model."""
+        qp = dict(query_params or {})
+        if model_name:
+            qp["model"] = model_name
+        return self._get_json("/v2/qos", qp or None, headers)
+
     # -- fleet observability (router endpoints) ------------------------------
 
     def get_fleet_events(self, limit=None, headers=None, query_params=None):

@@ -455,6 +455,25 @@ class EngineMetrics:
             "tpu_drain_duration_seconds",
             "Wall time of the last graceful drain (0 until one runs)")
         self.drain_duration.set(0.0)
+        self.qos_sheds = r.counter(
+            "tpu_qos_sheds_total",
+            "Requests shed by a per-class QoS gate, by class and reason "
+            "(qos_inflight, qos_queue, qos_throttled)",
+            ("qos_class", "reason"))
+        self.qos_inflight = r.gauge(
+            "tpu_qos_inflight",
+            "Admitted-but-unfinished requests per QoS class",
+            ("qos_class",))
+        self.qos_throttle_ratio = r.gauge(
+            "tpu_qos_throttle_ratio",
+            "Governor throttle ratio per QoS class (1 = full configured "
+            "token-bucket rate; the SLO-burn governor halves it per step)",
+            ("qos_class",))
+        self.qos_preemptions = r.counter(
+            "tpu_qos_preemptions_total",
+            "In-assembly batches split because a preempt-class request "
+            "arrived (WFQ preemption)",
+            ("model",))
         self._instruments: dict[tuple[str, str], ModelInstruments] = {}
         self._lock = lockdep.Lock("metrics.instruments")
 

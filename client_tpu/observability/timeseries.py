@@ -61,7 +61,8 @@ ENV_VAR = "CLIENT_TPU_TIMESERIES"
 # (`tenant_cost_rate` reuses the map machinery with TENANT keys: each
 # tenant's device-seconds-per-second — its share of device occupancy —
 # from cost-ledger deltas.)
-SCALAR_SIGNALS = ("duty_cycle", "hbm_used", "hbm_reserved")
+SCALAR_SIGNALS = ("duty_cycle", "hbm_used", "hbm_reserved",
+                  "qos_throttled")
 MODEL_SIGNALS = ("queue_depth", "in_flight", "batch_fill", "shed_rate",
                  "wave_p50_ms", "slo_burn", "tenant_cost_rate")
 SIGNALS = SCALAR_SIGNALS + MODEL_SIGNALS

@@ -77,6 +77,8 @@ _METHODS = [
     ("MemoryCensus", ops.MemoryRequest, ops.MemoryResponse, False),
     # Per-tenant cost ledger (gRPC mirror of /v2/costs).
     ("Costs", ops.CostsRequest, ops.CostsResponse, False),
+    # Tenant QoS status (gRPC mirror of /v2/qos).
+    ("Qos", ops.QosRequest, ops.QosResponse, False),
 ]
 
 

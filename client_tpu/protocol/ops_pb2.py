@@ -46,6 +46,8 @@ the message classes. Wire-compatible with the equivalent .proto:
     message MemoryResponse     { string memory_json = 1; }
     message CostsRequest       { string model = 1; }
     message CostsResponse      { string costs_json = 1; }
+    message QosRequest         { string model = 1; }
+    message QosResponse        { string qos_json = 1; }
 
 Event.detail_json / SloStatusResponse.slo_json /
 ProfileResponse.profile_json carry the open-ended detail/report dicts as
@@ -193,6 +195,14 @@ def _file_proto() -> _descriptor_pb2.FileDescriptorProto:
     m = message("CostsResponse")
     field(m, "costs_json", 1, _F.TYPE_STRING)
 
+    # Tenant QoS status (the /v2/qos body rides as JSON, same pattern
+    # as slo/profile/memory/costs).
+    m = message("QosRequest")
+    field(m, "model", 1, _F.TYPE_STRING)
+
+    m = message("QosResponse")
+    field(m, "qos_json", 1, _F.TYPE_STRING)
+
     return fdp
 
 
@@ -234,4 +244,6 @@ __all__ = [
     "MemoryResponse",
     "CostsRequest",
     "CostsResponse",
+    "QosRequest",
+    "QosResponse",
 ]

@@ -21,9 +21,17 @@ the other. Both servers and both clients now route through here:
   "no pushback", never as "retry immediately").
 * :func:`parse_pushback_metadata` — the gRPC client's trailing-metadata
   view (``retry-after`` preferred, ``retry-pushback-ms`` fallback).
+* :func:`format_slot_error` / :func:`parse_slot_error_retry_after` —
+  the shm-ring slot channel. A shed slot carries only an error string
+  (there is no header/metadata side channel in the segment), so the
+  pushback rides as a machine-parseable ``[retry-after=1.500s]`` suffix
+  producers strip back out. Same 3-decimal canonical text as the HTTP
+  header.
 """
 
 from __future__ import annotations
+
+import re
 
 __all__ = [
     "RETRY_AFTER_HEADER",
@@ -33,6 +41,8 @@ __all__ = [
     "format_retry_pushback_ms",
     "parse_retry_after",
     "parse_pushback_metadata",
+    "format_slot_error",
+    "parse_slot_error_retry_after",
 ]
 
 RETRY_AFTER_HEADER = "Retry-After"
@@ -97,3 +107,22 @@ def parse_pushback_metadata(meta) -> float | None:
         return value
     ms = parse_retry_after(meta.get(RETRY_PUSHBACK_MS_METADATA_KEY))
     return ms / 1000.0 if ms is not None else None
+
+
+_SLOT_RETRY_AFTER_RE = re.compile(r" \[retry-after=(\d+(?:\.\d+)?)s\]$")
+
+
+def format_slot_error(message: str, retry_after_s: float | None) -> str:
+    """Fold a pushback interval into a shm-ring slot error string."""
+    if retry_after_s is None:
+        return message
+    return f"{message} [retry-after={format_retry_after_s(retry_after_s)}s]"
+
+
+def parse_slot_error_retry_after(error) -> float | None:
+    """Pushback seconds from a slot error string, or None when the error
+    carries no ``[retry-after=...s]`` suffix (non-admission failures)."""
+    if not error:
+        return None
+    m = _SLOT_RETRY_AFTER_RE.search(str(error))
+    return parse_retry_after(m.group(1)) if m else None

@@ -412,6 +412,14 @@ class _Servicer(GRPCInferenceServiceServicer):
         snap = self.engine.costs_snapshot(model=request.model or None)
         return ops.CostsResponse(costs_json=json.dumps(snap))
 
+    def Qos(self, request, context):  # noqa: N802
+        """gRPC mirror of ``GET /v2/qos``: the tenant QoS class table
+        (weights, quotas, throttle ratios) + WFQ lane depths as JSON."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        snap = self.engine.qos_snapshot(model=request.model or None)
+        return ops.QosResponse(qos_json=json.dumps(snap))
+
     # -- shm slot ring (zero-copy data plane; engine.shmring) ---------------
 
     def RingRegister(self, request, context):  # noqa: N802

@@ -85,6 +85,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/v2/slo$"), "slo"),
     ("GET", re.compile(r"^/v2/profile$"), "profile"),
     ("GET", re.compile(r"^/v2/costs$"), "costs"),
+    ("GET", re.compile(r"^/v2/qos$"), "qos"),
     ("GET", re.compile(r"^/v2/timeseries$"), "timeseries"),
     ("GET", re.compile(r"^/v2/memory$"), "memory"),
     ("GET", re.compile(r"^/v2/load$"), "load"),
@@ -397,6 +398,17 @@ class _Handler(BaseHTTPRequestHandler):
         q = parse_qs(urlparse(self.path).query)
         model = (q.get("model") or [None])[0]
         self._send_json(self.engine.costs_snapshot(model=model))
+
+    def h_qos(self):
+        """Tenant QoS status (``/v2/qos``): the class table (weights,
+        quotas, governor throttle ratios, inflight, shed/preemption
+        tallies) plus per-model WFQ lane depths. ``?model=`` narrows
+        the lane depths to one model."""
+        from urllib.parse import parse_qs, urlparse
+
+        q = parse_qs(urlparse(self.path).query)
+        model = (q.get("model") or [None])[0]
+        self._send_json(self.engine.qos_snapshot(model=model))
 
     def h_timeseries(self):
         """Flight-recorder export (``/v2/timeseries``): the 1 Hz signal

@@ -84,6 +84,11 @@ DECLARED_ORDER: dict[str, int] = {
     # re-enters the state lock for each phase).
     "engine.repository.load": 150,
     "engine.repository": 200,
+    # QoS controller: admission-side class gates + governor. Taken
+    # before any scheduler queue lock (classification happens at admit,
+    # never under a queue condition); holds admission.bucket (unranked)
+    # across governor rate retargets.
+    "qos.controller": 250,
     # data plane (request flow)
     "scheduler.queue": 300,
     "scheduler.order": 310,

@@ -546,10 +546,17 @@ class RingProducer:
         self._meta = None
         return self._client.ring_doorbell(self.name, spec, headers=headers)
 
-    def reap(self, timeout_s: float = 10.0, copy: bool = True):
+    def reap(self, timeout_s: float = 10.0, copy: bool = True,
+             spin_sleep_s: float | None = None):
         """Wait for the oldest outstanding slot; returns
-        ``(slot, outputs, error)`` with the slot released."""
-        slot = self.ring.poll(timeout_s=timeout_s)
+        ``(slot, outputs, error)`` with the slot released.
+        ``spin_sleep_s`` is forwarded to :meth:`RingBuffer.poll` —
+        background/shadow producers should pass a coarse interval
+        (milliseconds): they don't need reap latency, and a fleet of
+        them at the default 100 us backoff measurably steals host CPU
+        from the live plane it is supposed to shadow."""
+        slot = self.ring.poll(timeout_s=timeout_s,
+                              spin_sleep_s=spin_sleep_s)
         outputs, error = self.ring.read_response(slot, copy=copy)
         self.ring.release(slot)
         self.ring.beat()

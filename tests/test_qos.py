@@ -1,0 +1,783 @@
+"""Tenant QoS — unit and e2e coverage.
+
+The QoS system layered over admission and scheduling: the
+``CLIENT_TPU_QOS`` config grammar (fail-fast on typos), tenant/priority
+classification, per-class gates (quota bucket, inflight, queue depth)
+with class-aware Retry-After pushback, the WFQ deficit-round-robin
+queue (weight ratios, preemption rotation, requeue), the SLO-burn
+governor's throttle/restore edges against stub SLO/cost feeds, the
+shm slot-error pushback suffix, the ``/v2/qos`` surface on HTTP and
+gRPC, and a chaos probe asserting live-p99 isolation under a full
+shadow load.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from client_tpu.admission import MIN_RETRY_AFTER_S, AdmissionError
+from client_tpu.admission.qos import (
+    ENV_VAR,
+    QosClassConfig,
+    QosConfig,
+    QosController,
+)
+from client_tpu.engine import InferRequest, TpuEngine
+from client_tpu.engine.config import (
+    DynamicBatchingConfig,
+    ModelConfig,
+    TensorConfig,
+)
+from client_tpu.engine.model import ModelBackend
+from client_tpu.engine.repository import ModelRepository
+from client_tpu.engine.scheduler import _WfqQueue
+from client_tpu.observability.events import journal
+from client_tpu.protocol.pushback import (
+    format_slot_error,
+    parse_slot_error_retry_after,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _config(**over) -> QosConfig:
+    spec = {
+        "classes": {
+            "interactive": {"weight": 8, "preempt": True, "protect": True},
+            "batch": {"weight": 2, "priority_level": 4,
+                      "tokens_per_s": 10.0, "burst": 2.0,
+                      "max_inflight": 2, "max_queue_depth": 4},
+            "shadow": {"weight": 1, "min_priority": 8},
+        },
+        "tenants": {"etl": "batch"},
+        "default_class": "interactive",
+    }
+    spec.update(over)
+    return QosConfig.from_dict(spec)
+
+
+class TestQosConfig:
+    def test_unknown_config_key_fails_fast(self):
+        with pytest.raises(ValueError, match="unknown qos config keys"):
+            QosConfig.from_dict({"clases": {}})
+
+    def test_unknown_class_key_fails_fast(self):
+        with pytest.raises(ValueError, match="unknown qos class keys"):
+            QosConfig.from_dict(
+                {"classes": {"a": {"tokens_per_sec": 10}}})
+
+    def test_weight_must_be_positive(self):
+        with pytest.raises(ValueError, match="weight must be > 0"):
+            QosClassConfig.from_dict("a", {"weight": 0})
+
+    def test_tenant_must_map_to_declared_class(self):
+        with pytest.raises(ValueError, match="undeclared class"):
+            QosConfig.from_dict({"classes": {"a": {}},
+                                 "tenants": {"t": "nope"}})
+
+    def test_default_class_must_be_declared(self):
+        with pytest.raises(ValueError, match="not declared"):
+            QosConfig.from_dict({"classes": {"a": {}},
+                                 "default_class": "nope"})
+
+    def test_default_class_fallback_prefers_declared_default(self):
+        cfg = QosConfig.from_dict(
+            {"classes": {"default": {}, "big": {"weight": 99}}})
+        assert cfg.default_class == "default"
+
+    def test_default_class_fallback_highest_weight_ties_by_name(self):
+        cfg = QosConfig.from_dict(
+            {"classes": {"a": {"weight": 2}, "b": {"weight": 2},
+                         "c": {"weight": 1}}})
+        assert cfg.default_class == "b"
+
+    def test_from_env_inline_and_disabled(self):
+        cfg = QosConfig.from_env(
+            {ENV_VAR: json.dumps({"classes": {"a": {"weight": 3}}})})
+        assert cfg.enabled and cfg.classes["a"].weight == 3
+        assert not QosConfig.from_env({}).enabled
+
+    def test_from_env_at_file(self, tmp_path):
+        p = tmp_path / "qos.json"
+        p.write_text(json.dumps({"classes": {"x": {}}}))
+        cfg = QosConfig.from_env({ENV_VAR: f"@{p}"})
+        assert "x" in cfg.classes
+
+
+class TestClassify:
+    def test_tenant_table_wins_over_priority_band(self):
+        qos = QosController(_config())
+        assert qos.classify("etl", 8) == "batch"
+
+    def test_priority_band_picks_tightest(self):
+        qos = QosController(_config(classes={
+            "lo": {"min_priority": 4},
+            "hi": {"min_priority": 8},
+            "interactive": {"weight": 8},
+        }, tenants={}, default_class="interactive"))
+        assert qos.classify("", 4) == "lo"
+        assert qos.classify("", 9) == "hi"
+        assert qos.classify("", 0) == "interactive"
+
+    def test_unmapped_tenant_falls_to_default(self):
+        qos = QosController(_config())
+        assert qos.classify("unknown", 0) == "interactive"
+
+    def test_disabled_controller_returns_empty(self):
+        assert QosController(QosConfig()).classify("etl", 8) == ""
+
+
+class TestAdmitGates:
+    def test_inflight_cap_sheds_with_reason(self):
+        qos = QosController(_config())
+        qos.on_request_start("batch")
+        qos.on_request_start("batch")
+        with pytest.raises(AdmissionError) as exc:
+            qos.admit("m", "batch")
+        assert exc.value.reason == "qos_inflight"
+        qos.on_request_end("batch")
+        snap = qos.snapshot()["classes"]["batch"]
+        assert snap["sheds"] == 1 and snap["inflight"] == 1
+
+    def test_queue_cap_sheds_with_reason(self):
+        qos = QosController(_config())
+        with pytest.raises(AdmissionError) as exc:
+            qos.admit("m", "batch", class_queue_depth=4)
+        assert exc.value.reason == "qos_queue"
+
+    def test_bucket_throttles_and_refills_on_fake_clock(self):
+        clk = FakeClock()
+        qos = QosController(_config(), clock=clk)
+        qos.admit("m", "batch")
+        qos.admit("m", "batch")  # burst of 2
+        with pytest.raises(AdmissionError) as exc:
+            qos.admit("m", "batch")
+        assert exc.value.reason == "qos_throttled"
+        # Deficit of one token at 10/s -> 0.1s of honest pushback.
+        assert exc.value.retry_after_s == pytest.approx(0.1)
+        clk.advance(0.1)
+        qos.admit("m", "batch")
+
+    def test_class_aware_pushback_uses_bucket_refill(self):
+        clk = FakeClock()
+        qos = QosController(_config(), clock=clk)
+        qos.admit("m", "batch")
+        qos.admit("m", "batch")  # bucket drained
+        qos.on_request_start("batch")
+        qos.on_request_start("batch")
+        with pytest.raises(AdmissionError) as exc:
+            qos.admit("m", "batch")  # inflight shed, bucket-derived wait
+        assert exc.value.reason == "qos_inflight"
+        assert exc.value.retry_after_s == pytest.approx(0.1)
+
+    def test_pushback_floor_without_bucket(self):
+        # A class with a queue cap but no token bucket has no refill
+        # time to advertise; the shed falls back to the global floor.
+        qos = QosController(QosConfig.from_dict(
+            {"classes": {"capped": {"max_queue_depth": 1}}}))
+        with pytest.raises(AdmissionError) as exc:
+            qos.admit("m", "capped", class_queue_depth=1)
+        assert exc.value.reason == "qos_queue"
+        assert exc.value.retry_after_s == pytest.approx(
+            MIN_RETRY_AFTER_S)
+
+    def test_uncapped_class_admits_everything(self):
+        qos = QosController(_config())
+        for _ in range(100):
+            qos.admit("m", "interactive", class_queue_depth=10**6)
+
+    def test_unknown_class_is_a_noop(self):
+        qos = QosController(_config())
+        qos.admit("m", "nope")
+        qos.on_request_start("nope")
+        qos.on_request_end("nope")
+
+    def test_class_gate_runs_before_shared_gate(self):
+        # Class caps and the shared gates compose: the class lane cap
+        # sheds first (reason qos_queue), and a request the class
+        # admits can still be shed by the shared depth gate.
+        from client_tpu.admission import (
+            AdmissionConfig,
+            AdmissionController,
+        )
+
+        ctrl = AdmissionController(
+            AdmissionConfig.from_dict({"max_queue_depth": 10}))
+        ctrl.attach_qos(QosController(_config()))
+        with pytest.raises(AdmissionError) as exc:
+            ctrl.admit("m", queue_depth=10, qos_class="batch",
+                       class_queue_depth=4)
+        assert exc.value.reason == "qos_queue"
+        with pytest.raises(AdmissionError) as exc:
+            ctrl.admit("m", queue_depth=10, qos_class="batch",
+                       class_queue_depth=0)
+        assert exc.value.reason == "queue_depth"
+        ctrl.admit("m", queue_depth=9, qos_class="batch",
+                   class_queue_depth=0)
+
+
+class _StubSlo:
+    def __init__(self):
+        self.burning = []
+
+    def fast_burn(self):
+        return list(self.burning)
+
+
+class _StubCosts:
+    def __init__(self):
+        self.tenants = {}
+
+    def snapshot(self):
+        return {"tenants": self.tenants}
+
+
+def _qos_events(name, since):
+    return [e for e in journal().snapshot(category="qos")
+            if e.name == name and e.seq > since]
+
+
+class TestGovernor:
+    def _controller(self, clk):
+        return QosController(_config(
+            tenants={"etl": "batch", "replay": "shadow"},
+            restore_hold_s=5.0), clock=clk)
+
+    def test_throttle_and_restore_edges_journal_once(self):
+        clk = FakeClock()
+        qos = self._controller(clk)
+        slo, costs = _StubSlo(), _StubCosts()
+        cursor = journal().export(limit=0)["next_seq"]
+
+        slo.burning = ["batch_net"]
+        costs.tenants = {"etl": {"device_s": 5.0, "host_s": 1.0}}
+        assert qos.governor_tick(slo, costs) == "batch"
+        snap = qos.snapshot()["classes"]["batch"]
+        assert snap["throttle_ratio"] == pytest.approx(0.5)
+        assert snap["effective_rate"] == pytest.approx(5.0)
+        assert len(_qos_events("throttle", cursor)) == 1
+
+        # Still burning: tighten again, but the journal edge fired once.
+        clk.advance(1.0)
+        costs.tenants = {"etl": {"device_s": 9.0, "host_s": 2.0}}
+        assert qos.governor_tick(slo, costs) == "batch"
+        assert qos.snapshot()["classes"]["batch"]["throttle_ratio"] \
+            == pytest.approx(0.25)
+        assert len(_qos_events("throttle", cursor)) == 1
+        assert qos.throttled_classes() == ["batch"]
+
+        # Burn clears: nothing moves inside the hold window...
+        slo.burning = []
+        clk.advance(1.0)
+        assert qos.governor_tick(slo, costs) is None
+        assert qos.snapshot()["classes"]["batch"]["throttle_ratio"] \
+            == pytest.approx(0.25)
+        # ...then one step per tick back up; qos.restore only on the
+        # ratio-reaches-1.0 edge.
+        clk.advance(5.0)
+        assert qos.governor_tick(slo, costs) == "batch"
+        assert not _qos_events("restore", cursor)
+        assert qos.governor_tick(slo, costs) == "batch"
+        assert qos.snapshot()["classes"]["batch"]["throttle_ratio"] \
+            == pytest.approx(1.0)
+        assert len(_qos_events("restore", cursor)) == 1
+        assert qos.throttled_classes() == []
+
+    def test_rate_floors_at_min_rate_ratio(self):
+        clk = FakeClock()
+        qos = self._controller(clk)
+        slo, costs = _StubSlo(), _StubCosts()
+        slo.burning = ["m"]
+        costs.tenants = {"etl": {"device_s": 1.0}}
+        for i in range(10):
+            clk.advance(1.0)
+            costs.tenants = {"etl": {"device_s": 1.0 + i}}
+            qos.governor_tick(slo, costs)
+        snap = qos.snapshot()["classes"]["batch"]
+        assert snap["throttle_ratio"] == pytest.approx(
+            qos.config.min_rate_ratio)
+
+    def test_protected_class_is_never_the_victim(self):
+        clk = FakeClock()
+        qos = QosController(_config(classes={
+            "interactive": {"weight": 8, "protect": True,
+                            "tokens_per_s": 100.0},
+            "batch": {"weight": 2, "tokens_per_s": 10.0},
+        }, tenants={"live": "interactive", "etl": "batch"},
+            default_class="interactive"), clock=clk)
+        slo, costs = _StubSlo(), _StubCosts()
+        slo.burning = ["m"]
+        # Interactive grows far faster, but it is protected.
+        costs.tenants = {"live": {"device_s": 100.0},
+                         "etl": {"device_s": 1.0}}
+        assert qos.governor_tick(slo, costs) == "batch"
+        assert qos.snapshot()["classes"]["interactive"][
+            "throttle_ratio"] == pytest.approx(1.0)
+
+    def test_victim_is_highest_occupancy_growth(self):
+        clk = FakeClock()
+        qos = QosController(_config(classes={
+            "a": {"tokens_per_s": 10.0},
+            "b": {"tokens_per_s": 10.0},
+        }, tenants={"ta": "a", "tb": "b"}, default_class="a"),
+            clock=clk)
+        slo, costs = _StubSlo(), _StubCosts()
+        slo.burning = ["m"]
+        costs.tenants = {"ta": {"device_s": 1.0},
+                         "tb": {"device_s": 4.0}}
+        assert qos.governor_tick(slo, costs) == "b"
+
+    def test_throttle_without_bucket_is_refused(self):
+        qos = QosController(_config())
+        assert not qos.throttle("shadow")   # no bucket to tighten
+        assert not qos.throttle("interactive")  # protected
+        assert not qos.restore("batch")     # not throttled
+
+
+def _req(cls_name, seq=0):
+    r = InferRequest(model_name="m",
+                     inputs={"INPUT": np.zeros((1, 2), np.float32)})
+    r.qos_class = cls_name
+    r.parameters = {"seq": seq}
+    return r
+
+
+class TestWfqQueue:
+    def _queue(self, classes):
+        qos = QosController(QosConfig.from_dict(
+            {"classes": classes, "default_class": list(classes)[0]}))
+        return _WfqQueue(qos)
+
+    def test_served_mix_converges_to_weight_ratio(self):
+        q = self._queue({"a": {"weight": 3}, "b": {"weight": 1}})
+        for i in range(120):
+            q.put(_req("a", i))
+        for i in range(120):
+            q.put(_req("b", i))
+        served = {"a": 0, "b": 0}
+        for _ in range(20):
+            for item in q.get_many(4, timeout=0):
+                served[item.qos_class] += 1
+        # 80 pops under saturation of both lanes: 3:1 within +-10%.
+        assert served["a"] + served["b"] == 80
+        ratio = served["a"] / max(1, served["b"])
+        assert 2.7 <= ratio <= 3.3
+
+    def test_fifo_within_a_lane(self):
+        q = self._queue({"a": {}})
+        for i in range(5):
+            q.put(_req("a", i))
+        got = [r.parameters["seq"] for r in q.get_many(5, timeout=0)]
+        assert got == [0, 1, 2, 3, 4]
+
+    def test_put_front_leads_its_lane(self):
+        q = self._queue({"a": {}})
+        q.put(_req("a", 1))
+        q.put(_req("a", 2))
+        q.put_front(_req("a", 0))
+        got = [r.parameters["seq"] for r in q.get_many(3, timeout=0)]
+        assert got == [0, 1, 2]
+
+    def test_preempt_arrival_resets_rotation(self):
+        q = self._queue({"batch": {"weight": 4},
+                         "inter": {"weight": 1, "preempt": True}})
+        for i in range(8):
+            q.put(_req("batch", i))
+        q.put(_req("inter", 99))
+        first = q.get_many(1, timeout=0)[0]
+        assert first.qos_class == "inter"
+
+    def test_preempt_pending_reports_waiting_lane(self):
+        q = self._queue({"batch": {}, "inter": {"preempt": True}})
+        assert q.preempt_pending() is None
+        q.put(_req("batch", 0))
+        assert q.preempt_pending() is None
+        q.put(_req("inter", 1))
+        assert q.preempt_pending() == "inter"
+        q.get_many(2, timeout=0)
+        assert q.preempt_pending() is None
+
+    def test_class_qsize_and_unknown_class_folds_to_default(self):
+        q = self._queue({"a": {}, "b": {}})
+        q.put(_req("a", 0))
+        q.put(_req("nope", 1))  # undeclared -> default lane
+        assert q.class_qsize("a") == 2
+        assert q.class_qsize("b") == 0
+        assert q.class_qsize("missing") == 0
+        assert q.qsize() == 2
+
+
+class TestSlotErrorPushback:
+    def test_round_trip(self):
+        msg = format_slot_error("qos class 'batch' throttled", 1.5)
+        assert msg.endswith("[retry-after=1.500s]")
+        assert parse_slot_error_retry_after(msg) == pytest.approx(1.5)
+
+    def test_none_retry_after_leaves_message_alone(self):
+        assert format_slot_error("boom", None) == "boom"
+        assert parse_slot_error_retry_after("boom") is None
+        assert parse_slot_error_retry_after("") is None
+        assert parse_slot_error_retry_after(None) is None
+
+    def test_sub_millisecond_floors_not_zero(self):
+        msg = format_slot_error("shed", 0.0004)
+        assert parse_slot_error_retry_after(msg) > 0.0
+
+
+QOS_SPEC = {
+    "classes": {
+        "interactive": {"weight": 8, "preempt": True, "protect": True},
+        "batch": {"weight": 1, "priority_level": 4},
+    },
+    "tenants": {"live": "interactive", "etl": "batch"},
+    "default_class": "interactive",
+}
+
+
+class _Gate:
+    def __init__(self):
+        self.enabled = False
+        self.release = threading.Event()
+        self.running = threading.Event()
+
+    def reset(self):
+        self.enabled = False
+        self.release.set()
+        self.release = threading.Event()
+        self.running = threading.Event()
+
+
+def _engine(gate=None, dim=4, mb=8, delay_us=200):
+    class GatedIdentity(ModelBackend):
+        jittable = False
+
+        def __init__(self):
+            self.config = ModelConfig(
+                name="m", platform="jax", max_batch_size=mb,
+                input=[TensorConfig("INPUT", "FP32", [-1])],
+                output=[TensorConfig("OUTPUT", "FP32", [-1])],
+                dynamic_batching=DynamicBatchingConfig(
+                    preferred_batch_size=[mb],
+                    max_queue_delay_microseconds=delay_us),
+                instance_count=1)
+
+        def make_apply(self):
+            def apply(inputs):
+                if gate is not None and gate.enabled:
+                    rel = gate.release
+                    gate.running.set()
+                    rel.wait(60)
+                return {"OUTPUT": inputs["INPUT"]}
+            return apply
+
+    repo = ModelRepository()
+    repo.register_backend(GatedIdentity())
+    qos = QosController(QosConfig.from_dict(QOS_SPEC))
+    return TpuEngine(repo, warmup=False, qos=qos)
+
+
+def _submit(engine, tenant, width=4, deadline_ms=0, priority=0):
+    done = threading.Event()
+    out = {}
+
+    def cb(resp):
+        out["error"] = resp.error
+        done.set()
+
+    req = InferRequest(
+        model_name="m", tenant=tenant, priority=priority,
+        inputs={"INPUT": np.ones((1, width), np.float32)})
+    if deadline_ms:
+        req.set_deadline_from_timeout_ms(deadline_ms)
+    engine.async_infer(req, cb)
+    return done, out
+
+
+class TestEngineIntegration:
+    def test_class_priority_level_stamped(self):
+        engine = _engine()
+        try:
+            assert engine.qos.classify("etl", 0) == "batch"
+            done, out = _submit(engine, "etl")
+            assert done.wait(10) and out["error"] is None
+            # priority_level mapping rode admission: batch lane saw it.
+            snap = engine.qos_snapshot()
+            assert snap["classes"]["batch"]["tenants"] == ["etl"]
+        finally:
+            engine.shutdown()
+
+    def test_gather_preempts_batch_for_interactive_arrival(self):
+        gate = _Gate()
+        engine = _engine(gate=gate, delay_us=300_000)
+        try:
+            sched = engine._schedulers["m"]
+            q = sched.queue
+            orig_get_many = q.get_many
+            injected = []
+
+            def get_many(max_items, timeout=None):
+                items = orig_get_many(max_items, timeout=timeout)
+                if not injected:
+                    # An interactive request lands right after this
+                    # slab pops — the gather's next loop-top check
+                    # must split the batch instead of waiting out the
+                    # 300ms delay window.
+                    injected.append(_submit(engine, "live"))
+                return items
+
+            gate.enabled = True
+            done0, _ = _submit(engine, "etl")
+            assert gate.running.wait(10)  # worker parked on the first
+            done1, _ = _submit(engine, "etl")
+            done2, _ = _submit(engine, "etl")
+            q.get_many = get_many
+            gate.enabled = False
+            gate.release.set()
+            t0 = time.monotonic()
+            for d in (done0, done1, done2):
+                assert d.wait(10)
+            deadline = time.monotonic() + 10
+            while not injected and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert injected, "gather never popped a slab"
+            assert injected[0][0].wait(10)
+            elapsed = time.monotonic() - t0
+            snap = engine.qos_snapshot()
+            assert snap["classes"]["interactive"]["preemptions"] >= 1
+            # The split batch must not have waited out the full delay.
+            assert elapsed < 5.0
+        finally:
+            gate.reset()
+            engine.shutdown()
+
+    def test_requeued_request_expires_as_queue_stage(self):
+        gate = _Gate()
+        engine = _engine(gate=gate, mb=4, delay_us=200)
+        try:
+            sched = engine._schedulers["m"]
+            gate.enabled = True
+            done0, _ = _submit(engine, "etl", width=4)
+            assert gate.running.wait(10)
+            # While the worker is parked: a compatible request, an
+            # incompatible one (width 5 can't batch with width 4), and
+            # a short-deadline request QUEUED BEHIND the incompatible
+            # one. The gather pops [w5, w6-short]: w5 breaks the batch
+            # and the requeue loop re-checks w6's deadline — by then
+            # expired — so it must fail as a stage=queue expiry
+            # instead of riding another wave.
+            done1, out1 = _submit(engine, "etl", width=4)
+            done2, out2 = _submit(engine, "etl", width=5)
+            done3, out3 = _submit(engine, "etl", width=6,
+                                  deadline_ms=150)
+            before = sched.stats.deadline_expired_count
+            time.sleep(0.3)  # let the 150ms budget lapse while parked
+            gate.enabled = False
+            gate.release.set()
+            for d in (done0, done1, done2, done3):
+                assert d.wait(10)
+            assert out1["error"] is None
+            assert out2["error"] is None
+            assert out3["error"] is not None
+            assert "deadline" in str(out3["error"]).lower()
+            assert sched.stats.deadline_expired_count > before
+        finally:
+            gate.reset()
+            engine.shutdown()
+
+
+class TestQosEndpoints:
+    @pytest.fixture(scope="class")
+    def stack(self):
+        from client_tpu.server import (
+            GrpcInferenceServer,
+            HttpInferenceServer,
+        )
+        engine = _engine()
+        http_srv = HttpInferenceServer(engine, port=0).start()
+        grpc_srv = GrpcInferenceServer(engine, port=0).start()
+        yield {"engine": engine, "http": http_srv,
+               "grpc_url": f"127.0.0.1:{grpc_srv.port}"}
+        http_srv.stop()
+        grpc_srv.stop()
+        engine.shutdown()
+
+    def test_http_endpoint_and_client(self, stack):
+        from urllib.request import urlopen
+
+        import client_tpu.http as httpclient
+
+        raw = json.load(urlopen(
+            f"http://{stack['http'].url}/v2/qos", timeout=10))
+        assert raw["enabled"] and raw["default_class"] == "interactive"
+        assert raw["classes"]["interactive"]["weight"] == 8
+        c = httpclient.InferenceServerClient(stack["http"].url)
+        try:
+            out = c.get_qos_status()
+            assert out["classes"]["batch"]["tenants"] == ["etl"]
+            out = c.get_qos_status(model_name="m")
+            assert "m" in out.get("queues", {}) or "queues" in out
+        finally:
+            c.close()
+
+    def test_grpc_endpoint_mirrors_http(self, stack):
+        import client_tpu.grpc as grpcclient
+
+        c = grpcclient.InferenceServerClient(stack["grpc_url"])
+        try:
+            out = c.get_qos_status()
+            assert out["enabled"]
+            assert out["classes"]["interactive"]["preempt"] is True
+            assert out["governor"]["throttle_factor"] == 0.5
+        finally:
+            c.close()
+
+
+@pytest.mark.chaos
+class TestShadowIsolationChaos:
+    """Live p99 under a full-rate shadow flood must stay within 1.10x
+    of the shadow-off baseline — the QoS acceptance bar, asserted
+    in-process where the only interference paths are the ones QoS
+    actually governs (queue order, quota, pushback)."""
+
+    def _build(self):
+        device = threading.Lock()
+        service_s = {"live_net": 0.004, "shadow_net": 0.0002}
+
+        class SleepIdent(ModelBackend):
+            jittable = False
+
+            def __init__(self, name):
+                self.config = ModelConfig(
+                    name=name, platform="jax", max_batch_size=4,
+                    input=[TensorConfig("INPUT", "FP32", [4])],
+                    output=[TensorConfig("OUTPUT", "FP32", [4])],
+                    dynamic_batching=DynamicBatchingConfig(
+                        preferred_batch_size=[4],
+                        max_queue_delay_microseconds=200),
+                    instance_count=1)
+                self._service = service_s[name]
+
+            def make_apply(self):
+                def apply(inputs):
+                    with device:
+                        time.sleep(self._service)
+                    return {"OUTPUT": inputs["INPUT"]}
+                return apply
+
+        repo = ModelRepository()
+        repo.register_backend(SleepIdent("live_net"))
+        repo.register_backend(SleepIdent("shadow_net"))
+        qos = QosController(QosConfig.from_dict({
+            "classes": {
+                "interactive": {"weight": 8, "preempt": True,
+                                "protect": True},
+                "shadow": {"weight": 1, "min_priority": 8,
+                           "tokens_per_s": 40.0, "burst": 4.0,
+                           "max_inflight": 2, "max_queue_depth": 4},
+            },
+            "default_class": "interactive"}))
+        return TpuEngine(repo, warmup=False, qos=qos)
+
+    def _measure_live_p99(self, engine, duration_s=1.2, conc=4):
+        lat_us = []
+        lock = threading.Lock()
+        stop_at = time.monotonic() + duration_s
+
+        def loop():
+            inp = np.ones((1, 4), np.float32)
+            while time.monotonic() < stop_at:
+                done = threading.Event()
+                t0 = time.perf_counter()
+
+                def cb(resp, done=done):
+                    done.set()
+
+                engine.async_infer(InferRequest(
+                    model_name="live_net", tenant="live",
+                    inputs={"INPUT": inp}), cb)
+                done.wait(30)
+                with lock:
+                    lat_us.append((time.perf_counter() - t0) * 1e6)
+
+        ts = [threading.Thread(target=loop, daemon=True)
+              for _ in range(conc)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(lat_us) >= 100
+        lat_us.sort()
+        return lat_us[int(len(lat_us) * 0.99)]
+
+    def _shadow_flood(self, engine, stop, counts):
+        inp = np.ones((1, 4), np.float32)
+        while not stop.is_set():
+            done = threading.Event()
+            err = {}
+
+            def cb(resp, done=done, err=err):
+                err["e"] = resp.error
+                done.set()
+
+            try:
+                engine.async_infer(InferRequest(
+                    model_name="shadow_net", priority=8,
+                    inputs={"INPUT": inp}), cb)
+            except AdmissionError as exc:
+                counts["sheds"] += 1
+                stop.wait(max(exc.retry_after_s or 0.0, 0.05))
+                continue
+            done.wait(30)
+            if err.get("e") is None:
+                counts["ok"] += 1
+
+    def test_live_p99_holds_under_full_shadow_load(self):
+        # One shared core makes any single p99 window noisy; bracket
+        # the flood window with two shadow-off windows and take the
+        # larger as baseline so baseline jitter can't manufacture a
+        # phantom inflation. Up to three attempts before declaring a
+        # real isolation failure.
+        ratios = []
+        for _attempt in range(3):
+            engine = self._build()
+            try:
+                self._measure_live_p99(engine, duration_s=0.4)  # warm
+                off_before = self._measure_live_p99(engine)
+                stop = threading.Event()
+                counts = {"ok": 0, "sheds": 0}
+                floods = [threading.Thread(
+                    target=self._shadow_flood,
+                    args=(engine, stop, counts), daemon=True)
+                    for _ in range(2)]
+                for t in floods:
+                    t.start()
+                try:
+                    p99_on = self._measure_live_p99(engine)
+                finally:
+                    stop.set()
+                    for t in floods:
+                        t.join(timeout=30)
+                off_after = self._measure_live_p99(engine)
+                # The flood really ran: admitted work went through.
+                assert counts["ok"] > 0
+                ratio = p99_on / max(off_before, off_after)
+                ratios.append(round(ratio, 3))
+                if ratio <= 1.10:
+                    return
+            finally:
+                engine.shutdown()
+        pytest.fail(
+            f"live p99 inflated beyond 1.10x under shadow load in "
+            f"all attempts: ratios={ratios}")

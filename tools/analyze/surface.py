@@ -66,6 +66,7 @@ HTTP_HANDLER_OPS = {
     "timeseries": "timeseries",
     "memory": "memory_census",
     "costs": "costs",
+    "qos": "qos",
     "load": "load_report",
     "metrics": "metrics",
 }
@@ -86,6 +87,7 @@ GRPC_RPC_OPS = {
     "Timeseries": "timeseries",
     "MemoryCensus": "memory_census",
     "Costs": "costs",
+    "Qos": "qos",
     "RingRegister": "ring_register",
     "RingStatus": "ring_status",
     "RingUnregister": "ring_unregister",
@@ -143,6 +145,7 @@ CLIENT_METHOD_OPS = {
     "get_timeseries": "timeseries",
     "get_memory": "memory_census",
     "get_costs": "costs",
+    "get_qos_status": "qos",
     "get_fleet_events": "fleet_events",
     "get_fleet_profile": "fleet_profile",
     "get_fleet_slo": "fleet_slo",

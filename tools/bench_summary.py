@@ -97,6 +97,35 @@ def check(hist: list, threshold: float = 0.25) -> int:
                   f"(floor 80%) {verdict}")
             if explained < 0.8:
                 failures += 1
+        # QoS isolation gate: with the interactive class protected and
+        # shadow demoted to the lowest WFQ lane, a full-rate shadow
+        # replay may inflate live p99 by at most 10% (the pre-QoS bar
+        # was 1.25x). Records predating QoS carry no ratio and skip.
+        ratio = r.get("shadow_p99_ratio")
+        if ratio is not None and r.get("qos") is not None:
+            verdict = "FAIL" if ratio > 1.10 else "ok"
+            print(f"bench-check: shm_fanin: live p99 under shadow "
+                  f"replay {ratio}x (ceiling 1.10x with QoS) {verdict}")
+            if ratio > 1.10:
+                failures += 1
+    # Gauntlet gate: the scenario record must carry the journal
+    # evidence, not just healthy ratios — per-class SLOs held
+    # (slo_pass), the governor throttled the drowning class during the
+    # flash crowd (throttle_fired), and restored it once recovery
+    # traffic diluted the burn (throttle_cleared).
+    gauntlet = runs[latest_ts].get("gauntlet")
+    if gauntlet is not None:
+        bits = (("slo_pass", bool(gauntlet.get("slo_pass"))),
+                ("throttle_fired", bool(gauntlet.get("throttle_fired"))),
+                ("throttle_cleared",
+                 bool(gauntlet.get("throttle_cleared"))))
+        bad = [name for name, ok in bits if not ok]
+        verdict = f"FAIL ({', '.join(bad)} unmet)" if bad else "ok"
+        print("bench-check: gauntlet: "
+              + " ".join(f"{name}={ok}" for name, ok in bits)
+              + f" {verdict}")
+        if bad:
+            failures += 1
     if failures:
         print(f"bench-check: {failures} probe(s) regressed more than "
               f"{threshold:.0%} on p99", file=sys.stderr)
@@ -176,6 +205,8 @@ def main() -> int:
                 _print_shm_ring_delta(rec)
             if probe == "shm_fanin":
                 _print_shm_fanin_delta(rec)
+            if probe == "gauntlet":
+                _print_gauntlet_delta(rec)
     return 0
 
 
@@ -238,7 +269,8 @@ def _print_shm_ring_delta(rec: dict) -> None:
 def _print_shm_fanin_delta(rec: dict) -> None:
     """The fan-in probe's two acceptance bars on one line each: N
     producer processes vs one on the reaper plane (>= 3x aggregate ips),
-    and the live plane's p99 with shadow replay on vs off (<= 1.25x)."""
+    and the live plane's p99 with shadow replay on vs off (<= 1.10x now
+    that the shadow class rides the lowest-weight QoS lane)."""
     r = rec.get("shm_fanin") or rec
     single, fanin = r.get("single") or {}, r.get("fanin") or {}
     if single and fanin:
@@ -255,6 +287,11 @@ def _print_shm_fanin_delta(rec: dict) -> None:
               f"{r.get('shadow_p99_ratio')}x "
               f"(shadow: {shed.get('completions')} done, "
               f"{shed.get('errors')} shed)")
+        qos = r.get("qos") or {}
+        if qos:
+            print(f"    qos: shadow sheds {qos.get('shadow_sheds')}, "
+                  f"interactive preemptions "
+                  f"{qos.get('interactive_preemptions')}")
     inter = r.get("interference") or {}
     if inter:
         legs = [("co_batch", inter.get("co_batch_us_per_req")),
@@ -271,6 +308,30 @@ def _print_shm_fanin_delta(rec: dict) -> None:
               + (f" (foreign occupancy {rho})" if rho is not None else "")
               + f" explains {inter.get('explained_fraction')} of the "
               f"{inter.get('p99_inflation_us')}us p99 inflation")
+
+
+def _print_gauntlet_delta(rec: dict) -> None:
+    """The scenario gauntlet's story on three lines: live p99 across
+    the baseline/diurnal/flash/mix phases, the flash crowd's journal
+    evidence (throttle fired AND cleared), and the per-class verdict."""
+    g = rec.get("gauntlet") or rec
+    base, diur = g.get("baseline") or {}, g.get("diurnal") or {}
+    flash, mix = g.get("flash") or {}, g.get("adversarial_mix") or {}
+    if base and flash:
+        print(f"    gauntlet live p99: {base.get('p99_us')}us base -> "
+              f"{diur.get('p99_us')}us diurnal "
+              f"({diur.get('p99_ratio')}x) -> {flash.get('p99_us')}us "
+              f"flash ({flash.get('p99_ratio')}x) -> "
+              f"{mix.get('vision_p99_us')}us mix")
+        print(f"    gauntlet flash crowd: throttle x"
+              f"{flash.get('throttle_fired')} "
+              f"cleared={flash.get('throttle_cleared')}, flood "
+              f"{flash.get('flood_completions')} done / "
+              f"{flash.get('flood_sheds')} shed")
+    print(f"    gauntlet verdict: slo_pass={g.get('slo_pass')} "
+          f"(threshold {g.get('slo_threshold_us')}us, "
+          f"dlrm {mix.get('dlrm_ok')}, gpt {mix.get('gpt_ok')}, "
+          f"preemptions {g.get('preemptions')})")
 
 
 def _print_router_delta(rec: dict) -> None:

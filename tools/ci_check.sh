@@ -1,12 +1,13 @@
 #!/usr/bin/env bash
 # CI gate: tier-1 tests + chaos suite + live endpoint lint + autotune
 # e2e + router e2e + fused kernel parity + DLRM e2e + shm ring e2e +
-# staged fan-in e2e + bench gate + static analysis / lockdep gate.
+# staged fan-in e2e + QoS gauntlet smoke + bench gate + static
+# analysis / lockdep gate.
 #
 #   tools/ci_check.sh            # everything (tier-1 already includes chaos)
 #   tools/ci_check.sh --fast     # all stages except tier-1
 #
-# Eleven stages:
+# Twelve stages:
 #   1. tier-1: the full fast suite (ROADMAP.md contract; excludes `slow`).
 #   2. chaos: the deterministic fault-injection suite alone (`-m chaos`) —
 #      redundant with tier-1 when stage 1 runs, but the -m filter proves
@@ -59,9 +60,18 @@
 #      summed per-tensor CRC32s are byte-identical to the binary-HTTP
 #      path for the same rows, and tpu_shm_dataset_* / tpu_shm_reaper_*
 #      render promlint-clean in both exposition dialects.
-#  10. bench gate: tools/bench_summary.py --check fails the build when the
+#  10. qos gauntlet smoke: one engine serving a protected interactive
+#      class and a quota'd batch class under CLIENT_TPU_SLO, hit with
+#      an in-process flash crowd on the batch model — the SLO fast-burn
+#      must fire and the governor must throttle the batch class
+#      (journal qos.throttle, /v2/qos shows the throttled ratio), and
+#      the tpu_qos_* families must render promlint-clean in both
+#      exposition dialects. The full routed gauntlet (restore edge,
+#      per-class p99 SLOs, adversarial mix) runs in bench.py and is
+#      gated by stage 11 when BENCH_HISTORY.json is present.
+#  11. bench gate: tools/bench_summary.py --check fails the build when the
 #      newest BENCH_HISTORY.json run regressed any probe's p99 by >25%.
-#  11. analysis gate: tpulint (python -m tools.analyze) against the
+#  12. analysis gate: tpulint (python -m tools.analyze) against the
 #      reviewed baseline, promlint --definitions over every metric
 #      registration site, and the concurrency-heavy tier-1 subset
 #      re-run under CLIENT_TPU_LOCKDEP=1 so the runtime lock-order and
@@ -76,7 +86,7 @@ FAST=0
 rc=0
 
 if [ "$FAST" -eq 0 ]; then
-    echo "=== stage 1/11: tier-1 test suite ==="
+    echo "=== stage 1/12: tier-1 test suite ==="
     rm -f /tmp/_t1.log
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -86,15 +96,15 @@ if [ "$FAST" -eq 0 ]; then
         | tr -cd . | wc -c)"
     [ "$t1" -ne 0 ] && { echo "tier-1 FAILED (exit $t1)"; rc=1; }
 else
-    echo "=== stage 1/11: tier-1 skipped (--fast) ==="
+    echo "=== stage 1/12: tier-1 skipped (--fast) ==="
 fi
 
-echo "=== stage 2/11: chaos (fault-injection) suite ==="
+echo "=== stage 2/12: chaos (fault-injection) suite ==="
 timeout -k 10 300 python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 [ $? -ne 0 ] && { echo "chaos suite FAILED"; rc=1; }
 
-echo "=== stage 3/11: live scrape (promlint + ops endpoints) ==="
+echo "=== stage 3/12: live scrape (promlint + ops endpoints) ==="
 SCRAPE_DIR=$(mktemp -d)
 python - "$SCRAPE_DIR" <<'EOF'
 import json
@@ -195,7 +205,7 @@ grep -q "^tpu_cost_" "$SCRAPE_DIR/metrics.om.txt" \
     || { echo "tpu_cost_* missing from openmetrics dialect"; rc=1; }
 rm -rf "$SCRAPE_DIR"
 
-echo "=== stage 4/11: autotune e2e (promotion + metrics) ==="
+echo "=== stage 4/12: autotune e2e (promotion + metrics) ==="
 TUNE_DIR=$(mktemp -d)
 CLIENT_TPU_AUTOTUNE='{"interval_s": 0.2, "cooldown_s": 0.5}' \
 timeout -k 10 300 python - "$TUNE_DIR" <<'EOF'
@@ -271,7 +281,7 @@ python tools/promlint.py --openmetrics "$TUNE_DIR/metrics.om.txt" \
     || { echo "promlint (autotune openmetrics) FAILED"; rc=1; }
 rm -rf "$TUNE_DIR"
 
-echo "=== stage 5/11: router e2e (balance + roll-drain + fleet + metrics) ==="
+echo "=== stage 5/12: router e2e (balance + roll-drain + fleet + metrics) ==="
 ROUTER_DIR=$(mktemp -d)
 timeout -k 10 300 python - "$ROUTER_DIR" <<'EOF'
 import json
@@ -445,7 +455,7 @@ grep -q "^tpu_fleet_drift_score{" "$ROUTER_DIR/metrics.om.txt" \
     || { echo "tpu_fleet_drift_score missing from openmetrics dialect"; rc=1; }
 rm -rf "$ROUTER_DIR"
 
-echo "=== stage 6/11: fused decode kernel parity (interpret) + wave metrics ==="
+echo "=== stage 6/12: fused decode kernel parity (interpret) + wave metrics ==="
 # The Pallas decode kernel and the sharded KV arena run in interpret mode
 # on CPU (docs/KERNELS.md): this stage proves (a) fused == reference on
 # the fast parity subset, (b) an engine on the fused path emits
@@ -516,7 +526,7 @@ python tools/promlint.py --openmetrics "$KERNEL_DIR/metrics.om.txt" \
     || { echo "promlint (kernel openmetrics) FAILED"; rc=1; }
 rm -rf "$KERNEL_DIR"
 
-echo "=== stage 7/11: dlrm e2e (lookup-bucket promotion + emb metrics) ==="
+echo "=== stage 7/12: dlrm e2e (lookup-bucket promotion + emb metrics) ==="
 DLRM_DIR=$(mktemp -d)
 CLIENT_TPU_AUTOTUNE='{"interval_s": 0.2, "cooldown_s": 0.5}' \
 timeout -k 10 300 python - "$DLRM_DIR" <<'EOF'
@@ -594,7 +604,7 @@ python tools/promlint.py --openmetrics "$DLRM_DIR/metrics.om.txt" \
     || { echo "promlint (dlrm openmetrics) FAILED"; rc=1; }
 rm -rf "$DLRM_DIR"
 
-echo "=== stage 8/11: shm ring e2e (producer process + doorbell + metrics) ==="
+echo "=== stage 8/12: shm ring e2e (producer process + doorbell + metrics) ==="
 RING_DIR=$(mktemp -d)
 timeout -k 10 300 python - "$RING_DIR" <<'EOF'
 import json
@@ -708,7 +718,7 @@ python tools/promlint.py --openmetrics "$RING_DIR/metrics.om.txt" \
     || { echo "promlint (shm ring openmetrics) FAILED"; rc=1; }
 rm -rf "$RING_DIR"
 
-echo "=== stage 9/11: staged fan-in e2e (8 producer processes + reaper metrics) ==="
+echo "=== stage 9/12: staged fan-in e2e (8 producer processes + reaper metrics) ==="
 FANIN_DIR=$(mktemp -d)
 timeout -k 10 300 python - "$FANIN_DIR" <<'EOF'
 import json
@@ -813,7 +823,173 @@ python tools/promlint.py --openmetrics "$FANIN_DIR/metrics.om.txt" \
     || { echo "promlint (fan-in openmetrics) FAILED"; rc=1; }
 rm -rf "$FANIN_DIR"
 
-echo "=== stage 10/11: bench p99 regression gate ==="
+echo "=== stage 10/12: qos gauntlet smoke (flash crowd -> throttle + metrics) ==="
+QOS_DIR=$(mktemp -d)
+CLIENT_TPU_SLO='{"availability": 0.999, "latency_threshold_us": 40000.0,
+    "latency_target": 0.9, "fast_burn_threshold": 14.4,
+    "models": {"batch_net": {"latency_target": 0.5,
+                             "fast_burn_threshold": 1.6}}}' \
+timeout -k 10 300 python - "$QOS_DIR" <<'EOF'
+import json
+import sys
+import threading
+import time
+from urllib.request import Request, urlopen
+
+import numpy as np
+
+from client_tpu.admission import AdmissionError
+from client_tpu.admission.qos import QosConfig, QosController
+from client_tpu.engine import TpuEngine
+from client_tpu.engine.config import (
+    DynamicBatchingConfig,
+    ModelConfig,
+    TensorConfig,
+)
+from client_tpu.engine.model import ModelBackend
+from client_tpu.engine.repository import ModelRepository
+from client_tpu.engine.types import InferRequest
+from client_tpu.observability.events import journal
+from client_tpu.server import HttpInferenceServer
+
+out_dir = sys.argv[1]
+DIM, SERVICE_S, MB = 16, 0.008, 4
+
+# One engine, two models on one shared 'device' lock: the protected
+# interactive class and the quota'd batch class the flash crowd slams.
+# Same policy shape as the full bench gauntlet, minus the router fleet.
+device = threading.Lock()
+
+
+class SleepIdentity(ModelBackend):
+    jittable = False  # time.sleep must run per call, not per trace
+
+    def __init__(self, name):
+        self.config = ModelConfig(
+            name=name, platform="jax", max_batch_size=MB,
+            input=[TensorConfig("INPUT", "FP32", [DIM])],
+            output=[TensorConfig("OUTPUT", "FP32", [DIM])],
+            dynamic_batching=DynamicBatchingConfig(
+                preferred_batch_size=[MB],
+                max_queue_delay_microseconds=200),
+            instance_count=1)
+
+    def make_apply(self):
+        def apply(inputs):
+            with device:
+                time.sleep(SERVICE_S)
+            return {"OUTPUT": np.asarray(inputs["INPUT"])}
+        return apply
+
+
+repo = ModelRepository()
+repo.register_backend(SleepIdentity("live_net"))
+repo.register_backend(SleepIdentity("batch_net"))
+qos = QosController(QosConfig.from_dict({
+    "classes": {
+        "interactive": {"weight": 8, "preempt": True, "protect": True},
+        "batch": {"weight": 2, "priority_level": 4,
+                  "tokens_per_s": 600.0, "burst": 60.0,
+                  "max_queue_depth": 64},
+    },
+    "tenants": {"live": "interactive", "flood": "batch"},
+    "default_class": "interactive",
+    "restore_hold_s": 1.0,
+    "governor_interval_s": 0.25,
+}))
+engine = TpuEngine(repo, warmup=True, qos=qos)
+if not engine.slo.enabled:
+    sys.exit("CLIENT_TPU_SLO set but engine built no SLO tracker")
+srv = HttpInferenceServer(engine, host="127.0.0.1", port=0).start()
+jrnl = journal()
+cursor = jrnl.export(limit=0)["next_seq"]
+try:
+    base = f"http://{srv.url}"
+    inp = np.ones((1, DIM), np.float32)
+    stop = threading.Event()
+    flood = {"ok": 0, "sheds": 0}
+
+    def flood_loop():
+        # Closed-loop flash crowd on the batch model: with a 40 ms
+        # queue-inclusive SLO threshold and an 8 ms serial device,
+        # 24 outstanding requests put every completion over it.
+        while not stop.is_set():
+            done = threading.Event()
+            try:
+                engine.async_infer(InferRequest(
+                    model_name="batch_net", tenant="flood",
+                    inputs={"INPUT": inp}), lambda resp: done.set())
+            except AdmissionError as exc:
+                flood["sheds"] += 1
+                stop.wait(min(exc.retry_after_s, 0.25))
+                continue
+            done.wait(60)
+            flood["ok"] += 1
+
+    threads = [threading.Thread(target=flood_loop, daemon=True)
+               for _ in range(24)]
+    for t in threads:
+        t.start()
+    throttled = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and throttled is None:
+        for e in jrnl.snapshot(category="qos"):
+            if e.seq >= cursor and e.name == "throttle":
+                throttled = e.detail
+                break
+        time.sleep(0.2)
+    if throttled is None:
+        sys.exit(f"flash crowd never tripped qos.throttle in 60s "
+                 f"(flood ok={flood['ok']} sheds={flood['sheds']}, "
+                 f"slo={json.dumps(engine.slo.snapshot())[:300]})")
+
+    # The governed class must be visibly throttled on the ops surface.
+    snap = json.load(urlopen(f"{base}/v2/qos", timeout=10))
+    ratio = snap["classes"]["batch"]["throttle_ratio"]
+    if not (snap["enabled"] and ratio < 1.0):
+        sys.exit(f"/v2/qos does not show batch throttled: {str(snap)[:300]}")
+    if "batch" not in snap["governor"]["throttled"]:
+        sys.exit(f"/v2/qos governor.throttled missing batch: "
+                 f"{str(snap)[:300]}")
+
+    # Interactive traffic still flows mid-crowd (protected class).
+    for _ in range(3):
+        engine.infer(InferRequest(model_name="live_net", tenant="live",
+                                  inputs={"INPUT": inp}), timeout_s=60)
+
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    classic = urlopen(f"{base}/metrics", timeout=10).read().decode()
+    om = urlopen(Request(f"{base}/metrics", headers={
+        "Accept": "application/openmetrics-text"}), timeout=10).read().decode()
+    for fam in ("tpu_qos_sheds_total", "tpu_qos_inflight",
+                "tpu_qos_throttle_ratio"):
+        if fam not in classic:
+            sys.exit(f"{fam} missing from /metrics")
+    with open(f"{out_dir}/metrics.txt", "w") as f:
+        f.write(classic)
+    with open(f"{out_dir}/metrics.om.txt", "w") as f:
+        f.write(om)
+    print(f"qos gauntlet smoke ok: throttle fired ({throttled}), "
+          f"batch ratio {ratio}, flood ok={flood['ok']} "
+          f"sheds={flood['sheds']}, tpu_qos_* rendered")
+finally:
+    srv.stop()
+    engine.shutdown()
+EOF
+[ $? -ne 0 ] && { echo "qos gauntlet smoke FAILED"; rc=1; }
+python tools/promlint.py "$QOS_DIR/metrics.txt" \
+    || { echo "promlint (qos classic) FAILED"; rc=1; }
+python tools/promlint.py --openmetrics "$QOS_DIR/metrics.om.txt" \
+    || { echo "promlint (qos openmetrics) FAILED"; rc=1; }
+grep -q "^tpu_qos_" "$QOS_DIR/metrics.txt" \
+    || { echo "tpu_qos_* missing from classic dialect"; rc=1; }
+grep -q "^tpu_qos_" "$QOS_DIR/metrics.om.txt" \
+    || { echo "tpu_qos_* missing from openmetrics dialect"; rc=1; }
+rm -rf "$QOS_DIR"
+
+echo "=== stage 11/12: bench p99 regression gate ==="
 if [ -f BENCH_HISTORY.json ]; then
     python tools/bench_summary.py --check \
         || { echo "bench gate FAILED"; rc=1; }
@@ -821,7 +997,7 @@ else
     echo "no BENCH_HISTORY.json — skipping"
 fi
 
-echo "=== stage 11/11: static analysis + lockdep gate ==="
+echo "=== stage 12/12: static analysis + lockdep gate ==="
 python -m tools.analyze --baseline tools/analyze/baseline.json \
     || { echo "tpulint FAILED"; rc=1; }
 python tools/promlint.py --definitions client_tpu \
@@ -829,7 +1005,7 @@ python tools/promlint.py --definitions client_tpu \
 CLIENT_TPU_LOCKDEP=1 timeout -k 10 600 python -m pytest -q \
     tests/test_lockdep.py tests/test_engine.py tests/test_generative.py \
     tests/test_shm_ring.py tests/test_shm_fanin.py \
-    tests/test_flight_recorder.py \
+    tests/test_flight_recorder.py tests/test_qos.py \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 [ $? -ne 0 ] && { echo "lockdep-enabled concurrency subset FAILED"; rc=1; }
 

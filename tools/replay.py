@@ -27,18 +27,64 @@ metadata (deterministic, seeded), which guarantees it.  Without
 ``--build`` the segment named by ``--dataset-key`` (default
 ``CLIENT_TPU_STAGED_PATH``) must already exist, e.g. staged by a
 capture pipeline.
+
+Load shapes (``--shape``, with ``--rate`` rows/s per producer): the
+perf_analyzer-heritage scenario generators the QoS gauntlet replays.
+``steady`` holds ``--rate`` flat; ``diurnal`` sweeps a raised cosine
+between ``--rate`` and ``--peak-rate`` over ``--shape-period``;
+``flash_crowd`` holds ``--rate`` except for a peak-rate burst in the
+middle tenth-and-a-half of each period.  ``--rate 0`` (default) keeps
+the historical closed-loop behavior: fill as fast as the ring admits.
+
+Shed backoff is per ring and honors the server's pushback: a shed slot
+error carries the admission ``Retry-After`` (see
+``client_tpu.protocol.pushback.parse_slot_error_retry_after``) and the
+producer pauses *its own ring* for that long plus jitter, so a capped
+shadow fleet decorrelates instead of retrying in synchronized waves.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
+import random
 import subprocess
 import sys
 import time
 
 from client_tpu import config as envcfg
+
+SHAPES = ("steady", "diurnal", "flash_crowd")
+
+# Fraction of each flash_crowd period spent at peak, and where the
+# burst starts — mid-period so every period sees a ramp-free jump.
+_FLASH_START, _FLASH_LEN = 0.5, 0.15
+
+
+def shape_rate(shape: str, t: float, period: float, base: float,
+               peak: float) -> float:
+    """Target send rate (rows/s) at elapsed time ``t`` for a load shape.
+
+    ``steady`` -> ``base``; ``diurnal`` -> raised cosine from ``base``
+    up to ``peak`` and back over each ``period``; ``flash_crowd`` ->
+    ``base`` with a rectangular ``peak`` burst covering ``_FLASH_LEN``
+    of each period.  Shared by the replay workers and the bench
+    gauntlet so the scenarios the gauntlet asserts against are the
+    scenarios production replay can generate."""
+    if shape not in SHAPES:
+        raise ValueError(f"unknown load shape {shape!r} "
+                         f"(valid: {', '.join(SHAPES)})")
+    period = max(period, 1e-3)
+    phase = (t % period) / period
+    if shape == "diurnal":
+        return base + (peak - base) * 0.5 * (1.0 - math.cos(
+            2.0 * math.pi * phase))
+    if shape == "flash_crowd":
+        in_burst = _FLASH_START <= phase < _FLASH_START + _FLASH_LEN
+        return peak if in_burst else base
+    return base
 
 
 def _log(msg: str) -> None:
@@ -99,9 +145,23 @@ def run_worker(args) -> int:
         # HBM spend instead of hiding in the live tenants' bills.
         spec["tenant"] = args.tenant
     client = httpclient.InferenceServerClient(args.url)
-    sent = completions = errors = crc = 0
+    sent = completions = errors = sheds = crc = 0
+    rng = random.Random(args.seed * 1000 + args.index)
+    peak = args.peak_rate if args.peak_rate > 0 else args.rate * 4.0
     t0 = time.monotonic()
     deadline = t0 + args.duration if args.duration > 0 else None
+    # This ring's backoff horizon: a shed completion parks *this*
+    # producer until the server-requested Retry-After (plus full
+    # jitter) has elapsed.  Per ring, not a shared constant — a capped
+    # fleet sleeping one fixed interval wakes up in lockstep and lands
+    # as synchronized occupancy spikes in the cost ledger.
+    backoff_until = 0.0
+    # Coarse reap polling (``--reap-poll``): a shadow fleet at the
+    # ring's default 100 us poll backoff spins enough host CPU to
+    # inflate the live plane it is shadowing; throughput-oriented
+    # replay keeps the fast default (0 = ring default).
+    reap_poll = args.reap_poll if args.reap_poll > 0 else None
+    next_at = t0
     try:
         with RingProducer(client, args.ring_name, args.ring_key,
                           slot_count=args.slot_count,
@@ -110,50 +170,86 @@ def run_worker(args) -> int:
                           spec=spec) as prod:
             row = args.index  # stagger producers across the dataset
             while True:
-                if deadline is not None and time.monotonic() >= deadline:
+                now = time.monotonic()
+                if deadline is not None and now >= deadline:
                     break
                 if args.count and sent >= args.count:
                     break
+                gate = backoff_until
+                if args.rate > 0:
+                    gate = max(gate, next_at)
+                if now < gate:
+                    time.sleep(min(gate - now, 0.02))
+                    continue
                 if prod.fill_staged(refs(row % rows)) is None:
                     before = errors
-                    completions, errors, crc = _reap_one(
-                        prod, completions, errors, crc)
+                    completions, errors, crc, err = _reap_one(
+                        prod, completions, errors, crc, reap_poll)
                     if errors > before:
-                        # Shed (admission rejected the slot): back off
-                        # instead of retry-storming — a shadow class only
-                        # protects live traffic if the replayer yields
-                        # when told to.
-                        time.sleep(args.shed_backoff)
+                        sheds += 1
+                        backoff_until = time.monotonic() + _shed_backoff_s(
+                            err, args.shed_backoff, rng)
                     continue
                 sent += 1
                 row += 1
+                if args.rate > 0:
+                    r = max(shape_rate(args.shape, now - t0,
+                                       args.shape_period, args.rate,
+                                       peak), 1e-6)
+                    # Pace against the shape; the max() clamp forgives
+                    # backlog accrued while gated so a long backoff is
+                    # not repaid as a catch-up burst.
+                    next_at = max(next_at, now - 1.0 / r) + 1.0 / r
             while prod.outstanding:
-                completions, errors, crc = _reap_one(
-                    prod, completions, errors, crc)
+                completions, errors, crc, _ = _reap_one(
+                    prod, completions, errors, crc, reap_poll)
     finally:
         client.close()
         ds.close()
     elapsed = time.monotonic() - t0
     print(json.dumps({
         "ring": args.ring_name, "sent": sent, "completions": completions,
-        "errors": errors, "crc": crc, "elapsed_s": round(elapsed, 3),
+        "errors": errors, "sheds": sheds, "crc": crc,
+        "elapsed_s": round(elapsed, 3),
         "ips": round(completions / elapsed, 1) if elapsed > 0 else 0.0,
     }), flush=True)
     return 0
 
 
-def _reap_one(prod, completions: int, errors: int, crc: int):
+def _shed_backoff_s(err, fallback_s: float, rng: random.Random) -> float:
+    """Backoff for one shed: the server's Retry-After when the slot
+    error carries it (admission pushback — class-aware since the QoS
+    classes derive it from their token-bucket refill time), floored at
+    the ``--shed-backoff`` constant and stretched by full jitter
+    (1x..2x) so producers shed in the same instant fan back out.
+
+    The floor matters under quota contention: a drained token bucket
+    advertises only its next-token refill (~1/rate), so N producers
+    honoring it verbatim all converge on a ~10ms retry spin that burns
+    the host the quota was protecting. ``--shed-backoff`` is the
+    operator's "never retry faster than this" knob."""
+    from client_tpu.protocol.pushback import parse_slot_error_retry_after
+
+    base = parse_slot_error_retry_after(err)
+    base = fallback_s if base is None else max(base, fallback_s)
+    return base * (1.0 + rng.random())
+
+
+def _reap_one(prod, completions: int, errors: int, crc: int,
+              spin_sleep_s: float | None = None):
     """Reap the oldest completion, folding its output bytes into the
     order-independent parity checksum (sum of per-tensor CRC32s — what
-    the byte-parity tests compare against the HTTP path)."""
+    the byte-parity tests compare against the HTTP path).  Returns the
+    updated counters plus the slot error string (None on success) so
+    the caller can honor any Retry-After pushback riding on it."""
     import zlib
 
-    _, outputs, err = prod.reap(timeout_s=30.0)
+    _, outputs, err = prod.reap(timeout_s=30.0, spin_sleep_s=spin_sleep_s)
     if err:
-        return completions + 1, errors + 1, crc
+        return completions + 1, errors + 1, crc, err
     for name in sorted(outputs or {}):
         crc += zlib.crc32(outputs[name].tobytes())
-    return completions + 1, errors, crc
+    return completions + 1, errors, crc, None
 
 
 def spawn_workers(url: str, model: str, dataset_key: str,
@@ -162,6 +258,10 @@ def spawn_workers(url: str, model: str, dataset_key: str,
                   priority: int = 0, tenant: str | None = None,
                   slot_count: int = 64,
                   slot_bytes: int = 1 << 16,
+                  rate: float = 0.0, peak_rate: float = 0.0,
+                  shape: str = "steady", shape_period: float = 8.0,
+                  shed_backoff: float = 0.05,
+                  reap_poll: float = 0.0,
                   key_prefix: str | None = None) -> list[subprocess.Popen]:
     """Start the producer subprocesses (importable — bench/ci reuse).
     Each worker is a REAL process re-invoking this module with
@@ -176,7 +276,11 @@ def spawn_workers(url: str, model: str, dataset_key: str,
                "--ring-key", f"{prefix}_r{i}", "--index", str(i),
                "--priority", str(priority), "--duration", str(duration),
                "--count", str(count), "--slot-count", str(slot_count),
-               "--slot-bytes", str(slot_bytes)]
+               "--slot-bytes", str(slot_bytes),
+               "--rate", str(rate), "--peak-rate", str(peak_rate),
+               "--shape", shape, "--shape-period", str(shape_period),
+               "--shed-backoff", str(shed_backoff),
+               "--reap-poll", str(reap_poll)]
         if tenant is not None:
             cmd += ["--tenant", tenant]
         procs.append(subprocess.Popen(
@@ -241,7 +345,10 @@ def run_coordinator(args) -> int:
             args.url, args.model, dataset_key, args.dataset_name,
             args.producers, duration=args.duration, count=args.count,
             priority=args.priority, tenant=args.tenant,
-            slot_count=args.slot_count, slot_bytes=args.slot_bytes)
+            slot_count=args.slot_count, slot_bytes=args.slot_bytes,
+            rate=args.rate, peak_rate=args.peak_rate, shape=args.shape,
+            shape_period=args.shape_period,
+            shed_backoff=args.shed_backoff, reap_poll=args.reap_poll)
         per = (f"{args.duration:.1f}s" if args.duration
                else f"{args.count} requests")
         _log(f"{len(procs)} producer processes live "
@@ -305,9 +412,26 @@ def main(argv=None) -> int:
                         "'shadow')")
     p.add_argument("--slot-count", type=int, default=64)
     p.add_argument("--slot-bytes", type=int, default=1 << 16)
+    p.add_argument("--reap-poll", type=float, default=0.0,
+                   help="reap poll sleep in seconds (0 = ring default "
+                        "fast spin); set coarse (e.g. 0.002) for shadow "
+                        "fleets that must not burn host CPU polling")
     p.add_argument("--shed-backoff", type=float, default=0.05,
-                   help="seconds a producer sleeps after a shed "
-                        "completion before refilling")
+                   help="fallback backoff seconds after a shed whose "
+                        "error carries no Retry-After pushback")
+    p.add_argument("--rate", type=float, default=0.0,
+                   help="target rows/s per producer (0 = closed loop: "
+                        "fill as fast as the ring admits)")
+    p.add_argument("--peak-rate", type=float, default=0.0,
+                   help="peak rows/s for diurnal/flash_crowd shapes "
+                        "(0 = 4x --rate)")
+    p.add_argument("--shape", default=envcfg.env_str(
+                       "CLIENT_TPU_REPLAY_SHAPE") or "steady",
+                   choices=SHAPES,
+                   help="load shape driven by --rate (default: "
+                        "CLIENT_TPU_REPLAY_SHAPE or steady)")
+    p.add_argument("--shape-period", type=float, default=8.0,
+                   help="seconds per diurnal/flash_crowd cycle")
     # internal: producer-subprocess mode
     p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--ring-name", default="", help=argparse.SUPPRESS)
