@@ -82,7 +82,7 @@ def _append_history(entry: dict) -> None:
 _SECTION_NAMES = ("simple", "gen_net", "seq_streaming", "ssd_net",
                   "router", "autotune", "dlrm", "bert", "shm_ab",
                   "shm_ab_large", "shm_ring", "shm_fanin", "gauntlet",
-                  "seq", "gen", "device_steady")
+                  "selfdriving", "seq", "gen", "device_steady")
 
 
 def _sections_filter() -> set | None:
@@ -217,7 +217,12 @@ _SECTION_EST = {"simple": 150, "bert": 180, "shm_ab": 150,
                 # two engine builds (4 models each incl. gpt+dlrm
                 # compiles) + four scenario phases + governor recovery
                 # wait; flash retries up to 3 flood rounds
-                "gauntlet": 300, "seq": 90, "gen": 150,
+                "gauntlet": 300,
+                # two engine builds + three closed-loop phases, each
+                # bounded by a journal-edge wait (retune ~8s, burn
+                # fire+clear ~15s, drift flag needs a full median
+                # window of skew before the rebalance lands)
+                "selfdriving": 240, "seq": 90, "gen": 150,
                 "device_steady": 550, "gen_net": 400,
                 "seq_streaming": 350, "ssd_net": 450,
                 # two engine builds + two short load phases + promotion
@@ -2120,6 +2125,501 @@ def bench_gauntlet(replicas: int = 2, conc: int = 4, phase_s: float = 6.0,
             eng.shutdown()
 
 
+def bench_selfdriving(replicas: int = 2, phase_s: float = 6.0):
+    """Self-driving chaos probe: every closed loop must fire AND clear
+    with zero operator input, on a routed 2-replica fleet under the
+    arrival shapes that trip each sensor.
+
+    Same deterministic substrate as the gauntlet — in-process engines
+    whose models share one device lock with fixed service times — but
+    the subject here is the control loops themselves
+    (``CLIENT_TPU_SELFDRIVE``), not the QoS policy:
+
+    * **dispatch retune** — a diurnal stream of staggered 3-row bursts
+      against an 8-wide preferred batch pads every dispatch to the
+      next bucket (fill 0.75 < fill_low): the tuner must cut the
+      dispatch deadline and cap max-batch (journal
+      ``autotune.dispatch_tighten``), after which the shorter window
+      splits the stagger into exact power-of-two batches and fill
+      recovers above the floor; when the bursts stop, quiet windows
+      must walk the override back out (``autotune.dispatch_restore``).
+    * **SLO-burn admission tightening** — a flash flood queues a slow
+      model past its latency objective: fast burn must progressively
+      cut its admitted rate (``admission.tighten``), and a fast
+      recovery trickle that dilutes the burn windows must restore it
+      stepwise (``admission.restore``).
+    * **drift re-placement** — hot-replica skew (one replica hammered
+      directly while its peer idles) must flag drift
+      (``fleet.drift``) and promote the LPT plan to executed rolling
+      moves (``fleet.rebalance`` ... ``fleet.rebalance_done``), after
+      which every model must still serve somewhere on the fleet and
+      the cooldown must hold the loop to exactly one rebalance.
+
+    Every assertion reads journal cursors (the edges, not the
+    internal state), and every loop's actuation count is bounded —
+    a flapping loop fails the probe even if it eventually converges.
+    Gated by ``bench_summary --check``: loops_closed AND
+    fill_recovered AND bounded.
+    """
+    import numpy as np
+
+    import client_tpu.http as httpclient
+    from client_tpu.engine import TpuEngine
+    from client_tpu.engine.config import (
+        DynamicBatchingConfig,
+        ModelConfig,
+        TensorConfig,
+    )
+    from client_tpu.engine.model import ModelBackend
+    from client_tpu.engine.repository import ModelRepository
+    from client_tpu.engine.types import InferRequest
+    from client_tpu.observability.events import journal
+    from client_tpu.observability.fleet import FleetMonitorConfig
+    from client_tpu.router import Replica, Router, RouterHttpServer
+    from client_tpu.server import HttpInferenceServer
+    from tools.replay import shape_rate
+
+    if os.environ.get("BENCH_SMOKE"):
+        phase_s = 4.0
+
+    dim = 16
+
+    class SleepIdentity(ModelBackend):
+        """Identity with a fixed service time under a shared 'device'
+        lock (the gauntlet's determinism idiom)."""
+
+        jittable = False  # time.sleep must run per call, not per trace
+
+        def __init__(self, name: str, device: threading.Lock,
+                     service_s: float, max_batch: int, delay_us: int):
+            self._device = device
+            self._service_s = service_s
+            self.config = ModelConfig(
+                name=name, platform="jax", max_batch_size=max_batch,
+                input=[TensorConfig("INPUT", "FP32", [dim])],
+                output=[TensorConfig("OUTPUT", "FP32", [dim])],
+                dynamic_batching=DynamicBatchingConfig(
+                    preferred_batch_size=[max_batch],
+                    max_queue_delay_microseconds=delay_us),
+                instance_count=1,
+            )
+
+        def make_apply(self):
+            def apply(inputs):
+                with self._device:
+                    time.sleep(self._service_s)
+                return {"OUTPUT": np.asarray(inputs["INPUT"])}
+            return apply
+
+    # Fast loop knobs: seconds-scale cooldowns/holds so fire->clear fits
+    # a bench phase; restore_hold_s stays above the post-retune measure
+    # window so healthy-fill ticks don't start loosening mid-measure
+    # (the flap the unit tests prove the hysteresis against).
+    selfdrive_spec = json.dumps({
+        "interval_s": 0.25, "min_calls": 4, "fill_low": 0.8,
+        "wait_high_s": 5.0, "cooldown_s": 2.0, "restore_hold_s": 4.0,
+        "burn_factor": 0.5, "burn_min_ratio": 0.25,
+        "burn_restore_step": 4.0, "burn_restore_hold_s": 1.0,
+        "burn_cooldown_s": 2.0, "rebalance_cooldown_s": 120.0,
+        "max_moves_per_window": 4, "rebalance_window_s": 300.0,
+        "quiesce_wait_s": 2.0})
+    # burn_net: anything past 30 ms is slow for an 8 ms-service model,
+    # and threshold 1.9 with target 0.5 means fast burn needs >95% of
+    # window completions slow — certain for a queued flood, cleared by
+    # a small fast trickle. The interactive model inherits objectives
+    # it cannot trip.
+    slo_spec = json.dumps({
+        "availability": 0.999,
+        "models": {"burn_net": {"latency_threshold_us": 30_000.0,
+                                "latency_target": 0.5,
+                                "fast_burn_threshold": 1.9}},
+    })
+
+    def build_replica():
+        device = threading.Lock()
+        repo = ModelRepository()
+        repo.register_backend(SleepIdentity(
+            "sd_net", device, 0.002, max_batch=8, delay_us=4000))
+        repo.register_backend(SleepIdentity(
+            "burn_net", device, 0.008, max_batch=4, delay_us=200))
+        # skew_net exists for the drift phase: 50 ms unbatched service,
+        # so a handful of queued calls puts ~0.25 s of queue wait on one
+        # replica. Queue wait is the one drift signal that stays
+        # per-replica in this in-process fleet — the profiler and the
+        # flight recorder are process-global singletons, so N in-process
+        # engines serve identical duty/fill timeseries and only the
+        # router's own load view can tell them apart. No SLO objective
+        # on it, so the admission loop cannot drain the queue out from
+        # under the drift signal.
+        repo.register_backend(SleepIdentity(
+            "skew_net", device, 0.05, max_batch=1, delay_us=200))
+        engine = TpuEngine(repo, warmup=True)
+        srv = HttpInferenceServer(engine, host="127.0.0.1", port=0).start()
+        return engine, srv
+
+    saved = {k: os.environ.get(k)
+             for k in ("CLIENT_TPU_SELFDRIVE", "CLIENT_TPU_SLO")}
+    os.environ["CLIENT_TPU_SELFDRIVE"] = selfdrive_spec
+    os.environ["CLIENT_TPU_SLO"] = slo_spec
+    fleet = []
+    router_srv = None
+    client = None
+    out: dict = {"replicas": replicas, "phase_s": phase_s}
+    jrnl = journal()
+    probe_seq = jrnl.export(limit=0)["next_seq"]
+    try:
+        try:
+            fleet = [build_replica() for _ in range(replicas)]
+            router = Router([Replica(srv.url) for _, srv in fleet],
+                            seed=101)
+            # The rebalancer arms only when a monitor exists AND
+            # CLIENT_TPU_SELFDRIVE is set at construction.
+            router_srv = RouterHttpServer(
+                router, port=0,
+                monitor_config=FleetMonitorConfig(
+                    interval_s=0.5, threshold=0.8, min_replicas=2,
+                    window_s=6.0)).start()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        if any(eng.selfdrive is None for eng, _ in fleet):
+            raise RuntimeError("selfdriving: engine governor not armed")
+        if router_srv.rebalancer is None:
+            raise RuntimeError("selfdriving: fleet rebalancer not armed")
+
+        client = httpclient.InferenceServerClient(
+            router_srv.url, concurrency=56)
+        inp = httpclient.InferInput("INPUT", [1, dim], "FP32")
+        inp.set_data_from_numpy(np.ones((1, dim), np.float32))
+
+        def infer(model, tenant):
+            client.infer(model, [inp],
+                         headers={"x-tpu-tenant": tenant})
+
+        def edges(category, name, since):
+            return [e for e in jrnl.snapshot(category=category,
+                                             since_seq=since)
+                    if e.name == name]
+
+        def wait_edges(category, name, since, deadline_s, n=1):
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                got = edges(category, name, since)
+                if len(got) >= n:
+                    return got
+                time.sleep(0.1)
+            return edges(category, name, since)
+
+        def direct_infer(eng, model):
+            """Async infer straight into one engine (bypassing the
+            router — the skew/burst phases need per-replica aim)."""
+            done, errs = threading.Event(), []
+
+            def cb(resp):
+                if resp.error is not None:
+                    errs.append(str(resp.error))
+                done.set()
+
+            eng.async_infer(InferRequest(
+                model_name=model,
+                inputs={"INPUT": np.ones((1, dim), np.float32)}), cb)
+            return done, errs
+
+        def sd_fill_counts():
+            rows = padded = 0.0
+            for eng, _ in fleet:
+                snap = eng.profiler.snapshot()
+                for m in snap.get("models", {}).values():
+                    if m.get("model") != "sd_net":
+                        continue
+                    for b in m.get("buckets", ()):
+                        rows += float(b.get("rows", 0) or 0)
+                        padded += float(b.get("padded_rows", 0) or 0)
+            return rows, padded
+
+        def fill_between(a, b):
+            dr, dp = b[0] - a[0], b[1] - a[1]
+            return round(dr / (dr + dp), 4) if (dr + dp) > 0 else None
+
+        # -- phase 0: baseline through the router -----------------------------
+        base = run_stable_load(
+            lambda: infer("sd_net", "live"), 2,
+            window_s=1.0, ramp_s=0.5, max_windows=3,
+            tag="selfdrive-base")
+        out["baseline"] = {"ips": round(base["ips"], 1),
+                           "p99_us": round(base["p99_us"], 1),
+                           "stable": base["stable"]}
+        log(f"selfdriving base: {base['ips']:.0f} ips, "
+            f"p99 {base['p99_us'] / 1e3:.1f}ms")
+
+        # -- phase 1: diurnal low-fill bursts -> dispatch retune --------------
+        # 3 rows staggered 1.5ms apart inside a 4ms dispatch window pad
+        # every batch to the 4-bucket (fill 0.75). After the tuner cuts
+        # the deadline, the same stagger splits into exact 2+1 batches.
+        c1 = jrnl.export(limit=0)["next_seq"]
+        f0 = sd_fill_counts()
+        stop_bursts = threading.Event()
+
+        def burst_loop(eng):
+            t0 = time.monotonic()
+            while not stop_bursts.is_set():
+                pending = []
+                for i in range(3):
+                    try:
+                        pending.append(direct_infer(eng, "sd_net"))
+                    except Exception:  # noqa: BLE001 — chaos tolerant
+                        break
+                    if i < 2:
+                        time.sleep(0.0015)
+                for done, _ in pending:
+                    done.wait(10)
+                rate = shape_rate("diurnal", time.monotonic() - t0,
+                                  phase_s, 25.0, 60.0)
+                stop_bursts.wait(1.0 / max(1.0, rate))
+
+        burst_threads = [threading.Thread(target=burst_loop, args=(eng,),
+                                          daemon=True)
+                         for eng, _ in fleet]
+        for t in burst_threads:
+            t.start()
+        tightens = wait_edges("autotune", "dispatch_tighten", c1,
+                              phase_s * 2, n=replicas)
+        f1 = sd_fill_counts()
+        time.sleep(1.5)  # post-retune window under the same bursts
+        f2 = sd_fill_counts()
+        stop_bursts.set()
+        for t in burst_threads:
+            t.join(timeout=20)
+        if not tightens:
+            raise RuntimeError(
+                "selfdriving: dispatch loop never tightened under "
+                "sustained 0.75-fill bursts")
+        fill_before = fill_between(f0, f1)
+        fill_after = fill_between(f1, f2)
+        # Quiet: the delta classifier must see the idle model and walk
+        # the override back out (the full-restore journal edge).
+        restores = wait_edges("autotune", "dispatch_restore", c1, 30.0)
+        if not restores:
+            raise RuntimeError(
+                "selfdriving: dispatch override never restored on quiet")
+        out["dispatch"] = {
+            "tighten_fired": len(tightens),
+            "restore_fired": len(restores),
+            "fill_before": fill_before,
+            "fill_after": fill_after,
+            "fill_recovered": bool(
+                fill_before is not None and fill_after is not None
+                and fill_after >= 0.8 and fill_after > fill_before),
+            "action_count": sum(
+                eng.selfdrive.snapshot()["dispatch"].get(
+                    "action_count", 0) for eng, _ in fleet),
+        }
+        log(f"selfdriving retune: tighten x{len(tightens)}, fill "
+            f"{fill_before} -> {fill_after}, restore x{len(restores)}")
+
+        # -- phase 2: flash flood -> SLO-burn admission tightening ------------
+        c2 = jrnl.export(limit=0)["next_seq"]
+        flood_counts = {"ok": 0, "shed": 0}
+        flood_lock = threading.Lock()
+        stop_flood = threading.Event()
+
+        def flood_loop():
+            while not stop_flood.is_set():
+                try:
+                    infer("burn_net", "flood")
+                    with flood_lock:
+                        flood_counts["ok"] += 1
+                except Exception:  # noqa: BLE001 — sheds are the point
+                    with flood_lock:
+                        flood_counts["shed"] += 1
+                    stop_flood.wait(0.05)
+
+        # 48 closed-loop senders -> ~24 queued per replica -> ~6 batch
+        # waves of 8ms behind each request: comfortably past the 30ms
+        # objective, while the sequential recovery trickle stays under.
+        flood_threads = [threading.Thread(target=flood_loop, daemon=True)
+                         for _ in range(48)]
+        for t in flood_threads:
+            t.start()
+        adm_tightens = wait_edges("admission", "tighten", c2, phase_s * 3)
+        stop_flood.set()
+        for t in flood_threads:
+            t.join(timeout=30)
+        if not adm_tightens:
+            raise RuntimeError(
+                "selfdriving: admission loop never tightened under burn")
+        # Recovery: fast sequential completions dilute the burn windows
+        # under the tightened rate floor, so the governor restores.
+        stop_trickle = threading.Event()
+
+        def trickle_loop():
+            while not stop_trickle.is_set():
+                try:
+                    infer("burn_net", "etl")
+                # tpulint: allow[swallowed-exception] paced best-effort
+                except Exception:  # noqa: BLE001
+                    pass
+                stop_trickle.wait(0.08)
+
+        trickle_threads = [threading.Thread(target=trickle_loop,
+                                            daemon=True)
+                           for _ in range(4)]
+        for t in trickle_threads:
+            t.start()
+        deadline = time.monotonic() + 45.0
+        adm_restores: list = []
+        while time.monotonic() < deadline:
+            adm_restores = edges("admission", "restore", c2)
+            if adm_restores and not any(
+                    eng.admission.tightened_models()
+                    for eng, _ in fleet):
+                break
+            time.sleep(0.2)
+        stop_trickle.set()
+        for t in trickle_threads:
+            t.join(timeout=10)
+        adm_cleared = bool(adm_restores) and not any(
+            eng.admission.tightened_models() for eng, _ in fleet)
+        out["admission"] = {
+            "tighten_fired": len(adm_tightens),
+            "restore_fired": len(adm_restores),
+            "cleared": adm_cleared,
+            "flood_ok": flood_counts["ok"],
+            "flood_shed": flood_counts["shed"],
+        }
+        log(f"selfdriving burn: tighten x{len(adm_tightens)}, flood "
+            f"{flood_counts['ok']} ok / {flood_counts['shed']} shed, "
+            f"cleared={adm_cleared}")
+
+        # -- phase 3: hot-replica skew -> drift re-placement ------------------
+        spurious = edges("fleet", "rebalance", probe_seq)
+        if spurious:
+            drifts = [{k: e.detail.get(k) for k in ("replica", "signals")}
+                      for e in edges("fleet", "drift", probe_seq)]
+            raise RuntimeError(
+                "selfdriving: rebalance fired before the skew phase "
+                f"(symmetric load misread as drift): {drifts}")
+        c3 = jrnl.export(limit=0)["next_seq"]
+        hot_counts = {"ok": 0, "err": 0}
+        stop_hot = threading.Event()
+        hot_eng = fleet[0][0]
+        cold_eng = fleet[1][0]
+
+        def hot_loop():
+            # Six closed-loop callers on a 50 ms serial model keep ~5
+            # calls queued: ~0.25 s of queue wait on the hot replica vs
+            # ~0 on its peer. The router's background load poller picks
+            # the skew up without any routed traffic, and the monitor's
+            # damped wait median crosses threshold only once the skew
+            # has persisted — exactly the hysteresis under test.
+            while not stop_hot.is_set():
+                try:
+                    done, errs = direct_infer(hot_eng, "skew_net")
+                    ok = done.wait(10) and not errs
+                except Exception:  # noqa: BLE001 — unload races are fine
+                    ok = False
+                with flood_lock:
+                    hot_counts["ok" if ok else "err"] += 1
+                if not ok:
+                    stop_hot.wait(0.05)
+
+        def keeper_loop():
+            # A light pulse keeps the idle replica genuinely serving
+            # (not just idle-by-omission) through the skew phase.
+            while not stop_hot.is_set():
+                try:
+                    done, _ = direct_infer(cold_eng, "skew_net")
+                    done.wait(10)
+                # tpulint: allow[swallowed-exception] pulse best-effort
+                except Exception:  # noqa: BLE001
+                    pass
+                stop_hot.wait(0.1)
+
+        hot_threads = [threading.Thread(target=hot_loop, daemon=True)
+                       for _ in range(6)]
+        hot_threads.append(threading.Thread(target=keeper_loop,
+                                            daemon=True))
+        for t in hot_threads:
+            t.start()
+        reb = wait_edges("fleet", "rebalance", c3, max(30.0, phase_s * 4))
+        reb_done = wait_edges("fleet", "rebalance_done", c3, 30.0)
+        stop_hot.set()
+        for t in hot_threads:
+            t.join(timeout=30)
+        drift_events = edges("fleet", "drift", c3)
+        if not reb or not reb_done:
+            raise RuntimeError(
+                f"selfdriving: drift loop incomplete (drift x"
+                f"{len(drift_events)}, rebalance x{len(reb)}, done x"
+                f"{len(reb_done)})")
+        # Flap check: two more monitor windows — the cooldown must hold
+        # the loop to the single rebalance it already executed.
+        time.sleep(2.0)
+        reb_all = edges("fleet", "rebalance", c3)
+        last = router_srv.rebalancer.snapshot().get("last") or {}
+        # Post-move serving: every model must still answer somewhere.
+        hosting: dict = {}
+        for model in ("sd_net", "burn_net", "skew_net"):
+            ok_on = []
+            for idx, (eng, _) in enumerate(fleet):
+                try:
+                    done, errs = direct_infer(eng, model)
+                    if done.wait(10) and not errs:
+                        ok_on.append(f"r{idx}")
+                except Exception:  # noqa: BLE001 — unloaded is expected
+                    pass
+            hosting[model] = ok_on
+        serving_after = all(hosting.values())
+        out["rebalance"] = {
+            "drift_events": len(drift_events),
+            "fired": len(reb_all),
+            "done": len(edges("fleet", "rebalance_done", c3)),
+            "moves": last.get("moves"),
+            "outcome": last.get("outcome"),
+            "hosting": hosting,
+            "serving_after": serving_after,
+            "flap_free": len(reb_all) == 1,
+            "hot_ok": hot_counts["ok"],
+            "hot_err": hot_counts["err"],
+        }
+        log(f"selfdriving drift: drift x{len(drift_events)}, rebalance "
+            f"x{len(reb_all)} ({last.get('moves')} moves, "
+            f"{last.get('outcome')}), hosting {hosting}")
+
+        # -- verdict ----------------------------------------------------------
+        out["loops_closed"] = bool(
+            tightens and restores
+            and adm_tightens and adm_cleared
+            and reb and reb_done and last.get("outcome") == "ok"
+            and serving_after)
+        out["fill_recovered"] = out["dispatch"]["fill_recovered"]
+        out["bounded"] = bool(
+            len(tightens) <= 2 * replicas
+            and len(adm_tightens) <= 2 * replicas
+            and len(reb_all) == 1
+            and (last.get("moves") or 0) <= 4)
+        log(f"selfdriving verdict: loops_closed={out['loops_closed']} "
+            f"fill_recovered={out['fill_recovered']} "
+            f"bounded={out['bounded']}")
+        client.close()
+        return out
+    finally:
+        if client is not None:
+            try:
+                client.close()
+            # tpulint: allow[swallowed-exception] close is idempotent
+            except Exception:  # noqa: BLE001
+                pass
+        if router_srv is not None:
+            router_srv.stop()
+        for eng, srv in fleet:
+            srv.stop()
+            eng.shutdown()
+
+
 def bench_sequence_oldest(n_seq: int = 128, window_s: float = 3.0,
                           stability_pct: float = 0.10,
                           stable_needed: int = 3, max_windows: int = 10):
@@ -3163,6 +3663,19 @@ def _main():
                              "throttle_cleared"),
                          "gauntlet": r})
 
+    def _rec_selfdriving(r):
+        _RESULT["selfdriving"] = r
+        # Top-level p99 = the routed baseline before any chaos — the
+        # plain-serving tail this fleet config yields; the evidence
+        # fields are what bench_summary --check verifies (every loop
+        # fired AND cleared, fill recovered, actuation bounded).
+        _append_history({"probe": "selfdriving",
+                         "p99_us": (r.get("baseline") or {}).get("p99_us"),
+                         "loops_closed": r.get("loops_closed"),
+                         "fill_recovered": r.get("fill_recovered"),
+                         "bounded": r.get("bounded"),
+                         "selfdriving": r})
+
     def _rec_seq(s):
         _RESULT["seq_oldest_steps_s"] = round(s["steps_s"], 1)
         _RESULT["seq_oldest"] = s
@@ -3252,6 +3765,7 @@ def _main():
     _run_section("shm_ring", bench_shm_ring, _rec_shm_ring)
     _run_section("shm_fanin", bench_shm_fanin, _rec_shm_fanin)
     _run_section("gauntlet", bench_gauntlet, _rec_gauntlet)
+    _run_section("selfdriving", bench_selfdriving, _rec_selfdriving)
     seq_res = _run_section("seq", bench_sequence_oldest, _rec_seq)
     seq_steps_s = seq_res["steps_s"] if seq_res else None
     gen = _run_section("gen", bench_generative, _rec_gen)

@@ -220,7 +220,8 @@ class _ModelGate:
     """Per-model admission state: bucket, in-flight count, service EWMA."""
 
     __slots__ = ("cfg", "bucket", "inflight", "shadow_inflight",
-                 "ewma_service_s")
+                 "ewma_service_s", "rate_ratio", "dyn_bucket",
+                 "dyn_base_rate", "dyn_max_inflight")
 
     def __init__(self, cfg: AdmissionConfig):
         self.cfg = cfg
@@ -231,6 +232,15 @@ class _ModelGate:
         self.inflight = 0
         self.shadow_inflight = 0
         self.ewma_service_s = 0.0
+        # Self-drive actuator state (tighten_model / set_concurrency_cap):
+        # the fraction of the configured rate currently admitted, a
+        # synthesized bucket for models with no configured rate cap, and
+        # a dynamic concurrency cap (0 = none). All of these only ever
+        # *tighten* relative to cfg.
+        self.rate_ratio = 1.0
+        self.dyn_bucket = None
+        self.dyn_base_rate = 0.0
+        self.dyn_max_inflight = 0
 
 
 class AdmissionController:
@@ -332,12 +342,18 @@ class AdmissionController:
                     f"({cfg.shadow_max_queue_depth})",
                     retry_after_s=est, reason="shadow"),
                     trace_id=trace_id, tenant=tenant)
-        if cfg.max_inflight > 0 and gate.inflight >= cfg.max_inflight:
+        # Effective concurrency cap: the configured one, tightened (never
+        # relaxed) by the self-drive governor's dynamic cap.
+        inflight_cap = cfg.max_inflight
+        if gate.dyn_max_inflight > 0:
+            inflight_cap = min(inflight_cap, gate.dyn_max_inflight) \
+                if inflight_cap > 0 else gate.dyn_max_inflight
+        if inflight_cap > 0 and gate.inflight >= inflight_cap:
             # Pushback ~ one service interval: a slot frees when the
             # oldest in-flight request completes.
             self._reject(model, version, "concurrency", AdmissionError(
                 f"model '{model}' is at its concurrency cap "
-                f"({gate.inflight}/{cfg.max_inflight} in flight)",
+                f"({gate.inflight}/{inflight_cap} in flight)",
                 retry_after_s=gate.ewma_service_s or MIN_RETRY_AFTER_S,
                 reason="concurrency"), trace_id=trace_id, tenant=tenant)
         if gate.bucket is not None and not gate.bucket.try_acquire():
@@ -346,6 +362,15 @@ class AdmissionController:
                 f"{cfg.tokens_per_s:g}/s (burst {gate.bucket.burst:g})",
                 retry_after_s=gate.bucket.retry_after_s(),
                 reason="throttled"), trace_id=trace_id, tenant=tenant)
+        if gate.dyn_bucket is not None \
+                and not gate.dyn_bucket.try_acquire():
+            # A model with no configured rate cap that the governor
+            # tightened under SLO burn: shed on the synthesized bucket.
+            self._reject(model, version, "tightened", AdmissionError(
+                f"model '{model}' admission tightened to "
+                f"{gate.rate_ratio:g}x of observed capacity under SLO "
+                "burn", retry_after_s=gate.dyn_bucket.retry_after_s(),
+                reason="tightened"), trace_id=trace_id, tenant=tenant)
         if cfg.max_queue_depth > 0 and queue_depth >= cfg.max_queue_depth:
             est = self._estimated_wait_s(gate, queue_depth, instances)
             self._reject(model, version, "queue_depth", AdmissionError(
@@ -474,6 +499,113 @@ class AdmissionController:
                         "shadow_inflight": g.shadow_inflight,
                         "ewma_service_s": g.ewma_service_s}
                     for m, g in self._gates.items()}
+
+    # -- self-drive actuators (SLO-burn tightening, concurrency nudges) ------
+
+    def tighten_model(self, model: str, version: str = "", *,
+                      factor: float = 0.5, min_ratio: float = 0.1,
+                      reason: str = "slo_burn") -> bool:
+        """Progressively lower the model's admitted rate (the SLO-burn
+        loop's actuator). Each call multiplies the current rate ratio by
+        ``factor``, floored at ``min_ratio``. With a configured token
+        bucket the cut retargets its refill rate; without one a bucket is
+        synthesized from the observed service capacity (1/EWMA), so even
+        an uncapped model can be shed under burn. Returns True when the
+        ratio actually moved. Journals ``admission.tighten`` only on the
+        untightened->tightened edge — the QoS governor's hysteresis
+        idiom — so a sustained burn logs one edge, not one per tick."""
+        gate = self._gate(model)
+        cfg = gate.cfg
+        with self._lock:
+            old = gate.rate_ratio
+            new = max(min_ratio, old * factor)
+            if new >= old:
+                return False
+            gate.rate_ratio = new
+            entered = old >= 1.0
+            if gate.bucket is None and gate.dyn_bucket is None:
+                # Capacity estimate for the synthesized cap; 1ms floor
+                # keeps a cold EWMA from minting an absurd rate.
+                gate.dyn_base_rate = 1.0 / max(gate.ewma_service_s, 1e-3)
+        if gate.bucket is not None:
+            gate.bucket.set_rate(cfg.tokens_per_s * new)
+        elif gate.dyn_bucket is None:
+            rate = max(1e-9, gate.dyn_base_rate * new)
+            gate.dyn_bucket = TokenBucket(rate, max(1.0, rate),
+                                          clock=self._clock)
+        else:
+            gate.dyn_bucket.set_rate(gate.dyn_base_rate * new)
+        if entered:
+            jour = self._journal()
+            if jour is not None:
+                jour.emit("admission", "tighten", severity="WARNING",
+                          model=model, version=version or None,
+                          ratio=round(new, 4), reason=reason)
+        return True
+
+    def restore_model(self, model: str, version: str = "", *,
+                      step: float = 2.0) -> bool:
+        """Walk one tightened model's rate ratio back up by ``step``
+        (multiplicative, capped at 1.0) — one step per quiet window, the
+        governor's restore idiom. Journals ``admission.restore`` only
+        when the ratio reaches 1.0 (the cleared edge). Returns True when
+        the ratio moved."""
+        gate = self._gate(model)
+        cfg = gate.cfg
+        with self._lock:
+            old = gate.rate_ratio
+            if old >= 1.0:
+                return False
+            new = min(1.0, old * max(1.0 + 1e-9, step))
+            gate.rate_ratio = new
+            cleared = new >= 1.0
+        if gate.bucket is not None:
+            gate.bucket.set_rate(cfg.tokens_per_s * new)
+        elif gate.dyn_bucket is not None:
+            if cleared:
+                gate.dyn_bucket = None
+            else:
+                gate.dyn_bucket.set_rate(gate.dyn_base_rate * new)
+        if cleared:
+            jour = self._journal()
+            if jour is not None:
+                jour.emit("admission", "restore", model=model,
+                          version=version or None, ratio=1.0)
+        return True
+
+    def tightened_models(self) -> dict[str, float]:
+        """{model: rate_ratio} for every model currently below 1.0."""
+        with self._lock:
+            return {m: g.rate_ratio for m, g in self._gates.items()
+                    if g.rate_ratio < 1.0}
+
+    def set_concurrency_cap(self, model: str, cap: int | None) -> int:
+        """Set (or with None, clear) the model's dynamic concurrency cap
+        — the dispatch tuner's admission-side nudge. The effective cap in
+        :meth:`admit` is min(configured, dynamic), so a nudge can only
+        tighten. Returns the dynamic cap now in force (0 = none)."""
+        gate = self._gate(model)
+        with self._lock:
+            gate.dyn_max_inflight = 0 if cap is None else max(1, int(cap))
+            return gate.dyn_max_inflight
+
+    def concurrency_cap(self, model: str) -> int:
+        """The effective concurrency cap for ``model`` (0 = uncapped)."""
+        gate = self._gate(model)
+        with self._lock:
+            cfg_cap, dyn = gate.cfg.max_inflight, gate.dyn_max_inflight
+        if dyn > 0:
+            return min(cfg_cap, dyn) if cfg_cap > 0 else dyn
+        return cfg_cap
+
+    def actuator_snapshot(self) -> dict[str, dict]:
+        """Per-model self-drive actuator state for observability
+        surfaces: only models with an active tighten or dynamic cap."""
+        with self._lock:
+            return {m: {"rate_ratio": round(g.rate_ratio, 4),
+                        "dyn_max_inflight": g.dyn_max_inflight}
+                    for m, g in self._gates.items()
+                    if g.rate_ratio < 1.0 or g.dyn_max_inflight > 0}
 
     # -- health --------------------------------------------------------------
 

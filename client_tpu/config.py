@@ -138,6 +138,14 @@ register(
     "arena); `1`/`on` takes defaults; else inline JSON or `@/path.json`.",
     "engine")
 register(
+    "CLIENT_TPU_SELFDRIVE", "", "json",
+    "Self-drive closed loops (dispatch retune, SLO-burn admission "
+    "tightening, drift re-placement): unset/`0`/`off` disables; `1`/`on` "
+    "takes defaults; else inline JSON or `@/path.json` (interval_s, "
+    "fill_low, wait_high_s, burn_factor, rebalance_cooldown_s, "
+    "max_moves_per_window, ... — see docs/SELFDRIVING.md).",
+    "engine")
+register(
     "CLIENT_TPU_GEN_CHUNK", "1", "int",
     "Decode chunk K: one device dispatch advances every stream K tokens "
     "(divides per-wave host overhead by K; adds ≤K−1 waves of TTFT).",

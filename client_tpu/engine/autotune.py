@@ -328,6 +328,25 @@ class Autotuner:
             self._applied = {k for k in self._applied if k[0] != name}
         self._refresh_gauges()
 
+    def on_version_retired(self, name: str, version) -> None:
+        """One version dropped or replaced during a *re-load* (the model
+        stays up). Without this, the retired version's cooldown keys,
+        applied-marks, and arena reservations survived the reload — a
+        version coming back inherited stale cooldowns and the arena
+        double-counted its buckets (only the full-unload path pruned,
+        and only by name)."""
+        version = str(version)
+        self.arena.release_prefix(f"bucket:{name}:{version}:")
+        self.arena.release(f"kv:{name}:{version}")
+        self.arena.release(f"rowcache:{name}:{version}")
+        with self._lock:
+            for key in [k for k in self._cooldown
+                        if k[0] == name and k[1] == version]:
+                del self._cooldown[key]
+            self._applied = {k for k in self._applied
+                             if not (k[0] == name and k[1] == version)}
+        self._refresh_gauges()
+
     # -- the decision pass ----------------------------------------------------
 
     def tick(self) -> list[dict]:
@@ -508,3 +527,287 @@ class Autotuner:
             "decisions": decisions,
         }
         return snap
+
+
+class DispatchTuner:
+    """Load-adaptive dispatch tuning: the self-drive loop that acts on
+    duty-cycle, queue wait, and fill *together* (the bucket
+    :class:`Autotuner` above only reads fill).
+
+    Per model, each tick classifies the operating point and actuates
+    through :meth:`Scheduler.set_dispatch_override` (tighten-only) and
+    the admission controller's dynamic concurrency cap:
+
+    - **starved** (fill below ``fill_low``, queue wait near zero):
+      arrivals are too sparse to fill the configured batch — waiting out
+      the full dispatch deadline buys nothing but padding. Tighten: cap
+      ``max_batch`` just above the observed mean batch occupancy (so the
+      bucket picker lands on a small, *full* bucket) and cut the gather
+      deadline by ``deadline_factor`` (floored at ``min_deadline_us``).
+    - **backlogged** (queue wait above ``wait_high_s``): full batches
+      are exactly what soaks a backlog — walk any dispatch override back
+      out immediately; when duty-cycle is also above ``duty_high`` the
+      device itself is the bottleneck, so additionally nudge the model's
+      admission concurrency cap down (shed early rather than queue).
+    - **quiet**: after ``restore_hold_s`` with neither condition,
+      restore one step per window (override widens multiplicatively,
+      concurrency cap clears) — the QoS governor's stepwise idiom.
+
+    Damping: per-(model, action) cooldowns space repeated actuations; a
+    journal edge fires only on the inactive->active transition
+    (``autotune.dispatch_tighten`` / ``autotune.concurrency_nudge``) and
+    on the full restore (``autotune.dispatch_restore`` /
+    ``autotune.concurrency_restore``), never per tick. The clock is
+    injectable so hysteresis is provable on a fake clock."""
+
+    def __init__(self, engine, *, fill_low: float = 0.5,
+                 wait_high_s: float = 0.5, duty_high: float = 0.85,
+                 min_deadline_us: int = 100, deadline_factor: float = 0.5,
+                 min_calls: int = 8, cooldown_s: float = 30.0,
+                 restore_hold_s: float = 30.0,
+                 concurrency_floor: int = 2, clock=time.monotonic):
+        self.engine = engine
+        self.fill_low = float(fill_low)
+        self.wait_high_s = float(wait_high_s)
+        self.duty_high = float(duty_high)
+        self.min_deadline_us = max(0, int(min_deadline_us))
+        self.deadline_factor = min(0.95, max(0.05, float(deadline_factor)))
+        self.min_calls = max(1, int(min_calls))
+        self.cooldown_s = float(cooldown_s)
+        self.restore_hold_s = float(restore_hold_s)
+        self.concurrency_floor = max(1, int(concurrency_floor))
+        self._clock = clock
+        self._lock = lockdep.Lock("engine.dispatch_tuner")
+        # (model, version) -> mutable loop state.
+        self._state: dict[tuple, dict] = {}
+        self._decisions: deque[dict] = deque(maxlen=64)
+        self.action_count = 0
+
+    # -- helpers --------------------------------------------------------------
+
+    def _journal(self, name: str, model: str, version,
+                 severity: str = "INFO", **detail) -> None:
+        from client_tpu.observability.events import journal
+
+        journal().emit("autotune", name, model=model,
+                       version=str(version) if version is not None else None,
+                       severity=severity, **detail)
+
+    def _note(self, st: dict, action: str, name: str, version,
+              **detail) -> dict:
+        now = self._clock()
+        st["cooldown"][action] = now + self.cooldown_s
+        d = {"action": action, "model": name, "version": str(version),
+             **detail}
+        with self._lock:
+            self._decisions.append(d)
+            self.action_count += 1
+        return d
+
+    def _cooling(self, st: dict, action: str) -> bool:
+        return self._clock() < st["cooldown"].get(action, 0.0)
+
+    # -- one evaluation pass ---------------------------------------------------
+
+    def tick(self) -> list[dict]:
+        """Classify every batched model and actuate; returns the
+        decisions taken this pass (tests drive this directly)."""
+        snap = self.engine.profiler.snapshot()
+        loads = self.engine.admission.load_snapshot()
+        duty = float(snap.get("duty_cycle") or 0.0)
+        out: list[dict] = []
+        seen: set[tuple] = set()
+        for entry in snap.get("models", {}).values():
+            name, version = entry["model"], entry["version"]
+            seen.add((name, str(version)))
+            sched = self.engine.scheduler_for(name, version)
+            if sched is None:
+                continue
+            cfg = sched.model.config
+            dyn = cfg.dynamic_batching
+            if dyn is None or cfg.max_batch_size <= 1:
+                continue
+            buckets = entry.get("buckets") or []
+            execs = sum(b["executions"] for b in buckets)
+            rows = sum(b["rows"] for b in buckets)
+            padded = sum(b["padded_rows"] for b in buckets)
+            depth = sched.queue.qsize()
+            service = loads.get(name, {}).get("ewma_service_s", 0.0)
+            wait_s = depth * service / max(1, cfg.instance_count)
+            with self._lock:  # snapshot() iterates _state concurrently
+                st = self._state.setdefault((name, str(version)), {
+                    "tight": False, "nudged": False, "cooldown": {},
+                    "quiet_since": None, "prev": (0, 0, 0)})
+            # Profiler bucket counters are cumulative — classify on the
+            # delta since the last classification, so a model that goes
+            # idle reads as quiet (and restores) instead of frozen at
+            # its last fill ratio forever.
+            pe, pr, pp = st.get("prev", (0, 0, 0))
+            if execs < pe or rows < pr or padded < pp:
+                pe = pr = pp = 0  # counters reset (reload/unload)
+            d_execs, d_rows = execs - pe, rows - pr
+            d_padded = padded - pp
+            fill = d_rows / max(1, d_rows + d_padded)
+            # No executions at all since the previous pass = idle, even
+            # if a sub-min_calls residue is still accumulating.
+            stalled = execs == st.get("last_seen", -1)
+            st["last_seen"] = execs
+            backlogged = wait_s >= self.wait_high_s
+            if backlogged:
+                st["prev"] = (execs, rows, padded)
+                st["quiet_since"] = None
+                out.extend(self._on_backlog(st, sched, name, version,
+                                            duty, wait_s, loads))
+            elif d_execs >= self.min_calls:
+                st["prev"] = (execs, rows, padded)
+                if fill < self.fill_low:
+                    st["quiet_since"] = None
+                    d = self._on_starved(st, sched, entry, name, version,
+                                         fill, d_rows, d_execs)
+                    if d is not None:
+                        out.append(d)
+                else:
+                    out.extend(self._on_quiet(st, sched, name, version))
+            elif d_execs == 0 or stalled:
+                # Fully idle since the last pass: quiet. A stalled
+                # partial delta is discarded, not hoarded forever.
+                st["prev"] = (execs, rows, padded)
+                out.extend(self._on_quiet(st, sched, name, version))
+            # else: a trickle below min_calls — keep accumulating the
+            # delta; no classification, no actuation.
+        # A fully idle model ages out of the profiler window and stops
+        # appearing in the snapshot — exactly when its override should
+        # restore. Walk actuated states the pass above never visited.
+        with self._lock:
+            stale = [(k, st) for k, st in self._state.items()
+                     if k not in seen and (st["tight"] or st["nudged"])]
+        for (name, version), st in stale:
+            sched = self.engine.scheduler_for(name, version)
+            if sched is None:
+                continue
+            out.extend(self._on_quiet(st, sched, name, version))
+        return out
+
+    def _on_starved(self, st: dict, sched, entry: dict, name: str,
+                    version, fill: float, rows: int,
+                    execs: int) -> dict | None:
+        if self._cooling(st, "dispatch"):
+            return None
+        cfg = sched.model.config
+        cur = sched.dispatch_overrides()
+        dyn = cfg.dynamic_batching
+        cur_delay = cur.get("max_queue_delay_us",
+                            dyn.max_queue_delay_microseconds)
+        new_delay = max(self.min_deadline_us,
+                        int(cur_delay * self.deadline_factor))
+        # Cap the batch just above observed occupancy: the bucket picker
+        # then lands on a small bucket that actually fills, instead of
+        # padding the configured maximum.
+        mean_rows = max(1.0, rows / max(1, execs))
+        cap = 1
+        while cap < mean_rows:
+            cap *= 2
+        cap = min(cfg.max_batch_size, cap)
+        if new_delay >= cur_delay and cap >= cur.get(
+                "max_batch", cfg.max_batch_size):
+            return None  # already at the floor — nothing to tighten
+        sched.set_dispatch_override(max_queue_delay_us=new_delay,
+                                    max_batch=cap)
+        entered = not st["tight"]
+        st["tight"] = True
+        if entered:
+            self._journal("dispatch_tighten", name, version,
+                          severity="WARNING", fill_ratio=round(fill, 4),
+                          max_batch=cap, max_queue_delay_us=new_delay)
+        return self._note(st, "dispatch", name, version,
+                          fill_ratio=round(fill, 4), max_batch=cap,
+                          max_queue_delay_us=new_delay)
+
+    def _on_backlog(self, st: dict, sched, name: str, version,
+                    duty: float, wait_s: float, loads: dict) -> list[dict]:
+        out = []
+        # A backlog wants full batches: drop any dispatch tightening NOW
+        # (no cooldown — holding a starvation override through a burst
+        # would throttle exactly when throughput matters).
+        if st["tight"]:
+            sched.set_dispatch_override()
+            st["tight"] = False
+            self._journal("dispatch_restore", name, version,
+                          wait_s=round(wait_s, 4), reason="backlog")
+            out.append(self._note(st, "dispatch_restore", name, version,
+                                  reason="backlog"))
+        if duty >= self.duty_high and not self._cooling(st, "concurrency"):
+            adm = self.engine.admission
+            inflight = loads.get(name, {}).get("inflight", 0)
+            cur = adm.concurrency_cap(name) or max(
+                inflight, self.concurrency_floor * 2)
+            cap = max(self.concurrency_floor, int(cur * 0.75))
+            if cap < cur:
+                adm.set_concurrency_cap(name, cap)
+                entered = not st["nudged"]
+                st["nudged"] = True
+                if entered:
+                    self._journal("concurrency_nudge", name, version,
+                                  severity="WARNING", cap=cap,
+                                  duty_cycle=round(duty, 4),
+                                  wait_s=round(wait_s, 4))
+                out.append(self._note(st, "concurrency", name, version,
+                                      cap=cap))
+        return out
+
+    def _on_quiet(self, st: dict, sched, name: str, version) -> list[dict]:
+        if not (st["tight"] or st["nudged"]):
+            st["quiet_since"] = None
+            return []
+        now = self._clock()
+        if st["quiet_since"] is None:
+            st["quiet_since"] = now
+            return []
+        if now - st["quiet_since"] < self.restore_hold_s:
+            return []
+        # One restore step per quiet window, then the window restarts.
+        st["quiet_since"] = now
+        out = []
+        if st["nudged"]:
+            self.engine.admission.set_concurrency_cap(name, None)
+            st["nudged"] = False
+            self._journal("concurrency_restore", name, version)
+            out.append(self._note(st, "concurrency_restore", name,
+                                  version))
+            return out
+        cfg = sched.model.config
+        dyn = cfg.dynamic_batching
+        cur = sched.dispatch_overrides()
+        new_delay = min(dyn.max_queue_delay_microseconds,
+                        max(1, int(cur.get(
+                            "max_queue_delay_us",
+                            dyn.max_queue_delay_microseconds))
+                            * 2))
+        new_cap = min(cfg.max_batch_size,
+                      cur.get("max_batch", cfg.max_batch_size) * 2)
+        if new_delay >= dyn.max_queue_delay_microseconds \
+                and new_cap >= cfg.max_batch_size:
+            sched.set_dispatch_override()
+            st["tight"] = False
+            self._journal("dispatch_restore", name, version,
+                          reason="quiet")
+            out.append(self._note(st, "dispatch_restore", name, version,
+                                  reason="quiet"))
+        else:
+            sched.set_dispatch_override(max_queue_delay_us=new_delay,
+                                        max_batch=new_cap)
+            out.append(self._note(st, "dispatch_step", name, version,
+                                  max_batch=new_cap,
+                                  max_queue_delay_us=new_delay))
+        return out
+
+    def snapshot(self) -> dict:
+        """Loop state for observability surfaces (/v2/profile's
+        ``selfdrive`` section): per-model phase plus recent decisions."""
+        with self._lock:
+            decisions = list(self._decisions)
+            models = {f"{n}:{v}": {"tight": st["tight"],
+                                   "nudged": st["nudged"]}
+                      for (n, v), st in self._state.items()}
+        return {"models": models, "decisions": decisions,
+                "action_count": self.action_count}

@@ -182,11 +182,24 @@ class TpuEngine:
         if _tune_cfg is not None:
             self.autotuner = Autotuner(self, _tune_cfg,
                                        registry=self.metrics.registry)
+        # Opt-in self-drive governor (CLIENT_TPU_SELFDRIVE): closes the
+        # dispatch-retune and SLO-burn-tightening loops. Unset → None,
+        # no thread, byte-identical engine.
+        from client_tpu.engine.selfdrive import (
+            SelfDriveConfig,
+            SelfDriveGovernor,
+        )
+
+        self.selfdrive: SelfDriveGovernor | None = None
+        _sd_cfg = SelfDriveConfig.from_env()
+        if _sd_cfg is not None:
+            self.selfdrive = SelfDriveGovernor(self, _sd_cfg)
         self.events.emit(
             "lifecycle", "server_start",
             models=len(self.repository.names()),
             slo_enabled=self.slo.enabled,
-            autotune=self.autotuner is not None)
+            autotune=self.autotuner is not None,
+            selfdrive=self.selfdrive is not None)
         if load_all:
             for name in self.repository.names():
                 try:
@@ -200,6 +213,8 @@ class TpuEngine:
                         severity="ERROR", model=name, error=str(exc))
         if self.autotuner is not None:
             self.autotuner.start()
+        if self.selfdrive is not None:
+            self.selfdrive.start()
         # The QoS governor needs both the alarm (SLO fast burn) and the
         # actuator (a throttleable class bucket); start_governor no-ops
         # without the latter.
@@ -385,6 +400,14 @@ class TpuEngine:
             self.events.emit("model", "load", model=name,
                              version=model.config.version)
         if self.autotuner is not None:
+            # Retired versions first (dropped by the re-poll or replaced
+            # by a new model object): prune their cooldowns/applied marks
+            # and release their arena reservations BEFORE the new
+            # incarnations re-reserve — otherwise a reload inherits stale
+            # cooldowns and the arena double-counts replaced buckets.
+            for v in sorted({str(s.model.config.version)
+                             for s in retired}):
+                self.autotuner.on_version_retired(name, v)
             for model, sched in zip(new_models, new_scheds):
                 self.autotuner.on_model_loaded(model, sched)
         if self._warmup:
@@ -1078,6 +1101,8 @@ class TpuEngine:
                 entry["row_cache"] = cache.snapshot()
         if self.autotuner is not None:
             self.autotuner.annotate(snap)
+        if self.selfdrive is not None:
+            snap["selfdrive"] = self.selfdrive.snapshot()
         rings = self.ring_shm.profile_table()
         if rings:
             snap["shm_rings"] = rings
@@ -1088,6 +1113,8 @@ class TpuEngine:
         # per-device walk detail (that's /v2/memory's job).
         census = self.memory_census()
         snap["memory"] = {
+            "bytes_limit": census["totals"].get("bytes_limit", 0),
+            "bytes_in_use": census["totals"].get("bytes_in_use", 0),
             "committed_bytes": census["totals"]["committed_bytes"],
             "attributed_bytes": census["attributed_bytes"],
             "unattributed_bytes": census["unattributed_bytes"],
@@ -1123,6 +1150,8 @@ class TpuEngine:
             self.qos.stop_governor()
         if getattr(self, "recorder", None) is not None:
             self.recorder.detach(self)
+        if getattr(self, "selfdrive", None) is not None:
+            self.selfdrive.stop()
         if getattr(self, "autotuner", None) is not None:
             self.autotuner.stop()
         if getattr(self, "trace", None) is not None:

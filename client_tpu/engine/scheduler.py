@@ -369,6 +369,10 @@ class Scheduler:
             raise EngineError(
                 f"model '{model.config.name}': preserve_ordering cannot be "
                 "combined with priority_levels", 400)
+        # Runtime dispatch override (the self-drive tuner's actuator):
+        # a single immutable dict swapped atomically, read once per
+        # gather. None means "use the model config as written".
+        self._dispatch_override: dict | None = None
         self._order_lock = lockdep.Lock("scheduler.order")
         self._arrival_seq = 0        # assigned at submit
         self._release_seq = 0        # next sequence allowed to respond
@@ -414,6 +418,31 @@ class Scheduler:
         picked (its executable stays in the jit cache). Returns the
         ladder actually applied (validated/clamped)."""
         return self.model.swap_buckets(buckets)
+
+    # -- dispatch overrides (self-drive tuner surface) ------------------------
+
+    def set_dispatch_override(self, *, max_queue_delay_us: int | None = None,
+                              max_batch: int | None = None) -> None:
+        """Override the gather window and/or batch cap at runtime without
+        touching the model config. Overrides only ever *tighten* (the
+        effective values are min()'d against the config), so a stale or
+        wild override cannot relax the operator's limits. Passing both
+        as None clears the override. The dict is swapped in one atomic
+        attribute store; workers read it once per gather."""
+        if max_queue_delay_us is None and max_batch is None:
+            self._dispatch_override = None
+            return
+        ovr: dict = {}
+        if max_queue_delay_us is not None:
+            ovr["max_queue_delay_us"] = max(0, int(max_queue_delay_us))
+        if max_batch is not None:
+            ovr["max_batch"] = max(1, int(max_batch))
+        self._dispatch_override = ovr
+
+    def dispatch_overrides(self) -> dict:
+        """The active override (empty dict when running as configured)."""
+        ovr = self._dispatch_override
+        return dict(ovr) if ovr else {}
 
     def submit(self, req: InferRequest) -> None:
         # Chaos site: scheduler admission — an injected error here proves
@@ -683,7 +712,17 @@ class DefaultScheduler(Scheduler):
         cfg = self.model.config
         max_batch = cfg.max_batch_size
         prefer = max(dyn.preferred_batch_size) if dyn.preferred_batch_size else max_batch
-        deadline_ns = now_ns() + dyn.max_queue_delay_microseconds * 1000
+        delay_us = dyn.max_queue_delay_microseconds
+        ovr = self._dispatch_override
+        if ovr is not None:
+            # Overrides tighten, never relax: min() against config keeps a
+            # stale tuner decision inside the operator's envelope.
+            if "max_batch" in ovr:
+                max_batch = min(max_batch, ovr["max_batch"])
+                prefer = min(prefer, max_batch)
+            if "max_queue_delay_us" in ovr:
+                delay_us = min(delay_us, ovr["max_queue_delay_us"])
+        deadline_ns = now_ns() + delay_us * 1000
         batch = [first]
         total = _request_batch(first)
         # Preemption: a batch-lane gather yields to a waiting

@@ -38,6 +38,7 @@ from client_tpu.observability.events import journal
 from client_tpu.observability.fleet import (
     FleetMonitorConfig,
     drift_scores,
+    fleet_median,
     merge_costs,
     merge_events,
     merge_expositions,
@@ -238,6 +239,19 @@ class FleetMonitor:
         self._flagged: dict[str, dict[str, float]] = {}
         self._report: dict = {"ticks": 0}
         self._ticks = 0
+        # Queue wait comes from the router's instantaneous load view —
+        # unlike the flight-recorder signals it has no windowed median
+        # of its own, and one wait spike at one tick must not flag a
+        # replica. Damp it here over the same window the recorder
+        # signals use (one sample per monitor tick).
+        self._wait_ticks = max(1, int(round(config.window_s
+                                            / config.interval_s)))
+        self._wait_hist: dict[str, list[float]] = {}
+        # Optional drift actuator (router/selfdrive.FleetRebalancer):
+        # called with the fresh report on every tick that has flagged
+        # replicas. The callee owns its own damping (cooldown, move
+        # budget); the monitor stays a pure sensor.
+        self.on_drift = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -289,7 +303,10 @@ class FleetMonitor:
                 sig = profile_signals(profiles.get(r.id))
             wait = (loads.get(r.id) or {}).get("wait_s")
             if wait is not None:
-                sig["wait_s"] = float(wait)
+                hist = self._wait_hist.setdefault(r.id, [])
+                hist.append(float(wait))
+                del hist[:-self._wait_ticks]
+                sig["wait_s"] = fleet_median(hist)
             signals[r.id] = sig
         errors = dict(ts_errors)
         errors.update(prof_errors)
@@ -345,6 +362,11 @@ class FleetMonitor:
         }
         with self._lock:
             self._report = report
+        if flagged and callable(self.on_drift):
+            try:
+                self.on_drift(report)
+            except Exception:  # noqa: BLE001 — actuator must not kill the sensor
+                _log.exception("fleet drift actuator failed")
         return report
 
     def drift_report(self) -> dict:

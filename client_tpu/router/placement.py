@@ -20,15 +20,40 @@ from __future__ import annotations
 
 import json
 
-__all__ = ["model_costs", "plan_placement", "placement_moves",
+__all__ = ["model_costs", "interference_costs", "model_hbm_bytes",
+           "plan_placement", "placement_moves", "budget_guard",
            "apply_placement"]
 
 
 HBM_WEIGHT_S_PER_GB = 10.0
+# Interference device-seconds (co-batch + queue-wait legs of the cost
+# ledger's attribution) count this much extra contention cost. >1
+# because interference a model *already* suffered predicts it will fight
+# whatever it is co-located with next.
+INTERFERENCE_WEIGHT = 2.0
+
+
+def interference_costs(costs: dict | None) -> dict[str, float]:
+    """Per-model interference device-seconds from a (federated)
+    ``/v2/costs`` snapshot: the ledger's co-batch and queue-wait legs
+    summed across tenants and versions. These are the seconds a model
+    spent fighting its co-residents — the empirical contention signal
+    the placement cost folds in."""
+    out: dict[str, float] = {}
+    for tenant in ((costs or {}).get("tenants") or {}).values():
+        for mkey, row in (tenant.get("models") or {}).items():
+            name = mkey.rsplit(":", 1)[0]
+            inter = row.get("interference") or {}
+            out[name] = out.get(name, 0.0) \
+                + float(inter.get("co_batch_s", 0.0) or 0.0) \
+                + float(inter.get("queue_wait_s", 0.0) or 0.0)
+    return out
 
 
 def model_costs(profiles: dict[str, dict],
                 hbm_weight_s_per_gb: float = HBM_WEIGHT_S_PER_GB,
+                costs: dict | None = None,
+                interference_weight: float = INTERFERENCE_WEIGHT,
                 ) -> dict[str, float]:
     """Fleet-wide per-model contention cost from ``/v2/profile`` bodies:
     device-seconds summed across replicas and versions (device time is
@@ -39,7 +64,14 @@ def model_costs(profiles: dict[str, dict],
     replicas, not summed), so LPT spreads two table-heavy models onto
     different replicas even when both are idle. Models that have never
     executed and reserve nothing cost a nominal epsilon so they still
-    get spread out."""
+    get spread out.
+
+    ``costs`` (a federated ``/v2/costs`` snapshot) adds the cost
+    ledger's interference attribution: a model that measurably co-batched
+    or queued behind its co-residents gets
+    ``interference_weight x`` those device-seconds on top, so LPT
+    separates the DLRM/generative/vision kind of mix that looks cheap by
+    device time alone but pathological when co-located."""
     device_s: dict[str, float] = {}
     hbm_bytes: dict[str, float] = {}
     for prof in profiles.values():
@@ -51,8 +83,10 @@ def model_costs(profiles: dict[str, dict],
                 entry.get("device_s", 0.0) or 0.0)
             hbm_bytes[name] = max(hbm_bytes.get(name, 0.0), float(
                 entry.get("hbm_bytes", 0) or 0))
+    inter = interference_costs(costs)
     return {m: (c + hbm_bytes[m] / (1 << 30) * hbm_weight_s_per_gb
-                if c + hbm_bytes[m] > 0 else 1e-6)
+                + interference_weight * inter.get(m, 0.0)
+                if c + hbm_bytes[m] + inter.get(m, 0.0) > 0 else 1e-6)
             for m, c in device_s.items()}
 
 
@@ -103,14 +137,88 @@ def placement_moves(plan: dict[str, list[str]],
     return loads + unloads
 
 
-def apply_placement(router, plan: dict[str, list[str]]) -> list[dict]:
+def model_hbm_bytes(profiles: dict[str, dict]) -> dict[str, float]:
+    """Per-model HBM reservation (max across replicas) from the
+    profiles' per-model ``hbm_bytes`` annotations."""
+    out: dict[str, float] = {}
+    for prof in profiles.values():
+        for entry in (prof.get("models") or {}).values():
+            name = entry.get("model")
+            if name:
+                out[name] = max(out.get(name, 0.0),
+                                float(entry.get("hbm_bytes", 0) or 0))
+    return out
+
+
+def budget_guard(steps: list[dict], profiles: dict[str, dict],
+                 headroom: float = 0.95,
+                 events=None) -> tuple[list[dict], list[dict]]:
+    """Apply-path HBM guard: drop load steps whose target replica lacks
+    census-reported free HBM (``memory.bytes_limit x headroom`` minus
+    ``memory.committed_bytes``, from the replica's own profile) for the
+    model's reservation, *before* any step is issued — rejecting up
+    front beats failing mid-apply with capacity already removed. A
+    rejected load also cancels every unload of the same model this
+    apply (the copy count must not shrink because the add never
+    happened). Replicas that report no limit (CPU dev, tests without a
+    device) are not guarded. Returns (admitted, rejected); each
+    rejection is journaled as ``placement.rejected_budget``."""
+    sizes = model_hbm_bytes(profiles)
+    free: dict[str, float] = {}
+    for rid, prof in profiles.items():
+        mem = prof.get("memory") or {}
+        limit = float(mem.get("bytes_limit", 0) or 0)
+        if limit > 0:
+            free[rid] = limit * headroom - float(
+                mem.get("committed_bytes", 0) or 0)
+    admitted, rejected, cancelled_models = [], [], set()
+    for step in steps:
+        if step["action"] != "load":
+            continue
+        rid, model = step["replica"], step["model"]
+        need = sizes.get(model, 0.0)
+        if rid in free and need > free[rid]:
+            rejected.append({**step, "ok": False,
+                             "error": "rejected_budget",
+                             "need_bytes": int(need),
+                             "free_bytes": int(max(0, free[rid]))})
+            cancelled_models.add(model)
+            if events is not None:
+                events.emit("placement", "rejected_budget",
+                            severity="WARNING", model=model,
+                            replica=rid, need_bytes=int(need),
+                            free_bytes=int(max(0, free[rid])))
+        else:
+            if rid in free:
+                free[rid] -= need
+            admitted.append(step)
+    for step in steps:
+        if step["action"] != "unload":
+            continue
+        if step["model"] in cancelled_models:
+            rejected.append({**step, "ok": False,
+                             "error": "cancelled_with_rejected_load"})
+        else:
+            admitted.append(step)
+    return admitted, rejected
+
+
+def apply_placement(router, plan: dict[str, list[str]],
+                    profiles: dict[str, dict] | None = None) -> list[dict]:
     """Issue the load/unload steps against the replicas through their
     repository control plane. Returns the step list with per-step
     ``ok``/``error`` annotations; a failed load aborts before any unload
-    runs (capacity is never removed after an add failed)."""
+    runs (capacity is never removed after an add failed). With
+    ``profiles`` (the same ``/v2/profile`` bodies the plan came from),
+    :func:`budget_guard` vets each load against the target replica's
+    census-reported free HBM first."""
     current = {r.id: set(r.load.models) for r in router.replicas}
     steps = placement_moves(plan, current)
     results = []
+    if profiles:
+        steps, rejected = budget_guard(steps, profiles,
+                                       events=router.events)
+        results.extend(rejected)
     for step in steps:
         replica = router.replica(step["replica"])
         path = f"/v2/repository/models/{step['model']}/{step['action']}"

@@ -47,6 +47,7 @@ from client_tpu.router.fleet import (
     stitched_trace,
 )
 from client_tpu.router import placement as _placement
+from client_tpu.router.selfdrive import fleet_plan
 
 _log = logging.getLogger("client_tpu")
 
@@ -66,6 +67,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
     router: Router = None  # patched on by RouterHttpServer
     federator: FleetFederator = None
     monitor: FleetMonitor | None = None
+    rebalancer = None  # FleetRebalancer when CLIENT_TPU_SELFDRIVE is set
     verbose = False
 
     def log_message(self, fmt, *args):  # noqa: A003
@@ -139,7 +141,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
         self._send_json(self.router.status())
 
     def h_get_v2_router_status(self, body):
-        self._send_json(self.router.status())
+        status = self.router.status()
+        if self.rebalancer is not None:
+            status["selfdrive"] = self.rebalancer.snapshot()
+        self._send_json(status)
 
     def h_get_metrics(self, body):
         accept = self.headers.get("Accept", "") or ""
@@ -151,28 +156,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
                    headers=[("Content-Type", ctype)])
 
     def _placement_plan(self):
-        profiles, current = {}, {}
-        for r in self.router.eligible():
-            try:
-                status, _, data = r.send("GET", "/v2/profile", timeout_s=10)
-                if status == 200:
-                    profiles[r.id] = json.loads(data)
-            # tpulint: allow[swallowed-exception] plan over who answers
-            except Exception:  # noqa: BLE001 — plan over who answers
-                continue
-            current[r.id] = set(r.load.models)
-        costs = _placement.model_costs(profiles)
-        if not costs:
-            # Nothing has executed yet: place whatever the fleet hosts.
-            for models in current.values():
-                for m in models:
-                    costs.setdefault(m, 1e-6)
-        plan = _placement.plan_placement(
-            costs, sorted(profiles) or sorted(current))
-        return costs, current, plan
+        # Shared with the drift-triggered rebalancer: the HTTP surface
+        # and the closed loop plan from identical logic (including the
+        # cost ledger's interference attribution).
+        return fleet_plan(self.router, self.federator)
 
     def h_get_v2_router_placement(self, body):
-        costs, current, plan = self._placement_plan()
+        costs, current, plan, _profiles = self._placement_plan()
         # Placement plans carry the fleet's observed drift so continuous
         # re-placement (ROADMAP item 2) has evidence, not just costs.
         drift = (self.monitor.drift_report() if self.monitor is not None
@@ -186,8 +176,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
         })
 
     def h_post_v2_router_placement(self, body):
-        _, current, plan = self._placement_plan()
-        results = _placement.apply_placement(self.router, plan)
+        _, current, plan, profiles = self._placement_plan()
+        results = _placement.apply_placement(self.router, plan,
+                                             profiles=profiles)
         self._send_json({"plan": plan, "applied": results})
 
     def h_post_v2_router_drain(self, body):
@@ -318,9 +309,21 @@ class RouterHttpServer:
         self.monitor = (FleetMonitor(router, monitor_config,
                                      self.federator)
                         if monitor_config is not None else None)
+        # CLIENT_TPU_SELFDRIVE closes the drift loop: the monitor's
+        # fleet.drift edge drives a damped, budgeted re-placement.
+        self.rebalancer = None
+        if self.monitor is not None:
+            from client_tpu.engine.selfdrive import SelfDriveConfig
+            from client_tpu.router.selfdrive import FleetRebalancer
+            sd_cfg = SelfDriveConfig.from_env()
+            if sd_cfg is not None:
+                self.rebalancer = FleetRebalancer(
+                    router, sd_cfg, federator=self.federator)
+                self.monitor.on_drift = self.rebalancer.on_drift
         handler = type("BoundRouterHandler", (_RouterHandler,),
                        {"router": router, "federator": self.federator,
-                        "monitor": self.monitor, "verbose": verbose})
+                        "monitor": self.monitor,
+                        "rebalancer": self.rebalancer, "verbose": verbose})
         server_cls = type("_RouterHttpd", (ThreadingHTTPServer,),
                           {"request_queue_size": 128})
         self.httpd = server_cls((host, port), handler)

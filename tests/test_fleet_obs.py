@@ -330,6 +330,66 @@ class TestFleetMonitor:
             assert promlint.lint(text, openmetrics=om) == []
 
 
+class _StubFederator:
+    """collect_signals test double: no timeseries, no profiles, a load
+    view the test mutates between ticks."""
+
+    def __init__(self, loads):
+        self.loads_by_replica = loads
+
+    def timeseries_raw(self):
+        return {}, {}
+
+    def profiles(self):
+        return {}, {}
+
+    def loads(self):
+        return {rid: dict(v) for rid, v in self.loads_by_replica.items()}
+
+
+class TestWaitDamping:
+    # Queue wait is the one drift signal without a flight-recorder
+    # median behind it: the monitor must damp it itself, or one wait
+    # spike at one tick flags a replica (the spurious-rebalance failure
+    # mode the selfdriving bench guards against).
+    def _fleet(self, window_s=8.0):
+        router = Router([Replica("127.0.0.1:1"), Replica("127.0.0.1:2"),
+                         Replica("127.0.0.1:3")],
+                        seed=7, poll_interval_s=3600.0)
+        fed = _StubFederator({r.id: {"wait_s": 0.05}
+                              for r in router.replicas})
+        monitor = FleetMonitor(
+            router,
+            FleetMonitorConfig(interval_s=1.0, threshold=0.5,
+                               window_s=window_s),
+            federator=fed)
+        return router, fed, monitor
+
+    def test_single_tick_spike_holds_sustained_skew_crosses(self):
+        _, fed, monitor = self._fleet()
+        for _ in range(8):
+            monitor.collect_signals()
+        # One-tick spike: the windowed median holds at baseline.
+        fed.loads_by_replica["127.0.0.1:3"] = {"wait_s": 5.0}
+        signals, errors = monitor.collect_signals()
+        assert errors == {}
+        assert signals["127.0.0.1:3"]["wait_s"] == 0.05
+        # Sustained skew: once it owns the median window, it reads
+        # through at full value and tick() flags the replica.
+        for _ in range(5):
+            signals, _ = monitor.collect_signals()
+        assert signals["127.0.0.1:3"]["wait_s"] == 5.0
+        report = monitor.tick()
+        assert list(report["flagged"]) == ["127.0.0.1:3"]
+
+    def test_history_is_bounded_by_the_window(self):
+        _, fed, monitor = self._fleet(window_s=4.0)
+        for _ in range(50):
+            monitor.collect_signals()
+        hist = monitor._wait_hist["127.0.0.1:1"]
+        assert len(hist) == 4
+
+
 # ---------------------------------------------------------------------------
 # E2E: two in-process engines behind a real router frontend
 

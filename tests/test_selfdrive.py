@@ -1,0 +1,558 @@
+"""Self-driving closed loops — fake-clock hysteresis units.
+
+Every loop the self-drive stack closes (dispatch retune, SLO-burn
+admission tightening, drift-triggered re-placement) must be provably
+*damped*: edge-triggered journal events (one per transition, never per
+tick), cooldown-spaced actuations, and stepwise restores that take
+exactly one step per quiet window. These tests drive each loop's public
+``tick``/``maybe_rebalance`` directly on a fake clock — no threads, no
+sleeps — so the hysteresis contract is deterministic.
+"""
+
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from client_tpu.admission import AdmissionConfig, AdmissionController
+from client_tpu.engine.autotune import DispatchTuner
+from client_tpu.engine.selfdrive import (
+    ENV_VAR,
+    SelfDriveConfig,
+    SelfDriveGovernor,
+)
+from client_tpu.engine.types import EngineError
+from client_tpu.observability.events import journal
+from client_tpu.router.selfdrive import FleetRebalancer, _truncate_steps
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _cursor():
+    return journal().export(limit=0)["next_seq"]
+
+
+def _events(category, name, since):
+    return [e for e in journal().snapshot(category=category)
+            if e.name == name and e.seq > since]
+
+
+# -- dispatch-retune loop stubs ----------------------------------------------
+
+
+class StubSched:
+    """Mirrors the real Scheduler's dispatch-override surface."""
+
+    def __init__(self, max_batch=8, delay_us=5000, depth=0):
+        dyn = SimpleNamespace(max_queue_delay_microseconds=delay_us)
+        cfg = SimpleNamespace(max_batch_size=max_batch, instance_count=1,
+                              dynamic_batching=dyn)
+        self.model = SimpleNamespace(config=cfg)
+        self.queue = SimpleNamespace(qsize=lambda: depth)
+        self._ovr = None
+
+    def set_depth(self, depth):
+        self.queue = SimpleNamespace(qsize=lambda: depth)
+
+    def set_dispatch_override(self, *, max_queue_delay_us=None,
+                              max_batch=None):
+        if max_queue_delay_us is None and max_batch is None:
+            self._ovr = None
+            return
+        d = {}
+        if max_queue_delay_us is not None:
+            d["max_queue_delay_us"] = max(0, int(max_queue_delay_us))
+        if max_batch is not None:
+            d["max_batch"] = max(1, int(max_batch))
+        self._ovr = d
+
+    def dispatch_overrides(self):
+        return dict(self._ovr or {})
+
+
+class StubTunerEngine:
+    """Just enough engine for DispatchTuner.tick(): a profiler snapshot,
+    an admission load view + concurrency caps, and scheduler_for."""
+
+    def __init__(self, sched, clock):
+        self.sched = sched
+        self.duty = 0.1
+        self.execs, self.rows, self.padded = 0, 0, 0
+        self.service_s = 0.0
+        self.admission = AdmissionController(AdmissionConfig(),
+                                             clock=clock)
+        self.profiler = SimpleNamespace(snapshot=self._snap)
+
+    def _snap(self, **_):
+        return {"duty_cycle": self.duty, "models": {"m:1": {
+            "model": "m", "version": "1",
+            "buckets": [{"executions": self.execs, "rows": self.rows,
+                         "padded_rows": self.padded}]}}}
+
+    def scheduler_for(self, name, version=""):
+        return self.sched
+
+    def add(self, execs, rows, padded):
+        # Profiler bucket counters are cumulative; traffic accumulates.
+        self.execs += execs
+        self.rows += rows
+        self.padded += padded
+
+
+def _tuner(clock, **over):
+    sched = StubSched()
+    eng = StubTunerEngine(sched, clock)
+    kw = dict(fill_low=0.5, wait_high_s=0.5, duty_high=0.85,
+              min_deadline_us=100, deadline_factor=0.5, min_calls=8,
+              cooldown_s=30.0, restore_hold_s=30.0, concurrency_floor=2,
+              clock=clock)
+    kw.update(over)
+    return DispatchTuner(eng, **kw), eng, sched
+
+
+class TestDispatchTunerHysteresis:
+    def test_starved_tightens_once_per_cooldown(self):
+        clk = FakeClock()
+        tuner, eng, sched = _tuner(clk)
+        eng.add(execs=16, rows=32, padded=96)  # fill 0.25, mean 2
+        cursor = _cursor()
+        out = tuner.tick()
+        assert [d["action"] for d in out] == ["dispatch"]
+        ovr = sched.dispatch_overrides()
+        assert ovr == {"max_queue_delay_us": 2500, "max_batch": 2}
+        assert len(_events("autotune", "dispatch_tighten", cursor)) == 1
+        # Still starved inside the cooldown: no second actuation.
+        clk.advance(5.0)
+        eng.add(execs=16, rows=32, padded=96)
+        assert tuner.tick() == []
+        assert sched.dispatch_overrides() == ovr
+        # Past the cooldown it tightens further, but the journal edge
+        # fired once — the loop entered "tight" on the first step.
+        clk.advance(30.0)
+        eng.add(execs=16, rows=32, padded=96)
+        out = tuner.tick()
+        assert [d["action"] for d in out] == ["dispatch"]
+        assert sched.dispatch_overrides()["max_queue_delay_us"] == 1250
+        assert len(_events("autotune", "dispatch_tighten", cursor)) == 1
+
+    def test_deadline_floor_stops_the_ratchet(self):
+        clk = FakeClock()
+        tuner, eng, sched = _tuner(clk, min_deadline_us=100,
+                                   cooldown_s=1.0)
+        for _ in range(12):
+            eng.add(execs=16, rows=16, padded=112)  # mean rows 1
+            tuner.tick()
+            clk.advance(2.0)
+        assert sched.dispatch_overrides()["max_queue_delay_us"] == 100
+        n = tuner.action_count
+        clk.advance(2.0)
+        eng.add(execs=16, rows=16, padded=112)
+        assert tuner.tick() == []  # at the floor: nothing to tighten
+        assert tuner.action_count == n
+
+    def test_backlog_drops_override_immediately(self):
+        clk = FakeClock()
+        tuner, eng, sched = _tuner(clk)
+        eng.add(execs=16, rows=32, padded=96)
+        tuner.tick()
+        assert sched.dispatch_overrides()
+        # Backlog arrives well inside the tighten cooldown — the
+        # restore must NOT wait it out (full batches soak backlogs).
+        clk.advance(1.0)
+        eng.service_s = 0.1
+        eng.admission._gate("m").ewma_service_s = 0.1
+        sched.set_depth(50)  # wait = 50 * 0.1 = 5s >= 0.5
+        cursor = _cursor()
+        out = tuner.tick()
+        assert [d["action"] for d in out] == ["dispatch_restore"]
+        assert sched.dispatch_overrides() == {}
+        evts = _events("autotune", "dispatch_restore", cursor)
+        assert len(evts) == 1 and evts[0].detail["reason"] == "backlog"
+
+    def test_backlog_hot_device_nudges_concurrency_once(self):
+        clk = FakeClock()
+        tuner, eng, sched = _tuner(clk)
+        eng.duty = 0.95
+        eng.admission._gate("m").ewma_service_s = 0.1
+        sched.set_depth(50)
+        cursor = _cursor()
+        out = tuner.tick()
+        assert [d["action"] for d in out] == ["concurrency"]
+        cap = eng.admission.concurrency_cap("m")
+        assert cap >= 2
+        assert len(_events("autotune", "concurrency_nudge", cursor)) == 1
+        # Within the cooldown: damped, no further nudge.
+        clk.advance(5.0)
+        assert tuner.tick() == []
+        assert eng.admission.concurrency_cap("m") == cap
+        # Past it: nudges lower, but the edge journal stays at one.
+        clk.advance(30.0)
+        out = tuner.tick()
+        assert [d["action"] for d in out] == ["concurrency"]
+        assert eng.admission.concurrency_cap("m") < cap
+        assert len(_events("autotune", "concurrency_nudge", cursor)) == 1
+
+    def test_quiet_restores_one_step_per_window(self):
+        clk = FakeClock()
+        tuner, eng, sched = _tuner(clk, cooldown_s=1.0)
+        eng.add(execs=16, rows=16, padded=112)
+        tuner.tick()
+        clk.advance(2.0)
+        eng.add(execs=16, rows=16, padded=112)
+        tuner.tick()  # two cuts: delay 2500 then 1250, cap 1
+        assert sched.dispatch_overrides() == {"max_queue_delay_us": 1250,
+                                              "max_batch": 1}
+        # Healthy fill now: the first quiet tick only arms the window.
+        eng.add(execs=16, rows=120, padded=8)
+        cursor = _cursor()
+        tuner.tick()
+        assert sched.dispatch_overrides()["max_batch"] == 1
+        # Inside the hold: still nothing.
+        clk.advance(10.0)
+        assert tuner.tick() == []
+        # One window -> exactly one widening step.
+        clk.advance(30.0)
+        out = tuner.tick()
+        assert [d["action"] for d in out] == ["dispatch_step"]
+        assert sched.dispatch_overrides() == {"max_queue_delay_us": 2500,
+                                              "max_batch": 2}
+        # A second step does not follow in the same window.
+        assert tuner.tick() == []
+        # Walk the remaining windows out; the full-restore edge fires
+        # exactly once and the override is gone.
+        for _ in range(4):
+            clk.advance(31.0)
+            tuner.tick()
+        assert sched.dispatch_overrides() == {}
+        evts = _events("autotune", "dispatch_restore", cursor)
+        assert len(evts) == 1 and evts[0].detail["reason"] == "quiet"
+        # Fully restored: quiet ticks are no-ops forever after.
+        clk.advance(31.0)
+        assert tuner.tick() == []
+
+    def test_quiet_clears_concurrency_nudge_before_dispatch(self):
+        clk = FakeClock()
+        tuner, eng, sched = _tuner(clk, cooldown_s=1.0)
+        eng.add(execs=16, rows=32, padded=96)
+        tuner.tick()  # tight
+        clk.advance(2.0)
+        eng.duty = 0.95
+        eng.add(execs=16, rows=120, padded=8)
+        eng.admission._gate("m").ewma_service_s = 0.1
+        sched.set_depth(50)
+        tuner.tick()  # backlog: restore dispatch + nudge concurrency
+        assert eng.admission.concurrency_cap("m") > 0
+        clk.advance(2.0)
+        sched.set_depth(0)
+        eng.duty = 0.1
+        eng.add(execs=16, rows=32, padded=96)
+        tuner.tick()  # starved again -> tight again
+        eng.add(execs=16, rows=120, padded=8)
+        cursor = _cursor()
+        tuner.tick()  # arm quiet window
+        clk.advance(31.0)
+        out = tuner.tick()  # step 1: concurrency cap clears first
+        assert [d["action"] for d in out] == ["concurrency_restore"]
+        assert eng.admission.concurrency_cap("m") == 0
+        assert len(_events("autotune", "concurrency_restore",
+                           cursor)) == 1
+        assert sched.dispatch_overrides()  # dispatch restore comes later
+
+
+# -- SLO-burn admission loop --------------------------------------------------
+
+
+class _StubSlo:
+    enabled = True
+
+    def __init__(self):
+        self.burning = []
+
+    def fast_burn(self):
+        return list(self.burning)
+
+
+def _governor(clk):
+    cfg = SelfDriveConfig.from_dict({
+        "burn_factor": 0.5, "burn_min_ratio": 0.1,
+        "burn_restore_step": 2.0, "burn_restore_hold_s": 10.0,
+        "burn_cooldown_s": 10.0})
+    adm = AdmissionController(AdmissionConfig(), clock=clk)
+    adm._gate("m").ewma_service_s = 0.05  # synthetic-bucket capacity
+    eng = SimpleNamespace(
+        admission=adm, slo=_StubSlo(),
+        profiler=SimpleNamespace(
+            snapshot=lambda **_: {"duty_cycle": 0.0, "models": {}}),
+        scheduler_for=lambda *a, **k: None)
+    return SelfDriveGovernor(eng, cfg, clock=clk), eng
+
+
+class TestBurnLoopHysteresis:
+    def test_burn_cuts_are_cooldown_spaced_and_edge_journaled(self):
+        clk = FakeClock()
+        gov, eng = _governor(clk)
+        eng.slo.burning = ["m"]
+        cursor = _cursor()
+        out = gov.tick()["admission"]
+        assert out == [{"action": "tighten", "model": "m", "ratio": 0.5}]
+        assert len(_events("admission", "tighten", cursor)) == 1
+        # Still burning inside the cooldown: damped.
+        clk.advance(5.0)
+        assert gov.tick()["admission"] == []
+        # Past it: a deeper cut, same single journal edge.
+        clk.advance(10.0)
+        out = gov.tick()["admission"]
+        assert out and out[0]["ratio"] == 0.25
+        assert len(_events("admission", "tighten", cursor)) == 1
+
+    def test_burn_floor_holds(self):
+        clk = FakeClock()
+        gov, eng = _governor(clk)
+        eng.slo.burning = ["m"]
+        for _ in range(8):
+            gov.tick()
+            clk.advance(11.0)
+        assert eng.admission.tightened_models()["m"] == pytest.approx(0.1)
+
+    def test_restore_exactly_once_per_quiet_window(self):
+        clk = FakeClock()
+        gov, eng = _governor(clk)
+        eng.slo.burning = ["m"]
+        gov.tick()
+        clk.advance(11.0)
+        gov.tick()  # ratio 0.25
+        eng.slo.burning = []
+        cursor = _cursor()
+        # Quiet, but inside the hold window: no restore yet.
+        clk.advance(5.0)
+        assert gov.tick()["admission"] == []
+        # One window -> exactly one step up; an immediate re-tick does
+        # not take a second step.
+        clk.advance(6.0)
+        out = gov.tick()["admission"]
+        assert out == [{"action": "restore", "model": "m", "ratio": 0.5}]
+        assert gov.tick()["admission"] == []
+        assert not _events("admission", "restore", cursor)
+        # Next window clears it; the restore edge fires exactly once.
+        clk.advance(11.0)
+        out = gov.tick()["admission"]
+        assert out and out[0]["ratio"] == 1.0
+        assert eng.admission.tightened_models() == {}
+        assert len(_events("admission", "restore", cursor)) == 1
+        # Fully restored: further quiet ticks are no-ops.
+        clk.advance(11.0)
+        assert gov.tick()["admission"] == []
+
+    def test_reburn_during_hold_postpones_restore(self):
+        clk = FakeClock()
+        gov, eng = _governor(clk)
+        eng.slo.burning = ["m"]
+        gov.tick()
+        eng.slo.burning = []
+        clk.advance(8.0)
+        eng.slo.burning = ["m"]  # burn returns before the hold lapses
+        gov.tick()
+        eng.slo.burning = []
+        clk.advance(8.0)  # 8s since the re-burn touch: still held
+        assert gov.tick()["admission"] == []
+        assert "m" in eng.admission.tightened_models()
+
+
+# -- drift re-placement loop --------------------------------------------------
+
+
+class StubReplica:
+    def __init__(self, rid, models, device_s):
+        self.id = rid
+        self.models = list(models)
+        self.device_s = dict(device_s)
+        self.outstanding = 0
+        self.posts = []
+
+    @property
+    def load(self):
+        return SimpleNamespace(models=list(self.models))
+
+    def send(self, method, path, **kw):
+        if method == "GET" and path == "/v2/profile":
+            body = {"models": {
+                f"{m}:1": {"model": m, "version": "1",
+                           "device_s": self.device_s.get(m, 0.0),
+                           "hbm_bytes": 0}
+                for m in self.models}}
+            return 200, {}, json.dumps(body).encode()
+        if method == "POST" and "/repository/models/" in path:
+            self.posts.append(path)
+            model, action = path.rsplit("/", 2)[-2:]
+            if action == "load" and model not in self.models:
+                self.models.append(model)
+            if action == "unload" and model in self.models:
+                self.models.remove(model)
+            return 200, {}, b"{}"
+        return 404, {}, b"{}"
+
+
+class StubRouter:
+    def __init__(self, replicas):
+        self.replicas = replicas
+        self.events = journal()
+        self.quiesced = []
+
+    def eligible(self):
+        return list(self.replicas)
+
+    def replica(self, rid):
+        return next(r for r in self.replicas if r.id == rid)
+
+    def quiesce(self, rid):
+        self.quiesced.append(("quiesce", rid))
+
+    def unquiesce(self, rid):
+        self.quiesced.append(("unquiesce", rid))
+
+
+def _fleet(clk, **over):
+    # r1 hosts both hot models, r2 is empty: LPT wants one moved over.
+    r1 = StubReplica("r1", ["m1", "m2"], {"m1": 10.0, "m2": 6.0})
+    r2 = StubReplica("r2", [], {})
+    router = StubRouter([r1, r2])
+    cfg = SelfDriveConfig.from_dict({
+        "rebalance_cooldown_s": 60.0, "max_moves_per_window": 4,
+        "rebalance_window_s": 300.0, "quiesce_wait_s": 0.1, **over})
+    reb = FleetRebalancer(router, cfg, clock=clk)
+    return reb, router, r1, r2
+
+
+def _drift():
+    return {"flagged": {"r1": {"duty_cycle": 0.99}}}
+
+
+class TestFleetRebalancer:
+    def test_no_flag_no_action(self):
+        clk = FakeClock()
+        reb, *_ = _fleet(clk)
+        assert reb.maybe_rebalance({"flagged": {}}) is None
+        assert reb.maybe_rebalance(None) is None
+        assert reb.rebalance_count == 0
+
+    def test_drift_fires_executes_and_journals_edges(self):
+        clk = FakeClock()
+        reb, router, r1, r2 = _fleet(clk)
+        cursor = _cursor()
+        rec = reb.maybe_rebalance(_drift())
+        assert rec is not None and rec["outcome"] == "ok"
+        # m2 (the lighter model) moved: loaded on r2, unloaded from r1.
+        assert r2.posts == ["/v2/repository/models/m2/load"]
+        assert r1.posts == ["/v2/repository/models/m2/unload"]
+        assert r1.models == ["m1"] and r2.models == ["m2"]
+        # The unload rolled under quiesce.
+        assert ("quiesce", "r1") in router.quiesced
+        assert ("unquiesce", "r1") in router.quiesced
+        assert len(_events("fleet", "rebalance", cursor)) == 1
+        done = _events("fleet", "rebalance_done", cursor)
+        assert len(done) == 1 and done[0].detail["outcome"] == "ok"
+        assert reb.rebalance_count == 1
+
+    def test_cooldown_damps_reflag(self):
+        clk = FakeClock()
+        reb, *_ = _fleet(clk)
+        assert reb.maybe_rebalance(_drift()) is not None
+        cursor = _cursor()
+        clk.advance(10.0)  # well inside rebalance_cooldown_s=60
+        assert reb.maybe_rebalance(_drift()) is None
+        assert not _events("fleet", "rebalance", cursor)
+
+    def test_balanced_fleet_is_stable_after_cooldown(self):
+        clk = FakeClock()
+        reb, router, r1, r2 = _fleet(clk)
+        assert reb.maybe_rebalance(_drift())["outcome"] == "ok"
+        clk.advance(61.0)
+        cursor = _cursor()
+        rec = reb.maybe_rebalance(_drift())
+        # The plan now equals current hosting: the loop clears without
+        # actuating and without journal noise.
+        assert rec["outcome"] == "stable" and rec["moves"] == 0
+        assert not _events("fleet", "rebalance", cursor)
+        assert reb.rebalance_count == 1
+
+    def test_move_budget_bounds_the_window(self):
+        clk = FakeClock()
+        reb, router, r1, r2 = _fleet(clk, max_moves_per_window=2,
+                                     rebalance_cooldown_s=1.0)
+        assert reb.maybe_rebalance(_drift())["outcome"] == "ok"  # 2 moves
+        # Undo the move out-of-band so the next plan wants it again.
+        r1.models, r2.models = ["m1", "m2"], []
+        clk.advance(2.0)  # cooldown lapsed, window budget exhausted
+        assert reb.maybe_rebalance(_drift()) is None
+        # A fresh window re-arms the budget.
+        clk.advance(301.0)
+        assert reb.maybe_rebalance(_drift())["outcome"] == "ok"
+        assert reb.rebalance_count == 2
+
+    def test_snapshot_reports_damping_state(self):
+        clk = FakeClock()
+        reb, *_ = _fleet(clk)
+        reb.maybe_rebalance(_drift())
+        snap = reb.snapshot()
+        assert snap["rebalances"] == 1
+        assert snap["window_moves"] == 2
+        assert snap["cooldown_remaining_s"] > 0
+        assert snap["last"]["outcome"] == "ok"
+
+    def test_truncation_preserves_load_before_unload(self):
+        steps = [
+            {"replica": "a", "action": "load", "model": "m1"},
+            {"replica": "a", "action": "load", "model": "m2"},
+            {"replica": "b", "action": "unload", "model": "m1"},
+            {"replica": "b", "action": "unload", "model": "m2"},
+        ]
+        kept, dropped = _truncate_steps(steps, 3)
+        # m2's load made the cut but only m1's unload fits; m2's extra
+        # copy is deferred, never orphaned.
+        assert kept == steps[:3] and dropped == 1
+        kept, dropped = _truncate_steps(steps, 1)
+        # m2's load fell out, so its unload is cancelled with it.
+        assert kept == [steps[0]] and dropped == 3
+
+
+# -- config grammar -----------------------------------------------------------
+
+
+class TestSelfDriveConfig:
+    def test_unknown_key_fails_fast(self):
+        with pytest.raises(EngineError, match="unknown key"):
+            SelfDriveConfig.from_dict({"fil_low": 0.3})
+
+    def test_non_numeric_fails_fast(self):
+        with pytest.raises(EngineError, match="expects a number"):
+            SelfDriveConfig.from_dict({"fill_low": "lots"})
+
+    def test_env_grammar(self, monkeypatch):
+        monkeypatch.delenv(ENV_VAR, raising=False)
+        assert SelfDriveConfig.from_env() is None
+        monkeypatch.setenv(ENV_VAR, "off")
+        assert SelfDriveConfig.from_env() is None
+        monkeypatch.setenv(ENV_VAR, "1")
+        assert SelfDriveConfig.from_env() == SelfDriveConfig()
+        monkeypatch.setenv(ENV_VAR, '{"fill_low": 0.3, '
+                                    '"max_moves_per_window": 2}')
+        cfg = SelfDriveConfig.from_env()
+        assert cfg.fill_low == 0.3 and cfg.max_moves_per_window == 2
+        monkeypatch.setenv(ENV_VAR, "{nope")
+        with pytest.raises(EngineError, match="invalid JSON"):
+            SelfDriveConfig.from_env()
+
+    def test_bounds(self):
+        with pytest.raises(EngineError, match="interval_s"):
+            SelfDriveConfig.from_dict({"interval_s": 0})
+        with pytest.raises(EngineError, match="burn_min_ratio"):
+            SelfDriveConfig.from_dict({"burn_min_ratio": 1.5})

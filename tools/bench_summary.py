@@ -126,6 +126,23 @@ def check(hist: list, threshold: float = 0.25) -> int:
               + f" {verdict}")
         if bad:
             failures += 1
+    # Self-driving gate: the chaos probe's journal-cursor evidence —
+    # all three control loops fired AND cleared (loops_closed), the
+    # dispatch retune actually recovered batch fill above its floor
+    # (fill_recovered), and no loop flapped (bounded actuation).
+    selfdriving = runs[latest_ts].get("selfdriving")
+    if selfdriving is not None:
+        bits = (("loops_closed", bool(selfdriving.get("loops_closed"))),
+                ("fill_recovered",
+                 bool(selfdriving.get("fill_recovered"))),
+                ("bounded", bool(selfdriving.get("bounded"))))
+        bad = [name for name, ok in bits if not ok]
+        verdict = f"FAIL ({', '.join(bad)} unmet)" if bad else "ok"
+        print("bench-check: selfdriving: "
+              + " ".join(f"{name}={ok}" for name, ok in bits)
+              + f" {verdict}")
+        if bad:
+            failures += 1
     if failures:
         print(f"bench-check: {failures} probe(s) regressed more than "
               f"{threshold:.0%} on p99", file=sys.stderr)
@@ -207,6 +224,8 @@ def main() -> int:
                 _print_shm_fanin_delta(rec)
             if probe == "gauntlet":
                 _print_gauntlet_delta(rec)
+            if probe == "selfdriving":
+                _print_selfdriving_delta(rec)
     return 0
 
 
@@ -332,6 +351,32 @@ def _print_gauntlet_delta(rec: dict) -> None:
           f"(threshold {g.get('slo_threshold_us')}us, "
           f"dlrm {mix.get('dlrm_ok')}, gpt {mix.get('gpt_ok')}, "
           f"preemptions {g.get('preemptions')})")
+
+
+def _print_selfdriving_delta(rec: dict) -> None:
+    """The self-driving probe's story per loop: dispatch retune with
+    the fill recovery it bought, SLO-burn tightening fire/clear, and
+    the drift rebalance with its move count and post-move hosting."""
+    r = rec.get("selfdriving") or rec
+    d, a = r.get("dispatch") or {}, r.get("admission") or {}
+    b = r.get("rebalance") or {}
+    if d:
+        print(f"    selfdriving retune: tighten x{d.get('tighten_fired')}"
+              f" restore x{d.get('restore_fired')}, fill "
+              f"{d.get('fill_before')} -> {d.get('fill_after')}")
+    if a:
+        print(f"    selfdriving burn: tighten x{a.get('tighten_fired')} "
+              f"restore x{a.get('restore_fired')} "
+              f"cleared={a.get('cleared')}, flood {a.get('flood_ok')} ok"
+              f" / {a.get('flood_shed')} shed")
+    if b:
+        print(f"    selfdriving drift: drift x{b.get('drift_events')} ->"
+              f" rebalance x{b.get('fired')} ({b.get('moves')} moves, "
+              f"{b.get('outcome')}), serving_after="
+              f"{b.get('serving_after')}")
+    print(f"    selfdriving verdict: loops_closed={r.get('loops_closed')}"
+          f" fill_recovered={r.get('fill_recovered')} "
+          f"bounded={r.get('bounded')}")
 
 
 def _print_router_delta(rec: dict) -> None:

@@ -86,7 +86,7 @@ FAST=0
 rc=0
 
 if [ "$FAST" -eq 0 ]; then
-    echo "=== stage 1/12: tier-1 test suite ==="
+    echo "=== stage 1/13: tier-1 test suite ==="
     rm -f /tmp/_t1.log
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -96,15 +96,15 @@ if [ "$FAST" -eq 0 ]; then
         | tr -cd . | wc -c)"
     [ "$t1" -ne 0 ] && { echo "tier-1 FAILED (exit $t1)"; rc=1; }
 else
-    echo "=== stage 1/12: tier-1 skipped (--fast) ==="
+    echo "=== stage 1/13: tier-1 skipped (--fast) ==="
 fi
 
-echo "=== stage 2/12: chaos (fault-injection) suite ==="
+echo "=== stage 2/13: chaos (fault-injection) suite ==="
 timeout -k 10 300 python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 [ $? -ne 0 ] && { echo "chaos suite FAILED"; rc=1; }
 
-echo "=== stage 3/12: live scrape (promlint + ops endpoints) ==="
+echo "=== stage 3/13: live scrape (promlint + ops endpoints) ==="
 SCRAPE_DIR=$(mktemp -d)
 python - "$SCRAPE_DIR" <<'EOF'
 import json
@@ -205,7 +205,7 @@ grep -q "^tpu_cost_" "$SCRAPE_DIR/metrics.om.txt" \
     || { echo "tpu_cost_* missing from openmetrics dialect"; rc=1; }
 rm -rf "$SCRAPE_DIR"
 
-echo "=== stage 4/12: autotune e2e (promotion + metrics) ==="
+echo "=== stage 4/13: autotune e2e (promotion + metrics) ==="
 TUNE_DIR=$(mktemp -d)
 CLIENT_TPU_AUTOTUNE='{"interval_s": 0.2, "cooldown_s": 0.5}' \
 timeout -k 10 300 python - "$TUNE_DIR" <<'EOF'
@@ -281,7 +281,7 @@ python tools/promlint.py --openmetrics "$TUNE_DIR/metrics.om.txt" \
     || { echo "promlint (autotune openmetrics) FAILED"; rc=1; }
 rm -rf "$TUNE_DIR"
 
-echo "=== stage 5/12: router e2e (balance + roll-drain + fleet + metrics) ==="
+echo "=== stage 5/13: router e2e (balance + roll-drain + fleet + metrics) ==="
 ROUTER_DIR=$(mktemp -d)
 timeout -k 10 300 python - "$ROUTER_DIR" <<'EOF'
 import json
@@ -455,7 +455,7 @@ grep -q "^tpu_fleet_drift_score{" "$ROUTER_DIR/metrics.om.txt" \
     || { echo "tpu_fleet_drift_score missing from openmetrics dialect"; rc=1; }
 rm -rf "$ROUTER_DIR"
 
-echo "=== stage 6/12: fused decode kernel parity (interpret) + wave metrics ==="
+echo "=== stage 6/13: fused decode kernel parity (interpret) + wave metrics ==="
 # The Pallas decode kernel and the sharded KV arena run in interpret mode
 # on CPU (docs/KERNELS.md): this stage proves (a) fused == reference on
 # the fast parity subset, (b) an engine on the fused path emits
@@ -526,7 +526,7 @@ python tools/promlint.py --openmetrics "$KERNEL_DIR/metrics.om.txt" \
     || { echo "promlint (kernel openmetrics) FAILED"; rc=1; }
 rm -rf "$KERNEL_DIR"
 
-echo "=== stage 7/12: dlrm e2e (lookup-bucket promotion + emb metrics) ==="
+echo "=== stage 7/13: dlrm e2e (lookup-bucket promotion + emb metrics) ==="
 DLRM_DIR=$(mktemp -d)
 CLIENT_TPU_AUTOTUNE='{"interval_s": 0.2, "cooldown_s": 0.5}' \
 timeout -k 10 300 python - "$DLRM_DIR" <<'EOF'
@@ -604,7 +604,7 @@ python tools/promlint.py --openmetrics "$DLRM_DIR/metrics.om.txt" \
     || { echo "promlint (dlrm openmetrics) FAILED"; rc=1; }
 rm -rf "$DLRM_DIR"
 
-echo "=== stage 8/12: shm ring e2e (producer process + doorbell + metrics) ==="
+echo "=== stage 8/13: shm ring e2e (producer process + doorbell + metrics) ==="
 RING_DIR=$(mktemp -d)
 timeout -k 10 300 python - "$RING_DIR" <<'EOF'
 import json
@@ -718,7 +718,7 @@ python tools/promlint.py --openmetrics "$RING_DIR/metrics.om.txt" \
     || { echo "promlint (shm ring openmetrics) FAILED"; rc=1; }
 rm -rf "$RING_DIR"
 
-echo "=== stage 9/12: staged fan-in e2e (8 producer processes + reaper metrics) ==="
+echo "=== stage 9/13: staged fan-in e2e (8 producer processes + reaper metrics) ==="
 FANIN_DIR=$(mktemp -d)
 timeout -k 10 300 python - "$FANIN_DIR" <<'EOF'
 import json
@@ -823,7 +823,7 @@ python tools/promlint.py --openmetrics "$FANIN_DIR/metrics.om.txt" \
     || { echo "promlint (fan-in openmetrics) FAILED"; rc=1; }
 rm -rf "$FANIN_DIR"
 
-echo "=== stage 10/12: qos gauntlet smoke (flash crowd -> throttle + metrics) ==="
+echo "=== stage 10/13: qos gauntlet smoke (flash crowd -> throttle + metrics) ==="
 QOS_DIR=$(mktemp -d)
 CLIENT_TPU_SLO='{"availability": 0.999, "latency_threshold_us": 40000.0,
     "latency_target": 0.9, "fast_burn_threshold": 14.4,
@@ -989,7 +989,119 @@ grep -q "^tpu_qos_" "$QOS_DIR/metrics.om.txt" \
     || { echo "tpu_qos_* missing from openmetrics dialect"; rc=1; }
 rm -rf "$QOS_DIR"
 
-echo "=== stage 11/12: bench p99 regression gate ==="
+echo "=== stage 11/13: closed-loop smoke (self-drive dispatch retune fires + clears) ==="
+SD_DIR=$(mktemp -d)
+CLIENT_TPU_SELFDRIVE='{"interval_s": 0.2, "min_calls": 4, "fill_low": 0.8,
+    "cooldown_s": 0.5, "restore_hold_s": 0.5, "wait_high_s": 5.0}' \
+CLIENT_TPU_PROFILE_WINDOW_S=2 \
+timeout -k 10 180 python - "$SD_DIR" <<'EOF'
+import json
+import sys
+import time
+
+import numpy as np
+
+from client_tpu.engine import TpuEngine
+from client_tpu.engine.config import (
+    DynamicBatchingConfig,
+    ModelConfig,
+    TensorConfig,
+)
+from client_tpu.engine.model import ModelBackend
+from client_tpu.engine.repository import ModelRepository
+from client_tpu.engine.types import InferRequest
+from client_tpu.observability.events import journal
+
+out_dir = sys.argv[1]
+DIM = 16
+
+
+class Identity(ModelBackend):
+    def __init__(self):
+        self.config = ModelConfig(
+            name="sparse_net", platform="jax", max_batch_size=8,
+            input=[TensorConfig("INPUT", "FP32", [DIM])],
+            output=[TensorConfig("OUTPUT", "FP32", [DIM])],
+            dynamic_batching=DynamicBatchingConfig(
+                preferred_batch_size=[8],
+                max_queue_delay_microseconds=5000),
+            instance_count=1)
+
+    def make_apply(self):
+        return lambda inputs: {"OUTPUT": inputs["INPUT"]}
+
+
+repo = ModelRepository()
+repo.register_backend(Identity())
+engine = TpuEngine(repo, warmup=True)
+if engine.selfdrive is None:
+    sys.exit("CLIENT_TPU_SELFDRIVE set but engine built no governor")
+jrnl = journal()
+cursor = jrnl.export(limit=0)["next_seq"]
+try:
+    inp = np.ones((1, DIM), np.float32)
+
+    def loop_events(name):
+        return [e for e in jrnl.snapshot(category="autotune")
+                if e.seq > cursor and e.name == name]
+
+    # Bursts of 3 single-row requests: the gather waits out the 5 ms
+    # deadline hoping for the preferred 8, then pads a 3-row batch into
+    # the 4-bucket (fill 0.75 < fill_low) — the probe-shaped waste the
+    # dispatch loop exists to fix.
+    import threading
+    tightened = False
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and not tightened:
+        done = [threading.Event() for _ in range(3)]
+        for ev in done:
+            engine.async_infer(
+                InferRequest(model_name="sparse_net",
+                             inputs={"INPUT": inp}),
+                lambda resp, ev=ev: ev.set())
+        for ev in done:
+            ev.wait(30)
+        tightened = bool(loop_events("dispatch_tighten"))
+    if not tightened:
+        sys.exit("sparse load never tripped autotune.dispatch_tighten "
+                 f"in 60s ({json.dumps(engine.profile_snapshot().get('selfdrive'))[:400]})")
+    sched = engine.scheduler_for("sparse_net")
+    ovr = sched.dispatch_overrides()
+    if not ovr or ovr.get("max_queue_delay_us", 5000) >= 5000:
+        sys.exit(f"tighten journaled but no dispatch override: {ovr}")
+
+    # Quiet: the profiler window (2s) empties, the loop restores the
+    # override after restore_hold_s and journals the clear edge.
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline \
+            and not loop_events("dispatch_restore"):
+        time.sleep(0.2)
+    if not loop_events("dispatch_restore"):
+        sys.exit("dispatch override never restored on a quiet window")
+    if sched.dispatch_overrides():
+        sys.exit(f"restore journaled but override still set: "
+                 f"{sched.dispatch_overrides()}")
+
+    snap = engine.profile_snapshot()
+    sd = snap.get("selfdrive")
+    if not sd or sd["dispatch"]["action_count"] < 2:
+        sys.exit(f"/v2/profile selfdrive section incomplete: "
+                 f"{json.dumps(sd)[:400]}")
+    with open(f"{out_dir}/profile.json", "w") as f:
+        json.dump(snap, f)
+    print(f"closed-loop smoke ok: tighten {ovr} then restored, "
+          f"{sd['dispatch']['action_count']} actuation(s)")
+finally:
+    engine.shutdown()
+EOF
+[ $? -ne 0 ] && { echo "closed-loop smoke FAILED"; rc=1; }
+python tools/profile_report.py --loops "$SD_DIR/profile.json" \
+    > "$SD_DIR/loops.txt" \
+    && grep -q "dispatch loop:" "$SD_DIR/loops.txt" \
+    || { echo "profile_report --loops FAILED"; rc=1; }
+rm -rf "$SD_DIR"
+
+echo "=== stage 12/13: bench p99 regression gate ==="
 if [ -f BENCH_HISTORY.json ]; then
     python tools/bench_summary.py --check \
         || { echo "bench gate FAILED"; rc=1; }
@@ -997,7 +1109,7 @@ else
     echo "no BENCH_HISTORY.json — skipping"
 fi
 
-echo "=== stage 12/12: static analysis + lockdep gate ==="
+echo "=== stage 13/13: static analysis + lockdep gate ==="
 python -m tools.analyze --baseline tools/analyze/baseline.json \
     || { echo "tpulint FAILED"; rc=1; }
 python tools/promlint.py --definitions client_tpu \

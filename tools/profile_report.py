@@ -26,6 +26,13 @@ get one line per model); ``--memory`` renders the HBM census
 
     python tools/profile_report.py http://127.0.0.1:8000 --timeseries
     python tools/profile_report.py http://127.0.0.1:8000 --memory
+
+``--loops`` renders the self-drive closed-loop state (docs/SELFDRIVING.md):
+the dispatch tuner's per-model phase and recent decisions, the admission
+loop's tightened rate ratios, or — against a router status body — the
+fleet rebalancer's damping state.
+
+    python tools/profile_report.py http://127.0.0.1:8000 --loops
 """
 
 from __future__ import annotations
@@ -244,6 +251,52 @@ def render_memory(report: dict, out=None) -> None:
           f"(threshold {pressure['threshold'] * 100:.0f}%){mark}\n")
 
 
+def render_loops(snap: dict, out=None) -> None:
+    """The self-drive loop view: which closed loops are actuated right
+    now and what they decided recently. Accepts an engine ``/v2/profile``
+    snapshot (``selfdrive`` section: dispatch tuner + admission loop) or
+    a router ``/v2/router/status`` body (``selfdrive`` section: the
+    rebalancer's damping state)."""
+    w = (out or sys.stdout).write
+    sd = snap.get("selfdrive")
+    if not sd:
+        w("self-drive disabled (no 'selfdrive' section — set "
+          "CLIENT_TPU_SELFDRIVE)\n")
+        return
+    if "rebalances" in sd:  # router status shape
+        w(f"fleet rebalancer: {sd['rebalances']} rebalance(s), window "
+          f"moves {sd['window_moves']}/{sd['window_budget']}, cooldown "
+          f"remaining {sd['cooldown_remaining_s']}s\n")
+        last = sd.get("last") or {}
+        if last:
+            w(f"  last: outcome={last.get('outcome')} "
+              f"moves={last.get('moves')} flagged={last.get('flagged')} "
+              f"truncated={last.get('truncated')} "
+              f"rejected={last.get('rejected')}\n")
+        return
+    cfg = sd.get("config", {})
+    w(f"self-drive: interval {cfg.get('interval_s')}s\n")
+    dispatch = sd.get("dispatch", {})
+    models = dispatch.get("models", {})
+    w(f"dispatch loop: {dispatch.get('action_count', 0)} actuation(s)\n")
+    for mkey in sorted(models):
+        st = models[mkey]
+        phase = ("tight" if st.get("tight") else "") or ""
+        phase += ("+nudged" if st.get("nudged") else "")
+        w(f"  {mkey}: {phase.lstrip('+') or 'idle'}\n")
+    for d in dispatch.get("decisions", [])[-10:]:
+        detail = {k: v for k, v in d.items()
+                  if k not in ("action", "model", "version")}
+        w(f"  recent: {d.get('action')} {d.get('model')}"
+          f":{d.get('version')} {detail}\n")
+    adm = sd.get("admission", {})
+    tightened = adm.get("tightened", {})
+    w(f"admission loop: {adm.get('action_count', 0)} actuation(s), "
+      f"tightened {len(tightened)} model(s)\n")
+    for m in sorted(tightened):
+        w(f"  {m}: rate ratio {tightened[m]}\n")
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("source", help="server base URL or saved snapshot path")
@@ -259,6 +312,10 @@ def main(argv=None) -> int:
     p.add_argument("--memory", action="store_true",
                    help="render the HBM census (/v2/memory) as an "
                         "owner/drift table")
+    p.add_argument("--loops", action="store_true",
+                   help="render the self-drive closed-loop state "
+                        "(the 'selfdrive' section of /v2/profile, or "
+                        "of /v2/router/status for the rebalancer)")
     args = p.parse_args(argv)
     endpoint = ""
     if args.timeseries:
@@ -275,6 +332,8 @@ def main(argv=None) -> int:
     if args.json:
         json.dump(snap, sys.stdout, indent=2)
         sys.stdout.write("\n")
+    elif args.loops:
+        render_loops(snap)
     elif args.timeseries:
         render_timeseries(snap)
     elif args.memory:
