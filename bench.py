@@ -290,17 +290,17 @@ def _run_section(section: str, probe, record):
     return res
 
 
-# bf16 peak FLOP/s per chip by TPU generation (public spec sheets).
-_PEAK_FLOPS = {"v5e": 197e12, "v5litepod": 197e12, "v4": 275e12,
-               "v5p": 459e12, "v6e": 918e12}
-
-
 def peak_flops() -> float | None:
+    """bf16 peak FLOP/s per chip: BENCH_PEAK_FLOPS override, else the
+    generation named by PALLAS_AXON_TPU_GEN looked up in the shared
+    peak-spec registry (client_tpu.observability.roofline — one table
+    for bench, the serving profiler, and tools/mfu_diag.py)."""
     env = os.environ.get("BENCH_PEAK_FLOPS")
     if env:
         return float(env)
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    return _PEAK_FLOPS.get(gen)
+    from client_tpu.observability.roofline import peak_flops_for_gen
+
+    return peak_flops_for_gen(os.environ.get("PALLAS_AXON_TPU_GEN", ""))
 
 
 def _backend_init_abort(reason: str) -> None:
@@ -637,9 +637,17 @@ def bench_inproc_simple(concurrency: int = BENCH_CONCURRENCY):
             res["xla_compiles"] = pm["compilations"]
             res["pad_waste_device_s"] = round(
                 pm["padding_waste_device_s"], 4)
+            # Roofline utilization (advisory until a TPU baseline exists:
+            # null on hosts with unknown peaks, recorded either way so
+            # the efficiency line carries hardware context when it can).
+            rl = pm.get("roofline") or {}
+            res["mfu"] = rl.get("mfu")
+            res["mbu"] = rl.get("mbu")
             log(f"simple: fill_ratio {res['fill_ratio']}, duty_cycle "
                 f"{res['duty_cycle']}, {res['xla_compiles']} XLA compiles, "
-                f"padding waste {res['pad_waste_device_s']}s device")
+                f"padding waste {res['pad_waste_device_s']}s device, "
+                f"mfu {res['mfu']}, mbu {res['mbu']} "
+                f"(bound {rl.get('bound', 'unknown')})")
     except Exception as exc:  # noqa: BLE001 — profiler must not sink bench
         log(f"profiler snapshot unavailable: {exc}")
     # Flight-recorder and HBM-census availability: the run is only
@@ -867,6 +875,12 @@ def bench_dlrm(window_s: float = 2.0):
                                      if nnz + padded else 1.0)
                 res["lookup_buckets"] = [b["bucket"] for b in pm["buckets"]
                                          if b["executions"]]
+                # Embedding-bag buckets lower to gathers, so expect the
+                # cost model to price ~0 flops and the story to be MBU:
+                # record both, advisory (null when peaks are unknown).
+                rl = pm.get("roofline") or {}
+                res["mfu"] = rl.get("mfu")
+                res["mbu"] = rl.get("mbu")
             if backend.row_cache is not None:
                 res["cache_hit_rate"] = round(
                     backend.row_cache.hit_rate(), 4)
@@ -2863,6 +2877,9 @@ def _bench_generative_once(n_streams: int, tokens: int):
             out["wave_step_ms_p99"] = top["wave_ms_p99"]
             out["wave_bucket"] = top["bucket"]
         out["duty_cycle"] = psnap["duty_cycle"]
+        rl = (pm or {}).get("roofline") or {}
+        out["mfu"] = rl.get("mfu")
+        out["mbu"] = rl.get("mbu")
     except Exception as exc:  # noqa: BLE001 — profiler must not sink bench
         log(f"generative wave stats unavailable: {exc}")
     engine.shutdown()
@@ -3291,12 +3308,12 @@ def bench_device_steady():
     return out
 
 
-def bert_flops_per_example(seq_len=128, hidden=768, n_layers=12, ffn=3072):
-    """Analytic forward FLOPs for one BERT-base example (2*MAC convention):
-    per layer 4 QKVO projections + 2 attention einsums + 2 FFN matmuls."""
-    s, h, f = seq_len, hidden, ffn
-    per_layer = 8 * s * h * h + 4 * s * s * h + 4 * s * h * f
-    return n_layers * per_layer
+# Shared analytic denominator — the definition lives in the roofline
+# module (one source for bench, the profiler plane, and mfu_diag); the
+# re-export keeps `from bench import bert_flops_per_example` working.
+from client_tpu.observability.roofline import (  # noqa: E402
+    bert_flops_per_example,
+)
 
 
 # bench_bert_mfu probe state, keyed by batch size (see the cache note in

@@ -239,6 +239,13 @@ register(
     "Efficiency-profiler sliding-window length in seconds.",
     "observability")
 register(
+    "CLIENT_TPU_ROOFLINE", "", "json",
+    "Roofline attribution (XLA cost-model capture + MFU/MBU peaks): "
+    "`0`/`off` disables capture; unset/`1`/`on` defaults (detected "
+    "device-kind peaks); else inline JSON or `@/path.json` with "
+    "`peak_flops`, `peak_bytes_per_s`, `device_kinds`, `capture`.",
+    "observability")
+register(
     "CLIENT_TPU_TIMESERIES", "", "json",
     "Flight recorder (1 Hz signal ring, GET /v2/timeseries): `0`/`off` "
     "disables; unset/`1`/`on` defaults; else inline JSON or `@/path.json`.",

@@ -117,6 +117,12 @@ class TpuEngine:
 
         self.profiler = _profiler()
         self.profiler.bind_metrics(self.metrics.registry)
+        # Roofline attribution config: resolved here purely so a
+        # malformed CLIENT_TPU_ROOFLINE fails the boot loudly — the
+        # capture/join paths re-read it and degrade instead of raising.
+        from client_tpu.observability import roofline as _roofline
+
+        _roofline.roofline_config()
         # Cost ledger (process-global, same pattern): schedulers charge
         # tenant-tagged device/queue/HBM time into it from below; binding
         # exports tpu_cost_device_seconds_total / tpu_cost_queue_seconds_
@@ -909,8 +915,12 @@ class TpuEngine:
         psnap = self.profiler.snapshot()
         fill: dict[str, float] = {}
         wave: dict[str, float] = {}
+        mfu: dict[str, float] = {}
         for entry in psnap.get("models", {}).values():
             name = entry["model"]
+            model_mfu = (entry.get("roofline") or {}).get("mfu")
+            if model_mfu is not None:
+                mfu[name] = round(float(model_mfu), 6)
             rows = sum(b["rows"] for b in entry.get("buckets", ()))
             padded = sum(b["padded_rows"] for b in entry.get("buckets", ()))
             if rows + padded:
@@ -933,6 +943,8 @@ class TpuEngine:
             sample["batch_fill"] = fill
         if wave:
             sample["wave_p50_ms"] = wave
+        if mfu:
+            sample["mfu"] = mfu
         # Admission shed rate: per-model counter delta over the tick gap
         # (the counter sums versions and reasons).
         shed_totals: dict[str, float] = {}

@@ -267,6 +267,10 @@ class GenerativeScheduler(Scheduler):
         # from max(dispatch, previous fetch) to this fetch, so pipelined
         # waves are not double-counted (see _drain_fetches).
         self._last_fetch_ns = 0
+        # (bucket, chunk) wave shapes whose static cost model has been
+        # captured — decode waves never pass Model.execute_timed, so the
+        # roofline numerator is pulled here, once per shape.
+        self._wave_cost_captured: set[tuple[int, int]] = set()
         # Per-row arena bytes for the cost ledger's HBM-byte-second
         # charges, cached on first use (one pytree walk, static shapes).
         self._row_bytes = 0.0
@@ -656,6 +660,24 @@ class GenerativeScheduler(Scheduler):
                                         t_disp=time.monotonic_ns(),
                                         bucket=bucket))
         self._inflight_waves += k
+        if (bucket, k) not in self._wave_cost_captured:
+            # Once per wave shape: static roofline numerator for this
+            # decode executable. The jit call above just traced this
+            # exact signature, so .lower() is a cache hit (no compile);
+            # donation is not executed by lowering, and self._arena is
+            # the live post-dispatch arena with identical avals.
+            self._wave_cost_captured.add((bucket, k))
+            from client_tpu.observability import roofline
+            from client_tpu.observability.profiler import profiler
+
+            args = (self.model._params, self._arena, rows, lens,
+                    seeds, temps, top_ks, top_ps, sample)
+            cost = roofline.capture_cost_model(
+                self._decode_chunk if k > 1 else self._decode,
+                args + ((k,) if k > 1 else ()))
+            profiler().record_wave_cost_model(
+                self.model.config.name, self.model.config.version,
+                bucket, k, cost)
 
     def _drain_fetches(self, force_one: bool = False) -> None:
         """Consume completed token fetches in dispatch order; emission,

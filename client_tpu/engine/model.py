@@ -30,6 +30,7 @@ from client_tpu import faults
 from client_tpu.engine.backend_init import log as _log
 from client_tpu.engine.config import ModelConfig
 from client_tpu.engine.types import DeadlineExpired, EngineError, now_ns
+from client_tpu.observability import roofline as _roofline
 from client_tpu.observability.profiler import profiler as _profiler
 from client_tpu.protocol.dtypes import wire_to_np_dtype
 
@@ -400,6 +401,17 @@ class Model:
                           cfg.name, pad_to, phases.compile_ns / 1e9)
                 _profiler().record_compile(
                     cfg.name, cfg.version, pad_to, phases.compile_ns,
+                    axis=cfg.padding_axis)
+                # Static roofline numerator, once per first-call trace:
+                # the lowering is trace-cached by the execution above, so
+                # this is dict work — and it never .compile()s (an AOT
+                # compile would not share the jit dispatch cache).
+                cost = _roofline.capture_cost_model(
+                    self._apply,
+                    (self._params, staged) if self._takes_params
+                    else (staged,))
+                _profiler().record_cost_model(
+                    cfg.name, cfg.version, pad_to, cost,
                     axis=cfg.padding_axis)
             phases.infer_end = now_ns()
             self._set_state("fetching outputs")

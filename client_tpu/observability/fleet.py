@@ -355,6 +355,7 @@ SIGNAL_FLOORS = {
     "fill_ratio": 0.05,
     "wave_ms_p50": 1.0,
     "wait_s": 0.05,
+    "mfu": 0.02,
 }
 
 
@@ -372,6 +373,7 @@ def profile_signals(profile: dict | None,
         rows = padded = 0.0
         waves_total = 0.0
         wave_weighted = 0.0
+        mfu_weighted = mfu_weight = 0.0
         for m in profile.get("models", {}).values():
             for b in m.get("buckets", ()):
                 rows += float(b.get("rows", 0) or 0)
@@ -382,10 +384,19 @@ def profile_signals(profile: dict | None,
                 if n > 0 and p50 is not None:
                     waves_total += n
                     wave_weighted += n * float(p50)
+            # Roofline MFU, device-time weighted across models: a busy
+            # model's utilization should dominate the replica signal.
+            mfu = (m.get("roofline") or {}).get("mfu")
+            if mfu is not None:
+                weight = max(float(m.get("device_s", 0.0) or 0.0), 1e-9)
+                mfu_weighted += float(mfu) * weight
+                mfu_weight += weight
         if padded > 0:
             signals["fill_ratio"] = rows / padded
         if waves_total > 0:
             signals["wave_ms_p50"] = wave_weighted / waves_total
+        if mfu_weight > 0:
+            signals["mfu"] = mfu_weighted / mfu_weight
     if load:
         wait = load.get("wait_s")
         if wait is not None:
@@ -413,13 +424,15 @@ def timeseries_signals(export: dict | None, window_s: float = 60.0,
     duty: list[float] = []
     fill: list[float] = []
     wave: list[float] = []
+    mfu: list[float] = []
     for s in samples:
         if float(s.get("ts_wall", 0) or 0) < now - window_s:
             continue
         sig = s.get("signals") or {}
         if sig.get("duty_cycle") is not None:
             duty.append(float(sig["duty_cycle"]))
-        for source, dest in (("batch_fill", fill), ("wave_p50_ms", wave)):
+        for source, dest in (("batch_fill", fill), ("wave_p50_ms", wave),
+                             ("mfu", mfu)):
             per_model = sig.get(source)
             if isinstance(per_model, dict) and per_model:
                 vals = [float(v) for v in per_model.values()]
@@ -431,6 +444,8 @@ def timeseries_signals(export: dict | None, window_s: float = 60.0,
         signals["fill_ratio"] = fleet_median(fill)
     if wave:
         signals["wave_ms_p50"] = fleet_median(wave)
+    if mfu:
+        signals["mfu"] = fleet_median(mfu)
     return signals
 
 

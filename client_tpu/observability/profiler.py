@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import os
 from client_tpu import config as envcfg
+from client_tpu.observability import roofline as _roofline
 from client_tpu.utils import lockdep
 import time
 import weakref
@@ -105,6 +106,10 @@ class _BucketCost:
     win_calls: int = 0
     prev_win_s: float = 0.0  # span of the rotated-out window, seconds
     prev_win_calls: int = 0
+    # Static XLA cost model captured at compile time (record_cost_model):
+    # {"available": True, "flops", "bytes_accessed", ...} or the
+    # annotated absence. None until the first capture attempt.
+    cost_model: dict | None = None
 
     def fill_ratio(self) -> float:
         total = self.rows + self.padded_rows
@@ -151,11 +156,14 @@ class _WaveCost:
     lands)."""
 
     waves: int = 0
+    dispatches: int = 0      # executable launches (waves / chunk)
     device_ns: int = 0
     wave_ns_ewma: float = 0.0
     # Per-dispatch per-wave samples for snapshot percentiles; bounded so
     # a long-running engine can't grow it.
     recent: deque = field(default_factory=lambda: deque(maxlen=512))
+    # Static cost of one dispatch (the whole K-chunk, not one wave).
+    cost_model: dict | None = None
 
 
 class _Bound:
@@ -163,7 +171,8 @@ class _Bound:
 
     __slots__ = ("registry_ref", "fill_ratio", "padded_rows",
                  "compilations", "compile_seconds", "device_seconds",
-                 "duty_cycle", "wave_seconds")
+                 "duty_cycle", "wave_seconds", "model_flops",
+                 "mfu", "mbu")
 
     def __init__(self, registry):
         self.registry_ref = weakref.ref(registry)
@@ -198,6 +207,23 @@ class _Bound:
             "(bucket = wave lane count, chunk = waves per dispatch)",
             ("model", "version", "bucket", "chunk"),
             buckets=WAVE_SECONDS_BUCKETS)
+        self.model_flops = registry.counter(
+            "tpu_model_flops_total",
+            "XLA cost-model FLOPs dispatched by warm executions "
+            "(static flops per call, padded bucket priced in full)",
+            ("model", "version", "bucket"))
+        self.mfu = registry.gauge(
+            "tpu_mfu",
+            "Model FLOP/s utilization per bucket: cost-model flops x "
+            "warm calls / device seconds, over the device-kind peak "
+            "(absent when peaks or cost model are unknown)",
+            ("model", "version", "bucket"))
+        self.mbu = registry.gauge(
+            "tpu_mbu",
+            "Memory bandwidth utilization per bucket: cost-model bytes "
+            "accessed x warm calls / device seconds, over the "
+            "device-kind peak (absent when unknown)",
+            ("model", "version", "bucket"))
 
 
 class EfficiencyProfiler:
@@ -281,6 +307,9 @@ class EfficiencyProfiler:
                     + (1 - _EWMA_ALPHA) * c.host_ns_ewma)
                 self._busy.append((end, max(0, device_ns)))
                 self._prune_locked(end)
+            flops = 0.0
+            if not cold and c.cost_model and c.cost_model.get("available"):
+                flops = float(c.cost_model.get("flops", 0.0))
         fill = (rows / key[2]) if key[2] else 1.0
         for b in self._bindings():
             b.fill_ratio.observe(fill, model=key[0], version=key[1])
@@ -290,6 +319,9 @@ class EfficiencyProfiler:
             if not cold and device_ns > 0:
                 b.device_seconds.inc(device_ns / 1e9,
                                      model=key[0], version=key[1])
+            if flops > 0:
+                b.model_flops.inc(flops, model=key[0], version=key[1],
+                                  bucket=str(key[2]))
 
     def record_compile(self, model: str, version, bucket: int | None,
                        compile_ns: int, trace_id: str | None = None,
@@ -321,6 +353,42 @@ class EfficiencyProfiler:
                        version=key[1], trace_id=trace_id,
                        bucket=key[2], compile_s=round(compile_ns / 1e9, 3))
 
+    def record_cost_model(self, model: str, version, bucket: int | None,
+                          cost: dict | None, axis: str = "rows") -> None:
+        """Attach the static XLA cost model captured for a bucket's
+        executable (:func:`client_tpu.observability.roofline.
+        capture_cost_model`, called once per first-call trace alongside
+        :meth:`record_compile`). An available capture always replaces a
+        prior one (recompile = new executable); an *unavailable* capture
+        only fills an empty slot — a bucket serving multiple signatures
+        keeps its working cost model even if one exotic signature's
+        analysis fails."""
+        if not cost:
+            return
+        key = (str(model), str(version), int(bucket or 0))
+        with self._lock:
+            c = self._costs.get(key)
+            if c is None:
+                c = self._costs[key] = _BucketCost()
+            c.axis = axis
+            if cost.get("available") or c.cost_model is None:
+                c.cost_model = dict(cost)
+
+    def record_wave_cost_model(self, model: str, version, bucket: int,
+                               chunk: int, cost: dict | None) -> None:
+        """Same contract as :meth:`record_cost_model` for a decode-wave
+        executable — the cost prices one *dispatch* (all ``chunk``
+        scanned waves), matching _WaveCost.dispatches."""
+        if not cost:
+            return
+        key = (str(model), str(version), int(bucket), max(1, int(chunk)))
+        with self._lock:
+            w = self._waves.get(key)
+            if w is None:
+                w = self._waves[key] = _WaveCost()
+            if cost.get("available") or w.cost_model is None:
+                w.cost_model = dict(cost)
+
     def record_wave(self, model: str, version, bucket: int, chunk: int,
                     duration_ns: int, waves: int = 1) -> None:
         """One generative decode dispatch completed: ``waves`` logical
@@ -341,6 +409,7 @@ class EfficiencyProfiler:
             if w is None:
                 w = self._waves[key] = _WaveCost()
             w.waves += waves
+            w.dispatches += 1
             w.device_ns += duration_ns
             w.wave_ns_ewma = (
                 per_wave_ns if w.wave_ns_ewma == 0.0
@@ -349,12 +418,18 @@ class EfficiencyProfiler:
             w.recent.append(per_wave_ns)
             self._busy.append((end, duration_ns))
             self._prune_locked(end)
+            flops = 0.0
+            if w.cost_model and w.cost_model.get("available"):
+                flops = float(w.cost_model.get("flops", 0.0))
         per_wave_s = per_wave_ns / 1e9
         for b in self._bindings():
             for _ in range(waves):
                 b.wave_seconds.observe(per_wave_s, model=key[0],
                                        version=key[1], bucket=str(key[2]),
                                        chunk=str(key[3]))
+            if flops > 0:
+                b.model_flops.inc(flops, model=key[0], version=key[1],
+                                  bucket=str(key[2]))
 
     # -- duty cycle ----------------------------------------------------------
 
@@ -378,11 +453,69 @@ class EfficiencyProfiler:
         return busy / wall
 
     def update_gauges(self) -> None:
-        """Refresh ``tpu_device_duty_cycle`` on every bound registry;
-        called at scrape time so a quiet period still reads current."""
+        """Refresh ``tpu_device_duty_cycle`` and the per-bucket
+        ``tpu_mfu`` / ``tpu_mbu`` gauges on every bound registry; called
+        at scrape time so a quiet period still reads current. MFU/MBU
+        rows exist only where both the cost model and the device peaks
+        are known — an unknown-peaks CPU host scrapes the (empty)
+        families cleanly rather than lying with zeros."""
         duty = self.duty_cycle()
+        rows = self._utilization_rows()
         for b in self._bindings():
             b.duty_cycle.set(round(duty, 6))
+            for model, version, bucket, mfu, mbu in rows:
+                if mfu is not None:
+                    b.mfu.set(round(mfu, 6), model=model, version=version,
+                              bucket=bucket)
+                if mbu is not None:
+                    b.mbu.set(round(mbu, 6), model=model, version=version,
+                              bucket=bucket)
+
+    def _utilization_rows(self) -> list[tuple]:
+        """(model, version, bucket, mfu, mbu) for every bucket with an
+        available cost model and warm device time; wave cells aggregate
+        across chunks into their lane bucket. Empty when peaks are
+        unknown (CPU host without a CLIENT_TPU_ROOFLINE override)."""
+        peaks = _roofline.resolve_peaks()
+        if peaks is None or not (peaks.flops_per_s or peaks.bytes_per_s):
+            return []
+        agg: dict[tuple[str, str, str], list[float]] = {}
+        with self._lock:
+            for (mname, version, bucket), c in self._costs.items():
+                warm = c.calls - c.cold_calls
+                if warm <= 0 or c.device_ns <= 0:
+                    continue
+                if not (c.cost_model and c.cost_model.get("available")):
+                    continue
+                row = agg.setdefault((mname, version, str(bucket)),
+                                     [0.0, 0.0, 0.0])
+                row[0] += float(c.cost_model.get("flops", 0.0)) * warm
+                row[1] += float(
+                    c.cost_model.get("bytes_accessed", 0.0)) * warm
+                row[2] += c.device_ns / 1e9
+            for (mname, version, bucket, _chunk), w in self._waves.items():
+                if w.dispatches <= 0 or w.device_ns <= 0:
+                    continue
+                if not (w.cost_model and w.cost_model.get("available")):
+                    continue
+                row = agg.setdefault((mname, version, str(bucket)),
+                                     [0.0, 0.0, 0.0])
+                row[0] += float(
+                    w.cost_model.get("flops", 0.0)) * w.dispatches
+                row[1] += float(
+                    w.cost_model.get("bytes_accessed", 0.0)) * w.dispatches
+                row[2] += w.device_ns / 1e9
+        out = []
+        for (mname, version, bucket), (flops, byts, dev_s) in agg.items():
+            if dev_s <= 0:
+                continue
+            mfu = (flops / dev_s / peaks.flops_per_s) \
+                if peaks.flops_per_s else None
+            mbu = (byts / dev_s / peaks.bytes_per_s) \
+                if peaks.bytes_per_s else None
+            if mfu is not None or mbu is not None:
+                out.append((mname, version, bucket, mfu, mbu))
+        return out
 
     # -- report ---------------------------------------------------------------
 
@@ -390,13 +523,23 @@ class EfficiencyProfiler:
         """The ``GET /v2/profile`` body: per-model/per-bucket cost table
         with padding-waste estimates and a bucket-ladder suggestion."""
         now = self._now()
+        ctx = _roofline.roofline_context()
+        peaks_dict = ctx.get("peaks")
+        peaks = None
+        if isinstance(peaks_dict, dict):
+            peaks = _roofline.PeakSpec(peaks_dict.get("flops_per_s"),
+                                       peaks_dict.get("bytes_per_s"),
+                                       peaks_dict.get("source", "registry"))
         with self._lock:
             items = sorted(self._costs.items())
             wave_items = sorted(
                 (k, (w.waves, w.device_ns, w.wave_ns_ewma,
-                     sorted(w.recent)))
+                     sorted(w.recent), w.dispatches, w.cost_model))
                 for k, w in self._waves.items())
         models: dict[str, dict] = {}
+        # Per-model roofline accumulators: [flops, bytes, wasted_flops,
+        # covered_device_s] summed over buckets+waves with cost models.
+        roofline_agg: dict[str, list[float]] = {}
 
         def model_entry(mname: str, version: str) -> dict:
             mkey = f"{mname}:{version}"
@@ -410,7 +553,18 @@ class EfficiencyProfiler:
                     "buckets": [], "suggestion": None,
                     "suggestions": [],
                 }
+                roofline_agg[mkey] = [0.0, 0.0, 0.0, 0.0]
             return entry
+
+        def accumulate_roofline(mkey: str, rl: dict,
+                                device_s: float) -> None:
+            if rl.get("cost_model") != "xla":
+                return
+            agg = roofline_agg[mkey]
+            agg[0] += rl["total_flops"]
+            agg[1] += rl["total_bytes"]
+            agg[2] += rl["padding_wasted_flops"]
+            agg[3] += device_s
 
         for (mname, version, bucket), c in items:
             if model and mname != model:
@@ -422,9 +576,18 @@ class EfficiencyProfiler:
             entry["padding_waste_device_s"] += waste
             entry["compilations"] += c.compile_count
             entry["compile_s"] += c.compile_ns / 1e9
+            warm = c.calls - c.cold_calls
+            total_rows = c.rows + c.padded_rows
+            rl = _roofline.bucket_roofline(
+                c.cost_model, warm, c.device_ns / 1e9,
+                (c.padded_rows / total_rows) if total_rows else 0.0,
+                peaks)
+            accumulate_roofline(f"{mname}:{version}", rl,
+                                c.device_ns / 1e9)
             entry["buckets"].append({
                 "bucket": bucket,
                 "axis": c.axis,
+                "roofline": rl,
                 "executions": c.calls,
                 "cold_executions": c.cold_calls,
                 "rows": c.rows,
@@ -446,12 +609,15 @@ class EfficiencyProfiler:
         # step times.  Wave device time also counts into the model's
         # device_s total — generative engines never pass execute_timed,
         # so without this their models profile as idle.
-        for (mname, version, bucket, chunk), (wv, dns, ewma, recent) \
-                in wave_items:
+        for (mname, version, bucket, chunk), \
+                (wv, dns, ewma, recent, dispatches, wcost) in wave_items:
             if model and mname != model:
                 continue
             entry = model_entry(mname, version)
             entry["device_s"] += dns / 1e9
+            rl = _roofline.bucket_roofline(wcost, dispatches, dns / 1e9,
+                                           0.0, peaks)
+            accumulate_roofline(f"{mname}:{version}", rl, dns / 1e9)
 
             def pct(q: float) -> float:
                 if not recent:
@@ -462,12 +628,14 @@ class EfficiencyProfiler:
                 "bucket": bucket,
                 "chunk": chunk,
                 "waves": wv,
+                "dispatches": dispatches,
                 "device_s": round(dns / 1e9, 6),
                 "wave_ms_ewma": round(ewma / 1e6, 3),
                 "wave_ms_p50": round(pct(0.5) / 1e6, 3),
                 "wave_ms_p99": round(pct(0.99) / 1e6, 3),
+                "roofline": rl,
             })
-        for entry in models.values():
+        for mkey, entry in models.items():
             entry["device_s"] = round(entry["device_s"], 6)
             entry["host_s"] = round(entry["host_s"], 6)
             entry["compile_s"] = round(entry["compile_s"], 6)
@@ -476,9 +644,12 @@ class EfficiencyProfiler:
             entry["suggestion"] = _suggest_bucket_tweak(entry["buckets"])
             entry["suggestions"] = _suggest_ladder_tweaks(
                 entry["buckets"], self.window_s)
+            entry["roofline"] = _model_roofline(
+                roofline_agg[mkey], entry["device_s"], peaks)
         return {
             "window_s": self.window_s,
             "duty_cycle": round(self.duty_cycle(), 6),
+            "roofline": ctx,
             "models": models,
         }
 
@@ -489,6 +660,43 @@ class EfficiencyProfiler:
             self._waves.clear()
             self._busy.clear()
             self._t0 = self._now()
+
+
+def _model_roofline(agg: list[float], device_s: float, peaks) -> dict:
+    """Model-level roofline rollup from the per-bucket accumulators
+    (flops, bytes, padding-wasted flops, covered device seconds).
+    ``cost_model_coverage`` is the fraction of the model's device time
+    whose executables carry a cost model — the honesty knob: a 0.4
+    coverage MFU describes 40% of the time, not the model."""
+    flops, byts, wasted, covered_s = agg
+    out = {
+        "total_flops": flops,
+        "total_bytes": byts,
+        "padding_wasted_flops": wasted,
+        "cost_model_coverage": round(covered_s / device_s, 4)
+        if device_s > 0 else 0.0,
+        "achieved_flops_per_s": None,
+        "achieved_bytes_per_s": None,
+        "arithmetic_intensity": None,
+        "mfu": None,
+        "mbu": None,
+        "bound": "unknown",
+    }
+    if covered_s <= 0:
+        return out
+    achieved_f = flops / covered_s
+    achieved_b = byts / covered_s
+    intensity = (flops / byts) if byts > 0 else None
+    out["achieved_flops_per_s"] = achieved_f
+    out["achieved_bytes_per_s"] = achieved_b
+    out["arithmetic_intensity"] = round(intensity, 4) \
+        if intensity is not None else None
+    out["bound"] = _roofline.classify_bound(intensity, peaks)
+    if peaks and peaks.flops_per_s:
+        out["mfu"] = round(achieved_f / peaks.flops_per_s, 6)
+    if peaks and peaks.bytes_per_s:
+        out["mbu"] = round(achieved_b / peaks.bytes_per_s, 6)
+    return out
 
 
 def _suggest_bucket_tweak(buckets: list[dict]) -> dict | None:

@@ -64,7 +64,7 @@ ENV_VAR = "CLIENT_TPU_TIMESERIES"
 SCALAR_SIGNALS = ("duty_cycle", "hbm_used", "hbm_reserved",
                   "qos_throttled")
 MODEL_SIGNALS = ("queue_depth", "in_flight", "batch_fill", "shed_rate",
-                 "wave_p50_ms", "slo_burn", "tenant_cost_rate")
+                 "wave_p50_ms", "slo_burn", "tenant_cost_rate", "mfu")
 SIGNALS = SCALAR_SIGNALS + MODEL_SIGNALS
 
 

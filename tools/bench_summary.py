@@ -204,7 +204,7 @@ def main() -> int:
             eff_keys = ("fill_ratio", "duty_cycle", "xla_compiles",
                         "pad_waste_device_s", "wave_step_ms_p50",
                         "cache_hit_rate", "timeseries_samples",
-                        "census_attr_fraction")
+                        "census_attr_fraction", "mfu", "mbu")
             view = {k: v for k, v in rec.items()
                     if k not in ("probe", "ts", "run_ts", "platform",
                                  "config", "windows") + eff_keys}

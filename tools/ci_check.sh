@@ -106,6 +106,10 @@ timeout -k 10 300 python -m pytest tests/ -q -m chaos \
 
 echo "=== stage 3/13: live scrape (promlint + ops endpoints) ==="
 SCRAPE_DIR=$(mktemp -d)
+# Pinned peaks: MFU/MBU need a peak spec, and the CI host is a CPU whose
+# device kind resolves to "peaks unknown" — the override also exercises
+# the CLIENT_TPU_ROOFLINE grammar on every CI run.
+CLIENT_TPU_ROOFLINE='{"peak_flops": 1e12, "peak_bytes_per_s": 1e11}' \
 python - "$SCRAPE_DIR" <<'EOF'
 import json
 import sys
@@ -161,6 +165,16 @@ try:
     prof = json.load(urlopen(f"{base}/v2/profile", timeout=10))
     if "models" not in prof or "duty_cycle" not in prof:
         sys.exit(f"/v2/profile smoke failed: {str(prof)[:200]}")
+    # Roofline attribution: the snapshot header resolves the peaks and
+    # every model entry joins its cost model with measured device time.
+    roof = prof.get("roofline")
+    if not roof or not isinstance(roof.get("peaks"), dict):
+        sys.exit(f"/v2/profile roofline header missing: {str(roof)[:200]}")
+    for mkey, m in prof["models"].items():
+        mr = m.get("roofline")
+        if not mr or mr.get("mfu") is None or mr.get("bound") == "unknown":
+            sys.exit(f"/v2/profile roofline join failed for {mkey}: "
+                     f"{str(mr)[:200]}")
     if "tpu_batch_fill_ratio" not in classic:
         sys.exit("tpu_batch_fill_ratio missing from /metrics scrape")
     engine.recorder.tick()  # deterministic sample even on a fast scrape
@@ -203,6 +217,14 @@ grep -q "^tpu_cost_" "$SCRAPE_DIR/metrics.txt" \
     || { echo "tpu_cost_* missing from classic dialect"; rc=1; }
 grep -q "^tpu_cost_" "$SCRAPE_DIR/metrics.om.txt" \
     || { echo "tpu_cost_* missing from openmetrics dialect"; rc=1; }
+grep -q "^tpu_mfu{" "$SCRAPE_DIR/metrics.txt" \
+    || { echo "tpu_mfu missing from classic dialect"; rc=1; }
+grep -q "^tpu_mfu{" "$SCRAPE_DIR/metrics.om.txt" \
+    || { echo "tpu_mfu missing from openmetrics dialect"; rc=1; }
+grep -q "^tpu_mbu{" "$SCRAPE_DIR/metrics.txt" \
+    || { echo "tpu_mbu missing from classic dialect"; rc=1; }
+grep -q "^tpu_mbu{" "$SCRAPE_DIR/metrics.om.txt" \
+    || { echo "tpu_mbu missing from openmetrics dialect"; rc=1; }
 rm -rf "$SCRAPE_DIR"
 
 echo "=== stage 4/13: autotune e2e (promotion + metrics) ==="

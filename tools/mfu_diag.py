@@ -43,7 +43,12 @@ import jax.numpy as jnp
 from jax import lax
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from bench import bert_flops_per_example  # noqa: E402 — shared denominator
+# Shared denominator from the roofline module (NOT bench: importing the
+# side-effect-heavy harness just for an analytic formula coupled this
+# diagnostic to bench's env preflight).
+from client_tpu.observability.roofline import (  # noqa: E402
+    bert_flops_per_example,
+)
 
 OUT = {}
 

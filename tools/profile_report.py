@@ -27,6 +27,13 @@ get one line per model); ``--memory`` renders the HBM census
     python tools/profile_report.py http://127.0.0.1:8000 --timeseries
     python tools/profile_report.py http://127.0.0.1:8000 --memory
 
+``--roofline`` renders the roofline attribution: device kind and peak
+specs, then per model/bucket the static FLOPs per call, arithmetic
+intensity, achieved FLOP/s and bytes/s, MFU/MBU, padding-wasted FLOPs,
+and the compute/bandwidth bound classification.
+
+    python tools/profile_report.py http://127.0.0.1:8000 --roofline
+
 ``--loops`` renders the self-drive closed-loop state (docs/SELFDRIVING.md):
 the dispatch tuner's per-model phase and recent decisions, the admission
 loop's tightened rate ratios, or — against a router status body — the
@@ -108,6 +115,85 @@ def render(snap: dict, out=None) -> None:
               f"{sug['below']} (fill {sug['fill_ratio']:.3f}, est. saving "
               f"{sug['est_saving_device_s']:.4f} device-s) — "
               f"{sug['reason']}\n")
+
+
+def _fmt_rate(v, scale: float = 1e9, suffix: str = "G") -> str:
+    if v is None:
+        return "-"
+    return f"{v / scale:.2f}{suffix}"
+
+
+def _roofline_row(kind: str, bucket, rl: dict, execs, device_s) -> tuple:
+    if rl.get("cost_model") != "xla":
+        return (kind, bucket, execs, f"{device_s:.4f}", "-", "-", "-",
+                "-", "-", "-", "-",
+                f"unavailable: {rl.get('reason', '?')}")
+    mfu = rl.get("mfu")
+    mbu = rl.get("mbu")
+    return (kind, bucket, execs, f"{device_s:.4f}",
+            _fmt_rate(rl.get("flops_per_call"), 1e9, "GF"),
+            f"{rl['arithmetic_intensity']:.2f}"
+            if rl.get("arithmetic_intensity") is not None else "-",
+            _fmt_rate(rl.get("achieved_flops_per_s"), 1e9, "GF/s"),
+            _fmt_rate(rl.get("achieved_bytes_per_s"), 1e9, "GB/s"),
+            f"{mfu * 100:.2f}%" if mfu is not None else "-",
+            f"{mbu * 100:.2f}%" if mbu is not None else "-",
+            rl.get("bound", "unknown"),
+            _fmt_rate(rl.get("padding_wasted_flops"), 1e9, "GF"))
+
+
+_ROOF_COLS = ("kind", "bucket", "execs", "device_s", "flops/call", "AI",
+              "achieved", "bytes/s", "mfu", "mbu", "bound", "pad_waste")
+
+
+def render_roofline(snap: dict, out=None) -> None:
+    """The achieved-vs-peak view: device kind and resolved peaks, then
+    per model one row per bucket (and per decode-wave shape) with the
+    static cost, achieved rates, MFU/MBU, and the bound classification.
+    Cost-model-less buckets render their annotated absence, not zeros."""
+    w = (out or sys.stdout).write
+    ctx = snap.get("roofline", {})
+    peaks = ctx.get("peaks")
+    if isinstance(peaks, dict):
+        peaks_s = (f"peak {_fmt_rate(peaks.get('flops_per_s'), 1e12, 'TF/s')}"
+                   f" / {_fmt_rate(peaks.get('bytes_per_s'), 1e9, 'GB/s')}"
+                   f" ({peaks.get('source')})")
+    else:
+        peaks_s = "peaks unknown (measured-only; set CLIENT_TPU_ROOFLINE)"
+    w(f"device_kind={ctx.get('device_kind', 'unknown')}  {peaks_s}\n")
+    if ctx.get("config_error"):
+        w(f"  CONFIG ERROR: {ctx['config_error']}\n")
+    models = snap.get("models", {})
+    if not models:
+        w("no recorded executions yet\n")
+        return
+    for mkey in sorted(models):
+        m = models[mkey]
+        mr = m.get("roofline", {})
+        mfu = mr.get("mfu")
+        mbu = mr.get("mbu")
+        w(f"\nmodel {m['model']} (version {m['version']}): "
+          f"{_fmt_rate(mr.get('total_flops'), 1e9, 'GF')} over "
+          f"{m['device_s']:.4f}s covered "
+          f"{mr.get('cost_model_coverage', 0) * 100:.0f}%"
+          + (f", mfu {mfu * 100:.2f}%" if mfu is not None else "")
+          + (f", mbu {mbu * 100:.2f}%" if mbu is not None else "")
+          + f", bound {mr.get('bound', 'unknown')}\n")
+        rows = [_ROOF_COLS]
+        for b in m.get("buckets", ()):
+            rows.append(_roofline_row(
+                b.get("axis", "rows"), b["bucket"],
+                b.get("roofline", {}),
+                b["executions"] - b["cold_executions"], b["device_s"]))
+        for wv in m.get("decode_waves", ()):
+            rows.append(_roofline_row(
+                f"wave*{wv['chunk']}", wv["bucket"], wv.get("roofline", {}),
+                wv.get("dispatches", 0), wv["device_s"]))
+        widths = [max(len(str(r[i])) for r in rows)
+                  for i in range(len(_ROOF_COLS))]
+        for r in rows:
+            w("  " + "  ".join(str(v).rjust(widths[i])
+                               for i, v in enumerate(r)).rstrip() + "\n")
 
 
 def render_fleet(fleet_snap: dict, out=None) -> None:
@@ -312,6 +398,10 @@ def main(argv=None) -> int:
     p.add_argument("--memory", action="store_true",
                    help="render the HBM census (/v2/memory) as an "
                         "owner/drift table")
+    p.add_argument("--roofline", action="store_true",
+                   help="render the roofline attribution of /v2/profile: "
+                        "achieved vs peak FLOP/s and bytes/s per bucket "
+                        "with the compute/bandwidth bound classification")
     p.add_argument("--loops", action="store_true",
                    help="render the self-drive closed-loop state "
                         "(the 'selfdrive' section of /v2/profile, or "
@@ -340,6 +430,8 @@ def main(argv=None) -> int:
         render_memory(snap)
     elif args.fleet:
         render_fleet(snap)
+    elif args.roofline:
+        render_roofline(snap)
     else:
         render(snap)
     return 0
