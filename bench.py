@@ -2173,8 +2173,16 @@ def bench_selfdriving(replicas: int = 2, phase_s: float = 6.0):
     internal state), and every loop's actuation count is bounded —
     a flapping loop fails the probe even if it eventually converges.
     Gated by ``bench_summary --check``: loops_closed AND
-    fill_recovered AND bounded.
+    fill_recovered AND bounded AND blackbox one-bundle-per-incident.
+
+    The incident blackbox rides the same probe: `admission.tighten`
+    and `fleet.rebalance` are trigger edges, so each induced incident
+    must yield exactly one bundle per engine plus one router bundle —
+    a storm of bundles from a single incident is the debounce/cooldown
+    failing, zero bundles is the trigger path failing.
     """
+    import tempfile
+
     import numpy as np
 
     import client_tpu.http as httpclient
@@ -2271,10 +2279,22 @@ def bench_selfdriving(replicas: int = 2, phase_s: float = 6.0):
         srv = HttpInferenceServer(engine, host="127.0.0.1", port=0).start()
         return engine, srv
 
+    # Blackbox armed on exactly the two incident edges this probe
+    # induces; the long cooldown means each trigger may capture only
+    # once per engine for the whole run — the one-bundle-per-incident
+    # invariant falls straight out of the config under test.
+    blackbox_dir = tempfile.mkdtemp(prefix="bench_blackbox_")
+    blackbox_spec = json.dumps({
+        "dir": blackbox_dir,
+        "triggers": ["admission.tighten", "fleet.rebalance"],
+        "debounce_s": 1.0, "cooldown_s": 600.0,
+        "window_s": 30.0, "post_window_s": 0.2})
     saved = {k: os.environ.get(k)
-             for k in ("CLIENT_TPU_SELFDRIVE", "CLIENT_TPU_SLO")}
+             for k in ("CLIENT_TPU_SELFDRIVE", "CLIENT_TPU_SLO",
+                       "CLIENT_TPU_BLACKBOX")}
     os.environ["CLIENT_TPU_SELFDRIVE"] = selfdrive_spec
     os.environ["CLIENT_TPU_SLO"] = slo_spec
+    os.environ["CLIENT_TPU_BLACKBOX"] = blackbox_spec
     fleet = []
     router_srv = None
     client = None
@@ -2303,6 +2323,9 @@ def bench_selfdriving(replicas: int = 2, phase_s: float = 6.0):
             raise RuntimeError("selfdriving: engine governor not armed")
         if router_srv.rebalancer is None:
             raise RuntimeError("selfdriving: fleet rebalancer not armed")
+        if any(eng.blackbox is None for eng, _ in fleet) \
+                or router_srv.blackbox is None:
+            raise RuntimeError("selfdriving: incident blackbox not armed")
 
         client = httpclient.InferenceServerClient(
             router_srv.url, concurrency=56)
@@ -2603,6 +2626,57 @@ def bench_selfdriving(replicas: int = 2, phase_s: float = 6.0):
             f"x{len(reb_all)} ({last.get('moves')} moves, "
             f"{last.get('outcome')}), hosting {hosting}")
 
+        # -- blackbox audit: exactly one bundle per induced incident ----------
+        # Two incidents were induced (admission.tighten, fleet.rebalance);
+        # each must yield one bundle per engine + one router bundle, and
+        # the router fan-out must have deduped against the local captures
+        # (shared journal) instead of double-writing.
+        expect = 2 * (replicas + 1)
+        bb_edges = wait_edges("blackbox", "captured", probe_seq, 20.0,
+                              n=expect)
+        # The in-process engines share one bundle directory (the ring IS
+        # the directory), so count bundles by trigger across the ring:
+        # exactly one per engine per incident, plus one router bundle
+        # per incident in the router/ subring.
+        ring = fleet[0][0].blackbox.store
+        trig_counts: dict = {}
+        for meta in ring.list():
+            trig = ring.load(meta["id"]).get("trigger")
+            trig_counts[trig] = trig_counts.get(trig, 0) + 1
+        router_triggers = sorted(
+            router_srv.blackbox.store.load(m["id"]).get("trigger")
+            for m in router_srv.blackbox.store.list())
+        capture_ms = [eng.blackbox.last_capture_ms for eng, _ in fleet
+                      if eng.blackbox.last_capture_ms is not None]
+        if router_srv.blackbox.last_capture_ms is not None:
+            capture_ms.append(router_srv.blackbox.last_capture_ms)
+        want = ["admission.tighten", "fleet.rebalance"]
+        one_per_incident = (
+            all(trig_counts.get(t) == replicas for t in want)
+            and sum(trig_counts.values()) == 2 * replicas
+            and router_triggers == want
+            and len(bb_edges) == expect)
+        if not one_per_incident:
+            raise RuntimeError(
+                "selfdriving: blackbox bundle audit failed — want "
+                f"{replicas} engine bundle(s) per incident {want} plus "
+                f"one router bundle each, got engines={trig_counts} "
+                f"router={router_triggers} "
+                f"captured_edges={len(bb_edges)}/{expect}")
+        out["blackbox_bundles"] = (
+            sum(trig_counts.values()) + len(router_triggers))
+        out["blackbox_capture_ms"] = round(max(capture_ms), 3) \
+            if capture_ms else None
+        out["blackbox"] = {
+            "engine_bundles": trig_counts,
+            "router": router_triggers,
+            "captured_edges": len(bb_edges),
+            "one_per_incident": one_per_incident,
+        }
+        log(f"selfdriving blackbox: {out['blackbox_bundles']} bundles "
+            f"({len(bb_edges)} captured edges, max capture "
+            f"{out['blackbox_capture_ms']}ms)")
+
         # -- verdict ----------------------------------------------------------
         out["loops_closed"] = bool(
             tightens and restores
@@ -2632,6 +2706,8 @@ def bench_selfdriving(replicas: int = 2, phase_s: float = 6.0):
         for eng, srv in fleet:
             srv.stop()
             eng.shutdown()
+        import shutil
+        shutil.rmtree(blackbox_dir, ignore_errors=True)
 
 
 def bench_sequence_oldest(n_seq: int = 128, window_s: float = 3.0,
@@ -3691,6 +3767,9 @@ def _main():
                          "loops_closed": r.get("loops_closed"),
                          "fill_recovered": r.get("fill_recovered"),
                          "bounded": r.get("bounded"),
+                         "blackbox_bundles": r.get("blackbox_bundles"),
+                         "blackbox_capture_ms":
+                             r.get("blackbox_capture_ms"),
                          "selfdriving": r})
 
     def _rec_seq(s):

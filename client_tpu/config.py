@@ -235,6 +235,14 @@ register(
     "events; unset/`1`/`on` defaults; else inline JSON or `@/path.json`.",
     "observability")
 register(
+    "CLIENT_TPU_BLACKBOX", "", "json",
+    "Incident blackbox (journal-triggered postmortem bundles on disk, "
+    "GET /v2/debug/bundles): `0`/`off` disables; unset/`1`/`on` defaults "
+    "(all triggers, ~48 MiB bundle ring under $TMPDIR); else inline JSON "
+    "or `@/path.json` with `dir`, `triggers`, `window_s`, `debounce_s`, "
+    "`cooldown_s`, `max_bundles`, `max_bundle_bytes`, `max_total_bytes`.",
+    "observability")
+register(
     "CLIENT_TPU_PROFILE_WINDOW_S", "60", "float",
     "Efficiency-profiler sliding-window length in seconds.",
     "observability")

@@ -200,12 +200,26 @@ class TpuEngine:
         _sd_cfg = SelfDriveConfig.from_env()
         if _sd_cfg is not None:
             self.selfdrive = SelfDriveGovernor(self, _sd_cfg)
+        # Incident blackbox (CLIENT_TPU_BLACKBOX): journal-triggered
+        # postmortem bundles on disk. Default ON with conservative
+        # caps; ``0``/``off`` disables and leaves self.blackbox None.
+        from client_tpu.observability.blackbox import (
+            BlackboxConfig,
+            BlackboxRecorder,
+        )
+
+        self.blackbox: BlackboxRecorder | None = None
+        _bb_cfg = BlackboxConfig.from_env()
+        if _bb_cfg.enabled:
+            self.blackbox = BlackboxRecorder(
+                self, _bb_cfg, registry=self.metrics.registry).install()
         self.events.emit(
             "lifecycle", "server_start",
             models=len(self.repository.names()),
             slo_enabled=self.slo.enabled,
             autotune=self.autotuner is not None,
-            selfdrive=self.selfdrive is not None)
+            selfdrive=self.selfdrive is not None,
+            blackbox=self.blackbox is not None)
         if load_all:
             for name in self.repository.names():
                 try:
@@ -817,12 +831,16 @@ class TpuEngine:
     # -- events / SLO ---------------------------------------------------------
 
     def events_export(self, *, model=None, severity=None, since_seq=None,
-                      since_ts=None, category=None, limit=None) -> dict:
+                      since_ts=None, until_ts=None, category=None,
+                      limit=None) -> dict:
         """``GET /v2/events`` body: the journal filtered by model /
-        minimum severity / exclusive since cursors / category."""
+        minimum severity / exclusive since cursors / category, with
+        ``until_ts`` as the inclusive wall upper bound (the "window
+        around this edge" read the blackbox and external scrapers use)."""
         return self.events.export(
             model=model, severity=severity, since_seq=since_seq,
-            since_ts=since_ts, category=category, limit=limit)
+            since_ts=since_ts, until_ts=until_ts, category=category,
+            limit=limit)
 
     def slo_snapshot(self) -> dict:
         """``GET /v2/slo`` body: per-model window counts and burn rates."""
@@ -1011,11 +1029,15 @@ class TpuEngine:
         return sample
 
     def timeseries_export(self, *, signal=None, model=None,
-                          since_seq=None, limit=None) -> dict:
+                          since_seq=None, since_wall=None,
+                          until_wall=None, limit=None) -> dict:
         """``GET /v2/timeseries`` body: the flight-recorder ring,
-        optionally narrowed by signal / model / exclusive seq cursor."""
+        optionally narrowed by signal / model / exclusive seq cursor /
+        wall-clock window (exclusive lower, inclusive upper)."""
         return self.recorder.export(signal=signal, model=model,
-                                    since_seq=since_seq, limit=limit)
+                                    since_seq=since_seq,
+                                    since_wall=since_wall,
+                                    until_wall=until_wall, limit=limit)
 
     def memory_census(self) -> dict:
         """``GET /v2/memory`` body: per-owner live device-buffer bytes,
@@ -1041,6 +1063,42 @@ class TpuEngine:
                     pass
         return self.hbm_census.report(extra_plans=extra_plans,
                                       events=self.events)
+
+    # -- incident blackbox ----------------------------------------------------
+
+    def blackbox_bundles(self, bundle_id: str | None = None) -> dict:
+        """``GET /v2/debug/bundles[/{id}]`` body: the bundle-ring index,
+        or one full bundle. 400 when disabled / malformed id / corrupt
+        bundle file, 404 when the id is unknown — never 500."""
+        if self.blackbox is None:
+            raise EngineError(
+                "blackbox disabled (CLIENT_TPU_BLACKBOX=off)", 400)
+        try:
+            return self.blackbox.bundles(bundle_id)
+        except KeyError:
+            raise EngineError(
+                f"unknown bundle {bundle_id!r}", 404) from None
+        except ValueError as exc:
+            raise EngineError(str(exc), 400) from None
+
+    def blackbox_capture(self, trigger: str = "manual", *,
+                         incident: str | None = None,
+                         note: str | None = None) -> dict:
+        """``POST /v2/debug/capture`` body: snapshot a bundle now.
+        ``manual``/``crash``/``fleet`` triggers always capture; an
+        automatic trigger name (the router fan-out path) respects the
+        debounce/cooldown and returns ``{"deduped": true}`` with the
+        prior bundle id instead of writing a second bundle for the
+        same incident."""
+        if self.blackbox is None:
+            raise EngineError(
+                "blackbox disabled (CLIENT_TPU_BLACKBOX=off)", 400)
+        try:
+            return self.blackbox.capture(
+                trigger, incident=incident, note=note,
+                respect_cooldown=True)
+        except ValueError as exc:
+            raise EngineError(str(exc), 400) from None
 
     # Staleness bound on the cached load report: piggybacked on every
     # inference response, so it must be cheaper than a response — 50ms is
@@ -1158,6 +1216,10 @@ class TpuEngine:
             self.events.emit("lifecycle", "server_shutdown",
                              draining=self._draining)
         self._live = False
+        if getattr(self, "blackbox", None) is not None:
+            # First: unsubscribe from the journal before the state the
+            # capture thread snapshots starts being torn down.
+            self.blackbox.close()
         if getattr(self, "qos", None) is not None:
             self.qos.stop_governor()
         if getattr(self, "recorder", None) is not None:

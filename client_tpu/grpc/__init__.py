@@ -552,12 +552,15 @@ class InferenceServerClient:
 
     def _events_via(self, stub, model_name="", severity="", category="",
                     since_seq=None, limit=None, headers=None,
-                    client_timeout=None):
+                    client_timeout=None, since_wall=None,
+                    until_wall=None):
         from client_tpu.protocol import ops_pb2 as ops
 
         request = ops.EventsRequest(
             model=model_name, severity=severity, category=category,
             since_seq=int(since_seq) if since_seq else 0,
+            since_wall=float(since_wall) if since_wall else 0.0,
+            until_wall=float(until_wall) if until_wall else 0.0,
             limit=int(limit) if limit else 0)
         response = self._unary(stub.Events, request,
                                self._md(headers), client_timeout)
@@ -579,14 +582,17 @@ class InferenceServerClient:
                 "dropped": response.dropped}
 
     def get_events(self, model_name="", severity="", category="",
-                   since_seq=None, limit=None, headers=None,
-                   client_timeout=None):
+                   since_seq=None, since_wall=None, until_wall=None,
+                   limit=None, headers=None, client_timeout=None):
         """Structured event journal (gRPC mirror of ``GET /v2/events``).
         Returns the same dict shape as the HTTP endpoint: ``events`` (each
-        with its ``detail`` decoded from JSON), ``next_seq``, ``dropped``."""
+        with its ``detail`` decoded from JSON), ``next_seq``, ``dropped``.
+        ``since_wall``/``until_wall`` bound the events by epoch-seconds
+        wall stamp (exclusive lower, inclusive upper)."""
         return self._events_via(self._client_stub, model_name, severity,
                                 category, since_seq, limit, headers,
-                                client_timeout)
+                                client_timeout, since_wall=since_wall,
+                                until_wall=until_wall)
 
     def get_slo_status(self, model_name="", headers=None,
                        client_timeout=None):
@@ -612,17 +618,22 @@ class InferenceServerClient:
         return json.loads(response.profile_json)
 
     def get_timeseries(self, signal="", model_name="", since_seq=None,
-                       limit=None, headers=None, client_timeout=None):
+                       since_wall=None, until_wall=None, limit=None,
+                       headers=None, client_timeout=None):
         """Flight-recorder signal ring (gRPC mirror of
         ``GET /v2/timeseries``): the 1 Hz duty-cycle / queue-depth /
         HBM sample history; ``since_seq`` is the exclusive cursor from
-        the previous response's ``next_seq``."""
+        the previous response's ``next_seq``; ``since_wall``/
+        ``until_wall`` an epoch-seconds window (exclusive lower,
+        inclusive upper)."""
         from client_tpu.protocol import ops_pb2 as ops
 
         response = self._unary(
             self._client_stub.Timeseries,
             ops.TimeseriesRequest(signal=signal, model=model_name,
                                   since_seq=since_seq or 0,
+                                  since_wall=float(since_wall or 0.0),
+                                  until_wall=float(until_wall or 0.0),
                                   limit=limit or 0),
             self._md(headers), client_timeout)
         return json.loads(response.timeseries_json)
@@ -661,6 +672,34 @@ class InferenceServerClient:
             ops.QosRequest(model=model_name),
             self._md(headers), client_timeout)
         return json.loads(response.qos_json)
+
+    def get_bundles(self, bundle_id="", headers=None,
+                    client_timeout=None):
+        """Incident-blackbox bundles (gRPC mirror of
+        ``GET /v2/debug/bundles[/{id}]``): the retained-bundle index,
+        or — with ``bundle_id`` — one full bundle document."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        response = self._unary(
+            self._client_stub.BlackboxBundles,
+            ops.BlackboxBundlesRequest(bundle_id=bundle_id),
+            self._md(headers), client_timeout)
+        return json.loads(response.bundles_json)
+
+    def capture_bundle(self, trigger="manual", incident="", note="",
+                       headers=None, client_timeout=None):
+        """Trigger an incident capture now (gRPC mirror of
+        ``POST /v2/debug/capture``) and return the written bundle's
+        meta; a non-``manual`` trigger respects the server's
+        debounce/cooldown and may return ``{"deduped": true}``."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        response = self._unary(
+            self._client_stub.BlackboxCapture,
+            ops.BlackboxCaptureRequest(trigger=trigger or "manual",
+                                       incident=incident, note=note),
+            self._md(headers), client_timeout)
+        return json.loads(response.bundle_json)
 
     # -- fleet observability (client-side federation) -------------------------
     # gRPC has no fronting router, so the multi-URL client federates the

@@ -864,13 +864,14 @@ class InferenceServerClient:
     # -- operational control plane -------------------------------------------
 
     def get_events(self, model_name="", severity="", category="",
-                   since_seq=None, limit=None, headers=None,
-                   query_params=None):
+                   since_seq=None, since_wall=None, until_wall=None,
+                   limit=None, headers=None, query_params=None):
         """Server operational event timeline (``GET /v2/events``):
         breaker/admission/drain/model/fault/deadline transitions with
         trace correlation. ``severity`` is a minimum (e.g. ``WARNING``);
         ``since_seq`` the exclusive cursor from the previous response's
-        ``next_seq``."""
+        ``next_seq``; ``since_wall``/``until_wall`` an epoch-seconds
+        window (exclusive lower, inclusive upper)."""
         qp = dict(query_params or {})
         if model_name:
             qp["model"] = model_name
@@ -880,6 +881,10 @@ class InferenceServerClient:
             qp["category"] = category
         if since_seq is not None:
             qp["since"] = int(since_seq)
+        if since_wall is not None:
+            qp["since_wall"] = float(since_wall)
+        if until_wall is not None:
+            qp["until_wall"] = float(until_wall)
         if limit is not None:
             qp["limit"] = int(limit)
         return self._get_json("/v2/events", qp or None, headers)
@@ -898,11 +903,14 @@ class InferenceServerClient:
         return self._get_json("/v2/profile", qp or None, headers)
 
     def get_timeseries(self, signal="", model_name="", since_seq=None,
-                       limit=None, headers=None, query_params=None):
+                       since_wall=None, until_wall=None, limit=None,
+                       headers=None, query_params=None):
         """Flight-recorder signal ring (``GET /v2/timeseries``): ~15 min
         of 1 Hz duty-cycle / queue-depth / batch-fill / shed-rate /
         wave-p50 / HBM / SLO-burn samples. ``since_seq`` is the
-        exclusive cursor from the previous response's ``next_seq``."""
+        exclusive cursor from the previous response's ``next_seq``;
+        ``since_wall``/``until_wall`` an epoch-seconds window
+        (exclusive lower, inclusive upper)."""
         qp = dict(query_params or {})
         if signal:
             qp["signal"] = signal
@@ -910,6 +918,10 @@ class InferenceServerClient:
             qp["model"] = model_name
         if since_seq is not None:
             qp["since"] = int(since_seq)
+        if since_wall is not None:
+            qp["since_wall"] = float(since_wall)
+        if until_wall is not None:
+            qp["until_wall"] = float(until_wall)
         if limit is not None:
             qp["limit"] = int(limit)
         return self._get_json("/v2/timeseries", qp or None, headers)
@@ -940,6 +952,30 @@ class InferenceServerClient:
         if model_name:
             qp["model"] = model_name
         return self._get_json("/v2/qos", qp or None, headers)
+
+    def get_bundles(self, bundle_id="", headers=None, query_params=None):
+        """Incident-blackbox bundles (``GET /v2/debug/bundles[/{id}]``):
+        the retained-bundle index, or — with ``bundle_id`` — one full
+        bundle document (render with ``tools/blackbox_report.py``)."""
+        path = "/v2/debug/bundles"
+        if bundle_id:
+            path += f"/{bundle_id}"
+        return self._get_json(path, query_params, headers)
+
+    def capture_bundle(self, trigger="manual", incident="", note="",
+                       headers=None, query_params=None):
+        """Trigger an incident capture now (``POST /v2/debug/capture``)
+        and return the written bundle's meta. Pass ``incident`` to
+        stamp a shared incident id (fleet-coordinated captures);
+        a non-``manual`` trigger name respects the server's
+        debounce/cooldown and may return ``{"deduped": true}``."""
+        body = {"trigger": trigger or "manual"}
+        if incident:
+            body["incident"] = incident
+        if note:
+            body["note"] = note
+        return self._post_json("/v2/debug/capture", body, query_params,
+                               headers)
 
     # -- fleet observability (router endpoints) ------------------------------
 

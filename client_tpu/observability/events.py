@@ -161,11 +161,15 @@ class EventJournal:
 
     def snapshot(self, *, model: str | None = None,
                  severity: str | None = None, since_seq: int | None = None,
-                 since_ts: float | None = None, category: str | None = None,
+                 since_ts: float | None = None,
+                 until_ts: float | None = None,
+                 category: str | None = None,
                  limit: int | None = None) -> list[Event]:
         """Filtered copy, oldest first. ``severity`` is a minimum (WARNING
         returns WARNING + ERROR); ``since_seq``/``since_ts`` are exclusive
-        cursors for incremental polls."""
+        cursors for incremental polls; ``until_ts`` is an inclusive wall
+        upper bound so callers can ask for "the window around this edge"
+        (the blackbox bundle writer, postmortem scrapes)."""
         min_rank = None
         if severity is not None:
             sev = str(severity).upper()
@@ -186,6 +190,8 @@ class EventJournal:
             if since_seq is not None and e.seq <= since_seq:
                 continue
             if since_ts is not None and e.ts_wall <= since_ts:
+                continue
+            if until_ts is not None and e.ts_wall > until_ts:
                 continue
             out.append(e)
         if limit is not None and limit >= 0:

@@ -256,12 +256,17 @@ class FlightRecorder:
     def export(self, *, signal: str | None = None,
                model: str | None = None,
                since_seq: int | None = None,
+               since_wall: float | None = None,
+               until_wall: float | None = None,
                limit: int | None = None) -> dict:
         """The ``GET /v2/timeseries`` body. ``signal`` narrows to one
         signal family, ``model`` narrows per-model maps to one model,
         ``since_seq`` is the exclusive cursor from the previous
-        response's ``next_seq``, ``limit`` keeps the newest n samples.
-        Unknown signal names raise ValueError (HTTP 400)."""
+        response's ``next_seq``, ``since_wall``/``until_wall`` bound the
+        samples by wall stamp (exclusive lower, inclusive upper — "the
+        60 s around this edge" without cursor arithmetic), ``limit``
+        keeps the newest n samples. Unknown signal names raise
+        ValueError (HTTP 400)."""
         if signal is not None and signal not in SIGNALS:
             raise ValueError(
                 f"unknown signal {signal!r}; valid: {list(SIGNALS)}")
@@ -271,6 +276,10 @@ class FlightRecorder:
             dropped = self._dropped
         if since_seq is not None:
             samples = [s for s in samples if s["seq"] > since_seq]
+        if since_wall is not None:
+            samples = [s for s in samples if s["ts_wall"] > since_wall]
+        if until_wall is not None:
+            samples = [s for s in samples if s["ts_wall"] <= until_wall]
         if limit is not None and limit >= 0:
             samples = samples[-limit:]
         out_samples = []

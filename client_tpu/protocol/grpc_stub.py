@@ -79,6 +79,12 @@ _METHODS = [
     ("Costs", ops.CostsRequest, ops.CostsResponse, False),
     # Tenant QoS status (gRPC mirror of /v2/qos).
     ("Qos", ops.QosRequest, ops.QosResponse, False),
+    # Incident blackbox (gRPC mirrors of /v2/debug/capture and
+    # /v2/debug/bundles).
+    ("BlackboxCapture", ops.BlackboxCaptureRequest,
+     ops.BlackboxCaptureResponse, False),
+    ("BlackboxBundles", ops.BlackboxBundlesRequest,
+     ops.BlackboxBundlesResponse, False),
 ]
 
 

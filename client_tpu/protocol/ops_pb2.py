@@ -11,7 +11,8 @@ the message classes. Wire-compatible with the equivalent .proto:
     syntax = "proto3"; package inference;
     message EventsRequest  { string model = 1; string severity = 2;
                              uint64 since_seq = 3; string category = 4;
-                             uint32 limit = 5; }
+                             uint32 limit = 5; double since_wall = 6;
+                             double until_wall = 7; }
     message Event          { uint64 seq = 1; double ts_wall = 2;
                              uint64 ts_mono_ns = 3; string category = 4;
                              string name = 5; string severity = 6;
@@ -40,7 +41,9 @@ the message classes. Wire-compatible with the equivalent .proto:
     message DatasetUnregisterRequest  { string name = 1; }
     message DatasetUnregisterResponse {}
     message TimeseriesRequest  { string signal = 1; string model = 2;
-                                 uint64 since_seq = 3; uint32 limit = 4; }
+                                 uint64 since_seq = 3; uint32 limit = 4;
+                                 double since_wall = 5;
+                                 double until_wall = 6; }
     message TimeseriesResponse { string timeseries_json = 1; }
     message MemoryRequest      {}
     message MemoryResponse     { string memory_json = 1; }
@@ -48,6 +51,12 @@ the message classes. Wire-compatible with the equivalent .proto:
     message CostsResponse      { string costs_json = 1; }
     message QosRequest         { string model = 1; }
     message QosResponse        { string qos_json = 1; }
+    message BlackboxCaptureRequest  { string trigger = 1;
+                                      string incident = 2;
+                                      string note = 3; }
+    message BlackboxCaptureResponse { string bundle_json = 1; }
+    message BlackboxBundlesRequest  { string bundle_id = 1; }
+    message BlackboxBundlesResponse { string bundles_json = 1; }
 
 Event.detail_json / SloStatusResponse.slo_json /
 ProfileResponse.profile_json carry the open-ended detail/report dicts as
@@ -93,6 +102,8 @@ def _file_proto() -> _descriptor_pb2.FileDescriptorProto:
     field(m, "since_seq", 3, _F.TYPE_UINT64)
     field(m, "category", 4, _F.TYPE_STRING)
     field(m, "limit", 5, _F.TYPE_UINT32)
+    field(m, "since_wall", 6, _F.TYPE_DOUBLE)
+    field(m, "until_wall", 7, _F.TYPE_DOUBLE)
 
     m = message("Event")
     field(m, "seq", 1, _F.TYPE_UINT64)
@@ -178,6 +189,8 @@ def _file_proto() -> _descriptor_pb2.FileDescriptorProto:
     field(m, "model", 2, _F.TYPE_STRING)
     field(m, "since_seq", 3, _F.TYPE_UINT64)
     field(m, "limit", 4, _F.TYPE_UINT32)
+    field(m, "since_wall", 5, _F.TYPE_DOUBLE)
+    field(m, "until_wall", 6, _F.TYPE_DOUBLE)
 
     m = message("TimeseriesResponse")
     field(m, "timeseries_json", 1, _F.TYPE_STRING)
@@ -202,6 +215,22 @@ def _file_proto() -> _descriptor_pb2.FileDescriptorProto:
 
     m = message("QosResponse")
     field(m, "qos_json", 1, _F.TYPE_STRING)
+
+    # Incident blackbox (the /v2/debug/bundles and /v2/debug/capture
+    # bodies ride as JSON, same pattern as slo/profile/memory/costs).
+    m = message("BlackboxCaptureRequest")
+    field(m, "trigger", 1, _F.TYPE_STRING)
+    field(m, "incident", 2, _F.TYPE_STRING)
+    field(m, "note", 3, _F.TYPE_STRING)
+
+    m = message("BlackboxCaptureResponse")
+    field(m, "bundle_json", 1, _F.TYPE_STRING)
+
+    m = message("BlackboxBundlesRequest")
+    field(m, "bundle_id", 1, _F.TYPE_STRING)
+
+    m = message("BlackboxBundlesResponse")
+    field(m, "bundles_json", 1, _F.TYPE_STRING)
 
     return fdp
 
@@ -246,4 +275,8 @@ __all__ = [
     "CostsResponse",
     "QosRequest",
     "QosResponse",
+    "BlackboxCaptureRequest",
+    "BlackboxCaptureResponse",
+    "BlackboxBundlesRequest",
+    "BlackboxBundlesResponse",
 ]

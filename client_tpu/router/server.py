@@ -22,6 +22,10 @@ Router-owned endpoints (never proxied):
 * ``GET /v2/fleet/{events,profile,metrics,slo,timeseries}`` — federated replica
   surfaces (see :mod:`client_tpu.router.fleet`); per-replica fetch
   failures are reported inline, never failing the aggregate.
+* ``POST /v2/debug/capture`` / ``GET /v2/debug/bundles[/{id}]`` —
+  fleet-coordinated incident capture (:mod:`client_tpu.router.blackbox`):
+  one incident id fans out to per-replica captures plus a router bundle
+  holding the federated views and the stitched fleet trace.
 
 Everything else under ``/v2`` is forwarded through the selection policy.
 The sequence id for affinity comes from the ``X-Sequence-Id`` request
@@ -68,6 +72,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
     federator: FleetFederator = None
     monitor: FleetMonitor | None = None
     rebalancer = None  # FleetRebalancer when CLIENT_TPU_SELFDRIVE is set
+    blackbox = None    # FleetBlackbox unless CLIENT_TPU_BLACKBOX=off
     verbose = False
 
     def log_message(self, fmt, *args):  # noqa: A003
@@ -93,6 +98,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
                           None)
             if own is not None:
                 own(body)
+                return
+            # Bundle-by-id carries the id as a path segment, which the
+            # exact-name handler lookup above cannot express — route it
+            # before the catch-all proxy would forward it to an
+            # arbitrary replica.
+            if method == "GET" and path.startswith("/v2/debug/bundles/"):
+                self._h_debug_bundle_by_id(
+                    path[len("/v2/debug/bundles/"):])
                 return
             if path.startswith("/v2"):
                 self._proxy(method, body)
@@ -237,6 +250,49 @@ class _RouterHandler(BaseHTTPRequestHandler):
         query = "&".join(f"{k}={v}" for k, v in q.items())
         self._send_json(self.federator.timeseries(query, limit=limit))
 
+    # -- fleet-coordinated incident blackbox ---------------------------------
+
+    def h_get_v2_debug_bundles(self, body):
+        if self.blackbox is None:
+            self._send_json(
+                {"error": "blackbox disabled (CLIENT_TPU_BLACKBOX=off)"},
+                400)
+            return
+        self._send_json(self.blackbox.bundles())
+
+    def _h_debug_bundle_by_id(self, bundle_id):
+        if self.blackbox is None:
+            self._send_json(
+                {"error": "blackbox disabled (CLIENT_TPU_BLACKBOX=off)"},
+                400)
+            return
+        try:
+            self._send_json(self.blackbox.bundles(bundle_id))
+        except KeyError:
+            self._send_json(
+                {"error": f"unknown bundle {bundle_id!r} (replica "
+                          "bundles are served by their replicas)"}, 404)
+        except ValueError as exc:
+            self._send_json({"error": str(exc)}, 400)
+
+    def h_post_v2_debug_capture(self, body):
+        if self.blackbox is None:
+            self._send_json(
+                {"error": "blackbox disabled (CLIENT_TPU_BLACKBOX=off)"},
+                400)
+            return
+        try:
+            opts = json.loads(body or b"{}")
+            if not isinstance(opts, dict):
+                raise ValueError("request body must be a JSON object")
+        except ValueError as exc:
+            self._send_json({"error": str(exc)}, 400)
+            return
+        self._send_json(self.blackbox.capture(
+            str(opts.get("trigger") or "manual"),
+            incident=opts.get("incident") or None,
+            note=opts.get("note") or None))
+
     def h_get_v2_fleet_metrics(self, body):
         text = self.federator.metrics_text()
         self._send(200, text.encode("utf-8"),
@@ -320,10 +376,23 @@ class RouterHttpServer:
                 self.rebalancer = FleetRebalancer(
                     router, sd_cfg, federator=self.federator)
                 self.monitor.on_drift = self.rebalancer.on_drift
+        # Fleet-coordinated incident blackbox (CLIENT_TPU_BLACKBOX,
+        # default ON): fleet.rebalance edges — and manual POSTs — fan
+        # one incident id out to every replica plus a router bundle.
+        from client_tpu.observability.blackbox import BlackboxConfig
+        from client_tpu.router.blackbox import FleetBlackbox
+
+        self.blackbox = None
+        _bb_cfg = BlackboxConfig.from_env()
+        if _bb_cfg.enabled:
+            self.blackbox = FleetBlackbox(
+                router, self.federator, monitor=self.monitor,
+                config=_bb_cfg).install()
         handler = type("BoundRouterHandler", (_RouterHandler,),
                        {"router": router, "federator": self.federator,
                         "monitor": self.monitor,
-                        "rebalancer": self.rebalancer, "verbose": verbose})
+                        "rebalancer": self.rebalancer,
+                        "blackbox": self.blackbox, "verbose": verbose})
         server_cls = type("_RouterHttpd", (ThreadingHTTPServer,),
                           {"request_queue_size": 128})
         self.httpd = server_cls((host, port), handler)
@@ -349,6 +418,8 @@ class RouterHttpServer:
         return self
 
     def stop(self) -> None:
+        if self.blackbox is not None:
+            self.blackbox.close()
         if self.monitor is not None:
             self.monitor.stop()
         self.httpd.shutdown()

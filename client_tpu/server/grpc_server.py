@@ -346,6 +346,8 @@ class _Servicer(GRPCInferenceServiceServicer):
                 severity=request.severity or None,
                 category=request.category or None,
                 since_seq=request.since_seq or None,
+                since_ts=request.since_wall or None,
+                until_ts=request.until_wall or None,
                 limit=request.limit or None)
         except ValueError as exc:  # unknown severity name
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
@@ -391,6 +393,8 @@ class _Servicer(GRPCInferenceServiceServicer):
                 signal=request.signal or None,
                 model=request.model or None,
                 since_seq=request.since_seq or None,
+                since_wall=request.since_wall or None,
+                until_wall=request.until_wall or None,
                 limit=request.limit or None)
         except ValueError as exc:  # unknown signal name
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
@@ -419,6 +423,31 @@ class _Servicer(GRPCInferenceServiceServicer):
 
         snap = self.engine.qos_snapshot(model=request.model or None)
         return ops.QosResponse(qos_json=json.dumps(snap))
+
+    def BlackboxCapture(self, request, context):  # noqa: N802
+        """gRPC mirror of ``POST /v2/debug/capture``: snapshot an
+        incident bundle now; the written bundle's meta rides as JSON."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        try:
+            meta = self.engine.blackbox_capture(
+                request.trigger or "manual",
+                incident=request.incident or None,
+                note=request.note or None)
+        except EngineError as exc:
+            _abort(context, exc)
+        return ops.BlackboxCaptureResponse(bundle_json=json.dumps(meta))
+
+    def BlackboxBundles(self, request, context):  # noqa: N802
+        """gRPC mirror of ``GET /v2/debug/bundles[/{id}]``: the bundle
+        index, or one full bundle when ``bundle_id`` is set."""
+        from client_tpu.protocol import ops_pb2 as ops
+
+        try:
+            out = self.engine.blackbox_bundles(request.bundle_id or None)
+        except EngineError as exc:
+            _abort(context, exc)
+        return ops.BlackboxBundlesResponse(bundles_json=json.dumps(out))
 
     # -- shm slot ring (zero-copy data plane; engine.shmring) ---------------
 

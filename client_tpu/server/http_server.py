@@ -89,6 +89,9 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/v2/timeseries$"), "timeseries"),
     ("GET", re.compile(r"^/v2/memory$"), "memory"),
     ("GET", re.compile(r"^/v2/load$"), "load"),
+    ("GET", re.compile(r"^/v2/debug/bundles$"), "debug_bundles"),
+    ("GET", re.compile(r"^/v2/debug/bundles/([^/]+)$"), "debug_bundle"),
+    ("POST", re.compile(r"^/v2/debug/capture$"), "debug_capture"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
 ]
 
@@ -349,7 +352,9 @@ class _Handler(BaseHTTPRequestHandler):
         """Operational event timeline (``/v2/events``). Filters:
         ``?model=`` exact, ``?severity=`` minimum (DEBUG..ERROR),
         ``?category=``, ``?since=<seq>`` exclusive cursor (use the
-        previous response's ``next_seq``), ``?limit=<n>`` newest n."""
+        previous response's ``next_seq``), ``?since_wall=``/
+        ``?until_wall=`` an epoch-seconds window (exclusive lower,
+        inclusive upper), ``?limit=<n>`` newest n."""
         from urllib.parse import parse_qs, urlparse
 
         q = parse_qs(urlparse(self.path).query)
@@ -366,11 +371,19 @@ class _Handler(BaseHTTPRequestHandler):
             except ValueError:
                 raise EngineError(f"malformed {key!r} parameter", 400)
 
+        # ``since_wall``/``until_wall`` are the wall-window pair shared
+        # with /v2/timeseries; ``since_ts`` predates them and stays as
+        # an alias for the lower bound.
+        since_wall = num("since_wall", float)
+        if since_wall is None:
+            since_wall = num("since_ts", float)
         try:
             self._send_json(self.engine.events_export(
                 model=one("model"), severity=one("severity"),
                 category=one("category"), since_seq=num("since", int),
-                since_ts=num("since_ts", float), limit=num("limit", int)))
+                since_ts=since_wall,
+                until_ts=num("until_wall", float),
+                limit=num("limit", int)))
         except ValueError as exc:  # unknown severity name
             raise EngineError(str(exc), 400)
 
@@ -414,7 +427,9 @@ class _Handler(BaseHTTPRequestHandler):
         """Flight-recorder export (``/v2/timeseries``): the 1 Hz signal
         ring. Filters: ``?signal=`` one signal family, ``?model=``
         narrows per-model maps, ``?since=<seq>`` exclusive cursor (use
-        the previous response's ``next_seq``), ``?limit=<n>`` newest n."""
+        the previous response's ``next_seq``), ``?since_wall=``/
+        ``?until_wall=`` an epoch-seconds window (exclusive lower,
+        inclusive upper), ``?limit=<n>`` newest n."""
         from urllib.parse import parse_qs, urlparse
 
         q = parse_qs(urlparse(self.path).query)
@@ -434,7 +449,10 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             self._send_json(self.engine.timeseries_export(
                 signal=one("signal"), model=one("model"),
-                since_seq=num("since", int), limit=num("limit", int)))
+                since_seq=num("since", int),
+                since_wall=num("since_wall", float),
+                until_wall=num("until_wall", float),
+                limit=num("limit", int)))
         except ValueError as exc:  # unknown signal name
             raise EngineError(str(exc), 400)
 
@@ -451,6 +469,33 @@ class _Handler(BaseHTTPRequestHandler):
         report = self.engine.load_report()
         self._send(200, json.dumps(report.to_json_dict()).encode("utf-8"),
                    extra_headers={LOAD_HEADER: encode_header(report)})
+
+    def h_debug_bundles(self):
+        """Incident-blackbox bundle index (``/v2/debug/bundles``):
+        retained bundles newest first, retention caps, capture
+        counters."""
+        self._send_json(self.engine.blackbox_bundles())
+
+    def h_debug_bundle(self, bundle_id):
+        """One full incident bundle (``/v2/debug/bundles/{id}``):
+        the JSON document ``tools/blackbox_report.py`` renders.
+        404 unknown id; 400 malformed id or corrupt bundle — never
+        500."""
+        self._send_json(self.engine.blackbox_bundles(bundle_id))
+
+    def h_debug_capture(self):
+        """Manual incident capture (``POST /v2/debug/capture``). Body
+        keys (all optional): ``trigger`` (default ``manual``; an
+        automatic trigger name respects debounce/cooldown and may
+        return ``{"deduped": true}``), ``incident`` (share one id
+        across a fleet), ``note`` (free text stored in the bundle)."""
+        body = json.loads(self._read_body() or b"{}")
+        if not isinstance(body, dict):
+            raise EngineError("request body must be a JSON object", 400)
+        self._send_json(self.engine.blackbox_capture(
+            str(body.get("trigger") or "manual"),
+            incident=body.get("incident") or None,
+            note=body.get("note") or None))
 
     def h_trace_setting(self):
         self._send_json(self.engine.trace_setting())

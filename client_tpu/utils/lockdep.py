@@ -115,6 +115,13 @@ DECLARED_ORDER: dict[str, int] = {
     "metrics.registry": 800,
     "metrics.family": 810,
     "metrics.value": 820,
+    # Blackbox trigger matcher: runs inside journal sinks, i.e. on the
+    # emitting thread AFTER observability.events is released but while
+    # the emitter may still hold any lock above — so it ranks innermost.
+    # Neither lock is ever held across an emit or a metrics update (the
+    # capture thread journals blackbox.captured with no locks held).
+    "observability.blackbox": 830,
+    "observability.blackbox.store": 840,
 }
 
 _enabled = False

@@ -1,0 +1,725 @@
+"""Incident blackbox: triggered postmortem bundles.
+
+Units cover the trigger vocabulary (pure match_trigger), the
+CLIENT_TPU_BLACKBOX grammar (defaults-on-unset, off, inline JSON,
+@file, unknown-key / bad-range fail-fast), the bundle store (atomic
+writes, newest-first listing, count- and byte-cap eviction, corrupt
+bundles raising ValueError — the 400-never-500 contract), and the
+recorder's admission control under a fake clock (debounce, per-trigger
+cooldown, storm counting, the router fan-out dedupe). The e2e half
+boots a real engine behind both frontends: an induced SLO fast-burn
+edge must yield exactly one bundle whose rendered report shows the
+trigger edge and the worst-request trace, HTTP and gRPC must serve
+identical bundle indexes, wall-clock window filters must ride
+/v2/events and /v2/timeseries on both transports, and the router must
+coordinate a fleet capture under one incident id with a dead replica
+degrading to an inline error. Crash-path hardening runs in real
+subprocesses (unhandled exception and hard abort)."""
+
+import gc
+import importlib.util
+import io
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from client_tpu.engine import EngineError, InferRequest, TpuEngine
+from client_tpu.models import build_repository
+from client_tpu.observability import events
+from client_tpu.observability.blackbox import (
+    DEFAULT_TRIGGERS,
+    BlackboxConfig,
+    BlackboxRecorder,
+    BundleStore,
+    match_trigger,
+)
+from client_tpu.observability.tracing import TraceContext
+from client_tpu.router import Replica, Router, RouterHttpServer
+from client_tpu.server import GrpcInferenceServer, HttpInferenceServer
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+blackbox_report = _load_tool("blackbox_report")
+
+
+def _get_json(url, path):
+    with urllib.request.urlopen(f"http://{url}{path}", timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _post_json(url, path, body):
+    req = urllib.request.Request(
+        f"http://{url}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# Trigger vocabulary
+
+
+class TestMatchTrigger:
+    def test_edge_triggers(self):
+        assert match_trigger("qos", "throttle", None) == "qos.throttle"
+        assert match_trigger("admission", "tighten",
+                             {"model": "m"}) == "admission.tighten"
+        assert match_trigger("fleet", "rebalance", None) == "fleet.rebalance"
+        assert match_trigger("memory", "pressure", None) == "memory.pressure"
+
+    def test_storm_triggers_map_to_storm_names(self):
+        assert match_trigger("breaker", "open", None) == "breaker.storm"
+        assert match_trigger("deadline", "expired", None) == "deadline.burst"
+
+    def test_health_requires_fast_burn_detail(self):
+        assert match_trigger("lifecycle", "health", None) is None
+        assert match_trigger("lifecycle", "health",
+                             {"state": "DEGRADED"}) is None
+        assert match_trigger(
+            "lifecycle", "health",
+            {"slo_fast_burn": True}) == "slo.fast_burn"
+
+    def test_non_incidents_ignored(self):
+        assert match_trigger("lifecycle", "server_start", None) is None
+        assert match_trigger("admission", "restore", None) is None
+        assert match_trigger("autotune", "dispatch_tighten", None) is None
+
+
+# ---------------------------------------------------------------------------
+# Config grammar
+
+
+class TestBlackboxConfig:
+    def test_unset_means_enabled_defaults(self):
+        cfg = BlackboxConfig.from_env(environ={})
+        assert cfg.enabled and cfg.triggers == DEFAULT_TRIGGERS
+        assert cfg.debounce_s == 30.0 and cfg.cooldown_s == 300.0
+        assert cfg.max_bundles == 12
+
+    def test_on_off_variants(self):
+        for raw in ("1", "on", "true"):
+            assert BlackboxConfig.from_env(
+                environ={"CLIENT_TPU_BLACKBOX": raw}).enabled
+        for raw in ("0", "off", "false"):
+            assert not BlackboxConfig.from_env(
+                environ={"CLIENT_TPU_BLACKBOX": raw}).enabled
+
+    def test_inline_json_and_file(self, tmp_path):
+        spec = {"dir": str(tmp_path), "debounce_s": 1,
+                "triggers": ["qos.throttle"]}
+        cfg = BlackboxConfig.from_env(
+            environ={"CLIENT_TPU_BLACKBOX": json.dumps(spec)})
+        assert cfg.dir == str(tmp_path)
+        assert cfg.debounce_s == 1.0
+        assert cfg.triggers == ("qos.throttle",)
+        p = tmp_path / "bb.json"
+        p.write_text(json.dumps(spec))
+        via_file = BlackboxConfig.from_env(
+            environ={"CLIENT_TPU_BLACKBOX": f"@{p}"})
+        assert via_file.dir == cfg.dir and via_file.triggers == cfg.triggers
+
+    def test_unknown_key_and_trigger_fail_fast(self):
+        with pytest.raises(ValueError, match="unknown key"):
+            BlackboxConfig.from_dict({"windoze_s": 5})
+        with pytest.raises(ValueError, match="unknown trigger"):
+            BlackboxConfig.from_dict({"triggers": ["qos.oops"]})
+        with pytest.raises(ValueError, match="invalid JSON"):
+            BlackboxConfig.from_env(
+                environ={"CLIENT_TPU_BLACKBOX": "{nope"})
+        with pytest.raises(ValueError, match="cannot read"):
+            BlackboxConfig.from_env(
+                environ={"CLIENT_TPU_BLACKBOX": "@/no/such/file.json"})
+
+    def test_range_validation(self):
+        with pytest.raises(ValueError, match="window_s"):
+            BlackboxConfig.from_dict({"window_s": 0})
+        with pytest.raises(ValueError, match="max_bundle_bytes"):
+            BlackboxConfig.from_dict({"max_bundle_bytes": 10})
+        with pytest.raises(ValueError, match="expects a number"):
+            BlackboxConfig.from_dict({"debounce_s": "soon"})
+
+    def test_resolved_dir_defaults_to_pid_scoped_tmp(self):
+        assert str(os.getpid()) in BlackboxConfig().resolved_dir()
+        assert BlackboxConfig(dir="/x/y").resolved_dir() == "/x/y"
+
+
+# ---------------------------------------------------------------------------
+# Bundle store
+
+
+class TestBundleStore:
+    def _write(self, store, bundle_id, payload=None):
+        body = payload or json.dumps(
+            {"id": bundle_id, "trigger": "manual"}).encode()
+        return store.write(bundle_id, body, {"trigger": "manual"})
+
+    def test_roundtrip_and_newest_first(self, tmp_path):
+        store = BundleStore(str(tmp_path))
+        self._write(store, "bb-1-0001-manual")
+        os.utime(store._path("bb-1-0001-manual"), (1.0, 1.0))
+        self._write(store, "bb-1-0002-manual")
+        ids = [m["id"] for m in store.list()]
+        assert ids == ["bb-1-0002-manual", "bb-1-0001-manual"]
+        assert store.load("bb-1-0001-manual")["id"] == "bb-1-0001-manual"
+        assert store.total_bytes() > 0
+        assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+
+    def test_count_cap_evicts_oldest(self, tmp_path):
+        store = BundleStore(str(tmp_path), max_bundles=2)
+        for i in range(4):
+            meta = self._write(store, f"bb-1-{i:04d}-manual")
+            # distinct mtimes so eviction order is deterministic
+            os.utime(store._path(meta["id"]), (i + 1.0, i + 1.0))
+        ids = {m["id"] for m in store.list()}
+        assert ids == {"bb-1-0002-manual", "bb-1-0003-manual"}
+
+    def test_byte_cap_evicts_oldest(self, tmp_path):
+        store = BundleStore(str(tmp_path), max_total_bytes=2048)
+        blob = json.dumps({"pad": "x" * 700}).encode()
+        for i in range(4):
+            self._write(store, f"bb-2-{i:04d}-manual", payload=blob)
+            os.utime(store._path(f"bb-2-{i:04d}-manual"),
+                     (i + 1.0, i + 1.0))
+        kept = [m["id"] for m in store.list()]
+        assert len(kept) == 2 and store.total_bytes() <= 2048
+        assert kept[0] == "bb-2-0003-manual"
+
+    def test_unknown_id_keyerror_corrupt_valueerror(self, tmp_path):
+        store = BundleStore(str(tmp_path))
+        with pytest.raises(KeyError):
+            store.load("bb-9-0001-manual")
+        (tmp_path / "bb-9-0002-manual.json").write_bytes(b"{torn...")
+        with pytest.raises(ValueError, match="corrupt"):
+            store.load("bb-9-0002-manual")
+        (tmp_path / "bb-9-0003-manual.json").write_bytes(b"[1, 2]")
+        with pytest.raises(ValueError, match="expected a JSON object"):
+            store.load("bb-9-0003-manual")
+
+    def test_malformed_ids_rejected(self, tmp_path):
+        store = BundleStore(str(tmp_path))
+        for bad in ("../etc/passwd", "", ".hidden", "a/b", "a b"):
+            with pytest.raises(ValueError, match="invalid bundle id"):
+                store.load(bad)
+            with pytest.raises(ValueError, match="invalid bundle id"):
+                store.write(bad, b"{}", {})
+
+
+# ---------------------------------------------------------------------------
+# Recorder admission control (fake clock) + capture
+
+
+@pytest.fixture(scope="module")
+def engine():
+    # Blackbox off for the shared unit-test engine: these tests build
+    # their own recorders with fake clocks; a default-on recorder would
+    # also react to every emitted trigger edge below.
+    old = os.environ.get("CLIENT_TPU_BLACKBOX")
+    os.environ["CLIENT_TPU_BLACKBOX"] = "off"
+    try:
+        eng = TpuEngine(build_repository(["simple"]), warmup=False)
+    finally:
+        if old is None:
+            os.environ.pop("CLIENT_TPU_BLACKBOX", None)
+        else:
+            os.environ["CLIENT_TPU_BLACKBOX"] = old
+    yield eng
+    eng.shutdown()
+
+
+class _FakeMono:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+def _recorder(engine, tmp_path, **cfg_kwargs):
+    """A recorder with a fake monotonic clock and a disabled capture
+    thread, so triggering is observed via the pending queue and
+    ``drain()`` is the deterministic capture entry point."""
+    cfg = BlackboxConfig(dir=str(tmp_path), post_window_s=0.0,
+                         **cfg_kwargs)
+    mono = _FakeMono()
+    rec = BlackboxRecorder(engine, cfg, mono=mono)
+    rec._stop.set()  # keep capture synchronous (drain() only)
+    return rec, mono
+
+
+def _emit(category, name, **detail):
+    return events.journal().emit(category, name, **detail)
+
+
+class TestRecorderTriggering:
+    def test_trigger_edge_writes_one_bundle(self, engine, tmp_path):
+        rec, _ = _recorder(engine, tmp_path)
+        evt = _emit("qos", "throttle", ratio=0.5)
+        rec._on_event(evt)
+        assert rec.drain() == 1
+        bundles = rec.store.list()
+        assert len(bundles) == 1
+        bundle = rec.store.load(bundles[0]["id"])
+        assert bundle["trigger"] == "qos.throttle"
+        assert bundle["trigger_event"]["category"] == "qos"
+        assert bundle["ts_wall"] == evt.ts_wall
+
+    def test_debounce_suppresses_second_trigger(self, engine, tmp_path):
+        rec, mono = _recorder(engine, tmp_path, debounce_s=30.0)
+        rec._on_event(_emit("qos", "throttle"))
+        mono.now += 10.0  # inside the debounce window
+        rec._on_event(_emit("memory", "pressure"))
+        assert len(rec._pending) == 1 and rec.suppressed == 1
+        mono.now += 25.0  # past the debounce; different trigger admits
+        rec._on_event(_emit("memory", "pressure"))
+        assert len(rec._pending) == 2
+
+    def test_per_trigger_cooldown(self, engine, tmp_path):
+        rec, mono = _recorder(engine, tmp_path, debounce_s=1.0,
+                              cooldown_s=300.0)
+        rec._on_event(_emit("qos", "throttle"))
+        mono.now += 100.0  # past debounce, inside the trigger cooldown
+        rec._on_event(_emit("qos", "throttle"))
+        assert len(rec._pending) == 1 and rec.suppressed == 1
+        mono.now += 300.0  # cooldown expired: same trigger admits again
+        rec._on_event(_emit("qos", "throttle"))
+        assert len(rec._pending) == 2
+
+    def test_storm_needs_count_inside_window(self, engine, tmp_path):
+        rec, mono = _recorder(engine, tmp_path, storm_count=3,
+                              storm_window_s=10.0)
+        for _ in range(2):
+            rec._on_event(_emit("breaker", "open", model="m"))
+            mono.now += 1.0
+        assert not rec._pending  # two opens in 10s is routine
+        rec._on_event(_emit("breaker", "open", model="m"))
+        assert len(rec._pending) == 1  # the third makes it a storm
+        bundle = rec.store.load(rec.store.list()[0]["id"]) \
+            if rec.drain() else None
+        assert bundle and bundle["trigger"] == "breaker.storm"
+
+    def test_storm_window_expiry_resets(self, engine, tmp_path):
+        rec, mono = _recorder(engine, tmp_path, storm_count=3,
+                              storm_window_s=10.0)
+        for _ in range(2):
+            rec._on_event(_emit("deadline", "expired"))
+            mono.now += 1.0
+        mono.now += 60.0  # the early edges age out of the window
+        rec._on_event(_emit("deadline", "expired"))
+        assert not rec._pending
+
+    def test_unconfigured_triggers_and_own_edges_ignored(
+            self, engine, tmp_path):
+        rec, _ = _recorder(engine, tmp_path,
+                           triggers=("fleet.rebalance",))
+        rec._on_event(_emit("qos", "throttle"))
+        rec._on_event(_emit("blackbox", "captured", bundle="x"))
+        rec._on_event(_emit("lifecycle", "server_start"))
+        assert not rec._pending and rec.suppressed == 0
+
+    def test_dead_engine_detaches_sink(self, tmp_path):
+        class Husk:
+            pass
+
+        husk = Husk()
+        cfg = BlackboxConfig(dir=str(tmp_path))
+        rec = BlackboxRecorder(husk, cfg)
+        rec._stop.set()
+        rec.install()
+        jrnl = events.journal()
+        assert rec._on_event in jrnl._sinks
+        del husk
+        gc.collect()
+        _emit("qos", "throttle")
+        assert rec._on_event not in jrnl._sinks
+        assert not rec._pending
+
+    def test_fan_out_dedupe_respects_cooldown(self, engine, tmp_path):
+        rec, _ = _recorder(engine, tmp_path)
+        first = rec.capture("fleet.rebalance", respect_cooldown=True,
+                            incident="inc-aaa")
+        assert "deduped" not in first
+        second = rec.capture("fleet.rebalance", respect_cooldown=True,
+                             incident="inc-aaa")
+        assert second["deduped"] and second["bundle"] == first["id"]
+        # manual captures never dedupe
+        assert "deduped" not in rec.capture(
+            "manual", respect_cooldown=True)
+
+    def test_capture_sections_and_journal_edge(self, engine, tmp_path):
+        rec, _ = _recorder(engine, tmp_path)
+        _emit("lifecycle", "server_start", probe=True)
+        cursor = events.journal().export(limit=0)["next_seq"]
+        meta = rec.capture("manual", note="unit capture")
+        bundle = rec.store.load(meta["id"])
+        for want in ("journal", "timeseries", "profile", "memory",
+                     "costs", "qos", "slo", "traces", "fingerprint"):
+            sec = bundle["sections"][want]
+            assert isinstance(sec, dict) and "error" not in sec, (
+                want, sec)
+        assert bundle["sections"]["journal"]["events"]
+        fp = bundle["sections"]["fingerprint"]
+        assert fp["pid"] == os.getpid() and "git" in fp
+        edges = [e for e in events.journal().snapshot(
+            category="blackbox", since_seq=cursor)
+            if e.name == "captured"]
+        assert len(edges) == 1
+        assert edges[0].detail["bundle"] == meta["id"]
+        assert edges[0].severity == "INFO"  # manual is not an incident
+
+    def test_unknown_trigger_rejected(self, engine, tmp_path):
+        rec, _ = _recorder(engine, tmp_path)
+        with pytest.raises(ValueError, match="unknown trigger"):
+            rec.capture("qos.oops")
+
+    def test_byte_cap_trims_and_marks_truncated(self, engine, tmp_path):
+        rec, _ = _recorder(engine, tmp_path,
+                           max_bundle_bytes=4096,
+                           max_total_bytes=8192)
+        for i in range(80):
+            _emit("lifecycle", "health", pad="x" * 120, i=i)
+        meta = rec.capture("manual")
+        assert meta["bytes"] <= 4096
+        assert meta["truncated"]
+        bundle = rec.store.load(meta["id"])  # trimmed but still valid
+        assert bundle["truncated"] == meta["truncated"]
+
+    def test_engine_accessor_maps_errors(self, engine, tmp_path):
+        rec, _ = _recorder(engine, tmp_path)
+        old = engine.blackbox
+        engine.blackbox = rec
+        try:
+            rec.capture("manual")
+            with pytest.raises(EngineError) as ei:
+                engine.blackbox_bundles("bb-0-9999-none")
+            assert ei.value.status == 404
+            with pytest.raises(EngineError) as ei:
+                engine.blackbox_bundles("../etc/passwd")
+            assert ei.value.status == 400
+            with pytest.raises(EngineError) as ei:
+                engine.blackbox_capture("qos.oops")
+            assert ei.value.status == 400
+        finally:
+            engine.blackbox = old
+
+    def test_disabled_engine_accessor_400(self, engine):
+        old = engine.blackbox
+        engine.blackbox = None
+        try:
+            with pytest.raises(EngineError) as ei:
+                engine.blackbox_bundles()
+            assert ei.value.status == 400
+        finally:
+            engine.blackbox = old
+
+
+# ---------------------------------------------------------------------------
+# Wall-clock window filters (satellite of the bundle ±window)
+
+
+class TestWallWindowFilters:
+    def test_journal_until_ts(self):
+        ticks = iter([100.0, 200.0, 300.0])
+        jrnl = events.EventJournal(capacity=16,
+                                   clock=lambda: next(ticks))
+        early = jrnl.emit("lifecycle", "server_start", n=1)
+        late = jrnl.emit("lifecycle", "server_start", n=2)
+        got = jrnl.export(until_ts=early.ts_wall)  # inclusive bound
+        assert [e["seq"] for e in got["events"]] == [early.seq]
+        assert len(jrnl.export(until_ts=late.ts_wall)["events"]) == 2
+        assert [e["seq"] for e in jrnl.export(  # exclusive lower bound
+            since_ts=early.ts_wall)["events"]] == [late.seq]
+
+    def test_recorder_wall_window(self, engine):
+        engine.recorder.tick()
+        export = engine.timeseries_export()
+        assert export["samples"]
+        last_wall = export["samples"][-1]["ts_wall"]
+        # since_wall is exclusive; until_wall inclusive
+        assert not engine.timeseries_export(
+            since_wall=last_wall)["samples"]
+        windowed = engine.timeseries_export(
+            since_wall=last_wall - 1e-6, until_wall=last_wall)
+        assert windowed["samples"][-1]["ts_wall"] == last_wall
+
+
+# ---------------------------------------------------------------------------
+# E2E: both transports + induced incident + renderer
+
+
+@pytest.fixture()
+def served(tmp_path, monkeypatch):
+    monkeypatch.setenv("CLIENT_TPU_BLACKBOX", json.dumps({
+        "dir": str(tmp_path / "bundles"), "post_window_s": 0.0,
+        "debounce_s": 0.0, "window_s": 300.0}))
+    eng = TpuEngine(build_repository(["simple"]), warmup=False)
+    http_srv = HttpInferenceServer(eng, host="127.0.0.1", port=0).start()
+    grpc_srv = GrpcInferenceServer(eng, host="127.0.0.1", port=0).start()
+    try:
+        yield eng, http_srv, grpc_srv
+    finally:
+        grpc_srv.stop()
+        http_srv.stop()
+        eng.shutdown()
+
+
+def _traced_infer(eng):
+    eng.infer(InferRequest(
+        model_name="simple",
+        inputs={"INPUT0": np.zeros((1, 16), dtype=np.int32),
+                "INPUT1": np.zeros((1, 16), dtype=np.int32)},
+        trace=TraceContext.new()), timeout_s=120)
+
+
+class TestBlackboxE2E:
+    def test_fast_burn_incident_one_bundle_and_report(self, served):
+        eng, http_srv, _ = served
+        assert eng.blackbox is not None
+        _traced_infer(eng)
+        eng.recorder.tick()
+        # The incident: health flips with fast-burning models. Exactly
+        # one bundle must come out of it (edge -> capture, cooldown
+        # holds a second edge of the same incident).
+        events.journal().emit(
+            "lifecycle", "health", severity="WARNING", model="simple",
+            state="DEGRADED", slo_fast_burn=True, burn_5m=14.4)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline \
+                and not eng.blackbox.store.list():
+            time.sleep(0.05)
+        events.journal().emit(
+            "lifecycle", "health", severity="WARNING", model="simple",
+            state="DEGRADED", slo_fast_burn=True, burn_5m=15.0)
+        time.sleep(0.3)  # a second capture would need a drain cycle
+        index = _get_json(http_srv.url, "/v2/debug/bundles")
+        assert len(index["bundles"]) == 1, index
+        bundle = _get_json(
+            http_srv.url, f"/v2/debug/bundles/{index['bundles'][0]['id']}")
+        assert bundle["trigger"] == "slo.fast_burn"
+        assert bundle["trigger_event"]["detail"]["slo_fast_burn"]
+        worst = bundle["sections"]["traces"]["worst"]
+        assert worst and worst[0]["model"] == "simple"
+        assert bundle["sections"]["timeseries"]["samples"]
+        out = io.StringIO()
+        blackbox_report.render(bundle, out=out)
+        text = out.getvalue()
+        assert "trigger edge" in text and "slo.fast_burn" in text
+        assert ">>>" in text  # the trigger row in the journal timeline
+        assert "flight recorder" in text
+        assert "worst in-window requests" in text
+
+    def test_http_grpc_parity_and_manual_capture(self, served):
+        import client_tpu.grpc as grpcclient
+
+        eng, http_srv, grpc_srv = served
+        cap = _post_json(http_srv.url, "/v2/debug/capture",
+                         {"note": "manual e2e"})
+        assert cap["trigger"] == "manual" and cap["note"] == "manual e2e"
+        http_index = _get_json(http_srv.url, "/v2/debug/bundles")
+        client = grpcclient.InferenceServerClient(grpc_srv.url)
+        try:
+            grpc_index = client.get_bundles()
+            assert ([b["id"] for b in grpc_index["bundles"]]
+                    == [b["id"] for b in http_index["bundles"]])
+            assert client.get_bundles(cap["id"])["id"] == cap["id"]
+            gcap = client.capture_bundle(note="grpc e2e")
+            assert gcap["id"] != cap["id"]
+            with pytest.raises(Exception):
+                client.get_bundles("bb-0-9999-none")
+        finally:
+            client.close()
+        metrics = urllib.request.urlopen(
+            f"http://{http_srv.url}/metrics", timeout=30).read().decode()
+        assert 'tpu_blackbox_captures_total{trigger="manual"} 2' in metrics
+        assert "tpu_blackbox_bundle_bytes" in metrics
+
+    def test_corrupt_bundle_is_400_never_500(self, served):
+        eng, http_srv, _ = served
+        bad = os.path.join(eng.blackbox.store.directory,
+                           "bb-1-0666-manual.json")
+        os.makedirs(os.path.dirname(bad), exist_ok=True)
+        with open(bad, "wb") as f:
+            f.write(b"{torn mid-write")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_json(http_srv.url, "/v2/debug/bundles/bb-1-0666-manual")
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_json(http_srv.url, "/v2/debug/bundles/bb-1-0777-manual")
+        assert ei.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(http_srv.url, "/v2/debug/capture",
+                       {"trigger": "qos.oops"})
+        assert ei.value.code == 400
+
+    def test_wall_window_filters_both_transports(self, served):
+        import client_tpu.grpc as grpcclient
+
+        eng, http_srv, grpc_srv = served
+        eng.recorder.tick()
+        now = time.time()  # tpulint: allow[wall-clock] test window bound
+        ev = _get_json(http_srv.url,
+                       f"/v2/events?until_wall={now - 3600}")
+        assert ev["events"] == []
+        ev = _get_json(http_srv.url,
+                       f"/v2/events?since_wall={now - 3600}")
+        assert ev["events"]
+        ts = _get_json(http_srv.url,
+                       f"/v2/timeseries?since_wall={now + 3600}")
+        assert ts["samples"] == []
+        client = grpcclient.InferenceServerClient(grpc_srv.url)
+        try:
+            assert client.get_events(
+                until_wall=now - 3600)["events"] == []
+            assert client.get_events(since_wall=now - 3600)["events"]
+            assert client.get_timeseries(
+                since_wall=now + 3600)["samples"] == []
+            assert client.get_timeseries(
+                until_wall=now + 3600)["samples"]
+        finally:
+            client.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet coordination
+
+
+class TestFleetBlackbox:
+    def test_router_capture_shares_incident_dead_replica_inline(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CLIENT_TPU_BLACKBOX", json.dumps({
+            "dir": str(tmp_path / "bundles"), "post_window_s": 0.0}))
+        fleet = []
+        router_srv = None
+        try:
+            for _ in range(2):
+                eng = TpuEngine(build_repository(["simple"]),
+                                warmup=False)
+                srv = HttpInferenceServer(
+                    eng, host="127.0.0.1", port=0).start()
+                fleet.append((eng, srv))
+            replicas = [Replica(srv.url) for _, srv in fleet]
+            dead = Replica("127.0.0.1:9")  # nothing listens there
+            router = Router(replicas + [dead], seed=7)
+            router_srv = RouterHttpServer(router, port=0).start()
+            assert router_srv.blackbox is not None
+            res = _post_json(router_srv.url, "/v2/debug/capture",
+                             {"note": "fleet e2e"})
+            incident = res["incident"]
+            assert incident.startswith("inc-")
+            assert res["bundle"]["incident"] == incident
+            live_ids = {r.id for r in replicas}
+            for rid, obj in res["replicas"].items():
+                if rid == dead.id:
+                    assert "error" in obj, obj
+                else:
+                    assert rid in live_ids
+                    assert obj["incident"] == incident, obj
+                    assert obj["trigger"] == "fleet"
+            # every live replica's bundle is greppable by incident id
+            for eng, _ in fleet:
+                stored = [eng.blackbox.store.load(m["id"])
+                          for m in eng.blackbox.store.list()]
+                assert any(b["incident"] == incident for b in stored)
+            index = _get_json(router_srv.url, "/v2/debug/bundles")
+            assert index["router"] and index["bundles"]
+            assert dead.id in index["errors"]
+            assert set(index["replicas"]) == live_ids
+            rb = _get_json(router_srv.url,
+                           f"/v2/debug/bundles/{res['bundle']['id']}")
+            assert rb["incident"] == incident
+            assert "router_status" in rb["sections"]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get_json(router_srv.url, "/v2/debug/bundles/bb-0-1-x")
+            assert ei.value.code == 404
+        finally:
+            if router_srv is not None:
+                router_srv.stop()
+            for eng, srv in fleet:
+                srv.stop()
+                eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Crash-path hardening (real subprocesses)
+
+
+_CRASH_SCRIPT = """
+import sys
+
+from client_tpu.observability import blackbox
+from client_tpu.observability.events import journal
+
+
+class Husk:  # any weakref-able stand-in; crash path never touches it
+    pass
+
+
+eng = Husk()
+rec = blackbox.BlackboxRecorder(
+    eng, blackbox.BlackboxConfig.from_dict({"dir": sys.argv[1]}))
+rec.install()
+journal().emit("lifecycle", "server_start", models=0)
+journal().emit("admission", "shed", severity="WARNING", model="m")
+raise RuntimeError("boom for the blackbox")
+"""
+
+_ABORT_SCRIPT = """
+import os
+
+from client_tpu.observability import blackbox
+
+blackbox.install_crash_hooks()
+os.abort()
+"""
+
+
+class TestCrashHooks:
+    def _run(self, script, *args):
+        return subprocess.run(
+            [sys.executable, "-c", script, *args],
+            capture_output=True, text=True, timeout=120,
+            cwd=REPO_ROOT,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+    def test_unhandled_exception_leaves_evidence(self, tmp_path):
+        proc = self._run(_CRASH_SCRIPT, str(tmp_path))
+        assert proc.returncode != 0
+        assert "boom for the blackbox" in proc.stderr
+        # one JSON evidence line with the journal tail on stderr
+        crash_lines = [ln for ln in proc.stderr.splitlines()
+                       if ln.startswith('{"blackbox": "crash"')]
+        assert len(crash_lines) == 1
+        evidence = json.loads(crash_lines[0])
+        assert "boom for the blackbox" in evidence["error"]
+        assert any(e["category"] == "admission"
+                   for e in evidence["journal_tail"])
+        # a mini crash bundle + the atexit journal flush on disk
+        crash = [n for n in os.listdir(tmp_path)
+                 if n.endswith("-crash.json")]
+        assert len(crash) == 1
+        bundle = json.loads((tmp_path / crash[0]).read_bytes())
+        assert bundle["trigger"] == "crash"
+        assert bundle["sections"]["journal"]["events"]
+        finals = [n for n in os.listdir(tmp_path)
+                  if n.startswith("final_journal_")]
+        assert len(finals) == 1
+
+    def test_hard_abort_dumps_stacks(self, tmp_path):
+        proc = self._run(_ABORT_SCRIPT)
+        assert proc.returncode != 0
+        assert "Fatal Python error: Aborted" in proc.stderr

@@ -68,6 +68,9 @@ HTTP_HANDLER_OPS = {
     "costs": "costs",
     "qos": "qos",
     "load": "load_report",
+    "debug_bundles": "blackbox_bundles",
+    "debug_bundle": "blackbox_bundles",
+    "debug_capture": "blackbox_capture",
     "metrics": "metrics",
 }
 
@@ -88,6 +91,8 @@ GRPC_RPC_OPS = {
     "MemoryCensus": "memory_census",
     "Costs": "costs",
     "Qos": "qos",
+    "BlackboxBundles": "blackbox_bundles",
+    "BlackboxCapture": "blackbox_capture",
     "RingRegister": "ring_register",
     "RingStatus": "ring_status",
     "RingUnregister": "ring_unregister",
@@ -146,6 +151,8 @@ CLIENT_METHOD_OPS = {
     "get_memory": "memory_census",
     "get_costs": "costs",
     "get_qos_status": "qos",
+    "get_bundles": "blackbox_bundles",
+    "capture_bundle": "blackbox_capture",
     "get_fleet_events": "fleet_events",
     "get_fleet_profile": "fleet_profile",
     "get_fleet_slo": "fleet_slo",

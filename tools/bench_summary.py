@@ -135,7 +135,12 @@ def check(hist: list, threshold: float = 0.25) -> int:
         bits = (("loops_closed", bool(selfdriving.get("loops_closed"))),
                 ("fill_recovered",
                  bool(selfdriving.get("fill_recovered"))),
-                ("bounded", bool(selfdriving.get("bounded"))))
+                ("bounded", bool(selfdriving.get("bounded"))),
+                # Incident blackbox: the induced incidents must have
+                # produced bundles (zero means the trigger path broke;
+                # the probe itself fails on more-than-one-per-incident).
+                ("blackbox_captured",
+                 bool(selfdriving.get("blackbox_bundles"))))
         bad = [name for name, ok in bits if not ok]
         verdict = f"FAIL ({', '.join(bad)} unmet)" if bad else "ok"
         print("bench-check: selfdriving: "
@@ -374,6 +379,11 @@ def _print_selfdriving_delta(rec: dict) -> None:
               f" rebalance x{b.get('fired')} ({b.get('moves')} moves, "
               f"{b.get('outcome')}), serving_after="
               f"{b.get('serving_after')}")
+    bb = r.get("blackbox") or {}
+    if bb:
+        print(f"    selfdriving blackbox: {r.get('blackbox_bundles')} "
+              f"bundles (one_per_incident={bb.get('one_per_incident')}, "
+              f"max capture {r.get('blackbox_capture_ms')}ms)")
     print(f"    selfdriving verdict: loops_closed={r.get('loops_closed')}"
           f" fill_recovered={r.get('fill_recovered')} "
           f"bounded={r.get('bounded')}")

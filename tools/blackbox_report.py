@@ -1,0 +1,336 @@
+#!/usr/bin/env python
+"""Render an incident-blackbox bundle as a postmortem timeline.
+
+Input is either a saved bundle file (``GET /v2/debug/bundles/{id} >
+bundle.json``) or a live server base URL — with no ``--id`` the newest
+retained bundle is fetched and rendered:
+
+    python tools/blackbox_report.py bundle.json
+    python tools/blackbox_report.py http://127.0.0.1:8000
+    python tools/blackbox_report.py http://127.0.0.1:8000 --id bb-123-0001-manual
+
+The report shows the trigger edge, the journal timeline around it
+(the trigger row marked ``>>>``), one sparkline per flight-recorder
+signal across the ±window, the worst-request stitched traces, condensed
+HBM-drift / cost / QoS tables, and the env/git fingerprint. Router
+bundles additionally show the per-replica capture table (shared
+incident id, inline errors).
+
+``--diff`` compares two bundles — journal deltas by ``category.name``,
+per-signal last-value drift, tenant cost movement — the "what changed
+between these two incidents" question:
+
+    python tools/blackbox_report.py --diff before.json after.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from urllib.parse import urlparse
+from urllib.request import urlopen
+
+try:
+    from tools.profile_report import _fmt_bytes, render_timeseries, sparkline
+except ImportError:  # executed as a script from tools/
+    from profile_report import _fmt_bytes, render_timeseries, sparkline
+
+
+def load_bundle(source: str, bundle_id: str = "",
+                timeout_s: float = 10.0) -> dict:
+    """Read a saved bundle file, or fetch one (newest when ``bundle_id``
+    is empty) from a live server / router."""
+    if urlparse(source).scheme not in ("http", "https"):
+        with open(source) as f:
+            return json.load(f)
+    base = source.rstrip("/")
+    if not bundle_id:
+        with urlopen(f"{base}/v2/debug/bundles",
+                     timeout=timeout_s) as resp:
+            index = json.load(resp)
+        bundles = index.get("bundles") or []
+        if not bundles:
+            raise SystemExit(f"no bundles retained on {base}")
+        bundle_id = bundles[0]["id"]
+    with urlopen(f"{base}/v2/debug/bundles/{bundle_id}",
+                 timeout=timeout_s) as resp:
+        return json.load(resp)
+
+
+def _ts(wall) -> str:
+    try:
+        return time.strftime("%Y-%m-%d %H:%M:%S",
+                             time.localtime(float(wall)))
+    except (TypeError, ValueError, OSError):
+        return str(wall)
+
+
+def _section(bundle: dict, name: str):
+    sec = (bundle.get("sections") or {}).get(name)
+    return sec if isinstance(sec, dict) else None
+
+
+def _condense(detail: dict, width: int = 60) -> str:
+    text = json.dumps(detail, default=str, sort_keys=True)
+    return text if len(text) <= width else text[:width - 3] + "..."
+
+
+def render(bundle: dict, out=None) -> None:
+    w = (out or sys.stdout).write
+    w(f"=== incident bundle {bundle.get('id')} ===\n")
+    w(f"trigger   : {bundle.get('trigger')}\n")
+    w(f"incident  : {bundle.get('incident')}\n")
+    w(f"captured  : {_ts(bundle.get('ts_wall'))} "
+      f"(epoch {bundle.get('ts_wall')})\n")
+    if bundle.get("note"):
+        w(f"note      : {bundle['note']}\n")
+    if bundle.get("window_s") is not None:
+        w(f"window    : -{bundle.get('window_s')}s / "
+          f"+{bundle.get('post_window_s')}s around the trigger\n")
+    if bundle.get("truncated"):
+        w(f"truncated : {', '.join(bundle['truncated'])} "
+          "(byte cap reached)\n")
+
+    edge = bundle.get("trigger_event")
+    if edge:
+        w("\n--- trigger edge ---\n")
+        w(f"  {edge.get('category')}.{edge.get('name')} "
+          f"[{edge.get('severity')}] seq={edge.get('seq')} "
+          f"at {_ts(edge.get('ts_wall'))}\n")
+        if edge.get("model"):
+            w(f"  model: {edge['model']}\n")
+        if edge.get("detail"):
+            w(f"  detail: {_condense(edge['detail'], 200)}\n")
+
+    _render_replicas(bundle, w)
+    _render_journal(bundle, w)
+
+    ts = _section(bundle, "timeseries") or _section(bundle,
+                                                    "fleet_timeseries")
+    if ts:
+        w("\n--- flight recorder (±window) ---\n")
+        render_timeseries(ts, out=out)
+
+    _render_traces(bundle, w)
+    _render_memory(bundle, w)
+    _render_costs(bundle, w)
+    _render_qos(bundle, w)
+
+    fp = _section(bundle, "fingerprint")
+    if fp:
+        w("\n--- fingerprint ---\n")
+        git = fp.get("git") or {}
+        w(f"  pid {fp.get('pid')}  python {fp.get('python')}  "
+          f"commit {git.get('commit', '?')[:12]}\n")
+        if fp.get("versions"):
+            w("  libs: " + " ".join(f"{k}={v}" for k, v in
+                                    sorted(fp["versions"].items()))
+              + "\n")
+        env = fp.get("env") or {}
+        if env:
+            w(f"  env ({len(env)} CLIENT_TPU_* vars): "
+              + " ".join(sorted(env)) + "\n")
+
+
+def _render_replicas(bundle: dict, w) -> None:
+    replicas = bundle.get("replicas")
+    if not isinstance(replicas, dict) or not replicas:
+        return
+    w("\n--- fleet capture (shared incident id) ---\n")
+    for rid in sorted(replicas):
+        obj = replicas[rid] or {}
+        if "error" in obj:
+            line = f"ERROR {obj['error']}"
+        elif obj.get("deduped"):
+            line = f"deduped -> {obj.get('bundle')}"
+        else:
+            line = f"bundle {obj.get('id')} ({obj.get('bytes', '?')}B)"
+        w(f"  {rid:<16} {line}\n")
+
+
+def _render_journal(bundle: dict, w) -> None:
+    jr = _section(bundle, "journal")
+    if not jr:
+        return
+    events = jr.get("events") or []
+    w(f"\n--- journal timeline ({len(events)} events, "
+      f"dropped {jr.get('dropped', 0)}) ---\n")
+    edge = bundle.get("trigger_event") or {}
+    t0 = bundle.get("ts_wall") or 0.0
+    for e in events:
+        dt = (e.get("ts_wall") or 0.0) - t0
+        mark = (">>>" if edge and e.get("seq") == edge.get("seq")
+                else "   ")
+        line = (f"{mark} {dt:+9.3f}s [{e.get('severity', '?'):>7}] "
+                f"{e.get('category')}.{e.get('name')}")
+        if e.get("model"):
+            line += f" model={e['model']}"
+        if e.get("detail"):
+            line += f" {_condense(e['detail'])}"
+        w(line + "\n")
+
+
+def _render_traces(bundle: dict, w) -> None:
+    tr = _section(bundle, "traces")
+    worst = (tr or {}).get("worst") or []
+    if not worst:
+        return
+    w(f"\n--- worst in-window requests ({len(worst)}) ---\n")
+    for t in worst:
+        spans = (t.get("chrome") or {}).get("traceEvents")
+        w(f"  {t.get('trace_id')}  model={t.get('model')}  "
+          f"wall={t.get('wall_time_ms', 0):.2f}ms  "
+          f"ok={t.get('ok')}  "
+          f"spans={len(spans) if isinstance(spans, list) else '?'}\n")
+        if t.get("error"):
+            w(f"    error: {t['error']}\n")
+
+
+def _render_memory(bundle: dict, w) -> None:
+    mem = _section(bundle, "memory")
+    if not mem:
+        return
+    totals = mem.get("totals") or {}
+    w("\n--- hbm census ---\n")
+    w(f"  committed {_fmt_bytes(totals.get('committed_bytes', 0))}  "
+      f"planned {_fmt_bytes(totals.get('plan_bytes', 0))}  "
+      f"unattributed {_fmt_bytes(totals.get('unattributed_bytes', 0))}\n")
+    drifted = [o for o in (mem.get("owners") or [])
+               if o.get("drift_bytes")]
+    for o in sorted(drifted, key=lambda o: -abs(o["drift_bytes"]))[:8]:
+        w(f"  drift {o.get('model')}/{o.get('component')}: "
+          f"{_fmt_bytes(o['drift_bytes'])} "
+          f"(live {_fmt_bytes(o.get('bytes', 0))})\n")
+
+
+def _render_costs(bundle: dict, w) -> None:
+    costs = _section(bundle, "costs") or _section(bundle, "fleet_costs")
+    tenants = (costs or {}).get("tenants")
+    if not isinstance(tenants, dict) or not tenants:
+        return
+    w("\n--- cost ledger (per tenant) ---\n")
+    rows = sorted(tenants.items(),
+                  key=lambda kv: -(kv[1].get("device_s") or 0))
+    for tenant, row in rows[:8]:
+        w(f"  {tenant:<16} device {row.get('device_s', 0):.4f}s  "
+          f"queue {row.get('queue_s', 0):.4f}s  "
+          f"hbm {_fmt_bytes(row.get('hbm_byte_s', 0))}·s\n")
+
+
+def _render_qos(bundle: dict, w) -> None:
+    qos = _section(bundle, "qos")
+    classes = (qos or {}).get("classes")
+    if not isinstance(classes, dict) or not classes:
+        return
+    w("\n--- qos classes ---\n")
+    for name in sorted(classes):
+        c = classes[name] or {}
+        w(f"  {name:<12} weight={c.get('weight', '?')} "
+          f"throttle={c.get('throttle_ratio', c.get('rate_ratio', '?'))} "
+          f"inflight={c.get('inflight', '?')} "
+          f"shed={c.get('shed', c.get('sheds', '?'))}\n")
+
+
+# -- diff ----------------------------------------------------------------------
+
+
+def _journal_counts(bundle: dict) -> dict[str, int]:
+    counts: dict[str, int] = {}
+    for e in (_section(bundle, "journal") or {}).get("events") or []:
+        key = f"{e.get('category')}.{e.get('name')}"
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def _signal_lasts(bundle: dict) -> dict[str, float]:
+    lasts: dict[str, float] = {}
+    ts = _section(bundle, "timeseries") or _section(bundle,
+                                                   "fleet_timeseries")
+    for s in (ts or {}).get("samples") or []:
+        for name, value in (s.get("signals") or {}).items():
+            if isinstance(value, dict):
+                for mname, v in value.items():
+                    lasts[f"{name}[{mname}]"] = float(v)
+            else:
+                lasts[name] = float(value)
+    return lasts
+
+
+def _tenant_device(bundle: dict) -> dict[str, float]:
+    costs = _section(bundle, "costs") or _section(bundle, "fleet_costs")
+    tenants = (costs or {}).get("tenants") or {}
+    return {t: float(row.get("device_s") or 0)
+            for t, row in tenants.items() if isinstance(row, dict)}
+
+
+def render_diff(a: dict, b: dict, out=None) -> None:
+    """What changed from bundle ``a`` to bundle ``b``."""
+    w = (out or sys.stdout).write
+    w(f"=== bundle diff: {a.get('id')} -> {b.get('id')} ===\n")
+    w(f"triggers  : {a.get('trigger')} -> {b.get('trigger')}\n")
+    w(f"incidents : {a.get('incident')} -> {b.get('incident')}\n")
+    try:
+        dt = float(b.get("ts_wall", 0)) - float(a.get("ts_wall", 0))
+        w(f"elapsed   : {dt:+.3f}s between captures\n")
+    except (TypeError, ValueError):
+        pass
+
+    ca, cb = _journal_counts(a), _journal_counts(b)
+    keys = sorted(set(ca) | set(cb),
+                  key=lambda k: -abs(cb.get(k, 0) - ca.get(k, 0)))
+    changed = [k for k in keys if ca.get(k, 0) != cb.get(k, 0)]
+    w(f"\n--- journal deltas ({len(changed)} event kinds changed) ---\n")
+    for k in changed:
+        w(f"  {k:<32} {ca.get(k, 0):>4} -> {cb.get(k, 0):<4} "
+          f"({cb.get(k, 0) - ca.get(k, 0):+d})\n")
+
+    la, lb = _signal_lasts(a), _signal_lasts(b)
+    moved = []
+    for k in sorted(set(la) | set(lb)):
+        va, vb = la.get(k), lb.get(k)
+        if va is None or vb is None or abs(vb - va) > 1e-9:
+            moved.append((k, va, vb))
+    w(f"\n--- signal drift ({len(moved)} series moved) ---\n")
+    for k, va, vb in moved:
+        fa = "-" if va is None else f"{va:.4g}"
+        fb = "-" if vb is None else f"{vb:.4g}"
+        w(f"  {k:<32} {fa:>10} -> {fb}\n")
+
+    ta, tb = _tenant_device(a), _tenant_device(b)
+    if ta or tb:
+        w("\n--- tenant device-seconds ---\n")
+        for t in sorted(set(ta) | set(tb)):
+            w(f"  {t:<16} {ta.get(t, 0):.4f}s -> {tb.get(t, 0):.4f}s "
+              f"({tb.get(t, 0) - ta.get(t, 0):+.4f}s)\n")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Render or diff incident-blackbox bundles.")
+    parser.add_argument("source", nargs="+",
+                        help="bundle file or server base URL "
+                             "(two files with --diff)")
+    parser.add_argument("--id", default="",
+                        help="bundle id to fetch from a live server "
+                             "(default: newest)")
+    parser.add_argument("--diff", action="store_true",
+                        help="diff two bundles instead of rendering one")
+    parser.add_argument("--timeout", type=float, default=10.0)
+    args = parser.parse_args(argv)
+    if args.diff:
+        if len(args.source) != 2:
+            parser.error("--diff needs exactly two bundle sources")
+        render_diff(load_bundle(args.source[0], timeout_s=args.timeout),
+                    load_bundle(args.source[1], timeout_s=args.timeout))
+        return 0
+    if len(args.source) != 1:
+        parser.error("exactly one bundle source expected "
+                     "(or use --diff with two)")
+    render(load_bundle(args.source[0], args.id, timeout_s=args.timeout))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,13 +1,13 @@
 #!/usr/bin/env bash
 # CI gate: tier-1 tests + chaos suite + live endpoint lint + autotune
 # e2e + router e2e + fused kernel parity + DLRM e2e + shm ring e2e +
-# staged fan-in e2e + QoS gauntlet smoke + bench gate + static
-# analysis / lockdep gate.
+# staged fan-in e2e + QoS gauntlet smoke + closed-loop smoke +
+# incident blackbox + bench gate + static analysis / lockdep gate.
 #
 #   tools/ci_check.sh            # everything (tier-1 already includes chaos)
 #   tools/ci_check.sh --fast     # all stages except tier-1
 #
-# Twelve stages:
+# Fourteen stages:
 #   1. tier-1: the full fast suite (ROADMAP.md contract; excludes `slow`).
 #   2. chaos: the deterministic fault-injection suite alone (`-m chaos`) —
 #      redundant with tier-1 when stage 1 runs, but the -m filter proves
@@ -68,10 +68,20 @@
 #      the tpu_qos_* families must render promlint-clean in both
 #      exposition dialects. The full routed gauntlet (restore edge,
 #      per-class p99 SLOs, adversarial mix) runs in bench.py and is
-#      gated by stage 11 when BENCH_HISTORY.json is present.
-#  11. bench gate: tools/bench_summary.py --check fails the build when the
+#      gated by stage 13 when BENCH_HISTORY.json is present.
+#  11. closed-loop smoke: the self-drive dispatch retune must fire on
+#      probe-shaped sparse traffic (journal autotune.dispatch_tighten,
+#      override applied) and restore on quiet, with the loop state
+#      rendered by profile_report --loops.
+#  12. incident blackbox: a live manual capture (POST /v2/debug/capture)
+#      must write a bundle whose index lists identically over HTTP and
+#      gRPC, the bundle's journal/timeseries/traces/fingerprint
+#      sections must be intact, tools/blackbox_report.py must render
+#      it, and the tpu_blackbox_* families must lint clean in both
+#      exposition dialects.
+#  13. bench gate: tools/bench_summary.py --check fails the build when the
 #      newest BENCH_HISTORY.json run regressed any probe's p99 by >25%.
-#  12. analysis gate: tpulint (python -m tools.analyze) against the
+#  14. analysis gate: tpulint (python -m tools.analyze) against the
 #      reviewed baseline, promlint --definitions over every metric
 #      registration site, and the concurrency-heavy tier-1 subset
 #      re-run under CLIENT_TPU_LOCKDEP=1 so the runtime lock-order and
@@ -86,7 +96,7 @@ FAST=0
 rc=0
 
 if [ "$FAST" -eq 0 ]; then
-    echo "=== stage 1/13: tier-1 test suite ==="
+    echo "=== stage 1/14: tier-1 test suite ==="
     rm -f /tmp/_t1.log
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
@@ -96,15 +106,15 @@ if [ "$FAST" -eq 0 ]; then
         | tr -cd . | wc -c)"
     [ "$t1" -ne 0 ] && { echo "tier-1 FAILED (exit $t1)"; rc=1; }
 else
-    echo "=== stage 1/13: tier-1 skipped (--fast) ==="
+    echo "=== stage 1/14: tier-1 skipped (--fast) ==="
 fi
 
-echo "=== stage 2/13: chaos (fault-injection) suite ==="
+echo "=== stage 2/14: chaos (fault-injection) suite ==="
 timeout -k 10 300 python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 [ $? -ne 0 ] && { echo "chaos suite FAILED"; rc=1; }
 
-echo "=== stage 3/13: live scrape (promlint + ops endpoints) ==="
+echo "=== stage 3/14: live scrape (promlint + ops endpoints) ==="
 SCRAPE_DIR=$(mktemp -d)
 # Pinned peaks: MFU/MBU need a peak spec, and the CI host is a CPU whose
 # device kind resolves to "peaks unknown" — the override also exercises
@@ -227,7 +237,7 @@ grep -q "^tpu_mbu{" "$SCRAPE_DIR/metrics.om.txt" \
     || { echo "tpu_mbu missing from openmetrics dialect"; rc=1; }
 rm -rf "$SCRAPE_DIR"
 
-echo "=== stage 4/13: autotune e2e (promotion + metrics) ==="
+echo "=== stage 4/14: autotune e2e (promotion + metrics) ==="
 TUNE_DIR=$(mktemp -d)
 CLIENT_TPU_AUTOTUNE='{"interval_s": 0.2, "cooldown_s": 0.5}' \
 timeout -k 10 300 python - "$TUNE_DIR" <<'EOF'
@@ -303,7 +313,7 @@ python tools/promlint.py --openmetrics "$TUNE_DIR/metrics.om.txt" \
     || { echo "promlint (autotune openmetrics) FAILED"; rc=1; }
 rm -rf "$TUNE_DIR"
 
-echo "=== stage 5/13: router e2e (balance + roll-drain + fleet + metrics) ==="
+echo "=== stage 5/14: router e2e (balance + roll-drain + fleet + metrics) ==="
 ROUTER_DIR=$(mktemp -d)
 timeout -k 10 300 python - "$ROUTER_DIR" <<'EOF'
 import json
@@ -477,7 +487,7 @@ grep -q "^tpu_fleet_drift_score{" "$ROUTER_DIR/metrics.om.txt" \
     || { echo "tpu_fleet_drift_score missing from openmetrics dialect"; rc=1; }
 rm -rf "$ROUTER_DIR"
 
-echo "=== stage 6/13: fused decode kernel parity (interpret) + wave metrics ==="
+echo "=== stage 6/14: fused decode kernel parity (interpret) + wave metrics ==="
 # The Pallas decode kernel and the sharded KV arena run in interpret mode
 # on CPU (docs/KERNELS.md): this stage proves (a) fused == reference on
 # the fast parity subset, (b) an engine on the fused path emits
@@ -548,7 +558,7 @@ python tools/promlint.py --openmetrics "$KERNEL_DIR/metrics.om.txt" \
     || { echo "promlint (kernel openmetrics) FAILED"; rc=1; }
 rm -rf "$KERNEL_DIR"
 
-echo "=== stage 7/13: dlrm e2e (lookup-bucket promotion + emb metrics) ==="
+echo "=== stage 7/14: dlrm e2e (lookup-bucket promotion + emb metrics) ==="
 DLRM_DIR=$(mktemp -d)
 CLIENT_TPU_AUTOTUNE='{"interval_s": 0.2, "cooldown_s": 0.5}' \
 timeout -k 10 300 python - "$DLRM_DIR" <<'EOF'
@@ -626,7 +636,7 @@ python tools/promlint.py --openmetrics "$DLRM_DIR/metrics.om.txt" \
     || { echo "promlint (dlrm openmetrics) FAILED"; rc=1; }
 rm -rf "$DLRM_DIR"
 
-echo "=== stage 8/13: shm ring e2e (producer process + doorbell + metrics) ==="
+echo "=== stage 8/14: shm ring e2e (producer process + doorbell + metrics) ==="
 RING_DIR=$(mktemp -d)
 timeout -k 10 300 python - "$RING_DIR" <<'EOF'
 import json
@@ -740,7 +750,7 @@ python tools/promlint.py --openmetrics "$RING_DIR/metrics.om.txt" \
     || { echo "promlint (shm ring openmetrics) FAILED"; rc=1; }
 rm -rf "$RING_DIR"
 
-echo "=== stage 9/13: staged fan-in e2e (8 producer processes + reaper metrics) ==="
+echo "=== stage 9/14: staged fan-in e2e (8 producer processes + reaper metrics) ==="
 FANIN_DIR=$(mktemp -d)
 timeout -k 10 300 python - "$FANIN_DIR" <<'EOF'
 import json
@@ -845,7 +855,7 @@ python tools/promlint.py --openmetrics "$FANIN_DIR/metrics.om.txt" \
     || { echo "promlint (fan-in openmetrics) FAILED"; rc=1; }
 rm -rf "$FANIN_DIR"
 
-echo "=== stage 10/13: qos gauntlet smoke (flash crowd -> throttle + metrics) ==="
+echo "=== stage 10/14: qos gauntlet smoke (flash crowd -> throttle + metrics) ==="
 QOS_DIR=$(mktemp -d)
 CLIENT_TPU_SLO='{"availability": 0.999, "latency_threshold_us": 40000.0,
     "latency_target": 0.9, "fast_burn_threshold": 14.4,
@@ -1011,7 +1021,7 @@ grep -q "^tpu_qos_" "$QOS_DIR/metrics.om.txt" \
     || { echo "tpu_qos_* missing from openmetrics dialect"; rc=1; }
 rm -rf "$QOS_DIR"
 
-echo "=== stage 11/13: closed-loop smoke (self-drive dispatch retune fires + clears) ==="
+echo "=== stage 11/14: closed-loop smoke (self-drive dispatch retune fires + clears) ==="
 SD_DIR=$(mktemp -d)
 CLIENT_TPU_SELFDRIVE='{"interval_s": 0.2, "min_calls": 4, "fill_low": 0.8,
     "cooldown_s": 0.5, "restore_hold_s": 0.5, "wait_high_s": 5.0}' \
@@ -1123,7 +1133,110 @@ python tools/profile_report.py --loops "$SD_DIR/profile.json" \
     || { echo "profile_report --loops FAILED"; rc=1; }
 rm -rf "$SD_DIR"
 
-echo "=== stage 12/13: bench p99 regression gate ==="
+echo "=== stage 12/14: incident blackbox (capture + both transports + report) ==="
+BB_DIR=$(mktemp -d)
+# @file spec so the CI run also exercises that arm of the env grammar.
+printf '{"dir": "%s/bundles"}\n' "$BB_DIR" > "$BB_DIR/bb.json"
+CLIENT_TPU_BLACKBOX="@$BB_DIR/bb.json" \
+timeout -k 10 180 python - "$BB_DIR" <<'EOF'
+import json
+import sys
+from urllib.request import Request, urlopen
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+from client_tpu.engine import TpuEngine
+from client_tpu.engine.types import InferRequest
+from client_tpu.models import build_repository
+from client_tpu.observability.tracing import TraceContext
+from client_tpu.server import GrpcInferenceServer, HttpInferenceServer
+
+out_dir = sys.argv[1]
+engine = TpuEngine(build_repository(["simple"]), warmup=False)
+srv = HttpInferenceServer(engine, host="127.0.0.1", port=0).start()
+gsrv = GrpcInferenceServer(engine, host="127.0.0.1", port=0).start()
+gclient = None
+try:
+    if engine.blackbox is None:
+        sys.exit("CLIENT_TPU_BLACKBOX set but engine built no recorder")
+    # One traced inference so the bundle's worst-request section is
+    # non-trivial.
+    engine.infer(InferRequest(
+        model_name="simple",
+        inputs={"INPUT0": np.zeros((1, 16), dtype=np.int32),
+                "INPUT1": np.zeros((1, 16), dtype=np.int32)},
+        trace=TraceContext.new(),
+    ), timeout_s=120)
+    engine.recorder.tick()  # at least one flight-recorder sample
+    base = f"http://{srv.url}"
+    cap = json.load(urlopen(Request(
+        f"{base}/v2/debug/capture",
+        data=json.dumps({"note": "ci manual capture"}).encode(),
+        headers={"Content-Type": "application/json"}), timeout=30))
+    if cap.get("trigger") != "manual" or not cap.get("id"):
+        sys.exit(f"manual capture failed: {str(cap)[:300]}")
+    index = json.load(urlopen(f"{base}/v2/debug/bundles", timeout=10))
+    ids = [b["id"] for b in index.get("bundles", [])]
+    if ids != [cap["id"]]:
+        sys.exit(f"HTTP index mismatch: {ids} vs {cap['id']}")
+    bundle = json.load(urlopen(
+        f"{base}/v2/debug/bundles/{cap['id']}", timeout=10))
+    secs = bundle.get("sections", {})
+    for want in ("journal", "timeseries", "traces", "fingerprint"):
+        if not isinstance(secs.get(want), dict) \
+                or "error" in secs[want]:
+            sys.exit(f"bundle section {want} bad: "
+                     f"{str(secs.get(want))[:200]}")
+    if not secs["journal"].get("events"):
+        sys.exit("bundle journal section is empty")
+    with open(f"{out_dir}/bundle.json", "w") as f:
+        json.dump(bundle, f)
+    # Transport parity: the gRPC face must list the same bundle and a
+    # second manual capture must dedupe nothing (manual never cools).
+    gclient = grpcclient.InferenceServerClient(gsrv.url)
+    gids = [b["id"] for b in gclient.get_bundles().get("bundles", [])]
+    if gids != ids:
+        sys.exit(f"gRPC index mismatch: {gids} vs {ids}")
+    gcap = gclient.capture_bundle(note="ci grpc capture")
+    if not gcap.get("id") or gcap["id"] == cap["id"]:
+        sys.exit(f"gRPC capture failed: {str(gcap)[:300]}")
+    classic = urlopen(f"{base}/metrics", timeout=10).read().decode()
+    om = urlopen(Request(f"{base}/metrics", headers={
+        "Accept": "application/openmetrics-text"}), timeout=10).read().decode()
+    with open(f"{out_dir}/metrics.txt", "w") as f:
+        f.write(classic)
+    with open(f"{out_dir}/metrics.om.txt", "w") as f:
+        f.write(om)
+    if 'tpu_blackbox_captures_total{trigger="manual"} 2' not in classic:
+        sys.exit("tpu_blackbox_captures_total{trigger=manual} != 2")
+    print(f"blackbox ok: bundle {cap['id']} "
+          f"({bundle.get('trigger')}, {len(secs)} sections), "
+          f"grpc bundle {gcap['id']}")
+finally:
+    if gclient is not None:
+        gclient.close()
+    gsrv.stop()
+    srv.stop()
+    engine.shutdown()
+EOF
+[ $? -ne 0 ] && { echo "blackbox smoke FAILED"; rc=1; }
+python tools/blackbox_report.py "$BB_DIR/bundle.json" \
+    > "$BB_DIR/report.txt" \
+    && grep -q "incident bundle" "$BB_DIR/report.txt" \
+    && grep -q "journal timeline" "$BB_DIR/report.txt" \
+    || { echo "blackbox_report render FAILED"; rc=1; }
+python tools/promlint.py "$BB_DIR/metrics.txt" \
+    || { echo "promlint blackbox (classic) FAILED"; rc=1; }
+python tools/promlint.py --openmetrics "$BB_DIR/metrics.om.txt" \
+    || { echo "promlint blackbox (openmetrics) FAILED"; rc=1; }
+grep -q "^tpu_blackbox_" "$BB_DIR/metrics.txt" \
+    || { echo "tpu_blackbox_* missing from classic dialect"; rc=1; }
+grep -q "^tpu_blackbox_" "$BB_DIR/metrics.om.txt" \
+    || { echo "tpu_blackbox_* missing from openmetrics dialect"; rc=1; }
+rm -rf "$BB_DIR"
+
+echo "=== stage 13/14: bench p99 regression gate ==="
 if [ -f BENCH_HISTORY.json ]; then
     python tools/bench_summary.py --check \
         || { echo "bench gate FAILED"; rc=1; }
@@ -1131,7 +1244,7 @@ else
     echo "no BENCH_HISTORY.json — skipping"
 fi
 
-echo "=== stage 13/13: static analysis + lockdep gate ==="
+echo "=== stage 14/14: static analysis + lockdep gate ==="
 python -m tools.analyze --baseline tools/analyze/baseline.json \
     || { echo "tpulint FAILED"; rc=1; }
 python tools/promlint.py --definitions client_tpu \
